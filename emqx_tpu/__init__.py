@@ -1,0 +1,23 @@
+"""emqx_tpu — a TPU-native MQTT broker framework.
+
+A ground-up re-design of the capabilities of EMQX 5.8 (reference:
+/root/reference) for TPU hardware: the publish hot path — wildcard
+topic-filter matching (``emqx_router``/``emqx_trie`` semantics,
+apps/emqx/src/emqx_trie_search.erl:30-97), fan-out, and rule-engine
+FROM/WHERE predicate evaluation — is batched into an array-form
+trie-automaton kernel on JAX/XLA, while a host-side trie remains the
+low-latency fallback and correctness oracle.
+
+Layout:
+  topic       — topic parse/validate/match semantics (emqx_topic.erl parity)
+  codec       — MQTT 3.1/3.1.1/5.0 wire codec (emqx_frame.erl parity)
+  ops         — matching engines: host trie oracle, token dictionary,
+                array automaton builder, batched JAX matcher
+  router      — route table: exact index + wildcard automaton + delta overlay
+  broker      — sessions, channels, dispatch, retainer, shared subs, hooks
+  rules       — SQL rule engine compiled onto the same matcher
+  parallel    — jax.sharding Mesh layouts, multi-chip matcher, cluster links
+  utils       — config, metrics, logging
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,2 @@
+"""Matching engines: host trie (oracle/fallback), token dictionary,
+array-form automaton, batched JAX matcher."""

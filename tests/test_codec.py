@@ -1,0 +1,240 @@
+"""MQTT codec tests: golden byte vectors, round-trip property tests
+(parse(serialize(p)) == p over randomized packets — the
+prop_emqx_frame.erl pattern), incremental-feed fragmentation, and
+malformed-frame rejection."""
+
+import random
+
+import pytest
+
+from emqx_tpu.codec import mqtt as m
+
+
+def rt(pkt, ver=m.MQTT_V5):
+    """serialize -> parse round trip through the stream parser."""
+    data = m.serialize(pkt, ver)
+    p = m.StreamParser(version=ver)
+    out = list(p.feed(data))
+    assert len(out) == 1
+    return out[0]
+
+
+# ---------------------------------------------------------------- golden
+
+def test_pingreq_bytes():
+    assert m.serialize(m.Pingreq()) == b"\xc0\x00"
+    assert m.serialize(m.Pingresp()) == b"\xd0\x00"
+
+
+def test_publish_qos0_v4_bytes():
+    # DUP=0 QoS=0 RETAIN=1, topic "a/b", payload "hi"
+    data = m.serialize(
+        m.Publish(topic="a/b", payload=b"hi", retain=True), m.MQTT_V4
+    )
+    assert data == b"\x31\x07\x00\x03a/bhi"
+
+
+def test_connect_v4_golden():
+    pkt = m.Connect(client_id="cid", proto_ver=4, clean_start=True,
+                    keepalive=30)
+    data = m.serialize(pkt)
+    out = rt(pkt)
+    assert out.client_id == "cid" and out.proto_ver == 4
+    assert data[0] == 0x10
+    assert b"MQTT" in data
+
+
+def test_varint_boundaries():
+    for n in (0, 127, 128, 16383, 16384, 2097151, 2097152, 268435455):
+        buf = m._varint(n)
+        r = m._Reader(buf)
+        assert r.varint() == n
+    with pytest.raises(m.MqttError):
+        m._varint(268435456)
+
+
+# ------------------------------------------------------------ round trip
+
+RNG = random.Random(7)
+
+
+def rand_props(rng, publish=False):
+    props = {}
+    if rng.random() < 0.5:
+        props["user_property"] = [("k", "v"), ("k2", "vv")]
+    if rng.random() < 0.3:
+        props["message_expiry_interval"] = rng.randint(0, 2**32 - 1)
+    if publish and rng.random() < 0.3:
+        props["subscription_identifier"] = [rng.randint(1, 1000)]
+        props["content_type"] = "application/json"
+    return props
+
+
+def rand_publish(rng, ver):
+    qos = rng.randint(0, 2)
+    return m.Publish(
+        topic=rng.choice(["a", "a/b/c", "dev/1/温度", "x/" + "y" * 100]),
+        payload=bytes(rng.getrandbits(8) for _ in range(rng.randint(0, 64))),
+        qos=qos,
+        retain=rng.random() < 0.5,
+        dup=qos > 0 and rng.random() < 0.5,
+        packet_id=rng.randint(1, 65535) if qos else None,
+        properties=rand_props(rng, publish=True) if ver == 5 else {},
+    )
+
+
+@pytest.mark.parametrize("ver", [m.MQTT_V4, m.MQTT_V5])
+def test_publish_roundtrip(ver):
+    for _ in range(200):
+        pkt = rand_publish(RNG, ver)
+        assert rt(pkt, ver) == pkt
+
+
+@pytest.mark.parametrize("ver", [m.MQTT_V3, m.MQTT_V4, m.MQTT_V5])
+def test_connect_roundtrip(ver):
+    for _ in range(100):
+        will = None
+        if RNG.random() < 0.5:
+            will = m.Will(
+                topic="will/t",
+                payload=b"gone",
+                qos=RNG.randint(0, 2),
+                retain=RNG.random() < 0.5,
+                properties={"will_delay_interval": 5} if ver == 5 else {},
+            )
+        pkt = m.Connect(
+            client_id="c-" + str(RNG.randint(0, 999)),
+            proto_ver=ver,
+            proto_name="MQIsdp" if ver == 3 else "MQTT",
+            clean_start=RNG.random() < 0.5,
+            keepalive=RNG.randint(0, 65535),
+            username="u" if RNG.random() < 0.5 else None,
+            password=b"p" if RNG.random() < 0.5 else None,
+            will=will,
+            properties={"session_expiry_interval": 120} if ver == 5 else {},
+        )
+        if pkt.password is not None and pkt.username is None and ver != 5:
+            pkt.password = None  # [MQTT-3.1.2-22]: password requires username
+        assert rt(pkt) == pkt
+
+
+@pytest.mark.parametrize("ver", [m.MQTT_V4, m.MQTT_V5])
+def test_sub_unsub_roundtrip(ver):
+    subs = [
+        m.Subscription("a/+/b", qos=1),
+        m.Subscription("$share/g/x/#", qos=2, no_local=ver == 5,
+                       retain_as_published=ver == 5, retain_handling=2 if ver == 5 else 0),
+    ]
+    pkt = m.Subscribe(packet_id=10, subscriptions=subs)
+    out = rt(pkt, ver)
+    if ver == 5:
+        assert out == pkt
+    else:
+        assert [s.topic_filter for s in out.subscriptions] == ["a/+/b", "$share/g/x/#"]
+        assert [s.qos for s in out.subscriptions] == [1, 2]
+    assert rt(m.Suback(packet_id=10, reason_codes=[0, 1, 0x80]), ver) == m.Suback(
+        packet_id=10, reason_codes=[0, 1, 0x80]
+    )
+    un = m.Unsubscribe(packet_id=11, topic_filters=["a/+/b", "c"])
+    assert rt(un, ver) == un
+
+
+@pytest.mark.parametrize("cls", [m.Puback, m.Pubrec, m.Pubrel, m.Pubcomp])
+@pytest.mark.parametrize("ver", [m.MQTT_V4, m.MQTT_V5])
+def test_acks_roundtrip(cls, ver):
+    pkt = cls(packet_id=77)
+    assert rt(pkt, ver) == pkt
+    if ver == 5:
+        pkt = cls(packet_id=78, reason_code=0x10,
+                  properties={"reason_string": "no one"})
+        assert rt(pkt, ver) == pkt
+
+
+def test_disconnect_auth_roundtrip():
+    assert rt(m.Disconnect()) == m.Disconnect()
+    d = m.Disconnect(reason_code=0x8E, properties={"reason_string": "bye"})
+    assert rt(d) == d
+    a = m.Auth(reason_code=0x18, properties={"authentication_method": "SCRAM"})
+    assert rt(a) == a
+    # v4 disconnect has an empty body
+    assert m.serialize(m.Disconnect(), m.MQTT_V4) == b"\xe0\x00"
+
+
+# ------------------------------------------------------- stream behavior
+
+def test_byte_at_a_time_feed():
+    pkts = [
+        m.Connect(client_id="c1", proto_ver=5),
+        m.Publish(topic="t/1", payload=b"x" * 300, qos=1, packet_id=5),
+        m.Pingreq(),
+    ]
+    stream = b"".join(m.serialize(p) for p in pkts)
+    parser = m.StreamParser()
+    got = []
+    for i in range(len(stream)):
+        got += list(parser.feed(stream[i : i + 1]))
+    assert got == pkts
+
+
+def test_version_locked_from_connect():
+    parser = m.StreamParser()
+    c = m.Connect(client_id="c", proto_ver=4)
+    pub = m.Publish(topic="t", payload=b"p")
+    out = list(parser.feed(m.serialize(c) + m.serialize(pub, 4)))
+    assert parser.version == 4
+    assert out[1].topic == "t"
+
+
+def test_max_packet_size_guard():
+    parser = m.StreamParser(max_packet_size=64)
+    big = m.serialize(m.Publish(topic="t", payload=b"z" * 200))
+    with pytest.raises(m.MqttError):
+        list(parser.feed(big))
+
+
+@pytest.mark.parametrize(
+    "raw",
+    [
+        b"\x00\x00",          # type 0
+        b"\xc1\x00",          # PINGREQ with flags
+        b"\x60\x02\x00\x01",  # PUBREL with flags 0 (must be 2)
+        b"\x10\x02\x00\x00",  # CONNECT truncated body
+        b"\x36\x03\x00\x01a", # qos3 publish
+    ],
+)
+def test_malformed(raw):
+    parser = m.StreamParser()
+    with pytest.raises(m.MqttError):
+        list(parser.feed(raw))
+
+
+def test_unknown_property_rejected():
+    # CONNACK v5 with property id 0x7F
+    body = b"\x00\x00" + b"\x02\x7f\x00"
+    raw = bytes([m.CONNACK << 4, len(body)]) + body
+    with pytest.raises(m.MqttError):
+        list(m.StreamParser().feed(raw))
+
+
+def test_unconsumed_feed_still_buffers():
+    # feed() must consume its chunk even if the iterator is dropped
+    parser = m.StreamParser()
+    ping = b"\xc0\x00"
+    parser.feed(ping[:1])  # iterator discarded
+    assert len(list(parser.feed(ping[1:]))) == 1
+
+
+def test_password_without_username_rejected_v4():
+    pkt = m.Connect(client_id="c", proto_ver=4, password=b"p")
+    raw = m.serialize(pkt)
+    with pytest.raises(m.MqttError):
+        list(m.StreamParser().feed(raw))
+    # v5 allows password without username
+    pkt5 = m.Connect(client_id="c", proto_ver=5, password=b"p")
+    assert rt(pkt5).password == b"p"
+
+
+def test_many_frames_one_chunk():
+    chunk = m.serialize(m.Pingreq()) * 5000
+    got = list(m.StreamParser().feed(chunk))
+    assert len(got) == 5000

@@ -1,0 +1,121 @@
+"""Topic semantics tests — case set mirrors the reference's
+emqx_topic_SUITE coverage (match/validate/words/$share)."""
+
+import pytest
+
+from emqx_tpu import topic as T
+
+
+def test_words():
+    assert T.words("a/b/c") == ("a", "b", "c")
+    assert T.words("a//b") == ("a", "", "b")
+    assert T.words("/a") == ("", "a")
+    assert T.words("a/") == ("a", "")
+    assert T.words("a") == ("a",)
+    assert T.levels("a/b/c") == 3
+    assert T.levels("/") == 2
+
+
+MATCH_CASES = [
+    # (name, filter, expected)
+    ("a/b/c", "a/b/c", True),
+    ("a/b/c", "a/+/c", True),
+    ("a/b/c", "a/#", True),
+    ("a/b/c", "#", True),
+    ("a/b/c", "+/+/+", True),
+    ("a/b/c", "a/b", False),
+    ("a/b/c", "a/b/c/d", False),
+    ("a/b/c", "a/+", False),
+    ("a/b/c", "+", False),
+    ("a/b/c", "b/+/c", False),
+    # '#' matches the parent level itself
+    ("sport", "sport/#", True),
+    ("sport/tennis", "sport/#", True),
+    ("sport", "sport/+", False),
+    # '+' matches empty levels
+    ("a//c", "a/+/c", True),
+    ("/b", "+/b", True),
+    ("/", "+/+", True),
+    ("/", "#", True),
+    ("a/", "a/+", True),
+    # '$' topics: no root wildcard match
+    ("$SYS/broker", "#", False),
+    ("$SYS/broker", "+/broker", False),
+    ("$SYS/broker", "$SYS/#", True),
+    ("$SYS/broker", "$SYS/+", True),
+    ("$SYS/a/b", "$SYS/+/b", True),
+    ("$SYS", "#", False),
+    # '$' deeper than root is ordinary
+    ("a/$SYS/b", "a/+/b", True),
+    ("a/$x", "a/#", True),
+    # exactness
+    ("a/B", "a/b", False),
+    ("aa/b", "a/b", False),
+]
+
+
+@pytest.mark.parametrize("name,flt,exp", MATCH_CASES)
+def test_match(name, flt, exp):
+    assert T.match(name, flt) is exp
+
+
+def test_is_wildcard():
+    assert T.is_wildcard("a/+/b")
+    assert T.is_wildcard("#")
+    assert not T.is_wildcard("a/b")
+    # '+' embedded in a word is not a wildcard level (it is invalid, but
+    # wildcard detection is level-wise like emqx_topic:wildcard/1)
+    assert not T.is_wildcard("a+b/c")
+
+
+def test_validate_name():
+    T.validate_name("a/b/c")
+    T.validate_name("$SYS/x")
+    with pytest.raises(ValueError):
+        T.validate_name("a/+/b")
+    with pytest.raises(ValueError):
+        T.validate_name("a/#")
+    with pytest.raises(ValueError):
+        T.validate_name("")
+    with pytest.raises(ValueError):
+        T.validate_name("a\x00b")
+    with pytest.raises(ValueError):
+        T.validate_name("x" * 70000)
+
+
+def test_validate_filter():
+    T.validate_filter("a/+/b")
+    T.validate_filter("a/#")
+    T.validate_filter("#")
+    T.validate_filter("+")
+    T.validate_filter("/")
+    with pytest.raises(ValueError):
+        T.validate_filter("a/#/b")  # '#' not last
+    with pytest.raises(ValueError):
+        T.validate_filter("a/b#")  # '#' not whole level
+    with pytest.raises(ValueError):
+        T.validate_filter("a/b+/c")  # '+' not whole level
+    with pytest.raises(ValueError):
+        T.validate_filter("")
+
+
+def test_share_parse():
+    s = T.parse_share("$share/g1/a/b/+")
+    assert s == T.SharedFilter("g1", "a/b/+")
+    assert T.parse_share("a/b") is None
+    assert T.real_topic("$share/g/t") == "t"
+    assert T.real_topic("t/x") == "t/x"
+    with pytest.raises(ValueError):
+        T.parse_share("$share/g")  # no topic
+    with pytest.raises(ValueError):
+        T.parse_share("$share//t")  # empty group
+    with pytest.raises(ValueError):
+        T.parse_share("$share/g+/t")  # wildcard group
+    with pytest.raises(ValueError):
+        T.parse_share("$share/g/$share/h/t")  # nested
+
+
+def test_validate_shared_filter():
+    T.validate_filter("$share/group/a/+/b")
+    with pytest.raises(ValueError):
+        T.validate_filter("$share/gr/")

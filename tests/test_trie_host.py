@@ -1,0 +1,130 @@
+"""HostTrie oracle tests: hand cases + randomized equivalence against the
+brute-force word matcher (the property-test pattern the reference applies
+to its matchers, e.g. emqx_trie_search semantics cases)."""
+
+import random
+
+import pytest
+
+from emqx_tpu import topic as T
+from emqx_tpu.ops.trie_host import HostTrie
+
+
+def build(filters):
+    t = HostTrie()
+    for i, f in enumerate(filters):
+        t.insert(f, i)
+    return t
+
+
+def ids(t, name):
+    return t.match(name)
+
+
+def test_basic_match():
+    t = build(["a/b/c", "a/+/c", "a/#", "#", "x/y"])
+    assert ids(t, "a/b/c") == {0, 1, 2, 3}
+    assert ids(t, "a/z/c") == {1, 2, 3}
+    assert ids(t, "a") == {2, 3}
+    assert ids(t, "x/y") == {3, 4}
+    assert ids(t, "q") == {3}
+
+
+def test_dollar_exclusion():
+    t = build(["#", "+/broker", "$SYS/#", "$SYS/+"])
+    assert ids(t, "$SYS/broker") == {2, 3}
+    assert ids(t, "other/broker") == {0, 1}
+    assert ids(t, "$SYS") == {2}
+
+
+def test_hash_parent():
+    t = build(["sport/#"])
+    assert ids(t, "sport") == {0}
+    assert ids(t, "sport/tennis/x") == {0}
+    assert ids(t, "sports") == set()
+
+
+def test_empty_levels():
+    t = build(["a/+/c", "+/b", "a/+", "#"])
+    assert ids(t, "a//c") == {0, 3}
+    assert ids(t, "/b") == {1, 3}
+    assert ids(t, "a/") == {2, 3}
+
+
+def test_delete_and_replace():
+    t = HostTrie()
+    t.insert("a/+", "s1")
+    t.insert("a/#", "s2")
+    assert t.match("a/b") == {"s1", "s2"}
+    assert t.delete_id("s1")
+    assert t.match("a/b") == {"s2"}
+    assert not t.delete_id("s1")
+    # replace same id with a new filter
+    t.insert("c/d", "s2")
+    assert t.match("a/b") == set()
+    assert t.match("c/d") == {"s2"}
+    assert len(t) == 1
+
+
+def test_prune_keeps_shared_prefixes():
+    t = HostTrie()
+    t.insert("a/b/c", 1)
+    t.insert("a/b", 2)
+    t.delete_id(1)
+    assert t.match("a/b") == {2}
+    t.delete_id(2)
+    assert t.match("a/b") == set()
+    assert len(t._root.children) == 0
+
+
+WORDS = ["a", "b", "c", "dev", "42", "", "$SYS", "$x", "longish-word"]
+
+
+def rand_filter(rng):
+    n = rng.randint(1, 6)
+    ws = []
+    for i in range(n):
+        r = rng.random()
+        if r < 0.2:
+            ws.append("+")
+        elif r < 0.3 and i == n - 1:
+            ws.append("#")
+        else:
+            ws.append(rng.choice(WORDS))
+    return "/".join(ws)
+
+
+def rand_name(rng):
+    n = rng.randint(1, 6)
+    return "/".join(rng.choice(WORDS) for _ in range(n))
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_randomized_equivalence(seed):
+    rng = random.Random(seed)
+    filters = [rand_filter(rng) for _ in range(300)]
+    t = build(filters)
+    for _ in range(300):
+        name = rand_name(rng)
+        assert t.match(name) == t.match_brute(name), name
+
+
+def test_randomized_with_deletions():
+    rng = random.Random(99)
+    t = HostTrie()
+    alive = {}
+    for step in range(2000):
+        op = rng.random()
+        if op < 0.55 or not alive:
+            fid = rng.randint(0, 500)
+            f = rand_filter(rng)
+            t.insert(f, fid)
+            alive[fid] = f
+        else:
+            fid = rng.choice(list(alive))
+            assert t.delete_id(fid)
+            del alive[fid]
+        if step % 100 == 0:
+            name = rand_name(rng)
+            assert t.match(name) == t.match_brute(name)
+    assert len(t) == len(alive)
