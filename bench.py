@@ -1,0 +1,155 @@
+"""Headline benchmark: batched wildcard route matching on one chip.
+
+Reproduces BASELINE.json config 3 by default: ~1M mixed `+`/`#` wildcard
+subscriptions, Zipf-skewed publish stream, batch-matched on the device.
+North star (BASELINE.md): 1M publishes/s routed with p99 match < 1 ms.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Extra detail goes to BENCH_DETAILS.json, never stdout.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    import numpy as np
+
+    import jax
+
+    from emqx_tpu import topic as T
+    from emqx_tpu.ops.automaton import build_automaton
+    from emqx_tpu.ops.dictionary import TokenDict, encode_topics
+    from emqx_tpu.ops.match_kernel import match_batch
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform not in ("cpu",)
+    n_subs = int(os.environ.get("BENCH_SUBS", 1_000_000 if on_tpu else 50_000))
+    batch = int(os.environ.get("BENCH_BATCH", 4096))
+    iters = int(os.environ.get("BENCH_ITERS", 50 if on_tpu else 10))
+    f_width = int(os.environ.get("BENCH_F", 16))
+    m_cap = int(os.environ.get("BENCH_M", 128))
+    max_levels = 16
+    rng = np.random.default_rng(0)
+
+    log(f"platform={platform} subs={n_subs} batch={batch} iters={iters}")
+
+    # --- subscription set: fleet-telemetry-style mixed wildcards -------
+    t0 = time.perf_counter()
+    n_vehicles = max(n_subs // 2, 1)
+    filters = []
+    for i in range(n_subs):
+        kind = i % 10
+        if kind < 5:  # vehicles/<id>/sensors/#
+            filters.append((i, ("vehicles", f"v{i % n_vehicles}", "sensors", "#")))
+        elif kind < 7:
+            filters.append((i, ("dev", f"g{i % 997}", "+", f"d{i % 4999}")))
+        elif kind < 9:
+            filters.append((i, ("site", "+", "floor", f"f{i % 331}", "#")))
+        else:
+            filters.append((i, ("alerts", f"z{i % 53}", "+", "+")))
+    gen_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    tdict = TokenDict()
+    aut = build_automaton(filters, tdict, max_levels=max_levels)
+    build_s = time.perf_counter() - t0
+    log(
+        f"built automaton: nodes={aut.n_nodes} buckets={len(aut.ht_rows)} "
+        f"probes={aut.probes} kernel_levels={aut.kernel_levels} "
+        f"in {build_s:.2f}s (gen {gen_s:.2f}s)"
+    )
+
+    # --- publish stream: Zipf-skewed over the vehicle fleet ------------
+    zipf = rng.zipf(1.3, size=batch * iters) % n_vehicles
+    streams = []
+    for it in range(iters):
+        topics = []
+        for j in range(batch):
+            i = it * batch + j
+            k = i % 10
+            if k < 6:
+                topics.append(("vehicles", f"v{zipf[i]}", "sensors", "temp"))
+            elif k < 8:
+                topics.append(("dev", f"g{i % 997}", "x", f"d{i % 4999}"))
+            elif k < 9:
+                topics.append(("site", f"s{i % 7}", "floor", f"f{i % 331}", "a"))
+            else:
+                topics.append(("nomatch", f"q{i}"))
+        streams.append(encode_topics(tdict, topics, aut.kernel_levels))
+
+    dev_tables = tuple(jax.device_put(a) for a in aut.device_arrays())
+
+    def run(tokens, lengths, dollar):
+        return match_batch(
+            *dev_tables,
+            tokens,
+            lengths,
+            dollar,
+            probes=aut.probes,
+            f_width=f_width,
+            m_cap=m_cap,
+        )
+
+    # warmup / compile
+    t0 = time.perf_counter()
+    codes, counts, ovf = run(*streams[0])
+    counts.block_until_ready()
+    log(f"compile+first batch: {time.perf_counter() - t0:.2f}s; "
+        f"ovf={int(np.asarray(ovf).sum())} "
+        f"mean_matches={float(np.asarray(counts).mean()):.2f}")
+
+    lat = []
+    t_start = time.perf_counter()
+    for s in streams:
+        t0 = time.perf_counter()
+        codes, counts, ovf = run(*s)
+        counts.block_until_ready()
+        lat.append(time.perf_counter() - t0)
+    elapsed = time.perf_counter() - t_start
+
+    total_topics = batch * iters
+    rate = total_topics / elapsed
+    lat_ms = np.array(lat) * 1e3
+    p50, p99 = np.percentile(lat_ms, [50, 99])
+    per_topic_p99_us = p99 * 1e3 / batch
+    details = {
+        "platform": platform,
+        "n_subs": n_subs,
+        "batch": batch,
+        "iters": iters,
+        "build_s": build_s,
+        "nodes": aut.n_nodes,
+        "probes": aut.probes,
+        "rate_topics_per_s": rate,
+        "batch_latency_ms_p50": float(p50),
+        "batch_latency_ms_p99": float(p99),
+        "per_topic_amortized_us_p99": float(per_topic_p99_us),
+        "overflow_frac": float(np.asarray(ovf).mean()),
+        "mean_matches_per_topic": float(np.asarray(counts).mean()),
+    }
+    with open(os.path.join(os.path.dirname(__file__) or ".", "BENCH_DETAILS.json"), "w") as f:
+        json.dump(details, f, indent=2)
+    log(json.dumps(details))
+
+    print(
+        json.dumps(
+            {
+                "metric": "wildcard_topic_matches_per_sec_per_chip",
+                "value": round(rate, 1),
+                "unit": f"topics/s @ {n_subs} wildcard subs (batch p99 {p99:.2f} ms)",
+                "vs_baseline": round(rate / 1_000_000, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,201 @@
+"""MatchEngine: the subscription-matching core, TPU-accelerated.
+
+Mirrors the reference's v2 router split (/root/reference/apps/emqx/src/
+emqx_router.erl:476-525): exact (non-wildcard) filters in an O(1) host
+hash map (`?ROUTE_TAB` direct lookup), wildcard filters in an index —
+here a device-resident array automaton batch-matched by
+`ops.match_kernel`, not an ordered-set skip-scan.
+
+Subscription churn vs XLA immutability (SURVEY §7 "hard parts") is
+handled the way `emqx_router_syncer` batches route ops: mutations land
+in a host-side *delta* trie immediately (correct from the next match on)
+and are folded into a rebuilt device automaton once the delta passes a
+threshold.  Deletions are masked out of stale device results by fid.
+
+Any topic the kernel flags (frontier overflow, match-cap overflow, too
+deep) is re-matched on the `HostTrie` oracle, so results are always
+exact regardless of kernel capacity bounds.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Hashable, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from . import topic as T
+from .ops.automaton import Automaton, build_automaton
+from .ops.dictionary import TokenDict, encode_topics
+from .ops.trie_host import HostTrie
+
+
+class MatchEngine:
+    """Mutable filter set with batched matching.
+
+    ``use_device=None`` (default) auto-enables the JAX path when any
+    wildcard filters exist; ``False`` forces pure-host matching (the
+    reference-equivalent CPU path kept as fallback per BASELINE.json).
+    """
+
+    def __init__(
+        self,
+        max_levels: int = 16,
+        f_width: int = 16,
+        m_cap: int = 128,
+        rebuild_threshold: int = 4096,
+        use_device: Optional[bool] = None,
+    ) -> None:
+        self.max_levels = max_levels
+        self.f_width = f_width
+        self.m_cap = m_cap
+        self.rebuild_threshold = rebuild_threshold
+        self.use_device = use_device
+        self._exact: Dict[str, Set[Hashable]] = {}
+        self._wild = HostTrie()  # full wildcard set: fallback + rebuild source
+        self._delta = HostTrie()  # wildcard filters added since last build
+        self._deep = HostTrie()  # filters too deep for the device index
+        self._by_fid: Dict[Hashable, str] = {}
+        self._deleted: Set[Hashable] = set()  # deleted since last build
+        self._tdict = TokenDict()
+        self._aut: Optional[Automaton] = None
+        self._dev: Optional[Tuple] = None  # device copies of table arrays
+        self._base_fids: Set[Hashable] = set()
+
+    # ------------------------------------------------------------- mutation
+
+    def insert(self, flt: str, fid: Hashable) -> None:
+        T.validate_filter(flt)
+        if fid in self._by_fid:
+            if self._by_fid[fid] == flt:
+                return
+            self.delete(fid)
+        self._by_fid[fid] = flt
+        if T.is_wildcard(flt):
+            self._wild.insert(flt, fid)
+            ws = T.words(flt)
+            body_depth = len(ws) - (1 if ws[-1] == "#" else 0)
+            if body_depth > self.max_levels:
+                self._deep.insert(flt, fid)
+            else:
+                self._delta.insert(flt, fid)
+                self._deleted.discard(fid)
+                if len(self._delta) >= self.rebuild_threshold:
+                    self.rebuild()
+        else:
+            self._exact.setdefault(flt, set()).add(fid)
+
+    def delete(self, fid: Hashable) -> bool:
+        flt = self._by_fid.pop(fid, None)
+        if flt is None:
+            return False
+        if T.is_wildcard(flt):
+            self._wild.delete_id(fid)
+            self._delta.delete_id(fid)
+            self._deep.delete_id(fid)
+            if fid in self._base_fids:
+                self._deleted.add(fid)
+        else:
+            ids = self._exact.get(flt)
+            if ids is not None:
+                ids.discard(fid)
+                if not ids:
+                    del self._exact[flt]
+        return True
+
+    def __len__(self) -> int:
+        return len(self._by_fid)
+
+    # -------------------------------------------------------------- rebuild
+
+    def rebuild(self, hash_buckets: int = 0) -> None:
+        """Fold the delta into a fresh device automaton snapshot."""
+        filters = [
+            (fid, ws)
+            for fid, ws in self._wild.filters()
+            if fid not in self._deep
+        ]
+        self._aut = build_automaton(
+            filters, self._tdict, self.max_levels, hash_buckets=hash_buckets
+        )
+        self._base_fids = {fid for fid, _ in filters}
+        self._delta = HostTrie()
+        self._deleted = set()
+        self._dev = None  # lazily device_put on first device match
+
+    def _device_tables(self):
+        if self._dev is None:
+            import jax
+
+            self._dev = tuple(
+                jax.device_put(a) for a in self._aut.device_arrays()
+            )
+        return self._dev
+
+    # -------------------------------------------------------------- match
+
+    def match(self, topic: str) -> Set[Hashable]:
+        return self.match_batch([topic])[0]
+
+    def match_host(self, topic_words: T.Words) -> Set[Hashable]:
+        """Pure-host exact match (oracle path)."""
+        out = set(self._exact.get(T.join(topic_words), ()))
+        out |= self._wild.match_words(topic_words)
+        return out
+
+    def match_batch(self, topics: Sequence[str]) -> List[Set[Hashable]]:
+        words = [T.words(t) for t in topics]
+        device_on = (
+            self.use_device is not False
+            and self._aut is not None
+            and self._aut.n_nodes > 1
+        )
+        if not device_on:
+            return [self.match_host(ws) for ws in words]
+
+        tokens, lengths, dollar = encode_topics(
+            self._tdict, words, self._aut.kernel_levels
+        )
+        codes, counts, ovf = self._match_device(tokens, lengths, dollar)
+        aut = self._aut
+        out: List[Set[Hashable]] = []
+        for i, ws in enumerate(words):
+            if ovf[i]:
+                out.append(self.match_host(ws))
+                continue
+            fids: Set[Hashable] = set(self._exact.get(topics[i], ()))
+            for code in codes[i, : counts[i]]:
+                for pos in aut.expand(int(code)):
+                    fid = aut.filters[pos][0]
+                    if fid not in self._deleted:
+                        fids.add(fid)
+            fids |= self._delta.match_words(ws)
+            fids |= self._deep.match_words(ws)
+            out.append(fids)
+        return out
+
+    def _match_device(self, tokens, lengths, dollar):
+        from .ops.match_kernel import match_batch
+
+        # pad the batch to a power-of-two bucket so XLA sees a bounded
+        # set of shapes (no recompile storm on ragged publish batches)
+        b = tokens.shape[0]
+        bp = 16
+        while bp < b:
+            bp *= 2
+        if bp != b:
+            pad = bp - b
+            tokens = np.pad(tokens, ((0, pad), (0, 0)), constant_values=-4)
+            lengths = np.pad(lengths, (0, pad))  # length 0 => inert row
+            dollar = np.pad(dollar, (0, pad), constant_values=True)
+
+        tables = self._device_tables()
+        codes, counts, ovf = match_batch(
+            *tables,
+            tokens,
+            lengths,
+            dollar,
+            probes=self._aut.probes,
+            f_width=self.f_width,
+            m_cap=self.m_cap,
+        )
+        return np.asarray(codes)[:b], np.asarray(counts)[:b], np.asarray(ovf)[:b]

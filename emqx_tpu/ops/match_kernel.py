@@ -1,0 +1,147 @@
+"""Batched wildcard-match kernel (JAX/XLA, TPU-first).
+
+One call matches a ``[B, L]`` batch of token-encoded topics against the
+whole automaton in a single XLA step — the device replacement for the
+per-publish `emqx_trie_search:match/2` skip-scan the reference runs on
+every publish (/root/reference/apps/emqx/src/emqx_trie_search.erl:171-253).
+
+Design constraints honored:
+  * static shapes everywhere — batch B, levels L, frontier width F,
+    match cap M, probe count P are trace-time constants;
+  * no data-dependent control flow: the per-topic branch set ("which
+    trie nodes are still alive") is a fixed-width frontier stepped by
+    `lax.scan`, with overflow *flagged* (host falls back to the CPU
+    trie for that topic) instead of dynamically grown;
+  * HBM-friendly access: per level each frontier lane costs one 96 B
+    bucket-row gather (literal edge) and one 16 B node-row gather
+    (``+`` edge + terminal flags), instead of dozens of scalar gathers;
+    match codes are collected through scan outputs and compacted with a
+    single scatter at the end.
+
+Match codes: ``node*2 + 1`` = a ``#``-terminal matched at ``node``;
+``node*2`` = exact-terminal.  `Automaton.expand` maps codes to filter
+positions via CSR.
+
+Topics deeper than the automaton's ``kernel_levels`` are safely
+*truncated* by the encoder: no filter body reaches that depth, so only
+``#`` terminals (all at depth < kernel_levels) can match, and the dead
+frontier past the deepest body level records nothing.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .automaton import BUCKET, mix32
+from .dictionary import SENTINEL
+
+
+def _bucket_lookup(ht_rows, nodes, toks, probes: int):
+    """Vectorized literal-edge lookup: (node, tok) -> child | SENTINEL.
+
+    ``nodes`` is [..., F]; ``toks`` broadcasts against it.  Each probe
+    is one row gather + an 8-wide compare."""
+    valid = nodes != SENTINEL
+    toks = jnp.broadcast_to(toks, nodes.shape)
+    nb = ht_rows.shape[0]
+    h0 = mix32(nodes.astype(jnp.uint32), toks.astype(jnp.uint32))
+    found = jnp.full(nodes.shape, SENTINEL, jnp.int32)
+    for p in range(probes):
+        b = ((h0 + np.uint32(p)) & np.uint32(nb - 1)).astype(jnp.int32)
+        b = jnp.where(valid, b, 0)  # dead lanes hit a cached row
+        row = ht_rows[b]  # [..., F, 3*BUCKET]
+        kn = row[..., 0:BUCKET]
+        kt = row[..., BUCKET : 2 * BUCKET]
+        kc = row[..., 2 * BUCKET :]
+        hit = (kn == nodes[..., None]) & (kt == toks[..., None])
+        child = jnp.max(jnp.where(hit, kc, -1), axis=-1)  # child ids >= 1
+        found = jnp.where(
+            (found == SENTINEL) & (child >= 0) & valid, child, found
+        )
+    return found
+
+
+@partial(jax.jit, static_argnames=("probes", "f_width", "m_cap"))
+def match_batch(
+    ht_rows,
+    node_rows,
+    tokens,  # [B, L] int32
+    lengths,  # [B] int32
+    dollar,  # [B] bool
+    *,
+    probes: int,
+    f_width: int,
+    m_cap: int,
+):
+    """Match a topic batch.  Returns ``(codes [B, m_cap] int32 (-1 pad),
+    counts [B] int32, overflow [B] bool)``; an overflowed row's codes are
+    incomplete and the caller must re-match that topic on the host."""
+    b, levels = tokens.shape
+    n_nodes = node_rows.shape[0]
+
+    def gather_rows(f):
+        return node_rows[jnp.clip(f, 0, n_nodes - 1)]  # [B, F, 4]
+
+    frontier = jnp.full((b, f_width), SENTINEL, jnp.int32).at[:, 0].set(0)
+    frows = gather_rows(frontier)
+
+    def step(carry, xs):
+        frontier, frows = carry
+        tok, i = xs
+        active = i < lengths  # [B]
+        lit = _bucket_lookup(ht_rows, frontier, tok[:, None], probes)
+        fvalid = frontier != SENTINEL
+        plus = jnp.where(fvalid, frows[..., 0], SENTINEL)
+        # '+' at the root never matches a '$'-topic
+        # (emqx_trie_search.erl:160-163 base_init $-exclusion)
+        plus = jnp.where((dollar & (i == 0))[:, None], SENTINEL, plus)
+        cand = jnp.sort(jnp.concatenate([lit, plus], axis=1), axis=1)
+        nf = cand[:, :f_width]
+        over = active & (cand[:, f_width] != SENTINEL)  # >F live branches
+        nf = jnp.where(active[:, None], nf, frontier)
+        nrows = gather_rows(nf)
+        h_hit = (nrows[..., 1] > 0) & (nf != SENTINEL) & active[:, None]
+        return (nf, nrows), (nf, h_hit, over)
+
+    xs = (tokens.T, jnp.arange(levels, dtype=jnp.int32))
+    (frontier, frows), (nf_seq, h_seq, over_seq) = lax.scan(
+        step, (frontier, frows), xs
+    )
+
+    # assemble (value, hit) pairs: root '#', per-level '#' hits, final
+    # exact hits — then compact into the code buffer with one scatter
+    root_hash = (node_rows[0, 1] > 0) & ~dollar  # "#" never on '$'-topics
+    e_hit = (frows[..., 2] > 0) & (frontier != SENTINEL)
+
+    # [B, 1 + L*F + F]
+    vals = jnp.concatenate(
+        [
+            jnp.ones((b, 1), jnp.int32),  # node 0, hash kind
+            jnp.transpose(nf_seq, (1, 0, 2)).reshape(b, -1) * 2 + 1,
+            frontier * 2,
+        ],
+        axis=1,
+    )
+    hits = jnp.concatenate(
+        [
+            root_hash[:, None],
+            jnp.transpose(h_seq, (1, 0, 2)).reshape(b, -1),
+            e_hit,
+        ],
+        axis=1,
+    )
+    prefix = jnp.cumsum(hits.astype(jnp.int32), axis=1)
+    count = prefix[:, -1]
+    pos = jnp.where(hits & (prefix <= m_cap), prefix - 1, m_cap)
+    rows = jnp.broadcast_to(
+        jnp.arange(b, dtype=jnp.int32)[:, None], pos.shape
+    )
+    buf = jnp.full((b, m_cap), -1, jnp.int32)
+    buf = buf.at[rows, pos].set(vals, mode="drop")
+    ovf = jnp.any(over_seq, axis=0) | (count > m_cap)
+    return buf, jnp.minimum(count, m_cap), ovf

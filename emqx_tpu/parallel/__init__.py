@@ -1,0 +1,8 @@
+from .sharded import ShardedIndex, build_sharded_index, make_mesh, sharded_match
+
+__all__ = [
+    "ShardedIndex",
+    "build_sharded_index",
+    "make_mesh",
+    "sharded_match",
+]

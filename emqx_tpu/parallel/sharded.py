@@ -1,0 +1,254 @@
+"""Multi-chip sharding of the subscription index (SPMD over a Mesh).
+
+The reference scales horizontally by full route-table replication plus
+per-node dispatch (mria replication, /root/reference/apps/emqx/src/
+emqx_router.erl:133-162; cross-node forward emqx_broker.erl:387-406).
+On TPU the equivalent is *partitioning the filter set over chips*:
+
+  * mesh axis ``sub``  — each chip holds its own shard of the wildcard
+    automaton (tables stacked on a leading axis, sharded over ``sub``);
+    a publish batch is matched against every shard and the union of
+    shard results is the route set.  This is the tensor-parallel analogue.
+  * mesh axis ``pub``  — the publish batch itself is sharded (the
+    data-parallel analogue of the reference's broker_pool topic-shard
+    hashing, emqx_broker.erl:539-540).
+
+All shards are built with identical table geometry (forced hash size /
+node-array padding) so one traced kernel serves every chip; `shard_map`
+keeps each chip probing only its local tables, and the only collective
+is a `psum` of per-topic match counts over ``sub`` (rides ICI).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Hashable, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.automaton import Automaton, build_automaton
+from ..ops.dictionary import SENTINEL, TokenDict, encode_topics
+from ..ops.match_kernel import match_batch
+from .. import topic as T
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    sub: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a 2D ``(sub, pub)`` mesh over the available devices."""
+    devs = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices, only {len(devs)} available"
+            )
+        devs = devs[:n_devices]
+    n = len(devs)
+    if sub is None:
+        # favor filter-set sharding; publishes shard over what's left
+        sub = n
+        while sub > 1 and n % sub:
+            sub -= 1
+    pub = n // sub
+    arr = np.array(devs[: sub * pub]).reshape(sub, pub)
+    return Mesh(arr, ("sub", "pub"))
+
+
+@dataclass
+class ShardedIndex:
+    """K automaton shards with common geometry, stacked for a mesh."""
+
+    shards: List[Automaton]
+    tables: Tuple[np.ndarray, ...]  # (ht_rows [K,Hb,3*B], node_rows [K,N,4])
+    probes: int
+    max_levels: int
+    kernel_levels: int
+
+    @property
+    def n_shards(self) -> int:
+        return len(self.shards)
+
+
+def build_sharded_index(
+    filters: Sequence[Tuple[Hashable, Tuple[str, ...]]],
+    tdict: TokenDict,
+    n_shards: int,
+    max_levels: int = 16,
+) -> ShardedIndex:
+    """Partition filters into ``n_shards`` automata with identical
+    geometry (same hash size / node count / probe bound)."""
+    parts: List[List] = [[] for _ in range(n_shards)]
+    for i, item in enumerate(filters):
+        parts[i % n_shards].append(item)
+    shards = [build_automaton(p, tdict, max_levels) for p in parts]
+    nb = max(len(a.ht_rows) for a in shards)
+    if any(len(a.ht_rows) != nb for a in shards):
+        shards = [
+            build_automaton(p, tdict, max_levels, hash_buckets=nb)
+            for p in parts
+        ]
+    probes = max(a.probes for a in shards)
+    n_nodes = max(a.n_nodes for a in shards)
+
+    def pad_nodes(a: np.ndarray) -> np.ndarray:
+        # padded node rows are never terminal and have no '+' child
+        out = np.zeros((n_nodes, 4), np.int32)
+        out[:, 0] = SENTINEL
+        out[: len(a)] = a
+        return out
+
+    ht = np.stack([a.ht_rows for a in shards])
+    nrows = np.stack([pad_nodes(a.node_rows) for a in shards])
+    return ShardedIndex(
+        shards=shards,
+        tables=(ht, nrows),
+        probes=probes,
+        max_levels=max_levels,
+        kernel_levels=max(a.kernel_levels for a in shards),
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("mesh", "probes", "f_width", "m_cap"),
+)
+def sharded_match(
+    mesh: Mesh,
+    ht_rows,
+    node_rows,
+    tokens,
+    lengths,
+    dollar,
+    *,
+    probes: int,
+    f_width: int,
+    m_cap: int,
+):
+    """Match a topic batch against every shard of the index.
+
+    Tables are sharded over ``sub``, the topic batch over ``pub``.
+    Returns ``(codes [K, B, m_cap], counts [K, B], ovf [K, B],
+    total [B])`` where ``total`` is the psum-reduced match count across
+    shards (the collective that proves ICI layout).
+    """
+
+    def local(ht, nr, tok, ln, dl):
+        codes, counts, ovf = match_batch(
+            ht[0],
+            nr[0],
+            tok,
+            ln,
+            dl,
+            probes=probes,
+            f_width=f_width,
+            m_cap=m_cap,
+        )
+        total = jax.lax.psum(counts, "sub")
+        return codes[None], counts[None], ovf[None], total
+
+    table_specs = tuple(P("sub") for _ in range(2))
+    fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=table_specs + (P("pub"), P("pub"), P("pub")),
+        out_specs=(
+            P("sub", "pub"),
+            P("sub", "pub"),
+            P("sub", "pub"),
+            P("pub"),
+        ),
+        # the scan carry inside match_batch starts replicated and becomes
+        # device-varying; skip the static vma check rather than thread
+        # mesh axis names into the single-chip kernel
+        check_vma=False,
+    )
+    return fn(ht_rows, node_rows, tokens, lengths, dollar)
+
+
+class ShardedMatchEngine:
+    """Host facade over a ShardedIndex on a mesh: encode, match, expand.
+
+    The single-chip `MatchEngine` owns mutation/delta logic; this engine
+    is the scale-out read path used by the cluster router (SURVEY §5.8).
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        index: ShardedIndex,
+        tdict: TokenDict,
+        f_width: int = 16,
+        m_cap: int = 128,
+    ) -> None:
+        self.mesh = mesh
+        self.index = index
+        self.tdict = tdict
+        self.f_width = f_width
+        self.m_cap = m_cap
+        k = index.n_shards
+        if k != mesh.shape["sub"]:
+            raise ValueError(
+                f"index has {k} shards but mesh 'sub' axis is "
+                f"{mesh.shape['sub']}"
+            )
+        self._dev_tables = tuple(
+            jax.device_put(t, NamedSharding(mesh, P("sub")))
+            for t in index.tables
+        )
+
+    def match_batch(self, topics: Sequence[str]) -> List[Set[Hashable]]:
+        words = [T.words(t) for t in topics]
+        tokens, lengths, dollar = encode_topics(
+            self.tdict, words, self.index.kernel_levels
+        )
+        # pad batch to a multiple of the pub axis
+        b = tokens.shape[0]
+        pub = self.mesh.shape["pub"]
+        bp = max(16, -(-b // pub) * pub)
+        while bp % pub:
+            bp += 1
+        if bp != b:
+            tokens = np.pad(tokens, ((0, bp - b), (0, 0)), constant_values=-4)
+            lengths = np.pad(lengths, (0, bp - b))
+            dollar = np.pad(dollar, (0, bp - b), constant_values=True)
+        codes, counts, ovf, _ = sharded_match(
+            self.mesh,
+            *self._dev_tables,
+            tokens,
+            lengths,
+            dollar,
+            probes=self.index.probes,
+            f_width=self.f_width,
+            m_cap=self.m_cap,
+        )
+        codes = np.asarray(codes)
+        counts = np.asarray(counts)
+        ovf = np.asarray(ovf)
+        out: List[Set[Hashable]] = []
+        for i, ws in enumerate(words):
+            fids: Set[Hashable] = set()
+            fallback = False
+            for k, aut in enumerate(self.index.shards):
+                if ovf[k, i]:
+                    fallback = True
+                    break
+                for code in codes[k, i, : counts[k, i]]:
+                    for pos in aut.expand(int(code)):
+                        fids.add(aut.filters[pos][0])
+            out.append(self._host_match(ws) if fallback else fids)
+        return out
+
+    def _host_match(self, ws: T.Words) -> Set[Hashable]:
+        fids: Set[Hashable] = set()
+        for aut in self.index.shards:
+            for fid, fw in aut.filters:
+                if T.match_words(ws, fw):
+                    fids.add(fid)
+        return fids

@@ -1,12 +1,21 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh so
 sharding tests run without TPU hardware (mirrors the driver's
-dryrun_multichip environment). Must run before jax is imported."""
+dryrun_multichip environment).
+
+The container pre-imports jax via sitecustomize with JAX_PLATFORMS set
+to the real TPU tunnel, so mutating os.environ alone is too late — the
+config value must be updated as well (safe while no backend is
+initialized).  Benchmarks (bench.py), not tests, use the real chip."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

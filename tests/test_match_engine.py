@@ -1,0 +1,189 @@
+"""Equivalence tests: device automaton matcher vs the host-trie oracle.
+
+Mirrors the reference's oracle pattern (`emqx_ds_storage_reference` as a
+trivially-correct stand-in, and the emqx_trie_search property suites):
+randomized filter/topic sets over a tiny alphabet maximize wildcard
+overlap and structural edge cases ('$'-topics, empty levels, '#'-parent
+matching, deep '+' chains)."""
+
+import random
+
+import pytest
+
+from emqx_tpu import topic as T
+from emqx_tpu.engine import MatchEngine
+from emqx_tpu.ops.automaton import build_automaton
+from emqx_tpu.ops.dictionary import TokenDict
+from emqx_tpu.ops.trie_host import HostTrie
+
+WORDS = ["a", "b", "c", "", "dev", "$SYS", "$share-ish", "x"]
+
+
+def random_filter(rng: random.Random) -> str:
+    depth = rng.randint(1, 6)
+    ws = []
+    for i in range(depth):
+        r = rng.random()
+        if r < 0.18:
+            ws.append("+")
+        elif r < 0.28 and i == depth - 1:
+            ws.append("#")
+        else:
+            ws.append(rng.choice(WORDS))
+    return "/".join(ws)
+
+
+def random_topic(rng: random.Random) -> str:
+    depth = rng.randint(1, 7)
+    return "/".join(rng.choice(WORDS) for _ in range(depth))
+
+
+def check_engine_vs_oracle(engine, oracle_trie, exact_map, topics):
+    got = engine.match_batch(topics)
+    for t, g in zip(topics, got):
+        ws = T.words(t)
+        want = set(exact_map.get(t, set())) | oracle_trie.match_words(ws)
+        assert g == want, (t, sorted(map(str, g)), sorted(map(str, want)))
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_randomized_equivalence(seed):
+    rng = random.Random(seed)
+    engine = MatchEngine(max_levels=8, f_width=8, m_cap=64)
+    oracle = HostTrie()
+    exact = {}
+    for fid in range(300):
+        flt = random_filter(rng)
+        try:
+            T.validate_filter(flt)
+        except ValueError:
+            continue
+        engine.insert(flt, fid)
+        if T.is_wildcard(flt):
+            oracle.insert(flt, fid)
+        else:
+            exact.setdefault(flt, set()).add(fid)
+    engine.rebuild()
+    topics = [random_topic(rng) for _ in range(200)]
+    # include every filter's concrete-ized form to force exact hits
+    for _, ws in list(oracle.filters())[:50]:
+        concrete = [rng.choice(WORDS) if w in "+#" else w for w in ws]
+        topics.append("/".join(concrete))
+    check_engine_vs_oracle(engine, oracle, exact, topics)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_churn_delta_and_delete(seed):
+    """Mutations after rebuild must be visible without a rebuild."""
+    rng = random.Random(1000 + seed)
+    engine = MatchEngine(max_levels=8, rebuild_threshold=10**9)
+    oracle = HostTrie()
+    exact = {}
+    fid = 0
+    live = {}
+    for round_ in range(4):
+        for _ in range(120):
+            flt = random_filter(rng)
+            try:
+                T.validate_filter(flt)
+            except ValueError:
+                continue
+            engine.insert(flt, fid)
+            live[fid] = flt
+            if T.is_wildcard(flt):
+                oracle.insert(flt, fid)
+            else:
+                exact.setdefault(flt, set()).add(fid)
+            fid += 1
+        if round_ == 1:
+            engine.rebuild()
+        # delete a third of live filters
+        for del_fid in list(live)[:: 3]:
+            flt = live.pop(del_fid)
+            engine.delete(del_fid)
+            if T.is_wildcard(flt):
+                oracle.delete_id(del_fid)
+            else:
+                exact[flt].discard(del_fid)
+        topics = [random_topic(rng) for _ in range(80)]
+        check_engine_vs_oracle(engine, oracle, exact, topics)
+
+
+def test_dollar_topic_rules():
+    engine = MatchEngine()
+    engine.insert("#", 1)
+    engine.insert("+/monitor", 2)
+    engine.insert("$SYS/monitor", 3)
+    engine.insert("$SYS/#", 4)
+    engine.insert("$SYS/+", 5)
+    engine.rebuild()
+    assert engine.match("$SYS/monitor") == {3, 4, 5}
+    assert engine.match("a/monitor") == {1, 2}
+    assert engine.match("$SYS") == {4}
+
+
+def test_hash_matches_parent_level():
+    engine = MatchEngine()
+    engine.insert("sport/tennis/#", 1)
+    engine.rebuild()
+    assert engine.match("sport/tennis") == {1}
+    assert engine.match("sport/tennis/player1/score") == {1}
+    assert engine.match("sport") == set()
+
+
+def test_empty_levels():
+    engine = MatchEngine()
+    engine.insert("a//b", 1)
+    engine.insert("a/+/b", 2)
+    engine.insert("/+", 3)
+    engine.rebuild()
+    assert engine.match("a//b") == {1, 2}
+    assert engine.match("/x") == {3}
+    assert engine.match("/") == {3}  # ('', '')
+
+
+def test_frontier_overflow_falls_back():
+    """More live branches than f_width must still return exact results
+    via the host fallback (overflow flag path)."""
+    engine = MatchEngine(max_levels=8, f_width=2, m_cap=4)
+    # many '+'-chains all alive at once
+    for i in range(12):
+        pat = ["+"] * 4
+        pat[i % 4] = "w%d" % (i % 3)
+        engine.insert("/".join(pat), i)
+    engine.insert("w0/+/+/+", 100)
+    engine.rebuild()
+    topic = "w0/w1/w2/w0"
+    want = {
+        fid
+        for fid, ws in engine._wild.filters()
+        if T.match_words(T.words(topic), ws)
+    }
+    assert engine.match(topic) == want
+
+
+def test_too_deep_topic_falls_back():
+    engine = MatchEngine(max_levels=4)
+    engine.insert("a/#", 1)
+    engine.rebuild()
+    deep = "a/" + "/".join("x%d" % i for i in range(10))
+    assert engine.match(deep) == {1}
+
+
+def test_automaton_structure_small():
+    td = TokenDict()
+    aut = build_automaton(
+        [(1, ("a", "b")), (2, ("a", "#")), (3, ("a", "+"))], td, max_levels=4
+    )
+    # nodes: root, a, a/b, a/+  -> 4
+    assert aut.n_nodes == 4
+    assert (aut.node_rows[:, 1] > 0).sum() == 1
+    assert (aut.node_rows[:, 2] > 0).sum() == 2  # a/b and a/+
+    assert (aut.node_rows[:, 0] != 2**31 - 1).sum() == 1
+    assert aut.kernel_levels == 3  # deepest body (2) + 1
+
+
+def test_forced_hash_size_for_sharding():
+    td = TokenDict()
+    aut = build_automaton([(1, ("a", "b"))], td, hash_buckets=256)
+    assert len(aut.ht_rows) == 256
