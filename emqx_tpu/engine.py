@@ -77,8 +77,11 @@ class MatchEngine:
             if body_depth > self.max_levels:
                 self._deep.insert(flt, fid)
             else:
+                # Do NOT clear a tombstone here: if the fid previously
+                # carried a *different* filter in the base snapshot, the
+                # tombstone is what masks the stale device entry.  The
+                # delta trie serves the re-inserted filter until rebuild.
                 self._delta.insert(flt, fid)
-                self._deleted.discard(fid)
                 if len(self._delta) >= self.rebuild_threshold:
                     self.rebuild()
         else:

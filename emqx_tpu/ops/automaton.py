@@ -230,6 +230,10 @@ def build_automaton(
         filters=flist,
         probes=probes,
         max_levels=max_levels,
-        kernel_levels=min(max_levels, depth + 1),
+        # Always scan one level past the deepest filter body: encoding
+        # topics to depth+1 keeps truncation exact (a topic deeper than
+        # every body can never sit on an exact terminal, because the
+        # frontier dies at depth+1 where the trie has no edges).
+        kernel_levels=depth + 1,
         n_nodes=n_nodes,
     )

@@ -187,3 +187,42 @@ def test_forced_hash_size_for_sharding():
     td = TokenDict()
     aut = build_automaton([(1, ("a", "b"))], td, hash_buckets=256)
     assert len(aut.ht_rows) == 256
+
+
+def test_reinsert_changed_filter_after_rebuild():
+    """ADVICE r1 (high): re-registering a fid with a different filter
+    after a rebuild must not unmask the stale device entry."""
+    eng = MatchEngine(use_device=True)
+    eng.insert("a/+", 1)
+    eng.rebuild()
+    eng.insert("b/+", 1)
+    assert eng.match("a/x") == set()
+    assert eng.match("b/x") == {1}
+    eng.rebuild()
+    assert eng.match("a/x") == set()
+    assert eng.match("b/x") == {1}
+
+
+def test_delete_then_reinsert_same_filter_after_rebuild():
+    eng = MatchEngine(use_device=True)
+    eng.insert("a/+", 1)
+    eng.rebuild()
+    eng.delete(1)
+    assert eng.match("a/x") == set()
+    eng.insert("a/+", 1)
+    assert eng.match("a/x") == {1}
+
+
+def test_full_depth_filter_does_not_match_deeper_topic():
+    """ADVICE r1 (high): body depth == max_levels must still scan one
+    level past the body so deeper topics cannot falsely exact-match."""
+    eng = MatchEngine(max_levels=4, use_device=True)
+    eng.insert("a/b/c/+", 1)
+    eng.rebuild()
+    assert eng.match("a/b/c/d") == {1}
+    assert eng.match("a/b/c/d/e") == set()
+    assert eng.match("a/b/c") == set()
+    # hash filter at full depth still matches arbitrarily deep
+    eng.insert("a/b/c/#", 2)
+    eng.rebuild()
+    assert eng.match("a/b/c/d/e/f") == {2}

@@ -64,6 +64,10 @@ def test_shard_geometry_uniform():
         if not any("#" == w for w in ws[:-1])
     ]
     idx = build_sharded_index(filters, TokenDict(), n_shards=4)
-    hsizes = {t.shape for t in idx.tables[:3]}
-    assert len(hsizes) == 1  # all shards share one hash-table geometry
-    assert idx.tables[3].shape == idx.tables[4].shape
+    ht, node_rows = idx.tables
+    # all shards stacked with one shared geometry per table
+    assert ht.shape[0] == node_rows.shape[0] == 4
+    assert all(a.ht_rows.shape == ht.shape[1:] for a in idx.shards)
+    assert all(
+        node_rows.shape[1] >= a.node_rows.shape[0] for a in idx.shards
+    )
