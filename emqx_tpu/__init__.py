@@ -13,11 +13,17 @@ Layout:
   codec       — MQTT 3.1/3.1.1/5.0 wire codec (emqx_frame.erl parity)
   ops         — matching engines: host trie oracle, token dictionary,
                 array automaton builder, batched JAX matcher
-  router      — route table: exact index + wildcard automaton + delta overlay
-  broker      — sessions, channels, dispatch, retainer, shared subs, hooks
-  rules       — SQL rule engine compiled onto the same matcher
-  parallel    — jax.sharding Mesh layouts, multi-chip matcher, cluster links
-  utils       — config, metrics, logging
+  engine      — MatchEngine: exact index + wildcard automaton + delta overlay
+  router      — subscription registry + dispatch plan over the engine
+  broker      — sessions, channels, connections, listeners, dispatch,
+                shared subs, connection manager
+  retainer    — retained-message store with reverse topic matching
+  hooks       — priority-ordered hook chains (emqx_hooks parity)
+  access      — authn/authz chains (emqx_access_control parity)
+  message     — broker-internal message representation
+  config      — typed config tree with update handlers
+  metrics     — named counters + gauges (emqx_metrics parity)
+  parallel    — jax.sharding Mesh layouts, multi-chip matcher
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"

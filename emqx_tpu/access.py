@@ -1,0 +1,218 @@
+"""Authentication + authorization entry points.
+
+Mirrors `emqx_access_control` (/root/reference/apps/emqx/src/
+emqx_access_control.erl): ``authenticate/1`` runs the authenticator
+chain, ``authorize/3`` consults the authorization source chain with a
+default when no source decides.  Providers follow the chain contract of
+`emqx_authn_chains` / `emqx_authz` (first decisive provider wins;
+``ignore`` falls through).
+
+Built-in providers re-create the file-based reference backends:
+``DictAuthenticator`` ≈ the mnesia/built-in-database password store
+(with salted SHA-256, apps/emqx_auth/src/emqx_authn/), ``AclProvider``
+≈ the file authz source (apps/emqx_auth/src/emqx_authz/sources) with
+``%c``/``%u`` topic placeholders.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import hashlib
+import hmac
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from . import topic as T
+
+# decisions
+ALLOW = "allow"
+DENY = "deny"
+IGNORE = "ignore"  # provider has no opinion; fall through the chain
+
+PUBLISH = "publish"
+SUBSCRIBE = "subscribe"
+ALL_ACTIONS = "all"
+
+
+@dataclass
+class ClientInfo:
+    """The slice of channel state access control sees (the reference's
+    clientinfo map, emqx_types.erl)."""
+
+    clientid: str
+    username: Optional[str] = None
+    password: Optional[bytes] = None
+    peerhost: str = ""
+    mountpoint: Optional[str] = None
+    is_superuser: bool = False
+
+
+class Authenticator:
+    """Chain element: return (ALLOW|DENY|IGNORE, updates-dict)."""
+
+    def authenticate(
+        self, client: ClientInfo
+    ) -> Tuple[str, Dict[str, object]]:
+        raise NotImplementedError
+
+
+class DictAuthenticator(Authenticator):
+    """Username/password store with per-user salted SHA-256 hashes."""
+
+    def __init__(self) -> None:
+        self._users: Dict[str, Tuple[bytes, bytes, bool]] = {}
+
+    def add_user(
+        self, username: str, password: str, is_superuser: bool = False
+    ) -> None:
+        salt = os.urandom(16)
+        digest = hashlib.sha256(salt + password.encode()).digest()
+        self._users[username] = (salt, digest, is_superuser)
+
+    def remove_user(self, username: str) -> None:
+        self._users.pop(username, None)
+
+    def authenticate(
+        self, client: ClientInfo
+    ) -> Tuple[str, Dict[str, object]]:
+        if client.username is None:
+            return IGNORE, {}
+        entry = self._users.get(client.username)
+        if entry is None:
+            return IGNORE, {}
+        salt, digest, is_superuser = entry
+        given = hashlib.sha256(salt + (client.password or b"")).digest()
+        if hmac.compare_digest(given, digest):
+            return ALLOW, {"is_superuser": is_superuser}
+        return DENY, {}
+
+
+@dataclass
+class AclRule:
+    """One authorization rule: permission x who x action x topics.
+
+    ``who`` selects by exact clientid (``("clientid", id)``), username
+    (``("username", name)``) or everyone (``"all"``).  Topic entries may
+    use MQTT wildcards and the placeholders ``%c`` (clientid) / ``%u``
+    (username); an ``{"eq": topic}`` entry requires literal equality
+    (no wildcard expansion), as in the reference acl.conf syntax.
+    """
+
+    permission: str  # ALLOW | DENY
+    who: object = "all"
+    action: str = ALL_ACTIONS
+    topics: Sequence[object] = field(default_factory=lambda: ["#"])
+
+    def applies_to(self, client: ClientInfo) -> bool:
+        if self.who == "all":
+            return True
+        kind, val = self.who  # type: ignore[misc]
+        if kind == "clientid":
+            return client.clientid == val
+        if kind == "username":
+            return client.username == val
+        return False
+
+    def covers(self, client: ClientInfo, action: str, topic: str) -> bool:
+        if self.action not in (ALL_ACTIONS, action):
+            return False
+        if not self.applies_to(client):
+            return False
+        for entry in self.topics:
+            if isinstance(entry, dict) and "eq" in entry:
+                if topic == self._expand(str(entry["eq"]), client):
+                    return True
+            else:
+                flt = self._expand(str(entry), client)
+                if T.match(topic, flt) or topic == flt:
+                    return True
+        return False
+
+    @staticmethod
+    def _expand(pattern: str, client: ClientInfo) -> str:
+        out = pattern.replace("%c", client.clientid)
+        if client.username is not None:
+            out = out.replace("%u", client.username)
+        return out
+
+
+class AclProvider:
+    """Ordered rule list; first covering rule decides."""
+
+    def __init__(self, rules: Optional[Iterable[AclRule]] = None) -> None:
+        self.rules: List[AclRule] = list(rules or ())
+
+    def authorize(
+        self, client: ClientInfo, action: str, topic: str
+    ) -> str:
+        for rule in self.rules:
+            if rule.covers(client, action, topic):
+                return rule.permission
+        return IGNORE
+
+
+class AccessControl:
+    """authenticate/authorize facade wired into the hook registry.
+
+    The ``client.authenticate`` / ``client.authorize`` hookpoints run
+    *before* the provider chains, mirroring how reference auth apps
+    attach to those hooks (emqx_access_control.erl:40-78).
+    """
+
+    def __init__(
+        self,
+        hooks=None,
+        allow_anonymous: bool = True,
+        authz_default: str = ALLOW,
+        deny_action: str = "ignore",  # 'ignore' pub, or 'disconnect'
+    ) -> None:
+        from .hooks import HookRegistry
+
+        self.hooks: "HookRegistry" = hooks
+        self.allow_anonymous = allow_anonymous
+        self.authz_default = authz_default
+        self.deny_action = deny_action
+        self.authenticators: List[Authenticator] = []
+        self.authz_sources: List[AclProvider] = []
+
+    # ---------------------------------------------------------- authn
+
+    def authenticate(self, client: ClientInfo) -> Tuple[bool, ClientInfo]:
+        """Returns (ok, possibly-updated clientinfo)."""
+        if self.hooks is not None:
+            res = self.hooks.run_fold(
+                "client.authenticate", (client,), IGNORE
+            )
+            if res == DENY:
+                return False, client
+            if res == ALLOW:
+                return True, client
+        for auth in self.authenticators:
+            decision, updates = auth.authenticate(client)
+            if decision == ALLOW:
+                for k, v in updates.items():
+                    setattr(client, k, v)
+                return True, client
+            if decision == DENY:
+                return False, client
+        return self.allow_anonymous, client
+
+    # ---------------------------------------------------------- authz
+
+    def authorize(
+        self, client: ClientInfo, action: str, topic: str
+    ) -> bool:
+        if client.is_superuser:
+            return True
+        if self.hooks is not None:
+            res = self.hooks.run_fold(
+                "client.authorize", (client, action, topic), IGNORE
+            )
+            if res in (ALLOW, DENY):
+                return res == ALLOW
+        for src in self.authz_sources:
+            decision = src.authorize(client, action, topic)
+            if decision in (ALLOW, DENY):
+                return decision == ALLOW
+        return self.authz_default == ALLOW

@@ -1,0 +1,9 @@
+"""Broker runtime: sessions, channels, dispatch, listeners.
+
+The host half of the SURVEY §7 architecture: asyncio connection
+handling + pure channel FSMs feeding publish micro-batches into the
+TPU match engine, with fan-out delivery into per-session queues.
+"""
+
+from .broker import Broker  # noqa: F401
+from .session import Session, SubOpts  # noqa: F401

@@ -1,0 +1,3 @@
+from .listener import main
+
+main()

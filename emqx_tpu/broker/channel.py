@@ -1,0 +1,582 @@
+"""Per-connection protocol FSM.
+
+Re-creates `emqx_channel` (/root/reference/apps/emqx/src/
+emqx_channel.erl) as a pure-ish state machine: the CONNECT/auth flow
+(:348-430), publish processing with QoS 0/1/2 acks (:615-631, 713-744),
+subscribe/unsubscribe (:801-808), and the deliver side (:944-987).  IO
+is injected: ``send(packets)`` writes to the transport, ``close(reason)``
+tears it down; the asyncio connection drives timers.
+"""
+
+from __future__ import annotations
+
+import secrets
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..access import ClientInfo, PUBLISH, SUBSCRIBE
+from ..codec import mqtt as C
+from ..message import Message
+from .. import topic as T
+from .broker import Broker
+from .session import Session, SubOpts
+
+# channel states
+CONNECTING = "connecting"
+CONNECTED = "connected"
+DISCONNECTED = "disconnected"
+
+# v5 reason codes used here
+RC_NORMAL = 0x00
+RC_DISCONNECT_WITH_WILL = 0x04
+RC_NO_MATCHING_SUBSCRIBERS = 0x10
+RC_UNSPECIFIED = 0x80
+RC_PROTOCOL_ERROR = 0x82
+RC_NOT_AUTHORIZED = 0x87
+RC_BAD_CLIENTID = 0x85
+RC_BAD_AUTH = 0x86
+RC_SERVER_BUSY = 0x89
+RC_SESSION_TAKEN_OVER = 0x8E
+RC_TOPIC_FILTER_INVALID = 0x8F
+RC_TOPIC_NAME_INVALID = 0x90
+RC_PACKET_ID_IN_USE = 0x91
+RC_NO_SUBSCRIPTION_EXISTED = 0x11
+RC_RECEIVE_MAX_EXCEEDED = 0x93
+RC_TOPIC_ALIAS_INVALID = 0x94
+RC_QUOTA_EXCEEDED = 0x97
+RC_SHARED_SUB_UNSUPPORTED = 0x9E
+RC_WILDCARD_SUB_UNSUPPORTED = 0xA2
+
+# CONNACK codes for MQTT < 5 (emqx_reason_codes:connack_error)
+_V3_CONNACK = {
+    RC_BAD_CLIENTID: 2,
+    RC_SERVER_BUSY: 3,
+    RC_BAD_AUTH: 4,
+    RC_NOT_AUTHORIZED: 5,
+}
+
+
+class Channel:
+    def __init__(
+        self,
+        broker: Broker,
+        send,
+        close,
+        peer: str = "",
+        mountpoint: Optional[str] = None,
+    ) -> None:
+        self.broker = broker
+        self._send = send
+        self._close = close
+        self.state = CONNECTING
+        self.version = C.MQTT_V5
+        self.client: Optional[ClientInfo] = None
+        self.session: Optional[Session] = None
+        self.keepalive = 0.0
+        self.peer = peer
+        self.mountpoint = mountpoint
+        self.will_msg: Optional[Message] = None
+        self._alias_in: Dict[int, str] = {}
+        self.last_rx = time.time()
+        self.connected_at: Optional[float] = None
+        self._closing = False
+
+    # ---------------------------------------------------------- util
+
+    def send_packets(self, packets: List[C.Packet]) -> None:
+        if packets and not self._closing:
+            m = self.broker.metrics
+            for p in packets:
+                if p.type == C.PUBLISH:
+                    m.inc("messages.sent")
+                    m.inc(f"messages.qos{p.qos}.sent")
+                    m.inc("packets.publish.sent")
+            self._send(packets)
+
+    def close(self, reason: str) -> None:
+        """CM-initiated close (takeover/kick): tell a v5 client why."""
+        if self._closing:
+            return
+        if self.version == C.MQTT_V5 and self.state == CONNECTED:
+            rc = (
+                RC_SESSION_TAKEN_OVER
+                if reason == "takenover"
+                else RC_UNSPECIFIED
+            )
+            self._send([C.Disconnect(reason_code=rc)])
+        if reason == "takenover":
+            # session moves to the new channel; don't tear it down
+            self.session = None
+            self.will_msg = None
+        self._shutdown(reason)
+
+    def _shutdown(self, reason: str) -> None:
+        self._closing = True
+        self.state = DISCONNECTED
+        self._close(reason)
+
+    def _mount(self, topic: str) -> str:
+        return self.mountpoint + topic if self.mountpoint else topic
+
+    def _unmount(self, topic: str) -> str:
+        if self.mountpoint and topic.startswith(self.mountpoint):
+            return topic[len(self.mountpoint) :]
+        return topic
+
+    # ------------------------------------------------------ incoming
+
+    def handle_in(self, pkt: C.Packet) -> None:
+        """One parsed packet from the wire (emqx_channel:handle_in/2)."""
+        self.last_rx = time.time()
+        m = self.broker.metrics
+        m.inc("packets.received")
+        if self.state == CONNECTING:
+            if pkt.type != C.CONNECT:
+                self._shutdown("protocol_error")
+                return
+            self._handle_connect(pkt)
+            return
+        t = pkt.type
+        if t == C.CONNECT:
+            self._disconnect_with(RC_PROTOCOL_ERROR)  # [MQTT-3.1.0-2]
+        elif t == C.PUBLISH:
+            self._handle_publish(pkt)
+        elif t == C.PUBACK:
+            m.inc("packets.puback.received")
+            ok, out = self.session.puback(pkt.packet_id)
+            if ok:
+                m.inc("messages.acked")
+                self.broker.hooks.run(
+                    "message.acked", self.client.clientid, pkt.packet_id
+                )
+            self.send_packets(out)
+        elif t == C.PUBREC:
+            m.inc("packets.pubrec.received")
+            ok, out = self.session.pubrec(pkt.packet_id)
+            if out:
+                m.inc("packets.pubrel.sent")
+            self.send_packets(out)
+        elif t == C.PUBREL:
+            m.inc("packets.pubrel.received")
+            found = self.session.pubrel(pkt.packet_id)
+            rc = RC_NORMAL if found else RC_PACKET_ID_IN_USE + 1  # 0x92
+            m.inc("packets.pubcomp.sent")
+            self.send_packets(
+                [C.Pubcomp(packet_id=pkt.packet_id,
+                           reason_code=0 if found else 0x92)]
+            )
+        elif t == C.PUBCOMP:
+            m.inc("packets.pubcomp.received")
+            ok, out = self.session.pubcomp(pkt.packet_id)
+            if ok:
+                m.inc("messages.acked")
+            self.send_packets(out)
+        elif t == C.SUBSCRIBE:
+            self._handle_subscribe(pkt)
+        elif t == C.UNSUBSCRIBE:
+            self._handle_unsubscribe(pkt)
+        elif t == C.PINGREQ:
+            m.inc("packets.pingreq.received")
+            m.inc("packets.pingresp.sent")
+            self.send_packets([C.Pingresp()])
+        elif t == C.DISCONNECT:
+            self._handle_disconnect(pkt)
+        elif t == C.AUTH:
+            m.inc("packets.auth.received")
+            self._disconnect_with(RC_PROTOCOL_ERROR)  # no enhanced auth yet
+        else:
+            self._shutdown("protocol_error")
+
+    # ------------------------------------------------------- connect
+
+    def _handle_connect(self, pkt: C.Connect) -> None:
+        m = self.broker.metrics
+        m.inc("packets.connect.received")
+        m.inc("client.connect")
+        self.version = pkt.proto_ver
+        self.broker.hooks.run("client.connect", pkt)
+        mqtt = self.broker.config.mqtt
+
+        clientid = pkt.client_id
+        assigned = None
+        if not clientid:
+            if self.version < C.MQTT_V5 and not pkt.clean_start:
+                self._connack_error(RC_BAD_CLIENTID)  # [MQTT-3.1.3-8]
+                return
+            clientid = assigned = "emqx_tpu_" + secrets.token_hex(8)
+        if len(clientid) > mqtt.max_clientid_len:
+            self._connack_error(RC_BAD_CLIENTID)
+            return
+
+        client = ClientInfo(
+            clientid=clientid,
+            username=pkt.username,
+            password=pkt.password,
+            peerhost=self.peer,
+            mountpoint=self.mountpoint,
+        )
+        m.inc("client.authenticate")
+        ok, client = self.broker.access.authenticate(client)
+        if not ok:
+            m.inc("packets.publish.auth_error")
+            self._connack_error(RC_BAD_AUTH)
+            return
+        if client.username is None:
+            m.inc("client.auth.anonymous")
+        client.password = None  # never retain credentials
+        self.client = client
+
+        expiry = float(
+            pkt.properties.get("session_expiry_interval", 0)
+            if self.version == C.MQTT_V5
+            else (0 if pkt.clean_start else mqtt.session_expiry_interval)
+        )
+        receive_max = pkt.properties.get("receive_maximum")
+        session, present = self.broker.cm.open_session(
+            pkt.clean_start,
+            clientid,
+            self,
+            expiry_interval=expiry,
+            max_inflight=min(
+                mqtt.max_inflight, receive_max or mqtt.max_inflight
+            ),
+        )
+        self.session = session
+        if present:
+            m.inc("session.resumed")
+            self.broker.hooks.run("session.resumed", clientid)
+            # re-register subscriptions in case the router was cleaned
+            for flt, opts in session.subscriptions.items():
+                self.broker.router.subscribe(clientid, flt, opts)
+
+        if pkt.will is not None:
+            self.will_msg = Message(
+                topic=self._mount(pkt.will.topic),
+                payload=pkt.will.payload,
+                qos=min(pkt.will.qos, mqtt.max_qos_allowed),
+                retain=pkt.will.retain,
+                from_client=clientid,
+                from_username=client.username,
+                properties=dict(pkt.will.properties),
+            )
+
+        self.keepalive = float(
+            mqtt.server_keepalive
+            if (mqtt.server_keepalive and self.version == C.MQTT_V5)
+            else pkt.keepalive
+        )
+
+        props: C.Properties = {}
+        if self.version == C.MQTT_V5:
+            if assigned is not None:
+                props["assigned_client_identifier"] = assigned
+            if mqtt.server_keepalive:
+                props["server_keep_alive"] = mqtt.server_keepalive
+            if mqtt.max_qos_allowed < 2:
+                props["maximum_qos"] = mqtt.max_qos_allowed
+            if not mqtt.retain_available:
+                props["retain_available"] = 0
+            if not mqtt.wildcard_subscription:
+                props["wildcard_subscription_available"] = 0
+            if not mqtt.shared_subscription:
+                props["shared_subscription_available"] = 0
+            props["topic_alias_maximum"] = mqtt.max_topic_alias
+            props["receive_maximum"] = mqtt.max_inflight
+            props["session_expiry_interval"] = int(expiry)
+
+        self.state = CONNECTED
+        self.connected_at = time.time()
+        m.inc("packets.connack.sent")
+        m.inc("client.connack")
+        m.inc("client.connected")
+        self.broker.hooks.run("client.connected", client)
+        self.send_packets(
+            [C.Connack(session_present=present, reason_code=0,
+                       properties=props)]
+        )
+        if present:
+            self.send_packets(session.resume())
+
+    def _connack_error(self, rc: int) -> None:
+        code = rc if self.version == C.MQTT_V5 else _V3_CONNACK.get(rc, 3)
+        self.broker.metrics.inc("packets.connack.sent")
+        self._send([C.Connack(session_present=False, reason_code=code)])
+        self._shutdown("connack_error")
+
+    # ------------------------------------------------------- publish
+
+    def _resolve_alias(self, pkt: C.Publish) -> Optional[str]:
+        """MQTT 5 topic-alias resolution; None => protocol error."""
+        alias = pkt.properties.get("topic_alias")
+        if alias is None:
+            return pkt.topic
+        if (
+            not isinstance(alias, int)
+            or alias == 0
+            or alias > self.broker.config.mqtt.max_topic_alias
+        ):
+            return None
+        if pkt.topic:
+            self._alias_in[alias] = pkt.topic
+            return pkt.topic
+        return self._alias_in.get(alias)
+
+    def _handle_publish(self, pkt: C.Publish) -> None:
+        m = self.broker.metrics
+        m.inc("packets.publish.received")
+        m.inc("messages.received")
+        m.inc(f"messages.qos{pkt.qos}.received")
+
+        topic = self._resolve_alias(pkt) if self.version == C.MQTT_V5 else pkt.topic
+        if topic is None:
+            self._disconnect_with(RC_TOPIC_ALIAS_INVALID)
+            return
+        try:
+            T.validate_name(topic)
+        except ValueError:
+            m.inc("packets.publish.error")
+            self._disconnect_with(RC_TOPIC_NAME_INVALID)
+            return
+        mqtt = self.broker.config.mqtt
+        if pkt.qos > mqtt.max_qos_allowed:
+            self._disconnect_with(0x9B)  # QoS not supported
+            return
+        if pkt.retain and not mqtt.retain_available:
+            self._disconnect_with(0x9A)  # retain not supported
+            return
+
+        full_topic = self._mount(topic)
+        m.inc("client.authorize")
+        if not self.broker.access.authorize(self.client, PUBLISH, full_topic):
+            m.inc("authorization.deny")
+            m.inc("packets.publish.auth_error")
+            self._publish_denied(pkt)
+            return
+        m.inc("authorization.allow")
+
+        props = {
+            k: v for k, v in pkt.properties.items() if k != "topic_alias"
+        }
+        msg = Message(
+            topic=full_topic,
+            payload=pkt.payload,
+            qos=pkt.qos,
+            retain=pkt.retain,
+            from_client=self.client.clientid,
+            from_username=self.client.username,
+            properties=props,
+        )
+
+        if pkt.qos == 0:
+            self.broker.publish(msg)
+            return
+        if pkt.qos == 1:
+            n = self.broker.publish(msg)
+            rc = (
+                RC_NO_MATCHING_SUBSCRIBERS
+                if (n == 0 and self.version == C.MQTT_V5)
+                else 0
+            )
+            m.inc("packets.puback.sent")
+            self.send_packets([C.Puback(packet_id=pkt.packet_id, reason_code=rc)])
+            return
+        # QoS 2: route immediately, dedup on packet id until PUBREL
+        st = self.session.awaiting_rel_add(pkt.packet_id)
+        if st == "in_use":
+            m.inc("packets.pubrec.sent")
+            self.send_packets(
+                [C.Pubrec(packet_id=pkt.packet_id, reason_code=0)]
+            )
+            return
+        if st == "full":
+            m.inc("messages.dropped")
+            m.inc("messages.dropped.await_pubrel_timeout")
+            self._disconnect_with(RC_RECEIVE_MAX_EXCEEDED)
+            return
+        n = self.broker.publish(msg)
+        rc = (
+            RC_NO_MATCHING_SUBSCRIBERS
+            if (n == 0 and self.version == C.MQTT_V5)
+            else 0
+        )
+        m.inc("packets.pubrec.sent")
+        self.send_packets([C.Pubrec(packet_id=pkt.packet_id, reason_code=rc)])
+
+    def _publish_denied(self, pkt: C.Publish) -> None:
+        """Unauthorized publish: drop or disconnect per config
+        (authorization.deny_action)."""
+        if self.broker.access.deny_action == "disconnect":
+            self._disconnect_with(RC_NOT_AUTHORIZED)
+            return
+        if pkt.qos == 1:
+            self.send_packets(
+                [C.Puback(packet_id=pkt.packet_id,
+                          reason_code=RC_NOT_AUTHORIZED)]
+            )
+        elif pkt.qos == 2:
+            self.send_packets(
+                [C.Pubrec(packet_id=pkt.packet_id,
+                          reason_code=RC_NOT_AUTHORIZED)]
+            )
+
+    # ----------------------------------------------------- subscribe
+
+    def _handle_subscribe(self, pkt: C.Subscribe) -> None:
+        m = self.broker.metrics
+        m.inc("packets.subscribe.received")
+        mqtt = self.broker.config.mqtt
+        subid = pkt.properties.get("subscription_identifier")
+        if isinstance(subid, list):
+            subid = subid[0] if subid else None
+        rcs: List[int] = []
+        retained_jobs: List[Tuple[Message, SubOpts]] = []
+        for sub in pkt.subscriptions:
+            rc = self._do_subscribe(sub, subid, mqtt, retained_jobs)
+            rcs.append(rc)
+        if self.version != C.MQTT_V5:
+            rcs = [rc if rc <= 2 else 0x80 for rc in rcs]
+        m.inc("packets.suback.sent")
+        self.send_packets([C.Suback(packet_id=pkt.packet_id, reason_codes=rcs)])
+        if retained_jobs:
+            self.send_packets(self.session.deliver(retained_jobs))
+
+    def _do_subscribe(
+        self,
+        sub: C.Subscription,
+        subid: Optional[int],
+        mqtt,
+        retained_jobs: List[Tuple[Message, SubOpts]],
+    ) -> int:
+        flt = sub.topic_filter
+        try:
+            T.validate_filter(flt)
+        except ValueError:
+            self.broker.metrics.inc("packets.subscribe.error")
+            return RC_TOPIC_FILTER_INVALID
+        shared = T.parse_share(flt)
+        if shared is not None and not mqtt.shared_subscription:
+            return RC_SHARED_SUB_UNSUPPORTED
+        real = shared.topic if shared else flt
+        if T.is_wildcard(real) and not mqtt.wildcard_subscription:
+            return RC_WILDCARD_SUB_UNSUPPORTED
+        if T.levels(real) > mqtt.max_topic_levels:
+            return RC_TOPIC_FILTER_INVALID
+        full = self._mount(flt) if shared is None else flt
+        self.broker.metrics.inc("client.authorize")
+        if not self.broker.access.authorize(
+            self.client, SUBSCRIBE, self._mount(real)
+        ):
+            self.broker.metrics.inc("authorization.deny")
+            self.broker.metrics.inc("packets.subscribe.auth_error")
+            return RC_NOT_AUTHORIZED
+        self.broker.metrics.inc("authorization.allow")
+
+        granted = min(sub.qos, mqtt.max_qos_allowed)
+        opts = SubOpts(
+            qos=granted,
+            no_local=sub.no_local,
+            retain_as_published=sub.retain_as_published,
+            retain_handling=sub.retain_handling,
+            subid=subid,
+        )
+        if shared is not None and sub.no_local:
+            return RC_PROTOCOL_ERROR  # [MQTT-3.8.3-4]
+        hooked = self.broker.hooks.run_fold(
+            "client.subscribe", (self.client, flt), opts
+        )
+        if hooked is None:
+            return RC_NOT_AUTHORIZED
+        opts = hooked
+        is_new = self.session.subscribe(full, opts)
+        retained = self.broker.subscribe(
+            self.client.clientid, full, opts, is_new_sub=is_new
+        )
+        for rmsg in retained:
+            # retained replay keeps the retain bit set [MQTT-3.3.1-8]
+            ropts = SubOpts(
+                qos=opts.qos,
+                retain_as_published=True,
+                subid=opts.subid,
+            )
+            retained_jobs.append((rmsg, ropts))
+        return granted
+
+    def _handle_unsubscribe(self, pkt: C.Unsubscribe) -> None:
+        m = self.broker.metrics
+        m.inc("packets.unsubscribe.received")
+        rcs: List[int] = []
+        for flt in pkt.topic_filters:
+            full = self._mount(flt) if not T.parse_share(flt) else flt
+            self.broker.hooks.run("client.unsubscribe", self.client, flt)
+            had = self.session.unsubscribe(full) is not None
+            if had:
+                self.broker.unsubscribe(self.client.clientid, full)
+            rcs.append(RC_NORMAL if had else RC_NO_SUBSCRIPTION_EXISTED)
+        m.inc("packets.unsuback.sent")
+        self.send_packets(
+            [C.Unsuback(packet_id=pkt.packet_id, reason_codes=rcs)]
+        )
+
+    # ---------------------------------------------------- disconnect
+
+    def _handle_disconnect(self, pkt: C.Disconnect) -> None:
+        m = self.broker.metrics
+        m.inc("packets.disconnect.received")
+        if pkt.reason_code == RC_NORMAL:
+            self.will_msg = None  # [MQTT-3.14.4-3]
+        if self.version == C.MQTT_V5:
+            expiry = pkt.properties.get("session_expiry_interval")
+            if expiry is not None and self.session is not None:
+                if self.session.expiry_interval == 0 and expiry > 0:
+                    self._disconnect_with(RC_PROTOCOL_ERROR)
+                    return
+                self.session.expiry_interval = float(expiry)  # type: ignore[arg-type]
+        self._shutdown("normal")
+
+    def _disconnect_with(self, rc: int) -> None:
+        if self.version == C.MQTT_V5 and self.state == CONNECTED:
+            self.broker.metrics.inc("packets.disconnect.sent")
+            self._send([C.Disconnect(reason_code=rc)])
+        self._shutdown(f"rc_{rc:#04x}")
+
+    # ------------------------------------------------------- timers
+
+    def keepalive_expired(self, now: Optional[float] = None) -> bool:
+        if self.keepalive <= 0 or self.state != CONNECTED:
+            return False
+        now = now if now is not None else time.time()
+        mult = self.broker.config.mqtt.keepalive_multiplier
+        return now - self.last_rx > self.keepalive * mult
+
+    def retry_deliveries(self) -> None:
+        if self.session is not None and self.state == CONNECTED:
+            self.send_packets(self.session.retry())
+            self.session.expire_awaiting_rel()
+
+    # ----------------------------------------------------- teardown
+
+    def connection_lost(self, reason: str = "closed") -> None:
+        """Socket gone (either direction).  Publishes the will, updates
+        the CM, drops router state for non-persistent sessions."""
+        if self.state == DISCONNECTED and self.session is None:
+            return
+        self.state = DISCONNECTED
+        m = self.broker.metrics
+        if self.client is not None:
+            m.inc("client.disconnected")
+            self.broker.hooks.run(
+                "client.disconnected", self.client, reason
+            )
+        if self.will_msg is not None:
+            will, self.will_msg = self.will_msg, None
+            delay = will.properties.pop("will_delay_interval", 0)
+            self.broker.publish(will)
+        if self.session is not None and self.client is not None:
+            self.broker.cm.disconnect(self.client.clientid, self)
+            if self.session.expiry_interval <= 0:
+                self.broker.router.cleanup_client(self.client.clientid)
+                self.broker.metrics.inc("session.terminated")
+                self.broker.hooks.run(
+                    "session.terminated", self.client.clientid, reason
+                )
+            self.session = None

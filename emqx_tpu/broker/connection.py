@@ -1,0 +1,125 @@
+"""Asyncio TCP connection: the owning loop for one client socket.
+
+Re-creates `emqx_connection` (/root/reference/apps/emqx/src/
+emqx_connection.erl:371-386 run_loop, :750-777 parse_incoming): reads
+socket chunks into the incremental `StreamParser`, feeds packets to the
+channel FSM, serializes outgoing packets, and drives the keepalive /
+retry timers that the reference hangs off its process timers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import List, Optional
+
+from ..codec import mqtt as C
+from .broker import Broker
+from .channel import Channel, CONNECTING
+
+log = logging.getLogger("emqx_tpu.connection")
+
+_TIMER_TICK = 5.0  # keepalive/retry check cadence
+
+
+class Connection:
+    def __init__(
+        self,
+        broker: Broker,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        mountpoint: Optional[str] = None,
+    ) -> None:
+        self.broker = broker
+        self.reader = reader
+        self.writer = writer
+        peername = writer.get_extra_info("peername")
+        peer = f"{peername[0]}:{peername[1]}" if peername else "?"
+        self.channel = Channel(
+            broker,
+            send=self._send_packets,
+            close=self._close,
+            peer=peer,
+            mountpoint=mountpoint,
+        )
+        self.parser = C.StreamParser(
+            max_packet_size=broker.config.mqtt.max_packet_size
+        )
+        self._closed = asyncio.Event()
+
+    # -------------------------------------------------------- output
+
+    def _send_packets(self, packets: List[C.Packet]) -> None:
+        if self.writer.is_closing():
+            return
+        m = self.broker.metrics
+        data = b"".join(
+            C.serialize(p, self.channel.version) for p in packets
+        )
+        m.inc("packets.sent", len(packets))
+        m.inc("bytes.sent", len(data))
+        self.writer.write(data)
+
+    def _close(self, reason: str) -> None:
+        if not self.writer.is_closing():
+            self.writer.close()
+        self._closed.set()
+
+    # --------------------------------------------------------- input
+
+    async def run(self) -> None:
+        """The connection's receive loop (emqx_connection:run_loop)."""
+        timer = asyncio.get_running_loop().create_task(self._timers())
+        reason = "closed"
+        try:
+            idle = self.broker.config.mqtt.idle_timeout
+            while not self._closed.is_set():
+                timeout = idle if self.channel.state == CONNECTING else None
+                try:
+                    data = await asyncio.wait_for(
+                        self.reader.read(65536), timeout
+                    )
+                except asyncio.TimeoutError:
+                    reason = "idle_timeout"
+                    break
+                if not data:
+                    break
+                self.broker.metrics.inc("bytes.received", len(data))
+                for pkt in self.parser.feed(data):
+                    self.channel.handle_in(pkt)
+                    if self._closed.is_set():
+                        break
+                await self._drain()
+        except C.MqttError as exc:
+            log.debug("codec error from %s: %s", self.channel.peer, exc)
+            reason = "frame_error"
+        except (ConnectionResetError, BrokenPipeError):
+            reason = "peer_reset"
+        except asyncio.CancelledError:
+            reason = "server_stopped"
+        finally:
+            timer.cancel()
+            self.channel.connection_lost(reason)
+            if not self.writer.is_closing():
+                self.writer.close()
+            try:
+                await self.writer.wait_closed()
+            except (ConnectionError, asyncio.CancelledError):
+                pass
+
+    async def _drain(self) -> None:
+        try:
+            await self.writer.drain()
+        except (ConnectionError, asyncio.CancelledError):
+            self._closed.set()
+
+    async def _timers(self) -> None:
+        """Keepalive + redelivery ticks (the reference's per-channel
+        timer messages, emqx_channel:handle_timeout/3)."""
+        while not self._closed.is_set():
+            await asyncio.sleep(_TIMER_TICK)
+            if self.channel.keepalive_expired():
+                self.channel.close("keepalive_timeout")
+                return
+            self.channel.retry_deliveries()
+            await self._drain()

@@ -1,0 +1,132 @@
+"""Listener lifecycle + broker server entry point.
+
+Re-creates `emqx_listeners` (/root/reference/apps/emqx/src/
+emqx_listeners.erl:242,430-448): bind/unbind TCP listeners, cap
+concurrent connections, hand accepted sockets to `Connection` loops.
+``python -m emqx_tpu.broker`` boots a broker the way `bin/emqx
+foreground` does.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, List, Optional
+
+from ..config import BrokerConfig, ListenerConfig
+from .broker import Broker
+from .connection import Connection
+
+log = logging.getLogger("emqx_tpu.listener")
+
+
+class Listener:
+    def __init__(self, broker: Broker, cfg: ListenerConfig) -> None:
+        self.broker = broker
+        self.cfg = cfg
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._conns: set = set()
+
+    @property
+    def port(self) -> int:
+        """Actual bound port (useful when cfg.port == 0)."""
+        if self._server is None or not self._server.sockets:
+            return self.cfg.port
+        return self._server.sockets[0].getsockname()[1]
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._on_client, self.cfg.bind, self.cfg.port
+        )
+        log.info(
+            "listener %s started on %s:%d",
+            self.cfg.name,
+            self.cfg.bind,
+            self.port,
+        )
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        for task in list(self._conns):
+            task.cancel()
+        if self._conns:
+            await asyncio.gather(*self._conns, return_exceptions=True)
+
+    async def _on_client(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        if len(self._conns) >= self.cfg.max_connections:
+            writer.close()
+            return
+        conn = Connection(
+            self.broker, reader, writer, mountpoint=self.cfg.mountpoint
+        )
+        task = asyncio.current_task()
+        self._conns.add(task)
+        try:
+            await conn.run()
+        finally:
+            self._conns.discard(task)
+
+
+class BrokerServer:
+    """A broker plus its listeners — the unit `emqx_machine` boots."""
+
+    def __init__(self, config: Optional[BrokerConfig] = None) -> None:
+        self.broker = Broker(config=config)
+        self.listeners: List[Listener] = [
+            Listener(self.broker, lc)
+            for lc in self.broker.config.listeners
+            if lc.enable and lc.type == "tcp"
+        ]
+
+    async def start(self) -> None:
+        for lst in self.listeners:
+            await lst.start()
+
+    async def stop(self) -> None:
+        for lst in self.listeners:
+            await lst.stop()
+
+    async def run_forever(self) -> None:
+        await self.start()
+        try:
+            await asyncio.Event().wait()
+        finally:
+            await self.stop()
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="emqx_tpu MQTT broker")
+    ap.add_argument("--port", type=int, default=1883)
+    ap.add_argument("--bind", default="0.0.0.0")
+    ap.add_argument("--config", help="JSON config file", default=None)
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    if args.config:
+        from ..config import ConfigHandler
+
+        cfg = ConfigHandler.load(args.config).root
+    else:
+        cfg = BrokerConfig()
+    cfg.listeners[0].port = args.port
+    cfg.listeners[0].bind = args.bind
+    server = BrokerServer(cfg)
+    try:
+        asyncio.run(server.run_forever())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

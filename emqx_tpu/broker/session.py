@@ -1,0 +1,329 @@
+"""In-memory MQTT session: subscriptions, mqueue, inflight, awaiting_rel.
+
+Re-creates `emqx_session_mem` (/root/reference/apps/emqx/src/
+emqx_session_mem.erl) + the session facade contract (emqx_session.erl
+callbacks :185-195): a channel-owned state machine holding QoS 1/2
+delivery windows.  Like the reference, an incoming QoS 2 PUBLISH is
+routed immediately and ``awaiting_rel`` only deduplicates until PUBREL
+(emqx_session_mem publish path).
+
+The session is detachable: on takeover the channel dies but the session
+object moves to the new channel with its pending queue and inflight
+window intact (emqx_session_mem:takeover/resume).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..codec import mqtt as C
+from ..message import Message
+from .inflight import Inflight
+from .mqueue import MQueue
+
+# inflight entry phases (server→client delivery)
+_PUBLISHING = "publish"  # sent PUBLISH, awaiting PUBACK (q1) / PUBREC (q2)
+_PUBREL = "pubrel"  # sent PUBREL, awaiting PUBCOMP
+
+
+@dataclass
+class SubOpts:
+    """Per-subscription options (the reference's subopts map)."""
+
+    qos: int = 0
+    no_local: bool = False
+    retain_as_published: bool = False
+    retain_handling: int = 0
+    subid: Optional[int] = None
+    share_group: Optional[str] = None
+
+    @classmethod
+    def from_subscription(
+        cls, sub: C.Subscription, share_group: Optional[str] = None
+    ) -> "SubOpts":
+        return cls(
+            qos=sub.qos,
+            no_local=sub.no_local,
+            retain_as_published=sub.retain_as_published,
+            retain_handling=sub.retain_handling,
+            share_group=share_group,
+        )
+
+
+@dataclass
+class _InflightEntry:
+    phase: str
+    msg: Optional[Message]
+    qos: int
+    ts: float
+
+
+class Session:
+    """One client's session state.  Pure data + transitions: no IO; the
+    channel turns returned ``Publish``/``Pubrel`` packets into bytes."""
+
+    def __init__(
+        self,
+        clientid: str,
+        clean_start: bool = True,
+        max_inflight: int = 32,
+        max_mqueue_len: int = 1000,
+        max_awaiting_rel: int = 100,
+        await_rel_timeout: float = 300.0,
+        retry_interval: float = 30.0,
+        expiry_interval: float = 0.0,
+        upgrade_qos: bool = False,
+        mqueue_priorities: Optional[Dict[str, int]] = None,
+        mqueue_default_priority: str = "lowest",
+        mqueue_store_qos0: bool = True,
+    ) -> None:
+        self.clientid = clientid
+        self.clean_start = clean_start
+        self.created_at = time.time()
+        self.subscriptions: Dict[str, SubOpts] = {}
+        self.mqueue = MQueue(
+            max_len=max_mqueue_len,
+            priorities=mqueue_priorities,
+            default_priority=mqueue_default_priority,
+            store_qos0=mqueue_store_qos0,
+        )
+        self.inflight = Inflight(max_inflight)
+        self.awaiting_rel: Dict[int, float] = {}
+        self.max_awaiting_rel = max_awaiting_rel
+        self.await_rel_timeout = await_rel_timeout
+        self.retry_interval = retry_interval
+        self.expiry_interval = expiry_interval
+        self.upgrade_qos = upgrade_qos
+        self._next_pid = 0
+
+    # ------------------------------------------------------- packet ids
+
+    def _alloc_packet_id(self) -> int:
+        for _ in range(65535):
+            self._next_pid = self._next_pid % 65535 + 1
+            if self._next_pid not in self.inflight:
+                return self._next_pid
+        raise RuntimeError("no free packet id")
+
+    # ------------------------------------------------------ subscribe
+
+    def subscribe(self, flt: str, opts: SubOpts) -> bool:
+        """Record the subscription; returns True if it is new (vs an
+        option refresh of an existing one)."""
+        is_new = flt not in self.subscriptions
+        self.subscriptions[flt] = opts
+        return is_new
+
+    def unsubscribe(self, flt: str) -> Optional[SubOpts]:
+        return self.subscriptions.pop(flt, None)
+
+    # -------------------------------------------------- deliver (out)
+
+    def deliver(
+        self, deliveries: List[Tuple[Message, SubOpts]]
+    ) -> List[C.Packet]:
+        """Accept matched messages for this session; returns the wire
+        packets that can go out now (window permitting) — the
+        `emqx_session:deliver/3` path."""
+        out: List[C.Packet] = []
+        for msg, opts in deliveries:
+            if opts.no_local and msg.from_client == self.clientid:
+                continue  # [MQTT-3.8.3-3]
+            qos = self._effective_qos(msg.qos, opts)
+            if qos == 0:
+                out.append(self._publish_packet(msg, opts, 0, None))
+                continue
+            if self.inflight.is_full():
+                self.mqueue.insert(self._queued(msg, opts, qos))
+                continue
+            pid = self._alloc_packet_id()
+            self.inflight.insert(
+                pid, _InflightEntry(_PUBLISHING, msg, qos, time.time())
+            )
+            out.append(self._publish_packet(msg, opts, qos, pid))
+        return out
+
+    def _effective_qos(self, msg_qos: int, opts: SubOpts) -> int:
+        if self.upgrade_qos:
+            return max(msg_qos, opts.qos)
+        return min(msg_qos, opts.qos)
+
+    def _queued(self, msg: Message, opts: SubOpts, qos: int) -> Message:
+        # bake the effective qos + subopts into the queued copy so the
+        # dequeue path needs no lookup (subscription may even be gone)
+        q = Message(
+            topic=msg.topic,
+            payload=msg.payload,
+            qos=qos,
+            retain=msg.retain and opts.retain_as_published,
+            from_client=msg.from_client,
+            from_username=msg.from_username,
+            mid=msg.mid,
+            timestamp=msg.timestamp,
+            properties=dict(msg.properties),
+        )
+        if opts.subid is not None:
+            q.properties["subscription_identifier"] = [opts.subid]
+        return q
+
+    def _publish_packet(
+        self,
+        msg: Message,
+        opts: Optional[SubOpts],
+        qos: int,
+        pid: Optional[int],
+        dup: bool = False,
+    ) -> C.Publish:
+        props = dict(msg.properties)
+        if opts is not None and opts.subid is not None:
+            props["subscription_identifier"] = [opts.subid]
+        left = msg.remaining_expiry()
+        if left is not None:
+            props["message_expiry_interval"] = left  # [MQTT-3.3.2-6]
+        retain = msg.retain and (opts is None or opts.retain_as_published)
+        return C.Publish(
+            topic=msg.topic,
+            payload=msg.payload,
+            qos=qos,
+            retain=retain,
+            dup=dup,
+            packet_id=pid,
+            properties=props,
+        )
+
+    def _dequeue(self) -> List[C.Packet]:
+        out: List[C.Packet] = []
+        while not self.inflight.is_full():
+            msg = self.mqueue.pop()
+            if msg is None:
+                break
+            if msg.expired():
+                continue
+            if msg.qos == 0:
+                out.append(self._publish_packet(msg, None, 0, None))
+                continue
+            pid = self._alloc_packet_id()
+            self.inflight.insert(
+                pid, _InflightEntry(_PUBLISHING, msg, msg.qos, time.time())
+            )
+            out.append(self._publish_packet(msg, None, msg.qos, pid))
+        return out
+
+    # ------------------------------------------- client acks (out path)
+
+    def puback(self, pid: int) -> Tuple[bool, List[C.Packet]]:
+        """PUBACK for a QoS 1 delivery; returns (known, follow-ups)."""
+        entry = self.inflight.get(pid)
+        if entry is None or entry.qos != 1:
+            return False, []
+        self.inflight.delete(pid)
+        return True, self._dequeue()
+
+    def pubrec(self, pid: int) -> Tuple[bool, List[C.Packet]]:
+        """PUBREC for a QoS 2 delivery: advance to PUBREL phase."""
+        entry = self.inflight.get(pid)
+        if entry is None or entry.qos != 2 or entry.phase != _PUBLISHING:
+            return False, []
+        self.inflight.update(
+            pid, _InflightEntry(_PUBREL, None, 2, time.time())
+        )
+        return True, [C.Pubrel(packet_id=pid)]
+
+    def pubcomp(self, pid: int) -> Tuple[bool, List[C.Packet]]:
+        entry = self.inflight.get(pid)
+        if entry is None or entry.phase != _PUBREL:
+            return False, []
+        self.inflight.delete(pid)
+        return True, self._dequeue()
+
+    # ------------------------------------------- incoming QoS 2 dedup
+
+    def awaiting_rel_add(self, pid: int) -> str:
+        """Register an incoming QoS 2 packet id.  Returns 'ok',
+        'in_use' (duplicate), or 'full'."""
+        if pid in self.awaiting_rel:
+            return "in_use"
+        if (
+            self.max_awaiting_rel
+            and len(self.awaiting_rel) >= self.max_awaiting_rel
+        ):
+            return "full"
+        self.awaiting_rel[pid] = time.time()
+        return "ok"
+
+    def pubrel(self, pid: int) -> bool:
+        return self.awaiting_rel.pop(pid, None) is not None
+
+    def expire_awaiting_rel(self, now: Optional[float] = None) -> int:
+        now = now if now is not None else time.time()
+        stale = [
+            pid
+            for pid, ts in self.awaiting_rel.items()
+            if now - ts > self.await_rel_timeout
+        ]
+        for pid in stale:
+            del self.awaiting_rel[pid]
+        return len(stale)
+
+    # ------------------------------------------------- retry / resume
+
+    def retry(self, now: Optional[float] = None) -> List[C.Packet]:
+        """Retransmit timed-out inflight entries (emqx_session_mem
+        retry timer)."""
+        now = now if now is not None else time.time()
+        out: List[C.Packet] = []
+        for pid, entry in self.inflight.items():
+            if now - entry.ts < self.retry_interval:
+                continue
+            if entry.phase == _PUBLISHING and entry.msg is not None:
+                if entry.msg.expired(now):
+                    self.inflight.delete(pid)
+                    continue
+                self.inflight.update(
+                    pid,
+                    _InflightEntry(_PUBLISHING, entry.msg, entry.qos, now),
+                )
+                out.append(
+                    self._publish_packet(
+                        entry.msg, None, entry.qos, pid, dup=True
+                    )
+                )
+            elif entry.phase == _PUBREL:
+                self.inflight.update(pid, _InflightEntry(_PUBREL, None, 2, now))
+                out.append(C.Pubrel(packet_id=pid))
+        return out
+
+    def resume(self) -> List[C.Packet]:
+        """Redeliver state to a reconnected client: all inflight
+        PUBLISHes (dup=1) and PUBRELs in original order, then drain the
+        queue into the window (emqx_session_mem:replay)."""
+        out: List[C.Packet] = []
+        now = time.time()
+        for pid, entry in self.inflight.items():
+            if entry.phase == _PUBLISHING and entry.msg is not None:
+                self.inflight.update(
+                    pid,
+                    _InflightEntry(_PUBLISHING, entry.msg, entry.qos, now),
+                )
+                out.append(
+                    self._publish_packet(
+                        entry.msg, None, entry.qos, pid, dup=True
+                    )
+                )
+            elif entry.phase == _PUBREL:
+                out.append(C.Pubrel(packet_id=pid))
+        out.extend(self._dequeue())
+        return out
+
+    def info(self) -> Dict[str, object]:
+        return {
+            "clientid": self.clientid,
+            "created_at": self.created_at,
+            "subscriptions_cnt": len(self.subscriptions),
+            "mqueue_len": len(self.mqueue),
+            "mqueue_dropped": self.mqueue.dropped,
+            "inflight_cnt": len(self.inflight),
+            "awaiting_rel_cnt": len(self.awaiting_rel),
+        }

@@ -1,0 +1,170 @@
+"""Typed broker configuration with runtime update handlers.
+
+A deliberately small analogue of the reference's HOCON config system
+(`emqx_config` persistent-term cache + per-path update handlers,
+/root/reference/apps/emqx/src/emqx_config.erl, emqx_config_handler.erl):
+typed dataclasses with defaults, dotted-path get/update, and validating
+change listeners.  Zone overrides collapse to per-listener overrides.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+@dataclass
+class MqttConfig:
+    max_packet_size: int = 1024 * 1024
+    max_clientid_len: int = 65535
+    max_topic_levels: int = 128
+    max_qos_allowed: int = 2
+    max_topic_alias: int = 65535
+    retain_available: bool = True
+    wildcard_subscription: bool = True
+    shared_subscription: bool = True
+    exclusive_subscription: bool = False
+    max_inflight: int = 32
+    max_awaiting_rel: int = 100
+    await_rel_timeout: float = 300.0
+    max_mqueue_len: int = 1000
+    mqueue_priorities: Dict[str, int] = field(default_factory=dict)
+    mqueue_default_priority: str = "lowest"  # lowest | highest
+    mqueue_store_qos0: bool = True
+    upgrade_qos: bool = False
+    keepalive_multiplier: float = 1.5
+    session_expiry_interval: float = 7200.0
+    server_keepalive: Optional[int] = None
+    retry_interval: float = 30.0
+    idle_timeout: float = 15.0
+
+
+@dataclass
+class ListenerConfig:
+    name: str = "tcp_default"
+    type: str = "tcp"  # tcp | ws
+    bind: str = "0.0.0.0"
+    port: int = 1883
+    max_connections: int = 1024000
+    mountpoint: Optional[str] = None
+    enable: bool = True
+
+
+@dataclass
+class AuthConfig:
+    allow_anonymous: bool = True
+    authz_default: str = "allow"  # allow | deny
+    deny_action: str = "ignore"  # ignore | disconnect
+
+
+@dataclass
+class RetainerConfig:
+    enable: bool = True
+    max_retained_messages: int = 0  # 0 = unlimited
+    max_payload_size: int = 1024 * 1024
+    msg_expiry_interval: float = 0.0  # 0 = never
+    deliver_rate: int = 1000  # per batch flush
+
+
+@dataclass
+class BrokerEngineConfig:
+    """Knobs for the TPU match engine + batch dispatcher."""
+
+    use_device: Optional[bool] = None  # None = auto
+    max_levels: int = 16
+    f_width: int = 16
+    m_cap: int = 128
+    rebuild_threshold: int = 4096
+    batch_window_ms: float = 1.0  # micro-batch accumulation window
+    batch_max: int = 4096
+
+
+@dataclass
+class SysConfig:
+    enable: bool = True
+    interval: float = 60.0  # $SYS heartbeat publish interval
+
+
+@dataclass
+class BrokerConfig:
+    mqtt: MqttConfig = field(default_factory=MqttConfig)
+    listeners: List[ListenerConfig] = field(
+        default_factory=lambda: [ListenerConfig()]
+    )
+    auth: AuthConfig = field(default_factory=AuthConfig)
+    retainer: RetainerConfig = field(default_factory=RetainerConfig)
+    engine: BrokerEngineConfig = field(default_factory=BrokerEngineConfig)
+    sys: SysConfig = field(default_factory=SysConfig)
+    node_name: str = "emqx_tpu@127.0.0.1"
+
+
+class ConfigHandler:
+    """Dotted-path get/update with validating listeners
+    (`emqx_config_handler` analogue)."""
+
+    def __init__(self, cfg: Optional[BrokerConfig] = None) -> None:
+        self.root = cfg or BrokerConfig()
+        self._handlers: Dict[str, List[Callable[[Any, Any], None]]] = {}
+
+    def get(self, path: str) -> Any:
+        obj: Any = self.root
+        for part in path.split("."):
+            if isinstance(obj, dict):
+                obj = obj[part]
+            else:
+                obj = getattr(obj, part)
+        return obj
+
+    def update(self, path: str, value: Any) -> Any:
+        """Set `path` to `value`, running registered handlers first;
+        a handler raising aborts the update (validation)."""
+        old = self.get(path)
+        for prefix, fns in self._handlers.items():
+            if path == prefix or path.startswith(prefix + "."):
+                for fn in fns:
+                    fn(old, value)
+        parts = path.split(".")
+        obj: Any = self.root
+        for part in parts[:-1]:
+            obj = obj[part] if isinstance(obj, dict) else getattr(obj, part)
+        if isinstance(obj, dict):
+            obj[parts[-1]] = value
+        else:
+            setattr(obj, parts[-1], value)
+        return value
+
+    def add_handler(
+        self, path: str, fn: Callable[[Any, Any], None]
+    ) -> None:
+        self._handlers.setdefault(path, []).append(fn)
+
+    # ---------------------------------------------------------- io
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self.root)
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "ConfigHandler":
+        root = BrokerConfig()
+        _merge_dataclass(root, data)
+        return cls(root)
+
+    @classmethod
+    def load(cls, path: str) -> "ConfigHandler":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+
+def _merge_dataclass(obj: Any, data: Dict[str, Any]) -> None:
+    for key, val in data.items():
+        if not hasattr(obj, key):
+            raise ValueError(f"unknown config key: {key}")
+        cur = getattr(obj, key)
+        if dataclasses.is_dataclass(cur) and isinstance(val, dict):
+            _merge_dataclass(cur, val)
+        elif key == "listeners" and isinstance(val, list):
+            setattr(obj, key, [ListenerConfig(**item) for item in val])
+        else:
+            setattr(obj, key, val)

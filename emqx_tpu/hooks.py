@@ -1,0 +1,132 @@
+"""Priority-ordered hook chains: the extension spine.
+
+Re-expresses the reference's hook system (`emqx_hooks:run/2`,
+`run_fold/3`, /root/reference/apps/emqx/src/emqx_hooks.erl; hookpoint
+inventory emqx_hookpoints.erl:40-71) without the gen_server: a plain
+registry of callback chains, sorted by descending priority then
+registration order.  Callbacks signal flow control by return value:
+
+  * ``run`` (notify):   return ``STOP`` to halt the chain, anything
+    else to continue.
+  * ``run_fold`` (transform): return ``STOP`` to halt keeping the
+    current accumulator, ``STOP_WITH(v)`` to halt replacing it,
+    ``None`` to pass the accumulator through unchanged, any other
+    value to replace the accumulator and continue.
+"""
+
+from __future__ import annotations
+
+import bisect
+import itertools
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
+
+# the core hookpoints (emqx_hookpoints.erl:40-71); registration is not
+# limited to these, but they document the broker's extension surface
+HOOKPOINTS = (
+    "client.connect",
+    "client.connack",
+    "client.connected",
+    "client.disconnected",
+    "client.authenticate",
+    "client.authorize",
+    "client.subscribe",
+    "client.unsubscribe",
+    "session.created",
+    "session.subscribed",
+    "session.unsubscribed",
+    "session.resumed",
+    "session.discarded",
+    "session.takenover",
+    "session.terminated",
+    "message.publish",
+    "message.puback",
+    "message.delivered",
+    "message.acked",
+    "message.dropped",
+    "delivery.dropped",
+)
+
+
+class _Stop:
+    __slots__ = ("value", "has_value")
+
+    def __init__(self, value: Any = None, has_value: bool = False):
+        self.value = value
+        self.has_value = has_value
+
+    def __repr__(self) -> str:
+        return f"STOP_WITH({self.value!r})" if self.has_value else "STOP"
+
+
+STOP = _Stop()
+
+
+def STOP_WITH(value: Any) -> _Stop:
+    return _Stop(value, True)
+
+
+class Callback(NamedTuple):
+    priority: int
+    seq: int
+    fn: Callable[..., Any]
+
+    def sort_key(self) -> Tuple[int, int]:
+        # higher priority first; ties in registration order
+        return (-self.priority, self.seq)
+
+
+class HookRegistry:
+    def __init__(self) -> None:
+        self._chains: Dict[str, List[Callback]] = {}
+        self._seq = itertools.count()
+
+    def add(
+        self, name: str, fn: Callable[..., Any], priority: int = 0
+    ) -> Callback:
+        cb = Callback(priority, next(self._seq), fn)
+        chain = self._chains.setdefault(name, [])
+        bisect.insort(chain, cb, key=Callback.sort_key)
+        return cb
+
+    def delete(self, name: str, fn_or_cb: Any) -> bool:
+        chain = self._chains.get(name, [])
+        for i, cb in enumerate(chain):
+            if cb is fn_or_cb or cb.fn is fn_or_cb:
+                del chain[i]
+                return True
+        return False
+
+    def callbacks(self, name: str) -> List[Callback]:
+        return list(self._chains.get(name, ()))
+
+    def run(self, name: str, *args: Any) -> None:
+        """Notify chain: each callback sees the same args; a ``STOP``
+        return halts the chain (emqx_hooks:run/2)."""
+        for cb in self._chains.get(name, ()):
+            res = cb.fn(*args)
+            if isinstance(res, _Stop):
+                return
+
+    def run_fold(self, name: str, args: Tuple[Any, ...], acc: Any) -> Any:
+        """Transform chain: callbacks get ``(*args, acc)`` and may
+        replace the accumulator (emqx_hooks:run_fold/3)."""
+        for cb in self._chains.get(name, ()):
+            res = cb.fn(*args, acc)
+            if isinstance(res, _Stop):
+                return res.value if res.has_value else acc
+            if res is not None:
+                acc = res
+        return acc
+
+
+# the default, process-global registry (the reference's hooks live in a
+# single ets table owned by one gen_server; one module-level registry
+# is the direct analogue for a single broker instance)
+_global: Optional[HookRegistry] = None
+
+
+def global_registry() -> HookRegistry:
+    global _global
+    if _global is None:
+        _global = HookRegistry()
+    return _global

@@ -1,0 +1,145 @@
+"""Named counters + gauges.
+
+The reference keeps a fixed-slot `counters` array referenced from
+persistent_term (`emqx_metrics`, /root/reference/apps/emqx/src/
+emqx_metrics.erl:152-356) so hot-path increments are lock-free.  The
+Python analogue: a flat list of ints indexed by a frozen name->slot map
+(attribute lookups hoisted by callers via ``counter(name)`` handles).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+# metric names mirror the reference's ?BYTES_METRICS / ?PACKET_METRICS /
+# ?MESSAGE_METRICS tables (emqx_metrics.erl:45-150)
+METRICS = (
+    "bytes.received",
+    "bytes.sent",
+    "packets.received",
+    "packets.sent",
+    "packets.connect.received",
+    "packets.connack.sent",
+    "packets.publish.received",
+    "packets.publish.sent",
+    "packets.publish.dropped",
+    "packets.publish.error",
+    "packets.publish.auth_error",
+    "packets.puback.received",
+    "packets.puback.sent",
+    "packets.pubrec.received",
+    "packets.pubrec.sent",
+    "packets.pubrel.received",
+    "packets.pubrel.sent",
+    "packets.pubcomp.received",
+    "packets.pubcomp.sent",
+    "packets.subscribe.received",
+    "packets.suback.sent",
+    "packets.subscribe.error",
+    "packets.subscribe.auth_error",
+    "packets.unsubscribe.received",
+    "packets.unsuback.sent",
+    "packets.pingreq.received",
+    "packets.pingresp.sent",
+    "packets.disconnect.received",
+    "packets.disconnect.sent",
+    "packets.auth.received",
+    "messages.received",
+    "messages.sent",
+    "messages.qos0.received",
+    "messages.qos0.sent",
+    "messages.qos1.received",
+    "messages.qos1.sent",
+    "messages.qos2.received",
+    "messages.qos2.sent",
+    "messages.publish",
+    "messages.delivered",
+    "messages.acked",
+    "messages.dropped",
+    "messages.dropped.no_subscribers",
+    "messages.dropped.await_pubrel_timeout",
+    "messages.dropped.expired",
+    "messages.dropped.queue_full",
+    "messages.forward",
+    "messages.retained",
+    "delivery.dropped",
+    "delivery.dropped.no_local",
+    "delivery.dropped.too_large",
+    "delivery.dropped.queue_full",
+    "delivery.dropped.expired",
+    "session.created",
+    "session.resumed",
+    "session.takenover",
+    "session.discarded",
+    "session.terminated",
+    "client.connect",
+    "client.connack",
+    "client.connected",
+    "client.disconnected",
+    "client.authenticate",
+    "client.auth.anonymous",
+    "client.authorize",
+    "authorization.allow",
+    "authorization.deny",
+    "rules.matched",
+    "actions.success",
+    "actions.failed",
+)
+
+_SLOT = {name: i for i, name in enumerate(METRICS)}
+
+
+class Metrics:
+    """One counter array; ``inc``/``val`` by name, ``counter`` returns a
+    bound fast-path increment callable."""
+
+    def __init__(self) -> None:
+        self._c: List[int] = [0] * len(METRICS)
+        self.start_time = time.time()
+
+    def inc(self, name: str, by: int = 1) -> None:
+        self._c[_SLOT[name]] += by
+
+    def val(self, name: str) -> int:
+        return self._c[_SLOT[name]]
+
+    def counter(self, name: str) -> Callable[[], None]:
+        slot = _SLOT[name]
+        c = self._c
+
+        def bump() -> None:
+            c[slot] += 1
+
+        return bump
+
+    def all(self) -> Dict[str, int]:
+        return {name: self._c[i] for name, i in _SLOT.items()}
+
+    def reset(self) -> None:
+        self._c = [0] * len(METRICS)
+
+
+class Stats:
+    """Max-tracking gauges (`emqx_stats`): current + historical max."""
+
+    def __init__(self) -> None:
+        self._cur: Dict[str, int] = {}
+        self._max: Dict[str, int] = {}
+
+    def set(self, name: str, value: int) -> None:
+        self._cur[name] = value
+        if value > self._max.get(name + ".max", 0):
+            self._max[name + ".max"] = value
+
+    def update_delta(self, name: str, delta: int) -> None:
+        self.set(name, self._cur.get(name, 0) + delta)
+
+    def get(self, name: str) -> int:
+        return self._cur.get(name, self._max.get(name, 0))
+
+    def all(self) -> Dict[str, int]:
+        out = dict(self._cur)
+        out.update(self._max)
+        return out

@@ -1,0 +1,132 @@
+"""Router: subscription registry over the TPU match engine.
+
+The layer the reference splits across `emqx_broker` subscriber tables +
+`emqx_router` route table (/root/reference/apps/emqx/src/
+emqx_broker.erl:119-132 ETS tables; emqx_router.erl:476-525 v2 route
+schema).  Here one object owns both because a single host is one
+"node": the `MatchEngine` indexes each distinct real filter once
+(fid = the filter string), and per-filter subscriber maps carry
+(clientid -> SubOpts) fan-out, CSR-expanded at dispatch time.
+
+Shared subscriptions route through the same engine entry for the real
+filter; group membership and per-message picks live in
+`SharedSubManager`.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from . import topic as T
+from .broker.session import SubOpts
+from .broker.shared import SharedSubManager
+from .engine import MatchEngine
+
+
+class Router:
+    def __init__(
+        self,
+        engine: Optional[MatchEngine] = None,
+        shared: Optional[SharedSubManager] = None,
+    ) -> None:
+        self.engine = engine or MatchEngine()
+        self.shared = shared or SharedSubManager()
+        # real filter -> {clientid -> SubOpts} (direct, non-shared)
+        self._subs: Dict[str, Dict[str, SubOpts]] = {}
+        # real filter -> {(group, clientid) -> SubOpts} (shared)
+        self._shared_opts: Dict[str, Dict[Tuple[str, str], SubOpts]] = {}
+        # clientid -> set of full filter strings (incl. $share prefix)
+        self._by_client: Dict[str, Set[str]] = {}
+
+    # ------------------------------------------------------- mutation
+
+    def subscribe(self, clientid: str, flt: str, opts: SubOpts) -> None:
+        """Register `clientid`'s subscription to `flt` (which may be a
+        `$share/...` filter).  Mirrors emqx_broker:subscribe/3 +
+        route-add (emqx_broker.erl:151-190, 691-721)."""
+        shared = T.parse_share(flt)
+        if shared is not None:
+            real = shared.topic
+            opts.share_group = shared.group
+            need_route = self.shared.join(shared.group, real, clientid)
+            self._shared_opts.setdefault(real, {})[
+                (shared.group, clientid)
+            ] = opts
+            if need_route and real not in self._subs:
+                self.engine.insert(real, real)
+        else:
+            real = flt
+            subs = self._subs.get(real)
+            if subs is None:
+                subs = self._subs[real] = {}
+                if real not in self._shared_opts or not self._shared_opts[real]:
+                    self.engine.insert(real, real)
+            subs[clientid] = opts
+        self._by_client.setdefault(clientid, set()).add(flt)
+
+    def unsubscribe(self, clientid: str, flt: str) -> bool:
+        shared = T.parse_share(flt)
+        if shared is not None:
+            real = shared.topic
+            emptied = self.shared.leave(shared.group, real, clientid)
+            opts_map = self._shared_opts.get(real)
+            if opts_map is not None:
+                opts_map.pop((shared.group, clientid), None)
+                if not opts_map:
+                    del self._shared_opts[real]
+            removed = True
+        else:
+            real = flt
+            subs = self._subs.get(real)
+            if subs is None or clientid not in subs:
+                removed = False
+            else:
+                del subs[clientid]
+                if not subs:
+                    del self._subs[real]
+                removed = True
+        self._maybe_drop_route(real)
+        filters = self._by_client.get(clientid)
+        if filters is not None:
+            filters.discard(flt)
+            if not filters:
+                del self._by_client[clientid]
+        return removed
+
+    def _maybe_drop_route(self, real: str) -> None:
+        if real not in self._subs and real not in self._shared_opts:
+            self.engine.delete(real)
+
+    def cleanup_client(self, clientid: str) -> None:
+        """Drop every subscription of a dead client (the
+        `subscriber_down` path, emqx_broker.erl:448-462)."""
+        for flt in list(self._by_client.get(clientid, ())):
+            self.unsubscribe(clientid, flt)
+
+    def subscriptions_of(self, clientid: str) -> Set[str]:
+        return set(self._by_client.get(clientid, ()))
+
+    def topics(self) -> List[str]:
+        """All indexed real filters (the route-table dump used by the
+        mgmt API's /topics)."""
+        return list(self._subs.keys() | self._shared_opts.keys())
+
+    # --------------------------------------------------------- match
+
+    def match_batch(
+        self, topics: Sequence[str]
+    ) -> List[Set[str]]:
+        """Real filters matching each topic (batched on device)."""
+        return self.engine.match_batch(topics)
+
+    def subscribers(
+        self, real: str
+    ) -> List[Tuple[str, SubOpts]]:
+        """Direct (non-shared) subscribers of a matched filter."""
+        return list(self._subs.get(real, {}).items())
+
+    def shared_opts(
+        self, real: str, group: str, clientid: str
+    ) -> Optional[SubOpts]:
+        m = self._shared_opts.get(real)
+        return None if m is None else m.get((group, clientid))

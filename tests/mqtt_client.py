@@ -1,0 +1,178 @@
+"""Minimal asyncio MQTT test client speaking real bytes through the
+repo codec — the role `emqtt` plays in the reference's client suites
+(apps/emqx/test/emqx_client_SUITE.erl): black-box testing through an
+actual socket."""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+from typing import List, Optional
+
+from emqx_tpu.codec import mqtt as C
+
+
+class TestClient:
+    __test__ = False  # not a pytest class
+
+    def __init__(
+        self,
+        port: int,
+        client_id: str = "",
+        version: int = C.MQTT_V5,
+        host: str = "127.0.0.1",
+    ):
+        self.host, self.port = host, port
+        self.client_id = client_id
+        self.version = version
+        self.reader: Optional[asyncio.StreamReader] = None
+        self.writer: Optional[asyncio.StreamWriter] = None
+        self.parser = C.StreamParser(version=version)
+        self._pids = itertools.count(1)
+        self._inbox: asyncio.Queue = asyncio.Queue()
+        self._pump: Optional[asyncio.Task] = None
+
+    async def connect(
+        self,
+        clean_start: bool = True,
+        keepalive: int = 60,
+        username: Optional[str] = None,
+        password: Optional[bytes] = None,
+        will: Optional[C.Will] = None,
+        properties: Optional[dict] = None,
+    ) -> C.Connack:
+        self.reader, self.writer = await asyncio.open_connection(
+            self.host, self.port
+        )
+        self._pump = asyncio.get_running_loop().create_task(self._read_loop())
+        await self.send(
+            C.Connect(
+                client_id=self.client_id,
+                proto_ver=self.version,
+                clean_start=clean_start,
+                keepalive=keepalive,
+                username=username,
+                password=password,
+                will=will,
+                properties=properties or {},
+            )
+        )
+        ack = await self.expect(C.CONNACK)
+        return ack
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                data = await self.reader.read(65536)
+                if not data:
+                    break
+                for pkt in self.parser.feed(data):
+                    await self._inbox.put(pkt)
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        await self._inbox.put(None)  # EOF marker
+
+    async def send(self, pkt: C.Packet) -> None:
+        self.writer.write(C.serialize(pkt, self.version))
+        await self.writer.drain()
+
+    async def recv(self, timeout: float = 2.0) -> Optional[C.Packet]:
+        """Next packet, or None on EOF."""
+        return await asyncio.wait_for(self._inbox.get(), timeout)
+
+    async def expect(self, ptype: int, timeout: float = 2.0) -> C.Packet:
+        """Next packet of the given type; auto-acks nothing, fails on
+        EOF or a different packet type."""
+        pkt = await self.recv(timeout)
+        assert pkt is not None, "connection closed while waiting"
+        assert pkt.type == ptype, f"expected type {ptype}, got {pkt!r}"
+        return pkt
+
+    async def subscribe(
+        self, *filters, qos: int = 0, **subopts
+    ) -> C.Suback:
+        pid = next(self._pids)
+        subs = [
+            C.Subscription(topic_filter=f, qos=qos, **subopts)
+            for f in filters
+        ]
+        await self.send(C.Subscribe(packet_id=pid, subscriptions=subs))
+        ack = await self.expect(C.SUBACK)
+        assert ack.packet_id == pid
+        return ack
+
+    async def unsubscribe(self, *filters) -> C.Unsuback:
+        pid = next(self._pids)
+        await self.send(
+            C.Unsubscribe(packet_id=pid, topic_filters=list(filters))
+        )
+        ack = await self.expect(C.UNSUBACK)
+        assert ack.packet_id == pid
+        return ack
+
+    async def publish(
+        self,
+        topic: str,
+        payload: bytes = b"",
+        qos: int = 0,
+        retain: bool = False,
+        properties: Optional[dict] = None,
+    ) -> Optional[C.Packet]:
+        """Publish and complete the QoS handshake; returns the final
+        ack (PUBACK/PUBCOMP) or None for QoS 0."""
+        pid = next(self._pids) if qos else None
+        await self.send(
+            C.Publish(
+                topic=topic,
+                payload=payload,
+                qos=qos,
+                retain=retain,
+                packet_id=pid,
+                properties=properties or {},
+            )
+        )
+        if qos == 0:
+            return None
+        if qos == 1:
+            ack = await self.expect(C.PUBACK)
+            assert ack.packet_id == pid
+            return ack
+        rec = await self.expect(C.PUBREC)
+        assert rec.packet_id == pid
+        await self.send(C.Pubrel(packet_id=pid))
+        comp = await self.expect(C.PUBCOMP)
+        assert comp.packet_id == pid
+        return comp
+
+    async def recv_publish(self, timeout: float = 2.0, ack: bool = True) -> C.Publish:
+        """Wait for an inbound PUBLISH, completing its QoS handshake."""
+        while True:
+            pkt = await self.recv(timeout)
+            assert pkt is not None, "connection closed"
+            if pkt.type != C.PUBLISH:
+                continue
+            if ack and pkt.qos == 1:
+                await self.send(C.Puback(packet_id=pkt.packet_id))
+            elif ack and pkt.qos == 2:
+                await self.send(C.Pubrec(packet_id=pkt.packet_id))
+                rel = await self.expect(C.PUBREL)
+                await self.send(C.Pubcomp(packet_id=rel.packet_id))
+            return pkt
+
+    async def ping(self) -> None:
+        await self.send(C.Pingreq())
+        await self.expect(C.PINGRESP)
+
+    async def disconnect(self, reason_code: int = 0) -> None:
+        await self.send(C.Disconnect(reason_code=reason_code))
+        await self.close()
+
+    async def close(self) -> None:
+        if self._pump is not None:
+            self._pump.cancel()
+        if self.writer is not None and not self.writer.is_closing():
+            self.writer.close()
+            try:
+                await self.writer.wait_closed()
+            except ConnectionError:
+                pass

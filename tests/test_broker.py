@@ -1,0 +1,153 @@
+"""Broker-level tests (no sockets): publish routing, fan-out, shared
+dispatch, retained replay, detached-session queueing, hooks, metrics."""
+
+import pytest
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.session import SubOpts
+from emqx_tpu.config import BrokerConfig
+from emqx_tpu.hooks import STOP_WITH
+from emqx_tpu.message import Message
+
+
+class FakeChannel:
+    def __init__(self):
+        self.sent = []
+        self.closed = None
+
+    def send_packets(self, pkts):
+        self.sent.extend(pkts)
+
+    def close(self, reason):
+        self.closed = reason
+
+
+def _connect(broker, clientid, clean_start=True, expiry=0.0):
+    ch = FakeChannel()
+    session, present = broker.cm.open_session(
+        clean_start, clientid, ch, expiry_interval=expiry
+    )
+    return ch, session
+
+
+def test_publish_fanout_to_multiple_subscribers():
+    b = Broker()
+    ch1, s1 = _connect(b, "c1")
+    ch2, s2 = _connect(b, "c2")
+    s1.subscribe("a/+", SubOpts(qos=0))
+    b.subscribe("c1", "a/+", SubOpts(qos=0))
+    s2.subscribe("a/b", SubOpts(qos=1))
+    b.subscribe("c2", "a/b", SubOpts(qos=1))
+
+    n = b.publish(Message(topic="a/b", payload=b"hi", qos=1))
+    assert n == 2
+    assert len(ch1.sent) == 1 and ch1.sent[0].qos == 0
+    assert len(ch2.sent) == 1 and ch2.sent[0].qos == 1
+    assert b.metrics.val("messages.delivered") == 2
+
+
+def test_publish_no_subscribers_drops():
+    b = Broker()
+    assert b.publish(Message(topic="nobody/home")) == 0
+    assert b.metrics.val("messages.dropped.no_subscribers") == 1
+
+
+def test_publish_many_batches_one_device_step():
+    b = Broker()
+    ch, s = _connect(b, "c1")
+    for flt in ("a/+", "b/#"):
+        s.subscribe(flt, SubOpts(qos=0))
+        b.subscribe("c1", flt, SubOpts(qos=0))
+    counts = b.publish_many(
+        [
+            Message(topic="a/x"),
+            Message(topic="b/y/z"),
+            Message(topic="c"),
+        ]
+    )
+    assert counts == [1, 1, 0]
+    assert len(ch.sent) == 2
+
+
+def test_message_publish_hook_mutates_and_drops():
+    b = Broker()
+    ch, s = _connect(b, "c1")
+    s.subscribe("t", SubOpts(qos=0))
+    b.subscribe("c1", "t", SubOpts(qos=0))
+
+    def rewrite(msg):
+        if msg.topic == "drop/me":
+            return STOP_WITH(None)
+        return Message(
+            topic=msg.topic, payload=msg.payload + b"!", qos=msg.qos,
+            from_client=msg.from_client,
+        )
+
+    b.hooks.add("message.publish", rewrite)
+    assert b.publish(Message(topic="drop/me")) == 0
+    b.publish(Message(topic="t", payload=b"x"))
+    assert ch.sent[0].payload == b"x!"
+
+
+def test_shared_dispatch_picks_one_and_skips_dead():
+    b = Broker(shared_strategy="round_robin")
+    ch1, s1 = _connect(b, "c1")
+    ch2, s2 = _connect(b, "c2")
+    for cid, s in (("c1", s1), ("c2", s2)):
+        s.subscribe("$share/g/t", SubOpts(qos=0))
+        b.subscribe(cid, "$share/g/t", SubOpts(qos=0))
+
+    for _ in range(4):
+        assert b.publish(Message(topic="t")) == 1
+    assert len(ch1.sent) == 2 and len(ch2.sent) == 2
+
+    # kill c1: picks must redispatch to c2
+    b.cm.kick("c1")
+    for _ in range(2):
+        assert b.publish(Message(topic="t")) == 1
+    assert len(ch2.sent) == 4
+
+
+def test_retained_replay_on_subscribe():
+    b = Broker()
+    b.publish(Message(topic="a/b", payload=b"keep", retain=True))
+    assert b.metrics.val("messages.retained") == 1
+    ch, s = _connect(b, "c1")
+    opts = SubOpts(qos=1)
+    s.subscribe("a/+", opts)
+    retained = b.subscribe("c1", "a/+", opts)
+    assert [m.topic for m in retained] == ["a/b"]
+    # retain_handling=2 suppresses replay
+    opts2 = SubOpts(qos=1, retain_handling=2)
+    assert b.subscribe("c1", "x/+", opts2) == []
+    # shared subs never replay retained
+    assert b.subscribe("c1", "$share/g/a/+", SubOpts(qos=1)) == []
+
+
+def test_detached_session_queues_qos1_drops_qos0():
+    b = Broker()
+    ch, s = _connect(b, "c1", clean_start=False, expiry=300.0)
+    s.subscribe("t", SubOpts(qos=1))
+    b.subscribe("c1", "t", SubOpts(qos=1))
+    b.cm.disconnect("c1", ch)
+
+    assert b.publish(Message(topic="t", qos=1)) == 1
+    assert b.publish(Message(topic="t", qos=0)) == 0  # dropped
+    assert len(s.mqueue) == 1
+    assert b.metrics.val("delivery.dropped") == 1
+
+    # reconnect: the queued message replays
+    ch2, s2 = _connect(b, "c1", clean_start=False)
+    assert s2 is s
+    out = s2.resume()
+    assert len(out) == 1 and out[0].topic == "t" and out[0].qos == 1
+
+
+def test_subscription_count_stat():
+    b = Broker()
+    ch, s = _connect(b, "c1")
+    b.subscribe("c1", "a/+", SubOpts())
+    b.subscribe("c1", "b", SubOpts())
+    assert b.stats.get("subscriptions.count") == 2
+    b.unsubscribe("c1", "a/+")
+    assert b.stats.get("subscriptions.count") == 1

@@ -1,0 +1,456 @@
+"""Unit tests: mqueue, inflight, session QoS machines, hooks, access,
+retainer, shared-sub strategies, router, connection manager."""
+
+import time
+
+import pytest
+
+from emqx_tpu.access import (
+    ALLOW,
+    DENY,
+    AccessControl,
+    AclProvider,
+    AclRule,
+    ClientInfo,
+    DictAuthenticator,
+    PUBLISH,
+    SUBSCRIBE,
+)
+from emqx_tpu.broker.cm import ConnectionManager
+from emqx_tpu.broker.inflight import Inflight
+from emqx_tpu.broker.mqueue import MQueue
+from emqx_tpu.broker.session import Session, SubOpts
+from emqx_tpu.broker.shared import SharedSubManager
+from emqx_tpu.codec import mqtt as C
+from emqx_tpu.hooks import HookRegistry, STOP, STOP_WITH
+from emqx_tpu.message import Message
+from emqx_tpu.retainer import Retainer
+from emqx_tpu.router import Router
+
+
+# ---------------------------------------------------------------- mqueue
+
+
+def test_mqueue_bounded_drop_oldest():
+    q = MQueue(max_len=3)
+    for i in range(3):
+        assert q.insert(Message(topic=f"t{i}", qos=1)) is None
+    dropped = q.insert(Message(topic="t3", qos=1))
+    assert dropped is not None and dropped.topic == "t0"
+    assert q.dropped == 1
+    assert [m.topic for m in q] == ["t1", "t2", "t3"]
+
+
+def test_mqueue_priorities():
+    q = MQueue(max_len=10, priorities={"hi": 5})
+    q.insert(Message(topic="lo1", qos=1))
+    q.insert(Message(topic="hi", qos=1))
+    q.insert(Message(topic="lo2", qos=1))
+    assert q.pop().topic == "hi"
+    assert q.pop().topic == "lo1"
+
+
+def test_mqueue_qos0_bypass():
+    q = MQueue(max_len=10, store_qos0=False)
+    m = Message(topic="a", qos=0)
+    assert q.insert(m) is m
+    assert len(q) == 0
+
+
+# --------------------------------------------------------------- inflight
+
+
+def test_inflight_window():
+    w = Inflight(max_size=2)
+    w.insert(1, "a")
+    w.insert(2, "b")
+    assert w.is_full()
+    with pytest.raises(KeyError):
+        w.insert(1, "dup")
+    assert w.delete(1) == "a"
+    assert not w.is_full()
+    assert [k for k, _ in w.items()] == [2]
+
+
+# ---------------------------------------------------------------- session
+
+
+def _mk_session(**kw):
+    kw.setdefault("max_inflight", 2)
+    kw.setdefault("max_mqueue_len", 10)
+    return Session("c1", **kw)
+
+
+def test_session_qos0_direct():
+    s = _mk_session()
+    out = s.deliver([(Message(topic="a", qos=0), SubOpts(qos=0))])
+    assert len(out) == 1 and out[0].qos == 0 and out[0].packet_id is None
+
+
+def test_session_qos1_flow():
+    s = _mk_session()
+    out = s.deliver([(Message(topic="a", qos=1), SubOpts(qos=1))])
+    pid = out[0].packet_id
+    assert out[0].qos == 1 and pid is not None
+    ok, more = s.puback(pid)
+    assert ok and more == []
+    # unknown pid is rejected
+    ok, _ = s.puback(99)
+    assert not ok
+
+
+def test_session_window_overflow_queues():
+    s = _mk_session()
+    msgs = [(Message(topic=f"t{i}", qos=1), SubOpts(qos=1)) for i in range(4)]
+    out = s.deliver(msgs)
+    assert len(out) == 2 and len(s.mqueue) == 2
+    ok, more = s.puback(out[0].packet_id)
+    assert ok and len(more) == 1  # dequeued into the freed slot
+    assert more[0].topic == "t2"
+
+
+def test_session_qos2_out_flow():
+    s = _mk_session()
+    out = s.deliver([(Message(topic="a", qos=2), SubOpts(qos=2))])
+    pid = out[0].packet_id
+    ok, pubrels = s.pubrec(pid)
+    assert ok and isinstance(pubrels[0], C.Pubrel)
+    # duplicate PUBREC is rejected in PUBREL phase
+    ok2, _ = s.pubrec(pid)
+    assert not ok2
+    ok3, _ = s.pubcomp(pid)
+    assert ok3 and len(s.inflight) == 0
+
+
+def test_session_qos2_in_dedup():
+    s = _mk_session(max_awaiting_rel=2)
+    assert s.awaiting_rel_add(10) == "ok"
+    assert s.awaiting_rel_add(10) == "in_use"
+    assert s.awaiting_rel_add(11) == "ok"
+    assert s.awaiting_rel_add(12) == "full"
+    assert s.pubrel(10)
+    assert not s.pubrel(10)
+
+
+def test_session_effective_qos_and_no_local():
+    s = _mk_session()
+    out = s.deliver([(Message(topic="a", qos=2), SubOpts(qos=1))])
+    assert out[0].qos == 1  # min(msg, sub)
+    out = s.deliver(
+        [(Message(topic="a", qos=0, from_client="c1"), SubOpts(no_local=True))]
+    )
+    assert out == []
+
+
+def test_session_retry_redelivers_dup():
+    s = _mk_session(retry_interval=0.0)
+    out = s.deliver([(Message(topic="a", qos=1), SubOpts(qos=1))])
+    pid = out[0].packet_id
+    again = s.retry(now=time.time() + 1)
+    assert len(again) == 1 and again[0].dup and again[0].packet_id == pid
+
+
+def test_session_resume_replays_in_order():
+    s = _mk_session()
+    out = s.deliver(
+        [
+            (Message(topic="a", qos=1), SubOpts(qos=1)),
+            (Message(topic="b", qos=2), SubOpts(qos=2)),
+            (Message(topic="c", qos=1), SubOpts(qos=1)),
+        ]
+    )
+    s.pubrec(out[1].packet_id)  # b advances to PUBREL phase
+    replay = s.resume()
+    # a re-published dup, b as PUBREL; c stays queued (window still full)
+    assert replay[0].topic == "a" and replay[0].dup
+    assert isinstance(replay[1], C.Pubrel)
+    assert len(replay) == 2 and len(s.mqueue) == 1
+    ok, more = s.pubcomp(out[1].packet_id)  # freeing a slot releases c
+    assert ok and more[0].topic == "c"
+
+
+# ------------------------------------------------------------------ hooks
+
+
+def test_hooks_priority_and_stop():
+    h = HookRegistry()
+    calls = []
+    h.add("t", lambda x: calls.append(("lo", x)), priority=0)
+    h.add("t", lambda x: calls.append(("hi", x)), priority=10)
+    h.run("t", 1)
+    assert calls == [("hi", 1), ("lo", 1)]
+
+    calls.clear()
+    h.add("s", lambda x: STOP, priority=5)
+    h.add("s", lambda x: calls.append("never"), priority=0)
+    h.run("s", 1)
+    assert calls == []
+
+
+def test_hooks_run_fold():
+    h = HookRegistry()
+    h.add("f", lambda base, acc: acc + 1)
+    h.add("f", lambda base, acc: None)  # pass-through
+    h.add("f", lambda base, acc: acc * 2)
+    assert h.run_fold("f", (0,), 3) == 8
+
+    h2 = HookRegistry()
+    h2.add("f", lambda acc: STOP_WITH("done"))
+    h2.add("f", lambda acc: "never")
+    assert h2.run_fold("f", (), "x") == "done"
+
+
+def test_hooks_delete():
+    h = HookRegistry()
+    fn = lambda: None  # noqa: E731
+    h.add("t", fn)
+    assert h.delete("t", fn)
+    assert not h.delete("t", fn)
+
+
+# ----------------------------------------------------------------- access
+
+
+def test_dict_authenticator():
+    ac = AccessControl(allow_anonymous=False)
+    auth = DictAuthenticator()
+    auth.add_user("alice", "secret", is_superuser=True)
+    ac.authenticators.append(auth)
+
+    ok, ci = ac.authenticate(ClientInfo("c1", "alice", b"secret"))
+    assert ok and ci.is_superuser
+    ok, _ = ac.authenticate(ClientInfo("c1", "alice", b"wrong"))
+    assert not ok
+    # unknown user falls through to allow_anonymous=False
+    ok, _ = ac.authenticate(ClientInfo("c1", "bob", b"x"))
+    assert not ok
+    ok, _ = ac.authenticate(ClientInfo("c1"))
+    assert not ok
+
+
+def test_acl_rules_placeholders_and_order():
+    ac = AccessControl(authz_default=DENY)
+    ac.authz_sources.append(
+        AclProvider(
+            [
+                AclRule(DENY, "all", PUBLISH, ["forbidden/#"]),
+                AclRule(ALLOW, ("username", "u1"), "all", ["dev/%u/#"]),
+                AclRule(ALLOW, "all", SUBSCRIBE, ["public/+"]),
+            ]
+        )
+    )
+    u1 = ClientInfo("c1", "u1")
+    assert ac.authorize(u1, PUBLISH, "dev/u1/x")
+    assert not ac.authorize(u1, PUBLISH, "dev/u2/x")
+    assert not ac.authorize(u1, PUBLISH, "forbidden/x")
+    assert ac.authorize(u1, SUBSCRIBE, "public/a")
+    assert not ac.authorize(u1, SUBSCRIBE, "private/a")  # default deny
+    su = ClientInfo("c2", is_superuser=True)
+    assert ac.authorize(su, PUBLISH, "forbidden/x")
+
+
+def test_acl_eq_rule():
+    ac = AccessControl(authz_default=DENY)
+    ac.authz_sources.append(
+        AclProvider([AclRule(ALLOW, "all", SUBSCRIBE, [{"eq": "a/#"}])])
+    )
+    ci = ClientInfo("c")
+    assert ac.authorize(ci, SUBSCRIBE, "a/#")
+    assert not ac.authorize(ci, SUBSCRIBE, "a/b")
+
+
+# --------------------------------------------------------------- retainer
+
+
+def test_retainer_store_match_delete():
+    r = Retainer()
+    r.store(Message(topic="a/b", payload=b"1", retain=True))
+    r.store(Message(topic="a/c", payload=b"2", retain=True))
+    r.store(Message(topic="x", payload=b"3", retain=True))
+    assert {m.topic for m in r.match("a/+")} == {"a/b", "a/c"}
+    assert {m.topic for m in r.match("#")} == {"a/b", "a/c", "x"}
+    assert [m.topic for m in r.match("a/b")] == ["a/b"]
+    # empty payload deletes
+    r.store(Message(topic="a/b", payload=b"", retain=True))
+    assert r.match("a/b") == []
+    assert len(r) == 2
+
+
+def test_retainer_hash_matches_parent():
+    r = Retainer()
+    r.store(Message(topic="a", payload=b"p", retain=True))
+    r.store(Message(topic="a/b/c", payload=b"q", retain=True))
+    assert {m.topic for m in r.match("a/#")} == {"a", "a/b/c"}
+
+
+def test_retainer_dollar_exclusion():
+    r = Retainer()
+    r.store(Message(topic="$SYS/up", payload=b"1", retain=True))
+    r.store(Message(topic="n", payload=b"2", retain=True))
+    assert [m.topic for m in r.match("#")] == ["n"]
+    assert [m.topic for m in r.match("+/up")] == []
+    assert [m.topic for m in r.match("$SYS/up")] == ["$SYS/up"]
+    assert [m.topic for m in r.match("$SYS/#")] == ["$SYS/up"]
+
+
+def test_retainer_limits_and_expiry():
+    r = Retainer(max_retained_messages=1, msg_expiry_interval=100.0)
+    assert r.store(Message(topic="a", payload=b"1", retain=True))
+    assert not r.store(Message(topic="b", payload=b"2", retain=True))
+    # replacing an existing topic is allowed at the cap
+    assert r.store(Message(topic="a", payload=b"3", retain=True))
+    old = Message(topic="a", payload=b"4", retain=True)
+    old.timestamp -= 1000
+    r.store(old)
+    assert r.match("a") == []  # expired via store-level interval
+
+
+def test_retainer_message_expiry_property():
+    r = Retainer()
+    m = Message(
+        topic="a",
+        payload=b"1",
+        retain=True,
+        properties={"message_expiry_interval": 1},
+    )
+    m.timestamp -= 10
+    r.store(m)
+    assert r.match("a") == []
+
+
+# ----------------------------------------------------------- shared subs
+
+
+def _msg(topic="t", frm="pub"):
+    return Message(topic=topic, from_client=frm)
+
+
+def test_shared_round_robin():
+    s = SharedSubManager(strategy="round_robin")
+    s.join("g", "t", "a")
+    s.join("g", "t", "b")
+    picks = [s.pick("g", "t", _msg()) for _ in range(4)]
+    assert picks == ["a", "b", "a", "b"]
+
+
+def test_shared_sticky():
+    s = SharedSubManager(strategy="sticky", seed=1)
+    s.join("g", "t", "a")
+    s.join("g", "t", "b")
+    first = s.pick("g", "t", _msg())
+    assert all(s.pick("g", "t", _msg()) == first for _ in range(5))
+    s.leave("g", "t", first)
+    nxt = s.pick("g", "t", _msg())
+    assert nxt != first
+
+
+def test_shared_hash_strategies():
+    s = SharedSubManager(strategy="hash_clientid")
+    s.join("g", "t", "a")
+    s.join("g", "t", "b")
+    p1 = s.pick("g", "t", _msg(frm="x"))
+    assert all(s.pick("g", "t", _msg(frm="x")) == p1 for _ in range(5))
+    st = SharedSubManager(strategy="hash_topic")
+    st.join("g", "t", "a")
+    st.join("g", "t", "b")
+    q1 = st.pick("g", "t", _msg(topic="z"))
+    assert all(st.pick("g", "t", _msg(topic="z")) == q1 for _ in range(5))
+
+
+def test_shared_exclude_and_leave_all():
+    s = SharedSubManager(strategy="random", seed=2)
+    assert s.join("g", "t", "a")  # first member => route add
+    assert not s.join("g", "t", "b")
+    assert s.pick("g", "t", _msg(), exclude={"a"}) == "b"
+    assert s.pick("g", "t", _msg(), exclude={"a", "b"}) is None
+    emptied = s.leave_all("a")
+    assert emptied == []
+    assert s.leave_all("b") == [("g", "t")]
+
+
+# ----------------------------------------------------------------- router
+
+
+def test_router_subscribe_match_unsubscribe():
+    r = Router()
+    r.subscribe("c1", "a/+", SubOpts(qos=1))
+    r.subscribe("c2", "a/b", SubOpts(qos=0))
+    matched = r.match_batch(["a/b"])[0]
+    assert matched == {"a/+", "a/b"}
+    subs = dict(r.subscribers("a/+"))
+    assert "c1" in subs
+    r.unsubscribe("c1", "a/+")
+    assert r.match_batch(["a/b"])[0] == {"a/b"}
+
+
+def test_router_shared_and_direct_same_filter():
+    r = Router()
+    r.subscribe("c1", "t/x", SubOpts(qos=1))
+    r.subscribe("c2", "$share/g/t/x", SubOpts(qos=1))
+    assert r.match_batch(["t/x"])[0] == {"t/x"}
+    assert r.shared.members("g", "t/x") == ["c2"]
+    # dropping the direct sub keeps the route for the shared group
+    r.unsubscribe("c1", "t/x")
+    assert r.match_batch(["t/x"])[0] == {"t/x"}
+    r.unsubscribe("c2", "$share/g/t/x")
+    assert r.match_batch(["t/x"])[0] == set()
+
+
+def test_router_cleanup_client():
+    r = Router()
+    r.subscribe("c1", "a/#", SubOpts())
+    r.subscribe("c1", "$share/g/b", SubOpts())
+    r.subscribe("c2", "a/#", SubOpts())
+    r.cleanup_client("c1")
+    assert r.subscriptions_of("c1") == set()
+    assert r.match_batch(["b"])[0] == set()
+    assert r.match_batch(["a/x"])[0] == {"a/#"}
+
+
+# --------------------------------------------------------------------- cm
+
+
+class FakeChannel:
+    def __init__(self):
+        self.sent = []
+        self.closed = None
+
+    def send_packets(self, pkts):
+        self.sent.extend(pkts)
+
+    def close(self, reason):
+        self.closed = reason
+
+
+def test_cm_open_resume_takeover():
+    cm = ConnectionManager(lambda clientid, clean_start, **kw: Session(
+        clientid, clean_start=clean_start,
+        expiry_interval=kw.get("expiry_interval", 0.0)))
+    ch1 = FakeChannel()
+    s1, present = cm.open_session(False, "c", ch1, expiry_interval=60.0)
+    assert not present
+    # second connection takes over the live session
+    ch2 = FakeChannel()
+    s2, present = cm.open_session(False, "c", ch2)
+    assert present and s2 is s1 and ch1.closed == "takenover"
+    # clean start discards
+    ch3 = FakeChannel()
+    s3, present = cm.open_session(True, "c", ch3)
+    assert not present and s3 is not s1
+
+
+def test_cm_disconnect_and_expiry():
+    cm = ConnectionManager(lambda clientid, clean_start, **kw: Session(
+        clientid, clean_start=clean_start,
+        expiry_interval=kw.get("expiry_interval", 0.0)))
+    ch = FakeChannel()
+    s, _ = cm.open_session(False, "c", ch, expiry_interval=0.5)
+    cm.disconnect("c", ch)
+    assert cm.lookup("c") is s and not cm.connected("c")
+    assert cm.expire_sessions(now=time.time() + 1) == ["c"]
+    assert cm.lookup("c") is None
+    # zero-expiry sessions drop immediately on disconnect
+    ch2 = FakeChannel()
+    cm.open_session(True, "d", ch2)
+    cm.disconnect("d", ch2)
+    assert cm.lookup("d") is None

@@ -1,0 +1,358 @@
+"""End-to-end integration: a real broker on a real TCP socket, driven
+by the codec-level test client — the M2 'minimum end-to-end slice'
+(SURVEY §7): CONNECT/SUBSCRIBE/PUBLISH/deliver across connections,
+QoS 0/1/2, wildcard + shared subs, retained, wills, session resume."""
+
+import asyncio
+
+import pytest
+
+from emqx_tpu.broker.listener import BrokerServer
+from emqx_tpu.codec import mqtt as C
+from emqx_tpu.config import BrokerConfig, ListenerConfig
+
+from mqtt_client import TestClient
+
+
+@pytest.fixture
+def server_port(request):
+    """Run a broker server in a dedicated event loop via asyncio.run
+    per test (pytest-asyncio is not available; tests drive their own
+    loop through `run`)."""
+    return None
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_server(**cfg_kw) -> BrokerServer:
+    cfg = BrokerConfig(**cfg_kw)
+    cfg.listeners = [ListenerConfig(port=0)]  # ephemeral port
+    return BrokerServer(cfg)
+
+
+async def start(server):
+    await server.start()
+    return server.listeners[0].port
+
+
+def test_connect_ping_disconnect():
+    async def t():
+        server = make_server()
+        port = await start(server)
+        try:
+            cli = TestClient(port, "c1")
+            ack = await cli.connect()
+            assert ack.reason_code == 0 and not ack.session_present
+            await cli.ping()
+            await cli.disconnect()
+        finally:
+            await server.stop()
+
+    run(t())
+
+
+def test_pub_sub_roundtrip_qos0():
+    async def t():
+        server = make_server()
+        port = await start(server)
+        try:
+            sub = TestClient(port, "sub")
+            await sub.connect()
+            await sub.subscribe("a/+/c", qos=0)
+            pub = TestClient(port, "pub")
+            await pub.connect()
+            await pub.publish("a/b/c", b"hello")
+            msg = await sub.recv_publish()
+            assert msg.topic == "a/b/c" and msg.payload == b"hello"
+            await sub.disconnect()
+            await pub.disconnect()
+        finally:
+            await server.stop()
+
+    run(t())
+
+
+def test_qos1_and_qos2_delivery():
+    async def t():
+        server = make_server()
+        port = await start(server)
+        try:
+            sub = TestClient(port, "sub")
+            await sub.connect()
+            await sub.subscribe("q/#", qos=2)
+            pub = TestClient(port, "pub")
+            await pub.connect()
+
+            ack = await pub.publish("q/1", b"one", qos=1)
+            assert ack.reason_code == 0
+            m1 = await sub.recv_publish()
+            assert m1.qos == 1 and m1.payload == b"one"
+
+            comp = await pub.publish("q/2", b"two", qos=2)
+            assert comp is not None
+            m2 = await sub.recv_publish()
+            assert m2.qos == 2 and m2.payload == b"two"
+            broker = server.broker
+            assert broker.metrics.val("messages.qos2.received") == 1
+            await sub.disconnect()
+            await pub.disconnect()
+        finally:
+            await server.stop()
+
+    run(t())
+
+
+def test_qos1_no_subscribers_reason_code():
+    async def t():
+        server = make_server()
+        port = await start(server)
+        try:
+            pub = TestClient(port, "pub")
+            await pub.connect()
+            ack = await pub.publish("void", b"x", qos=1)
+            assert ack.reason_code == 0x10  # no matching subscribers
+            await pub.disconnect()
+        finally:
+            await server.stop()
+
+    run(t())
+
+
+def test_retained_message_replay():
+    async def t():
+        server = make_server()
+        port = await start(server)
+        try:
+            pub = TestClient(port, "pub")
+            await pub.connect()
+            await pub.publish("state/light", b"on", retain=True)
+            await pub.disconnect()
+
+            sub = TestClient(port, "sub")
+            await sub.connect()
+            await sub.subscribe("state/+")
+            msg = await sub.recv_publish()
+            assert msg.topic == "state/light" and msg.payload == b"on"
+            assert msg.retain
+            await sub.disconnect()
+        finally:
+            await server.stop()
+
+    run(t())
+
+
+def test_shared_subscription_balancing():
+    async def t():
+        server = make_server()
+        port = await start(server)
+        server.broker.router.shared.strategy = "round_robin"
+        try:
+            c1 = TestClient(port, "c1")
+            c2 = TestClient(port, "c2")
+            await c1.connect()
+            await c2.connect()
+            await c1.subscribe("$share/g/work")
+            await c2.subscribe("$share/g/work")
+            pub = TestClient(port, "pub")
+            await pub.connect()
+            for i in range(4):
+                await pub.publish("work", str(i).encode())
+            got1 = [await c1.recv_publish() for _ in range(2)]
+            got2 = [await c2.recv_publish() for _ in range(2)]
+            assert {m.payload for m in got1} | {m.payload for m in got2} == {
+                b"0", b"1", b"2", b"3"
+            }
+            await c1.disconnect()
+            await c2.disconnect()
+            await pub.disconnect()
+        finally:
+            await server.stop()
+
+    run(t())
+
+
+def test_will_message_on_abnormal_disconnect():
+    async def t():
+        server = make_server()
+        port = await start(server)
+        try:
+            watcher = TestClient(port, "watcher")
+            await watcher.connect()
+            await watcher.subscribe("wills/#")
+
+            doomed = TestClient(port, "doomed")
+            await doomed.connect(
+                will=C.Will(topic="wills/doomed", payload=b"gone", qos=1)
+            )
+            # abrupt socket close => will fires
+            await doomed.close()
+            msg = await watcher.recv_publish()
+            assert msg.topic == "wills/doomed" and msg.payload == b"gone"
+
+            # graceful disconnect => no will
+            polite = TestClient(port, "polite")
+            await polite.connect(
+                will=C.Will(topic="wills/polite", payload=b"bye")
+            )
+            await polite.disconnect()
+            with pytest.raises(asyncio.TimeoutError):
+                await watcher.recv_publish(timeout=0.3)
+            await watcher.disconnect()
+        finally:
+            await server.stop()
+
+    run(t())
+
+
+def test_session_resume_redelivers_queued():
+    async def t():
+        server = make_server()
+        port = await start(server)
+        try:
+            sub = TestClient(port, "persist")
+            await sub.connect(
+                clean_start=False,
+                properties={"session_expiry_interval": 300},
+            )
+            await sub.subscribe("inbox/persist", qos=1)
+            await sub.close()  # drop without DISCONNECT; session persists
+            await asyncio.sleep(0.05)
+
+            pub = TestClient(port, "pub")
+            await pub.connect()
+            await pub.publish("inbox/persist", b"offline-msg", qos=1)
+            await pub.disconnect()
+
+            sub2 = TestClient(port, "persist")
+            ack = await sub2.connect(
+                clean_start=False,
+                properties={"session_expiry_interval": 300},
+            )
+            assert ack.session_present
+            msg = await sub2.recv_publish()
+            assert msg.payload == b"offline-msg" and msg.qos == 1
+            await sub2.disconnect()
+        finally:
+            await server.stop()
+
+    run(t())
+
+
+def test_takeover_closes_old_connection():
+    async def t():
+        server = make_server()
+        port = await start(server)
+        try:
+            first = TestClient(port, "dup")
+            await first.connect(
+                clean_start=False,
+                properties={"session_expiry_interval": 60},
+            )
+            second = TestClient(port, "dup")
+            ack = await second.connect(
+                clean_start=False,
+                properties={"session_expiry_interval": 60},
+            )
+            assert ack.session_present
+            # old connection gets DISCONNECT(0x8E) then EOF
+            pkt = await first.recv(timeout=2.0)
+            assert pkt is not None and pkt.type == C.DISCONNECT
+            assert pkt.reason_code == 0x8E
+            await second.disconnect()
+            await first.close()
+        finally:
+            await server.stop()
+
+    run(t())
+
+
+def test_auth_denied_connect():
+    async def t():
+        server = make_server()
+        port = await start(server)
+        server.broker.access.allow_anonymous = False
+        try:
+            cli = TestClient(port, "nope")
+            ack = await cli.connect()
+            assert ack.reason_code == 0x86  # bad user name or password
+            assert await cli.recv(timeout=1.0) is None  # closed
+        finally:
+            await server.stop()
+
+    run(t())
+
+
+def test_acl_denied_publish_qos1():
+    async def t():
+        from emqx_tpu.access import AclProvider, AclRule, DENY
+
+        server = make_server()
+        port = await start(server)
+        server.broker.access.authz_sources.append(
+            AclProvider([AclRule(DENY, "all", "publish", ["secret/#"])])
+        )
+        try:
+            cli = TestClient(port, "c")
+            await cli.connect()
+            await cli.send(
+                C.Publish(topic="secret/x", payload=b"x", qos=1, packet_id=7)
+            )
+            ack = await cli.expect(C.PUBACK)
+            assert ack.reason_code == 0x87  # not authorized
+            await cli.disconnect()
+        finally:
+            await server.stop()
+
+    run(t())
+
+
+def test_mqtt_v311_client():
+    async def t():
+        server = make_server()
+        port = await start(server)
+        try:
+            sub = TestClient(port, "v4sub", version=C.MQTT_V4)
+            ack = await sub.connect()
+            assert ack.reason_code == 0
+            await sub.subscribe("old/+", qos=1)
+            pub = TestClient(port, "v4pub", version=C.MQTT_V4)
+            await pub.connect()
+            await pub.publish("old/school", b"341", qos=1)
+            msg = await sub.recv_publish()
+            assert msg.payload == b"341"
+            await sub.disconnect()
+            await pub.disconnect()
+        finally:
+            await server.stop()
+
+    run(t())
+
+
+def test_unsubscribe_stops_delivery():
+    async def t():
+        server = make_server()
+        port = await start(server)
+        try:
+            sub = TestClient(port, "sub")
+            await sub.connect()
+            await sub.subscribe("u/t")
+            pub = TestClient(port, "pub")
+            await pub.connect()
+            await pub.publish("u/t", b"1")
+            assert (await sub.recv_publish()).payload == b"1"
+            unack = await sub.unsubscribe("u/t")
+            assert unack.reason_codes == [0]
+            await pub.publish("u/t", b"2")
+            with pytest.raises(asyncio.TimeoutError):
+                await sub.recv_publish(timeout=0.3)
+            # unsubscribing again reports no-subscription-existed
+            unack2 = await sub.unsubscribe("u/t")
+            assert unack2.reason_codes == [0x11]
+            await sub.disconnect()
+            await pub.disconnect()
+        finally:
+            await server.stop()
+
+    run(t())
