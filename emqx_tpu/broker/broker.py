@@ -16,6 +16,7 @@ turns per-publish trie walks into one XLA call.
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from ..access import AccessControl
@@ -70,6 +71,11 @@ class Broker:
         self.cm = ConnectionManager(self._make_session)
         self.cm.on_discarded = self._session_discarded
         self.cm.on_takenover = lambda s: self.metrics.inc("session.takenover")
+        from ..rules.engine import RuleEngine
+
+        self.rules = RuleEngine(broker=self)
+        # clientid -> (fire_at, will message): MQTT 5 delayed wills
+        self._pending_wills: Dict[str, Tuple[float, Message]] = {}
 
     # -------------------------------------------------- session setup
 
@@ -77,7 +83,7 @@ class Broker:
         mqtt = self.config.mqtt
         self.metrics.inc("session.created")
         self.hooks.run("session.created", clientid)
-        return Session(
+        session = Session(
             clientid=clientid,
             clean_start=clean_start,
             max_inflight=kw.get("max_inflight", mqtt.max_inflight),
@@ -94,6 +100,14 @@ class Broker:
             mqueue_default_priority=mqtt.mqueue_default_priority,
             mqueue_store_qos0=mqtt.mqueue_store_qos0,
         )
+
+        def on_dropped(msg: Message, reason: str) -> None:
+            self.metrics.inc("delivery.dropped")
+            self.metrics.inc(f"delivery.dropped.{reason}")
+            self.hooks.run("delivery.dropped", clientid, msg, reason)
+
+        session.on_dropped = on_dropped
+        return session
 
     def _session_discarded(self, session: Session) -> None:
         self.metrics.inc("session.discarded")
@@ -125,7 +139,7 @@ class Broker:
         return ok
 
     def _sub_count(self) -> int:
-        return len(self.router.engine)
+        return self.router.subscription_count()
 
     # ------------------------------------------------------ publish
 
@@ -165,13 +179,21 @@ class Broker:
 
     def _dispatch(self, msg: Message, filters: Set[str]) -> int:
         """Fan a routed message out to subscriber sessions
-        (emqx_broker:dispatch + do_dispatch, :408-420, :639-673)."""
+        (emqx_broker:dispatch + do_dispatch, :408-420, :639-673).
+        Rule hits come back from the same match step as a distinct fid
+        class and run before delivery (emqx_rule_engine.erl:226-231)."""
+        rule_ids: List[str] = []
         per_client: Dict[str, List[Tuple[Message, SubOpts]]] = {}
         for real in filters:
+            if isinstance(real, tuple):  # ("rule", rule_id, i)
+                rule_ids.append(real[1])
+                continue
             for clientid, opts in self.router.subscribers(real):
                 per_client.setdefault(clientid, []).append((msg, opts))
             for group in self.router.shared.groups_for(real):
                 self._shared_pick(msg, real, group, per_client)
+        if rule_ids:
+            self.rules.apply(msg, sorted(set(rule_ids)))
         if not per_client:
             self.metrics.inc("messages.dropped")
             self.metrics.inc("messages.dropped.no_subscribers")
@@ -230,6 +252,31 @@ class Broker:
                 self.hooks.run("delivery.dropped", clientid, dropped, "queue_full")
             kept += 1
         return kept
+
+    # -------------------------------------------------- delayed wills
+
+    def schedule_will(self, clientid: str, will: Message, delay: float) -> None:
+        """Queue a will for will_delay_interval seconds
+        ([MQTT-3.1.3.2.2]); a reconnect before the deadline cancels."""
+        self._pending_wills[clientid] = (time.time() + delay, will)
+
+    def cancel_will(self, clientid: str) -> None:
+        self._pending_wills.pop(clientid, None)
+
+    def tick(self, now: Optional[float] = None) -> None:
+        """Periodic housekeeping: fire due wills, expire detached
+        sessions (driven by BrokerServer's timer, or manually in
+        tests)."""
+        now = now if now is not None else time.time()
+        due = [
+            cid
+            for cid, (at, _) in self._pending_wills.items()
+            if now >= at
+        ]
+        for cid in due:
+            _, will = self._pending_wills.pop(cid)
+            self.publish(will)
+        self.cm.expire_sessions(now)
 
     # ----------------------------------------------------- sys info
 

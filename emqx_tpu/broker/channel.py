@@ -242,6 +242,7 @@ class Channel:
             ),
         )
         self.session = session
+        self.broker.cancel_will(clientid)  # reconnect cancels a delayed will
         if present:
             m.inc("session.resumed")
             self.broker.hooks.run("session.resumed", clientid)
@@ -569,8 +570,16 @@ class Channel:
             )
         if self.will_msg is not None:
             will, self.will_msg = self.will_msg, None
-            delay = will.properties.pop("will_delay_interval", 0)
-            self.broker.publish(will)
+            delay = float(will.properties.pop("will_delay_interval", 0) or 0)
+            expiry = self.session.expiry_interval if self.session else 0.0
+            if delay > 0 and expiry > 0:
+                # fire at min(delay, session expiry) unless the client
+                # reconnects first ([MQTT-3.1.2-8], [MQTT-3.1.3.2.2])
+                self.broker.schedule_will(
+                    self.client.clientid, will, min(delay, expiry)
+                )
+            else:
+                self.broker.publish(will)
         if self.session is not None and self.client is not None:
             self.broker.cm.disconnect(self.client.clientid, self)
             if self.session.expiry_interval <= 0:

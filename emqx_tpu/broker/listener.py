@@ -82,12 +82,30 @@ class BrokerServer:
             for lc in self.broker.config.listeners
             if lc.enable and lc.type == "tcp"
         ]
+        self._housekeeper: Optional[asyncio.Task] = None
 
     async def start(self) -> None:
         for lst in self.listeners:
             await lst.start()
+        self._housekeeper = asyncio.get_running_loop().create_task(
+            self._housekeeping()
+        )
+
+    async def _housekeeping(self) -> None:
+        """Delayed wills + detached-session expiry (the reference's
+        per-process timers, centralized)."""
+        while True:
+            await asyncio.sleep(1.0)
+            self.broker.tick()
 
     async def stop(self) -> None:
+        if self._housekeeper is not None:
+            self._housekeeper.cancel()
+            try:
+                await self._housekeeper
+            except asyncio.CancelledError:
+                pass
+            self._housekeeper = None
         for lst in self.listeners:
             await lst.stop()
 

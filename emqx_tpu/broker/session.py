@@ -97,6 +97,9 @@ class Session:
         self.expiry_interval = expiry_interval
         self.upgrade_qos = upgrade_qos
         self._next_pid = 0
+        # wired by the broker: called with (dropped_msg, reason) when a
+        # delivery is lost to queue overflow or expiry
+        self.on_dropped: Optional[Callable[[Message, str], None]] = None
 
     # ------------------------------------------------------- packet ids
 
@@ -136,7 +139,9 @@ class Session:
                 out.append(self._publish_packet(msg, opts, 0, None))
                 continue
             if self.inflight.is_full():
-                self.mqueue.insert(self._queued(msg, opts, qos))
+                evicted = self.mqueue.insert(self._queued(msg, opts, qos))
+                if evicted is not None and self.on_dropped is not None:
+                    self.on_dropped(evicted, "queue_full")
                 continue
             pid = self._alloc_packet_id()
             self.inflight.insert(
@@ -200,6 +205,8 @@ class Session:
             if msg is None:
                 break
             if msg.expired():
+                if self.on_dropped is not None:
+                    self.on_dropped(msg, "expired")
                 continue
             if msg.qos == 0:
                 out.append(self._publish_packet(msg, None, 0, None))

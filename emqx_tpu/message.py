@@ -44,6 +44,9 @@ class Message:
     mid: bytes = field(default_factory=new_guid)
     timestamp: float = field(default_factory=time.time)
     properties: Dict[str, object] = field(default_factory=dict)
+    # broker-internal metadata that never reaches the wire (the
+    # reference's #message.headers)
+    headers: Dict[str, object] = field(default_factory=dict)
     # broker-internal flags (sys: $SYS self-publishes skip some hooks;
     # dup: redelivery)
     sys: bool = False

@@ -111,6 +111,13 @@ class Router:
         mgmt API's /topics)."""
         return list(self._subs.keys() | self._shared_opts.keys())
 
+    def subscription_count(self) -> int:
+        """Total (client, filter) subscription pairs — the
+        'subscriptions.count' stat (rule fids excluded)."""
+        return sum(len(v) for v in self._subs.values()) + sum(
+            len(v) for v in self._shared_opts.values()
+        )
+
     # --------------------------------------------------------- match
 
     def match_batch(

@@ -1,0 +1,15 @@
+"""SQL rule engine.
+
+The reference's `emqx_rule_engine` (/root/reference/apps/
+emqx_rule_engine/src/): rules are SQL statements whose FROM topics are
+matched per message through the shared topic index
+(emqx_rule_engine.erl:226-231) and whose WHERE/SELECT run per match
+(emqx_rule_runtime.erl:60-100).  Here FROM filters are compiled into
+the *same* match-engine automaton as subscriptions (distinct fid
+class), so rule matching rides the batched device step; WHERE
+predicates additionally compile to a batched column program
+(`predicate.py`) with the interpreter as oracle.
+"""
+
+from .engine import Rule, RuleEngine  # noqa: F401
+from .sql import parse_sql, SqlError  # noqa: F401

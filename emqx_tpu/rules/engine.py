@@ -1,0 +1,247 @@
+"""Rule registry + execution, wired into the broker's match step.
+
+Mirrors `emqx_rule_engine` (/root/reference/apps/emqx_rule_engine/src/
+emqx_rule_engine.erl): each rule's FROM filters register in the topic
+index (:536 `emqx_topic_index:insert` into ?RULE_TOPIC_INDEX) and
+per-message lookup is a match over that index (:226-231
+`get_rules_for_topic`).  Here the rule filters go into the *same*
+MatchEngine as subscriptions under a distinct fid class
+``("rule", rule_id, i)``, so one batched device step returns routes
+and rule hits together; `Broker._dispatch` splits the classes.
+
+Actions mirror the reference's builtins (emqx_rule_actions): republish
+(with ${var} placeholder templates, `emqx_placeholder` semantics),
+console, and arbitrary Python callables (the hook for
+resource/bridge-style sinks).
+"""
+
+from __future__ import annotations
+
+import logging
+import re as _re
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..message import Message
+from .runtime import build_env, eval_select, eval_where
+from .sql import ParsedSql, parse_sql
+
+log = logging.getLogger("emqx_tpu.rules")
+
+RULE_FID = "rule"  # fid class tag
+
+# republish chains are legal but must terminate (the reference relies
+# on operator care; we hard-cap recursion)
+MAX_REPUBLISH_DEPTH = 8
+
+_PLACEHOLDER = _re.compile(r"\$\{([^}]+)\}")
+
+
+def render_template(template: str, data: Dict[str, Any]) -> str:
+    """${a.b} placeholder substitution (emqx_placeholder parity)."""
+
+    def sub(m):
+        cur: Any = data
+        for part in m.group(1).split("."):
+            if isinstance(cur, dict) and part in cur:
+                cur = cur[part]
+            else:
+                return "undefined"
+        if isinstance(cur, bool):
+            return "true" if cur else "false"
+        if isinstance(cur, bytes):
+            return cur.decode("utf-8", "replace")
+        if isinstance(cur, float) and cur.is_integer():
+            return str(int(cur))
+        if isinstance(cur, (dict, list)):
+            import json
+
+            return json.dumps(cur)
+        return str(cur)
+
+    return _PLACEHOLDER.sub(sub, template)
+
+
+@dataclass
+class RepublishAction:
+    topic: str  # template
+    payload: str = "${payload}"  # template
+    qos: int = 0
+    retain: bool = False
+
+    kind: str = "republish"
+
+
+@dataclass
+class ConsoleAction:
+    kind: str = "console"
+
+
+@dataclass
+class FunctionAction:
+    fn: Callable[[Dict[str, Any], Message], None]
+    kind: str = "function"
+
+
+Action = Any
+
+
+@dataclass
+class Rule:
+    rule_id: str
+    sql: str
+    parsed: ParsedSql
+    actions: List[Action] = field(default_factory=list)
+    enabled: bool = True
+    description: str = ""
+    # counters (emqx_rule_metrics)
+    matched: int = 0
+    passed: int = 0
+    failed: int = 0
+    actions_success: int = 0
+    actions_failed: int = 0
+
+    def metrics(self) -> Dict[str, int]:
+        return {
+            "matched": self.matched,
+            "passed": self.passed,
+            "failed": self.failed,
+            "actions.success": self.actions_success,
+            "actions.failed": self.actions_failed,
+        }
+
+
+class RuleEngine:
+    def __init__(self, broker=None) -> None:
+        self.broker = broker
+        self.rules: Dict[str, Rule] = {}
+
+    # ------------------------------------------------------ registry
+
+    def add_rule(
+        self,
+        rule_id: str,
+        sql: str,
+        actions: Optional[List[Action]] = None,
+        enabled: bool = True,
+        description: str = "",
+    ) -> Rule:
+        # validate fully BEFORE touching the registry/index, so a bad
+        # update cannot destroy or half-register a live rule
+        parsed = parse_sql(sql)
+        from .. import topic as T
+
+        for flt in parsed.froms:
+            T.validate_filter(flt)
+        if rule_id in self.rules:
+            self.remove_rule(rule_id)
+        rule = Rule(
+            rule_id=rule_id,
+            sql=sql,
+            parsed=parsed,
+            actions=list(actions or ()),
+            enabled=enabled,
+            description=description,
+        )
+        self.rules[rule_id] = rule
+        if self.broker is not None:
+            eng = self.broker.router.engine
+            for i, flt in enumerate(parsed.froms):
+                eng.insert(flt, (RULE_FID, rule_id, i))
+        return rule
+
+    def remove_rule(self, rule_id: str) -> bool:
+        rule = self.rules.pop(rule_id, None)
+        if rule is None:
+            return False
+        if self.broker is not None:
+            eng = self.broker.router.engine
+            for i in range(len(rule.parsed.froms)):
+                eng.delete((RULE_FID, rule_id, i))
+        return True
+
+    def enable_rule(self, rule_id: str, enabled: bool) -> None:
+        self.rules[rule_id].enabled = enabled
+
+    # ----------------------------------------------------- execution
+
+    def apply(self, msg: Message, rule_ids: List[str]) -> int:
+        """Run the listed rules against one message; returns how many
+        passed their WHERE (emqx_rule_runtime:apply_rules/3)."""
+        if not rule_ids:
+            return 0
+        env = build_env(msg)
+        hits = 0
+        for rid in rule_ids:
+            rule = self.rules.get(rid)
+            if rule is None or not rule.enabled:
+                continue
+            rule.matched += 1
+            if not eval_where(rule.parsed.where, env):
+                rule.failed += 1
+                continue
+            rule.passed += 1
+            hits += 1
+            selected = eval_select(rule.parsed, env)
+            self._run_actions(rule, selected, msg)
+        if self.broker is not None and hits:
+            self.broker.metrics.inc("rules.matched", hits)
+        return hits
+
+    def _run_actions(
+        self, rule: Rule, selected: Dict[str, Any], msg: Message
+    ) -> None:
+        for action in rule.actions:
+            try:
+                self._run_action(action, selected, msg)
+                rule.actions_success += 1
+                if self.broker is not None:
+                    self.broker.metrics.inc("actions.success")
+            except Exception as exc:
+                rule.actions_failed += 1
+                if self.broker is not None:
+                    self.broker.metrics.inc("actions.failed")
+                log.warning(
+                    "rule %s action %s failed: %s",
+                    rule.rule_id,
+                    getattr(action, "kind", action),
+                    exc,
+                )
+
+    def _run_action(
+        self, action: Action, selected: Dict[str, Any], msg: Message
+    ) -> None:
+        if isinstance(action, RepublishAction):
+            depth = int(msg.headers.get("republish_depth", 0))
+            if depth >= MAX_REPUBLISH_DEPTH:
+                raise RuntimeError("republish depth cap hit (rule loop?)")
+            out = Message(
+                topic=render_template(action.topic, selected),
+                payload=render_template(action.payload, selected).encode(),
+                qos=action.qos,
+                retain=action.retain,
+                from_client=msg.from_client,
+                from_username=msg.from_username,
+                headers={"republish_depth": depth + 1},
+            )
+            if self.broker is None:
+                raise RuntimeError("republish without a broker")
+            self.broker.publish(out)
+        elif isinstance(action, ConsoleAction):
+            log.info("rule output: %s", selected)
+        elif isinstance(action, FunctionAction):
+            action.fn(selected, msg)
+        else:
+            raise RuntimeError(f"unknown action {action!r}")
+
+    def info(self) -> List[Dict[str, Any]]:
+        return [
+            {
+                "id": r.rule_id,
+                "sql": r.sql,
+                "enabled": r.enabled,
+                "description": r.description,
+                **r.metrics(),
+            }
+            for r in self.rules.values()
+        ]

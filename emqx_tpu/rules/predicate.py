@@ -1,0 +1,393 @@
+"""Batched WHERE-predicate compiler: rule conditions over message
+columns as one vectorized step.
+
+The reference interprets each rule's WHERE per message
+(emqx_rule_runtime.erl:60-100).  Here a WHERE AST compiles — when its
+node set allows — into a column program evaluated over the whole
+publish micro-batch at once (jax.jit; numpy fallback off-device), the
+SURVEY §7 "WHERE predicate eval is the second kernel target" plan.
+
+Semantics must match the interpreter (`runtime.eval_where`) exactly:
+
+  * ordering comparisons / arithmetic on a null or non-numeric value
+    ERROR, and an error makes the whole WHERE false — but
+    short-circuiting means an error on the right of an
+    already-decided and/or never surfaces.  Captured by compiling
+    every boolean node to a (T, F) pair — "provably true" /
+    "provably false without error" under short-circuit order:
+
+        ordering cmp:  T = defined & cmp,  F = defined & ~cmp
+        not:           (T, F) -> (F, T)
+        and:           T = Tl & Tr,        F = Fl | (Tl & Fr)
+        or:            T = Tl | (Fl & Tr), F = Fl & Fr
+
+  * equality (`=`, `!=`) over plain operands (var / literal) is TOTAL:
+    null or cross-type operands are simply unequal (no error) — so
+    `missing != 'y'` is TRUE.  Equality over a compound side (an
+    arithmetic expression) inherits that side's error semantics.
+  * booleans are their own type: `retain = 1` is false even when
+    retain is true (Erlang term inequality in the reference).
+
+Columns are dual-typed: each var extracts to a float lane (NaN = not a
+number/undefined) and a dictionary-encoded id lane (-1 = not a
+string/bool; bools get reserved ids).  Comparisons pick lanes by
+operand type.  Unsupported nodes (function calls, CASE, bare vars in
+boolean position) make ``compile_where`` return None and the caller
+falls back to the interpreter.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from .runtime import lookup_var
+
+# reserved string-lane ids for booleans ('\x00' cannot occur in MQTT
+# UTF-8 strings, so these keys cannot collide with real payloads)
+_TRUE_KEY = "\x00true"
+_FALSE_KEY = "\x00false"
+
+
+class _Unsupported(Exception):
+    pass
+
+
+class PredicateProgram:
+    """A compiled WHERE: collect var columns, evaluate batched."""
+
+    def __init__(self, where: tuple, var_paths: List[Tuple[str, ...]]):
+        self.where = where
+        self.var_paths = var_paths
+        self._jit = None
+
+    # ---------------------------------------------------- extraction
+
+    def extract_columns(
+        self, envs: Sequence[Dict[str, Any]]
+    ) -> Tuple[Dict[str, np.ndarray], Dict[str, int]]:
+        """Host side: pull each var path from each env into dual-typed
+        columns; strings (and bools) dictionary-encoded per batch."""
+        n = len(envs)
+        sdict: Dict[str, int] = {_TRUE_KEY: 0, _FALSE_KEY: 1}
+        num = {p: np.full(n, np.nan, np.float64) for p in self.var_paths}
+        sid = {p: np.full(n, -1, np.int32) for p in self.var_paths}
+        for i, env in enumerate(envs):
+            for p in self.var_paths:
+                try:
+                    v = lookup_var(env, p)
+                except Exception:
+                    v = None
+                if isinstance(v, bool):
+                    sid[p][i] = sdict[_TRUE_KEY if v else _FALSE_KEY]
+                elif isinstance(v, (int, float)):
+                    num[p][i] = v
+                elif isinstance(v, str):
+                    key = str(v)
+                    if key not in sdict:
+                        sdict[key] = len(sdict)
+                    sid[p][i] = sdict[key]
+        cols = {}
+        for p in self.var_paths:
+            cols["n:" + "/".join(p)] = num[p]
+            cols["s:" + "/".join(p)] = sid[p]
+        return cols, sdict
+
+    # ---------------------------------------------------- evaluation
+
+    def eval_batch(
+        self, envs: Sequence[Dict[str, Any]], use_jax: bool = False
+    ) -> np.ndarray:
+        cols, sdict = self.extract_columns(envs)
+        lit_ids = _literal_ids(self.where, sdict)
+        if use_jax and self._f32_safe(cols):
+            import jax
+
+            if self._jit is None:
+                import jax.numpy as jnp
+
+                def fn(cols, lit_ids):
+                    t, _ = _eval(self.where, cols, lit_ids, jnp)
+                    return t
+
+                self._jit = jax.jit(fn)
+            return np.asarray(self._jit(cols, lit_ids))
+        t, _ = _eval(self.where, cols, lit_ids, np)
+        return np.asarray(t)
+
+    def _f32_safe(self, cols: Dict[str, np.ndarray]) -> bool:
+        """The device path computes in float32 (jax default / TPU
+        native); use it only when every numeric value round-trips
+        exactly, else stay on the float64 host path.  Millisecond
+        timestamps are the canonical offender."""
+        lits: List[float] = []
+        _num_literals(self.where, lits)
+        for v in lits:
+            if float(np.float32(v)) != v:
+                return False
+        for name, a in cols.items():
+            if name.startswith("n:"):
+                finite = a[np.isfinite(a)]
+                if not (finite == finite.astype(np.float32)).all():
+                    return False
+        return True
+
+
+def _num_literals(expr: tuple, out: List[float]) -> None:
+    kind = expr[0]
+    if kind == "lit" and isinstance(expr[1], (int, float)) and not isinstance(
+        expr[1], bool
+    ):
+        out.append(float(expr[1]))
+    elif kind == "op":
+        _num_literals(expr[2], out)
+        _num_literals(expr[3], out)
+    elif kind in ("not", "neg"):
+        _num_literals(expr[1], out)
+    elif kind == "in":
+        _num_literals(expr[1], out)
+        for e in expr[2]:
+            _num_literals(e, out)
+
+
+def _string_literals(expr: tuple, out: Set[str]) -> None:
+    kind = expr[0]
+    if kind == "lit" and isinstance(expr[1], str):
+        out.add(expr[1])
+    elif kind == "op":
+        _string_literals(expr[2], out)
+        _string_literals(expr[3], out)
+    elif kind in ("not", "neg"):
+        _string_literals(expr[1], out)
+    elif kind == "in":
+        _string_literals(expr[1], out)
+        for e in expr[2]:
+            _string_literals(e, out)
+
+
+def _literal_ids(where: tuple, sdict: Dict[str, int]) -> Dict[str, int]:
+    """Map string literals to batch-dict ids (-2 = absent from batch:
+    matches nothing, distinct from -1 'not a string')."""
+    lits: Set[str] = set()
+    _string_literals(where, lits)
+    return {s: sdict.get(s, -2) for s in lits}
+
+
+def _collect_vars(expr: tuple, out: List[Tuple[str, ...]]) -> None:
+    kind = expr[0]
+    if kind == "var":
+        if expr[1] not in out:
+            out.append(expr[1])
+    elif kind == "op":
+        _collect_vars(expr[2], out)
+        _collect_vars(expr[3], out)
+    elif kind in ("not", "neg"):
+        _collect_vars(expr[1], out)
+    elif kind == "in":
+        _collect_vars(expr[1], out)
+        for e in expr[2]:
+            _collect_vars(e, out)
+    elif kind in ("call", "case"):
+        raise _Unsupported(kind)
+
+
+def compile_where(where: Optional[tuple]) -> Optional[PredicateProgram]:
+    """Compile if every node is in the supported subset, else None."""
+    if where is None:
+        return None
+    try:
+        paths: List[Tuple[str, ...]] = []
+        _collect_vars(where, paths)
+        _check_bool(where)
+    except _Unsupported:
+        return None
+    return PredicateProgram(where, paths)
+
+
+def _check_bool(expr: tuple) -> None:
+    """Validate a boolean-position node."""
+    kind = expr[0]
+    if kind == "lit" and isinstance(expr[1], bool):
+        return
+    if kind == "not":
+        return _check_bool(expr[1])
+    if kind == "in":
+        lt = _check_val(expr[1])
+        for e in expr[2]:
+            et = _check_val(e)
+            if "bool" in (lt, et):
+                raise _Unsupported("bool in IN")
+            if lt != "var" and et != "var" and et != lt:
+                raise _Unsupported("mixed IN list")
+        return
+    if kind == "op":
+        sym = expr[1]
+        if sym in ("and", "or"):
+            _check_bool(expr[2])
+            _check_bool(expr[3])
+            return
+        if sym in ("=", "!=", ">", "<", ">=", "<="):
+            lt, rt = _check_val(expr[2]), _check_val(expr[3])
+            if "bool" in (lt, rt):
+                raise _Unsupported("bool compare")
+            if lt == "str" and rt == "str":
+                raise _Unsupported("str-str compare is constant")
+            if "str" in (lt, rt):
+                other = rt if lt == "str" else lt
+                if other != "var":
+                    raise _Unsupported("str vs num compare")
+                if sym not in ("=", "!="):
+                    raise _Unsupported("string ordering")
+            return
+    raise _Unsupported(f"{kind} at boolean position")
+
+
+def _check_val(expr: tuple) -> str:
+    """Validate a value-position node -> 'num' | 'str' | 'bool' |
+    'var' (dual-typed) | 'expr' (compound numeric)."""
+    kind = expr[0]
+    if kind == "lit":
+        v = expr[1]
+        if isinstance(v, bool):
+            return "bool"
+        if isinstance(v, (int, float)):
+            return "num"
+        if isinstance(v, str):
+            return "str"
+        raise _Unsupported(f"literal {v!r}")
+    if kind == "var":
+        return "var"
+    if kind == "neg":
+        t = _check_val(expr[1])
+        if t not in ("num", "var", "expr"):
+            raise _Unsupported("neg of non-number")
+        return "expr"
+    if kind == "op" and expr[1] in ("+", "-", "*", "/", "div", "mod"):
+        for sub in (expr[2], expr[3]):
+            if _check_val(sub) not in ("num", "var", "expr"):
+                raise _Unsupported("arith on non-numbers")
+        return "expr"
+    raise _Unsupported(kind)
+
+
+def _eval(expr: tuple, cols, lit_ids, xp):
+    """Boolean-position evaluation -> (T, F) masks."""
+    kind = expr[0]
+    if kind == "op" and expr[1] in ("and", "or"):
+        tl, fl = _eval(expr[2], cols, lit_ids, xp)
+        tr, fr = _eval(expr[3], cols, lit_ids, xp)
+        if expr[1] == "and":
+            return tl & tr, fl | (tl & fr)
+        return tl | (fl & tr), fl & fr
+    if kind == "not":
+        t, f = _eval(expr[1], cols, lit_ids, xp)
+        return f, t
+    if kind == "lit":  # bool literal (validated)
+        n = _batch_len(cols)
+        full = xp.full(n, bool(expr[1]))
+        return full, ~full
+    if kind == "in":
+        ts = fs = None
+        for e in expr[2]:
+            t, f = _eval(("op", "=", expr[1], e), cols, lit_ids, xp)
+            ts = t if ts is None else (ts | (fs & t))
+            fs = f if fs is None else (fs & f)
+        return ts, fs
+    if kind == "op":
+        return _eval_cmp(expr, cols, lit_ids, xp)
+    raise AssertionError(f"non-boolean node at boolean position: {kind}")
+
+
+def _is_simple(expr: tuple) -> bool:
+    return expr[0] in ("lit", "var")
+
+
+def _eval_cmp(expr: tuple, cols, lit_ids, xp):
+    sym, le, re_ = expr[1], expr[2], expr[3]
+    lstr = le[0] == "lit" and isinstance(le[1], str)
+    rstr = re_[0] == "lit" and isinstance(re_[1], str)
+    if lstr or rstr:
+        # string-literal equality against a var's id lane; TOTAL
+        lit, var = (le, re_) if lstr else (re_, le)
+        ids = cols["s:" + "/".join(var[1])]
+        lid = lit_ids[lit[1]]
+        eq = ids == lid
+        return (eq, ~eq) if sym == "=" else (~eq, eq)
+
+    if sym in ("=", "!="):
+        lv, ld = _num_eval_pair(le, cols, lit_ids, xp)
+        rv, rd = _num_eval_pair(re_, cols, lit_ids, xp)
+        eq = ld & rd & (lv == rv)
+        if le[0] == "var" and re_[0] == "var":
+            # var-var equality also matches on the id lanes
+            li = cols["s:" + "/".join(le[1])]
+            ri = cols["s:" + "/".join(re_[1])]
+            eq = eq | ((li >= 0) & (li == ri))
+        # equality itself is total; only a COMPOUND side contributes
+        # error semantics (its sub-expression may fail to evaluate).
+        # A simple var being non-numeric is mere inequality.
+        cd = None
+        for side, d in ((le, ld), (re_, rd)):
+            if not _is_simple(side):
+                cd = d if cd is None else (cd & d)
+        if cd is None:
+            return (eq, ~eq) if sym == "=" else (~eq, eq)
+        return (eq, cd & ~eq) if sym == "=" else (cd & ~eq, eq)
+
+    # ordering: error semantics
+    lv, ld = _num_eval_pair(le, cols, lit_ids, xp)
+    rv, rd = _num_eval_pair(re_, cols, lit_ids, xp)
+    d = ld & rd
+    cmp = {
+        ">": lv > rv,
+        "<": lv < rv,
+        ">=": lv >= rv,
+        "<=": lv <= rv,
+    }[sym]
+    return d & cmp, d & ~cmp
+
+
+def _num_eval_pair(expr: tuple, cols, lit_ids, xp):
+    """Numeric (value, defined) evaluation."""
+    kind = expr[0]
+    if kind == "lit":
+        n = _batch_len(cols)
+        dt = np.float64 if xp is np else np.float32
+        v = xp.full(n, float(expr[1]), dt)
+        return v, xp.full(n, True)
+    if kind == "var":
+        v = cols["n:" + "/".join(expr[1])]
+        return v, ~xp.isnan(v)
+    if kind == "neg":
+        v, d = _num_eval_pair(expr[1], cols, lit_ids, xp)
+        return -v, d
+    if kind == "op":
+        sym = expr[1]
+        lv, ld = _num_eval_pair(expr[2], cols, lit_ids, xp)
+        rv, rd = _num_eval_pair(expr[3], cols, lit_ids, xp)
+        d = ld & rd
+        if sym == "+":
+            return lv + rv, d
+        if sym == "-":
+            return lv - rv, d
+        if sym == "*":
+            return lv * rv, d
+        if sym == "/":
+            ok = rv != 0
+            return xp.where(ok, lv / xp.where(ok, rv, 1), 0), d & ok
+        # div/mod: the interpreter truncates BOTH operands to int
+        # first (int(a) // int(b), int(a) % int(b)), then floor-divides
+        ta = xp.trunc(lv)
+        tb = xp.trunc(rv)
+        ok = tb != 0
+        safe = xp.where(ok, tb, 1)
+        q = xp.floor(ta / safe)
+        if sym == "div":
+            return q, d & ok
+        return ta - q * safe, d & ok
+    raise AssertionError(f"bad numeric node {kind}")
+
+
+def _batch_len(cols) -> int:
+    return next(iter(cols.values())).shape[0]

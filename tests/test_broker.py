@@ -151,3 +151,41 @@ def test_subscription_count_stat():
     assert b.stats.get("subscriptions.count") == 2
     b.unsubscribe("c1", "a/+")
     assert b.stats.get("subscriptions.count") == 1
+
+
+def test_connected_queue_full_drop_is_counted():
+    from emqx_tpu.config import MqttConfig
+
+    cfg = BrokerConfig()
+    cfg.mqtt.max_inflight = 1
+    cfg.mqtt.max_mqueue_len = 1
+    b = Broker(config=cfg)
+    ch, s = _connect(b, "slow")
+    s.subscribe("t", SubOpts(qos=1))
+    b.subscribe("slow", "t", SubOpts(qos=1))
+    # 1 inflight + 1 queued + 1 evicts the queued one
+    for i in range(3):
+        b.publish(Message(topic="t", payload=str(i).encode(), qos=1))
+    assert b.metrics.val("delivery.dropped.queue_full") == 1
+    assert b.metrics.val("delivery.dropped") == 1
+
+
+def test_delayed_will_fires_and_reconnect_cancels():
+    import time as _t
+
+    b = Broker()
+    watcher_ch, ws = _connect(b, "w")
+    ws.subscribe("wills/#", SubOpts(qos=0))
+    b.subscribe("w", "wills/#", SubOpts(qos=0))
+
+    will = Message(topic="wills/c1", payload=b"gone")
+    b.schedule_will("c1", will, 10.0)
+    b.tick(now=_t.time() + 5)
+    assert watcher_ch.sent == []
+    b.tick(now=_t.time() + 11)
+    assert len(watcher_ch.sent) == 1
+
+    b.schedule_will("c2", Message(topic="wills/c2"), 10.0)
+    b.cancel_will("c2")
+    b.tick(now=_t.time() + 100)
+    assert len(watcher_ch.sent) == 1

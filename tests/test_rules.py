@@ -1,0 +1,385 @@
+"""Rule engine tests: SQL parse, interpreter eval, function library,
+broker integration through the shared match step, republish actions,
+and batched-predicate equivalence against the interpreter oracle."""
+
+import json
+import random
+
+import numpy as np
+import pytest
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.session import SubOpts
+from emqx_tpu.message import Message
+from emqx_tpu.rules.engine import (
+    FunctionAction,
+    RepublishAction,
+    RuleEngine,
+    render_template,
+)
+from emqx_tpu.rules.predicate import compile_where
+from emqx_tpu.rules.runtime import build_env, eval_expr, eval_select, eval_where
+from emqx_tpu.rules.sql import SqlError, parse_sql
+
+
+# ------------------------------------------------------------------ parse
+
+
+def test_parse_basic():
+    q = parse_sql('SELECT payload.x AS x, clientid FROM "t/#" WHERE x > 10')
+    assert [f.alias or f.expr for f in q.fields] == ["x", ("var", ("clientid",))]
+    assert q.froms == ["t/#"]
+    assert q.where == ("op", ">", ("var", ("x",)), ("lit", 10))
+
+
+def test_parse_star_and_multi_from():
+    q = parse_sql('SELECT * FROM "a/+", "b"')
+    assert q.fields[0].star and q.froms == ["a/+", "b"]
+    assert q.where is None
+
+
+def test_parse_precedence():
+    q = parse_sql('SELECT * FROM "t" WHERE a = 1 OR b = 2 AND c = 3')
+    assert q.where[1] == "or"
+    assert q.where[3][1] == "and"
+    q2 = parse_sql('SELECT * FROM "t" WHERE (a = 1 OR b = 2) AND c = 3')
+    assert q2.where[1] == "and"
+
+
+def test_parse_arith_in_case():
+    q = parse_sql(
+        'SELECT CASE WHEN qos = 0 THEN \'low\' ELSE \'hi\' END AS lvl '
+        'FROM "t" WHERE qos + 1 * 2 IN (1, 3) AND NOT retain'
+    )
+    assert q.fields[0].expr[0] == "case"
+    assert q.where[2][0] == "in"
+    # 1*2 binds tighter than +
+    assert q.where[2][1] == (
+        "op", "+", ("var", ("qos",)), ("op", "*", ("lit", 1), ("lit", 2))
+    )
+
+
+def test_parse_errors():
+    for bad in (
+        "SELECT",
+        'SELECT * FROM',
+        'SELECT * FROM "t" WHERE',
+        'SELECT * FROM "t" trailing',
+        'SELECT * FROM "t" WHERE a in 1',
+    ):
+        with pytest.raises(SqlError):
+            parse_sql(bad)
+
+
+# ------------------------------------------------------------------- eval
+
+
+def _env(payload=None, **over):
+    msg = Message(
+        topic=over.pop("topic", "dev/d1/temp"),
+        payload=json.dumps(payload).encode() if payload is not None else b"",
+        qos=over.pop("qos", 1),
+        retain=over.pop("retain", False),
+        from_client=over.pop("clientid", "c1"),
+        from_username=over.pop("username", "u1"),
+    )
+    return build_env(msg)
+
+
+def test_eval_where_payload_fields():
+    env = _env(payload={"temp": 31.5, "ok": True, "tags": {"site": "x"}})
+    assert eval_where(parse_sql('SELECT * FROM "t" WHERE payload.temp > 30').where, env)
+    assert not eval_where(
+        parse_sql('SELECT * FROM "t" WHERE payload.temp > 32').where, env
+    )
+    assert eval_where(parse_sql('SELECT * FROM "t" WHERE payload.ok').where, env)
+    assert eval_where(
+        parse_sql("SELECT * FROM \"t\" WHERE payload.tags.site = 'x'").where, env
+    )
+
+
+def test_eval_where_missing_field_is_false_but_shortcircuits():
+    env = _env(payload={"a": 1})
+    w1 = parse_sql('SELECT * FROM "t" WHERE payload.missing > 1').where
+    assert not eval_where(w1, env)
+    w2 = parse_sql(
+        'SELECT * FROM "t" WHERE payload.a = 1 OR payload.missing > 1'
+    ).where
+    assert eval_where(w2, env)
+    # errors on the left poison the whole predicate
+    w3 = parse_sql(
+        'SELECT * FROM "t" WHERE payload.missing > 1 OR payload.a = 1'
+    ).where
+    assert not eval_where(w3, env)
+
+
+def test_eval_select_aliases_and_star():
+    env = _env(payload={"t": 7})
+    sql = parse_sql(
+        'SELECT payload.t * 2 AS doubled, clientid, upper(username) FROM "t"'
+    )
+    out = eval_select(sql, env)
+    assert out == {"doubled": 14, "clientid": "c1", "upper": "U1"}
+    star = eval_select(parse_sql('SELECT * FROM "t"'), env)
+    assert star["topic"] == "dev/d1/temp" and star["qos"] == 1
+
+
+def test_eval_funcs():
+    env = _env(payload={"s": "Hello World", "xs": [1, 2, 3]})
+    cases = {
+        "lower(payload.s)": "hello world",
+        "strlen(payload.s)": 11,
+        "substr(payload.s, 6)": "World",
+        "nth(2, payload.xs)": 2,
+        "concat('a', 'b', 1)": "ab1",
+        "topic(1, 'x')": "1/x",
+        "abs(0 - 5)": 5,
+        "round(3.7)": 4,
+        "max(1, 2, 3)": 3,
+        "json_encode(payload.xs)": "[1, 2, 3]",
+        "is_str(payload.s)": True,
+        "contains(3, payload.xs)": True,
+        "split('a,b,c', ',')": ["a", "b", "c"],
+        "md5('abc')": "900150983cd24fb0d6963f7d28e17f72",
+    }
+    for src, want in cases.items():
+        got = eval_expr(parse_sql(f'SELECT {src} FROM "t"').fields[0].expr, env)
+        assert got == want, (src, got, want)
+
+
+def test_like_operator():
+    env = _env(topic="dev/d1/temp")
+    assert eval_where(
+        parse_sql("SELECT * FROM \"t\" WHERE topic LIKE 'dev/%/temp'").where, env
+    )
+    assert not eval_where(
+        parse_sql("SELECT * FROM \"t\" WHERE topic LIKE 'dev/_/xx'").where, env
+    )
+
+
+# ---------------------------------------------------------------- broker
+
+
+def test_rule_fires_through_broker_match():
+    b = Broker()
+    hits = []
+    b.rules.add_rule(
+        "r1",
+        'SELECT payload.v AS v, topic FROM "sensors/+/temp" WHERE payload.v > 100',
+        actions=[FunctionAction(lambda sel, msg: hits.append(sel))],
+    )
+    b.publish(Message(topic="sensors/s1/temp", payload=b'{"v": 150}'))
+    b.publish(Message(topic="sensors/s1/temp", payload=b'{"v": 50}'))
+    b.publish(Message(topic="other", payload=b'{"v": 999}'))
+    assert len(hits) == 1 and hits[0]["v"] == 150
+    rule = b.rules.rules["r1"]
+    assert rule.matched == 2 and rule.passed == 1 and rule.failed == 1
+    assert b.metrics.val("rules.matched") == 1
+    assert b.metrics.val("actions.success") == 1
+
+
+def test_rule_and_subscription_share_match_step():
+    b = Broker()
+    from tests_fakes import FakeChannel  # local helper below
+
+    ch = FakeChannel()
+    session, _ = b.cm.open_session(True, "c1", ch)
+    session.subscribe("sensors/+/temp", SubOpts(qos=0))
+    b.subscribe("c1", "sensors/+/temp", SubOpts(qos=0))
+    fired = []
+    b.rules.add_rule(
+        "r",
+        'SELECT * FROM "sensors/#"',
+        actions=[FunctionAction(lambda sel, msg: fired.append(sel))],
+    )
+    n = b.publish(Message(topic="sensors/a/temp", payload=b"{}"))
+    assert n == 1  # subscriber delivery count excludes rule hits
+    assert len(ch.sent) == 1 and len(fired) == 1
+
+
+def test_republish_action_and_loop_cap():
+    b = Broker()
+    from tests_fakes import FakeChannel
+
+    ch = FakeChannel()
+    session, _ = b.cm.open_session(True, "c1", ch)
+    session.subscribe("alerts/#", SubOpts(qos=0))
+    b.subscribe("c1", "alerts/#", SubOpts(qos=0))
+    b.rules.add_rule(
+        "alert",
+        'SELECT payload.v AS v, topic FROM "sensors/+" WHERE payload.v > 10',
+        actions=[
+            RepublishAction(topic="alerts/${topic}", payload='{"v": ${v}}')
+        ],
+    )
+    b.publish(Message(topic="sensors/s9", payload=b'{"v": 42}'))
+    assert len(ch.sent) == 1
+    assert ch.sent[0].topic == "alerts/sensors/s9"
+    assert json.loads(ch.sent[0].payload) == {"v": 42}
+
+    # a self-triggering rule must stop at the depth cap, not recurse
+    b2 = Broker()
+    b2.rules.add_rule(
+        "loop",
+        'SELECT topic FROM "loop/#"',
+        actions=[RepublishAction(topic="loop/x", payload="again")],
+    )
+    b2.publish(Message(topic="loop/x", payload=b"start"))
+    r = b2.rules.rules["loop"]
+    assert r.actions_failed == 1  # the cap converts the loop into a failure
+    assert r.passed <= 9
+
+
+def test_rule_remove_and_disable():
+    b = Broker()
+    fired = []
+    b.rules.add_rule(
+        "r", 'SELECT * FROM "t"', actions=[FunctionAction(lambda s, m: fired.append(1))]
+    )
+    b.publish(Message(topic="t"))
+    b.rules.enable_rule("r", False)
+    b.publish(Message(topic="t"))
+    assert len(fired) == 1
+    b.rules.enable_rule("r", True)
+    b.rules.remove_rule("r")
+    b.publish(Message(topic="t"))
+    assert len(fired) == 1
+    assert b.router.engine.match_batch(["t"])[0] == set()
+
+
+def test_render_template():
+    data = {"a": {"b": 2}, "s": "x", "f": 3.0, "flag": True}
+    assert render_template("${a.b}/${s}/${f}/${flag}/${nope}", data) == (
+        "2/x/3/true/undefined"
+    )
+
+
+# ------------------------------------------------- batched predicates
+
+
+def _random_env(rng):
+    payload = {}
+    if rng.random() < 0.9:
+        payload["a"] = rng.choice([rng.randint(-5, 5), rng.uniform(-5, 5)])
+    if rng.random() < 0.7:
+        payload["b"] = rng.randint(0, 3)
+    if rng.random() < 0.6:
+        payload["s"] = rng.choice(["x", "y", "z"])
+    return build_env(
+        Message(
+            topic=rng.choice(["t/1", "t/2"]),
+            payload=json.dumps(payload).encode(),
+            qos=rng.randint(0, 2),
+            retain=bool(rng.getrandbits(1)),
+            from_client=rng.choice(["c1", "c2"]),
+        )
+    )
+
+
+_PREDICATES = [
+    "payload.a > 0",
+    "payload.a > payload.b",
+    "payload.a + 1 >= payload.b * 2",
+    "payload.s = 'x'",
+    "payload.s != 'y'",
+    "qos = 2 AND retain = 1 OR payload.b = 0",
+    "NOT (payload.a > 0) AND payload.b <= 2",
+    "payload.a = 1 OR payload.missing > 1",
+    "payload.missing > 1 OR payload.a = 1",
+    "qos IN (1, 2)",
+    "payload.s IN ('x', 'q')",
+    "payload.a / payload.b > 1",
+    "payload.a div 2 = 1",
+    "payload.a mod 2 = 0",
+    "payload.a - 0.5 < payload.b OR payload.s = 'z' AND qos > 0",
+]
+
+
+@pytest.mark.parametrize("src", _PREDICATES)
+def test_predicate_batch_equivalence(src):
+    where = parse_sql(f'SELECT * FROM "t" WHERE {src}').where
+    prog = compile_where(where)
+    assert prog is not None, f"should compile: {src}"
+    rng = random.Random(hash(src) & 0xFFFF)
+    envs = [_random_env(rng) for _ in range(256)]
+    got = prog.eval_batch(envs)
+    want = np.array([eval_where(where, e) for e in envs])
+    assert got.dtype == bool
+    mismatch = np.nonzero(got != want)[0]
+    assert mismatch.size == 0, (
+        src,
+        [envs[i]["payload"] for i in mismatch[:3]],
+        got[mismatch[:3]],
+        want[mismatch[:3]],
+    )
+
+
+def test_predicate_batch_jax_path():
+    where = parse_sql(
+        'SELECT * FROM "t" WHERE payload.a > 0 AND qos IN (1, 2)'
+    ).where
+    prog = compile_where(where)
+    rng = random.Random(3)
+    envs = [_random_env(rng) for _ in range(64)]
+    got = prog.eval_batch(envs, use_jax=True)
+    want = np.array([eval_where(where, e) for e in envs])
+    assert (got == want).all()
+
+
+def test_predicate_unsupported_falls_back():
+    for src in (
+        "lower(clientid) = 'c1'",
+        "CASE WHEN qos = 0 THEN true ELSE false END",
+    ):
+        where = parse_sql(f'SELECT * FROM "t" WHERE {src}').where
+        assert compile_where(where) is None
+
+
+def test_predicate_total_equality_with_compound_side():
+    """Review r2: `payload.s != qos + 1` with a string var must stay
+    True (equality is total; only the compound side carries errors)."""
+    where = parse_sql('SELECT * FROM "t" WHERE payload.s != qos + 1').where
+    prog = compile_where(where)
+    env = build_env(Message(topic="t", payload=b'{"s": "abc"}', qos=1))
+    assert eval_where(where, env) is True
+    assert prog.eval_batch([env])[0]
+    # and an erroring compound side still poisons both polarities
+    where2 = parse_sql(
+        'SELECT * FROM "t" WHERE payload.missing + 1 != 5'
+    ).where
+    prog2 = compile_where(where2)
+    assert eval_where(where2, env) is False
+    assert not prog2.eval_batch([env])[0]
+
+
+def test_predicate_timestamp_precision():
+    """Review r2: millisecond timestamps exceed float32; the batch
+    path must not lose the comparison."""
+    where = parse_sql(
+        'SELECT * FROM "t" WHERE timestamp > 1753000000100'
+    ).where
+    prog = compile_where(where)
+    env = build_env(Message(topic="t"))
+    env["timestamp"] = 1753000000200
+    env2 = build_env(Message(topic="t"))
+    env2["timestamp"] = 1753000000000
+    got = prog.eval_batch([env, env2], use_jax=True)
+    assert got.tolist() == [True, False]
+
+
+def test_add_rule_invalid_sql_keeps_old_rule():
+    b = Broker()
+    b.rules.add_rule("r1", 'SELECT * FROM "t/#"')
+    with pytest.raises(SqlError):
+        b.rules.add_rule("r1", "SELECT FROM")
+    assert "r1" in b.rules.rules
+    assert b.router.engine.match_batch(["t/x"])[0] == {("rule", "r1", 0)}
+    with pytest.raises(ValueError):
+        b.rules.add_rule("r1", 'SELECT * FROM "bad/#/mid"')
+    assert "r1" in b.rules.rules
+
+
+def test_rule_fids_do_not_inflate_subscription_stat():
+    b = Broker()
+    b.rules.add_rule("r1", 'SELECT * FROM "t/#"')
+    assert b.info()["subscriptions"] == 0

@@ -1,0 +1,13 @@
+"""Shared fakes for broker-level tests."""
+
+
+class FakeChannel:
+    def __init__(self):
+        self.sent = []
+        self.closed = None
+
+    def send_packets(self, pkts):
+        self.sent.extend(pkts)
+
+    def close(self, reason):
+        self.closed = reason
