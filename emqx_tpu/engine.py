@@ -19,6 +19,7 @@ exact regardless of kernel capacity bounds.
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, Hashable, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
@@ -42,14 +43,18 @@ class MatchEngine:
         max_levels: int = 16,
         f_width: int = 16,
         m_cap: int = 128,
+        e_cap: int = 512,
         rebuild_threshold: int = 4096,
         use_device: Optional[bool] = None,
+        background_rebuild: bool = False,
     ) -> None:
         self.max_levels = max_levels
         self.f_width = f_width
         self.m_cap = m_cap
+        self.e_cap = e_cap
         self.rebuild_threshold = rebuild_threshold
         self.use_device = use_device
+        self.background_rebuild = background_rebuild
         self._exact: Dict[str, Set[Hashable]] = {}
         self._wild = HostTrie()  # full wildcard set: fallback + rebuild source
         self._delta = HostTrie()  # wildcard filters added since last build
@@ -60,10 +65,21 @@ class MatchEngine:
         self._aut: Optional[Automaton] = None
         self._dev: Optional[Tuple] = None  # device copies of table arrays
         self._base_fids: Set[Hashable] = set()
+        # background (double-buffered) rebuild state: the builder thread
+        # assembles a new snapshot while matching continues on the live
+        # one — the `emqx_router_syncer` no-stop-the-world property
+        # (/root/reference/apps/emqx/src/emqx_router_syncer.erl:58)
+        self._lock = threading.Lock()
+        self._building = False
+        self._built: Optional[Tuple] = None  # (aut, dev, fid_arr, base_fids)
+        self._pending_inserts: List[Tuple[str, Hashable]] = []
+        self._pending_deletes: Set[Hashable] = set()
 
     # ------------------------------------------------------------- mutation
 
     def insert(self, flt: str, fid: Hashable) -> None:
+        if self._built is not None:
+            self._poll_swap()
         T.validate_filter(flt)
         if fid in self._by_fid:
             if self._by_fid[fid] == flt:
@@ -82,8 +98,13 @@ class MatchEngine:
                 # tombstone is what masks the stale device entry.  The
                 # delta trie serves the re-inserted filter until rebuild.
                 self._delta.insert(flt, fid)
+                if self._building:
+                    self._pending_inserts.append((flt, fid))
                 if len(self._delta) >= self.rebuild_threshold:
-                    self.rebuild()
+                    if self.background_rebuild:
+                        self._start_background_rebuild()
+                    else:
+                        self.rebuild()
         else:
             self._exact.setdefault(flt, set()).add(fid)
 
@@ -97,6 +118,8 @@ class MatchEngine:
             self._deep.delete_id(fid)
             if fid in self._base_fids:
                 self._deleted.add(fid)
+            if self._building:
+                self._pending_deletes.add(fid)
         else:
             ids = self._exact.get(flt)
             if ids is not None:
@@ -110,27 +133,119 @@ class MatchEngine:
 
     # -------------------------------------------------------------- rebuild
 
-    def rebuild(self, hash_buckets: int = 0) -> None:
-        """Fold the delta into a fresh device automaton snapshot."""
-        filters = [
+    def _snapshot_filters(self) -> List[Tuple[Hashable, T.Words]]:
+        return [
             (fid, ws)
             for fid, ws in self._wild.filters()
             if fid not in self._deep
         ]
-        self._aut = build_automaton(
+
+    def _build(
+        self, filters, hash_buckets: int = 0, device_put: bool = False
+    ):
+        aut = build_automaton(
             filters, self._tdict, self.max_levels, hash_buckets=hash_buckets
         )
-        self._base_fids = {fid for fid, _ in filters}
+        # position -> fid, vectorized-indexable (int64 fast path when
+        # every fid is an int; object fallback for arbitrary Hashables —
+        # filled by assignment so tuple fids stay 1-D, not broadcast)
+        fids = [fid for fid, _ in filters]
+        if fids and all(type(f) is int for f in fids):
+            fid_arr: np.ndarray = np.array(fids, np.int64)
+        else:
+            fid_arr = np.empty(len(fids), object)
+            fid_arr[:] = fids
+        dev = None
+        if device_put:
+            import jax
+
+            dev = tuple(
+                jax.device_put(a)
+                for a in (*aut.device_arrays(), *aut.expand_arrays())
+            )
+        return aut, dev, fid_arr, set(fids)
+
+    def rebuild(self, hash_buckets: int = 0) -> None:
+        """Fold the delta into a fresh device automaton snapshot
+        (synchronous; see ``background_rebuild`` for the no-stall path)."""
+        filters = self._snapshot_filters()
+        self._aut, self._dev, self._fid_arr, self._base_fids = self._build(
+            filters, hash_buckets=hash_buckets
+        )
         self._delta = HostTrie()
         self._deleted = set()
-        self._dev = None  # lazily device_put on first device match
+
+    def _start_background_rebuild(self) -> None:
+        with self._lock:
+            if self._building:
+                return
+            self._building = True
+            self._pending_inserts = []
+            self._pending_deletes = set()
+            filters = self._snapshot_filters()
+
+        def work():
+            try:
+                built = self._build(filters, device_put=True)
+            except Exception:  # build failure must not wedge the engine
+                import logging
+
+                logging.getLogger("emqx_tpu.engine").exception(
+                    "background automaton rebuild failed "
+                    "(%d filters); matching continues on the host overlay",
+                    len(filters),
+                )
+                built = ()
+            with self._lock:
+                self._built = built
+
+        threading.Thread(
+            target=work, name="matchengine-rebuild", daemon=True
+        ).start()
+
+    def _poll_swap(self) -> None:
+        """Adopt a finished background build: O(pending) swap, no stall."""
+        if self._built is None:
+            return
+        with self._lock:
+            built = self._built
+            self._built = None
+            if not built:  # failed build: allow a retrigger
+                self._building = False
+                return
+            self._aut, self._dev, self._fid_arr, self._base_fids = built
+            delta = HostTrie()
+            for flt, fid in self._pending_inserts:
+                if self._by_fid.get(fid) == flt and fid not in self._deep:
+                    delta.insert(flt, fid)
+            self._delta = delta
+            self._deleted = {
+                fid for fid in self._pending_deletes if fid in self._base_fids
+            }
+            self._pending_inserts = []
+            self._pending_deletes = set()
+            self._building = False
+
+    def index_stats(self) -> Dict[str, object]:
+        return {
+            "base": len(self._base_fids),
+            "delta": len(self._delta),
+            "deep": len(self._deep),
+            "exact": sum(len(v) for v in self._exact.values()),
+            "deleted": len(self._deleted),
+            "building": self._building,
+        }
 
     def _device_tables(self):
         if self._dev is None:
             import jax
 
             self._dev = tuple(
-                jax.device_put(a) for a in self._aut.device_arrays()
+                jax.device_put(a)
+                for a in (
+                    *self._aut.device_arrays(),
+                    *self._aut.expand_arrays(),
+                )
             )
         return self._dev
 
@@ -146,6 +261,8 @@ class MatchEngine:
         return out
 
     def match_batch(self, topics: Sequence[str]) -> List[Set[Hashable]]:
+        if self._built is not None:
+            self._poll_swap()
         words = [T.words(t) for t in topics]
         device_on = (
             self.use_device is not False
@@ -155,30 +272,36 @@ class MatchEngine:
         if not device_on:
             return [self.match_host(ws) for ws in words]
 
-        tokens, lengths, dollar = encode_topics(
-            self._tdict, words, self._aut.kernel_levels
-        )
-        codes, counts, ovf = self._match_device(tokens, lengths, dollar)
-        aut = self._aut
+        pos, counts, ovf = self.match_batch_pos(words)
+        fid_arr = self._fid_arr
+        deleted = self._deleted
         out: List[Set[Hashable]] = []
         for i, ws in enumerate(words):
             if ovf[i]:
                 out.append(self.match_host(ws))
                 continue
-            fids: Set[Hashable] = set(self._exact.get(topics[i], ()))
-            for code in codes[i, : counts[i]]:
-                for pos in aut.expand(int(code)):
-                    fid = aut.filters[pos][0]
-                    if fid not in self._deleted:
-                        fids.add(fid)
-            fids |= self._delta.match_words(ws)
-            fids |= self._deep.match_words(ws)
+            fids: Set[Hashable] = set(fid_arr[pos[i, : counts[i]]].tolist())
+            if deleted:
+                fids -= deleted
+            if self._exact:
+                fids |= self._exact.get(topics[i], set())
+            if len(self._delta):
+                fids |= self._delta.match_words(ws)
+            if len(self._deep):
+                fids |= self._deep.match_words(ws)
             out.append(fids)
         return out
 
-    def _match_device(self, tokens, lengths, dollar):
-        from .ops.match_kernel import match_batch
+    def match_batch_pos(self, words: Sequence[T.Words]):
+        """Device fast path: encoded topics -> matched filter positions
+        ``(pos [B, e_cap] into the base snapshot, counts [B], ovf [B])``.
+        Rows flagged ``ovf`` must be re-matched on the host.  Callers
+        must still overlay exact/delta/deep/deleted state."""
+        from .ops.match_kernel import match_expand
 
+        tokens, lengths, dollar = encode_topics(
+            self._tdict, words, self._aut.kernel_levels
+        )
         # pad the batch to a power-of-two bucket so XLA sees a bounded
         # set of shapes (no recompile storm on ragged publish batches)
         b = tokens.shape[0]
@@ -192,7 +315,7 @@ class MatchEngine:
             dollar = np.pad(dollar, (0, pad), constant_values=True)
 
         tables = self._device_tables()
-        codes, counts, ovf = match_batch(
+        pos, counts, ovf = match_expand(
             *tables,
             tokens,
             lengths,
@@ -200,5 +323,6 @@ class MatchEngine:
             probes=self._aut.probes,
             f_width=self.f_width,
             m_cap=self.m_cap,
+            e_cap=self.e_cap,
         )
-        return np.asarray(codes)[:b], np.asarray(counts)[:b], np.asarray(ovf)[:b]
+        return np.asarray(pos)[:b], np.asarray(counts)[:b], np.asarray(ovf)[:b]

@@ -63,11 +63,11 @@ class Automaton:
     # per-node rows [n_nodes, 4]: (plus_child|SENTINEL, hash_flag,
     # exact_flag, 0)
     node_rows: np.ndarray
-    # CSR node -> positions into `filters` (host-side expansion)
-    exact_off: np.ndarray
-    exact_idx: np.ndarray
-    hash_off: np.ndarray
-    hash_idx: np.ndarray
+    # CSR keyed by match code (node*2 | is_hash) -> positions into
+    # `filters`; device-gatherable so code->fid expansion never loops
+    # on the host (the round-1 bottleneck).
+    code_off: np.ndarray  # [2*n_nodes + 1] int32
+    code_idx: np.ndarray  # [n_filters] int32
     # build metadata
     filters: List[Tuple[object, Tuple[str, ...]]]  # (fid, words) as built
     probes: int  # bucket-chain probe bound for the kernel
@@ -77,13 +77,36 @@ class Automaton:
 
     def expand(self, val: int) -> Sequence[int]:
         """Device match code (node*2 | kind) -> filter positions."""
-        node, kind = val >> 1, val & 1
-        if kind:
-            return self.hash_idx[self.hash_off[node] : self.hash_off[node + 1]]
-        return self.exact_idx[self.exact_off[node] : self.exact_off[node + 1]]
+        return self.code_idx[self.code_off[val] : self.code_off[val + 1]]
 
     def device_arrays(self) -> Tuple[np.ndarray, ...]:
         return (self.ht_rows, self.node_rows)
+
+    def expand_arrays(self) -> Tuple[np.ndarray, np.ndarray]:
+        return (self.code_off, self.code_idx)
+
+
+def expand_codes_host(
+    code_off: np.ndarray,
+    code_idx: np.ndarray,
+    codes: np.ndarray,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Vectorized host-side expansion of a ``[B, M]`` code matrix (-1
+    padded) into flat ``(topic_row, filter_position)`` pairs.
+
+    This is the "device returns compressed (filter-ID, count) form,
+    host expands lazily" strategy (SURVEY §7): the device ships only
+    the compact per-topic code list; the fan-out amplification happens
+    here with pure numpy — no Python loop per match."""
+    rows, cols = np.nonzero(codes >= 0)
+    c = codes[rows, cols].astype(np.int64)
+    starts = code_off[c].astype(np.int64)
+    lens = code_off[c + 1].astype(np.int64) - starts
+    total = int(lens.sum())
+    seg_end = np.cumsum(lens)
+    within = np.arange(total, dtype=np.int64) - np.repeat(seg_end - lens, lens)
+    src = np.repeat(starts, lens) + within
+    return np.repeat(rows, lens), code_idx[src]
 
 
 def _build_bucket_table(
@@ -206,27 +229,20 @@ def build_automaton(
 
     term = parent.astype(np.int64)
 
-    def _csr(sel: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
-        idx = np.nonzero(sel)[0]
-        nodes = term[idx]
-        order = np.argsort(nodes, kind="stable")
-        counts = np.bincount(nodes, minlength=n_nodes).astype(np.int64)
-        off = np.zeros(n_nodes + 1, np.int64)
-        np.cumsum(counts, out=off[1:])
-        return off, idx[order].astype(np.int64)
+    codes_all = term * 2 + is_hash.astype(np.int64)
+    order = np.argsort(codes_all, kind="stable")
+    counts = np.bincount(codes_all, minlength=2 * n_nodes).astype(np.int64)
+    code_off = np.zeros(2 * n_nodes + 1, np.int64)
+    np.cumsum(counts, out=code_off[1:])
 
-    hash_off, hash_idx = _csr(is_hash)
-    exact_off, exact_idx = _csr(~is_hash)
     node_rows[term[is_hash], 1] = 1
     node_rows[term[~is_hash], 2] = 1
 
     return Automaton(
         ht_rows=ht_rows,
         node_rows=node_rows,
-        exact_off=exact_off,
-        exact_idx=exact_idx,
-        hash_off=hash_off,
-        hash_idx=hash_idx,
+        code_off=code_off.astype(np.int32),
+        code_idx=order.astype(np.int32),
         filters=flist,
         probes=probes,
         max_levels=max_levels,

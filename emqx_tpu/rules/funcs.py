@@ -30,14 +30,17 @@ def _like(s: Any, pattern: Any) -> bool:
     """SQL LIKE: % = any run, _ = one char."""
     if not isinstance(s, str) or not isinstance(pattern, str):
         return False
-    pat = (
-        pattern.replace("\\", "\\\\")
-        .replace("*", "[*]")
-        .replace("?", "[?]")
-        .replace("%", "*")
-        .replace("_", "?")
-    )
-    return fnmatch.fnmatchcase(s, pat)
+    out = []
+    for ch in pattern:
+        if ch == "%":
+            out.append("*")
+        elif ch == "_":
+            out.append("?")
+        elif ch in "*?[":  # neutralize fnmatch metacharacters
+            out.append("[" + ch + "]")
+        else:
+            out.append(ch)
+    return fnmatch.fnmatchcase(s, "".join(out))
 
 
 FUNCS: Dict[str, Callable[..., Any]] = {}

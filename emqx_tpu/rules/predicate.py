@@ -73,12 +73,18 @@ class PredicateProgram:
         sdict: Dict[str, int] = {_TRUE_KEY: 0, _FALSE_KEY: 1}
         num = {p: np.full(n, np.nan, np.float64) for p in self.var_paths}
         sid = {p: np.full(n, -1, np.int32) for p in self.var_paths}
+        # a lookup ERROR (e.g. descending into a non-JSON payload) is
+        # not the same as undefined: the interpreter errors when it
+        # evaluates that var, making the WHERE false — tracked as a
+        # third lane so total equality stays oracle-equal
+        err = {p: np.zeros(n, bool) for p in self.var_paths}
         for i, env in enumerate(envs):
             for p in self.var_paths:
                 try:
                     v = lookup_var(env, p)
                 except Exception:
-                    v = None
+                    err[p][i] = True
+                    continue
                 if isinstance(v, bool):
                     sid[p][i] = sdict[_TRUE_KEY if v else _FALSE_KEY]
                 elif isinstance(v, (int, float)):
@@ -92,6 +98,7 @@ class PredicateProgram:
         for p in self.var_paths:
             cols["n:" + "/".join(p)] = num[p]
             cols["s:" + "/".join(p)] = sid[p]
+            cols["e:" + "/".join(p)] = err[p]
         return cols, sdict
 
     # ---------------------------------------------------- evaluation
@@ -119,8 +126,13 @@ class PredicateProgram:
     def _f32_safe(self, cols: Dict[str, np.ndarray]) -> bool:
         """The device path computes in float32 (jax default / TPU
         native); use it only when every numeric value round-trips
-        exactly, else stay on the float64 host path.  Millisecond
-        timestamps are the canonical offender."""
+        exactly AND the WHERE performs no arithmetic — an arithmetic
+        RESULT can lose precision even when every input round-trips
+        (16777216+1 == 16777216 in f32), so any arith stays on the
+        float64 host path.  Millisecond timestamps are the canonical
+        input offender."""
+        if _has_arith(self.where):
+            return False
         lits: List[float] = []
         _num_literals(self.where, lits)
         for v in lits:
@@ -132,6 +144,21 @@ class PredicateProgram:
                 if not (finite == finite.astype(np.float32)).all():
                     return False
         return True
+
+
+def _has_arith(expr: tuple) -> bool:
+    kind = expr[0]
+    if kind == "neg":
+        return True
+    if kind == "op":
+        if expr[1] in ("+", "-", "*", "/", "div", "mod"):
+            return True
+        return _has_arith(expr[2]) or _has_arith(expr[3])
+    if kind == "not":
+        return _has_arith(expr[1])
+    if kind == "in":
+        return _has_arith(expr[1]) or any(_has_arith(e) for e in expr[2])
+    return False
 
 
 def _num_literals(expr: tuple, out: List[float]) -> None:
@@ -308,12 +335,15 @@ def _eval_cmp(expr: tuple, cols, lit_ids, xp):
     lstr = le[0] == "lit" and isinstance(le[1], str)
     rstr = re_[0] == "lit" and isinstance(re_[1], str)
     if lstr or rstr:
-        # string-literal equality against a var's id lane; TOTAL
+        # string-literal equality against a var's id lane; TOTAL except
+        # when the var lookup itself ERRORED (interpreter: WHERE false)
         lit, var = (le, re_) if lstr else (re_, le)
         ids = cols["s:" + "/".join(var[1])]
+        erv = cols["e:" + "/".join(var[1])]
         lid = lit_ids[lit[1]]
-        eq = ids == lid
-        return (eq, ~eq) if sym == "=" else (~eq, eq)
+        eq = ~erv & (ids == lid)
+        ne = ~erv & (ids != lid)
+        return (eq, ne) if sym == "=" else (ne, eq)
 
     if sym in ("=", "!="):
         lv, ld = _num_eval_pair(le, cols, lit_ids, xp)
@@ -324,16 +354,29 @@ def _eval_cmp(expr: tuple, cols, lit_ids, xp):
             li = cols["s:" + "/".join(le[1])]
             ri = cols["s:" + "/".join(re_[1])]
             eq = eq | ((li >= 0) & (li == ri))
-        # equality itself is total; only a COMPOUND side contributes
-        # error semantics (its sub-expression may fail to evaluate).
-        # A simple var being non-numeric is mere inequality.
+        # equality itself is total; but a lookup ERROR on a simple var
+        # (vs merely undefined) poisons the row, and a COMPOUND side
+        # contributes its own error semantics (sub-expression may fail)
+        ok = None
+        for side in (le, re_):
+            if side[0] == "var":
+                e = cols["e:" + "/".join(side[1])]
+                ok = ~e if ok is None else (ok & ~e)
+        if ok is None:
+            ok = xp.full(_batch_len(cols), True)
         cd = None
         for side, d in ((le, ld), (re_, rd)):
             if not _is_simple(side):
                 cd = d if cd is None else (cd & d)
         if cd is None:
-            return (eq, ~eq) if sym == "=" else (~eq, eq)
-        return (eq, cd & ~eq) if sym == "=" else (cd & ~eq, eq)
+            return (
+                (eq & ok, ~eq & ok) if sym == "=" else (~eq & ok, eq & ok)
+            )
+        return (
+            (eq & ok, cd & ~eq & ok)
+            if sym == "="
+            else (cd & ~eq & ok, eq & ok)
+        )
 
     # ordering: error semantics
     lv, ld = _num_eval_pair(le, cols, lit_ids, xp)

@@ -226,3 +226,47 @@ def test_full_depth_filter_does_not_match_deeper_topic():
     eng.insert("a/b/c/#", 2)
     eng.rebuild()
     assert eng.match("a/b/c/d/e/f") == {2}
+
+
+def test_background_rebuild_no_stop_the_world():
+    """Mutations during a background rebuild stay correct through the
+    swap (emqx_router_syncer-style batching, no synchronous rebuild)."""
+    rng = random.Random(7)
+    eng = MatchEngine(
+        use_device=True, background_rebuild=True, rebuild_threshold=64
+    )
+    live = {}
+    fid = 0
+    for round_ in range(6):
+        for _ in range(100):
+            flt = random_filter(rng)
+            try:
+                T.validate_filter(flt)
+            except ValueError:
+                continue
+            eng.insert(flt, fid)
+            live[fid] = flt
+            fid += 1
+        # delete a few while a build may be in flight
+        for victim in rng.sample(sorted(live), 10):
+            eng.delete(victim)
+            del live[victim]
+        topics = [random_topic(rng) for _ in range(20)]
+        got = eng.match_batch(topics)
+        for t, g in zip(topics, got):
+            want = {
+                f for f, w in live.items() if T.match_words(T.words(t), T.words(w))
+            }
+            assert g == want, (round_, t, g, want)
+    # drain: wait for any in-flight build and check again post-swap
+    import time
+
+    for _ in range(200):
+        if eng._built is not None or not eng._building:
+            break
+        time.sleep(0.05)
+    topics = [random_topic(rng) for _ in range(50)]
+    got = eng.match_batch(topics)
+    for t, g in zip(topics, got):
+        want = {f for f, w in live.items() if T.match_words(T.words(t), T.words(w))}
+        assert g == want, (t, g, want)

@@ -383,3 +383,63 @@ def test_rule_fids_do_not_inflate_subscription_stat():
     b = Broker()
     b.rules.add_rule("r1", 'SELECT * FROM "t/#"')
     assert b.info()["subscriptions"] == 0
+
+
+def test_compiled_where_error_vs_undefined_matches_interpreter():
+    """Code-review r2: a lookup ERROR (non-JSON payload) must make the
+    compiled WHERE false, exactly like the interpreter — distinct from
+    a merely-missing field (which is total inequality)."""
+    from emqx_tpu.message import Message
+
+    for sql in (
+        'SELECT * FROM "t" WHERE payload.x != 1',
+        "SELECT * FROM \"t\" WHERE payload.x != 'y'",
+        'SELECT * FROM "t" WHERE payload.x = 1 OR qos = 1',
+    ):
+        w = parse_sql(sql).where
+        prog = compile_where(w)
+        assert prog is not None, sql
+        envs = [
+            build_env(Message(topic="t", payload=b"hello", qos=1)),  # error
+            build_env(Message(topic="t", payload=b'{"a": 2}', qos=1)),  # undef
+            build_env(Message(topic="t", payload=b'{"x": 1}', qos=1)),
+        ]
+        want = [eval_where(w, e) for e in envs]
+        got = prog.eval_batch(envs).tolist()
+        assert got == want, (sql, got, want)
+
+
+def test_compiled_where_arith_precision_matches_interpreter():
+    """Code-review r2: f32 arithmetic results must not diverge from the
+    float64 interpreter (16777216 + 1 == 16777216 in f32)."""
+    w = parse_sql('SELECT * FROM "t" WHERE payload.a + 1 > 16777216').where
+    prog = compile_where(w)
+    envs = [_env(payload={"a": 16777216})]
+    want = [eval_where(w, e) for e in envs]
+    got = prog.eval_batch(envs, use_jax=True).tolist()
+    assert got == want == [True]
+
+
+def test_like_bracket_literal():
+    """Code-review r2: '[' in a LIKE pattern is a literal, not a
+    character class."""
+    assert eval_where(
+        parse_sql("SELECT * FROM \"t\" WHERE topic LIKE 'a[0]%'").where,
+        build_env(Message(topic="a[0]x", payload=b"", qos=0)),
+    )
+    assert not eval_where(
+        parse_sql("SELECT * FROM \"t\" WHERE topic LIKE 'a[0]%'").where,
+        build_env(Message(topic="a0x", payload=b"", qos=0)),
+    )
+
+
+def test_engine_tuple_fids_survive_rebuild():
+    """Code-review r2: all-tuple fids must stay a 1-D object array, not
+    broadcast into a 2-D array that breaks device matching."""
+    from emqx_tpu.engine import MatchEngine
+
+    eng = MatchEngine(use_device=True)
+    for i in range(5):
+        eng.insert(f"r/{i}/+", ("rule", "r1", i))
+    eng.rebuild()
+    assert eng.match("r/3/x") == {("rule", "r1", 3)}
