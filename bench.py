@@ -193,11 +193,23 @@ def main():
     log(f"compile+first batch: {time.perf_counter() - t0:.2f}s; "
         f"ovf={int(ovf.sum())} mean_fanout={len(fids) / batch:.2f}")
 
-    # (a) device-only throughput: everything stays on-device
+    # (a) device-only throughput: batches pre-encoded so the clock sees
+    # only dispatch + device compute (host tokenize cost is excluded
+    # here and included in the full-path phase below)
+    encoded = [
+        encode_topics(tdict, [T.words(t) for t in s], aut.kernel_levels)
+        for s in streams
+    ]
     t0 = time.perf_counter()
-    outs = [submit(s) for s in streams]
+    outs = [
+        match_batch(
+            *dev, *e, probes=aut.probes, f_width=f_width, m_cap=m_cap
+        )
+        for e in encoded
+    ]
     outs[-1][1].block_until_ready()
     device_rate = batch * iters / (time.perf_counter() - t0)
+    del encoded, outs
     log(f"device-only match rate: {device_rate:,.0f} topics/s")
 
     # (b) full path, pipelined: submit keeps `depth` batches in flight,

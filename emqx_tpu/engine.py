@@ -30,6 +30,17 @@ from .ops.dictionary import TokenDict, encode_topics
 from .ops.trie_host import HostTrie
 
 
+def make_fid_arr(fids: List[Hashable]) -> np.ndarray:
+    """Position -> fid, vectorized-indexable: int64 fast path when every
+    fid is an int; object fallback (filled by assignment so tuple fids
+    stay 1-D, not broadcast)."""
+    if fids and all(type(f) is int for f in fids):
+        return np.array(fids, np.int64)
+    arr = np.empty(len(fids), object)
+    arr[:] = fids
+    return arr
+
+
 class MatchEngine:
     """Mutable filter set with batched matching.
 
@@ -43,7 +54,6 @@ class MatchEngine:
         max_levels: int = 16,
         f_width: int = 16,
         m_cap: int = 128,
-        e_cap: int = 512,
         rebuild_threshold: int = 4096,
         use_device: Optional[bool] = None,
         background_rebuild: bool = False,
@@ -51,7 +61,6 @@ class MatchEngine:
         self.max_levels = max_levels
         self.f_width = f_width
         self.m_cap = m_cap
-        self.e_cap = e_cap
         self.rebuild_threshold = rebuild_threshold
         self.use_device = use_device
         self.background_rebuild = background_rebuild
@@ -72,6 +81,7 @@ class MatchEngine:
         self._lock = threading.Lock()
         self._building = False
         self._built: Optional[Tuple] = None  # (aut, dev, fid_arr, base_fids)
+        self._build_thread: Optional[threading.Thread] = None
         self._pending_inserts: List[Tuple[str, Hashable]] = []
         self._pending_deletes: Set[Hashable] = set()
 
@@ -146,28 +156,28 @@ class MatchEngine:
         aut = build_automaton(
             filters, self._tdict, self.max_levels, hash_buckets=hash_buckets
         )
-        # position -> fid, vectorized-indexable (int64 fast path when
-        # every fid is an int; object fallback for arbitrary Hashables —
-        # filled by assignment so tuple fids stay 1-D, not broadcast)
         fids = [fid for fid, _ in filters]
-        if fids and all(type(f) is int for f in fids):
-            fid_arr: np.ndarray = np.array(fids, np.int64)
-        else:
-            fid_arr = np.empty(len(fids), object)
-            fid_arr[:] = fids
         dev = None
         if device_put:
-            import jax
+            dev = self._device_put(aut)
+        return aut, dev, make_fid_arr(fids), set(fids)
 
-            dev = tuple(
-                jax.device_put(a)
-                for a in (*aut.device_arrays(), *aut.expand_arrays())
-            )
-        return aut, dev, fid_arr, set(fids)
+    def _device_put(self, aut):
+        import jax
+
+        return tuple(jax.device_put(a) for a in aut.device_arrays())
 
     def rebuild(self, hash_buckets: int = 0) -> None:
         """Fold the delta into a fresh device automaton snapshot
-        (synchronous; see ``background_rebuild`` for the no-stall path)."""
+        (synchronous; see ``background_rebuild`` for the no-stall path).
+
+        If a background build is in flight, wait for it first: two
+        concurrent builders would interleave TokenDict.add's
+        check-then-act and could alias two words onto one token id."""
+        t = self._build_thread
+        if t is not None and t.is_alive():
+            t.join()
+        self._poll_swap()
         filters = self._snapshot_filters()
         self._aut, self._dev, self._fid_arr, self._base_fids = self._build(
             filters, hash_buckets=hash_buckets
@@ -199,9 +209,10 @@ class MatchEngine:
             with self._lock:
                 self._built = built
 
-        threading.Thread(
+        self._build_thread = threading.Thread(
             target=work, name="matchengine-rebuild", daemon=True
-        ).start()
+        )
+        self._build_thread.start()
 
     def _poll_swap(self) -> None:
         """Adopt a finished background build: O(pending) swap, no stall."""
@@ -238,15 +249,7 @@ class MatchEngine:
 
     def _device_tables(self):
         if self._dev is None:
-            import jax
-
-            self._dev = tuple(
-                jax.device_put(a)
-                for a in (
-                    *self._aut.device_arrays(),
-                    *self._aut.expand_arrays(),
-                )
-            )
+            self._dev = self._device_put(self._aut)
         return self._dev
 
     # -------------------------------------------------------------- match
@@ -272,15 +275,18 @@ class MatchEngine:
         if not device_on:
             return [self.match_host(ws) for ws in words]
 
-        pos, counts, ovf = self.match_batch_pos(words)
+        rows, gpos, ovf = self.match_batch_flat(words)
         fid_arr = self._fid_arr
         deleted = self._deleted
+        fids_flat = fid_arr[gpos]
+        per_row = np.bincount(rows, minlength=len(words))
+        chunks = np.split(fids_flat, np.cumsum(per_row)[:-1])
         out: List[Set[Hashable]] = []
         for i, ws in enumerate(words):
             if ovf[i]:
                 out.append(self.match_host(ws))
                 continue
-            fids: Set[Hashable] = set(fid_arr[pos[i, : counts[i]]].tolist())
+            fids: Set[Hashable] = set(chunks[i].tolist())
             if deleted:
                 fids -= deleted
             if self._exact:
@@ -292,12 +298,16 @@ class MatchEngine:
             out.append(fids)
         return out
 
-    def match_batch_pos(self, words: Sequence[T.Words]):
-        """Device fast path: encoded topics -> matched filter positions
-        ``(pos [B, e_cap] into the base snapshot, counts [B], ovf [B])``.
+    def match_batch_flat(self, words: Sequence[T.Words]):
+        """Device fast path: encoded topics -> flat row-sorted
+        ``(topic_row, position)`` pairs into the base snapshot plus a
+        per-row overflow flag.  The device ships only the compact code
+        form; fan-out expansion happens host-side with vectorized CSR
+        (`expand_codes_host`) — the SURVEY §7 amplification strategy.
         Rows flagged ``ovf`` must be re-matched on the host.  Callers
         must still overlay exact/delta/deep/deleted state."""
-        from .ops.match_kernel import match_expand
+        from .ops.automaton import expand_codes_host
+        from .ops.match_kernel import match_batch
 
         tokens, lengths, dollar = encode_topics(
             self._tdict, words, self._aut.kernel_levels
@@ -315,7 +325,7 @@ class MatchEngine:
             dollar = np.pad(dollar, (0, pad), constant_values=True)
 
         tables = self._device_tables()
-        pos, counts, ovf = match_expand(
+        codes, _, ovf = match_batch(
             *tables,
             tokens,
             lengths,
@@ -323,6 +333,9 @@ class MatchEngine:
             probes=self._aut.probes,
             f_width=self.f_width,
             m_cap=self.m_cap,
-            e_cap=self.e_cap,
         )
-        return np.asarray(pos)[:b], np.asarray(counts)[:b], np.asarray(ovf)[:b]
+        aut = self._aut
+        rows, pos = expand_codes_host(
+            aut.code_off, aut.code_idx, np.asarray(codes)[:b]
+        )
+        return rows, pos, np.asarray(ovf)[:b]

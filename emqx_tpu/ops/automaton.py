@@ -82,9 +82,6 @@ class Automaton:
     def device_arrays(self) -> Tuple[np.ndarray, ...]:
         return (self.ht_rows, self.node_rows)
 
-    def expand_arrays(self) -> Tuple[np.ndarray, np.ndarray]:
-        return (self.code_off, self.code_idx)
-
 
 def expand_codes_host(
     code_off: np.ndarray,

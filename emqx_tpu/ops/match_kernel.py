@@ -145,73 +145,3 @@ def match_batch(
     buf = buf.at[rows, pos].set(vals, mode="drop")
     ovf = jnp.any(over_seq, axis=0) | (count > m_cap)
     return buf, jnp.minimum(count, m_cap), ovf
-
-
-def _expand(code_off, code_idx, codes, *, e_cap: int):
-    """Device-side CSR expansion: match codes -> filter positions.
-
-    Replaces the reference's per-subscriber ETS bag walk
-    (/root/reference/apps/emqx/src/emqx_broker.erl:639-673 dispatch
-    loop) with a segment gather: every output slot binary-searches its
-    owning code segment, so the host never loops per match.  Returns
-    ``(pos [B, e_cap] int32 (-1 pad), n [B], eovf [B])``; an overflowed
-    row's expansion is incomplete (host re-expands that topic).
-    """
-    b, m = codes.shape
-    valid = codes >= 0
-    c = jnp.where(valid, codes, 0)
-    seg = code_off[c + 1] - code_off[c]  # [B, M] segment lengths
-    seg = jnp.where(valid, seg, 0)
-    ends = jnp.cumsum(seg, axis=1)
-    starts = ends - seg
-    total = ends[:, -1]
-
-    e_idx = jnp.arange(e_cap, dtype=jnp.int32)
-    seg_id = jax.vmap(lambda en: jnp.searchsorted(en, e_idx, side="right"))(
-        ends
-    )  # [B, e_cap]: which code's segment covers output slot e
-    seg_id = jnp.clip(seg_id, 0, m - 1).astype(jnp.int32)
-    within = e_idx[None, :] - jnp.take_along_axis(starts, seg_id, axis=1)
-    code_sel = jnp.take_along_axis(c, seg_id, axis=1)
-    src = code_off[code_sel] + within
-    live = e_idx[None, :] < jnp.minimum(total, e_cap)[:, None]
-    pos = jnp.where(
-        live, code_idx[jnp.clip(src, 0, code_idx.shape[0] - 1)], -1
-    )
-    return pos, jnp.minimum(total, e_cap), total > e_cap
-
-
-@partial(
-    jax.jit,
-    static_argnames=("probes", "f_width", "m_cap", "e_cap"),
-)
-def match_expand(
-    ht_rows,
-    node_rows,
-    code_off,
-    code_idx,
-    tokens,
-    lengths,
-    dollar,
-    *,
-    probes: int,
-    f_width: int,
-    m_cap: int,
-    e_cap: int,
-):
-    """Fused full path: topic batch -> matched filter positions, one XLA
-    step, nothing ragged on the host.  Returns ``(pos [B, e_cap], n [B],
-    ovf [B])`` where ``ovf`` covers frontier, match-cap, and expansion
-    overflow (host fallback per row)."""
-    codes, _, ovf = match_batch(
-        ht_rows,
-        node_rows,
-        tokens,
-        lengths,
-        dollar,
-        probes=probes,
-        f_width=f_width,
-        m_cap=m_cap,
-    )
-    pos, n, eovf = _expand(code_off, code_idx, codes, e_cap=e_cap)
-    return pos, n, ovf | eovf

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..engine import MatchEngine
 from ..ops.automaton import Automaton, build_automaton
 from ..ops.dictionary import SENTINEL, TokenDict, encode_topics
 from ..ops.match_kernel import match_batch
@@ -74,6 +75,23 @@ class ShardedIndex:
     @property
     def n_shards(self) -> int:
         return len(self.shards)
+
+    @property
+    def n_nodes(self) -> int:
+        return max(a.n_nodes for a in self.shards)
+
+    @property
+    def offsets(self) -> List[int]:
+        """Global filter-position offset of each shard (shard-local
+        positions + offset = position into the concatenated fid list)."""
+        out, acc = [], 0
+        for a in self.shards:
+            out.append(acc)
+            acc += len(a.filters)
+        return out
+
+    def device_arrays(self) -> Tuple[np.ndarray, ...]:
+        return self.tables
 
 
 def build_sharded_index(
@@ -172,83 +190,120 @@ def sharded_match(
     return fn(ht_rows, node_rows, tokens, lengths, dollar)
 
 
-class ShardedMatchEngine:
-    """Host facade over a ShardedIndex on a mesh: encode, match, expand.
+class ShardedMatchEngine(MatchEngine):
+    """Mutable chip-sharded MatchEngine: same delta/tombstone/fallback
+    semantics as the single-chip engine (it IS one — VERDICT r1 "unify
+    the engines"), with the base snapshot partitioned over the mesh's
+    ``sub`` axis and matched by `sharded_match`.
 
-    The single-chip `MatchEngine` owns mutation/delta logic; this engine
-    is the scale-out read path used by the cluster router (SURVEY §5.8).
+    ``index``/``tdict`` may seed the engine with a pre-built
+    ShardedIndex (the read-only round-1 calling convention); mutation
+    via insert/delete plus rebuild works the same as `MatchEngine`.
     """
 
     def __init__(
         self,
         mesh: Mesh,
-        index: ShardedIndex,
-        tdict: TokenDict,
+        index: Optional[ShardedIndex] = None,
+        tdict: Optional[TokenDict] = None,
         f_width: int = 16,
         m_cap: int = 128,
+        max_levels: int = 16,
+        rebuild_threshold: int = 4096,
+        background_rebuild: bool = False,
     ) -> None:
+        super().__init__(
+            max_levels=index.max_levels if index is not None else max_levels,
+            f_width=f_width,
+            m_cap=m_cap,
+            rebuild_threshold=rebuild_threshold,
+            use_device=True,
+            background_rebuild=background_rebuild,
+        )
         self.mesh = mesh
-        self.index = index
-        self.tdict = tdict
-        self.f_width = f_width
-        self.m_cap = m_cap
-        k = index.n_shards
-        if k != mesh.shape["sub"]:
+        if tdict is not None:
+            self._tdict = tdict
+        if index is not None:
+            self._adopt(index)
+
+    @property
+    def index(self) -> Optional[ShardedIndex]:
+        return self._aut
+
+    def _adopt(self, index: ShardedIndex) -> None:
+        """Seed the engine with a pre-built index's FILTER SET.  The
+        filters re-enter through the normal insert routing (exact vs
+        wildcard vs deep) and one rebuild re-shards them with this
+        engine's own TokenDict — so deletion masking and topic encoding
+        stay consistent regardless of how the seed index was built."""
+        if index.n_shards != self.mesh.shape["sub"]:
             raise ValueError(
-                f"index has {k} shards but mesh 'sub' axis is "
-                f"{mesh.shape['sub']}"
+                f"index has {index.n_shards} shards but mesh 'sub' axis "
+                f"is {self.mesh.shape['sub']}"
             )
-        self._dev_tables = tuple(
-            jax.device_put(t, NamedSharding(mesh, P("sub")))
+        for a in index.shards:
+            for fid, ws in a.filters:
+                self.insert(T.join(ws), fid)
+        self.rebuild()
+
+    # -------------------------------------------- sharded build/match
+
+    def _build(
+        self, filters, hash_buckets: int = 0, device_put: bool = False
+    ):
+        from ..engine import make_fid_arr
+
+        index = build_sharded_index(
+            filters, self._tdict, self.mesh.shape["sub"], self.max_levels
+        )
+        fids = [fid for a in index.shards for fid, _ in a.filters]
+        dev = self._device_put(index) if device_put else None
+        return index, dev, make_fid_arr(fids), set(fids)
+
+    def _device_put(self, index: ShardedIndex):
+        return tuple(
+            jax.device_put(t, NamedSharding(self.mesh, P("sub")))
             for t in index.tables
         )
 
-    def match_batch(self, topics: Sequence[str]) -> List[Set[Hashable]]:
-        words = [T.words(t) for t in topics]
+    def match_batch_flat(self, words: Sequence[T.Words]):
+        from ..ops.automaton import expand_codes_host
+
+        index: ShardedIndex = self._aut
         tokens, lengths, dollar = encode_topics(
-            self.tdict, words, self.index.kernel_levels
+            self._tdict, words, index.kernel_levels
         )
-        # pad batch to a multiple of the pub axis
+        # pad batch to a pub-axis multiple (bounded shape set)
         b = tokens.shape[0]
         pub = self.mesh.shape["pub"]
-        bp = max(16, -(-b // pub) * pub)
+        bp = 16
+        while bp < b:
+            bp *= 2
         while bp % pub:
             bp += 1
         if bp != b:
             tokens = np.pad(tokens, ((0, bp - b), (0, 0)), constant_values=-4)
             lengths = np.pad(lengths, (0, bp - b))
             dollar = np.pad(dollar, (0, bp - b), constant_values=True)
-        codes, counts, ovf, _ = sharded_match(
+        codes, _, ovf, _ = sharded_match(
             self.mesh,
-            *self._dev_tables,
+            *self._device_tables(),
             tokens,
             lengths,
             dollar,
-            probes=self.index.probes,
+            probes=index.probes,
             f_width=self.f_width,
             m_cap=self.m_cap,
         )
-        codes = np.asarray(codes)
-        counts = np.asarray(counts)
-        ovf = np.asarray(ovf)
-        out: List[Set[Hashable]] = []
-        for i, ws in enumerate(words):
-            fids: Set[Hashable] = set()
-            fallback = False
-            for k, aut in enumerate(self.index.shards):
-                if ovf[k, i]:
-                    fallback = True
-                    break
-                for code in codes[k, i, : counts[k, i]]:
-                    for pos in aut.expand(int(code)):
-                        fids.add(aut.filters[pos][0])
-            out.append(self._host_match(ws) if fallback else fids)
-        return out
-
-    def _host_match(self, ws: T.Words) -> Set[Hashable]:
-        fids: Set[Hashable] = set()
-        for aut in self.index.shards:
-            for fid, fw in aut.filters:
-                if T.match_words(ws, fw):
-                    fids.add(fid)
-        return fids
+        codes = np.asarray(codes)[:, :b]
+        ovf_rows = np.asarray(ovf)[:, :b].any(axis=0)
+        rows_all: List[np.ndarray] = []
+        gpos_all: List[np.ndarray] = []
+        for k, (aut, off) in enumerate(zip(index.shards, index.offsets)):
+            r, p = expand_codes_host(aut.code_off, aut.code_idx, codes[k])
+            rows_all.append(r)
+            gpos_all.append(p + off)
+        rows = np.concatenate(rows_all) if rows_all else np.zeros(0, np.int64)
+        gpos = np.concatenate(gpos_all) if gpos_all else np.zeros(0, np.int64)
+        order = np.argsort(rows, kind="stable")
+        return rows[order], gpos[order], ovf_rows
