@@ -74,6 +74,10 @@ class Broker:
         from ..rules.engine import RuleEngine
 
         self.rules = RuleEngine(broker=self)
+        # ClusterNode installs itself here (the emqx_external_broker
+        # registration point, emqx_broker.erl:379-380): provides
+        # match_remote(topics) and forward(msg, nodes)
+        self.external = None
         # clientid -> (fire_at, will message): MQTT 5 delayed wills
         self._pending_wills: Dict[str, Tuple[float, Message]] = {}
 
@@ -168,16 +172,33 @@ class Broker:
             results.append(None)  # fill from dispatch below
         if live:
             matched = self.router.match_batch([m.topic for m in live])
-            it = iter(zip(live, matched))
+            remote: Optional[List[Set[str]]] = None
+            if self.external is not None:
+                remote = self.external.match_remote([m.topic for m in live])
+            j = 0
             for i, r in enumerate(results):
                 if r is None:
-                    msg, filters = next(it)
-                    results[i] = self._dispatch(msg, filters)
+                    results[i] = self._dispatch(live[j], matched[j])
+                    if remote is not None and remote[j]:
+                        self.metrics.inc("messages.forward")
+                        self.external.forward(live[j], remote[j])
+                    j += 1
         return [r if r is not None else 0 for r in results]
+
+    def dispatch_forwarded(self, msg: Message) -> int:
+        """Deliver a message forwarded in from a peer node: local
+        dispatch only — publish hooks, retained storage, and rules
+        already ran on the origin node, and re-forwarding would loop
+        (the reference's forward lands directly in `dispatch/2`,
+        emqx_broker.erl:408-420)."""
+        filters = self.router.match_batch([msg.topic])[0]
+        return self._dispatch(msg, filters, run_rules=False)
 
     # ----------------------------------------------------- dispatch
 
-    def _dispatch(self, msg: Message, filters: Set[str]) -> int:
+    def _dispatch(
+        self, msg: Message, filters: Set[str], run_rules: bool = True
+    ) -> int:
         """Fan a routed message out to subscriber sessions
         (emqx_broker:dispatch + do_dispatch, :408-420, :639-673).
         Rule hits come back from the same match step as a distinct fid
@@ -192,7 +213,7 @@ class Broker:
                 per_client.setdefault(clientid, []).append((msg, opts))
             for group in self.router.shared.groups_for(real):
                 self._shared_pick(msg, real, group, per_client)
-        if rule_ids:
+        if rule_ids and run_rules:
             self.rules.apply(msg, sorted(set(rule_ids)))
         if not per_client:
             self.metrics.inc("messages.dropped")

@@ -1,0 +1,21 @@
+"""Cluster layer: membership, route replication, publish forwarding.
+
+The reference clusters through three mechanisms (SURVEY §5.8): mria
+table replication (every node holds all routes), gen_rpc forwarding
+(emqx_broker.erl:387-406), and ekka membership/autoheal.  Here:
+
+  * `transport`  — length-prefixed JSON RPC over asyncio TCP between
+    nodes (the gen_rpc analogue, with a BPAPI-style proto version).
+  * `routes`     — full-replica cluster route table (filter -> nodes),
+    wildcard-indexed by its own MatchEngine so remote routing rides
+    the same TPU match step as local routing.
+  * `node`       — ClusterNode: wires a Broker into the cluster
+    (route-delta broadcast, forward, heartbeat membership, dead-node
+    route purge — emqx_router_helper:cleanup_routes).
+"""
+
+from .node import ClusterNode
+from .routes import ClusterRouteTable
+from .transport import NodeTransport
+
+__all__ = ["ClusterNode", "ClusterRouteTable", "NodeTransport"]

@@ -1,0 +1,380 @@
+"""ClusterNode: wires one Broker into a cluster of peers.
+
+Re-creates the reference's cluster spine on asyncio + the shared match
+engine:
+
+  * route-delta broadcast with batching — `emqx_router_syncer` batches
+    ops into single mria txns (/root/reference/apps/emqx/src/
+    emqx_router_syncer.erl:58,115-121); here local route add/del ops
+    buffer briefly and flush as one ``route_ops`` cast to every peer.
+  * publish forwarding — `emqx_broker:forward/4` async mode via
+    gen_rpc (emqx_broker.erl:387-406); here a ``forward`` cast carrying
+    the message to each node whose replica matches the topic.
+  * membership — ekka-style static seeds + heartbeats; a node missing
+    heartbeats past the timeout is declared down and its routes are
+    purged from the local replica (`emqx_router_helper` dead-node
+    cleanup, emqx_router.erl:316-323).  A node heard from again is
+    re-synced with a full route exchange.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..message import Message
+from .routes import ClusterRouteTable
+from .transport import NodeTransport, pack_bytes, unpack_bytes
+
+log = logging.getLogger("emqx_tpu.cluster")
+
+
+def _props_to_wire(props: Dict[str, Any]) -> Dict[str, Any]:
+    """MQTT 5 properties JSON-safely: bytes values (correlation_data,
+    authentication_data) wrap as {"$b64": ...}."""
+    out: Dict[str, Any] = {}
+    for k, v in props.items():
+        if isinstance(v, (bytes, bytearray)):
+            out[k] = {"$b64": pack_bytes(bytes(v))}
+        elif isinstance(v, list):
+            out[k] = [
+                list(p) if isinstance(p, tuple) else p for p in v
+            ]  # user_property pairs
+        else:
+            out[k] = v
+    return out
+
+
+def _props_from_wire(props: Dict[str, Any]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for k, v in props.items():
+        if isinstance(v, dict) and set(v) == {"$b64"}:
+            out[k] = unpack_bytes(v["$b64"])
+        elif isinstance(v, list):
+            out[k] = [tuple(p) if isinstance(p, list) else p for p in v]
+        else:
+            out[k] = v
+    return out
+
+
+def msg_to_wire(msg: Message) -> Dict[str, Any]:
+    return {
+        "topic": msg.topic,
+        "payload": pack_bytes(msg.payload),
+        "qos": msg.qos,
+        "retain": msg.retain,
+        "from_client": msg.from_client,
+        "from_username": msg.from_username,
+        "mid": pack_bytes(msg.mid),
+        "timestamp": msg.timestamp,
+        "properties": _props_to_wire(msg.properties),
+        "sys": msg.sys,
+        "dup": msg.dup,
+    }
+
+
+def msg_from_wire(obj: Dict[str, Any]) -> Message:
+    return Message(
+        topic=obj["topic"],
+        payload=unpack_bytes(obj["payload"]),
+        qos=obj.get("qos", 0),
+        retain=obj.get("retain", False),
+        from_client=obj.get("from_client", ""),
+        from_username=obj.get("from_username"),
+        mid=unpack_bytes(obj["mid"]),
+        timestamp=obj.get("timestamp", 0.0),
+        properties=_props_from_wire(obj.get("properties") or {}),
+        sys=obj.get("sys", False),
+        dup=obj.get("dup", False),
+    )
+
+
+class ClusterNode:
+    def __init__(
+        self,
+        name: str,
+        broker,
+        bind: str = "127.0.0.1",
+        port: int = 0,
+        heartbeat_interval: float = 0.5,
+        down_after: float = 2.0,
+        flush_interval: float = 0.005,
+        flush_max: int = 1000,
+    ) -> None:
+        self.name = name
+        self.broker = broker
+        self.transport = NodeTransport(name, bind, port)
+        self.routes = ClusterRouteTable()
+        self.heartbeat_interval = heartbeat_interval
+        self.down_after = down_after
+        self.flush_interval = flush_interval
+        self.flush_max = flush_max
+        # peers: name -> (host, port); alive tracking by last heartbeat
+        self._peers: Dict[str, Tuple[str, int]] = {}
+        self._last_seen: Dict[str, float] = {}
+        self._down: set = set()
+        self._synced: set = set()  # peers whose full sync succeeded
+        self._pending_ops: List[Tuple[str, str]] = []  # (op, filter)
+        self._flush_wakeup = asyncio.Event()
+        self._tasks: List[asyncio.Task] = []
+        self._fwd_tasks: set = set()
+        self._started = False
+
+        self.transport.on("route_ops", self._handle_route_ops)
+        self.transport.on("forward", self._handle_forward)
+        self.transport.on("heartbeat", self._handle_heartbeat)
+        self.transport.on("sync", self._handle_sync)
+
+        # wire into the broker: route-change notifications + forward
+        broker.router.on_route_added = self._route_added
+        broker.router.on_route_removed = self._route_removed
+        broker.external = self
+
+    # ------------------------------------------------------- lifecycle
+
+    async def start(self, seeds: Optional[List[Tuple[str, str, int]]] = None):
+        """Start the transport and join via seed nodes (ekka static
+        discovery analogue): exchange full route sets with each seed."""
+        await self.transport.start()
+        self._started = True
+        for name, host, port in seeds or ():
+            self.add_peer(name, host, port)
+        loop = asyncio.get_running_loop()
+        self._tasks = [
+            loop.create_task(self._flush_loop()),
+            loop.create_task(self._heartbeat_loop()),
+        ]
+        for name in list(self._peers):
+            await self._sync_with(name)
+
+    async def stop(self) -> None:
+        self._started = False
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except asyncio.CancelledError:
+                pass
+        self._tasks = []
+        await self.transport.stop()
+
+    def add_peer(self, name: str, host: str, port: int) -> None:
+        if name == self.name:
+            return
+        self._peers[name] = (host, port)
+        self.transport.add_peer(name, host, port)
+        self._last_seen.setdefault(name, time.monotonic())
+
+    @property
+    def port(self) -> int:
+        return self.transport.port
+
+    def peers_alive(self) -> List[str]:
+        return [p for p in self._peers if p not in self._down]
+
+    # ----------------------------------------------- route replication
+
+    def _route_added(self, flt: str) -> None:
+        self.routes.add_route(flt, self.name)
+        self._queue_op("add", flt)
+
+    def _route_removed(self, flt: str) -> None:
+        self.routes.delete_route(flt, self.name)
+        self._queue_op("del", flt)
+
+    def _queue_op(self, op: str, flt: str) -> None:
+        if not self._started:
+            return
+        self._pending_ops.append((op, flt))
+        if len(self._pending_ops) >= self.flush_max:
+            self._flush_wakeup.set()
+
+    async def _flush_loop(self) -> None:
+        while True:
+            try:
+                await asyncio.wait_for(
+                    self._flush_wakeup.wait(), self.flush_interval
+                )
+            except asyncio.TimeoutError:
+                pass
+            self._flush_wakeup.clear()
+            if not self._pending_ops:
+                continue
+            ops, self._pending_ops = self._pending_ops, []
+            obj = {"type": "route_ops", "node": self.name, "ops": ops}
+            await asyncio.gather(
+                *(
+                    self.transport.cast(p, obj)
+                    for p in self.peers_alive()
+                ),
+                return_exceptions=True,
+            )
+
+    async def _handle_route_ops(self, peer: str, obj: Dict) -> None:
+        node = obj.get("node", peer)
+        for op, flt in obj.get("ops", ()):
+            if op == "add":
+                self.routes.add_route(flt, node)
+            else:
+                self.routes.delete_route(flt, node)
+
+    async def _sync_with(self, peer: str) -> None:
+        """Full bidirectional route exchange (the mria bootstrap copy a
+        joining node gets).  Failure is retried from the heartbeat loop
+        until it succeeds — a joiner must not silently miss pre-existing
+        routes."""
+        reply = await self.transport.call(
+            peer,
+            {
+                "type": "sync",
+                "node": self.name,
+                "listen": [self.transport.bind, self.transport.port],
+                "routes": self._local_routes(),
+            },
+        )
+        if reply is None:
+            self._synced.discard(peer)
+            return
+        self._mark_alive(peer)
+        self._synced.add(peer)
+        for entry in reply.get("routes", ()):
+            for node in entry["nodes"]:
+                if node != self.name:
+                    self.routes.add_route(entry["topic"], node)
+
+    async def _handle_sync(self, peer: str, obj: Dict) -> Dict:
+        node = obj.get("node", peer)
+        self._learn_peer(node, obj.get("listen"))
+        self._mark_alive(node)
+        # peer's local routes replace whatever we had for it
+        self.routes.purge_node(node)
+        for flt in obj.get("routes", ()):
+            self.routes.add_route(flt, node)
+        return {"routes": self.routes.all_routes()}
+
+    def _learn_peer(self, node: str, listen) -> None:
+        """Adopt a peer advertised in a sync/heartbeat message so
+        membership is symmetric without manual add_peer on both sides."""
+        if node != self.name and node not in self._peers and listen:
+            self.add_peer(node, listen[0], int(listen[1]))
+
+    def _local_routes(self) -> List[str]:
+        return sorted(self.routes.routes_of(self.name))
+
+    # ----------------------------------------------------- forwarding
+
+    def match_remote(self, topics: List[str]) -> List[set]:
+        """Nodes (other than self) with matching routes, per topic."""
+        return self.routes.match_nodes(topics, exclude=self.name)
+
+    def forward(self, msg: Message, nodes: set) -> None:
+        """Async-forward one message to each node (fire-and-forget cast,
+        rpc.mode=async: emqx_broker.erl:387-391).  Tasks are held in a
+        strong-ref set so they can't be GC'd mid-send, and failures are
+        counted + logged rather than lost."""
+        if not nodes:
+            return
+        obj = {"type": "forward", "node": self.name, "msg": msg_to_wire(msg)}
+        loop = asyncio.get_running_loop()
+        for node in nodes:
+            if node in self._down:
+                continue
+            task = loop.create_task(self._forward_one(node, obj))
+            self._fwd_tasks.add(task)
+            task.add_done_callback(self._fwd_done)
+
+    def _fwd_done(self, task: asyncio.Task) -> None:
+        self._fwd_tasks.discard(task)
+        if not task.cancelled() and task.exception() is not None:
+            self.broker.metrics.inc("messages.forward.failed")
+            log.error(
+                "%s: forward task crashed", self.name, exc_info=task.exception()
+            )
+
+    async def _forward_one(self, node: str, obj: Dict) -> None:
+        ok = await self.transport.cast(node, obj)
+        if not ok:
+            self.broker.metrics.inc("messages.forward.failed")
+
+    async def _handle_forward(self, peer: str, obj: Dict) -> None:
+        msg = msg_from_wire(obj["msg"])
+        self.broker.metrics.inc("messages.forward.received")
+        # dispatch-only: hooks/retain/rules already ran on the origin
+        # node (the reference's forward lands in dispatch/2 directly,
+        # emqx_broker.erl:408-420)
+        self.broker.dispatch_forwarded(msg)
+
+    # ----------------------------------------------------- membership
+
+    async def _heartbeat_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.heartbeat_interval)
+            obj = {
+                "type": "heartbeat",
+                "node": self.name,
+                "listen": [self.transport.bind, self.transport.port],
+            }
+            # bound each cast so one blackholed peer can't stall the
+            # loop (and thereby starve heartbeats to healthy peers)
+            await asyncio.gather(
+                *(
+                    asyncio.wait_for(
+                        self.transport.cast(p, obj),
+                        self.heartbeat_interval * 4,
+                    )
+                    for p in self._peers
+                ),
+                return_exceptions=True,
+            )
+            now = time.monotonic()
+            for p, seen in list(self._last_seen.items()):
+                if p in self._down:
+                    continue
+                if now - seen > self.down_after:
+                    self._node_down(p)
+            # retry any initial sync that failed (peer was not yet up)
+            for p in self.peers_alive():
+                if p not in self._synced:
+                    await self._sync_with(p)
+
+    async def _handle_heartbeat(self, peer: str, obj: Dict) -> None:
+        node = obj.get("node", peer)
+        self._learn_peer(node, obj.get("listen"))
+        if node not in self._peers:
+            return
+        came_back = node in self._down
+        self._mark_alive(node)
+        if came_back:
+            log.info("%s: node %s is back, resyncing routes", self.name, node)
+            await self._sync_with(node)
+
+    def _mark_alive(self, node: str) -> None:
+        self._last_seen[node] = time.monotonic()
+        self._down.discard(node)
+
+    def _node_down(self, node: str) -> None:
+        """Declare a peer dead: purge its replica routes so publishes
+        stop forwarding into the void."""
+        self._down.add(node)
+        self._synced.discard(node)
+        purged = self.routes.purge_node(node)
+        self.transport.drop_peer(node)
+        self.broker.metrics.inc("cluster.nodes.down")
+        self.broker.hooks.run("node.down", node)
+        log.warning(
+            "%s: node %s down, purged %d routes", self.name, node, purged
+        )
+
+    # ------------------------------------------------------ introspection
+
+    def info(self) -> Dict[str, Any]:
+        return {
+            "node": self.name,
+            "peers": sorted(self._peers),
+            "alive": sorted(self.peers_alive()),
+            "down": sorted(self._down),
+            "routes": len(self.routes),
+        }

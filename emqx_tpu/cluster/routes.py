@@ -1,0 +1,89 @@
+"""Cluster route table: full replica of (filter -> nodes) per node.
+
+The reference replicates `?ROUTE_TAB`/`?ROUTE_TAB_FILTERS` to every
+node via mria so route lookup is always node-local
+(/root/reference/apps/emqx/src/emqx_router.erl:133-162); cross-node
+consistency comes from broadcasting route ops.  Same shape here: each
+node applies every peer's route deltas to its replica, and the replica
+indexes wildcard filters in its own MatchEngine so the remote-routing
+lookup is the same batched device step as local routing.
+
+fid convention: the filter string itself (one engine entry per filter,
+whatever number of nodes subscribe to it).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set
+
+from ..engine import MatchEngine
+
+
+class ClusterRouteTable:
+    def __init__(self, engine: Optional[MatchEngine] = None) -> None:
+        self.engine = engine or MatchEngine()
+        # filter -> set of node names holding local subscribers for it
+        self._nodes_by_filter: Dict[str, Set[str]] = {}
+        self._filters_by_node: Dict[str, Set[str]] = {}
+
+    def add_route(self, flt: str, node: str) -> None:
+        nodes = self._nodes_by_filter.get(flt)
+        if nodes is None:
+            nodes = self._nodes_by_filter[flt] = set()
+            self.engine.insert(flt, flt)
+        nodes.add(node)
+        self._filters_by_node.setdefault(node, set()).add(flt)
+
+    def delete_route(self, flt: str, node: str) -> None:
+        nodes = self._nodes_by_filter.get(flt)
+        if nodes is None:
+            return
+        nodes.discard(node)
+        if not nodes:
+            del self._nodes_by_filter[flt]
+            self.engine.delete(flt)
+        flts = self._filters_by_node.get(node)
+        if flts is not None:
+            flts.discard(flt)
+            if not flts:
+                del self._filters_by_node[node]
+
+    def purge_node(self, node: str) -> int:
+        """Drop every route of a dead node (emqx_router_helper's
+        cleanup_routes, emqx_router.erl:316-323)."""
+        flts = list(self._filters_by_node.get(node, ()))
+        for flt in flts:
+            self.delete_route(flt, node)
+        return len(flts)
+
+    def routes_of(self, node: str) -> Set[str]:
+        return set(self._filters_by_node.get(node, ()))
+
+    def nodes_for(self, flt: str) -> Set[str]:
+        return set(self._nodes_by_filter.get(flt, ()))
+
+    def match_nodes(
+        self, topics: Sequence[str], exclude: Optional[str] = None
+    ) -> List[Set[str]]:
+        """Per topic, the set of nodes with at least one matching route
+        (the aggregation emqx_broker:aggre does over match_routes,
+        emqx_broker.erl:339-377)."""
+        matched = self.engine.match_batch(topics)
+        out: List[Set[str]] = []
+        for filters in matched:
+            nodes: Set[str] = set()
+            for flt in filters:
+                nodes |= self._nodes_by_filter.get(flt, ())
+            if exclude is not None:
+                nodes.discard(exclude)
+            out.append(nodes)
+        return out
+
+    def all_routes(self) -> List[Dict[str, object]]:
+        return [
+            {"topic": flt, "nodes": sorted(nodes)}
+            for flt, nodes in self._nodes_by_filter.items()
+        ]
+
+    def __len__(self) -> int:
+        return len(self._nodes_by_filter)

@@ -1,0 +1,261 @@
+"""Inter-node RPC transport: length-prefixed JSON over asyncio TCP.
+
+The gen_rpc analogue (/root/reference/apps/emqx/src/emqx_rpc.erl:82-119
+wraps gen_rpc casts/calls): one listening server per node, one outgoing
+connection per peer, messages are JSON objects with a ``type`` field
+dispatched to registered handlers.  Casts are fire-and-forget (ordered
+per peer, like gen_rpc's per-key ordered casts); calls carry a
+``call_id`` and await a ``reply``.
+
+Versioned like the reference's BPAPI (proto/*_proto_vN modules +
+emqx_bpapi static checks): the hello handshake carries PROTO_VER and a
+node refuses peers with an incompatible major version.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import logging
+from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
+
+log = logging.getLogger("emqx_tpu.cluster.transport")
+
+PROTO_VER = (1, 0)
+
+Handler = Callable[[str, Dict[str, Any]], Awaitable[Optional[Dict[str, Any]]]]
+
+
+def pack_bytes(b: bytes) -> str:
+    return base64.b64encode(b).decode("ascii")
+
+
+def unpack_bytes(s: str) -> bytes:
+    return base64.b64decode(s.encode("ascii"))
+
+
+class PeerLink:
+    """One outgoing connection to a peer, with lazy (re)connect and
+    per-peer ordered sends."""
+
+    def __init__(
+        self,
+        self_node: str,
+        addr: Tuple[str, int],
+        connect_timeout: float = 2.0,
+    ) -> None:
+        self.self_node = self_node
+        self.addr = addr
+        self.connect_timeout = connect_timeout
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._lock = asyncio.Lock()
+        self._calls: Dict[int, asyncio.Future] = {}
+        self._call_seq = 0
+        self._pump: Optional[asyncio.Task] = None
+
+    async def _ensure(self) -> None:
+        if self._writer is not None and not self._writer.is_closing():
+            return
+        # bounded connect: a blackholed peer must fail fast, not hang
+        # the caller for the kernel SYN timeout
+        try:
+            self._reader, self._writer = await asyncio.wait_for(
+                asyncio.open_connection(*self.addr), self.connect_timeout
+            )
+        except asyncio.TimeoutError as exc:
+            raise ConnectionError(f"connect to {self.addr} timed out") from exc
+        await self._send_obj(
+            {"type": "hello", "node": self.self_node, "ver": list(PROTO_VER)}
+        )
+        self._pump = asyncio.get_running_loop().create_task(self._read_loop())
+
+    async def _read_loop(self) -> None:
+        try:
+            while self._reader is not None:
+                obj = await read_frame(self._reader)
+                if obj is None:
+                    break
+                if obj.get("type") == "reply":
+                    fut = self._calls.pop(obj.get("call_id"), None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(obj.get("result"))
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            for fut in self._calls.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError("peer link lost"))
+            self._calls.clear()
+
+    async def _send_obj(self, obj: Dict[str, Any]) -> None:
+        data = json.dumps(obj, separators=(",", ":")).encode()
+        assert self._writer is not None
+        self._writer.write(len(data).to_bytes(4, "big") + data)
+        await self._writer.drain()
+
+    async def cast(self, obj: Dict[str, Any]) -> bool:
+        """Fire-and-forget; returns False when the peer is unreachable
+        (the caller decides whether that matters — async forward mode,
+        emqx_broker.erl:387-391 forward_async)."""
+        async with self._lock:
+            try:
+                await self._ensure()
+                await self._send_obj(obj)
+                return True
+            except (ConnectionError, OSError):
+                self._drop()
+                return False
+
+    async def call(
+        self, obj: Dict[str, Any], timeout: float = 5.0
+    ) -> Optional[Dict[str, Any]]:
+        async with self._lock:
+            try:
+                await self._ensure()
+                self._call_seq += 1
+                cid = self._call_seq
+                obj = dict(obj, call_id=cid)
+                fut: asyncio.Future = (
+                    asyncio.get_running_loop().create_future()
+                )
+                self._calls[cid] = fut
+                await self._send_obj(obj)
+            except (ConnectionError, OSError):
+                self._drop()
+                return None
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except (asyncio.TimeoutError, ConnectionError):
+            return None
+
+    def _drop(self) -> None:
+        if self._pump is not None:
+            self._pump.cancel()
+            self._pump = None
+        if self._writer is not None:
+            self._writer.close()
+        self._writer = None
+        self._reader = None
+
+    def close(self) -> None:
+        self._drop()
+
+
+async def read_frame(reader: asyncio.StreamReader) -> Optional[Dict]:
+    try:
+        head = await reader.readexactly(4)
+    except (asyncio.IncompleteReadError, ConnectionError):
+        return None
+    n = int.from_bytes(head, "big")
+    if n > 64 * 1024 * 1024:
+        raise ConnectionError(f"oversized cluster frame: {n}")
+    try:
+        data = await reader.readexactly(n)
+    except (asyncio.IncompleteReadError, ConnectionError):
+        return None
+    return json.loads(data)
+
+
+class NodeTransport:
+    """The node's RPC endpoint: a listening server plus peer links."""
+
+    def __init__(self, node: str, bind: str = "127.0.0.1", port: int = 0):
+        self.node = node
+        self.bind = bind
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._links: Dict[str, PeerLink] = {}
+        self._handlers: Dict[str, Handler] = {}
+        self._peer_addrs: Dict[str, Tuple[str, int]] = {}
+
+    def on(self, mtype: str, handler: Handler) -> None:
+        self._handlers[mtype] = handler
+
+    def add_peer(self, node: str, host: str, port: int) -> None:
+        self._peer_addrs[node] = (host, port)
+
+    def drop_peer(self, node: str) -> None:
+        link = self._links.pop(node, None)
+        if link is not None:
+            link.close()
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._on_conn, self.bind, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        for link in self._links.values():
+            link.close()
+        self._links.clear()
+
+    def _link(self, node: str) -> Optional[PeerLink]:
+        link = self._links.get(node)
+        if link is None:
+            addr = self._peer_addrs.get(node)
+            if addr is None:
+                return None
+            link = self._links[node] = PeerLink(self.node, addr)
+        return link
+
+    async def cast(self, node: str, obj: Dict[str, Any]) -> bool:
+        link = self._link(node)
+        return False if link is None else await link.cast(obj)
+
+    async def call(
+        self, node: str, obj: Dict[str, Any], timeout: float = 5.0
+    ) -> Optional[Dict[str, Any]]:
+        link = self._link(node)
+        return None if link is None else await link.call(obj, timeout)
+
+    async def _on_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        peer = "?"
+        try:
+            hello = await read_frame(reader)
+            if not hello or hello.get("type") != "hello":
+                return
+            ver = tuple(hello.get("ver", ()))
+            if not ver or ver[0] != PROTO_VER[0]:
+                log.warning(
+                    "rejecting peer %s: proto %s != %s",
+                    hello.get("node"),
+                    ver,
+                    PROTO_VER,
+                )
+                return
+            peer = hello.get("node", "?")
+            while True:
+                obj = await read_frame(reader)
+                if obj is None:
+                    return
+                handler = self._handlers.get(obj.get("type", ""))
+                if handler is None:
+                    log.warning("no handler for %r from %s", obj.get("type"), peer)
+                    continue
+                result = await handler(peer, obj)
+                if "call_id" in obj:
+                    reply = json.dumps(
+                        {
+                            "type": "reply",
+                            "call_id": obj["call_id"],
+                            "result": result,
+                        },
+                        separators=(",", ":"),
+                    ).encode()
+                    writer.write(len(reply).to_bytes(4, "big") + reply)
+                    await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        except Exception:
+            log.exception("cluster connection from %s crashed", peer)
+        finally:
+            writer.close()

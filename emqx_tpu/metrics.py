@@ -63,7 +63,10 @@ METRICS = (
     "messages.dropped.expired",
     "messages.dropped.queue_full",
     "messages.forward",
+    "messages.forward.failed",
+    "messages.forward.received",
     "messages.retained",
+    "cluster.nodes.down",
     "delivery.dropped",
     "delivery.dropped.no_local",
     "delivery.dropped.too_large",

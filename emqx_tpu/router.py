@@ -31,6 +31,11 @@ class Router:
     ) -> None:
         self.engine = engine or MatchEngine()
         self.shared = shared or SharedSubManager()
+        # cluster hooks: fired when a real filter gains its first local
+        # subscriber / loses its last one (the sync_route add/delete
+        # points, emqx_broker.erl:691-721) — ClusterNode broadcasts them
+        self.on_route_added = None
+        self.on_route_removed = None
         # real filter -> {clientid -> SubOpts} (direct, non-shared)
         self._subs: Dict[str, Dict[str, SubOpts]] = {}
         # real filter -> {(group, clientid) -> SubOpts} (shared)
@@ -54,6 +59,8 @@ class Router:
             ] = opts
             if need_route and real not in self._subs:
                 self.engine.insert(real, real)
+                if self.on_route_added is not None:
+                    self.on_route_added(real)
         else:
             real = flt
             subs = self._subs.get(real)
@@ -61,6 +68,8 @@ class Router:
                 subs = self._subs[real] = {}
                 if real not in self._shared_opts or not self._shared_opts[real]:
                     self.engine.insert(real, real)
+                    if self.on_route_added is not None:
+                        self.on_route_added(real)
             subs[clientid] = opts
         self._by_client.setdefault(clientid, set()).add(flt)
 
@@ -95,7 +104,8 @@ class Router:
 
     def _maybe_drop_route(self, real: str) -> None:
         if real not in self._subs and real not in self._shared_opts:
-            self.engine.delete(real)
+            if self.engine.delete(real) and self.on_route_removed is not None:
+                self.on_route_removed(real)
 
     def cleanup_client(self, clientid: str) -> None:
         """Drop every subscription of a dead client (the

@@ -1,0 +1,230 @@
+"""Cluster-layer tests: multi-node brokers in one process over loopback
+TCP — the `emqx_cth_cluster` pattern (peer nodes on the same host,
+/root/reference/apps/emqx/test/emqx_cth_cluster.erl:44,334-349) without
+spawning OS processes (pytest drives its own event loop)."""
+
+import asyncio
+
+from emqx_tpu.broker.listener import BrokerServer
+from emqx_tpu.cluster import ClusterNode
+from emqx_tpu.config import BrokerConfig
+from emqx_tpu.message import Message
+from mqtt_client import TestClient
+
+
+FAST = dict(heartbeat_interval=0.05, down_after=0.25, flush_interval=0.002)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def start_node(name, seeds=()):
+    cfg = BrokerConfig()
+    cfg.listeners[0].port = 0
+    srv = BrokerServer(cfg)
+    await srv.start()
+    node = ClusterNode(name, srv.broker, **FAST)
+    await node.start(seeds=list(seeds))
+    return srv, node
+
+
+async def stop_node(srv, node):
+    await node.stop()
+    await srv.stop()
+
+
+async def settle(t=0.05):
+    await asyncio.sleep(t)
+
+
+def test_cross_node_pubsub():
+    async def t():
+        s1, n1 = await start_node("n1")
+        s2, n2 = await start_node("n2", seeds=[("n1", "127.0.0.1", n1.port)])
+        try:
+            sub = TestClient(s1.listeners[0].port, "subA")
+            await sub.connect()
+            await sub.subscribe("fleet/+/temp", qos=1)
+            await settle()  # route delta flush -> n2 replica
+
+            assert n2.routes.nodes_for("fleet/+/temp") == {"n1"}
+
+            pub = TestClient(s2.listeners[0].port, "pubB")
+            await pub.connect()
+            await pub.publish("fleet/v1/temp", b"22C", qos=1)
+            msg = await sub.recv_publish(timeout=5)
+            assert msg.topic == "fleet/v1/temp" and msg.payload == b"22C"
+            await sub.disconnect()
+            await pub.disconnect()
+        finally:
+            await stop_node(s2, n2)
+            await stop_node(s1, n1)
+
+    run(t())
+
+
+def test_route_replication_and_removal():
+    async def t():
+        s1, n1 = await start_node("n1")
+        s2, n2 = await start_node("n2", seeds=[("n1", "127.0.0.1", n1.port)])
+        try:
+            c = TestClient(s1.listeners[0].port, "c1")
+            await c.connect()
+            await c.subscribe("a/b", qos=0)
+            await c.subscribe("x/#", qos=0)
+            await settle()
+            assert n2.routes.nodes_for("a/b") == {"n1"}
+            assert n2.routes.nodes_for("x/#") == {"n1"}
+
+            await c.unsubscribe("a/b")
+            await settle()
+            assert n2.routes.nodes_for("a/b") == set()
+            assert n2.routes.nodes_for("x/#") == {"n1"}
+            await c.disconnect()
+            await settle()  # session cleanup drops the last route too
+            assert n2.routes.nodes_for("x/#") == set()
+        finally:
+            await stop_node(s2, n2)
+            await stop_node(s1, n1)
+
+    run(t())
+
+
+def test_late_join_gets_existing_routes():
+    async def t():
+        s1, n1 = await start_node("n1")
+        try:
+            c = TestClient(s1.listeners[0].port, "c1")
+            await c.connect()
+            await c.subscribe("warehouse/+/door", qos=0)
+            await settle()
+
+            s2, n2 = await start_node(
+                "n2", seeds=[("n1", "127.0.0.1", n1.port)]
+            )
+            try:
+                # the sync exchange, not delta broadcast, carried this
+                assert n2.routes.nodes_for("warehouse/+/door") == {"n1"}
+
+                pub = TestClient(s2.listeners[0].port, "p1")
+                await pub.connect()
+                await pub.publish("warehouse/7/door", b"open", qos=0)
+                msg = await c.recv_publish(timeout=5)
+                assert msg.payload == b"open"
+                await pub.disconnect()
+            finally:
+                await stop_node(s2, n2)
+            await c.disconnect()
+        finally:
+            await stop_node(s1, n1)
+
+    run(t())
+
+
+def test_dead_node_routes_purged():
+    async def t():
+        s1, n1 = await start_node("n1")
+        s2, n2 = await start_node("n2", seeds=[("n1", "127.0.0.1", n1.port)])
+        n1.add_peer("n2", "127.0.0.1", n2.port)
+        try:
+            c2 = TestClient(s2.listeners[0].port, "c2")
+            await c2.connect()
+            await c2.subscribe("dead/+", qos=0)
+            await settle()
+            assert n1.routes.nodes_for("dead/+") == {"n2"}
+
+            # kill n2 without cleanup: n1 must notice and purge
+            await c2.close()
+            await stop_node(s2, n2)
+            for _ in range(40):
+                if "n2" in n1._down:
+                    break
+                await asyncio.sleep(0.05)
+            assert "n2" in n1._down
+            assert n1.routes.nodes_for("dead/+") == set()
+            # publishing on n1 no longer forwards (and does not error)
+            s1.broker.publish_many([Message(topic="dead/x", payload=b"z")])
+        finally:
+            await stop_node(s1, n1)
+
+    run(t())
+
+
+def test_three_node_fanout():
+    async def t():
+        s1, n1 = await start_node("n1")
+        seeds = [("n1", "127.0.0.1", n1.port)]
+        s2, n2 = await start_node("n2", seeds=seeds)
+        s3, n3 = await start_node(
+            "n3", seeds=seeds + [("n2", "127.0.0.1", n2.port)]
+        )
+        n1.add_peer("n2", "127.0.0.1", n2.port)
+        try:
+            subs = []
+            for srv, cid in ((s1, "sA"), (s2, "sB")):
+                c = TestClient(srv.listeners[0].port, cid)
+                await c.connect()
+                await c.subscribe("news/#", qos=0)
+                subs.append(c)
+            await settle()
+
+            pub = TestClient(s3.listeners[0].port, "p3")
+            await pub.connect()
+            await pub.publish("news/today", b"hi", qos=0)
+            for c in subs:
+                msg = await c.recv_publish(timeout=5)
+                assert msg.payload == b"hi"
+            await pub.disconnect()
+            for c in subs:
+                await c.disconnect()
+        finally:
+            await stop_node(s3, n3)
+            await stop_node(s2, n2)
+            await stop_node(s1, n1)
+
+    run(t())
+
+
+def test_forward_preserves_bytes_properties_and_skips_side_effects():
+    """Code-review r2: bytes-valued MQTT 5 properties must survive the
+    JSON transport, and a forwarded message must not re-run publish
+    hooks/retain/rules on the receiving node."""
+
+    async def t():
+        s1, n1 = await start_node("n1")
+        s2, n2 = await start_node("n2", seeds=[("n1", "127.0.0.1", n1.port)])
+        try:
+            hook_topics = []
+            s1.broker.hooks.add(
+                "message.publish", lambda m: hook_topics.append(m.topic) or m
+            )
+            sub = TestClient(s1.listeners[0].port, "subA")
+            await sub.connect()
+            await sub.subscribe("req/+", qos=1)
+            await settle()
+
+            pub = TestClient(s2.listeners[0].port, "pubB")
+            await pub.connect()
+            await pub.publish(
+                "req/1",
+                b"ask",
+                qos=1,
+                properties={
+                    "correlation_data": b"\x00\x01\xff",
+                    "response_topic": "resp/1",
+                },
+            )
+            msg = await sub.recv_publish(timeout=5)
+            assert msg.properties.get("correlation_data") == b"\x00\x01\xff"
+            assert msg.properties.get("response_topic") == "resp/1"
+            # publish hooks ran on the origin node only
+            assert "req/1" not in hook_topics
+            assert s1.broker.metrics.val("messages.forward.received") == 1
+            await sub.disconnect()
+            await pub.disconnect()
+        finally:
+            await stop_node(s2, n2)
+            await stop_node(s1, n1)
+
+    run(t())
