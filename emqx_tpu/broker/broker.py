@@ -78,8 +78,20 @@ class Broker:
         # registration point, emqx_broker.erl:379-380): provides
         # match_remote(topics) and forward(msg, nodes)
         self.external = None
+        # durable storage + persistent sessions (emqx_persistent_message
+        # gate + emqx_persistent_session_ds restore-on-reconnect)
+        self.durable = None
+        if self.config.durable.enable:
+            from ..ds.persist import DurableSessions
+
+            self.durable = DurableSessions(
+                self.config.durable.data_dir,
+                n_streams=self.config.durable.n_streams,
+                store_qos0=self.config.durable.store_qos0,
+            )
         # clientid -> (fire_at, will message): MQTT 5 delayed wills
         self._pending_wills: Dict[str, Tuple[float, Message]] = {}
+        self._last_ds_sync = time.time()
 
     # -------------------------------------------------- session setup
 
@@ -115,6 +127,11 @@ class Broker:
 
     def _session_discarded(self, session: Session) -> None:
         self.metrics.inc("session.discarded")
+        if self.durable is not None and session.expiry_interval > 0:
+            # the persistence gate must not outlive the session, or the
+            # DS log grows forever for a subscriber that can never return
+            self.durable.remove_session_filters(session.subscriptions)
+            self.durable.discard(session.clientid)
         self.router.cleanup_client(session.clientid)
         self.hooks.run("session.discarded", session.clientid)
 
@@ -126,6 +143,16 @@ class Broker:
         """Register the subscription; returns retained messages to
         replay per retain_handling ([MQTT-3.3.1-9..11])."""
         self.router.subscribe(clientid, flt, opts)
+        # gate refcount: only a NEW subscription counts (an options
+        # refresh re-subscribe must not inflate it past drainability)
+        if (
+            self.durable is not None
+            and opts.share_group is None
+            and is_new_sub
+        ):
+            session = self.cm.lookup(clientid)
+            if session is not None and session.expiry_interval > 0:
+                self.durable.add_filter(flt)
         self.hooks.run("session.subscribed", clientid, flt, opts)
         self.stats.set("subscriptions.count", self._sub_count())
         if opts.share_group is not None:
@@ -138,12 +165,78 @@ class Broker:
     def unsubscribe(self, clientid: str, flt: str) -> bool:
         ok = self.router.unsubscribe(clientid, flt)
         if ok:
+            if self.durable is not None and T.parse_share(flt) is None:
+                session = self.cm.lookup(clientid)
+                if session is not None and session.expiry_interval > 0:
+                    self.durable.remove_filter(flt)
             self.hooks.run("session.unsubscribed", clientid, flt)
             self.stats.set("subscriptions.count", self._sub_count())
         return ok
 
     def _sub_count(self) -> int:
         return self.router.subscription_count()
+
+    # --------------------------------------------- session open/close
+
+    def open_session(
+        self, clean_start: bool, clientid: str, channel, **session_kwargs
+    ) -> Tuple[Session, bool]:
+        """`emqx_cm:open_session` plus durable restore: when the broker
+        restarted and the in-memory session is gone, a clean_start=false
+        reconnect rebuilds the session from its DS checkpoint and
+        replays messages persisted since disconnect
+        (emqx_persistent_session_ds resume)."""
+        session, present = self.cm.open_session(
+            clean_start, clientid, channel, **session_kwargs
+        )
+        if present or clean_start or self.durable is None:
+            if self.durable is not None and (clean_start or present):
+                # a live resume or clean start invalidates any on-disk
+                # checkpoint — else a later restart would double-replay
+                # messages already delivered live
+                self.durable.discard(clientid)
+            return session, present
+        state = self.durable.load(clientid)
+        if state is None:
+            return session, False
+        # rebuild subscriptions, then replay the missed interval into
+        # the fresh session's mqueue (flushed after CONNACK by resume())
+        for flt, opts_dict in state.subs.items():
+            opts = SubOpts.from_dict(opts_dict)
+            session.subscribe(flt, opts)
+            self.router.subscribe(clientid, flt, opts)
+        replayed = 0
+        for flt, msg in self.durable.replay(state):
+            opts = session.subscriptions.get(flt)
+            if opts is None:
+                continue
+            qos = session._effective_qos(msg.qos, opts)
+            if qos == 0 and not self.config.mqtt.mqueue_store_qos0:
+                continue
+            session.mqueue.insert(session._queued(msg, opts, max(qos, 0)))
+            replayed += 1
+        self.durable.discard(clientid)  # live again; saved on disconnect
+        self.metrics.inc("session.resumed")
+        self.hooks.run("session.resumed", clientid)
+        return session, True
+
+    def channel_disconnected(self, clientid: str) -> None:
+        """Checkpoint a persistent session at channel close so a broker
+        restart can rebuild it (emqx_persistent_session_ds commit).
+        A stale close (takeover: a NEW channel is already attached) must
+        not checkpoint, or a restart would double-replay messages the
+        live connection already received."""
+        session = self.cm.lookup(clientid)
+        if (
+            self.durable is not None
+            and session is not None
+            and self.cm.channel(clientid) is None
+            and session.expiry_interval > 0
+            and session.subscriptions
+        ):
+            self.durable.save(
+                clientid, session.subscriptions, session.expiry_interval
+            )
 
     # ------------------------------------------------------ publish
 
@@ -170,6 +263,8 @@ class Broker:
                         self.metrics.inc("messages.retained")
             live.append(msg)
             results.append(None)  # fill from dispatch below
+        if live and self.durable is not None:
+            self.durable.persist(live)
         if live:
             matched = self.router.match_batch([m.topic for m in live])
             remote: Optional[List[Set[str]]] = None
@@ -298,6 +393,20 @@ class Broker:
             _, will = self._pending_wills.pop(cid)
             self.publish(will)
         self.cm.expire_sessions(now)
+        if self.durable is not None:
+            self.durable.purge_expired(now)
+            cfg = self.config.durable
+            if now - self._last_ds_sync >= cfg.sync_interval:
+                self._last_ds_sync = now
+                self.durable.sync()  # fsync + census checkpoint
+                self.durable.gc(
+                    int((now - cfg.retention_hours * 3600.0) * 1e6)
+                )
+
+    def shutdown(self) -> None:
+        """Flush and close durable state (called by BrokerServer.stop)."""
+        if self.durable is not None:
+            self.durable.close()
 
     # ----------------------------------------------------- sys info
 

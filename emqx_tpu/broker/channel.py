@@ -232,7 +232,7 @@ class Channel:
             else (0 if pkt.clean_start else mqtt.session_expiry_interval)
         )
         receive_max = pkt.properties.get("receive_maximum")
-        session, present = self.broker.cm.open_session(
+        session, present = self.broker.open_session(
             pkt.clean_start,
             clientid,
             self,
@@ -582,6 +582,7 @@ class Channel:
                 self.broker.publish(will)
         if self.session is not None and self.client is not None:
             self.broker.cm.disconnect(self.client.clientid, self)
+            self.broker.channel_disconnected(self.client.clientid)
             if self.session.expiry_interval <= 0:
                 self.broker.router.cleanup_client(self.client.clientid)
                 self.broker.metrics.inc("session.terminated")

@@ -108,6 +108,7 @@ class BrokerServer:
             self._housekeeper = None
         for lst in self.listeners:
             await lst.stop()
+        self.broker.shutdown()
 
     async def run_forever(self) -> None:
         await self.start()

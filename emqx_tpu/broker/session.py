@@ -51,6 +51,15 @@ class SubOpts:
             share_group=share_group,
         )
 
+    def to_dict(self) -> dict:
+        from dataclasses import asdict
+
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "SubOpts":
+        return cls(**data)
+
 
 @dataclass
 class _InflightEntry:

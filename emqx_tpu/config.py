@@ -88,6 +88,18 @@ class SysConfig:
 
 
 @dataclass
+class DurableConfig:
+    """Durable storage + persistent sessions (emqx_durable_storage)."""
+
+    enable: bool = False
+    data_dir: str = "data/ds"
+    n_streams: int = 16
+    store_qos0: bool = False
+    sync_interval: float = 5.0  # fsync + census checkpoint cadence
+    retention_hours: float = 168.0  # segment GC horizon (7 days)
+
+
+@dataclass
 class BrokerConfig:
     mqtt: MqttConfig = field(default_factory=MqttConfig)
     listeners: List[ListenerConfig] = field(
@@ -97,6 +109,7 @@ class BrokerConfig:
     retainer: RetainerConfig = field(default_factory=RetainerConfig)
     engine: BrokerEngineConfig = field(default_factory=BrokerEngineConfig)
     sys: SysConfig = field(default_factory=SysConfig)
+    durable: DurableConfig = field(default_factory=DurableConfig)
     node_name: str = "emqx_tpu@127.0.0.1"
 
 

@@ -1,0 +1,20 @@
+"""Durable storage (the `emqx_durable_storage` layer).
+
+`api` defines the emqx_ds-style behavior (store_batch / get_streams /
+make_iterator / next) with value-typed resumable iterators;
+`builtin_local` is the real single-node backend on the native C++
+dslog engine; `reference` is the trivially-correct in-memory oracle
+used by the differential tests.
+"""
+
+from .api import DurableStorage, IterRef, StreamRef
+from .builtin_local import LocalStorage
+from .reference import ReferenceStorage
+
+__all__ = [
+    "DurableStorage",
+    "IterRef",
+    "StreamRef",
+    "LocalStorage",
+    "ReferenceStorage",
+]

@@ -1,0 +1,172 @@
+"""Single-node durable storage on the native dslog engine.
+
+The `emqx_ds_builtin_local` analogue (/root/reference/apps/
+emqx_ds_builtin_local/src/) with the storage layer in C++
+(native/dslog.cpp) instead of RocksDB: messages append to a
+(stream, time)-indexed log, streams are topic-prefix hash shards, and
+a learned topic set per stream prunes `get_streams` for concrete
+filters (the LTS idea, emqx_ds_lts.erl:100-143, without the adaptive
+wildcard discovery — the topic census spills to 'opaque' past a bound
+and the stream then serves every filter)."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .. import topic as T
+from ..message import Message
+from .api import (
+    DurableStorage,
+    IterRef,
+    StreamRef,
+    decode_message,
+    encode_message,
+    filter_streams,
+    stream_of,
+)
+from .native import DsLog
+
+_TOPIC_CENSUS_MAX = 8192
+
+
+class LocalStorage(DurableStorage):
+    def __init__(
+        self,
+        directory: str,
+        n_streams: int = 16,
+        seg_bytes: int = 0,
+    ) -> None:
+        self.directory = directory
+        self.n_streams = n_streams
+        self._log = DsLog(directory, seg_bytes=seg_bytes)
+        # learned topic structure: stream -> topics seen (None = opaque)
+        self._census: Dict[int, Optional[Set[str]]] = {}
+        self._census_path = os.path.join(directory, "census.json")
+        self._load_census()
+
+    # ------------------------------------------------------------ write
+
+    def store_batch(self, msgs: Sequence[Message], sync: bool = False) -> None:
+        for msg in msgs:
+            shard = stream_of(msg.topic, self.n_streams)
+            ts_us = int(msg.timestamp * 1e6)
+            self._log.append(shard, ts_us, encode_message(msg))
+            census = self._census.setdefault(shard, set())
+            if census is not None:
+                census.add(msg.topic)
+                if len(census) > _TOPIC_CENSUS_MAX:
+                    self._census[shard] = None  # opaque from now on
+        if sync:
+            self._log.sync()
+            self._save_census()
+
+    # ------------------------------------------------------------- read
+
+    def get_streams(
+        self, topic_filter: str, start_time_us: int = 0
+    ) -> List[StreamRef]:
+        only = filter_streams(topic_filter, self.n_streams)
+        present = set(self._log.streams()) | set(self._census)
+        if only is not None:
+            return [StreamRef(shard=only)] if only in present else []
+        fwords = T.words(topic_filter)
+        out = []
+        for shard in sorted(present):
+            census = self._census.get(shard)
+            if census is not None and not any(
+                T.match_words(T.words(t), fwords) for t in census
+            ):
+                continue  # provably no matching topic in this stream
+            out.append(StreamRef(shard=shard))
+        return out
+
+    def next(self, it: IterRef, n: int) -> Tuple[IterRef, List[Message]]:
+        out: List[Message] = []
+        ts, seq = it.ts, it.seq
+        fwords = T.words(it.topic_filter)
+        for ets, eseq, payload in self._log.scan(it.stream.shard, ts):
+            if (ets, eseq) <= (ts, seq):
+                continue
+            if len(out) >= n:
+                break
+            msg = decode_message(payload)
+            if T.match_words(T.words(msg.topic), fwords):
+                out.append(msg)
+            ts, seq = ets, eseq
+        return IterRef(it.stream, it.topic_filter, ts, seq), out
+
+    # ------------------------------------------------------- lifecycle
+
+    def _total_count(self) -> int:
+        return sum(self._log.stream_count(s) for s in self._log.streams())
+
+    def _load_census(self) -> None:
+        """Load the census cache, validating it against the log (the
+        log is the source of truth): a crash after the last save leaves
+        the cache stale, and a stale census could wrongly prune streams
+        — rebuild whenever the record count disagrees."""
+        try:
+            with open(self._census_path) as f:
+                raw = json.load(f)
+            if raw.get("n") != self._total_count():
+                raise ValueError("census stale vs log")
+            self._census = {
+                int(k): (None if v is None else set(v))
+                for k, v in raw["streams"].items()
+            }
+        except (OSError, ValueError, KeyError):
+            self._rebuild_census()
+
+    def _rebuild_census(self) -> None:
+        """Recover the topic census by scanning the log (the log is the
+        source of truth; the census is a cache)."""
+        self._census = {}
+        for shard in self._log.streams():
+            census: Optional[Set[str]] = set()
+            for _, _, payload in self._log.scan(shard, 0):
+                if census is not None:
+                    census.add(decode_message(payload).topic)
+                    if len(census) > _TOPIC_CENSUS_MAX:
+                        census = None
+                        break
+            self._census[shard] = census
+
+    def _save_census(self) -> None:
+        tmp = self._census_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {
+                    "n": self._total_count(),
+                    "streams": {
+                        str(k): (None if v is None else sorted(v))
+                        for k, v in self._census.items()
+                    },
+                },
+                f,
+            )
+        os.replace(tmp, self._census_path)
+
+    def gc(self, cutoff_ts_us: int) -> int:
+        """Retention: reclaim segments wholly older than the cutoff.
+        The census may now overstate topics (harmless: it only prunes
+        when a topic is provably absent)."""
+        return self._log.gc(cutoff_ts_us)
+
+    def sync(self) -> None:
+        self._log.sync()
+        self._save_census()
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "streams": len(self._log.streams()),
+            "messages": sum(
+                self._log.stream_count(s) for s in self._log.streams()
+            ),
+        }
+
+    def close(self) -> None:
+        if self._log._h:  # idempotent: second close is a no-op
+            self._save_census()
+            self._log.close()

@@ -1,0 +1,166 @@
+"""ctypes binding for the native dslog storage engine.
+
+Builds ``native/dslog.cpp`` on demand with g++ (the environment bakes
+the toolchain in; pybind11 is not available so the C ABI + ctypes is
+the binding layer — see native/dslog.cpp for the format).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO, "native", "dslog.cpp")
+_SO = os.path.join(_REPO, "native", "build", "libdslog.so")
+
+_lock = threading.Lock()
+_lib = None
+
+
+def _build() -> None:
+    os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    subprocess.run(
+        [
+            "g++",
+            "-O2",
+            "-fPIC",
+            "-shared",
+            "-std=c++17",
+            "-Wall",
+            "-o",
+            _SO,
+            _SRC,
+        ],
+        check=True,
+        capture_output=True,
+    )
+
+
+def load():
+    """Load (building if stale) the dslog shared library."""
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(
+            _SRC
+        ):
+            _build()
+        lib = ctypes.CDLL(_SO)
+        lib.dslog_open.restype = ctypes.c_void_p
+        lib.dslog_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.dslog_close.argtypes = [ctypes.c_void_p]
+        lib.dslog_append.restype = ctypes.c_int64
+        lib.dslog_append.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_uint32,
+            ctypes.c_uint64,
+            ctypes.c_char_p,
+            ctypes.c_uint32,
+        ]
+        lib.dslog_sync.restype = ctypes.c_int
+        lib.dslog_sync.argtypes = [ctypes.c_void_p]
+        lib.dslog_streams.restype = ctypes.c_int
+        lib.dslog_streams.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_int,
+        ]
+        lib.dslog_iter_new.restype = ctypes.c_void_p
+        lib.dslog_iter_new.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_uint32,
+            ctypes.c_uint64,
+        ]
+        lib.dslog_iter_free.argtypes = [ctypes.c_void_p]
+        lib.dslog_iter_next.restype = ctypes.c_int64
+        lib.dslog_iter_next.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.dslog_stream_count.restype = ctypes.c_int64
+        lib.dslog_stream_count.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+        lib.dslog_gc.restype = ctypes.c_int64
+        lib.dslog_gc.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        _lib = lib
+        return lib
+
+
+class DsLog:
+    """Thin OO wrapper over the C ABI."""
+
+    def __init__(self, directory: str, seg_bytes: int = 0) -> None:
+        self._lib = load()
+        os.makedirs(directory, exist_ok=True)
+        self._h = self._lib.dslog_open(directory.encode(), seg_bytes)
+        if not self._h:
+            raise OSError(f"dslog_open failed for {directory}")
+
+    def append(self, stream: int, ts: int, data: bytes) -> int:
+        seq = self._lib.dslog_append(self._h, stream, ts, data, len(data))
+        if seq < 0:
+            raise OSError(f"dslog_append failed: {seq}")
+        return seq
+
+    def sync(self) -> None:
+        rc = self._lib.dslog_sync(self._h)
+        if rc != 0:
+            raise OSError(f"dslog_sync failed: {rc}")
+
+    def streams(self) -> list:
+        cap = 1024
+        while True:
+            buf = (ctypes.c_uint32 * cap)()
+            n = self._lib.dslog_streams(self._h, buf, cap)
+            if n <= cap:
+                return list(buf[: max(n, 0)])
+            cap = n
+
+    def stream_count(self, stream: int) -> int:
+        return self._lib.dslog_stream_count(self._h, stream)
+
+    def gc(self, cutoff_ts: int) -> int:
+        """Reclaim whole segments older than cutoff_ts (microseconds);
+        returns records dropped."""
+        return self._lib.dslog_gc(self._h, cutoff_ts)
+
+    def scan(self, stream: int, ts_from: int):
+        """Generator over (ts, seq, payload) from ts_from (inclusive)."""
+        it = self._lib.dslog_iter_new(self._h, stream, ts_from)
+        cap = 64 * 1024
+        buf = ctypes.create_string_buffer(cap)
+        ts = ctypes.c_uint64()
+        seq = ctypes.c_uint64()
+        try:
+            while True:
+                n = self._lib.dslog_iter_next(
+                    it, buf, cap, ctypes.byref(ts), ctypes.byref(seq)
+                )
+                if n == 0:
+                    return
+                if n == -7:  # -E2BIG: grow and retry
+                    cap *= 4
+                    buf = ctypes.create_string_buffer(cap)
+                    continue
+                if n < 0:
+                    raise OSError(f"dslog_iter_next failed: {n}")
+                yield ts.value, seq.value, buf.raw[:n]
+        finally:
+            self._lib.dslog_iter_free(it)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.dslog_close(self._h)
+            self._h = None
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:
+            pass

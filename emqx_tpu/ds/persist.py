@@ -1,0 +1,232 @@
+"""Durable (persistent) sessions over the DS storage engine.
+
+The `emqx_persistent_session_ds` + `emqx_persistent_message` slice
+(/root/reference/apps/emqx/src/emqx_persistent_session_ds.erl,
+emqx_persistent_message.erl:98-113): messages matching a persistent
+session's subscriptions are persisted to DS, session metadata is
+checkpointed on disconnect, and a reconnect after a broker restart
+rebuilds the session and replays the missed interval from storage.
+
+Division of labor with the in-memory session: while the broker stays
+up, a detached session's messages queue in its mqueue (fast path).  DS
+replay serves the case the mqueue cannot: the broker process restarted
+and in-memory state is gone.  The persistence *gate* mirrors
+emqx_persistent_message:persist/1 — a message is stored only when some
+persistent session's filter matches it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .. import topic as T
+from ..engine import MatchEngine
+from ..message import Message
+from .builtin_local import LocalStorage
+
+
+class SessionState:
+    """One checkpointed session (the state emqx_persistent_session_ds
+    keeps in DS session tables)."""
+
+    def __init__(
+        self,
+        clientid: str,
+        subs: Dict[str, Dict],
+        expiry: float,
+        disconnected_at: float,
+    ) -> None:
+        self.clientid = clientid
+        self.subs = subs  # filter -> SubOpts-as-dict
+        self.expiry = expiry
+        self.disconnected_at = disconnected_at
+
+    def expired(self, now: float) -> bool:
+        return now - self.disconnected_at > self.expiry
+
+    def to_json(self) -> Dict:
+        return {
+            "clientid": self.clientid,
+            "subs": self.subs,
+            "expiry": self.expiry,
+            "disconnected_at": self.disconnected_at,
+        }
+
+    @staticmethod
+    def from_json(obj: Dict) -> "SessionState":
+        return SessionState(
+            clientid=obj["clientid"],
+            subs=obj["subs"],
+            expiry=obj["expiry"],
+            disconnected_at=obj["disconnected_at"],
+        )
+
+
+class DurableSessions:
+    def __init__(
+        self,
+        directory: str,
+        n_streams: int = 16,
+        store_qos0: bool = False,
+    ) -> None:
+        self.storage = LocalStorage(
+            os.path.join(directory, "messages"), n_streams=n_streams
+        )
+        self.state_dir = os.path.join(directory, "sessions")
+        os.makedirs(self.state_dir, exist_ok=True)
+        self.store_qos0 = store_qos0
+        # persistence gate: filters of every persistent session (live or
+        # detached), refcounted; host matching is fine at this rate
+        self._gate = MatchEngine(use_device=False)
+        self._refs: Dict[str, int] = {}
+        # detached states restored from disk at boot
+        self._boot_states: Dict[str, SessionState] = {}
+        self._load_states()
+
+    # ------------------------------------------------------------ gate
+
+    def add_filter(self, flt: str) -> None:
+        n = self._refs.get(flt, 0)
+        if n == 0:
+            self._gate.insert(flt, flt)
+        self._refs[flt] = n + 1
+
+    def remove_filter(self, flt: str) -> None:
+        n = self._refs.get(flt, 0)
+        if n <= 1:
+            self._refs.pop(flt, None)
+            self._gate.delete(flt)
+        else:
+            self._refs[flt] = n - 1
+
+    def persist(self, msgs: List[Message]) -> int:
+        """Store messages a persistent session could need on resume."""
+        batch = []
+        for msg in msgs:
+            if msg.sys or (msg.qos == 0 and not self.store_qos0):
+                continue
+            if self._gate.match(msg.topic):
+                batch.append(msg)
+        if batch:
+            self.storage.store_batch(batch)
+        return len(batch)
+
+    # ------------------------------------------------------ checkpoints
+
+    def _state_path(self, clientid: str) -> str:
+        import hashlib
+
+        safe = hashlib.sha1(clientid.encode()).hexdigest()
+        return os.path.join(self.state_dir, safe + ".json")
+
+    def save(
+        self,
+        clientid: str,
+        subs: Dict[str, object],
+        expiry: float,
+        now: Optional[float] = None,
+    ) -> None:
+        state = SessionState(
+            clientid=clientid,
+            subs={
+                flt: opts.to_dict() if hasattr(opts, "to_dict") else dict(opts)
+                for flt, opts in subs.items()
+            },
+            expiry=expiry,
+            disconnected_at=now if now is not None else time.time(),
+        )
+        tmp = self._state_path(clientid) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state.to_json(), f)
+        os.replace(tmp, self._state_path(clientid))
+
+    def load(self, clientid: str) -> Optional[SessionState]:
+        """Boot-restored state for a reconnecting client (None if the
+        broker never restarted or no checkpoint exists/survives)."""
+        state = self._boot_states.get(clientid)
+        if state is not None and state.expired(time.time()):
+            self.discard(clientid)
+            return None
+        return state
+
+    def discard(self, clientid: str) -> None:
+        self._boot_states.pop(clientid, None)
+        try:
+            os.unlink(self._state_path(clientid))
+        except OSError:
+            pass
+
+    def _load_states(self) -> None:
+        for name in os.listdir(self.state_dir):
+            if not name.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(self.state_dir, name)) as f:
+                    state = SessionState.from_json(json.load(f))
+            except (OSError, ValueError, KeyError):
+                continue
+            self._boot_states[state.clientid] = state
+            for flt in state.subs:
+                if not T.parse_share(flt):
+                    self.add_filter(flt)
+
+    def purge_expired(self, now: Optional[float] = None) -> List[str]:
+        now = now if now is not None else time.time()
+        dead = [
+            cid
+            for cid, st in self._boot_states.items()
+            if st.expired(now)
+        ]
+        for cid in dead:
+            state = self._boot_states[cid]
+            for flt in state.subs:
+                if not T.parse_share(flt):
+                    self.remove_filter(flt)
+            self.discard(cid)
+        return dead
+
+    # ---------------------------------------------------------- replay
+
+    def remove_session_filters(self, subs: Dict[str, object]) -> None:
+        """Drop a discarded/expired session's filters from the gate (and
+        its checkpoint must be discarded separately)."""
+        for flt in subs:
+            if T.parse_share(flt) is None:
+                self.remove_filter(flt)
+
+    def gc(self, cutoff_ts_us: int) -> int:
+        """Retention pass over the message log."""
+        return self.storage.gc(cutoff_ts_us)
+
+    def sync(self) -> None:
+        self.storage.sync()
+
+    def replay(
+        self, state: SessionState
+    ) -> List[Tuple[str, Message]]:
+        """Messages persisted since the checkpoint, per matching filter,
+        deduped by message id across overlapping filters; ordered by
+        storage order within each stream."""
+        since_us = int(state.disconnected_at * 1e6)
+        seen: set = set()
+        out: List[Tuple[str, Message]] = []
+        for flt in state.subs:
+            if T.parse_share(flt):
+                continue  # shared subs don't replay ([MQTT-4.8.2-27])
+            for stream in self.storage.get_streams(flt, since_us):
+                it = self.storage.make_iterator(stream, flt, since_us)
+                while True:
+                    it, msgs = self.storage.next(it, 256)
+                    if not msgs:
+                        break
+                    for msg in msgs:
+                        if msg.mid not in seen:
+                            seen.add(msg.mid)
+                            out.append((flt, msg))
+        return out
+
+    def close(self) -> None:
+        self.storage.close()

@@ -1,0 +1,186 @@
+"""Persistent sessions over durable storage: a session checkpointed at
+disconnect survives a broker restart, and messages persisted while it
+was away replay on reconnect (emqx_persistent_session_ds semantics at
+the black-box level)."""
+
+import asyncio
+
+from emqx_tpu.broker.listener import BrokerServer
+from emqx_tpu.config import BrokerConfig, ListenerConfig
+from mqtt_client import TestClient
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_server(data_dir):
+    cfg = BrokerConfig()
+    cfg.listeners = [ListenerConfig(port=0)]
+    cfg.durable.enable = True
+    cfg.durable.data_dir = str(data_dir)
+    return BrokerServer(cfg)
+
+
+def test_session_survives_broker_restart(tmp_path):
+    async def t():
+        srv1 = make_server(tmp_path / "ds")
+        await srv1.start()
+        port = srv1.listeners[0].port
+
+        c1 = TestClient(port, "veh-1")
+        await c1.connect(
+            clean_start=False,
+            properties={"session_expiry_interval": 3600},
+        )
+        await c1.subscribe("cmd/veh-1/#", qos=1)
+        await c1.disconnect()
+
+        # messages arrive while the client is away; qos1 -> persisted
+        pub = TestClient(port, "ctl")
+        await pub.connect()
+        for i in range(3):
+            await pub.publish(f"cmd/veh-1/step{i}", f"go{i}".encode(), qos=1)
+        await pub.disconnect()
+
+        # broker restarts: all in-memory state is gone
+        await srv1.stop()
+        srv1.broker.durable.close()
+
+        srv2 = make_server(tmp_path / "ds")
+        await srv2.start()
+        port2 = srv2.listeners[0].port
+        c1b = TestClient(port2, "veh-1")
+        ack = await c1b.connect(
+            clean_start=False,
+            properties={"session_expiry_interval": 3600},
+        )
+        assert ack.session_present  # rebuilt from the DS checkpoint
+        got = []
+        for _ in range(3):
+            msg = await c1b.recv_publish(timeout=5)
+            got.append((msg.topic, msg.payload, msg.qos))
+        assert sorted(got) == [
+            (f"cmd/veh-1/step{i}", f"go{i}".encode(), 1) for i in range(3)
+        ]
+        # subscription is live again, not just replayed
+        pub2 = TestClient(port2, "ctl2")
+        await pub2.connect()
+        await pub2.publish("cmd/veh-1/live", b"now", qos=1)
+        msg = await c1b.recv_publish(timeout=5)
+        assert msg.payload == b"now"
+        await pub2.disconnect()
+        await c1b.disconnect()
+        await srv2.stop()
+        srv2.broker.durable.close()
+
+    run(t())
+
+
+def test_clean_start_discards_checkpoint(tmp_path):
+    async def t():
+        srv1 = make_server(tmp_path / "ds")
+        await srv1.start()
+        port = srv1.listeners[0].port
+        c1 = TestClient(port, "dev-9")
+        await c1.connect(
+            clean_start=False,
+            properties={"session_expiry_interval": 3600},
+        )
+        await c1.subscribe("q/#", qos=1)
+        await c1.disconnect()
+        await srv1.stop()
+        srv1.broker.durable.close()
+
+        srv2 = make_server(tmp_path / "ds")
+        await srv2.start()
+        c1b = TestClient(srv2.listeners[0].port, "dev-9")
+        ack = await c1b.connect(clean_start=True)
+        assert not ack.session_present
+        # and a later clean_start=false reconnect finds nothing either
+        await c1b.disconnect()
+        c1c = TestClient(srv2.listeners[0].port, "dev-9")
+        ack2 = await c1c.connect(
+            clean_start=False,
+            properties={"session_expiry_interval": 3600},
+        )
+        assert not ack2.session_present
+        await c1c.disconnect()
+        await srv2.stop()
+        srv2.broker.durable.close()
+
+    run(t())
+
+
+def test_qos0_not_persisted_by_default(tmp_path):
+    async def t():
+        srv1 = make_server(tmp_path / "ds")
+        await srv1.start()
+        port = srv1.listeners[0].port
+        c1 = TestClient(port, "s0")
+        await c1.connect(
+            clean_start=False,
+            properties={"session_expiry_interval": 3600},
+        )
+        await c1.subscribe("ev/#", qos=1)
+        await c1.disconnect()
+        pub = TestClient(port, "p")
+        await pub.connect()
+        await pub.publish("ev/a", b"q0", qos=0)
+        await pub.publish("ev/b", b"q1", qos=1)
+        await pub.disconnect()
+        await srv1.stop()
+        srv1.broker.durable.close()
+
+        srv2 = make_server(tmp_path / "ds")
+        await srv2.start()
+        c1b = TestClient(srv2.listeners[0].port, "s0")
+        ack = await c1b.connect(
+            clean_start=False,
+            properties={"session_expiry_interval": 3600},
+        )
+        assert ack.session_present
+        msg = await c1b.recv_publish(timeout=5)
+        assert msg.payload == b"q1"  # only the QoS1 message survived
+        try:
+            extra = await c1b.recv(timeout=0.3)
+            assert False, f"unexpected extra packet: {extra!r}"
+        except asyncio.TimeoutError:
+            pass
+        await c1b.disconnect()
+        await srv2.stop()
+        srv2.broker.durable.close()
+
+    run(t())
+
+
+def test_expired_checkpoint_not_resumed(tmp_path):
+    async def t():
+        srv1 = make_server(tmp_path / "ds")
+        await srv1.start()
+        port = srv1.listeners[0].port
+        c1 = TestClient(port, "exp-1")
+        await c1.connect(
+            clean_start=False,
+            properties={"session_expiry_interval": 1},
+        )
+        await c1.subscribe("z/#", qos=1)
+        await c1.disconnect()
+        await srv1.stop()
+        srv1.broker.durable.close()
+
+        await asyncio.sleep(1.2)  # past the 1s expiry
+
+        srv2 = make_server(tmp_path / "ds")
+        await srv2.start()
+        c1b = TestClient(srv2.listeners[0].port, "exp-1")
+        ack = await c1b.connect(
+            clean_start=False,
+            properties={"session_expiry_interval": 1},
+        )
+        assert not ack.session_present
+        await c1b.disconnect()
+        await srv2.stop()
+        srv2.broker.durable.close()
+
+    run(t())
