@@ -89,6 +89,24 @@ class Broker:
                 n_streams=self.config.durable.n_streams,
                 store_qos0=self.config.durable.store_qos0,
             )
+            # advertise boot-state filters as live routes so peers keep
+            # forwarding (and this node keeps persisting) for sessions
+            # detached across the restart — the reference gets this from
+            # the DS-backed persistent-session router
+            # (emqx_persistent_session_ds_router); without it,
+            # remote-origin messages in the restart→reconnect window
+            # would be persisted nowhere
+            self.durable.on_drop = self.router.cleanup_client
+            # drop checkpoints that expired while the broker was down
+            # BEFORE advertising (and before their gate refs can persist
+            # anything for sessions that can never legally resume)
+            self.durable.purge_expired()
+            for state in self.durable.boot_states():
+                for flt, opts_dict in state.subs.items():
+                    if T.parse_share(flt) is None:
+                        self.router.subscribe(
+                            state.clientid, flt, SubOpts.from_dict(opts_dict)
+                        )
         # clientid -> (fire_at, will message): MQTT 5 delayed wills
         self._pending_wills: Dict[str, Tuple[float, Message]] = {}
         self._last_ds_sync = time.time()
@@ -127,13 +145,29 @@ class Broker:
 
     def _session_discarded(self, session: Session) -> None:
         self.metrics.inc("session.discarded")
-        if self.durable is not None and session.expiry_interval > 0:
+        if self.durable is not None:
             # the persistence gate must not outlive the session, or the
             # DS log grows forever for a subscriber that can never return
-            self.durable.remove_session_filters(session.subscriptions)
+            self._release_gate(session)
             self.durable.discard(session.clientid)
         self.router.cleanup_client(session.clientid)
         self.hooks.run("session.discarded", session.clientid)
+
+    def _release_gate(self, session: Session) -> None:
+        """Release exactly the persistence-gate refs this session holds."""
+        if self.durable is not None:
+            for flt in session.gate_filters:
+                self.durable.remove_filter(flt)
+            session.gate_filters.clear()
+
+    def session_terminated(self, clientid: str, session: Session) -> None:
+        """A session ending with expiry<=0 (e.g. MQTT5 DISCONNECT that
+        lowered session_expiry_interval to 0): drop router state AND the
+        gate refs, or the gate persists messages for a session that can
+        never return (emqx_channel session-expiry handling)."""
+        self._release_gate(session)
+        self.router.cleanup_client(clientid)
+        self.metrics.inc("session.terminated")
 
     # ---------------------------------------------------- subscribe
 
@@ -144,15 +178,18 @@ class Broker:
         replay per retain_handling ([MQTT-3.3.1-9..11])."""
         self.router.subscribe(clientid, flt, opts)
         # gate refcount: only a NEW subscription counts (an options
-        # refresh re-subscribe must not inflate it past drainability)
-        if (
-            self.durable is not None
-            and opts.share_group is None
-            and is_new_sub
-        ):
+        # refresh re-subscribe must not inflate it past drainability).
+        # session.gate_filters records exactly which refs this session
+        # holds, so every termination path releases them exactly once.
+        if self.durable is not None and opts.share_group is None:
             session = self.cm.lookup(clientid)
-            if session is not None and session.expiry_interval > 0:
+            if (
+                session is not None
+                and session.expiry_interval > 0
+                and flt not in session.gate_filters
+            ):
                 self.durable.add_filter(flt)
+                session.gate_filters.add(flt)
         self.hooks.run("session.subscribed", clientid, flt, opts)
         self.stats.set("subscriptions.count", self._sub_count())
         if opts.share_group is not None:
@@ -165,9 +202,10 @@ class Broker:
     def unsubscribe(self, clientid: str, flt: str) -> bool:
         ok = self.router.unsubscribe(clientid, flt)
         if ok:
-            if self.durable is not None and T.parse_share(flt) is None:
+            if self.durable is not None:
                 session = self.cm.lookup(clientid)
-                if session is not None and session.expiry_interval > 0:
+                if session is not None and flt in session.gate_filters:
+                    session.gate_filters.discard(flt)
                     self.durable.remove_filter(flt)
             self.hooks.run("session.unsubscribed", clientid, flt)
             self.stats.set("subscriptions.count", self._sub_count())
@@ -193,8 +231,10 @@ class Broker:
             if self.durable is not None and (clean_start or present):
                 # a live resume or clean start invalidates any on-disk
                 # checkpoint — else a later restart would double-replay
-                # messages already delivered live
-                self.durable.discard(clientid)
+                # messages already delivered live.  drop_checkpoint also
+                # releases the gate refs _load_states took for the boot
+                # state, which no live session carries.
+                self.durable.drop_checkpoint(clientid)
             return session, present
         state = self.durable.load(clientid)
         if state is None:
@@ -205,6 +245,11 @@ class Broker:
             opts = SubOpts.from_dict(opts_dict)
             session.subscribe(flt, opts)
             self.router.subscribe(clientid, flt, opts)
+            if T.parse_share(flt) is None:
+                # the boot-state gate refs (taken in _load_states)
+                # transfer to the live session, to be released exactly
+                # once on its eventual discard/termination
+                session.gate_filters.add(flt)
         replayed = 0
         for flt, msg in self.durable.replay(state):
             opts = session.subscriptions.get(flt)
@@ -286,6 +331,12 @@ class Broker:
         already ran on the origin node, and re-forwarding would loop
         (the reference's forward lands directly in `dispatch/2`,
         emqx_broker.erl:408-420)."""
+        if self.durable is not None:
+            # each node durably stores what its own gate needs: DS is
+            # node-local here (unlike the reference's replicated DS), so
+            # a local persistent session's messages must be persisted on
+            # THIS node even when published remotely
+            self.durable.persist([msg])
         filters = self.router.match_batch([msg.topic])[0]
         return self._dispatch(msg, filters, run_rules=False)
 
@@ -347,6 +398,13 @@ class Broker:
     ) -> int:
         session = self.cm.lookup(clientid)
         if session is None:
+            if self.durable is not None and self.durable.has_checkpoint(
+                clientid
+            ):
+                # detached across a restart: the message was already
+                # persisted by the gate and will replay on resume —
+                # not a drop
+                return 0
             self.metrics.inc("delivery.dropped", len(deliveries))
             return 0
         channel = self.cm.channel(clientid)

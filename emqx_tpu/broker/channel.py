@@ -584,8 +584,9 @@ class Channel:
             self.broker.cm.disconnect(self.client.clientid, self)
             self.broker.channel_disconnected(self.client.clientid)
             if self.session.expiry_interval <= 0:
-                self.broker.router.cleanup_client(self.client.clientid)
-                self.broker.metrics.inc("session.terminated")
+                self.broker.session_terminated(
+                    self.client.clientid, self.session
+                )
                 self.broker.hooks.run(
                     "session.terminated", self.client.clientid, reason
                 )

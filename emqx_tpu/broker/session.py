@@ -92,6 +92,9 @@ class Session:
         self.clean_start = clean_start
         self.created_at = time.time()
         self.subscriptions: Dict[str, SubOpts] = {}
+        # persistence-gate refs this session holds (maintained by the
+        # broker; released exactly once on discard/termination)
+        self.gate_filters: set = set()
         self.mqueue = MQueue(
             max_len=max_mqueue_len,
             priorities=mqueue_priorities,

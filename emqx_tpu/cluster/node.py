@@ -22,6 +22,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..message import Message
@@ -116,7 +117,20 @@ class ClusterNode:
         self._last_seen: Dict[str, float] = {}
         self._down: set = set()
         self._synced: set = set()  # peers whose full sync succeeded
-        self._pending_ops: List[Tuple[str, str]] = []  # (op, filter)
+        self._pending_ops: List[Tuple[int, str, str]] = []  # (seq, op, flt)
+        # versioned route-op stream: every local op gets a monotonic seq
+        # and casts carry (epoch, seq).  A full-sync snapshot carries the
+        # seq it was cut at, so the receiver can purge-and-replace
+        # without losing ops that raced past the snapshot on the other
+        # TCP connection (sync replies and casts are unordered): ops in
+        # the per-peer log with seq > snapshot seq are re-applied after
+        # the snapshot.  The epoch (one per process incarnation)
+        # invalidates the log across a peer restart.
+        self._epoch = time.time_ns()
+        self._op_seq = 0
+        self._peer_epoch: Dict[str, int] = {}
+        self._peer_seq: Dict[str, int] = {}
+        self._op_log: Dict[str, deque] = {}
         self._flush_wakeup = asyncio.Event()
         self._tasks: List[asyncio.Task] = []
         self._fwd_tasks: set = set()
@@ -131,6 +145,11 @@ class ClusterNode:
         broker.router.on_route_added = self._route_added
         broker.router.on_route_removed = self._route_removed
         broker.external = self
+        # adopt routes created before the cluster layer attached (e.g.
+        # boot-advertised persistent-session filters after a restart) so
+        # the initial full sync carries them to peers
+        for flt in broker.router.topics():
+            self.routes.add_route(flt, self.name)
 
     # ------------------------------------------------------- lifecycle
 
@@ -188,7 +207,8 @@ class ClusterNode:
     def _queue_op(self, op: str, flt: str) -> None:
         if not self._started:
             return
-        self._pending_ops.append((op, flt))
+        self._op_seq += 1
+        self._pending_ops.append((self._op_seq, op, flt))
         if len(self._pending_ops) >= self.flush_max:
             self._flush_wakeup.set()
 
@@ -204,7 +224,12 @@ class ClusterNode:
             if not self._pending_ops:
                 continue
             ops, self._pending_ops = self._pending_ops, []
-            obj = {"type": "route_ops", "node": self.name, "ops": ops}
+            obj = {
+                "type": "route_ops",
+                "node": self.name,
+                "epoch": self._epoch,
+                "ops": ops,
+            }
             await asyncio.gather(
                 *(
                     self.transport.cast(p, obj)
@@ -213,13 +238,49 @@ class ClusterNode:
                 return_exceptions=True,
             )
 
+    def _check_epoch(self, node: str, epoch: int) -> None:
+        """A new epoch means the peer restarted: its op stream starts
+        over, so the buffered log from the old incarnation is garbage."""
+        if self._peer_epoch.get(node) != epoch:
+            self._peer_epoch[node] = epoch
+            self._peer_seq[node] = 0
+            self._op_log[node] = deque(maxlen=8192)
+
     async def _handle_route_ops(self, peer: str, obj: Dict) -> None:
         node = obj.get("node", peer)
-        for op, flt in obj.get("ops", ()):
+        self._check_epoch(node, obj.get("epoch", 0))
+        log_ = self._op_log[node]
+        for seq, op, flt in obj.get("ops", ()):
+            if seq <= self._peer_seq.get(node, 0):
+                # already reflected by an applied snapshot (or a dup):
+                # re-applying a stale delete would transiently remove a
+                # route the snapshot re-asserted
+                continue
             if op == "add":
                 self.routes.add_route(flt, node)
             else:
                 self.routes.delete_route(flt, node)
+            log_.append((seq, op, flt))
+            self._peer_seq[node] = seq
+
+    def _apply_snapshot(
+        self, node: str, filters: List[str], snap_seq: int
+    ) -> None:
+        """Purge-and-replace `node`'s routes from a full-sync snapshot,
+        then re-apply any ops that raced past the snapshot cut (casts
+        travel on a different connection than the sync reply, so a
+        freshly added route may already be applied locally while absent
+        from the snapshot — a blind purge would silently drop it)."""
+        self.routes.purge_node(node)
+        for flt in filters:
+            self.routes.add_route(flt, node)
+        for seq, op, flt in self._op_log.get(node, ()):
+            if seq > snap_seq:
+                if op == "add":
+                    self.routes.add_route(flt, node)
+                else:
+                    self.routes.delete_route(flt, node)
+        self._peer_seq[node] = max(self._peer_seq.get(node, 0), snap_seq)
 
     async def _sync_with(self, peer: str) -> None:
         """Full bidirectional route exchange (the mria bootstrap copy a
@@ -232,6 +293,8 @@ class ClusterNode:
                 "type": "sync",
                 "node": self.name,
                 "listen": [self.transport.bind, self.transport.port],
+                "epoch": self._epoch,
+                "seq": self._op_seq,
                 "routes": self._local_routes(),
             },
         )
@@ -240,20 +303,41 @@ class ClusterNode:
             return
         self._mark_alive(peer)
         self._synced.add(peer)
+        self._check_epoch(peer, reply.get("epoch", 0))
+        # split the reply: the responder's own routes purge-and-replace
+        # (seq-guarded); third-party routes are add-only hints, so force
+        # a direct (purge-and-replace) sync with each of those nodes to
+        # reconcile anything stale the responder still carried
+        own: List[str] = []
+        changed_third_party: set = set()
         for entry in reply.get("routes", ()):
             for node in entry["nodes"]:
-                if node != self.name:
-                    self.routes.add_route(entry["topic"], node)
+                if node == peer:
+                    own.append(entry["topic"])
+                elif node != self.name:
+                    if self.routes.add_route(entry["topic"], node):
+                        # the responder taught us something about a node
+                        # we thought we were synced with — it may be a
+                        # stale phantom, so re-sync with that node
+                        # directly (no-op churn avoided: an already-known
+                        # route triggers nothing)
+                        changed_third_party.add(node)
+        self._apply_snapshot(peer, own, reply.get("seq", 0))
+        self._synced -= changed_third_party  # heartbeat loop re-syncs
 
     async def _handle_sync(self, peer: str, obj: Dict) -> Dict:
         node = obj.get("node", peer)
         self._learn_peer(node, obj.get("listen"))
         self._mark_alive(node)
-        # peer's local routes replace whatever we had for it
-        self.routes.purge_node(node)
-        for flt in obj.get("routes", ()):
-            self.routes.add_route(flt, node)
-        return {"routes": self.routes.all_routes()}
+        # peer's local routes replace whatever we had for it (seq-guarded
+        # against its own racing casts, same as the requester side)
+        self._check_epoch(node, obj.get("epoch", 0))
+        self._apply_snapshot(node, obj.get("routes", ()), obj.get("seq", 0))
+        return {
+            "routes": self.routes.all_routes(),
+            "epoch": self._epoch,
+            "seq": self._op_seq,
+        }
 
     def _learn_peer(self, node: str, listen) -> None:
         """Adopt a peer advertised in a sync/heartbeat message so

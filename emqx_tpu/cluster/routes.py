@@ -26,13 +26,16 @@ class ClusterRouteTable:
         self._nodes_by_filter: Dict[str, Set[str]] = {}
         self._filters_by_node: Dict[str, Set[str]] = {}
 
-    def add_route(self, flt: str, node: str) -> None:
+    def add_route(self, flt: str, node: str) -> bool:
+        """Returns True when (flt, node) was not already present."""
         nodes = self._nodes_by_filter.get(flt)
         if nodes is None:
             nodes = self._nodes_by_filter[flt] = set()
             self.engine.insert(flt, flt)
+        new = node not in nodes
         nodes.add(node)
         self._filters_by_node.setdefault(node, set()).add(flt)
+        return new
 
     def delete_route(self, flt: str, node: str) -> None:
         nodes = self._nodes_by_filter.get(flt)

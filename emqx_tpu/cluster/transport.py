@@ -22,7 +22,7 @@ from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
 log = logging.getLogger("emqx_tpu.cluster.transport")
 
-PROTO_VER = (1, 0)
+PROTO_VER = (2, 0)
 
 Handler = Callable[[str, Dict[str, Any]], Awaitable[Optional[Dict[str, Any]]]]
 

@@ -84,7 +84,17 @@ class DurableSessions:
         self._refs: Dict[str, int] = {}
         # detached states restored from disk at boot
         self._boot_states: Dict[str, SessionState] = {}
+        # fired (with the clientid) when a boot checkpoint is dropped —
+        # the broker uses it to retract the routes it advertised for
+        # the detached session
+        self.on_drop = None
         self._load_states()
+
+    def boot_states(self) -> List[SessionState]:
+        return list(self._boot_states.values())
+
+    def has_checkpoint(self, clientid: str) -> bool:
+        return clientid in self._boot_states
 
     # ------------------------------------------------------------ gate
 
@@ -148,7 +158,7 @@ class DurableSessions:
         broker never restarted or no checkpoint exists/survives)."""
         state = self._boot_states.get(clientid)
         if state is not None and state.expired(time.time()):
-            self.discard(clientid)
+            self.drop_checkpoint(clientid)
             return None
         return state
 
@@ -158,6 +168,17 @@ class DurableSessions:
             os.unlink(self._state_path(clientid))
         except OSError:
             pass
+
+    def drop_checkpoint(self, clientid: str) -> None:
+        """Discard a boot checkpoint AND release the gate refs
+        _load_states took for it (a plain discard leaks them when no
+        live session inherits the filters)."""
+        state = self._boot_states.get(clientid)
+        if state is not None:
+            self.remove_session_filters(state.subs)
+            if self.on_drop is not None:
+                self.on_drop(clientid)
+        self.discard(clientid)
 
     def _load_states(self) -> None:
         for name in os.listdir(self.state_dir):
@@ -181,11 +202,7 @@ class DurableSessions:
             if st.expired(now)
         ]
         for cid in dead:
-            state = self._boot_states[cid]
-            for flt in state.subs:
-                if not T.parse_share(flt):
-                    self.remove_filter(flt)
-            self.discard(cid)
+            self.drop_checkpoint(cid)
         return dead
 
     # ---------------------------------------------------------- replay

@@ -163,8 +163,14 @@ class TestClient:
         await self.send(C.Pingreq())
         await self.expect(C.PINGRESP)
 
-    async def disconnect(self, reason_code: int = 0) -> None:
-        await self.send(C.Disconnect(reason_code=reason_code))
+    async def disconnect(
+        self, reason_code: int = 0, properties: dict = None
+    ) -> None:
+        await self.send(
+            C.Disconnect(
+                reason_code=reason_code, properties=properties or {}
+            )
+        )
         await self.close()
 
     async def close(self) -> None:

@@ -228,3 +228,139 @@ def test_forward_preserves_bytes_properties_and_skips_side_effects():
             await stop_node(s1, n1)
 
     run(t())
+
+
+def test_sync_snapshot_does_not_lose_racing_route_add():
+    """A full-sync purge must not drop a route whose add cast raced past
+    the snapshot on the other connection: the seq-guarded re-apply in
+    _apply_snapshot keeps it."""
+
+    async def t():
+        srv_a, a = await start_node("a")
+        srv_b, b = await start_node("b", seeds=[("a", "127.0.0.1", a.port)])
+        await settle(0.2)
+
+        # simulate the race directly: B has applied an add from A at a
+        # seq NEWER than the snapshot A would reply with
+        await b._handle_route_ops(
+            "a",
+            {
+                "node": "a",
+                "epoch": a._epoch,
+                "ops": [[a._op_seq + 1, "add", "raced/topic"]],
+            },
+        )
+        assert "a" in b.routes.match_nodes(["raced/topic"])[0]
+        # now a full sync with A's (older) snapshot runs: the purge must
+        # re-apply the newer op from the log instead of dropping it
+        await b._sync_with("a")
+        assert "a" in b.routes.match_nodes(["raced/topic"])[0]
+        # whereas an op INCLUDED in the snapshot window (seq <= snap) is
+        # governed by the snapshot: a stale route is reconciled away
+        await b._handle_route_ops(
+            "a",
+            {
+                "node": "a",
+                "epoch": a._epoch,
+                "ops": [[a._op_seq, "add", "stale/topic"]]
+                if a._op_seq > 0
+                else [[0, "add", "stale/topic"]],
+            },
+        )
+        if a._op_seq > 0:
+            await b._sync_with("a")
+            assert "a" not in b.routes.match_nodes(["stale/topic"])[0]
+
+        await stop_node(srv_b, b)
+        await stop_node(srv_a, a)
+
+    run(t())
+
+
+def test_restart_epoch_resets_op_log():
+    """A peer restart (new epoch) must invalidate the buffered op log so
+    old-incarnation ops are not replayed over the new snapshot."""
+
+    async def t():
+        srv_a, a = await start_node("a")
+        srv_b, b = await start_node("b", seeds=[("a", "127.0.0.1", a.port)])
+        await settle(0.2)
+        await b._handle_route_ops(
+            "a", {"node": "a", "epoch": 123, "ops": [[99, "add", "old/x"]]}
+        )
+        assert len(b._op_log["a"]) == 1
+        # new epoch arrives: log resets, old op cannot resurrect
+        b._check_epoch("a", 456)
+        assert len(b._op_log["a"]) == 0
+        b._apply_snapshot("a", [], 0)
+        assert "a" not in b.routes.match_nodes(["old/x"])[0]
+        await stop_node(srv_b, b)
+        await stop_node(srv_a, a)
+
+    run(t())
+
+
+def test_restarted_node_advertises_boot_session_routes(tmp_path):
+    """After a restart, a node's detached persistent-session filters
+    must still be advertised as cluster routes so peers forward (and the
+    home node persists) messages published in the restart→reconnect
+    window."""
+
+    async def t():
+        # node A: durable broker; client subscribes and disconnects
+        cfg = BrokerConfig()
+        cfg.listeners[0].port = 0
+        cfg.durable.enable = True
+        cfg.durable.data_dir = str(tmp_path / "ds-a")
+        srv_a = BrokerServer(cfg)
+        await srv_a.start()
+        c = TestClient(srv_a.listeners[0].port, "roamer")
+        await c.connect(
+            clean_start=False,
+            properties={"session_expiry_interval": 3600},
+        )
+        await c.subscribe("fleet/+/pos", qos=1)
+        await c.disconnect()
+        await srv_a.stop()
+        srv_a.broker.durable.close()
+
+        # node A restarts (no client reconnect yet) and clusters with B
+        cfg2 = BrokerConfig()
+        cfg2.listeners[0].port = 0
+        cfg2.durable.enable = True
+        cfg2.durable.data_dir = str(tmp_path / "ds-a")
+        srv_a2 = BrokerServer(cfg2)
+        await srv_a2.start()
+        node_a = ClusterNode("a", srv_a2.broker, **FAST)
+        await node_a.start()
+        srv_b, node_b = await start_node(
+            "b", seeds=[("a", "127.0.0.1", node_a.port)]
+        )
+        await settle(0.3)
+
+        # B sees A's boot-advertised route and forwards a publish
+        assert "a" in node_b.routes.match_nodes(["fleet/7/pos"])[0]
+        pub = TestClient(srv_b.listeners[0].port, "pub")
+        await pub.connect()
+        await pub.publish("fleet/7/pos", b"37.7,-122.4", qos=1)
+        await pub.disconnect()
+        await settle(0.2)
+
+        # the reconnecting client replays the remote-origin message
+        c2 = TestClient(srv_a2.listeners[0].port, "roamer")
+        ack = await c2.connect(
+            clean_start=False,
+            properties={"session_expiry_interval": 3600},
+        )
+        assert ack.session_present
+        pkt = await c2.recv_publish()
+        assert pkt.topic == "fleet/7/pos"
+        assert pkt.payload == b"37.7,-122.4"
+        await c2.disconnect()
+
+        await stop_node(srv_b, node_b)
+        await node_a.stop()
+        await srv_a2.stop()
+        srv_a2.broker.durable.close()
+
+    run(t())

@@ -184,3 +184,79 @@ def test_expired_checkpoint_not_resumed(tmp_path):
         srv2.broker.durable.close()
 
     run(t())
+
+
+def test_gate_released_on_clean_start_discard(tmp_path):
+    """Discarding a boot checkpoint (clean_start reconnect) must release
+    the gate refs _load_states took, or the gate persists messages for a
+    session that can never return."""
+
+    async def t():
+        srv1 = make_server(tmp_path / "ds")
+        await srv1.start()
+        c1 = TestClient(srv1.listeners[0].port, "leak-1")
+        await c1.connect(
+            clean_start=False,
+            properties={"session_expiry_interval": 3600},
+        )
+        await c1.subscribe("leak/a/#", qos=1)
+        await c1.disconnect()
+        await srv1.stop()
+        srv1.broker.durable.close()
+
+        srv2 = make_server(tmp_path / "ds")
+        await srv2.start()
+        assert srv2.broker.durable._refs == {"leak/a/#": 1}
+        c1b = TestClient(srv2.listeners[0].port, "leak-1")
+        await c1b.connect(clean_start=True)
+        assert srv2.broker.durable._refs == {}
+        assert not srv2.broker.durable._gate.match("leak/a/x")
+        await c1b.disconnect()
+        await srv2.stop()
+        srv2.broker.durable.close()
+
+    run(t())
+
+
+def test_gate_released_on_expiry_zero_disconnect(tmp_path):
+    """An MQTT5 client that lowers session_expiry_interval to 0 at
+    DISCONNECT terminates the session — the gate refs taken at subscribe
+    time (expiry was >0 then) must be released."""
+
+    async def t():
+        srv = make_server(tmp_path / "ds")
+        await srv.start()
+        c = TestClient(srv.listeners[0].port, "zero-x")
+        await c.connect(
+            clean_start=False,
+            properties={"session_expiry_interval": 3600},
+        )
+        await c.subscribe("zero/#", qos=1)
+        assert srv.broker.durable._refs == {"zero/#": 1}
+        await c.disconnect(properties={"session_expiry_interval": 0})
+        await asyncio.sleep(0.05)
+        assert srv.broker.durable._refs == {}
+        await srv.stop()
+        srv.broker.durable.close()
+
+    run(t())
+
+
+def test_remote_forwarded_message_is_persisted(tmp_path):
+    """A message arriving via cluster forward must hit the local
+    persistence gate: DS is node-local, so remote-origin messages for a
+    local persistent session are stored here or nowhere."""
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.message import Message
+
+    cfg = BrokerConfig()
+    cfg.durable.enable = True
+    cfg.durable.data_dir = str(tmp_path / "ds")
+    broker = Broker(cfg)
+    broker.durable.add_filter("far/#")
+    n0 = broker.durable.storage.stats()["messages"]
+    broker.dispatch_forwarded(
+        Message(topic="far/away", payload=b"x", qos=1)
+    )
+    assert broker.durable.storage.stats()["messages"] == n0 + 1
+    broker.shutdown()
