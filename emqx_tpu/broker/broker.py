@@ -16,6 +16,7 @@ turns per-publish trie walks into one XLA call.
 from __future__ import annotations
 
 import asyncio
+import logging
 import time
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
@@ -27,6 +28,8 @@ from ..message import Message
 from ..metrics import Metrics, Stats
 from ..retainer import Retainer
 from ..router import Router
+
+log = logging.getLogger("emqx_tpu.broker")
 from .. import topic as T
 from .cm import ConnectionManager
 from .session import Session, SubOpts
@@ -52,6 +55,7 @@ class Broker:
                 m_cap=eng_cfg.m_cap,
                 rebuild_threshold=eng_cfg.rebuild_threshold,
                 use_device=eng_cfg.use_device,
+                background_rebuild=eng_cfg.background_rebuild,
             ),
             shared=SharedSubManager(strategy=shared_strategy),
         )
@@ -78,6 +82,10 @@ class Broker:
         # registration point, emqx_broker.erl:379-380): provides
         # match_remote(topics) and forward(msg, nodes)
         self.external = None
+        # live micro-batcher: installed+started by BrokerServer (needs a
+        # running loop); when present, channels route publishes through
+        # it instead of calling publish() synchronously
+        self.batcher: Optional["PublishBatcher"] = None
         # durable storage + persistent sessions (emqx_persistent_message
         # gate + emqx_persistent_session_ds restore-on-reconnect)
         self.durable = None
@@ -290,39 +298,112 @@ class Broker:
         return self.publish_many([msg])[0]
 
     def publish_many(self, msgs: Sequence[Message]) -> List[int]:
-        """Route a micro-batch: all topics matched in one device step."""
+        """Route a micro-batch: all topics matched in one device step.
+
+        Composed of three stages so the `PublishBatcher` can run the
+        device-bound middle stage in an executor (keeping the event loop
+        reading sockets during the kernel round-trip) while the
+        state-mutating stages stay on the loop thread."""
+        live, results = self.publish_prepare(msgs)
+        matched, remote = self.publish_match(live)
+        return self.publish_dispatch(live, matched, remote, results)
+
+    def publish_prepare(
+        self, msgs: Sequence[Message]
+    ) -> Tuple[List[Message], List[Optional[int]]]:
+        """Stage 1 (loop thread): publish hooks, retained store, and the
+        durable persistence gate."""
         live: List[Message] = []
         results: List[Optional[int]] = []
         for msg in msgs:
-            out = self.hooks.run_fold("message.publish", (), msg)
-            if out is None:
-                self.metrics.inc("messages.dropped")
-                self.hooks.run("message.dropped", msg, "by_hook")
+            # per-message isolation: one hook/retainer failure must not
+            # poison the other up-to-4095 messages in the window
+            try:
+                out = self.hooks.run_fold("message.publish", (), msg)
+                if out is None:
+                    self.metrics.inc("messages.dropped")
+                    self.hooks.run("message.dropped", msg, "by_hook")
+                    results.append(0)
+                    continue
+                msg = out
+                self.metrics.inc("messages.publish")
+                if msg.retain and not msg.sys:
+                    if self.retainer.store(msg):
+                        if msg.payload:
+                            self.metrics.inc("messages.retained")
+            except Exception:
+                log.exception("publish prepare failed for %s", msg.topic)
+                self.metrics.inc("messages.publish.error")
                 results.append(0)
                 continue
-            msg = out
-            self.metrics.inc("messages.publish")
-            if msg.retain and not msg.sys:
-                if self.retainer.store(msg):
-                    if msg.payload:
-                        self.metrics.inc("messages.retained")
             live.append(msg)
             results.append(None)  # fill from dispatch below
         if live and self.durable is not None:
-            self.durable.persist(live)
-        if live:
-            matched = self.router.match_batch([m.topic for m in live])
-            remote: Optional[List[Set[str]]] = None
-            if self.external is not None:
-                remote = self.external.match_remote([m.topic for m in live])
-            j = 0
-            for i, r in enumerate(results):
-                if r is None:
-                    results[i] = self._dispatch(live[j], matched[j])
+            try:
+                self.durable.persist(live)
+            except Exception:
+                log.exception("durable persist failed for window")
+        return live, results
+
+    def publish_match(
+        self, live: Sequence[Message]
+    ) -> Tuple[List[Set[str]], Optional[List[Set[str]]]]:
+        """Stage 2 (any thread): one batched match step for local
+        filters + remote route nodes.  Only reads engine state the
+        MatchEngine locks internally."""
+        if not live:
+            return [], None
+        topics = [m.topic for m in live]
+        try:
+            matched = self.router.match_batch(topics)
+        except Exception:
+            # device failure degrades to the host oracle instead of
+            # failing (and disconnecting) the whole window
+            log.exception(
+                "device match failed for window of %d; host fallback",
+                len(topics),
+            )
+            matched = self.router.engine.match_batch_host(topics)
+        remote: Optional[List[Set[str]]] = None
+        if self.external is not None:
+            try:
+                remote = self.external.match_remote(topics)
+            except Exception:
+                log.exception("remote match failed for window")
+        return matched, remote
+
+    def publish_dispatch(
+        self,
+        live: Sequence[Message],
+        matched: Sequence[Set[str]],
+        remote: Optional[Sequence[Set[str]]],
+        results: List[Optional[int]],
+    ) -> List[int]:
+        """Stage 3 (loop thread): fan out to sessions, forward to peers,
+        then run all rule hits over the batch in one predicate step."""
+        rule_sink: List[Tuple[Message, List[str]]] = []
+        j = 0
+        for i, r in enumerate(results):
+            if r is None:
+                try:
+                    results[i] = self._dispatch(
+                        live[j], matched[j], rule_sink=rule_sink
+                    )
                     if remote is not None and remote[j]:
                         self.metrics.inc("messages.forward")
                         self.external.forward(live[j], remote[j])
-                    j += 1
+                except Exception:
+                    log.exception(
+                        "dispatch failed for %s", live[j].topic
+                    )
+                    self.metrics.inc("messages.publish.error")
+                    results[i] = 0
+                j += 1
+        if rule_sink:
+            try:
+                self.rules.apply_batch(rule_sink)
+            except Exception:
+                log.exception("rule batch failed for window")
         return [r if r is not None else 0 for r in results]
 
     def dispatch_forwarded(self, msg: Message) -> int:
@@ -343,12 +424,18 @@ class Broker:
     # ----------------------------------------------------- dispatch
 
     def _dispatch(
-        self, msg: Message, filters: Set[str], run_rules: bool = True
+        self,
+        msg: Message,
+        filters: Set[str],
+        run_rules: bool = True,
+        rule_sink: Optional[List] = None,
     ) -> int:
         """Fan a routed message out to subscriber sessions
         (emqx_broker:dispatch + do_dispatch, :408-420, :639-673).
         Rule hits come back from the same match step as a distinct fid
-        class and run before delivery (emqx_rule_engine.erl:226-231)."""
+        class (emqx_rule_engine.erl:226-231); with a ``rule_sink`` they
+        accumulate for one batched predicate pass over the whole window,
+        otherwise they run per message."""
         rule_ids: List[str] = []
         per_client: Dict[str, List[Tuple[Message, SubOpts]]] = {}
         for real in filters:
@@ -360,7 +447,11 @@ class Broker:
             for group in self.router.shared.groups_for(real):
                 self._shared_pick(msg, real, group, per_client)
         if rule_ids and run_rules:
-            self.rules.apply(msg, sorted(set(rule_ids)))
+            ids = sorted(set(rule_ids))
+            if rule_sink is not None:
+                rule_sink.append((msg, ids))
+            else:
+                self.rules.apply(msg, ids)
         if not per_client:
             self.metrics.inc("messages.dropped")
             self.metrics.inc("messages.dropped.no_subscribers")
@@ -494,6 +585,25 @@ class PublishBatcher:
         self.batch_max = batch_max
         self._queue: asyncio.Queue = asyncio.Queue()
         self._task: Optional[asyncio.Task] = None
+        # connection read loops pause above the high watermark and
+        # resume below the low one (TCP backpressure; bounds both memory
+        # and queueing delay under a publish flood)
+        self.high_watermark = batch_max * 2
+        self.low_watermark = batch_max // 2
+        self._uncongested = asyncio.Event()
+        self._uncongested.set()
+
+    def depth(self) -> int:
+        return self._queue.qsize()
+
+    def congested(self) -> bool:
+        if self._queue.qsize() >= self.high_watermark:
+            self._uncongested.clear()
+            return True
+        return False
+
+    async def wait_uncongested(self) -> None:
+        await self._uncongested.wait()
 
     async def start(self) -> None:
         if self._task is None:
@@ -513,12 +623,21 @@ class PublishBatcher:
         self._queue.put_nowait((msg, fut))
         return fut
 
+    def publish_nowait(self, msg: Message) -> None:
+        """Fire-and-forget enqueue (QoS 0): no future is created, so a
+        failed window can't leave unobserved exceptions behind."""
+        self._queue.put_nowait((msg, None))
+
     async def _run(self) -> None:
+        loop = asyncio.get_running_loop()
         while True:
             batch = [await self._queue.get()]
-            deadline = asyncio.get_running_loop().time() + self.window
+            deadline = loop.time() + self.window
             while len(batch) < self.batch_max:
-                timeout = deadline - asyncio.get_running_loop().time()
+                if not self._queue.empty():
+                    batch.append(self._queue.get_nowait())
+                    continue
+                timeout = deadline - loop.time()
                 if timeout <= 0:
                     break
                 try:
@@ -529,12 +648,29 @@ class PublishBatcher:
                     break
             msgs = [m for m, _ in batch]
             try:
-                counts = self.broker.publish_many(msgs)
+                # hooks/retain/persist + dispatch mutate broker state and
+                # write to connection transports: loop thread only.  The
+                # match stage is the device round-trip — run it in the
+                # default executor so the loop keeps reading sockets
+                # (accumulating the next window) while the kernel runs.
+                live, results = self.broker.publish_prepare(msgs)
+                matched, remote = await loop.run_in_executor(
+                    None, self.broker.publish_match, live
+                )
+                counts = self.broker.publish_dispatch(
+                    live, matched, remote, results
+                )
             except Exception as exc:  # resolve futures either way
+                log.exception("publish window of %d failed", len(batch))
                 for _, fut in batch:
-                    if not fut.done():
+                    if fut is not None and not fut.done():
                         fut.set_exception(exc)
                 continue
             for (_, fut), n in zip(batch, counts):
-                if not fut.done():
+                if fut is not None and not fut.done():
                     fut.set_result(n)
+            if (
+                not self._uncongested.is_set()
+                and self._queue.qsize() <= self.low_watermark
+            ):
+                self._uncongested.set()

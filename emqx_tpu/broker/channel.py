@@ -368,18 +368,24 @@ class Channel:
             properties=props,
         )
 
+        batcher = self.broker.batcher
         if pkt.qos == 0:
-            self.broker.publish(msg)
+            if batcher is not None:
+                batcher.publish_nowait(msg)  # fire-and-forget
+            else:
+                self.broker.publish(msg)
             return
         if pkt.qos == 1:
-            n = self.broker.publish(msg)
-            rc = (
-                RC_NO_MATCHING_SUBSCRIBERS
-                if (n == 0 and self.version == C.MQTT_V5)
-                else 0
-            )
-            m.inc("packets.puback.sent")
-            self.send_packets([C.Puback(packet_id=pkt.packet_id, reason_code=rc)])
+            if batcher is not None:
+                # ack resolves from the batch future — the whole window
+                # is one device step, PUBACKs stream out in batch order
+                batcher.publish(msg).add_done_callback(
+                    lambda f, pid=pkt.packet_id: self._publish_acked(
+                        pid, 1, f
+                    )
+                )
+            else:
+                self._send_pub_ack(pkt.packet_id, 1, self.broker.publish(msg))
             return
         # QoS 2: route immediately, dedup on packet id until PUBREL
         st = self.session.awaiting_rel_add(pkt.packet_id)
@@ -394,14 +400,44 @@ class Channel:
             m.inc("messages.dropped.await_pubrel_timeout")
             self._disconnect_with(RC_RECEIVE_MAX_EXCEEDED)
             return
-        n = self.broker.publish(msg)
+        if batcher is not None:
+            batcher.publish(msg).add_done_callback(
+                lambda f, pid=pkt.packet_id: self._publish_acked(pid, 2, f)
+            )
+        else:
+            self._send_pub_ack(pkt.packet_id, 2, self.broker.publish(msg))
+
+    def _publish_acked(self, packet_id: int, qos: int, fut) -> None:
+        """Batch future resolved: emit the deferred PUBACK/PUBREC."""
+        if fut.cancelled():
+            return
+        exc = fut.exception()
+        if exc is not None:
+            # routing failed: never ack a publish we did not route (the
+            # client's retransmit gives it another chance).  For QoS 2
+            # the packet id must leave awaiting_rel, or the dedup guard
+            # would PUBREC the retransmit without ever routing it.
+            if qos == 2 and self.session is not None:
+                self.session.awaiting_rel.pop(packet_id, None)
+            self.broker.metrics.inc("messages.publish.error")
+            if self.state == CONNECTED:
+                self._disconnect_with(0x80)  # unspecified error
+            return
+        self._send_pub_ack(packet_id, qos, fut.result())
+
+    def _send_pub_ack(self, packet_id: int, qos: int, n: int) -> None:
+        m = self.broker.metrics
         rc = (
             RC_NO_MATCHING_SUBSCRIBERS
             if (n == 0 and self.version == C.MQTT_V5)
             else 0
         )
-        m.inc("packets.pubrec.sent")
-        self.send_packets([C.Pubrec(packet_id=pkt.packet_id, reason_code=rc)])
+        if qos == 1:
+            m.inc("packets.puback.sent")
+            self.send_packets([C.Puback(packet_id=packet_id, reason_code=rc)])
+        else:
+            m.inc("packets.pubrec.sent")
+            self.send_packets([C.Pubrec(packet_id=packet_id, reason_code=rc)])
 
     def _publish_denied(self, pkt: C.Publish) -> None:
         """Unauthorized publish: drop or disconnect per config

@@ -90,6 +90,13 @@ class Connection:
                     if self._closed.is_set():
                         break
                 await self._drain()
+                batcher = self.broker.batcher
+                if batcher is not None and batcher.congested():
+                    # stop reading until the publish queue drains: TCP
+                    # backpressure propagates to the client, bounding
+                    # broker memory and queueing delay (the esockd
+                    # active_n / emqx_olp role)
+                    await batcher.wait_uncongested()
         except C.MqttError as exc:
             log.debug("codec error from %s: %s", self.channel.peer, exc)
             reason = "frame_error"

@@ -85,6 +85,16 @@ class BrokerServer:
         self._housekeeper: Optional[asyncio.Task] = None
 
     async def start(self) -> None:
+        eng_cfg = self.broker.config.engine
+        if eng_cfg.batch_publish:
+            from .broker import PublishBatcher
+
+            self.broker.batcher = PublishBatcher(
+                self.broker,
+                window=eng_cfg.batch_window_ms / 1000.0,
+                batch_max=eng_cfg.batch_max,
+            )
+            await self.broker.batcher.start()
         for lst in self.listeners:
             await lst.start()
         self._housekeeper = asyncio.get_running_loop().create_task(
@@ -108,6 +118,9 @@ class BrokerServer:
             self._housekeeper = None
         for lst in self.listeners:
             await lst.stop()
+        if self.broker.batcher is not None:
+            await self.broker.batcher.stop()
+            self.broker.batcher = None
         self.broker.shutdown()
 
     async def run_forever(self) -> None:

@@ -21,7 +21,8 @@ from ..engine import MatchEngine
 
 class ClusterRouteTable:
     def __init__(self, engine: Optional[MatchEngine] = None) -> None:
-        self.engine = engine or MatchEngine()
+        # not `engine or ...`: an empty MatchEngine is falsy (__len__)
+        self.engine = engine if engine is not None else MatchEngine()
         # filter -> set of node names holding local subscribers for it
         self._nodes_by_filter: Dict[str, Set[str]] = {}
         self._filters_by_node: Dict[str, Set[str]] = {}

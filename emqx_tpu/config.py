@@ -77,6 +77,8 @@ class BrokerEngineConfig:
     f_width: int = 16
     m_cap: int = 128
     rebuild_threshold: int = 4096
+    background_rebuild: bool = True  # fold deltas off-thread (no stall)
+    batch_publish: bool = True  # route live publishes via PublishBatcher
     batch_window_ms: float = 1.0  # micro-batch accumulation window
     batch_max: int = 4096
 

@@ -30,6 +30,21 @@ from .ops.dictionary import TokenDict, encode_topics
 from .ops.trie_host import HostTrie
 
 
+def _pad_batch(tokens, lengths, dollar):
+    """Pad the batch to a power-of-two bucket so XLA sees a bounded set
+    of shapes (no recompile storm on ragged publish batches)."""
+    b = tokens.shape[0]
+    bp = 16
+    while bp < b:
+        bp *= 2
+    if bp != b:
+        pad = bp - b
+        tokens = np.pad(tokens, ((0, pad), (0, 0)), constant_values=-4)
+        lengths = np.pad(lengths, (0, pad))  # length 0 => inert row
+        dollar = np.pad(dollar, (0, pad), constant_values=True)
+    return tokens, lengths, dollar
+
+
 def make_fid_arr(fids: List[Hashable]) -> np.ndarray:
     """Position -> fid, vectorized-indexable: int64 fast path when every
     fid is an int; object fallback (filled by assignment so tuple fids
@@ -79,6 +94,12 @@ class MatchEngine:
         # one — the `emqx_router_syncer` no-stop-the-world property
         # (/root/reference/apps/emqx/src/emqx_router_syncer.erl:58)
         self._lock = threading.Lock()
+        # serializes host-side mutation vs. the overlay/encode phases of
+        # a match running on another thread (the PublishBatcher runs the
+        # device step in an executor so the event loop keeps reading
+        # sockets); the kernel call itself runs OUTSIDE this lock on an
+        # immutable snapshot, so a SUBSCRIBE never waits on the device
+        self._mlock = threading.RLock()
         self._building = False
         self._built: Optional[Tuple] = None  # (aut, dev, fid_arr, base_fids)
         self._build_thread: Optional[threading.Thread] = None
@@ -88,6 +109,10 @@ class MatchEngine:
     # ------------------------------------------------------------- mutation
 
     def insert(self, flt: str, fid: Hashable) -> None:
+        with self._mlock:
+            self._insert_locked(flt, fid)
+
+    def _insert_locked(self, flt: str, fid: Hashable) -> None:
         if self._built is not None:
             self._poll_swap()
         T.validate_filter(flt)
@@ -119,6 +144,10 @@ class MatchEngine:
             self._exact.setdefault(flt, set()).add(fid)
 
     def delete(self, fid: Hashable) -> bool:
+        with self._mlock:
+            return self._delete_locked(fid)
+
+    def _delete_locked(self, fid: Hashable) -> bool:
         flt = self._by_fid.pop(fid, None)
         if flt is None:
             return False
@@ -237,6 +266,27 @@ class MatchEngine:
             self._pending_deletes = set()
             self._building = False
 
+    def warmup(self, max_batch: int = 4096) -> int:
+        """Pre-compile the kernel for every power-of-two batch bucket up
+        to ``max_batch`` (the `_pad_batch` shape set), so a production
+        publish flood never stalls on a first-use XLA compile.  Returns
+        the number of buckets warmed (0 when the device path is off)."""
+        with self._mlock:
+            device_on = (
+                self.use_device is not False
+                and self._aut is not None
+                and self._aut.n_nodes > 1
+            )
+        if not device_on:
+            return 0
+        n = 0
+        bp = 16
+        while bp <= max_batch:
+            self.match_batch(["\x00warmup"] * bp)
+            n += 1
+            bp *= 2
+        return n
+
     def index_stats(self) -> Dict[str, object]:
         return {
             "base": len(self._base_fids),
@@ -263,21 +313,66 @@ class MatchEngine:
         out |= self._wild.match_words(topic_words)
         return out
 
-    def match_batch(self, topics: Sequence[str]) -> List[Set[Hashable]]:
-        if self._built is not None:
-            self._poll_swap()
-        words = [T.words(t) for t in topics]
-        device_on = (
-            self.use_device is not False
-            and self._aut is not None
-            and self._aut.n_nodes > 1
+    def _snapshot_refs(self) -> Tuple:
+        """Coherent (automaton, device tables, fid array, delta, deep,
+        deleted) snapshot; must be captured under ``_mlock`` so a
+        concurrent rebuild swap cannot mix generations.  delta/deleted
+        belong to the SAME generation as the automaton: a swap landing
+        mid-kernel replaces them with (empty) successors folded into the
+        new base, and overlaying those against the old base would drop
+        every delta-resident subscription for the window."""
+        return (
+            self._aut,
+            self._device_tables(),
+            self._fid_arr,
+            self._delta,
+            self._deep,
+            self._deleted,
         )
-        if not device_on:
-            return [self.match_host(ws) for ws in words]
 
-        rows, gpos, ovf = self.match_batch_flat(words)
-        fid_arr = self._fid_arr
-        deleted = self._deleted
+    def match_batch(self, topics: Sequence[str]) -> List[Set[Hashable]]:
+        """Staged so the device step runs lock-free on an immutable
+        snapshot: encode/snapshot under the mutation lock, kernel
+        outside it, overlay (exact/delta/deep/deleted — possibly newer
+        than the snapshot, which only *adds* correctness) under it
+        again."""
+        words = [T.words(t) for t in topics]
+        with self._mlock:
+            if self._built is not None:
+                self._poll_swap()
+            device_on = (
+                self.use_device is not False
+                and self._aut is not None
+                and self._aut.n_nodes > 1
+            )
+            if device_on:
+                snap = self._snapshot_refs()
+        if not device_on:
+            # per-topic locking: holding _mlock across the whole batch
+            # would stall a loop-thread SUBSCRIBE (and with it the
+            # entire event loop) for the full window when this runs in
+            # the batcher's executor
+            out: List[Set[Hashable]] = []
+            for ws in words:
+                with self._mlock:
+                    out.append(self.match_host(ws))
+            return out
+        rows, gpos, ovf = self._flat_from_snapshot(snap, words)
+        with self._mlock:
+            return self._overlay(topics, words, rows, gpos, ovf, snap)
+
+    def match_batch_host(self, topics: Sequence[str]) -> List[Set[Hashable]]:
+        """Pure-host batch match (the device-failure fallback path)."""
+        out: List[Set[Hashable]] = []
+        for t in topics:
+            with self._mlock:
+                out.append(self.match_host(T.words(t)))
+        return out
+
+    def _overlay(
+        self, topics, words, rows, gpos, ovf, snap
+    ) -> List[Set[Hashable]]:
+        _, _, fid_arr, delta, deep, deleted = snap
         fids_flat = fid_arr[gpos]
         per_row = np.bincount(rows, minlength=len(words))
         chunks = np.split(fids_flat, np.cumsum(per_row)[:-1])
@@ -291,10 +386,10 @@ class MatchEngine:
                 fids -= deleted
             if self._exact:
                 fids |= self._exact.get(topics[i], set())
-            if len(self._delta):
-                fids |= self._delta.match_words(ws)
-            if len(self._deep):
-                fids |= self._deep.match_words(ws)
+            if len(delta):
+                fids |= delta.match_words(ws)
+            if len(deep):
+                fids |= deep.match_words(ws)
             out.append(fids)
         return out
 
@@ -306,35 +401,29 @@ class MatchEngine:
         (`expand_codes_host`) — the SURVEY §7 amplification strategy.
         Rows flagged ``ovf`` must be re-matched on the host.  Callers
         must still overlay exact/delta/deep/deleted state."""
+        with self._mlock:
+            snap = self._snapshot_refs()
+        return self._flat_from_snapshot(snap, words)
+
+    def _flat_from_snapshot(self, snap: Tuple, words: Sequence[T.Words]):
         from .ops.automaton import expand_codes_host
         from .ops.match_kernel import match_batch
 
+        aut, tables = snap[0], snap[1]
         tokens, lengths, dollar = encode_topics(
-            self._tdict, words, self._aut.kernel_levels
+            self._tdict, words, aut.kernel_levels
         )
-        # pad the batch to a power-of-two bucket so XLA sees a bounded
-        # set of shapes (no recompile storm on ragged publish batches)
         b = tokens.shape[0]
-        bp = 16
-        while bp < b:
-            bp *= 2
-        if bp != b:
-            pad = bp - b
-            tokens = np.pad(tokens, ((0, pad), (0, 0)), constant_values=-4)
-            lengths = np.pad(lengths, (0, pad))  # length 0 => inert row
-            dollar = np.pad(dollar, (0, pad), constant_values=True)
-
-        tables = self._device_tables()
+        tokens, lengths, dollar = _pad_batch(tokens, lengths, dollar)
         codes, _, ovf = match_batch(
             *tables,
             tokens,
             lengths,
             dollar,
-            probes=self._aut.probes,
+            probes=aut.probes,
             f_width=self.f_width,
             m_cap=self.m_cap,
         )
-        aut = self._aut
         rows, pos = expand_codes_host(
             aut.code_off, aut.code_idx, np.asarray(codes)[:b]
         )

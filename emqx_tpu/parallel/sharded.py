@@ -267,9 +267,15 @@ class ShardedMatchEngine(MatchEngine):
         )
 
     def match_batch_flat(self, words: Sequence[T.Words]):
+        with self._mlock:
+            snap = self._snapshot_refs()
+        return self._flat_from_snapshot(snap, words)
+
+    def _flat_from_snapshot(self, snap, words: Sequence[T.Words]):
         from ..ops.automaton import expand_codes_host
 
-        index: ShardedIndex = self._aut
+        index: ShardedIndex = snap[0]
+        dev_tables = snap[1]
         tokens, lengths, dollar = encode_topics(
             self._tdict, words, index.kernel_levels
         )
@@ -287,7 +293,7 @@ class ShardedMatchEngine(MatchEngine):
             dollar = np.pad(dollar, (0, bp - b), constant_values=True)
         codes, _, ovf, _ = sharded_match(
             self.mesh,
-            *self._device_tables(),
+            *dev_tables,
             tokens,
             lengths,
             dollar,

@@ -29,8 +29,10 @@ class Router:
         engine: Optional[MatchEngine] = None,
         shared: Optional[SharedSubManager] = None,
     ) -> None:
-        self.engine = engine or MatchEngine()
-        self.shared = shared or SharedSubManager()
+        # `engine or MatchEngine()` would DISCARD a configured empty
+        # engine: MatchEngine defines __len__, so a fresh one is falsy
+        self.engine = engine if engine is not None else MatchEngine()
+        self.shared = shared if shared is not None else SharedSubManager()
         # cluster hooks: fired when a real filter gains its first local
         # subscriber / loses its last one (the sync_route add/delete
         # points, emqx_broker.erl:691-721) — ClusterNode broadcasts them

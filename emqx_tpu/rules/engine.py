@@ -23,6 +23,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..message import Message
+from .predicate import PredicateProgram, compile_where
 from .runtime import build_env, eval_select, eval_where
 from .sql import ParsedSql, parse_sql
 
@@ -94,6 +95,9 @@ class Rule:
     actions: List[Action] = field(default_factory=list)
     enabled: bool = True
     description: str = ""
+    # compiled WHERE column program (None when the AST has nodes the
+    # compiler doesn't cover → per-message interpreter fallback)
+    program: Optional[PredicateProgram] = None
     # counters (emqx_rule_metrics)
     matched: int = 0
     passed: int = 0
@@ -142,6 +146,7 @@ class RuleEngine:
             actions=list(actions or ()),
             enabled=enabled,
             description=description,
+            program=compile_where(parsed.where),
         )
         self.rules[rule_id] = rule
         if self.broker is not None:
@@ -184,6 +189,56 @@ class RuleEngine:
             hits += 1
             selected = eval_select(rule.parsed, env)
             self._run_actions(rule, selected, msg)
+        if self.broker is not None and hits:
+            self.broker.metrics.inc("rules.matched", hits)
+        return hits
+
+    def apply_batch(
+        self, items: List[Tuple[Message, List[str]]]
+    ) -> int:
+        """Run rule hits for a whole publish micro-batch: per rule, the
+        WHERE evaluates over all its matched messages in one vectorized
+        column pass (PredicateProgram; interpreter fallback for
+        uncompilable predicates) — the batched analogue of
+        emqx_rule_runtime:apply_rules/3 per message."""
+        if not items:
+            return 0
+        if len(items) == 1:
+            return self.apply(items[0][0], items[0][1])
+        msgs = [m for m, _ in items]
+        env_cache: List[Optional[Dict[str, Any]]] = [None] * len(items)
+
+        def env(i: int) -> Dict[str, Any]:
+            e = env_cache[i]
+            if e is None:
+                e = env_cache[i] = build_env(msgs[i])
+            return e
+
+        by_rule: Dict[str, List[int]] = {}
+        for i, (_, rids) in enumerate(items):
+            for rid in rids:
+                by_rule.setdefault(rid, []).append(i)
+        hits = 0
+        for rid, idxs in by_rule.items():
+            rule = self.rules.get(rid)
+            if rule is None or not rule.enabled:
+                continue
+            rule.matched += len(idxs)
+            if rule.program is not None and len(idxs) > 1:
+                mask = rule.program.eval_batch([env(i) for i in idxs])
+                passed = [i for i, ok in zip(idxs, mask.tolist()) if ok]
+            else:
+                passed = [
+                    i
+                    for i in idxs
+                    if eval_where(rule.parsed.where, env(i))
+                ]
+            rule.failed += len(idxs) - len(passed)
+            rule.passed += len(passed)
+            hits += len(passed)
+            for i in passed:
+                selected = eval_select(rule.parsed, env(i))
+                self._run_actions(rule, selected, msgs[i])
         if self.broker is not None and hits:
             self.broker.metrics.inc("rules.matched", hits)
         return hits

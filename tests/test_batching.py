@@ -1,0 +1,170 @@
+"""Live publish micro-batching: the broker's production path routes
+through PublishBatcher (one device step per window) and the rule
+engine's WHERE runs vectorized over each window — VERDICT r2 weak #1/#2
+(the reference analogue: emqx_broker:publish per message at
+emqx_broker.erl:244-253, amortized here per SURVEY §7)."""
+
+import asyncio
+
+import pytest
+
+from emqx_tpu.broker.listener import BrokerServer
+from emqx_tpu.config import BrokerConfig, ListenerConfig
+from emqx_tpu.message import Message
+from emqx_tpu.rules.engine import FunctionAction
+from mqtt_client import TestClient
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_server(**engine_kw):
+    cfg = BrokerConfig()
+    cfg.listeners = [ListenerConfig(port=0)]
+    for k, v in engine_kw.items():
+        setattr(cfg.engine, k, v)
+    return BrokerServer(cfg)
+
+
+def test_batcher_installed_by_default():
+    async def t():
+        srv = make_server()
+        await srv.start()
+        assert srv.broker.batcher is not None
+        await srv.stop()
+        assert srv.broker.batcher is None
+
+    run(t())
+
+
+def test_concurrent_publishers_one_window():
+    """Many concurrent QoS1 publishes coalesce into batcher windows;
+    every message is delivered and acked exactly once."""
+
+    async def t():
+        srv = make_server(batch_window_ms=5.0)
+        await srv.start()
+        port = srv.listeners[0].port
+        sub = TestClient(port, "sub")
+        await sub.connect()
+        await sub.subscribe("load/#", qos=1)
+
+        pubs = [TestClient(port, f"p{i}") for i in range(8)]
+        for p in pubs:
+            await p.connect()
+
+        match_calls = [0]
+        orig_match = srv.broker.publish_match
+
+        def counting_match(live):
+            match_calls[0] += 1
+            return orig_match(live)
+
+        srv.broker.publish_match = counting_match
+
+        async def blast(p, i):
+            for k in range(10):
+                await p.publish(f"load/{i}/{k}", f"{i}:{k}".encode(), qos=1)
+
+        await asyncio.gather(*(blast(p, i) for i, p in enumerate(pubs)))
+        got = set()
+        for _ in range(80):
+            pkt = await sub.recv_publish()
+            got.add(pkt.payload.decode())
+        assert got == {f"{i}:{k}" for i in range(8) for k in range(10)}
+        # the batcher actually batched: strictly fewer match steps than
+        # messages (8 concurrent publishers with 5 ms windows coalesce)
+        assert srv.broker.metrics.val("messages.publish") >= 80
+        assert 0 < match_calls[0] < 80
+        for p in pubs:
+            await p.disconnect()
+        await sub.disconnect()
+        await srv.stop()
+
+    run(t())
+
+
+def test_rules_batched_where_over_live_path():
+    """A compilable WHERE evaluates via PredicateProgram over the
+    window; results equal the interpreter's per-message verdicts."""
+
+    async def t():
+        srv = make_server(batch_window_ms=5.0)
+        await srv.start()
+        port = srv.listeners[0].port
+        hits = []
+        rule = srv.broker.rules.add_rule(
+            "r1",
+            "SELECT payload.v AS v FROM \"t/#\" WHERE payload.v > 5",
+            actions=[FunctionAction(fn=lambda sel, msg: hits.append(sel["v"]))],
+        )
+        assert rule.program is not None  # compiled, not interpreted
+
+        pub = TestClient(port, "pub")
+        await pub.connect()
+        for v in range(10):
+            await pub.publish("t/x", b'{"v": %d}' % v, qos=1)
+        await pub.disconnect()
+        await asyncio.sleep(0.05)
+        assert sorted(hits) == [6, 7, 8, 9]
+        assert rule.matched == 10 and rule.passed == 4 and rule.failed == 6
+        await srv.stop()
+
+    run(t())
+
+
+def test_apply_batch_matches_interpreter():
+    """apply_batch (vectorized WHERE) and apply (interpreter) agree on
+    a mixed batch, including null/missing and string predicates."""
+    from emqx_tpu.broker.broker import Broker
+
+    payloads = [
+        b'{"temp": 31, "site": "sf"}',
+        b'{"temp": 12, "site": "la"}',
+        b'{"temp": 40}',
+        b"not json",
+        b'{"temp": "hot", "site": "sf"}',
+    ]
+    sql = "SELECT * FROM \"m/#\" WHERE payload.temp > 20 and payload.site = 'sf'"
+
+    def run_engine(batched):
+        broker = Broker(BrokerConfig())
+        got = []
+        broker.rules.add_rule(
+            "r",
+            sql,
+            actions=[FunctionAction(fn=lambda sel, msg: got.append(msg.payload))],
+        )
+        msgs = [Message(topic="m/a", payload=p, qos=1) for p in payloads]
+        if batched:
+            broker.rules.apply_batch([(m, ["r"]) for m in msgs])
+        else:
+            for m in msgs:
+                broker.rules.apply(m, ["r"])
+        return got
+
+    assert run_engine(True) == run_engine(False) == [payloads[0]]
+
+
+def test_batcher_failure_does_not_ack():
+    """If routing raises, the QoS1 publish must NOT be acked (client
+    retransmits); the connection is closed with an error instead."""
+
+    async def t():
+        srv = make_server(batch_window_ms=1.0)
+        await srv.start()
+        port = srv.listeners[0].port
+
+        def boom(*a, **k):
+            raise RuntimeError("injected")
+
+        srv.broker.publish_match = boom
+        pub = TestClient(port, "pub")
+        await pub.connect()
+        with pytest.raises(Exception):
+            await pub.publish("t/x", b"y", qos=1)
+        await pub.close()
+        await srv.stop()
+
+    run(t())

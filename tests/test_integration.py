@@ -127,7 +127,12 @@ def test_retained_message_replay():
         try:
             pub = TestClient(port, "pub")
             await pub.connect()
-            await pub.publish("state/light", b"on", retain=True)
+            # qos=1: the PUBACK resolves only after the publish window
+            # flushed (retain store included), so the later subscribe
+            # deterministically sees the retained copy.  A qos0 retained
+            # publish racing a foreign subscribe is unordered, as in the
+            # reference (cross-client ordering is not an MQTT guarantee).
+            await pub.publish("state/light", b"on", retain=True, qos=1)
             await pub.disconnect()
 
             sub = TestClient(port, "sub")
