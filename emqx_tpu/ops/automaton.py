@@ -157,17 +157,17 @@ def _build_bucket_table(
         nb *= 2  # probe bound exceeded: grow and retry
 
 
-def build_automaton(
+def encode_filters(
     filters: Sequence[Tuple[object, Tuple[str, ...]]],
     tdict: TokenDict,
     max_levels: int = 16,
-    load: float = 0.5,
-    hash_buckets: int = 0,
-) -> Automaton:
-    """Build the automaton from ``(fid, filter_words)`` pairs.
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, List]:
+    """Encode ``(fid, words)`` pairs into build-input arrays.
 
-    ``hash_buckets`` forces a minimum bucket count so multiple shard
-    automata can share one traced kernel shape (stacked over a mesh).
+    Split from assembly so a caller can keep the arrays of an existing
+    build and re-encode only its delta (`MatchEngine`'s incremental
+    rebuild: the O(N) per-filter Python loop here is the dominant
+    rebuild cost at 10M filters, and N-delta of it is unchanged work).
     """
     nf = len(filters)
     mat = np.full((nf, max_levels), PAD_TOK, np.int32)
@@ -182,7 +182,41 @@ def build_automaton(
         blen[i] = len(body)
         is_hash[i] = hsh
         flist.append((fid, ws))
+    return mat, blen, is_hash, flist
 
+
+def build_automaton(
+    filters: Sequence[Tuple[object, Tuple[str, ...]]],
+    tdict: TokenDict,
+    max_levels: int = 16,
+    load: float = 0.5,
+    hash_buckets: int = 0,
+) -> Automaton:
+    """Build the automaton from ``(fid, filter_words)`` pairs.
+
+    ``hash_buckets`` forces a minimum bucket count so multiple shard
+    automata can share one traced kernel shape (stacked over a mesh).
+    """
+    return assemble_automaton(
+        *encode_filters(filters, tdict, max_levels),
+        max_levels=max_levels,
+        load=load,
+        hash_buckets=hash_buckets,
+    )
+
+
+def assemble_automaton(
+    mat: np.ndarray,
+    blen: np.ndarray,
+    is_hash: np.ndarray,
+    flist: List[Tuple[object, Tuple[str, ...]]],
+    max_levels: int = 16,
+    load: float = 0.5,
+    hash_buckets: int = 0,
+) -> Automaton:
+    """Assemble from pre-encoded arrays (fully vectorized numpy — the
+    GIL-friendly half of the build)."""
+    nf = len(flist)
     # BFS by depth: unique (parent, token) pairs become child nodes.
     parent = np.zeros(nf, np.int64)
     n_nodes = 1

@@ -50,10 +50,14 @@ class HostTrie:
     def filters(self) -> Iterator[Tuple[Hashable, Tuple[str, ...]]]:
         return iter(self._filters.items())
 
-    def insert(self, flt: str, fid: Hashable) -> None:
+    def insert(
+        self, flt: str, fid: Hashable, ws: Optional[Tuple[str, ...]] = None
+    ) -> None:
         """Insert filter `flt` under id `fid`. Re-inserting the same id
-        replaces its previous filter."""
-        ws = T.words(flt)
+        replaces its previous filter.  ``ws`` skips the re-split when
+        the caller already has the words."""
+        if ws is None:
+            ws = T.words(flt)
         if fid in self._filters:
             if self._filters[fid] == ws:
                 return

@@ -248,6 +248,11 @@ class ShardedMatchEngine(MatchEngine):
 
     # -------------------------------------------- sharded build/match
 
+    def _snapshot_inputs(self):
+        # the sharded builder re-partitions from the filter list; no
+        # incremental array cache (base-class optimization) yet
+        return self._snapshot_filters()
+
     def _build(
         self, filters, hash_buckets: int = 0, device_put: bool = False
     ):
@@ -258,7 +263,7 @@ class ShardedMatchEngine(MatchEngine):
         )
         fids = [fid for a in index.shards for fid, _ in a.filters]
         dev = self._device_put(index) if device_put else None
-        return index, dev, make_fid_arr(fids), set(fids)
+        return index, dev, make_fid_arr(fids), set(fids), None
 
     def _device_put(self, index: ShardedIndex):
         return tuple(

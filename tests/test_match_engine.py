@@ -270,3 +270,108 @@ def test_background_rebuild_no_stop_the_world():
     for t, g in zip(topics, got):
         want = {f for f, w in live.items() if T.match_words(T.words(t), T.words(w))}
         assert g == want, (t, g, want)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_delta_automaton_churn_equivalence(seed):
+    """With a tiny delta-automaton threshold, sustained churn runs
+    through the two-tier device path (base automaton + delta automaton
+    + host residual) and must stay oracle-equal, including deletes of
+    delta-resident filters and a big rebuild dropping the delta tier."""
+    rng = random.Random(2000 + seed)
+    engine = MatchEngine(
+        max_levels=8,
+        rebuild_threshold=10**9,
+        delta_aut_threshold=32,
+    )
+    oracle = HostTrie()
+    exact = {}
+    fid = 0
+    live = {}
+    built_delta = False
+    for round_ in range(5):
+        for _ in range(100):
+            flt = random_filter(rng)
+            try:
+                T.validate_filter(flt)
+            except ValueError:
+                continue
+            engine.insert(flt, fid)
+            live[fid] = flt
+            if T.is_wildcard(flt):
+                oracle.insert(flt, fid)
+            else:
+                exact.setdefault(flt, set()).add(fid)
+            fid += 1
+        built_delta = built_delta or engine._daut is not None
+        if round_ == 0:
+            engine.rebuild()  # establish a base; later rounds churn
+        if round_ == 3:
+            # deletes hitting base AND delta-automaton entries
+            for del_fid in list(live)[::2]:
+                flt = live.pop(del_fid)
+                engine.delete(del_fid)
+                if T.is_wildcard(flt):
+                    oracle.delete_id(del_fid)
+                else:
+                    exact[flt].discard(del_fid)
+        topics = [random_topic(rng) for _ in range(60)]
+        check_engine_vs_oracle(engine, oracle, exact, topics)
+    assert built_delta  # the two-tier path was actually exercised
+    # a big rebuild folds everything and drops the delta tier
+    engine.rebuild()
+    assert engine._daut is None
+    topics = [random_topic(rng) for _ in range(60)]
+    check_engine_vs_oracle(engine, oracle, exact, topics)
+
+
+def test_delta_fold_residual_bound():
+    """The host residual stays geometrically bounded while the delta
+    folds into the device tier (the churn cliff from VERDICT r2 weak
+    #4), and table capacity classes keep the compiled-shape set small."""
+    engine = MatchEngine(
+        max_levels=8, rebuild_threshold=10**9, delta_aut_threshold=64
+    )
+    shapes = set()
+    for i in range(4000):
+        engine.insert(f"churn/{i % 97}/+/x{i}", i)
+        assert len(engine._delta_new) <= max(64, len(engine._delta) // 4), i
+        if engine._daut is not None:
+            shapes.add(
+                (
+                    engine._daut.node_rows.shape,
+                    engine._daut.kernel_levels,
+                    engine._daut.probes,
+                )
+            )
+    assert engine._daut is not None
+    assert len(engine._daut_fids) + len(engine._delta_new) >= 4000 - 64
+    # pow2 node-capacity classes bound the traced-shape set
+    assert len(shapes) <= 4
+
+
+def test_reinserted_fid_survives_fold():
+    """A fid deleted and re-inserted with a different filter must keep
+    matching after the delta fold: tombstones are per-generation (the
+    base's stale entry is masked; the fold's current entry is not)."""
+    engine = MatchEngine(
+        max_levels=8, rebuild_threshold=10**9, delta_aut_threshold=16
+    )
+    for i in range(40):
+        engine.insert(f"seed/{i}/+", i)
+    engine.rebuild()  # all 40 in the base
+    # re-point fid 7 at a different filter (delete+insert via replace)
+    engine.insert("moved/here/#", 7)
+    assert engine.match("moved/here/x") == {7}
+    assert 7 not in engine.match("seed/7/q")
+    # force folds until fid 7 lives in the delta automaton
+    for i in range(100, 140):
+        engine.insert(f"churn/{i}/+", i)
+    assert engine._daut is not None and 7 in engine._daut_fids
+    assert engine.match("moved/here/x") == {7}  # the r3 review regression
+    assert 7 not in engine.match("seed/7/q")
+    # and a deleted fid stays deleted across the fold
+    engine.delete(8)
+    for i in range(200, 240):
+        engine.insert(f"churn2/{i}/+", i)
+    assert 8 not in engine.match("seed/8/q")

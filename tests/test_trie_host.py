@@ -128,3 +128,70 @@ def test_randomized_with_deletions():
             name = rand_name(rng)
             assert t.match(name) == t.match_brute(name)
     assert len(t) == len(alive)
+
+
+# ---------------------------------------------------------------- native
+
+def _native_or_skip():
+    from emqx_tpu.ops.trie_native import NativeTrie, load
+
+    if load() is None:
+        import pytest
+
+        pytest.skip("native hosttrie unavailable")
+    return NativeTrie()
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_native_trie_equivalence(seed):
+    """NativeTrie (C++) must agree with HostTrie (the Python oracle) on
+    randomized insert/delete/match churn, including '$'-topics, empty
+    levels, and fid reuse across different filters."""
+    import random
+
+    from emqx_tpu import topic as T
+    from emqx_tpu.ops.trie_host import HostTrie
+
+    rng = random.Random(7000 + seed)
+    native = _native_or_skip()
+    py = HostTrie()
+    words = ["a", "b", "c", "dev", "x1", "", "$SYS", "+", "#"]
+    live = set()
+    for step in range(1500):
+        op = rng.random()
+        if op < 0.55 or not live:
+            depth = rng.randint(1, 4)
+            ws = [rng.choice(words) for _ in range(depth)]
+            flt = "/".join(ws)
+            try:
+                T.validate_filter(flt)
+            except ValueError:
+                continue
+            fid = rng.choice(
+                ["s%d" % rng.randint(0, 300), rng.randint(0, 300),
+                 ("rule", rng.randint(0, 50))]
+            )
+            native.insert(flt, fid)
+            py.insert(flt, fid)
+            live.add(fid)
+        else:
+            fid = rng.choice(sorted(live, key=str))
+            assert native.delete_id(fid) == py.delete_id(fid)
+            live.discard(fid)
+        if step % 100 == 99:
+            assert len(native) == len(py)
+            for _ in range(30):
+                depth = rng.randint(1, 5)
+                t = "/".join(
+                    rng.choice(["a", "b", "c", "dev", "x1", "", "$SYS", "q9"])
+                    for _ in range(depth)
+                )
+                assert native.match(t) == py.match_words(T.words(t)), t
+
+
+def test_native_trie_large_matchset_grows_buffer():
+    native = _native_or_skip()
+    for i in range(5000):
+        native.insert("big/#", i)
+    got = native.match("big/one/two")
+    assert got == set(range(5000))
