@@ -21,6 +21,10 @@ log = logging.getLogger("emqx_tpu.listener")
 
 
 class Listener:
+    """One bound socket accepting MQTT clients over tcp/ssl/ws/wss
+    (the four transports emqx_listeners starts via esockd/cowboy,
+    emqx_listeners.erl:430-447)."""
+
     def __init__(self, broker: Broker, cfg: ListenerConfig) -> None:
         self.broker = broker
         self.cfg = cfg
@@ -34,13 +38,28 @@ class Listener:
             return self.cfg.port
         return self._server.sockets[0].getsockname()[1]
 
+    def _ssl_context(self):
+        import ssl as ssl_mod
+
+        ctx = ssl_mod.SSLContext(ssl_mod.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(self.cfg.certfile, self.cfg.keyfile)
+        if self.cfg.cacertfile:
+            ctx.load_verify_locations(self.cfg.cacertfile)
+        if self.cfg.verify:
+            ctx.verify_mode = ssl_mod.CERT_REQUIRED
+        return ctx
+
     async def start(self) -> None:
+        ssl_ctx = (
+            self._ssl_context() if self.cfg.type in ("ssl", "wss") else None
+        )
         self._server = await asyncio.start_server(
-            self._on_client, self.cfg.bind, self.cfg.port
+            self._on_client, self.cfg.bind, self.cfg.port, ssl=ssl_ctx
         )
         log.info(
-            "listener %s started on %s:%d",
+            "listener %s (%s) started on %s:%d",
             self.cfg.name,
+            self.cfg.type,
             self.cfg.bind,
             self.port,
         )
@@ -61,12 +80,43 @@ class Listener:
         if len(self._conns) >= self.cfg.max_connections:
             writer.close()
             return
-        conn = Connection(
-            self.broker, reader, writer, mountpoint=self.cfg.mountpoint
-        )
+        # count the connection against the cap from accept time — a
+        # slow (up to 10 s) WS handshake must not be a free pass
         task = asyncio.current_task()
         self._conns.add(task)
         try:
+            if self.cfg.type in ("ws", "wss"):
+                from .ws import WsError, WsServerStream, server_handshake
+
+                try:
+                    await asyncio.wait_for(
+                        server_handshake(reader, writer), 10.0
+                    )
+                except (
+                    WsError,
+                    asyncio.TimeoutError,
+                    asyncio.IncompleteReadError,
+                    asyncio.LimitOverrunError,
+                    ConnectionError,
+                    ValueError,
+                ):
+                    writer.close()
+                    return
+                stream = WsServerStream(
+                    reader,
+                    writer,
+                    max_size=self.broker.config.mqtt.max_packet_size * 2,
+                )
+                conn = Connection(
+                    self.broker,
+                    stream,
+                    stream,
+                    mountpoint=self.cfg.mountpoint,
+                )
+            else:
+                conn = Connection(
+                    self.broker, reader, writer, mountpoint=self.cfg.mountpoint
+                )
             await conn.run()
         finally:
             self._conns.discard(task)
@@ -80,9 +130,13 @@ class BrokerServer:
         self.listeners: List[Listener] = [
             Listener(self.broker, lc)
             for lc in self.broker.config.listeners
-            if lc.enable and lc.type == "tcp"
+            if lc.enable and lc.type in ("tcp", "ssl", "ws", "wss")
         ]
         self._housekeeper: Optional[asyncio.Task] = None
+        from ..sys_topics import SysTopics
+
+        self.sys = SysTopics(self.broker)
+        self.api = None  # MgmtApi when config.api.enable
 
     async def start(self) -> None:
         eng_cfg = self.broker.config.engine
@@ -97,6 +151,12 @@ class BrokerServer:
             await self.broker.batcher.start()
         for lst in self.listeners:
             await lst.start()
+        api_cfg = self.broker.config.api
+        if api_cfg.enable:
+            from ..mgmt import MgmtApi
+
+            self.api = MgmtApi(self, bind=api_cfg.bind, port=api_cfg.port)
+            await self.api.start()
         self._housekeeper = asyncio.get_running_loop().create_task(
             self._housekeeping()
         )
@@ -107,6 +167,7 @@ class BrokerServer:
         while True:
             await asyncio.sleep(1.0)
             self.broker.tick()
+            self.sys.tick()
 
     async def stop(self) -> None:
         if self._housekeeper is not None:
@@ -116,6 +177,9 @@ class BrokerServer:
             except asyncio.CancelledError:
                 pass
             self._housekeeper = None
+        if self.api is not None:
+            await self.api.stop()
+            self.api = None
         for lst in self.listeners:
             await lst.stop()
         if self.broker.batcher is not None:

@@ -44,12 +44,17 @@ class MqttConfig:
 @dataclass
 class ListenerConfig:
     name: str = "tcp_default"
-    type: str = "tcp"  # tcp | ws
+    type: str = "tcp"  # tcp | ssl | ws | wss
     bind: str = "0.0.0.0"
     port: int = 1883
     max_connections: int = 1024000
     mountpoint: Optional[str] = None
     enable: bool = True
+    # TLS options (ssl/wss listeners; emqx_tls_lib's core knobs)
+    certfile: Optional[str] = None
+    keyfile: Optional[str] = None
+    cacertfile: Optional[str] = None
+    verify: bool = False  # require + verify client certificates
 
 
 @dataclass
@@ -90,6 +95,15 @@ class SysConfig:
 
 
 @dataclass
+class ApiConfig:
+    """Management REST + Prometheus endpoint (emqx_management slice)."""
+
+    enable: bool = False
+    bind: str = "127.0.0.1"
+    port: int = 18083
+
+
+@dataclass
 class DurableConfig:
     """Durable storage + persistent sessions (emqx_durable_storage)."""
 
@@ -111,6 +125,7 @@ class BrokerConfig:
     retainer: RetainerConfig = field(default_factory=RetainerConfig)
     engine: BrokerEngineConfig = field(default_factory=BrokerEngineConfig)
     sys: SysConfig = field(default_factory=SysConfig)
+    api: ApiConfig = field(default_factory=ApiConfig)
     durable: DurableConfig = field(default_factory=DurableConfig)
     node_name: str = "emqx_tpu@127.0.0.1"
 

@@ -100,28 +100,41 @@ class Metrics:
 
     def __init__(self) -> None:
         self._c: List[int] = [0] * len(METRICS)
+        # names outside the fixed slot registry (per-feature counters
+        # like exhook.* — the reference's emqx_metrics_worker role)
+        self._extra: Dict[str, int] = {}
+        # increments arrive from the event loop AND worker threads
+        # (exhook's gRPC pool, the batcher's executor); Python's += is
+        # not atomic, so counting is locked (uncontended ~100 ns)
+        self._lock = threading.Lock()
         self.start_time = time.time()
 
     def inc(self, name: str, by: int = 1) -> None:
-        self._c[_SLOT[name]] += by
+        i = _SLOT.get(name)
+        with self._lock:
+            if i is None:
+                self._extra[name] = self._extra.get(name, 0) + by
+            else:
+                self._c[i] += by
 
     def val(self, name: str) -> int:
-        return self._c[_SLOT[name]]
+        i = _SLOT.get(name)
+        return self._extra.get(name, 0) if i is None else self._c[i]
 
     def counter(self, name: str) -> Callable[[], None]:
-        slot = _SLOT[name]
-        c = self._c
-
         def bump() -> None:
-            c[slot] += 1
+            self.inc(name)
 
         return bump
 
     def all(self) -> Dict[str, int]:
-        return {name: self._c[i] for name, i in _SLOT.items()}
+        out = {name: self._c[i] for name, i in _SLOT.items()}
+        out.update(self._extra)
+        return out
 
     def reset(self) -> None:
         self._c = [0] * len(METRICS)
+        self._extra = {}
 
 
 class Stats:

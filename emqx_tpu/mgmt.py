@@ -1,0 +1,215 @@
+"""Management REST API + Prometheus exposition.
+
+A compact analogue of `emqx_management`'s minirest API
+(/root/reference/apps/emqx_management/src, ~15.6 kLoC of OpenAPI
+handlers) and `emqx_prometheus` (/root/reference/apps/emqx_prometheus/
+src/emqx_prometheus.erl): read endpoints for clients/subscriptions/
+routes/rules/stats/metrics, write endpoints for publish/kick/rules, and
+a ``/metrics`` scrape in Prometheus text exposition format.  Served
+with aiohttp on the broker's event loop.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Optional
+
+from aiohttp import web
+
+from .message import Message
+
+
+def _json(data, status: int = 200) -> web.Response:
+    return web.json_response(data, status=status)
+
+
+class MgmtApi:
+    def __init__(self, server, bind: str = "127.0.0.1", port: int = 0) -> None:
+        self.server = server  # BrokerServer
+        self.broker = server.broker
+        self.bind = bind
+        self.port = port
+        self._runner: Optional[web.AppRunner] = None
+
+    # ------------------------------------------------------- lifecycle
+
+    async def start(self) -> None:
+        app = web.Application()
+        r = app.router
+        r.add_get("/api/v5/clients", self.get_clients)
+        r.add_get("/api/v5/clients/{clientid}", self.get_client)
+        r.add_delete("/api/v5/clients/{clientid}", self.kick_client)
+        r.add_get("/api/v5/subscriptions", self.get_subscriptions)
+        r.add_get("/api/v5/topics", self.get_topics)
+        r.add_get("/api/v5/stats", self.get_stats)
+        r.add_get("/api/v5/metrics", self.get_metrics)
+        r.add_get("/api/v5/nodes", self.get_nodes)
+        r.add_get("/api/v5/rules", self.get_rules)
+        r.add_post("/api/v5/rules", self.post_rule)
+        r.add_delete("/api/v5/rules/{rule_id}", self.delete_rule)
+        r.add_post("/api/v5/publish", self.post_publish)
+        r.add_get("/metrics", self.prometheus)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.bind, self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    # --------------------------------------------------------- clients
+
+    async def get_clients(self, request: web.Request) -> web.Response:
+        cm = self.broker.cm
+        out = []
+        for cid in cm.clients():
+            session = cm.lookup(cid)
+            if session is None:
+                continue
+            out.append(
+                {
+                    "clientid": cid,
+                    "connected": cm.connected(cid),
+                    **session.info(),
+                }
+            )
+        return _json({"data": out, "meta": {"count": len(out)}})
+
+    async def get_client(self, request: web.Request) -> web.Response:
+        cid = request.match_info["clientid"]
+        session = self.broker.cm.lookup(cid)
+        if session is None:
+            return _json({"code": "NOT_FOUND"}, status=404)
+        return _json(
+            {
+                "clientid": cid,
+                "connected": self.broker.cm.connected(cid),
+                **session.info(),
+            }
+        )
+
+    async def kick_client(self, request: web.Request) -> web.Response:
+        cid = request.match_info["clientid"]
+        if not self.broker.cm.kick(cid):
+            return _json({"code": "NOT_FOUND"}, status=404)
+        return web.Response(status=204)
+
+    # --------------------------------------------------- subscriptions
+
+    async def get_subscriptions(self, request: web.Request) -> web.Response:
+        out = []
+        router = self.broker.router
+        for cid in self.broker.cm.clients():
+            for flt in sorted(router.subscriptions_of(cid)):
+                out.append({"clientid": cid, "topic": flt})
+        return _json({"data": out, "meta": {"count": len(out)}})
+
+    async def get_topics(self, request: web.Request) -> web.Response:
+        topics = sorted(self.broker.router.topics())
+        node = self.broker.config.node_name
+        return _json(
+            {
+                "data": [{"topic": t, "node": node} for t in topics],
+                "meta": {"count": len(topics)},
+            }
+        )
+
+    # ------------------------------------------------------ stats/meta
+
+    async def get_stats(self, request: web.Request) -> web.Response:
+        stats = self.broker.stats.all()
+        stats["connections.count"] = len(self.broker.cm)
+        stats["retained.count"] = len(self.broker.retainer)
+        return _json(stats)
+
+    async def get_metrics(self, request: web.Request) -> web.Response:
+        return _json(self.broker.metrics.all())
+
+    async def get_nodes(self, request: web.Request) -> web.Response:
+        node = {
+            "node": self.broker.config.node_name,
+            "uptime": int(time.time() - self.broker.metrics.start_time),
+            "connections": len(self.broker.cm),
+            "node_status": "running",
+        }
+        ext = self.broker.external
+        cluster = ext.info() if ext is not None else {}
+        return _json({"data": [node], "cluster": cluster})
+
+    # ----------------------------------------------------------- rules
+
+    async def get_rules(self, request: web.Request) -> web.Response:
+        return _json({"data": self.broker.rules.info()})
+
+    async def post_rule(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+            rule = self.broker.rules.add_rule(
+                body["id"],
+                body["sql"],
+                enabled=body.get("enable", True),
+                description=body.get("description", ""),
+            )
+        except (KeyError, ValueError, json.JSONDecodeError) as exc:
+            return _json({"code": "BAD_REQUEST", "message": str(exc)}, 400)
+        return _json({"id": rule.rule_id, "sql": rule.sql}, status=201)
+
+    async def delete_rule(self, request: web.Request) -> web.Response:
+        if not self.broker.rules.remove_rule(request.match_info["rule_id"]):
+            return _json({"code": "NOT_FOUND"}, status=404)
+        return web.Response(status=204)
+
+    # --------------------------------------------------------- publish
+
+    async def post_publish(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+            msg = Message(
+                topic=body["topic"],
+                payload=str(body.get("payload", "")).encode(),
+                qos=int(body.get("qos", 0)),
+                retain=bool(body.get("retain", False)),
+                from_client=body.get("clientid", "http_api"),
+            )
+        except (KeyError, ValueError, json.JSONDecodeError) as exc:
+            return _json({"code": "BAD_REQUEST", "message": str(exc)}, 400)
+        batcher = self.broker.batcher
+        if batcher is not None:
+            n = await batcher.publish(msg)
+        else:
+            n = self.broker.publish(msg)
+        return _json({"delivered": n})
+
+    # ------------------------------------------------------ prometheus
+
+    async def prometheus(self, request: web.Request) -> web.Response:
+        """Prometheus text exposition of counters + gauges
+        (emqx_prometheus.erl's collect families, minimally)."""
+        lines = []
+
+        def emit(name: str, kind: str, value) -> None:
+            metric = "emqx_" + name.replace(".", "_").replace("-", "_")
+            lines.append(f"# TYPE {metric} {kind}")
+            lines.append(f"{metric} {value}")
+
+        for name, value in sorted(self.broker.metrics.all().items()):
+            emit(name, "counter", value)
+        stats = self.broker.stats.all()
+        stats["connections.count"] = len(self.broker.cm)
+        stats["retained.count"] = len(self.broker.retainer)
+        for name, value in sorted(stats.items()):
+            emit(name, "gauge", value)
+        emit(
+            "uptime_seconds",
+            "gauge",
+            int(time.time() - self.broker.metrics.start_time),
+        )
+        return web.Response(
+            text="\n".join(lines) + "\n",
+            content_type="text/plain",
+            charset="utf-8",
+        )

@@ -1,0 +1,165 @@
+"""exhook graft server: a gRPC client drives the HookProvider service
+over a real loopback channel (the reference contract an external EMQX
+speaks, apps/emqx_exhook/priv/protos/exhook.proto)."""
+
+import grpc
+import pytest
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.config import BrokerConfig
+from emqx_tpu.exhook import pb
+from emqx_tpu.exhook.server import SERVICE, ExhookServer
+from emqx_tpu.rules.engine import FunctionAction
+
+
+def rpc(channel, method, req, resp_cls):
+    fn = channel.unary_unary(
+        f"/{SERVICE}/{method}",
+        request_serializer=lambda m: m.SerializeToString(),
+        response_deserializer=resp_cls.FromString,
+    )
+    return fn(req, timeout=5)
+
+
+@pytest.fixture()
+def served():
+    broker = Broker(BrokerConfig())
+    srv = ExhookServer(broker=broker, bind="127.0.0.1:0")
+    srv.start()
+    chan = grpc.insecure_channel(f"127.0.0.1:{srv.port}")
+    yield broker, srv, chan
+    chan.close()
+    srv.stop()
+
+
+def test_provider_loaded_lists_hooks(served):
+    broker, srv, chan = served
+    resp = rpc(
+        chan,
+        "OnProviderLoaded",
+        pb.ProviderLoadedRequest(
+            broker=pb.BrokerInfo(version="5.8.0"),
+            meta=pb.RequestMeta(node="emqx@remote", cluster_name="cl1"),
+        ),
+        pb.LoadedResponse,
+    )
+    names = {h.name for h in resp.hooks}
+    assert "message.publish" in names and "client.authenticate" in names
+    pub = next(h for h in resp.hooks if h.name == "message.publish")
+    assert list(pub.topics) == ["#"]
+    assert broker.metrics.val("exhook.provider.loaded") == 1
+
+
+def test_message_publish_verdicts(served):
+    broker, srv, chan = served
+
+    from emqx_tpu.hooks import STOP_WITH
+
+    # a hook that drops secret topics and rewrites others
+    def gate(msg):
+        if msg.topic.startswith("secret/"):
+            return STOP_WITH(None)
+        if msg.topic == "rewrite/me":
+            msg.topic = "rewritten/you"
+        return msg
+
+    broker.hooks.add("message.publish", gate)
+
+    def publish(topic, payload=b"x"):
+        return rpc(
+            chan,
+            "OnMessagePublish",
+            pb.MessagePublishRequest(
+                message=pb.Message(topic=topic, payload=payload, qos=1)
+            ),
+            pb.ValuedResponse,
+        )
+
+    ok = publish("plain/topic")
+    assert ok.type == pb.ValuedResponse.IGNORE
+
+    dropped = publish("secret/launch-codes")
+    assert dropped.type == pb.ValuedResponse.STOP_AND_RETURN
+    assert dropped.message.headers["allow_publish"] == "false"
+
+    moved = publish("rewrite/me")
+    assert moved.type == pb.ValuedResponse.CONTINUE
+    assert moved.message.topic == "rewritten/you"
+
+
+def test_message_publish_runs_rules(served):
+    broker, srv, chan = served
+    hits = []
+    broker.rules.add_rule(
+        "r1",
+        'SELECT payload.v AS v FROM "metrics/#" WHERE payload.v > 10',
+        actions=[FunctionAction(fn=lambda sel, msg: hits.append(sel["v"]))],
+    )
+    for v, topic in ((5, "metrics/a"), (42, "metrics/b"), (9, "other/c")):
+        rpc(
+            chan,
+            "OnMessagePublish",
+            pb.MessagePublishRequest(
+                message=pb.Message(topic=topic, payload=b'{"v": %d}' % v)
+            ),
+            pb.ValuedResponse,
+        )
+    assert hits == [42]
+
+
+def test_authenticate_and_authorize(served):
+    broker, srv, chan = served
+    from emqx_tpu.access import DictAuthenticator
+
+    broker.access.allow_anonymous = False
+    authn = DictAuthenticator()
+    authn.add_user("alice", "wonder")
+    broker.access.authenticators.append(authn)
+
+    def auth(clientid, username, password):
+        return rpc(
+            chan,
+            "OnClientAuthenticate",
+            pb.ClientAuthenticateRequest(
+                clientinfo=pb.ClientInfo(
+                    clientid=clientid, username=username, password=password
+                )
+            ),
+            pb.ValuedResponse,
+        )
+
+    assert auth("c1", "alice", "wonder").bool_result is True
+    assert auth("c1", "alice", "nope").bool_result is False
+    assert auth("c2", "mallory", "x").bool_result is False
+
+    resp = rpc(
+        chan,
+        "OnClientAuthorize",
+        pb.ClientAuthorizeRequest(
+            clientinfo=pb.ClientInfo(clientid="c1", username="alice"),
+            type=pb.ClientAuthorizeRequest.PUBLISH,
+            topic="t/1",
+        ),
+        pb.ValuedResponse,
+    )
+    assert resp.bool_result is True  # default authz allow
+
+
+def test_notification_hooks_fan_into_local_chain(served):
+    broker, srv, chan = served
+    seen = []
+    broker.hooks.add(
+        "session.subscribed", lambda cid, topic: seen.append((cid, topic))
+    )
+    rpc(
+        chan,
+        "OnSessionSubscribed",
+        pb.SessionSubscribedRequest(
+            clientinfo=pb.ClientInfo(clientid="dev-1"),
+            topic="fleet/+/pos",
+            subopts=pb.SubOpts(qos=1),
+        ),
+        pb.EmptySuccess,
+    )
+    assert seen == [("dev-1", "fleet/+/pos")]
+    assert broker.metrics.val("exhook.session.subscribed") == 1

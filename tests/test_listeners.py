@@ -1,0 +1,214 @@
+"""WS and TLS listeners: full MQTT pub/sub roundtrips over ws:// and
+mqtts:// (emqx_listeners.erl:430-447 transport parity)."""
+
+import asyncio
+import base64
+import datetime
+import os
+
+import pytest
+
+from emqx_tpu.broker.listener import BrokerServer
+from emqx_tpu.broker import ws as W
+from emqx_tpu.codec import mqtt as C
+from emqx_tpu.config import BrokerConfig, ListenerConfig
+from mqtt_client import TestClient
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class WsTestClient(TestClient):
+    """TestClient over a client-side websocket (masked frames)."""
+
+    async def connect(self, **kw):
+        r, w = await asyncio.open_connection(self.host, self.port)
+        key = base64.b64encode(os.urandom(16)).decode()
+        w.write(
+            (
+                f"GET /mqtt HTTP/1.1\r\nHost: {self.host}\r\n"
+                "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                f"Sec-WebSocket-Key: {key}\r\n"
+                "Sec-WebSocket-Version: 13\r\n"
+                "Sec-WebSocket-Protocol: mqtt\r\n\r\n"
+            ).encode()
+        )
+        await w.drain()
+        status = await r.readuntil(b"\r\n\r\n")
+        assert b"101" in status.split(b"\r\n")[0], status
+        assert b"Sec-WebSocket-Protocol: mqtt" in status
+
+        class _ClientStream(W.WsServerStream):
+            def write(self, data: bytes) -> None:  # clients mask
+                if data and not self._w.is_closing():
+                    self._w.write(
+                        W.frame(W.OP_BINARY, data, mask=os.urandom(4))
+                    )
+
+        stream = _ClientStream(r, w)
+        self.reader = stream
+        self.writer = stream
+        self._pump = asyncio.get_running_loop().create_task(
+            self._read_loop()
+        )
+        await self.send(
+            C.Connect(
+                client_id=self.client_id,
+                proto_ver=self.version,
+                clean_start=kw.get("clean_start", True),
+                keepalive=kw.get("keepalive", 60),
+                properties=kw.get("properties") or {},
+            )
+        )
+        return await self.expect(C.CONNACK)
+
+
+def test_ws_pubsub_roundtrip():
+    async def t():
+        cfg = BrokerConfig()
+        cfg.listeners = [
+            ListenerConfig(port=0),
+            ListenerConfig(name="ws_default", type="ws", port=0),
+        ]
+        srv = BrokerServer(cfg)
+        await srv.start()
+        tcp_port, ws_port = (lst.port for lst in srv.listeners)
+
+        sub = WsTestClient(ws_port, "ws-sub")
+        ack = await sub.connect()
+        assert ack.reason_code == 0
+        await sub.subscribe("web/#", qos=1)
+
+        # cross-transport: publish over plain TCP, deliver over WS
+        pub = TestClient(tcp_port, "tcp-pub")
+        await pub.connect()
+        await pub.publish("web/news", b"hello ws", qos=1)
+        pkt = await sub.recv_publish()
+        assert pkt.topic == "web/news" and pkt.payload == b"hello ws"
+
+        # and WS -> TCP
+        await pub.subscribe("from/ws")
+        await sub.publish("from/ws", b"reverse", qos=1)
+        pkt2 = await pub.recv_publish()
+        assert pkt2.payload == b"reverse"
+
+        await pub.disconnect()
+        await sub.disconnect()
+        await srv.stop()
+
+    run(t())
+
+
+def test_ws_rejects_plain_http():
+    async def t():
+        cfg = BrokerConfig()
+        cfg.listeners = [ListenerConfig(name="ws", type="ws", port=0)]
+        srv = BrokerServer(cfg)
+        await srv.start()
+        r, w = await asyncio.open_connection(
+            "127.0.0.1", srv.listeners[0].port
+        )
+        w.write(b"GET / HTTP/1.1\r\nHost: x\r\n\r\n")
+        await w.drain()
+        resp = await r.read(64)
+        assert b"400" in resp
+        w.close()
+        await srv.stop()
+
+    run(t())
+
+
+def _make_cert(tmp_path):
+    """Self-signed localhost certificate via `cryptography`."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, "localhost")]
+    )
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .add_extension(
+            x509.SubjectAlternativeName([x509.DNSName("localhost")]),
+            critical=False,
+        )
+        .sign(key, hashes.SHA256())
+    )
+    certfile = tmp_path / "cert.pem"
+    keyfile = tmp_path / "key.pem"
+    certfile.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+    keyfile.write_bytes(
+        key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption(),
+        )
+    )
+    return str(certfile), str(keyfile)
+
+
+def test_tls_pubsub_roundtrip(tmp_path):
+    import ssl
+
+    certfile, keyfile = _make_cert(tmp_path)
+
+    class TlsTestClient(TestClient):
+        async def connect(self, **kw):
+            ctx = ssl.create_default_context(cafile=certfile)
+            ctx.check_hostname = False
+            self.reader, self.writer = await asyncio.open_connection(
+                self.host, self.port, ssl=ctx, server_hostname="localhost"
+            )
+            self._pump = asyncio.get_running_loop().create_task(
+                self._read_loop()
+            )
+            await self.send(
+                C.Connect(
+                    client_id=self.client_id,
+                    proto_ver=self.version,
+                    clean_start=True,
+                    keepalive=60,
+                )
+            )
+            return await self.expect(C.CONNACK)
+
+    async def t():
+        cfg = BrokerConfig()
+        cfg.listeners = [
+            ListenerConfig(
+                name="ssl",
+                type="ssl",
+                port=0,
+                certfile=certfile,
+                keyfile=keyfile,
+            )
+        ]
+        srv = BrokerServer(cfg)
+        await srv.start()
+        port = srv.listeners[0].port
+
+        sub = TlsTestClient(port, "tls-sub")
+        ack = await sub.connect()
+        assert ack.reason_code == 0
+        await sub.subscribe("sec/#", qos=1)
+        pub = TlsTestClient(port, "tls-pub")
+        await pub.connect()
+        await pub.publish("sec/data", b"encrypted hi", qos=1)
+        pkt = await sub.recv_publish()
+        assert pkt.payload == b"encrypted hi"
+        await pub.disconnect()
+        await sub.disconnect()
+        await srv.stop()
+
+    run(t())

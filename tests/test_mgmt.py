@@ -1,0 +1,157 @@
+"""Observability surface: $SYS heartbeat topics, REST API, Prometheus
+exposition (emqx_sys / emqx_management / emqx_prometheus parity at the
+black-box level)."""
+
+import asyncio
+import json
+
+import aiohttp
+
+from emqx_tpu.broker.listener import BrokerServer
+from emqx_tpu.config import BrokerConfig, ListenerConfig
+from mqtt_client import TestClient
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_server(sys_interval=3600.0):
+    cfg = BrokerConfig()
+    cfg.listeners = [ListenerConfig(port=0)]
+    cfg.api.enable = True
+    cfg.api.port = 0
+    cfg.sys.interval = sys_interval
+    return BrokerServer(cfg)
+
+
+def test_sys_heartbeat_over_mqtt():
+    async def t():
+        srv = make_server(sys_interval=0.0)  # publish on every tick
+        await srv.start()
+        port = srv.listeners[0].port
+        sub = TestClient(port, "mon")
+        await sub.connect()
+        await sub.subscribe("$SYS/#")
+        srv.sys.tick()  # drive directly instead of waiting 1s
+        seen = {}
+        for _ in range(8):
+            pkt = await sub.recv_publish()
+            seen[pkt.topic.rsplit("/", 1)[-1]] = pkt.payload
+        assert "version" in seen and b"emqx_tpu" in seen["version"]
+        assert "uptime" in seen
+        stats = json.loads(seen["stats"])
+        assert stats["connections.count"] >= 1
+        await sub.disconnect()
+        await srv.stop()
+
+    run(t())
+
+
+def test_rest_clients_subscriptions_stats():
+    async def t():
+        srv = make_server()
+        await srv.start()
+        port = srv.listeners[0].port
+        api = f"http://127.0.0.1:{srv.api.port}"
+
+        c = TestClient(port, "dev-42")
+        await c.connect()
+        await c.subscribe("tele/+/up", qos=1)
+
+        async with aiohttp.ClientSession() as http:
+            async with http.get(api + "/api/v5/clients") as r:
+                data = await r.json()
+            assert r.status == 200
+            assert any(x["clientid"] == "dev-42" for x in data["data"])
+
+            async with http.get(api + "/api/v5/clients/dev-42") as r:
+                one = await r.json()
+            assert one["connected"] is True
+
+            async with http.get(api + "/api/v5/subscriptions") as r:
+                subs = await r.json()
+            assert {"clientid": "dev-42", "topic": "tele/+/up"} in subs["data"]
+
+            async with http.get(api + "/api/v5/topics") as r:
+                topics = await r.json()
+            assert any(t["topic"] == "tele/+/up" for t in topics["data"])
+
+            async with http.get(api + "/api/v5/stats") as r:
+                stats = await r.json()
+            assert stats["connections.count"] == 1
+
+            # publish over REST, delivered over MQTT
+            async with http.post(
+                api + "/api/v5/publish",
+                json={"topic": "tele/7/up", "payload": "ping", "qos": 1},
+            ) as r:
+                out = await r.json()
+            assert out["delivered"] == 1
+            pkt = await c.recv_publish()
+            assert pkt.topic == "tele/7/up" and pkt.payload == b"ping"
+
+            # kick over REST
+            async with http.delete(api + "/api/v5/clients/dev-42") as r:
+                assert r.status == 204
+            await asyncio.sleep(0.05)
+            async with http.get(api + "/api/v5/clients/dev-42") as r2:
+                assert r2.status in (200, 404)
+
+        await c.close()
+        await srv.stop()
+
+    run(t())
+
+
+def test_rest_rules_crud():
+    async def t():
+        srv = make_server()
+        await srv.start()
+        api = f"http://127.0.0.1:{srv.api.port}"
+        async with aiohttp.ClientSession() as http:
+            async with http.post(
+                api + "/api/v5/rules",
+                json={
+                    "id": "r9",
+                    "sql": 'SELECT * FROM "a/#" WHERE payload.x > 1',
+                },
+            ) as r:
+                assert r.status == 201
+            async with http.get(api + "/api/v5/rules") as r:
+                rules = await r.json()
+            assert rules["data"][0]["id"] == "r9"
+            async with http.post(
+                api + "/api/v5/rules", json={"id": "bad", "sql": "NOT SQL"}
+            ) as r:
+                assert r.status == 400
+            async with http.delete(api + "/api/v5/rules/r9") as r:
+                assert r.status == 204
+            async with http.delete(api + "/api/v5/rules/r9") as r:
+                assert r.status == 404
+        await srv.stop()
+
+    run(t())
+
+
+def test_prometheus_exposition():
+    async def t():
+        srv = make_server()
+        await srv.start()
+        port = srv.listeners[0].port
+        c = TestClient(port, "p")
+        await c.connect()
+        await c.publish("x/y", b"1", qos=1)
+        api = f"http://127.0.0.1:{srv.api.port}"
+        async with aiohttp.ClientSession() as http:
+            async with http.get(api + "/metrics") as r:
+                text = await r.text()
+        assert r.status == 200
+        assert "# TYPE emqx_messages_received counter" in text
+        assert "emqx_messages_received 1" in text
+        assert "# TYPE emqx_connections_count gauge" in text
+        assert "emqx_uptime_seconds" in text
+        await c.disconnect()
+        await srv.stop()
+
+    run(t())
