@@ -179,7 +179,9 @@ class AccessControl:
     # ---------------------------------------------------------- authn
 
     def authenticate(self, client: ClientInfo) -> Tuple[bool, ClientInfo]:
-        """Returns (ok, possibly-updated clientinfo)."""
+        """Returns (ok, possibly-updated clientinfo).  Async providers
+        (is_async=True, e.g. HTTP) are SKIPPED here — channels route
+        through ``authenticate_async`` when any are registered."""
         if self.hooks is not None:
             res = self.hooks.run_fold(
                 "client.authenticate", (client,), IGNORE
@@ -190,6 +192,38 @@ class AccessControl:
                 return True, client
         for auth in self.authenticators:
             decision, updates = auth.authenticate(client)
+            if decision == ALLOW:
+                for k, v in updates.items():
+                    setattr(client, k, v)
+                return True, client
+            if decision == DENY:
+                return False, client
+        return self.allow_anonymous, client
+
+    @property
+    def has_async_authn(self) -> bool:
+        return any(
+            getattr(a, "is_async", False) for a in self.authenticators
+        )
+
+    async def authenticate_async(
+        self, client: ClientInfo
+    ) -> Tuple[bool, ClientInfo]:
+        """Chain walk that awaits async providers in order (the
+        per-listener chain of emqx_authn_chains, with IO providers)."""
+        if self.hooks is not None:
+            res = self.hooks.run_fold(
+                "client.authenticate", (client,), IGNORE
+            )
+            if res == DENY:
+                return False, client
+            if res == ALLOW:
+                return True, client
+        for auth in self.authenticators:
+            if getattr(auth, "is_async", False):
+                decision, updates = await auth.authenticate_async(client)
+            else:
+                decision, updates = auth.authenticate(client)
             if decision == ALLOW:
                 for k, v in updates.items():
                     setattr(client, k, v)

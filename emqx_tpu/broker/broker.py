@@ -75,9 +75,16 @@ class Broker:
         self.cm = ConnectionManager(self._make_session)
         self.cm.on_discarded = self._session_discarded
         self.cm.on_takenover = lambda s: self.metrics.inc("session.takenover")
+        from ..resources import ResourceManager
         from ..rules.engine import RuleEngine
 
         self.rules = RuleEngine(broker=self)
+        self.resources = ResourceManager()
+        from ..modules import DelayedPublish, ExclusiveSub, TopicRewrite
+
+        self.delayed = DelayedPublish(self)
+        self.rewrite = TopicRewrite(self)
+        self.exclusive = ExclusiveSub()
         # ClusterNode installs itself here (the emqx_external_broker
         # registration point, emqx_broker.erl:379-380): provides
         # match_remote(topics) and forward(msg, nodes)
@@ -159,6 +166,9 @@ class Broker:
             self._release_gate(session)
             self.durable.discard(session.clientid)
         self.router.cleanup_client(session.clientid)
+        self.exclusive.release_all(session.clientid)
+        if self.external is not None:
+            self.external.client_closed(session.clientid)
         self.hooks.run("session.discarded", session.clientid)
 
     def _release_gate(self, session: Session) -> None:
@@ -175,6 +185,9 @@ class Broker:
         never return (emqx_channel session-expiry handling)."""
         self._release_gate(session)
         self.router.cleanup_client(clientid)
+        self.exclusive.release_all(clientid)
+        if self.external is not None:
+            self.external.client_closed(clientid)
         self.metrics.inc("session.terminated")
 
     # ---------------------------------------------------- subscribe
@@ -235,6 +248,8 @@ class Broker:
         session, present = self.cm.open_session(
             clean_start, clientid, channel, **session_kwargs
         )
+        if self.external is not None:
+            self.external.client_opened(clientid)
         if present or clean_start or self.durable is None:
             if self.durable is not None and (clean_start or present):
                 # a live resume or clean start invalidates any on-disk
@@ -272,6 +287,93 @@ class Broker:
         self.metrics.inc("session.resumed")
         self.hooks.run("session.resumed", clientid)
         return session, True
+
+    # ------------------------------------------- cross-node takeover
+
+    def export_session(self, clientid: str) -> Optional[Dict]:
+        """Serialize and REMOVE a session for migration to another node
+        (the owning side of emqx_cm's takeover protocol,
+        emqx_cm.erl:314-317).  The live channel (if any) is closed with
+        the takeover reason; local router/gate/checkpoint state is
+        released because the session now lives elsewhere."""
+        from ..cluster.node import msg_to_wire
+
+        session = self.cm.lookup(clientid)
+        if session is None:
+            return None
+        channel = self.cm.channel(clientid)
+        if channel is not None:
+            channel.close("takenover")
+        # unacked inflight PUBLISHes re-deliver FIRST (original send
+        # order precedes the backlog, [MQTT-4.6.0-1]); PUBREL-phase
+        # entries are dropped — the receiver already owns the message
+        queued = [
+            msg_to_wire(entry.msg)
+            for _pid, entry in session.inflight.items()
+            if entry.msg is not None
+        ]
+        while True:
+            m = session.mqueue.pop()
+            if m is None:
+                break
+            queued.append(msg_to_wire(m))
+        state = {
+            "subs": {
+                flt: opts.to_dict()
+                for flt, opts in session.subscriptions.items()
+            },
+            "expiry": session.expiry_interval,
+            "queued": queued,
+            "awaiting_rel": list(session.awaiting_rel.keys()),
+        }
+        self._release_gate(session)
+        if self.durable is not None:
+            self.durable.discard(clientid)
+        self.router.cleanup_client(clientid)
+        self.exclusive.release_all(clientid)
+        self.cm.remove(clientid)
+        if self.external is not None:
+            self.external.client_closed(clientid)
+        self.metrics.inc("session.takenover")
+        self.hooks.run("session.takenover", clientid)
+        return state
+
+    def adopt_orphan_session(
+        self, clientid: str, state: Dict, expiry: float
+    ) -> None:
+        """The connection that requested a takeover died before the
+        state arrived; the owning node already destroyed its copy, so
+        re-home it as a DETACHED local session (resumable by the next
+        reconnect) instead of losing it."""
+        session = self._make_session(
+            clientid,
+            clean_start=False,
+            expiry_interval=max(expiry, float(state.get("expiry", 0.0))),
+        )
+        self.cm.attach_detached(clientid, session)
+        self.import_session(session, state)
+        if self.external is not None:
+            self.external.client_opened(clientid)
+        log.warning(
+            "adopted orphaned takeover state for %s (requester died)",
+            clientid,
+        )
+
+    def import_session(self, session: Session, state: Dict) -> None:
+        """Rebuild a migrated session's state into a freshly opened
+        local session (the taking side of the takeover protocol)."""
+        from ..cluster.node import msg_from_wire
+
+        for flt, opts_dict in state.get("subs", {}).items():
+            opts = SubOpts.from_dict(opts_dict)
+            session.subscribe(flt, opts)
+            self.subscribe(session.clientid, flt, opts, is_new_sub=True)
+        for wire in state.get("queued", ()):
+            session.mqueue.insert(msg_from_wire(wire))
+        now = time.time()
+        for pid in state.get("awaiting_rel", ()):
+            session.awaiting_rel[int(pid)] = now
+        self.metrics.inc("session.imported")
 
     def channel_disconnected(self, clientid: str) -> None:
         """Checkpoint a persistent session at channel close so a broker
@@ -412,14 +514,30 @@ class Broker:
         already ran on the origin node, and re-forwarding would loop
         (the reference's forward lands directly in `dispatch/2`,
         emqx_broker.erl:408-420)."""
+        return self.dispatch_forwarded_many([msg])
+
+    def dispatch_forwarded_many(self, msgs: Sequence[Message]) -> int:
+        """Batched forwarded dispatch: one gate pass + one match step
+        per inbound cluster frame."""
+        if not msgs:
+            return 0
         if self.durable is not None:
             # each node durably stores what its own gate needs: DS is
             # node-local here (unlike the reference's replicated DS), so
             # a local persistent session's messages must be persisted on
             # THIS node even when published remotely
-            self.durable.persist([msg])
-        filters = self.router.match_batch([msg.topic])[0]
-        return self._dispatch(msg, filters, run_rules=False)
+            try:
+                self.durable.persist(list(msgs))
+            except Exception:
+                log.exception("durable persist failed for forwarded batch")
+        matched = self.router.match_batch([m.topic for m in msgs])
+        total = 0
+        for msg, filters in zip(msgs, matched):
+            try:
+                total += self._dispatch(msg, filters, run_rules=False)
+            except Exception:
+                log.exception("forwarded dispatch failed for %s", msg.topic)
+        return total
 
     # ----------------------------------------------------- dispatch
 
@@ -541,6 +659,7 @@ class Broker:
         for cid in due:
             _, will = self._pending_wills.pop(cid)
             self.publish(will)
+        self.delayed.tick(now)
         self.cm.expire_sessions(now)
         if self.durable is not None:
             self.durable.purge_expired(now)

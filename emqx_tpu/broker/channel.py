@@ -10,6 +10,7 @@ tears it down; the asyncio connection drives timers.
 
 from __future__ import annotations
 
+import logging
 import secrets
 import time
 from typing import Dict, List, Optional, Tuple
@@ -20,6 +21,8 @@ from ..message import Message
 from .. import topic as T
 from .broker import Broker
 from .session import Session, SubOpts
+
+log = logging.getLogger("emqx_tpu.channel")
 
 # channel states
 CONNECTING = "connecting"
@@ -80,6 +83,8 @@ class Channel:
         self.last_rx = time.time()
         self.connected_at: Optional[float] = None
         self._closing = False
+        self._pending_connect = None  # in-flight async-connect task
+        self._connect_backlog: List[C.Packet] = []  # pipelined pre-CONNACK
 
     # ---------------------------------------------------------- util
 
@@ -131,6 +136,18 @@ class Channel:
         m = self.broker.metrics
         m.inc("packets.received")
         if self.state == CONNECTING:
+            if self._pending_connect is not None:
+                # CONNECT is resolving asynchronously (HTTP auth or
+                # remote takeover).  Clients may legally pipeline
+                # packets before CONNACK — buffer them (bounded) and
+                # replay once connected; a second CONNECT is fatal.
+                if pkt.type == C.CONNECT:
+                    self._shutdown("protocol_error")  # [MQTT-3.1.0-2]
+                elif len(self._connect_backlog) >= 64:
+                    self._shutdown("connect_backlog_overflow")
+                else:
+                    self._connect_backlog.append(pkt)
+                return
             if pkt.type != C.CONNECT:
                 self._shutdown("protocol_error")
                 return
@@ -216,7 +233,36 @@ class Channel:
             mountpoint=self.mountpoint,
         )
         m.inc("client.authenticate")
+        if self.broker.access.has_async_authn:
+            # IO-backed providers (HTTP) must not block the loop: defer
+            # the rest of CONNECT until the chain resolves
+            import asyncio
+
+            self._pending_connect = asyncio.get_running_loop().create_task(
+                self._async_auth_connect(pkt, clientid, assigned, client)
+            )
+            return
         ok, client = self.broker.access.authenticate(client)
+        self._post_auth_connect(pkt, clientid, assigned, client, ok)
+
+    async def _async_auth_connect(
+        self, pkt, clientid, assigned, client
+    ) -> None:
+        try:
+            ok, client = await self.broker.access.authenticate_async(client)
+        except Exception:
+            log.exception("async authentication failed for %s", clientid)
+            ok = False
+        self._pending_connect = None
+        if self.state != CONNECTING:
+            return
+        self._post_auth_connect(pkt, clientid, assigned, client, ok)
+
+    def _post_auth_connect(
+        self, pkt, clientid, assigned, client, ok
+    ) -> None:
+        m = self.broker.metrics
+        mqtt = self.broker.config.mqtt
         if not ok:
             m.inc("packets.publish.auth_error")
             self._connack_error(RC_BAD_AUTH)
@@ -232,6 +278,71 @@ class Channel:
             else (0 if pkt.clean_start else mqtt.session_expiry_interval)
         )
         receive_max = pkt.properties.get("receive_maximum")
+
+        ext = self.broker.external
+        if (
+            not pkt.clean_start
+            and ext is not None
+            and self.broker.cm.lookup(clientid) is None
+            and ext.remote_owner(clientid) is not None
+        ):
+            # the session lives on a peer: fetch it asynchronously (the
+            # reference's cross-node takeover, emqx_cm.erl:314-317) and
+            # finish the CONNECT when the state transfer resolves
+            import asyncio
+
+            self._pending_connect = asyncio.get_running_loop().create_task(
+                self._remote_connect(
+                    pkt, clientid, assigned, client, expiry, receive_max
+                )
+            )
+            return
+        self._finish_connect(
+            pkt, clientid, assigned, client, expiry, receive_max, None
+        )
+
+    async def _remote_connect(
+        self, pkt, clientid, assigned, client, expiry, receive_max
+    ) -> None:
+        import asyncio
+
+        # the takeover DESTROYS the session on the owning node, so the
+        # fetched state must never be dropped: shield the RPC from our
+        # own cancellation and re-home the state as a detached local
+        # session if this connection dies mid-flight
+        inner = asyncio.get_running_loop().create_task(
+            self.broker.external.takeover(clientid)
+        )
+
+        def rescue(task: "asyncio.Task") -> None:
+            if task.cancelled() or task.exception() is not None:
+                return
+            state = task.result()
+            if state and self.broker.cm.lookup(clientid) is None:
+                self.broker.adopt_orphan_session(clientid, state, expiry)
+
+        try:
+            state = await asyncio.shield(inner)
+        except asyncio.CancelledError:
+            inner.add_done_callback(rescue)
+            raise
+        except Exception:
+            log.exception("remote takeover of %s failed", clientid)
+            state = None
+        self._pending_connect = None
+        if self.state != CONNECTING:
+            if state and self.broker.cm.lookup(clientid) is None:
+                self.broker.adopt_orphan_session(clientid, state, expiry)
+            return  # connection died while fetching
+        self._finish_connect(
+            pkt, clientid, assigned, client, expiry, receive_max, state
+        )
+
+    def _finish_connect(
+        self, pkt, clientid, assigned, client, expiry, receive_max, imported
+    ) -> None:
+        m = self.broker.metrics
+        mqtt = self.broker.config.mqtt
         session, present = self.broker.open_session(
             pkt.clean_start,
             clientid,
@@ -242,6 +353,9 @@ class Channel:
             ),
         )
         self.session = session
+        if imported is not None and not present:
+            self.broker.import_session(session, imported)
+            present = True  # the client's session DID survive — elsewhere
         self.broker.cancel_will(clientid)  # reconnect cancels a delayed will
         if present:
             m.inc("session.resumed")
@@ -295,8 +409,34 @@ class Channel:
             [C.Connack(session_present=present, reason_code=0,
                        properties=props)]
         )
+        # server-side auto-subscribe (emqx_auto_subscribe): applied on
+        # every connect through the SAME validation/mountpoint/authz
+        # gauntlet a client SUBSCRIBE passes; re-subscribing is a no-op
+        for entry in self.broker.config.auto_subscribe:
+            flt = (
+                entry["topic"]
+                .replace("%c", clientid)
+                .replace("%u", client.username or "")
+            )
+            try:
+                T.validate_filter(flt)
+            except ValueError:
+                log.warning("invalid auto_subscribe filter %r", flt)
+                continue
+            full = self._mount(flt)
+            if not self.broker.access.authorize(client, SUBSCRIBE, full):
+                continue
+            opts = SubOpts(qos=int(entry.get("qos", 0)))
+            is_new = session.subscribe(full, opts)
+            self.broker.subscribe(clientid, full, opts, is_new_sub=is_new)
         if present:
             self.send_packets(session.resume())
+        # replay packets the client pipelined while CONNECT resolved
+        backlog, self._connect_backlog = self._connect_backlog, []
+        for pending in backlog:
+            if self.state != CONNECTED:
+                break
+            self.handle_in(pending)
 
     def _connack_error(self, rc: int) -> None:
         code = rc if self.version == C.MQTT_V5 else _V3_CONNACK.get(rc, 3)
@@ -484,12 +624,21 @@ class Channel:
         mqtt,
         retained_jobs: List[Tuple[Message, SubOpts]],
     ) -> int:
-        flt = sub.topic_filter
+        flt = self.broker.rewrite.rewrite_sub(sub.topic_filter)
         try:
             T.validate_filter(flt)
         except ValueError:
             self.broker.metrics.inc("packets.subscribe.error")
             return RC_TOPIC_FILTER_INVALID
+        exclusive = flt.startswith("$exclusive/")
+        if exclusive:
+            if not mqtt.exclusive_subscription:
+                return RC_TOPIC_FILTER_INVALID
+            flt = flt[len("$exclusive/"):]
+            if not flt:
+                return RC_TOPIC_FILTER_INVALID
+            # the lock is acquired LAST, after every validation/authz
+            # gate below — an error return must not leave a stale hold
         shared = T.parse_share(flt)
         if shared is not None and not mqtt.shared_subscription:
             return RC_SHARED_SUB_UNSUPPORTED
@@ -524,6 +673,10 @@ class Channel:
         if hooked is None:
             return RC_NOT_AUTHORIZED
         opts = hooked
+        if exclusive and not self.broker.exclusive.acquire(
+            self.client.clientid, flt
+        ):
+            return 0x97  # quota exceeded: already held (reference rc)
         is_new = self.session.subscribe(full, opts)
         retained = self.broker.subscribe(
             self.client.clientid, full, opts, is_new_sub=is_new
@@ -543,6 +696,10 @@ class Channel:
         m.inc("packets.unsubscribe.received")
         rcs: List[int] = []
         for flt in pkt.topic_filters:
+            flt = self.broker.rewrite.rewrite_sub(flt)
+            if flt.startswith("$exclusive/"):
+                flt = flt[len("$exclusive/"):]
+                self.broker.exclusive.release(self.client.clientid, flt)
             full = self._mount(flt) if not T.parse_share(flt) else flt
             self.broker.hooks.run("client.unsubscribe", self.client, flt)
             had = self.session.unsubscribe(full) is not None
@@ -598,6 +755,9 @@ class Channel:
         if self.state == DISCONNECTED and self.session is None:
             return
         self.state = DISCONNECTED
+        if self._pending_connect is not None:
+            self._pending_connect.cancel()
+            self._pending_connect = None
         m = self.broker.metrics
         if self.client is not None:
             m.inc("client.disconnected")

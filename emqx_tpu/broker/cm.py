@@ -108,6 +108,18 @@ class ConnectionManager:
         if e.session.expiry_interval <= 0:
             del self._entries[clientid]
 
+    def attach_detached(self, clientid: str, session: Session) -> None:
+        """Register a session with no live channel (orphaned takeover
+        state re-homed locally); expires like any detached session."""
+        entry = _Entry(session, None)
+        entry.disconnected_at = time.time()
+        self._entries[clientid] = entry
+
+    def remove(self, clientid: str) -> bool:
+        """Silently drop an entry (takeover export: the session is not
+        discarded — it moved to another node, so no discard callbacks)."""
+        return self._entries.pop(clientid, None) is not None
+
     def kick(self, clientid: str) -> bool:
         """Forcibly remove a client (mgmt API `kick`): close the live
         channel and discard the session."""

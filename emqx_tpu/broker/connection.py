@@ -29,10 +29,12 @@ class Connection:
         reader: asyncio.StreamReader,
         writer: asyncio.StreamWriter,
         mountpoint: Optional[str] = None,
+        limiter=None,
     ) -> None:
         self.broker = broker
         self.reader = reader
         self.writer = writer
+        self.limiter = limiter
         peername = writer.get_extra_info("peername")
         peer = f"{peername[0]}:{peername[1]}" if peername else "?"
         self.channel = Channel(
@@ -85,10 +87,35 @@ class Connection:
                 if not data:
                     break
                 self.broker.metrics.inc("bytes.received", len(data))
-                for pkt in self.parser.feed(data):
-                    self.channel.handle_in(pkt)
-                    if self._closed.is_set():
-                        break
+                if self.limiter is None:
+                    for pkt in self.parser.feed(data):
+                        self.channel.handle_in(pkt)
+                        if self._closed.is_set():
+                            break
+                else:
+                    # enforcement sits INSIDE the packet loop: one large
+                    # TCP read can carry a whole flood, so pausing only
+                    # future reads would let the burst straight through.
+                    # The pause throttles processing (and the client,
+                    # via the unread socket) without disconnecting —
+                    # the reference hibernates the socket the same way.
+                    # Sleeps are capped per packet so control packets
+                    # are still handled within ~1 s.
+                    delay = self.limiter.consume(len(data), 0)
+                    if delay > 0:
+                        self.broker.metrics.inc("connection.rate_limited")
+                        await asyncio.sleep(min(delay, 1.0))
+                    for pkt in self.parser.feed(data):
+                        if pkt.type == C.PUBLISH:
+                            delay = self.limiter.consume(0, 1)
+                            if delay > 0:
+                                self.broker.metrics.inc(
+                                    "connection.rate_limited"
+                                )
+                                await asyncio.sleep(min(delay, 1.0))
+                        self.channel.handle_in(pkt)
+                        if self._closed.is_set():
+                            break
                 await self._drain()
                 batcher = self.broker.batcher
                 if batcher is not None and batcher.congested():

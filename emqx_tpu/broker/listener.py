@@ -38,6 +38,16 @@ class Listener:
             return self.cfg.port
         return self._server.sockets[0].getsockname()[1]
 
+    def _make_limiter(self):
+        if self.cfg.messages_rate <= 0 and self.cfg.bytes_rate <= 0:
+            return None
+        from ..limiter import ConnectionLimiter
+
+        return ConnectionLimiter(
+            messages_rate=self.cfg.messages_rate,
+            bytes_rate=self.cfg.bytes_rate,
+        )
+
     def _ssl_context(self):
         import ssl as ssl_mod
 
@@ -112,10 +122,15 @@ class Listener:
                     stream,
                     stream,
                     mountpoint=self.cfg.mountpoint,
+                    limiter=self._make_limiter(),
                 )
             else:
                 conn = Connection(
-                    self.broker, reader, writer, mountpoint=self.cfg.mountpoint
+                    self.broker,
+                    reader,
+                    writer,
+                    mountpoint=self.cfg.mountpoint,
+                    limiter=self._make_limiter(),
                 )
             await conn.run()
         finally:
@@ -185,6 +200,7 @@ class BrokerServer:
         if self.broker.batcher is not None:
             await self.broker.batcher.stop()
             self.broker.batcher = None
+        await self.broker.resources.stop_all()
         self.broker.shutdown()
 
     async def run_forever(self) -> None:

@@ -22,7 +22,7 @@ from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
 log = logging.getLogger("emqx_tpu.cluster.transport")
 
-PROTO_VER = (2, 0)
+PROTO_VER = (3, 0)
 
 Handler = Callable[[str, Dict[str, Any]], Awaitable[Optional[Dict[str, Any]]]]
 
@@ -33,6 +33,27 @@ def pack_bytes(b: bytes) -> str:
 
 def unpack_bytes(s: str) -> bytes:
     return base64.b64decode(s.encode("ascii"))
+
+
+_F_JSON = 0
+_F_BIN = 1
+
+
+def _pack_json(obj: Dict[str, Any]) -> bytes:
+    data = json.dumps(obj, separators=(",", ":")).encode()
+    return (len(data) + 1).to_bytes(4, "big") + bytes([_F_JSON]) + data
+
+
+def _pack_bin(mtype: str, payload: bytes) -> bytes:
+    t = mtype.encode()
+    body_len = 1 + 1 + len(t) + len(payload)
+    return (
+        body_len.to_bytes(4, "big")
+        + bytes([_F_BIN])
+        + bytes([len(t)])
+        + t
+        + payload
+    )
 
 
 class PeerLink:
@@ -90,10 +111,22 @@ class PeerLink:
             self._calls.clear()
 
     async def _send_obj(self, obj: Dict[str, Any]) -> None:
-        data = json.dumps(obj, separators=(",", ":")).encode()
         assert self._writer is not None
-        self._writer.write(len(data).to_bytes(4, "big") + data)
+        self._writer.write(_pack_json(obj))
         await self._writer.drain()
+
+    async def cast_bin(self, mtype: str, payload: bytes) -> bool:
+        """Fire-and-forget binary frame: payload bytes travel raw (no
+        JSON/base64 re-encode — the message-forward hot path)."""
+        async with self._lock:
+            try:
+                await self._ensure()
+                self._writer.write(_pack_bin(mtype, payload))
+                await self._writer.drain()
+                return True
+            except (ConnectionError, OSError):
+                self._drop()
+                return False
 
     async def cast(self, obj: Dict[str, Any]) -> bool:
         """Fire-and-forget; returns False when the peer is unreachable
@@ -144,6 +177,8 @@ class PeerLink:
 
 
 async def read_frame(reader: asyncio.StreamReader) -> Optional[Dict]:
+    """Read one frame.  Format 0 = JSON control message; format 1 =
+    binary: returned as {"type": mtype, "_bin": payload-bytes}."""
     try:
         head = await reader.readexactly(4)
     except (asyncio.IncompleteReadError, ConnectionError):
@@ -155,7 +190,14 @@ async def read_frame(reader: asyncio.StreamReader) -> Optional[Dict]:
         data = await reader.readexactly(n)
     except (asyncio.IncompleteReadError, ConnectionError):
         return None
-    return json.loads(data)
+    fmt = data[0]
+    if fmt == _F_JSON:
+        return json.loads(data[1:])
+    if fmt == _F_BIN:
+        tlen = data[1]
+        mtype = data[2 : 2 + tlen].decode()
+        return {"type": mtype, "_bin": data[2 + tlen :]}
+    raise ConnectionError(f"unknown frame format {fmt}")
 
 
 class NodeTransport:
@@ -209,6 +251,10 @@ class NodeTransport:
         link = self._link(node)
         return False if link is None else await link.cast(obj)
 
+    async def cast_bin(self, node: str, mtype: str, payload: bytes) -> bool:
+        link = self._link(node)
+        return False if link is None else await link.cast_bin(mtype, payload)
+
     async def call(
         self, node: str, obj: Dict[str, Any], timeout: float = 5.0
     ) -> Optional[Dict[str, Any]]:
@@ -243,15 +289,15 @@ class NodeTransport:
                     continue
                 result = await handler(peer, obj)
                 if "call_id" in obj:
-                    reply = json.dumps(
-                        {
-                            "type": "reply",
-                            "call_id": obj["call_id"],
-                            "result": result,
-                        },
-                        separators=(",", ":"),
-                    ).encode()
-                    writer.write(len(reply).to_bytes(4, "big") + reply)
+                    writer.write(
+                        _pack_json(
+                            {
+                                "type": "reply",
+                                "call_id": obj["call_id"],
+                                "result": result,
+                            }
+                        )
+                    )
                     await writer.drain()
         except (ConnectionError, asyncio.IncompleteReadError):
             pass

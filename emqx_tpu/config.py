@@ -55,6 +55,9 @@ class ListenerConfig:
     keyfile: Optional[str] = None
     cacertfile: Optional[str] = None
     verify: bool = False  # require + verify client certificates
+    # per-connection rate limits (emqx_limiter); 0 = unlimited
+    messages_rate: float = 0.0  # PUBLISH packets per second
+    bytes_rate: float = 0.0  # inbound bytes per second
 
 
 @dataclass
@@ -126,6 +129,9 @@ class BrokerConfig:
     engine: BrokerEngineConfig = field(default_factory=BrokerEngineConfig)
     sys: SysConfig = field(default_factory=SysConfig)
     api: ApiConfig = field(default_factory=ApiConfig)
+    # server-side auto-subscribe on connect (emqx_auto_subscribe):
+    # entries {"topic": ..., "qos": 0}; %c/%u placeholders supported
+    auto_subscribe: List[Dict[str, Any]] = field(default_factory=list)
     durable: DurableConfig = field(default_factory=DurableConfig)
     node_name: str = "emqx_tpu@127.0.0.1"
 

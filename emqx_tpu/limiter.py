@@ -1,0 +1,72 @@
+"""Rate limiting: token buckets on the connection read path.
+
+The `emqx_limiter` role (/root/reference/apps/emqx/src/emqx_limiter/,
+hierarchical token buckets integrated with esockd's activation):
+per-connection buckets for message and byte rates; an exhausted bucket
+PAUSES the read loop (TCP backpressure throttles the client) instead of
+disconnecting, exactly like the reference hibernating the socket.
+Global overload shedding is the PublishBatcher watermark (broker.py) —
+together they bound both ingress rate and queued volume.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+
+class TokenBucket:
+    """rate tokens/second, bursting to `burst`.  ``consume`` reports the
+    seconds to wait before the deficit is refilled (0.0 = proceed)."""
+
+    def __init__(self, rate: float, burst: Optional[float] = None) -> None:
+        self.rate = float(rate)
+        self.burst = float(burst if burst is not None else rate)
+        self.tokens = self.burst
+        self._at = time.monotonic()
+
+    def consume(self, n: float, now: Optional[float] = None) -> float:
+        now = now if now is not None else time.monotonic()
+        self.tokens = min(
+            self.burst, self.tokens + (now - self._at) * self.rate
+        )
+        self._at = now
+        # debt is capped at one burst: a single oversized read must not
+        # translate into an unbounded pause (during which keepalives
+        # would starve and the client would die by timeout, not be
+        # throttled)
+        self.tokens = max(self.tokens - n, -self.burst)
+        if self.tokens >= 0:
+            return 0.0
+        return -self.tokens / self.rate  # time until balance reaches 0
+
+
+class ConnectionLimiter:
+    """Message-rate + byte-rate buckets for one connection."""
+
+    def __init__(
+        self,
+        messages_rate: float = 0.0,
+        bytes_rate: float = 0.0,
+        messages_burst: Optional[float] = None,
+        bytes_burst: Optional[float] = None,
+    ) -> None:
+        self.msg_bucket = (
+            TokenBucket(messages_rate, messages_burst)
+            if messages_rate > 0
+            else None
+        )
+        self.byte_bucket = (
+            TokenBucket(bytes_rate, bytes_burst) if bytes_rate > 0 else None
+        )
+
+    def consume(self, n_bytes: int, n_messages: int) -> float:
+        """Returns the pause (seconds) the read loop owes before
+        continuing — the max of both buckets' deficits."""
+        delay = 0.0
+        now = time.monotonic()
+        if self.byte_bucket is not None and n_bytes:
+            delay = max(delay, self.byte_bucket.consume(n_bytes, now))
+        if self.msg_bucket is not None and n_messages:
+            delay = max(delay, self.msg_bucket.consume(n_messages, now))
+        return delay

@@ -1,0 +1,255 @@
+"""Resource framework: buffered, health-checked sinks for rule actions.
+
+A compact analogue of `emqx_resource` (/root/reference/apps/
+emqx_resource/src/emqx_resource.erl:169-253 behavior callbacks;
+emqx_resource_manager.erl health state machine;
+emqx_resource_buffer_worker.erl replayq buffering): every external IO
+target is a Resource with start/stop/query/health callbacks, fronted by
+a BufferWorker that absorbs bursts and outages — queries queue in a
+bounded buffer, failures retry with backoff while the resource is
+marked disconnected, and nothing is lost within the buffer bound.
+
+`HttpSink` is the built-in HTTP action target (the emqx_bridge_http
+role) using aiohttp.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from collections import deque
+from typing import Any, Dict, Optional
+
+log = logging.getLogger("emqx_tpu.resources")
+
+CONNECTING = "connecting"
+CONNECTED = "connected"
+DISCONNECTED = "disconnected"
+
+
+class Resource:
+    """Callback behavior (emqx_resource.erl:169-253)."""
+
+    async def on_start(self) -> None: ...
+
+    async def on_stop(self) -> None: ...
+
+    async def on_query(self, query: Any) -> None:
+        """Deliver one query; raise on failure (triggers retry)."""
+        raise NotImplementedError
+
+    async def health_check(self) -> bool:
+        return True
+
+
+class HttpSink(Resource):
+    """POST each query's body to a URL (emqx_bridge_http essentials)."""
+
+    def __init__(
+        self,
+        url: str,
+        method: str = "POST",
+        headers: Optional[Dict[str, str]] = None,
+        timeout: float = 5.0,
+    ) -> None:
+        self.url = url
+        self.method = method
+        self.headers = dict(headers or {})
+        self.timeout = timeout
+        self._session = None
+
+    async def on_start(self) -> None:
+        import aiohttp
+
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=self.timeout)
+        )
+
+    async def on_stop(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    async def on_query(self, query: Any) -> None:
+        body = query if isinstance(query, (bytes, str)) else None
+        json_body = None if body is not None else query
+        async with self._session.request(
+            self.method,
+            self.url,
+            data=body,
+            json=json_body,
+            headers=self.headers,
+        ) as resp:
+            if resp.status >= 300:
+                raise RuntimeError(f"http sink status {resp.status}")
+
+    async def health_check(self) -> bool:
+        try:
+            async with self._session.head(
+                self.url, headers=self.headers
+            ) as resp:
+                return resp.status < 500
+        except Exception:
+            return False
+
+
+class BufferWorker:
+    """Bounded replay buffer + retrying drain loop per resource
+    (emqx_resource_buffer_worker.erl): queries survive sink outages up
+    to ``max_buffer``; beyond it the OLDEST drops (counted)."""
+
+    def __init__(
+        self,
+        resource: Resource,
+        max_buffer: int = 10_000,
+        max_retries: Optional[int] = None,
+        retry_base: float = 0.05,
+        retry_cap: float = 5.0,
+        health_interval: float = 1.0,
+    ) -> None:
+        self.resource = resource
+        self.max_buffer = max_buffer
+        self.max_retries = max_retries
+        self.retry_base = retry_base
+        self.retry_cap = retry_cap
+        self.health_interval = health_interval
+        self.status = CONNECTING
+        self.stats = {
+            "matched": 0,
+            "success": 0,
+            "failed": 0,
+            "dropped": 0,
+            "retried": 0,
+        }
+        self._buf: deque = deque()
+        self._wake = asyncio.Event()
+        self._task: Optional[asyncio.Task] = None
+
+    # ------------------------------------------------------- lifecycle
+
+    async def start(self) -> None:
+        await self.resource.on_start()
+        self.status = CONNECTED if await self._health() else DISCONNECTED
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        await self.resource.on_stop()
+
+    async def _health(self) -> bool:
+        try:
+            return await self.resource.health_check()
+        except Exception:
+            return False
+
+    # --------------------------------------------------------- intake
+
+    def enqueue(self, query: Any) -> bool:
+        """Queue one query (non-blocking; called from rule actions).
+        Returns False when the buffer had to drop its oldest entry."""
+        self.stats["matched"] += 1
+        ok = True
+        if len(self._buf) >= self.max_buffer:
+            self._buf.popleft()
+            self.stats["dropped"] += 1
+            ok = False
+        self._buf.append(query)
+        self._wake.set()
+        return ok
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    # ---------------------------------------------------------- drain
+
+    async def _run(self) -> None:
+        backoff = self.retry_base
+        retries = 0
+        while True:
+            if not self._buf:
+                self._wake.clear()
+                try:
+                    await asyncio.wait_for(
+                        self._wake.wait(), self.health_interval
+                    )
+                except asyncio.TimeoutError:
+                    if self.status != CONNECTED and await self._health():
+                        self.status = CONNECTED
+                    continue
+            query = self._buf[0]  # keep at head until delivered
+            try:
+                await self.resource.on_query(query)
+                self._buf.popleft()
+                self.stats["success"] += 1
+                self.status = CONNECTED
+                backoff = self.retry_base
+                retries = 0
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:
+                self.status = DISCONNECTED
+                self.stats["retried"] += 1
+                retries += 1
+                if (
+                    self.max_retries is not None
+                    and retries > self.max_retries
+                ):
+                    self._buf.popleft()
+                    self.stats["failed"] += 1
+                    retries = 0
+                    log.warning(
+                        "sink query dropped after %d retries: %s",
+                        self.max_retries,
+                        exc,
+                    )
+                    continue
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, self.retry_cap)
+
+
+class ResourceManager:
+    """Registry of named resources and their buffer workers
+    (emqx_resource_manager's lifecycle role)."""
+
+    def __init__(self) -> None:
+        self._workers: Dict[str, BufferWorker] = {}
+
+    async def create(
+        self, resource_id: str, resource: Resource, **worker_kw
+    ) -> BufferWorker:
+        await self.remove(resource_id)
+        worker = BufferWorker(resource, **worker_kw)
+        await worker.start()
+        self._workers[resource_id] = worker
+        return worker
+
+    def get(self, resource_id: str) -> Optional[BufferWorker]:
+        return self._workers.get(resource_id)
+
+    async def remove(self, resource_id: str) -> bool:
+        worker = self._workers.pop(resource_id, None)
+        if worker is None:
+            return False
+        await worker.stop()
+        return True
+
+    async def stop_all(self) -> None:
+        for rid in list(self._workers):
+            await self.remove(rid)
+
+    def info(self) -> Dict[str, Dict]:
+        return {
+            rid: {
+                "status": w.status,
+                "buffered": len(w),
+                **w.stats,
+            }
+            for rid, w in self._workers.items()
+        }

@@ -84,6 +84,18 @@ class FunctionAction:
     kind: str = "function"
 
 
+@dataclass
+class SinkAction:
+    """Forward the rule output to a registered resource's buffer worker
+    (the bridge/action path: emqx_resource buffered IO).  The payload
+    template renders against the SELECTed columns; None sends them as
+    JSON."""
+
+    resource_id: str
+    payload: Optional[str] = None  # template; None => selected as JSON
+    kind: str = "sink"
+
+
 Action = Any
 
 
@@ -286,6 +298,21 @@ class RuleEngine:
             log.info("rule output: %s", selected)
         elif isinstance(action, FunctionAction):
             action.fn(selected, msg)
+        elif isinstance(action, SinkAction):
+            if self.broker is None:
+                raise RuntimeError("sink action without a broker")
+            worker = self.broker.resources.get(action.resource_id)
+            if worker is None:
+                raise RuntimeError(
+                    f"unknown resource {action.resource_id!r}"
+                )
+            if action.payload is not None:
+                query: Any = render_template(action.payload, selected)
+            else:
+                import json as _json
+
+                query = _json.dumps(selected, default=str)
+            worker.enqueue(query)
         else:
             raise RuntimeError(f"unknown action {action!r}")
 

@@ -1,0 +1,140 @@
+"""Auth backends: pbkdf2 hashing, JWT (HS256), async HTTP authn
+(emqx_auth_jwt / emqx_auth_http / authn hash options parity)."""
+
+import asyncio
+import time
+
+from aiohttp import web
+
+from emqx_tpu.auth_providers import (
+    HttpAuthenticator,
+    JwtAuthenticator,
+    Pbkdf2Authenticator,
+    make_jwt,
+)
+from emqx_tpu.broker.listener import BrokerServer
+from emqx_tpu.config import BrokerConfig, ListenerConfig
+from mqtt_client import TestClient
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_server():
+    cfg = BrokerConfig()
+    cfg.listeners = [ListenerConfig(port=0)]
+    cfg.auth.allow_anonymous = False
+    return BrokerServer(cfg)
+
+
+def test_pbkdf2_over_socket():
+    async def t():
+        srv = make_server()
+        auth = Pbkdf2Authenticator(iterations=1000)
+        auth.add_user("bob", "hunter2")
+        srv.broker.access.authenticators.append(auth)
+        await srv.start()
+        port = srv.listeners[0].port
+
+        ok = TestClient(port, "c1")
+        ack = await ok.connect(username="bob", password=b"hunter2")
+        assert ack.reason_code == 0
+        await ok.disconnect()
+
+        bad = TestClient(port, "c2")
+        ack2 = await bad.connect(username="bob", password=b"wrong")
+        assert ack2.reason_code != 0
+        await bad.close()
+        await srv.stop()
+
+    run(t())
+
+
+def test_jwt_claims_and_expiry():
+    async def t():
+        srv = make_server()
+        secret = b"tpu-secret"
+        srv.broker.access.authenticators.append(
+            JwtAuthenticator(secret, required_claims={"sub": "%c"})
+        )
+        await srv.start()
+        port = srv.listeners[0].port
+
+        good = make_jwt(
+            secret, {"sub": "dev1", "exp": time.time() + 60}
+        )
+        c = TestClient(port, "dev1")
+        ack = await c.connect(username="ignored", password=good.encode())
+        assert ack.reason_code == 0
+        await c.disconnect()
+
+        # claim mismatch: token minted for another clientid
+        c2 = TestClient(port, "dev2")
+        ack2 = await c2.connect(username="x", password=good.encode())
+        assert ack2.reason_code != 0
+        await c2.close()
+
+        # expired token
+        old = make_jwt(secret, {"sub": "dev3", "exp": time.time() - 60})
+        c3 = TestClient(port, "dev3")
+        ack3 = await c3.connect(username="x", password=old.encode())
+        assert ack3.reason_code != 0
+        await c3.close()
+
+        # garbage signature
+        forged = good[:-4] + "AAAA"
+        c4 = TestClient(port, "dev1")
+        ack4 = await c4.connect(username="x", password=forged.encode())
+        assert ack4.reason_code != 0
+        await c4.close()
+        await srv.stop()
+
+    run(t())
+
+
+def test_http_authenticator_async_path():
+    async def t():
+        calls = []
+
+        async def handle(request):
+            body = await request.json()
+            calls.append(body)
+            if body["username"] == "alice" and body["password"] == "pw":
+                return web.json_response(
+                    {"result": "allow", "is_superuser": True}
+                )
+            return web.json_response({"result": "deny"})
+
+        app = web.Application()
+        app.router.add_post("/auth", handle)
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        auth_port = site._server.sockets[0].getsockname()[1]
+
+        srv = make_server()
+        http_auth = HttpAuthenticator(
+            f"http://127.0.0.1:{auth_port}/auth"
+        )
+        srv.broker.access.authenticators.append(http_auth)
+        await srv.start()
+        port = srv.listeners[0].port
+
+        c = TestClient(port, "web1")
+        ack = await c.connect(username="alice", password=b"pw")
+        assert ack.reason_code == 0
+        assert calls and calls[0]["clientid"] == "web1"
+        await c.disconnect()
+
+        c2 = TestClient(port, "web2")
+        ack2 = await c2.connect(username="eve", password=b"x")
+        assert ack2.reason_code != 0
+        await c2.close()
+
+        await http_auth.close()
+        await srv.stop()
+        await runner.cleanup()
+
+    run(t())

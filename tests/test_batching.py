@@ -168,3 +168,58 @@ def test_batcher_failure_does_not_ack():
         await srv.stop()
 
     run(t())
+
+
+def test_rate_limited_flooder_does_not_starve_others():
+    """A listener with messages_rate throttles a flooding publisher via
+    read-pausing while a well-behaved client on the same listener keeps
+    its latency (emqx_limiter semantics: throttle, not disconnect)."""
+    import time as _time
+
+    async def t():
+        cfg = BrokerConfig()
+        cfg.listeners = [ListenerConfig(port=0, messages_rate=50)]
+        srv = BrokerServer(cfg)
+        await srv.start()
+        port = srv.listeners[0].port
+
+        sub = TestClient(port, "watcher")
+        await sub.connect()
+        await sub.subscribe("flood/#")
+        await sub.subscribe("calm/#")
+
+        flooder = TestClient(port, "flood")
+        await flooder.connect()
+
+        async def blast():
+            # fire-and-forget qos0 flood, ~10x over the budget
+            for i in range(300):
+                try:
+                    await flooder.send(
+                        __import__("emqx_tpu.codec.mqtt", fromlist=["x"])
+                        .Publish(topic="flood/x", payload=b"f", qos=0)
+                    )
+                except ConnectionError:
+                    return
+
+        task = asyncio.get_running_loop().create_task(blast())
+        await asyncio.sleep(0.3)
+
+        calm = TestClient(port, "calm")
+        await calm.connect()
+        t0 = _time.perf_counter()
+        await calm.publish("calm/ping", b"p", qos=1)
+        calm_rtt = _time.perf_counter() - t0
+        assert calm_rtt < 0.5  # not starved by the flood
+
+        # the flooder is throttled: nowhere near 300 deliveries yet
+        n = srv.broker.metrics.val("messages.received")
+        assert n < 150, n
+        assert srv.broker.metrics.val("connection.rate_limited") > 0
+        task.cancel()
+        await calm.disconnect()
+        await sub.close()
+        await flooder.close()
+        await srv.stop()
+
+    run(t())

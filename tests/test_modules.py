@@ -1,0 +1,154 @@
+"""Broker modules: delayed publish ($delayed/), topic rewrite,
+exclusive subscriptions ($exclusive/), auto-subscribe
+(emqx_modules/emqx_delayed.erl, emqx_rewrite.erl,
+emqx_exclusive_subscription.erl, emqx_auto_subscribe)."""
+
+import asyncio
+import time
+
+from emqx_tpu.broker.listener import BrokerServer
+from emqx_tpu.config import BrokerConfig, ListenerConfig
+from emqx_tpu.modules import RewriteRule
+from mqtt_client import TestClient
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_server(**cfg_fn):
+    cfg = BrokerConfig()
+    cfg.listeners = [ListenerConfig(port=0)]
+    for k, v in cfg_fn.items():
+        setattr(cfg, k, v)
+    return BrokerServer(cfg)
+
+
+def test_delayed_publish():
+    async def t():
+        srv = make_server()
+        await srv.start()
+        port = srv.listeners[0].port
+        sub = TestClient(port, "s")
+        await sub.connect()
+        await sub.subscribe("job/#", qos=1)
+        pub = TestClient(port, "p")
+        await pub.connect()
+        t0 = time.monotonic()
+        await pub.publish("$delayed/1/job/run", b"later", qos=1)
+        assert len(srv.broker.delayed) == 1
+        # nothing delivered before the delay elapses
+        try:
+            got_early = await sub.recv(timeout=0.3)
+            assert got_early is None or got_early.type != 3
+        except asyncio.TimeoutError:
+            pass  # exactly what we want: nothing arrived
+        srv.broker.delayed.tick(time.time() + 2)  # fast-forward
+        pkt = await sub.recv_publish()
+        assert pkt.topic == "job/run" and pkt.payload == b"later"
+        # malformed delay drops
+        await pub.publish("$delayed/notanum", b"x", qos=1)
+        assert len(srv.broker.delayed) == 0
+        await pub.disconnect()
+        await sub.disconnect()
+        await srv.stop()
+
+    run(t())
+
+
+def test_topic_rewrite_pub_and_sub():
+    async def t():
+        srv = make_server()
+        await srv.start()
+        srv.broker.rewrite.add_rule(
+            RewriteRule(
+                action="all",
+                source="x/#",
+                pattern=r"^x/y/(.+)$",
+                dest=r"z/y/\1",
+            )
+        )
+        port = srv.listeners[0].port
+        sub = TestClient(port, "s")
+        await sub.connect()
+        # subscribing x/y/+ actually lands on z/y/+
+        await sub.subscribe("x/y/+", qos=1)
+        pub = TestClient(port, "p")
+        await pub.connect()
+        await pub.publish("z/y/direct", b"d", qos=1)
+        assert (await sub.recv_publish()).payload == b"d"
+        # publishing x/y/1 is rewritten to z/y/1
+        await pub.publish("x/y/1", b"r", qos=1)
+        pkt = await sub.recv_publish()
+        assert pkt.topic == "z/y/1" and pkt.payload == b"r"
+        await pub.disconnect()
+        await sub.disconnect()
+        await srv.stop()
+
+    run(t())
+
+
+def test_exclusive_subscription():
+    async def t():
+        srv = make_server()
+        srv.broker.config.mqtt.exclusive_subscription = True
+        await srv.start()
+        port = srv.listeners[0].port
+        a = TestClient(port, "a")
+        await a.connect()
+        ack = await a.subscribe("$exclusive/lock/1", qos=1)
+        assert ack.reason_codes[0] <= 2
+        b = TestClient(port, "b")
+        await b.connect()
+        ack_b = await b.subscribe("$exclusive/lock/1", qos=1)
+        assert ack_b.reason_codes[0] == 0x97  # already held
+        # holder receives messages on the REAL topic
+        pub = TestClient(port, "p")
+        await pub.connect()
+        await pub.publish("lock/1", b"m", qos=1)
+        assert (await a.recv_publish()).payload == b"m"
+        # release on disconnect frees the lock
+        await a.disconnect()
+        await asyncio.sleep(0.05)
+        ack_b2 = await b.subscribe("$exclusive/lock/1", qos=1)
+        assert ack_b2.reason_codes[0] <= 2
+        await b.disconnect()
+        await pub.disconnect()
+        await srv.stop()
+
+    run(t())
+
+
+def test_exclusive_disabled_by_default():
+    async def t():
+        srv = make_server()
+        await srv.start()
+        a = TestClient(srv.listeners[0].port, "a")
+        await a.connect()
+        ack = await a.subscribe("$exclusive/q/1", qos=1)
+        assert ack.reason_codes[0] >= 0x80
+        await a.disconnect()
+        await srv.stop()
+
+    run(t())
+
+
+def test_auto_subscribe():
+    async def t():
+        srv = make_server(
+            auto_subscribe=[{"topic": "inbox/%c", "qos": 1}]
+        )
+        await srv.start()
+        port = srv.listeners[0].port
+        c = TestClient(port, "dev9")
+        await c.connect()
+        pub = TestClient(port, "p")
+        await pub.connect()
+        await pub.publish("inbox/dev9", b"auto", qos=1)
+        pkt = await c.recv_publish()
+        assert pkt.payload == b"auto"
+        await pub.disconnect()
+        await c.disconnect()
+        await srv.stop()
+
+    run(t())
