@@ -321,9 +321,16 @@ def run_broker_bench(log):
         await asyncio.gather(*(e.wait() for e in sub_ready))
         if device:
             t_warm = time.perf_counter()
-            warmed = await loop.run_in_executor(
-                None, srv.broker.router.engine.warmup, 4096
-            )
+
+            def build_and_warm():
+                # the threshold crossing kicked a BACKGROUND rebuild;
+                # force a synchronous one (joins the builder) so the
+                # automaton exists before warming the batch buckets
+                eng = srv.broker.router.engine
+                eng.rebuild()
+                return eng.warmup(4096)
+
+            warmed = await loop.run_in_executor(None, build_and_warm)
             log(
                 f"warmed {warmed} kernel batch buckets in "
                 f"{time.perf_counter() - t_warm:.1f}s"
@@ -442,7 +449,7 @@ def main():
         tokens, lengths, dollar = encode_topics(
             tdict, words, aut.kernel_levels
         )
-        return match_batch(
+        out = match_batch(
             *dev,
             tokens,
             lengths,
@@ -451,6 +458,13 @@ def main():
             f_width=f_width,
             m_cap=m_cap,
         )
+        # start the device->host copies immediately so transfers overlap
+        # with the next batches' compute instead of serializing on the
+        # (tunnel-inflated) round-trip at drain time
+        out[0].copy_to_host_async()
+        out[1].copy_to_host_async()
+        out[2].copy_to_host_async()
+        return out
 
     def drain(out):
         """Transfer the compact code form and expand to per-topic fid

@@ -178,26 +178,39 @@ class AccessControl:
 
     # ---------------------------------------------------------- authn
 
+    def _hook_verdict(self, client: ClientInfo) -> Optional[bool]:
+        if self.hooks is None:
+            return None
+        res = self.hooks.run_fold("client.authenticate", (client,), IGNORE)
+        if res == DENY:
+            return False
+        if res == ALLOW:
+            return True
+        return None
+
+    @staticmethod
+    def _apply_decision(
+        decision: str, updates: Dict, client: ClientInfo
+    ) -> Optional[bool]:
+        if decision == ALLOW:
+            for k, v in updates.items():
+                setattr(client, k, v)
+            return True
+        if decision == DENY:
+            return False
+        return None
+
     def authenticate(self, client: ClientInfo) -> Tuple[bool, ClientInfo]:
         """Returns (ok, possibly-updated clientinfo).  Async providers
         (is_async=True, e.g. HTTP) are SKIPPED here — channels route
         through ``authenticate_async`` when any are registered."""
-        if self.hooks is not None:
-            res = self.hooks.run_fold(
-                "client.authenticate", (client,), IGNORE
-            )
-            if res == DENY:
-                return False, client
-            if res == ALLOW:
-                return True, client
+        verdict = self._hook_verdict(client)
+        if verdict is not None:
+            return verdict, client
         for auth in self.authenticators:
-            decision, updates = auth.authenticate(client)
-            if decision == ALLOW:
-                for k, v in updates.items():
-                    setattr(client, k, v)
-                return True, client
-            if decision == DENY:
-                return False, client
+            out = self._apply_decision(*auth.authenticate(client), client)
+            if out is not None:
+                return out, client
         return self.allow_anonymous, client
 
     @property
@@ -209,28 +222,27 @@ class AccessControl:
     async def authenticate_async(
         self, client: ClientInfo
     ) -> Tuple[bool, ClientInfo]:
-        """Chain walk that awaits async providers in order (the
-        per-listener chain of emqx_authn_chains, with IO providers)."""
-        if self.hooks is not None:
-            res = self.hooks.run_fold(
-                "client.authenticate", (client,), IGNORE
-            )
-            if res == DENY:
-                return False, client
-            if res == ALLOW:
-                return True, client
+        """Same chain walk, awaiting IO providers in order (the
+        per-listener chain of emqx_authn_chains with IO providers)."""
+        verdict = self._hook_verdict(client)
+        if verdict is not None:
+            return verdict, client
         for auth in self.authenticators:
             if getattr(auth, "is_async", False):
                 decision, updates = await auth.authenticate_async(client)
             else:
                 decision, updates = auth.authenticate(client)
-            if decision == ALLOW:
-                for k, v in updates.items():
-                    setattr(client, k, v)
-                return True, client
-            if decision == DENY:
-                return False, client
+            out = self._apply_decision(decision, updates, client)
+            if out is not None:
+                return out, client
         return self.allow_anonymous, client
+
+    async def close(self) -> None:
+        """Release IO-backed providers (HTTP sessions etc.)."""
+        for auth in self.authenticators:
+            closer = getattr(auth, "close", None)
+            if closer is not None:
+                await closer()
 
     # ---------------------------------------------------------- authz
 

@@ -305,13 +305,17 @@ class Broker:
         if channel is not None:
             channel.close("takenover")
         # unacked inflight PUBLISHes re-deliver FIRST (original send
-        # order precedes the backlog, [MQTT-4.6.0-1]); PUBREL-phase
-        # entries are dropped — the receiver already owns the message
-        queued = [
-            msg_to_wire(entry.msg)
-            for _pid, entry in session.inflight.items()
-            if entry.msg is not None
-        ]
+        # order precedes the backlog, [MQTT-4.6.0-1]) with the EFFECTIVE
+        # (subscription-granted) qos and dup set, exactly as a local
+        # resume would; PUBREL-phase entries are dropped — the receiver
+        # already owns the message
+        queued = []
+        for _pid, entry in session.inflight.items():
+            if entry.msg is not None:
+                w = msg_to_wire(entry.msg)
+                w["qos"] = entry.qos
+                w["dup"] = True
+                queued.append(w)
         while True:
             m = session.mqueue.pop()
             if m is None:

@@ -281,6 +281,16 @@ class Channel:
 
         ext = self.broker.external
         if (
+            ext is not None
+            and pkt.clean_start
+            and ext.remote_owner(clientid) is not None
+        ):
+            # clientid uniqueness is cluster-wide regardless of
+            # clean_start: a duplicate live connection on another node
+            # must be kicked (the reference discards the remote session
+            # either way; no state transfer is wanted here)
+            ext.discard_remote(clientid)
+        if (
             not pkt.clean_start
             and ext is not None
             and self.broker.cm.lookup(clientid) is None

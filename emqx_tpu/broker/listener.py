@@ -201,6 +201,7 @@ class BrokerServer:
             await self.broker.batcher.stop()
             self.broker.batcher = None
         await self.broker.resources.stop_all()
+        await self.broker.access.close()
         self.broker.shutdown()
 
     async def run_forever(self) -> None:

@@ -140,12 +140,11 @@ class ClusterNode:
         # emqx_cm_registry role, emqx_cm_registry.erl:161) — drives
         # cross-node session takeover on reconnect-elsewhere
         self.clients: Dict[str, str] = {}
-        self._pending_client_ops: List[Tuple[str, str]] = []
         self._pending_fwd: Dict[str, List[Message]] = {}
 
         self.transport.on("route_ops", self._handle_route_ops)
-        self.transport.on("client_ops", self._handle_client_ops)
         self.transport.on("takeover", self._handle_takeover)
+        self.transport.on("client_discard", self._handle_client_discard)
         self.transport.on("forward_batch", self._handle_forward_batch)
         self.transport.on("heartbeat", self._handle_heartbeat)
         self.transport.on("sync", self._handle_sync)
@@ -241,11 +240,6 @@ class ClusterNode:
                         "ops": ops,
                     }
                 )
-            if self._pending_client_ops:
-                cops, self._pending_client_ops = self._pending_client_ops, []
-                casts.append(
-                    {"type": "client_ops", "node": self.name, "ops": cops}
-                )
             for obj in casts:
                 await asyncio.gather(
                     *(
@@ -266,20 +260,26 @@ class ClusterNode:
             self._op_log[node] = deque(maxlen=8192)
 
     async def _handle_route_ops(self, peer: str, obj: Dict) -> None:
+        """One ordered op stream per peer: route ops (add/del on a
+        filter) and client-registry ops (cadd/cdel on a clientid)."""
         node = obj.get("node", peer)
         self._check_epoch(node, obj.get("epoch", 0))
         log_ = self._op_log[node]
-        for seq, op, flt in obj.get("ops", ()):
+        for seq, op, arg in obj.get("ops", ()):
             if seq <= self._peer_seq.get(node, 0):
                 # already reflected by an applied snapshot (or a dup):
                 # re-applying a stale delete would transiently remove a
                 # route the snapshot re-asserted
                 continue
             if op == "add":
-                self.routes.add_route(flt, node)
-            else:
-                self.routes.delete_route(flt, node)
-            log_.append((seq, op, flt))
+                self.routes.add_route(arg, node)
+            elif op == "del":
+                self.routes.delete_route(arg, node)
+            elif op == "cadd":
+                self.clients[arg] = node
+            elif op == "cdel" and self.clients.get(arg) == node:
+                del self.clients[arg]
+            log_.append((seq, op, arg))
             self._peer_seq[node] = seq
 
     def _apply_snapshot(
@@ -294,7 +294,7 @@ class ClusterNode:
         for flt in filters:
             self.routes.add_route(flt, node)
         for seq, op, flt in self._op_log.get(node, ()):
-            if seq > snap_seq:
+            if seq > snap_seq and op in ("add", "del"):
                 if op == "add":
                     self.routes.add_route(flt, node)
                 else:
@@ -324,7 +324,9 @@ class ClusterNode:
         self._mark_alive(peer)
         self._synced.add(peer)
         self._check_epoch(peer, reply.get("epoch", 0))
-        self._apply_clients(peer, reply.get("clients", ()))
+        self._apply_clients(
+            peer, reply.get("clients", ()), reply.get("seq", 0)
+        )
         # split the reply: the responder's own routes purge-and-replace
         # (seq-guarded); third-party routes are add-only hints, so force
         # a direct (purge-and-replace) sync with each of those nodes to
@@ -354,7 +356,7 @@ class ClusterNode:
         # against its own racing casts, same as the requester side)
         self._check_epoch(node, obj.get("epoch", 0))
         self._apply_snapshot(node, obj.get("routes", ()), obj.get("seq", 0))
-        self._apply_clients(node, obj.get("clients", ()))
+        self._apply_clients(node, obj.get("clients", ()), obj.get("seq", 0))
         return {
             "routes": self.routes.all_routes(),
             "clients": self._local_clients(),
@@ -367,13 +369,21 @@ class ClusterNode:
             cid for cid, n in self.clients.items() if n == self.name
         )
 
-    def _apply_clients(self, node: str, cids) -> None:
-        """Purge-and-replace `node`'s client-registry claims."""
+    def _apply_clients(self, node: str, cids, snap_seq: int = 0) -> None:
+        """Purge-and-replace `node`'s client-registry claims, then
+        re-apply client ops that raced past the snapshot (same seq
+        guard as the route snapshot)."""
         for cid, n in list(self.clients.items()):
             if n == node:
                 del self.clients[cid]
         for cid in cids:
             self.clients[cid] = node
+        for seq, op, cid in self._op_log.get(node, ()):
+            if seq > snap_seq and op in ("cadd", "cdel"):
+                if op == "cadd":
+                    self.clients[cid] = node
+                elif self.clients.get(cid) == node:
+                    del self.clients[cid]
 
     def _learn_peer(self, node: str, listen) -> None:
         """Adopt a peer advertised in a sync/heartbeat message so
@@ -398,17 +408,13 @@ class ClusterNode:
     def _queue_client_op(self, op: str, clientid: str) -> None:
         if not self._started:
             return
-        self._pending_client_ops.append((op, clientid))
-        if len(self._pending_client_ops) >= self.flush_max:
+        # client ops ride the SAME ordered op stream as route ops (one
+        # shared seq, one cast sequence): separate casts would re-order
+        # against each other and break the per-peer seq guard
+        self._op_seq += 1
+        self._pending_ops.append((self._op_seq, "c" + op, clientid))
+        if len(self._pending_ops) >= self.flush_max:
             self._flush_wakeup.set()
-
-    async def _handle_client_ops(self, peer: str, obj: Dict) -> None:
-        node = obj.get("node", peer)
-        for op, cid in obj.get("ops", ()):
-            if op == "add":
-                self.clients[cid] = node
-            elif self.clients.get(cid) == node:
-                del self.clients[cid]
 
     def remote_owner(self, clientid: str) -> Optional[str]:
         """The live peer owning this client's session, if any."""
@@ -416,6 +422,25 @@ class ClusterNode:
         if owner is None or owner == self.name or owner in self._down:
             return None
         return owner
+
+    def discard_remote(self, clientid: str) -> None:
+        """Fire-and-forget kick of a duplicate session on its owning
+        node (clean_start reconnect elsewhere: cluster-wide clientid
+        uniqueness without a state transfer)."""
+        owner = self.remote_owner(clientid)
+        if owner is None:
+            return
+        loop = asyncio.get_running_loop()
+        task = loop.create_task(
+            self.transport.cast(
+                owner, {"type": "client_discard", "clientid": clientid}
+            )
+        )
+        self._fwd_tasks.add(task)
+        task.add_done_callback(self._fwd_tasks.discard)
+
+    async def _handle_client_discard(self, peer: str, obj: Dict) -> None:
+        self.broker.cm.kick(obj.get("clientid", ""))
 
     async def takeover(self, clientid: str) -> Optional[Dict]:
         """Fetch (and migrate away) the session owned by a peer — the

@@ -147,6 +147,9 @@ class MatchEngine:
         # sockets); the kernel call itself runs OUTSIDE this lock on an
         # immutable snapshot, so a SUBSCRIBE never waits on the device
         self._mlock = threading.RLock()
+        # words-tuple -> encoded row cache (see _encode_cached)
+        self._enc_cache: Dict[Tuple[str, ...], Tuple] = {}
+        self._enc_gen = 0
         self._building = False
         self._built: Optional[Tuple] = None  # (aut, dev, fid_arr, base_fids)
         self._build_thread: Optional[threading.Thread] = None
@@ -662,14 +665,49 @@ class MatchEngine:
     def _flat_from_snapshot(self, snap: Tuple, words: Sequence[T.Words]):
         return self._flat_finish(self._flat_dispatch(snap[0], snap[1], words))
 
+    def _encode_cached(self, words, levels: int):
+        """Tokenize with a per-topic row cache: live publish streams are
+        Zipf-heavy, so hot topics re-encode as one dict hit instead of a
+        per-word walk.  The cache invalidates wholesale whenever the
+        token dictionary grows (a previously-unknown word may now be a
+        filter literal, making cached UNKNOWN rows stale)."""
+        from .ops.dictionary import PAD_TOK
+
+        gen = len(self._tdict)
+        if gen != self._enc_gen:
+            self._enc_cache.clear()
+            self._enc_gen = gen
+        cache = self._enc_cache
+        b = len(words)
+        tokens = np.full((b, levels), PAD_TOK, np.int32)
+        lengths = np.zeros(b, np.int32)
+        dollar = np.zeros(b, bool)
+        get = self._tdict.get
+        for i, ws in enumerate(words):
+            key = (ws, levels)
+            hit = cache.get(key)
+            if hit is None:
+                n = min(len(ws), levels)
+                row = np.full(levels, PAD_TOK, np.int32)
+                for j in range(n):
+                    row[j] = get(ws[j])
+                hit = (row, n, bool(ws) and ws[0].startswith("$"))
+                if len(cache) >= 131072:
+                    cache.clear()
+                cache[key] = hit
+            tokens[i] = hit[0]
+            lengths[i] = hit[1]
+            dollar[i] = hit[2]
+        return tokens, lengths, dollar
+
     def _flat_dispatch(self, aut, tables, words: Sequence[T.Words]):
         """Encode + launch the kernel; returns a pending handle without
         blocking (JAX async dispatch), so several automata (base +
         segments) overlap on the device and the host<->device link."""
         from .ops.match_kernel import match_batch
 
-        tokens, lengths, dollar = encode_topics(
-            self._tdict, words, aut.kernel_levels
+        tokens, lengths, dollar = self._encode_cached(
+            words, aut.kernel_levels
         )
         b = tokens.shape[0]
         tokens, lengths, dollar = _pad_batch(tokens, lengths, dollar)
@@ -682,6 +720,12 @@ class MatchEngine:
             f_width=self.f_width,
             m_cap=self.m_cap,
         )
+        # start device->host copies immediately: results stream back
+        # while later dispatches (delta automaton, next windows) compute,
+        # instead of serializing on the round-trip at finish time
+        if hasattr(codes, "copy_to_host_async"):
+            codes.copy_to_host_async()
+            ovf.copy_to_host_async()
         return aut, codes, ovf, b
 
     def _flat_finish(self, pending):

@@ -204,6 +204,7 @@ class BufferWorker:
                     self._buf.popleft()
                     self.stats["failed"] += 1
                     retries = 0
+                    backoff = self.retry_base  # next query starts fresh
                     log.warning(
                         "sink query dropped after %d retries: %s",
                         self.max_retries,

@@ -559,3 +559,30 @@ def test_clean_session_churn_does_not_leak_registry():
         await stop_node(srv_a, a)
 
     run(t())
+
+
+def test_clean_start_elsewhere_kicks_remote_duplicate():
+    """Cluster-wide clientid uniqueness holds for clean_start=True too:
+    the old node's live connection is kicked, no state transfers."""
+
+    async def t():
+        srv_a, a = await start_node("a")
+        srv_b, b = await start_node("b", seeds=[("a", "127.0.0.1", a.port)])
+        await settle(0.3)
+        c1 = TestClient(srv_a.listeners[0].port, "uniq-1")
+        await c1.connect(
+            clean_start=False,
+            properties={"session_expiry_interval": 3600},
+        )
+        await settle(0.2)
+        c2 = TestClient(srv_b.listeners[0].port, "uniq-1")
+        ack = await c2.connect(clean_start=True)
+        assert not ack.session_present
+        await settle(0.3)
+        assert srv_a.broker.cm.lookup("uniq-1") is None  # kicked
+        assert srv_b.broker.cm.lookup("uniq-1") is not None
+        await c2.disconnect()
+        await stop_node(srv_b, b)
+        await stop_node(srv_a, a)
+
+    run(t())
