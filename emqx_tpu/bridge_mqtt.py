@@ -1,0 +1,158 @@
+"""MQTT bridge: egress and ingress between this broker and a remote
+MQTT broker.
+
+The `emqx_bridge_mqtt` role (/root/reference/apps/emqx_bridge_mqtt,
+emqtt-based): *egress* forwards locally published topics to a remote
+broker through the buffered resource layer (outage-safe, bounded
+replay); *ingress* subscribes remotely and republishes locally with an
+optional topic prefix.  Both ride `MqttClient` with auto-reconnect.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional, Tuple
+
+from .client import MqttClient
+from .hooks import STOP_WITH
+from .message import Message
+from .resources import Resource
+
+log = logging.getLogger("emqx_tpu.bridge")
+
+
+class MqttEgressResource(Resource):
+    """Resource wrapper: queries are (topic, payload, qos, retain)."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        client_id: str,
+        username: Optional[str] = None,
+        password: Optional[bytes] = None,
+    ) -> None:
+        self.client = MqttClient(
+            host, port, client_id, username=username, password=password
+        )
+
+    async def on_start(self) -> None:
+        await self.client.start()
+
+    async def on_stop(self) -> None:
+        await self.client.stop()
+
+    async def on_query(self, query: Tuple[str, bytes, int, bool]) -> None:
+        topic, payload, qos, retain = query
+        await self.client.publish(topic, payload, qos=qos, retain=retain)
+
+    async def health_check(self) -> bool:
+        return self.client.connected.is_set()
+
+
+class MqttBridge:
+    """One configured bridge: egress topic filters and/or ingress
+    remote subscriptions."""
+
+    def __init__(
+        self,
+        broker,
+        name: str,
+        host: str,
+        port: int,
+        egress: Optional[List[str]] = None,  # local filters to forward
+        ingress: Optional[List[str]] = None,  # remote filters to import
+        remote_prefix: str = "",  # prepended to egressed topics
+        local_prefix: str = "",  # prepended to ingressed topics
+        username: Optional[str] = None,
+        password: Optional[bytes] = None,
+        forward_qos: int = 1,
+    ) -> None:
+        self.broker = broker
+        self.name = name
+        self.egress = list(egress or ())
+        self.ingress = list(ingress or ())
+        self.remote_prefix = remote_prefix
+        self.local_prefix = local_prefix
+        self.forward_qos = forward_qos
+        self._resource = MqttEgressResource(
+            host, port, f"bridge-{name}", username=username, password=password
+        )
+        self._ingress_client: Optional[MqttClient] = None
+        if self.ingress:
+            self._ingress_client = MqttClient(
+                host,
+                port,
+                f"bridge-{name}-in",
+                username=username,
+                password=password,
+            )
+            self._ingress_client.on_message = self._on_remote
+        self._hook_cb = None
+        (
+            self._host,
+            self._port,
+            self._username,
+            self._password,
+        ) = (host, port, username, password)
+
+    # ------------------------------------------------------- lifecycle
+
+    async def start(self) -> None:
+        await self.broker.resources.create(
+            f"bridge:{self.name}", self._resource, retry_base=0.05
+        )
+        if self.egress:
+            self._hook_cb = self.broker.hooks.add(
+                "message.publish", self._on_local, priority=-50
+            )
+        if self._ingress_client is not None:
+            for flt in self.ingress:
+                await self._ingress_client.subscribe(flt, qos=self.forward_qos)
+            await self._ingress_client.start()
+
+    async def stop(self) -> None:
+        if self._hook_cb is not None:
+            self.broker.hooks.delete("message.publish", self._hook_cb)
+            self._hook_cb = None
+        if self._ingress_client is not None:
+            await self._ingress_client.stop()
+        await self.broker.resources.remove(f"bridge:{self.name}")
+
+    # ----------------------------------------------------------- taps
+
+    def _on_local(self, msg: Message):
+        """Egress tap on 'message.publish': matching local topics
+        queue into the buffered resource (never blocks the hot path)."""
+        from . import topic as T
+
+        if msg.sys or msg.headers.get("bridged"):
+            return None
+        for flt in self.egress:
+            if T.match(msg.topic, flt):
+                worker = self.broker.resources.get(f"bridge:{self.name}")
+                if worker is not None:
+                    worker.enqueue(
+                        (
+                            self.remote_prefix + msg.topic,
+                            msg.payload,
+                            min(msg.qos, self.forward_qos),
+                            msg.retain,
+                        )
+                    )
+                self.broker.metrics.inc("bridge.egress")
+                break
+        return None  # the fold accumulator is untouched
+
+    def _on_remote(self, msg: Message) -> None:
+        """Ingress: republish a remote message locally (loop-marked so
+        an overlapping egress filter can't echo it back out)."""
+        local = Message(
+            topic=self.local_prefix + msg.topic,
+            payload=msg.payload,
+            qos=msg.qos,
+            retain=msg.retain,
+            headers={"bridged": True},
+        )
+        self.broker.metrics.inc("bridge.ingress")
+        self.broker.publish(local)

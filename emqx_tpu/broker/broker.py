@@ -85,6 +85,27 @@ class Broker:
         self.delayed = DelayedPublish(self)
         self.rewrite = TopicRewrite(self)
         self.exclusive = ExclusiveSub()
+        from ..ops_guard import (
+            AlarmRegistry,
+            BannedList,
+            FlappingDetector,
+            SlowSubs,
+        )
+
+        from ..trace import TraceManager
+
+        self.trace = TraceManager(self)
+        self.alarms = AlarmRegistry(self)
+        self.banned = BannedList()
+        fl = self.config.flapping
+        self.flapping = FlappingDetector(
+            self.banned,
+            max_count=fl.max_count,
+            window=fl.window,
+            ban_time=fl.ban_time,
+            enable=fl.enable,
+        )
+        self.slow_subs = SlowSubs()
         # ClusterNode installs itself here (the emqx_external_broker
         # registration point, emqx_broker.erl:379-380): provides
         # match_remote(topics) and forward(msg, nodes)
@@ -625,6 +646,12 @@ class Broker:
             packets = session.deliver(deliveries)
             self.hooks.run("message.delivered", clientid, deliveries)
             channel.send_packets(packets)
+            now = time.time()
+            for m, _opts in deliveries:
+                if m.timestamp:
+                    self.slow_subs.record(
+                        clientid, m.topic, (now - m.timestamp) * 1000.0
+                    )
             return len(deliveries)
         # detached persistent session: queue QoS>0, drop QoS0
         kept = 0
@@ -664,6 +691,7 @@ class Broker:
             _, will = self._pending_wills.pop(cid)
             self.publish(will)
         self.delayed.tick(now)
+        self.alarms.tick(now)
         self.cm.expire_sessions(now)
         if self.durable is not None:
             self.durable.purge_expired(now)
@@ -677,8 +705,40 @@ class Broker:
 
     def shutdown(self) -> None:
         """Flush and close durable state (called by BrokerServer.stop)."""
+        self.trace.stop_all()
         if self.durable is not None:
             self.durable.close()
+
+    # -------------------------------------------------- config updates
+
+    def apply_config(self, path: str, value) -> None:
+        """Apply one dotted-path config update to the live config tree
+        (the emqx_config_handler runtime-update role; cluster-wide
+        ordering is the ClusterNode's conf-txn journal).  Raises
+        ValueError for any unknown path segment."""
+        parts = path.split(".")
+        obj = self.config
+        for part in parts[:-1]:
+            if isinstance(obj, dict):
+                if part not in obj:
+                    raise ValueError(f"unknown config key: {path}")
+                obj = obj[part]
+            else:
+                if not hasattr(obj, part):
+                    raise ValueError(f"unknown config key: {path}")
+                obj = getattr(obj, part)
+        leaf = parts[-1]
+        if isinstance(obj, dict):
+            obj[leaf] = value
+        else:
+            if not hasattr(obj, leaf):
+                raise ValueError(f"unknown config key: {path}")
+            old = getattr(obj, leaf)
+            # coerce to the existing leaf's type (JSON loses int/float)
+            if old is not None and not isinstance(value, type(old)):
+                value = type(old)(value)
+            setattr(obj, leaf, value)
+        self.hooks.run("config.updated", path, value)
 
     # ----------------------------------------------------- sys info
 
@@ -721,6 +781,13 @@ class PublishBatcher:
 
     def congested(self) -> bool:
         if self._queue.qsize() >= self.high_watermark:
+            # activate() is a cheap no-op while already active, and an
+            # operator-cleared alarm re-raises while congestion persists
+            self.broker.alarms.activate(
+                "publish_queue_congested",
+                details={"depth": self._queue.qsize()},
+                message="publish micro-batch queue above high watermark",
+            )
             self._uncongested.clear()
             return True
         return False
@@ -797,3 +864,4 @@ class PublishBatcher:
                 and self._queue.qsize() <= self.low_watermark
             ):
                 self._uncongested.set()
+                self.broker.alarms.deactivate("publish_queue_congested")

@@ -225,6 +225,13 @@ class Channel:
             self._connack_error(RC_BAD_CLIENTID)
             return
 
+        peerhost = self.peer.rsplit(":", 1)[0] if self.peer else ""
+        if self.broker.banned.is_banned(
+            clientid=clientid, username=pkt.username, peerhost=peerhost
+        ):
+            m.inc("client.banned")
+            self._connack_error(0x8A)  # banned ([MQTT-3.2.2.2])
+            return
         client = ClientInfo(
             clientid=clientid,
             username=pkt.username,
@@ -771,6 +778,13 @@ class Channel:
         m = self.broker.metrics
         if self.client is not None:
             m.inc("client.disconnected")
+            if self.broker.flapping.on_disconnect(self.client.clientid):
+                m.inc("client.flapping_banned")
+                self.broker.alarms.activate(
+                    f"flapping/{self.client.clientid}",
+                    message="client banned for flapping",
+                    ttl=self.broker.flapping.ban_time,
+                )
             self.broker.hooks.run(
                 "client.disconnected", self.client, reason
             )

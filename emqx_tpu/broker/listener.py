@@ -75,14 +75,18 @@ class Listener:
         )
 
     async def stop(self) -> None:
+        # cancel connection handlers BEFORE wait_closed: Python 3.12's
+        # Server.wait_closed also waits for live handlers, so the old
+        # order deadlocks while any client is still connected
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
-            self._server = None
         for task in list(self._conns):
             task.cancel()
         if self._conns:
             await asyncio.gather(*self._conns, return_exceptions=True)
+        if self._server is not None:
+            await self._server.wait_closed()
+            self._server = None
 
     async def _on_client(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter

@@ -1,0 +1,263 @@
+"""Async MQTT client — the `emqtt` analogue, on this package's codec.
+
+Used by the MQTT bridge (and available standalone): connect with
+auto-reconnect + resubscribe, QoS 0/1/2 publish with pipelined acks,
+subscription callbacks, keepalive pings.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+from typing import Awaitable, Callable, Dict, List, Optional, Tuple
+
+from .codec import mqtt as C
+from .message import Message
+
+log = logging.getLogger("emqx_tpu.client")
+
+OnMessage = Callable[[Message], Optional[Awaitable[None]]]
+
+
+class MqttClient:
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        client_id: str,
+        username: Optional[str] = None,
+        password: Optional[bytes] = None,
+        keepalive: int = 60,
+        clean_start: bool = True,
+        reconnect_min: float = 0.2,
+        reconnect_max: float = 10.0,
+        version: int = C.MQTT_V5,
+    ) -> None:
+        self.host, self.port = host, port
+        self.client_id = client_id
+        self.username = username
+        self.password = password
+        self.keepalive = keepalive
+        self.clean_start = clean_start
+        self.reconnect_min = reconnect_min
+        self.reconnect_max = reconnect_max
+        self.version = version
+        self.on_message: Optional[OnMessage] = None
+        self.connected = asyncio.Event()
+
+        self._subs: Dict[str, int] = {}  # filter -> qos (for resubscribe)
+        self._pids = itertools.count(1)
+        self._acks: Dict[int, asyncio.Future] = {}
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._task: Optional[asyncio.Task] = None
+        self._stopping = False
+
+    # ------------------------------------------------------- lifecycle
+
+    async def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        self._stopping = True
+        if self._writer is not None and not self._writer.is_closing():
+            try:
+                self._writer.write(C.serialize(C.Disconnect(), self.version))
+                await self._writer.drain()
+            except ConnectionError:
+                pass
+            self._writer.close()
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    # ------------------------------------------------------- main loop
+
+    async def _run(self) -> None:
+        backoff = self.reconnect_min
+        while not self._stopping:
+            try:
+                await self._session()
+                backoff = self.reconnect_min
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:
+                log.debug("mqtt client %s: %s", self.client_id, exc)
+            self.connected.clear()
+            for fut in self._acks.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError("link lost"))
+            self._acks.clear()
+            if self._stopping:
+                return
+            await asyncio.sleep(backoff)
+            backoff = min(backoff * 2, self.reconnect_max)
+
+    async def _session(self) -> None:
+        reader, writer = await asyncio.open_connection(self.host, self.port)
+        self._writer = writer
+        parser = C.StreamParser(version=self.version)
+        writer.write(
+            C.serialize(
+                C.Connect(
+                    client_id=self.client_id,
+                    proto_ver=self.version,
+                    clean_start=self.clean_start,
+                    keepalive=self.keepalive,
+                    username=self.username,
+                    password=self.password,
+                ),
+                self.version,
+            )
+        )
+        await writer.drain()
+        ping_task: Optional[asyncio.Task] = None
+        try:
+            while True:
+                data = await reader.read(1 << 16)
+                if not data:
+                    raise ConnectionError("server closed")
+                for pkt in parser.feed(data):
+                    if pkt.type == C.CONNACK:
+                        if pkt.reason_code != 0:
+                            raise ConnectionError(
+                                f"connect refused rc={pkt.reason_code:#x}"
+                            )
+                        self.connected.set()
+                        ping_task = asyncio.get_running_loop().create_task(
+                            self._pinger(writer)
+                        )
+                        await self._resubscribe(writer)
+                    elif pkt.type == C.PUBLISH:
+                        await self._incoming(pkt, writer)
+                    elif pkt.type in (C.PUBACK, C.SUBACK, C.UNSUBACK,
+                                      C.PUBCOMP):
+                        fut = self._acks.pop(pkt.packet_id, None)
+                        if fut is not None and not fut.done():
+                            fut.set_result(pkt)
+                    elif pkt.type == C.PUBREC:
+                        writer.write(
+                            C.serialize(
+                                C.Pubrel(packet_id=pkt.packet_id),
+                                self.version,
+                            )
+                        )
+                        await writer.drain()
+                    elif pkt.type == C.DISCONNECT:
+                        raise ConnectionError("server disconnect")
+                await writer.drain()
+        finally:
+            if ping_task is not None:
+                ping_task.cancel()
+            if not writer.is_closing():
+                writer.close()
+            self._writer = None
+
+    async def _pinger(self, writer: asyncio.StreamWriter) -> None:
+        interval = max(self.keepalive * 0.5, 1.0)
+        while True:
+            await asyncio.sleep(interval)
+            if writer.is_closing():
+                return
+            writer.write(C.serialize(C.Pingreq(), self.version))
+            await writer.drain()
+
+    async def _incoming(
+        self, pkt: "C.Publish", writer: asyncio.StreamWriter
+    ) -> None:
+        if pkt.qos == 1:
+            writer.write(
+                C.serialize(C.Puback(packet_id=pkt.packet_id), self.version)
+            )
+        elif pkt.qos == 2:
+            writer.write(
+                C.serialize(C.Pubrec(packet_id=pkt.packet_id), self.version)
+            )
+        if self.on_message is not None:
+            msg = Message(
+                topic=pkt.topic,
+                payload=pkt.payload,
+                qos=pkt.qos,
+                retain=pkt.retain,
+                properties=dict(pkt.properties),
+            )
+            out = self.on_message(msg)
+            if asyncio.iscoroutine(out):
+                await out
+
+    async def _resubscribe(self, writer: asyncio.StreamWriter) -> None:
+        if not self._subs:
+            return
+        pid = next(self._pids) % 65535 or 1
+        subs = [
+            C.Subscription(topic_filter=f, qos=q)
+            for f, q in self._subs.items()
+        ]
+        writer.write(
+            C.serialize(
+                C.Subscribe(packet_id=pid, subscriptions=subs), self.version
+            )
+        )
+        await writer.drain()
+
+    # ------------------------------------------------------------- api
+
+    async def subscribe(self, flt: str, qos: int = 0) -> None:
+        self._subs[flt] = qos
+        if self.connected.is_set() and self._writer is not None:
+            pid = next(self._pids) % 65535 or 1
+            fut = asyncio.get_running_loop().create_future()
+            self._acks[pid] = fut
+            self._writer.write(
+                C.serialize(
+                    C.Subscribe(
+                        packet_id=pid,
+                        subscriptions=[
+                            C.Subscription(topic_filter=flt, qos=qos)
+                        ],
+                    ),
+                    self.version,
+                )
+            )
+            await self._writer.drain()
+            await asyncio.wait_for(fut, 10)
+
+    async def publish(
+        self,
+        topic: str,
+        payload: bytes,
+        qos: int = 0,
+        retain: bool = False,
+        timeout: float = 10.0,
+    ) -> None:
+        """Publish; for QoS>0 waits for the final ack.  Raises
+        ConnectionError when the link is down (callers buffer/retry —
+        the bridge's BufferWorker does exactly that)."""
+        if not self.connected.is_set() or self._writer is None:
+            raise ConnectionError("not connected")
+        pid = None
+        fut = None
+        if qos > 0:
+            pid = next(self._pids) % 65535 or 1
+            fut = asyncio.get_running_loop().create_future()
+            self._acks[pid] = fut
+        self._writer.write(
+            C.serialize(
+                C.Publish(
+                    topic=topic,
+                    payload=payload,
+                    qos=qos,
+                    retain=retain,
+                    packet_id=pid,
+                ),
+                self.version,
+            )
+        )
+        await self._writer.drain()
+        if fut is not None:
+            await asyncio.wait_for(fut, timeout)

@@ -140,11 +140,17 @@ class ClusterNode:
         # emqx_cm_registry role, emqx_cm_registry.erl:161) — drives
         # cross-node session takeover on reconnect-elsewhere
         self.clients: Dict[str, str] = {}
+        # cluster config journal: per-path last-writer-wins ordered by
+        # (counter, node) — total order, so every node converges to the
+        # same value for every path regardless of arrival order
+        self._conf_counter = 0
+        self._conf_latest: Dict[str, Tuple[int, str, Any]] = {}
         self._pending_fwd: Dict[str, List[Message]] = {}
 
         self.transport.on("route_ops", self._handle_route_ops)
         self.transport.on("takeover", self._handle_takeover)
         self.transport.on("client_discard", self._handle_client_discard)
+        self.transport.on("conf_txn", self._handle_conf_txn)
         self.transport.on("forward_batch", self._handle_forward_batch)
         self.transport.on("heartbeat", self._handle_heartbeat)
         self.transport.on("sync", self._handle_sync)
@@ -316,6 +322,8 @@ class ClusterNode:
                 "seq": self._op_seq,
                 "routes": self._local_routes(),
                 "clients": self._local_clients(),
+                "conf": self._conf_dump(),
+                "peers": self._peer_list(),
             },
         )
         if reply is None:
@@ -327,6 +335,9 @@ class ClusterNode:
         self._apply_clients(
             peer, reply.get("clients", ()), reply.get("seq", 0)
         )
+        for cnt, node, path, value in reply.get("conf", ()):
+            self._conf_apply((cnt, node), path, value)
+        self._adopt_peers(reply.get("peers", ()))
         # split the reply: the responder's own routes purge-and-replace
         # (seq-guarded); third-party routes are add-only hints, so force
         # a direct (purge-and-replace) sync with each of those nodes to
@@ -357,12 +368,30 @@ class ClusterNode:
         self._check_epoch(node, obj.get("epoch", 0))
         self._apply_snapshot(node, obj.get("routes", ()), obj.get("seq", 0))
         self._apply_clients(node, obj.get("clients", ()), obj.get("seq", 0))
+        for cnt, n2, path, value in obj.get("conf", ()):
+            self._conf_apply((cnt, n2), path, value)
+        self._adopt_peers(obj.get("peers", ()))
         return {
             "routes": self.routes.all_routes(),
             "clients": self._local_clients(),
+            "conf": self._conf_dump(),
+            "peers": self._peer_list(),
             "epoch": self._epoch,
             "seq": self._op_seq,
         }
+
+    def _peer_list(self) -> List[List]:
+        """Known peers with addresses (membership gossip: a joiner that
+        only seeded one node learns the full mesh at sync time)."""
+        return [
+            [n, h, p] for n, (h, p) in self._peers.items()
+        ]
+
+    def _adopt_peers(self, peers) -> None:
+        for entry in peers:
+            name, host, port = entry[0], entry[1], int(entry[2])
+            if name != self.name and name not in self._peers:
+                self.add_peer(name, host, port)
 
     def _local_clients(self) -> List[str]:
         return sorted(
@@ -422,6 +451,56 @@ class ClusterNode:
         if owner is None or owner == self.name or owner in self._down:
             return None
         return owner
+
+    # ------------------------------------------- cluster-wide config
+
+    def update_config(self, path: str, value) -> Tuple[int, str]:
+        """Apply a config update cluster-wide (the emqx_conf /
+        emqx_cluster_rpc multicall role, emqx_cluster_rpc.erl:26-54,
+        simplified: a replicated, (counter, node)-ordered txn journal
+        with last-writer-wins and sync-time catch-up instead of an
+        mnesia transaction log)."""
+        self._conf_counter += 1
+        txn = (self._conf_counter, self.name)
+        self._conf_apply(txn, path, value)
+        obj = {
+            "type": "conf_txn",
+            "node": self.name,
+            "txns": [[txn[0], txn[1], path, value]],
+        }
+        loop = asyncio.get_running_loop()
+        for p in self.peers_alive():
+            task = loop.create_task(self.transport.cast(p, obj))
+            self._fwd_tasks.add(task)
+            task.add_done_callback(self._fwd_tasks.discard)
+        return txn
+
+    def _conf_apply(self, txn: Tuple[int, str], path: str, value) -> None:
+        """Apply iff this txn is the newest for its path (LWW by the
+        (counter, node) total order): a concurrently minted older txn
+        arriving later is journal-recorded but never clobbers state, so
+        all nodes converge."""
+        self._conf_counter = max(self._conf_counter, txn[0])
+        cur = self._conf_latest.get(path)
+        if cur is not None and (cur[0], cur[1]) >= txn:
+            return
+        self._conf_latest[path] = (txn[0], txn[1], value)
+        try:
+            self.broker.apply_config(path, value)
+        except Exception:
+            log.exception("cluster config txn %s failed for %s", txn, path)
+
+    def _conf_dump(self) -> List[List]:
+        """Per-path compaction: the latest txn for EVERY path, so a late
+        joiner catches up completely regardless of journal age."""
+        return [
+            [cnt, node, path, value]
+            for path, (cnt, node, value) in self._conf_latest.items()
+        ]
+
+    async def _handle_conf_txn(self, peer: str, obj: Dict) -> None:
+        for cnt, node, path, value in obj.get("txns", ()):
+            self._conf_apply((cnt, node), path, value)
 
     def discard_remote(self, clientid: str) -> None:
         """Fire-and-forget kick of a duplicate session on its owning

@@ -98,6 +98,16 @@ class SysConfig:
 
 
 @dataclass
+class FlappingConfig:
+    """Flapping-client detection (emqx_flapping defaults)."""
+
+    enable: bool = True
+    max_count: int = 15
+    window: float = 60.0
+    ban_time: float = 300.0
+
+
+@dataclass
 class ApiConfig:
     """Management REST + Prometheus endpoint (emqx_management slice)."""
 
@@ -129,6 +139,7 @@ class BrokerConfig:
     engine: BrokerEngineConfig = field(default_factory=BrokerEngineConfig)
     sys: SysConfig = field(default_factory=SysConfig)
     api: ApiConfig = field(default_factory=ApiConfig)
+    flapping: FlappingConfig = field(default_factory=FlappingConfig)
     # server-side auto-subscribe on connect (emqx_auto_subscribe):
     # entries {"topic": ..., "qos": 0}; %c/%u placeholders supported
     auto_subscribe: List[Dict[str, Any]] = field(default_factory=list)

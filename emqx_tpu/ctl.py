@@ -1,0 +1,199 @@
+"""`emqx_tpu.ctl` — the operator CLI against a running broker's
+management API.
+
+The `emqx_ctl` role (/root/reference/apps/emqx_ctl/src/emqx_ctl.erl:
+command registry dispatched from bin/emqx_ctl via nodetool RPC); here
+commands are HTTP calls to the REST surface, so the CLI works against
+any reachable broker:
+
+    python -m emqx_tpu.ctl status
+    python -m emqx_tpu.ctl clients [kick <clientid>]
+    python -m emqx_tpu.ctl subscriptions | topics | rules | metrics
+    python -m emqx_tpu.ctl publish <topic> <payload> [--qos N]
+    python -m emqx_tpu.ctl trace start <name> <type> <match> | stop <name>
+    python -m emqx_tpu.ctl banned [add <as> <who>] [del <as> <who>]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+from typing import Any, Optional
+
+
+class Ctl:
+    def __init__(self, base: str) -> None:
+        self.base = base.rstrip("/")
+
+    def _req(
+        self, path: str, method: str = "GET", body: Optional[dict] = None
+    ) -> Any:
+        req = urllib.request.Request(
+            self.base + path,
+            method=method,
+            data=None if body is None else json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                raw = resp.read()
+                return json.loads(raw) if raw else None
+        except urllib.error.HTTPError as exc:
+            detail = exc.read().decode(errors="replace")
+            raise SystemExit(f"error {exc.code}: {detail}")
+        except urllib.error.URLError as exc:
+            raise SystemExit(f"cannot reach broker API at {self.base}: {exc}")
+
+    # ------------------------------------------------------- commands
+
+    def status(self) -> None:
+        nodes = self._req("/api/v5/nodes")
+        for n in nodes["data"]:
+            print(
+                f"node {n['node']} is {n['node_status']}; "
+                f"uptime {n['uptime']}s; {n['connections']} connections"
+            )
+        cluster = nodes.get("cluster") or {}
+        if cluster:
+            print(
+                f"cluster: peers={cluster.get('alive', [])} "
+                f"down={cluster.get('down', [])} "
+                f"routes={cluster.get('routes')}"
+            )
+
+    def clients(self, kick: Optional[str] = None) -> None:
+        if kick:
+            self._req(f"/api/v5/clients/{kick}", method="DELETE")
+            print(f"kicked {kick}")
+            return
+        data = self._req("/api/v5/clients")
+        for c in data["data"]:
+            state = "connected" if c["connected"] else "detached"
+            print(
+                f"{c['clientid']}\t{state}\tsubs={c['subscriptions_cnt']}"
+                f"\tmqueue={c['mqueue_len']}"
+            )
+        print(f"({data['meta']['count']} clients)")
+
+    def subscriptions(self) -> None:
+        data = self._req("/api/v5/subscriptions")
+        for s in data["data"]:
+            print(f"{s['clientid']}\t{s['topic']}")
+        print(f"({data['meta']['count']} subscriptions)")
+
+    def topics(self) -> None:
+        data = self._req("/api/v5/topics")
+        for t in data["data"]:
+            print(f"{t['topic']}\t{t['node']}")
+        print(f"({data['meta']['count']} topics)")
+
+    def rules(self) -> None:
+        for r in self._req("/api/v5/rules")["data"]:
+            state = "enabled" if r["enabled"] else "disabled"
+            print(f"{r['id']}\t{state}\tmatched={r['matched']}\t{r['sql']}")
+
+    def metrics(self, name: Optional[str] = None) -> None:
+        data = self._req("/api/v5/metrics")
+        for k in sorted(data):
+            if name is None or name in k:
+                print(f"{k}\t{data[k]}")
+
+    def stats(self) -> None:
+        data = self._req("/api/v5/stats")
+        for k in sorted(data):
+            print(f"{k}\t{data[k]}")
+
+    def publish(self, topic: str, payload: str, qos: int = 0) -> None:
+        out = self._req(
+            "/api/v5/publish",
+            method="POST",
+            body={"topic": topic, "payload": payload, "qos": qos},
+        )
+        print(f"delivered to {out['delivered']} subscribers")
+
+    def trace(self, action: str, *args: str) -> None:
+        if action == "list":
+            for t in self._req("/api/v5/trace")["data"]:
+                print(
+                    f"{t['name']}\t{t['type']}={t['match']}\t"
+                    f"hits={t['hits']}\t{t['file']}"
+                )
+        elif action == "start":
+            name, kind, match = args[0], args[1], args[2]
+            out = self._req(
+                "/api/v5/trace",
+                method="POST",
+                body={"name": name, "type": kind, "match": match},
+            )
+            print(f"tracing to {out['file']}")
+        elif action == "stop":
+            self._req(f"/api/v5/trace/{args[0]}", method="DELETE")
+            print(f"stopped {args[0]}")
+        else:
+            raise SystemExit(f"unknown trace action {action!r}")
+
+    def banned(self, action: str = "list", *args: str) -> None:
+        if action == "list":
+            for b in self._req("/api/v5/banned")["data"]:
+                print(f"{b['as']}={b['who']}\tuntil={b['until']}")
+        elif action == "add":
+            self._req(
+                "/api/v5/banned",
+                method="POST",
+                body={"as": args[0], "who": args[1]},
+            )
+            print(f"banned {args[0]}={args[1]}")
+        elif action == "del":
+            self._req(
+                f"/api/v5/banned/{args[0]}/{args[1]}", method="DELETE"
+            )
+            print(f"unbanned {args[0]}={args[1]}")
+        else:
+            raise SystemExit(f"unknown banned action {action!r}")
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(prog="emqx_tpu.ctl")
+    ap.add_argument(
+        "--api",
+        default="http://127.0.0.1:18083",
+        help="management API base URL",
+    )
+    ap.add_argument("command", help="status|clients|subscriptions|topics|"
+                    "rules|metrics|stats|publish|trace|banned")
+    ap.add_argument("args", nargs="*")
+    ap.add_argument("--qos", type=int, default=0)
+    ns = ap.parse_args(argv)
+    ctl = Ctl(ns.api)
+
+    cmd = ns.command
+    if cmd == "status":
+        ctl.status()
+    elif cmd == "clients":
+        ctl.clients(kick=ns.args[1] if ns.args[:1] == ["kick"] else None)
+    elif cmd == "subscriptions":
+        ctl.subscriptions()
+    elif cmd == "topics":
+        ctl.topics()
+    elif cmd == "rules":
+        ctl.rules()
+    elif cmd == "metrics":
+        ctl.metrics(ns.args[0] if ns.args else None)
+    elif cmd == "stats":
+        ctl.stats()
+    elif cmd == "publish":
+        ctl.publish(ns.args[0], ns.args[1] if len(ns.args) > 1 else "",
+                    qos=ns.qos)
+    elif cmd == "trace":
+        ctl.trace(ns.args[0] if ns.args else "list", *ns.args[1:])
+    elif cmd == "banned":
+        ctl.banned(ns.args[0] if ns.args else "list", *ns.args[1:])
+    else:
+        raise SystemExit(f"unknown command {cmd!r}")
+
+
+if __name__ == "__main__":
+    main()

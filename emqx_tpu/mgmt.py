@@ -31,6 +31,26 @@ class MgmtApi:
         self.bind = bind
         self.port = port
         self._runner: Optional[web.AppRunner] = None
+        # audit trail of mutating API calls (emqx_audit's role): ring
+        # buffer surfaced at /api/v5/audit
+        self.audit_log: list = []
+        self.audit_cap = 1000
+
+    @web.middleware
+    async def _audit_middleware(self, request: web.Request, handler):
+        resp = await handler(request)
+        if request.method in ("POST", "PUT", "DELETE"):
+            self.audit_log.append(
+                {
+                    "at": time.time(),
+                    "method": request.method,
+                    "path": request.path,
+                    "from": request.remote,
+                    "status": resp.status,
+                }
+            )
+            del self.audit_log[: -self.audit_cap]
+        return resp
 
     # ------------------------------------------------------- lifecycle
 
@@ -49,7 +69,20 @@ class MgmtApi:
         r.add_post("/api/v5/rules", self.post_rule)
         r.add_delete("/api/v5/rules/{rule_id}", self.delete_rule)
         r.add_post("/api/v5/publish", self.post_publish)
+        r.add_get("/api/v5/alarms", self.get_alarms)
+        r.add_delete("/api/v5/alarms", self.clear_alarms)
+        r.add_get("/api/v5/banned", self.get_banned)
+        r.add_post("/api/v5/banned", self.post_banned)
+        r.add_delete("/api/v5/banned/{kind}/{who}", self.delete_banned)
+        r.add_get("/api/v5/slow_subscriptions", self.get_slow_subs)
+        r.add_get("/api/v5/trace", self.get_traces)
+        r.add_post("/api/v5/trace", self.post_trace)
+        r.add_delete("/api/v5/trace/{name}", self.delete_trace)
+        r.add_get("/api/v5/trace/{name}/log", self.get_trace_log)
+        r.add_get("/api/v5/audit", self.get_audit)
+        r.add_put("/api/v5/configs", self.put_config)
         r.add_get("/metrics", self.prometheus)
+        app.middlewares.append(self._audit_middleware)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.bind, self.port)
@@ -183,6 +216,114 @@ class MgmtApi:
         else:
             n = self.broker.publish(msg)
         return _json({"delivered": n})
+
+    # ------------------------------------------------- alarms / banned
+
+    async def get_alarms(self, request: web.Request) -> web.Response:
+        which = request.query.get("activated", "true") == "true"
+        alarms = (
+            self.broker.alarms.active()
+            if which
+            else self.broker.alarms.history()
+        )
+        return _json(
+            {
+                "data": [
+                    {
+                        "name": a.name,
+                        "message": a.message,
+                        "details": a.details,
+                        "activated_at": a.activated_at,
+                        "deactivated_at": a.deactivated_at,
+                    }
+                    for a in alarms
+                ]
+            }
+        )
+
+    async def clear_alarms(self, request: web.Request) -> web.Response:
+        for a in self.broker.alarms.active():
+            self.broker.alarms.deactivate(a.name)
+        return web.Response(status=204)
+
+    async def get_banned(self, request: web.Request) -> web.Response:
+        return _json({"data": self.broker.banned.all()})
+
+    async def post_banned(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+            self.broker.banned.ban(
+                body["as"],
+                body["who"],
+                seconds=body.get("seconds"),
+                reason=body.get("reason", ""),
+            )
+        except (KeyError, ValueError, json.JSONDecodeError) as exc:
+            return _json({"code": "BAD_REQUEST", "message": str(exc)}, 400)
+        return _json({"as": body["as"], "who": body["who"]}, status=201)
+
+    async def delete_banned(self, request: web.Request) -> web.Response:
+        ok = self.broker.banned.unban(
+            request.match_info["kind"], request.match_info["who"]
+        )
+        return web.Response(status=204 if ok else 404)
+
+    async def get_slow_subs(self, request: web.Request) -> web.Response:
+        return _json({"data": self.broker.slow_subs.top()})
+
+    # ----------------------------------------------------- trace/audit
+
+    async def get_traces(self, request: web.Request) -> web.Response:
+        return _json({"data": self.broker.trace.list()})
+
+    async def post_trace(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+            rule = self.broker.trace.start(
+                body["name"],
+                body["type"],
+                body["match"],
+                duration=body.get("duration"),
+            )
+        except (KeyError, ValueError, json.JSONDecodeError) as exc:
+            return _json({"code": "BAD_REQUEST", "message": str(exc)}, 400)
+        return _json({"name": rule.name, "file": rule.path}, status=201)
+
+    async def delete_trace(self, request: web.Request) -> web.Response:
+        ok = self.broker.trace.stop(request.match_info["name"])
+        return web.Response(status=204 if ok else 404)
+
+    async def get_trace_log(self, request: web.Request) -> web.Response:
+        import os
+
+        name = request.match_info["name"]
+        path = os.path.join(self.broker.trace.directory, f"{name}.log")
+        if not os.path.exists(path):
+            return _json({"code": "NOT_FOUND"}, status=404)
+        with open(path) as f:
+            return web.Response(text=f.read(), content_type="text/plain")
+
+    async def get_audit(self, request: web.Request) -> web.Response:
+        return _json({"data": list(self.audit_log)})
+
+    async def put_config(self, request: web.Request) -> web.Response:
+        """Runtime config update; with a cluster attached, the change
+        journals through the conf-txn multicall so every node applies
+        it (emqx_conf's cluster-wide update path)."""
+        try:
+            body = await request.json()
+            path, value = body["path"], body["value"]
+            ext = self.broker.external
+            if ext is not None and hasattr(ext, "update_config"):
+                # validate locally BEFORE journaling: a bad path must
+                # return 400, not poison every node's journal
+                self.broker.apply_config(path, value)
+                txn = ext.update_config(path, value)
+                return _json({"path": path, "txn": list(txn)})
+            self.broker.apply_config(path, value)
+        except (KeyError, ValueError, TypeError, json.JSONDecodeError) as exc:
+            return _json({"code": "BAD_REQUEST", "message": str(exc)}, 400)
+        return _json({"path": path})
 
     # ------------------------------------------------------ prometheus
 

@@ -1,0 +1,251 @@
+"""Operational guards: alarms, banned clients, flapping detection,
+slow-subscriber tracking.
+
+The `emqx_alarm` / `emqx_banned` / `emqx_flapping` / `emqx_slow_subs`
+slice (/root/reference/apps/emqx/src/emqx_alarm.erl, emqx_banned.erl,
+emqx_flapping.erl; apps/emqx_slow_subs): alarms are an
+activate/deactivate registry published to ``$SYS`` and surfaced over
+REST; bans deny CONNECT by clientid/username/peerhost with expiry;
+flapping detection bans clients that reconnect too fast; slow subs
+keep a top-K table of delivery latency.
+"""
+
+from __future__ import annotations
+
+import heapq
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass
+class Alarm:
+    name: str
+    details: Dict = field(default_factory=dict)
+    message: str = ""
+    activated_at: float = 0.0
+    deactivated_at: Optional[float] = None
+    expires_at: Optional[float] = None  # auto-deactivate deadline
+
+    @property
+    def active(self) -> bool:
+        return self.deactivated_at is None
+
+
+class AlarmRegistry:
+    """activate/deactivate with history (emqx_alarm.erl), publishing
+    ``$SYS/brokers/<node>/alarms/...`` through the broker."""
+
+    def __init__(self, broker=None, history_cap: int = 256) -> None:
+        self.broker = broker
+        self.history_cap = history_cap
+        self._active: Dict[str, Alarm] = {}
+        self._history: List[Alarm] = []
+
+    def activate(
+        self,
+        name: str,
+        details: Optional[Dict] = None,
+        message: str = "",
+        ttl: Optional[float] = None,
+    ) -> bool:
+        if name in self._active:
+            return False  # already active (duplicate activation ignored)
+        now = time.time()
+        alarm = Alarm(
+            name=name,
+            details=dict(details or {}),
+            message=message or name,
+            activated_at=now,
+            expires_at=None if ttl is None else now + ttl,
+        )
+        self._active[name] = alarm
+        self._publish("alarms/activate", alarm)
+        return True
+
+    def deactivate(self, name: str) -> bool:
+        alarm = self._active.pop(name, None)
+        if alarm is None:
+            return False
+        alarm.deactivated_at = time.time()
+        self._history.append(alarm)
+        del self._history[: -self.history_cap]
+        self._publish("alarms/deactivate", alarm)
+        return True
+
+    def _publish(self, suffix: str, alarm: Alarm) -> None:
+        if self.broker is None:
+            return
+        import json
+
+        from .message import Message
+
+        self.broker.metrics.inc("alarms." + suffix.rsplit("/", 1)[-1])
+        node = self.broker.config.node_name
+        self.broker.publish(
+            Message(
+                topic=f"$SYS/brokers/{node}/{suffix}",
+                payload=json.dumps(
+                    {"name": alarm.name, "message": alarm.message,
+                     "details": alarm.details}
+                ).encode(),
+                sys=True,
+            )
+        )
+
+    def tick(self, now: Optional[float] = None) -> None:
+        """Auto-deactivate alarms past their ttl (per-client flapping
+        alarms would otherwise accumulate forever)."""
+        now = now if now is not None else time.time()
+        for name in [
+            n
+            for n, a in self._active.items()
+            if a.expires_at is not None and now > a.expires_at
+        ]:
+            self.deactivate(name)
+
+    def active(self) -> List[Alarm]:
+        return list(self._active.values())
+
+    def history(self) -> List[Alarm]:
+        return list(self._history)
+
+
+class BannedList:
+    """Deny CONNECT by clientid / username / peerhost until an expiry
+    (emqx_banned.erl's mnesia table, node-local here)."""
+
+    def __init__(self) -> None:
+        # (kind, value) -> (until_ts | None, reason)
+        self._entries: Dict[Tuple[str, str], Tuple[Optional[float], str]] = {}
+
+    def ban(
+        self,
+        kind: str,
+        value: str,
+        seconds: Optional[float] = None,
+        reason: str = "",
+    ) -> None:
+        until = None if seconds is None else time.time() + seconds
+        self._entries[(kind, value)] = (until, reason)
+
+    def unban(self, kind: str, value: str) -> bool:
+        return self._entries.pop((kind, value), None) is not None
+
+    def _check_one(self, kind: str, value: Optional[str]) -> bool:
+        if value is None:
+            return False
+        entry = self._entries.get((kind, value))
+        if entry is None:
+            return False
+        until, _ = entry
+        if until is not None and time.time() > until:
+            del self._entries[(kind, value)]
+            return False
+        return True
+
+    def is_banned(
+        self,
+        clientid: Optional[str] = None,
+        username: Optional[str] = None,
+        peerhost: Optional[str] = None,
+    ) -> bool:
+        return (
+            self._check_one("clientid", clientid)
+            or self._check_one("username", username)
+            or self._check_one("peerhost", peerhost)
+        )
+
+    def all(self) -> List[Dict]:
+        now = time.time()
+        return [
+            {"as": k, "who": v, "until": until, "reason": reason}
+            for (k, v), (until, reason) in self._entries.items()
+            if until is None or until > now
+        ]
+
+
+class FlappingDetector:
+    """Clients reconnecting more than ``max_count`` times inside
+    ``window`` seconds get banned for ``ban_time`` (emqx_flapping.erl)."""
+
+    def __init__(
+        self,
+        banned: BannedList,
+        max_count: int = 15,
+        window: float = 60.0,
+        ban_time: float = 300.0,
+        enable: bool = True,
+    ) -> None:
+        self.banned = banned
+        self.max_count = max_count
+        self.window = window
+        self.ban_time = ban_time
+        self.enable = enable
+        self._hits: Dict[str, List[float]] = {}
+
+    def on_disconnect(self, clientid: str) -> bool:
+        """Record a connection cycle; returns True when it tripped the
+        detector (client banned)."""
+        if not self.enable:
+            return False
+        now = time.time()
+        if len(self._hits) > 10_000:
+            # amortized sweep: rotating clientids must not leak entries
+            cutoff_all = now - self.window
+            self._hits = {
+                cid: ts
+                for cid, ts in self._hits.items()
+                if ts and ts[-1] >= cutoff_all
+            }
+        hits = self._hits.setdefault(clientid, [])
+        hits.append(now)
+        cutoff = now - self.window
+        while hits and hits[0] < cutoff:
+            hits.pop(0)
+        if len(hits) >= self.max_count:
+            self.banned.ban(
+                "clientid",
+                clientid,
+                seconds=self.ban_time,
+                reason="flapping",
+            )
+            del self._hits[clientid]
+            return True
+        return False
+
+
+class SlowSubs:
+    """Top-K delivery-latency table (emqx_slow_subs): every delivery
+    reports (clientid, topic, latency); the slowest K stick."""
+
+    def __init__(self, top_k: int = 10, threshold_ms: float = 500.0) -> None:
+        self.top_k = top_k
+        self.threshold_ms = threshold_ms
+        # min-heap of (latency_ms, seq, clientid, topic, ts)
+        self._heap: List[Tuple] = []
+        self._seq = 0
+
+    def record(self, clientid: str, topic: str, latency_ms: float) -> None:
+        if latency_ms < self.threshold_ms:
+            return
+        self._seq += 1
+        item = (latency_ms, self._seq, clientid, topic, time.time())
+        if len(self._heap) < self.top_k:
+            heapq.heappush(self._heap, item)
+        elif item > self._heap[0]:
+            heapq.heapreplace(self._heap, item)
+
+    def top(self) -> List[Dict]:
+        return [
+            {
+                "clientid": cid,
+                "topic": topic,
+                "latency_ms": round(lat, 3),
+                "at": ts,
+            }
+            for lat, _, cid, topic, ts in sorted(self._heap, reverse=True)
+        ]
+
+    def clear(self) -> None:
+        self._heap = []

@@ -1,0 +1,193 @@
+"""Client/topic tracing to per-trace log files.
+
+The `emqx_trace` role (/root/reference/apps/emqx/src/emqx_trace/
+emqx_trace.erl:82-94 taps, managed over REST by emqx_mgmt_api_trace):
+operators start named traces filtered by clientid, topic filter, or
+peer IP; matching broker events (connect/disconnect/subscribe/
+unsubscribe/publish/deliver) append formatted lines to the trace's
+file until it is stopped or its window ends.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from . import topic as T
+
+
+@dataclass
+class TraceRule:
+    name: str
+    kind: str  # "clientid" | "topic" | "ip"
+    match: str
+    path: str
+    started_at: float = field(default_factory=time.time)
+    ends_at: Optional[float] = None
+    hits: int = 0
+
+    def matches(
+        self, clientid: Optional[str], topic: Optional[str], ip: Optional[str]
+    ) -> bool:
+        if self.kind == "clientid":
+            return clientid == self.match
+        if self.kind == "topic":
+            return topic is not None and T.match(topic, self.match)
+        if self.kind == "ip":
+            return ip is not None and ip.split(":", 1)[0] == self.match
+        return False
+
+
+class TraceManager:
+    """Attaches to the broker's hookpoints and fans matching events to
+    per-trace files."""
+
+    EVENTS = (
+        "client.connected",
+        "client.disconnected",
+        "session.subscribed",
+        "session.unsubscribed",
+        "message.publish",
+        "message.delivered",
+    )
+
+    def __init__(self, broker, directory: str = "data/trace") -> None:
+        self.broker = broker
+        self.directory = directory
+        self._rules: Dict[str, TraceRule] = {}
+        self._files: Dict[str, object] = {}
+        hooks = broker.hooks
+        hooks.add("client.connected", self._on_connected, priority=-100)
+        hooks.add("client.disconnected", self._on_disconnected, priority=-100)
+        hooks.add("session.subscribed", self._on_subscribed, priority=-100)
+        hooks.add(
+            "session.unsubscribed", self._on_unsubscribed, priority=-100
+        )
+        hooks.add("message.publish", self._on_publish, priority=-200)
+        hooks.add("message.delivered", self._on_delivered, priority=-100)
+
+    # ------------------------------------------------------ management
+
+    def start(
+        self,
+        name: str,
+        kind: str,
+        match: str,
+        duration: Optional[float] = None,
+    ) -> TraceRule:
+        import re as _re
+
+        if not _re.fullmatch(r"[A-Za-z0-9_-]{1,64}", name):
+            # the name lands in a file path: no traversal characters
+            raise ValueError(f"invalid trace name {name!r}")
+        if kind not in ("clientid", "topic", "ip"):
+            raise ValueError(f"unknown trace kind {kind!r}")
+        if name in self._rules:
+            raise ValueError(f"trace {name!r} already running")
+        os.makedirs(self.directory, exist_ok=True)
+        path = os.path.join(self.directory, f"{name}.log")
+        rule = TraceRule(
+            name=name,
+            kind=kind,
+            match=match,
+            path=path,
+            ends_at=None if duration is None else time.time() + duration,
+        )
+        self._rules[name] = rule
+        self._files[name] = open(path, "a", buffering=1)
+        return rule
+
+    def stop(self, name: str) -> bool:
+        rule = self._rules.pop(name, None)
+        f = self._files.pop(name, None)
+        if f is not None:
+            f.close()
+        return rule is not None
+
+    def list(self) -> List[Dict]:
+        return [
+            {
+                "name": r.name,
+                "type": r.kind,
+                "match": r.match,
+                "file": r.path,
+                "hits": r.hits,
+                "started_at": r.started_at,
+            }
+            for r in self._rules.values()
+        ]
+
+    def stop_all(self) -> None:
+        for name in list(self._rules):
+            self.stop(name)
+
+    # ---------------------------------------------------------- taps
+
+    def _emit(
+        self,
+        event: str,
+        clientid: Optional[str],
+        topic: Optional[str],
+        detail: str = "",
+        ip: Optional[str] = None,
+    ) -> None:
+        if not self._rules:
+            return
+        now = time.time()
+        line = None
+        for name, rule in list(self._rules.items()):
+            if rule.ends_at is not None and now > rule.ends_at:
+                self.stop(name)
+                continue
+            if not rule.matches(clientid, topic, ip):
+                continue
+            if line is None:
+                stamp = time.strftime(
+                    "%Y-%m-%dT%H:%M:%S", time.localtime(now)
+                )
+                line = (
+                    f"{stamp} [{event}] clientid={clientid or '-'} "
+                    f"topic={topic or '-'} {detail}\n"
+                )
+            rule.hits += 1
+            self._files[name].write(line)
+
+    def _on_connected(self, client) -> None:
+        self._emit(
+            "client.connected",
+            client.clientid,
+            None,
+            ip=getattr(client, "peerhost", None),
+        )
+
+    def _on_disconnected(self, client, reason) -> None:
+        self._emit(
+            "client.disconnected",
+            client.clientid,
+            None,
+            f"reason={reason}",
+            ip=getattr(client, "peerhost", None),
+        )
+
+    def _on_subscribed(self, clientid, flt, *rest) -> None:
+        self._emit("session.subscribed", clientid, flt)
+
+    def _on_unsubscribed(self, clientid, flt, *rest) -> None:
+        self._emit("session.unsubscribed", clientid, flt)
+
+    def _on_publish(self, msg):
+        self._emit(
+            "message.publish",
+            msg.from_client or None,
+            msg.topic,
+            f"qos={msg.qos} len={len(msg.payload)}",
+        )
+        return None  # never alters the fold accumulator
+
+    def _on_delivered(self, clientid, deliveries) -> None:
+        for msg, _opts in deliveries:
+            self._emit(
+                "message.delivered", clientid, msg.topic, f"qos={msg.qos}"
+            )

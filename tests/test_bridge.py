@@ -1,0 +1,124 @@
+"""MQTT bridge + client: two local brokers connected by an egress +
+ingress bridge (emqx_bridge_mqtt semantics over the package's own
+client, which also gets its reconnect behavior exercised)."""
+
+import asyncio
+
+from emqx_tpu.bridge_mqtt import MqttBridge
+from emqx_tpu.broker.listener import BrokerServer
+from emqx_tpu.client import MqttClient
+from emqx_tpu.config import BrokerConfig, ListenerConfig
+from mqtt_client import TestClient
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def make_server():
+    cfg = BrokerConfig()
+    cfg.listeners = [ListenerConfig(port=0)]
+    srv = BrokerServer(cfg)
+    await srv.start()
+    return srv
+
+
+def test_client_pubsub_and_reconnect():
+    async def t():
+        srv = await make_server()
+        port = srv.listeners[0].port
+        got = []
+        sub = MqttClient("127.0.0.1", port, "cl-sub", reconnect_min=0.05)
+        sub.on_message = lambda m: got.append((m.topic, m.payload))
+        await sub.start()
+        await asyncio.wait_for(sub.connected.wait(), 5)
+        await sub.subscribe("c/#", qos=1)
+
+        pub = MqttClient("127.0.0.1", port, "cl-pub", reconnect_min=0.05)
+        await pub.start()
+        await asyncio.wait_for(pub.connected.wait(), 5)
+        await pub.publish("c/1", b"one", qos=1)
+        for _ in range(100):
+            if got:
+                break
+            await asyncio.sleep(0.02)
+        assert got == [("c/1", b"one")]
+
+        # server kicks the subscriber: it reconnects and resubscribes
+        srv.broker.cm.kick("cl-sub")
+        await asyncio.sleep(0.3)
+        await asyncio.wait_for(sub.connected.wait(), 5)
+        await pub.publish("c/2", b"two", qos=1)
+        for _ in range(100):
+            if len(got) >= 2:
+                break
+            await asyncio.sleep(0.02)
+        assert ("c/2", b"two") in got
+
+        await pub.stop()
+        await sub.stop()
+        await srv.stop()
+
+    run(t())
+
+
+def test_bridge_egress_and_ingress():
+    async def t():
+        local = await make_server()
+        remote = await make_server()
+        lport = local.listeners[0].port
+        rport = remote.listeners[0].port
+
+        bridge = MqttBridge(
+            local.broker,
+            "up",
+            "127.0.0.1",
+            rport,
+            egress=["tele/#"],
+            ingress=["cmd/#"],
+        )
+        await bridge.start()
+        await asyncio.wait_for(
+            bridge._resource.client.connected.wait(), 5
+        )
+        if bridge._ingress_client is not None:
+            await asyncio.wait_for(
+                bridge._ingress_client.connected.wait(), 5
+            )
+        await asyncio.sleep(0.1)
+
+        # remote watcher sees local telemetry (egress)
+        watcher = TestClient(rport, "w")
+        await watcher.connect()
+        await watcher.subscribe("tele/#", qos=1)
+        lpub = TestClient(lport, "lp")
+        await lpub.connect()
+        await lpub.publish("tele/v1/temp", b"20.1", qos=1)
+        pkt = await watcher.recv_publish(timeout=5)
+        assert pkt.topic == "tele/v1/temp" and pkt.payload == b"20.1"
+
+        # local subscriber receives remote commands (ingress)
+        lsub = TestClient(lport, "ls")
+        await lsub.connect()
+        await lsub.subscribe("cmd/#", qos=1)
+        rpub = TestClient(rport, "rp")
+        await rpub.connect()
+        await rpub.publish("cmd/v1/go", b"north", qos=1)
+        pkt2 = await lsub.recv_publish(timeout=5)
+        assert pkt2.topic == "cmd/v1/go" and pkt2.payload == b"north"
+
+        # egress survives a remote outage: buffered and replayed
+        await remote.stop()
+        await asyncio.sleep(0.1)
+        await lpub.publish("tele/v1/late", b"queued", qos=1)
+        worker = local.broker.resources.get("bridge:up")
+        assert len(worker) >= 1  # buffered while the remote is down
+
+        await bridge.stop()
+        await lpub.disconnect()
+        await lsub.disconnect()
+        await watcher.close()
+        await rpub.close()
+        await local.stop()
+
+    run(t())

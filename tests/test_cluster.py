@@ -586,3 +586,36 @@ def test_clean_start_elsewhere_kicks_remote_duplicate():
         await stop_node(srv_a, a)
 
     run(t())
+
+
+def test_cluster_wide_config_update():
+    """A config update on one node journals to every node (emqx_conf /
+    emqx_cluster_rpc multicall semantics), including late joiners via
+    sync catch-up."""
+
+    async def t():
+        srv_a, a = await start_node("a")
+        srv_b, b = await start_node("b", seeds=[("a", "127.0.0.1", a.port)])
+        await settle(0.3)
+
+        a.update_config("mqtt.max_inflight", 64)
+        await settle(0.2)
+        assert srv_a.broker.config.mqtt.max_inflight == 64
+        assert srv_b.broker.config.mqtt.max_inflight == 64
+
+        # a late joiner catches up from the journal at sync time
+        srv_c, c = await start_node("c", seeds=[("a", "127.0.0.1", a.port)])
+        await settle(0.4)
+        assert srv_c.broker.config.mqtt.max_inflight == 64
+
+        # last-writer-wins across concurrent origins
+        b.update_config("mqtt.max_inflight", 48)
+        await settle(0.3)
+        assert srv_a.broker.config.mqtt.max_inflight == 48
+        assert srv_c.broker.config.mqtt.max_inflight == 48
+
+        await stop_node(srv_c, c)
+        await stop_node(srv_b, b)
+        await stop_node(srv_a, a)
+
+    run(t())

@@ -1,0 +1,144 @@
+"""Operational guards: banned CONNECT, flapping ban, alarms over $SYS
+and REST, slow-subscription tracking (emqx_banned / emqx_flapping /
+emqx_alarm / emqx_slow_subs parity)."""
+
+import asyncio
+
+import aiohttp
+
+from emqx_tpu.broker.listener import BrokerServer
+from emqx_tpu.config import BrokerConfig, ListenerConfig
+from emqx_tpu.ops_guard import SlowSubs
+from mqtt_client import TestClient
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_server(**kw):
+    cfg = BrokerConfig()
+    cfg.listeners = [ListenerConfig(port=0)]
+    cfg.api.enable = True
+    cfg.api.port = 0
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return BrokerServer(cfg)
+
+
+def test_banned_client_rejected_at_connect():
+    async def t():
+        srv = make_server()
+        await srv.start()
+        port = srv.listeners[0].port
+        srv.broker.banned.ban("clientid", "evil", reason="test")
+        c = TestClient(port, "evil")
+        ack = await c.connect()
+        assert ack.reason_code == 0x8A  # banned
+        await c.close()
+        # expiry frees the ban
+        srv.broker.banned.ban("clientid", "brief", seconds=-1)
+        c2 = TestClient(port, "brief")
+        ack2 = await c2.connect()
+        assert ack2.reason_code == 0
+        await c2.disconnect()
+        await srv.stop()
+
+    run(t())
+
+
+def test_flapping_client_gets_banned():
+    async def t():
+        from emqx_tpu.config import FlappingConfig
+
+        srv = make_server(
+            flapping=FlappingConfig(max_count=3, window=10.0, ban_time=60.0)
+        )
+        await srv.start()
+        port = srv.listeners[0].port
+        for _ in range(3):
+            c = TestClient(port, "flappy")
+            await c.connect()
+            await c.disconnect()
+            await asyncio.sleep(0.02)
+        c = TestClient(port, "flappy")
+        ack = await c.connect()
+        assert ack.reason_code == 0x8A  # banned for flapping
+        await c.close()
+        assert any(
+            a.name.startswith("flapping/") for a in srv.broker.alarms.active()
+        )
+        await srv.stop()
+
+    run(t())
+
+
+def test_alarms_rest_and_sys():
+    async def t():
+        srv = make_server()
+        await srv.start()
+        port = srv.listeners[0].port
+        mon = TestClient(port, "mon")
+        await mon.connect()
+        await mon.subscribe("$SYS/#")
+
+        srv.broker.alarms.activate(
+            "high_mem", details={"pct": 93}, message="memory high"
+        )
+        pkt = await mon.recv_publish()
+        assert pkt.topic.endswith("/alarms/activate")
+        assert b"high_mem" in pkt.payload
+
+        api = f"http://127.0.0.1:{srv.api.port}"
+        async with aiohttp.ClientSession() as http:
+            async with http.get(api + "/api/v5/alarms") as r:
+                data = await r.json()
+            assert data["data"][0]["name"] == "high_mem"
+            async with http.delete(api + "/api/v5/alarms") as r:
+                assert r.status == 204
+            async with http.get(api + "/api/v5/alarms") as r:
+                assert (await r.json())["data"] == []
+            async with http.get(
+                api + "/api/v5/alarms?activated=false"
+            ) as r:
+                hist = await r.json()
+            assert hist["data"][0]["name"] == "high_mem"
+
+        await mon.disconnect()
+        await srv.stop()
+
+    run(t())
+
+
+def test_banned_rest_crud():
+    async def t():
+        srv = make_server()
+        await srv.start()
+        api = f"http://127.0.0.1:{srv.api.port}"
+        async with aiohttp.ClientSession() as http:
+            async with http.post(
+                api + "/api/v5/banned",
+                json={"as": "peerhost", "who": "10.0.0.9", "seconds": 60},
+            ) as r:
+                assert r.status == 201
+            async with http.get(api + "/api/v5/banned") as r:
+                data = await r.json()
+            assert data["data"][0]["who"] == "10.0.0.9"
+            async with http.delete(
+                api + "/api/v5/banned/peerhost/10.0.0.9"
+            ) as r:
+                assert r.status == 204
+        await srv.stop()
+
+    run(t())
+
+
+def test_slow_subs_topk():
+    ss = SlowSubs(top_k=2, threshold_ms=10.0)
+    ss.record("a", "t/1", 5.0)  # below threshold: ignored
+    ss.record("b", "t/2", 50.0)
+    ss.record("c", "t/3", 500.0)
+    ss.record("d", "t/4", 100.0)  # evicts the 50ms entry
+    top = ss.top()
+    assert [e["clientid"] for e in top] == ["c", "d"]
+    assert top[0]["latency_ms"] == 500.0

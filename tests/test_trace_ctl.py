@@ -1,0 +1,121 @@
+"""Tracing, audit trail, and the ctl CLI (emqx_trace / emqx_audit /
+emqx_ctl parity at the black-box level)."""
+
+import asyncio
+import subprocess
+import sys
+
+import aiohttp
+
+from emqx_tpu.broker.listener import BrokerServer
+from emqx_tpu.config import BrokerConfig, ListenerConfig
+from mqtt_client import TestClient
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_server(tmp_path):
+    cfg = BrokerConfig()
+    cfg.listeners = [ListenerConfig(port=0)]
+    cfg.api.enable = True
+    cfg.api.port = 0
+    srv = BrokerServer(cfg)
+    srv.broker.trace.directory = str(tmp_path / "trace")
+    return srv
+
+
+def test_trace_clientid_and_topic(tmp_path):
+    async def t():
+        srv = make_server(tmp_path)
+        await srv.start()
+        port = srv.listeners[0].port
+        api = f"http://127.0.0.1:{srv.api.port}"
+
+        async with aiohttp.ClientSession() as http:
+            async with http.post(
+                api + "/api/v5/trace",
+                json={"name": "t1", "type": "clientid", "match": "dev-1"},
+            ) as r:
+                assert r.status == 201
+            async with http.post(
+                api + "/api/v5/trace",
+                json={"name": "t2", "type": "topic", "match": "sensors/#"},
+            ) as r:
+                assert r.status == 201
+
+            c = TestClient(port, "dev-1")
+            await c.connect()
+            await c.subscribe("sensors/+/temp", qos=1)
+            p = TestClient(port, "other")
+            await p.connect()
+            await p.publish("sensors/5/temp", b"21.5", qos=1)
+            await c.recv_publish()
+            await p.disconnect()
+            await c.disconnect()
+            await asyncio.sleep(0.05)
+
+            async with http.get(api + "/api/v5/trace/t1/log") as r:
+                log1 = await r.text()
+            assert "client.connected" in log1 and "clientid=dev-1" in log1
+            assert "session.subscribed" in log1
+            async with http.get(api + "/api/v5/trace/t2/log") as r:
+                log2 = await r.text()
+            assert "message.publish" in log2
+            assert "topic=sensors/5/temp" in log2
+
+            async with http.get(api + "/api/v5/trace") as r:
+                lst = await r.json()
+            assert {t["name"] for t in lst["data"]} == {"t1", "t2"}
+            async with http.delete(api + "/api/v5/trace/t1") as r:
+                assert r.status == 204
+
+            # mutations show up in the audit trail
+            async with http.get(api + "/api/v5/audit") as r:
+                audit = await r.json()
+            paths = [(a["method"], a["path"]) for a in audit["data"]]
+            assert ("POST", "/api/v5/trace") in paths
+            assert ("DELETE", "/api/v5/trace/t1") in paths
+
+        await srv.stop()
+
+    run(t())
+
+
+def test_ctl_cli_against_live_broker(tmp_path):
+    async def t():
+        srv = make_server(tmp_path)
+        await srv.start()
+        port = srv.listeners[0].port
+        api = f"http://127.0.0.1:{srv.api.port}"
+        c = TestClient(port, "cli-watch")
+        await c.connect()
+        await c.subscribe("cli/#", qos=1)
+
+        def ctl(*args):
+            out = subprocess.run(
+                [sys.executable, "-m", "emqx_tpu.ctl", "--api", api, *args],
+                capture_output=True,
+                text=True,
+                timeout=30,
+                cwd="/root/repo",
+            )
+            assert out.returncode == 0, out.stderr
+            return out.stdout
+
+        loop = asyncio.get_running_loop()
+        status = await loop.run_in_executor(None, ctl, "status")
+        assert "is running" in status
+        clients = await loop.run_in_executor(None, ctl, "clients")
+        assert "cli-watch" in clients
+        pub = await loop.run_in_executor(
+            None, ctl, "publish", "cli/hello", "from-ctl"
+        )
+        assert "delivered to 1" in pub
+        pkt = await c.recv_publish()
+        assert pkt.payload == b"from-ctl"
+        await c.disconnect()
+        await srv.stop()
+
+    run(t())
