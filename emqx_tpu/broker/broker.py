@@ -106,6 +106,15 @@ class Broker:
             enable=fl.enable,
         )
         self.slow_subs = SlowSubs()
+        from ..gateway import GatewayRegistry
+
+        self.gateways = GatewayRegistry(self)
+        from ..payload_pipeline import PayloadPipeline
+
+        self.pipeline = PayloadPipeline(self)
+        from ..rebalance import EvictionAgent
+
+        self.eviction = EvictionAgent(self)
         # ClusterNode installs itself here (the emqx_external_broker
         # registration point, emqx_broker.erl:379-380): provides
         # match_remote(topics) and forward(msg, nodes)

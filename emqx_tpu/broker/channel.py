@@ -103,11 +103,10 @@ class Channel:
         if self._closing:
             return
         if self.version == C.MQTT_V5 and self.state == CONNECTED:
-            rc = (
-                RC_SESSION_TAKEN_OVER
-                if reason == "takenover"
-                else RC_UNSPECIFIED
-            )
+            rc = {
+                "takenover": RC_SESSION_TAKEN_OVER,
+                "evacuated": 0x9C,  # use another server (rebalance)
+            }.get(reason, RC_UNSPECIFIED)
             self._send([C.Disconnect(reason_code=rc)])
         if reason == "takenover":
             # session moves to the new channel; don't tear it down
@@ -225,6 +224,13 @@ class Channel:
             self._connack_error(RC_BAD_CLIENTID)
             return
 
+        if self.broker.eviction.status in ("evacuating", "evacuated"):
+            # a draining node refuses new sessions so clients land on a
+            # peer (the reference eviction agent's connect rejection)
+            m.inc("client.evacuation_refused")
+            self._connack_error(RC_SERVER_BUSY if self.version < C.MQTT_V5
+                                else 0x9C)
+            return
         peerhost = self.peer.rsplit(":", 1)[0] if self.peer else ""
         if self.broker.banned.is_banned(
             clientid=clientid, username=pkt.username, peerhost=peerhost

@@ -176,9 +176,26 @@ class BrokerServer:
 
             self.api = MgmtApi(self, bind=api_cfg.bind, port=api_cfg.port)
             await self.api.start()
+        for gw_cfg in self.broker.config.gateways:
+            await self._load_gateway(gw_cfg)
         self._housekeeper = asyncio.get_running_loop().create_task(
             self._housekeeping()
         )
+
+    async def _load_gateway(self, gw_cfg: dict) -> None:
+        kind = gw_cfg.get("type")
+        if kind == "stomp":
+            from ..gateway.stomp import StompGateway
+
+            await self.broker.gateways.load(
+                StompGateway(
+                    self.broker,
+                    bind=gw_cfg.get("bind", "0.0.0.0"),
+                    port=int(gw_cfg.get("port", 61613)),
+                )
+            )
+        else:
+            log.warning("unknown gateway type %r ignored", kind)
 
     async def _housekeeping(self) -> None:
         """Delayed wills + detached-session expiry (the reference's
@@ -204,6 +221,7 @@ class BrokerServer:
         if self.broker.batcher is not None:
             await self.broker.batcher.stop()
             self.broker.batcher = None
+        await self.broker.gateways.stop_all()
         await self.broker.resources.stop_all()
         await self.broker.access.close()
         self.broker.shutdown()

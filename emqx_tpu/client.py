@@ -148,6 +148,15 @@ class MqttClient:
                             )
                         )
                         await writer.drain()
+                    elif pkt.type == C.PUBREL:
+                        # inbound QoS2 completion (receiver side)
+                        writer.write(
+                            C.serialize(
+                                C.Pubcomp(packet_id=pkt.packet_id),
+                                self.version,
+                            )
+                        )
+                        await writer.drain()
                     elif pkt.type == C.DISCONNECT:
                         raise ConnectionError("server disconnect")
                 await writer.drain()

@@ -143,6 +143,8 @@ class BrokerConfig:
     # server-side auto-subscribe on connect (emqx_auto_subscribe):
     # entries {"topic": ..., "qos": 0}; %c/%u placeholders supported
     auto_subscribe: List[Dict[str, Any]] = field(default_factory=list)
+    # protocol gateways (emqx_gateway): {"type": "stomp", "bind", "port"}
+    gateways: List[Dict[str, Any]] = field(default_factory=list)
     durable: DurableConfig = field(default_factory=DurableConfig)
     node_name: str = "emqx_tpu@127.0.0.1"
 

@@ -1,0 +1,211 @@
+"""Multi-protocol gateway framework.
+
+The `emqx_gateway` behaviors (/root/reference/apps/emqx_gateway/src/
+bhvrs/emqx_gateway_frame.erl:45-63 parse/serialize contract,
+emqx_gateway_channel.erl, emqx_gateway_conn.erl): a gateway adapts a
+non-MQTT protocol onto the broker's pub/sub core.  Each gateway
+supplies a frame codec and a channel class; the framework owns the TCP
+accept loop, the read/parse pump, and the session adapter that turns
+broker deliveries (MQTT Publish packets) into gateway frames.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, List, Optional, Tuple
+
+log = logging.getLogger("emqx_tpu.gateway")
+
+
+class GatewayFrame:
+    """Frame codec behavior (emqx_gateway_frame parity)."""
+
+    def initial_state(self):
+        return b""
+
+    def parse(self, state, data: bytes) -> Tuple[List[object], object]:
+        """Consume bytes, return (frames, new_state)."""
+        raise NotImplementedError
+
+    def serialize(self, frame) -> bytes:
+        raise NotImplementedError
+
+
+class GatewayChannel:
+    """Per-connection protocol handler.  Subclasses implement
+    ``handle_frame``; ``deliver`` receives broker deliveries (MQTT
+    Publish packets via the session adapter) to re-frame for the
+    client."""
+
+    def __init__(self, gateway: "Gateway", write, close, peer: str) -> None:
+        self.gateway = gateway
+        self.broker = gateway.broker
+        self.write = write  # callable(bytes)
+        self.close = close  # callable(reason)
+        self.peer = peer
+        self.clientid: Optional[str] = None
+        self.session = None
+
+    def handle_frame(self, frame) -> None:
+        raise NotImplementedError
+
+    def deliver(self, publishes) -> None:
+        raise NotImplementedError
+
+    def connection_lost(self, reason: str) -> None:
+        if self.clientid is not None and self.session is not None:
+            self.broker.cm.disconnect(self.clientid, self._adapter)
+            if self.session.expiry_interval <= 0:
+                self.broker.session_terminated(self.clientid, self.session)
+            self.session = None
+
+    # --------------------------------------------------- broker glue
+
+    def open_session(self, clientid: str, clean_start: bool = True):
+        """Register with the broker's connection manager; deliveries
+        route back through this channel."""
+        channel = self
+
+        class _Adapter:
+            """ChannelLike: broker-side deliveries + kicks land here."""
+
+            @staticmethod
+            def send_packets(packets) -> None:
+                channel.deliver(packets)
+
+            @staticmethod
+            def close(reason: str) -> None:
+                channel.close(reason)
+
+        self._adapter = _Adapter()
+        session, present = self.broker.open_session(
+            clean_start, clientid, self._adapter
+        )
+        self.clientid = clientid
+        self.session = session
+        self.broker.metrics.inc(f"gateway.{self.gateway.name}.connected")
+        return session, present
+
+
+class Gateway:
+    """One configured gateway instance: a frame codec, a channel class,
+    and a TCP listener."""
+
+    name = "abstract"
+    frame_class = GatewayFrame
+    channel_class = GatewayChannel
+
+    def __init__(
+        self, broker, bind: str = "0.0.0.0", port: int = 0
+    ) -> None:
+        self.broker = broker
+        self.bind = bind
+        self.port = port
+        self.frame: GatewayFrame = self.frame_class()
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._conns: set = set()
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._on_client, self.bind, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        log.info("gateway %s listening on %s:%d", self.name, self.bind,
+                 self.port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+        for task in list(self._conns):
+            task.cancel()
+        if self._conns:
+            await asyncio.gather(*self._conns, return_exceptions=True)
+        if self._server is not None:
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _on_client(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        task = asyncio.current_task()
+        self._conns.add(task)
+        peername = writer.get_extra_info("peername")
+        peer = f"{peername[0]}:{peername[1]}" if peername else "?"
+        closed = asyncio.Event()
+
+        def write(data: bytes) -> None:
+            if not writer.is_closing():
+                writer.write(data)
+
+        def close(reason: str) -> None:
+            if not writer.is_closing():
+                writer.close()
+            closed.set()
+
+        channel = self.channel_class(self, write, close, peer)
+        state = self.frame.initial_state()
+        reason = "closed"
+        try:
+            while not closed.is_set():
+                data = await reader.read(65536)
+                if not data:
+                    break
+                try:
+                    frames, state = self.frame.parse(state, data)
+                except ValueError as exc:
+                    log.debug("gateway %s frame error: %s", self.name, exc)
+                    reason = "frame_error"
+                    break
+                for frame in frames:
+                    channel.handle_frame(frame)
+                    if closed.is_set():
+                        break
+                await writer.drain()
+        except (ConnectionResetError, BrokenPipeError):
+            reason = "peer_reset"
+        except asyncio.CancelledError:
+            reason = "server_stopped"
+        finally:
+            self._conns.discard(task)
+            channel.connection_lost(reason)
+            if not writer.is_closing():
+                writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, asyncio.CancelledError):
+                pass
+
+
+class GatewayRegistry:
+    """Named gateway instances bound to one broker (the emqx_gateway
+    registry/lifecycle role)."""
+
+    def __init__(self, broker) -> None:
+        self.broker = broker
+        self._gateways: Dict[str, Gateway] = {}
+
+    async def load(self, gateway: Gateway) -> Gateway:
+        await gateway.start()
+        self._gateways[gateway.name] = gateway
+        return gateway
+
+    def get(self, name: str) -> Optional[Gateway]:
+        return self._gateways.get(name)
+
+    async def unload(self, name: str) -> bool:
+        gw = self._gateways.pop(name, None)
+        if gw is None:
+            return False
+        await gw.stop()
+        return True
+
+    async def stop_all(self) -> None:
+        for name in list(self._gateways):
+            await self.unload(name)
+
+    def info(self) -> List[Dict]:
+        return [
+            {"name": n, "port": g.port, "bind": g.bind}
+            for n, g in self._gateways.items()
+        ]

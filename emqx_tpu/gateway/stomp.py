@@ -1,0 +1,326 @@
+"""STOMP 1.2 gateway: STOMP clients speak to the MQTT broker core.
+
+The `emqx_gateway_stomp` role (/root/reference/apps/emqx_gateway_stomp/
+src/emqx_stomp_frame.erl grammar comment :35-67, emqx_stomp_channel.erl
+command handling); the codec is written from the public STOMP 1.2
+specification:
+
+    frame   = command EOL *(header EOL) EOL body NUL
+    client  : CONNECT/STOMP SEND SUBSCRIBE UNSUBSCRIBE ACK NACK DISCONNECT
+    server  : CONNECTED MESSAGE RECEIPT ERROR
+
+Mapping onto the broker: destination == topic (MQTT wildcards pass
+through), SEND -> publish, SUBSCRIBE id:ack-mode -> broker subscription
+(``auto`` = QoS0, ``client``/``client-individual`` = QoS1 where ACK
+acks the delivery), MESSAGE carries subscription + message-id headers.
+"""
+
+from __future__ import annotations
+
+import secrets
+from typing import Dict, List, Optional, Tuple
+
+from ..access import PUBLISH, SUBSCRIBE, ClientInfo
+from ..broker.session import SubOpts
+from ..codec import mqtt as C
+from ..message import Message
+from . import Gateway, GatewayChannel, GatewayFrame
+
+EOL = b"\n"
+NUL = b"\x00"
+MAX_FRAME = 1 << 20
+
+
+class StompFrame:
+    __slots__ = ("command", "headers", "body")
+
+    def __init__(
+        self,
+        command: str,
+        headers: Optional[Dict[str, str]] = None,
+        body: bytes = b"",
+    ) -> None:
+        self.command = command
+        self.headers = headers or {}
+        self.body = body
+
+
+_ESCAPES = {"\\n": "\n", "\\c": ":", "\\\\": "\\", "\\r": "\r"}
+
+
+def _unescape(value: str) -> str:
+    out = []
+    i = 0
+    while i < len(value):
+        pair = value[i : i + 2]
+        if pair in _ESCAPES:
+            out.append(_ESCAPES[pair])
+            i += 2
+        else:
+            out.append(value[i])
+            i += 1
+    return "".join(out)
+
+
+def _escape(value: str) -> str:
+    return (
+        value.replace("\\", "\\\\")
+        .replace("\n", "\\n")
+        .replace(":", "\\c")
+        .replace("\r", "\\r")
+    )
+
+
+class StompCodec(GatewayFrame):
+    def initial_state(self) -> bytes:
+        return b""
+
+    def parse(self, state: bytes, data: bytes) -> Tuple[List[StompFrame], bytes]:
+        buf = state + data
+        if len(buf) > MAX_FRAME:
+            raise ValueError("stomp frame too large")
+        frames: List[StompFrame] = []
+        while buf:
+            # bare EOLs between frames are heartbeats
+            if buf[0:1] in (b"\n", b"\r"):
+                buf = buf.lstrip(b"\r\n")
+                continue
+            head_end = buf.find(b"\n\n")
+            crlf = False
+            alt = buf.find(b"\r\n\r\n")
+            if alt != -1 and (head_end == -1 or alt < head_end):
+                head_end, crlf = alt, True
+            if head_end == -1:
+                break
+            header_blob = buf[:head_end].decode("utf-8", "replace")
+            body_start = head_end + (4 if crlf else 2)
+            lines = [
+                ln.rstrip("\r") for ln in header_blob.split("\n")
+            ]
+            command = lines[0].strip()
+            headers: Dict[str, str] = {}
+            for ln in lines[1:]:
+                if ":" not in ln:
+                    continue
+                k, v = ln.split(":", 1)
+                headers.setdefault(_unescape(k), _unescape(v))
+            if "content-length" in headers:
+                n = int(headers["content-length"])
+                if len(buf) < body_start + n + 1:
+                    break
+                body = buf[body_start : body_start + n]
+                if buf[body_start + n : body_start + n + 1] != NUL:
+                    raise ValueError("stomp frame missing NUL after body")
+                buf = buf[body_start + n + 1 :]
+            else:
+                nul = buf.find(NUL, body_start)
+                if nul == -1:
+                    break
+                body = buf[body_start:nul]
+                buf = buf[nul + 1 :]
+            frames.append(StompFrame(command, headers, body))
+        return frames, buf
+
+    def serialize(self, frame: StompFrame) -> bytes:
+        out = [frame.command.encode()]
+        headers = dict(frame.headers)
+        if frame.body:
+            headers.setdefault("content-length", str(len(frame.body)))
+        for k, v in headers.items():
+            out.append(f"{_escape(k)}:{_escape(str(v))}".encode())
+        return EOL.join(out) + b"\n\n" + frame.body + NUL
+
+
+class StompChannel(GatewayChannel):
+    def __init__(self, gateway, write, close, peer) -> None:
+        super().__init__(gateway, write, close, peer)
+        self.connected = False
+        # subscription id -> (topic, ack_mode)
+        self._subs: Dict[str, Tuple[str, str]] = {}
+        self._topic_sub: Dict[str, str] = {}  # topic -> sub id
+        self.client: Optional[ClientInfo] = None
+
+    # ------------------------------------------------------- outgoing
+
+    def _send(self, frame: StompFrame) -> None:
+        self.write(self.gateway.frame.serialize(frame))
+
+    def _error(self, message: str, detail: str = "") -> None:
+        self._send(
+            StompFrame(
+                "ERROR", {"message": message}, detail.encode()
+            )
+        )
+        self.close("stomp_error")
+
+    def _receipt(self, headers: Dict[str, str]) -> None:
+        rid = headers.get("receipt")
+        if rid is not None:
+            self._send(StompFrame("RECEIPT", {"receipt-id": rid}))
+
+    # ------------------------------------------------------- incoming
+
+    def handle_frame(self, frame: StompFrame) -> None:
+        cmd = frame.command
+        if not self.connected:
+            if cmd in ("CONNECT", "STOMP"):
+                self._handle_connect(frame)
+            else:
+                self._error("not connected")
+            return
+        if cmd == "SEND":
+            self._handle_send(frame)
+        elif cmd == "SUBSCRIBE":
+            self._handle_subscribe(frame)
+        elif cmd == "UNSUBSCRIBE":
+            self._handle_unsubscribe(frame)
+        elif cmd in ("ACK", "NACK"):
+            self._handle_ack(frame, cmd == "ACK")
+        elif cmd == "DISCONNECT":
+            self._receipt(frame.headers)
+            self.close("normal")
+        elif cmd in ("BEGIN", "COMMIT", "ABORT"):
+            # transactions are accepted but not batched (receipt only)
+            self._receipt(frame.headers)
+        else:
+            self._error(f"unsupported command {cmd}")
+
+    def _handle_connect(self, frame: StompFrame) -> None:
+        login = frame.headers.get("login")
+        passcode = frame.headers.get("passcode")
+        clientid = "stomp-" + (login or secrets.token_hex(6))
+        client = ClientInfo(
+            clientid=clientid,
+            username=login,
+            password=passcode.encode() if passcode else None,
+            peerhost=self.peer,
+        )
+        if self.broker.banned.is_banned(
+            clientid=clientid, username=login,
+            peerhost=self.peer.rsplit(":", 1)[0],
+        ):
+            self._error("banned")
+            return
+        ok, client = self.broker.access.authenticate(client)
+        if not ok:
+            self._error("authentication failed")
+            return
+        client.password = None
+        self.client = client
+        self.open_session(clientid, clean_start=True)
+        self.connected = True
+        self._send(
+            StompFrame(
+                "CONNECTED",
+                {
+                    "version": "1.2",
+                    "server": "emqx_tpu",
+                    "heart-beat": "0,0",
+                    "session": clientid,
+                },
+            )
+        )
+
+    def _handle_send(self, frame: StompFrame) -> None:
+        dest = frame.headers.get("destination")
+        if not dest:
+            self._error("SEND requires destination")
+            return
+        if not self.broker.access.authorize(self.client, PUBLISH, dest):
+            self._error("publish not authorized", dest)
+            return
+        msg = Message(
+            topic=dest,
+            payload=frame.body,
+            qos=int(frame.headers.get("qos", 0)),
+            retain=frame.headers.get("retain") == "true",
+            from_client=self.clientid,
+            from_username=self.client.username,
+        )
+        batcher = self.broker.batcher
+        if batcher is not None:
+            batcher.publish_nowait(msg)
+        else:
+            self.broker.publish(msg)
+        self._receipt(frame.headers)
+
+    def _handle_subscribe(self, frame: StompFrame) -> None:
+        dest = frame.headers.get("destination")
+        sid = frame.headers.get("id")
+        if not dest or sid is None:
+            self._error("SUBSCRIBE requires destination and id")
+            return
+        if not self.broker.access.authorize(self.client, SUBSCRIBE, dest):
+            self._error("subscribe not authorized", dest)
+            return
+        ack_mode = frame.headers.get("ack", "auto")
+        qos = 0 if ack_mode == "auto" else 1
+        opts = SubOpts(qos=qos)
+        is_new = self.session.subscribe(dest, opts)
+        self.broker.subscribe(self.clientid, dest, opts, is_new_sub=is_new)
+        self._subs[sid] = (dest, ack_mode)
+        self._topic_sub[dest] = sid
+        self._receipt(frame.headers)
+
+    def _handle_unsubscribe(self, frame: StompFrame) -> None:
+        sid = frame.headers.get("id")
+        sub = self._subs.pop(sid, None)
+        if sub is not None:
+            dest, _ = sub
+            # several STOMP subscription ids may share one destination:
+            # the broker subscription lives until the LAST one goes
+            if not any(d == dest for d, _m in self._subs.values()):
+                self._topic_sub.pop(dest, None)
+                self.session.unsubscribe(dest)
+                self.broker.unsubscribe(self.clientid, dest)
+            elif self._topic_sub.get(dest) == sid:
+                self._topic_sub[dest] = next(
+                    s for s, (d, _m) in self._subs.items() if d == dest
+                )
+        self._receipt(frame.headers)
+
+    def _handle_ack(self, frame: StompFrame, positive: bool) -> None:
+        try:
+            pid = int(frame.headers.get("id", ""))
+        except ValueError:
+            self._receipt(frame.headers)
+            return
+        if positive and self.session is not None:
+            # settle the QoS1 delivery AND frame any messages the freed
+            # inflight slot dequeues (the MQTT channel's follow-ups)
+            _ok, follow_ups = self.session.puback(pid)
+            if follow_ups:
+                self.deliver(follow_ups)
+        self._receipt(frame.headers)
+
+    # ------------------------------------------------------ deliveries
+
+    def deliver(self, packets) -> None:
+        """Broker deliveries arrive as MQTT packets (Publish/Pubrel);
+        re-frame Publishes as MESSAGE."""
+        for pkt in packets:
+            if pkt.type != C.PUBLISH:
+                continue
+            sid = self._topic_sub.get(pkt.topic)
+            if sid is None:
+                # wildcard subscriptions: find the matching filter
+                from .. import topic as T
+
+                for s, (flt, _mode) in self._subs.items():
+                    if T.match(pkt.topic, flt):
+                        sid = s
+                        break
+            headers = {
+                "destination": pkt.topic,
+                "subscription": sid or "0",
+                "message-id": str(pkt.packet_id or 0),
+            }
+            if pkt.packet_id:
+                headers["ack"] = str(pkt.packet_id)
+            self._send(StompFrame("MESSAGE", headers, pkt.payload))
+
+
+class StompGateway(Gateway):
+    name = "stomp"
+    frame_class = StompCodec
+    channel_class = StompChannel

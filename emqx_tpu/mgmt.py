@@ -81,6 +81,14 @@ class MgmtApi:
         r.add_get("/api/v5/trace/{name}/log", self.get_trace_log)
         r.add_get("/api/v5/audit", self.get_audit)
         r.add_put("/api/v5/configs", self.put_config)
+        r.add_get("/api/v5/gateways", self.get_gateways)
+        r.add_post(
+            "/api/v5/load_rebalance/evacuation/start", self.start_evacuation
+        )
+        r.add_post(
+            "/api/v5/load_rebalance/evacuation/stop", self.stop_evacuation
+        )
+        r.add_get("/api/v5/load_rebalance/status", self.rebalance_status)
         r.add_get("/metrics", self.prometheus)
         app.middlewares.append(self._audit_middleware)
         self._runner = web.AppRunner(app, access_log=None)
@@ -295,8 +303,12 @@ class MgmtApi:
 
     async def get_trace_log(self, request: web.Request) -> web.Response:
         import os
+        import re
 
         name = request.match_info["name"]
+        if not re.fullmatch(r"[A-Za-z0-9_-]{1,64}", name):
+            # same charset trace.start enforces: the name joins a path
+            return _json({"code": "BAD_REQUEST"}, status=400)
         path = os.path.join(self.broker.trace.directory, f"{name}.log")
         if not os.path.exists(path):
             return _json({"code": "NOT_FOUND"}, status=404)
@@ -324,6 +336,26 @@ class MgmtApi:
         except (KeyError, ValueError, TypeError, json.JSONDecodeError) as exc:
             return _json({"code": "BAD_REQUEST", "message": str(exc)}, 400)
         return _json({"path": path})
+
+    async def get_gateways(self, request: web.Request) -> web.Response:
+        return _json({"data": self.broker.gateways.info()})
+
+    async def start_evacuation(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json() if request.can_read_body else {}
+        except json.JSONDecodeError:
+            body = {}
+        await self.broker.eviction.start_evacuation(
+            int(body.get("conn_evict_rate", 50))
+        )
+        return _json(self.broker.eviction.info())
+
+    async def stop_evacuation(self, request: web.Request) -> web.Response:
+        await self.broker.eviction.stop_evacuation()
+        return _json(self.broker.eviction.info())
+
+    async def rebalance_status(self, request: web.Request) -> web.Response:
+        return _json(self.broker.eviction.info())
 
     # ------------------------------------------------------ prometheus
 

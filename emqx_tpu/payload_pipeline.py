@@ -1,0 +1,192 @@
+"""Payload pipeline: message transformation + schema validation.
+
+The `emqx_message_transformation` + `emqx_schema_validation` slice
+(/root/reference/apps/emqx_message_transformation,
+apps/emqx_schema_validation; hookpoints 'message.transformation_failed'
+and 'schema.validation_failed', emqx_hookpoints.erl:63-64): both hook
+ahead of routing on ``message.publish`` — transformations rewrite
+topic/payload fields, validations check JSON payloads against JSON
+Schema and drop or disconnect on failure.  Order matches the
+reference: transformation first, then validation.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from . import topic as T
+from .hooks import STOP_WITH
+from .message import Message
+
+log = logging.getLogger("emqx_tpu.pipeline")
+
+
+@dataclass
+class Transformation:
+    """Set topic or payload fields from ``${...}`` templates rendered
+    against the rule-engine environment (payload.*, topic, clientid)."""
+
+    name: str
+    topics: List[str]
+    # operations: dotted target -> template; targets: "topic" or
+    # "payload.<field>"; a non-template value is assigned literally
+    operations: Dict[str, Any] = field(default_factory=dict)
+    failure_action: str = "drop"  # drop | ignore
+
+
+@dataclass
+class Validation:
+    name: str
+    topics: List[str]
+    schema: Dict[str, Any]  # JSON Schema
+    failure_action: str = "drop"  # drop | disconnect | ignore
+    _validator: Any = None
+
+    def validator(self):
+        if self._validator is None:
+            import jsonschema
+
+            self._validator = jsonschema.Draft202012Validator(self.schema)
+        return self._validator
+
+
+class PayloadPipeline:
+    def __init__(self, broker) -> None:
+        self.broker = broker
+        self.transformations: List[Transformation] = []
+        self.validations: List[Validation] = []
+        # one hook, ordered after rewrite (90) and delayed (100), before
+        # the trace tap and rule dispatch
+        broker.hooks.add("message.publish", self._on_publish, priority=80)
+
+    # ------------------------------------------------------ management
+
+    def add_transformation(self, t: Transformation) -> None:
+        for flt in t.topics:
+            T.validate_filter(flt)
+        self.transformations.append(t)
+
+    def add_validation(self, v: Validation) -> None:
+        for flt in v.topics:
+            T.validate_filter(flt)
+        v.validator()  # compile now: a bad schema fails registration
+        self.validations.append(v)
+
+    def remove(self, name: str) -> bool:
+        n0 = len(self.transformations) + len(self.validations)
+        self.transformations = [
+            t for t in self.transformations if t.name != name
+        ]
+        self.validations = [v for v in self.validations if v.name != name]
+        return len(self.transformations) + len(self.validations) != n0
+
+    def info(self) -> List[Dict]:
+        return [
+            {"name": t.name, "kind": "transformation", "topics": t.topics}
+            for t in self.transformations
+        ] + [
+            {"name": v.name, "kind": "validation", "topics": v.topics}
+            for v in self.validations
+        ]
+
+    # ------------------------------------------------------------ hook
+
+    def _matches(self, topics: List[str], topic: str) -> bool:
+        return any(T.match(topic, flt) for flt in topics)
+
+    def _on_publish(self, msg: Message):
+        if msg.sys or not (self.transformations or self.validations):
+            return None
+        out = msg
+        for t in self.transformations:
+            if not self._matches(t.topics, out.topic):
+                continue
+            try:
+                out = self._apply_transformation(t, out)
+            except Exception as exc:
+                self.broker.metrics.inc("messages.transformation_failed")
+                self.broker.hooks.run(
+                    "message.transformation_failed", out, t.name, str(exc)
+                )
+                if t.failure_action == "drop":
+                    return STOP_WITH(None)
+        for v in self.validations:
+            if not self._matches(v.topics, out.topic):
+                continue
+            err = self._validate(v, out)
+            if err is not None:
+                self.broker.metrics.inc("messages.validation_failed")
+                self.broker.hooks.run(
+                    "schema.validation_failed", out, v.name, err
+                )
+                if v.failure_action == "disconnect" and out.from_client:
+                    ch = self.broker.cm.channel(out.from_client)
+                    if ch is not None:
+                        ch.close("validation_failed")
+                if v.failure_action in ("drop", "disconnect"):
+                    return STOP_WITH(None)
+        return out if out is not msg else None
+
+    def _apply_transformation(
+        self, t: Transformation, msg: Message
+    ) -> Message:
+        from .rules.engine import render_template
+        from .rules.runtime import build_env
+
+        env = build_env(msg)
+        touches_payload = any(
+            target == "payload" or target.startswith("payload.")
+            for target in t.operations
+        )
+        payload = None
+        if touches_payload:
+            # only payload-editing operations need (and re-encode) JSON;
+            # a non-JSON payload is a transformation FAILURE, never a
+            # silent replacement with {}
+            payload = json.loads(msg.payload.decode())
+            if not isinstance(payload, dict):
+                payload = {"value": payload}
+        new_topic = msg.topic
+        for target, template in t.operations.items():
+            value = (
+                render_template(template, env)
+                if isinstance(template, str) and "${" in template
+                else template
+            )
+            if target == "topic":
+                new_topic = str(value)
+            elif target == "payload":
+                payload = value
+            elif target.startswith("payload."):
+                payload[target[len("payload."):]] = value
+            else:
+                raise ValueError(f"unknown transformation target {target}")
+        return Message(
+            topic=new_topic,
+            payload=json.dumps(payload).encode()
+            if touches_payload
+            else msg.payload,
+            qos=msg.qos,
+            retain=msg.retain,
+            from_client=msg.from_client,
+            from_username=msg.from_username,
+            mid=msg.mid,
+            timestamp=msg.timestamp,
+            properties=dict(msg.properties),
+            headers=dict(msg.headers),
+        )
+
+    def _validate(self, v: Validation, msg: Message) -> Optional[str]:
+        try:
+            payload = json.loads(msg.payload.decode())
+        except (ValueError, UnicodeDecodeError) as exc:
+            return f"payload is not JSON: {exc}"
+        errors = sorted(
+            v.validator().iter_errors(payload), key=lambda e: e.path
+        )
+        if errors:
+            return "; ".join(e.message for e in errors[:3])
+        return None

@@ -1,0 +1,86 @@
+"""Node evacuation: gradually migrate connections off this node.
+
+The `emqx_node_rebalance` / `emqx_eviction_agent` role
+(/root/reference/apps/emqx_node_rebalance/src/
+emqx_node_rebalance_evacuation.erl, apps/emqx_eviction_agent): an
+operator drains a node by disconnecting clients at a bounded rate; v5
+clients receive USE_ANOTHER_SERVER so well-behaved ones reconnect to a
+peer, where the cross-node takeover migrates their persistent sessions.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Optional
+
+log = logging.getLogger("emqx_tpu.rebalance")
+
+RC_USE_ANOTHER_SERVER = 0x9C
+
+
+class EvictionAgent:
+    def __init__(self, broker) -> None:
+        self.broker = broker
+        self.status = "disabled"
+        self.started_at: Optional[float] = None
+        self.evicted = 0
+        self._task: Optional[asyncio.Task] = None
+
+    async def start_evacuation(self, conn_evict_rate: int = 50) -> None:
+        """Disconnect `conn_evict_rate` clients per second until the
+        node is drained.  Sessions with expiry survive detached and are
+        taken over when their clients land on a peer."""
+        if self.status == "evacuating":
+            return
+        self.status = "evacuating"
+        self.started_at = time.time()
+        self.evicted = 0
+        self.broker.alarms.activate(
+            "node_evacuating", message="connection evacuation in progress"
+        )
+        self._task = asyncio.get_running_loop().create_task(
+            self._run(max(conn_evict_rate, 1))
+        )
+
+    async def stop_evacuation(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        if self.status == "evacuating":
+            self.status = "stopped"
+        self.broker.alarms.deactivate("node_evacuating")
+
+    async def _run(self, rate: int) -> None:
+        cm = self.broker.cm
+        while True:
+            connected = [cid for cid in cm.clients() if cm.connected(cid)]
+            if not connected:
+                self.status = "evacuated"
+                self.broker.alarms.deactivate("node_evacuating")
+                log.info("evacuation complete: %d evicted", self.evicted)
+                return
+            for cid in connected[:rate]:
+                channel = cm.channel(cid)
+                if channel is not None:
+                    channel.close("evacuated")
+                    self.evicted += 1
+                    self.broker.metrics.inc("client.evicted")
+            await asyncio.sleep(1.0)
+
+    def info(self) -> dict:
+        return {
+            "status": self.status,
+            "evicted": self.evicted,
+            "started_at": self.started_at,
+            "remaining": sum(
+                1
+                for cid in self.broker.cm.clients()
+                if self.broker.cm.connected(cid)
+            ),
+        }

@@ -1,0 +1,185 @@
+"""STOMP gateway: a raw STOMP 1.2 client session against the broker
+core, interoperating with MQTT clients (emqx_gateway + stomp parity)."""
+
+import asyncio
+
+from emqx_tpu.broker.listener import BrokerServer
+from emqx_tpu.config import BrokerConfig, ListenerConfig
+from emqx_tpu.gateway.stomp import StompCodec, StompFrame
+from mqtt_client import TestClient
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class StompTestClient:
+    def __init__(self, port: int):
+        self.port = port
+        self.codec = StompCodec()
+        self.state = b""
+        self.frames: asyncio.Queue = asyncio.Queue()
+
+    async def connect(self, login=None, passcode=None):
+        self.reader, self.writer = await asyncio.open_connection(
+            "127.0.0.1", self.port
+        )
+        self._pump = asyncio.get_running_loop().create_task(self._read())
+        headers = {"accept-version": "1.2", "host": "emqx"}
+        if login:
+            headers["login"] = login
+        if passcode:
+            headers["passcode"] = passcode
+        await self.send(StompFrame("CONNECT", headers))
+        frame = await self.expect("CONNECTED", "ERROR")
+        return frame
+
+    async def _read(self):
+        try:
+            while True:
+                data = await self.reader.read(65536)
+                if not data:
+                    break
+                frames, self.state = self.codec.parse(self.state, data)
+                for f in frames:
+                    await self.frames.put(f)
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+
+    async def send(self, frame: StompFrame):
+        self.writer.write(self.codec.serialize(frame))
+        await self.writer.drain()
+
+    async def expect(self, *commands, timeout=3.0) -> StompFrame:
+        frame = await asyncio.wait_for(self.frames.get(), timeout)
+        assert frame.command in commands, (frame.command, frame.headers)
+        return frame
+
+    async def close(self):
+        self._pump.cancel()
+        self.writer.close()
+
+
+async def make_server(**cfg_kw):
+    cfg = BrokerConfig()
+    cfg.listeners = [ListenerConfig(port=0)]
+    cfg.gateways = [{"type": "stomp", "bind": "127.0.0.1", "port": 0}]
+    for k, v in cfg_kw.items():
+        setattr(cfg, k, v)
+    srv = BrokerServer(cfg)
+    await srv.start()
+    return srv
+
+
+def test_stomp_send_subscribe_roundtrip():
+    async def t():
+        srv = await make_server()
+        sport = srv.broker.gateways.get("stomp").port
+        mport = srv.listeners[0].port
+
+        s1 = StompTestClient(sport)
+        ack = await s1.connect(login="alice")
+        assert ack.command == "CONNECTED"
+        assert ack.headers["version"] == "1.2"
+
+        # STOMP subscribes with an MQTT wildcard destination
+        await s1.send(
+            StompFrame(
+                "SUBSCRIBE",
+                {"id": "0", "destination": "stocks/+", "receipt": "r1"},
+            )
+        )
+        await s1.expect("RECEIPT")
+
+        # MQTT publisher -> STOMP subscriber
+        m = TestClient(mport, "mq")
+        await m.connect()
+        await m.publish("stocks/appl", b"190.5", qos=1)
+        msg = await s1.expect("MESSAGE")
+        assert msg.headers["destination"] == "stocks/appl"
+        assert msg.headers["subscription"] == "0"
+        assert msg.body == b"190.5"
+
+        # STOMP SEND -> MQTT subscriber
+        await m.subscribe("orders/#", qos=1)
+        await s1.send(
+            StompFrame(
+                "SEND",
+                {"destination": "orders/1", "receipt": "r2"},
+                b"buy 100",
+            )
+        )
+        await s1.expect("RECEIPT")
+        pkt = await m.recv_publish()
+        assert pkt.topic == "orders/1" and pkt.payload == b"buy 100"
+
+        # the gateway session is visible to the broker's CM
+        assert srv.broker.cm.lookup("stomp-alice") is not None
+
+        await s1.send(StompFrame("DISCONNECT", {"receipt": "bye"}))
+        await s1.expect("RECEIPT")
+        await s1.close()
+        await m.disconnect()
+        await asyncio.sleep(0.05)
+        assert srv.broker.cm.lookup("stomp-alice") is None
+        await srv.stop()
+
+    run(t())
+
+
+def test_stomp_client_ack_mode():
+    async def t():
+        srv = await make_server()
+        sport = srv.broker.gateways.get("stomp").port
+        mport = srv.listeners[0].port
+
+        s1 = StompTestClient(sport)
+        await s1.connect(login="bob")
+        await s1.send(
+            StompFrame(
+                "SUBSCRIBE",
+                {"id": "7", "destination": "jobs/q", "ack": "client",
+                 "receipt": "r"},
+            )
+        )
+        await s1.expect("RECEIPT")
+
+        m = TestClient(mport, "mq2")
+        await m.connect()
+        await m.publish("jobs/q", b"task-1", qos=1)
+        msg = await s1.expect("MESSAGE")
+        assert "ack" in msg.headers  # client-mode delivery carries an ack id
+        session = srv.broker.cm.lookup("stomp-bob")
+        assert len(session.inflight) == 1
+        await s1.send(StompFrame("ACK", {"id": msg.headers["ack"]}))
+        for _ in range(50):
+            if len(session.inflight) == 0:
+                break
+            await asyncio.sleep(0.02)
+        assert len(session.inflight) == 0  # settled by the STOMP ACK
+        await s1.close()
+        await m.disconnect()
+        await srv.stop()
+
+    run(t())
+
+
+def test_stomp_codec_escapes_and_content_length():
+    codec = StompCodec()
+    frame = StompFrame(
+        "SEND",
+        {"destination": "a:b\nc", "receipt": "r\\1"},
+        b"\x00binary\x00body",
+    )
+    frames, rest = codec.parse(b"", codec.serialize(frame))
+    assert rest == b""
+    f = frames[0]
+    assert f.headers["destination"] == "a:b\nc"
+    assert f.headers["receipt"] == "r\\1"
+    assert f.body == b"\x00binary\x00body"
+    # partial delivery reassembles
+    blob = codec.serialize(frame)
+    frames1, st = codec.parse(b"", blob[:10])
+    assert frames1 == []
+    frames2, st = codec.parse(st, blob[10:])
+    assert len(frames2) == 1 and frames2[0].body == f.body

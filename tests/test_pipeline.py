@@ -1,0 +1,135 @@
+"""Message transformation + schema validation ahead of routing
+(emqx_message_transformation / emqx_schema_validation parity)."""
+
+import asyncio
+import json
+
+from emqx_tpu.broker.listener import BrokerServer
+from emqx_tpu.config import BrokerConfig, ListenerConfig
+from emqx_tpu.payload_pipeline import Transformation, Validation
+from mqtt_client import TestClient
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def make_server():
+    cfg = BrokerConfig()
+    cfg.listeners = [ListenerConfig(port=0)]
+    srv = BrokerServer(cfg)
+    await srv.start()
+    return srv
+
+
+def test_schema_validation_drops_invalid():
+    async def t():
+        srv = await make_server()
+        port = srv.listeners[0].port
+        failures = []
+        srv.broker.hooks.add(
+            "schema.validation_failed",
+            lambda msg, name, err: failures.append((name, err)),
+        )
+        srv.broker.pipeline.add_validation(
+            Validation(
+                name="temp-check",
+                topics=["sensors/#"],
+                schema={
+                    "type": "object",
+                    "properties": {"temp": {"type": "number"}},
+                    "required": ["temp"],
+                },
+            )
+        )
+        sub = TestClient(port, "s")
+        await sub.connect()
+        await sub.subscribe("sensors/#", qos=1)
+        pub = TestClient(port, "p")
+        await pub.connect()
+        await pub.publish("sensors/a", b'{"temp": 20.5}', qos=1)
+        pkt = await sub.recv_publish()
+        assert json.loads(pkt.payload)["temp"] == 20.5
+        # invalid: dropped, hookpoint fired
+        await pub.publish("sensors/a", b'{"temp": "hot"}', qos=1)
+        await pub.publish("sensors/a", b"not json", qos=1)
+        await pub.publish("other/a", b"not json", qos=1)  # not covered
+        await asyncio.sleep(0.05)
+        assert len(failures) == 2
+        assert failures[0][0] == "temp-check"
+        assert srv.broker.metrics.val("messages.validation_failed") == 2
+        # the valid message was the only sensors/# delivery
+        await pub.publish("sensors/a", b'{"temp": 1}', qos=1)
+        pkt2 = await sub.recv_publish()
+        assert json.loads(pkt2.payload)["temp"] == 1
+        await pub.disconnect()
+        await sub.disconnect()
+        await srv.stop()
+
+    run(t())
+
+
+def test_transformation_rewrites_payload_and_topic():
+    async def t():
+        srv = await make_server()
+        port = srv.listeners[0].port
+        srv.broker.pipeline.add_transformation(
+            Transformation(
+                name="enrich",
+                topics=["raw/#"],
+                operations={
+                    "topic": "cooked/${clientid}",
+                    "payload.source": "${topic}",
+                    "payload.unit": "celsius",
+                },
+            )
+        )
+        sub = TestClient(port, "s2")
+        await sub.connect()
+        await sub.subscribe("cooked/#", qos=1)
+        pub = TestClient(port, "dev7")
+        await pub.connect()
+        await pub.publish("raw/x", b'{"v": 3}', qos=1)
+        pkt = await sub.recv_publish()
+        assert pkt.topic == "cooked/dev7"
+        body = json.loads(pkt.payload)
+        assert body == {"v": 3, "source": "raw/x", "unit": "celsius"}
+        await pub.disconnect()
+        await sub.disconnect()
+        await srv.stop()
+
+    run(t())
+
+
+def test_transformation_then_validation_order():
+    async def t():
+        srv = await make_server()
+        port = srv.listeners[0].port
+        # the transformation injects the field validation requires
+        srv.broker.pipeline.add_transformation(
+            Transformation(
+                name="default-temp",
+                topics=["t/#"],
+                operations={"payload.temp": 0},
+            )
+        )
+        srv.broker.pipeline.add_validation(
+            Validation(
+                name="needs-temp",
+                topics=["t/#"],
+                schema={"type": "object", "required": ["temp"]},
+            )
+        )
+        sub = TestClient(port, "s3")
+        await sub.connect()
+        await sub.subscribe("t/#", qos=1)
+        pub = TestClient(port, "p3")
+        await pub.connect()
+        await pub.publish("t/1", b"{}", qos=1)  # temp injected -> passes
+        pkt = await sub.recv_publish()
+        assert json.loads(pkt.payload)["temp"] == 0
+        await pub.disconnect()
+        await sub.disconnect()
+        await srv.stop()
+
+    run(t())

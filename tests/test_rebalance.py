@@ -1,0 +1,101 @@
+"""Node evacuation: bounded-rate eviction with cross-node session
+migration (emqx_node_rebalance / emqx_eviction_agent parity)."""
+
+import asyncio
+
+from emqx_tpu.broker.listener import BrokerServer
+from emqx_tpu.cluster import ClusterNode
+from emqx_tpu.codec import mqtt as C
+from emqx_tpu.config import BrokerConfig, ListenerConfig
+from mqtt_client import TestClient
+
+FAST = dict(heartbeat_interval=0.05, down_after=0.25, flush_interval=0.002)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_evacuation_drains_and_signals_clients():
+    async def t():
+        cfg = BrokerConfig()
+        cfg.listeners = [ListenerConfig(port=0)]
+        cfg.api.enable = True
+        cfg.api.port = 0
+        srv = BrokerServer(cfg)
+        await srv.start()
+        port = srv.listeners[0].port
+
+        clients = [TestClient(port, f"ev-{i}") for i in range(6)]
+        for c in clients:
+            await c.connect(
+                clean_start=False,
+                properties={"session_expiry_interval": 600},
+            )
+        await srv.broker.eviction.start_evacuation(conn_evict_rate=100)
+        # v5 clients get USE_ANOTHER_SERVER before the close
+        pkt = await clients[0].recv(timeout=3)
+        assert pkt is not None and pkt.type == C.DISCONNECT
+        assert pkt.reason_code == 0x9C
+        for _ in range(100):
+            if srv.broker.eviction.info()["status"] == "evacuated":
+                break
+            await asyncio.sleep(0.05)
+        info = srv.broker.eviction.info()
+        assert info["status"] == "evacuated" and info["evicted"] == 6
+        # persistent sessions survive detached (takeover-able)
+        assert srv.broker.cm.lookup("ev-0") is not None
+        assert not srv.broker.cm.connected("ev-0")
+        for c in clients:
+            await c.close()
+        await srv.stop()
+
+    run(t())
+
+
+def test_evacuated_client_migrates_to_peer():
+    async def t():
+        async def start_node(name, seeds=()):
+            cfg = BrokerConfig()
+            cfg.listeners = [ListenerConfig(port=0)]
+            srv = BrokerServer(cfg)
+            await srv.start()
+            node = ClusterNode(name, srv.broker, **FAST)
+            await node.start(seeds=list(seeds))
+            return srv, node
+
+        srv_a, a = await start_node("a")
+        srv_b, b = await start_node("b", seeds=[("a", "127.0.0.1", a.port)])
+        await asyncio.sleep(0.3)
+
+        c = TestClient(srv_a.listeners[0].port, "mover")
+        await c.connect(
+            clean_start=False,
+            properties={"session_expiry_interval": 600},
+        )
+        await c.subscribe("m/#", qos=1)
+        await srv_a.broker.eviction.start_evacuation(conn_evict_rate=100)
+        await asyncio.sleep(0.3)
+        assert not srv_a.broker.cm.connected("mover")
+
+        # the client follows USE_ANOTHER_SERVER to node B: takeover
+        c2 = TestClient(srv_b.listeners[0].port, "mover")
+        ack = await c2.connect(
+            clean_start=False,
+            properties={"session_expiry_interval": 600},
+        )
+        assert ack.session_present  # migrated with subscriptions
+        pub = TestClient(srv_b.listeners[0].port, "pub")
+        await pub.connect()
+        await pub.publish("m/1", b"hello", qos=1)
+        pkt = await c2.recv_publish()
+        assert pkt.payload == b"hello"
+        await pub.disconnect()
+        await c2.disconnect()
+        await c.close()
+        await b.stop()
+        await srv_b.stop()
+        await a.stop()
+        await srv_a.stop()
+
+    run(t())
