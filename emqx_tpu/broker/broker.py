@@ -417,15 +417,38 @@ class Broker:
         live connection already received."""
         session = self.cm.lookup(clientid)
         if (
-            self.durable is not None
-            and session is not None
+            session is not None
             and self.cm.channel(clientid) is None
             and session.expiry_interval > 0
             and session.subscriptions
         ):
-            self.durable.save(
-                clientid, session.subscriptions, session.expiry_interval
-            )
+            if self.durable is not None:
+                self.durable.save(
+                    clientid, session.subscriptions, session.expiry_interval
+                )
+            if self.external is not None:
+                # buddy replication (simplified emqx_ds_builtin_raft):
+                # the checkpoint + everything pending survives this
+                # node's death on the clientid's buddy peer
+                from ..cluster.node import msg_to_wire
+
+                queued = []
+                for _pid, e in session.inflight.items():
+                    if e.msg is not None:
+                        w = msg_to_wire(e.msg)
+                        w["qos"] = e.qos  # granted qos + dup, as resume
+                        w["dup"] = True
+                        queued.append(w)
+                queued.extend(msg_to_wire(m) for m in session.mqueue)
+                self.external.replicate_checkpoint(
+                    clientid,
+                    {
+                        flt: o.to_dict()
+                        for flt, o in session.subscriptions.items()
+                    },
+                    session.expiry_interval,
+                    queued,
+                )
 
     # ------------------------------------------------------ publish
 
@@ -664,16 +687,25 @@ class Broker:
             return len(deliveries)
         # detached persistent session: queue QoS>0, drop QoS0
         kept = 0
+        replicated = []
         for m, opts in deliveries:
             qos = session._effective_qos(m.qos, opts)
             if qos == 0:
                 self.metrics.inc("delivery.dropped")
                 continue
-            dropped = session.mqueue.insert(session._queued(m, opts, qos))
+            baked = session._queued(m, opts, qos)
+            dropped = session.mqueue.insert(baked)
             if dropped is not None:
                 self.metrics.inc("delivery.dropped.queue_full")
                 self.hooks.run("delivery.dropped", clientid, dropped, "queue_full")
+            replicated.append(baked)
             kept += 1
+        if replicated and self.external is not None:
+            from ..cluster.node import msg_to_wire
+
+            self.external.replicate_queued(
+                clientid, [msg_to_wire(m) for m in replicated]
+            )
         return kept
 
     # -------------------------------------------------- delayed wills

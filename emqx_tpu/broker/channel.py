@@ -303,15 +303,24 @@ class Channel:
             # must be kicked (the reference discards the remote session
             # either way; no state transfer is wanted here)
             ext.discard_remote(clientid)
+        durable = self.broker.durable
         if (
             not pkt.clean_start
             and ext is not None
             and self.broker.cm.lookup(clientid) is None
-            and ext.remote_owner(clientid) is not None
+            and (
+                # a live remote owner ALWAYS wins (its state is fresher
+                # than any local disk checkpoint); otherwise only defer
+                # when there is no local checkpoint to resume from
+                ext.remote_owner(clientid) is not None
+                or durable is None
+                or not durable.has_checkpoint(clientid)
+            )
         ):
-            # the session lives on a peer: fetch it asynchronously (the
-            # reference's cross-node takeover, emqx_cm.erl:314-317) and
-            # finish the CONNECT when the state transfer resolves
+            # the session may live elsewhere: a live peer (takeover) or
+            # a replica of a dead node's session — fetch asynchronously
+            # (the reference's cross-node takeover, emqx_cm.erl:314-317)
+            # and finish the CONNECT when the lookup resolves
             import asyncio
 
             self._pending_connect = asyncio.get_running_loop().create_task(
@@ -334,7 +343,7 @@ class Channel:
         # own cancellation and re-home the state as a detached local
         # session if this connection dies mid-flight
         inner = asyncio.get_running_loop().create_task(
-            self.broker.external.takeover(clientid)
+            self.broker.external.fetch_session(clientid)
         )
 
         def rescue(task: "asyncio.Task") -> None:
@@ -366,6 +375,11 @@ class Channel:
     ) -> None:
         m = self.broker.metrics
         mqtt = self.broker.config.mqtt
+        if imported is not None and self.broker.durable is not None:
+            # the fetched (takeover/replica) state supersedes any stale
+            # local checkpoint — drop it or open_session would resurrect
+            # the older state and discard the fresh import
+            self.broker.durable.drop_checkpoint(clientid)
         session, present = self.broker.open_session(
             pkt.clean_start,
             clientid,

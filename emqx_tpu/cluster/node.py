@@ -25,6 +25,7 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..ds.replication import ReplicaStore, rendezvous_pick
 from ..message import Message
 from .routes import ClusterRouteTable
 from .transport import NodeTransport, pack_bytes, unpack_bytes
@@ -146,11 +147,17 @@ class ClusterNode:
         self._conf_counter = 0
         self._conf_latest: Dict[str, Tuple[int, str, Any]] = {}
         self._pending_fwd: Dict[str, List[Message]] = {}
+        # DS replication: this node's replica copies of peers' sessions
+        self.replicas = ReplicaStore()
+        self._pending_repl: List[Tuple[str, Dict]] = []
 
         self.transport.on("route_ops", self._handle_route_ops)
         self.transport.on("takeover", self._handle_takeover)
         self.transport.on("client_discard", self._handle_client_discard)
         self.transport.on("conf_txn", self._handle_conf_txn)
+        self.transport.on("ds_ckpt", self._handle_ds_ckpt)
+        self.transport.on("ds_msgs", self._handle_ds_msgs)
+        self.transport.on("ds_take", self._handle_ds_take)
         self.transport.on("forward_batch", self._handle_forward_batch)
         self.transport.on("heartbeat", self._handle_heartbeat)
         self.transport.on("sync", self._handle_sync)
@@ -256,6 +263,8 @@ class ClusterNode:
                 )
             if self._pending_fwd:
                 await self._flush_forwards()
+            if self._pending_repl:
+                await self._flush_replication()
 
     def _check_epoch(self, node: str, epoch: int) -> None:
         """A new epoch means the peer restarted: its op stream starts
@@ -283,8 +292,16 @@ class ClusterNode:
                 self.routes.delete_route(arg, node)
             elif op == "cadd":
                 self.clients[arg] = node
-            elif op == "cdel" and self.clients.get(arg) == node:
-                del self.clients[arg]
+                # the session is live on `node` now: any replica held
+                # here is stale (fresh replication will follow)
+                self.replicas.drop(arg)
+            elif op == "cdel":
+                if self.clients.get(arg) == node:
+                    del self.clients[arg]
+                    # only the CURRENT owner's close invalidates the
+                    # replica; a lagging cdel from a previous owner must
+                    # not destroy the new owner's fresh checkpoint
+                    self.replicas.drop(arg)
             log_.append((seq, op, arg))
             self._peer_seq[node] = seq
 
@@ -451,6 +468,106 @@ class ClusterNode:
         if owner is None or owner == self.name or owner in self._down:
             return None
         return owner
+
+    # --------------------------------------------- DS replication
+
+    def _buddy(self, clientid: str) -> Optional[str]:
+        peers = self.peers_alive()
+        if not peers:
+            return None
+        return rendezvous_pick(clientid, peers, 1)[0]
+
+    def replicate_checkpoint(
+        self, clientid: str, subs: Dict, expiry: float, queued: List[Dict]
+    ) -> None:
+        """Ship a persistent session's checkpoint (+ its pending
+        messages) to the clientid's buddy peer.  Buffered into the SAME
+        flush cycle as the op stream: a checkpoint cast overtaking the
+        connect's still-buffered cadd op would be dropped as stale by
+        the receiver."""
+        buddy = self._buddy(clientid)
+        if buddy is None:
+            return
+        obj = {
+            "type": "ds_ckpt",
+            "clientid": clientid,
+            "state": {
+                "subs": subs,
+                "expiry": expiry,
+                "queued": queued,
+                "saved_at": time.time(),
+            },
+        }
+        self._pending_repl.append((buddy, obj))
+        self._flush_wakeup.set()
+
+    def replicate_queued(self, clientid: str, wire_msgs: List[Dict]) -> None:
+        """Buffer per-client queued-message replication; flushed with
+        the op stream (ordering, see replicate_checkpoint)."""
+        buddy = self._buddy(clientid)
+        if buddy is None:
+            return
+        self._pending_repl.append(
+            (buddy, {"type": "ds_msgs", "clientid": clientid,
+                     "messages": wire_msgs})
+        )
+        if len(self._pending_repl) >= self.flush_max:
+            self._flush_wakeup.set()
+
+    async def _flush_replication(self) -> None:
+        pending, self._pending_repl = self._pending_repl, []
+        for buddy, obj in pending:
+            # sent inline (not as a task): per-link FIFO keeps these
+            # ORDERED AFTER the op casts flushed this same cycle
+            await self.transport.cast(buddy, obj)
+
+    async def _handle_ds_ckpt(self, peer: str, obj: Dict) -> None:
+        self.replicas.store_checkpoint(
+            obj.get("clientid", ""), obj.get("state", {})
+        )
+
+    async def _handle_ds_msgs(self, peer: str, obj: Dict) -> None:
+        self.replicas.append_messages(
+            obj.get("clientid", ""), obj.get("messages", [])
+        )
+
+    async def _handle_ds_take(self, peer: str, obj: Dict) -> Dict:
+        return {"state": self.replicas.take(obj.get("clientid", ""))}
+
+    async def fetch_session(self, clientid: str) -> Optional[Dict]:
+        """Locate a reconnecting client's session anywhere in the
+        cluster: live owner takeover first, then replica stores — this
+        node's, then the rendezvous buddy, then the remaining peers
+        CONCURRENTLY (a hung peer must not serialize a reconnect
+        storm)."""
+        state = await self.takeover(clientid)
+        if state is not None:
+            return state
+        state = self.replicas.take(clientid)
+        if state is not None:
+            self.broker.metrics.inc("session.replica_restored")
+            return state
+        peers = self.peers_alive()
+        if not peers:
+            return None
+        buddy = rendezvous_pick(clientid, peers, 1)[0]
+        obj = {"type": "ds_take", "clientid": clientid}
+        reply = await self.transport.call(buddy, obj, timeout=2.0)
+        if reply and reply.get("state"):
+            self.broker.metrics.inc("session.replica_restored")
+            return reply["state"]
+        rest = [p for p in peers if p != buddy]
+        if not rest:
+            return None
+        replies = await asyncio.gather(
+            *(self.transport.call(p, obj, timeout=2.0) for p in rest),
+            return_exceptions=True,
+        )
+        for r in replies:
+            if isinstance(r, dict) and r.get("state"):
+                self.broker.metrics.inc("session.replica_restored")
+                return r["state"]
+        return None
 
     # ------------------------------------------- cluster-wide config
 
@@ -621,6 +738,7 @@ class ClusterNode:
                 ),
                 return_exceptions=True,
             )
+            self.replicas.purge_expired()
             now = time.monotonic()
             for p, seen in list(self._last_seen.items()):
                 if p in self._down:

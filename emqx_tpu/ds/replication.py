@@ -1,0 +1,119 @@
+"""Durable-session replication across cluster nodes.
+
+The role of `emqx_ds_builtin_raft` (/root/reference/apps/
+emqx_ds_builtin_raft/src/emqx_ds_replication_layer.erl: replicated DS
+shards so node loss doesn't lose durable messages), deliberately
+simplified: instead of Raft consensus, each node replicates the durable
+state a persistent session depends on — its checkpoint and its gated
+message batches — to a deterministic BUDDY peer (rendezvous hash per
+clientid over alive peers).  When a client reconnects elsewhere after
+its home node died, the new node restores from its local replica store.
+
+Consistency model (documented, weaker than the reference's Raft):
+asynchronous replication, last-write-wins per clientid; a crash between
+local persist and the replication cast can lose the tail batch.  That
+trades the reference's quorum latency for zero write-path round-trips,
+and converts "node loss = total session loss" into "node loss loses at
+most the un-replicated tail".
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import time
+from typing import Dict, List, Optional
+
+log = logging.getLogger("emqx_tpu.ds.replication")
+
+
+def rendezvous_pick(key: str, nodes: List[str], k: int = 1) -> List[str]:
+    """Highest-random-weight hashing: stable buddy choice that only
+    moves keys owned by a node that joined/left."""
+    scored = sorted(
+        nodes,
+        key=lambda n: hashlib.blake2b(
+            f"{key}\x00{n}".encode(), digest_size=8
+        ).digest(),
+        reverse=True,
+    )
+    return scored[:k]
+
+
+class ReplicaStore:
+    """This node's copy of OTHER nodes' persistent sessions: checkpoint
+    + pending messages per clientid, consulted when a client lands here
+    after its home node died."""
+
+    def __init__(self, cap_per_client: int = 10_000) -> None:
+        self.cap_per_client = cap_per_client
+        # clientid -> {"subs", "expiry", "saved_at", "queued"}
+        self._checkpoints: Dict[str, Dict] = {}
+        # clientid -> wire-dict message buffers (+ first-append stamp,
+        # so orphaned buffers — messages without a checkpoint, e.g.
+        # after a buddy reassignment — age out instead of leaking)
+        self._messages: Dict[str, List[Dict]] = {}
+        self._msg_since: Dict[str, float] = {}
+
+    def store_checkpoint(self, clientid: str, state: Dict) -> None:
+        self._checkpoints[clientid] = state
+
+    def drop(self, clientid: str) -> None:
+        self._checkpoints.pop(clientid, None)
+        self._messages.pop(clientid, None)
+        self._msg_since.pop(clientid, None)
+
+    def append_messages(self, clientid: str, msgs: List[Dict]) -> None:
+        """Messages arrive (and stay) in wire-dict form — only a
+        restore pays the decode."""
+        buf = self._messages.setdefault(clientid, [])
+        self._msg_since.setdefault(clientid, time.time())
+        buf.extend(msgs)
+        del buf[: -self.cap_per_client]
+
+    def take(self, clientid: str) -> Optional[Dict]:
+        """Claim a replica for restore (removes it).  The returned dict
+        matches the takeover-export shape, so Broker.import_session
+        consumes both."""
+        state = self._checkpoints.pop(clientid, None)
+        if state is None:
+            # keep any orphaned message buffer: a checkpoint may still
+            # arrive (buddy reassignment race); it ages out via
+            # purge_expired otherwise
+            return None
+        msgs = self._messages.pop(clientid, [])
+        self._msg_since.pop(clientid, None)
+        return {
+            "subs": state.get("subs", {}),
+            "expiry": state.get("expiry", 0),
+            "queued": list(state.get("queued", [])) + msgs,
+            "awaiting_rel": [],
+        }
+
+    def purge_expired(
+        self, now: Optional[float] = None, orphan_ttl: float = 86400.0
+    ) -> int:
+        now = now if now is not None else time.time()
+        dead = [
+            cid
+            for cid, st in self._checkpoints.items()
+            if now - st.get("saved_at", now) > st.get("expiry", 0)
+        ]
+        for cid in dead:
+            self.drop(cid)
+        orphans = [
+            cid
+            for cid, since in self._msg_since.items()
+            if cid not in self._checkpoints and now - since > orphan_ttl
+        ]
+        for cid in orphans:
+            self.drop(cid)
+        return len(dead) + len(orphans)
+
+    def info(self) -> Dict[str, int]:
+        return {
+            "checkpoints": len(self._checkpoints),
+            "buffered_messages": sum(
+                len(v) for v in self._messages.values()
+            ),
+        }

@@ -619,3 +619,92 @@ def test_cluster_wide_config_update():
         await stop_node(srv_a, a)
 
     run(t())
+
+
+def test_session_survives_node_death_via_replication():
+    """DS replication (simplified emqx_ds_builtin_raft): a persistent
+    session's checkpoint and queued messages survive the death of the
+    node that owned them — the client resumes on the buddy."""
+
+    async def t():
+        srv_a, a = await start_node("a")
+        srv_b, b = await start_node("b", seeds=[("a", "127.0.0.1", a.port)])
+        await settle(0.3)
+
+        c = TestClient(srv_a.listeners[0].port, "phoenix")
+        await c.connect(
+            clean_start=False,
+            properties={"session_expiry_interval": 3600},
+        )
+        await c.subscribe("ash/#", qos=1)
+        await c.disconnect()
+        await settle(0.2)
+        # the checkpoint was replicated to B (the only peer)
+        assert b.replicas.info()["checkpoints"] == 1
+
+        # messages published while detached queue on A AND replicate
+        pub = TestClient(srv_b.listeners[0].port, "p")
+        await pub.connect()
+        await pub.publish("ash/1", b"rise", qos=1)
+        await pub.disconnect()
+        await settle(0.3)
+        assert b.replicas.info()["buffered_messages"] >= 1
+
+        # node A dies hard
+        await stop_node(srv_a, a)
+        await settle(0.5)  # B declares A down
+
+        # the client lands on B: session restored from the replica
+        c2 = TestClient(srv_b.listeners[0].port, "phoenix")
+        ack = await c2.connect(
+            clean_start=False,
+            properties={"session_expiry_interval": 3600},
+        )
+        assert ack.session_present
+        pkt = await c2.recv_publish()
+        assert pkt.topic == "ash/1" and pkt.payload == b"rise"
+        assert srv_b.broker.metrics.val("session.replica_restored") == 1
+
+        # subscriptions came back too: new publishes deliver live
+        pub2 = TestClient(srv_b.listeners[0].port, "p2")
+        await pub2.connect()
+        await pub2.publish("ash/2", b"again", qos=1)
+        assert (await c2.recv_publish()).payload == b"again"
+        await pub2.disconnect()
+        await c2.disconnect()
+        await stop_node(srv_b, b)
+
+    run(t())
+
+
+def test_replica_dropped_when_client_returns_to_owner():
+    """A live reconnect on the owner invalidates the buddy's replica
+    (the cadd registry op), preventing a later stale double-restore."""
+
+    async def t():
+        srv_a, a = await start_node("a")
+        srv_b, b = await start_node("b", seeds=[("a", "127.0.0.1", a.port)])
+        await settle(0.3)
+        c = TestClient(srv_a.listeners[0].port, "rt")
+        await c.connect(
+            clean_start=False,
+            properties={"session_expiry_interval": 600},
+        )
+        await c.subscribe("rt/#", qos=1)
+        await c.disconnect()
+        await settle(0.2)
+        assert b.replicas.info()["checkpoints"] == 1
+        # reconnect on A: the cadd op reaches B and clears the replica
+        c2 = TestClient(srv_a.listeners[0].port, "rt")
+        ack = await c2.connect(
+            clean_start=False,
+            properties={"session_expiry_interval": 600},
+        )
+        assert ack.session_present
+        await settle(0.2)
+        assert b.replicas.info()["checkpoints"] == 0
+        await c2.disconnect()
+        await stop_node(srv_b, b)
+        await stop_node(srv_a, a)
+
+    run(t())
