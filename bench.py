@@ -408,7 +408,7 @@ def main():
         os.environ.get("BENCH_BATCH", 32768 if on_tpu else 4096)
     )
     iters = int(os.environ.get("BENCH_ITERS", 50 if on_tpu else 10))
-    f_width = int(os.environ.get("BENCH_F", 16))
+    f_width = int(os.environ.get("BENCH_F", 8))
     m_cap = int(os.environ.get("BENCH_M", 16))
     depth = int(os.environ.get("BENCH_DEPTH", 8))  # batches in flight
     fanout = int(os.environ.get("BENCH_FANOUT", 8))

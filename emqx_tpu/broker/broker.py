@@ -115,6 +115,21 @@ class Broker:
         from ..rebalance import EvictionAgent
 
         self.eviction = EvictionAgent(self)
+        from ..plugins import PluginManager
+
+        self.plugins = PluginManager(self, directory=self.config.plugin_dir)
+        for name in self.config.plugins:
+            self.plugins.load(name)
+        from ..ft import FileTransfer
+
+        ft_cfg = self.config.ft
+        self.ft = FileTransfer(
+            self,
+            directory=ft_cfg.storage_dir,
+            max_file_size=ft_cfg.max_file_size,
+            transfer_ttl=ft_cfg.transfer_ttl,
+            enable=ft_cfg.enable,
+        )
         # ClusterNode installs itself here (the emqx_external_broker
         # registration point, emqx_broker.erl:379-380): provides
         # match_remote(topics) and forward(msg, nodes)
@@ -320,6 +335,24 @@ class Broker:
 
     # ------------------------------------------- cross-node takeover
 
+    @staticmethod
+    def _serialize_pending(session: Session) -> List[Dict]:
+        """Wire-serialize everything a session still owes its client:
+        unacked inflight PUBLISHes FIRST (granted qos + dup, exactly as
+        a local resume redelivers, [MQTT-4.6.0-1]) then the mqueue
+        backlog.  Shared by takeover export and buddy replication."""
+        from ..cluster.node import msg_to_wire
+
+        queued: List[Dict] = []
+        for _pid, entry in session.inflight.items():
+            if entry.msg is not None:
+                w = msg_to_wire(entry.msg)
+                w["qos"] = entry.qos
+                w["dup"] = True
+                queued.append(w)
+        queued.extend(msg_to_wire(m) for m in session.mqueue)
+        return queued
+
     def export_session(self, clientid: str) -> Optional[Dict]:
         """Serialize and REMOVE a session for migration to another node
         (the owning side of emqx_cm's takeover protocol,
@@ -334,23 +367,9 @@ class Broker:
         channel = self.cm.channel(clientid)
         if channel is not None:
             channel.close("takenover")
-        # unacked inflight PUBLISHes re-deliver FIRST (original send
-        # order precedes the backlog, [MQTT-4.6.0-1]) with the EFFECTIVE
-        # (subscription-granted) qos and dup set, exactly as a local
-        # resume would; PUBREL-phase entries are dropped — the receiver
-        # already owns the message
-        queued = []
-        for _pid, entry in session.inflight.items():
-            if entry.msg is not None:
-                w = msg_to_wire(entry.msg)
-                w["qos"] = entry.qos
-                w["dup"] = True
-                queued.append(w)
-        while True:
-            m = session.mqueue.pop()
-            if m is None:
-                break
-            queued.append(msg_to_wire(m))
+        queued = self._serialize_pending(session)
+        while session.mqueue.pop() is not None:
+            pass  # drained: the session leaves this node
         state = {
             "subs": {
                 flt: opts.to_dict()
@@ -430,16 +449,7 @@ class Broker:
                 # buddy replication (simplified emqx_ds_builtin_raft):
                 # the checkpoint + everything pending survives this
                 # node's death on the clientid's buddy peer
-                from ..cluster.node import msg_to_wire
-
-                queued = []
-                for _pid, e in session.inflight.items():
-                    if e.msg is not None:
-                        w = msg_to_wire(e.msg)
-                        w["qos"] = e.qos  # granted qos + dup, as resume
-                        w["dup"] = True
-                        queued.append(w)
-                queued.extend(msg_to_wire(m) for m in session.mqueue)
+                queued = self._serialize_pending(session)
                 self.external.replicate_checkpoint(
                     clientid,
                     {
@@ -733,6 +743,7 @@ class Broker:
             self.publish(will)
         self.delayed.tick(now)
         self.alarms.tick(now)
+        self.ft.tick(now)
         self.cm.expire_sessions(now)
         if self.durable is not None:
             self.durable.purge_expired(now)

@@ -221,6 +221,7 @@ class BrokerServer:
         if self.broker.batcher is not None:
             await self.broker.batcher.stop()
             self.broker.batcher = None
+        self.broker.plugins.unload_all()
         await self.broker.gateways.stop_all()
         await self.broker.resources.stop_all()
         await self.broker.access.close()

@@ -148,7 +148,10 @@ class ClusterNode:
         self._conf_latest: Dict[str, Tuple[int, str, Any]] = {}
         self._pending_fwd: Dict[str, List[Message]] = {}
         # DS replication: this node's replica copies of peers' sessions
-        self.replicas = ReplicaStore()
+        # (buffer bound mirrors the owner's mqueue depth)
+        self.replicas = ReplicaStore(
+            cap_per_client=broker.config.mqtt.max_mqueue_len
+        )
         self._pending_repl: List[Tuple[str, Dict]] = []
 
         self.transport.on("route_ops", self._handle_route_ops)
@@ -444,9 +447,13 @@ class ClusterNode:
 
     def client_opened(self, clientid: str) -> None:
         self.clients[clientid] = self.name
+        # a locally opened session invalidates any replica WE hold for
+        # it (peers drop theirs via the cadd op)
+        self.replicas.drop(clientid)
         self._queue_client_op("add", clientid)
 
     def client_closed(self, clientid: str) -> None:
+        self.replicas.drop(clientid)
         if self.clients.get(clientid) == self.name:
             del self.clients[clientid]
             self._queue_client_op("del", clientid)
@@ -532,7 +539,11 @@ class ClusterNode:
         )
 
     async def _handle_ds_take(self, peer: str, obj: Dict) -> Dict:
-        return {"state": self.replicas.take(obj.get("clientid", ""))}
+        # NON-destructive peek: if the reply is lost (timeout, link
+        # drop) the only surviving copy must not vanish with it.  The
+        # claimant's session-open broadcasts cadd, which is what drops
+        # this replica once the restore actually succeeded.
+        return {"state": self.replicas.peek(obj.get("clientid", ""))}
 
     async def fetch_session(self, clientid: str) -> Optional[Dict]:
         """Locate a reconnecting client's session anywhere in the
@@ -547,26 +558,20 @@ class ClusterNode:
         if state is not None:
             self.broker.metrics.inc("session.replica_restored")
             return state
-        peers = self.peers_alive()
-        if not peers:
+        # the replica lives on the clientid's rendezvous buddy: one
+        # bounded RPC — never a full-cluster sweep, so a connect storm
+        # of brand-new persistent clients costs one fast miss each.
+        # (After a membership change the historical buddy may differ;
+        # that miss is within the documented best-effort model.)
+        buddy = self._buddy(clientid)
+        if buddy is None:
             return None
-        buddy = rendezvous_pick(clientid, peers, 1)[0]
-        obj = {"type": "ds_take", "clientid": clientid}
-        reply = await self.transport.call(buddy, obj, timeout=2.0)
+        reply = await self.transport.call(
+            buddy, {"type": "ds_take", "clientid": clientid}, timeout=1.0
+        )
         if reply and reply.get("state"):
             self.broker.metrics.inc("session.replica_restored")
             return reply["state"]
-        rest = [p for p in peers if p != buddy]
-        if not rest:
-            return None
-        replies = await asyncio.gather(
-            *(self.transport.call(p, obj, timeout=2.0) for p in rest),
-            return_exceptions=True,
-        )
-        for r in replies:
-            if isinstance(r, dict) and r.get("state"):
-                self.broker.metrics.inc("session.replica_restored")
-                return r["state"]
         return None
 
     # ------------------------------------------- cluster-wide config

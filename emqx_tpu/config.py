@@ -117,6 +117,16 @@ class ApiConfig:
 
 
 @dataclass
+class FtConfig:
+    """MQTT file transfer (emqx_ft)."""
+
+    enable: bool = False
+    storage_dir: str = "data/ft"
+    max_file_size: int = 256 * 1024 * 1024
+    transfer_ttl: float = 3600.0
+
+
+@dataclass
 class DurableConfig:
     """Durable storage + persistent sessions (emqx_durable_storage)."""
 
@@ -145,6 +155,10 @@ class BrokerConfig:
     auto_subscribe: List[Dict[str, Any]] = field(default_factory=list)
     # protocol gateways (emqx_gateway): {"type": "stomp", "bind", "port"}
     gateways: List[Dict[str, Any]] = field(default_factory=list)
+    # plugin names loaded at boot, in order (emqx_plugins)
+    plugins: List[str] = field(default_factory=list)
+    plugin_dir: str = "plugins"
+    ft: FtConfig = field(default_factory=FtConfig)
     durable: DurableConfig = field(default_factory=DurableConfig)
     node_name: str = "emqx_tpu@127.0.0.1"
 

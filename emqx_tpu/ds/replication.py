@@ -71,6 +71,20 @@ class ReplicaStore:
         buf.extend(msgs)
         del buf[: -self.cap_per_client]
 
+    def peek(self, clientid: str) -> Optional[Dict]:
+        """Non-destructive view in the restore shape (used by remote
+        ds_take: the claimant's session-open op performs the drop)."""
+        state = self._checkpoints.get(clientid)
+        if state is None:
+            return None
+        return {
+            "subs": dict(state.get("subs", {})),
+            "expiry": state.get("expiry", 0),
+            "queued": list(state.get("queued", []))
+            + list(self._messages.get(clientid, [])),
+            "awaiting_rel": [],
+        }
+
     def take(self, clientid: str) -> Optional[Dict]:
         """Claim a replica for restore (removes it).  The returned dict
         matches the takeover-export shape, so Broker.import_session

@@ -1,0 +1,175 @@
+"""MQTT file transfer over `$file/...` topics.
+
+The `emqx_ft` role (/root/reference/apps/emqx_ft/src: `$file` topic
+commands, chunk assembly in emqx_ft_assembler, fs exporter): clients
+stream files through ordinary PUBLISHes —
+
+    $file/<fileid>/init           payload = JSON {"name", "size", ...}
+    $file/<fileid>/<offset>       payload = raw segment bytes
+    $file/<fileid>/fin[/<size>]   finalize: assemble + store
+
+Commands are intercepted on the publish hook (never routed); the
+assembler keeps per-transfer segment maps, validates the final size,
+and exports completed files to the storage directory.  Results are
+observable on `$file/<fileid>/response` for subscribers.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Dict, Optional, Tuple
+
+from .hooks import STOP_WITH
+from .message import Message
+
+log = logging.getLogger("emqx_tpu.ft")
+
+PREFIX = "$file/"
+
+
+class Transfer:
+    __slots__ = ("fileid", "meta", "segments", "started_at", "total")
+
+    def __init__(self, fileid: str, meta: Dict) -> None:
+        self.fileid = fileid
+        self.meta = meta
+        self.segments: Dict[int, bytes] = {}
+        self.started_at = time.time()
+        self.total = 0
+
+
+class FileTransfer:
+    def __init__(
+        self,
+        broker,
+        directory: str = "data/ft",
+        max_file_size: int = 256 * 1024 * 1024,
+        transfer_ttl: float = 3600.0,
+        enable: bool = True,
+    ) -> None:
+        self.broker = broker
+        self.directory = directory
+        self.max_file_size = max_file_size
+        self.transfer_ttl = transfer_ttl
+        self.enable = enable
+        self._transfers: Dict[str, Transfer] = {}
+        broker.hooks.add("message.publish", self._on_publish, priority=95)
+
+    # ------------------------------------------------------------ hook
+
+    def _on_publish(self, msg: Message):
+        if not self.enable or not msg.topic.startswith(PREFIX):
+            return None
+        parts = msg.topic.split("/")
+        if len(parts) < 3:
+            return None  # malformed: route normally (harmless)
+        fileid, command = parts[1], parts[2]
+        if command == "response":
+            return None  # our own status publishes route normally
+        # file ids land in paths: constrain the charset
+        if not fileid or any(c in fileid for c in "/\\.\x00"):
+            self._respond(fileid, "error", "invalid fileid")
+            return STOP_WITH(None)
+        try:
+            if command == "init":
+                self._init(fileid, msg)
+            elif command == "fin":
+                self._fin(
+                    fileid, int(parts[3]) if len(parts) > 3 else None
+                )
+            elif command == "abort":
+                self._transfers.pop(fileid, None)
+                self._respond(fileid, "ok", "aborted")
+            else:
+                self._segment(fileid, int(command), msg)
+        except (ValueError, KeyError) as exc:
+            self.broker.metrics.inc("ft.error")
+            self._respond(fileid, "error", str(exc))
+        return STOP_WITH(None)  # $file commands are never routed
+
+    # --------------------------------------------------------- phases
+
+    def _init(self, fileid: str, msg: Message) -> None:
+        meta = json.loads(msg.payload.decode() or "{}")
+        size = int(meta.get("size", 0))
+        if size > self.max_file_size:
+            raise ValueError(f"file exceeds limit ({size} bytes)")
+        self._transfers[fileid] = Transfer(fileid, meta)
+        self.broker.metrics.inc("ft.init")
+        self._respond(fileid, "ok", "init")
+
+    def _segment(self, fileid: str, offset: int, msg: Message) -> None:
+        tr = self._transfers.get(fileid)
+        if tr is None:
+            raise KeyError(f"no transfer {fileid!r} (init first)")
+        if offset < 0:
+            raise ValueError("negative offset")
+        new = len(msg.payload) + (
+            0 if offset in tr.segments else tr.total
+        )
+        if offset not in tr.segments:
+            tr.total += len(msg.payload)
+        if tr.total > self.max_file_size:
+            del self._transfers[fileid]
+            raise ValueError("transfer exceeds size limit")
+        tr.segments[offset] = msg.payload
+        self.broker.metrics.inc("ft.segment")
+
+    def _fin(self, fileid: str, final_size: Optional[int]) -> None:
+        tr = self._transfers.pop(fileid, None)
+        if tr is None:
+            raise KeyError(f"no transfer {fileid!r}")
+        blob = bytearray()
+        for offset in sorted(tr.segments):
+            seg = tr.segments[offset]
+            if offset != len(blob):
+                if offset < len(blob):  # overlapping rewrite
+                    blob[offset : offset + len(seg)] = seg
+                    continue
+                raise ValueError(
+                    f"gap in transfer at offset {len(blob)} != {offset}"
+                )
+            blob.extend(seg)
+        expected = final_size if final_size is not None else int(
+            tr.meta.get("size", len(blob))
+        )
+        if expected != len(blob):
+            raise ValueError(
+                f"size mismatch: got {len(blob)}, expected {expected}"
+            )
+        name = os.path.basename(str(tr.meta.get("name", fileid))) or fileid
+        outdir = os.path.join(self.directory, fileid)
+        os.makedirs(outdir, exist_ok=True)
+        path = os.path.join(outdir, name)
+        with open(path, "wb") as f:
+            f.write(blob)
+        self.broker.metrics.inc("ft.assembled")
+        self._respond(fileid, "ok", path)
+        log.info("file transfer %s assembled -> %s", fileid, path)
+
+    def _respond(self, fileid: str, result: str, detail: str) -> None:
+        self.broker.publish(
+            Message(
+                topic=f"$file/{fileid}/response",
+                payload=json.dumps(
+                    {"result": result, "detail": detail}
+                ).encode(),
+                qos=0,
+                sys=True,
+            )
+        )
+
+    def tick(self, now: Optional[float] = None) -> int:
+        """Expire stalled transfers (assembler GC)."""
+        now = now if now is not None else time.time()
+        dead = [
+            fid
+            for fid, tr in self._transfers.items()
+            if now - tr.started_at > self.transfer_ttl
+        ]
+        for fid in dead:
+            del self._transfers[fid]
+        return len(dead)

@@ -82,6 +82,9 @@ class MgmtApi:
         r.add_get("/api/v5/audit", self.get_audit)
         r.add_put("/api/v5/configs", self.put_config)
         r.add_get("/api/v5/gateways", self.get_gateways)
+        r.add_get("/api/v5/plugins", self.get_plugins)
+        r.add_get("/", self.dashboard)
+        r.add_get("/dashboard", self.dashboard)
         r.add_post(
             "/api/v5/load_rebalance/evacuation/start", self.start_evacuation
         )
@@ -339,6 +342,50 @@ class MgmtApi:
 
     async def get_gateways(self, request: web.Request) -> web.Response:
         return _json({"data": self.broker.gateways.info()})
+
+    async def get_plugins(self, request: web.Request) -> web.Response:
+        return _json({"data": self.broker.plugins.info()})
+
+    async def dashboard(self, request: web.Request) -> web.Response:
+        """Minimal operator status page (the emqx_dashboard role,
+        server-rendered: live stats refreshed by meta tag, links to the
+        JSON API for everything else)."""
+        b = self.broker
+        stats = b.stats.all()
+        rows = "".join(
+            f"<tr><td>{k}</td><td>{v}</td></tr>"
+            for k, v in sorted(
+                {
+                    "connections": len(b.cm),
+                    "subscriptions": b.router.subscription_count(),
+                    "topics": len(b.router.topics()),
+                    "retained": len(b.retainer),
+                    "messages.received": b.metrics.val("messages.received"),
+                    "messages.sent": b.metrics.val("messages.sent"),
+                    "messages.dropped": b.metrics.val("messages.dropped"),
+                    "rules": len(b.rules.rules),
+                    "alarms.active": len(b.alarms.active()),
+                    **{f"stat:{k}": v for k, v in stats.items()},
+                }.items()
+            )
+        )
+        html = (
+            "<!DOCTYPE html><html><head><title>emqx_tpu</title>"
+            '<meta http-equiv="refresh" content="5">'
+            "<style>body{font-family:monospace;margin:2em}"
+            "table{border-collapse:collapse}td{border:1px solid #999;"
+            "padding:2px 8px}</style></head><body>"
+            f"<h2>emqx_tpu — {b.config.node_name}</h2>"
+            f"<table>{rows}</table>"
+            '<p>APIs: <a href="/api/v5/clients">clients</a> '
+            '<a href="/api/v5/subscriptions">subscriptions</a> '
+            '<a href="/api/v5/rules">rules</a> '
+            '<a href="/api/v5/metrics">metrics</a> '
+            '<a href="/api/v5/alarms">alarms</a> '
+            '<a href="/metrics">prometheus</a></p>'
+            "</body></html>"
+        )
+        return web.Response(text=html, content_type="text/html")
 
     async def start_evacuation(self, request: web.Request) -> web.Response:
         try:

@@ -1,0 +1,74 @@
+"""Plugin loader: operator-supplied Python extensions.
+
+The `emqx_plugins` role (/root/reference/apps/emqx_plugins/src:
+installable packages registering hooks at boot, with enable/disable
+order): here a plugin is a Python module (a single ``<name>.py`` file
+in the plugin directory, or an importable module path) exposing
+
+    def setup(broker) -> None | object
+
+``setup`` registers hooks/rules/resources against the broker; the
+optional return value is retained and, if it has ``teardown(broker)``,
+called at unload.  Plugins load in configured order at server start.
+"""
+
+from __future__ import annotations
+
+import importlib
+import importlib.util
+import logging
+import os
+from typing import Dict, List, Optional
+
+log = logging.getLogger("emqx_tpu.plugins")
+
+
+class PluginManager:
+    def __init__(self, broker, directory: str = "plugins") -> None:
+        self.broker = broker
+        self.directory = directory
+        self._loaded: Dict[str, object] = {}
+
+    def load(self, name: str) -> bool:
+        """Load one plugin by name: `<dir>/<name>.py` first, else an
+        importable module path."""
+        if name in self._loaded:
+            return False
+        path = os.path.join(self.directory, f"{name}.py")
+        try:
+            if os.path.exists(path):
+                spec = importlib.util.spec_from_file_location(
+                    f"emqx_tpu_plugin_{name}", path
+                )
+                module = importlib.util.module_from_spec(spec)
+                spec.loader.exec_module(module)
+            else:
+                module = importlib.import_module(name)
+            handle = module.setup(self.broker)
+        except Exception:
+            log.exception("plugin %s failed to load", name)
+            self.broker.metrics.inc("plugins.load_failed")
+            return False
+        self._loaded[name] = handle
+        self.broker.metrics.inc("plugins.loaded")
+        log.info("plugin %s loaded", name)
+        return True
+
+    def unload(self, name: str) -> bool:
+        handle = self._loaded.pop(name, None)
+        if handle is None:
+            return False
+        teardown = getattr(handle, "teardown", None)
+        if teardown is not None:
+            try:
+                teardown(self.broker)
+            except Exception:
+                log.exception("plugin %s teardown failed", name)
+        return True
+
+    def unload_all(self) -> None:
+        for name in list(self._loaded):
+            self.unload(name)
+
+    def info(self) -> List[Dict]:
+        return [{"name": n, "status": "running"} for n in self._loaded]

@@ -1,0 +1,122 @@
+"""File transfer over $file/ topics + plugin loading + dashboard
+(emqx_ft / emqx_plugins / emqx_dashboard parity)."""
+
+import asyncio
+import json
+
+import aiohttp
+
+from emqx_tpu.broker.listener import BrokerServer
+from emqx_tpu.config import BrokerConfig, ListenerConfig
+from mqtt_client import TestClient
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_file_transfer_assembly(tmp_path):
+    async def t():
+        cfg = BrokerConfig()
+        cfg.listeners = [ListenerConfig(port=0)]
+        cfg.ft.enable = True
+        cfg.ft.storage_dir = str(tmp_path / "ft")
+        srv = BrokerServer(cfg)
+        await srv.start()
+        port = srv.listeners[0].port
+
+        c = TestClient(port, "uploader")
+        await c.connect()
+        await c.subscribe("$file/f1/response")
+        data = bytes(range(256)) * 40  # 10240 bytes
+        await c.publish(
+            "$file/f1/init",
+            json.dumps({"name": "blob.bin", "size": len(data)}).encode(),
+        )
+        resp = await c.recv_publish()
+        assert json.loads(resp.payload)["result"] == "ok"
+        # segments out of order
+        await c.publish("$file/f1/5120", data[5120:])
+        await c.publish("$file/f1/0", data[:5120])
+        await c.publish("$file/f1/fin", b"")
+        resp2 = await c.recv_publish()
+        body = json.loads(resp2.payload)
+        assert body["result"] == "ok", body
+        with open(body["detail"], "rb") as f:
+            assert f.read() == data
+
+        # size mismatch is rejected
+        await c.subscribe("$file/f2/response")
+        await c.publish(
+            "$file/f2/init", json.dumps({"size": 10}).encode()
+        )
+        await c.publish("$file/f2/0", b"short")
+        await c.publish("$file/f2/fin", b"")
+        msgs = [await c.recv_publish() for _ in range(2)]
+        results = [json.loads(m.payload)["result"] for m in msgs]
+        assert "error" in results
+        await c.disconnect()
+        await srv.stop()
+
+    run(t())
+
+
+def test_plugin_loading(tmp_path):
+    plugin_dir = tmp_path / "plugins"
+    plugin_dir.mkdir()
+    (plugin_dir / "stamp.py").write_text(
+        "def setup(broker):\n"
+        "    from emqx_tpu.hooks import STOP_WITH\n"
+        "    def stamp(msg):\n"
+        "        msg.properties['user_property'] = [('via', 'plugin')]\n"
+        "        return msg\n"
+        "    cb = broker.hooks.add('message.publish', stamp)\n"
+        "    class H:\n"
+        "        def teardown(self, broker):\n"
+        "            broker.hooks.delete('message.publish', cb)\n"
+        "    return H()\n"
+    )
+
+    async def t():
+        cfg = BrokerConfig()
+        cfg.listeners = [ListenerConfig(port=0)]
+        cfg.plugins = ["stamp"]
+        cfg.plugin_dir = str(plugin_dir)
+        srv = BrokerServer(cfg)
+        await srv.start()
+        assert srv.broker.plugins.info() == [
+            {"name": "stamp", "status": "running"}
+        ]
+        port = srv.listeners[0].port
+        sub = TestClient(port, "s")
+        await sub.connect()
+        await sub.subscribe("p/#", qos=1)
+        pub = TestClient(port, "p")
+        await pub.connect()
+        await pub.publish("p/x", b"hello", qos=1)
+        pkt = await sub.recv_publish()
+        assert ("via", "plugin") in pkt.properties.get("user_property", [])
+        await pub.disconnect()
+        await sub.disconnect()
+        await srv.stop()
+
+    run(t())
+
+
+def test_dashboard_page():
+    async def t():
+        cfg = BrokerConfig()
+        cfg.listeners = [ListenerConfig(port=0)]
+        cfg.api.enable = True
+        cfg.api.port = 0
+        srv = BrokerServer(cfg)
+        await srv.start()
+        api = f"http://127.0.0.1:{srv.api.port}"
+        async with aiohttp.ClientSession() as http:
+            async with http.get(api + "/dashboard") as r:
+                text = await r.text()
+        assert r.status == 200
+        assert "emqx_tpu" in text and "connections" in text
+        await srv.stop()
+
+    run(t())
