@@ -24,6 +24,15 @@ from .session import Session, SubOpts
 
 log = logging.getLogger("emqx_tpu.channel")
 
+# per-qos metric names, precomputed (an f-string per packet allocates
+# on the hottest path)
+_QOS_SENT = ("messages.qos0.sent", "messages.qos1.sent", "messages.qos2.sent")
+_QOS_RECV = (
+    "messages.qos0.received",
+    "messages.qos1.received",
+    "messages.qos2.received",
+)
+
 # channel states
 CONNECTING = "connecting"
 CONNECTED = "connected"
@@ -94,7 +103,7 @@ class Channel:
             for p in packets:
                 if p.type == C.PUBLISH:
                     m.inc("messages.sent")
-                    m.inc(f"messages.qos{p.qos}.sent")
+                    m.inc(_QOS_SENT[p.qos])
                     m.inc("packets.publish.sent")
             self._send(packets)
 
@@ -503,7 +512,7 @@ class Channel:
         m = self.broker.metrics
         m.inc("packets.publish.received")
         m.inc("messages.received")
-        m.inc(f"messages.qos{pkt.qos}.received")
+        m.inc(_QOS_RECV[pkt.qos])
 
         topic = self._resolve_alias(pkt) if self.version == C.MQTT_V5 else pkt.topic
         if topic is None:
