@@ -396,7 +396,7 @@ def main():
 
     from emqx_tpu import topic as T
     from emqx_tpu.ops.automaton import build_automaton, expand_codes_host
-    from emqx_tpu.ops.dictionary import TokenDict, encode_topics
+    from emqx_tpu.ops.dictionary import PAD_TOK, TokenDict, encode_topics
     from emqx_tpu.ops.match_kernel import match_batch
 
     platform = jax.devices()[0].platform
@@ -440,15 +440,37 @@ def main():
 
     dev = tuple(jax.device_put(a) for a in aut.device_arrays())
 
+    # per-topic encode cache: live publish streams are Zipf-heavy, so
+    # hot topics re-encode as one dict hit (the engine's production
+    # path has the same cache, engine._encode_cached)
+    enc_cache = {}
+
     def submit(topic_strings):
         """Tokenize + dispatch one batch; returns device arrays without
         blocking (JAX async dispatch keeps `depth` batches in flight so
         host<->device latency amortizes away, as the broker's pipelined
         publish path does)."""
-        words = [T.words(t) for t in topic_strings]
-        tokens, lengths, dollar = encode_topics(
-            tdict, words, aut.kernel_levels
-        )
+        levels = aut.kernel_levels
+        b = len(topic_strings)
+        tokens = np.full((b, levels), PAD_TOK, np.int32)
+        lengths = np.zeros(b, np.int32)
+        dollar = np.zeros(b, bool)
+        get = tdict.get
+        for i, t in enumerate(topic_strings):
+            hit = enc_cache.get(t)
+            if hit is None:
+                ws = T.words(t)
+                n = min(len(ws), levels)
+                row = np.full(levels, PAD_TOK, np.int32)
+                for j in range(n):
+                    row[j] = get(ws[j])
+                hit = (row, n, bool(ws) and ws[0].startswith("$"))
+                if len(enc_cache) >= 262144:
+                    enc_cache.clear()
+                enc_cache[t] = hit
+            tokens[i] = hit[0]
+            lengths[i] = hit[1]
+            dollar[i] = hit[2]
         out = match_batch(
             *dev,
             tokens,

@@ -152,6 +152,7 @@ class BrokerServer:
             if lc.enable and lc.type in ("tcp", "ssl", "ws", "wss")
         ]
         self._housekeeper: Optional[asyncio.Task] = None
+        self.telemetry = None
         from ..sys_topics import SysTopics
 
         self.sys = SysTopics(self.broker)
@@ -178,6 +179,17 @@ class BrokerServer:
             await self.api.start()
         for gw_cfg in self.broker.config.gateways:
             await self._load_gateway(gw_cfg)
+        cfg = self.broker.config
+        if cfg.telemetry_enable and cfg.telemetry_url:
+            from ..telemetry import TelemetryReporter
+
+            self.telemetry = TelemetryReporter(
+                self.broker,
+                cfg.telemetry_url,
+                interval=cfg.telemetry_interval,
+                enable=True,
+            )
+            await self.telemetry.start()
         self._housekeeper = asyncio.get_running_loop().create_task(
             self._housekeeping()
         )
@@ -204,6 +216,8 @@ class BrokerServer:
             await asyncio.sleep(1.0)
             self.broker.tick()
             self.sys.tick()
+            if self.telemetry is not None:
+                self.telemetry.tick()
 
     async def stop(self) -> None:
         if self._housekeeper is not None:
@@ -221,6 +235,9 @@ class BrokerServer:
         if self.broker.batcher is not None:
             await self.broker.batcher.stop()
             self.broker.batcher = None
+        if self.telemetry is not None:
+            await self.telemetry.stop()
+            self.telemetry = None
         self.broker.plugins.unload_all()
         await self.broker.gateways.stop_all()
         await self.broker.resources.stop_all()

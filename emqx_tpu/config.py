@@ -159,6 +159,10 @@ class BrokerConfig:
     plugins: List[str] = field(default_factory=list)
     plugin_dir: str = "plugins"
     ft: FtConfig = field(default_factory=FtConfig)
+    # opt-in anonymous usage telemetry (emqx_telemetry); off by default
+    telemetry_enable: bool = False
+    telemetry_url: str = ""
+    telemetry_interval: float = 7 * 24 * 3600.0
     durable: DurableConfig = field(default_factory=DurableConfig)
     node_name: str = "emqx_tpu@127.0.0.1"
 

@@ -1,0 +1,76 @@
+"""Opt-in telemetry reporter.
+
+The `emqx_telemetry` role (/root/reference/apps/emqx_telemetry/src:
+periodic anonymous usage reports).  Disabled by default; when enabled
+it POSTs a small JSON snapshot (version, uptime, counts — never
+payloads, topics, or client identifiers) to the configured URL on an
+interval, via the buffered resource layer so an unreachable endpoint
+never affects the broker.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import Optional
+
+from .resources import BufferWorker, HttpSink
+from .sys_topics import VERSION
+
+
+class TelemetryReporter:
+    def __init__(
+        self,
+        broker,
+        url: str,
+        interval: float = 7 * 24 * 3600.0,
+        enable: bool = False,
+    ) -> None:
+        self.broker = broker
+        self.url = url
+        self.interval = interval
+        self.enable = enable
+        self.node_uuid = str(uuid.uuid4())  # random per boot, not stable
+        self._worker: Optional[BufferWorker] = None
+        self._last = 0.0
+
+    async def start(self) -> None:
+        if not self.enable:
+            return
+        self._worker = BufferWorker(
+            HttpSink(self.url), max_buffer=8, max_retries=3
+        )
+        await self._worker.start()
+
+    async def stop(self) -> None:
+        if self._worker is not None:
+            await self._worker.stop()
+            self._worker = None
+
+    def report(self) -> dict:
+        b = self.broker
+        return {
+            "uuid": self.node_uuid,
+            "version": VERSION,
+            "uptime": int(time.time() - b.metrics.start_time),
+            "connections": len(b.cm),
+            "subscriptions": b.router.subscription_count(),
+            "rules": len(b.rules.rules),
+            "gateways": [g["name"] for g in b.gateways.info()],
+            "cluster_size": (
+                1 + len(b.external.peers_alive())
+                if b.external is not None
+                else 1
+            ),
+        }
+
+    def tick(self, now: Optional[float] = None) -> bool:
+        if not self.enable or self._worker is None:
+            return False
+        now = now if now is not None else time.time()
+        if now - self._last < self.interval:
+            return False
+        self._last = now
+        self._worker.enqueue(json.dumps(self.report()))
+        return True

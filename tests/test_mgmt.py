@@ -155,3 +155,46 @@ def test_prometheus_exposition():
         await srv.stop()
 
     run(t())
+
+
+def test_telemetry_reporter():
+    from aiohttp import web
+
+    async def t():
+        reports = []
+
+        async def handle(request):
+            reports.append(await request.json())
+            return web.Response(status=200)
+
+        app = web.Application()
+        app.router.add_post("/t", handle)
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        tport = site._server.sockets[0].getsockname()[1]
+
+        cfg = BrokerConfig()
+        cfg.listeners = [ListenerConfig(port=0)]
+        cfg.telemetry_enable = True
+        cfg.telemetry_url = f"http://127.0.0.1:{tport}/t"
+        cfg.telemetry_interval = 0.0  # report on every tick
+        srv = BrokerServer(cfg)
+        await srv.start()
+        assert srv.telemetry.tick()
+        for _ in range(100):
+            if reports:
+                break
+            await asyncio.sleep(0.02)
+        assert reports and reports[0]["version"].startswith("emqx_tpu")
+        assert "uuid" in reports[0] and reports[0]["cluster_size"] == 1
+        # nothing sensitive leaves: only counts and names
+        assert set(reports[0]) <= {
+            "uuid", "version", "uptime", "connections", "subscriptions",
+            "rules", "gateways", "cluster_size",
+        }
+        await srv.stop()
+        await runner.cleanup()
+
+    run(t())
