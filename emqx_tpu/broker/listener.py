@@ -187,7 +187,6 @@ class BrokerServer:
                 self.broker,
                 cfg.telemetry_url,
                 interval=cfg.telemetry_interval,
-                enable=True,
             )
             await self.telemetry.start()
         self._housekeeper = asyncio.get_running_loop().create_task(

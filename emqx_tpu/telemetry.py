@@ -25,21 +25,22 @@ class TelemetryReporter:
         broker,
         url: str,
         interval: float = 7 * 24 * 3600.0,
-        enable: bool = False,
     ) -> None:
         self.broker = broker
         self.url = url
         self.interval = interval
-        self.enable = enable
         self.node_uuid = str(uuid.uuid4())  # random per boot, not stable
         self._worker: Optional[BufferWorker] = None
         self._last = 0.0
 
     async def start(self) -> None:
-        if not self.enable:
-            return
         self._worker = BufferWorker(
-            HttpSink(self.url), max_buffer=8, max_retries=3
+            HttpSink(self.url),
+            max_buffer=8,
+            max_retries=3,
+            # a reporter that POSTs weekly must not HEAD-probe a dead
+            # endpoint every second
+            health_interval=max(self.interval, 60.0),
         )
         await self._worker.start()
 
@@ -66,9 +67,10 @@ class TelemetryReporter:
         }
 
     def tick(self, now: Optional[float] = None) -> bool:
-        if not self.enable or self._worker is None:
+        if self._worker is None:
             return False
-        now = now if now is not None else time.time()
+        # monotonic basis: wall-clock steps must not skew the interval
+        now = now if now is not None else time.monotonic()
         if now - self._last < self.interval:
             return False
         self._last = now

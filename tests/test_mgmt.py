@@ -173,7 +173,7 @@ def test_telemetry_reporter():
         await runner.setup()
         site = web.TCPSite(runner, "127.0.0.1", 0)
         await site.start()
-        tport = site._server.sockets[0].getsockname()[1]
+        tport = runner.addresses[0][1]
 
         cfg = BrokerConfig()
         cfg.listeners = [ListenerConfig(port=0)]
