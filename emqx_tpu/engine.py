@@ -370,14 +370,57 @@ class MatchEngine:
         _pad_nodes_pow2(aut, minimum=4096)
         aut.kernel_levels = self.max_levels + 1
         self._daut = aut
-        self._ddev = None  # uploaded lazily by the next match's snapshot
+        self._ddev = None  # the warm thread (or next snapshot) uploads
         self._dfid_arr = make_fid_arr([fid for fid, _ in filters])
+        self._warm_delta_async(aut)
         self._daut_fids = {fid for fid, _ in filters}
         self._delta_new = make_trie()
         # the new delta automaton holds only CURRENT filters, so its
         # tombstone set starts empty (fresh object: an in-flight match's
         # captured snapshot keeps the old set + old automaton pair)
         self._deleted_daut = set()
+
+    def _warm_built(self, aut, dev) -> None:
+        """Compile the kernel for a freshly built automaton's table
+        shapes (called off the hot path so the first real match never
+        pays a shape-class compile in its own latency).  Sharded
+        subclasses override — their tables feed a different kernel."""
+        from .ops.match_kernel import match_batch
+
+        out = match_batch(
+            *dev,
+            np.full((16, aut.kernel_levels), -4, np.int32),
+            np.zeros(16, np.int32),
+            np.zeros(16, bool),
+            probes=aut.probes,
+            f_width=self.f_width,
+            m_cap=self.m_cap,
+        )
+        out[0].block_until_ready()
+
+    def _warm_delta_async(self, aut) -> None:
+        """Upload + warm a freshly folded delta automaton in a daemon
+        thread."""
+
+        def work():
+            try:
+                import jax
+
+                dev = tuple(jax.device_put(a) for a in aut.device_arrays())
+                with self._mlock:
+                    if self._daut is aut and self._ddev is None:
+                        self._ddev = dev
+                self._warm_built(aut, dev)
+            except Exception:
+                import logging
+
+                logging.getLogger("emqx_tpu.engine").debug(
+                    "delta shape warm failed", exc_info=True
+                )
+
+        threading.Thread(
+            target=work, name="matchengine-warm", daemon=True
+        ).start()
 
     def _drop_delta_aut(self) -> None:
         self._daut = None
@@ -429,6 +472,18 @@ class MatchEngine:
         def work():
             try:
                 built = self._build(inputs, device_put=True)
+                # compile the kernel for the new table shapes HERE, in
+                # the builder thread, so the first post-swap match never
+                # pays a shape-class compile in its own latency
+                try:
+                    if built[1] is not None and built[0].n_nodes > 1:
+                        self._warm_built(built[0], built[1])
+                except Exception:
+                    import logging
+
+                    logging.getLogger("emqx_tpu.engine").debug(
+                        "base shape warm failed", exc_info=True
+                    )
             except Exception:  # build failure must not wedge the engine
                 import logging
 

@@ -265,6 +265,11 @@ class ShardedMatchEngine(MatchEngine):
         dev = self._device_put(index) if device_put else None
         return index, dev, make_fid_arr(fids), set(fids), None
 
+    def _warm_built(self, index, dev) -> None:
+        # the sharded tables feed sharded_match, not the single-chip
+        # kernel; its compile is warmed by the first sharded call
+        return
+
     def _device_put(self, index: ShardedIndex):
         return tuple(
             jax.device_put(t, NamedSharding(self.mesh, P("sub")))

@@ -31,7 +31,7 @@ class TelemetryReporter:
         self.interval = interval
         self.node_uuid = str(uuid.uuid4())  # random per boot, not stable
         self._worker: Optional[BufferWorker] = None
-        self._last = 0.0
+        self._last: Optional[float] = None  # None => report on first tick
 
     async def start(self) -> None:
         self._worker = BufferWorker(
@@ -71,7 +71,7 @@ class TelemetryReporter:
             return False
         # monotonic basis: wall-clock steps must not skew the interval
         now = now if now is not None else time.monotonic()
-        if now - self._last < self.interval:
+        if self._last is not None and now - self._last < self.interval:
             return False
         self._last = now
         self._worker.enqueue(json.dumps(self.report()))
