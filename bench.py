@@ -408,7 +408,7 @@ def main():
         os.environ.get("BENCH_BATCH", 32768 if on_tpu else 4096)
     )
     iters = int(os.environ.get("BENCH_ITERS", 50 if on_tpu else 10))
-    f_width = int(os.environ.get("BENCH_F", 8))
+    f_width = int(os.environ.get("BENCH_F", 4))
     m_cap = int(os.environ.get("BENCH_M", 16))
     depth = int(os.environ.get("BENCH_DEPTH", 8))  # batches in flight
     fanout = int(os.environ.get("BENCH_FANOUT", 8))
@@ -429,8 +429,8 @@ def main():
     build_s = time.perf_counter() - t0
     fid_arr = np.arange(n_subs, dtype=np.int64)  # position == fid here
     log(
-        f"built automaton: nodes={aut.n_nodes} buckets={len(aut.ht_rows)} "
-        f"probes={aut.probes} kernel_levels={aut.kernel_levels} "
+        f"built automaton: nodes={aut.n_nodes} buckets={len(aut.fp_rows)} "
+        f"salt={aut.salt} kernel_levels={aut.kernel_levels} "
         f"in {build_s:.2f}s (gen {gen_s:.2f}s)"
     )
 
@@ -481,7 +481,6 @@ def main():
             tokens,
             lengths,
             dollar,
-            probes=aut.probes,
             f_width=f_width,
             m_cap=m_cap,
         )
@@ -519,7 +518,7 @@ def main():
     t0 = time.perf_counter()
     outs = [
         match_batch(
-            *dev, *e, probes=aut.probes, f_width=f_width, m_cap=m_cap
+            *dev, *e, f_width=f_width, m_cap=m_cap
         )
         for e in encoded
     ]
@@ -596,7 +595,7 @@ def main():
         "iters": iters,
         "build_s": build_s,
         "nodes": aut.n_nodes,
-        "probes": aut.probes,
+        "salt": aut.salt,
         "rate_topics_per_s": rate,
         "device_only_rate_topics_per_s": device_rate,
         "sync_batch_latency_ms_p50": float(p50),

@@ -57,8 +57,10 @@ def _pad_nodes_pow2(aut: Automaton, minimum: int = 16) -> None:
     while cap < n:
         cap *= 2
     if cap != n:
-        pad = np.zeros((cap - n, 4), np.int32)
+        pad = np.zeros((cap - n, 8), np.int32)
         pad[:, 0] = int(SENTINEL)
+        pad[:, 4] = -1  # no incoming edge: verification-dead
+        pad[:, 5] = -1
         aut.node_rows = np.concatenate([aut.node_rows, pad])
 
 
@@ -84,7 +86,7 @@ class MatchEngine:
     def __init__(
         self,
         max_levels: int = 16,
-        f_width: int = 16,
+        f_width: int = 8,
         m_cap: int = 128,
         rebuild_threshold: int = 4096,
         use_device: Optional[bool] = None,
@@ -392,7 +394,6 @@ class MatchEngine:
             np.full((16, aut.kernel_levels), -4, np.int32),
             np.zeros(16, np.int32),
             np.zeros(16, bool),
-            probes=aut.probes,
             f_width=self.f_width,
             m_cap=self.m_cap,
         )
@@ -771,7 +772,6 @@ class MatchEngine:
             tokens,
             lengths,
             dollar,
-            probes=aut.probes,
             f_width=self.f_width,
             m_cap=self.m_cap,
         )

@@ -67,8 +67,8 @@ class ShardedIndex:
     """K automaton shards with common geometry, stacked for a mesh."""
 
     shards: List[Automaton]
-    tables: Tuple[np.ndarray, ...]  # (ht_rows [K,Hb,3*B], node_rows [K,N,4])
-    probes: int
+    # (fp_rows [K,Hb,2*B], node_rows [K,N,8], salts [K] uint32)
+    tables: Tuple[np.ndarray, ...]
     max_levels: int
     kernel_levels: int
 
@@ -106,28 +106,30 @@ def build_sharded_index(
     for i, item in enumerate(filters):
         parts[i % n_shards].append(item)
     shards = [build_automaton(p, tdict, max_levels) for p in parts]
-    nb = max(len(a.ht_rows) for a in shards)
-    if any(len(a.ht_rows) != nb for a in shards):
+    nb = max(len(a.fp_rows) for a in shards)
+    if any(len(a.fp_rows) != nb for a in shards):
         shards = [
             build_automaton(p, tdict, max_levels, hash_buckets=nb)
             for p in parts
         ]
-    probes = max(a.probes for a in shards)
     n_nodes = max(a.n_nodes for a in shards)
 
     def pad_nodes(a: np.ndarray) -> np.ndarray:
-        # padded node rows are never terminal and have no '+' child
-        out = np.zeros((n_nodes, 4), np.int32)
+        # padded node rows are never terminal, have no '+' child, and
+        # no incoming edge (verification-dead)
+        out = np.zeros((n_nodes, 8), np.int32)
         out[:, 0] = SENTINEL
+        out[:, 4] = -1
+        out[:, 5] = -1
         out[: len(a)] = a
         return out
 
-    ht = np.stack([a.ht_rows for a in shards])
+    ht = np.stack([a.fp_rows for a in shards])
     nrows = np.stack([pad_nodes(a.node_rows) for a in shards])
+    salts = np.array([a.salt for a in shards], np.uint32)
     return ShardedIndex(
         shards=shards,
-        tables=(ht, nrows),
-        probes=probes,
+        tables=(ht, nrows, salts),
         max_levels=max_levels,
         kernel_levels=max(a.kernel_levels for a in shards),
     )
@@ -135,17 +137,17 @@ def build_sharded_index(
 
 @partial(
     jax.jit,
-    static_argnames=("mesh", "probes", "f_width", "m_cap"),
+    static_argnames=("mesh", "f_width", "m_cap"),
 )
 def sharded_match(
     mesh: Mesh,
-    ht_rows,
+    fp_rows,
     node_rows,
+    salts,
     tokens,
     lengths,
     dollar,
     *,
-    probes: int,
     f_width: int,
     m_cap: int,
 ):
@@ -157,21 +159,21 @@ def sharded_match(
     shards (the collective that proves ICI layout).
     """
 
-    def local(ht, nr, tok, ln, dl):
+    def local(ht, nr, salt, tok, ln, dl):
         codes, counts, ovf = match_batch(
             ht[0],
             nr[0],
+            salt[0],
             tok,
             ln,
             dl,
-            probes=probes,
             f_width=f_width,
             m_cap=m_cap,
         )
         total = jax.lax.psum(counts, "sub")
         return codes[None], counts[None], ovf[None], total
 
-    table_specs = tuple(P("sub") for _ in range(2))
+    table_specs = tuple(P("sub") for _ in range(3))
     fn = jax.shard_map(
         local,
         mesh=mesh,
@@ -187,7 +189,7 @@ def sharded_match(
         # mesh axis names into the single-chip kernel
         check_vma=False,
     )
-    return fn(ht_rows, node_rows, tokens, lengths, dollar)
+    return fn(fp_rows, node_rows, salts, tokens, lengths, dollar)
 
 
 class ShardedMatchEngine(MatchEngine):
@@ -307,7 +309,6 @@ class ShardedMatchEngine(MatchEngine):
             tokens,
             lengths,
             dollar,
-            probes=index.probes,
             f_width=self.f_width,
             m_cap=self.m_cap,
         )

@@ -186,7 +186,7 @@ def test_automaton_structure_small():
 def test_forced_hash_size_for_sharding():
     td = TokenDict()
     aut = build_automaton([(1, ("a", "b"))], td, hash_buckets=256)
-    assert len(aut.ht_rows) == 256
+    assert len(aut.fp_rows) == 256
 
 
 def test_reinsert_changed_filter_after_rebuild():
@@ -341,7 +341,6 @@ def test_delta_fold_residual_bound():
                 (
                     engine._daut.node_rows.shape,
                     engine._daut.kernel_levels,
-                    engine._daut.probes,
                 )
             )
     assert engine._daut is not None

@@ -64,10 +64,10 @@ def test_shard_geometry_uniform():
         if not any("#" == w for w in ws[:-1])
     ]
     idx = build_sharded_index(filters, TokenDict(), n_shards=4)
-    ht, node_rows = idx.tables
+    ht, node_rows, salts = idx.tables
     # all shards stacked with one shared geometry per table
     assert ht.shape[0] == node_rows.shape[0] == 4
-    assert all(a.ht_rows.shape == ht.shape[1:] for a in idx.shards)
+    assert all(a.fp_rows.shape == ht.shape[1:] for a in idx.shards)
     assert all(
         node_rows.shape[1] >= a.node_rows.shape[0] for a in idx.shards
     )
