@@ -205,6 +205,30 @@ class BrokerServer:
                     port=int(gw_cfg.get("port", 61613)),
                 )
             )
+        elif kind == "mqttsn":
+            from ..gateway.mqttsn import MqttSnGateway
+
+            await self.broker.gateways.load(
+                MqttSnGateway(
+                    self.broker,
+                    bind=gw_cfg.get("bind", "0.0.0.0"),
+                    port=int(gw_cfg.get("port", 1884)),
+                    predefined={
+                        int(k): v
+                        for k, v in gw_cfg.get("predefined", {}).items()
+                    },
+                )
+            )
+        elif kind == "coap":
+            from ..gateway.coap import CoapGateway
+
+            await self.broker.gateways.load(
+                CoapGateway(
+                    self.broker,
+                    bind=gw_cfg.get("bind", "0.0.0.0"),
+                    port=int(gw_cfg.get("port", 5683)),
+                )
+            )
         else:
             log.warning("unknown gateway type %r ignored", kind)
 
@@ -255,8 +279,8 @@ def main(argv: Optional[List[str]] = None) -> None:
     import argparse
 
     ap = argparse.ArgumentParser(description="emqx_tpu MQTT broker")
-    ap.add_argument("--port", type=int, default=1883)
-    ap.add_argument("--bind", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=None)
+    ap.add_argument("--bind", default=None)
     ap.add_argument("--config", help="JSON config file", default=None)
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
@@ -271,8 +295,12 @@ def main(argv: Optional[List[str]] = None) -> None:
         cfg = ConfigHandler.load(args.config).root
     else:
         cfg = BrokerConfig()
-    cfg.listeners[0].port = args.port
-    cfg.listeners[0].bind = args.bind
+    # CLI flags override the first listener only when given explicitly
+    # (default 1883 / 0.0.0.0 must not clobber a config file)
+    if args.port is not None:
+        cfg.listeners[0].port = args.port
+    if args.bind is not None:
+        cfg.listeners[0].bind = args.bind
     server = BrokerServer(cfg)
     try:
         asyncio.run(server.run_forever())

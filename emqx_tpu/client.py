@@ -216,25 +216,32 @@ class MqttClient:
 
     # ------------------------------------------------------------- api
 
+    async def _request(self, make_packet, timeout: float = 10.0) -> None:
+        """Allocate a packet id, send ``make_packet(pid)``, await the
+        matching ack — the one place the ack protocol lives."""
+        pid = next(self._pids) % 65535 or 1
+        fut = asyncio.get_running_loop().create_future()
+        self._acks[pid] = fut
+        self._writer.write(C.serialize(make_packet(pid), self.version))
+        await self._writer.drain()
+        await asyncio.wait_for(fut, timeout)
+
     async def subscribe(self, flt: str, qos: int = 0) -> None:
         self._subs[flt] = qos
         if self.connected.is_set() and self._writer is not None:
-            pid = next(self._pids) % 65535 or 1
-            fut = asyncio.get_running_loop().create_future()
-            self._acks[pid] = fut
-            self._writer.write(
-                C.serialize(
-                    C.Subscribe(
-                        packet_id=pid,
-                        subscriptions=[
-                            C.Subscription(topic_filter=flt, qos=qos)
-                        ],
-                    ),
-                    self.version,
+            await self._request(
+                lambda pid: C.Subscribe(
+                    packet_id=pid,
+                    subscriptions=[C.Subscription(topic_filter=flt, qos=qos)],
                 )
             )
-            await self._writer.drain()
-            await asyncio.wait_for(fut, 10)
+
+    async def unsubscribe(self, flt: str) -> None:
+        self._subs.pop(flt, None)
+        if self.connected.is_set() and self._writer is not None:
+            await self._request(
+                lambda pid: C.Unsubscribe(packet_id=pid, topic_filters=[flt])
+            )
 
     async def publish(
         self,
@@ -249,24 +256,19 @@ class MqttClient:
         the bridge's BufferWorker does exactly that)."""
         if not self.connected.is_set() or self._writer is None:
             raise ConnectionError("not connected")
-        pid = None
-        fut = None
         if qos > 0:
-            pid = next(self._pids) % 65535 or 1
-            fut = asyncio.get_running_loop().create_future()
-            self._acks[pid] = fut
+            await self._request(
+                lambda pid: C.Publish(
+                    topic=topic, payload=payload, qos=qos,
+                    retain=retain, packet_id=pid,
+                ),
+                timeout,
+            )
+            return
         self._writer.write(
             C.serialize(
-                C.Publish(
-                    topic=topic,
-                    payload=payload,
-                    qos=qos,
-                    retain=retain,
-                    packet_id=pid,
-                ),
+                C.Publish(topic=topic, payload=payload, qos=0, retain=retain),
                 self.version,
             )
         )
         await self._writer.drain()
-        if fut is not None:
-            await asyncio.wait_for(fut, timeout)

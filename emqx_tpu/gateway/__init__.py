@@ -13,6 +13,8 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import struct
+import time
 from typing import Dict, List, Optional, Tuple
 
 log = logging.getLogger("emqx_tpu.gateway")
@@ -61,6 +63,15 @@ class GatewayChannel:
             self.session = None
 
     # --------------------------------------------------- broker glue
+
+    def broker_publish(self, msg) -> None:
+        """Publish through the shared micro-batcher when one is running
+        (one device match step per window), else synchronously."""
+        batcher = self.broker.batcher
+        if batcher is not None:
+            batcher.publish_nowait(msg)
+        else:
+            self.broker.publish(msg)
 
     def open_session(self, clientid: str, clean_start: bool = True):
         """Register with the broker's connection manager; deliveries
@@ -175,6 +186,124 @@ class Gateway:
                 await writer.wait_closed()
             except (ConnectionError, asyncio.CancelledError):
                 pass
+
+
+class UdpGateway(Gateway):
+    """Datagram gateway base (the `emqx_gateway_conn` UDP side,
+    /root/reference/apps/emqx_gateway/src/emqx_gateway_conn.erl:120-141
+    esockd udp_proxy role): one socket, one channel per peer address,
+    idle peers expired after ``idle_timeout_s``.
+
+    Datagram protocols frame per-packet, so ``frame.parse`` is called
+    with exactly one datagram and must consume it whole."""
+
+    idle_timeout_s = 120.0
+    max_channels = 65536  # spoofed-source flood ceiling
+
+    def __init__(self, broker, bind: str = "0.0.0.0", port: int = 0) -> None:
+        super().__init__(broker, bind, port)
+        self._channels: Dict[Tuple[str, int], GatewayChannel] = {}
+        self._last_seen: Dict[Tuple[str, int], float] = {}
+        self._transport = None
+        self._reaper: Optional[asyncio.Task] = None
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        gateway = self
+
+        class _Proto(asyncio.DatagramProtocol):
+            def connection_made(self, transport):
+                gateway._transport = transport
+
+            def datagram_received(self, data, addr):
+                gateway._on_datagram(data, addr)
+
+        self._transport, _ = await loop.create_datagram_endpoint(
+            _Proto, local_addr=(self.bind, self.port)
+        )
+        self.port = self._transport.get_extra_info("sockname")[1]
+        self._reaper = asyncio.get_running_loop().create_task(
+            self._reap_idle()
+        )
+        log.info("udp gateway %s listening on %s:%d", self.name, self.bind,
+                 self.port)
+
+    async def stop(self) -> None:
+        if self._reaper is not None:
+            self._reaper.cancel()
+            try:
+                await self._reaper
+            except asyncio.CancelledError:
+                pass
+            self._reaper = None
+        for addr in list(self._channels):
+            self._drop_peer(addr, "server_stopped")
+        if self._transport is not None:
+            self._transport.close()
+            self._transport = None
+
+    def _on_datagram(self, data: bytes, addr) -> None:
+        # parse BEFORE allocating per-peer state: spoofed-source garbage
+        # must not grow the channel table
+        try:
+            frames, _ = self.frame.parse(self.frame.initial_state(), data)
+        except (ValueError, IndexError, struct.error) as exc:
+            log.debug("udp gateway %s frame error from %s: %s",
+                      self.name, addr, exc)
+            return
+        chan = self._channels.get(addr)
+        if chan is None:
+            if len(self._channels) >= self.max_channels:
+                log.debug("udp gateway %s at channel cap; dropping %s",
+                          self.name, addr)
+                return
+            peer = f"{addr[0]}:{addr[1]}"
+            gateway = self
+
+            def write(out: bytes, _addr=addr) -> None:
+                if gateway._transport is not None:
+                    gateway._transport.sendto(out, _addr)
+
+            def close(reason: str, _addr=addr) -> None:
+                gateway._drop_peer(_addr, reason)
+
+            chan = self.channel_class(self, write, close, peer)
+            self._channels[addr] = chan
+        self._last_seen[addr] = time.monotonic()
+        for frame in frames:
+            try:
+                chan.handle_frame(frame)
+            except (ValueError, IndexError, struct.error) as exc:
+                log.debug("udp gateway %s handler error from %s: %s",
+                          self.name, addr, exc)
+
+    def _drop_peer(self, addr, reason: str) -> None:
+        chan = self._channels.pop(addr, None)
+        self._last_seen.pop(addr, None)
+        if chan is not None:
+            chan.connection_lost(reason)
+
+    async def _reap_idle(self) -> None:
+        while True:
+            await asyncio.sleep(min(self.idle_timeout_s / 4, 30.0))
+            now = time.monotonic()
+            cutoff = now - self.idle_timeout_s
+            for addr, seen in list(self._last_seen.items()):
+                chan = self._channels.get(addr)
+                # a channel may extend its own lifetime (MQTT-SN
+                # sleeping clients announce a sleep duration)
+                deadline = getattr(chan, "idle_deadline", None)
+                try:
+                    if deadline is not None:
+                        if now > deadline:
+                            self._drop_peer(addr, "idle_timeout")
+                    elif seen < cutoff:
+                        self._drop_peer(addr, "idle_timeout")
+                except Exception:
+                    # one bad channel must not kill the shared reaper
+                    # (that would leak every future idle peer)
+                    log.exception("udp gateway %s: drop of %s failed",
+                                  self.name, addr)
 
 
 class GatewayRegistry:

@@ -1,0 +1,356 @@
+"""CoAP gateway (RFC 7252) over UDP — publish/subscribe bridge.
+
+Capability match for the reference's CoAP gateway
+(/root/reference/apps/emqx_gateway_coap/src/emqx_coap_frame.erl wire
+codec, emqx_coap_pubsub_handler.erl): connectionless mode where
+
+  * ``PUT``/``POST coap://host/ps/{topic}?qos=&retain=`` publishes,
+  * ``GET /ps/{topic}`` with ``Observe: 0`` subscribes (topic may hold
+    ``+``/``#`` wildcards), ``Observe: 1`` unsubscribes,
+  * matched broker deliveries flow back as ``2.05 Content``
+    notifications carrying the subscribe token and a growing Observe
+    sequence number,
+  * ``clientid``/``username``/``password`` ride Uri-Query (the
+    reference's connectionless auth shape).
+
+One channel per UDP peer; the channel opens a broker session lazily on
+the first request and reuses the shared micro-batcher for publishes."""
+
+from __future__ import annotations
+
+import logging
+import secrets
+import struct
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .. import topic as T
+from ..access import PUBLISH, SUBSCRIBE, ClientInfo
+from ..codec import mqtt as C
+from ..message import Message
+from ..broker.session import SubOpts
+from . import GatewayChannel, GatewayFrame, UdpGateway
+
+log = logging.getLogger("emqx_tpu.gateway.coap")
+
+# message types
+CON, NON, ACK, RST = 0, 1, 2, 3
+
+# method / response codes: class << 5 | detail
+GET, POST, PUT, DELETE = 0x01, 0x02, 0x03, 0x04
+CREATED = 0x41  # 2.01
+DELETED = 0x42  # 2.02
+VALID = 0x43  # 2.03
+CHANGED = 0x44  # 2.04
+CONTENT = 0x45  # 2.05
+BAD_REQUEST = 0x80  # 4.00
+UNAUTHORIZED = 0x81  # 4.01
+NOT_FOUND = 0x84  # 4.04
+
+# option numbers
+OPT_OBSERVE = 6
+OPT_URI_PATH = 11
+OPT_CONTENT_FORMAT = 12
+OPT_URI_QUERY = 15
+
+
+@dataclass
+class CoapMessage:
+    type: int = CON
+    code: int = GET
+    message_id: int = 0
+    token: bytes = b""
+    options: List[Tuple[int, bytes]] = field(default_factory=list)
+    payload: bytes = b""
+
+    def opt_all(self, num: int) -> List[bytes]:
+        return [v for n, v in self.options if n == num]
+
+    def opt(self, num: int) -> Optional[bytes]:
+        vals = self.opt_all(num)
+        return vals[0] if vals else None
+
+    @property
+    def uri_path(self) -> List[str]:
+        return [v.decode("utf-8", "replace") for v in
+                self.opt_all(OPT_URI_PATH)]
+
+    @property
+    def queries(self) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        for v in self.opt_all(OPT_URI_QUERY):
+            s = v.decode("utf-8", "replace")
+            k, _, val = s.partition("=")
+            out[k] = val
+        return out
+
+    @property
+    def observe(self) -> Optional[int]:
+        v = self.opt(OPT_OBSERVE)
+        if v is None:
+            return None
+        return int.from_bytes(v, "big") if v else 0
+
+
+def _encode_uint(n: int) -> bytes:
+    if n == 0:
+        return b""
+    out = n.to_bytes((n.bit_length() + 7) // 8, "big")
+    return out
+
+
+class CoapCodec(GatewayFrame):
+    """RFC 7252 §3 framing: one datagram = one message."""
+
+    def parse(self, state, data: bytes) -> Tuple[List[CoapMessage], object]:
+        if len(data) < 4:
+            raise ValueError("short CoAP datagram")
+        b0 = data[0]
+        if (b0 >> 6) != 1:
+            raise ValueError(f"bad CoAP version {b0 >> 6}")
+        mtype = (b0 >> 4) & 0x03
+        tkl = b0 & 0x0F
+        if tkl > 8:
+            raise ValueError("token too long")
+        code = data[1]
+        mid = struct.unpack_from(">H", data, 2)[0]
+        off = 4
+        token = data[off : off + tkl]
+        off += tkl
+        options: List[Tuple[int, bytes]] = []
+        num = 0
+        payload = b""
+        while off < len(data):
+            b = data[off]
+            off += 1
+            if b == 0xFF:
+                payload = data[off:]
+                break
+            delta, length = b >> 4, b & 0x0F
+            if delta == 13:
+                delta = 13 + data[off]; off += 1
+            elif delta == 14:
+                delta = 269 + struct.unpack_from(">H", data, off)[0]; off += 2
+            elif delta == 15:
+                raise ValueError("reserved option delta 15")
+            if length == 13:
+                length = 13 + data[off]; off += 1
+            elif length == 14:
+                length = 269 + struct.unpack_from(">H", data, off)[0]; off += 2
+            elif length == 15:
+                raise ValueError("reserved option length 15")
+            num += delta
+            options.append((num, data[off : off + length]))
+            off += length
+        return [CoapMessage(mtype, code, mid, token, options, payload)], state
+
+    def serialize(self, m: CoapMessage) -> bytes:
+        out = bytearray()
+        out.append(0x40 | (m.type << 4) | len(m.token))
+        out.append(m.code)
+        out += struct.pack(">H", m.message_id)
+        out += m.token
+        last = 0
+        for num, val in sorted(m.options, key=lambda o: o[0]):
+            delta = num - last
+            last = num
+            d_ext = l_ext = b""
+            if delta >= 269:
+                d_nib, d_ext = 14, struct.pack(">H", delta - 269)
+            elif delta >= 13:
+                d_nib, d_ext = 13, bytes([delta - 13])
+            else:
+                d_nib = delta
+            length = len(val)
+            if length >= 269:
+                l_nib, l_ext = 14, struct.pack(">H", length - 269)
+            elif length >= 13:
+                l_nib, l_ext = 13, bytes([length - 13])
+            else:
+                l_nib = length
+            out.append((d_nib << 4) | l_nib)
+            out += d_ext + l_ext + val
+        if m.payload:
+            out.append(0xFF)
+            out += m.payload
+        return bytes(out)
+
+
+class CoapChannel(GatewayChannel):
+    """Connectionless pub/sub handler (emqx_coap_pubsub_handler.erl)."""
+
+    def __init__(self, gateway, write, close, peer) -> None:
+        super().__init__(gateway, write, close, peer)
+        self.codec: CoapCodec = gateway.frame
+        self.client: Optional[ClientInfo] = None
+        self._next_mid = secrets.randbelow(0xFFFF)
+        # observe registrations: filter -> (token, next sequence number)
+        self._observers: Dict[str, Tuple[bytes, int]] = {}
+        # recent notification message id -> filter, so an RST cancels
+        # only the observation it responds to (RFC 7641 §3.6)
+        self._note_mids: Dict[int, str] = {}
+
+    def _alloc_mid(self) -> int:
+        self._next_mid = (self._next_mid + 1) % 0x10000
+        return self._next_mid
+
+    def _reply(self, req: CoapMessage, code: int,
+               options: Optional[List[Tuple[int, bytes]]] = None,
+               payload: bytes = b"") -> None:
+        # piggy-backed ACK for CON, NON reply for NON (RFC 7252 §5.2)
+        if req.type == CON:
+            rtype, mid = ACK, req.message_id
+        else:
+            rtype, mid = NON, self._alloc_mid()
+        self.write(self.codec.serialize(CoapMessage(
+            rtype, code, mid, req.token, options or [], payload)))
+
+    # --------------------------------------------------------- session
+
+    def _ensure_session(self, req: CoapMessage) -> bool:
+        if self.session is not None:
+            return True
+        q = req.queries
+        clientid = q.get("clientid") or "coap-" + secrets.token_hex(4)
+        client = ClientInfo(
+            clientid=clientid,
+            username=q.get("username"),
+            password=(q.get("password") or "").encode() or None,
+            peerhost=self.peer,
+        )
+        if self.broker.banned.is_banned(
+            clientid=clientid, username=client.username,
+            peerhost=self.peer.rsplit(":", 1)[0],
+        ):
+            return False
+        ok, client = self.broker.access.authenticate(client)
+        if not ok:
+            return False
+        client.password = None
+        self.client = client
+        self.open_session(clientid, clean_start=True)
+        return True
+
+    # ------------------------------------------------------ frame pump
+
+    def handle_frame(self, m: CoapMessage) -> None:
+        if m.type == RST:
+            # observe cancel via reset (RFC 7641 §3.6): only the
+            # observation whose notification was rejected — an RST is
+            # spoofable, so it must never be a kill-all
+            flt = self._note_mids.pop(m.message_id, None)
+            if flt is not None:
+                self._cancel_observe(flt)
+            return
+        if m.type == ACK or m.code == 0:  # ack / empty ping
+            if m.type == CON and m.code == 0:
+                self.write(self.codec.serialize(CoapMessage(
+                    RST, 0, m.message_id, b"")))
+            return
+        path = m.uri_path
+        if not path or path[0] != "ps":
+            self._reply(m, NOT_FOUND)
+            return
+        topic = "/".join(path[1:])
+        if not topic:
+            self._reply(m, BAD_REQUEST)
+            return
+        if not self._ensure_session(m):
+            self._reply(m, UNAUTHORIZED)
+            return
+        if m.code in (PUT, POST):
+            self._handle_publish(m, topic)
+        elif m.code == GET:
+            obs = m.observe
+            if obs == 0:
+                self._handle_subscribe(m, topic)
+            elif obs == 1:
+                self._handle_unsubscribe(m, topic)
+            else:
+                self._reply(m, BAD_REQUEST)
+        elif m.code == DELETE:
+            self._handle_unsubscribe(m, topic)
+        else:
+            self._reply(m, BAD_REQUEST)
+
+    def _handle_publish(self, m: CoapMessage, topic: str) -> None:
+        if not self.broker.access.authorize(self.client, PUBLISH, topic):
+            self._reply(m, UNAUTHORIZED)
+            return
+        q = m.queries
+        try:
+            qos = min(max(int(q.get("qos", "0")), 0), 2)
+        except ValueError:
+            qos = 0
+        msg = Message(
+            topic=topic, payload=m.payload, qos=qos,
+            retain=q.get("retain") in ("true", "1"),
+            from_client=self.clientid,
+            from_username=self.client.username if self.client else None,
+        )
+        self.broker_publish(msg)
+        self._reply(m, CHANGED)
+
+    def _handle_subscribe(self, m: CoapMessage, flt: str) -> None:
+        if not self.broker.access.authorize(self.client, SUBSCRIBE, flt):
+            self._reply(m, UNAUTHORIZED)
+            return
+        q = m.queries
+        try:
+            qos = min(max(int(q.get("qos", "0")), 0), 2)
+        except ValueError:
+            qos = 0
+        opts = SubOpts(qos=qos)
+        is_new = self.session.subscribe(flt, opts)
+        self.broker.subscribe(self.clientid, flt, opts, is_new_sub=is_new)
+        self._observers[flt] = (m.token, 1)
+        self._reply(m, CONTENT, options=[(OPT_OBSERVE, b"")])
+
+    def _handle_unsubscribe(self, m: CoapMessage, flt: str) -> None:
+        self._cancel_observe(flt)
+        self._reply(m, DELETED)
+
+    def _cancel_observe(self, flt: str) -> None:
+        if flt in self._observers:
+            del self._observers[flt]
+            if self.session is not None:
+                self.session.unsubscribe(flt)
+                self.broker.unsubscribe(self.clientid, flt)
+
+    # ----------------------------------------------------- deliveries
+
+    def deliver(self, packets) -> None:
+        for pkt in packets:
+            if pkt.type != C.PUBLISH:
+                continue
+            # every matching observe relation gets the notification
+            # (overlapping filters behave like overlapping MQTT subs:
+            # duplicates are possible, starvation is not)
+            for flt, (token, seq) in list(self._observers.items()):
+                if not T.match(pkt.topic, flt):
+                    continue
+                self._observers[flt] = (token, seq + 1)
+                mid = self._alloc_mid()
+                if len(self._note_mids) >= 512:
+                    self._note_mids.clear()
+                self._note_mids[mid] = flt
+                note = CoapMessage(
+                    NON, CONTENT, mid, token,
+                    [(OPT_OBSERVE, _encode_uint(seq)),
+                     (OPT_URI_PATH, b"ps")],
+                    pkt.payload,
+                )
+                self.write(self.codec.serialize(note))
+            # QoS1+ deliveries settle immediately: CoAP NON has no
+            # application ack (the reference treats notifications the
+            # same way in connectionless mode)
+            if pkt.packet_id and self.session is not None:
+                _ok, follow = self.session.puback(pkt.packet_id)
+                if follow:
+                    self.deliver(follow)
+
+
+class CoapGateway(UdpGateway):
+    name = "coap"
+    frame_class = CoapCodec
+    channel_class = CoapChannel

@@ -1,0 +1,623 @@
+"""MQTT-SN (v1.2) gateway over UDP.
+
+Capability match for the reference's MQTT-SN gateway
+(/root/reference/apps/emqx_gateway_mqttsn/src/emqx_mqttsn_frame.erl
+wire codec, emqx_mqttsn_channel.erl session bridge): topic-id
+registration both directions, QoS 0/1/2 publish, QoS -1
+publish-without-connection on predefined/short topics, wildcard
+subscribe, sleeping clients (DISCONNECT with duration buffers
+deliveries until PINGREQ wake), SEARCHGW/GWINFO discovery.
+
+The channel adapts datagrams onto the same broker core the MQTT
+listeners use: publishes ride the shared micro-batcher, deliveries
+arrive as MQTT Publish packets from the session and are re-framed as
+SN PUBLISH (with an on-demand REGISTER round-trip when the client
+doesn't know the topic id yet)."""
+
+from __future__ import annotations
+
+import logging
+import secrets
+import struct
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..access import PUBLISH, SUBSCRIBE, ClientInfo
+from ..codec import mqtt as C
+from ..message import Message
+from ..broker.session import SubOpts
+from . import GatewayChannel, GatewayFrame, UdpGateway
+
+log = logging.getLogger("emqx_tpu.gateway.mqttsn")
+
+# message types (MQTT-SN spec v1.2 §5.2.1)
+ADVERTISE = 0x00
+SEARCHGW = 0x01
+GWINFO = 0x02
+CONNECT = 0x04
+CONNACK = 0x05
+WILLTOPICREQ = 0x06
+WILLTOPIC = 0x07
+WILLMSGREQ = 0x08
+WILLMSG = 0x09
+REGISTER = 0x0A
+REGACK = 0x0B
+PUBLISH = 0x0C
+PUBACK = 0x0D
+PUBCOMP = 0x0E
+PUBREC = 0x0F
+PUBREL = 0x10
+SUBSCRIBE_SN = 0x12
+SUBACK = 0x13
+UNSUBSCRIBE = 0x14
+UNSUBACK = 0x15
+PINGREQ = 0x16
+PINGRESP = 0x17
+DISCONNECT = 0x18
+
+# flag bits (§5.3.4)
+FLAG_DUP = 0x80
+FLAG_QOS = 0x60
+FLAG_RETAIN = 0x10
+FLAG_WILL = 0x08
+FLAG_CLEAN = 0x04
+FLAG_TOPIC_TYPE = 0x03
+
+TOPIC_NORMAL = 0x00  # registered topic id
+TOPIC_PREDEF = 0x01
+TOPIC_SHORT = 0x02  # 2-char topic name carried in the id field
+
+RC_ACCEPTED = 0x00
+RC_CONGESTION = 0x01
+RC_INVALID_TOPIC = 0x02
+RC_NOT_SUPPORTED = 0x03
+
+GATEWAY_ID = 1
+
+
+def _qos_bits(flags: int) -> int:
+    """QoS field: 0b11 encodes QoS -1 (publish without connection)."""
+    q = (flags & FLAG_QOS) >> 5
+    return -1 if q == 3 else q
+
+
+class SnFrame:
+    __slots__ = ("msg_type", "fields")
+
+    def __init__(self, msg_type: int, **fields) -> None:
+        self.msg_type = msg_type
+        self.fields = fields
+
+    def __getattr__(self, name):
+        try:
+            return self.fields[name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"SnFrame({self.msg_type:#04x}, {self.fields})"
+
+
+class SnCodec(GatewayFrame):
+    """One datagram = one frame (§5.2: length, msgtype, variable part)."""
+
+    def parse(self, state, data: bytes) -> Tuple[List[SnFrame], object]:
+        if len(data) < 2:
+            raise ValueError("short datagram")
+        if data[0] == 0x01:
+            if len(data) < 4:
+                raise ValueError("short extended-length datagram")
+            length = struct.unpack_from(">H", data, 1)[0]
+            off = 3
+        else:
+            length = data[0]
+            off = 1
+        if length != len(data):
+            raise ValueError(f"length mismatch: {length} != {len(data)}")
+        t = data[off]
+        body = data[off + 1 :]
+        return [self._parse_body(t, body)], state
+
+    def _parse_body(self, t: int, b: bytes) -> SnFrame:
+        if t == SEARCHGW:
+            return SnFrame(t, radius=b[0] if b else 0)
+        if t == GWINFO:
+            return SnFrame(t, gw_id=b[0] if b else 0)
+        if t == ADVERTISE:
+            return SnFrame(t, gw_id=b[0],
+                           duration=struct.unpack_from(">H", b, 1)[0])
+        if t == CONNACK:
+            return SnFrame(t, rc=b[0] if b else 0)
+        if t in (WILLTOPICREQ, WILLMSGREQ, PINGRESP):
+            return SnFrame(t)
+        if t == SUBACK:
+            flags = b[0]
+            tid, mid = struct.unpack_from(">HH", b, 1)
+            return SnFrame(t, flags=flags, topic_id=tid, msg_id=mid,
+                           rc=b[5])
+        if t == UNSUBACK:
+            return SnFrame(t, msg_id=struct.unpack_from(">H", b, 0)[0])
+        if t == CONNECT:
+            if len(b) < 4:
+                raise ValueError("short CONNECT")
+            flags, proto_id = b[0], b[1]
+            duration = struct.unpack_from(">H", b, 2)[0]
+            return SnFrame(
+                t, flags=flags, protocol_id=proto_id, duration=duration,
+                client_id=b[4:].decode("utf-8", "replace"),
+            )
+        if t in (WILLTOPIC, WILLMSG):
+            if t == WILLTOPIC:
+                if not b:  # empty WILLTOPIC clears the will
+                    return SnFrame(t, flags=0, topic="")
+                return SnFrame(t, flags=b[0],
+                               topic=b[1:].decode("utf-8", "replace"))
+            return SnFrame(t, data=b)
+        if t == REGISTER:
+            tid, mid = struct.unpack_from(">HH", b, 0)
+            return SnFrame(t, topic_id=tid, msg_id=mid,
+                           topic=b[4:].decode("utf-8", "replace"))
+        if t == REGACK:
+            tid, mid = struct.unpack_from(">HH", b, 0)
+            return SnFrame(t, topic_id=tid, msg_id=mid, rc=b[4])
+        if t == PUBLISH:
+            flags = b[0]
+            tid, mid = struct.unpack_from(">HH", b, 1)
+            return SnFrame(t, flags=flags, topic_id=tid, msg_id=mid,
+                           data=b[5:])
+        if t == PUBACK:
+            tid, mid = struct.unpack_from(">HH", b, 0)
+            return SnFrame(t, topic_id=tid, msg_id=mid, rc=b[4])
+        if t in (PUBREC, PUBREL, PUBCOMP):
+            return SnFrame(t, msg_id=struct.unpack_from(">H", b, 0)[0])
+        if t in (SUBSCRIBE_SN, UNSUBSCRIBE):
+            flags = b[0]
+            mid = struct.unpack_from(">H", b, 1)[0]
+            tt = flags & FLAG_TOPIC_TYPE
+            rest = b[3:]
+            if tt == TOPIC_NORMAL:  # topic NAME (possibly wildcard)
+                return SnFrame(t, flags=flags, msg_id=mid,
+                               topic=rest.decode("utf-8", "replace"))
+            if tt == TOPIC_SHORT:
+                return SnFrame(t, flags=flags, msg_id=mid,
+                               topic=rest[:2].decode("utf-8", "replace"))
+            return SnFrame(t, flags=flags, msg_id=mid,
+                           topic_id=struct.unpack_from(">H", rest, 0)[0])
+        if t == PINGREQ:
+            return SnFrame(t, client_id=b.decode("utf-8", "replace"))
+        if t == DISCONNECT:
+            duration = struct.unpack_from(">H", b, 0)[0] if len(b) >= 2 else None
+            return SnFrame(t, duration=duration)
+        return SnFrame(t, raw=b)
+
+    def serialize(self, frame: SnFrame) -> bytes:
+        t = frame.msg_type
+        f = frame.fields
+        if t == GWINFO:
+            body = bytes([f["gw_id"]])
+        elif t == ADVERTISE:
+            body = bytes([f["gw_id"]]) + struct.pack(">H", f["duration"])
+        elif t == SEARCHGW:
+            body = bytes([f.get("radius", 0)])
+        elif t == CONNECT:
+            body = (bytes([f["flags"], f.get("protocol_id", 1)])
+                    + struct.pack(">H", f["duration"])
+                    + f["client_id"].encode())
+        elif t == WILLTOPIC:
+            topic = f.get("topic", "")
+            body = (bytes([f.get("flags", 0)]) + topic.encode()
+                    if topic else b"")
+        elif t == WILLMSG:
+            body = f["data"]
+        elif t in (SUBSCRIBE_SN, UNSUBSCRIBE):
+            flags = f.get("flags", 0)
+            body = bytes([flags]) + struct.pack(">H", f["msg_id"])
+            if "topic" in f:
+                body += f["topic"].encode()
+            else:
+                body += struct.pack(">H", f["topic_id"])
+        elif t == PINGREQ:
+            body = f.get("client_id", "").encode()
+        elif t == CONNACK:
+            body = bytes([f["rc"]])
+        elif t in (WILLTOPICREQ, WILLMSGREQ):
+            body = b""
+        elif t == REGISTER:
+            body = (struct.pack(">HH", f["topic_id"], f["msg_id"])
+                    + f["topic"].encode())
+        elif t == REGACK:
+            body = struct.pack(">HH", f["topic_id"], f["msg_id"]) + bytes(
+                [f["rc"]])
+        elif t == PUBLISH:
+            body = (bytes([f["flags"]])
+                    + struct.pack(">HH", f["topic_id"], f["msg_id"])
+                    + f["data"])
+        elif t == PUBACK:
+            body = struct.pack(">HH", f["topic_id"], f["msg_id"]) + bytes(
+                [f["rc"]])
+        elif t in (PUBREC, PUBREL, PUBCOMP):
+            body = struct.pack(">H", f["msg_id"])
+        elif t == SUBACK:
+            body = (bytes([f.get("flags", 0)])
+                    + struct.pack(">HH", f["topic_id"], f["msg_id"])
+                    + bytes([f["rc"]]))
+        elif t == UNSUBACK:
+            body = struct.pack(">H", f["msg_id"])
+        elif t == PINGRESP:
+            body = b""
+        elif t == DISCONNECT:
+            d = f.get("duration")
+            body = b"" if d is None else struct.pack(">H", d)
+        else:
+            body = f.get("raw", b"")
+        total = len(body) + 2
+        if total + 0 < 256:
+            return bytes([total, t]) + body
+        return b"\x01" + struct.pack(">H", total + 2) + bytes([t]) + body
+
+
+class SnChannel(GatewayChannel):
+    """Per-peer MQTT-SN state machine (emqx_mqttsn_channel.erl parity:
+    register/publish/subscribe flows, sleeping state, will setup)."""
+
+    def __init__(self, gateway, write, close, peer) -> None:
+        super().__init__(gateway, write, close, peer)
+        self.codec: SnCodec = gateway.frame
+        self.client: Optional[ClientInfo] = None
+        self.connected = False
+        self.asleep = False
+        # topic registry, both directions (client REGISTER + ours)
+        self._id_by_topic: Dict[str, int] = {}
+        self._topic_by_id: Dict[int, str] = {}
+        self._next_tid = 1
+        self._next_mid = 1
+        # deliveries parked on an outstanding REGISTER msg_id
+        self._awaiting_reg: Dict[int, Tuple[int, List[C.Packet]]] = {}
+        self._asleep_buffer: List[C.Packet] = []
+        self._awaiting_rel: Dict[int, Message] = {}  # inbound QoS2
+        self._pending_connect: Optional[SnFrame] = None
+        self._will_topic: Optional[str] = None
+        self._will_flags = 0
+        self.will_msg: Optional[Message] = None
+        # set while sleeping: the UDP reaper honors this instead of the
+        # default idle timeout (§6.14 sleep duration)
+        self.idle_deadline: Optional[float] = None
+
+    # ------------------------------------------------------------ util
+
+    def _send(self, frame: SnFrame) -> None:
+        self.write(self.codec.serialize(frame))
+
+    def _alloc_mid(self) -> int:
+        mid = self._next_mid
+        self._next_mid = mid % 0xFFFF + 1
+        return mid
+
+    def _register_topic(self, topic: str) -> int:
+        tid = self._id_by_topic.get(topic)
+        if tid is None:
+            tid = self._next_tid
+            self._next_tid += 1
+            self._id_by_topic[topic] = tid
+            self._topic_by_id[tid] = topic
+        return tid
+
+    def _resolve(self, topic_type: int, topic_id: int) -> Optional[str]:
+        if topic_type == TOPIC_NORMAL:
+            return self._topic_by_id.get(topic_id)
+        if topic_type == TOPIC_PREDEF:
+            return self.gateway.predefined.get(topic_id)
+        if topic_type == TOPIC_SHORT:
+            raw = struct.pack(">H", topic_id)
+            try:
+                return raw.decode("utf-8")
+            except UnicodeDecodeError:
+                return None
+        return None
+
+    # ------------------------------------------------------ frame pump
+
+    def handle_frame(self, frame: SnFrame) -> None:
+        t = frame.msg_type
+        if t == SEARCHGW:
+            self._send(SnFrame(GWINFO, gw_id=GATEWAY_ID))
+            return
+        if t == CONNECT:
+            self._handle_connect(frame)
+            return
+        if t == WILLTOPIC:
+            self._will_topic = frame.topic or None
+            self._will_flags = frame.flags
+            if self._will_topic:
+                self._send(SnFrame(WILLMSGREQ))
+            else:
+                self._finish_connect()
+            return
+        if t == WILLMSG:
+            self._finish_connect(will_msg=frame.data)
+            return
+        if t == PUBLISH and _qos_bits(frame.flags) == -1:
+            # QoS -1: fire-and-forget without a session (§6.8)
+            self._publish_qos_neg1(frame)
+            return
+        if not self.connected:
+            return
+        if t == REGISTER:
+            tid = self._register_topic(frame.topic)
+            self._send(SnFrame(REGACK, topic_id=tid, msg_id=frame.msg_id,
+                               rc=RC_ACCEPTED))
+        elif t == REGACK:
+            self._handle_regack(frame)
+        elif t == PUBLISH:
+            self._handle_publish(frame)
+        elif t == PUBACK:
+            if self.session is not None:
+                _ok, follow = self.session.puback(frame.msg_id)
+                if follow:
+                    self.deliver(follow)
+        elif t == PUBREC:
+            if self.session is not None:
+                self.session.pubrec(frame.msg_id)
+            self._send(SnFrame(PUBREL, msg_id=frame.msg_id))
+        elif t == PUBCOMP:
+            if self.session is not None:
+                _ok, follow = self.session.pubcomp(frame.msg_id)
+                if follow:
+                    self.deliver(follow)
+        elif t == PUBREL:
+            msg = self._awaiting_rel.pop(frame.msg_id, None)
+            if msg is not None:
+                self.broker_publish(msg)
+            self._send(SnFrame(PUBCOMP, msg_id=frame.msg_id))
+        elif t == SUBSCRIBE_SN:
+            self._handle_subscribe(frame)
+        elif t == UNSUBSCRIBE:
+            self._handle_unsubscribe(frame)
+        elif t == PINGREQ:
+            if self.asleep and frame.client_id:
+                self._wake()
+            self._send(SnFrame(PINGRESP))
+        elif t == DISCONNECT:
+            self._handle_disconnect(frame)
+
+    # ------------------------------------------------------- lifecycle
+
+    def _handle_connect(self, frame: SnFrame) -> None:
+        self._pending_connect = frame
+        # a fresh CONNECT must not inherit a previous session's will
+        # (MQTT-SN §6.3: the Will flag alone governs will setup)
+        self._will_topic = None
+        self._will_flags = 0
+        self.will_msg = None
+        if frame.flags & FLAG_WILL:
+            self._send(SnFrame(WILLTOPICREQ))
+        else:
+            self._finish_connect()
+
+    def _finish_connect(self, will_msg: bytes = b"") -> None:
+        frame = self._pending_connect
+        if frame is None:
+            return
+        clientid = frame.client_id or "sn-" + secrets.token_hex(4)
+        client = ClientInfo(clientid=clientid, peerhost=self.peer)
+        if self.broker.banned.is_banned(
+            clientid=clientid, peerhost=self.peer.rsplit(":", 1)[0]
+        ):
+            self._reject_connect()
+            return
+        ok, client = self.broker.access.authenticate(client)
+        if not ok:
+            self._reject_connect()
+            return
+        self.client = client
+        clean = bool(frame.flags & FLAG_CLEAN)
+        self.open_session(clientid, clean_start=clean)
+        if self._will_topic:
+            qos = (self._will_flags & FLAG_QOS) >> 5
+            self.will_msg = Message(
+                topic=self._will_topic, payload=will_msg,
+                qos=min(qos, 2),
+                retain=bool(self._will_flags & FLAG_RETAIN),
+                from_client=clientid,
+            )
+        self.connected = True
+        self.asleep = False
+        self._pending_connect = None
+        self._send(SnFrame(CONNACK, rc=RC_ACCEPTED))
+
+    def _reject_connect(self) -> None:
+        """Clear the half-open CONNECT state so a stray WILLMSG cannot
+        re-enter _finish_connect and bypass the ban/auth verdict."""
+        self._pending_connect = None
+        self._will_topic = None
+        self._will_flags = 0
+        self._send(SnFrame(CONNACK, rc=RC_NOT_SUPPORTED))
+
+    def _handle_disconnect(self, frame: SnFrame) -> None:
+        if frame.duration and self.session is not None:
+            # sleeping client (§6.14): session stays; buffer deliveries
+            # until PINGREQ wake or the announced duration lapses
+            self.asleep = True
+            self.idle_deadline = time.monotonic() + frame.duration * 1.5
+            self._send(SnFrame(DISCONNECT))
+            return
+        self._send(SnFrame(DISCONNECT))
+        self.connected = False
+        self.will_msg = None  # graceful disconnect cancels the will
+        self.close("client_disconnect")
+
+    def _wake(self) -> None:
+        self.asleep = False
+        self.idle_deadline = None
+        buffered, self._asleep_buffer = self._asleep_buffer, []
+        if buffered:
+            self.deliver(buffered)
+
+    def connection_lost(self, reason: str) -> None:
+        if (self.connected and self.will_msg is not None
+                and reason not in ("client_disconnect", "takeover")):
+            will, self.will_msg = self.will_msg, None
+            self.broker.publish(will)
+        super().connection_lost(reason)
+
+    # -------------------------------------------------------- publish
+
+    def _publish_qos_neg1(self, frame: SnFrame) -> None:
+        tt = frame.flags & FLAG_TOPIC_TYPE
+        if tt == TOPIC_NORMAL:
+            return  # normal ids need a connection to be registered
+        topic = self._resolve(tt, frame.topic_id)
+        if topic is None:
+            return
+        # connectionless != unpoliced: the anonymous publisher still
+        # goes through ban, authentication, and ACL like every other
+        # publish path
+        host = self.peer.rsplit(":", 1)[0]
+        if self.broker.banned.is_banned(clientid="sn-anonymous",
+                                        peerhost=host):
+            return
+        client = self.client
+        if client is None:
+            ok, client = self.broker.access.authenticate(
+                ClientInfo(clientid="sn-anonymous", peerhost=self.peer)
+            )
+            if not ok:
+                return
+        if not self.broker.access.authorize(client, PUBLISH, topic):
+            return
+        self.broker_publish(Message(
+            topic=topic, payload=frame.data, qos=0,
+            retain=bool(frame.flags & FLAG_RETAIN),
+            from_client="sn-anonymous",
+        ))
+
+    def _handle_publish(self, frame: SnFrame) -> None:
+        tt = frame.flags & FLAG_TOPIC_TYPE
+        topic = self._resolve(tt, frame.topic_id)
+        qos = max(_qos_bits(frame.flags), 0)
+        if topic is None:
+            if qos >= 1:
+                self._send(SnFrame(PUBACK, topic_id=frame.topic_id,
+                                   msg_id=frame.msg_id,
+                                   rc=RC_INVALID_TOPIC))
+            return
+        if not self.broker.access.authorize(self.client, PUBLISH, topic):
+            if qos >= 1:
+                self._send(SnFrame(PUBACK, topic_id=frame.topic_id,
+                                   msg_id=frame.msg_id,
+                                   rc=RC_NOT_SUPPORTED))
+            return
+        msg = Message(
+            topic=topic, payload=frame.data, qos=min(qos, 2),
+            retain=bool(frame.flags & FLAG_RETAIN),
+            from_client=self.clientid,
+            from_username=self.client.username if self.client else None,
+        )
+        if qos == 2:
+            self._awaiting_rel[frame.msg_id] = msg
+            self._send(SnFrame(PUBREC, msg_id=frame.msg_id))
+            return
+        self.broker_publish(msg)
+        if qos == 1:
+            self._send(SnFrame(PUBACK, topic_id=frame.topic_id,
+                               msg_id=frame.msg_id, rc=RC_ACCEPTED))
+
+    # ------------------------------------------------------ subscribe
+
+    def _handle_subscribe(self, frame: SnFrame) -> None:
+        qos = max(_qos_bits(frame.flags), 0)
+        tt = frame.flags & FLAG_TOPIC_TYPE
+        if "topic" in frame.fields:
+            flt = frame.topic
+        else:
+            flt = self._resolve(tt, frame.topic_id)
+        if not flt:
+            self._send(SnFrame(SUBACK, topic_id=0, msg_id=frame.msg_id,
+                               rc=RC_INVALID_TOPIC))
+            return
+        if not self.broker.access.authorize(self.client, SUBSCRIBE, flt):
+            self._send(SnFrame(SUBACK, topic_id=0, msg_id=frame.msg_id,
+                               rc=RC_NOT_SUPPORTED))
+            return
+        opts = SubOpts(qos=min(qos, 2))
+        is_new = self.session.subscribe(flt, opts)
+        self.broker.subscribe(self.clientid, flt, opts, is_new_sub=is_new)
+        # a concrete topic gets an id the client can PUBLISH to;
+        # wildcard filters get 0 (ids arrive via REGISTER on delivery)
+        tid = 0
+        if "+" not in flt and "#" not in flt:
+            tid = self._register_topic(flt)
+        self._send(SnFrame(SUBACK,
+                           flags=(min(qos, 2) << 5), topic_id=tid,
+                           msg_id=frame.msg_id, rc=RC_ACCEPTED))
+
+    def _handle_unsubscribe(self, frame: SnFrame) -> None:
+        tt = frame.flags & FLAG_TOPIC_TYPE
+        flt = frame.fields.get("topic") or self._resolve(
+            tt, frame.fields.get("topic_id", 0))
+        if flt and self.session is not None:
+            self.session.unsubscribe(flt)
+            self.broker.unsubscribe(self.clientid, flt)
+        self._send(SnFrame(UNSUBACK, msg_id=frame.msg_id))
+
+    # ----------------------------------------------------- deliveries
+
+    def _handle_regack(self, frame: SnFrame) -> None:
+        parked = self._awaiting_reg.pop(frame.msg_id, None)
+        if parked is None:
+            return
+        tid, packets = parked
+        if frame.rc == RC_ACCEPTED:
+            self.deliver(packets)
+        # rejected: drop — client refused the topic registration
+
+    def deliver(self, packets) -> None:
+        if self.asleep:
+            # PUBREL must survive sleep too, or an in-flight outbound
+            # QoS 2 handshake never completes after wake
+            self._asleep_buffer.extend(
+                p for p in packets if p.type in (C.PUBLISH, C.PUBREL))
+            return
+        for pkt in packets:
+            if pkt.type == C.PUBREL:
+                self._send(SnFrame(PUBREL, msg_id=pkt.packet_id))
+                continue
+            if pkt.type != C.PUBLISH:
+                continue
+            topic = pkt.topic
+            tt = TOPIC_NORMAL
+            enc = topic.encode()
+            if len(enc) == 2 and "+" not in topic and "#" not in topic:
+                tt = TOPIC_SHORT
+                tid = struct.unpack(">H", enc)[0]
+            else:
+                tid = self._id_by_topic.get(topic)
+                if tid is None:
+                    # client doesn't know this topic: REGISTER first,
+                    # park the delivery until REGACK (§6.10)
+                    tid = self._register_topic(topic)
+                    mid = self._alloc_mid()
+                    self._awaiting_reg[mid] = (tid, [pkt])
+                    self._send(SnFrame(REGISTER, topic_id=tid, msg_id=mid,
+                                       topic=topic))
+                    continue
+            flags = (min(pkt.qos, 2) << 5) | tt
+            if pkt.retain:
+                flags |= FLAG_RETAIN
+            if getattr(pkt, "dup", False):
+                flags |= FLAG_DUP
+            self._send(SnFrame(
+                PUBLISH, flags=flags, topic_id=tid,
+                msg_id=pkt.packet_id or 0, data=pkt.payload))
+
+
+class MqttSnGateway(UdpGateway):
+    name = "mqttsn"
+    frame_class = SnCodec
+    channel_class = SnChannel
+
+    def __init__(self, broker, bind: str = "0.0.0.0", port: int = 0,
+                 predefined: Optional[Dict[int, str]] = None) -> None:
+        super().__init__(broker, bind, port)
+        # predefined topic ids (gateway.mqttsn.predefined config table)
+        self.predefined: Dict[int, str] = dict(predefined or {})

@@ -237,11 +237,7 @@ class StompChannel(GatewayChannel):
             from_client=self.clientid,
             from_username=self.client.username,
         )
-        batcher = self.broker.batcher
-        if batcher is not None:
-            batcher.publish_nowait(msg)
-        else:
-            self.broker.publish(msg)
+        self.broker_publish(msg)
         self._receipt(frame.headers)
 
     def _handle_subscribe(self, frame: StompFrame) -> None:

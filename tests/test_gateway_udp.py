@@ -1,0 +1,350 @@
+"""UDP gateways: MQTT-SN and CoAP clients interoperating with MQTT
+clients through the broker core (emqx_gateway_mqttsn /
+emqx_gateway_coap parity)."""
+
+import asyncio
+import struct
+
+from emqx_tpu.broker.listener import BrokerServer
+from emqx_tpu.config import BrokerConfig, ListenerConfig
+from emqx_tpu.gateway import coap as CO
+from emqx_tpu.gateway import mqttsn as SN
+from mqtt_client import TestClient
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class UdpTestClient:
+    """Raw datagram client with a frame queue."""
+
+    def __init__(self, port, codec):
+        self.port = port
+        self.codec = codec
+        self.frames: asyncio.Queue = asyncio.Queue()
+
+    async def start(self):
+        loop = asyncio.get_running_loop()
+        client = self
+
+        class _Proto(asyncio.DatagramProtocol):
+            def datagram_received(self, data, addr):
+                frames, _ = client.codec.parse(
+                    client.codec.initial_state(), data
+                )
+                for f in frames:
+                    client.frames.put_nowait(f)
+
+        self.transport, _ = await loop.create_datagram_endpoint(
+            _Proto, remote_addr=("127.0.0.1", self.port)
+        )
+        return self
+
+    def send(self, frame):
+        self.transport.sendto(self.codec.serialize(frame))
+
+    def send_raw(self, data: bytes):
+        self.transport.sendto(data)
+
+    async def expect(self, *types, timeout=3.0):
+        while True:
+            f = await asyncio.wait_for(self.frames.get(), timeout)
+            kind = getattr(f, "msg_type", None)
+            if kind is None:
+                kind = f.type  # CoapMessage: match on message type
+            if kind in types:
+                return f
+
+    def close(self):
+        self.transport.close()
+
+
+async def make_server(gateways):
+    cfg = BrokerConfig()
+    cfg.listeners = [ListenerConfig(port=0)]
+    cfg.gateways = gateways
+    srv = BrokerServer(cfg)
+    await srv.start()
+    return srv
+
+
+# ------------------------------------------------------------- MQTT-SN
+
+
+def sn_frame(t, **kw):
+    return SN.SnFrame(t, **kw)
+
+
+async def sn_connect(port, clientid, clean=True, will=None):
+    c = await UdpTestClient(port, SN.SnCodec()).start()
+    flags = SN.FLAG_CLEAN if clean else 0
+    if will is not None:
+        flags |= SN.FLAG_WILL
+    c.send(sn_frame(SN.CONNECT, flags=flags, protocol_id=1, duration=60,
+                    client_id=clientid))
+    if will is not None:
+        await c.expect(SN.WILLTOPICREQ)
+        c.send(sn_frame(SN.WILLTOPIC, flags=will.get("flags", 0),
+                        topic=will["topic"]))
+        await c.expect(SN.WILLMSGREQ)
+        c.send(sn_frame(SN.WILLMSG, data=will["msg"]))
+    ack = await c.expect(SN.CONNACK)
+    assert ack.rc == SN.RC_ACCEPTED
+    return c
+
+
+def test_mqttsn_pub_sub_roundtrip():
+    async def t():
+        srv = await make_server(
+            [{"type": "mqttsn", "bind": "127.0.0.1", "port": 0}]
+        )
+        sport = srv.broker.gateways.get("mqttsn").port
+        mport = srv.listeners[0].port
+
+        sn = await sn_connect(sport, "sn1")
+        # register a topic, publish QoS 1 to an MQTT subscriber
+        m = TestClient(mport, "m1")
+        await m.connect()
+        await m.subscribe("sensors/#")
+
+        sn.send(sn_frame(SN.REGISTER, topic_id=0, msg_id=1,
+                         topic="sensors/temp"))
+        rack = await sn.expect(SN.REGACK)
+        assert rack.rc == SN.RC_ACCEPTED
+        tid = rack.topic_id
+
+        sn.send(sn_frame(SN.PUBLISH, flags=(1 << 5), topic_id=tid,
+                         msg_id=2, data=b"21.5"))
+        pack = await sn.expect(SN.PUBACK)
+        assert pack.rc == SN.RC_ACCEPTED
+        pub = await m.recv_publish()
+        assert pub.topic == "sensors/temp" and pub.payload == b"21.5"
+
+        # wildcard subscribe: MQTT publish flows back, REGISTER first
+        sn.send(sn_frame(SN.SUBSCRIBE_SN, flags=0, msg_id=3,
+                         topic="alerts/#"))
+        sack = await sn.expect(SN.SUBACK)
+        assert sack.rc == SN.RC_ACCEPTED
+
+        await m.publish("alerts/fire", b"hot", qos=0)
+        reg = await sn.expect(SN.REGISTER)
+        assert reg.topic == "alerts/fire"
+        sn.send(sn_frame(SN.REGACK, topic_id=reg.topic_id,
+                         msg_id=reg.msg_id, rc=SN.RC_ACCEPTED))
+        spub = await sn.expect(SN.PUBLISH)
+        assert spub.topic_id == reg.topic_id and spub.data == b"hot"
+
+        sn.send(sn_frame(SN.PINGREQ, client_id=""))
+        await sn.expect(SN.PINGRESP)
+        sn.close()
+        await m.close()
+        await srv.stop()
+
+    run(t())
+
+
+def test_mqttsn_short_topic_and_qos_neg1():
+    async def t():
+        srv = await make_server(
+            [{"type": "mqttsn", "bind": "127.0.0.1", "port": 0,
+              "predefined": {7: "pre/defined"}}]
+        )
+        gw = srv.broker.gateways.get("mqttsn")
+        mport = srv.listeners[0].port
+        m = TestClient(mport, "m2")
+        await m.connect()
+        await m.subscribe("ab", "pre/defined")
+
+        sn = await sn_connect(gw.port, "sn2")
+        # short topic name "ab" rides the topic_id field
+        tid = struct.unpack(">H", b"ab")[0]
+        sn.send(sn_frame(SN.PUBLISH,
+                         flags=SN.TOPIC_SHORT, topic_id=tid,
+                         msg_id=0, data=b"s"))
+        pub = await m.recv_publish()
+        assert pub.topic == "ab" and pub.payload == b"s"
+
+        # QoS -1 publish without a connection, predefined topic
+        anon = await UdpTestClient(gw.port, SN.SnCodec()).start()
+        anon.send(sn_frame(SN.PUBLISH,
+                           flags=(3 << 5) | SN.TOPIC_PREDEF,
+                           topic_id=7, msg_id=0, data=b"fire"))
+        pub = await m.recv_publish()
+        assert pub.topic == "pre/defined" and pub.payload == b"fire"
+
+        anon.close()
+        sn.close()
+        await m.close()
+        await srv.stop()
+
+    run(t())
+
+
+def test_mqttsn_sleep_buffers_and_wakes():
+    async def t():
+        srv = await make_server(
+            [{"type": "mqttsn", "bind": "127.0.0.1", "port": 0}]
+        )
+        gw = srv.broker.gateways.get("mqttsn")
+        mport = srv.listeners[0].port
+
+        sn = await sn_connect(gw.port, "sn3")
+        sn.send(sn_frame(SN.SUBSCRIBE_SN, flags=0, msg_id=1,
+                         topic="news/today"))
+        sack = await sn.expect(SN.SUBACK)
+        tid = sack.topic_id
+        assert tid != 0  # concrete filter gets an id upfront
+
+        # go to sleep; publishes are buffered, not delivered
+        sn.send(sn_frame(SN.DISCONNECT, duration=60))
+        await sn.expect(SN.DISCONNECT)
+
+        m = TestClient(mport, "m3")
+        await m.connect()
+        await m.publish("news/today", b"zzz", qos=0)
+        await asyncio.sleep(0.2)
+        assert sn.frames.empty()
+
+        # PINGREQ with client id wakes and flushes
+        sn.send(sn_frame(SN.PINGREQ, client_id="sn3"))
+        pub = await sn.expect(SN.PUBLISH)
+        assert pub.topic_id == tid and pub.data == b"zzz"
+        await sn.expect(SN.PINGRESP)
+
+        sn.close()
+        await m.close()
+        await srv.stop()
+
+    run(t())
+
+
+def test_mqttsn_will_fires_on_drop():
+    async def t():
+        srv = await make_server(
+            [{"type": "mqttsn", "bind": "127.0.0.1", "port": 0}]
+        )
+        gw = srv.broker.gateways.get("mqttsn")
+        mport = srv.listeners[0].port
+        m = TestClient(mport, "m4")
+        await m.connect()
+        await m.subscribe("wills/#")
+
+        sn = await sn_connect(gw.port, "sn4",
+                              will={"topic": "wills/sn4", "msg": b"gone"})
+        # non-graceful loss (reaped as idle) publishes the will
+        addr = next(iter(gw._channels))
+        gw._drop_peer(addr, "idle_timeout")
+        pub = await m.recv_publish()
+        assert pub.topic == "wills/sn4" and pub.payload == b"gone"
+
+        sn.close()
+        await m.close()
+        await srv.stop()
+
+    run(t())
+
+
+def test_mqttsn_malformed_datagram_is_ignored():
+    async def t():
+        srv = await make_server(
+            [{"type": "mqttsn", "bind": "127.0.0.1", "port": 0}]
+        )
+        gw = srv.broker.gateways.get("mqttsn")
+        raw = await UdpTestClient(gw.port, SN.SnCodec()).start()
+        raw.send_raw(b"\x02\x0c")  # truncated PUBLISH body
+        raw.send_raw(b"\xff")  # nonsense
+        raw.send_raw(b"")  # empty
+        await asyncio.sleep(0.1)
+        # garbage must not register channels nor kill the gateway
+        assert not gw._channels
+        sn = await sn_connect(gw.port, "sn5")
+        sn.close()
+        await srv.stop()
+
+    run(t())
+
+
+# --------------------------------------------------------------- CoAP
+
+
+def coap_msg(code, path, *, mtype=CO.CON, mid=1, token=b"\x01",
+             queries=(), observe=None, payload=b""):
+    opts = [(CO.OPT_URI_PATH, seg.encode()) for seg in path.split("/")]
+    opts += [(CO.OPT_URI_QUERY, q.encode()) for q in queries]
+    if observe is not None:
+        opts.append((CO.OPT_OBSERVE,
+                     observe.to_bytes(1, "big") if observe else b""))
+    return CO.CoapMessage(mtype, code, mid, token, opts, payload)
+
+
+def test_coap_publish_subscribe():
+    async def t():
+        srv = await make_server(
+            [{"type": "coap", "bind": "127.0.0.1", "port": 0}]
+        )
+        gw = srv.broker.gateways.get("coap")
+        mport = srv.listeners[0].port
+        m = TestClient(mport, "cm1")
+        await m.connect()
+        await m.subscribe("co/up")
+
+        c = await UdpTestClient(gw.port, CO.CoapCodec()).start()
+        # PUT /ps/co/up publishes
+        c.send(coap_msg(CO.PUT, "ps/co/up", mid=7,
+                        queries=["clientid=coap1"], payload=b"hello"))
+        ack = await c.expect(CO.ACK)
+        assert ack.code == CO.CHANGED and ack.message_id == 7
+        pub = await m.recv_publish()
+        assert pub.topic == "co/up" and pub.payload == b"hello"
+
+        # GET /ps/co/+ observe=0 subscribes (wildcard filter)
+        c.send(coap_msg(CO.GET, "ps/co/+", mid=8, token=b"\x42",
+                        observe=0))
+        ack = await c.expect(CO.ACK)
+        assert ack.code == CO.CONTENT
+
+        await m.publish("co/down", b"notify", qos=0)
+        note = await c.expect(CO.NON)
+        assert note.code == CO.CONTENT
+        assert note.token == b"\x42"
+        assert note.payload == b"notify"
+        assert note.observe == 1
+
+        await m.publish("co/down", b"n2", qos=0)
+        note = await c.expect(CO.NON)
+        assert note.observe == 2  # sequence grows
+
+        # observe=1 cancels
+        c.send(coap_msg(CO.GET, "ps/co/+", mid=9, token=b"\x42",
+                        observe=1))
+        ack = await c.expect(CO.ACK)
+        assert ack.code == CO.DELETED
+        await m.publish("co/down", b"n3", qos=0)
+        await asyncio.sleep(0.2)
+        assert c.frames.empty()
+
+        c.close()
+        await m.close()
+        await srv.stop()
+
+    run(t())
+
+
+def test_coap_not_found_and_garbage():
+    async def t():
+        srv = await make_server(
+            [{"type": "coap", "bind": "127.0.0.1", "port": 0}]
+        )
+        gw = srv.broker.gateways.get("coap")
+        c = await UdpTestClient(gw.port, CO.CoapCodec()).start()
+        c.send_raw(b"\x40")  # short datagram
+        c.send_raw(b"\xd0\x02")  # bad version bits
+        c.send(coap_msg(CO.GET, "other/x", mid=3))
+        rsp = await c.expect(CO.ACK)
+        assert rsp.code == CO.NOT_FOUND
+        assert len(gw._channels) == 1  # garbage registered nothing
+        c.close()
+        await srv.stop()
+
+    run(t())
