@@ -44,6 +44,9 @@ class MqttClient:
         self.reconnect_max = reconnect_max
         self.version = version
         self.on_message: Optional[OnMessage] = None
+        # fired after every successful CONNACK + resubscribe (link
+        # agents push a full state resync here)
+        self.on_connect = None
         self.connected = asyncio.Event()
 
         self._subs: Dict[str, int] = {}  # filter -> qos (for resubscribe)
@@ -133,6 +136,11 @@ class MqttClient:
                             self._pinger(writer)
                         )
                         await self._resubscribe(writer)
+                        if self.on_connect is not None:
+                            try:
+                                self.on_connect()
+                            except Exception:
+                                log.exception("on_connect callback failed")
                     elif pkt.type == C.PUBLISH:
                         await self._incoming(pkt, writer)
                     elif pkt.type in (C.PUBACK, C.SUBACK, C.UNSUBACK,

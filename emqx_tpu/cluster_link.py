@@ -1,0 +1,367 @@
+"""Cluster linking: route-aware federation between independent clusters.
+
+Capability match for `emqx_cluster_link`
+(/root/reference/apps/emqx_cluster_link/src/emqx_cluster_link.erl
+external-broker behavior, emqx_cluster_link_router_syncer.erl
+route-op push, emqx_cluster_link_extrouter.erl remote-interest table):
+two clusters exchange *routes first*, so only messages some remote
+subscriber actually wants ever cross the link.
+
+Transport rides the ordinary MQTT surface (the reference does the
+same — its link agent is an MQTT client on the remote cluster):
+
+  * ``$LINK/route/{cluster}``  — route ops pushed BY cluster
+    ``{cluster}``'s agent to this broker: add/del/reset of the topic
+    filters that cluster currently has local subscribers for.
+  * ``$LINK/msg/{cluster}``    — wrapped messages this broker forwards
+    TO cluster ``{cluster}``; its agent subscribes to exactly this
+    topic over the link connection.
+
+Loop prevention is by origin tagging (the reference's
+`emqx_cluster_link:should_route_to_external_dests` dest-check): a
+message carries its origin cluster end-to-end; it is never forwarded
+back to its origin, so even cyclic link topologies cannot echo.
+
+Both halves live here:
+  * `LinkAgent`   — local side of one configured link: pushes route
+    ops for local-interest filters (gated by the link's topic
+    allowlist) and imports wrapped messages.
+  * `LinkServer`  — accepts route ops from remote agents and forwards
+    matching local publishes, via one ``message.publish`` hook.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import logging
+from typing import Dict, List, Optional, Sequence, Set
+
+from . import topic as T
+from .client import MqttClient
+from .message import Message
+
+log = logging.getLogger("emqx_tpu.cluster_link")
+
+ROUTE_PREFIX = "$LINK/route/"
+MSG_PREFIX = "$LINK/msg/"
+
+
+def filters_intersect(a: str, b: str) -> bool:
+    """True when two topic filters can match a common topic
+    (the reference's topic intersection, emqx_topic:intersection/2)."""
+    aw, bw = T.words(a), T.words(b)
+    i = 0
+    while True:
+        a_end, b_end = i >= len(aw), i >= len(bw)
+        if a_end and b_end:
+            return True
+        if a_end:
+            return list(bw[i:]) == ["#"]
+        if b_end:
+            return list(aw[i:]) == ["#"]
+        x, y = aw[i], bw[i]
+        if x == "#" or y == "#":
+            return True
+        if x != y and x != "+" and y != "+":
+            return False
+        i += 1
+
+
+def _wrap(msg: Message, origin: str) -> bytes:
+    return json.dumps({
+        "t": msg.topic,
+        "p": base64.b64encode(msg.payload).decode(),
+        "q": msg.qos,
+        "r": msg.retain,
+        "o": origin,
+        "c": msg.from_client,
+    }).encode()
+
+
+def _unwrap(payload: bytes) -> Optional[Message]:
+    try:
+        d = json.loads(payload)
+        return Message(
+            topic=d["t"],
+            payload=base64.b64decode(d["p"]),
+            qos=int(d.get("q", 0)),
+            retain=bool(d.get("r", False)),
+            from_client=d.get("c", ""),
+            headers={"cluster_origin": d.get("o", "?")},
+        )
+    except (ValueError, KeyError, TypeError):
+        return None
+
+
+class LinkAgent:
+    """Local half of one configured link (the reference's
+    emqx_cluster_link_router_syncer + msg import actor)."""
+
+    def __init__(
+        self,
+        broker,
+        local_cluster: str,
+        name: str,  # remote cluster name
+        host: str,
+        port: int,
+        topics: Sequence[str],
+        username: Optional[str] = None,
+        password: Optional[bytes] = None,
+    ) -> None:
+        self.broker = broker
+        self.local_cluster = local_cluster
+        self.name = name
+        self.topics = list(topics)
+        self._pushed: Set[str] = set()
+        self.client = MqttClient(
+            host, port, f"$link-{local_cluster}-{name}",
+            username=username, password=password,
+        )
+        self.client.on_message = self._on_remote
+        self._ops: asyncio.Queue = asyncio.Queue()
+        self._pusher: Optional[asyncio.Task] = None
+
+    # ------------------------------------------------------ lifecycle
+
+    async def start(self) -> None:
+        await self.client.subscribe(MSG_PREFIX + self.local_cluster, qos=1)
+        # every (re)connect pushes a full resync: the remote may have
+        # restarted with an empty extern-route table, and a silent gap
+        # would permanently stop forwarding
+        self.client.on_connect = lambda: self._ops.put_nowait(
+            ("reset", None)
+        )
+        await self.client.start()
+        self._pusher = asyncio.get_running_loop().create_task(
+            self._push_loop()
+        )
+
+    async def stop(self) -> None:
+        if self._pusher is not None:
+            self._pusher.cancel()
+            try:
+                await self._pusher
+            except asyncio.CancelledError:
+                pass
+            self._pusher = None
+        await self.client.stop()
+
+    # ----------------------------------------------------- route sync
+
+    def relevant(self, flt: str) -> bool:
+        return any(filters_intersect(flt, t) for t in self.topics)
+
+    def route_added(self, flt: str) -> None:
+        if not flt.startswith("$") and self.relevant(flt):
+            self._ops.put_nowait(("add", flt))
+
+    def route_removed(self, flt: str) -> None:
+        if not flt.startswith("$") and self.relevant(flt):
+            self._ops.put_nowait(("del", flt))
+
+    def _current_filters(self) -> List[str]:
+        router = self.broker.router
+        out = set()
+        for flt in list(router._subs) + list(router._shared_opts):
+            if not flt.startswith("$") and self.relevant(flt):
+                out.add(flt)
+        return sorted(out)
+
+    async def _push_loop(self) -> None:
+        """Serialize route ops onto the link connection; a reconnect
+        collapses the queue into one reset (full resync)."""
+        topic = ROUTE_PREFIX + self.local_cluster
+        while True:
+            op, flt = await self._ops.get()
+            try:
+                if op == "reset":
+                    await self.client.connected.wait()
+                    filters = self._current_filters()
+                    self._pushed = set(filters)
+                    body = {"op": "reset", "filters": filters}
+                else:
+                    if (op == "add") == (flt in self._pushed):
+                        continue  # dedup repeated adds/dels
+                    await self.client.connected.wait()
+                    (self._pushed.add if op == "add"
+                     else self._pushed.discard)(flt)
+                    body = {"op": op, "filters": [flt]}
+                await self.client.publish(
+                    topic, json.dumps(body).encode(), qos=1
+                )
+            except (ConnectionError, asyncio.TimeoutError):
+                # link dropped mid-push: full resync once it's back
+                while not self._ops.empty():
+                    self._ops.get_nowait()
+                self._ops.put_nowait(("reset", None))
+                await asyncio.sleep(0.2)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("cluster link %s: route push failed",
+                              self.name)
+
+    # -------------------------------------------------- message import
+
+    def _on_remote(self, msg: Message) -> None:
+        inner = _unwrap(msg.payload)
+        if inner is None:
+            log.warning("cluster link %s: malformed wrapped message",
+                        self.name)
+            return
+        if inner.headers.get("cluster_origin") == self.local_cluster:
+            return  # never re-import our own traffic
+        self.broker.metrics.inc("cluster_link.ingress")
+        self.broker.publish(inner)
+
+
+class LinkServer:
+    """Remote-interest table + forwarder (the reference's extrouter +
+    external-broker forward hook)."""
+
+    def __init__(self, broker, local_cluster: str,
+                 allowed: Optional[Set[str]] = None) -> None:
+        self.broker = broker
+        self.local_cluster = local_cluster
+        # route ops are only honored for known peer clusters — without
+        # this gate ANY client could push {"op":"reset","filters":["#"]}
+        # under a cluster name of its choosing and siphon every publish
+        # past per-topic ACLs via its own $LINK/msg subscription
+        self.allowed: Set[str] = set(allowed or ())
+        # remote cluster -> filters it currently wants
+        self.extern_routes: Dict[str, Set[str]] = {}
+        self._hook = None
+
+    def start(self) -> None:
+        self._hook = self.broker.hooks.add(
+            "message.publish", self._on_publish, priority=-60
+        )
+
+    def stop(self) -> None:
+        if self._hook is not None:
+            self.broker.hooks.delete("message.publish", self._hook)
+            self._hook = None
+
+    # ---------------------------------------------------------- hook
+
+    def _on_publish(self, msg: Message):
+        topic = msg.topic
+        if topic.startswith(ROUTE_PREFIX):
+            self._route_op(topic[len(ROUTE_PREFIX):], msg.payload)
+            return None
+        if topic.startswith("$"):  # $LINK/msg, $SYS, ... never forward
+            return None
+        origin = msg.headers.get("cluster_origin")
+        for cluster, filters in self.extern_routes.items():
+            if cluster == origin:
+                continue  # loop prevention: never send back to origin
+            if any(T.match(topic, f) for f in filters):
+                self.broker.metrics.inc("cluster_link.egress")
+                self.broker.publish(Message(
+                    topic=MSG_PREFIX + cluster,
+                    payload=_wrap(msg, origin or self.local_cluster),
+                    qos=1,
+                ))
+        return None
+
+    def _route_op(self, cluster: str, payload: bytes) -> None:
+        if cluster not in self.allowed:
+            log.warning("cluster link: route op for unconfigured peer "
+                        "%r ignored", cluster)
+            return
+        try:
+            body = json.loads(payload)
+            op = body["op"]
+            filters = [str(f) for f in body.get("filters", [])]
+        except (ValueError, KeyError, TypeError):
+            log.warning("cluster link: malformed route op from %r", cluster)
+            return
+        routes = self.extern_routes.setdefault(cluster, set())
+        if op == "reset":
+            routes.clear()
+            routes.update(filters)
+        elif op == "add":
+            routes.update(filters)
+        elif op == "del":
+            routes.difference_update(filters)
+        log.debug("cluster link: %s now wants %d filters",
+                  cluster, len(routes))
+
+
+class ClusterLinks:
+    """All configured links of one broker + the serving half."""
+
+    def __init__(self, broker, local_cluster: str,
+                 links: Sequence[dict]) -> None:
+        self.broker = broker
+        # configured link names are the peers whose route ops we honor;
+        # an `accept_from` entry extends the set for asymmetric setups
+        allowed = {l["name"] for l in links}
+        for l in links:
+            allowed.update(l.get("accept_from", ()))
+        self.server = LinkServer(broker, local_cluster, allowed)
+        self.agents = [
+            LinkAgent(
+                broker,
+                local_cluster,
+                name=l["name"],
+                host=l.get("host", "127.0.0.1"),
+                port=int(l.get("port", 1883)),
+                topics=l.get("topics", ["#"]),
+                username=l.get("username"),
+                password=(l["password"].encode()
+                          if l.get("password") else None),
+            )
+            for l in links
+        ]
+        self._prev_added = None
+        self._prev_removed = None
+
+    async def start(self) -> None:
+        self.server.start()
+        router = self.broker.router
+        # chain (don't clobber) the cluster node's route hooks
+        self._prev_added = router.on_route_added
+        self._prev_removed = router.on_route_removed
+
+        def added(flt, _prev=self._prev_added):
+            if _prev is not None:
+                _prev(flt)
+            for a in self.agents:
+                a.route_added(flt)
+
+        def removed(flt, _prev=self._prev_removed):
+            if _prev is not None:
+                _prev(flt)
+            for a in self.agents:
+                a.route_removed(flt)
+
+        router.on_route_added = added
+        router.on_route_removed = removed
+        for a in self.agents:
+            await a.start()
+
+    async def stop(self) -> None:
+        for a in self.agents:
+            await a.stop()
+        self.server.stop()
+        self.broker.router.on_route_added = self._prev_added
+        self.broker.router.on_route_removed = self._prev_removed
+
+    def info(self) -> dict:
+        return {
+            "links": [
+                {
+                    "name": a.name,
+                    "topics": a.topics,
+                    "connected": a.client.connected.is_set(),
+                    "pushed_routes": len(a._pushed),
+                }
+                for a in self.agents
+            ],
+            "extern_routes": {
+                c: sorted(f) for c, f in self.server.extern_routes.items()
+            },
+        }

@@ -1,0 +1,185 @@
+"""Cluster linking: route-aware federation between two independent
+brokers (emqx_cluster_link parity — routes sync first, only wanted
+messages cross, origin tagging kills loops)."""
+
+import asyncio
+
+import pytest
+
+from emqx_tpu.broker.listener import BrokerServer
+from emqx_tpu.cluster_link import filters_intersect
+from emqx_tpu.config import BrokerConfig, ListenerConfig
+from mqtt_client import TestClient
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.mark.parametrize(
+    "a,b,want",
+    [
+        ("a/b", "a/b", True),
+        ("a/b", "a/c", False),
+        ("a/+", "a/b", True),
+        ("a/#", "x/y", False),
+        ("a/#", "a", True),
+        ("a/#", "a/b/c", True),
+        ("+/b", "a/+", True),
+        ("a/+/c", "a/b/#", True),
+        ("a/b/c", "a/b", False),
+        ("#", "anything/at/all", True),
+        ("a/+/x", "a/b/y", False),
+    ],
+)
+def test_filters_intersect(a, b, want):
+    assert filters_intersect(a, b) is want
+    assert filters_intersect(b, a) is want
+
+
+async def start_broker(name, links=()):
+    cfg = BrokerConfig()
+    cfg.listeners = [ListenerConfig(bind="127.0.0.1", port=0)]
+    cfg.cluster_name = name
+    srv = BrokerServer(cfg)
+    await srv.start()
+    return srv
+
+
+async def add_links(srv, links):
+    from emqx_tpu.cluster_link import ClusterLinks
+
+    srv.cluster_links = ClusterLinks(
+        srv.broker, srv.broker.config.cluster_name, links
+    )
+    await srv.cluster_links.start()
+
+
+async def settle(check, timeout=5.0):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        if check():
+            return True
+        await asyncio.sleep(0.05)
+    return False
+
+
+def test_link_routes_then_messages_cross():
+    async def t():
+        east = await start_broker("east")
+        west = await start_broker("west")
+        # east pulls from west for sensor topics only; west configures
+        # the symmetric link entry (that's what serves east's route ops)
+        await add_links(east, [{
+            "name": "west", "host": "127.0.0.1",
+            "port": west.listeners[0].port,
+            "topics": ["sensors/#"],
+        }])
+        await add_links(west, [{
+            "name": "east", "host": "127.0.0.1",
+            "port": east.listeners[0].port,
+            "topics": [],
+        }])
+
+        # no local subscriber yet: west must see zero extern routes
+        # for east even after the link connects
+        agent = east.cluster_links.agents[0]
+        assert await settle(lambda: agent.client.connected.is_set())
+        await asyncio.sleep(0.2)
+        assert not any(_extern(west).values())
+
+        sub = TestClient(east.listeners[0].port, "e-sub")
+        await sub.connect()
+        await sub.subscribe("sensors/+/temp", qos=1)
+        # the route op must arrive at west
+        assert await settle(
+            lambda: west.broker.hooks is not None and any(
+                "sensors/+/temp" in fs
+                for fs in _extern(west).values()
+            )
+        ), _extern(west)
+
+        # a publish on west now crosses to the east subscriber
+        pub = TestClient(west.listeners[0].port, "w-pub")
+        await pub.connect()
+        await pub.publish("sensors/s1/temp", b"19.5", qos=1)
+        got = await sub.recv_publish()
+        assert got.topic == "sensors/s1/temp" and got.payload == b"19.5"
+
+        # topics outside the link allowlist never sync routes
+        await sub.subscribe("billing/#")
+        await asyncio.sleep(0.3)
+        assert not any(
+            "billing/#" in fs for fs in _extern(west).values()
+        )
+
+        # unsubscribe withdraws the route
+        await sub.unsubscribe("sensors/+/temp")
+        assert await settle(
+            lambda: not any(
+                "sensors/+/temp" in fs for fs in _extern(west).values()
+            )
+        )
+
+        await pub.close()
+        await sub.close()
+        await east.stop()
+        await west.stop()
+
+    run(t())
+
+
+def _extern(srv):
+    cl = srv.cluster_links
+    return cl.server.extern_routes if cl else {}
+
+
+def test_bidirectional_links_no_loop():
+    async def t():
+        east = await start_broker("east")
+        west = await start_broker("west")
+        await add_links(east, [{
+            "name": "west", "host": "127.0.0.1",
+            "port": west.listeners[0].port, "topics": ["#"],
+        }])
+        await add_links(west, [{
+            "name": "east", "host": "127.0.0.1",
+            "port": east.listeners[0].port, "topics": ["#"],
+        }])
+
+        se = TestClient(east.listeners[0].port, "se")
+        await se.connect()
+        await se.subscribe("chat/#", qos=1)
+        sw = TestClient(west.listeners[0].port, "sw")
+        await sw.connect()
+        await sw.subscribe("chat/#", qos=1)
+
+        assert await settle(lambda: any(_extern(west).values()))
+        assert await settle(lambda: any(_extern(east).values()))
+
+        pub = TestClient(west.listeners[0].port, "wp")
+        await pub.connect()
+        await pub.publish("chat/hello", b"x", qos=1)
+
+        got_w = await sw.recv_publish()
+        got_e = await se.recv_publish()
+        assert got_w.payload == got_e.payload == b"x"
+
+        # loop check: neither side may see the message twice
+        await asyncio.sleep(0.5)
+        extra = 0
+        for c in (se, sw):
+            try:
+                await asyncio.wait_for(c.recv_publish(), 0.2)
+                extra += 1
+            except asyncio.TimeoutError:
+                pass
+        assert extra == 0, "message echoed back across the link"
+
+        await pub.close()
+        await se.close()
+        await sw.close()
+        await east.stop()
+        await west.stop()
+
+    run(t())
