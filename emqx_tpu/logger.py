@@ -1,0 +1,122 @@
+"""Structured logging: JSON formatter + repeated-event throttling.
+
+The `emqx_logger` / `emqx_log_throttler` roles
+(/root/reference/apps/emqx/src/emqx_logger.erl JSON/structured
+formatters, emqx_log_throttler.erl:62-105 per-event-window dedup):
+
+  * `JsonFormatter` — one JSON object per line (ts, level, logger,
+    msg, plus any ``extra`` fields), machine-shippable as-is.
+  * `LogThrottler` — a logging.Filter that lets the FIRST event of a
+    throttle key through per window and swallows the rest; at window
+    roll it emits one summary line with the dropped count (the
+    reference's "dropped N events" report).  Keyed on an explicit
+    ``throttle`` extra when present, else on (logger, msg-template) —
+    so hot-path repeats (auth failures, socket errors) cannot flood
+    the log at line rate.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Dict, Optional, Tuple
+
+
+class JsonFormatter(logging.Formatter):
+    """One JSON object per record, stable keys first."""
+
+    _STD = {
+        "name", "msg", "args", "levelname", "levelno", "pathname",
+        "filename", "module", "exc_info", "exc_text", "stack_info",
+        "lineno", "funcName", "created", "msecs", "relativeCreated",
+        "thread", "threadName", "processName", "process",
+        "taskName", "throttle",
+    }
+
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": round(record.created, 3),
+            "level": record.levelname.lower(),
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        if record.exc_info and record.exc_info[0] is not None:
+            out["exc"] = self.formatException(record.exc_info)
+        for k, v in record.__dict__.items():
+            if k not in self._STD and not k.startswith("_"):
+                try:
+                    json.dumps(v)
+                    out[k] = v
+                except (TypeError, ValueError):
+                    out[k] = repr(v)
+        return json.dumps(out, separators=(",", ":"))
+
+
+class LogThrottler(logging.Filter):
+    """First-per-window pass-through with dropped-count summaries."""
+
+    def __init__(self, window_s: float = 60.0,
+                 max_keys: int = 4096) -> None:
+        super().__init__()
+        self.window_s = window_s
+        self.max_keys = max_keys
+        # key -> (window_start, dropped_count)
+        self._seen: Dict[Tuple[str, str], Tuple[float, int]] = {}
+
+    def _key(self, record: logging.LogRecord) -> Tuple[str, str]:
+        tag = getattr(record, "throttle", None)
+        if tag is not None:
+            return (record.name, str(tag))
+        return (record.name, str(record.msg))
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        if record.levelno >= logging.ERROR:
+            return True  # errors always pass (reference behavior)
+        now = time.monotonic()
+        key = self._key(record)
+        entry = self._seen.get(key)
+        if entry is None:
+            if len(self._seen) >= self.max_keys:
+                self._seen.clear()
+            self._seen[key] = (now, 0)
+            return True
+        start, dropped = entry
+        if now - start < self.window_s:
+            self._seen[key] = (start, dropped + 1)
+            return False
+        # window rolled: emit, and summarize what was swallowed
+        self._seen[key] = (now, 0)
+        if dropped:
+            record.msg = (f"{record.msg} (throttled: {dropped} similar "
+                          f"events in the last {self.window_s:.0f}s)")
+            record.args = record.args or ()
+        return True
+
+
+def configure(
+    fmt: str = "text",
+    level: str = "info",
+    throttle_window_s: Optional[float] = None,
+) -> None:
+    """Apply the configured format/level/throttle to the emqx_tpu
+    logger tree (the `log.*` config section).
+
+    The throttler attaches to OUR handler, not the logger: Python
+    applies logger-level filters only to records emitted on that exact
+    logger, and nearly every log site uses a child
+    (``emqx_tpu.<module>``) — records propagating up bypass logger
+    filters but do pass handler filters."""
+    root = logging.getLogger("emqx_tpu")
+    root.setLevel(getattr(logging, level.upper(), logging.INFO))
+    handler = logging.StreamHandler()
+    if fmt == "json":
+        handler.setFormatter(JsonFormatter())
+    else:
+        handler.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s %(name)s %(message)s"
+        ))
+    if throttle_window_s:
+        handler.addFilter(LogThrottler(window_s=throttle_window_s))
+    root.addHandler(handler)
+    root.propagate = False

@@ -1,0 +1,196 @@
+"""OpenTelemetry export — OTLP/JSON over HTTP, no SDK dependency.
+
+The `emqx_opentelemetry` role (/root/reference/apps/emqx_opentelemetry/
+src/emqx_otel_metrics.erl periodic metric push, emqx_otel_logger.erl
+log bridge): broker counters/gauges go out as OTLP `resourceMetrics`
+to ``{endpoint}/v1/metrics`` on an interval, and (optionally) log
+records as OTLP `resourceLogs` to ``{endpoint}/v1/logs``.
+
+OTLP/HTTP has a stable JSON encoding (the protobuf JSON mapping), so a
+collector ingests these payloads natively — the environment just has
+no otel SDK, and none is needed for export.  Delivery rides the same
+buffered resource layer as every other sink: an unreachable collector
+never affects the broker.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Dict, List, Optional
+
+from .resources import BufferWorker, HttpSink
+
+_SEVERITY = {  # python level -> OTLP severityNumber
+    logging.DEBUG: 5,
+    logging.INFO: 9,
+    logging.WARNING: 13,
+    logging.ERROR: 17,
+    logging.CRITICAL: 21,
+}
+
+
+def _attrs(d: Dict[str, str]) -> List[dict]:
+    return [
+        {"key": k, "value": {"stringValue": str(v)}} for k, v in d.items()
+    ]
+
+
+class OtelExporter:
+    """Periodic OTLP metric push + optional log bridge for one broker."""
+
+    def __init__(
+        self,
+        broker,
+        endpoint: str,  # e.g. http://collector:4318
+        interval: float = 10.0,
+        export_logs: bool = False,
+        log_level: int = logging.WARNING,
+    ) -> None:
+        self.broker = broker
+        self.endpoint = endpoint.rstrip("/")
+        self.interval = interval
+        self.export_logs = export_logs
+        self.log_level = log_level
+        self._metrics_worker: Optional[BufferWorker] = None
+        self._logs_worker: Optional[BufferWorker] = None
+        self._handler: Optional[logging.Handler] = None
+        self._last: float = 0.0
+        self._resource = {
+            "attributes": _attrs({
+                "service.name": "emqx_tpu",
+                "service.instance.id": broker.config.node_name,
+            })
+        }
+
+    # ------------------------------------------------------ lifecycle
+
+    async def start(self) -> None:
+        import asyncio
+
+        self._loop = asyncio.get_running_loop()
+        self._metrics_worker = BufferWorker(
+            HttpSink(self.endpoint + "/v1/metrics",
+                     headers={"Content-Type": "application/json"}),
+            max_buffer=64,
+            max_retries=3,
+        )
+        await self._metrics_worker.start()
+        if self.export_logs:
+            self._logs_worker = BufferWorker(
+                HttpSink(self.endpoint + "/v1/logs",
+                         headers={"Content-Type": "application/json"}),
+                max_buffer=256,
+                max_retries=3,
+            )
+            await self._logs_worker.start()
+            self._handler = _OtelLogHandler(self)
+            self._handler.setLevel(self.log_level)
+            logging.getLogger("emqx_tpu").addHandler(self._handler)
+
+    async def stop(self) -> None:
+        if self._handler is not None:
+            logging.getLogger("emqx_tpu").removeHandler(self._handler)
+            self._handler = None
+        if self._metrics_worker is not None:
+            await self._metrics_worker.stop()
+            self._metrics_worker = None
+        if self._logs_worker is not None:
+            await self._logs_worker.stop()
+            self._logs_worker = None
+
+    # -------------------------------------------------------- metrics
+
+    def tick(self, now: Optional[float] = None) -> bool:
+        """Called from the broker's 1 Hz housekeeping; exports every
+        ``interval`` seconds.  Returns True when a push was queued."""
+        now = time.time() if now is None else now
+        if now - self._last < self.interval:
+            return False
+        self._last = now
+        if self._metrics_worker is not None:
+            self._metrics_worker.enqueue(self.metrics_payload(now))
+            return True
+        return False
+
+    def metrics_payload(self, now: float) -> bytes:
+        t_ns = str(int(now * 1e9))
+        metrics = []
+        for name, val in sorted(self.broker.metrics.all().items()):
+            metrics.append({
+                "name": "emqx_" + name.replace(".", "_"),
+                "sum": {
+                    "dataPoints": [{"timeUnixNano": t_ns,
+                                    "asInt": str(int(val))}],
+                    "aggregationTemporality": 2,  # CUMULATIVE
+                    "isMonotonic": True,
+                },
+            })
+        for name, val in sorted(self.broker.stats.all().items()):
+            metrics.append({
+                "name": "emqx_" + name.replace(".", "_"),
+                "gauge": {
+                    "dataPoints": [{"timeUnixNano": t_ns,
+                                    "asInt": str(int(val))}],
+                },
+            })
+        return json.dumps({
+            "resourceMetrics": [{
+                "resource": self._resource,
+                "scopeMetrics": [{
+                    "scope": {"name": "emqx_tpu"},
+                    "metrics": metrics,
+                }],
+            }]
+        }).encode()
+
+    # ----------------------------------------------------------- logs
+
+    def emit_log(self, record: logging.LogRecord) -> None:
+        if self._logs_worker is None:
+            return
+        # the buffer worker itself logs drops/outages on
+        # emqx_tpu.resources — exporting those would regenerate one
+        # query per drop against a dead collector, forever
+        if record.name.startswith("emqx_tpu.resources"):
+            return
+        body = {
+            "resourceLogs": [{
+                "resource": self._resource,
+                "scopeLogs": [{
+                    "scope": {"name": record.name},
+                    "logRecords": [{
+                        "timeUnixNano": str(int(record.created * 1e9)),
+                        "severityNumber": _SEVERITY.get(
+                            record.levelno,
+                            min(21, max(1, record.levelno // 5)),
+                        ),
+                        "severityText": record.levelname,
+                        "body": {"stringValue": record.getMessage()},
+                        "attributes": _attrs({
+                            "logger": record.name,
+                            "module": record.module,
+                        }),
+                    }],
+                }],
+            }]
+        }
+        # logs can arrive from worker threads (engine fold/build
+        # daemons); BufferWorker wakes an asyncio.Event, which must
+        # happen on the loop thread
+        self._loop.call_soon_threadsafe(
+            self._logs_worker.enqueue, json.dumps(body).encode()
+        )
+
+
+class _OtelLogHandler(logging.Handler):
+    def __init__(self, exporter: OtelExporter) -> None:
+        super().__init__()
+        self.exporter = exporter
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            self.exporter.emit_log(record)
+        except Exception:  # never let telemetry break logging
+            pass

@@ -1,0 +1,132 @@
+"""OTLP export (emqx_opentelemetry parity) and structured logging
+(emqx_logger / emqx_log_throttler parity)."""
+
+import asyncio
+import json
+import logging
+
+from emqx_tpu.broker.listener import BrokerServer
+from emqx_tpu.config import BrokerConfig, ListenerConfig
+from emqx_tpu.logger import JsonFormatter, LogThrottler
+from emqx_tpu.otel import OtelExporter
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_otel_metrics_payload_shape():
+    """The payload must be valid OTLP/JSON: resourceMetrics ->
+    scopeMetrics -> metrics with sum (counters) and gauge (stats)."""
+
+    async def t():
+        cfg = BrokerConfig()
+        cfg.listeners = [ListenerConfig(port=0)]
+        srv = BrokerServer(cfg)
+        await srv.start()
+        srv.broker.metrics.inc("messages.received", 5)
+        srv.broker.stats.set("connections.count", 2)
+        exp = OtelExporter(srv.broker, "http://127.0.0.1:0")
+        body = json.loads(exp.metrics_payload(1000.0))
+        rm = body["resourceMetrics"][0]
+        attrs = {a["key"]: a["value"]["stringValue"]
+                 for a in rm["resource"]["attributes"]}
+        assert attrs["service.name"] == "emqx_tpu"
+        metrics = {m["name"]: m for m in rm["scopeMetrics"][0]["metrics"]}
+        recv = metrics["emqx_messages_received"]
+        assert recv["sum"]["isMonotonic"] is True
+        assert recv["sum"]["dataPoints"][0]["asInt"] == "5"
+        conn = metrics["emqx_connections_count"]
+        assert conn["gauge"]["dataPoints"][0]["asInt"] == "2"
+        await srv.stop()
+
+    run(t())
+
+
+def test_otel_end_to_end_collector():
+    """Full push: broker -> OtelExporter -> local HTTP collector."""
+
+    async def t():
+        from aiohttp import web
+
+        received = []
+
+        async def collect(request):
+            received.append(await request.json())
+            return web.Response(status=200)
+
+        app = web.Application()
+        async def head(request):
+            return web.Response()
+
+        app.router.add_post("/v1/metrics", collect)
+        app.router.add_route("HEAD", "/v1/metrics", head)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+
+        cfg = BrokerConfig()
+        cfg.listeners = [ListenerConfig(port=0)]
+        cfg.otel.enable = True
+        cfg.otel.endpoint = f"http://127.0.0.1:{port}"
+        cfg.otel.interval = 0.0  # every housekeeping tick
+        srv = BrokerServer(cfg)
+        await srv.start()
+        assert srv.otel is not None
+        srv.otel.tick()  # force an immediate export
+        for _ in range(100):
+            if received:
+                break
+            await asyncio.sleep(0.05)
+        assert received, "collector never received an OTLP push"
+        assert "resourceMetrics" in received[0]
+        await srv.stop()
+        await runner.cleanup()
+
+    run(t())
+
+
+def test_json_formatter_fields_and_extras():
+    fmt = JsonFormatter()
+    rec = logging.LogRecord(
+        "emqx_tpu.test", logging.WARNING, __file__, 1,
+        "client %s kicked", ("c1",), None,
+    )
+    rec.clientid = "c1"
+    out = json.loads(fmt.format(rec))
+    assert out["level"] == "warning"
+    assert out["logger"] == "emqx_tpu.test"
+    assert out["msg"] == "client c1 kicked"
+    assert out["clientid"] == "c1"
+    assert isinstance(out["ts"], float)
+
+
+def test_log_throttler_windows_and_summary(caplog):
+    throttler = LogThrottler(window_s=0.2)
+    logger = logging.getLogger("emqx_tpu.throttle_test")
+    logger.addFilter(throttler)
+    logger.setLevel(logging.INFO)
+    try:
+        with caplog.at_level(logging.INFO, "emqx_tpu.throttle_test"):
+            for _ in range(10):
+                logger.info("socket error from %s", "1.2.3.4")
+        assert len(caplog.records) == 1  # first passes, rest swallowed
+
+        caplog.clear()
+        import time as _t
+        _t.sleep(0.25)
+        with caplog.at_level(logging.INFO, "emqx_tpu.throttle_test"):
+            logger.info("socket error from %s", "1.2.3.4")
+        assert len(caplog.records) == 1
+        assert "throttled: 9 similar events" in caplog.records[0].getMessage()
+
+        # errors always pass
+        caplog.clear()
+        with caplog.at_level(logging.INFO, "emqx_tpu.throttle_test"):
+            for _ in range(3):
+                logger.error("disk full")
+        assert len(caplog.records) == 3
+    finally:
+        logger.removeFilter(throttler)
