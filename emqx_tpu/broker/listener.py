@@ -157,6 +157,8 @@ class BrokerServer:
 
         self.sys = SysTopics(self.broker)
         self.api = None  # MgmtApi when config.api.enable
+        self.cluster_links = None  # ClusterLinks when config.cluster_links
+        self.otel = None  # OtelExporter when config.otel.enable
 
     async def start(self) -> None:
         eng_cfg = self.broker.config.engine
@@ -180,6 +182,32 @@ class BrokerServer:
         for gw_cfg in self.broker.config.gateways:
             await self._load_gateway(gw_cfg)
         cfg = self.broker.config
+        if cfg.cluster_links:
+            from ..cluster_link import ClusterLinks
+
+            self.cluster_links = ClusterLinks(
+                self.broker, cfg.cluster_name, cfg.cluster_links
+            )
+            await self.cluster_links.start()
+        if cfg.otel.enable:
+            from ..otel import OtelExporter
+
+            self.otel = OtelExporter(
+                self.broker,
+                cfg.otel.endpoint,
+                interval=cfg.otel.interval,
+                export_logs=cfg.otel.export_logs,
+            )
+            await self.otel.start()
+        if (cfg.log.format != "text" or cfg.log.level != "info"
+                or cfg.log.throttle_window_s):
+            from ..logger import configure as configure_logging
+
+            configure_logging(
+                fmt=cfg.log.format,
+                level=cfg.log.level,
+                throttle_window_s=cfg.log.throttle_window_s or None,
+            )
         if cfg.telemetry_enable and cfg.telemetry_url:
             from ..telemetry import TelemetryReporter
 
@@ -241,6 +269,8 @@ class BrokerServer:
             self.sys.tick()
             if self.telemetry is not None:
                 self.telemetry.tick()
+            if self.otel is not None:
+                self.otel.tick()
 
     async def stop(self) -> None:
         if self._housekeeper is not None:
@@ -253,6 +283,12 @@ class BrokerServer:
         if self.api is not None:
             await self.api.stop()
             self.api = None
+        if self.cluster_links is not None:
+            await self.cluster_links.stop()
+            self.cluster_links = None
+        if self.otel is not None:
+            await self.otel.stop()
+            self.otel = None
         for lst in self.listeners:
             await lst.stop()
         if self.broker.batcher is not None:

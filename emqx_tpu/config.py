@@ -139,6 +139,25 @@ class DurableConfig:
 
 
 @dataclass
+class OtelConfig:
+    """OpenTelemetry export (emqx_opentelemetry): OTLP/JSON over HTTP."""
+
+    enable: bool = False
+    endpoint: str = "http://127.0.0.1:4318"
+    interval: float = 10.0
+    export_logs: bool = False
+
+
+@dataclass
+class LogConfig:
+    """Structured logging (emqx_logger + emqx_log_throttler)."""
+
+    format: str = "text"  # text | json
+    level: str = "info"
+    throttle_window_s: float = 0.0  # 0 disables throttling
+
+
+@dataclass
 class BrokerConfig:
     mqtt: MqttConfig = field(default_factory=MqttConfig)
     listeners: List[ListenerConfig] = field(
@@ -165,6 +184,12 @@ class BrokerConfig:
     telemetry_interval: float = 7 * 24 * 3600.0
     durable: DurableConfig = field(default_factory=DurableConfig)
     node_name: str = "emqx_tpu@127.0.0.1"
+    # cluster linking (emqx_cluster_link): this cluster's name plus
+    # links [{"name", "host", "port", "topics": [...]}, ...]
+    cluster_name: str = "emqx_tpu"
+    cluster_links: List[Dict[str, Any]] = field(default_factory=list)
+    otel: OtelConfig = field(default_factory=OtelConfig)
+    log: LogConfig = field(default_factory=LogConfig)
 
 
 class ConfigHandler:

@@ -40,6 +40,9 @@ class HostTrie:
     def __init__(self) -> None:
         self._root = _Node()
         self._filters: Dict[Hashable, Tuple[str, ...]] = {}
+        # fid -> insertion sequence tag (the match_since residual view)
+        self._seqs: Dict[Hashable, int] = {}
+        self._seq = 0
 
     def __len__(self) -> int:
         return len(self._filters)
@@ -52,15 +55,16 @@ class HostTrie:
 
     def insert(
         self, flt: str, fid: Hashable, ws: Optional[Tuple[str, ...]] = None
-    ) -> None:
+    ) -> int:
         """Insert filter `flt` under id `fid`. Re-inserting the same id
         replaces its previous filter.  ``ws`` skips the re-split when
-        the caller already has the words."""
+        the caller already has the words.  Returns the monotonically
+        increasing sequence tag (0 when unchanged)."""
         if ws is None:
             ws = T.words(flt)
         if fid in self._filters:
             if self._filters[fid] == ws:
-                return
+                return 0
             self.delete_id(fid)
         node = self._root
         terminal_hash = ws and ws[-1] == _HASH
@@ -69,11 +73,15 @@ class HostTrie:
             node = node.children.setdefault(w, _Node())
         (node.hash_ids if terminal_hash else node.exact_ids).add(fid)
         self._filters[fid] = ws
+        self._seq += 1
+        self._seqs[fid] = self._seq
+        return self._seq
 
     def delete_id(self, fid: Hashable) -> bool:
         ws = self._filters.pop(fid, None)
         if ws is None:
             return False
+        self._seqs.pop(fid, None)
         terminal_hash = ws and ws[-1] == _HASH
         body = ws[:-1] if terminal_hash else ws
         # walk down recording the path so empty nodes can be pruned
@@ -125,6 +133,21 @@ class HostTrie:
         if dollar:
             out -= self._root.hash_ids
         return out
+
+    def last_seq(self) -> int:
+        return self._seq
+
+    def match_since_words(
+        self, name: Tuple[str, ...], min_seq: int
+    ) -> Set[Hashable]:
+        """Matches restricted to filters inserted with seq >= min_seq
+        (the residual-since-watermark view; the native trie filters
+        during the walk, this fallback filters after)."""
+        seqs = self._seqs
+        return {
+            fid for fid in self.match_words(name)
+            if seqs.get(fid, 0) >= min_seq
+        }
 
     def match_brute(self, name: str) -> Set[Hashable]:
         """O(filters) reference implementation used in tests."""

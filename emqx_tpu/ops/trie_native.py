@@ -61,10 +61,20 @@ def load():
             lib.ht_free.argtypes = [ctypes.c_void_p]
             lib.ht_len.restype = ctypes.c_int64
             lib.ht_len.argtypes = [ctypes.c_void_p]
-            lib.ht_insert.restype = ctypes.c_int32
+            lib.ht_insert.restype = ctypes.c_int64
             lib.ht_insert.argtypes = [
                 ctypes.c_void_p,
                 ctypes.c_char_p,
+                ctypes.c_int64,
+            ]
+            lib.ht_seq.restype = ctypes.c_int64
+            lib.ht_seq.argtypes = [ctypes.c_void_p]
+            lib.ht_match_since.restype = ctypes.c_int64
+            lib.ht_match_since.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_char_p,
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64),
                 ctypes.c_int64,
             ]
             lib.ht_delete.restype = ctypes.c_int32
@@ -137,13 +147,16 @@ class NativeTrie:
     def _unintern(self, h: int) -> Hashable:
         return self._rev[h >> 1] if h & 1 else h >> 1
 
-    def insert(self, flt: str, fid: Hashable, ws: Tuple[str, ...] = None) -> None:
+    def insert(self, flt: str, fid: Hashable, ws: Tuple[str, ...] = None) -> int:
+        """Insert; returns the monotonically increasing sequence tag
+        (0 when unchanged) — `match_since_words` filters on it."""
         if ws is None:
             ws = T.words(flt)
         if self._filters.get(fid) == ws:
-            return
-        self._lib.ht_insert(self._h, flt.encode(), self._intern(fid))
+            return 0
+        seq = self._lib.ht_insert(self._h, flt.encode(), self._intern(fid))
         self._filters[fid] = ws
+        return seq
 
     def delete_id(self, fid: Hashable) -> bool:
         if type(fid) is int and fid >= 0:
@@ -178,6 +191,30 @@ class NativeTrie:
 
     def match_words(self, name: Tuple[str, ...]) -> set:
         return self.match("/".join(name))
+
+    def last_seq(self) -> int:
+        return self._lib.ht_seq(self._h)
+
+    def match_since_words(self, name: Tuple[str, ...], min_seq: int) -> set:
+        """Matches restricted to filters inserted with seq >= min_seq
+        (the residual-since-watermark view)."""
+        raw = "/".join(name).encode()
+        n = self._lib.ht_match_since(
+            self._h, raw, min_seq, self._buf_p, len(self._buf)
+        )
+        if n > len(self._buf):
+            self._buf = np.empty(int(n) * 2, np.int64)
+            self._buf_p = self._buf.ctypes.data_as(
+                ctypes.POINTER(ctypes.c_int64)
+            )
+            n = self._lib.ht_match_since(
+                self._h, raw, min_seq, self._buf_p, len(self._buf)
+            )
+        rev = self._rev
+        return {
+            rev[h >> 1] if h & 1 else h >> 1
+            for h in self._buf[:n].tolist()
+        }
 
     def match_brute(self, name: str) -> set:
         nw = T.words(name)

@@ -260,9 +260,13 @@ class ShardedMatchEngine(MatchEngine):
     ):
         from ..engine import make_fid_arr
 
-        index = build_sharded_index(
-            filters, self._tdict, self.mesh.shape["sub"], self.max_levels
-        )
+        # the sharded builder encodes (TokenDict-mutating) inside the
+        # builder thread: exclude concurrent fold/rebuild encoders
+        with self._enc_lock:
+            index = build_sharded_index(
+                filters, self._tdict, self.mesh.shape["sub"],
+                self.max_levels
+            )
         fids = [fid for a in index.shards for fid, _ in a.filters]
         dev = self._device_put(index) if device_put else None
         return index, dev, make_fid_arr(fids), set(fids), None

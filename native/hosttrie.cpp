@@ -24,8 +24,17 @@ namespace {
 
 struct Node {
     std::unordered_map<std::string, int32_t> children;
-    std::unordered_set<int64_t> exact;  // filters ending exactly here
-    std::unordered_set<int64_t> hash;   // filters '<path-here>/#'
+    // fid -> insertion sequence number; the seq tags let one trie
+    // serve both the full set (ht_match) and the "inserted since the
+    // last fold watermark" residual view (ht_match_since) without a
+    // second structure or a rebuild at fold time
+    std::unordered_map<int64_t, int64_t> exact;  // filters ending here
+    std::unordered_map<int64_t, int64_t> hash;   // filters '<path>/#'
+    // max insert seq anywhere in this node's subtree (monotone upper
+    // bound; deletes leave it stale, which only costs pruning power).
+    // ht_match_since skips whole subtrees below the watermark, so the
+    // residual walk is O(residual-touched paths), not O(full trie).
+    int64_t max_seq = 0;
     bool empty() const {
         return children.empty() && exact.empty() && hash.empty();
     }
@@ -36,6 +45,7 @@ struct Trie {
     std::vector<int32_t> free_;   // pruned node slots for reuse
     // fid -> its filter string (needed for delete + replace semantics)
     std::unordered_map<int64_t, std::string> filters;
+    int64_t seq = 0;              // monotonically increasing insert tag
     Trie() { nodes.emplace_back(); }
 
     int32_t alloc() {
@@ -108,8 +118,9 @@ int64_t ht_len(void* h) {
 }
 
 // Insert `flt` under `fid`; re-inserting the same fid replaces its
-// previous filter.  Returns 1 if the set changed.
-int32_t ht_insert(void* h, const char* flt, int64_t fid) {
+// previous filter.  Returns the assigned sequence tag (> 0), or 0 when
+// the set did not change (same fid, same filter).
+int64_t ht_insert(void* h, const char* flt, int64_t fid) {
     Trie* t = static_cast<Trie*>(h);
     auto it = t->filters.find(fid);
     if (it != t->filters.end()) {
@@ -133,13 +144,24 @@ int32_t ht_insert(void* h, const char* flt, int64_t fid) {
             node = cit->second;
         }
     }
+    int64_t seq = ++t->seq;
     if (terminal_hash)
-        t->nodes[node].hash.insert(fid);
+        t->nodes[node].hash[fid] = seq;
     else
-        t->nodes[node].exact.insert(fid);
+        t->nodes[node].exact[fid] = seq;
     t->filters[fid] = flt;
-    return 1;
+    // refresh subtree max along the inserted path (root included)
+    node = 0;
+    t->nodes[0].max_seq = seq;
+    for (size_t i = 0; i < body; ++i) {
+        node = t->nodes[node].children[ws[i]];
+        t->nodes[node].max_seq = seq;
+    }
+    return seq;
 }
+
+// Latest assigned sequence tag (the fold watermark source).
+int64_t ht_seq(void* h) { return static_cast<Trie*>(h)->seq; }
 
 int32_t ht_delete(void* h, int64_t fid) {
     Trie* t = static_cast<Trie*>(h);
@@ -159,9 +181,9 @@ int64_t ht_match(void* h, const char* topic, int64_t* out, int64_t cap) {
     split_levels(topic, name);
     bool dollar = !name.empty() && !name[0].empty() && name[0][0] == '$';
     int64_t n = 0;
-    auto emit = [&](const std::unordered_set<int64_t>& ids) {
-        for (int64_t fid : ids) {
-            if (n < cap) out[n] = fid;
+    auto emit = [&](const std::unordered_map<int64_t, int64_t>& ids) {
+        for (auto& kv : ids) {
+            if (n < cap) out[n] = kv.first;
             ++n;
         }
     };
@@ -183,6 +205,47 @@ int64_t ht_match(void* h, const char* topic, int64_t* out, int64_t cap) {
         if (!(dollar && i == 0)) {
             auto plus = ch.find("+");
             if (plus != ch.end()) stack.emplace_back(plus->second, i + 1);
+        }
+    }
+    return n;
+}
+
+// Like ht_match, but only filters whose insertion tag is >= min_seq —
+// the residual ("inserted since the last fold") view used by the match
+// engine's overlay.  Same walk, filtered emit.
+int64_t ht_match_since(void* h, const char* topic, int64_t min_seq,
+                       int64_t* out, int64_t cap) {
+    Trie* t = static_cast<Trie*>(h);
+    std::vector<std::string> name;
+    split_levels(topic, name);
+    bool dollar = !name.empty() && !name[0].empty() && name[0][0] == '$';
+    int64_t n = 0;
+    auto emit = [&](const std::unordered_map<int64_t, int64_t>& ids) {
+        for (auto& kv : ids) {
+            if (kv.second < min_seq) continue;
+            if (n < cap) out[n] = kv.first;
+            ++n;
+        }
+    };
+    std::vector<std::pair<int32_t, size_t>> stack;
+    if (t->nodes[0].max_seq >= min_seq) stack.emplace_back(0, 0);
+    const size_t len = name.size();
+    while (!stack.empty()) {
+        auto [node, i] = stack.back();
+        stack.pop_back();
+        if (!(dollar && node == 0)) emit(t->nodes[node].hash);
+        if (i == len) {
+            emit(t->nodes[node].exact);
+            continue;
+        }
+        auto& ch = t->nodes[node].children;
+        auto lit = ch.find(name[i]);
+        if (lit != ch.end() && t->nodes[lit->second].max_seq >= min_seq)
+            stack.emplace_back(lit->second, i + 1);
+        if (!(dollar && i == 0)) {
+            auto plus = ch.find("+");
+            if (plus != ch.end() && t->nodes[plus->second].max_seq >= min_seq)
+                stack.emplace_back(plus->second, i + 1);
         }
     }
     return n;

@@ -332,10 +332,11 @@ def test_delta_fold_residual_bound():
     engine = MatchEngine(
         max_levels=8, rebuild_threshold=10**9, delta_aut_threshold=64
     )
+    engine._fold_async = False  # strict bound needs inline folds
     shapes = set()
     for i in range(4000):
         engine.insert(f"churn/{i % 97}/+/x{i}", i)
-        assert len(engine._delta_new) <= max(64, len(engine._delta) // 4), i
+        assert engine._residual_count <= max(64, len(engine._delta) // 4), i
         if engine._daut is not None:
             shapes.add(
                 (
@@ -344,9 +345,66 @@ def test_delta_fold_residual_bound():
                 )
             )
     assert engine._daut is not None
-    assert len(engine._daut_fids) + len(engine._delta_new) >= 4000 - 64
+    assert len(engine._daut_fids) + engine._residual_count >= 4000 - 64
     # pow2 node-capacity classes bound the traced-shape set
     assert len(shapes) <= 4
+
+
+def test_async_fold_churn_equivalence():
+    """Randomized churn with ASYNC folds (the production mode): after
+    all in-flight folds drain, every match must agree with the oracle —
+    covers the delete/reinsert-during-fold tombstone races."""
+    import time as _t
+
+    rng = random.Random(1234)
+    engine = MatchEngine(
+        max_levels=8, rebuild_threshold=10**9, delta_aut_threshold=32
+    )
+    oracle = HostTrie()
+    live = {}
+    fid = 0
+    for step in range(3000):
+        r = rng.random()
+        if r < 0.70 or not live:
+            flt = random_filter(rng)
+            try:
+                T.validate_filter(flt)
+            except ValueError:
+                continue
+            fid += 1
+            engine.insert(flt, fid)
+            if fid in live:
+                oracle.delete_id(fid)
+            oracle.insert(flt, fid)
+            live[fid] = flt
+        elif r < 0.85:
+            victim = rng.choice(list(live))
+            engine.delete(victim)
+            oracle.delete_id(victim)
+            del live[victim]
+        else:  # re-point an existing fid (delete+insert via replace)
+            victim = rng.choice(list(live))
+            flt = random_filter(rng)
+            try:
+                T.validate_filter(flt)
+            except ValueError:
+                continue
+            engine.insert(flt, victim)
+            oracle.delete_id(victim)
+            oracle.insert(flt, victim)
+            live[victim] = flt
+    # drain in-flight folds
+    deadline = _t.time() + 20
+    while _t.time() < deadline:
+        t = engine._fold_thread
+        if t is not None and t.is_alive():
+            t.join(0.1)
+        elif not engine._folding:
+            break
+    assert not engine._folding
+    topics = [random_topic(rng) for _ in range(200)]
+    check_engine_vs_oracle(engine, oracle, {}, topics)
+    assert engine._daut is not None  # async folds actually ran
 
 
 def test_reinserted_fid_survives_fold():
@@ -356,6 +414,7 @@ def test_reinserted_fid_survives_fold():
     engine = MatchEngine(
         max_levels=8, rebuild_threshold=10**9, delta_aut_threshold=16
     )
+    engine._fold_async = False  # deterministic fold points
     for i in range(40):
         engine.insert(f"seed/{i}/+", i)
     engine.rebuild()  # all 40 in the base

@@ -1,0 +1,150 @@
+"""Deterministic race reproduction via tracepoints (SURVEY §5.2 — the
+snabbkaffe ?tp / ?force_ordering role): pin the async-fold adoption
+into exact windows of a concurrent match and assert oracle equality,
+instead of hoping a wall-clock stress test hits the interleaving."""
+
+import random
+import threading
+
+from emqx_tpu import topic as T
+from emqx_tpu import tp
+from emqx_tpu.engine import MatchEngine
+from emqx_tpu.ops.trie_host import HostTrie
+
+
+def build_engine(n=400, threshold=64):
+    eng = MatchEngine(
+        max_levels=8, rebuild_threshold=10**9,
+        delta_aut_threshold=threshold,
+    )
+    oracle = HostTrie()
+    for i in range(n):
+        eng.insert(f"seed/{i % 23}/+/s{i}", i)
+        oracle.insert(f"seed/{i % 23}/+/s{i}", i)
+    eng.rebuild()
+    return eng, oracle
+
+
+def oracle_check(eng, oracle, topics):
+    got = eng.match_batch(topics)
+    for t, g in zip(topics, got):
+        want = oracle.match_words(T.words(t))
+        assert g == want, (t, sorted(map(str, g)), sorted(map(str, want)))
+
+
+def churn(eng, oracle, start, count):
+    for i in range(start, start + count):
+        eng.insert(f"churn/{i % 97}/+/c{i}", i)
+        oracle.insert(f"churn/{i % 97}/+/c{i}", i)
+
+
+def drain_folds(eng, timeout=15.0):
+    import time
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        t = eng._fold_thread
+        if t is not None and t.is_alive():
+            t.join(0.1)
+        elif not eng._folding:
+            return
+    raise TimeoutError("fold never drained")
+
+
+def test_fold_adopts_inside_match_window():
+    """The adoption is forced to land between a match's snapshot and
+    its overlay — the exact interleaving where a count-based residual
+    skip-check once dropped filters folded mid-batch."""
+    eng, oracle = build_engine()
+    churn(eng, oracle, 1000, 200)  # enough residual to trigger a fold
+    drain_folds(eng)
+    topics = [f"churn/{i % 97}/x/y" for i in range(60)] + [
+        f"seed/{i % 23}/q/r" for i in range(40)
+    ]
+    with tp.collect() as trace, tp.force_ordering(
+        after="match_overlay", block="fold_adopt"
+    ):
+        # the fold assembles concurrently but may only adopt once the
+        # match below has passed its overlay tracepoint
+        churn(eng, oracle, 2000, 100)  # crosses the fold threshold
+        oracle_check(eng, oracle, topics)
+        drain_folds(eng)
+    tp.assert_present(trace, "fold_commit")
+    tp.assert_order(trace, "match_overlay", "fold_commit")
+    # and matches AFTER adoption are equally correct
+    oracle_check(eng, oracle, topics)
+
+
+def test_fold_adopts_before_overlay_of_older_snapshot():
+    """Mirror image: a match snapshots, the fold adopts, THEN the
+    match overlays against its (older) snapshot — entries between the
+    two watermarks must come from the residual view, not be lost."""
+    eng, oracle = build_engine()
+    churn(eng, oracle, 1000, 200)
+    drain_folds(eng)
+    topics = [f"churn/{i % 97}/x/y" for i in range(60)]
+
+    adopted = threading.Event()
+
+    def matcher():
+        oracle_check(eng, oracle, topics)
+
+    with tp.collect() as trace:
+        with tp.force_ordering(after="match_snapshot", block="fold_adopt"):
+            with tp.force_ordering(after="fold_adopt", block="match_overlay"):
+                t = threading.Thread(target=matcher)
+                churn(eng, oracle, 2000, 100)  # triggers the fold
+                t.start()
+                t.join(20)
+                assert not t.is_alive()
+        drain_folds(eng)
+    tp.assert_present(trace, "fold_commit")
+    tp.assert_order(trace, "match_snapshot", "fold_commit")
+    tp.assert_order(trace, "fold_adopt", "match_overlay")
+    oracle_check(eng, oracle, topics)
+
+
+def test_base_swap_discards_inflight_fold():
+    """A base rebuild swapping mid-fold must discard the fold (its
+    inputs predate the new base), and matching stays oracle-equal."""
+    eng, oracle = build_engine()
+    eng.background_rebuild = True
+    eng.rebuild_threshold = 250
+    topics = [f"churn/{i % 97}/x/y" for i in range(60)]
+    with tp.collect() as trace:
+        with tp.force_ordering(after="daut_drop", block="fold_assemble_done"):
+            # cross BOTH thresholds: a fold starts, then the base
+            # rebuild (threshold 250) starts and swaps while the fold
+            # is pinned pre-adoption
+            churn(eng, oracle, 3000, 400)
+            import time
+            deadline = time.time() + 15
+            while time.time() < deadline and not tp.events_of(
+                trace, "daut_drop"
+            ):
+                eng.match_batch(["churn/1/x/y"])  # polls the swap
+                time.sleep(0.02)
+        drain_folds(eng)
+    tp.assert_present(trace, "daut_drop")
+    tp.assert_present(trace, "fold_discard")
+    tp.assert_absent(
+        trace, "fold_commit",
+        gen=tp.assert_present(trace, "fold_discard")["gen"],
+    )
+    oracle_check(eng, oracle, topics)
+
+
+def test_fold_failure_injection_keeps_matching():
+    """An injected crash in the fold thread must leave matching on the
+    residual overlay, oracle-equal, and a later fold recovers."""
+    eng, oracle = build_engine()
+    topics = [f"churn/{i % 97}/x/y" for i in range(60)]
+    with tp.collect() as trace:
+        with tp.inject("fold_assemble_done", RuntimeError("injected")):
+            churn(eng, oracle, 1000, 200)
+            drain_folds(eng)
+            oracle_check(eng, oracle, topics)
+        # next fold (no injection) recovers the device tier
+        churn(eng, oracle, 5000, 200)
+        drain_folds(eng)
+    assert eng._daut is not None
+    oracle_check(eng, oracle, topics)
