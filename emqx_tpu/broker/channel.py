@@ -69,6 +69,21 @@ _V3_CONNACK = {
 
 
 class Channel:
+    # lazily-resolved metric slot tuples (shared: one registry per
+    # process), so the per-packet hot path pays one lock per group
+    # instead of one per counter
+    _recv_slots = None
+    _sent_slots = None
+    _auth_ok = None
+
+    def _auth_ok_slots(self, m):
+        ok = Channel._auth_ok
+        if ok is None:
+            ok = Channel._auth_ok = m.slots(
+                "client.authorize", "authorization.allow"
+            )
+        return ok
+
     def __init__(
         self,
         broker: Broker,
@@ -100,11 +115,15 @@ class Channel:
     def send_packets(self, packets: List[C.Packet]) -> None:
         if packets and not self._closing:
             m = self.broker.metrics
+            sent = self._sent_slots
+            if sent is None:
+                sent = Channel._sent_slots = tuple(
+                    m.slots("messages.sent", q, "packets.publish.sent")
+                    for q in _QOS_SENT
+                )
             for p in packets:
                 if p.type == C.PUBLISH:
-                    m.inc("messages.sent")
-                    m.inc(_QOS_SENT[p.qos])
-                    m.inc("packets.publish.sent")
+                    m.inc_slots(sent[p.qos])
             self._send(packets)
 
     def close(self, reason: str) -> None:
@@ -510,9 +529,13 @@ class Channel:
 
     def _handle_publish(self, pkt: C.Publish) -> None:
         m = self.broker.metrics
-        m.inc("packets.publish.received")
-        m.inc("messages.received")
-        m.inc(_QOS_RECV[pkt.qos])
+        recv = Channel._recv_slots
+        if recv is None:
+            recv = Channel._recv_slots = tuple(
+                m.slots("packets.publish.received", "messages.received", q)
+                for q in _QOS_RECV
+            )
+        m.inc_slots(recv[pkt.qos])
 
         topic = self._resolve_alias(pkt) if self.version == C.MQTT_V5 else pkt.topic
         if topic is None:
@@ -533,13 +556,13 @@ class Channel:
             return
 
         full_topic = self._mount(topic)
-        m.inc("client.authorize")
         if not self.broker.access.authorize(self.client, PUBLISH, full_topic):
+            m.inc("client.authorize")
             m.inc("authorization.deny")
             m.inc("packets.publish.auth_error")
             self._publish_denied(pkt)
             return
-        m.inc("authorization.allow")
+        m.inc_slots(self._auth_ok_slots(m))
 
         props = {
             k: v for k, v in pkt.properties.items() if k != "topic_alias"

@@ -568,21 +568,68 @@ _FLAG_CHECK = {
 }
 
 
+def _parse_publish_fast(body: bytes, flags: int, ver: int) -> Publish:
+    """Inline decode of the overwhelmingly-common PUBLISH shape (no
+    properties) — the broker's hottest parse.  Anything unusual falls
+    back to the generic `_Reader` path, so semantics are identical."""
+    qos = (flags >> 1) & 0x03
+    if qos == 3:
+        raise MqttError("PUBLISH qos 3")
+    if len(body) < 2:
+        raise MqttError("truncated packet")
+    tl = (body[0] << 8) | body[1]
+    pos = 2 + tl
+    if len(body) < pos + (2 if qos else 0) + (1 if ver == MQTT_V5 else 0):
+        raise MqttError("truncated packet")
+    raw_topic = body[2:pos]
+    try:
+        topic = raw_topic.decode("utf-8")
+    except UnicodeDecodeError:
+        raise MqttError("invalid UTF-8 string")
+    if "\x00" in topic:
+        raise MqttError("NUL in UTF-8 string")
+    pid = None
+    if qos:
+        pid = (body[pos] << 8) | body[pos + 1]
+        if pid == 0:
+            raise MqttError("packet id 0")
+        pos += 2
+    props: Properties = {}
+    if ver == MQTT_V5:
+        if body[pos] == 0:
+            pos += 1
+        else:  # non-empty properties: rare — take the generic path
+            r = _Reader(body, pos)
+            props = _read_properties(r)
+            pos = r.pos
+    return Publish(
+        topic=topic,
+        payload=body[pos:],
+        qos=qos,
+        retain=bool(flags & 0x01),
+        dup=bool(flags & 0x08),
+        packet_id=pid,
+        properties=props,
+    )
+
+
 def parse_frame(ptype: int, flags: int, body: bytes, ver: int) -> Packet:
     """Parse one complete frame body (after the fixed header)."""
-    if ptype != PUBLISH:
-        want = _FLAG_CHECK.get(ptype)
-        if want is None:
-            raise MqttError(f"invalid packet type {ptype}")
-        if flags != want:
-            raise MqttError(f"bad fixed-header flags for type {ptype}")
+    if ptype == PUBLISH:
+        return _parse_publish_fast(body, flags, ver)
+    want = _FLAG_CHECK.get(ptype)
+    if want is None:
+        raise MqttError(f"invalid packet type {ptype}")
+    if flags != want:
+        raise MqttError(f"bad fixed-header flags for type {ptype}")
+    if ptype == PUBACK and len(body) == 2:  # v3 shape / v5 rc omitted
+        pid = (body[0] << 8) | body[1]
+        return Puback(packet_id=pid)
     r = _Reader(body)
     if ptype == CONNECT:
         pkt: Packet = _parse_connect(r)
     elif ptype == CONNACK:
         pkt = _parse_connack(r, ver)
-    elif ptype == PUBLISH:
-        pkt = _parse_publish(r, flags, ver)
     elif ptype == PUBACK:
         pkt = _parse_puback_like(Puback, r, ver)
     elif ptype == PUBREC:
@@ -802,6 +849,39 @@ def _ser_auth(p: Auth) -> Tuple[int, bytes]:
 def serialize(pkt: Packet, version: int = MQTT_V5) -> bytes:
     """Serialize a packet for the given negotiated protocol version."""
     t = pkt.type
+    if t == PUBLISH and not pkt.properties:
+        # hot path: a handful of C-level joins, no per-byte Python work
+        qos = pkt.qos
+        if qos not in (0, 1, 2):
+            raise MqttError("bad qos")
+        flags = (0x08 if pkt.dup else 0) | (qos << 1) | (
+            0x01 if pkt.retain else 0
+        )
+        topic = pkt.topic.encode("utf-8")
+        tl = len(topic)
+        if tl > 65535:
+            raise MqttError("string too long")
+        if qos:
+            if not pkt.packet_id:
+                raise MqttError("qos>0 publish without packet id")
+            mid = struct.pack(">H", pkt.packet_id)
+        else:
+            mid = b""
+        tail = (b"\x00" + pkt.payload if version == MQTT_V5
+                else pkt.payload)
+        rlen = 2 + tl + len(mid) + len(tail)
+        if rlen < 128:  # 1-byte varint: the common frame
+            return b"".join((
+                struct.pack(">BBH", (PUBLISH << 4) | flags, rlen, tl),
+                topic, mid, tail,
+            ))
+        return b"".join((
+            bytes(((PUBLISH << 4) | flags,)), _varint(rlen),
+            struct.pack(">H", tl), topic, mid, tail,
+        ))
+    if t == PUBACK and not pkt.reason_code and not pkt.properties:
+        pid = pkt.packet_id
+        return bytes((PUBACK << 4, 2, pid >> 8, pid & 0xFF))
     if t == CONNECT:
         flags, body = _ser_connect(pkt)  # version taken from the packet
     elif t == CONNACK:

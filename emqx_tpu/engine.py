@@ -117,6 +117,7 @@ class MatchEngine:
         use_device: Optional[bool] = None,
         background_rebuild: bool = False,
         delta_aut_threshold: int = 1024,
+        delta_fold_factor: int = 2,
     ) -> None:
         self.max_levels = max_levels
         self.f_width = f_width
@@ -125,6 +126,12 @@ class MatchEngine:
         self.use_device = use_device
         self.background_rebuild = background_rebuild
         self.delta_aut_threshold = delta_aut_threshold
+        # fold when the residual reaches delta/factor: a smaller factor
+        # folds less often (less background assemble stealing the GIL
+        # from the insert thread), at the cost of a larger host-matched
+        # residual between folds — profiled best at 2 for sustained
+        # 100k-scale churn
+        self.delta_fold_factor = delta_fold_factor
         self._exact: Dict[str, Set[Hashable]] = {}
         self._wild = make_trie()  # full wildcard set: fallback + rebuild source
         # wildcard filters added since last build: fid -> words.  A
@@ -265,8 +272,21 @@ class MatchEngine:
                 self._delta[fid] = ws
                 if seq:
                     self._delta_seq[fid] = seq
-                    self._residual_log.append((fid, seq))
+                    log = self._residual_log
+                    log.append((fid, seq))
                     self._residual_count += 1
+                    if len(log) > 1024 and len(log) > 4 * max(
+                        self._residual_count, 1
+                    ):
+                        # amortized compaction: churn that never crosses
+                        # the fold threshold (or runs with the device
+                        # off) must not grow the log without bound
+                        wm = self._fold_watermark
+                        dseq = self._delta_seq
+                        self._residual_log = [
+                            e for e in log
+                            if e[1] > wm and dseq.get(e[0]) == e[1]
+                        ]
                 if self._building:
                     self._pending_inserts.append((flt, fid))
                 if len(self._delta) >= self.rebuild_threshold:
@@ -276,7 +296,8 @@ class MatchEngine:
                         self.rebuild()
                 if self.use_device is not False and (
                     self._residual_count
-                    >= max(self.delta_aut_threshold, len(self._delta) // 4)
+                    >= max(self.delta_aut_threshold,
+                           len(self._delta) // self.delta_fold_factor)
                 ):
                     self._fold_delta_aut()
         else:
@@ -442,11 +463,13 @@ class MatchEngine:
             full_items = None
         deleted_snap = set(self._deleted_daut)
         snap_seq = self._wild.last_seq()
-        self._folding = True
-        self._fold_deletes = set()
         gen = self._fold_gen
+        # fire BEFORE flipping _folding: a tp-harness exception here
+        # (injection / ordering timeout) must not wedge folds off
         tp("fold_capture", gen=gen, snap_seq=snap_seq,
            n_new=len(new_items))
+        self._folding = True
+        self._fold_deletes = set()
 
         def work():
             aut = None
@@ -488,8 +511,15 @@ class MatchEngine:
                     self._folding = False
                 return
             # blocking tracepoint OUTSIDE the lock: force_ordering may
-            # pin the adoption here while a match holds/needs _mlock
-            tp("fold_adopt", gen=gen)
+            # pin the adoption here while a match holds/needs _mlock.
+            # A harness exception (ordering timeout) must release
+            # _folding or no fold would ever run again.
+            try:
+                tp("fold_adopt", gen=gen)
+            except BaseException:
+                with self._mlock:
+                    self._folding = False
+                raise
             with self._mlock:
                 self._folding = False
                 if self._fold_gen != gen:
@@ -679,11 +709,12 @@ class MatchEngine:
                 fid: s for fid, s in self._delta_seq.items() if fid in delta
             }
             self._fold_watermark = self._rebuild_snap_seq
+            # rebuild the log from _delta_seq, NOT the old log: a fold
+            # committing mid-build pruned the log past ITS watermark,
+            # which is ahead of the rebuild snapshot — every pending
+            # delta entry post-dates the snapshot, so all are residual
             self._residual_log = [
-                (fid, seq)
-                for fid, seq in self._residual_log
-                if seq > self._fold_watermark
-                and self._delta_seq.get(fid) == seq
+                (fid, s) for fid, s in self._delta_seq.items()
             ]
             self._residual_count = len(self._residual_log)
             self._drop_delta_aut()

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 # metric names mirror the reference's ?BYTES_METRICS / ?PACKET_METRICS /
 # ?MESSAGE_METRICS tables (emqx_metrics.erl:45-150)
@@ -116,6 +116,24 @@ class Metrics:
                 self._extra[name] = self._extra.get(name, 0) + by
             else:
                 self._c[i] += by
+
+    def slots(self, *names: str) -> Tuple[int, ...]:
+        """Pre-resolve registry names to slot indices for `inc_slots`
+        (hot paths bump several counters per packet; one lock+loop
+        beats N inc() calls)."""
+        out = []
+        for n in names:
+            i = _SLOT.get(n)
+            if i is None:
+                raise KeyError(f"not a registry metric: {n}")
+            out.append(i)
+        return tuple(out)
+
+    def inc_slots(self, slots: Tuple[int, ...], by: int = 1) -> None:
+        c = self._c
+        with self._lock:
+            for i in slots:
+                c[i] += by
 
     def val(self, name: str) -> int:
         i = _SLOT.get(name)

@@ -40,7 +40,7 @@ _lib_failed = False
 def _build() -> None:
     os.makedirs(os.path.dirname(_SO), exist_ok=True)
     subprocess.run(
-        ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-Wall", "-o", _SO, _SRC],
+        ["g++", "-O3", "-fPIC", "-shared", "-std=c++20", "-Wall", "-o", _SO, _SRC],
         check=True,
         capture_output=True,
     )

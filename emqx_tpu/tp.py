@@ -66,9 +66,9 @@ def _fire(point: str, fields: Dict[str, Any]) -> None:
             elif point == block and not evt.is_set():
                 waiters.append(evt)
     for evt in waiters:  # wait OUTSIDE the lock (the releaser needs it)
-        if not evt.wait(10.0):
+        if not evt.wait(30.0):
             raise TimeoutError(
-                f"force_ordering: {point!r} waited 10s for its trigger"
+                f"force_ordering: {point!r} waited 30s for its trigger"
             )
     if exc is not None:
         raise exc

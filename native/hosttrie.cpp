@@ -16,14 +16,29 @@
 #include <cstdint>
 #include <cstring>
 #include <string>
+#include <string_view>
 #include <unordered_map>
-#include <unordered_set>
 #include <vector>
 
 namespace {
 
+// heterogeneous string hashing: lookups take string_views of the
+// incoming topic bytes, so the hot path allocates no level strings
+struct SvHash {
+    using is_transparent = void;
+    size_t operator()(std::string_view sv) const noexcept {
+        return std::hash<std::string_view>{}(sv);
+    }
+    size_t operator()(const std::string& s) const noexcept {
+        return std::hash<std::string_view>{}(s);
+    }
+};
+
+using ChildMap =
+    std::unordered_map<std::string, int32_t, SvHash, std::equal_to<>>;
+
 struct Node {
-    std::unordered_map<std::string, int32_t> children;
+    ChildMap children;
     // fid -> insertion sequence number; the seq tags let one trie
     // serve both the full set (ht_match) and the "inserted since the
     // last fold watermark" residual view (ht_match_since) without a
@@ -61,22 +76,25 @@ struct Trie {
 };
 
 // split on '/', preserving empty levels ("a//b" -> ["a", "", "b"]);
-// "" -> [""] (one empty level), matching emqx_tpu.topic.words
-static void split_levels(const char* s, std::vector<std::string>& out) {
+// "" -> [""] (one empty level), matching emqx_tpu.topic.words.
+// string_views into the caller's buffer: zero allocations.
+static void split_levels(const char* s, std::vector<std::string_view>& out) {
     out.clear();
     const char* start = s;
     const char* p = s;
     for (;; ++p) {
         if (*p == '/' || *p == '\0') {
-            out.emplace_back(start, p - start);
+            out.emplace_back(start, (size_t)(p - start));
             if (*p == '\0') break;
             start = p + 1;
         }
     }
 }
 
+thread_local std::vector<std::string_view> tl_ws;
+
 static void remove_path(Trie* t, const std::string& flt, int64_t fid) {
-    std::vector<std::string> ws;
+    std::vector<std::string_view> ws;
     split_levels(flt.c_str(), ws);
     bool terminal_hash = !ws.empty() && ws.back() == "#";
     size_t body = terminal_hash ? ws.size() - 1 : ws.size();
@@ -127,7 +145,7 @@ int64_t ht_insert(void* h, const char* flt, int64_t fid) {
         if (it->second == flt) return 0;
         remove_path(t, it->second, fid);
     }
-    std::vector<std::string> ws;
+    auto& ws = tl_ws;
     split_levels(flt, ws);
     bool terminal_hash = !ws.empty() && ws.back() == "#";
     size_t body = terminal_hash ? ws.size() - 1 : ws.size();
@@ -138,7 +156,7 @@ int64_t ht_insert(void* h, const char* flt, int64_t fid) {
         if (cit == ch.end()) {
             int32_t nn = t->alloc();
             // alloc() may reallocate nodes; re-find the child map
-            t->nodes[node].children.emplace(ws[i], nn);
+            t->nodes[node].children.emplace(std::string(ws[i]), nn);
             node = nn;
         } else {
             node = cit->second;
@@ -154,7 +172,7 @@ int64_t ht_insert(void* h, const char* flt, int64_t fid) {
     node = 0;
     t->nodes[0].max_seq = seq;
     for (size_t i = 0; i < body; ++i) {
-        node = t->nodes[node].children[ws[i]];
+        node = t->nodes[node].children.find(ws[i])->second;
         t->nodes[node].max_seq = seq;
     }
     return seq;
@@ -177,7 +195,7 @@ int32_t ht_delete(void* h, int64_t fid) {
 // retry when the return exceeds cap).
 int64_t ht_match(void* h, const char* topic, int64_t* out, int64_t cap) {
     Trie* t = static_cast<Trie*>(h);
-    std::vector<std::string> name;
+    std::vector<std::string_view> name;
     split_levels(topic, name);
     bool dollar = !name.empty() && !name[0].empty() && name[0][0] == '$';
     int64_t n = 0;
@@ -203,7 +221,7 @@ int64_t ht_match(void* h, const char* topic, int64_t* out, int64_t cap) {
         auto lit = ch.find(name[i]);
         if (lit != ch.end()) stack.emplace_back(lit->second, i + 1);
         if (!(dollar && i == 0)) {
-            auto plus = ch.find("+");
+            auto plus = ch.find(std::string_view("+", 1));
             if (plus != ch.end()) stack.emplace_back(plus->second, i + 1);
         }
     }
@@ -216,7 +234,7 @@ int64_t ht_match(void* h, const char* topic, int64_t* out, int64_t cap) {
 int64_t ht_match_since(void* h, const char* topic, int64_t min_seq,
                        int64_t* out, int64_t cap) {
     Trie* t = static_cast<Trie*>(h);
-    std::vector<std::string> name;
+    std::vector<std::string_view> name;
     split_levels(topic, name);
     bool dollar = !name.empty() && !name[0].empty() && name[0][0] == '$';
     int64_t n = 0;
@@ -243,7 +261,7 @@ int64_t ht_match_since(void* h, const char* topic, int64_t min_seq,
         if (lit != ch.end() && t->nodes[lit->second].max_seq >= min_seq)
             stack.emplace_back(lit->second, i + 1);
         if (!(dollar && i == 0)) {
-            auto plus = ch.find("+");
+            auto plus = ch.find(std::string_view("+", 1));
             if (plus != ch.end() && t->nodes[plus->second].max_seq >= min_seq)
                 stack.emplace_back(plus->second, i + 1);
         }

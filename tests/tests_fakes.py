@@ -11,3 +11,17 @@ class FakeChannel:
 
     def close(self, reason):
         self.closed = reason
+
+
+def drain_folds(eng, timeout=15.0):
+    """Wait until the engine has no fold in flight (shared test util)."""
+    import time
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        t = eng._fold_thread
+        if t is not None and t.is_alive():
+            t.join(0.1)
+        elif not eng._folding:
+            return
+    raise TimeoutError("fold never drained")
