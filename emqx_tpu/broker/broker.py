@@ -319,15 +319,27 @@ class Broker:
                 # once on its eventual discard/termination
                 session.gate_filters.add(flt)
         replayed = 0
-        for flt, msg in self.durable.replay(state):
-            opts = session.subscriptions.get(flt)
-            if opts is None:
-                continue
-            qos = session._effective_qos(msg.qos, opts)
-            if qos == 0 and not self.config.mqtt.mqueue_store_qos0:
-                continue
-            session.mqueue.insert(session._queued(msg, opts, max(qos, 0)))
-            replayed += 1
+        while True:
+            msgs, done = self.durable.replay_chunk(state)
+            for flt, msg in msgs:
+                opts = session.subscriptions.get(flt)
+                if opts is None:
+                    continue
+                qos = session._effective_qos(msg.qos, opts)
+                if qos == 0 and not self.config.mqtt.mqueue_store_qos0:
+                    continue
+                session.mqueue.insert(
+                    session._queued(msg, opts, max(qos, 0))
+                )
+                replayed += 1
+            if done:
+                break
+            # NOTE: the iterator cursors are NOT checkpointed here.
+            # Chunk messages live only in the in-memory mqueue until
+            # the client drains them — persisting advanced cursors now
+            # would skip those messages if we crash before delivery.
+            # Chunking bounds replay memory; save_state is for callers
+            # that durably hand off each chunk before advancing.
         self.durable.discard(clientid)  # live again; saved on disconnect
         self.metrics.inc("session.resumed")
         self.hooks.run("session.resumed", clientid)

@@ -25,6 +25,7 @@ from typing import Dict, List, Optional, Tuple
 from .. import topic as T
 from ..engine import MatchEngine
 from ..message import Message
+from .api import IterRef
 from .builtin_local import LocalStorage
 
 
@@ -38,22 +39,35 @@ class SessionState:
         subs: Dict[str, Dict],
         expiry: float,
         disconnected_at: float,
+        iters: Optional[Dict[str, List[Dict]]] = None,
     ) -> None:
         self.clientid = clientid
         self.subs = subs  # filter -> SubOpts-as-dict
         self.expiry = expiry
         self.disconnected_at = disconnected_at
+        # replay progress: filter -> list of IterRef json cursors.
+        # None = replay not started; persisted mid-replay so a crash
+        # resumes from the cursors instead of re-reading from
+        # disconnected_at (the reference persists per-stream progress
+        # the same way, emqx_persistent_session_ds_stream_scheduler).
+        self.iters = iters
+        # transient: message-id dedup across overlapping filters within
+        # ONE replay run (lost on crash — replay is at-least-once)
+        self._replay_seen: set = set()
 
     def expired(self, now: float) -> bool:
         return now - self.disconnected_at > self.expiry
 
     def to_json(self) -> Dict:
-        return {
+        out = {
             "clientid": self.clientid,
             "subs": self.subs,
             "expiry": self.expiry,
             "disconnected_at": self.disconnected_at,
         }
+        if self.iters is not None:
+            out["iters"] = self.iters
+        return out
 
     @staticmethod
     def from_json(obj: Dict) -> "SessionState":
@@ -62,6 +76,7 @@ class SessionState:
             subs=obj["subs"],
             expiry=obj["expiry"],
             disconnected_at=obj["disconnected_at"],
+            iters=obj.get("iters"),
         )
 
 
@@ -221,29 +236,73 @@ class DurableSessions:
     def sync(self) -> None:
         self.storage.sync()
 
-    def replay(
-        self, state: SessionState
-    ) -> List[Tuple[str, Message]]:
-        """Messages persisted since the checkpoint, per matching filter,
-        deduped by message id across overlapping filters; ordered by
-        storage order within each stream."""
-        since_us = int(state.disconnected_at * 1e6)
-        seen: set = set()
+    def replay_chunk(
+        self, state: SessionState, max_msgs: int = 1024
+    ) -> Tuple[List[Tuple[str, Message]], bool]:
+        """Up to ``max_msgs`` messages persisted since the checkpoint,
+        advancing the state's per-(filter, stream) iterator cursors.
+        A caller that durably hands off each chunk may checkpoint the
+        cursors between chunks (`save_state`) so a crash resumes
+        mid-interval; a caller that only buffers in memory (the
+        broker's resume path) must NOT, or a crash would skip the
+        buffered chunk — chunking still bounds its replay memory.
+        Returns ``(messages, done)``; message ids dedup across
+        overlapping filters within one run (at-least-once across a
+        crash)."""
+        if state.iters is None:
+            since_us = int(state.disconnected_at * 1e6)
+            state.iters = {
+                flt: [
+                    self.storage.make_iterator(s, flt, since_us).to_json()
+                    for s in self.storage.get_streams(flt, since_us)
+                ]
+                for flt in state.subs
+                # shared subs don't replay ([MQTT-4.8.2-27])
+                if not T.parse_share(flt)
+            }
+        seen = state._replay_seen
         out: List[Tuple[str, Message]] = []
-        for flt in state.subs:
-            if T.parse_share(flt):
-                continue  # shared subs don't replay ([MQTT-4.8.2-27])
-            for stream in self.storage.get_streams(flt, since_us):
-                it = self.storage.make_iterator(stream, flt, since_us)
-                while True:
-                    it, msgs = self.storage.next(it, 256)
+        for flt, cursors in state.iters.items():
+            i = 0
+            while i < len(cursors):
+                it = IterRef.from_json(cursors[i])
+                exhausted = False
+                while len(out) < max_msgs:
+                    it, msgs = self.storage.next(
+                        it, min(256, max_msgs - len(out))
+                    )
                     if not msgs:
+                        exhausted = True
                         break
                     for msg in msgs:
                         if msg.mid not in seen:
                             seen.add(msg.mid)
                             out.append((flt, msg))
-        return out
+                if exhausted:
+                    cursors.pop(i)
+                else:  # budget hit: persist progress, come back later
+                    cursors[i] = it.to_json()
+                    return out, False
+        state.iters = {f: c for f, c in state.iters.items() if c}
+        return out, not any(state.iters.values())
+
+    def save_state(self, state: SessionState) -> None:
+        """Persist a state object as-is (mid-replay checkpoint)."""
+        tmp = self._state_path(state.clientid) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state.to_json(), f)
+        os.replace(tmp, self._state_path(state.clientid))
+
+    def replay(
+        self, state: SessionState
+    ) -> List[Tuple[str, Message]]:
+        """Whole-interval replay (chunked under the hood)."""
+        out: List[Tuple[str, Message]] = []
+        while True:
+            msgs, done = self.replay_chunk(state)
+            out.extend(msgs)
+            if done:
+                return out
 
     def close(self) -> None:
         self.storage.close()
