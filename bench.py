@@ -536,11 +536,11 @@ def main():
             aut.code_off, aut.code_idx, codes, inv
         )
         fids = fid_arr[pos]  # flat (topic_row, fid) pairs
-        return rows, fids, np.asarray(counts)[:n_uniq], np.asarray(ovf)[:n_uniq][inv]
+        return rows, fids, np.asarray(ovf)[:n_uniq][inv]
 
     # warmup / compile
     t0 = time.perf_counter()
-    rows, fids, counts, ovf = drain(submit(streams[0]))
+    rows, fids, ovf = drain(submit(streams[0]))
     log(f"compile+first batch: {time.perf_counter() - t0:.2f}s; "
         f"ovf={int(ovf.sum())} mean_fanout={len(fids) / batch:.2f}")
 
@@ -579,11 +579,11 @@ def main():
     for s in streams:
         inflight.append(submit(s))
         if len(inflight) >= depth:
-            rows, fids, counts, ovf = drain(inflight.popleft())
+            rows, fids, ovf = drain(inflight.popleft())
             total_matches += len(fids)
             ovf_total += int(ovf.sum())
     while inflight:
-        rows, fids, counts, ovf = drain(inflight.popleft())
+        rows, fids, ovf = drain(inflight.popleft())
         total_matches += len(fids)
         ovf_total += int(ovf.sum())
     elapsed = time.perf_counter() - t_start

@@ -257,6 +257,20 @@ class BrokerServer:
                     port=int(gw_cfg.get("port", 5683)),
                 )
             )
+        elif kind == "exproto":
+            from ..gateway.exproto import ExprotoGateway
+
+            await self.broker.gateways.load(
+                ExprotoGateway(
+                    self.broker,
+                    bind=gw_cfg.get("bind", "0.0.0.0"),
+                    port=int(gw_cfg.get("port", 7993)),
+                    handler_address=gw_cfg.get(
+                        "handler", "127.0.0.1:9100"
+                    ),
+                    adapter_bind=gw_cfg.get("adapter_bind", "127.0.0.1:0"),
+                )
+            )
         else:
             log.warning("unknown gateway type %r ignored", kind)
 

@@ -945,12 +945,21 @@ class MatchEngine:
                     0,  # rows used
                 ]
             index, mat, lens, dol, used = entry
-            # the hard-cap reset may only happen at a batch BOUNDARY:
-            # a mid-batch reset would re-point rows already recorded in
-            # this batch's idx array at other topics' tokens
+            # the hard-cap reset may only happen at a batch BOUNDARY,
+            # and must allocate FRESH arrays: an in-flight batch on
+            # another thread still gathers from the old ones after
+            # releasing this mutex, so rows must never be overwritten
+            # under it (growth and dict-clear paths already reallocate)
             if used >= 262144:
-                index.clear()
-                used = 0
+                cap = 4096
+                entry = self._enc_cache[levels] = [
+                    {},
+                    np.full((cap, levels), PAD_TOK, np.int32),
+                    np.zeros(cap, np.int32),
+                    np.zeros(cap, bool),
+                    0,
+                ]
+                index, mat, lens, dol, used = entry
             b = len(words)
             idx = np.empty(b, np.int64)
             get = self._tdict.get

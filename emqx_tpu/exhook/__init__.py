@@ -3,51 +3,21 @@ EMQX (reference contract: /root/reference/apps/emqx_exhook/priv/protos/
 exhook.proto; bridge semantics: emqx_exhook_handler.erl:230-236).
 
 `exhook_pb2` is generated from proto/exhook.proto with protoc on demand
-(no grpc_tools in this environment; the service layer is hand-wired
-generic handlers in server.py).
+(shared codegen plumbing: emqx_tpu.grpc_util; the service layer is
+hand-wired generic handlers in server.py).
 """
 
 from __future__ import annotations
 
 import os
-import subprocess
-import sys
+
+from ..grpc_util import ensure_pb2
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _REPO = os.path.dirname(os.path.dirname(_HERE))
-_PROTO = os.path.join(_REPO, "proto", "exhook.proto")
-_PB2 = os.path.join(_HERE, "exhook_pb2.py")
 
-
-def ensure_pb2():
-    if not os.path.exists(_PB2) or os.path.getmtime(_PB2) < os.path.getmtime(
-        _PROTO
-    ):
-        try:
-            subprocess.run(
-                [
-                    "protoc",
-                    "-I",
-                    os.path.dirname(_PROTO),
-                    "--python_out=" + _HERE,
-                    _PROTO,
-                ],
-                check=True,
-                capture_output=True,
-            )
-        except (OSError, subprocess.CalledProcessError):
-            # no protoc (or failed run): the committed exhook_pb2.py is
-            # authoritative — mtimes after a fresh checkout are
-            # arbitrary, so a stale-looking file is not an error
-            if not os.path.exists(_PB2):
-                raise
-    if _HERE not in sys.path:
-        sys.path.insert(0, _HERE)
-    import exhook_pb2  # noqa: F401
-
-    return exhook_pb2
-
-
-pb = ensure_pb2()
+pb = ensure_pb2(
+    os.path.join(_REPO, "proto", "exhook.proto"), _HERE, "exhook_pb2"
+)
 
 from .server import ExhookServer  # noqa: E402,F401

@@ -1,0 +1,42 @@
+"""Shared gRPC plumbing: on-demand protoc codegen.
+
+No grpc_tools exists in this environment, so protobuf message modules
+are generated with the system ``protoc`` when the ``.proto`` is newer,
+and the committed ``*_pb2.py`` is the fallback — mtimes after a fresh
+checkout are arbitrary, so a stale-looking file is not an error unless
+it is missing entirely.  Used by the exhook server and the exproto
+gateway."""
+
+from __future__ import annotations
+
+import importlib
+import os
+import subprocess
+import sys
+
+
+def ensure_pb2(proto_path: str, out_dir: str, module_name: str):
+    """Generate (if possible) and import ``module_name`` from
+    ``out_dir``, regenerating from ``proto_path`` when it is newer."""
+    pb2_path = os.path.join(out_dir, module_name + ".py")
+    if not os.path.exists(pb2_path) or os.path.getmtime(
+        pb2_path
+    ) < os.path.getmtime(proto_path):
+        try:
+            subprocess.run(
+                [
+                    "protoc",
+                    "-I",
+                    os.path.dirname(proto_path),
+                    "--python_out=" + out_dir,
+                    proto_path,
+                ],
+                check=True,
+                capture_output=True,
+            )
+        except (OSError, subprocess.CalledProcessError):
+            if not os.path.exists(pb2_path):
+                raise
+    if out_dir not in sys.path:
+        sys.path.insert(0, out_dir)
+    return importlib.import_module(module_name)

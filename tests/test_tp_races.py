@@ -55,8 +55,15 @@ def test_fold_adopts_inside_match_window():
         after="match_overlay", block="fold_adopt"
     ):
         # the fold assembles concurrently but may only adopt once the
-        # match below has passed its overlay tracepoint
-        churn(eng, oracle, 2000, 100)  # crosses the fold threshold
+        # match below has passed its overlay tracepoint.  Churn until a
+        # fold actually captures: the geometric threshold depends on
+        # where the previous fold's watermark landed.
+        for round_ in range(50):
+            if tp.events_of(trace, "fold_capture"):
+                break
+            churn(eng, oracle, 2000 + round_ * 100, 100)
+        else:
+            raise AssertionError("fold never captured")
         oracle_check(eng, oracle, topics)
         drain_folds(eng)
     tp.assert_present(trace, "fold_commit")
@@ -83,9 +90,14 @@ def test_fold_adopts_before_overlay_of_older_snapshot():
         with tp.force_ordering(after="match_snapshot", block="fold_adopt"):
             with tp.force_ordering(after="fold_commit", block="match_overlay"):
                 t = threading.Thread(target=matcher)
-                churn(eng, oracle, 2000, 100)  # triggers the fold
+                for round_ in range(50):
+                    if tp.events_of(trace, "fold_capture"):
+                        break
+                    churn(eng, oracle, 2000 + round_ * 100, 100)
+                else:
+                    raise AssertionError("fold never captured")
                 t.start()
-                t.join(20)
+                t.join(30)
                 assert not t.is_alive()
         drain_folds(eng)
     tp.assert_present(trace, "fold_commit")
