@@ -189,6 +189,21 @@ class BrokerServer:
                 self.broker, cfg.cluster_name, cfg.cluster_links
             )
             await self.cluster_links.start()
+        if cfg.ft.enable and cfg.ft.s3:
+            from ..s3 import S3Client, S3Sink
+
+            s3c = cfg.ft.s3
+            self.broker.ft.s3_exporter = await self.broker.resources.create(
+                "ft:s3",
+                S3Sink(S3Client(
+                    s3c["endpoint"],
+                    s3c["bucket"],
+                    s3c.get("access_key", ""),
+                    s3c.get("secret_key", ""),
+                    region=s3c.get("region", "us-east-1"),
+                )),
+                max_buffer=256,
+            )
         if cfg.otel.enable:
             from ..otel import OtelExporter
 

@@ -124,6 +124,10 @@ class FtConfig:
     storage_dir: str = "data/ft"
     max_file_size: int = 256 * 1024 * 1024
     transfer_ttl: float = 3600.0
+    # optional S3 export of assembled files (emqx_ft's s3 storage
+    # backend): {"endpoint", "bucket", "access_key", "secret_key",
+    # "region"} — empty dict disables
+    s3: Dict[str, str] = field(default_factory=dict)
 
 
 @dataclass

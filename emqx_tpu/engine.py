@@ -934,32 +934,27 @@ class MatchEngine:
             if gen != self._enc_gen:
                 self._enc_cache.clear()
                 self._enc_gen = gen
-            entry = self._enc_cache.get(levels)
-            if entry is None:
+            def fresh_entry():
                 cap = 4096
-                entry = self._enc_cache[levels] = [
+                return [
                     {},  # ws tuple -> row index
                     np.full((cap, levels), PAD_TOK, np.int32),
                     np.zeros(cap, np.int32),  # lengths
                     np.zeros(cap, bool),  # dollar
                     0,  # rows used
                 ]
-            index, mat, lens, dol, used = entry
+
+            entry = self._enc_cache.get(levels)
+            if entry is None:
+                entry = self._enc_cache[levels] = fresh_entry()
             # the hard-cap reset may only happen at a batch BOUNDARY,
             # and must allocate FRESH arrays: an in-flight batch on
             # another thread still gathers from the old ones after
             # releasing this mutex, so rows must never be overwritten
             # under it (growth and dict-clear paths already reallocate)
-            if used >= 262144:
-                cap = 4096
-                entry = self._enc_cache[levels] = [
-                    {},
-                    np.full((cap, levels), PAD_TOK, np.int32),
-                    np.zeros(cap, np.int32),
-                    np.zeros(cap, bool),
-                    0,
-                ]
-                index, mat, lens, dol, used = entry
+            elif entry[4] >= 262144:
+                entry = self._enc_cache[levels] = fresh_entry()
+            index, mat, lens, dol, used = entry
             b = len(words)
             idx = np.empty(b, np.int64)
             get = self._tdict.get

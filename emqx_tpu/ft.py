@@ -56,6 +56,10 @@ class FileTransfer:
         self.transfer_ttl = transfer_ttl
         self.enable = enable
         self._transfers: Dict[str, Transfer] = {}
+        # optional S3 exporter (the emqx_ft s3 storage backend): a
+        # BufferWorker over S3Sink; assembled files upload as
+        # `<fileid>/<name>` alongside the local copy
+        self.s3_exporter = None
         broker.hooks.add("message.publish", self._on_publish, priority=95)
 
     # ------------------------------------------------------------ hook
@@ -147,6 +151,8 @@ class FileTransfer:
         with open(path, "wb") as f:
             f.write(blob)
         self.broker.metrics.inc("ft.assembled")
+        if self.s3_exporter is not None:
+            self.s3_exporter.enqueue((f"{fileid}/{name}", bytes(blob)))
         self._respond(fileid, "ok", path)
         log.info("file transfer %s assembled -> %s", fileid, path)
 

@@ -86,7 +86,12 @@ class ExprotoChannel(GatewayChannel):
             conn=self.conn_id,
             conninfo=pb.ConnInfo(
                 socktype=pb.TCP,
-                peername=pb.Address(host=host, port=int(port or 0)),
+                peername=pb.Address(
+                    host=host,
+                    # peer may be "?" when the socket reset before the
+                    # peername could be read
+                    port=int(port) if port.isdigit() else 0,
+                ),
                 sockname=pb.Address(host=gateway.bind, port=gateway.port),
             ),
         ))
@@ -120,19 +125,26 @@ class ExprotoChannel(GatewayChannel):
         )
 
     def deliver(self, packets) -> None:
-        msgs = [
-            pb.Message(
-                node=self.gateway.node,
-                id=pkt.packet_id and str(pkt.packet_id) or "",
-                qos=pkt.qos,
-                topic=pkt.topic,
-                payload=bytes(pkt.payload),
-                timestamp=int(time.time() * 1000),
-            )
-            for pkt in packets
-            if pkt.type == C.PUBLISH
-        ]
-        if msgs:
+        # iterative settle: each puback can dequeue ANOTHER packet from
+        # the session's backlog (recursing here would stack one frame
+        # per queued message)
+        pending = list(packets)
+        while pending:
+            batch, pending = pending, []
+            msgs = [
+                pb.Message(
+                    node=self.gateway.node,
+                    id=pkt.packet_id and str(pkt.packet_id) or "",
+                    qos=pkt.qos,
+                    topic=pkt.topic,
+                    payload=bytes(pkt.payload),
+                    timestamp=int(time.time() * 1000),
+                )
+                for pkt in batch
+                if pkt.type == C.PUBLISH
+            ]
+            if not msgs:
+                return
             self.call_handler(
                 "OnReceivedMessages",
                 pb.ReceivedMessagesRequest(conn=self.conn_id, messages=msgs),
@@ -141,11 +153,11 @@ class ExprotoChannel(GatewayChannel):
             # deliveries settle on handoff (the reference treats the
             # handler service as the terminal hop the same way)
             if self.session is not None:
-                for pkt in packets:
+                for pkt in batch:
                     if pkt.type == C.PUBLISH and pkt.packet_id:
                         _ok, follow = self.session.puback(pkt.packet_id)
                         if follow:
-                            self.deliver(follow)
+                            pending.extend(follow)
 
     def connection_lost(self, reason: str) -> None:
         if self._keepalive_task is not None:

@@ -1,0 +1,166 @@
+"""Minimal S3 client + uploader resource (AWS Signature V4).
+
+The `emqx_s3` role (/root/reference/apps/emqx_s3/src/emqx_s3_client.erl
+thin client over erlcloud, emqx_s3_uploader.erl): enough of the S3
+REST API to PUT/GET/DELETE objects — the operations the file-transfer
+exporter and data bridges need — against AWS or any S3-compatible
+store (MinIO etc.), with no SDK dependency: SigV4 signing is ~50 lines
+of hmac/sha256 over the canonical request, implemented here from the
+public signature spec.
+
+`S3Sink` adapts the client onto the buffered resource layer, so rule
+actions and the file-transfer exporter get retry/health semantics for
+free."""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import logging
+from typing import Dict, Optional, Tuple
+from urllib.parse import quote
+
+log = logging.getLogger("emqx_tpu.s3")
+
+
+def _sha256(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _hmac(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+class S3Client:
+    """SigV4-signed requests to one bucket."""
+
+    def __init__(
+        self,
+        endpoint: str,  # e.g. https://s3.us-east-1.amazonaws.com or MinIO URL
+        bucket: str,
+        access_key: str,
+        secret_key: str,
+        region: str = "us-east-1",
+    ) -> None:
+        self.endpoint = endpoint.rstrip("/")
+        self.bucket = bucket
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+        self._session = None
+        # virtual-hosted style needs DNS; path-style works everywhere
+        # (MinIO, localstack, AWS) — the reference defaults the same way
+        self.host = self.endpoint.split("://", 1)[-1]
+
+    # ------------------------------------------------------- signing
+
+    def sign(
+        self,
+        method: str,
+        key: str,
+        payload: bytes = b"",
+        now: Optional[datetime.datetime] = None,
+    ) -> Tuple[str, Dict[str, str]]:
+        """Returns (url, headers) for a signed request (SigV4,
+        single-chunk, signed payload)."""
+        now = now or datetime.datetime.now(datetime.timezone.utc)
+        amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+        datestamp = now.strftime("%Y%m%d")
+        path = "/" + self.bucket + "/" + quote(key, safe="/~")
+        payload_hash = _sha256(payload)
+        headers = {
+            "host": self.host,
+            "x-amz-content-sha256": payload_hash,
+            "x-amz-date": amz_date,
+        }
+        signed_headers = ";".join(sorted(headers))
+        canonical = "\n".join(
+            [
+                method,
+                path,
+                "",  # no query string
+                "".join(f"{k}:{headers[k]}\n" for k in sorted(headers)),
+                signed_headers,
+                payload_hash,
+            ]
+        )
+        scope = f"{datestamp}/{self.region}/s3/aws4_request"
+        to_sign = "\n".join(
+            ["AWS4-HMAC-SHA256", amz_date, scope, _sha256(canonical.encode())]
+        )
+        k = _hmac(b"AWS4" + self.secret_key.encode(), datestamp)
+        k = _hmac(k, self.region)
+        k = _hmac(k, "s3")
+        k = _hmac(k, "aws4_request")
+        signature = hmac.new(k, to_sign.encode(), hashlib.sha256).hexdigest()
+        headers["authorization"] = (
+            f"AWS4-HMAC-SHA256 Credential={self.access_key}/{scope}, "
+            f"SignedHeaders={signed_headers}, Signature={signature}"
+        )
+        return self.endpoint + path, headers
+
+    # ------------------------------------------------------- requests
+
+    async def _request(self, method: str, key: str, payload: bytes = b""):
+        import aiohttp
+
+        if self._session is None:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=30)
+            )
+        url, headers = self.sign(method, key, payload)
+        return await self._session.request(
+            method, url, data=payload or None, headers=headers
+        )
+
+    async def put_object(self, key: str, body: bytes) -> None:
+        async with await self._request("PUT", key, body) as resp:
+            if resp.status >= 300:
+                raise RuntimeError(f"s3 put {key}: status {resp.status}")
+
+    async def get_object(self, key: str) -> bytes:
+        async with await self._request("GET", key) as resp:
+            if resp.status >= 300:
+                raise RuntimeError(f"s3 get {key}: status {resp.status}")
+            return await resp.read()
+
+    async def delete_object(self, key: str) -> None:
+        async with await self._request("DELETE", key) as resp:
+            if resp.status >= 300 and resp.status != 404:
+                raise RuntimeError(f"s3 delete {key}: status {resp.status}")
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+
+class S3Sink:
+    """Resource behavior: queries are ``(key, body)`` uploads
+    (emqx_s3_uploader's buffered-upload role via the resource layer)."""
+
+    def __init__(self, client: S3Client) -> None:
+        self.client = client
+
+    async def on_start(self) -> None:
+        pass
+
+    async def on_stop(self) -> None:
+        await self.client.close()
+
+    async def on_query(self, query) -> None:
+        key, body = query
+        await self.client.put_object(key, body)
+
+    async def health_check(self) -> bool:
+        # a signed GET on a probe key: 2xx/404 prove reachability AND
+        # accepted credentials; 401/403 (bad secret, clock skew,
+        # revoked key) must report down or uploads would retry-drop
+        # forever against a sink that can never accept them
+        try:
+            resp = await self.client._request("GET", ".health-probe")
+            async with resp:
+                return resp.status < 500 and resp.status not in (401, 403)
+        except Exception:
+            return False
