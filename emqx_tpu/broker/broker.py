@@ -80,6 +80,9 @@ class Broker:
 
         self.rules = RuleEngine(broker=self)
         self.resources = ResourceManager()
+        # Aggregators attached by rules/bridges (emqx_connector_
+        # aggregator buffers): ticked by the server's 1 Hz housekeeping
+        self.aggregators: List = []
         from ..modules import DelayedPublish, ExclusiveSub, TopicRewrite
 
         self.delayed = DelayedPublish(self)

@@ -300,6 +300,11 @@ class BrokerServer:
                 self.telemetry.tick()
             if self.otel is not None:
                 self.otel.tick()
+            for agg in self.broker.aggregators:
+                try:
+                    agg.tick()
+                except Exception:
+                    log.exception("aggregator tick failed")
 
     async def stop(self) -> None:
         if self._housekeeper is not None:

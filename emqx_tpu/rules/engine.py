@@ -96,6 +96,16 @@ class SinkAction:
     kind: str = "sink"
 
 
+@dataclass
+class AggregateAction:
+    """Push the SELECTed columns into an Aggregator (the
+    emqx_connector_aggregator path: records batch into time-bucketed
+    CSV/JSONL objects and flush to the aggregator's delivery sink)."""
+
+    aggregator: Any  # emqx_tpu.aggregator.Aggregator
+    kind: str = "aggregate"
+
+
 Action = Any
 
 
@@ -298,6 +308,8 @@ class RuleEngine:
             log.info("rule output: %s", selected)
         elif isinstance(action, FunctionAction):
             action.fn(selected, msg)
+        elif isinstance(action, AggregateAction):
+            action.aggregator.push([selected])
         elif isinstance(action, SinkAction):
             if self.broker is None:
                 raise RuntimeError("sink action without a broker")
