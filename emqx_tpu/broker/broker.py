@@ -18,7 +18,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from ..access import AccessControl
 from ..config import BrokerConfig
@@ -133,6 +133,15 @@ class Broker:
             transfer_ttl=ft_cfg.transfer_ttl,
             enable=ft_cfg.enable,
         )
+        # delivery guards: predicates (clientid, msg) -> bool applied
+        # at fan-out, AFTER routing — the last line of defense for
+        # RESERVED ($-prefixed) topics, whose subscriptions can exist
+        # without ever passing the client.subscribe hook (durable
+        # resume, takeover import, boot-window subscribes). Only
+        # consulted for $-topics so the ordinary fan-out path pays
+        # nothing. Cluster linking uses this to pin $LINK/msg delivery
+        # to the peer's agent session.
+        self.delivery_guards: List[Callable[[str, Message], bool]] = []
         # ClusterNode installs itself here (the emqx_external_broker
         # registration point, emqx_broker.erl:379-380): provides
         # match_remote(topics) and forward(msg, nodes)
@@ -328,6 +337,8 @@ class Broker:
                 opts = session.subscriptions.get(flt)
                 if opts is None:
                     continue
+                if not self._delivery_allowed(clientid, msg):
+                    continue
                 qos = session._effective_qos(msg.qos, opts)
                 if qos == 0 and not self.config.mqtt.mqueue_store_qos0:
                     continue
@@ -437,7 +448,9 @@ class Broker:
             session.subscribe(flt, opts)
             self.subscribe(session.clientid, flt, opts, is_new_sub=True)
         for wire in state.get("queued", ()):
-            session.mqueue.insert(msg_from_wire(wire))
+            m = msg_from_wire(wire)
+            if self._delivery_allowed(session.clientid, m):
+                session.mqueue.insert(m)
         now = time.time()
         for pid in state.get("awaiting_rel", ()):
             session.awaiting_rel[int(pid)] = now
@@ -652,6 +665,11 @@ class Broker:
                 rule_sink.append((msg, ids))
             else:
                 self.rules.apply(msg, ids)
+        if self.delivery_guards and msg.topic.startswith("$"):
+            denied = [cid for cid in per_client
+                      if not self._delivery_allowed(cid, msg)]
+            for cid in denied:
+                del per_client[cid]
         if not per_client:
             self.metrics.inc("messages.dropped")
             self.metrics.inc("messages.dropped.no_subscribers")
@@ -662,6 +680,15 @@ class Broker:
             delivered += self._deliver_to(clientid, deliveries)
         self.metrics.inc("messages.delivered", delivered)
         return delivered
+
+    def _delivery_allowed(self, clientid: str, msg: Message) -> bool:
+        """Delivery-guard check; must gate EVERY path that puts a
+        message in front of a session — live fan-out, durable replay,
+        and takeover import — or a hookless subscription could receive
+        reserved-topic traffic the guards exist to pin down."""
+        if self.delivery_guards and msg.topic.startswith("$"):
+            return all(g(clientid, msg) for g in self.delivery_guards)
+        return True
 
     def _shared_pick(
         self,

@@ -171,6 +171,17 @@ class BrokerServer:
                 batch_max=eng_cfg.batch_max,
             )
             await self.broker.batcher.start()
+        cfg = self.broker.config
+        if cfg.cluster_links:
+            from ..cluster_link import ClusterLinks
+
+            # install the $LINK guard hooks BEFORE any listener accepts
+            # a client: a subscribe slipping in ahead of the guard would
+            # siphon forwarded traffic for the session's lifetime
+            self.cluster_links = ClusterLinks(
+                self.broker, cfg.cluster_name, cfg.cluster_links
+            )
+            self.cluster_links.install()
         for lst in self.listeners:
             await lst.start()
         api_cfg = self.broker.config.api
@@ -181,13 +192,7 @@ class BrokerServer:
             await self.api.start()
         for gw_cfg in self.broker.config.gateways:
             await self._load_gateway(gw_cfg)
-        cfg = self.broker.config
-        if cfg.cluster_links:
-            from ..cluster_link import ClusterLinks
-
-            self.cluster_links = ClusterLinks(
-                self.broker, cfg.cluster_name, cfg.cluster_links
-            )
+        if self.cluster_links is not None:
             await self.cluster_links.start()
         if cfg.ft.enable and cfg.ft.s3:
             from ..s3 import S3Client, S3Sink

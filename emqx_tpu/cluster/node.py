@@ -74,6 +74,15 @@ def msg_to_wire(msg: Message) -> Dict[str, Any]:
         "properties": _props_to_wire(msg.properties),
         "sys": msg.sys,
         "dup": msg.dup,
+        # broker-internal headers must survive intra-cluster forwarding:
+        # losing `cluster_origin` on the hop would make the peer node's
+        # LinkServer re-export imported traffic (gossip), and losing
+        # `link_egress` would make its delivery guard drop legitimate
+        # $LINK/msg deliveries (only JSON-scalar values cross the wire)
+        "headers": {
+            k: v for k, v in msg.headers.items()
+            if isinstance(v, (str, int, float, bool)) or v is None
+        },
     }
 
 
@@ -90,6 +99,7 @@ def msg_from_wire(obj: Dict[str, Any]) -> Message:
         properties=_props_from_wire(obj.get("properties") or {}),
         sys=obj.get("sys", False),
         dup=obj.get("dup", False),
+        headers=dict(obj.get("headers") or {}),
     )
 
 

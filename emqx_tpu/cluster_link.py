@@ -17,10 +17,15 @@ same — its link agent is an MQTT client on the remote cluster):
     TO cluster ``{cluster}``; its agent subscribes to exactly this
     topic over the link connection.
 
-Loop prevention is by origin tagging (the reference's
-`emqx_cluster_link:should_route_to_external_dests` dest-check): a
-message carries its origin cluster end-to-end; it is never forwarded
-back to its origin, so even cyclic link topologies cannot echo.
+Loop prevention follows the reference's "no gossip message
+forwarding" rule (emqx_cluster_link.erl:86-89 forward/1): only
+LOCALLY-originated publishes are ever exported; a link-imported
+message (it carries a `cluster_origin` header end-to-end) is
+delivered locally and never re-exported. Cyclic topologies therefore
+cannot echo or storm — and, as in the reference, transitive relay
+through a middle cluster is deliberately unsupported: in a chain
+A—B—C, subscribers on C do not see A's publishes unless A and C are
+linked directly (full-mesh the clusters that need to interoperate).
 
 Both halves live here:
   * `LinkAgent`   — local side of one configured link: pushes route
@@ -28,6 +33,11 @@ Both halves live here:
     allowlist) and imports wrapped messages.
   * `LinkServer`  — accepts route ops from remote agents and forwards
     matching local publishes, via one ``message.publish`` hook.
+
+Compatibility note: agent identity is ``$link:{cluster}:{name}``
+(':'-separated). Earlier builds used '-' separators, which are
+ambiguous for cluster names containing '-'; both ends of a link must
+run a build with the same scheme.
 """
 
 from __future__ import annotations
@@ -115,8 +125,12 @@ class LinkAgent:
         self.name = name
         self.topics = list(topics)
         self._pushed: Set[str] = set()
+        # ':' separates the identity fields unambiguously — with '-' a
+        # peer named "us" and one named "us-east" would have
+        # indistinguishable agent prefixes, letting one configured
+        # peer's agent pass as another's
         self.client = MqttClient(
-            host, port, f"$link-{local_cluster}-{name}",
+            host, port, f"$link:{local_cluster}:{name}",
             username=username, password=password,
         )
         self.client.on_message = self._on_remote
@@ -213,13 +227,27 @@ class LinkAgent:
             return
         if inner.headers.get("cluster_origin") == self.local_cluster:
             return  # never re-import our own traffic
+        if inner.topic.startswith("$"):
+            # imported traffic is data, never control: a peer must not
+            # inject $LINK route ops, $SYS lines, or $delayed commands
+            log.warning("cluster link %s: imported message on reserved "
+                        "topic %r dropped", self.name, inner.topic)
+            return
         self.broker.metrics.inc("cluster_link.ingress")
         self.broker.publish(inner)
 
 
 class LinkServer:
     """Remote-interest table + forwarder (the reference's extrouter +
-    external-broker forward hook)."""
+    external-broker forward hook).
+
+    Trust model: the $LINK control/data surface is bound to the link
+    agent's SESSION identity (clientid ``$link:<peer>:...``). Clientid
+    alone is spoofable on a wide-open broker — same as the reference,
+    deployments must require credentials for ``$link:*`` clientids via
+    the authn chain (the reference ships mandatory link ACLs for the
+    same reason); a spoofer also cannot hide, since taking the agent's
+    clientid kicks the live agent session."""
 
     def __init__(self, broker, local_cluster: str,
                  allowed: Optional[Set[str]] = None) -> None:
@@ -233,43 +261,150 @@ class LinkServer:
         # remote cluster -> filters it currently wants
         self.extern_routes: Dict[str, Set[str]] = {}
         self._hook = None
+        self._sub_hook = None
 
     def start(self) -> None:
         self._hook = self.broker.hooks.add(
             "message.publish", self._on_publish, priority=-60
         )
+        self._sub_hook = self.broker.hooks.add(
+            "client.subscribe", self._on_subscribe, priority=-60
+        )
+        # delivery-time enforcement: subscriptions can come into being
+        # WITHOUT passing the client.subscribe hook (durable-session
+        # resume, takeover import, a subscribe during a boot window, a
+        # $share group resolved at dispatch) — so the real gate is at
+        # fan-out: $LINK/msg/<c> is only ever handed to c's agent
+        # session, $LINK/route/* is never delivered to anyone
+        self.broker.delivery_guards.append(self._delivery_guard)
 
     def stop(self) -> None:
         if self._hook is not None:
             self.broker.hooks.delete("message.publish", self._hook)
             self._hook = None
+        if getattr(self, "_sub_hook", None) is not None:
+            self.broker.hooks.delete("client.subscribe", self._sub_hook)
+            self._sub_hook = None
+        if self._delivery_guard in self.broker.delivery_guards:
+            self.broker.delivery_guards.remove(self._delivery_guard)
 
     # ---------------------------------------------------------- hook
+
+    def _delivery_guard(self, clientid: str, msg: Message) -> bool:
+        t = msg.topic
+        if t.startswith(MSG_PREFIX):
+            # only OUR egress wrapper reaches an agent — the header is
+            # broker-internal state no wire client can set, so a local
+            # client cannot hand-craft a wrapped payload and have it
+            # delivered (it would be unwrapped and injected remotely
+            # with forged topic/from_client, bypassing remote ACLs)
+            if not msg.headers.get("link_egress"):
+                return False
+            c = t[len(MSG_PREFIX):]
+            return c in self.allowed and self._is_agent(clientid, c)
+        if t.startswith(ROUTE_PREFIX):
+            return False  # control ops are consumed by the hook only
+        return True
+
+    def _is_agent(self, clientid: str, cluster: str) -> bool:
+        """True when `clientid` is cluster's link agent: agents connect
+        as ``$link:{their cluster}:{their name for us}`` (LinkAgent
+        __init__); the ':'-delimited first field is the peer identity
+        we bind to — unambiguous because ':' cannot appear in a
+        cluster name."""
+        if ":" in cluster:
+            return False
+        return clientid.startswith(f"$link:{cluster}:")
+
+    def _on_subscribe(self, client, flt: str, opts):
+        """$LINK/msg/<c> carries wrapped copies of every matching
+        publish and $LINK/route/<c> is the control surface — both are
+        reserved for the link agent of cluster <c>; any other
+        subscription that could observe them is denied (the reference
+        mandates the same via its link ACLs, emqx_cluster_link.erl
+        actor authz).
+
+        Only filters whose FIRST level is the literal ``$LINK`` can
+        ever match these topics ([MQTT-4.7.2-1]: topics beginning with
+        `$` never match a root wildcard), so plain ``#``/``+/...``
+        subscriptions pass untouched. Shared subscriptions are checked
+        on their REAL filter — ``$share/g/$LINK/msg/x`` is the same
+        siphon with a prefix on it."""
+        from .hooks import STOP_WITH
+        try:
+            share = T.parse_share(flt)
+        except ValueError:
+            return None  # malformed $share: channel rejects it anyway
+        real = share.topic if share else flt
+        if not real.startswith("$LINK/"):
+            return None  # not a $LINK topic: leave the accumulator alone
+        if real.startswith(MSG_PREFIX) and share is None:
+            c = real[len(MSG_PREFIX):]
+            if c in self.allowed and self._is_agent(client.clientid, c):
+                return opts
+        return STOP_WITH(None)  # deny (run_fold None => 0x87)
 
     def _on_publish(self, msg: Message):
         topic = msg.topic
         if topic.startswith(ROUTE_PREFIX):
-            self._route_op(topic[len(ROUTE_PREFIX):], msg.payload)
+            if msg.headers.get("cluster_origin"):
+                # a wrapped message a peer smuggled in with a
+                # $LINK/route topic: control ops are only honored from
+                # directly-connected agent sessions, never from
+                # imported traffic (peer B must not be able to forge
+                # route ops for peer C)
+                log.warning("cluster link: imported message targeting "
+                            "control topic %r dropped", topic)
+                return None
+            self._route_op(topic[len(ROUTE_PREFIX):], msg.payload,
+                           msg.from_client)
             return None
-        if topic.startswith("$"):  # $LINK/msg, $SYS, ... never forward
+        if topic.startswith(MSG_PREFIX):
+            from .hooks import STOP_WITH
+            if not msg.headers.get("link_egress"):
+                # a client hand-publishing a forged wrapped payload on
+                # the egress topic: drop it outright (the delivery
+                # guard would refuse it anyway; dropping here also
+                # stops retain/persistence side effects)
+                log.warning("cluster link: foreign publish on egress "
+                            "topic %r from %r dropped", topic,
+                            msg.from_client)
+                return STOP_WITH(None)
             return None
-        origin = msg.headers.get("cluster_origin")
+        if topic.startswith("$"):  # $SYS, $delayed, ... never forward
+            return None
+        if msg.headers.get("cluster_origin"):
+            # link-imported message: deliver locally only, never
+            # re-export ("no gossip forwarding",
+            # emqx_cluster_link.erl:86-89 forward/1 drops any message
+            # carrying a link origin) — in a >=3-cluster mesh
+            # re-forwarding duplicates deliveries, and in a cycle it
+            # ping-pongs forever
+            return None
         for cluster, filters in self.extern_routes.items():
-            if cluster == origin:
-                continue  # loop prevention: never send back to origin
             if any(T.match(topic, f) for f in filters):
                 self.broker.metrics.inc("cluster_link.egress")
                 self.broker.publish(Message(
                     topic=MSG_PREFIX + cluster,
-                    payload=_wrap(msg, origin or self.local_cluster),
+                    payload=_wrap(msg, self.local_cluster),
                     qos=1,
+                    headers={"link_egress": True},
                 ))
         return None
 
-    def _route_op(self, cluster: str, payload: bytes) -> None:
+    def _route_op(self, cluster: str, payload: bytes,
+                  from_client: str) -> None:
         if cluster not in self.allowed:
             log.warning("cluster link: route op for unconfigured peer "
                         "%r ignored", cluster)
+            return
+        if not self._is_agent(from_client, cluster):
+            # bind the control surface to the link agent's session —
+            # otherwise any local client that can publish could reset
+            # the peer's route table or inject {"op":"reset",
+            # "filters":["#"]} to siphon every publish past topic ACLs
+            log.warning("cluster link: route op for %r from foreign "
+                        "client %r ignored", cluster, from_client)
             return
         try:
             body = json.loads(payload)
@@ -301,6 +436,15 @@ class ClusterLinks:
         allowed = {l["name"] for l in links}
         for l in links:
             allowed.update(l.get("accept_from", ()))
+        # ':' delimits the agent identity fields ($link:{cluster}:{name});
+        # a name containing it would make the identity checks fail open
+        # into a silently dead link — reject at configuration time
+        for n in allowed | {local_cluster}:
+            if ":" in n:
+                raise ValueError(
+                    f"cluster name {n!r} may not contain ':' "
+                    "(reserved as the link-identity separator)"
+                )
         self.server = LinkServer(broker, local_cluster, allowed)
         self.agents = [
             LinkAgent(
@@ -318,13 +462,25 @@ class ClusterLinks:
         ]
         self._prev_added = None
         self._prev_removed = None
+        self._installed = False
+        self._hooks_chained = False
+
+    def install(self) -> None:
+        """Register the LinkServer hooks (forwarding + the $LINK
+        guard). Called by BrokerServer BEFORE listeners accept clients
+        so no subscription can slip in ahead of the guard; start()
+        installs lazily for embedded/test use."""
+        if not self._installed:
+            self.server.start()
+            self._installed = True
 
     async def start(self) -> None:
-        self.server.start()
+        self.install()
         router = self.broker.router
         # chain (don't clobber) the cluster node's route hooks
         self._prev_added = router.on_route_added
         self._prev_removed = router.on_route_removed
+        self._hooks_chained = True
 
         def added(flt, _prev=self._prev_added):
             if _prev is not None:
@@ -347,8 +503,15 @@ class ClusterLinks:
         for a in self.agents:
             await a.stop()
         self.server.stop()
-        self.broker.router.on_route_added = self._prev_added
-        self.broker.router.on_route_removed = self._prev_removed
+        self._installed = False
+        if self._hooks_chained:
+            # only restore what start() actually saved — stop() after a
+            # bare install() (e.g. a boot that failed between install
+            # and start) must not reset the router hooks to our
+            # __init__ defaults and silently cut route sync
+            self.broker.router.on_route_added = self._prev_added
+            self.broker.router.on_route_removed = self._prev_removed
+            self._hooks_chained = False
 
     def info(self) -> dict:
         return {

@@ -17,6 +17,7 @@ formatters, emqx_log_throttler.erl:62-105 per-event-window dedup):
 
 from __future__ import annotations
 
+import copy
 import json
 import logging
 import time
@@ -57,10 +58,15 @@ class LogThrottler(logging.Filter):
     """First-per-window pass-through with dropped-count summaries."""
 
     def __init__(self, window_s: float = 60.0,
-                 max_keys: int = 4096) -> None:
+                 max_keys: int = 4096,
+                 handler: Optional[logging.Handler] = None) -> None:
         super().__init__()
         self.window_s = window_s
         self.max_keys = max_keys
+        # the handler this filter is attached to; summary records are
+        # emitted on it directly so the shared LogRecord instance other
+        # handlers (e.g. the OTel log handler) see is never mutated
+        self.handler = handler
         # key -> (window_start, dropped_count)
         self._seen: Dict[Tuple[str, str], Tuple[float, int]] = {}
 
@@ -71,6 +77,8 @@ class LogThrottler(logging.Filter):
         return (record.name, str(record.msg))
 
     def filter(self, record: logging.LogRecord) -> bool:
+        if getattr(record, "_throttle_summary", False):
+            return True  # our own summary copy re-entering via handle()
         if record.levelno >= logging.ERROR:
             return True  # errors always pass (reference behavior)
         now = time.monotonic()
@@ -85,12 +93,30 @@ class LogThrottler(logging.Filter):
         if now - start < self.window_s:
             self._seen[key] = (start, dropped + 1)
             return False
-        # window rolled: emit, and summarize what was swallowed
+        # window rolled: emit, and summarize what was swallowed — on a
+        # COPY, because this record instance is shared with every other
+        # handler on the logger tree; mutating msg in place would make
+        # their output depend on handler order
         self._seen[key] = (now, 0)
         if dropped:
-            record.msg = (f"{record.msg} (throttled: {dropped} similar "
-                          f"events in the last {self.window_s:.0f}s)")
-            record.args = record.args or ()
+            summary = copy.copy(record)
+            summary.msg = (f"{record.getMessage()} (throttled: {dropped} "
+                           f"similar events in the last "
+                           f"{self.window_s:.0f}s)")
+            summary.args = ()
+            summary._throttle_summary = True
+            if self.handler is not None:
+                # handler-attached (configure() wiring): emit the copy
+                # on OUR handler only; siblings see the plain original
+                if summary.levelno >= self.handler.level:
+                    self.handler.handle(summary)
+                return False
+            # logger-attached fallback (no handler bound): annotating a
+            # copy is impossible — a filter cannot substitute the
+            # record — so keep the legacy in-place annotation rather
+            # than silently losing the dropped count
+            record.msg = summary.msg
+            record.args = ()
         return True
 
 
@@ -109,7 +135,13 @@ def configure(
     filters but do pass handler filters."""
     root = logging.getLogger("emqx_tpu")
     root.setLevel(getattr(logging, level.upper(), logging.INFO))
+    # reconfiguration replaces our handler instead of stacking a new
+    # one per configure() call (which would duplicate every line)
+    for h in list(root.handlers):
+        if getattr(h, "_emqx_tpu_handler", False):
+            root.removeHandler(h)
     handler = logging.StreamHandler()
+    handler._emqx_tpu_handler = True
     if fmt == "json":
         handler.setFormatter(JsonFormatter())
     else:
@@ -117,6 +149,7 @@ def configure(
             "%(asctime)s %(levelname)s %(name)s %(message)s"
         ))
     if throttle_window_s:
-        handler.addFilter(LogThrottler(window_s=throttle_window_s))
+        handler.addFilter(LogThrottler(window_s=throttle_window_s,
+                                       handler=handler))
     root.addHandler(handler)
     root.propagate = False

@@ -154,13 +154,18 @@ class S3Sink:
         await self.client.put_object(key, body)
 
     async def health_check(self) -> bool:
-        # a signed GET on a probe key: 2xx/404 prove reachability AND
-        # accepted credentials; 401/403 (bad secret, clock skew,
-        # revoked key) must report down or uploads would retry-drop
-        # forever against a sink that can never accept them
+        # probe with the operation this sink actually performs: a PUT
+        # of one empty, fixed-key marker object. A GET-based probe
+        # misreports least-privilege credentials — S3 answers 403 (not
+        # 404) to GetObject on a missing key whenever the caller lacks
+        # s3:ListBucket, so a PutObject-only credential would look
+        # permanently down while uploads work fine. The marker is
+        # overwritten in place and never deleted: a DELETE would need
+        # an extra permission and, on versioned buckets, each probe
+        # cycle would leave a delete marker behind (cover `.health-
+        # probe` with a noncurrent-version lifecycle rule there).
         try:
-            resp = await self.client._request("GET", ".health-probe")
-            async with resp:
-                return resp.status < 500 and resp.status not in (401, 403)
+            await self.client.put_object(".health-probe", b"")
+            return True
         except Exception:
             return False

@@ -183,3 +183,258 @@ def test_bidirectional_links_no_loop():
         await west.stop()
 
     run(t())
+
+
+def test_three_cluster_chain_no_reforward():
+    """A link-imported message must never be re-exported (the
+    reference's 'no gossip forwarding': forward/1 drops any message
+    carrying a link origin) — in a 3-cluster mesh re-forwarding would
+    duplicate deliveries or storm a cycle forever."""
+
+    async def t():
+        a = await start_broker("a")
+        b = await start_broker("b")
+        c = await start_broker("c")
+        # full mesh: every cluster links to the other two
+        async def mesh(me, peers):
+            await add_links(me, [{
+                "name": p.broker.config.cluster_name, "host": "127.0.0.1",
+                "port": p.listeners[0].port, "topics": ["#"],
+            } for p in peers])
+        await mesh(a, (b, c))
+        await mesh(b, (a, c))
+        await mesh(c, (a, b))
+
+        subs = []
+        for srv, cid in ((a, "sa"), (b, "sb"), (c, "sc")):
+            s = TestClient(srv.listeners[0].port, cid)
+            await s.connect()
+            await s.subscribe("news/#", qos=1)
+            subs.append(s)
+        # wait until every broker knows both peers want news/#
+        for srv in (a, b, c):
+            assert await settle(lambda srv=srv: sum(
+                1 for fs in _extern(srv).values() if "news/#" in fs
+            ) == 2), _extern(srv)
+
+        pub = TestClient(a.listeners[0].port, "pa")
+        await pub.connect()
+        await pub.publish("news/x", b"once", qos=1)
+
+        # each subscriber gets exactly one copy
+        for s in subs:
+            got = await s.recv_publish()
+            assert got.payload == b"once"
+        await asyncio.sleep(0.5)
+        for s in subs:
+            try:
+                extra = await asyncio.wait_for(s.recv_publish(), 0.2)
+                raise AssertionError(
+                    f"duplicate delivery across the mesh: {extra.topic}"
+                )
+            except asyncio.TimeoutError:
+                pass
+
+        await pub.close()
+        for s in subs:
+            await s.close()
+        for srv in (a, b, c):
+            await srv.stop()
+
+    run(t())
+
+
+def test_route_op_requires_agent_identity():
+    """Route ops published by a non-agent client for a configured peer
+    name must be ignored, and $LINK/msg subscriptions are denied for
+    anyone but that peer's agent — otherwise any local client could
+    reset federation or siphon every forwarded publish past topic
+    ACLs."""
+    import json as _json
+
+    async def t():
+        east = await start_broker("east")
+        await add_links(east, [{
+            "name": "west", "host": "127.0.0.1",
+            "port": 1, "topics": [],  # port 1: agent never connects
+        }])
+
+        evil = TestClient(east.listeners[0].port, "evil")
+        await evil.connect()
+        # 1. spoofed route op for the configured peer is ignored
+        await evil.publish("$LINK/route/west", _json.dumps(
+            {"op": "reset", "filters": ["#"]}
+        ).encode(), qos=1)
+        await asyncio.sleep(0.2)
+        assert not _extern(east).get("west"), _extern(east)
+
+        # 2. $LINK/msg subscription denied for a foreign client
+        ack = await evil.subscribe("$LINK/msg/west", qos=1)
+        assert ack.reason_codes[0] >= 0x80, ack.reason_codes
+        ack = await evil.subscribe("$LINK/#", qos=1)
+        assert ack.reason_codes[0] >= 0x80, ack.reason_codes
+
+        # 3. the real agent identity is accepted for both
+        agent = TestClient(east.listeners[0].port, "$link:west:east")
+        await agent.connect()
+        ack = await agent.subscribe("$LINK/msg/west", qos=1)
+        assert ack.reason_codes[0] < 0x80, ack.reason_codes
+        await agent.publish("$LINK/route/west", _json.dumps(
+            {"op": "add", "filters": ["t/#"]}
+        ).encode(), qos=1)
+        assert await settle(
+            lambda: "t/#" in _extern(east).get("west", ())
+        )
+
+        await evil.close()
+        await agent.close()
+        await east.stop()
+
+    run(t())
+
+
+def test_link_guard_allows_root_wildcards_blocks_share_bypass():
+    """'#' can never match $-topics ([MQTT-4.7.2-1]) so it must be
+    GRANTED; '$share/g/$LINK/msg/x' is the same siphon with a prefix
+    and must be denied; imported messages on reserved topics drop."""
+
+    async def t():
+        east = await start_broker("east")
+        await add_links(east, [{
+            "name": "west", "host": "127.0.0.1", "port": 1, "topics": [],
+        }])
+
+        mon = TestClient(east.listeners[0].port, "monitor")
+        await mon.connect()
+        for ok_flt in ("#", "+/msg/x", "$SYS/#"):
+            ack = await mon.subscribe(ok_flt, qos=1)
+            assert ack.reason_codes[0] < 0x80, (ok_flt, ack.reason_codes)
+        for bad_flt in ("$share/g/$LINK/msg/west", "$LINK/route/+",
+                        "$LINK/msg/west"):
+            ack = await mon.subscribe(bad_flt, qos=1)
+            assert ack.reason_codes[0] >= 0x80, (bad_flt, ack.reason_codes)
+
+        # imported wrapped message targeting a control topic is dropped
+        from emqx_tpu.cluster_link import LinkServer  # noqa: F401
+        from emqx_tpu.message import Message
+        import json as _json
+        srv = east.cluster_links.server
+        srv._on_publish(Message(
+            topic="$LINK/route/west",
+            payload=_json.dumps(
+                {"op": "reset", "filters": ["#"]}).encode(),
+            from_client="$link:west:forged",
+            headers={"cluster_origin": "elsewhere"},
+        ))
+        assert not srv.extern_routes.get("west")
+
+        await mon.close()
+        await east.stop()
+
+    run(t())
+
+
+def test_delivery_guard_blocks_hookless_subscriptions():
+    """Subscriptions that never passed the client.subscribe hook
+    (durable resume, takeover import, boot-window subscribes) must
+    still get nothing: $LINK/msg delivery is pinned to the agent
+    session at fan-out time."""
+
+    async def t():
+        east = await start_broker("east")
+        await add_links(east, [{
+            "name": "west", "host": "127.0.0.1", "port": 1, "topics": [],
+        }])
+        broker = east.broker
+
+        # connect two clients; then force-install a $LINK/msg sub for
+        # the evil one directly in the router (simulating a durable
+        # restore that bypasses the subscribe hook)
+        evil = TestClient(east.listeners[0].port, "evil")
+        await evil.connect()
+        await evil.subscribe("probe/ok", qos=1)  # liveness channel
+        agent = TestClient(east.listeners[0].port, "$link:west:east")
+        await agent.connect()
+        ack = await agent.subscribe("$LINK/msg/west", qos=1)
+        assert ack.reason_codes[0] < 0x80
+        from emqx_tpu.broker.session import SubOpts
+        broker.router.subscribe("evil", "$LINK/msg/west", SubOpts(qos=1))
+
+        # a forwarded-bound publish: west wants t/#, someone publishes
+        east.cluster_links.server.extern_routes["west"] = {"t/#"}
+        pub = TestClient(east.listeners[0].port, "p")
+        await pub.connect()
+        await pub.publish("t/x", b"secret", qos=1)
+
+        # the agent receives the wrapped copy; evil receives nothing
+        got = await agent.recv_publish(timeout=3)
+        assert got.topic == "$LINK/msg/west"
+        await pub.publish("probe/ok", b"alive", qos=1)
+        got = await evil.recv_publish(timeout=3)
+        assert got.topic == "probe/ok", got  # NOT the $LINK copy
+
+        for c in (evil, agent, pub):
+            await c.close()
+        await east.stop()
+
+    run(t())
+
+
+def test_forged_wrapped_publish_dropped():
+    """A local client hand-publishing a wrapped payload on
+    $LINK/msg/<peer> must be dropped — otherwise it would be unwrapped
+    and injected into the remote cluster with forged topic/from_client,
+    bypassing the remote side's ACLs."""
+    import json as _json
+    import base64 as _b64
+
+    async def t():
+        east = await start_broker("east")
+        await add_links(east, [{
+            "name": "west", "host": "127.0.0.1", "port": 1, "topics": [],
+        }])
+        agent = TestClient(east.listeners[0].port, "$link:west:east")
+        await agent.connect()
+        ack = await agent.subscribe("$LINK/msg/west", qos=1)
+        assert ack.reason_codes[0] < 0x80
+
+        forger = TestClient(east.listeners[0].port, "forger")
+        await forger.connect()
+        forged = _json.dumps({
+            "t": "secret/cmd",
+            "p": _b64.b64encode(b"pwn").decode(),
+            "q": 1, "r": False, "o": "east", "c": "admin",
+        }).encode()
+        await forger.publish("$LINK/msg/west", forged, qos=1)
+        try:
+            got = await agent.recv_publish(timeout=0.8)
+            raise AssertionError(
+                f"forged wrapped publish delivered to agent: {got.topic}"
+            )
+        except asyncio.TimeoutError:
+            pass
+
+        # the legitimate egress path still flows (marker set internally)
+        east.cluster_links.server.extern_routes["west"] = {"t/#"}
+        await forger.publish("t/x", b"real", qos=1)
+        got = await agent.recv_publish(timeout=3)
+        assert got.topic == "$LINK/msg/west"
+
+        await agent.close()
+        await forger.close()
+        await east.stop()
+
+    run(t())
+
+
+def test_cluster_name_with_colon_rejected():
+    from emqx_tpu.cluster_link import ClusterLinks
+    import pytest as _pytest
+
+    class _B:  # ClusterLinks only touches broker at start()
+        pass
+
+    with _pytest.raises(ValueError):
+        ClusterLinks(_B(), "eu:west", [{"name": "us"}])
+    with _pytest.raises(ValueError):
+        ClusterLinks(_B(), "eu", [{"name": "us:east"}])

@@ -103,30 +103,52 @@ def test_json_formatter_fields_and_extras():
     assert isinstance(out["ts"], float)
 
 
-def test_log_throttler_windows_and_summary(caplog):
-    throttler = LogThrottler(window_s=0.2)
-    logger = logging.getLogger("emqx_tpu.throttle_test")
-    logger.addFilter(throttler)
-    logger.setLevel(logging.INFO)
-    try:
-        with caplog.at_level(logging.INFO, "emqx_tpu.throttle_test"):
-            for _ in range(10):
-                logger.info("socket error from %s", "1.2.3.4")
-        assert len(caplog.records) == 1  # first passes, rest swallowed
+class _Capture(logging.Handler):
+    def __init__(self):
+        super().__init__(logging.DEBUG)
+        self.records = []
 
-        caplog.clear()
+    def emit(self, record):
+        self.records.append(record)
+
+
+def test_log_throttler_windows_and_summary():
+    # the throttler is a handler filter (configure() wires it that
+    # way); the summary line goes to ITS handler only, on a copied
+    # record — sibling handlers must see the original untouched
+    ours = _Capture()
+    sibling = _Capture()  # e.g. the OTel log handler
+    throttler = LogThrottler(window_s=0.2, handler=ours)
+    ours.addFilter(throttler)
+    logger = logging.getLogger("emqx_tpu.throttle_test")
+    logger.addHandler(ours)
+    logger.addHandler(sibling)
+    logger.setLevel(logging.INFO)
+    logger.propagate = False
+    try:
+        for _ in range(10):
+            logger.info("socket error from %s", "1.2.3.4")
+        assert len(ours.records) == 1  # first passes, rest swallowed
+        assert len(sibling.records) == 10  # unthrottled sibling
+
+        ours.records.clear()
+        sibling.records.clear()
         import time as _t
         _t.sleep(0.25)
-        with caplog.at_level(logging.INFO, "emqx_tpu.throttle_test"):
-            logger.info("socket error from %s", "1.2.3.4")
-        assert len(caplog.records) == 1
-        assert "throttled: 9 similar events" in caplog.records[0].getMessage()
+        logger.info("socket error from %s", "1.2.3.4")
+        assert len(ours.records) == 1
+        assert ("throttled: 9 similar events"
+                in ours.records[0].getMessage())
+        # the shared record instance was NOT mutated: the sibling
+        # handler sees the plain message
+        assert len(sibling.records) == 1
+        assert sibling.records[0].getMessage() == "socket error from 1.2.3.4"
 
         # errors always pass
-        caplog.clear()
-        with caplog.at_level(logging.INFO, "emqx_tpu.throttle_test"):
-            for _ in range(3):
-                logger.error("disk full")
-        assert len(caplog.records) == 3
+        ours.records.clear()
+        for _ in range(3):
+            logger.error("disk full")
+        assert len(ours.records) == 3
     finally:
-        logger.removeFilter(throttler)
+        logger.removeHandler(ours)
+        logger.removeHandler(sibling)
