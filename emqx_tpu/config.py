@@ -109,11 +109,24 @@ class FlappingConfig:
 
 @dataclass
 class ApiConfig:
-    """Management REST + Prometheus endpoint (emqx_management slice)."""
+    """Management REST + Prometheus endpoint (emqx_management slice).
+
+    Authentication is always on (emqx_mgmt_auth): a default admin is
+    bootstrapped on first start from default_username/default_password
+    (the reference ships admin/public the same way); set
+    ``default_password`` to None to disable bootstrap entirely (then
+    seed users via MgmtAuth directly)."""
 
     enable: bool = False
     bind: str = "127.0.0.1"
     port: int = 18083
+    data_dir: str = "data/mgmt"
+    default_username: str = "admin"
+    default_password: Optional[str] = "public"
+    token_ttl: float = 3600.0
+    # whether /metrics (Prometheus scrape) also requires credentials;
+    # the reference leaves the scrape endpoint open by default
+    prometheus_auth: bool = False
 
 
 @dataclass

@@ -17,7 +17,9 @@ any reachable broker:
 from __future__ import annotations
 
 import argparse
+import base64
 import json
+import os
 import sys
 import urllib.error
 import urllib.request
@@ -25,17 +27,34 @@ from typing import Any, Optional
 
 
 class Ctl:
-    def __init__(self, base: str) -> None:
+    def __init__(self, base: str, user: Optional[str] = None,
+                 api_key: Optional[str] = None) -> None:
+        """`user`/`api_key` are "name:secret" pairs; user logs in for a
+        Bearer token, api_key goes as HTTP Basic (emqx_mgmt_auth)."""
         self.base = base.rstrip("/")
+        self._auth: Optional[str] = None
+        if api_key:
+            self._auth = "Basic " + base64.b64encode(
+                api_key.encode()
+            ).decode()
+        elif user:
+            username, _, password = user.partition(":")
+            out = self._req("/api/v5/login", method="POST", body={
+                "username": username, "password": password,
+            })
+            self._auth = "Bearer " + out["token"]
 
     def _req(
         self, path: str, method: str = "GET", body: Optional[dict] = None
     ) -> Any:
+        headers = {"Content-Type": "application/json"}
+        if self._auth:
+            headers["Authorization"] = self._auth
         req = urllib.request.Request(
             self.base + path,
             method=method,
             data=None if body is None else json.dumps(body).encode(),
-            headers={"Content-Type": "application/json"},
+            headers=headers,
         )
         try:
             with urllib.request.urlopen(req, timeout=10) as resp:
@@ -162,12 +181,24 @@ def main(argv=None) -> None:
         default="http://127.0.0.1:18083",
         help="management API base URL",
     )
+    ap.add_argument(
+        "--user",
+        default=os.environ.get("EMQX_CTL_USER", "admin:public"),
+        help="admin credentials as user:password "
+        "(env EMQX_CTL_USER; logs in for a Bearer token)",
+    )
+    ap.add_argument(
+        "--api-key",
+        default=os.environ.get("EMQX_CTL_API_KEY"),
+        help="API key as key:secret (env EMQX_CTL_API_KEY; "
+        "preferred over --user when set)",
+    )
     ap.add_argument("command", help="status|clients|subscriptions|topics|"
                     "rules|metrics|stats|publish|trace|banned")
     ap.add_argument("args", nargs="*")
     ap.add_argument("--qos", type=int, default=0)
     ns = ap.parse_args(argv)
-    ctl = Ctl(ns.api)
+    ctl = Ctl(ns.api, user=ns.user, api_key=ns.api_key)
 
     cmd = ns.command
     if cmd == "status":

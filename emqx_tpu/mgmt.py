@@ -20,36 +20,101 @@ from aiohttp import web
 from .message import Message
 
 
-def _json(data, status: int = 200) -> web.Response:
-    return web.json_response(data, status=status)
+def _json(data, status: int = 200, headers=None) -> web.Response:
+    return web.json_response(data, status=status, headers=headers)
 
 
 class MgmtApi:
+    # routes reachable without credentials: the login endpoint, the
+    # status page (which degrades to a login hint when anonymous, the
+    # way the reference serves dashboard assets openly and gates the
+    # data), and the Prometheus scrape (open by default in the
+    # reference; gate it with api.prometheus_auth=true)
+    _OPEN = {
+        ("POST", "/api/v5/login"),
+        ("GET", "/"),
+        ("GET", "/dashboard"),
+    }
+
     def __init__(self, server, bind: str = "127.0.0.1", port: int = 0) -> None:
         self.server = server  # BrokerServer
         self.broker = server.broker
         self.bind = bind
         self.port = port
         self._runner: Optional[web.AppRunner] = None
-        # audit trail of mutating API calls (emqx_audit's role): ring
-        # buffer surfaced at /api/v5/audit
-        self.audit_log: list = []
-        self.audit_cap = 1000
+        from .mgmt_auth import AuditLog, MgmtAuth
+
+        cfg = self.broker.config.api
+        self.auth = MgmtAuth(
+            cfg.data_dir,
+            default_username=cfg.default_username,
+            default_password=cfg.default_password,
+            token_ttl=cfg.token_ttl,
+        )
+        self.prometheus_auth = cfg.prometheus_auth
+        # audit trail of mutating API calls (emqx_audit's role),
+        # persisted across restarts, surfaced at /api/v5/audit
+        self.audit = AuditLog(cfg.data_dir)
+        # failed-login throttle: remote -> recent failure monotonics
+        self._login_failures: dict = {}
+
+    @property
+    def audit_log(self) -> list:
+        return self.audit.entries
 
     @web.middleware
-    async def _audit_middleware(self, request: web.Request, handler):
+    async def _auth_middleware(self, request: web.Request, handler):
+        """401 on every management route without credentials
+        (emqx_mgmt_auth / emqx_dashboard authn+RBAC): Bearer admin
+        token or Basic api-key; viewers are read-only."""
+        path, method = request.path, request.method
+        open_route = (
+            (method, path) in self._OPEN
+            or (path == "/metrics" and not self.prometheus_auth)
+        )
+        ident = self.auth.authenticate_header(
+            request.headers.get("Authorization")
+        )
+        if not open_route:
+            if ident is None:
+                return _json(
+                    {"code": "UNAUTHORIZED",
+                     "message": "login or api key required"},
+                    status=401,
+                    headers={
+                        # lets browsers/tools prompt for an api key
+                        "WWW-Authenticate":
+                        'Basic realm="emqx_tpu api key"',
+                    },
+                )
+            self_pwd_change = (
+                ident.via == "token"
+                and method == "PUT"
+                and path == f"/api/v5/users/{ident.actor}/change_pwd"
+            )
+            if (method not in ("GET", "HEAD") and not ident.can_write
+                    and not self_pwd_change):
+                # viewers are read-only — except rotating their OWN
+                # password, which change_pwd re-verifies with old_pwd
+                return _json(
+                    {"code": "FORBIDDEN",
+                     "message": "viewer role is read-only"},
+                    status=403,
+                )
+        request["identity"] = ident
         resp = await handler(request)
-        if request.method in ("POST", "PUT", "DELETE"):
-            self.audit_log.append(
+        if method in ("POST", "PUT", "DELETE") and path != "/api/v5/login":
+            self.audit.append(
                 {
                     "at": time.time(),
-                    "method": request.method,
-                    "path": request.path,
+                    "actor": ident.actor if ident else None,
+                    "via": ident.via if ident else None,
+                    "method": method,
+                    "path": path,
                     "from": request.remote,
                     "status": resp.status,
                 }
             )
-            del self.audit_log[: -self.audit_cap]
         return resp
 
     # ------------------------------------------------------- lifecycle
@@ -57,6 +122,14 @@ class MgmtApi:
     async def start(self) -> None:
         app = web.Application()
         r = app.router
+        r.add_post("/api/v5/login", self.post_login)
+        r.add_get("/api/v5/api_key", self.get_api_keys)
+        r.add_post("/api/v5/api_key", self.post_api_key)
+        r.add_delete("/api/v5/api_key/{key}", self.delete_api_key)
+        r.add_get("/api/v5/users", self.get_users)
+        r.add_post("/api/v5/users", self.post_user)
+        r.add_delete("/api/v5/users/{username}", self.delete_user)
+        r.add_put("/api/v5/users/{username}/change_pwd", self.change_pwd)
         r.add_get("/api/v5/clients", self.get_clients)
         r.add_get("/api/v5/clients/{clientid}", self.get_client)
         r.add_delete("/api/v5/clients/{clientid}", self.kick_client)
@@ -93,7 +166,7 @@ class MgmtApi:
         )
         r.add_get("/api/v5/load_rebalance/status", self.rebalance_status)
         r.add_get("/metrics", self.prometheus)
-        app.middlewares.append(self._audit_middleware)
+        app.middlewares.append(self._auth_middleware)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.bind, self.port)
@@ -104,6 +177,130 @@ class MgmtApi:
         if self._runner is not None:
             await self._runner.cleanup()
             self._runner = None
+
+    # ------------------------------------------------------------ auth
+
+    _LOGIN_WINDOW = 60.0
+    _LOGIN_MAX_FAILURES = 10
+
+    async def post_login(self, request: web.Request) -> web.Response:
+        """Dashboard-style login: credentials -> Bearer token
+        (emqx_dashboard_admin:sign_token). The only unauthenticated
+        mutating route, so it is (a) throttled per remote after
+        repeated failures and (b) runs its 50k-round PBKDF2 in a
+        worker thread — on the event loop it would stall every
+        connected MQTT client for tens of ms per attempt."""
+        import asyncio as _aio
+
+        try:
+            body = await request.json()
+            username = str(body["username"])
+            password = str(body["password"])
+        except (KeyError, TypeError, json.JSONDecodeError):
+            return _json({"code": "BAD_REQUEST"}, status=400)
+        now = time.monotonic()
+        remote = request.remote or "?"
+        failures = [
+            t for t in self._login_failures.get(remote, ())
+            if now - t < self._LOGIN_WINDOW
+        ]
+        if len(failures) >= self._LOGIN_MAX_FAILURES:
+            self._login_failures[remote] = failures
+            return _json(
+                {"code": "TOO_MANY_REQUESTS",
+                 "message": "too many failed logins; retry later"},
+                status=429,
+            )
+        token = await _aio.get_running_loop().run_in_executor(
+            None, self.auth.login, username, password
+        )
+        if token is None:
+            failures.append(now)
+            self._login_failures[remote] = failures
+            if len(self._login_failures) > 10_000:
+                self._login_failures.clear()  # bound the table
+            return _json(
+                {"code": "BAD_USERNAME_OR_PWD"}, status=401
+            )
+        self._login_failures.pop(remote, None)
+        user = self.auth.admins[username]
+        return _json({
+            "token": token,
+            "role": user["role"],
+            "version": "5.8",
+        })
+
+    async def get_api_keys(self, request: web.Request) -> web.Response:
+        return _json({"data": self.auth.info()})
+
+    async def post_api_key(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+            key, secret = self.auth.create_api_key(
+                body["name"],
+                role=body.get("role", "administrator"),
+                expires_in=body.get("expires_in"),
+                enabled=bool(body.get("enable", True)),
+            )
+        except (KeyError, ValueError, TypeError, json.JSONDecodeError) as exc:
+            return _json({"code": "BAD_REQUEST", "message": str(exc)}, 400)
+        # the plaintext secret appears in this response and never again
+        return _json({"api_key": key, "api_secret": secret}, status=201)
+
+    async def delete_api_key(self, request: web.Request) -> web.Response:
+        ok = self.auth.delete_api_key(request.match_info["key"])
+        return web.Response(status=204 if ok else 404)
+
+    async def get_users(self, request: web.Request) -> web.Response:
+        return _json({"data": [
+            {"username": u, "role": e["role"]}
+            for u, e in self.auth.admins.items()
+        ]})
+
+    async def post_user(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+            username = str(body["username"])
+            if username in self.auth.admins:
+                return _json({"code": "ALREADY_EXISTS"}, status=409)
+            self.auth.add_admin(
+                username,
+                str(body["password"]),
+                role=body.get("role", "viewer"),
+            )
+        except (KeyError, ValueError, TypeError, json.JSONDecodeError) as exc:
+            return _json({"code": "BAD_REQUEST", "message": str(exc)}, 400)
+        return _json({"username": username}, status=201)
+
+    async def delete_user(self, request: web.Request) -> web.Response:
+        username = request.match_info["username"]
+        ident = request["identity"]
+        if ident is not None and ident.via == "token" \
+                and ident.actor == username:
+            return _json(
+                {"code": "BAD_REQUEST",
+                 "message": "cannot delete the logged-in user"}, 400
+            )
+        try:
+            ok = self.auth.delete_admin(username)
+        except ValueError as exc:
+            # the last administrator is undeletable: it would lock the
+            # plane and re-seed default credentials on restart
+            return _json({"code": "BAD_REQUEST", "message": str(exc)}, 400)
+        return web.Response(status=204 if ok else 404)
+
+    async def change_pwd(self, request: web.Request) -> web.Response:
+        username = request.match_info["username"]
+        try:
+            body = await request.json()
+            ok = self.auth.change_password(
+                username, str(body["old_pwd"]), str(body["new_pwd"])
+            )
+        except (KeyError, ValueError, TypeError, json.JSONDecodeError) as exc:
+            return _json({"code": "BAD_REQUEST", "message": str(exc)}, 400)
+        if not ok:
+            return _json({"code": "BAD_USERNAME_OR_PWD"}, status=401)
+        return web.Response(status=204)
 
     # --------------------------------------------------------- clients
 
@@ -351,6 +548,19 @@ class MgmtApi:
         server-rendered: live stats refreshed by meta tag, links to the
         JSON API for everything else)."""
         b = self.broker
+        if request.get("identity") is None:
+            # anonymous: node identity + login hint only; operational
+            # stats need credentials (Basic api-key works in-browser
+            # via the WWW-Authenticate challenge on /api/v5 routes)
+            html = (
+                "<!DOCTYPE html><html><head><title>emqx_tpu</title>"
+                "<style>body{font-family:monospace;margin:2em}</style>"
+                f"</head><body><h2>emqx_tpu — {b.config.node_name}</h2>"
+                "<p>authentication required: POST /api/v5/login "
+                "{username,password} for a Bearer token, or use an "
+                "API key via HTTP Basic.</p></body></html>"
+            )
+            return web.Response(text=html, content_type="text/html")
         stats = b.stats.all()
         rows = "".join(
             f"<tr><td>{k}</td><td>{v}</td></tr>"

@@ -1,0 +1,24 @@
+"""Authenticated management-API session for tests: logs in with the
+bootstrapped default admin and returns an aiohttp session that sends
+the Bearer token on every request (the mgmt plane answers 401 without
+it — emqx_mgmt_auth parity)."""
+
+import aiohttp
+
+
+async def auth_session(srv, username="admin", password="public"):
+    """Returns (ClientSession with auth header, api base url)."""
+    api = f"http://127.0.0.1:{srv.api.port}"
+    async with aiohttp.ClientSession() as http:
+        async with http.post(
+            api + "/api/v5/login",
+            json={"username": username, "password": password},
+        ) as r:
+            assert r.status == 200, await r.text()
+            token = (await r.json())["token"]
+    return (
+        aiohttp.ClientSession(
+            headers={"Authorization": f"Bearer {token}"}
+        ),
+        api,
+    )

@@ -2,12 +2,17 @@
 (emqx_ft / emqx_plugins / emqx_dashboard parity)."""
 
 import asyncio
+import tempfile
+
+# auto-cleaned parent for per-test mgmt stores (finalized at interpreter exit)
+_MGMT_TMP = tempfile.TemporaryDirectory(prefix="emqx-mgmt-")
 import json
 
 import aiohttp
 
 from emqx_tpu.broker.listener import BrokerServer
 from emqx_tpu.config import BrokerConfig, ListenerConfig
+from api_helper import auth_session
 from mqtt_client import TestClient
 
 
@@ -108,11 +113,12 @@ def test_dashboard_page():
         cfg = BrokerConfig()
         cfg.listeners = [ListenerConfig(port=0)]
         cfg.api.enable = True
+        cfg.api.data_dir = tempfile.mkdtemp(dir=_MGMT_TMP.name)
         cfg.api.port = 0
         srv = BrokerServer(cfg)
         await srv.start()
-        api = f"http://127.0.0.1:{srv.api.port}"
-        async with aiohttp.ClientSession() as http:
+        http, api = await auth_session(srv)
+        async with http:
             async with http.get(api + "/dashboard") as r:
                 text = await r.text()
         assert r.status == 200

@@ -3,12 +3,17 @@ exposition (emqx_sys / emqx_management / emqx_prometheus parity at the
 black-box level)."""
 
 import asyncio
+import tempfile
+
+# auto-cleaned parent for per-test mgmt stores (finalized at interpreter exit)
+_MGMT_TMP = tempfile.TemporaryDirectory(prefix="emqx-mgmt-")
 import json
 
 import aiohttp
 
 from emqx_tpu.broker.listener import BrokerServer
 from emqx_tpu.config import BrokerConfig, ListenerConfig
+from api_helper import auth_session
 from mqtt_client import TestClient
 
 
@@ -20,6 +25,7 @@ def make_server(sys_interval=3600.0):
     cfg = BrokerConfig()
     cfg.listeners = [ListenerConfig(port=0)]
     cfg.api.enable = True
+    cfg.api.data_dir = tempfile.mkdtemp(dir=_MGMT_TMP.name)
     cfg.api.port = 0
     cfg.sys.interval = sys_interval
     return BrokerServer(cfg)
@@ -53,13 +59,13 @@ def test_rest_clients_subscriptions_stats():
         srv = make_server()
         await srv.start()
         port = srv.listeners[0].port
-        api = f"http://127.0.0.1:{srv.api.port}"
+        http, api = await auth_session(srv)
 
         c = TestClient(port, "dev-42")
         await c.connect()
         await c.subscribe("tele/+/up", qos=1)
 
-        async with aiohttp.ClientSession() as http:
+        async with http:
             async with http.get(api + "/api/v5/clients") as r:
                 data = await r.json()
             assert r.status == 200
@@ -108,8 +114,8 @@ def test_rest_rules_crud():
     async def t():
         srv = make_server()
         await srv.start()
-        api = f"http://127.0.0.1:{srv.api.port}"
-        async with aiohttp.ClientSession() as http:
+        http, api = await auth_session(srv)
+        async with http:
             async with http.post(
                 api + "/api/v5/rules",
                 json={
@@ -142,8 +148,8 @@ def test_prometheus_exposition():
         c = TestClient(port, "p")
         await c.connect()
         await c.publish("x/y", b"1", qos=1)
-        api = f"http://127.0.0.1:{srv.api.port}"
-        async with aiohttp.ClientSession() as http:
+        http, api = await auth_session(srv)
+        async with http:
             async with http.get(api + "/metrics") as r:
                 text = await r.text()
         assert r.status == 200

@@ -3,12 +3,17 @@ and REST, slow-subscription tracking (emqx_banned / emqx_flapping /
 emqx_alarm / emqx_slow_subs parity)."""
 
 import asyncio
+import tempfile
+
+# auto-cleaned parent for per-test mgmt stores (finalized at interpreter exit)
+_MGMT_TMP = tempfile.TemporaryDirectory(prefix="emqx-mgmt-")
 
 import aiohttp
 
 from emqx_tpu.broker.listener import BrokerServer
 from emqx_tpu.config import BrokerConfig, ListenerConfig
 from emqx_tpu.ops_guard import SlowSubs
+from api_helper import auth_session
 from mqtt_client import TestClient
 
 
@@ -20,6 +25,7 @@ def make_server(**kw):
     cfg = BrokerConfig()
     cfg.listeners = [ListenerConfig(port=0)]
     cfg.api.enable = True
+    cfg.api.data_dir = tempfile.mkdtemp(dir=_MGMT_TMP.name)
     cfg.api.port = 0
     for k, v in kw.items():
         setattr(cfg, k, v)
@@ -89,8 +95,8 @@ def test_alarms_rest_and_sys():
         assert pkt.topic.endswith("/alarms/activate")
         assert b"high_mem" in pkt.payload
 
-        api = f"http://127.0.0.1:{srv.api.port}"
-        async with aiohttp.ClientSession() as http:
+        http, api = await auth_session(srv)
+        async with http:
             async with http.get(api + "/api/v5/alarms") as r:
                 data = await r.json()
             assert data["data"][0]["name"] == "high_mem"
@@ -114,8 +120,8 @@ def test_banned_rest_crud():
     async def t():
         srv = make_server()
         await srv.start()
-        api = f"http://127.0.0.1:{srv.api.port}"
-        async with aiohttp.ClientSession() as http:
+        http, api = await auth_session(srv)
+        async with http:
             async with http.post(
                 api + "/api/v5/banned",
                 json={"as": "peerhost", "who": "10.0.0.9", "seconds": 60},

@@ -2,6 +2,10 @@
 migration (emqx_node_rebalance / emqx_eviction_agent parity)."""
 
 import asyncio
+import tempfile
+
+# auto-cleaned parent for per-test mgmt stores (finalized at interpreter exit)
+_MGMT_TMP = tempfile.TemporaryDirectory(prefix="emqx-mgmt-")
 
 from emqx_tpu.broker.listener import BrokerServer
 from emqx_tpu.cluster import ClusterNode
@@ -21,6 +25,7 @@ def test_evacuation_drains_and_signals_clients():
         cfg = BrokerConfig()
         cfg.listeners = [ListenerConfig(port=0)]
         cfg.api.enable = True
+        cfg.api.data_dir = tempfile.mkdtemp(dir=_MGMT_TMP.name)
         cfg.api.port = 0
         srv = BrokerServer(cfg)
         await srv.start()

@@ -2,6 +2,10 @@
 emqx_ctl parity at the black-box level)."""
 
 import asyncio
+import tempfile
+
+# auto-cleaned parent for per-test mgmt stores (finalized at interpreter exit)
+_MGMT_TMP = tempfile.TemporaryDirectory(prefix="emqx-mgmt-")
 import subprocess
 import sys
 
@@ -9,6 +13,7 @@ import aiohttp
 
 from emqx_tpu.broker.listener import BrokerServer
 from emqx_tpu.config import BrokerConfig, ListenerConfig
+from api_helper import auth_session
 from mqtt_client import TestClient
 
 
@@ -20,6 +25,7 @@ def make_server(tmp_path):
     cfg = BrokerConfig()
     cfg.listeners = [ListenerConfig(port=0)]
     cfg.api.enable = True
+    cfg.api.data_dir = tempfile.mkdtemp(dir=_MGMT_TMP.name)
     cfg.api.port = 0
     srv = BrokerServer(cfg)
     srv.broker.trace.directory = str(tmp_path / "trace")
@@ -31,9 +37,9 @@ def test_trace_clientid_and_topic(tmp_path):
         srv = make_server(tmp_path)
         await srv.start()
         port = srv.listeners[0].port
-        api = f"http://127.0.0.1:{srv.api.port}"
+        http, api = await auth_session(srv)
 
-        async with aiohttp.ClientSession() as http:
+        async with http:
             async with http.post(
                 api + "/api/v5/trace",
                 json={"name": "t1", "type": "clientid", "match": "dev-1"},
