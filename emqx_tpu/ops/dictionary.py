@@ -29,10 +29,25 @@ SENTINEL = np.int32(2**31 - 1)
 
 
 class TokenDict:
-    """Append-only word -> id map shared by builder and encoders."""
+    """Append-only word -> id map shared by builder and encoders.
+
+    Lookups stay on the Python dict (nanosecond-scale, the per-topic
+    encode path); BULK filter encodes can go through a native mirror
+    (`tokdict_native.NativeEncoder`) that does the split+map work in
+    one GIL-released C++ call and reports new words back, so both maps
+    always hold the identical word -> id relation.  Mutations are not
+    thread-safe — callers serialize them (the engine's ``_enc_lock``),
+    exactly as with the plain dict."""
 
     def __init__(self) -> None:
+        import threading
+
         self._ids: Dict[str, int] = {}
+        self._native = None  # lazy; False when unavailable
+        # native() can race between the match thread (_enc_mutex) and
+        # a builder thread (_enc_lock): two encoders seeded moments
+        # apart would alias token ids.  One lock, one instance.
+        self._nat_lock = threading.Lock()
 
     def __len__(self) -> int:
         return len(self._ids)
@@ -40,13 +55,51 @@ class TokenDict:
     def add(self, word: str) -> int:
         wid = self._ids.get(word)
         if wid is None:
-            wid = len(self._ids)
+            nat = self._native
+            if nat:
+                # the mirror is the allocator once it exists, so ids
+                # stay aligned across both maps
+                wid = nat.add(word)
+            else:
+                wid = len(self._ids)
             self._ids[word] = wid
         return wid
 
     def get(self, word: str) -> int:
         """Lookup without inserting; unknown words -> UNKNOWN_TOK."""
         return self._ids.get(word, UNKNOWN_TOK)
+
+    def native(self):
+        """The native batch encoder, created on first use (None when
+        the toolchain can't build it)."""
+        if self._native is None:
+            with self._nat_lock:
+                if self._native is None:
+                    try:
+                        from .tokdict_native import NativeEncoder, load
+
+                        self._native = (
+                            NativeEncoder(self._ids)
+                            if load() is not None else False
+                        )
+                    except Exception:
+                        self._native = False
+        return self._native or None
+
+    def encode_filters_into(
+        self, items, max_levels: int,
+        mat: np.ndarray, blen: np.ndarray, ish: np.ndarray,
+    ) -> bool:
+        """Batch-encode ``(fid, words)`` pairs into the given array
+        slices via the native encoder; False when unavailable (caller
+        falls back to the per-item Python loop)."""
+        nat = self.native()
+        if nat is None:
+            return False
+        nat.encode_filters_into(
+            self._ids, items, max_levels, mat, blen, ish
+        )
+        return True
 
 
 def encode_topics(

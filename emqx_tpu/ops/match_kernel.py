@@ -67,21 +67,17 @@ def _fp_lookup(fp_rows, nodes, toks, salt):
     return jnp.where(valid & (child >= 0), child, SENTINEL)
 
 
-@partial(jax.jit, static_argnames=("f_width", "m_cap"))
-def match_batch(
+def _match_core(
     fp_rows,
     node_rows,
-    salt,  # uint32 scalar (traced: shard stacks carry per-shard salts)
-    tokens,  # [B, L] int32
-    lengths,  # [B] int32
-    dollar,  # [B] bool
-    *,
+    salt,
+    tokens,
+    lengths,
+    dollar,
     f_width: int,
-    m_cap: int,
 ):
-    """Match a topic batch.  Returns ``(codes [B, m_cap] int32 (-1 pad),
-    counts [B] int32, overflow [B] bool)``; an overflowed row's codes are
-    incomplete and the caller must re-match that topic on the host."""
+    """Shared frontier scan: returns ``(vals, hits, over_seq)`` — the
+    (code value, hit flag) pair matrix the output stages compact."""
     b, levels = tokens.shape
     n_nodes = node_rows.shape[0]
     salt = salt.astype(jnp.uint32)
@@ -157,6 +153,28 @@ def match_batch(
         ],
         axis=1,
     )
+    return vals, hits, over_seq
+
+
+@partial(jax.jit, static_argnames=("f_width", "m_cap"))
+def match_batch(
+    fp_rows,
+    node_rows,
+    salt,  # uint32 scalar (traced: shard stacks carry per-shard salts)
+    tokens,  # [B, L] int32
+    lengths,  # [B] int32
+    dollar,  # [B] bool
+    *,
+    f_width: int,
+    m_cap: int,
+):
+    """Match a topic batch.  Returns ``(codes [B, m_cap] int32 (-1 pad),
+    counts [B] int32, overflow [B] bool)``; an overflowed row's codes are
+    incomplete and the caller must re-match that topic on the host."""
+    b = tokens.shape[0]
+    vals, hits, over_seq = _match_core(
+        fp_rows, node_rows, salt, tokens, lengths, dollar, f_width
+    )
     prefix = jnp.cumsum(hits.astype(jnp.int32), axis=1)
     count = prefix[:, -1]
     pos = jnp.where(hits & (prefix <= m_cap), prefix - 1, m_cap)
@@ -167,3 +185,51 @@ def match_batch(
     buf = buf.at[rows, pos].set(vals, mode="drop")
     ovf = jnp.any(over_seq, axis=0) | (count > m_cap)
     return buf, jnp.minimum(count, m_cap), ovf
+
+
+@partial(jax.jit, static_argnames=("f_width", "m_cap", "c_cap"))
+def match_batch_compact(
+    fp_rows,
+    node_rows,
+    salt,
+    tokens,  # [B, L] int32
+    lengths,  # [B] int32
+    dollar,  # [B] bool
+    *,
+    f_width: int,
+    m_cap: int,
+    c_cap: int,
+):
+    """`match_batch` with a COMPACTED output layout for slow
+    host<->device links (the axon tunnel moves ~10 MB/s: the dense
+    ``[B, m_cap]`` code matrix at ~3% fill was the full-path
+    bottleneck — 1 MB/batch of mostly ``-1``).
+
+    Returns ``(flat [c_cap] int32, counts [B] int16, total [1] int32)``:
+      * ``flat``   — all match codes, row-major, rows abutting at
+        offsets ``cumsum(counts)`` (the host rebuilds boundaries);
+      * ``counts`` — per-row code count, NEGATIVE (-n-1) when the row
+        overflowed ``f_width``/``m_cap`` and must be host-rematched;
+      * ``total``  — sum of per-row counts BEFORE the ``c_cap`` clip:
+        if ``total > c_cap`` the flat buffer dropped codes and the
+        caller must fall back to the dense kernel (rare: size c_cap
+        for ~2x the expected fill).
+
+    ~12x fewer bytes per batch at bench shapes (flat ~B/2 used of
+    c_cap=B, int16 counts, no [B, m_cap] dense matrix)."""
+    b = tokens.shape[0]
+    vals, hits, over_seq = _match_core(
+        fp_rows, node_rows, salt, tokens, lengths, dollar, f_width
+    )
+    prefix = jnp.cumsum(hits.astype(jnp.int32), axis=1)
+    count = prefix[:, -1]
+    count_c = jnp.minimum(count, m_cap)
+    row_start = jnp.cumsum(count_c) - count_c  # exclusive
+    valid = hits & (prefix <= m_cap)
+    tgt = jnp.where(valid, row_start[:, None] + (prefix - 1), c_cap)
+    flat = jnp.full((c_cap,), -1, jnp.int32)
+    flat = flat.at[tgt.reshape(-1)].set(vals.reshape(-1), mode="drop")
+    ovf = jnp.any(over_seq, axis=0) | (count > m_cap)
+    counts_out = jnp.where(ovf, -count_c - 1, count_c).astype(jnp.int16)
+    total = (row_start[-1] + count_c[-1]).astype(jnp.int32)[None]
+    return flat, counts_out, total

@@ -77,6 +77,11 @@ class HostTrie:
         self._seqs[fid] = self._seq
         return self._seq
 
+    def insert_batch(self, items):
+        """Batch insert of ``(flt, fid, ws)`` triples (interface twin
+        of NativeTrie.insert_batch); returns per-item seq tags."""
+        return [self.insert(flt, fid, ws=ws) for flt, fid, ws in items]
+
     def delete_id(self, fid: Hashable) -> bool:
         ws = self._filters.pop(fid, None)
         if ws is None:

@@ -69,6 +69,11 @@ def load():
             ]
             lib.ht_seq.restype = ctypes.c_int64
             lib.ht_seq.argtypes = [ctypes.c_void_p]
+            _i64p = ctypes.POINTER(ctypes.c_int64)
+            lib.ht_insert_batch.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p,
+                _i64p, _i64p, _i64p, ctypes.c_int64, _i64p,
+            ]
             lib.ht_match_since.restype = ctypes.c_int64
             lib.ht_match_since.argtypes = [
                 ctypes.c_void_p,
@@ -111,6 +116,8 @@ class NativeTrie:
         self._filters: Dict[Hashable, Tuple[str, ...]] = {}
         self._buf = np.empty(1024, np.int64)
         self._buf_p = self._buf.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+        # bound locals: CDLL attribute access is a per-call dict lookup
+        self._ht_insert = self._lib.ht_insert
 
     def __del__(self) -> None:
         lib, h = getattr(self, "_lib", None), getattr(self, "_h", None)
@@ -154,9 +161,37 @@ class NativeTrie:
             ws = T.words(flt)
         if self._filters.get(fid) == ws:
             return 0
-        seq = self._lib.ht_insert(self._h, flt.encode(), self._intern(fid))
+        seq = self._ht_insert(self._h, flt.encode(), self._intern(fid))
         self._filters[fid] = ws
         return seq
+
+    def insert_batch(self, items) -> List[int]:
+        """Insert ``(flt, fid, ws)`` triples in ONE GIL-released call
+        (the emqx_router_syncer batching shape); returns per-item
+        sequence tags.  Callers pre-filter unchanged entries."""
+        n = len(items)
+        parts = []
+        fids = np.empty(n, np.int64)
+        for i, (flt, fid, ws) in enumerate(items):
+            parts.append(flt.encode())
+            fids[i] = self._intern(fid)
+        blob = b"".join(parts)
+        lens = np.fromiter((len(p) for p in parts), np.int64, count=n)
+        starts = np.empty(n, np.int64)
+        if n:
+            starts[0] = 0
+            np.cumsum(lens[:-1], out=starts[1:])
+        seqs = np.empty(n, np.int64)
+        p64 = ctypes.POINTER(ctypes.c_int64)
+        self._lib.ht_insert_batch(
+            self._h, blob,
+            starts.ctypes.data_as(p64), lens.ctypes.data_as(p64),
+            fids.ctypes.data_as(p64), n, seqs.ctypes.data_as(p64),
+        )
+        flt_map = self._filters
+        for flt, fid, ws in items:
+            flt_map[fid] = ws
+        return seqs.tolist()
 
     def delete_id(self, fid: Hashable) -> bool:
         if type(fid) is int and fid >= 0:

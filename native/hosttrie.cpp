@@ -178,6 +178,19 @@ int64_t ht_insert(void* h, const char* flt, int64_t fid) {
     return seq;
 }
 
+// Batch insert (the emqx_router_syncer batching shape: route ops
+// arrive in windows, and one GIL-released call amortizes the ctypes
+// boundary).  Filter i = blob[starts[i], starts[i]+lens[i]); seqs_out
+// gets each insert's sequence tag (0 when unchanged).
+void ht_insert_batch(void* h, const char* blob, const int64_t* starts,
+                     const int64_t* lens, const int64_t* fids,
+                     int64_t n, int64_t* seqs_out) {
+    for (int64_t i = 0; i < n; i++) {
+        std::string f(blob + starts[i], static_cast<size_t>(lens[i]));
+        seqs_out[i] = ht_insert(h, f.c_str(), fids[i]);
+    }
+}
+
 // Latest assigned sequence tag (the fold watermark source).
 int64_t ht_seq(void* h) { return static_cast<Trie*>(h)->seq; }
 

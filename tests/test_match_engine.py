@@ -303,6 +303,12 @@ def test_delta_automaton_churn_equivalence(seed):
             else:
                 exact.setdefault(flt, set()).add(fid)
             fid += 1
+        # folds are async and now warm the kernel BEFORE committing;
+        # join so the round's checks (and the exercised-path assert)
+        # see the committed delta automaton deterministically
+        t = engine._fold_thread
+        if t is not None and t.is_alive():
+            t.join(60)
         built_delta = built_delta or engine._daut is not None
         if round_ == 0:
             engine.rebuild()  # establish a base; later rounds churn
@@ -428,3 +434,67 @@ def test_reinserted_fid_survives_fold():
     for i in range(200, 240):
         engine.insert(f"churn2/{i}/+", i)
     assert 8 not in engine.match("seed/8/q")
+
+
+def test_insert_many_equivalence():
+    """insert_many must land in exactly the same state as per-item
+    insert: same matches across exact/wild/deep/replaced entries."""
+    import random
+
+    rng = random.Random(99)
+    pairs = []
+    fid = 0
+    for _ in range(400):
+        flt = random_filter(rng)
+        try:
+            T.validate_filter(flt)
+        except ValueError:
+            continue
+        pairs.append((flt, fid))
+        fid += 1
+    # replacements: re-list some fids with different filters
+    for i in range(0, len(pairs), 7):
+        if "#" not in pairs[i][0]:  # '#/x' would be invalid
+            pairs.append((pairs[i][0] + "/x", pairs[i][1]))
+    deep = "/".join(f"l{i}" for i in range(12)) + "/+"
+    pairs.append((deep, 10_001))  # deep (max_levels=8) path
+
+    one = MatchEngine(max_levels=8, rebuild_threshold=10**9,
+                      delta_aut_threshold=10**9)
+    many = MatchEngine(max_levels=8, rebuild_threshold=10**9,
+                       delta_aut_threshold=10**9)
+    for flt, f in pairs:
+        one.insert(flt, f)
+    for i in range(0, len(pairs), 64):  # windowed, as the syncer does
+        many.insert_many(pairs[i:i + 64])
+
+    topics = [random_topic(rng) for _ in range(200)]
+    topics.append("l0/l1/l2/l3/l4/l5/l6/l7/l8/l9/l10/l11/zz")
+    assert one.match_batch(topics) == many.match_batch(topics)
+    assert one.index_stats()["exact"] == many.index_stats()["exact"]
+
+    # an invalid filter anywhere in the window rejects the WHOLE
+    # window before any mutation (atomic validation) — no half-applied
+    # batches
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        many.insert_many([("ok/+", 20_000), ("bad/#/mid", 20_001)])
+    assert 20_000 not in many._by_fid
+    assert many.match("ok/x") == one.match("ok/x")
+
+
+def test_insert_many_duplicate_fid_last_wins():
+    """A fid listed twice in ONE window must end exactly as per-item
+    inserts would: the LAST filter wins everywhere."""
+    eng = MatchEngine(max_levels=8, rebuild_threshold=10**9,
+                      delta_aut_threshold=10**9)
+    eng.insert_many([("a/+", 1), ("b/+", 1)])
+    assert eng.match("a/x") == set()
+    assert eng.match("b/x") == {1}
+    assert eng._by_fid[1] == "b/+"
+    # and with a pre-existing registration in the same engine
+    eng.insert_many([("c/+", 1), ("d/+", 1), ("e/+", 2)])
+    assert eng.match("b/x") == set()
+    assert eng.match("c/x") == set()
+    assert eng.match("d/x") == {1}
+    assert eng.match("e/x") == {2}

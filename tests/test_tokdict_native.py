@@ -1,0 +1,121 @@
+"""Native batch filter encoder vs the Python TokenDict loop: ids,
+bodies, hash flags, and new-word mirroring must agree bit-for-bit."""
+
+import numpy as np
+import pytest
+
+from emqx_tpu.ops.dictionary import (PAD_TOK, PLUS_TOK, TokenDict,
+                                     encode_filter)
+from emqx_tpu.ops.tokdict_native import load
+
+
+FILTERS = [
+    ("a", "b", "c"),
+    ("a", "+", "c"),
+    ("#",),
+    ("a", "#"),
+    ("a", "", "#"),
+    ("", "#"),
+    ("+",),
+    ("+", "+"),
+    ("$SYS", "broker", "#"),
+    ("x" * 100, "y"),
+    ("a", "b"),        # repeats reuse ids
+    ("utf8", "日本語", "résumé"),
+    ("",),
+]
+
+
+@pytest.mark.skipif(load() is None, reason="native tokdict unavailable")
+def test_native_matches_python_encoder():
+    max_levels = 8
+    # python reference
+    td_py = TokenDict()
+    ref = [encode_filter(td_py, ws) for ws in FILTERS]
+
+    td = TokenDict()
+    n = len(FILTERS)
+    mat = np.full((n, max_levels), PAD_TOK, np.int32)
+    blen = np.zeros(n, np.int32)
+    ish = np.zeros(n, bool)
+    items = [(i, ws) for i, ws in enumerate(FILTERS)]
+    assert td.encode_filters_into(items, max_levels, mat, blen, ish)
+
+    for i, (body, hsh) in enumerate(ref):
+        assert bool(ish[i]) == hsh, FILTERS[i]
+        assert int(blen[i]) == len(body), FILTERS[i]
+        assert mat[i, : len(body)].tolist() == body, FILTERS[i]
+        assert (mat[i, len(body):] == PAD_TOK).all()
+    # the python mirror ends up identical to the pure-python dict
+    assert td._ids == td_py._ids
+    # and subsequent python-side adds stay aligned with the mirror
+    wid = td.add("brand-new-word")
+    assert wid == len(td._ids) - 1
+    assert td.native().add("brand-new-word") == wid
+
+
+@pytest.mark.skipif(load() is None, reason="native tokdict unavailable")
+def test_native_rejects_too_deep():
+    td = TokenDict()
+    deep = tuple(f"l{i}" for i in range(10))
+    mat = np.zeros((1, 4), np.int32)
+    blen = np.zeros(1, np.int32)
+    ish = np.zeros(1, bool)
+    with pytest.raises(ValueError):
+        td.encode_filters_into([(0, deep)], 4, mat, blen, ish)
+
+
+@pytest.mark.skipif(load() is None, reason="native tokdict unavailable")
+def test_randomized_equivalence_native_vs_python():
+    import random
+
+    rng = random.Random(7)
+    words = ["a", "b", "cc", "+", "", "dev", "$x", "zz9"]
+    filters = []
+    for _ in range(500):
+        n = rng.randint(1, 6)
+        ws = [rng.choice(words) for _ in range(n)]
+        if rng.random() < 0.4:
+            ws.append("#")
+        filters.append(tuple(ws))
+    td_py = TokenDict()
+    ref = [encode_filter(td_py, ws) for ws in filters]
+    td = TokenDict()
+    mat = np.full((len(filters), 8), PAD_TOK, np.int32)
+    blen = np.zeros(len(filters), np.int32)
+    ish = np.zeros(len(filters), bool)
+    assert td.encode_filters_into(
+        [(i, ws) for i, ws in enumerate(filters)], 8, mat, blen, ish
+    )
+    for i, (body, hsh) in enumerate(ref):
+        assert bool(ish[i]) == hsh
+        assert mat[i, : len(body)].tolist() == body
+        assert int(blen[i]) == len(body)
+    assert td._ids == td_py._ids
+
+
+@pytest.mark.skipif(load() is None, reason="native tokdict unavailable")
+def test_encode_topics_into_matches_python():
+    from emqx_tpu.ops.dictionary import encode_topics, UNKNOWN_TOK
+    from emqx_tpu import topic as T
+
+    td = TokenDict()
+    # register some filter words so ids exist
+    mat0 = np.zeros((3, 6), np.int32); b0 = np.zeros(3, np.int32)
+    h0 = np.zeros(3, bool)
+    td.encode_filters_into(
+        [(0, ("a", "b")), (1, ("$SYS", "x")), (2, ("deep", "", "w"))],
+        6, mat0, b0, h0,
+    )
+    topics = ["a/b", "a/zz", "$SYS/x", "", "/", "deep//w",
+              "a/b/c/d/e/f/g/h/i"]  # last: truncation at levels
+    levels = 6
+    want = encode_topics(td, [T.words(t) for t in topics], levels)
+    n = len(topics)
+    mat = np.zeros((n, levels), np.int32)
+    lens = np.zeros(n, np.int32)
+    dol = np.zeros(n, bool)
+    td.native().encode_topics_into(topics, levels, mat, lens, dol)
+    assert (mat == want[0]).all()
+    assert (lens == want[1]).all()
+    assert (dol == want[2]).all()

@@ -1,0 +1,45 @@
+"""Where do insert cycles go under sustained churn?  Compares bare
+host-only inserts, device-path inserts (folds+rebuilds live), and the
+encode cost the background threads pay (GIL steal suspect)."""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+from emqx_tpu.engine import MatchEngine
+
+N = int(os.environ.get("P_N", 100_000))
+
+# 1. bare inserts, host only, huge thresholds (no folds/builds)
+eng = MatchEngine(use_device=False, rebuild_threshold=10**9,
+                  delta_aut_threshold=10**9)
+t0 = time.perf_counter()
+for i in range(N):
+    eng.insert(f"ins/{i % 4099}/+/x{i}", i)
+el = time.perf_counter() - t0
+print(f"bare insert (no device, no folds): {N/el:,.0f}/s ({el/N*1e6:.1f} us)", flush=True)
+
+# 2. encode cost of those same filters (what fold/rebuild threads pay)
+from emqx_tpu.ops.automaton import encode_filters
+items = list(eng._delta.items())
+t0 = time.perf_counter()
+inputs = encode_filters(items, eng._tdict, 16)
+el = time.perf_counter() - t0
+print(f"encode_filters of {len(items)}: {el*1e3:.0f} ms ({el/len(items)*1e6:.1f} us/filter)", flush=True)
+
+# 3. assemble cost (numpy, releases GIL in C)
+from emqx_tpu.ops.automaton import assemble_automaton
+t0 = time.perf_counter()
+aut = assemble_automaton(*inputs, max_levels=16)
+el = time.perf_counter() - t0
+print(f"assemble: {el*1e3:.0f} ms", flush=True)
+
+# 4. device-path churn (folds + background rebuild live), no matches
+eng2 = MatchEngine(rebuild_threshold=65536, background_rebuild=True,
+                   use_device=True)
+for i in range(1000):
+    eng2.insert(f"seed/{i}/+/s{i}", -i - 1)
+eng2.rebuild()
+t0 = time.perf_counter()
+for i in range(N):
+    eng2.insert(f"ins/{i % 4099}/+/x{i}", 10**6 + i)
+el = time.perf_counter() - t0
+print(f"device-path insert (folds+rebuilds): {N/el:,.0f}/s ({el/N*1e6:.1f} us) stats={eng2.index_stats()}", flush=True)

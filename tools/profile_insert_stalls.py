@@ -1,0 +1,87 @@
+"""Reproduce measure_insert_rps and attribute stalls: log every insert
+>2ms with the engine state flags, plus a background-thread activity
+sample, to find what steals the insert thread's time."""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+from bench import make_filters
+from emqx_tpu.engine import MatchEngine, enable_compile_cache
+enable_compile_cache()
+
+n_base = 1_000_000
+n_insert = 100_000
+filters, pops = make_filters(n_base, 8)
+eng = MatchEngine(max_levels=16, rebuild_threshold=65536,
+                  background_rebuild=True, use_device=True)
+for fid, ws in filters:
+    eng._wild.insert("/".join(ws), fid)
+    eng._by_fid[fid] = "/".join(ws)
+t0 = time.perf_counter(); eng.rebuild()
+print(f"rebuild base: {time.perf_counter()-t0:.1f}s", flush=True)
+probe = [f"vehicles/v{i}/sensors/temp" for i in range(16)]
+t0 = time.perf_counter(); eng.match_batch(probe)
+print(f"first match: {time.perf_counter()-t0:.1f}s", flush=True)
+
+stalls = []
+t_start = time.perf_counter()
+match_time = 0.0
+mlat = []
+W = 512
+for w0 in range(0, n_insert, W):
+    t0 = time.perf_counter()
+    eng.insert_many([(f"ins/{i % 4099}/+/x{i}", n_base + i)
+                     for i in range(w0, min(w0 + W, n_insert))])
+    dt = time.perf_counter() - t0
+    if dt > 0.004:
+        stalls.append((w0, dt, dict(eng.index_stats())))
+    if (w0 // W) % 4 == 3:
+        m0 = time.perf_counter()
+        eng.match_batch(probe)
+        md = time.perf_counter() - m0
+        match_time += md
+        mlat.append((w0, md))
+el = time.perf_counter() - t_start - match_time
+print(f"insert rate: {n_insert/el:,.0f}/s (el={el:.2f}s match_time={match_time:.2f}s)", flush=True)
+print(f"stalls>2ms: {len(stalls)} total {sum(s[1] for s in stalls):.2f}s", flush=True)
+for i, dt, st in stalls[:15]:
+    print(f"  insert#{i} {dt*1e3:8.1f} ms building={st['building']} folding={st['folding']} delta={st['delta']} residual={st['residual']}", flush=True)
+mlat.sort(key=lambda x: -x[1])
+print("slowest matches:", [(i, round(d*1e3)) for i, d in mlat[:6]], flush=True)
+
+# second pass: timeline of builder phases vs probe spikes
+import threading
+from emqx_tpu.engine import MatchEngine as _ME
+ev = []
+_orig_dp = _ME._device_put
+_orig_warm = _ME._warm_built
+def dp(self, aut, chunk_bytes=1 << 19):
+    t0 = time.perf_counter(); out = _orig_dp(self, aut, chunk_bytes)
+    ev.append(("device_put", t0, time.perf_counter(), threading.current_thread().name))
+    return out
+def warm(self, aut, dev):
+    t0 = time.perf_counter(); out = _orig_warm(self, aut, dev)
+    ev.append(("warm", t0, time.perf_counter(), threading.current_thread().name))
+    return out
+_ME._device_put = dp; _ME._warm_built = warm
+
+eng2 = _ME(max_levels=16, rebuild_threshold=65536,
+           background_rebuild=True, use_device=True)
+for fid, ws in filters:
+    eng2._wild.insert("/".join(ws), fid)
+    eng2._by_fid[fid] = "/".join(ws)
+eng2.rebuild(); eng2.match_batch(probe)
+base_t = time.perf_counter()
+probes = []
+W = 512
+for w0 in range(0, n_insert, W):
+    eng2.insert_many([(f"i2/{i % 4099}/+/y{i}", 3*n_base + i)
+                      for i in range(w0, min(w0 + W, n_insert))])
+    if (w0 // W) % 4 == 3:
+        m0 = time.perf_counter()
+        eng2.match_batch(probe)
+        probes.append((m0 - base_t, time.perf_counter() - m0))
+print("--- timeline (builder events, relative s) ---", flush=True)
+for name, t0, t1, thr in ev:
+    print(f"  {name:10s} {t0-base_t:7.2f} -> {t1-base_t:7.2f} ({t1-t0:6.2f}s) [{thr}]", flush=True)
+slow = sorted(probes, key=lambda x: -x[1])[:8]
+print("slow probes at:", [(round(t,2), round(d*1e3)) for t, d in slow], flush=True)
