@@ -159,9 +159,17 @@ class BrokerServer:
         self.api = None  # MgmtApi when config.api.enable
         self.cluster_links = None  # ClusterLinks when config.cluster_links
         self.otel = None  # OtelExporter when config.otel.enable
+        self.exhook_clients: list = []  # ExhookClient per config.exhooks
 
     async def start(self) -> None:
         eng_cfg = self.broker.config.engine
+        if self.broker.router.engine.use_device is not False:
+            # persistent XLA cache: automaton capacity-class compiles
+            # happen once EVER, not once per process — a first-use
+            # compile stalls concurrent matches for seconds
+            from ..engine import enable_compile_cache
+
+            enable_compile_cache()
         if eng_cfg.batch_publish:
             from .broker import PublishBatcher
 
@@ -194,6 +202,24 @@ class BrokerServer:
             await self._load_gateway(gw_cfg)
         if self.cluster_links is not None:
             await self.cluster_links.start()
+        for ex_cfg in cfg.exhooks:
+            from ..exhook.client import ExhookClient
+
+            client = ExhookClient(
+                self.broker,
+                name=ex_cfg["name"],
+                url=ex_cfg["url"],
+                timeout=float(ex_cfg.get("timeout", 5.0)),
+                failure_action=ex_cfg.get("failure_action", "deny"),
+            )
+            # dial in an executor: OnProviderLoaded is a blocking
+            # round-trip and must not stall listener startup.  start()
+            # never raises on an unreachable provider — deny policies
+            # fail closed and the housekeeper retries the load
+            await asyncio.get_running_loop().run_in_executor(
+                None, client.start
+            )
+            self.exhook_clients.append(client)
         if cfg.ft.enable and cfg.ft.s3:
             from ..s3 import S3Client, S3Sink
 
@@ -310,6 +336,12 @@ class BrokerServer:
                     agg.tick()
                 except Exception:
                     log.exception("aggregator tick failed")
+            for client in self.exhook_clients:
+                if not client.loaded:
+                    # blocking dial: keep it off the event loop
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, client.retry
+                    )
 
     async def stop(self) -> None:
         if self._housekeeper is not None:
@@ -325,6 +357,14 @@ class BrokerServer:
         if self.cluster_links is not None:
             await self.cluster_links.stop()
             self.cluster_links = None
+        for client in self.exhook_clients:
+            try:
+                await asyncio.get_running_loop().run_in_executor(
+                    None, client.stop
+                )
+            except Exception:
+                log.debug("exhook client stop failed", exc_info=True)
+        self.exhook_clients = []
         if self.otel is not None:
             await self.otel.stop()
             self.otel = None

@@ -205,6 +205,9 @@ class BrokerConfig:
     # links [{"name", "host", "port", "topics": [...]}, ...]
     cluster_name: str = "emqx_tpu"
     cluster_links: List[Dict[str, Any]] = field(default_factory=list)
+    # exhook CLIENT servers this broker calls out to (emqx_exhook):
+    # [{"name", "url", "timeout", "failure_action": "deny"|"ignore"}]
+    exhooks: List[Dict[str, Any]] = field(default_factory=list)
     otel: OtelConfig = field(default_factory=OtelConfig)
     log: LogConfig = field(default_factory=LogConfig)
 

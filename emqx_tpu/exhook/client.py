@@ -1,0 +1,498 @@
+"""exhook CLIENT: this broker calling OUT to external HookProvider
+gRPC servers — the reference's own direction
+(/root/reference/apps/emqx_exhook/src/emqx_exhook_handler.erl:230-236
+bridges 'message.publish' to gRPC; emqx_exhook_server.erl:135 manages
+the channel with a scheduler-sized pool and a request timeout;
+emqx_exhook_mgr.erl handles lifecycle + failure policy).
+
+Lifecycle: `start()` dials the server and calls OnProviderLoaded with
+our broker info; the provider answers with the HOOKS it wants, and
+exactly those local hookpoints get handlers.  `stop()` sends
+OnProviderUnloaded and unregisters.
+
+Failure policy (`request_failed_action`): ``deny`` fails closed
+(authenticate/authorize answer DENY, a publish is dropped), ``ignore``
+fails open (the local chain continues).  A circuit breaker backs off
+after consecutive transport failures so a dead provider costs one
+fast-failed call per breaker window instead of a full timeout per
+event (the reference's auto_reconnect role).
+
+Notify-only hooks (connected/disconnected/session.*/delivered/...)
+are fired asynchronously and never block the broker; the three
+verdict hooks (authenticate/authorize/message.publish) are
+synchronous calls with the configured timeout, as in the reference.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional, Sequence
+
+import grpc
+
+from ..hooks import STOP_WITH
+from ..message import Message
+from . import pb
+
+log = logging.getLogger("emqx_tpu.exhook.client")
+
+SERVICE = "emqx.exhook.v2.HookProvider"
+
+_VERDICT_HOOKS = {
+    "client.authenticate",
+    "client.authorize",
+    "message.publish",
+}
+
+# local hookpoint -> (rpc name, request builder key)
+_NOTIFY_RPC = {
+    "client.connected": "OnClientConnected",
+    "client.disconnected": "OnClientDisconnected",
+    "client.subscribe": "OnClientSubscribe",
+    "client.unsubscribe": "OnClientUnsubscribe",
+    "session.created": "OnSessionCreated",
+    "session.subscribed": "OnSessionSubscribed",
+    "session.unsubscribed": "OnSessionUnsubscribed",
+    "session.resumed": "OnSessionResumed",
+    "session.discarded": "OnSessionDiscarded",
+    "session.takenover": "OnSessionTakenover",
+    "session.terminated": "OnSessionTerminated",
+    "message.delivered": "OnMessageDelivered",
+    "message.dropped": "OnMessageDropped",
+    "message.acked": "OnMessageAcked",
+}
+
+
+def _msg_to_pb(msg: Message, node: str) -> "pb.Message":
+    headers = {
+        k: str(v) for k, v in msg.headers.items()
+        if isinstance(v, (str, int, float, bool))
+    }
+    if msg.from_username:
+        headers.setdefault("username", msg.from_username)
+    return pb.Message(
+        node=node,
+        id=msg.mid.hex() if isinstance(msg.mid, bytes) else str(msg.mid),
+        qos=msg.qos,
+        topic=msg.topic,
+        payload=msg.payload,
+        timestamp=int(msg.timestamp * 1000),
+        headers=headers,
+        # 'from' is a Python keyword; protobuf accepts it via kwargs
+        **{"from": msg.from_client},
+    )
+
+
+def _pb_to_msg(m, base: Message) -> Optional[Message]:
+    """Fold a provider's returned Message back onto the original
+    (emqx_exhook_handler:assign_to_message semantics: topic, qos,
+    payload, headers come from the provider; allow_publish=false in
+    the headers is the drop verdict)."""
+    if m.headers.get("allow_publish", "true") == "false":
+        return None
+    return Message(
+        topic=m.topic or base.topic,
+        payload=bytes(m.payload),
+        qos=int(m.qos),
+        retain=base.retain,
+        from_client=base.from_client,
+        from_username=base.from_username,
+        mid=base.mid,
+        timestamp=base.timestamp,
+        properties=base.properties,
+        headers=base.headers,
+    )
+
+
+class ExhookClient:
+    """One configured HookProvider server (emqx_exhook_server.erl)."""
+
+    def __init__(
+        self,
+        broker,
+        name: str,
+        url: str,
+        timeout: float = 5.0,
+        failure_action: str = "deny",  # deny | ignore
+        breaker_threshold: int = 3,
+        breaker_window: float = 10.0,
+    ) -> None:
+        self.broker = broker
+        self.name = name
+        self.url = url
+        self.timeout = timeout
+        self.failure_action = failure_action
+        self.breaker_threshold = breaker_threshold
+        self.breaker_window = breaker_window
+        self._channel: Optional[grpc.Channel] = None
+        self._methods: Dict[str, grpc.UnaryUnaryMultiCallable] = {}
+        self._registered: List = []  # (hookpoint, callback)
+        self.hooks: List[str] = []  # what the provider asked for
+        self.loaded = False
+        self._failures = 0
+        self._open_until = 0.0
+        self.stats = {"calls": 0, "failures": 0, "fast_failed": 0}
+
+    # ------------------------------------------------------- lifecycle
+
+    def _method(self, rpc: str, req_cls, resp_cls):
+        m = self._methods.get(rpc)
+        if m is None:
+            m = self._methods[rpc] = self._channel.unary_unary(
+                f"/{SERVICE}/{rpc}",
+                request_serializer=req_cls.SerializeToString,
+                response_deserializer=resp_cls.FromString,
+            )
+        return m
+
+    def _meta(self) -> "pb.RequestMeta":
+        cfg = self.broker.config
+        return pb.RequestMeta(
+            node=cfg.node_name,
+            version="5.8.0-emqx_tpu",
+            sysdescr="emqx_tpu",
+            cluster_name=getattr(cfg, "cluster_name", "") or "",
+        )
+
+    def start(self) -> None:
+        """Dial and load; NEVER raises on an unreachable provider — a
+        'deny' policy fails CLOSED immediately (verdict hooks register
+        in deny mode) and `retry()` completes the load when the server
+        comes up (the reference's auto_reconnect role); silently
+        skipping the provider would degrade deny to allow-everything
+        for the process lifetime."""
+        self._channel = grpc.insecure_channel(
+            self.url.replace("http://", ""),
+            options=[("grpc.enable_retries", 0)],
+        )
+        try:
+            self._load()
+        except grpc.RpcError as exc:
+            if self.failure_action == "deny":
+                self._register(list(_VERDICT_HOOKS))
+                log.warning(
+                    "exhook client %s: provider at %s unreachable "
+                    "(%s); failing CLOSED until it loads",
+                    self.name, self.url, exc.code(),
+                )
+            else:
+                log.warning(
+                    "exhook client %s: provider at %s unreachable "
+                    "(%s); failing open until it loads",
+                    self.name, self.url, exc.code(),
+                )
+
+    def _load(self) -> None:
+        loaded = self._method(
+            "OnProviderLoaded", pb.ProviderLoadedRequest, pb.LoadedResponse
+        )(
+            pb.ProviderLoadedRequest(
+                broker=pb.BrokerInfo(
+                    version="5.8.0-emqx_tpu",
+                    sysdescr="emqx_tpu",
+                    uptime=int(time.time()
+                               - self.broker.metrics.start_time),
+                ),
+                meta=self._meta(),
+            ),
+            timeout=self.timeout,
+        )
+        self.hooks = [h.name for h in loaded.hooks]
+        self._register(self.hooks)
+        self.loaded = True
+        log.info("exhook client %s: provider at %s wants %d hooks",
+                 self.name, self.url, len(self._registered))
+
+    def retry(self) -> None:
+        """Attempt to (re)load an unreachable provider; cheap no-op
+        once loaded.  Driven by the broker's housekeeping tick."""
+        if self.loaded or self._channel is None:
+            return
+        try:
+            self._load()
+        except grpc.RpcError:
+            pass
+
+    def _register(self, names: Sequence[str]) -> None:
+        reg = self.broker.hooks
+        for name, cb in self._registered:
+            reg.delete(name, cb)
+        self._registered = []
+        for name in names:
+            if name == "message.publish":
+                cb = reg.add("message.publish", self._on_message_publish,
+                             priority=50)
+            elif name == "client.authenticate":
+                cb = reg.add("client.authenticate", self._on_authenticate,
+                             priority=50)
+            elif name == "client.authorize":
+                cb = reg.add("client.authorize", self._on_authorize,
+                             priority=50)
+            elif name in _NOTIFY_RPC:
+                cb = reg.add(name, self._notify_handler(name), priority=50)
+            else:
+                continue
+            self._registered.append((name, cb))
+
+    def stop(self) -> None:
+        for name, cb in self._registered:
+            self.broker.hooks.delete(name, cb)
+        self._registered = []
+        if self._channel is not None:
+            if self.loaded:
+                try:
+                    self._method(
+                        "OnProviderUnloaded", pb.ProviderUnloadedRequest,
+                        pb.EmptySuccess,
+                    )(pb.ProviderUnloadedRequest(meta=self._meta()),
+                      timeout=self.timeout)
+                except grpc.RpcError:
+                    pass
+            self._channel.close()
+            self._channel = None
+        self.loaded = False
+
+    # --------------------------------------------------------- breaker
+
+    def _call(self, rpc: str, req_cls, resp_cls, req):
+        """Verdict call with circuit breaking: after
+        ``breaker_threshold`` consecutive transport failures the
+        breaker opens for ``breaker_window`` seconds and calls fail
+        fast (None result) instead of each eating a full timeout."""
+        now = time.monotonic()
+        if now < self._open_until:
+            self.stats["fast_failed"] += 1
+            return None
+        try:
+            self.stats["calls"] += 1
+            out = self._method(rpc, req_cls, resp_cls)(
+                req, timeout=self.timeout
+            )
+            self._failures = 0
+            return out
+        except grpc.RpcError as exc:
+            self.stats["failures"] += 1
+            self._failures += 1
+            if self._failures >= self.breaker_threshold:
+                self._open_until = now + self.breaker_window
+                log.warning(
+                    "exhook client %s: breaker OPEN for %.0fs after %d "
+                    "failures (%s)", self.name, self.breaker_window,
+                    self._failures, exc.code(),
+                )
+            else:
+                log.warning("exhook client %s: %s failed: %s",
+                            self.name, rpc, exc.code())
+            return None
+
+    # -------------------------------------------------- verdict hooks
+
+    def _client_pb(self, client) -> "pb.ClientInfo":
+        return pb.ClientInfo(
+            node=self.broker.config.node_name,
+            clientid=getattr(client, "clientid", "") or "",
+            username=getattr(client, "username", "") or "",
+            peerhost=(getattr(client, "peerhost", "") or "").split(":")[0],
+            protocol="mqtt",
+            mountpoint=getattr(client, "mountpoint", "") or "",
+            is_superuser=bool(getattr(client, "is_superuser", False)),
+            anonymous=not getattr(client, "username", None),
+        )
+
+    def _on_message_publish(self, msg: Message):
+        if msg.sys or msg.topic.startswith("$"):
+            return None  # the reference skips $-topics (is_sys check)
+        if not self.loaded:
+            # dial never succeeded: fail closed without a wire attempt
+            return STOP_WITH(None) if self.failure_action == "deny" \
+                else None
+        out = self._call(
+            "OnMessagePublish", pb.MessagePublishRequest,
+            pb.ValuedResponse,
+            pb.MessagePublishRequest(
+                message=_msg_to_pb(msg, self.broker.config.node_name),
+                meta=self._meta(),
+            ),
+        )
+        if out is None:  # transport failure
+            if self.failure_action == "deny":
+                return STOP_WITH(None)  # drop the message
+            return None
+        if out.type == pb.ValuedResponse.IGNORE:
+            return None
+        if out.WhichOneof("value") != "message":
+            return None
+        folded = _pb_to_msg(out.message, msg)
+        if folded is None:
+            return STOP_WITH(None)  # provider set allow_publish=false
+        if out.type == pb.ValuedResponse.STOP_AND_RETURN:
+            return STOP_WITH(folded)
+        return folded  # CONTINUE with the mutated message
+
+    def _on_authenticate(self, client, acc):
+        from ..access import ALLOW, DENY
+
+        if not self.loaded:
+            return DENY if self.failure_action == "deny" else None
+        out = self._call(
+            "OnClientAuthenticate", pb.ClientAuthenticateRequest,
+            pb.ValuedResponse,
+            pb.ClientAuthenticateRequest(
+                clientinfo=self._client_pb(client),
+                result=acc == ALLOW,
+                meta=self._meta(),
+            ),
+        )
+        if out is None:
+            return DENY if self.failure_action == "deny" else None
+        if out.type == pb.ValuedResponse.IGNORE or \
+                out.WhichOneof("value") != "bool_result":
+            return None
+        verdict = ALLOW if out.bool_result else DENY
+        if out.type == pb.ValuedResponse.STOP_AND_RETURN:
+            return STOP_WITH(verdict)
+        return verdict
+
+    def _on_authorize(self, client, action, topic, acc):
+        from ..access import ALLOW, DENY, PUBLISH
+
+        if not self.loaded:
+            return DENY if self.failure_action == "deny" else None
+        out = self._call(
+            "OnClientAuthorize", pb.ClientAuthorizeRequest,
+            pb.ValuedResponse,
+            pb.ClientAuthorizeRequest(
+                clientinfo=self._client_pb(client),
+                type=(pb.ClientAuthorizeRequest.PUBLISH
+                      if action == PUBLISH
+                      else pb.ClientAuthorizeRequest.SUBSCRIBE),
+                topic=topic,
+                result=acc == ALLOW,
+                meta=self._meta(),
+            ),
+        )
+        if out is None:
+            return DENY if self.failure_action == "deny" else None
+        if out.type == pb.ValuedResponse.IGNORE or \
+                out.WhichOneof("value") != "bool_result":
+            return None
+        verdict = ALLOW if out.bool_result else DENY
+        if out.type == pb.ValuedResponse.STOP_AND_RETURN:
+            return STOP_WITH(verdict)
+        return verdict
+
+    # --------------------------------------------------- notify hooks
+
+    def _notify_handler(self, name: str):
+        rpc = _NOTIFY_RPC[name]
+
+        def handler(*args):
+            if time.monotonic() < self._open_until:
+                self.stats["fast_failed"] += 1
+                return None
+            try:
+                req = self._notify_request(name, args)
+            except Exception:
+                log.debug("exhook notify %s: request build failed",
+                          name, exc_info=True)
+                return None
+            if req is None:
+                return None
+            method = self._method(
+                rpc, type(req), pb.EmptySuccess
+            )
+            fut = method.future(req, timeout=self.timeout)
+            fut.add_done_callback(self._notify_done)
+            return None
+
+        return handler
+
+    def _notify_done(self, fut) -> None:
+        exc = fut.exception()
+        if exc is not None:
+            self.stats["failures"] += 1
+            self._failures += 1
+            if self._failures >= self.breaker_threshold:
+                self._open_until = (
+                    time.monotonic() + self.breaker_window
+                )
+        else:
+            self._failures = 0
+
+    def _notify_request(self, name: str, args):
+        meta = self._meta()
+        node = self.broker.config.node_name
+        if name == "client.connected":
+            return pb.ClientConnectedRequest(
+                clientinfo=self._client_pb(args[0]), meta=meta)
+        if name == "client.disconnected":
+            return pb.ClientDisconnectedRequest(
+                clientinfo=self._client_pb(args[0]),
+                reason=str(args[1]) if len(args) > 1 else "",
+                meta=meta)
+        if name == "client.subscribe":
+            # fold hook signature (client, flt, acc): notify-only here
+            return pb.ClientSubscribeRequest(
+                clientinfo=self._client_pb(args[0]),
+                topic_filters=[pb.TopicFilter(name=str(args[1]))],
+                meta=meta)
+        if name == "client.unsubscribe":
+            return pb.ClientUnsubscribeRequest(
+                clientinfo=self._client_pb(args[0]),
+                topic_filters=[pb.TopicFilter(name=str(args[1]))],
+                meta=meta)
+        if name.startswith("session."):
+            cls = {
+                "session.created": pb.SessionCreatedRequest,
+                "session.subscribed": pb.SessionSubscribedRequest,
+                "session.unsubscribed": pb.SessionUnsubscribedRequest,
+                "session.resumed": pb.SessionResumedRequest,
+                "session.discarded": pb.SessionDiscardedRequest,
+                "session.takenover": pb.SessionTakenoverRequest,
+                "session.terminated": pb.SessionTerminatedRequest,
+            }[name]
+            kw = {"meta": meta}
+            ci = pb.ClientInfo(node=node, clientid=str(args[0]))
+            kw["clientinfo"] = ci
+            if name == "session.subscribed" and len(args) > 1:
+                kw["topic"] = str(args[1])
+            if name == "session.unsubscribed" and len(args) > 1:
+                kw["topic"] = str(args[1])
+            return cls(**kw)
+        if name == "message.delivered":
+            msgs = args[1]
+            if not msgs:
+                return None
+            m = msgs[0][0] if isinstance(msgs, (list, tuple)) and \
+                isinstance(msgs[0], tuple) else msgs
+            if not isinstance(m, Message):
+                return None
+            return pb.MessageDeliveredRequest(
+                clientinfo=pb.ClientInfo(node=node,
+                                         clientid=str(args[0])),
+                message=_msg_to_pb(m, node), meta=meta)
+        if name == "message.dropped":
+            return pb.MessageDroppedRequest(
+                message=_msg_to_pb(args[0], node),
+                reason=str(args[1]) if len(args) > 1 else "",
+                meta=meta)
+        if name == "message.acked":
+            m = args[1]
+            if not isinstance(m, Message):
+                return None
+            return pb.MessageAckedRequest(
+                clientinfo=pb.ClientInfo(node=node,
+                                         clientid=str(args[0])),
+                message=_msg_to_pb(m, node), meta=meta)
+        return None
+
+    def info(self) -> dict:
+        return {
+            "name": self.name,
+            "url": self.url,
+            "hooks": [n for n, _ in self._registered],
+            "failure_action": self.failure_action,
+            "breaker_open": time.monotonic() < self._open_until,
+            **self.stats,
+        }

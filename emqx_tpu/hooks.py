@@ -101,16 +101,19 @@ class HookRegistry:
 
     def run(self, name: str, *args: Any) -> None:
         """Notify chain: each callback sees the same args; a ``STOP``
-        return halts the chain (emqx_hooks:run/2)."""
-        for cb in self._chains.get(name, ()):
+        return halts the chain (emqx_hooks:run/2).  Iterates a
+        SNAPSHOT: registrations may land from other threads (e.g. an
+        exhook dial completing in an executor) mid-dispatch."""
+        for cb in tuple(self._chains.get(name, ())):
             res = cb.fn(*args)
             if isinstance(res, _Stop):
                 return
 
     def run_fold(self, name: str, args: Tuple[Any, ...], acc: Any) -> Any:
         """Transform chain: callbacks get ``(*args, acc)`` and may
-        replace the accumulator (emqx_hooks:run_fold/3)."""
-        for cb in self._chains.get(name, ()):
+        replace the accumulator (emqx_hooks:run_fold/3).  Snapshot
+        iteration, as in `run`."""
+        for cb in tuple(self._chains.get(name, ())):
             res = cb.fn(*args, acc)
             if isinstance(res, _Stop):
                 return res.value if res.has_value else acc

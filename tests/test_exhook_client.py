@@ -1,0 +1,292 @@
+"""exhook CLIENT mode: this broker calls out to an external
+HookProvider (the reference's own direction,
+emqx_exhook_handler.erl:230-236) — round-trip against a stub provider
+that mutates publishes, vetoes auth, and observes notifications;
+plus the deny/ignore failure policy and the circuit breaker."""
+
+import threading
+import time
+from concurrent import futures
+
+import grpc
+import pytest
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.config import BrokerConfig
+from emqx_tpu.exhook import pb
+from emqx_tpu.exhook.client import SERVICE, ExhookClient
+from emqx_tpu.message import Message
+from tests_fakes import FakeChannel
+
+
+def attach(broker, cid, flt):
+    ch = FakeChannel()
+    broker.cm.open_session(True, cid, ch)
+    broker.subscribe(cid, flt, __import__(
+        "emqx_tpu.broker.session", fromlist=["SubOpts"]).SubOpts(qos=0))
+    return ch
+
+
+class StubProvider:
+    """Minimal HookProvider: wants message.publish + auth + a few
+    notifies; rewrites payloads, denies user 'mallory', drops topic
+    'secret/x'."""
+
+    def __init__(self, hooks=None):
+        self.hooks = hooks or [
+            "message.publish", "client.authenticate",
+            "client.authorize", "session.created",
+        ]
+        self.seen = []
+        self.lock = threading.Lock()
+
+    def _record(self, name, req):
+        with self.lock:
+            self.seen.append((name, req))
+
+    def handlers(self):
+        def unary(fn, req_cls, resp_cls):
+            return grpc.unary_unary_rpc_method_handler(
+                fn,
+                request_deserializer=req_cls.FromString,
+                response_serializer=lambda m: m.SerializeToString(),
+            )
+
+        def loaded(req, ctx):
+            self._record("loaded", req)
+            return pb.LoadedResponse(
+                hooks=[pb.HookSpec(name=n, topics=["#"])
+                       for n in self.hooks]
+            )
+
+        def unloaded(req, ctx):
+            self._record("unloaded", req)
+            return pb.EmptySuccess()
+
+        def on_publish(req, ctx):
+            self._record("publish", req)
+            m = req.message
+            if m.topic == "secret/x":
+                out = pb.Message()
+                out.CopyFrom(m)
+                out.headers["allow_publish"] = "false"
+                return pb.ValuedResponse(
+                    type=pb.ValuedResponse.STOP_AND_RETURN, message=out
+                )
+            out = pb.Message()
+            out.CopyFrom(m)
+            out.payload = m.payload + b"!ext"
+            return pb.ValuedResponse(
+                type=pb.ValuedResponse.CONTINUE, message=out
+            )
+
+        def on_auth(req, ctx):
+            self._record("auth", req)
+            ok = req.clientinfo.username != "mallory"
+            return pb.ValuedResponse(
+                type=pb.ValuedResponse.STOP_AND_RETURN, bool_result=ok
+            )
+
+        def on_authz(req, ctx):
+            self._record("authz", req)
+            ok = not req.topic.startswith("forbidden/")
+            return pb.ValuedResponse(
+                type=pb.ValuedResponse.STOP_AND_RETURN, bool_result=ok
+            )
+
+        def notify(name):
+            def h(req, ctx):
+                self._record(name, req)
+                return pb.EmptySuccess()
+            return h
+
+        return {
+            "OnProviderLoaded": unary(
+                loaded, pb.ProviderLoadedRequest, pb.LoadedResponse),
+            "OnProviderUnloaded": unary(
+                unloaded, pb.ProviderUnloadedRequest, pb.EmptySuccess),
+            "OnMessagePublish": unary(
+                on_publish, pb.MessagePublishRequest, pb.ValuedResponse),
+            "OnClientAuthenticate": unary(
+                on_auth, pb.ClientAuthenticateRequest, pb.ValuedResponse),
+            "OnClientAuthorize": unary(
+                on_authz, pb.ClientAuthorizeRequest, pb.ValuedResponse),
+            "OnSessionCreated": unary(
+                notify("session.created"), pb.SessionCreatedRequest,
+                pb.EmptySuccess),
+        }
+
+
+@pytest.fixture()
+def provider():
+    stub = StubProvider()
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+    server.add_generic_rpc_handlers((
+        grpc.method_handlers_generic_handler(SERVICE, stub.handlers()),
+    ))
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    yield stub, port
+    server.stop(0)
+
+
+def make_client(port, **kw):
+    broker = Broker(BrokerConfig())
+    client = ExhookClient(
+        broker, "test", f"127.0.0.1:{port}", timeout=3.0, **kw
+    )
+    client.start()
+    return broker, client
+
+
+def test_publish_mutation_round_trip(provider):
+    stub, port = provider
+    broker, client = make_client(port)
+    try:
+        assert "message.publish" in [n for n, _ in client._registered]
+
+        # subscriber sees the provider-mutated payload
+        ch = attach(broker, "c1", "t/#")
+        broker.publish(Message(topic="t/1", payload=b"hi", qos=0))
+        assert [p.payload for p in ch.sent] == [b"hi!ext"]
+
+        # provider veto: secret topic never delivers
+        broker.subscribe("c1", "secret/#", __import__(
+            "emqx_tpu.broker.session",
+            fromlist=["SubOpts"]).SubOpts(qos=0))
+        broker.publish(Message(topic="secret/x", payload=b"s", qos=0))
+        assert all(p.topic != "secret/x" for p in ch.sent)
+
+        # $-topics are never sent out (reference skips sys messages)
+        n_before = len([s for s in stub.seen if s[0] == "publish"])
+        broker.publish(Message(
+            topic="$SYS/x", payload=b"s", qos=0, sys=True
+        ))
+        assert len(
+            [s for s in stub.seen if s[0] == "publish"]
+        ) == n_before
+    finally:
+        client.stop()
+    assert any(n == "unloaded" for n, _ in stub.seen)
+
+
+def test_auth_verdicts(provider):
+    stub, port = provider
+    broker, client = make_client(port)
+    try:
+        from emqx_tpu.access import ClientInfo
+
+        ok, _ = broker.access.authenticate(
+            ClientInfo(clientid="a", username="alice")
+        )
+        assert ok
+        ok, _ = broker.access.authenticate(
+            ClientInfo(clientid="m", username="mallory")
+        )
+        assert not ok
+
+        from emqx_tpu.access import PUBLISH
+        assert broker.access.authorize(
+            ClientInfo(clientid="a"), PUBLISH, "ok/t"
+        )
+        assert not broker.access.authorize(
+            ClientInfo(clientid="a"), PUBLISH, "forbidden/t"
+        )
+    finally:
+        client.stop()
+
+
+def test_notify_hooks_fire(provider):
+    stub, port = provider
+    broker, client = make_client(port)
+    try:
+        broker.hooks.run("session.created", "some-client")
+        deadline = time.time() + 3
+        while time.time() < deadline:
+            if any(n == "session.created" for n, _ in stub.seen):
+                break
+            time.sleep(0.05)
+        assert any(n == "session.created" for n, _ in stub.seen)
+    finally:
+        client.stop()
+
+
+def test_failure_policy_and_breaker(provider):
+    stub, port = provider
+    # deny: a dead provider drops publishes / denies auth
+    broker, client = make_client(port, failure_action="deny",
+                                 breaker_threshold=2,
+                                 breaker_window=0.3)
+    from emqx_tpu.access import ClientInfo
+
+    ch = attach(broker, "c1", "t/#")
+    try:
+        # kill the transport out from under the client
+        client._channel.close()
+        client._channel = grpc.insecure_channel("127.0.0.1:1")
+        client._methods.clear()
+
+        broker.publish(Message(topic="t/1", payload=b"x", qos=0))
+        assert ch.sent == []  # fail-closed: dropped
+        ok, _ = broker.access.authenticate(ClientInfo(clientid="a"))
+        assert not ok
+        # breaker is open after 2 failures: calls fail fast
+        before = client.stats["calls"]
+        broker.publish(Message(topic="t/2", payload=b"x", qos=0))
+        assert client.stats["calls"] == before
+        assert client.stats["fast_failed"] >= 1
+        assert client.info()["breaker_open"]
+    finally:
+        client.stop()
+
+    # ignore: a dead provider fails open (local chain continues)
+    broker2, client2 = make_client(port, failure_action="ignore")
+    ch2 = attach(broker2, "c1", "t/#")
+    try:
+        client2._channel.close()
+        client2._channel = grpc.insecure_channel("127.0.0.1:1")
+        client2._methods.clear()
+        broker2.publish(Message(topic="t/1", payload=b"y", qos=0))
+        assert [p.payload for p in ch2.sent] == [b"y"]
+        ok, _ = broker2.access.authenticate(ClientInfo(clientid="a"))
+        assert ok  # allow_anonymous default continues to apply
+    finally:
+        client2.stop()
+
+
+def test_unreachable_provider_fails_closed_then_recovers(provider):
+    """A provider down at dial time with failure_action=deny must fail
+    CLOSED (not silently skip), and retry() completes the real
+    registration once the server is reachable."""
+    stub, port = provider
+    broker = Broker(BrokerConfig())
+    client = ExhookClient(broker, "t", "127.0.0.1:1",  # nothing there
+                          timeout=0.5, failure_action="deny")
+    client.start()  # must not raise
+    assert not client.loaded
+    ch = attach(broker, "c1", "t/#")
+    broker.publish(Message(topic="t/1", payload=b"x", qos=0))
+    assert ch.sent == []  # fail-closed drop
+    from emqx_tpu.access import ClientInfo
+    ok, _ = broker.access.authenticate(ClientInfo(clientid="a"))
+    assert not ok
+
+    # the provider "comes up": point at the live stub and retry
+    client._channel.close()
+    client._channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    client._methods.clear()
+    client.retry()
+    assert client.loaded
+    broker.publish(Message(topic="t/2", payload=b"hi", qos=0))
+    assert [p.payload for p in ch.sent] == [b"hi!ext"]
+    client.stop()
+
+    # ignore policy: down provider fails open at dial time
+    broker2 = Broker(BrokerConfig())
+    client2 = ExhookClient(broker2, "t2", "127.0.0.1:1",
+                           timeout=0.5, failure_action="ignore")
+    client2.start()
+    ch2 = attach(broker2, "c1", "t/#")
+    broker2.publish(Message(topic="t/1", payload=b"y", qos=0))
+    assert [p.payload for p in ch2.sent] == [b"y"]
+    client2.stop()
