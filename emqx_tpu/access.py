@@ -175,6 +175,13 @@ class AccessControl:
         self.deny_action = deny_action
         self.authenticators: List[Authenticator] = []
         self.authz_sources: List[AclProvider] = []
+        # DB-backed authz (auth_db.SqlAuthorizer/RedisAuthorizer):
+        # rows are prefetched per client at CONNECT into _acl_cache
+        self.db_authz_sources: List = []
+        self._acl_cache: Dict[str, List[Dict]] = {}
+        # liveness probe for cache eviction (wired by the broker to
+        # its connection manager); None = no pressure-based cleanup
+        self.is_live: Optional[Callable[[str], bool]] = None
 
     # ---------------------------------------------------------- authn
 
@@ -238,8 +245,10 @@ class AccessControl:
         return self.allow_anonymous, client
 
     async def close(self) -> None:
-        """Release IO-backed providers (HTTP sessions etc.)."""
-        for auth in self.authenticators:
+        """Release IO-backed providers (HTTP sessions, DB pools)."""
+        for auth in list(self.authenticators) + list(
+            self.db_authz_sources
+        ):
             closer = getattr(auth, "close", None)
             if closer is not None:
                 await closer()
@@ -261,4 +270,66 @@ class AccessControl:
             decision = src.authorize(client, action, topic)
             if decision in (ALLOW, DENY):
                 return decision == ALLOW
+        # DB-backed sources: evaluate the rows prefetched at CONNECT
+        # (the reference's emqx_authz_cache role — authorize runs on
+        # the publish/subscribe hot path and must never wait on IO)
+        rows = self._acl_cache.get(client.clientid)
+        if rows is not None:
+            from .auth_db import evaluate_acl_rows
+
+            decision = evaluate_acl_rows(rows, client, action, topic)
+            if decision in (ALLOW, DENY):
+                return decision == ALLOW
         return self.authz_default == ALLOW
+
+    # -------------------------------------------- DB-backed ACL cache
+
+    @property
+    def has_async_authz(self) -> bool:
+        return bool(self.db_authz_sources)
+
+    async def prefetch_acl(self, client: ClientInfo) -> None:
+        """Fetch the client's ACL rows from every DB source ONCE at
+        CONNECT; `authorize` then evaluates them synchronously.  A
+        fetch failure leaves no cache entry — the chain default
+        applies (and with authz_default=deny, fails closed)."""
+        if not self.db_authz_sources:
+            return
+        rows: List[Dict] = []
+        try:
+            for src in self.db_authz_sources:
+                rows.extend(await src.fetch_rows(client))
+        except Exception:
+            import logging
+
+            logging.getLogger("emqx_tpu.access").exception(
+                "acl prefetch failed for %s", client.clientid
+            )
+            self._acl_cache.pop(client.clientid, None)
+            return
+        if len(self._acl_cache) >= 100_000:
+            self._evict_acl()
+        self._acl_cache[client.clientid] = rows
+
+    def _evict_acl(self) -> None:
+        """Bound the cache WITHOUT clearing live clients' entries (a
+        wholesale clear would mass-deny every connected client under
+        authz_default=deny until reconnect): drop entries for dead
+        sessions first, then the oldest tenth as a backstop."""
+        if self.is_live is not None:
+            dead = [
+                cid for cid in self._acl_cache if not self.is_live(cid)
+            ]
+            for cid in dead:
+                del self._acl_cache[cid]
+        if len(self._acl_cache) >= 100_000:
+            for cid in list(self._acl_cache)[: len(self._acl_cache) // 10]:
+                del self._acl_cache[cid]
+
+    def drop_acl(self, clientid: str) -> None:
+        """NOTE: never called eagerly on disconnect/discard — a
+        reconnecting client's NEW prefetch can land before the OLD
+        channel's teardown runs, and an eager drop would wipe the
+        fresh entry.  Dead entries are reclaimed under cache pressure
+        (`_evict_acl`) and overwritten at each CONNECT."""
+        self._acl_cache.pop(clientid, None)

@@ -73,6 +73,11 @@ class Broker:
             deny_action=self.config.auth.deny_action,
         )
         self.cm = ConnectionManager(self._make_session)
+        # ACL-cache eviction probes session liveness so pressure never
+        # wipes a connected client's prefetched rows
+        self.access.is_live = (
+            lambda cid: self.cm.lookup(cid) is not None
+        )
         self.cm.on_discarded = self._session_discarded
         self.cm.on_takenover = lambda s: self.metrics.inc("session.takenover")
         from ..resources import ResourceManager
@@ -243,6 +248,9 @@ class Broker:
         self._release_gate(session)
         self.router.cleanup_client(clientid)
         self.exclusive.release_all(clientid)
+        # deliberately NOT dropping the ACL cache entry here: an
+        # immediate reconnect's fresh prefetch can precede this
+        # teardown; dead entries reclaim under cache pressure instead
         if self.external is not None:
             self.external.client_closed(clientid)
         self.metrics.inc("session.terminated")

@@ -274,23 +274,32 @@ class Channel:
             mountpoint=self.mountpoint,
         )
         m.inc("client.authenticate")
-        if self.broker.access.has_async_authn:
-            # IO-backed providers (HTTP) must not block the loop: defer
-            # the rest of CONNECT until the chain resolves
+        access = self.broker.access
+        if access.has_async_authn or access.has_async_authz:
+            # IO-backed providers (HTTP/DB) must not block the loop:
+            # defer the rest of CONNECT until the chain resolves (and
+            # the DB ACL prefetch lands — authorize() on the hot path
+            # only reads the cache)
             import asyncio
 
             self._pending_connect = asyncio.get_running_loop().create_task(
                 self._async_auth_connect(pkt, clientid, assigned, client)
             )
             return
-        ok, client = self.broker.access.authenticate(client)
+        ok, client = access.authenticate(client)
         self._post_auth_connect(pkt, clientid, assigned, client, ok)
 
     async def _async_auth_connect(
         self, pkt, clientid, assigned, client
     ) -> None:
         try:
-            ok, client = await self.broker.access.authenticate_async(client)
+            access = self.broker.access
+            if access.has_async_authn:
+                ok, client = await access.authenticate_async(client)
+            else:
+                ok, client = access.authenticate(client)
+            if ok:
+                await access.prefetch_acl(client)
         except Exception:
             log.exception("async authentication failed for %s", clientid)
             ok = False
