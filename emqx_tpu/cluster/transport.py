@@ -211,6 +211,7 @@ class NodeTransport:
         self._links: Dict[str, PeerLink] = {}
         self._handlers: Dict[str, Handler] = {}
         self._peer_addrs: Dict[str, Tuple[str, int]] = {}
+        self._inbound: set = set()  # live inbound connection writers
 
     def on(self, mtype: str, handler: Handler) -> None:
         self._handlers[mtype] = handler
@@ -224,19 +225,30 @@ class NodeTransport:
             link.close()
 
     async def start(self) -> None:
+        if self._server is not None:
+            return  # idempotent: callers may pre-start to learn the port
         self._server = await asyncio.start_server(
             self._on_conn, self.bind, self.port
         )
         self.port = self._server.sockets[0].getsockname()[1]
 
     async def stop(self) -> None:
-        if self._server is not None:
-            self._server.close()
-            await self._server.wait_closed()
-            self._server = None
+        # close OUR ends first: Python 3.12's Server.wait_closed()
+        # waits for every live connection handler, and peers' idle
+        # inbound links would otherwise hold it open forever
         for link in self._links.values():
             link.close()
         self._links.clear()
+        for w in list(self._inbound):
+            w.close()
+        if self._server is not None:
+            self._server.close()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 2.0)
+            except asyncio.TimeoutError:
+                log.warning("transport %s: wait_closed timed out",
+                            self.node)
+            self._server = None
 
     def _link(self, node: str) -> Optional[PeerLink]:
         link = self._links.get(node)
@@ -265,6 +277,7 @@ class NodeTransport:
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         peer = "?"
+        self._inbound.add(writer)
         try:
             hello = await read_frame(reader)
             if not hello or hello.get("type") != "hello":
@@ -304,4 +317,5 @@ class NodeTransport:
         except Exception:
             log.exception("cluster connection from %s crashed", peer)
         finally:
+            self._inbound.discard(writer)
             writer.close()

@@ -45,8 +45,12 @@ class ReplicaStore:
     + pending messages per clientid, consulted when a client lands here
     after its home node died."""
 
-    def __init__(self, cap_per_client: int = 10_000) -> None:
+    def __init__(self, cap_per_client: int = 10_000,
+                 orphan_cap: int = 100_000) -> None:
         self.cap_per_client = cap_per_client
+        # the orphan pool is GLOBAL (cross-client): its own cap, and
+        # never 0 (a 0 per-client cap must not unbound it)
+        self.orphan_cap = max(orphan_cap, 1024)
         # clientid -> {"subs", "expiry", "saved_at", "queued"}
         self._checkpoints: Dict[str, Dict] = {}
         # clientid -> wire-dict message buffers (+ first-append stamp,
@@ -54,9 +58,31 @@ class ReplicaStore:
         # after a buddy reassignment — age out instead of leaking)
         self._messages: Dict[str, List[Dict]] = {}
         self._msg_since: Dict[str, float] = {}
+        # quorum-stored messages whose TARGET node died before
+        # confirming (raft mode's forward fallback): keyed by TOPIC,
+        # matched against a restoring session's filters.  At-least-once
+        # semantics: a copy the home also replicated may double-deliver
+        self._orphans: List[tuple] = []  # (wire_msg, stored_at)
 
     def store_checkpoint(self, clientid: str, state: Dict) -> None:
+        """Buffered messages the checkpoint INCLUDES (same mid) leave
+        the append buffer — it absorbed them.  Only those: a
+        checkpoint built from a stale snapshot (an adopter's import
+        racing the log tail) may apply AFTER a message entry it never
+        saw, and clearing wholesale would destroy that entry's only
+        replica copy."""
         self._checkpoints[clientid] = state
+        buf = self._messages.get(clientid)
+        if buf:
+            included = {
+                m.get("mid") for m in state.get("queued", ())
+            }
+            kept = [m for m in buf if m.get("mid") not in included]
+            if kept:
+                self._messages[clientid] = kept
+            else:
+                self._messages.pop(clientid, None)
+                self._msg_since.pop(clientid, None)
 
     def drop(self, clientid: str) -> None:
         self._checkpoints.pop(clientid, None)
@@ -71,24 +97,50 @@ class ReplicaStore:
         buf.extend(msgs)
         del buf[: -self.cap_per_client]
 
+    def add_orphans(self, wire_msgs) -> None:
+        now = time.time()
+        self._orphans.extend((w, now) for w in wire_msgs)
+        if len(self._orphans) > self.orphan_cap:
+            # oldest-first eviction against the GLOBAL cap (evicting
+            # with the per-client cap threw away other clients'
+            # quorum-stored messages)
+            del self._orphans[: len(self._orphans) - self.orphan_cap]
+
+    def _matching_orphans(self, subs: Dict) -> List[Dict]:
+        if not self._orphans or not subs:
+            return []
+        from .. import topic as T
+
+        filters = []
+        for f in subs:
+            share = T.parse_share(f)
+            filters.append(share.topic if share else f)
+        return [
+            w for w, _ in self._orphans
+            if any(T.match(w.get("topic", ""), f) for f in filters)
+        ]
+
     def peek(self, clientid: str) -> Optional[Dict]:
         """Non-destructive view in the restore shape (used by remote
         ds_take: the claimant's session-open op performs the drop)."""
         state = self._checkpoints.get(clientid)
         if state is None:
             return None
+        subs = dict(state.get("subs", {}))
         return {
-            "subs": dict(state.get("subs", {})),
+            "subs": subs,
             "expiry": state.get("expiry", 0),
             "queued": list(state.get("queued", []))
-            + list(self._messages.get(clientid, [])),
+            + list(self._messages.get(clientid, []))
+            + self._matching_orphans(subs),
             "awaiting_rel": [],
         }
 
     def take(self, clientid: str) -> Optional[Dict]:
         """Claim a replica for restore (removes it).  The returned dict
         matches the takeover-export shape, so Broker.import_session
-        consumes both."""
+        consumes both.  Orphans stay (other sessions may match them);
+        they age out via purge_expired."""
         state = self._checkpoints.pop(clientid, None)
         if state is None:
             # keep any orphaned message buffer: a checkpoint may still
@@ -97,10 +149,12 @@ class ReplicaStore:
             return None
         msgs = self._messages.pop(clientid, [])
         self._msg_since.pop(clientid, None)
+        subs = state.get("subs", {})
         return {
-            "subs": state.get("subs", {}),
+            "subs": subs,
             "expiry": state.get("expiry", 0),
-            "queued": list(state.get("queued", [])) + msgs,
+            "queued": list(state.get("queued", [])) + msgs
+            + self._matching_orphans(subs),
             "awaiting_rel": [],
         }
 
@@ -122,7 +176,12 @@ class ReplicaStore:
         ]
         for cid in orphans:
             self.drop(cid)
-        return len(dead) + len(orphans)
+        n_top = len(self._orphans)
+        self._orphans = [
+            (w, ts) for w, ts in self._orphans
+            if now - ts <= orphan_ttl
+        ]
+        return len(dead) + len(orphans) + n_top - len(self._orphans)
 
     def info(self) -> Dict[str, int]:
         return {

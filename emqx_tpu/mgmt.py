@@ -530,7 +530,12 @@ class MgmtApi:
                 # validate locally BEFORE journaling: a bad path must
                 # return 400, not poison every node's journal
                 self.broker.apply_config(path, value)
-                txn = ext.update_config(path, value)
+                if hasattr(ext, "update_config_async"):
+                    # raft mode: the API call resolves (or fails) with
+                    # the quorum commit, never silently
+                    txn = await ext.update_config_async(path, value)
+                else:
+                    txn = ext.update_config(path, value)
                 return _json({"path": path, "txn": list(txn)})
             self.broker.apply_config(path, value)
         except (KeyError, ValueError, TypeError, json.JSONDecodeError) as exc:

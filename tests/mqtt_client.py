@@ -117,6 +117,7 @@ class TestClient:
         qos: int = 0,
         retain: bool = False,
         properties: Optional[dict] = None,
+        timeout: float = 2.0,
     ) -> Optional[C.Packet]:
         """Publish and complete the QoS handshake; returns the final
         ack (PUBACK/PUBCOMP) or None for QoS 0."""
@@ -134,10 +135,10 @@ class TestClient:
         if qos == 0:
             return None
         if qos == 1:
-            ack = await self.expect(C.PUBACK)
+            ack = await self.expect(C.PUBACK, timeout=timeout)
             assert ack.packet_id == pid
             return ack
-        rec = await self.expect(C.PUBREC)
+        rec = await self.expect(C.PUBREC, timeout=timeout)
         assert rec.packet_id == pid
         await self.send(C.Pubrel(packet_id=pid))
         comp = await self.expect(C.PUBCOMP)

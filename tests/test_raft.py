@@ -1,0 +1,239 @@
+"""Raft consensus (the `ra`/emqx_cluster_rpc quorum upgrade over
+round-3's LWW): elections, quorum commit, the VERDICT's two
+done-criteria — kill the leader mid-stream with ZERO acked-entry
+loss, and concurrent conf updates resolving to one deterministic
+winner on every node — plus log recovery from disk."""
+
+import asyncio
+
+import pytest
+
+from emqx_tpu.cluster.raft import LEADER, NotLeader, RaftNode
+from emqx_tpu.cluster.transport import NodeTransport
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class Cluster:
+    """N transports + raft nodes on loopback (the emqx_cth_cluster
+    peer-nodes-in-one-host pattern)."""
+
+    def __init__(self, n, data_dirs=None):
+        self.names = [f"n{i}" for i in range(n)]
+        self.data_dirs = data_dirs or [None] * n
+        self.transports = {}
+        self.rafts = {}
+        self.applied = {name: [] for name in self.names}
+
+    async def start(self, fast=True):
+        for name in self.names:
+            self.transports[name] = NodeTransport(name)
+            await self.transports[name].start()
+        for name in self.names:
+            for other in self.names:
+                if other != name:
+                    self.transports[name].add_peer(
+                        other, "127.0.0.1", self.transports[other].port
+                    )
+        for i, name in enumerate(self.names):
+            peers = [p for p in self.names if p != name]
+            r = RaftNode(
+                name, peers, self.transports[name],
+                apply_cb=(lambda nm: lambda idx, p:
+                          self.applied[nm].append((idx, p)))(name),
+                data_dir=self.data_dirs[i],
+                election_timeout=(0.05, 0.12) if fast else (0.15, 0.3),
+                heartbeat=0.02 if fast else 0.05,
+                fsync=False,
+            )
+            self.rafts[name] = r
+            r.start()
+
+    async def stop(self):
+        for r in self.rafts.values():
+            await r.stop()
+        for t in self.transports.values():
+            await t.stop()
+
+    async def leader(self, timeout=5.0):
+        deadline = asyncio.get_event_loop().time() + timeout
+        while asyncio.get_event_loop().time() < deadline:
+            leaders = [
+                r for r in self.rafts.values()
+                if r.role == LEADER and not r._stopped
+            ]
+            if len(leaders) == 1:
+                return leaders[0]
+            await asyncio.sleep(0.02)
+        raise AssertionError("no (single) leader elected")
+
+    async def kill(self, name):
+        """Hard-stop a node: raft halted AND transport torn down (no
+        goodbyes — the crash shape)."""
+        await self.rafts[name].stop()
+        await self.transports[name].stop()
+
+
+def test_election_and_replication():
+    async def t():
+        c = Cluster(3)
+        await c.start()
+        leader = await c.leader()
+        for i in range(20):
+            await leader.propose({"op": i})
+        await asyncio.sleep(0.2)  # followers learn commit via heartbeat
+        for name in c.names:
+            assert [p["op"] for _, p in c.applied[name]] == list(range(20))
+        # every node applied in identical order with identical indexes
+        assert len({tuple(map(str, c.applied[n])) for n in c.names}) == 1
+        await c.stop()
+
+    run(t())
+
+
+def test_follower_submit_forwards_to_leader():
+    async def t():
+        c = Cluster(3)
+        await c.start()
+        leader = await c.leader()
+        follower = next(
+            r for r in c.rafts.values() if r.node != leader.node
+        )
+        idx = await follower.submit({"via": "follower"})
+        assert idx >= 1
+        await asyncio.sleep(0.2)
+        assert any(
+            p.get("via") == "follower" for _, p in c.applied[leader.node]
+        )
+        with pytest.raises(NotLeader):
+            await follower.propose({"x": 1})
+        await c.stop()
+
+    run(t())
+
+
+def test_leader_kill_mid_stream_zero_acked_loss():
+    """The VERDICT's criterion: stream entries, kill the leader at a
+    random point, verify EVERY acked entry survives on the remaining
+    quorum (and the cluster keeps accepting writes)."""
+
+    async def t():
+        c = Cluster(3)
+        await c.start()
+        leader = await c.leader()
+        acked = []
+        for i in range(30):
+            idx = await leader.submit({"seq": i})
+            acked.append((idx, i))
+            if i == 17:
+                victim = leader.node
+                await c.kill(victim)
+                # the survivors elect a new leader; keep streaming
+                leader = await c.leader()
+        await asyncio.sleep(0.3)
+        survivors = [n for n in c.names if n != victim]
+        for name in survivors:
+            seqs = [p["seq"] for _, p in c.applied[name]]
+            # every ACKED seq is present, in ack order
+            acked_seqs = [s for _, s in acked]
+            assert [s for s in seqs if s in set(acked_seqs)] == acked_seqs, (
+                name, seqs, acked_seqs
+            )
+        await c.stop()
+
+    run(t())
+
+
+def test_conf_conflict_deterministic_winner():
+    """Two nodes race conflicting updates to ONE config path: all
+    nodes apply both in the SAME committed order, so the final value
+    is identical everywhere (emqx_cluster_rpc's logged-multicall
+    semantics; round-3's per-path LWW could disagree)."""
+
+    async def t():
+        c = Cluster(3)
+        await c.start()
+        await c.leader()
+        a, b = c.rafts["n0"], c.rafts["n1"]
+        await asyncio.gather(
+            a.submit({"path": "mqtt.max_qos", "value": 1}),
+            b.submit({"path": "mqtt.max_qos", "value": 2}),
+        )
+        await asyncio.sleep(0.3)
+        finals = set()
+        for name in c.names:
+            state = {}
+            for _, p in c.applied[name]:
+                state[p["path"]] = p["value"]
+            finals.add(state["mqtt.max_qos"])
+        assert len(finals) == 1, finals  # one deterministic winner
+        # and the full logs are identical
+        assert len({tuple(map(str, c.applied[n])) for n in c.names}) == 1
+        await c.stop()
+
+    run(t())
+
+
+def test_lagging_node_catches_up():
+    async def t():
+        c = Cluster(3)
+        await c.start()
+        leader = await c.leader()
+        lag = next(n for n in c.names if n != leader.node)
+        # partition the laggard by tearing down its transport links
+        for other in c.names:
+            if other != lag:
+                c.transports[other].drop_peer(lag)
+                c.transports[lag].drop_peer(other)
+                c.transports[other]._peer_addrs.pop(lag, None)
+        addrs = {
+            n: ("127.0.0.1", c.transports[n].port) for n in c.names
+        }
+        for i in range(10):
+            await leader.submit({"seq": i})
+        assert len(c.applied[lag]) == 0
+        # heal the partition
+        for other in c.names:
+            if other != lag:
+                c.transports[other].add_peer(lag, *addrs[lag])
+        deadline = asyncio.get_event_loop().time() + 5
+        while asyncio.get_event_loop().time() < deadline:
+            if len(c.applied[lag]) == 10:
+                break
+            await asyncio.sleep(0.05)
+        assert [p["seq"] for _, p in c.applied[lag]] == list(range(10))
+        await c.stop()
+
+    run(t())
+
+
+def test_log_recovery_from_disk(tmp_path):
+    """A restarted node recovers term/log from disk and rejoins with
+    its entries intact (the reference's ra WAL role)."""
+
+    async def t():
+        dirs = [str(tmp_path / f"n{i}") for i in range(3)]
+        c = Cluster(3, data_dirs=dirs)
+        await c.start()
+        leader = await c.leader()
+        for i in range(7):
+            await leader.submit({"seq": i})
+        await asyncio.sleep(0.2)
+        await c.stop()
+
+        # full restart from the same dirs
+        c2 = Cluster(3, data_dirs=dirs)
+        await c2.start()
+        leader2 = await c2.leader()
+        # logs recovered: committed entries re-apply after new commits
+        idx = await leader2.submit({"seq": 99})
+        assert idx >= 8  # appended after the recovered entries
+        await asyncio.sleep(0.3)
+        for name in c2.names:
+            seqs = [p["seq"] for _, p in c2.applied[name]]
+            assert seqs[:7] == list(range(7)) and 99 in seqs, (name, seqs)
+        await c2.stop()
+
+    run(t())
