@@ -179,11 +179,13 @@ class Broker:
             # anything for sessions that can never legally resume)
             self.durable.purge_expired()
             for state in self.durable.boot_states():
+                # shared filters advertise too (durable shared subs:
+                # publishes in the all-offline window must keep
+                # matching, and so keep persisting)
                 for flt, opts_dict in state.subs.items():
-                    if T.parse_share(flt) is None:
-                        self.router.subscribe(
-                            state.clientid, flt, SubOpts.from_dict(opts_dict)
-                        )
+                    self.router.subscribe(
+                        state.clientid, flt, SubOpts.from_dict(opts_dict)
+                    )
         # clientid -> (fire_at, will message): MQTT 5 delayed wills
         self._pending_wills: Dict[str, Tuple[float, Message]] = {}
         self._last_ds_sync = time.time()
@@ -233,11 +235,20 @@ class Broker:
             self.external.client_closed(session.clientid)
         self.hooks.run("session.discarded", session.clientid)
 
+    @staticmethod
+    def _gate_real(flt: str) -> str:
+        """The persistence gate matches MESSAGE TOPICS, so a $share
+        filter contributes its real topic part."""
+        share = T.parse_share(flt)
+        return share.topic if share else flt
+
     def _release_gate(self, session: Session) -> None:
         """Release exactly the persistence-gate refs this session holds."""
         if self.durable is not None:
             for flt in session.gate_filters:
-                self.durable.remove_filter(flt)
+                self.durable.remove_filter(self._gate_real(flt))
+                if T.parse_share(flt) is not None:
+                    self.durable.shared_leave(flt, session.clientid)
             session.gate_filters.clear()
 
     def session_terminated(self, clientid: str, session: Session) -> None:
@@ -267,15 +278,22 @@ class Broker:
         # refresh re-subscribe must not inflate it past drainability).
         # session.gate_filters records exactly which refs this session
         # holds, so every termination path releases them exactly once.
-        if self.durable is not None and opts.share_group is None:
+        if self.durable is not None:
+            # shared filters gate too (durable shared subs,
+            # emqx_ds_shared_sub): the group's offline interval must
+            # persist so members replay their stream shares on resume
             session = self.cm.lookup(clientid)
             if (
                 session is not None
                 and session.expiry_interval > 0
                 and flt not in session.gate_filters
             ):
-                self.durable.add_filter(flt)
+                self.durable.add_filter(self._gate_real(flt))
                 session.gate_filters.add(flt)
+                if opts.share_group is not None:
+                    # durable group membership drives the replay-time
+                    # stream assignment across restarts
+                    self.durable.shared_join(flt, clientid)
         self.hooks.run("session.subscribed", clientid, flt, opts)
         self.stats.set("subscriptions.count", self._sub_count())
         if opts.share_group is not None:
@@ -292,7 +310,9 @@ class Broker:
                 session = self.cm.lookup(clientid)
                 if session is not None and flt in session.gate_filters:
                     session.gate_filters.discard(flt)
-                    self.durable.remove_filter(flt)
+                    self.durable.remove_filter(self._gate_real(flt))
+                    if T.parse_share(flt) is not None:
+                        self.durable.shared_leave(flt, clientid)
             self.hooks.run("session.unsubscribed", clientid, flt)
             self.stats.set("subscriptions.count", self._sub_count())
         return ok
@@ -343,11 +363,10 @@ class Broker:
             opts = SubOpts.from_dict(opts_dict)
             session.subscribe(flt, opts)
             self.router.subscribe(clientid, flt, opts)
-            if T.parse_share(flt) is None:
-                # the boot-state gate refs (taken in _load_states)
-                # transfer to the live session, to be released exactly
-                # once on its eventual discard/termination
-                session.gate_filters.add(flt)
+            # the boot-state gate refs (taken in _load_states, shared
+            # filters included) transfer to the live session, to be
+            # released exactly once on its eventual discard/termination
+            session.gate_filters.add(flt)
         replayed = 0
         while True:
             msgs, done = self.durable.replay_chunk(state)
@@ -716,13 +735,22 @@ class Broker:
         per_client: Dict[str, List[Tuple[Message, SubOpts]]],
     ) -> None:
         """Pick one live group member, skipping dead ones
-        (redispatch, emqx_shared_sub.erl:144-166)."""
+        (redispatch, emqx_shared_sub.erl:144-166).  With durable
+        storage on, DETACHED persistent members are skipped too: their
+        share of the group's traffic arrives via stream-assigned
+        replay (durable shared subs) — queueing here as well would
+        double-deliver the offline interval."""
         tried: Set[str] = set()
         while True:
             picked = self.router.shared.pick(group, real, msg, exclude=tried)
             if picked is None:
                 return
-            if self.cm.lookup(picked) is not None:
+            session = self.cm.lookup(picked)
+            if session is not None and (
+                self.durable is None
+                or self.cm.channel(picked) is not None
+                or session.expiry_interval <= 0
+            ):
                 opts = self.router.shared_opts(real, group, picked)
                 if opts is not None:
                     per_client.setdefault(picked, []).append((msg, opts))

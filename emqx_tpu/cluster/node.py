@@ -189,7 +189,11 @@ class ClusterNode:
         self.transport.on("ds_msgs", self._handle_ds_msgs)
         self.transport.on("ds_take", self._handle_ds_take)
         self.transport.on("forward_batch", self._handle_forward_batch)
-        self.transport.on("forward_sync", self._handle_forward_sync)
+        # concurrent: this handler AWAITS a raft commit whose quorum
+        # traffic may share the inbound connection — inline it would
+        # deadlock-by-stall every failover window
+        self.transport.on("forward_sync", self._handle_forward_sync,
+                          concurrent=True)
         self.transport.on("heartbeat", self._handle_heartbeat)
         self.transport.on("sync", self._handle_sync)
 
@@ -655,7 +659,7 @@ class ClusterNode:
         duplicates beat losses)."""
         if self.raft_ds is None:
             return 0
-        rep = self.replicas.peek(session.clientid)
+        rep = self.replicas.peek(session.clientid, mark_orphans=True)
         if not rep or not rep.get("queued"):
             return 0
         seen = {m.mid for m in session.mqueue}
@@ -687,7 +691,7 @@ class ClusterNode:
                 # after adoption live only in the replica store):
                 # merge the local replica copy, deduplicating by mid —
                 # QoS1 is at-least-once, a duplicate beats a loss
-                rep = self.replicas.peek(clientid)
+                rep = self.replicas.peek(clientid, mark_orphans=True)
                 if rep and rep.get("queued"):
                     seen = {
                         m.get("mid") for m in state.get("queued", ())

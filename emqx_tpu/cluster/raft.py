@@ -105,7 +105,10 @@ class RaftNode:
             )
             self._recover()
 
-        transport.on(f"raft.{group}", self._on_rpc)
+        # concurrent: the propose kind awaits a commit whose append
+        # replies may share the connection; votes/appends are
+        # order-insensitive (term/index guarded)
+        transport.on(f"raft.{group}", self._on_rpc, concurrent=True)
 
     # ---------------------------------------------------- persistence
 
@@ -419,9 +422,19 @@ class RaftNode:
         self.log.append((self.term, payload))
         idx = len(self.log)
         self._persist_append(idx, [(self.term, payload)])
-        await self._fsync_log()  # durable BEFORE any ack can form
+        # register the waiter BEFORE the fsync await: a leadership
+        # loss during the executor hop fails waiters via
+        # _become_follower — ours must already be on the list or it
+        # would strand for the full timeout
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._commit_waiters.setdefault(idx, []).append(fut)
+        try:
+            await self._fsync_log()  # durable BEFORE any ack can form
+        except Exception:
+            waiters = self._commit_waiters.get(idx)
+            if waiters and fut in waiters:
+                waiters.remove(fut)
+            raise
         if not self.peers:  # single-node group commits immediately
             self._set_commit(idx)
         else:

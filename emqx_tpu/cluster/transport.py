@@ -210,11 +210,25 @@ class NodeTransport:
         self._server: Optional[asyncio.AbstractServer] = None
         self._links: Dict[str, PeerLink] = {}
         self._handlers: Dict[str, Handler] = {}
+        self._concurrent: set = set()  # handlers that run as tasks
         self._peer_addrs: Dict[str, Tuple[str, int]] = {}
         self._inbound: set = set()  # live inbound connection writers
+        self._tasks: set = set()
 
-    def on(self, mtype: str, handler: Handler) -> None:
+    def on(self, mtype: str, handler: Handler,
+           concurrent: bool = False) -> None:
+        """Register a handler.  ``concurrent=True`` runs each request
+        as its own task (reply sent when it finishes) instead of
+        inline in the connection's serial read loop — REQUIRED for
+        handlers that await quorum traffic arriving on the same
+        connection (forward_sync awaiting a raft commit whose
+        AppendEntries share the link would deadlock otherwise).
+        Serial handlers keep per-peer FIFO (route-op streams)."""
         self._handlers[mtype] = handler
+        if concurrent:
+            self._concurrent.add(mtype)
+        else:
+            self._concurrent.discard(mtype)
 
     def add_peer(self, node: str, host: str, port: int) -> None:
         self._peer_addrs[node] = (host, port)
@@ -273,6 +287,25 @@ class NodeTransport:
         link = self._link(node)
         return None if link is None else await link.call(obj, timeout)
 
+    async def _handle_and_reply(
+        self, handler: Handler, peer: str, obj: Dict[str, Any],
+        writer: asyncio.StreamWriter,
+    ) -> None:
+        try:
+            result = await handler(peer, obj)
+            if "call_id" in obj and not writer.is_closing():
+                writer.write(_pack_json({
+                    "type": "reply",
+                    "call_id": obj["call_id"],
+                    "result": result,
+                }))
+                await writer.drain()
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        except Exception:
+            log.exception("concurrent handler %r from %s crashed",
+                          obj.get("type"), peer)
+
     async def _on_conn(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
@@ -296,9 +329,17 @@ class NodeTransport:
                 obj = await read_frame(reader)
                 if obj is None:
                     return
-                handler = self._handlers.get(obj.get("type", ""))
+                mtype = obj.get("type", "")
+                handler = self._handlers.get(mtype)
                 if handler is None:
-                    log.warning("no handler for %r from %s", obj.get("type"), peer)
+                    log.warning("no handler for %r from %s", mtype, peer)
+                    continue
+                if mtype in self._concurrent:
+                    task = asyncio.get_running_loop().create_task(
+                        self._handle_and_reply(handler, peer, obj, writer)
+                    )
+                    self._tasks.add(task)
+                    task.add_done_callback(self._tasks.discard)
                     continue
                 result = await handler(peer, obj)
                 if "call_id" in obj:

@@ -27,6 +27,7 @@ from ..engine import MatchEngine
 from ..message import Message
 from .api import IterRef
 from .builtin_local import LocalStorage
+from .replication import rendezvous_pick
 
 
 class SessionState:
@@ -103,6 +104,38 @@ class DurableSessions:
         # the broker uses it to retract the routes it advertised for
         # the detached session
         self.on_drop = None
+        # grouped long-poll over the message log (the beamformer):
+        # stores fire beams waking coherent parked readers
+        from .beamformer import Beamformer
+
+        self.beamformer = Beamformer(self.storage)
+        # durable $share membership (the emqx_ds_shared_sub leader
+        # state, persisted): stream assignment must see EVERY member —
+        # detached, resumed, or mid-replay — regardless of liveness or
+        # checkpoint presence
+        self._share_members: Dict[str, List[str]] = {}
+        self._share_path = os.path.join(directory, "share_members.json")
+        try:
+            with open(self._share_path) as f:
+                self._share_members = {
+                    k: list(v) for k, v in json.load(f).items()
+                }
+        except (OSError, json.JSONDecodeError):
+            pass
+        # GROUP-level consumed progress per (share filter, stream):
+        # the emqx_ds_shared_sub leader's per-stream offsets.  Replay
+        # never re-reads below it, so membership churn (a member
+        # leaving after consuming its share) cannot re-deliver the
+        # consumed interval to the survivors.
+        self._share_progress: Dict[str, Dict[str, List[int]]] = {}
+        self._share_prog_path = os.path.join(
+            directory, "share_progress.json"
+        )
+        try:
+            with open(self._share_prog_path) as f:
+                self._share_progress = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            pass
         self._load_states()
 
     def boot_states(self) -> List[SessionState]:
@@ -137,6 +170,13 @@ class DurableSessions:
                 batch.append(msg)
         if batch:
             self.storage.store_batch(batch)
+            if self.beamformer.has_parked():
+                from .api import stream_of
+
+                self.beamformer.notify({
+                    stream_of(m.topic, self.storage.n_streams)
+                    for m in batch
+                })
         return len(batch)
 
     # ------------------------------------------------------ checkpoints
@@ -167,6 +207,9 @@ class DurableSessions:
         with open(tmp, "w") as f:
             json.dump(state.to_json(), f)
         os.replace(tmp, self._state_path(clientid))
+        # group progress rides the checkpoint cadence (see
+        # _advance_share_progress)
+        self._flush_share_progress()
 
     def load(self, clientid: str) -> Optional[SessionState]:
         """Boot-restored state for a reconnecting client (None if the
@@ -190,7 +233,7 @@ class DurableSessions:
         live session inherits the filters)."""
         state = self._boot_states.get(clientid)
         if state is not None:
-            self.remove_session_filters(state.subs)
+            self.remove_session_filters(state.subs, clientid)
             if self.on_drop is not None:
                 self.on_drop(clientid)
         self.discard(clientid)
@@ -206,8 +249,8 @@ class DurableSessions:
                 continue
             self._boot_states[state.clientid] = state
             for flt in state.subs:
-                if not T.parse_share(flt):
-                    self.add_filter(flt)
+                share = T.parse_share(flt)
+                self.add_filter(share.topic if share else flt)
 
     def purge_expired(self, now: Optional[float] = None) -> List[str]:
         now = now if now is not None else time.time()
@@ -222,12 +265,20 @@ class DurableSessions:
 
     # ---------------------------------------------------------- replay
 
-    def remove_session_filters(self, subs: Dict[str, object]) -> None:
+    def remove_session_filters(
+        self, subs: Dict[str, object], clientid: Optional[str] = None
+    ) -> None:
         """Drop a discarded/expired session's filters from the gate (and
-        its checkpoint must be discarded separately)."""
+        its checkpoint must be discarded separately).  $share filters
+        release their REAL-topic ref (mirroring _load_states) and,
+        when the clientid is known, leave the durable group — a ghost
+        member would keep streams rendezvous-assigned to a session
+        that can never replay them."""
         for flt in subs:
-            if T.parse_share(flt) is None:
-                self.remove_filter(flt)
+            share = T.parse_share(flt)
+            self.remove_filter(share.topic if share else flt)
+            if share is not None and clientid is not None:
+                self.shared_leave(flt, clientid)
 
     def gc(self, cutoff_ts_us: int) -> int:
         """Retention pass over the message log."""
@@ -235,6 +286,63 @@ class DurableSessions:
 
     def sync(self) -> None:
         self.storage.sync()
+
+    def _save_share_members(self) -> None:
+        tmp = self._share_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._share_members, f)
+        os.replace(tmp, self._share_path)
+
+    def shared_join(self, share_flt: str, clientid: str) -> None:
+        members = self._share_members.setdefault(share_flt, [])
+        if clientid not in members:
+            members.append(clientid)
+            self._save_share_members()
+
+    def shared_leave(self, share_flt: str, clientid: str) -> None:
+        members = self._share_members.get(share_flt)
+        if members and clientid in members:
+            members.remove(clientid)
+            if not members:
+                del self._share_members[share_flt]
+            self._save_share_members()
+
+    def _advance_share_progress(self, share_flt: str,
+                                it: IterRef) -> None:
+        """In-MEMORY only: the consumed interval lives in session
+        mqueues until a checkpoint persists it, so the progress file
+        is flushed together with checkpoints (`save`/`close`) — a
+        crash mid-replay re-replays (at-least-once) instead of
+        skipping undelivered messages (the broker.py replay-cursor
+        invariant, applied group-wide)."""
+        prog = self._share_progress.setdefault(share_flt, {})
+        key = str(it.stream.shard)
+        cur = prog.get(key)
+        if cur is None or (it.ts, it.seq) > (cur[0], cur[1]):
+            prog[key] = [it.ts, it.seq]
+            self._share_prog_dirty = True
+
+    def _flush_share_progress(self) -> None:
+        if not getattr(self, "_share_prog_dirty", False):
+            return
+        tmp = self._share_prog_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._share_progress, f)
+        os.replace(tmp, self._share_prog_path)
+        self._share_prog_dirty = False
+
+    def shared_group_members(self, share_flt: str) -> List[str]:
+        """Members of this exact $share filter: the PERSISTED registry
+        (survives restarts and stays stable across the whole replay
+        sequence — a member leaving _boot_states on ITS resume must
+        not shrink the assignment its peers derive), plus any
+        checkpointed stragglers; sorted, so every member computes the
+        same stream split."""
+        members = set(self._share_members.get(share_flt, ()))
+        for cid, st in self._boot_states.items():
+            if share_flt in st.subs:
+                members.add(cid)
+        return sorted(members)
 
     def replay_chunk(
         self, state: SessionState, max_msgs: int = 1024
@@ -251,18 +359,54 @@ class DurableSessions:
         crash)."""
         if state.iters is None:
             since_us = int(state.disconnected_at * 1e6)
-            state.iters = {
-                flt: [
-                    self.storage.make_iterator(s, flt, since_us).to_json()
-                    for s in self.storage.get_streams(flt, since_us)
+            state.iters = {}
+            for flt in state.subs:
+                share = T.parse_share(flt)
+                if share is None:
+                    state.iters[flt] = [
+                        self.storage.make_iterator(
+                            s, flt, since_us
+                        ).to_json()
+                        for s in self.storage.get_streams(flt, since_us)
+                    ]
+                    continue
+                # DURABLE SHARED SUBS (emqx_ds_shared_sub): the group's
+                # offline interval replays EXACTLY ONCE across its
+                # persistent members — each DS stream is assigned to
+                # one member by rendezvous hash over the member set, so
+                # every member independently derives the same split
+                # without a live leader (the reference elects one;
+                # deterministic assignment is this fs-backend's
+                # equivalent)
+                members = self.shared_group_members(flt)
+                streams = [
+                    s for s in self.storage.get_streams(
+                        share.topic, since_us
+                    )
+                    if not members
+                    or rendezvous_pick(
+                        f"{share.group}:{s.shard}", members, 1
+                    )[0] == state.clientid
                 ]
-                for flt in state.subs
-                # shared subs don't replay ([MQTT-4.8.2-27])
-                if not T.parse_share(flt)
-            }
+                prog = self._share_progress.get(flt, {})
+                its = []
+                for s in streams:
+                    it = self.storage.make_iterator(
+                        s, share.topic, since_us
+                    )
+                    p = prog.get(str(s.shard))
+                    if p and (p[0], p[1]) > (it.ts, it.seq):
+                        # group already consumed past here
+                        it = IterRef(
+                            stream=s, topic_filter=share.topic,
+                            ts=p[0], seq=p[1],
+                        )
+                    its.append(it.to_json())
+                state.iters[flt] = its
         seen = state._replay_seen
         out: List[Tuple[str, Message]] = []
         for flt, cursors in state.iters.items():
+            is_shared = T.parse_share(flt) is not None
             i = 0
             while i < len(cursors):
                 it = IterRef.from_json(cursors[i])
@@ -278,6 +422,11 @@ class DurableSessions:
                         if msg.mid not in seen:
                             seen.add(msg.mid)
                             out.append((flt, msg))
+                if is_shared:
+                    # group progress: the interval up to this cursor is
+                    # CONSUMED for the whole group — survivors must not
+                    # re-read it after membership churn
+                    self._advance_share_progress(flt, it)
                 if exhausted:
                     cursors.pop(i)
                 else:  # budget hit: persist progress, come back later
@@ -305,4 +454,5 @@ class DurableSessions:
                 return out
 
     def close(self) -> None:
+        self._flush_share_progress()
         self.storage.close()

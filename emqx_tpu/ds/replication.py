@@ -61,8 +61,11 @@ class ReplicaStore:
         # quorum-stored messages whose TARGET node died before
         # confirming (raft mode's forward fallback): keyed by TOPIC,
         # matched against a restoring session's filters.  At-least-once
-        # semantics: a copy the home also replicated may double-deliver
-        self._orphans: List[tuple] = []  # (wire_msg, stored_at)
+        # semantics: a copy the home also replicated may double-deliver.
+        # Each orphan tracks which clients it was handed to, so a
+        # client reconnecting repeatedly is not re-served the same
+        # orphan for the whole TTL
+        self._orphans: List[tuple] = []  # (wire, stored_at, delivered_to)
 
     def store_checkpoint(self, clientid: str, state: Dict) -> None:
         """Buffered messages the checkpoint INCLUDES (same mid) leave
@@ -99,14 +102,20 @@ class ReplicaStore:
 
     def add_orphans(self, wire_msgs) -> None:
         now = time.time()
-        self._orphans.extend((w, now) for w in wire_msgs)
+        self._orphans.extend((w, now, set()) for w in wire_msgs)
         if len(self._orphans) > self.orphan_cap:
             # oldest-first eviction against the GLOBAL cap (evicting
             # with the per-client cap threw away other clients'
             # quorum-stored messages)
             del self._orphans[: len(self._orphans) - self.orphan_cap]
 
-    def _matching_orphans(self, subs: Dict) -> List[Dict]:
+    def _matching_orphans(
+        self, subs: Dict, clientid: Optional[str] = None,
+        mark: bool = False,
+    ) -> List[Dict]:
+        """Orphans matching `subs` that `clientid` has not been served
+        yet; ``mark=True`` records the hand-off (destructive restore
+        paths), the non-destructive remote peek leaves it unmarked."""
         if not self._orphans or not subs:
             return []
         from .. import topic as T
@@ -115,14 +124,23 @@ class ReplicaStore:
         for f in subs:
             share = T.parse_share(f)
             filters.append(share.topic if share else f)
-        return [
-            w for w, _ in self._orphans
-            if any(T.match(w.get("topic", ""), f) for f in filters)
-        ]
+        out = []
+        for w, _ts, delivered in self._orphans:
+            if clientid is not None and clientid in delivered:
+                continue
+            if any(T.match(w.get("topic", ""), f) for f in filters):
+                out.append(w)
+                if mark and clientid is not None:
+                    delivered.add(clientid)
+        return out
 
-    def peek(self, clientid: str) -> Optional[Dict]:
+    def peek(self, clientid: str,
+             mark_orphans: bool = False) -> Optional[Dict]:
         """Non-destructive view in the restore shape (used by remote
-        ds_take: the claimant's session-open op performs the drop)."""
+        ds_take: the claimant's session-open op performs the drop).
+        ``mark_orphans=True`` for peeks that DO deliver (the local
+        resume merge) so repeated reconnects aren't re-served the same
+        orphans."""
         state = self._checkpoints.get(clientid)
         if state is None:
             return None
@@ -132,7 +150,7 @@ class ReplicaStore:
             "expiry": state.get("expiry", 0),
             "queued": list(state.get("queued", []))
             + list(self._messages.get(clientid, []))
-            + self._matching_orphans(subs),
+            + self._matching_orphans(subs, clientid, mark=mark_orphans),
             "awaiting_rel": [],
         }
 
@@ -154,7 +172,7 @@ class ReplicaStore:
             "subs": subs,
             "expiry": state.get("expiry", 0),
             "queued": list(state.get("queued", [])) + msgs
-            + self._matching_orphans(subs),
+            + self._matching_orphans(subs, clientid, mark=True),
             "awaiting_rel": [],
         }
 
@@ -178,8 +196,7 @@ class ReplicaStore:
             self.drop(cid)
         n_top = len(self._orphans)
         self._orphans = [
-            (w, ts) for w, ts in self._orphans
-            if now - ts <= orphan_ttl
+            e for e in self._orphans if now - e[1] <= orphan_ttl
         ]
         return len(dead) + len(orphans) + n_top - len(self._orphans)
 
