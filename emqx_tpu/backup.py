@@ -1,0 +1,312 @@
+"""Data backup/restore: one archive for a node's operational state.
+
+The `emqx_mgmt_data_backup` role (/root/reference/apps/
+emqx_management/src/emqx_mgmt_data_backup.erl, 996 LoC: tar of config
++ mnesia tables with per-table import, version checks, and a result
+report): `export_archive` writes a ``.tar.gz`` holding the config
+tree, retained messages, the banned table, SQL rules, and the
+management-auth stores; `import_archive` restores them into a RUNNING
+broker, applying config through the validating update path and
+reporting what was restored and what was skipped.
+
+Structural config (listeners, node/cluster identity, durable storage
+layout) is deliberately NOT hot-applied — the reference's import
+equally refuses settings that require a reboot — it is still in the
+archive for a fresh node booting from it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import logging
+import os
+import tarfile
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .cluster.node import msg_from_wire, msg_to_wire
+
+log = logging.getLogger("emqx_tpu.backup")
+
+FORMAT_VERSION = 1
+
+# config roots that cannot hot-apply into a running broker
+_STRUCTURAL = (
+    "listeners", "node_name", "cluster_name", "durable", "api",
+    "plugin_dir", "plugins", "gateways", "exhooks", "cluster_links",
+)
+
+
+def _flatten(prefix: str, obj: Any, out: Dict[str, Any]) -> None:
+    if dataclasses.is_dataclass(obj):
+        obj = dataclasses.asdict(obj)
+    if isinstance(obj, dict) and obj and all(
+        isinstance(k, str) for k in obj
+    ):
+        for k, v in obj.items():
+            _flatten(f"{prefix}.{k}" if prefix else k, v, out)
+    else:
+        out[prefix] = obj
+
+
+def gather_state(server) -> Tuple[Dict[str, bytes], Dict]:
+    """Serialize the broker state into archive members.  MUST run on
+    the event loop (it iterates loop-owned structures — the retainer
+    trie, rule/banned tables; a worker thread would race concurrent
+    publishes); it is pure dict walks, fast enough to stay inline.
+    Returns (members, manifest)."""
+    from .config import ConfigHandler
+
+    broker = server.broker
+    members: Dict[str, bytes] = {}
+    members["cluster.json"] = json.dumps(
+        ConfigHandler(broker.config).to_dict(), indent=1, default=str
+    ).encode()
+    retained = [
+        msg_to_wire(m) for m in broker.retainer.match("#")
+    ] + [
+        # '#' misses $-topics by MQTT rules; export those explicitly
+        msg_to_wire(m)
+        for t in broker.retainer.topics() if t.startswith("$")
+        for m in broker.retainer.match(t)
+    ]
+    members["retained.jsonl"] = "\n".join(
+        json.dumps(w, separators=(",", ":")) for w in retained
+    ).encode()
+    members["banned.json"] = json.dumps(broker.banned.all()).encode()
+    members["rules.json"] = json.dumps([
+        {
+            "id": r.rule_id,
+            "sql": r.sql,
+            "enabled": r.enabled,
+            "description": r.description,
+        }
+        for r in broker.rules.rules.values()
+    ]).encode()
+    api = getattr(server, "api", None)
+    if api is not None:
+        members["mgmt/admins.json"] = json.dumps(api.auth.admins).encode()
+        members["mgmt/api_keys.json"] = json.dumps(
+            api.auth.api_keys
+        ).encode()
+
+    manifest = {
+        "version": FORMAT_VERSION,
+        "exported_at": time.time(),
+        "node": broker.config.node_name,
+        "counts": {
+            "retained": len(retained),
+            "banned": len(broker.banned.all()),
+            "rules": len(broker.rules.rules),
+        },
+    }
+    members["META.json"] = json.dumps(manifest, indent=1).encode()
+    return members, manifest
+
+
+def write_archive(
+    members: Dict[str, bytes], directory: str
+) -> str:
+    """Tar+gzip the gathered members to disk (pure bytes work — safe
+    in a worker thread)."""
+    os.makedirs(directory, exist_ok=True)
+    stamp = time.strftime("%Y%m%d-%H%M%S")
+    path = os.path.join(directory, f"emqx-export-{stamp}.tar.gz")
+    with tarfile.open(path, "w:gz") as tar:
+        for name, data in members.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            info.mtime = int(time.time())
+            tar.addfile(info, io.BytesIO(data))
+    return path
+
+
+def export_archive(
+    server, directory: Optional[str] = None
+) -> Tuple[str, Dict]:
+    """Gather + write in one call (CLI/tests; the REST handler splits
+    the phases so only the bytes work leaves the event loop)."""
+    directory = directory or os.path.join(
+        server.broker.config.api.data_dir, "backups"
+    )
+    members, manifest = gather_state(server)
+    path = write_archive(members, directory)
+    log.info("exported %s (%s)", path, manifest["counts"])
+    return path, manifest
+
+
+def parse_archive(data: bytes) -> Dict[str, bytes]:
+    """Untar an uploaded archive into its members (bytes work — safe
+    in a worker thread); validates the format version."""
+    try:
+        tar = tarfile.open(fileobj=io.BytesIO(data), mode="r:gz")
+    except tarfile.TarError as exc:
+        raise ValueError(f"not a backup archive: {exc}") from exc
+    members: Dict[str, bytes] = {}
+    for info in tar.getmembers():
+        f = tar.extractfile(info)
+        if f is not None:
+            members[info.name] = f.read()
+    meta_raw = members.get("META.json")
+    if meta_raw is None:
+        raise ValueError("archive has no META.json")
+    meta = json.loads(meta_raw)
+    if int(meta.get("version", 0)) > FORMAT_VERSION:
+        raise ValueError(
+            f"archive format v{meta.get('version')} is newer than "
+            f"this broker understands (v{FORMAT_VERSION})"
+        )
+    return members
+
+
+def import_archive(server, data: bytes) -> Dict:
+    """Parse + apply in one call (CLI/tests; the REST handler parses
+    off-loop and applies via `apply_state_async`)."""
+    return apply_state(server, parse_archive(data))
+
+
+def apply_state(server, members: Dict[str, bytes],
+                report: Optional[Dict] = None) -> Dict:
+    """Restore parsed members into a running broker; returns the
+    report {restored: {...}, errors: [...], skipped: [...]} (the
+    reference's import result map).  Runs on the event loop (it
+    mutates loop-owned structures)."""
+    broker = server.broker
+    if report is None:
+        report = {"restored": {}, "errors": [], "skipped": []}
+
+    def read(name: str) -> Optional[bytes]:
+        return members.get(name)
+
+    # --- config: flatten and apply leaf-by-leaf through the
+    # validating update path; structural roots are reported skipped
+    conf_raw = read("cluster.json")
+    if conf_raw is not None:
+        flat: Dict[str, Any] = {}
+        _flatten("", json.loads(conf_raw), flat)
+        current: Dict[str, Any] = {}
+        _flatten("", broker.config, current)
+        applied = 0
+        for path, value in flat.items():
+            root = path.split(".", 1)[0]
+            if root in _STRUCTURAL:
+                if path not in report["skipped"]:
+                    report["skipped"].append(root)
+                continue
+            if current.get(path, object()) == value:
+                continue  # unchanged
+            try:
+                broker.apply_config(path, value)
+                applied += 1
+            except Exception as exc:
+                report["errors"].append(f"config {path}: {exc}")
+        report["skipped"] = sorted(set(report["skipped"]))
+        report["restored"]["config_keys"] = applied
+
+    # --- retained messages
+    ret_raw = read("retained.jsonl")
+    if ret_raw is not None:
+        n = 0
+        for line in ret_raw.decode().splitlines():
+            n += _store_retained_line(broker, line, report)
+        report["restored"]["retained"] = n
+
+    # --- banned table
+    ban_raw = read("banned.json")
+    if ban_raw is not None:
+        n = 0
+        now = time.time()
+        for entry in json.loads(ban_raw):
+            try:
+                until = entry.get("until")
+                seconds = None
+                if until is not None:
+                    seconds = max(float(until) - now, 0.0)
+                    if seconds == 0.0:
+                        continue  # already expired
+                broker.banned.ban(
+                    entry["as"], entry["who"],
+                    seconds=seconds,
+                    reason=entry.get("reason", ""),
+                )
+                n += 1
+            except Exception as exc:
+                report["errors"].append(f"banned: {exc}")
+        report["restored"]["banned"] = n
+
+    # --- SQL rules (same id replaces)
+    rules_raw = read("rules.json")
+    if rules_raw is not None:
+        n = 0
+        for entry in json.loads(rules_raw):
+            try:
+                broker.rules.remove_rule(entry["id"])
+                broker.rules.add_rule(
+                    entry["id"], entry["sql"],
+                    enabled=entry.get("enabled", True),
+                    description=entry.get("description", ""),
+                )
+                n += 1
+            except Exception as exc:
+                report["errors"].append(f"rule {entry.get('id')}: {exc}")
+        report["restored"]["rules"] = n
+
+    # --- management auth stores (merged: imported users/keys are
+    # added/overwritten, existing extras stay — the reference merges
+    # mnesia records the same way)
+    api = getattr(server, "api", None)
+    if api is not None:
+        admins_raw = read("mgmt/admins.json")
+        if admins_raw is not None:
+            imported = json.loads(admins_raw)
+            api.auth.admins.update(imported)
+            api.auth._save(api.auth._admins_path, api.auth.admins)
+            report["restored"]["admins"] = len(imported)
+        keys_raw = read("mgmt/api_keys.json")
+        if keys_raw is not None:
+            imported = json.loads(keys_raw)
+            api.auth.api_keys.update(imported)
+            api.auth._save(api.auth._keys_path, api.auth.api_keys)
+            report["restored"]["api_keys"] = len(imported)
+
+    log.info("import done: %s", report)
+    return report
+
+
+def _store_retained_line(broker, line: str, report: Dict) -> int:
+    line = line.strip()
+    if not line:
+        return 0
+    try:
+        msg = msg_from_wire(json.loads(line))
+        msg.retain = True
+        broker.retainer.store(msg)
+        return 1
+    except Exception as exc:
+        report["errors"].append(f"retained: {exc}")
+        return 0
+
+
+async def apply_state_async(server, members: Dict[str, bytes]) -> Dict:
+    """apply_state for the REST path: the (possibly large) retained
+    table applies in chunks with loop yields so connected clients'
+    keepalives keep flowing during a restore."""
+    import asyncio
+
+    report: Dict[str, Any] = {"restored": {}, "errors": [], "skipped": []}
+    small = {
+        k: v for k, v in members.items() if k != "retained.jsonl"
+    }
+    apply_state(server, small, report)
+    ret_raw = members.get("retained.jsonl")
+    if ret_raw is not None:
+        broker = server.broker
+        n = 0
+        for i, line in enumerate(ret_raw.decode().splitlines()):
+            n += _store_retained_line(broker, line, report)
+            if i % 500 == 499:
+                await asyncio.sleep(0)
+        report["restored"]["retained"] = n
+    return report

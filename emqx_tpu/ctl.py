@@ -12,6 +12,7 @@ any reachable broker:
     python -m emqx_tpu.ctl publish <topic> <payload> [--qos N]
     python -m emqx_tpu.ctl trace start <name> <type> <match> | stop <name>
     python -m emqx_tpu.ctl banned [add <as> <who>] [del <as> <who>]
+    python -m emqx_tpu.ctl data export | import <archive.tar.gz>
 """
 
 from __future__ import annotations
@@ -45,21 +46,28 @@ class Ctl:
             self._auth = "Bearer " + out["token"]
 
     def _req(
-        self, path: str, method: str = "GET", body: Optional[dict] = None
+        self,
+        path: str,
+        method: str = "GET",
+        body: Optional[dict] = None,
+        raw: Optional[bytes] = None,
+        timeout: float = 10.0,
     ) -> Any:
-        headers = {"Content-Type": "application/json"}
+        if raw is not None:
+            headers = {"Content-Type": "application/octet-stream"}
+            data = raw
+        else:
+            headers = {"Content-Type": "application/json"}
+            data = None if body is None else json.dumps(body).encode()
         if self._auth:
             headers["Authorization"] = self._auth
         req = urllib.request.Request(
-            self.base + path,
-            method=method,
-            data=None if body is None else json.dumps(body).encode(),
-            headers=headers,
+            self.base + path, method=method, data=data, headers=headers
         )
         try:
-            with urllib.request.urlopen(req, timeout=10) as resp:
-                raw = resp.read()
-                return json.loads(raw) if raw else None
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                out = resp.read()
+                return json.loads(out) if out else None
         except urllib.error.HTTPError as exc:
             detail = exc.read().decode(errors="replace")
             raise SystemExit(f"error {exc.code}: {detail}")
@@ -154,6 +162,26 @@ class Ctl:
         else:
             raise SystemExit(f"unknown trace action {action!r}")
 
+    def data(self, action: str, *args: str) -> None:
+        """Backup/restore (emqx ctl data export|import <file>)."""
+        if action == "export":
+            out = self._req("/api/v5/data/export", method="POST")
+            print(f"exported {out['filename']}: {out['counts']}")
+        elif action == "import":
+            with open(args[0], "rb") as f:
+                blob = f.read()
+            report = self._req(
+                "/api/v5/data/import", method="POST", raw=blob,
+                timeout=60,
+            )
+            print(f"restored: {report['restored']}")
+            if report.get("skipped"):
+                print(f"skipped (reboot-only): {report['skipped']}")
+            for err in report.get("errors", ()):
+                print(f"error: {err}")
+        else:
+            raise SystemExit(f"unknown data action {action!r}")
+
     def banned(self, action: str = "list", *args: str) -> None:
         if action == "list":
             for b in self._req("/api/v5/banned")["data"]:
@@ -194,7 +222,7 @@ def main(argv=None) -> None:
         "preferred over --user when set)",
     )
     ap.add_argument("command", help="status|clients|subscriptions|topics|"
-                    "rules|metrics|stats|publish|trace|banned")
+                    "rules|metrics|stats|publish|trace|banned|data")
     ap.add_argument("args", nargs="*")
     ap.add_argument("--qos", type=int, default=0)
     ns = ap.parse_args(argv)
@@ -222,6 +250,8 @@ def main(argv=None) -> None:
         ctl.trace(ns.args[0] if ns.args else "list", *ns.args[1:])
     elif cmd == "banned":
         ctl.banned(ns.args[0] if ns.args else "list", *ns.args[1:])
+    elif cmd == "data":
+        ctl.data(ns.args[0] if ns.args else "export", *ns.args[1:])
     else:
         raise SystemExit(f"unknown command {cmd!r}")
 

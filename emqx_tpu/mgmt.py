@@ -12,6 +12,7 @@ with aiohttp on the broker's event loop.
 from __future__ import annotations
 
 import json
+import os
 import time
 from typing import Optional
 
@@ -87,6 +88,14 @@ class MgmtApi:
                         'Basic realm="emqx_tpu api key"',
                     },
                 )
+            if path.startswith("/api/v5/data/") and not ident.can_write:
+                # backup archives hold the full config (secrets
+                # included): administrator-only, even for downloads
+                return _json(
+                    {"code": "FORBIDDEN",
+                     "message": "administrator required"},
+                    status=403,
+                )
             self_pwd_change = (
                 ident.via == "token"
                 and method == "PUT"
@@ -120,7 +129,9 @@ class MgmtApi:
     # ------------------------------------------------------- lifecycle
 
     async def start(self) -> None:
-        app = web.Application()
+        # default client_max_size (1 MiB) would reject any realistic
+        # backup-archive upload at /api/v5/data/import
+        app = web.Application(client_max_size=512 * 1024 * 1024)
         r = app.router
         r.add_post("/api/v5/login", self.post_login)
         r.add_get("/api/v5/api_key", self.get_api_keys)
@@ -154,6 +165,9 @@ class MgmtApi:
         r.add_get("/api/v5/trace/{name}/log", self.get_trace_log)
         r.add_get("/api/v5/audit", self.get_audit)
         r.add_put("/api/v5/configs", self.put_config)
+        r.add_post("/api/v5/data/export", self.post_export)
+        r.add_get("/api/v5/data/export/{name}", self.get_export_file)
+        r.add_post("/api/v5/data/import", self.post_import)
         r.add_get("/api/v5/gateways", self.get_gateways)
         r.add_get("/api/v5/plugins", self.get_plugins)
         r.add_get("/", self.dashboard)
@@ -541,6 +555,64 @@ class MgmtApi:
         except (KeyError, ValueError, TypeError, json.JSONDecodeError) as exc:
             return _json({"code": "BAD_REQUEST", "message": str(exc)}, 400)
         return _json({"path": path})
+
+    async def post_export(self, request: web.Request) -> web.Response:
+        """Write a backup archive (emqx_mgmt_data_backup export):
+        state gathering runs ON the loop (it reads loop-owned
+        structures — off-loop it would race concurrent publishes);
+        only the tar/gzip/disk bytes work leaves the loop."""
+        import asyncio
+
+        from .backup import gather_state, write_archive
+
+        members, manifest = gather_state(self.server)
+        directory = os.path.join(
+            self.broker.config.api.data_dir, "backups"
+        )
+        path = await asyncio.get_running_loop().run_in_executor(
+            None, write_archive, members, directory
+        )
+        return _json({
+            "filename": os.path.basename(path),
+            **manifest,
+        }, status=201)
+
+    async def get_export_file(self, request: web.Request) -> web.Response:
+        import re
+
+        name = request.match_info["name"]
+        if not re.fullmatch(r"emqx-export-[0-9-]+\.tar\.gz", name):
+            return _json({"code": "BAD_REQUEST"}, status=400)
+        path = os.path.join(
+            self.broker.config.api.data_dir, "backups", name
+        )
+        if not os.path.exists(path):
+            return _json({"code": "NOT_FOUND"}, status=404)
+        # FileResponse streams off-loop (sendfile) instead of holding
+        # the whole archive in memory on the event loop
+        return web.FileResponse(path, headers={
+            "Content-Type": "application/gzip",
+            "Content-Disposition": f'attachment; filename="{name}"',
+        })
+
+    async def post_import(self, request: web.Request) -> web.Response:
+        """Restore an uploaded archive (raw body) into this broker:
+        untar/ungzip off-loop, then apply mutations ON the loop in
+        chunks so client keepalives keep flowing during the restore."""
+        import asyncio
+
+        from .backup import apply_state_async, parse_archive
+
+        data = await request.read()
+        try:
+            members = await asyncio.get_running_loop().run_in_executor(
+                None, parse_archive, data
+            )
+        except ValueError as exc:
+            return _json({"code": "BAD_REQUEST", "message": str(exc)},
+                         status=400)
+        report = await apply_state_async(self.server, members)
+        return _json(report)
 
     async def get_gateways(self, request: web.Request) -> web.Response:
         return _json({"data": self.broker.gateways.info()})
