@@ -673,6 +673,25 @@ def main():
         filters[: min(n_subs, 1_000_000)], n_insert, log
     )
 
+    sharded_stats = {}
+    if os.environ.get("BENCH_SHARDED", "1") != "0":
+        # the sharded engine runs on the driver's virtual 8-device CPU
+        # mesh in a SUBPROCESS (this process must keep seeing the TPU)
+        import subprocess
+
+        log("sharded mesh bench (8-way CPU subprocess)...")
+        try:
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "tools", "bench_sharded.py")],
+                capture_output=True, text=True, timeout=420,
+            )
+            sharded_stats = json.loads(out.stdout.strip().splitlines()[-1])
+            log(f"sharded: {sharded_stats}")
+        except Exception as exc:
+            log(f"sharded bench failed: {exc}")
+
     broker_stats = {}
     if os.environ.get("BENCH_BROKER", "1") != "0":
         host = run_broker_bench(log)  # host match path
@@ -710,6 +729,7 @@ def main():
         "Zipf-hit-rate dependent — matches the production engine's "
         "cache) + device match + async compact-code transfer + "
         "vectorized host CSR expand to per-topic fid lists",
+        **sharded_stats,
         **broker_stats,
     }
     with open(

@@ -184,15 +184,23 @@ def apply_state(server, members: Dict[str, bytes],
     # validating update path; structural roots are reported skipped
     conf_raw = read("cluster.json")
     if conf_raw is not None:
+        try:
+            conf_obj = json.loads(conf_raw)
+        except (ValueError, UnicodeDecodeError) as exc:
+            report["errors"].append(f"cluster.json: {exc}")
+            conf_obj = None
+    else:
+        conf_obj = None
+    if conf_obj is not None:
         flat: Dict[str, Any] = {}
-        _flatten("", json.loads(conf_raw), flat)
+        _flatten("", conf_obj, flat)
         current: Dict[str, Any] = {}
         _flatten("", broker.config, current)
         applied = 0
         for path, value in flat.items():
             root = path.split(".", 1)[0]
             if root in _STRUCTURAL:
-                if path not in report["skipped"]:
+                if root not in report["skipped"]:
                     report["skipped"].append(root)
                 continue
             if current.get(path, object()) == value:
@@ -202,23 +210,28 @@ def apply_state(server, members: Dict[str, bytes],
                 applied += 1
             except Exception as exc:
                 report["errors"].append(f"config {path}: {exc}")
-        report["skipped"] = sorted(set(report["skipped"]))
+        report["skipped"].sort()
         report["restored"]["config_keys"] = applied
 
     # --- retained messages
     ret_raw = read("retained.jsonl")
     if ret_raw is not None:
         n = 0
-        for line in ret_raw.decode().splitlines():
+        for line in ret_raw.decode(errors="replace").splitlines():
             n += _store_retained_line(broker, line, report)
         report["restored"]["retained"] = n
 
     # --- banned table
     ban_raw = read("banned.json")
     if ban_raw is not None:
+        try:
+            ban_entries = json.loads(ban_raw)
+        except (ValueError, UnicodeDecodeError) as exc:
+            report["errors"].append(f"banned.json: {exc}")
+            ban_entries = []
         n = 0
         now = time.time()
-        for entry in json.loads(ban_raw):
+        for entry in ban_entries:
             try:
                 until = entry.get("until")
                 seconds = None
@@ -239,8 +252,13 @@ def apply_state(server, members: Dict[str, bytes],
     # --- SQL rules (same id replaces)
     rules_raw = read("rules.json")
     if rules_raw is not None:
+        try:
+            rule_entries = json.loads(rules_raw)
+        except (ValueError, UnicodeDecodeError) as exc:
+            report["errors"].append(f"rules.json: {exc}")
+            rule_entries = []
         n = 0
-        for entry in json.loads(rules_raw):
+        for entry in rule_entries:
             try:
                 broker.rules.remove_rule(entry["id"])
                 broker.rules.add_rule(
@@ -260,13 +278,21 @@ def apply_state(server, members: Dict[str, bytes],
     if api is not None:
         admins_raw = read("mgmt/admins.json")
         if admins_raw is not None:
-            imported = json.loads(admins_raw)
+            try:
+                imported = json.loads(admins_raw)
+            except (ValueError, UnicodeDecodeError) as exc:
+                report["errors"].append(f"admins.json: {exc}")
+                imported = {}
             api.auth.admins.update(imported)
             api.auth._save(api.auth._admins_path, api.auth.admins)
             report["restored"]["admins"] = len(imported)
         keys_raw = read("mgmt/api_keys.json")
         if keys_raw is not None:
-            imported = json.loads(keys_raw)
+            try:
+                imported = json.loads(keys_raw)
+            except (ValueError, UnicodeDecodeError) as exc:
+                report["errors"].append(f"api_keys.json: {exc}")
+                imported = {}
             api.auth.api_keys.update(imported)
             api.auth._save(api.auth._keys_path, api.auth.api_keys)
             report["restored"]["api_keys"] = len(imported)

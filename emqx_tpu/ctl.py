@@ -168,6 +168,8 @@ class Ctl:
             out = self._req("/api/v5/data/export", method="POST")
             print(f"exported {out['filename']}: {out['counts']}")
         elif action == "import":
+            if not args:
+                raise SystemExit("usage: data import <archive.tar.gz>")
             with open(args[0], "rb") as f:
                 blob = f.read()
             report = self._req(

@@ -129,9 +129,9 @@ class MgmtApi:
     # ------------------------------------------------------- lifecycle
 
     async def start(self) -> None:
-        # default client_max_size (1 MiB) would reject any realistic
-        # backup-archive upload at /api/v5/data/import
-        app = web.Application(client_max_size=512 * 1024 * 1024)
+        app = web.Application()  # default 1 MiB body cap: the open
+        # login route must not buffer attacker-sized bodies; the
+        # import handler streams its own (authenticated) larger limit
         r = app.router
         r.add_post("/api/v5/login", self.post_login)
         r.add_get("/api/v5/api_key", self.get_api_keys)
@@ -603,7 +603,22 @@ class MgmtApi:
 
         from .backup import apply_state_async, parse_archive
 
-        data = await request.read()
+        # stream the body manually: the app-wide 1 MiB cap protects
+        # the unauthenticated routes, while this (admin-only) upload
+        # allows realistic archive sizes under its own bound
+        max_size = 512 * 1024 * 1024
+        chunks = []
+        got = 0
+        async for chunk in request.content.iter_chunked(1 << 20):
+            got += len(chunk)
+            if got > max_size:
+                return _json(
+                    {"code": "BAD_REQUEST",
+                     "message": "archive exceeds 512 MiB"},
+                    status=413,
+                )
+            chunks.append(chunk)
+        data = b"".join(chunks)
         try:
             members = await asyncio.get_running_loop().run_in_executor(
                 None, parse_archive, data

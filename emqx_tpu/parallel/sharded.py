@@ -94,25 +94,44 @@ class ShardedIndex:
         return self.tables
 
 
-def build_sharded_index(
-    filters: Sequence[Tuple[Hashable, Tuple[str, ...]]],
-    tdict: TokenDict,
-    n_shards: int,
-    max_levels: int = 16,
+def shard_of(fid: Hashable, n_shards: int) -> int:
+    """STABLE fid -> shard assignment within the engine's lifetime: an
+    incremental rebuild must route a fid's delta to the same shard's
+    arena every time.  hash() matches the equality semantics of every
+    engine dict (repr() would split np.int64(7) from int 7 and route a
+    delete's dead-mark to the wrong arena)."""
+    return hash(fid) % n_shards
+
+
+def assemble_sharded(
+    shard_inputs: Sequence[Tuple],
+    max_levels: int,
+    min_buckets: int = 4,
+    min_nodes: int = 16,
 ) -> ShardedIndex:
-    """Partition filters into ``n_shards`` automata with identical
-    geometry (same hash size / node count / probe bound)."""
-    parts: List[List] = [[] for _ in range(n_shards)]
-    for i, item in enumerate(filters):
-        parts[i % n_shards].append(item)
-    shards = [build_automaton(p, tdict, max_levels) for p in parts]
+    """Assemble per-shard encoded arrays into one stacked index with
+    identical geometry (shared hash size / padded node count) so every
+    shard rides one compiled kernel.  ``min_buckets``/``min_nodes``
+    let callers pin STICKY capacity classes across rebuilds."""
+    from ..ops.automaton import assemble_automaton
+
+    shards = [
+        assemble_automaton(*inp, max_levels=max_levels,
+                           hash_buckets=min_buckets)
+        for inp in shard_inputs
+    ]
     nb = max(len(a.fp_rows) for a in shards)
     if any(len(a.fp_rows) != nb for a in shards):
         shards = [
-            build_automaton(p, tdict, max_levels, hash_buckets=nb)
-            for p in parts
+            assemble_automaton(*inp, max_levels=max_levels,
+                               hash_buckets=nb)
+            for inp in shard_inputs
         ]
-    n_nodes = max(a.n_nodes for a in shards)
+    n_nodes = max(max(a.n_nodes for a in shards), min_nodes)
+    cap = 16
+    while cap < n_nodes:
+        cap *= 2
+    n_nodes = cap  # power-of-two class: bounded compiled-shape set
 
     def pad_nodes(a: np.ndarray) -> np.ndarray:
         # padded node rows are never terminal, have no '+' child, and
@@ -132,6 +151,25 @@ def build_sharded_index(
         tables=(ht, nrows, salts),
         max_levels=max_levels,
         kernel_levels=max(a.kernel_levels for a in shards),
+    )
+
+
+def build_sharded_index(
+    filters: Sequence[Tuple[Hashable, Tuple[str, ...]]],
+    tdict: TokenDict,
+    n_shards: int,
+    max_levels: int = 16,
+) -> ShardedIndex:
+    """Partition filters into ``n_shards`` automata with identical
+    geometry (same hash size / node count / probe bound)."""
+    from ..ops.automaton import encode_filters
+
+    parts: List[List] = [[] for _ in range(n_shards)]
+    for fid, ws in filters:
+        parts[shard_of(fid, n_shards)].append((fid, ws))
+    return assemble_sharded(
+        [encode_filters(p, tdict, max_levels) for p in parts],
+        max_levels,
     )
 
 
@@ -223,6 +261,10 @@ class ShardedMatchEngine(MatchEngine):
             background_rebuild=background_rebuild,
         )
         self.mesh = mesh
+        # sticky geometry classes (never shrink): rebuilds reuse
+        # compiled kernel shapes instead of re-tracing per size
+        self._shard_min_buckets = 4
+        self._shard_min_nodes = 16
         if tdict is not None:
             self._tdict = tdict
         if index is not None:
@@ -250,26 +292,60 @@ class ShardedMatchEngine(MatchEngine):
 
     # -------------------------------------------- sharded build/match
 
-    def _snapshot_inputs(self):
-        # the sharded builder re-partitions from the filter list; no
-        # incremental array cache (base-class optimization) yet
-        return self._snapshot_filters()
-
     def _build(
-        self, filters, hash_buckets: int = 0, device_put: bool = False
+        self, inputs, hash_buckets: int = 0, device_put: bool = False
     ):
-        from ..engine import make_fid_arr
+        """Incremental sharded rebuild (VERDICT r3 weak #4: the O(N)
+        re-encode per rebuild): one `_EncArena` PER SHARD, with the
+        stable fid->shard hash routing each delta item to its arena —
+        an incremental rebuild re-encodes only the delta, exactly like
+        the base engine.  Geometry (hash size / node class) is sticky
+        so successive rebuilds reuse compiled kernel shapes."""
+        from ..engine import _EncArena
 
-        # the sharded builder encodes (TokenDict-mutating) inside the
-        # builder thread: exclude concurrent fold/rebuild encoders
+        n_shards = self.mesh.shape["sub"]
         with self._enc_lock:
-            index = build_sharded_index(
-                filters, self._tdict, self.mesh.shape["sub"],
-                self.max_levels
+            if inputs[0] == "full":
+                arenas = [
+                    _EncArena(self.max_levels) for _ in range(n_shards)
+                ]
+                parts: List[List] = [[] for _ in range(n_shards)]
+                for fid, ws in inputs[1]:
+                    parts[shard_of(fid, n_shards)].append((fid, ws))
+                for arena, items in zip(arenas, parts):
+                    arena.apply(items, (), self._tdict)
+            else:
+                _, items, dropped = inputs
+                arenas = self._build_cache
+                parts = [[] for _ in range(n_shards)]
+                drops: List[List] = [[] for _ in range(n_shards)]
+                for fid, ws in items:
+                    parts[shard_of(fid, n_shards)].append((fid, ws))
+                for fid in dropped:
+                    drops[shard_of(fid, n_shards)].append(fid)
+                for arena, its, dr in zip(arenas, parts, drops):
+                    arena.apply(its, dr, self._tdict)
+            views = [a.views() for a in arenas]
+            fid_views = [a.fid_view() for a in arenas]
+            n_live = sum(len(a.rows) for a in arenas)
+        index = assemble_sharded(
+            views, self.max_levels,
+            min_buckets=self._shard_min_buckets,
+            min_nodes=self._shard_min_nodes,
+        )
+        self._shard_min_buckets = len(index.tables[0][0])
+        self._shard_min_nodes = index.tables[1].shape[1]
+        if all(v.dtype != object for v in fid_views):
+            fid_arr = np.concatenate(fid_views) if fid_views else \
+                np.zeros(0, np.int64)
+        else:
+            from ..engine import make_fid_arr
+
+            fid_arr = make_fid_arr(
+                [f for v in fid_views for f in v.tolist()]
             )
-        fids = [fid for a in index.shards for fid, _ in a.filters]
         dev = self._device_put(index) if device_put else None
-        return index, dev, make_fid_arr(fids), set(fids), None
+        return index, dev, fid_arr, n_live, arenas
 
     def _warm_built(self, index, dev) -> None:
         # the sharded tables feed sharded_match, not the single-chip

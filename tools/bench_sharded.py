@@ -1,0 +1,113 @@
+"""Sharded-engine benchmark on the virtual 8-device CPU mesh (the
+driver's dryrun environment): sharded insert + match throughput under
+churn, with incremental per-shard rebuilds.  Spawned by bench.py as a
+subprocess (the main bench must keep seeing the real TPU) — prints
+ONE JSON line on stdout."""
+
+import json
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import numpy as np
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from bench import make_filters, make_topics
+    from emqx_tpu.ops.dictionary import TokenDict
+    from emqx_tpu.parallel.sharded import ShardedMatchEngine, make_mesh
+
+    n_subs = int(os.environ.get("BENCH_SHARDED_SUBS", 200_000))
+    n_insert = int(os.environ.get("BENCH_SHARDED_INSERTS", 50_000))
+    batch = int(os.environ.get("BENCH_SHARDED_BATCH", 4096))
+    mesh = make_mesh(8)
+
+    rng = np.random.default_rng(0)
+    filters, pops = make_filters(n_subs, 8)
+    # construct EMPTY and seed through the real mutation path: timing
+    # the engine's own insert_many + sharded rebuild measures the index
+    # that actually serves the matches below (a pre-built seed index
+    # would be discarded by adoption)
+    eng = ShardedMatchEngine(
+        mesh, f_width=4, m_cap=16,
+        rebuild_threshold=10**9, background_rebuild=True,
+    )
+    t0 = time.perf_counter()
+    W = 4096
+    pairs = [("/".join(ws), fid) for fid, ws in filters]
+    for w0 in range(0, len(pairs), W):
+        eng.insert_many(pairs[w0:w0 + W])
+    eng.rebuild()
+    build_s = time.perf_counter() - t0
+    eng.rebuild_threshold = 65536
+
+    streams = [make_topics(rng, batch, pops) for _ in range(10)]
+    eng.match_batch(streams[0])  # compile
+
+    # match throughput on the mesh
+    t0 = time.perf_counter()
+    total = 0
+    for s in streams:
+        out = eng.match_batch(s)
+        total += sum(len(x) for x in out)
+    match_rate = batch * len(streams) / (time.perf_counter() - t0)
+
+    # churn: windowed inserts while the match stream stays hot (the
+    # final explicit rebuild below is the incremental one — the churn
+    # volume stays under the background threshold)
+    probe = streams[0][:256]
+    t0 = time.perf_counter()
+    match_time = 0.0
+    lat = []
+    W = 512
+    for w0 in range(0, n_insert, W):
+        eng.insert_many([
+            (f"ins/{i % 4099}/+/x{i}", n_subs + i)
+            for i in range(w0, min(w0 + W, n_insert))
+        ])
+        if (w0 // W) % 8 == 7:
+            m0 = time.perf_counter()
+            eng.match_batch(probe)
+            dt = time.perf_counter() - m0
+            match_time += dt
+            lat.append(dt)
+    el = time.perf_counter() - t0 - match_time
+    insert_rps = n_insert / el
+
+    # one explicit incremental rebuild: only the delta re-encodes
+    t0 = time.perf_counter()
+    eng.rebuild()
+    incr_rebuild_s = time.perf_counter() - t0
+    eng.match_batch(probe)
+
+    lat_ms = np.array(lat or [0.0]) * 1e3
+    print(json.dumps({
+        "sharded_mesh": dict(mesh.shape),
+        "sharded_subs": n_subs,
+        "sharded_build_s": round(build_s, 2),
+        "sharded_match_topics_per_s": round(match_rate, 1),
+        "sharded_mean_fanout": round(total / (batch * len(streams)), 2),
+        "sharded_insert_rps": round(insert_rps, 1),
+        "sharded_churn_match_p50_ms": round(
+            float(np.percentile(lat_ms, 50)), 1),
+        "sharded_churn_match_p99_ms": round(
+            float(np.percentile(lat_ms, 99)), 1),
+        "sharded_incremental_rebuild_s": round(incr_rebuild_s, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()
