@@ -692,6 +692,25 @@ def main():
         except Exception as exc:
             log(f"sharded bench failed: {exc}")
 
+    if os.environ.get("BENCH_MC", "1") != "0":
+        # multi-core broker: worker processes + loadgen processes (the
+        # whole phase lives outside this TPU-holding process)
+        import subprocess
+
+        log("multicore broker bench (worker pool subprocess)...")
+        try:
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "tools", "bench_multicore.py")],
+                capture_output=True, text=True, timeout=540,
+            )
+            mc = json.loads(out.stdout.strip().splitlines()[-1])
+            sharded_stats.update(mc)
+            log(f"multicore: {mc}")
+        except Exception as exc:
+            log(f"multicore bench failed: {exc}")
+
     broker_stats = {}
     if os.environ.get("BENCH_BROKER", "1") != "0":
         host = run_broker_bench(log)  # host match path

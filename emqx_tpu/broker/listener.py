@@ -64,7 +64,8 @@ class Listener:
             self._ssl_context() if self.cfg.type in ("ssl", "wss") else None
         )
         self._server = await asyncio.start_server(
-            self._on_client, self.cfg.bind, self.cfg.port, ssl=ssl_ctx
+            self._on_client, self.cfg.bind, self.cfg.port, ssl=ssl_ctx,
+            reuse_port=self.cfg.reuse_port or None,
         )
         log.info(
             "listener %s (%s) started on %s:%d",
@@ -160,6 +161,7 @@ class BrokerServer:
         self.cluster_links = None  # ClusterLinks when config.cluster_links
         self.otel = None  # OtelExporter when config.otel.enable
         self.exhook_clients: list = []  # ExhookClient per config.exhooks
+        self.cluster_node = None  # ClusterNode when config.cluster
 
     async def start(self) -> None:
         eng_cfg = self.broker.config.engine
@@ -202,6 +204,25 @@ class BrokerServer:
             await self._load_gateway(gw_cfg)
         if self.cluster_links is not None:
             await self.cluster_links.start()
+        cl = cfg.cluster
+        if cl.get("enable"):
+            from ..cluster import ClusterNode
+
+            self.cluster_node = ClusterNode(
+                cfg.node_name,
+                self.broker,
+                bind=cl.get("bind", "127.0.0.1"),
+                port=int(cl.get("port", 0)),
+                consensus=cl.get("consensus", "lww"),
+                raft_data_dir=cl.get("raft_data_dir"),
+                heartbeat_interval=float(
+                    cl.get("heartbeat_interval", 0.5)
+                ),
+                down_after=float(cl.get("down_after", 2.0)),
+            )
+            await self.cluster_node.start(seeds=[
+                (s[0], s[1], int(s[2])) for s in cl.get("seeds", ())
+            ])
         for ex_cfg in cfg.exhooks:
             from ..exhook.client import ExhookClient
 
@@ -357,6 +378,9 @@ class BrokerServer:
         if self.cluster_links is not None:
             await self.cluster_links.stop()
             self.cluster_links = None
+        if self.cluster_node is not None:
+            await self.cluster_node.stop()
+            self.cluster_node = None
         for client in self.exhook_clients:
             try:
                 await asyncio.get_running_loop().run_in_executor(
@@ -397,6 +421,11 @@ def main(argv: Optional[List[str]] = None) -> None:
     ap.add_argument("--port", type=int, default=None)
     ap.add_argument("--bind", default=None)
     ap.add_argument("--config", help="JSON config file", default=None)
+    ap.add_argument(
+        "--workers", type=int, default=0,
+        help="spawn N worker processes sharing the port "
+        "(SO_REUSEPORT accept pool, clustered on loopback)",
+    )
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
 
@@ -404,6 +433,22 @@ def main(argv: Optional[List[str]] = None) -> None:
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s %(message)s",
     )
+    if args.workers > 1:
+        import json as _json
+
+        from .multicore import main as mc_main
+
+        base = None
+        if args.config:
+            with open(args.config) as f:
+                base = _json.load(f)
+        mc_main(
+            args.workers,
+            args.port or 1883,
+            bind=args.bind or "0.0.0.0",
+            base_config=base,
+        )
+        return
     if args.config:
         from ..config import ConfigHandler
 

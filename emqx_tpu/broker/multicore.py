@@ -1,0 +1,221 @@
+"""Multi-core broker: worker processes sharing one listening port.
+
+The reference runs on every BEAM scheduler via its broker/router
+pools (/root/reference/apps/emqx/src/emqx_broker.erl:539-540, esockd
+acceptor pools); a single asyncio loop caps this broker at one core.
+The multi-core launcher spawns N WORKER PROCESSES that each run the
+full broker:
+
+  * every worker binds the SAME MQTT port with SO_REUSEPORT — the
+    kernel spreads accepted connections across workers (the acceptor
+    pool);
+  * workers cluster over loopback using the ordinary inter-node
+    transport (route-delta replication + binary-wire forwards), so a
+    publish accepted by worker A reaches subscribers owned by worker
+    B exactly as it would cross real nodes — no new protocol, and a
+    multi-host deployment composes by seeding workers at other hosts.
+
+Usage: ``python -m emqx_tpu.broker --workers N [--port P]`` or
+`spawn_workers()` programmatically (the bench drives it that way).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+log = logging.getLogger("emqx_tpu.multicore")
+
+
+def free_ports(n: int) -> List[int]:
+    """Probe N currently-free loopback ports (shared by the launcher,
+    its bench tool, and tests — TOCTOU applies, as with any probe)."""
+    return _free_ports(n)
+
+
+def _free_ports(n: int) -> List[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    return ports
+
+
+def worker_configs(
+    n_workers: int,
+    port: int,
+    bind: str = "0.0.0.0",
+    base_config: Optional[Dict] = None,
+    use_device: Optional[bool] = False,
+) -> List[Dict]:
+    """Per-worker config dicts: shared REUSEPORT listener + loopback
+    cluster full-mesh seeds.  ``use_device=False`` by default — worker
+    processes must not fight over one TPU; run a single-process broker
+    for the device match path, or give exactly one worker the device.
+    """
+    cluster_ports = _free_ports(n_workers)
+    configs = []
+    for i in range(n_workers):
+        cfg = dict(base_config or {})
+        cfg["node_name"] = f"worker{i}"
+        cfg["listeners"] = [{
+            "name": "tcp_shared",
+            "bind": bind,
+            "port": port,
+            "reuse_port": True,
+        }]
+        engine = dict(cfg.get("engine") or {})
+        if use_device is not None:
+            engine["use_device"] = use_device
+        cfg["engine"] = engine
+        cfg["cluster"] = {
+            "enable": True,
+            "bind": "127.0.0.1",
+            "port": cluster_ports[i],
+            "heartbeat_interval": 0.5,
+            "down_after": 3.0,
+            "seeds": [
+                [f"worker{j}", "127.0.0.1", cluster_ports[j]]
+                for j in range(n_workers) if j != i
+            ],
+        }
+        configs.append(cfg)
+    return configs
+
+
+class WorkerPool:
+    """Spawn + supervise the worker processes."""
+
+    def __init__(self, configs: List[Dict],
+                 log_dir: Optional[str] = None) -> None:
+        self.configs = configs
+        self.log_dir = log_dir or tempfile.mkdtemp(prefix="emqx-mc-")
+        self.procs: List[subprocess.Popen] = []
+        self._conf_paths: List[str] = []
+
+    def _spawn_one(self, i: int, mode: str = "w") -> subprocess.Popen:
+        cfg = self.configs[i]
+        env = dict(os.environ)
+        if not (cfg.get("engine") or {}).get("use_device"):
+            # host-engine workers must not initialize (or fight over)
+            # the TPU backend a sitecustomize may pre-wire — the
+            # RESTART path must apply the same override as the first
+            # spawn
+            env["JAX_PLATFORMS"] = "cpu"
+        log_f = open(
+            os.path.join(self.log_dir, f"worker{i}.log"), mode
+        )
+        return subprocess.Popen(
+            [sys.executable, "-m", "emqx_tpu.broker",
+             "--config", self._conf_paths[i]],
+            stdout=log_f, stderr=subprocess.STDOUT, env=env,
+        )
+
+    def start(self) -> None:
+        os.makedirs(self.log_dir, exist_ok=True)
+        for i, cfg in enumerate(self.configs):
+            conf_path = os.path.join(self.log_dir, f"worker{i}.json")
+            with open(conf_path, "w") as f:
+                json.dump(cfg, f, indent=1)
+            self._conf_paths.append(conf_path)
+        self.procs = [
+            self._spawn_one(i) for i in range(len(self.configs))
+        ]
+        log.info("spawned %d workers (logs in %s)",
+                 len(self.procs), self.log_dir)
+
+    def wait_ready(self, port: int, timeout: float = 60.0) -> None:
+        """Block until the shared port accepts (all workers share it,
+        so the first acceptor proves the pool is serving)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if any(p.poll() is not None for p in self.procs):
+                dead = [
+                    i for i, p in enumerate(self.procs)
+                    if p.poll() is not None
+                ]
+                raise RuntimeError(
+                    f"workers {dead} exited during startup; see "
+                    f"{self.log_dir}"
+                )
+            try:
+                with socket.create_connection(
+                    ("127.0.0.1", port), timeout=0.5
+                ):
+                    return
+            except OSError:
+                time.sleep(0.2)
+        raise TimeoutError(f"port {port} not accepting after {timeout}s")
+
+    def alive(self) -> int:
+        return sum(1 for p in self.procs if p.poll() is None)
+
+    def stop(self, timeout: float = 10.0) -> None:
+        for p in self.procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGINT)
+        deadline = time.monotonic() + timeout
+        for p in self.procs:
+            try:
+                p.wait(max(deadline - time.monotonic(), 0.1))
+            except subprocess.TimeoutExpired:
+                p.kill()
+        self.procs = []
+
+
+def spawn_workers(
+    n_workers: int,
+    port: int,
+    bind: str = "0.0.0.0",
+    base_config: Optional[Dict] = None,
+    use_device: Optional[bool] = False,
+) -> WorkerPool:
+    pool = WorkerPool(worker_configs(
+        n_workers, port, bind=bind, base_config=base_config,
+        use_device=use_device,
+    ))
+    pool.start()
+    return pool
+
+
+def main(n_workers: int, port: int, bind: str = "0.0.0.0",
+         base_config: Optional[Dict] = None) -> None:
+    """Foreground supervisor: run the pool, restart dead workers,
+    terminate cleanly on SIGINT/SIGTERM."""
+    pool = spawn_workers(n_workers, port, bind=bind,
+                         base_config=base_config)
+    stopping = False
+
+    def _stop(_sig, _frm):
+        nonlocal stopping
+        stopping = True
+
+    signal.signal(signal.SIGINT, _stop)
+    signal.signal(signal.SIGTERM, _stop)
+    try:
+        # inside try/finally: a startup failure must stop the
+        # SURVIVING workers too, or zombies keep sharing the port
+        pool.wait_ready(port)
+        print(f"emqx_tpu multicore: {n_workers} workers on :{port} "
+              f"(logs: {pool.log_dir})", flush=True)
+        while not stopping:
+            time.sleep(1.0)
+            for i, p in enumerate(pool.procs):
+                if p.poll() is not None and not stopping:
+                    log.warning("worker %d died (rc=%s); restarting",
+                                i, p.returncode)
+                    pool.procs[i] = pool._spawn_one(i, mode="a")
+    finally:
+        pool.stop()

@@ -50,6 +50,10 @@ class ListenerConfig:
     max_connections: int = 1024000
     mountpoint: Optional[str] = None
     enable: bool = True
+    # SO_REUSEPORT accept sharding: multiple worker PROCESSES bind the
+    # same port and the kernel spreads accepted connections across
+    # them (the multi-core launcher's esockd-acceptor-pool analogue)
+    reuse_port: bool = False
     # TLS options (ssl/wss listeners; emqx_tls_lib's core knobs)
     certfile: Optional[str] = None
     keyfile: Optional[str] = None
@@ -208,6 +212,14 @@ class BrokerConfig:
     # exhook CLIENT servers this broker calls out to (emqx_exhook):
     # [{"name", "url", "timeout", "failure_action": "deny"|"ignore"}]
     exhooks: List[Dict[str, Any]] = field(default_factory=list)
+    # cluster membership (the ekka static-seeds shape): when enabled,
+    # this node joins peers over the inter-node transport; the
+    # multi-core launcher uses the same mechanism to cluster its
+    # worker processes on loopback
+    cluster: Dict[str, Any] = field(default_factory=dict)
+    # {"enable": bool, "bind": str, "port": int,
+    #  "seeds": [[name, host, port], ...],
+    #  "consensus": "lww"|"raft", "raft_data_dir": str}
     otel: OtelConfig = field(default_factory=OtelConfig)
     log: LogConfig = field(default_factory=LogConfig)
 

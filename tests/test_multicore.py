@@ -1,0 +1,65 @@
+"""Multi-core broker: worker processes share one SO_REUSEPORT listener
+and cluster over loopback — a client landing on any worker reaches
+subscribers owned by any other (the esockd-acceptor-pool +
+broker-pool role, emqx_broker.erl:539-540, as processes)."""
+
+import asyncio
+
+from emqx_tpu.broker.multicore import (free_ports, spawn_workers,
+                                       worker_configs)
+from mqtt_client import TestClient
+
+
+def test_worker_configs_shape():
+    cfgs = worker_configs(3, 1883)
+    assert len(cfgs) == 3
+    for i, cfg in enumerate(cfgs):
+        assert cfg["listeners"][0]["port"] == 1883
+        assert cfg["listeners"][0]["reuse_port"] is True
+        assert cfg["node_name"] == f"worker{i}"
+        assert cfg["engine"]["use_device"] is False
+        seeds = cfg["cluster"]["seeds"]
+        assert len(seeds) == 2 and all(
+            s[0] != f"worker{i}" for s in seeds
+        )
+    # all workers agree on each other's cluster ports
+    ports = {c["node_name"]: c["cluster"]["port"] for c in cfgs}
+    for cfg in cfgs:
+        for name, _h, p in cfg["cluster"]["seeds"]:
+            assert ports[name] == p
+
+
+def test_cross_worker_pubsub():
+    port = free_ports(1)[0]
+    pool = spawn_workers(3, port, bind="127.0.0.1")
+    try:
+        pool.wait_ready(port, timeout=120)
+
+        async def t():
+            await asyncio.sleep(2.0)  # cluster mesh settles
+            # many clients spread across workers by the kernel; every
+            # subscriber must receive regardless of worker placement
+            subs = []
+            for i in range(6):
+                c = TestClient(port, f"mcs{i}")
+                await c.connect()
+                await c.subscribe(f"mc/{i}/#", qos=1)
+                subs.append(c)
+            await asyncio.sleep(1.0)  # route replication
+            pub = TestClient(port, "mcp")
+            await pub.connect()
+            for i in range(6):
+                await pub.publish(f"mc/{i}/x", str(i).encode(), qos=1,
+                                  timeout=10)
+            for i, c in enumerate(subs):
+                m = await c.recv_publish(timeout=10)
+                assert m.topic == f"mc/{i}/x"
+                assert m.payload == str(i).encode()
+            await pub.close()
+            for c in subs:
+                await c.close()
+
+        asyncio.run(t())
+        assert pool.alive() == 3
+    finally:
+        pool.stop()

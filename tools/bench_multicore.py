@@ -1,0 +1,208 @@
+"""Multi-core broker benchmark: N worker processes (SO_REUSEPORT +
+loopback clustering) driven by K load-generator processes, so neither
+side is single-core-bound.  Prints ONE JSON line.
+
+Workload = the emqtt_bench shape run_broker_bench uses: S wildcard
+subscribers (bench/{i}/#), P QoS1 publishers round-robining over
+them; with workers sharing the accept socket, most deliveries cross
+worker processes over the binary cluster wire."""
+
+import asyncio
+import json
+import os
+import struct
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+async def _loadgen(port, gen_id, n_pubs, n_subs, sub_base, n_msgs,
+                   inflight):
+    from emqx_tpu.codec import mqtt as C
+
+    loop = asyncio.get_running_loop()
+    total = n_pubs * n_msgs
+    received = 0
+    lat = []
+    all_done = loop.create_future()
+    sub_ready = [asyncio.Event() for _ in range(n_subs)]
+
+    async def open_conn(cid):
+        r, w = await asyncio.open_connection("127.0.0.1", port)
+        w.write(C.serialize(
+            C.Connect(client_id=cid, proto_ver=C.MQTT_V5), C.MQTT_V5
+        ))
+        await w.drain()
+        p = C.StreamParser(version=C.MQTT_V5)
+        while True:
+            data = await r.read(1 << 16)
+            assert data, "closed during CONNECT"
+            pkts = list(p.feed(data))
+            if pkts:
+                assert pkts[0].type == C.CONNACK
+                break
+        return r, w, p
+
+    async def subscriber(i):
+        nonlocal received
+        r, w, p = await open_conn(f"g{gen_id}s{i}")
+        w.write(C.serialize(C.Subscribe(
+            packet_id=1,
+            subscriptions=[C.Subscription(
+                topic_filter=f"bench/{sub_base + i}/#", qos=0
+            )],
+        ), C.MQTT_V5))
+        await w.drain()
+        while True:
+            data = await r.read(1 << 16)
+            if not data:
+                return
+            for pkt in p.feed(data):
+                if pkt.type == C.SUBACK:
+                    sub_ready[i].set()
+                elif pkt.type == C.PUBLISH:
+                    lat.append(
+                        loop.time()
+                        - struct.unpack_from("d", pkt.payload)[0]
+                    )
+                    received += 1
+                    if received >= total and not all_done.done():
+                        all_done.set_result(None)
+
+    async def publisher(j):
+        r, w, p = await open_conn(f"g{gen_id}p{j}")
+        acked = 0
+        dead = False
+        ev = asyncio.Event()
+
+        async def acks():
+            nonlocal acked, dead
+            while acked < n_msgs:
+                data = await r.read(1 << 16)
+                if not data:
+                    # connection lost: wake the flow-control wait or
+                    # the publisher parks forever
+                    dead = True
+                    ev.set()
+                    return
+                for pkt in p.feed(data):
+                    if pkt.type == C.PUBACK:
+                        acked += 1
+                        ev.set()
+
+        t = loop.create_task(acks())
+        pid = 0
+        for k in range(n_msgs):
+            i = (j + k * 7) % n_subs
+            pid = (pid % 65535) + 1
+            w.write(C.serialize(C.Publish(
+                topic=f"bench/{sub_base + i}/v",
+                payload=struct.pack("d", loop.time()),
+                qos=1, packet_id=pid,
+            ), C.MQTT_V5))
+            if (k & 31) == 0:
+                await w.drain()
+            while k - acked >= inflight and not dead:
+                ev.clear()
+                await ev.wait()
+            if dead:
+                raise ConnectionError(f"publisher g{gen_id}p{j} lost")
+        await w.drain()
+        await t
+        w.close()
+
+    subs = [asyncio.ensure_future(subscriber(i)) for i in range(n_subs)]
+    await asyncio.gather(*(e.wait() for e in sub_ready))
+    await asyncio.sleep(1.0)  # cross-worker route replication settles
+    t0 = time.perf_counter()
+    await asyncio.gather(*(publisher(j) for j in range(n_pubs)))
+    await asyncio.wait_for(all_done, 180)
+    elapsed = time.perf_counter() - t0
+    for t in subs:
+        t.cancel()
+    import numpy as np
+
+    lat_ms = np.array(lat) * 1e3
+    print(json.dumps({
+        "msgs": total,
+        "elapsed": elapsed,
+        "p50_ms": float(np.percentile(lat_ms, 50)),
+        "p99_ms": float(np.percentile(lat_ms, 99)),
+    }))
+
+
+def main():
+    import signal
+
+    from emqx_tpu.broker.multicore import spawn_workers
+
+    # a SIGTERM (e.g. the parent bench's timeout kill) must still run
+    # the finally that stops the worker pool, or orphans keep the
+    # port and skew the next bench phase
+    signal.signal(signal.SIGTERM,
+                  lambda *_: (_ for _ in ()).throw(KeyboardInterrupt()))
+
+    ncpu = os.cpu_count() or 1
+    # scaling beyond the core count only adds scheduling overhead; the
+    # result records the cpu count so the number is interpretable
+    n_workers = int(os.environ.get(
+        "BENCH_MC_WORKERS", max(2, min(8, ncpu))
+    ))
+    n_gens = int(os.environ.get(
+        "BENCH_MC_GENS", max(2, min(4, ncpu // 2 or 1))
+    ))
+    pubs_per_gen = int(os.environ.get("BENCH_MC_PUBS", 25))
+    subs_per_gen = int(os.environ.get("BENCH_MC_SUBS", 25))
+    msgs = int(os.environ.get("BENCH_MC_MSGS", 400))
+    from emqx_tpu.broker.multicore import free_ports
+
+    port = free_ports(1)[0]
+    env = dict(os.environ)
+    pool = spawn_workers(n_workers, port, bind="127.0.0.1")
+    try:
+        pool.wait_ready(port, timeout=120)
+        time.sleep(2.0)  # cluster mesh settles
+        gens = []
+        for g in range(n_gens):
+            gens.append(subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__),
+                 "--loadgen", str(port), str(g), str(pubs_per_gen),
+                 str(subs_per_gen), str(g * subs_per_gen), str(msgs)],
+                stdout=subprocess.PIPE, text=True, env=env,
+            ))
+        results = []
+        for p in gens:
+            out, _ = p.communicate(timeout=240)
+            results.append(json.loads(out.strip().splitlines()[-1]))
+        total = sum(r["msgs"] for r in results)
+        elapsed = max(r["elapsed"] for r in results)
+        print(json.dumps({
+            "mc_host_cpus": ncpu,
+            "mc_workers": n_workers,
+            "mc_alive": pool.alive(),
+            "mc_loadgens": n_gens,
+            "mc_msgs": total,
+            "mc_msgs_per_s": round(total / elapsed, 1),
+            # worst GEN's percentiles (per-gen distributions are not
+            # merged), named so nobody reads them as a combined p50
+            "mc_delivery_p50_worst_gen_ms": round(max(
+                r["p50_ms"] for r in results), 2),
+            "mc_delivery_p99_worst_gen_ms": round(max(
+                r["p99_ms"] for r in results), 2),
+        }))
+    finally:
+        pool.stop()
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--loadgen":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        _, _, port, gid, pubs, subs, base, msgs = sys.argv
+        asyncio.run(_loadgen(
+            int(port), int(gid), int(pubs), int(subs), int(base),
+            int(msgs), inflight=256,
+        ))
+    else:
+        main()
