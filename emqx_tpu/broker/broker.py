@@ -902,30 +902,44 @@ class PublishBatcher:
         broker: Broker,
         window: float = 0.001,
         batch_max: int = 4096,
+        pipeline_windows: int = 4,
     ) -> None:
         self.broker = broker
         self.window = window
         self.batch_max = batch_max
+        self.pipeline_windows = max(pipeline_windows, 1)
         self._queue: asyncio.Queue = asyncio.Queue()
         self._task: Optional[asyncio.Task] = None
+        self._dispatch_task: Optional[asyncio.Task] = None
+        self._inflight_q: Optional[asyncio.Queue] = None
         # connection read loops pause above the high watermark and
-        # resume below the low one (TCP backpressure; bounds both memory
-        # and queueing delay under a publish flood)
+        # resume below the low one (TCP backpressure; bounds both
+        # memory and queueing delay under a publish flood).  The bound
+        # counts queued messages PLUS the pipelined windows already in
+        # flight — pipelining holds up to pipeline_windows*batch_max
+        # messages outside the queue
         self.high_watermark = batch_max * 2
         self.low_watermark = batch_max // 2
         self._uncongested = asyncio.Event()
         self._uncongested.set()
 
     def depth(self) -> int:
-        return self._queue.qsize()
+        return self._queue.qsize() + self._inflight_msgs()
+
+    def _inflight_msgs(self) -> int:
+        q = self._inflight_q
+        return 0 if q is None else q.qsize() * self.batch_max
+
+    def _depth_below_low(self) -> bool:
+        return self.depth() <= self.low_watermark
 
     def congested(self) -> bool:
-        if self._queue.qsize() >= self.high_watermark:
+        if self.depth() >= self.high_watermark:
             # activate() is a cheap no-op while already active, and an
             # operator-cleared alarm re-raises while congestion persists
             self.broker.alarms.activate(
                 "publish_queue_congested",
-                details={"depth": self._queue.qsize()},
+                details={"depth": self.depth()},
                 message="publish micro-batch queue above high watermark",
             )
             self._uncongested.clear()
@@ -959,34 +973,83 @@ class PublishBatcher:
         self._queue.put_nowait((msg, None))
 
     async def _run(self) -> None:
+        """Collector: fills windows and launches their device match,
+        keeping up to ``pipeline_windows`` kernels in flight so e2e
+        throughput amortizes the host<->device round-trip instead of
+        serializing on it; `_dispatch_loop` consumes results strictly
+        in window order (session/publisher ordering)."""
         loop = asyncio.get_running_loop()
-        while True:
-            batch = [await self._queue.get()]
-            deadline = loop.time() + self.window
-            while len(batch) < self.batch_max:
-                if not self._queue.empty():
-                    batch.append(self._queue.get_nowait())
-                    continue
-                timeout = deadline - loop.time()
-                if timeout <= 0:
-                    break
+        inflight: asyncio.Queue = asyncio.Queue(
+            maxsize=self.pipeline_windows
+        )
+        self._inflight_q = inflight
+        self._dispatch_task = loop.create_task(
+            self._dispatch_loop(inflight)
+        )
+        try:
+            while True:
+                batch = [await self._queue.get()]
+                deadline = loop.time() + self.window
+                while len(batch) < self.batch_max:
+                    if not self._queue.empty():
+                        batch.append(self._queue.get_nowait())
+                        continue
+                    timeout = deadline - loop.time()
+                    if timeout <= 0:
+                        break
+                    try:
+                        batch.append(
+                            await asyncio.wait_for(
+                                self._queue.get(), timeout
+                            )
+                        )
+                    except asyncio.TimeoutError:
+                        break
+                msgs = [m for m, _ in batch]
                 try:
-                    batch.append(
-                        await asyncio.wait_for(self._queue.get(), timeout)
+                    # hooks/retain/persist mutate broker state: loop
+                    # thread only, and in window order
+                    live, results = self.broker.publish_prepare(msgs)
+                    match_fut = loop.run_in_executor(
+                        None, self.broker.publish_match, live
                     )
-                except asyncio.TimeoutError:
-                    break
-            msgs = [m for m, _ in batch]
+                except Exception as exc:
+                    for _, fut in batch:
+                        if fut is not None and not fut.done():
+                            fut.set_exception(exc)
+                    log.exception(
+                        "publish window of %d failed in prepare",
+                        len(batch),
+                    )
+                    continue
+                # blocks when pipeline_windows are already in flight —
+                # natural backpressure onto the collector
+                await inflight.put((batch, live, results, match_fut))
+        finally:
+            self._dispatch_task.cancel()
             try:
-                # hooks/retain/persist + dispatch mutate broker state and
-                # write to connection transports: loop thread only.  The
-                # match stage is the device round-trip — run it in the
-                # default executor so the loop keeps reading sockets
-                # (accumulating the next window) while the kernel runs.
-                live, results = self.broker.publish_prepare(msgs)
-                matched, remote = await loop.run_in_executor(
-                    None, self.broker.publish_match, live
-                )
+                await self._dispatch_task
+            except asyncio.CancelledError:
+                pass
+            self._dispatch_task = None
+            # fail the futures of windows abandoned in flight: their
+            # callers (mgmt publish, QoS ack callbacks) must not hang
+            # past shutdown
+            exc = ConnectionError("broker stopping")
+            while not inflight.empty():
+                batch, _live, _res, match_fut = inflight.get_nowait()
+                match_fut.cancel()
+                for _, fut in batch:
+                    if fut is not None and not fut.done():
+                        fut.set_exception(exc)
+            self._inflight_q = None
+
+    async def _dispatch_loop(self, inflight: asyncio.Queue) -> None:
+        while True:
+            batch, live, results, match_fut = await inflight.get()
+            counts = None
+            try:
+                matched, remote = await match_fut
                 counts = self.broker.publish_dispatch(
                     live, matched, remote, results
                 )
@@ -1011,18 +1074,31 @@ class PublishBatcher:
                             if attempt == 9:
                                 raise
                             await asyncio.sleep(0.2)
+            except asyncio.CancelledError:
+                raise
             except Exception as exc:  # resolve futures either way
                 log.exception("publish window of %d failed", len(batch))
                 for _, fut in batch:
                     if fut is not None and not fut.done():
                         fut.set_exception(exc)
                 continue
-            for (_, fut), n in zip(batch, counts):
-                if fut is not None and not fut.done():
-                    fut.set_result(n)
-            if (
-                not self._uncongested.is_set()
-                and self._queue.qsize() <= self.low_watermark
-            ):
-                self._uncongested.set()
-                self.broker.alarms.deactivate("publish_queue_congested")
+            # the tail is protected too: an exception here (e.g. the
+            # alarm deactivation re-entering publish) must not kill
+            # this task — a dead dispatcher fills the inflight queue
+            # and wedges ALL publishing silently
+            try:
+                for (_, fut), n in zip(batch, counts):
+                    if fut is not None and not fut.done():
+                        fut.set_result(n)
+                if (
+                    not self._uncongested.is_set()
+                    and self._depth_below_low()
+                ):
+                    self._uncongested.set()
+                    self.broker.alarms.deactivate(
+                        "publish_queue_congested"
+                    )
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("publish window post-dispatch failed")

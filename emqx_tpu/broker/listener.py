@@ -179,6 +179,7 @@ class BrokerServer:
                 self.broker,
                 window=eng_cfg.batch_window_ms / 1000.0,
                 batch_max=eng_cfg.batch_max,
+                pipeline_windows=eng_cfg.pipeline_windows,
             )
             await self.broker.batcher.start()
         cfg = self.broker.config

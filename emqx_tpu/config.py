@@ -93,6 +93,11 @@ class BrokerEngineConfig:
     batch_publish: bool = True  # route live publishes via PublishBatcher
     batch_window_ms: float = 1.0  # micro-batch accumulation window
     batch_max: int = 4096
+    # windows matched concurrently on the device: the collector keeps
+    # filling window N+1..N+k while window N's kernel runs, so e2e
+    # throughput stops serializing on the host<->device round-trip
+    # (dispatch stays strictly in window order)
+    pipeline_windows: int = 4
 
 
 @dataclass
