@@ -91,6 +91,11 @@ def gather_state(server) -> Tuple[Dict[str, bytes], Dict]:
         members["mgmt/api_keys.json"] = json.dumps(
             api.auth.api_keys
         ).encode()
+    from .schema_registry import global_registry
+
+    members["schemas.json"] = json.dumps(
+        global_registry().dump()
+    ).encode()
 
     manifest = {
         "version": FORMAT_VERSION,
@@ -296,6 +301,27 @@ def apply_state(server, members: Dict[str, bytes],
             api.auth.api_keys.update(imported)
             api.auth._save(api.auth._keys_path, api.auth.api_keys)
             report["restored"]["api_keys"] = len(imported)
+
+    # --- schema registry
+    schemas_raw = read("schemas.json")
+    if schemas_raw is not None:
+        from .schema_registry import global_registry
+
+        try:
+            entries = json.loads(schemas_raw)
+        except (ValueError, UnicodeDecodeError) as exc:
+            report["errors"].append(f"schemas.json: {exc}")
+            entries = {}
+        n = 0
+        for name, entry in entries.items():
+            try:
+                global_registry().add(
+                    name, entry["type"], entry["source"]
+                )
+                n += 1
+            except Exception as exc:
+                report["errors"].append(f"schema {name}: {exc}")
+        report["restored"]["schemas"] = n
 
     log.info("import done: %s", report)
     return report

@@ -84,7 +84,8 @@ class Broker:
         from ..rules.engine import RuleEngine
 
         self.rules = RuleEngine(broker=self)
-        self.resources = ResourceManager()
+        self.resources = ResourceManager()  # alarms wired below (init
+        # order: the AlarmRegistry is constructed a few lines down)
         # Aggregators attached by rules/bridges (emqx_connector_
         # aggregator buffers): ticked by the server's 1 Hz housekeeping
         self.aggregators: List = []
@@ -104,6 +105,7 @@ class Broker:
 
         self.trace = TraceManager(self)
         self.alarms = AlarmRegistry(self)
+        self.resources.alarms = self.alarms
         self.banned = BannedList()
         fl = self.config.flapping
         self.flapping = FlappingDetector(

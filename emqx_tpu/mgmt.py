@@ -56,6 +56,13 @@ class MgmtApi:
         # audit trail of mutating API calls (emqx_audit's role),
         # persisted across restarts, surfaced at /api/v5/audit
         self.audit = AuditLog(cfg.data_dir)
+        # schema registry persistence: REST-registered schemas reload
+        # on restart (rules reference them by name)
+        from .schema_registry import global_registry
+
+        global_registry().load(
+            os.path.join(cfg.data_dir, "schemas.json")
+        )
         # failed-login throttle: remote -> recent failure monotonics
         self._login_failures: dict = {}
 
@@ -168,6 +175,9 @@ class MgmtApi:
         r.add_post("/api/v5/data/export", self.post_export)
         r.add_get("/api/v5/data/export/{name}", self.get_export_file)
         r.add_post("/api/v5/data/import", self.post_import)
+        r.add_get("/api/v5/schema_registry", self.get_schemas)
+        r.add_post("/api/v5/schema_registry", self.post_schema)
+        r.add_delete("/api/v5/schema_registry/{name}", self.delete_schema)
         r.add_get("/api/v5/gateways", self.get_gateways)
         r.add_get("/api/v5/plugins", self.get_plugins)
         r.add_get("/", self.dashboard)
@@ -628,6 +638,37 @@ class MgmtApi:
                          status=400)
         report = await apply_state_async(self.server, members)
         return _json(report)
+
+    async def get_schemas(self, request: web.Request) -> web.Response:
+        from .schema_registry import global_registry
+
+        return _json({"data": global_registry().info()})
+
+    async def post_schema(self, request: web.Request) -> web.Response:
+        import asyncio
+
+        from .schema_registry import global_registry
+
+        try:
+            body = await request.json()
+            # protobuf registration shells out to protoc: keep that
+            # (and the temp-file IO) off the event loop
+            await asyncio.get_running_loop().run_in_executor(
+                None, global_registry().add,
+                body["name"], body["type"], body["source"],
+            )
+        except (KeyError, ValueError, TypeError, OSError,
+                json.JSONDecodeError) as exc:
+            return _json({"code": "BAD_REQUEST", "message": str(exc)},
+                         status=400)
+        return _json({"name": body["name"], "type": body["type"]},
+                     status=201)
+
+    async def delete_schema(self, request: web.Request) -> web.Response:
+        from .schema_registry import global_registry
+
+        ok = global_registry().remove(request.match_info["name"])
+        return web.Response(status=204 if ok else 404)
 
     async def get_gateways(self, request: web.Request) -> web.Response:
         return _json({"data": self.broker.gateways.info()})

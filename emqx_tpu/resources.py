@@ -149,6 +149,29 @@ class BufferWorker:
         except Exception:
             return False
 
+    # alarm hook, wired by the ResourceManager when a broker owns this
+    # worker (the reference raises resource_down alarms the same way)
+    on_status_alarm = None
+
+    def _alarm(self, down: bool) -> None:
+        if self.on_status_alarm is not None:
+            try:
+                self.on_status_alarm(down)
+            except Exception:
+                pass
+
+    def _set_status(self, new: str) -> None:
+        """EVERY status flip goes through here so the alarm fires on
+        the drain path too (a sink failing under sustained traffic
+        never reaches the idle probe)."""
+        if new == self.status:
+            return
+        self.status = new
+        if new == DISCONNECTED:
+            self._alarm(True)
+        elif new == CONNECTED:
+            self._alarm(False)
+
     # --------------------------------------------------------- intake
 
     def enqueue(self, query: Any) -> bool:
@@ -180,21 +203,28 @@ class BufferWorker:
                         self._wake.wait(), self.health_interval
                     )
                 except asyncio.TimeoutError:
-                    if self.status != CONNECTED and await self._health():
-                        self.status = CONNECTED
+                    # periodic probe in EVERY state (the reference's
+                    # resource manager health-checks while connected
+                    # too — a silently dead sink must flip to
+                    # disconnected before traffic piles into it, not
+                    # when the next query fails)
+                    healthy = await self._health()
+                    self._set_status(
+                        CONNECTED if healthy else DISCONNECTED
+                    )
                     continue
             query = self._buf[0]  # keep at head until delivered
             try:
                 await self.resource.on_query(query)
                 self._buf.popleft()
                 self.stats["success"] += 1
-                self.status = CONNECTED
+                self._set_status(CONNECTED)
                 backoff = self.retry_base
                 retries = 0
             except asyncio.CancelledError:
                 raise
             except Exception as exc:
-                self.status = DISCONNECTED
+                self._set_status(DISCONNECTED)
                 self.stats["retried"] += 1
                 retries += 1
                 if (
@@ -219,14 +249,26 @@ class ResourceManager:
     """Registry of named resources and their buffer workers
     (emqx_resource_manager's lifecycle role)."""
 
-    def __init__(self) -> None:
+    def __init__(self, alarms=None) -> None:
         self._workers: Dict[str, BufferWorker] = {}
+        self.alarms = alarms  # broker AlarmRegistry (optional)
 
     async def create(
         self, resource_id: str, resource: Resource, **worker_kw
     ) -> BufferWorker:
         await self.remove(resource_id)
         worker = BufferWorker(resource, **worker_kw)
+        if self.alarms is not None:
+            def status_alarm(down: bool, rid=resource_id):
+                if down:
+                    self.alarms.activate(
+                        f"resource_down:{rid}",
+                        details={"resource": rid},
+                        message=f"resource {rid} health check failing",
+                    )
+                else:
+                    self.alarms.deactivate(f"resource_down:{rid}")
+            worker.on_status_alarm = status_alarm
         await worker.start()
         self._workers[resource_id] = worker
         return worker
@@ -238,6 +280,8 @@ class ResourceManager:
         worker = self._workers.pop(resource_id, None)
         if worker is None:
             return False
+        # a deleted resource must not leave its down-alarm behind
+        worker._alarm(False)
         await worker.stop()
         return True
 

@@ -337,6 +337,33 @@ def _is_array(x):
 # -------------------------------------------------------- json / encoding
 
 
+@_register("schema_decode")
+def _schema_decode(name, payload, message_type=None):
+    """Decode a payload against a registered schema
+    (emqx_rule_funcs:schema_decode — avro/protobuf/json by name)."""
+    from ..schema_registry import global_registry
+
+    if isinstance(payload, str):
+        payload = payload.encode()
+    return global_registry().decode(name, payload, message_type)
+
+
+@_register("schema_encode")
+def _schema_encode(name, value, message_type=None):
+    from ..schema_registry import global_registry
+
+    return global_registry().encode(name, value, message_type)
+
+
+@_register("schema_check")
+def _schema_check(name, payload):
+    from ..schema_registry import global_registry
+
+    if isinstance(payload, str):
+        payload = payload.encode()
+    return global_registry().check(name, payload)
+
+
 @_register("json_decode")
 def _json_decode(s):
     if isinstance(s, bytes):
