@@ -116,15 +116,26 @@ class Broker:
             enable=fl.enable,
         )
         self.slow_subs = SlowSubs()
+        # node/zone-aggregate ingress limiter (top of the hierarchy)
+        self.zone_limiter = None
+        zm = self.config.mqtt.zone_messages_rate
+        zb = self.config.mqtt.zone_bytes_rate
+        if zm > 0 or zb > 0:
+            from ..limiter import ConnectionLimiter
+
+            self.zone_limiter = ConnectionLimiter(
+                messages_rate=zm, bytes_rate=zb
+            )
         from ..gateway import GatewayRegistry
 
         self.gateways = GatewayRegistry(self)
         from ..payload_pipeline import PayloadPipeline
 
         self.pipeline = PayloadPipeline(self)
-        from ..rebalance import EvictionAgent
+        from ..rebalance import EvictionAgent, RebalanceCoordinator
 
         self.eviction = EvictionAgent(self)
+        self.rebalance = RebalanceCoordinator(self)
         from ..plugins import PluginManager
 
         self.plugins = PluginManager(self, directory=self.config.plugin_dir)

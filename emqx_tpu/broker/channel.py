@@ -252,9 +252,12 @@ class Channel:
             self._connack_error(RC_BAD_CLIENTID)
             return
 
-        if self.broker.eviction.status in ("evacuating", "evacuated"):
+        if (self.broker.eviction.status in ("evacuating", "evacuated")
+                or self.broker.rebalance.shedding):
             # a draining node refuses new sessions so clients land on a
-            # peer (the reference eviction agent's connect rejection)
+            # peer (the reference eviction agent's connect rejection);
+            # a rebalance donor refuses too, else shed clients bounce
+            # straight back through the load balancer
             m.inc("client.evacuation_refused")
             self._connack_error(RC_SERVER_BUSY if self.version < C.MQTT_V5
                                 else 0x9C)

@@ -30,6 +30,16 @@ class Listener:
         self.cfg = cfg
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set = set()
+        # listener-aggregate buckets shared by ALL this listener's
+        # connections (the hierarchical limiter's middle level)
+        self._shared_limiter = None
+        if cfg.max_messages_rate > 0 or cfg.max_bytes_rate > 0:
+            from ..limiter import ConnectionLimiter
+
+            self._shared_limiter = ConnectionLimiter(
+                messages_rate=cfg.max_messages_rate,
+                bytes_rate=cfg.max_bytes_rate,
+            )
 
     @property
     def port(self) -> int:
@@ -39,14 +49,18 @@ class Listener:
         return self._server.sockets[0].getsockname()[1]
 
     def _make_limiter(self):
-        if self.cfg.messages_rate <= 0 and self.cfg.bytes_rate <= 0:
-            return None
-        from ..limiter import ConnectionLimiter
+        from ..limiter import ConnectionLimiter, HierarchicalLimiter
 
-        return ConnectionLimiter(
-            messages_rate=self.cfg.messages_rate,
-            bytes_rate=self.cfg.bytes_rate,
-        )
+        conn = None
+        if self.cfg.messages_rate > 0 or self.cfg.bytes_rate > 0:
+            conn = ConnectionLimiter(
+                messages_rate=self.cfg.messages_rate,
+                bytes_rate=self.cfg.bytes_rate,
+            )
+        zone = getattr(self.broker, "zone_limiter", None)
+        if self._shared_limiter is None and zone is None:
+            return conn  # single level: no wrapper indirection
+        return HierarchicalLimiter(conn, self._shared_limiter, zone)
 
     def _ssl_context(self):
         import ssl as ssl_mod

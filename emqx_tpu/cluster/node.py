@@ -195,6 +195,8 @@ class ClusterNode:
         self.transport.on("forward_sync", self._handle_forward_sync,
                           concurrent=True)
         self.transport.on("heartbeat", self._handle_heartbeat)
+        self.transport.on("conn_count", self._handle_conn_count)
+        self.transport.on("rebalance_shed", self._handle_rebalance_shed)
         self.transport.on("sync", self._handle_sync)
 
         # wire into the broker: route-change notifications + forward
@@ -1109,6 +1111,19 @@ class ClusterNode:
         if came_back:
             log.info("%s: node %s is back, resyncing routes", self.name, node)
             await self._sync_with(node)
+
+    async def _handle_conn_count(self, peer: str, obj: Dict) -> Dict:
+        """Live connection census for the rebalance planner."""
+        cm = self.broker.cm
+        return {"count": sum(
+            1 for cid in cm.clients() if cm.connected(cid)
+        )}
+
+    async def _handle_rebalance_shed(self, peer: str, obj: Dict) -> None:
+        """A coordinator asked this donor to shed its excess."""
+        self.broker.rebalance.start_shed(
+            int(obj.get("count", 0)), int(obj.get("rate", 50))
+        )
 
     def _mark_alive(self, node: str) -> None:
         self._last_seen[node] = time.monotonic()

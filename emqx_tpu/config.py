@@ -20,6 +20,10 @@ class MqttConfig:
     max_packet_size: int = 1024 * 1024
     max_clientid_len: int = 65535
     max_topic_levels: int = 128
+    # NODE-aggregate ingress limits shared by every connection of
+    # every listener (the hierarchical limiter's zone level); 0 = off
+    zone_messages_rate: float = 0.0
+    zone_bytes_rate: float = 0.0
     max_qos_allowed: int = 2
     max_topic_alias: int = 65535
     retain_available: bool = True
@@ -62,6 +66,10 @@ class ListenerConfig:
     # per-connection rate limits (emqx_limiter); 0 = unlimited
     messages_rate: float = 0.0  # PUBLISH packets per second
     bytes_rate: float = 0.0  # inbound bytes per second
+    # listener-AGGREGATE limits shared by all its connections
+    # (the hierarchical limiter's listener level); 0 = unlimited
+    max_messages_rate: float = 0.0
+    max_bytes_rate: float = 0.0
 
 
 @dataclass

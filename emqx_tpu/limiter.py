@@ -1,12 +1,15 @@
-"""Rate limiting: token buckets on the connection read path.
+"""Rate limiting: hierarchical token buckets on the connection path.
 
 The `emqx_limiter` role (/root/reference/apps/emqx/src/emqx_limiter/,
-hierarchical token buckets integrated with esockd's activation):
-per-connection buckets for message and byte rates; an exhausted bucket
-PAUSES the read loop (TCP backpressure throttles the client) instead of
-disconnecting, exactly like the reference hibernating the socket.
-Global overload shedding is the PublishBatcher watermark (broker.py) —
-together they bound both ingress rate and queued volume.
+13 modules of hierarchical token buckets integrated with esockd's
+activation): a connection draws from up to THREE levels — its own
+buckets, the listener's SHARED buckets (all connections of one
+listener compete for the aggregate rate), and the node/zone's shared
+buckets.  An exhausted bucket at any level PAUSES the read loop (TCP
+backpressure throttles the client) instead of disconnecting, exactly
+like the reference hibernating the socket.  Global overload shedding
+is the PublishBatcher watermark (broker.py) — together they bound
+ingress rate per client, per listener, per node, and queued volume.
 """
 
 from __future__ import annotations
@@ -70,3 +73,20 @@ class ConnectionLimiter:
         if self.msg_bucket is not None and n_messages:
             delay = max(delay, self.msg_bucket.consume(n_messages, now))
         return delay
+
+
+class HierarchicalLimiter:
+    """One connection's view of the limiter tree: its private buckets
+    plus any SHARED levels (listener aggregate, node/zone aggregate —
+    plain `ConnectionLimiter`s consumed by every connection of the
+    scope).  The pause owed is the max deficit across levels, so the
+    tightest bound wins (emqx_htb_limiter's semantics, flattened)."""
+
+    def __init__(self, *levels) -> None:
+        self.levels = [lv for lv in levels if lv is not None]
+
+    def consume(self, n_bytes: int, n_messages: int) -> float:
+        return max(
+            (lv.consume(n_bytes, n_messages) for lv in self.levels),
+            default=0.0,
+        )

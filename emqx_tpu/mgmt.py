@@ -188,6 +188,10 @@ class MgmtApi:
         r.add_post(
             "/api/v5/load_rebalance/evacuation/stop", self.stop_evacuation
         )
+        r.add_post(
+            "/api/v5/load_rebalance/start", self.start_rebalance
+        )
+        r.add_post("/api/v5/load_rebalance/stop", self.stop_rebalance)
         r.add_get("/api/v5/load_rebalance/status", self.rebalance_status)
         r.add_get("/metrics", self.prometheus)
         app.middlewares.append(self._auth_middleware)
@@ -744,8 +748,30 @@ class MgmtApi:
         await self.broker.eviction.stop_evacuation()
         return _json(self.broker.eviction.info())
 
+    async def start_rebalance(self, request: web.Request) -> web.Response:
+        """Cluster-wide balance (POST /load_rebalance/start): plan
+        donors from live connection counts and shed their excess."""
+        try:
+            body = await request.json() if request.can_read_body else {}
+        except json.JSONDecodeError:
+            body = {}
+        await self.broker.rebalance.start(
+            conn_evict_rate=int(body.get("conn_evict_rate", 50)),
+            rel_conn_threshold=float(
+                body.get("rel_conn_threshold", 1.10)
+            ),
+        )
+        return _json(self.broker.rebalance.info())
+
+    async def stop_rebalance(self, request: web.Request) -> web.Response:
+        await self.broker.rebalance.stop()
+        return _json(self.broker.rebalance.info())
+
     async def rebalance_status(self, request: web.Request) -> web.Response:
-        return _json(self.broker.eviction.info())
+        return _json({
+            "evacuation": self.broker.eviction.info(),
+            "rebalance": self.broker.rebalance.info(),
+        })
 
     # ------------------------------------------------------ prometheus
 

@@ -20,6 +20,24 @@ log = logging.getLogger("emqx_tpu.rebalance")
 RC_USE_ANOTHER_SERVER = 0x9C
 
 
+def _evict_batch(broker, cids) -> int:
+    """Close each client with USE_ANOTHER_SERVER semantics; returns
+    how many were actually closed (shared by evacuation + rebalance)."""
+    n = 0
+    for cid in cids:
+        channel = broker.cm.channel(cid)
+        if channel is not None:
+            channel.close("evacuated")
+            n += 1
+            broker.metrics.inc("client.evicted")
+    return n
+
+
+def _connected(broker) -> list:
+    cm = broker.cm
+    return [cid for cid in cm.clients() if cm.connected(cid)]
+
+
 class EvictionAgent:
     def __init__(self, broker) -> None:
         self.broker = broker
@@ -57,20 +75,14 @@ class EvictionAgent:
         self.broker.alarms.deactivate("node_evacuating")
 
     async def _run(self, rate: int) -> None:
-        cm = self.broker.cm
         while True:
-            connected = [cid for cid in cm.clients() if cm.connected(cid)]
+            connected = _connected(self.broker)
             if not connected:
                 self.status = "evacuated"
                 self.broker.alarms.deactivate("node_evacuating")
                 log.info("evacuation complete: %d evicted", self.evicted)
                 return
-            for cid in connected[:rate]:
-                channel = cm.channel(cid)
-                if channel is not None:
-                    channel.close("evacuated")
-                    self.evicted += 1
-                    self.broker.metrics.inc("client.evicted")
+            self.evicted += _evict_batch(self.broker, connected[:rate])
             await asyncio.sleep(1.0)
 
     def info(self) -> dict:
@@ -83,4 +95,134 @@ class EvictionAgent:
                 for cid in self.broker.cm.clients()
                 if self.broker.cm.connected(cid)
             ),
+        }
+
+
+def plan_rebalance(
+    conn_counts: dict, threshold: float = 1.10
+) -> dict:
+    """The balance PLANNER (emqx_node_rebalance.erl donor/recipient
+    split): nodes above ``avg * threshold`` are donors and shed down
+    to the average; nodes below are recipients.  Returns
+    {"avg", "donors": {node: n_to_evict}, "recipients": [...]} —
+    empty donors = already balanced."""
+    if not conn_counts:
+        return {"avg": 0, "donors": {}, "recipients": []}
+    avg = sum(conn_counts.values()) / len(conn_counts)
+    donors = {
+        node: int(count - avg)
+        for node, count in conn_counts.items()
+        if count > avg * threshold and int(count - avg) > 0
+    }
+    recipients = sorted(
+        node for node, count in conn_counts.items()
+        if count <= avg * threshold
+    )
+    return {"avg": avg, "donors": donors, "recipients": recipients}
+
+
+class RebalanceCoordinator:
+    """Cluster-wide rebalance (emqx_node_rebalance.erl): gather every
+    node's connection count, compute the donor plan, and drive each
+    donor's eviction agent for its excess at a bounded rate.  Evicted
+    v5 clients get USE_ANOTHER_SERVER; a fronting load balancer (or
+    the multicore pool's shared socket) lands the reconnect on a less
+    loaded node, where takeover migrates the session."""
+
+    def __init__(self, broker) -> None:
+        self.broker = broker
+        self.status = "idle"
+        self.plan: Optional[dict] = None
+        self._task: Optional[asyncio.Task] = None
+
+    @property
+    def shedding(self) -> bool:
+        """True while this node is actively evicting its excess — the
+        connect path refuses new sessions then, so shed clients land
+        on a recipient instead of bouncing back to the donor."""
+        return self._task is not None and not self._task.done()
+
+    async def _conn_counts(self) -> dict:
+        ext = self.broker.external
+        counts = {
+            getattr(ext, "name", "local"): len(_connected(self.broker))
+        }
+        peers = ext.peers_alive() if ext is not None else []
+        replies = await asyncio.gather(
+            *(ext.transport.call(p, {"type": "conn_count"}, timeout=2.0)
+              for p in peers),
+            return_exceptions=True,
+        )
+        for peer, reply in zip(peers, replies):
+            if isinstance(reply, dict):
+                counts[peer] = int(reply.get("count", 0))
+        return counts
+
+    async def start(
+        self,
+        conn_evict_rate: int = 50,
+        rel_conn_threshold: float = 1.10,
+    ) -> dict:
+        """Compute the plan, start shedding this node's share, and ask
+        remote donors to shed theirs (any node can coordinate)."""
+        if self.shedding:
+            return self.plan or {}
+        counts = await self._conn_counts()
+        self.plan = plan_rebalance(counts, rel_conn_threshold)
+        ext = self.broker.external
+        me = getattr(ext, "name", "local")
+        if ext is not None:
+            for node, n in self.plan["donors"].items():
+                if node != me:
+                    await ext.transport.cast(node, {
+                        "type": "rebalance_shed",
+                        "count": n,
+                        "rate": conn_evict_rate,
+                    })
+        excess = self.plan["donors"].get(me, 0)
+        if excess > 0:
+            self.start_shed(excess, conn_evict_rate)
+        else:
+            # nothing to shed locally; remote donors report their own
+            # status — this coordinator is done
+            self.status = "balanced"
+        return self.plan
+
+    def start_shed(self, count: int, rate: int) -> None:
+        """Begin evicting `count` local connections at `rate`/s (local
+        donor share, or a remote coordinator's request)."""
+        if self.shedding or count <= 0:
+            return
+        self.status = "rebalancing"
+        self._task = asyncio.get_running_loop().create_task(
+            self._shed(count, max(rate, 1))
+        )
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        self.status = "idle"
+
+    async def _shed(self, excess: int, rate: int) -> None:
+        shed = 0
+        while shed < excess:
+            connected = _connected(self.broker)
+            if not connected:
+                break
+            shed += _evict_batch(
+                self.broker, connected[: min(rate, excess - shed)]
+            )
+            await asyncio.sleep(1.0)
+        self.status = "balanced"
+        log.info("rebalance shed %d connections", shed)
+
+    def info(self) -> dict:
+        return {
+            "status": self.status,
+            "plan": self.plan,
         }

@@ -58,6 +58,114 @@ def test_evacuation_drains_and_signals_clients():
     run(t())
 
 
+def test_plan_rebalance_donors_and_recipients():
+    from emqx_tpu.rebalance import plan_rebalance
+
+    plan = plan_rebalance({"a": 90, "b": 10, "c": 20})
+    assert plan["avg"] == 40
+    assert plan["donors"] == {"a": 50}
+    assert plan["recipients"] == ["b", "c"]
+    # balanced cluster -> no donors
+    assert plan_rebalance({"a": 10, "b": 10})["donors"] == {}
+    assert plan_rebalance({})["donors"] == {}
+    # threshold guards small skews
+    assert plan_rebalance({"a": 11, "b": 10}, threshold=1.2)["donors"] == {}
+
+
+def test_cluster_rebalance_sheds_overloaded_node():
+    async def t():
+        async def start_node(name, seeds=()):
+            cfg = BrokerConfig()
+            cfg.listeners = [ListenerConfig(port=0)]
+            srv = BrokerServer(cfg)
+            await srv.start()
+            node = ClusterNode(name, srv.broker, **FAST)
+            await node.start(seeds=list(seeds))
+            return srv, node
+
+        srv_a, a = await start_node("a")
+        srv_b, b = await start_node("b", seeds=[("a", "127.0.0.1", a.port)])
+        await asyncio.sleep(0.3)
+
+        # 8 connections on A, none on B: A is the donor
+        clients = [TestClient(srv_a.listeners[0].port, f"rb-{i}")
+                   for i in range(8)]
+        for c in clients:
+            await c.connect()
+
+        plan = await srv_a.broker.rebalance.start(
+            conn_evict_rate=100, rel_conn_threshold=1.05
+        )
+        assert plan["donors"].get("a", 0) >= 3  # shed down toward avg=4
+        assert "b" in plan["recipients"]
+
+        for _ in range(100):
+            info = srv_a.broker.rebalance.info()
+            if info["status"] == "balanced":
+                break
+            await asyncio.sleep(0.05)
+        live = sum(1 for c in srv_a.broker.cm.clients()
+                   if srv_a.broker.cm.connected(c))
+        assert live <= 8 - plan["donors"]["a"]
+
+        for c in clients:
+            await c.close()
+        await b.stop()
+        await srv_b.stop()
+        await a.stop()
+        await srv_a.stop()
+
+    run(t())
+
+
+def test_rebalance_remote_donor_shed_via_cast():
+    """The coordinator on a balanced node still drives a remote donor."""
+
+    async def t():
+        async def start_node(name, seeds=()):
+            cfg = BrokerConfig()
+            cfg.listeners = [ListenerConfig(port=0)]
+            srv = BrokerServer(cfg)
+            await srv.start()
+            node = ClusterNode(name, srv.broker, **FAST)
+            await node.start(seeds=list(seeds))
+            return srv, node
+
+        srv_a, a = await start_node("a")
+        srv_b, b = await start_node("b", seeds=[("a", "127.0.0.1", a.port)])
+        await asyncio.sleep(0.3)
+
+        clients = [TestClient(srv_a.listeners[0].port, f"rr-{i}")
+                   for i in range(6)]
+        for c in clients:
+            await c.connect()
+
+        # start from B (a recipient): it must tell A to shed remotely
+        plan = await srv_b.broker.rebalance.start(
+            conn_evict_rate=100, rel_conn_threshold=1.05
+        )
+        assert plan["donors"].get("a", 0) >= 2
+
+        for _ in range(100):
+            live = sum(1 for c in srv_a.broker.cm.clients()
+                       if srv_a.broker.cm.connected(c))
+            if live <= 6 - plan["donors"]["a"]:
+                break
+            await asyncio.sleep(0.05)
+        live = sum(1 for c in srv_a.broker.cm.clients()
+                   if srv_a.broker.cm.connected(c))
+        assert live <= 6 - plan["donors"]["a"]
+
+        for c in clients:
+            await c.close()
+        await b.stop()
+        await srv_b.stop()
+        await a.stop()
+        await srv_a.stop()
+
+    run(t())
+
+
 def test_evacuated_client_migrates_to_peer():
     async def t():
         async def start_node(name, seeds=()):
