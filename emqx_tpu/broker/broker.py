@@ -124,7 +124,7 @@ class Broker:
             from ..limiter import ConnectionLimiter
 
             self.zone_limiter = ConnectionLimiter(
-                messages_rate=zm, bytes_rate=zb
+                messages_rate=zm, bytes_rate=zb, shared=True
             )
         from ..gateway import GatewayRegistry
 
@@ -132,10 +132,13 @@ class Broker:
         from ..payload_pipeline import PayloadPipeline
 
         self.pipeline = PayloadPipeline(self)
-        from ..rebalance import EvictionAgent, RebalanceCoordinator
+        from ..rebalance import (
+            EvictionAgent, PurgeAgent, RebalanceCoordinator,
+        )
 
         self.eviction = EvictionAgent(self)
         self.rebalance = RebalanceCoordinator(self)
+        self.purger = PurgeAgent(self)
         from ..plugins import PluginManager
 
         self.plugins = PluginManager(self, directory=self.config.plugin_dir)

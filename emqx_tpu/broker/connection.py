@@ -99,12 +99,15 @@ class Connection:
                     # The pause throttles processing (and the client,
                     # via the unread socket) without disconnecting —
                     # the reference hibernates the socket the same way.
-                    # Sleeps are capped per packet so control packets
-                    # are still handled within ~1 s.
+                    # The FULL deficit is slept (in 1s slices so close
+                    # stays responsive): shared listener/zone buckets
+                    # hand out long waits under contention and cutting
+                    # them short would let the aggregate rate scale
+                    # with the number of connections.
                     delay = self.limiter.consume(len(data), 0)
                     if delay > 0:
                         self.broker.metrics.inc("connection.rate_limited")
-                        await asyncio.sleep(min(delay, 1.0))
+                        await self._pause(delay)
                     for pkt in self.parser.feed(data):
                         if pkt.type == C.PUBLISH:
                             delay = self.limiter.consume(0, 1)
@@ -112,7 +115,7 @@ class Connection:
                                 self.broker.metrics.inc(
                                     "connection.rate_limited"
                                 )
-                                await asyncio.sleep(min(delay, 1.0))
+                                await self._pause(delay)
                         self.channel.handle_in(pkt)
                         if self._closed.is_set():
                             break
@@ -140,6 +143,15 @@ class Connection:
                 await self.writer.wait_closed()
             except (ConnectionError, asyncio.CancelledError):
                 pass
+
+    async def _pause(self, delay: float) -> None:
+        """Sleep a limiter deficit in 1s slices, bailing early when
+        the connection is closed (kick/stop must not wait out a long
+        shared-bucket debt)."""
+        while delay > 0 and not self._closed.is_set():
+            step = min(delay, 1.0)
+            await asyncio.sleep(step)
+            delay -= step
 
     async def _drain(self) -> None:
         try:

@@ -39,6 +39,7 @@ class Listener:
             self._shared_limiter = ConnectionLimiter(
                 messages_rate=cfg.max_messages_rate,
                 bytes_rate=cfg.max_bytes_rate,
+                shared=True,
             )
 
     @property
@@ -380,6 +381,11 @@ class BrokerServer:
                     )
 
     async def stop(self) -> None:
+        # elastic-ops agents first: their loops kick sessions and must
+        # not keep firing against a half-torn-down broker
+        await self.broker.eviction.stop_evacuation()
+        await self.broker.rebalance.stop()
+        await self.broker.purger.stop_purge()
         if self._housekeeper is not None:
             self._housekeeper.cancel()
             try:

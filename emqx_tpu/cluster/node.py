@@ -197,6 +197,7 @@ class ClusterNode:
         self.transport.on("heartbeat", self._handle_heartbeat)
         self.transport.on("conn_count", self._handle_conn_count)
         self.transport.on("rebalance_shed", self._handle_rebalance_shed)
+        self.transport.on("session_purge", self._handle_session_purge)
         self.transport.on("sync", self._handle_sync)
 
         # wire into the broker: route-change notifications + forward
@@ -1120,10 +1121,26 @@ class ClusterNode:
         )}
 
     async def _handle_rebalance_shed(self, peer: str, obj: Dict) -> None:
-        """A coordinator asked this donor to shed its excess."""
+        """A coordinator asked this donor to shed its excess (or to
+        stop a shed it started earlier)."""
+        if obj.get("stop"):
+            await self.broker.rebalance.stop_local()
+            return
         self.broker.rebalance.start_shed(
             int(obj.get("count", 0)), int(obj.get("rate", 50))
         )
+
+    async def _handle_session_purge(self, peer: str, obj: Dict) -> None:
+        """Cluster-wide detached-session purge fan-out (start/stop)."""
+        if obj.get("stop"):
+            await self.broker.purger.stop_purge()
+            return
+        try:
+            await self.broker.purger.start_purge(
+                int(obj.get("rate", 500))
+            )
+        except RuntimeError:
+            log.info("purge refused: eviction busy on this node")
 
     def _mark_alive(self, node: str) -> None:
         self._last_seen[node] = time.monotonic()

@@ -22,9 +22,24 @@ class TokenBucket:
     """rate tokens/second, bursting to `burst`.  ``consume`` reports the
     seconds to wait before the deficit is refilled (0.0 = proceed)."""
 
-    def __init__(self, rate: float, burst: Optional[float] = None) -> None:
+    def __init__(
+        self,
+        rate: float,
+        burst: Optional[float] = None,
+        max_debt: Optional[float] = None,
+    ) -> None:
         self.rate = float(rate)
         self.burst = float(burst if burst is not None else rate)
+        # PRIVATE buckets cap debt at one burst: a single oversized
+        # read must not become an unbounded pause (keepalives would
+        # starve and the client would die by timeout, not throttle).
+        # SHARED buckets (listener/zone aggregate) need max_debt=inf:
+        # with a cap, N connections hitting the bucket at once saturate
+        # the debt instead of accumulating it, and the aggregate rate
+        # scales with N instead of staying at `rate`.
+        self.max_debt = float(
+            max_debt if max_debt is not None else self.burst
+        )
         self.tokens = self.burst
         self._at = time.monotonic()
 
@@ -34,11 +49,7 @@ class TokenBucket:
             self.burst, self.tokens + (now - self._at) * self.rate
         )
         self._at = now
-        # debt is capped at one burst: a single oversized read must not
-        # translate into an unbounded pause (during which keepalives
-        # would starve and the client would die by timeout, not be
-        # throttled)
-        self.tokens = max(self.tokens - n, -self.burst)
+        self.tokens = max(self.tokens - n, -self.max_debt)
         if self.tokens >= 0:
             return 0.0
         return -self.tokens / self.rate  # time until balance reaches 0
@@ -53,14 +64,21 @@ class ConnectionLimiter:
         bytes_rate: float = 0.0,
         messages_burst: Optional[float] = None,
         bytes_burst: Optional[float] = None,
+        shared: bool = False,
     ) -> None:
+        # shared (aggregate) buckets accumulate debt without a cap so
+        # the combined admitted rate stays at the configured rate no
+        # matter how many connections compete — see TokenBucket
+        debt = float("inf") if shared else None
         self.msg_bucket = (
-            TokenBucket(messages_rate, messages_burst)
+            TokenBucket(messages_rate, messages_burst, max_debt=debt)
             if messages_rate > 0
             else None
         )
         self.byte_bucket = (
-            TokenBucket(bytes_rate, bytes_burst) if bytes_rate > 0 else None
+            TokenBucket(bytes_rate, bytes_burst, max_debt=debt)
+            if bytes_rate > 0
+            else None
         )
 
     def consume(self, n_bytes: int, n_messages: int) -> float:

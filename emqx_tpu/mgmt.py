@@ -25,6 +25,14 @@ def _json(data, status: int = 200, headers=None) -> web.Response:
     return web.json_response(data, status=status, headers=headers)
 
 
+async def _body_json(request: web.Request) -> dict:
+    """Optional JSON body: absent or malformed -> {}."""
+    try:
+        return await request.json() if request.can_read_body else {}
+    except json.JSONDecodeError:
+        return {}
+
+
 class MgmtApi:
     # routes reachable without credentials: the login endpoint, the
     # status page (which degrades to a login hint when anonymous, the
@@ -192,6 +200,12 @@ class MgmtApi:
             "/api/v5/load_rebalance/start", self.start_rebalance
         )
         r.add_post("/api/v5/load_rebalance/stop", self.stop_rebalance)
+        r.add_post(
+            "/api/v5/load_rebalance/purge/start", self.start_purge
+        )
+        r.add_post(
+            "/api/v5/load_rebalance/purge/stop", self.stop_purge
+        )
         r.add_get("/api/v5/load_rebalance/status", self.rebalance_status)
         r.add_get("/metrics", self.prometheus)
         app.middlewares.append(self._auth_middleware)
@@ -681,67 +695,29 @@ class MgmtApi:
         return _json({"data": self.broker.plugins.info()})
 
     async def dashboard(self, request: web.Request) -> web.Response:
-        """Minimal operator status page (the emqx_dashboard role,
-        server-rendered: live stats refreshed by meta tag, links to the
-        JSON API for everything else)."""
-        b = self.broker
-        if request.get("identity") is None:
-            # anonymous: node identity + login hint only; operational
-            # stats need credentials (Basic api-key works in-browser
-            # via the WWW-Authenticate challenge on /api/v5 routes)
-            html = (
-                "<!DOCTYPE html><html><head><title>emqx_tpu</title>"
-                "<style>body{font-family:monospace;margin:2em}</style>"
-                f"</head><body><h2>emqx_tpu — {b.config.node_name}</h2>"
-                "<p>authentication required: POST /api/v5/login "
-                "{username,password} for a Bearer token, or use an "
-                "API key via HTTP Basic.</p></body></html>"
-            )
-            return web.Response(text=html, content_type="text/html")
-        stats = b.stats.all()
-        rows = "".join(
-            f"<tr><td>{k}</td><td>{v}</td></tr>"
-            for k, v in sorted(
-                {
-                    "connections": len(b.cm),
-                    "subscriptions": b.router.subscription_count(),
-                    "topics": len(b.router.topics()),
-                    "retained": len(b.retainer),
-                    "messages.received": b.metrics.val("messages.received"),
-                    "messages.sent": b.metrics.val("messages.sent"),
-                    "messages.dropped": b.metrics.val("messages.dropped"),
-                    "rules": len(b.rules.rules),
-                    "alarms.active": len(b.alarms.active()),
-                    **{f"stat:{k}": v for k, v in stats.items()},
-                }.items()
-            )
+        """The web dashboard: a single self-contained HTML app (see
+        dashboard.py) that logs in against /api/v5/login and drives
+        the same JSON API operators script against.  Served openly —
+        like the reference serving SPA assets — while every data
+        route stays behind auth."""
+        from .dashboard import DASHBOARD_HTML
+
+        return web.Response(
+            text=DASHBOARD_HTML, content_type="text/html"
         )
-        html = (
-            "<!DOCTYPE html><html><head><title>emqx_tpu</title>"
-            '<meta http-equiv="refresh" content="5">'
-            "<style>body{font-family:monospace;margin:2em}"
-            "table{border-collapse:collapse}td{border:1px solid #999;"
-            "padding:2px 8px}</style></head><body>"
-            f"<h2>emqx_tpu — {b.config.node_name}</h2>"
-            f"<table>{rows}</table>"
-            '<p>APIs: <a href="/api/v5/clients">clients</a> '
-            '<a href="/api/v5/subscriptions">subscriptions</a> '
-            '<a href="/api/v5/rules">rules</a> '
-            '<a href="/api/v5/metrics">metrics</a> '
-            '<a href="/api/v5/alarms">alarms</a> '
-            '<a href="/metrics">prometheus</a></p>'
-            "</body></html>"
-        )
-        return web.Response(text=html, content_type="text/html")
 
     async def start_evacuation(self, request: web.Request) -> web.Response:
+        body = await _body_json(request)
         try:
-            body = await request.json() if request.can_read_body else {}
-        except json.JSONDecodeError:
-            body = {}
-        await self.broker.eviction.start_evacuation(
-            int(body.get("conn_evict_rate", 50))
-        )
+            await self.broker.eviction.start_evacuation(
+                int(body.get("conn_evict_rate", 50))
+            )
+        except (TypeError, ValueError) as e:
+            return _json({"code": "BAD_REQUEST", "message": str(e)},
+                         status=400)
+        except RuntimeError as e:
+            return _json({"code": "CONFLICT", "message": str(e)},
+                         status=409)
         return _json(self.broker.eviction.info())
 
     async def stop_evacuation(self, request: web.Request) -> web.Response:
@@ -751,26 +727,61 @@ class MgmtApi:
     async def start_rebalance(self, request: web.Request) -> web.Response:
         """Cluster-wide balance (POST /load_rebalance/start): plan
         donors from live connection counts and shed their excess."""
+        body = await _body_json(request)
         try:
-            body = await request.json() if request.can_read_body else {}
-        except json.JSONDecodeError:
-            body = {}
-        await self.broker.rebalance.start(
-            conn_evict_rate=int(body.get("conn_evict_rate", 50)),
-            rel_conn_threshold=float(
-                body.get("rel_conn_threshold", 1.10)
-            ),
-        )
+            await self.broker.rebalance.start(
+                conn_evict_rate=int(body.get("conn_evict_rate", 50)),
+                rel_conn_threshold=float(
+                    body.get("rel_conn_threshold", 1.10)
+                ),
+            )
+        except (TypeError, ValueError) as e:
+            return _json({"code": "BAD_REQUEST", "message": str(e)},
+                         status=400)
         return _json(self.broker.rebalance.info())
 
     async def stop_rebalance(self, request: web.Request) -> web.Response:
         await self.broker.rebalance.stop()
         return _json(self.broker.rebalance.info())
 
+    async def start_purge(self, request: web.Request) -> web.Response:
+        """Purge detached sessions (POST /load_rebalance/purge/start);
+        body {"purge_rate": N, "cluster": true} fans out to peers."""
+        body = await _body_json(request)
+        try:
+            rate = int(body.get("purge_rate", 500))
+            await self.broker.purger.start_purge(rate)
+        except (TypeError, ValueError) as e:
+            return _json({"code": "BAD_REQUEST", "message": str(e)},
+                         status=400)
+        except RuntimeError as e:
+            return _json({"code": "CONFLICT", "message": str(e)},
+                         status=409)
+        ext = self.broker.external
+        if body.get("cluster") and ext is not None:
+            for peer in ext.peers_alive():
+                await ext.transport.cast(
+                    peer, {"type": "session_purge", "rate": rate}
+                )
+        return _json(self.broker.purger.info())
+
+    async def stop_purge(self, request: web.Request) -> web.Response:
+        """Body {"cluster": true} also stops peers' purges."""
+        body = await _body_json(request)
+        await self.broker.purger.stop_purge()
+        ext = self.broker.external
+        if body.get("cluster") and ext is not None:
+            for peer in ext.peers_alive():
+                await ext.transport.cast(
+                    peer, {"type": "session_purge", "stop": True}
+                )
+        return _json(self.broker.purger.info())
+
     async def rebalance_status(self, request: web.Request) -> web.Response:
         return _json({
             "evacuation": self.broker.eviction.info(),
             "rebalance": self.broker.rebalance.info(),
+            "purge": self.broker.purger.info(),
         })
 
     # ------------------------------------------------------ prometheus

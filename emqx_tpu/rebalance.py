@@ -38,6 +38,11 @@ def _connected(broker) -> list:
     return [cid for cid in cm.clients() if cm.connected(cid)]
 
 
+def _detached(broker) -> list:
+    cm = broker.cm
+    return [cid for cid in cm.clients() if not cm.connected(cid)]
+
+
 class EvictionAgent:
     def __init__(self, broker) -> None:
         self.broker = broker
@@ -52,6 +57,10 @@ class EvictionAgent:
         taken over when their clients land on a peer."""
         if self.status == "evacuating":
             return
+        if self.broker.purger.status == "purging":
+            # a running purge would destroy the very sessions this
+            # evacuation parks detached for peer takeover
+            raise RuntimeError("session purge in progress")
         self.status = "evacuating"
         self.started_at = time.time()
         self.evicted = 0
@@ -95,6 +104,68 @@ class EvictionAgent:
                 for cid in self.broker.cm.clients()
                 if self.broker.cm.connected(cid)
             ),
+        }
+
+
+class PurgeAgent:
+    """Bounded-rate session purge (emqx_node_rebalance_purge.erl):
+    before maintenance an operator wipes DETACHED sessions (persistent
+    state lingering with no live channel) at `purge_rate`/s; live
+    connections are untouched.  Cluster-wide via the `session_purge`
+    cast."""
+
+    def __init__(self, broker) -> None:
+        self.broker = broker
+        self.status = "disabled"
+        self.purged = 0
+        self._task: Optional[asyncio.Task] = None
+
+    async def start_purge(self, purge_rate: int = 500) -> None:
+        if self.status == "purging":
+            return
+        # the reference purge refuses to start while the eviction
+        # agent is busy: an evacuation/rebalance parks sessions
+        # DETACHED on purpose (awaiting peer takeover) and a purge
+        # would destroy exactly those
+        if (self.broker.eviction.status == "evacuating"
+                or self.broker.rebalance.shedding):
+            raise RuntimeError("eviction/rebalance in progress")
+        self.status = "purging"
+        self.purged = 0
+        self._task = asyncio.get_running_loop().create_task(
+            self._run(max(purge_rate, 1))
+        )
+
+    async def stop_purge(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        if self.status == "purging":
+            self.status = "stopped"
+
+    async def _run(self, rate: int) -> None:
+        cm = self.broker.cm
+        while True:
+            detached = _detached(self.broker)
+            if not detached:
+                self.status = "purged"
+                log.info("purge complete: %d sessions", self.purged)
+                return
+            for cid in detached[:rate]:
+                if cm.kick(cid):
+                    self.purged += 1
+                    self.broker.metrics.inc("session.purged")
+            await asyncio.sleep(1.0)
+
+    def info(self) -> dict:
+        return {
+            "status": self.status,
+            "purged": self.purged,
+            "remaining": len(_detached(self.broker)),
         }
 
 
@@ -193,12 +264,16 @@ class RebalanceCoordinator:
         donor share, or a remote coordinator's request)."""
         if self.shedding or count <= 0:
             return
+        if self.broker.purger.status == "purging":
+            log.warning("rebalance shed refused: purge in progress")
+            return
         self.status = "rebalancing"
         self._task = asyncio.get_running_loop().create_task(
             self._shed(count, max(rate, 1))
         )
 
-    async def stop(self) -> None:
+    async def stop_local(self) -> None:
+        """Cancel this node's shed only (a remote coordinator's stop)."""
         if self._task is not None:
             self._task.cancel()
             try:
@@ -206,6 +281,26 @@ class RebalanceCoordinator:
             except asyncio.CancelledError:
                 pass
             self._task = None
+        self.status = "idle"
+
+    async def stop(self) -> None:
+        """Stop the local shed AND any remote donors this coordinator
+        started (the plan remembers them)."""
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        ext = self.broker.external
+        if ext is not None and self.plan:
+            me = getattr(ext, "name", "local")
+            for node in self.plan.get("donors", {}):
+                if node != me:
+                    await ext.transport.cast(
+                        node, {"type": "rebalance_shed", "stop": True}
+                    )
         self.status = "idle"
 
     async def _shed(self, excess: int, rate: int) -> None:

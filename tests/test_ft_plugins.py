@@ -121,8 +121,31 @@ def test_dashboard_page():
         async with http:
             async with http.get(api + "/dashboard") as r:
                 text = await r.text()
-        assert r.status == 200
-        assert "emqx_tpu" in text and "connections" in text
+            assert r.status == 200
+            assert "emqx_tpu" in text and "connections" in text
+            # the SPA drives these endpoints; verify its contract
+            async with http.get(api + "/api/v5/stats") as r:
+                stats = await r.json()
+            assert "connections.count" in stats
+            async with http.get(api + "/api/v5/nodes") as r:
+                nodes = await r.json()
+            assert nodes["data"][0]["node_status"] == "running"
+            async with http.get(api + "/api/v5/clients") as r:
+                clients = await r.json()
+            assert "data" in clients
+            async with http.get(api + "/api/v5/alarms") as r:
+                alarms = await r.json()
+            assert "data" in alarms
+            async with http.get(api + "/api/v5/rules") as r:
+                rules = await r.json()
+            assert "data" in rules
+        # anonymous fetch serves the SPA shell too (login is in-page)
+        import aiohttp
+
+        async with aiohttp.ClientSession() as anon:
+            async with anon.get(api + "/dashboard") as r:
+                text = await r.text()
+            assert r.status == 200 and "/api/v5/login" in text
         await srv.stop()
 
     run(t())

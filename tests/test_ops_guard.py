@@ -175,6 +175,29 @@ def test_hierarchical_limiter_levels():
     assert c.consume(100, 0) > 0.0
 
 
+def test_shared_bucket_debt_accumulates_across_consumers():
+    """Aggregate enforcement: N connections hammering one SHARED
+    bucket must queue behind its rate — the debt (and so the owed
+    pause) keeps growing instead of saturating at one burst, which
+    would let the combined rate scale with N."""
+    from emqx_tpu.limiter import ConnectionLimiter
+
+    shared = ConnectionLimiter(
+        messages_rate=10, messages_burst=10, shared=True
+    )
+    delays = [shared.consume(0, 1) for _ in range(50)]
+    # first burst-worth admitted free, then the wait grows linearly:
+    # the 50th consumer owes ~(50-10)/10 = 4s, far beyond one burst
+    assert delays[9] == 0.0
+    assert delays[-1] > 3.0
+    assert delays[-1] > delays[20] > delays[11]
+    # a PRIVATE bucket keeps the one-burst debt cap (bounded pause)
+    private = ConnectionLimiter(messages_rate=10, messages_burst=10)
+    for _ in range(50):
+        capped = private.consume(0, 1)
+    assert capped <= 1.0 + 1e-6
+
+
 def test_listener_hierarchy_over_socket():
     """End to end: a listener-aggregate message cap throttles two
     clients' combined publish rate via read-pausing."""

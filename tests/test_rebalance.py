@@ -166,6 +166,196 @@ def test_rebalance_remote_donor_shed_via_cast():
     run(t())
 
 
+def test_purge_drops_detached_sessions_only():
+    async def t():
+        cfg = BrokerConfig()
+        cfg.listeners = [ListenerConfig(port=0)]
+        srv = BrokerServer(cfg)
+        await srv.start()
+        port = srv.listeners[0].port
+
+        # three persistent sessions; two go detached, one stays live
+        clients = [TestClient(port, f"pg-{i}") for i in range(3)]
+        for c in clients:
+            await c.connect(
+                clean_start=False,
+                properties={"session_expiry_interval": 600},
+            )
+        await clients[0].disconnect()
+        await clients[1].disconnect()
+        await asyncio.sleep(0.05)
+        assert not srv.broker.cm.connected("pg-0")
+        assert srv.broker.cm.lookup("pg-0") is not None
+
+        await srv.broker.purger.start_purge(purge_rate=100)
+        for _ in range(100):
+            if srv.broker.purger.info()["status"] == "purged":
+                break
+            await asyncio.sleep(0.05)
+        info = srv.broker.purger.info()
+        assert info["status"] == "purged" and info["purged"] == 2
+        assert srv.broker.cm.lookup("pg-0") is None
+        assert srv.broker.cm.lookup("pg-1") is None
+        # the live client is untouched
+        assert srv.broker.cm.connected("pg-2")
+        await clients[2].disconnect()
+        for c in clients:
+            await c.close()
+        await srv.stop()
+
+    run(t())
+
+
+def test_purge_refused_while_evacuating():
+    async def t():
+        import pytest
+
+        cfg = BrokerConfig()
+        cfg.listeners = [ListenerConfig(port=0)]
+        srv = BrokerServer(cfg)
+        await srv.start()
+        c = TestClient(srv.listeners[0].port, "busy")
+        await c.connect(
+            clean_start=False,
+            properties={"session_expiry_interval": 600},
+        )
+        await srv.broker.eviction.start_evacuation(conn_evict_rate=1)
+        with pytest.raises(RuntimeError):
+            await srv.broker.purger.start_purge()
+        await srv.broker.eviction.stop_evacuation()
+        await c.close()
+        await srv.stop()
+
+    run(t())
+
+
+def test_eviction_refused_while_purging():
+    """The exclusion is bidirectional: a running purge blocks
+    evacuation/shed (which would park sessions the purge destroys)."""
+
+    async def t():
+        import pytest
+
+        cfg = BrokerConfig()
+        cfg.listeners = [ListenerConfig(port=0)]
+        srv = BrokerServer(cfg)
+        await srv.start()
+        # a detached session keeps the purge loop alive
+        c = TestClient(srv.listeners[0].port, "pp")
+        await c.connect(
+            clean_start=False,
+            properties={"session_expiry_interval": 600},
+        )
+        await c.disconnect()
+        await asyncio.sleep(0.05)
+        srv.broker.purger.status = "purging"  # freeze mid-purge
+        with pytest.raises(RuntimeError):
+            await srv.broker.eviction.start_evacuation()
+        srv.broker.rebalance.start_shed(5, 10)
+        assert not srv.broker.rebalance.shedding
+        srv.broker.purger.status = "disabled"
+        await c.close()
+        await srv.stop()
+
+    run(t())
+
+
+def test_rebalance_stop_reaches_remote_donors():
+    async def t():
+        async def start_node(name, seeds=()):
+            cfg = BrokerConfig()
+            cfg.listeners = [ListenerConfig(port=0)]
+            srv = BrokerServer(cfg)
+            await srv.start()
+            node = ClusterNode(name, srv.broker, **FAST)
+            await node.start(seeds=list(seeds))
+            return srv, node
+
+        srv_a, a = await start_node("a")
+        srv_b, b = await start_node("b", seeds=[("a", "127.0.0.1", a.port)])
+        await asyncio.sleep(0.3)
+
+        clients = [TestClient(srv_a.listeners[0].port, f"rs-{i}")
+                   for i in range(6)]
+        for c in clients:
+            await c.connect()
+
+        # coordinate from B with a slow rate so the shed is still
+        # running on A when the stop arrives
+        plan = await srv_b.broker.rebalance.start(
+            conn_evict_rate=1, rel_conn_threshold=1.05
+        )
+        assert plan["donors"].get("a", 0) >= 2
+        for _ in range(50):
+            if srv_a.broker.rebalance.shedding:
+                break
+            await asyncio.sleep(0.05)
+        assert srv_a.broker.rebalance.shedding
+
+        await srv_b.broker.rebalance.stop()
+        for _ in range(50):
+            if not srv_a.broker.rebalance.shedding:
+                break
+            await asyncio.sleep(0.05)
+        assert not srv_a.broker.rebalance.shedding
+        assert srv_a.broker.rebalance.status == "idle"
+
+        for c in clients:
+            await c.close()
+        await b.stop()
+        await srv_b.stop()
+        await a.stop()
+        await srv_a.stop()
+
+    run(t())
+
+
+def test_cluster_purge_fans_out():
+    async def t():
+        async def start_node(name, seeds=()):
+            cfg = BrokerConfig()
+            cfg.listeners = [ListenerConfig(port=0)]
+            srv = BrokerServer(cfg)
+            await srv.start()
+            node = ClusterNode(name, srv.broker, **FAST)
+            await node.start(seeds=list(seeds))
+            return srv, node
+
+        srv_a, a = await start_node("a")
+        srv_b, b = await start_node("b", seeds=[("a", "127.0.0.1", a.port)])
+        await asyncio.sleep(0.3)
+
+        c = TestClient(srv_b.listeners[0].port, "pg-remote")
+        await c.connect(
+            clean_start=False,
+            properties={"session_expiry_interval": 600},
+        )
+        await c.disconnect()
+        await asyncio.sleep(0.05)
+        assert srv_b.broker.cm.lookup("pg-remote") is not None
+
+        # the fan-out path the REST handler uses: cast to peers
+        await srv_a.broker.purger.start_purge(100)
+        for peer in a.peers_alive():
+            await a.transport.cast(
+                peer, {"type": "session_purge", "rate": 100}
+            )
+        for _ in range(100):
+            if srv_b.broker.purger.info()["status"] == "purged":
+                break
+            await asyncio.sleep(0.05)
+        assert srv_b.broker.cm.lookup("pg-remote") is None
+        assert srv_b.broker.purger.info()["status"] == "purged"
+
+        await c.close()
+        await b.stop()
+        await srv_b.stop()
+        await a.stop()
+        await srv_a.stop()
+
+    run(t())
+
+
 def test_evacuated_client_migrates_to_peer():
     async def t():
         async def start_node(name, seeds=()):
