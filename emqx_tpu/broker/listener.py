@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 from typing import Dict, List, Optional
 
 from ..config import BrokerConfig, ListenerConfig
@@ -33,6 +34,9 @@ class Listener:
         # listener-aggregate buckets shared by ALL this listener's
         # connections (the hierarchical limiter's middle level)
         self._shared_limiter = None
+        self._ssl_ctx = None
+        self._crl_mtime = 0.0
+        self._crl_next_update = None
         if cfg.max_messages_rate > 0 or cfg.max_bytes_rate > 0:
             from ..limiter import ConnectionLimiter
 
@@ -72,7 +76,77 @@ class Listener:
             ctx.load_verify_locations(self.cfg.cacertfile)
         if self.cfg.verify:
             ctx.verify_mode = ssl_mod.CERT_REQUIRED
+        if self.cfg.crlfile:
+            # revocation checking (the emqx_crl_cache role,
+            # /root/reference/apps/emqx/src/emqx_crl_cache.erl): leaf
+            # certs are checked against the CRL; the housekeeper
+            # re-loads the file when it changes, so revocations take
+            # effect on new handshakes without a listener restart
+            if not self.cfg.verify:
+                raise ValueError(
+                    f"listener {self.cfg.name}: crlfile requires "
+                    "verify=true (without a requested client cert "
+                    "there is nothing to check revocation against)"
+                )
+            ctx.verify_flags |= ssl_mod.VERIFY_CRL_CHECK_LEAF
+            ctx.load_verify_locations(self.cfg.crlfile)
+            self._crl_mtime = os.stat(self.cfg.crlfile).st_mtime
+            self._note_crl_expiry()
+        self._ssl_ctx = ctx
         return ctx
+
+    def _note_crl_expiry(self) -> None:
+        """Track the CRL's nextUpdate: once it passes, OpenSSL fails
+        EVERY handshake with CRL_HAS_EXPIRED — the operator needs a
+        warning before that, since an untouched file never triggers
+        the mtime-based reload."""
+        self._crl_next_update = None
+        try:
+            from cryptography import x509
+
+            with open(self.cfg.crlfile, "rb") as f:
+                crl = x509.load_pem_x509_crl(f.read())
+            self._crl_next_update = crl.next_update_utc
+        except Exception:
+            log.debug("CRL nextUpdate unreadable", exc_info=True)
+
+    def maybe_reload_crl(self) -> bool:
+        """Re-load the CRL file into the LIVE ssl context when its
+        mtime changes (OpenSSL picks the freshest CRL per issuer, so
+        additive loading rolls the list forward).  Returns True when a
+        reload happened."""
+        if self._ssl_ctx is None or not self.cfg.crlfile:
+            return False
+        if self._crl_next_update is not None:
+            import datetime
+
+            now = datetime.datetime.now(datetime.timezone.utc)
+            if now > self._crl_next_update:
+                log.warning(
+                    "listener %s: CRL is past nextUpdate (%s) — "
+                    "OpenSSL now rejects ALL client certs on this "
+                    "listener until a fresh CRL is written",
+                    self.cfg.name, self._crl_next_update,
+                )
+                self._crl_next_update = None  # warn once per expiry
+        try:
+            mtime = os.stat(self.cfg.crlfile).st_mtime
+        except OSError:
+            return False
+        if mtime == self._crl_mtime:
+            return False
+        try:
+            self._ssl_ctx.load_verify_locations(self.cfg.crlfile)
+        except Exception:
+            # mtime NOT advanced: the load retries every tick until
+            # the operator writes a CRL OpenSSL accepts
+            log.warning("listener %s: CRL reload failed",
+                        self.cfg.name, exc_info=True)
+            return False
+        self._crl_mtime = mtime
+        self._note_crl_expiry()
+        log.info("listener %s: CRL reloaded", self.cfg.name)
+        return True
 
     async def start(self) -> None:
         ssl_ctx = (
@@ -379,6 +453,8 @@ class BrokerServer:
                     await asyncio.get_running_loop().run_in_executor(
                         None, client.retry
                     )
+            for lst in self.listeners:
+                lst.maybe_reload_crl()
 
     async def stop(self) -> None:
         # elastic-ops agents first: their loops kick sessions and must

@@ -63,6 +63,9 @@ class ListenerConfig:
     keyfile: Optional[str] = None
     cacertfile: Optional[str] = None
     verify: bool = False  # require + verify client certificates
+    # PEM CRL checked against client leaf certs (emqx_crl_cache);
+    # the file is watched and hot-reloaded on change
+    crlfile: Optional[str] = None
     # per-connection rate limits (emqx_limiter); 0 = unlimited
     messages_rate: float = 0.0  # PUBLISH packets per second
     bytes_rate: float = 0.0  # inbound bytes per second

@@ -158,6 +158,250 @@ def _make_cert(tmp_path):
     return str(certfile), str(keyfile)
 
 
+def _make_pki(tmp_path):
+    """CA + server cert + two client certs + a CRL revoking one
+    (`cryptography`-built, no openssl CLI)."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    now = datetime.datetime.now(datetime.timezone.utc)
+
+    def _name(cn):
+        return x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
+
+    def _key():
+        return rsa.generate_private_key(
+            public_exponent=65537, key_size=2048
+        )
+
+    def _write(path, pem):
+        (tmp_path / path).write_bytes(pem)
+        return str(tmp_path / path)
+
+    def _key_pem(key):
+        return key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption(),
+        )
+
+    ca_key = _key()
+    ca_cert = (
+        x509.CertificateBuilder()
+        .subject_name(_name("test-ca")).issuer_name(_name("test-ca"))
+        .public_key(ca_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .add_extension(
+            x509.BasicConstraints(ca=True, path_length=None),
+            critical=True,
+        )
+        .sign(ca_key, hashes.SHA256())
+    )
+
+    def _issue(cn, san=None):
+        key = _key()
+        b = (
+            x509.CertificateBuilder()
+            .subject_name(_name(cn)).issuer_name(_name("test-ca"))
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=1))
+        )
+        if san:
+            b = b.add_extension(
+                x509.SubjectAlternativeName([x509.DNSName(san)]),
+                critical=False,
+            )
+        return key, b.sign(ca_key, hashes.SHA256())
+
+    srv_key, srv_cert = _issue("localhost", san="localhost")
+    good_key, good_cert = _issue("client-good")
+    bad_key, bad_cert = _issue("client-revoked")
+
+    crl = (
+        x509.CertificateRevocationListBuilder()
+        .issuer_name(_name("test-ca"))
+        .last_update(now - datetime.timedelta(minutes=5))
+        .next_update(now + datetime.timedelta(days=1))
+        .add_revoked_certificate(
+            x509.RevokedCertificateBuilder()
+            .serial_number(bad_cert.serial_number)
+            .revocation_date(now - datetime.timedelta(minutes=1))
+            .build()
+        )
+        .sign(ca_key, hashes.SHA256())
+    )
+    enc = serialization.Encoding.PEM
+    return {
+        "ca": _write("ca.pem", ca_cert.public_bytes(enc)),
+        "ca_key": _write("ca.key", _key_pem(ca_key)),
+        "srv_cert": _write("srv.pem", srv_cert.public_bytes(enc)),
+        "srv_key": _write("srv.key", _key_pem(srv_key)),
+        "good_cert": _write("good.pem", good_cert.public_bytes(enc)),
+        "good_key": _write("good.key", _key_pem(good_key)),
+        "bad_cert": _write("bad.pem", bad_cert.public_bytes(enc)),
+        "bad_key": _write("bad.key", _key_pem(bad_key)),
+        "crl": _write("ca.crl", crl.public_bytes(enc)),
+    }
+
+
+async def _mtls_probe(port, ca, certfile, keyfile):
+    """True if the broker ACCEPTS this client cert: under TLS 1.3 the
+    server's verify verdict arrives AFTER the client handshake
+    completes, so acceptance is probed by an MQTT CONNECT->CONNACK
+    round trip (a revoked cert gets an alert/EOF instead)."""
+    import ssl
+
+    ctx = ssl.create_default_context(cafile=ca)
+    ctx.check_hostname = False
+    ctx.load_cert_chain(certfile, keyfile)
+    try:
+        r, w = await asyncio.open_connection(
+            "127.0.0.1", port, ssl=ctx, server_hostname="localhost"
+        )
+    except (ssl.SSLError, ConnectionError):
+        return False
+    try:
+        w.write(C.serialize(C.Connect(client_id="crl-probe")))
+        await w.drain()
+        data = await asyncio.wait_for(r.read(4), 5.0)
+        return len(data) > 0 and data[0] >> 4 == 2  # CONNACK
+    except (ssl.SSLError, ConnectionError, asyncio.TimeoutError):
+        return False
+    finally:
+        w.close()
+
+
+def test_tls_crl_rejects_revoked_client(tmp_path):
+    """mTLS listener with a CRL (emqx_crl_cache role): a revoked
+    client cert is rejected; an unrevoked one connects."""
+    pki = _make_pki(tmp_path)
+
+    async def t():
+        cfg = BrokerConfig()
+        cfg.listeners = [
+            ListenerConfig(
+                name="mtls", type="ssl", port=0,
+                certfile=pki["srv_cert"], keyfile=pki["srv_key"],
+                cacertfile=pki["ca"], verify=True,
+                crlfile=pki["crl"],
+            )
+        ]
+        srv = BrokerServer(cfg)
+        await srv.start()
+        port = srv.listeners[0].port
+
+        assert await _mtls_probe(port, pki["ca"], pki["good_cert"],
+                                 pki["good_key"])
+        assert not await _mtls_probe(port, pki["ca"], pki["bad_cert"],
+                                     pki["bad_key"])
+        await srv.stop()
+
+    run(t())
+
+
+def test_tls_crl_requires_verify(tmp_path):
+    """crlfile without verify=true is a misconfiguration (no client
+    cert requested -> nothing to revoke-check) and must fail loudly,
+    not silently skip revocation."""
+    import pytest
+
+    pki = _make_pki(tmp_path)
+
+    async def t():
+        cfg = BrokerConfig()
+        cfg.listeners = [
+            ListenerConfig(
+                name="mtls", type="ssl", port=0,
+                certfile=pki["srv_cert"], keyfile=pki["srv_key"],
+                cacertfile=pki["ca"], crlfile=pki["crl"],
+            )
+        ]
+        srv = BrokerServer(cfg)
+        with pytest.raises(ValueError, match="verify"):
+            await srv.start()
+        await srv.stop()
+
+    run(t())
+
+
+def test_tls_crl_hot_reload(tmp_path):
+    """Revoking a cert by rewriting the CRL file takes effect on new
+    handshakes after maybe_reload_crl, without a listener restart."""
+    import os
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+
+    pki = _make_pki(tmp_path)
+
+    async def t():
+        cfg = BrokerConfig()
+        cfg.listeners = [
+            ListenerConfig(
+                name="mtls", type="ssl", port=0,
+                certfile=pki["srv_cert"], keyfile=pki["srv_key"],
+                cacertfile=pki["ca"], verify=True,
+                crlfile=pki["crl"],
+            )
+        ]
+        srv = BrokerServer(cfg)
+        await srv.start()
+        lst = srv.listeners[0]
+        port = lst.port
+
+        # 'good' connects fine against the original CRL
+        assert await _mtls_probe(port, pki["ca"], pki["good_cert"],
+                                 pki["good_key"])
+
+        # roll the CRL forward: now 'good' is revoked too
+        from cryptography.x509.oid import NameOID
+
+        now = datetime.datetime.now(datetime.timezone.utc)
+        ca_name = x509.Name(
+            [x509.NameAttribute(NameOID.COMMON_NAME, "test-ca")]
+        )
+        good = x509.load_pem_x509_certificate(
+            open(pki["good_cert"], "rb").read()
+        )
+        bad = x509.load_pem_x509_certificate(
+            open(pki["bad_cert"], "rb").read()
+        )
+        ca_key = serialization.load_pem_private_key(
+            open(pki["ca_key"], "rb").read(), password=None
+        )
+        builder = (
+            x509.CertificateRevocationListBuilder()
+            .issuer_name(ca_name)
+            .last_update(now)
+            .next_update(now + datetime.timedelta(days=1))
+        )
+        for cert in (good, bad):
+            builder = builder.add_revoked_certificate(
+                x509.RevokedCertificateBuilder()
+                .serial_number(cert.serial_number)
+                .revocation_date(now)
+                .build()
+            )
+        crl2 = builder.sign(ca_key, hashes.SHA256())
+        with open(pki["crl"], "wb") as f:
+            f.write(crl2.public_bytes(serialization.Encoding.PEM))
+        os.utime(pki["crl"], (0, 10**10))  # force a new mtime
+        assert lst.maybe_reload_crl()
+
+        assert not await _mtls_probe(port, pki["ca"],
+                                     pki["good_cert"],
+                                     pki["good_key"])
+        await srv.stop()
+
+    run(t())
+
+
 def test_tls_pubsub_roundtrip(tmp_path):
     import ssl
 
