@@ -13,6 +13,9 @@ any reachable broker:
     python -m emqx_tpu.ctl trace start <name> <type> <match> | stop <name>
     python -m emqx_tpu.ctl banned [add <as> <who>] [del <as> <who>]
     python -m emqx_tpu.ctl data export | import <archive.tar.gz>
+    python -m emqx_tpu.ctl rebalance [start|stop|status]
+    python -m emqx_tpu.ctl rebalance evacuation start|stop
+    python -m emqx_tpu.ctl rebalance purge start|stop
 """
 
 from __future__ import annotations
@@ -184,6 +187,69 @@ class Ctl:
         else:
             raise SystemExit(f"unknown data action {action!r}")
 
+    def rebalance(self, action: str = "status", *args: str) -> None:
+        """Elastic ops (emqx ctl rebalance): evacuation, cluster
+        balance, detached-session purge."""
+        if action == "status":
+            info = self._req("/api/v5/load_rebalance/status")
+            for kind, d in info.items():
+                line = "\t".join(f"{k}={v}" for k, v in d.items()
+                                 if k != "plan")
+                print(f"{kind}:\t{line}")
+                if d.get("plan"):
+                    print(f"\tplan: {json.dumps(d['plan'])}")
+        elif action == "start":
+            out = self._req("/api/v5/load_rebalance/start",
+                            method="POST", body={})
+            print(f"rebalance: {out['status']}")
+            if out.get("plan"):
+                print(f"plan: {json.dumps(out['plan'])}")
+        elif action == "stop":
+            self._req("/api/v5/load_rebalance/stop", method="POST")
+            print("rebalance stopped")
+        elif action == "evacuation":
+            sub = args[0] if args else "status"
+            if sub == "status":
+                info = self._req("/api/v5/load_rebalance/status")
+                print(json.dumps(info["evacuation"]))
+            elif sub == "start":
+                out = self._req(
+                    "/api/v5/load_rebalance/evacuation/start",
+                    method="POST", body={},
+                )
+                print(f"evacuation: {out['status']}")
+            elif sub == "stop":
+                out = self._req(
+                    "/api/v5/load_rebalance/evacuation/stop",
+                    method="POST",
+                )
+                print(f"evacuation: {out['status']} "
+                      f"(evicted {out['evicted']})")
+            else:
+                raise SystemExit(f"unknown evacuation action {sub!r}")
+        elif action == "purge":
+            sub = args[0] if args else "status"
+            if sub == "status":
+                info = self._req("/api/v5/load_rebalance/status")
+                print(json.dumps(info["purge"]))
+            elif sub == "start":
+                out = self._req(
+                    "/api/v5/load_rebalance/purge/start",
+                    method="POST", body={"cluster": True},
+                )
+                print(f"purge: {out['status']}")
+            elif sub == "stop":
+                out = self._req(
+                    "/api/v5/load_rebalance/purge/stop",
+                    method="POST", body={"cluster": True},
+                )
+                print(f"purge: {out['status']} "
+                      f"(purged {out['purged']})")
+            else:
+                raise SystemExit(f"unknown purge action {sub!r}")
+        else:
+            raise SystemExit(f"unknown rebalance action {action!r}")
+
     def banned(self, action: str = "list", *args: str) -> None:
         if action == "list":
             for b in self._req("/api/v5/banned")["data"]:
@@ -224,7 +290,8 @@ def main(argv=None) -> None:
         "preferred over --user when set)",
     )
     ap.add_argument("command", help="status|clients|subscriptions|topics|"
-                    "rules|metrics|stats|publish|trace|banned|data")
+                    "rules|metrics|stats|publish|trace|banned|data|"
+                    "rebalance")
     ap.add_argument("args", nargs="*")
     ap.add_argument("--qos", type=int, default=0)
     ns = ap.parse_args(argv)
@@ -254,6 +321,9 @@ def main(argv=None) -> None:
         ctl.banned(ns.args[0] if ns.args else "list", *ns.args[1:])
     elif cmd == "data":
         ctl.data(ns.args[0] if ns.args else "export", *ns.args[1:])
+    elif cmd == "rebalance":
+        ctl.rebalance(ns.args[0] if ns.args else "status",
+                      *ns.args[1:])
     else:
         raise SystemExit(f"unknown command {cmd!r}")
 

@@ -121,6 +121,23 @@ def test_ctl_cli_against_live_broker(tmp_path):
         assert "delivered to 1" in pub
         pkt = await c.recv_publish()
         assert pkt.payload == b"from-ctl"
+
+        # elastic ops round trip: status -> start -> status -> stop
+        reb = await loop.run_in_executor(None, ctl, "rebalance")
+        assert "evacuation:" in reb and "purge:" in reb
+        started = await loop.run_in_executor(
+            None, ctl, "rebalance", "start"
+        )
+        assert "rebalance:" in started
+        stopped = await loop.run_in_executor(
+            None, ctl, "rebalance", "stop"
+        )
+        assert "stopped" in stopped
+        purge = await loop.run_in_executor(
+            None, ctl, "rebalance", "purge", "start"
+        )
+        assert "purge:" in purge
+
         await c.disconnect()
         await srv.stop()
 
