@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
@@ -72,6 +73,22 @@ class Broker:
             authz_default=self.config.auth.authz_default,
             deny_action=self.config.auth.deny_action,
         )
+        self.gcp_devices = None
+        if self.config.gcp_device_enable:
+            from ..gcp_device import (
+                GcpDeviceAuthenticator, GcpDeviceRegistry,
+            )
+
+            os.makedirs(
+                os.path.dirname(self.config.gcp_device_file) or ".",
+                exist_ok=True,
+            )
+            self.gcp_devices = GcpDeviceRegistry(
+                self.config.gcp_device_file
+            )
+            self.access.authenticators.append(
+                GcpDeviceAuthenticator(self.gcp_devices)
+            )
         self.cm = ConnectionManager(self._make_session)
         # ACL-cache eviction probes session liveness so pressure never
         # wipes a connected client's prefetched rows

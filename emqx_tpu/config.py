@@ -215,6 +215,11 @@ class BrokerConfig:
     plugins: List[str] = field(default_factory=list)
     plugin_dir: str = "plugins"
     ft: FtConfig = field(default_factory=FtConfig)
+    # GCP IoT-Core compat device registry (emqx_gcp_device): devices
+    # keep their projects/.../devices/D clientids and JWT-per-connect
+    # credentials after migrating off Google IoT Core
+    gcp_device_enable: bool = False
+    gcp_device_file: str = "data/gcp_devices.json"
     # opt-in anonymous usage telemetry (emqx_telemetry); off by default
     telemetry_enable: bool = False
     telemetry_url: str = ""

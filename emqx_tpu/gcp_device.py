@@ -1,0 +1,232 @@
+"""GCP IoT-Core compatible device registry + authenticator.
+
+The `emqx_gcp_device` app (/root/reference/apps/emqx_gcp_device/src/
+emqx_gcp_device.erl:17-23 put/get/remove/import_devices,
+emqx_gcp_device_authn.erl:44-56 check logic): devices migrated off
+Google Cloud IoT Core keep their clientid shape
+``projects/P/locations/L/registries/R/devices/D`` and authenticate
+with a JWT in the password field, signed by one of the device's
+registered public keys (RS256/ES256, like IoT Core).  The registry is
+persisted and managed over REST.
+
+Decision ladder (authn.erl's check/1): non-GCP clientid or non-JWT
+password -> IGNORE (next provider); expired JWT -> DENY; device
+unknown -> IGNORE; no unexpired keys, or no key verifying the
+signature -> DENY; a key verifies -> ALLOW.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .access import ALLOW, DENY, IGNORE, Authenticator, ClientInfo
+from .auth_providers import _b64url_decode
+
+
+def deviceid_from_clientid(clientid: str) -> Optional[str]:
+    """``projects/P/locations/L/registries/R/devices/D`` -> ``D``
+    (authn.erl gcp_deviceid_from_clientid)."""
+    parts = clientid.split("/")
+    if (
+        len(parts) == 8
+        and parts[0] == "projects"
+        and parts[2] == "locations"
+        and parts[4] == "registries"
+        and parts[6] == "devices"
+        and parts[7]
+    ):
+        return parts[7]
+    return None
+
+
+def _verify_sig(key_pem: bytes, alg: str, signing: bytes,
+                sig: bytes) -> bool:
+    """RS256/ES256 verification with a device's registered public key
+    (PEM; certificates accepted too, as IoT Core allowed)."""
+    try:
+        from cryptography.exceptions import InvalidSignature
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import ec, padding
+        from cryptography.hazmat.primitives.asymmetric.utils import (
+            encode_dss_signature,
+        )
+
+        if b"BEGIN CERTIFICATE" in key_pem:
+            from cryptography import x509
+
+            pub = x509.load_pem_x509_certificate(key_pem).public_key()
+        else:
+            pub = serialization.load_pem_public_key(key_pem)
+        if alg == "RS256":
+            pub.verify(sig, signing, padding.PKCS1v15(),
+                       hashes.SHA256())
+            return True
+        if alg == "ES256":
+            if len(sig) != 64:
+                return False
+            r = int.from_bytes(sig[:32], "big")
+            s = int.from_bytes(sig[32:], "big")
+            pub.verify(encode_dss_signature(r, s), signing,
+                       ec.ECDSA(hashes.SHA256()))
+            return True
+        return False
+    except (InvalidSignature, ValueError, TypeError):
+        return False
+    except Exception:
+        return False
+
+
+class GcpDeviceRegistry:
+    """deviceid -> keys [{key_type, key, expires_at}] + location tuple
+    (+extra), persisted as one JSON file (the mnesia table's role)."""
+
+    def __init__(self, path: Optional[str] = None) -> None:
+        self.path = path
+        self._devices: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.Lock()
+        if path and os.path.exists(path):
+            try:
+                with open(path) as f:
+                    self._devices = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                self._devices = {}
+
+    def _flush(self) -> None:
+        if not self.path:
+            return
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._devices, f)
+        os.replace(tmp, self.path)
+
+    def put_device(self, device: Dict[str, Any]) -> None:
+        """{"deviceid", "keys": [{"key_type","key","expires_at"}],
+        "project","location","registry","extra"}"""
+        if not isinstance(device, dict) or "deviceid" not in device:
+            raise ValueError("device must be an object with deviceid")
+        deviceid = str(device["deviceid"])
+        raw_keys = device.get("keys", [])
+        if not isinstance(raw_keys, list) or any(
+            not isinstance(k, dict) or "key" not in k
+            for k in raw_keys
+        ):
+            raise ValueError(
+                f"device {deviceid}: keys must be objects with 'key'"
+            )
+        keys = [
+            {
+                "key_type": str(k.get("key_type", "RSA_PEM")),
+                "key": str(k["key"]),
+                "expires_at": float(k.get("expires_at", 0)),
+            }
+            for k in raw_keys
+        ]
+        with self._lock:
+            self._devices[deviceid] = {
+                "deviceid": deviceid,
+                "keys": keys,
+                "project": str(device.get("project", "")),
+                "location": str(device.get("location", "")),
+                "registry": str(device.get("registry", "")),
+                "created_at": float(
+                    device.get("created_at", time.time())
+                ),
+                "extra": device.get("extra", {}),
+            }
+            self._flush()
+
+    def get_device(self, deviceid: str) -> Optional[Dict[str, Any]]:
+        return self._devices.get(deviceid)
+
+    def remove_device(self, deviceid: str) -> bool:
+        with self._lock:
+            found = self._devices.pop(deviceid, None) is not None
+            if found:
+                self._flush()
+        return found
+
+    def import_devices(
+        self, devices: List[Dict[str, Any]]
+    ) -> Tuple[int, int]:
+        """Per-device fold that continues past bad entries, returning
+        (imported, errors) — emqx_gcp_device:import_devices/1."""
+        imported = errors = 0
+        for d in devices:
+            try:
+                self.put_device(d)
+                imported += 1
+            except (ValueError, TypeError, KeyError):
+                errors += 1
+        return imported, errors
+
+    def list_devices(self) -> List[Dict[str, Any]]:
+        return list(self._devices.values())
+
+    def clear(self) -> None:
+        with self._lock:
+            self._devices.clear()
+            self._flush()
+
+    def actual_keys(self, deviceid: str) -> Optional[List[str]]:
+        """Unexpired key PEMs, or None when the device is unknown
+        (emqx_gcp_device:get_device_actual_keys)."""
+        device = self._devices.get(deviceid)
+        if device is None:
+            return None
+        now = time.time()
+        return [
+            k["key"]
+            for k in device["keys"]
+            if not k["expires_at"] or k["expires_at"] >= now
+        ]
+
+
+class GcpDeviceAuthenticator(Authenticator):
+    def __init__(self, registry: GcpDeviceRegistry,
+                 leeway: float = 5.0) -> None:
+        self.registry = registry
+        self.leeway = leeway
+
+    @staticmethod
+    def _peek(
+        token: str,
+    ) -> Optional[Tuple[str, bytes, bytes, Dict[str, Any]]]:
+        """(alg, signing_input, signature, claims) without
+        verification, or None when the password is not JWT-shaped."""
+        try:
+            head_b64, body_b64, sig_b64 = token.split(".")
+            header = json.loads(_b64url_decode(head_b64))
+            alg = header.get("alg")
+            if not isinstance(alg, str):
+                return None
+            claims = json.loads(_b64url_decode(body_b64))
+            if not isinstance(claims, dict):
+                return None
+            return (alg, f"{head_b64}.{body_b64}".encode(),
+                    _b64url_decode(sig_b64), claims)
+        except (ValueError, json.JSONDecodeError):
+            return None
+
+    def authenticate(self, client: ClientInfo):
+        deviceid = deviceid_from_clientid(client.clientid or "")
+        if deviceid is None or not client.password:
+            return IGNORE, {}
+        peeked = self._peek(client.password.decode("utf-8", "replace"))
+        if peeked is None:
+            return IGNORE, {}  # not a JWT: let other providers try
+        alg, signing, sig, claims = peeked
+        exp = claims.get("exp")
+        if isinstance(exp, (int, float)) and \
+                time.time() > float(exp) + self.leeway:
+            return DENY, {}
+        keys = self.registry.actual_keys(deviceid)
+        if keys is None:
+            return IGNORE, {}  # unknown device: not ours to judge
+        for pem in keys:
+            if _verify_sig(pem.encode(), alg, signing, sig):
+                return ALLOW, {}
+        return DENY, {}

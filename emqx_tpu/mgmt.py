@@ -186,6 +186,17 @@ class MgmtApi:
         r.add_get("/api/v5/schema_registry", self.get_schemas)
         r.add_post("/api/v5/schema_registry", self.post_schema)
         r.add_delete("/api/v5/schema_registry/{name}", self.delete_schema)
+        r.add_get("/api/v5/gcp_devices", self.get_gcp_devices)
+        r.add_post("/api/v5/gcp_devices", self.post_gcp_devices)
+        r.add_get(
+            "/api/v5/gcp_devices/{deviceid:.+}", self.get_gcp_device
+        )
+        r.add_put(
+            "/api/v5/gcp_devices/{deviceid:.+}", self.put_gcp_device
+        )
+        r.add_delete(
+            "/api/v5/gcp_devices/{deviceid:.+}", self.delete_gcp_device
+        )
         r.add_get("/api/v5/gateways", self.get_gateways)
         r.add_get("/api/v5/plugins", self.get_plugins)
         r.add_get("/", self.dashboard)
@@ -693,6 +704,67 @@ class MgmtApi:
 
     async def get_plugins(self, request: web.Request) -> web.Response:
         return _json({"data": self.broker.plugins.info()})
+
+    # -------------------------------------------------- gcp devices
+
+    def _gcp_registry(self):
+        reg = self.broker.gcp_devices
+        if reg is None:
+            raise web.HTTPNotImplemented(
+                text=json.dumps({
+                    "code": "NOT_ENABLED",
+                    "message": "set gcp_device_enable: true",
+                }),
+                content_type="application/json",
+            )
+        return reg
+
+    async def get_gcp_devices(self, request: web.Request) -> web.Response:
+        devices = self._gcp_registry().list_devices()
+        return _json({"data": devices, "meta": {"count": len(devices)}})
+
+    async def post_gcp_devices(self, request: web.Request) -> web.Response:
+        """Bulk import (emqx_gcp_device:import_devices): a JSON list
+        of device objects."""
+        reg = self._gcp_registry()
+        try:
+            body = await request.json()
+            if not isinstance(body, list):
+                raise ValueError("expected a JSON list of devices")
+        except (ValueError, json.JSONDecodeError) as e:
+            return _json({"code": "BAD_REQUEST", "message": str(e)},
+                         status=400)
+        imported, errors = reg.import_devices(body)
+        return _json({"imported": imported, "errors": errors})
+
+    async def get_gcp_device(self, request: web.Request) -> web.Response:
+        device = self._gcp_registry().get_device(
+            request.match_info["deviceid"]
+        )
+        if device is None:
+            return _json({"code": "NOT_FOUND"}, status=404)
+        return _json(device)
+
+    async def put_gcp_device(self, request: web.Request) -> web.Response:
+        reg = self._gcp_registry()
+        try:
+            body = await request.json()
+            body["deviceid"] = request.match_info["deviceid"]
+            reg.put_device(body)
+        except (KeyError, TypeError, ValueError,
+                json.JSONDecodeError) as e:
+            return _json({"code": "BAD_REQUEST", "message": str(e)},
+                         status=400)
+        return _json(reg.get_device(request.match_info["deviceid"]))
+
+    async def delete_gcp_device(
+        self, request: web.Request
+    ) -> web.Response:
+        if not self._gcp_registry().remove_device(
+            request.match_info["deviceid"]
+        ):
+            return _json({"code": "NOT_FOUND"}, status=404)
+        return web.Response(status=204)
 
     async def dashboard(self, request: web.Request) -> web.Response:
         """The web dashboard: a single self-contained HTML app (see

@@ -1,0 +1,187 @@
+"""GCP IoT-Core compat devices (emqx_gcp_device parity): registry
+CRUD over REST, and JWT-per-connect authentication with the device's
+registered RS256/ES256 public key."""
+
+import asyncio
+import base64
+import json
+import tempfile
+import time
+
+from emqx_tpu.broker.listener import BrokerServer
+from emqx_tpu.config import BrokerConfig, ListenerConfig
+from mqtt_client import TestClient
+
+_MGMT_TMP = tempfile.TemporaryDirectory(prefix="emqx-gcp-")
+
+CLIENTID = (
+    "projects/p1/locations/us-central1/registries/reg1/devices/dev1"
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _b64url(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def _make_keypair():
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    pub_pem = key.public_key().public_bytes(
+        serialization.Encoding.PEM,
+        serialization.PublicFormat.SubjectPublicKeyInfo,
+    ).decode()
+    return key, pub_pem
+
+
+def _rs256_jwt(key, claims) -> str:
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import padding
+
+    head = _b64url(json.dumps({"alg": "RS256", "typ": "JWT"}).encode())
+    body = _b64url(json.dumps(claims).encode())
+    sig = key.sign(
+        f"{head}.{body}".encode(), padding.PKCS1v15(), hashes.SHA256()
+    )
+    return f"{head}.{body}.{_b64url(sig)}"
+
+
+def test_deviceid_parse():
+    from emqx_tpu.gcp_device import deviceid_from_clientid
+
+    assert deviceid_from_clientid(CLIENTID) == "dev1"
+    assert deviceid_from_clientid("ordinary-client") is None
+    assert deviceid_from_clientid("projects/p/devices/d") is None
+    assert deviceid_from_clientid(
+        "projects/p/locations/l/registries/r/devices/"
+    ) is None
+
+
+def test_gcp_device_jwt_connect():
+    """A registered device connects with a fresh RS256 JWT; a wrong
+    key or an expired JWT is rejected (authn.erl's decision ladder)."""
+    key, pub_pem = _make_keypair()
+    wrong_key, _ = _make_keypair()
+
+    async def t():
+        cfg = BrokerConfig()
+        cfg.listeners = [ListenerConfig(port=0)]
+        cfg.auth.allow_anonymous = False
+        cfg.gcp_device_enable = True
+        cfg.gcp_device_file = tempfile.mktemp(
+            suffix=".json", dir=_MGMT_TMP.name
+        )
+        srv = BrokerServer(cfg)
+        await srv.start()
+        port = srv.listeners[0].port
+        srv.broker.gcp_devices.put_device({
+            "deviceid": "dev1",
+            "keys": [{"key_type": "RSA_PEM", "key": pub_pem,
+                      "expires_at": 0}],
+            "project": "p1", "location": "us-central1",
+            "registry": "reg1",
+        })
+
+        good = _rs256_jwt(key, {"aud": "p1",
+                                "exp": int(time.time()) + 300})
+        c = TestClient(port, CLIENTID)
+        ack = await c.connect(password=good.encode())
+        assert ack.reason_code == 0
+        await c.disconnect()
+
+        # wrong key -> rejected
+        bad = _rs256_jwt(wrong_key, {"exp": int(time.time()) + 300})
+        c2 = TestClient(port, CLIENTID)
+        ack2 = await c2.connect(password=bad.encode())
+        assert ack2.reason_code != 0
+        await c2.close()
+
+        # expired JWT -> rejected even with the right key
+        stale = _rs256_jwt(key, {"exp": int(time.time()) - 300})
+        c3 = TestClient(port, CLIENTID)
+        ack3 = await c3.connect(password=stale.encode())
+        assert ack3.reason_code != 0
+        await c3.close()
+
+        # expired KEY -> rejected (actual_keys filters it out)
+        srv.broker.gcp_devices.put_device({
+            "deviceid": "dev1",
+            "keys": [{"key_type": "RSA_PEM", "key": pub_pem,
+                      "expires_at": time.time() - 10}],
+        })
+        c4 = TestClient(port, CLIENTID)
+        ack4 = await c4.connect(password=good.encode())
+        assert ack4.reason_code != 0
+        await c4.close()
+        await srv.stop()
+
+    run(t())
+
+
+def test_gcp_device_registry_persistence_and_rest():
+    key, pub_pem = _make_keypair()
+
+    async def t():
+        import aiohttp
+
+        from api_helper import auth_session
+
+        cfg = BrokerConfig()
+        cfg.listeners = [ListenerConfig(port=0)]
+        cfg.api.enable = True
+        cfg.api.port = 0
+        cfg.api.data_dir = tempfile.mkdtemp(dir=_MGMT_TMP.name)
+        cfg.gcp_device_enable = True
+        cfg.gcp_device_file = tempfile.mktemp(
+            suffix=".json", dir=_MGMT_TMP.name
+        )
+        srv = BrokerServer(cfg)
+        await srv.start()
+        http, api = await auth_session(srv)
+        async with http:
+            async with http.post(api + "/api/v5/gcp_devices", json=[
+                {"deviceid": "d1",
+                 "keys": [{"key": pub_pem, "expires_at": 0}]},
+                {"deviceid": "d2", "keys": []},
+                {"keys": "not-a-device"},
+            ]) as r:
+                out = await r.json()
+                # bad entries are skipped, not aborting the batch
+                assert out["imported"] == 2 and out["errors"] == 1
+            # malformed key objects are a 400, not a 500
+            async with http.put(
+                api + "/api/v5/gcp_devices/dX",
+                json={"keys": ["bare-string"]},
+            ) as r:
+                assert r.status == 400
+            async with http.get(api + "/api/v5/gcp_devices") as r:
+                assert (await r.json())["meta"]["count"] == 2
+            async with http.put(
+                api + "/api/v5/gcp_devices/d3",
+                json={"keys": [{"key": pub_pem}]},
+            ) as r:
+                assert (await r.json())["deviceid"] == "d3"
+            async with http.delete(
+                api + "/api/v5/gcp_devices/d2"
+            ) as r:
+                assert r.status == 204
+            async with http.get(
+                api + "/api/v5/gcp_devices/d2"
+            ) as r:
+                assert r.status == 404
+        await srv.stop()
+
+        # the registry file survives a restart
+        srv2 = BrokerServer(cfg)
+        await srv2.start()
+        assert srv2.broker.gcp_devices.get_device("d1") is not None
+        assert srv2.broker.gcp_devices.get_device("d3") is not None
+        assert srv2.broker.gcp_devices.get_device("d2") is None
+        await srv2.stop()
+
+    run(t())
