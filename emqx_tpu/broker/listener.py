@@ -402,6 +402,16 @@ class BrokerServer:
                         int(k): v
                         for k, v in gw_cfg.get("predefined", {}).items()
                     },
+                    advertise_interval=float(
+                        gw_cfg.get("advertise_interval", 0.0)
+                    ),
+                    broadcast_addr=gw_cfg.get(
+                        "broadcast_addr", "255.255.255.255"
+                    ),
+                    advertise_port=(
+                        int(gw_cfg["advertise_port"])
+                        if "advertise_port" in gw_cfg else None
+                    ),
                 )
             )
         elif kind == "coap":

@@ -43,15 +43,25 @@ DELETED = 0x42  # 2.02
 VALID = 0x43  # 2.03
 CHANGED = 0x44  # 2.04
 CONTENT = 0x45  # 2.05
+CONTINUE = 0x5F  # 2.31 (RFC 7959)
 BAD_REQUEST = 0x80  # 4.00
 UNAUTHORIZED = 0x81  # 4.01
 NOT_FOUND = 0x84  # 4.04
+ENTITY_INCOMPLETE = 0x88  # 4.08 (RFC 7959)
+ENTITY_TOO_LARGE = 0x8D  # 4.13
 
 # option numbers
 OPT_OBSERVE = 6
 OPT_URI_PATH = 11
 OPT_CONTENT_FORMAT = 12
 OPT_URI_QUERY = 15
+OPT_BLOCK1 = 27  # RFC 7959 request-payload blockwise transfer
+
+
+def _parse_block(v: bytes) -> Tuple[int, bool, int]:
+    """Block option value -> (num, more, szx); empty = block 0."""
+    n = int.from_bytes(v, "big") if v else 0
+    return n >> 4, bool(n & 0x08), n & 0x07
 
 
 @dataclass
@@ -189,6 +199,32 @@ class CoapChannel(GatewayChannel):
         # recent notification message id -> filter, so an RST cancels
         # only the observation it responds to (RFC 7641 §3.6)
         self._note_mids: Dict[int, str] = {}
+        # Block1 assembly buffers: (token, topic) -> partial payload,
+        # charged against the GATEWAY-wide budget (spoofed sources can
+        # mint channels freely, so per-channel caps alone don't bound
+        # memory); completed transfers remembered for dup final blocks
+        self._block_bufs: Dict[Tuple[bytes, str], bytearray] = {}
+        self._block_done: Dict[Tuple[bytes, str], int] = {}
+
+    def _blk_charge(self, n: int) -> bool:
+        gw = self.gateway
+        if gw._block_total + n > gw.block_budget:
+            return False
+        gw._block_total += n
+        return True
+
+    def _blk_credit(self, n: int) -> None:
+        self.gateway._block_total -= n
+
+    def _blk_drop(self, key) -> None:
+        buf = self._block_bufs.pop(key, None)
+        if buf is not None:
+            self._blk_credit(len(buf))
+
+    def connection_lost(self, reason: str) -> None:
+        for key in list(self._block_bufs):
+            self._blk_drop(key)
+        super().connection_lost(reason)
 
     def _alloc_mid(self) -> int:
         self._next_mid = (self._next_mid + 1) % 0x10000
@@ -277,19 +313,75 @@ class CoapChannel(GatewayChannel):
         if not self.broker.access.authorize(self.client, PUBLISH, topic):
             self._reply(m, UNAUTHORIZED)
             return
+        payload = m.payload
+        b1 = m.opt(OPT_BLOCK1)
+        if b1 is not None:
+            # RFC 7959 Block1: a constrained writer streams a large
+            # payload in 16..1024-byte blocks; the assembled whole is
+            # published once the final (M=0) block lands
+            num, more, szx = _parse_block(b1)
+            size = 16 << szx
+            key = (bytes(m.token), topic)
+            buf = self._block_bufs.get(key)
+            if buf is not None and len(buf) == (num + 1) * size:
+                # duplicate of the last block (our 2.31 ACK was lost
+                # and the CON retransmitted): re-ACK, don't re-append
+                if more:
+                    self._reply(m, CONTINUE, options=[(OPT_BLOCK1, b1)])
+                    return
+            elif self._block_done.get(key) == num and buf is None:
+                # retransmitted FINAL block after the publish: re-ACK
+                # without publishing a duplicate
+                self._reply(m, CHANGED, options=[(OPT_BLOCK1, b1)])
+                return
+            elif num == 0:
+                if buf is None and len(self._block_bufs) >= 4:
+                    self._reply(m, ENTITY_TOO_LARGE)
+                    return  # per-peer concurrent-assembly cap
+                if buf is not None:
+                    self._blk_credit(len(buf))
+                buf = self._block_bufs[key] = bytearray()
+                self._block_done.pop(key, None)
+            elif buf is None or len(buf) != num * size:
+                # out-of-order / unknown transfer (§2.5)
+                self._blk_drop(key)
+                self._reply(m, ENTITY_INCOMPLETE)
+                return
+            if buf is not None and len(buf) != (num + 1) * size:
+                if not self._blk_charge(len(m.payload)):
+                    self._blk_drop(key)
+                    self._reply(m, ENTITY_TOO_LARGE)
+                    return
+                buf += m.payload
+            if len(buf) > self.broker.config.mqtt.max_packet_size:
+                self._blk_drop(key)
+                self._reply(m, ENTITY_TOO_LARGE)
+                return
+            if more:
+                self._reply(m, CONTINUE, options=[(OPT_BLOCK1, b1)])
+                return
+            self._blk_credit(len(buf))
+            self._block_bufs.pop(key)
+            self._block_done[key] = num
+            if len(self._block_done) > 16:
+                self._block_done.pop(next(iter(self._block_done)))
+            payload = bytes(buf)
         q = m.queries
         try:
             qos = min(max(int(q.get("qos", "0")), 0), 2)
         except ValueError:
             qos = 0
         msg = Message(
-            topic=topic, payload=m.payload, qos=qos,
+            topic=topic, payload=payload, qos=qos,
             retain=q.get("retain") in ("true", "1"),
             from_client=self.clientid,
             from_username=self.client.username if self.client else None,
         )
         self.broker_publish(msg)
-        self._reply(m, CHANGED)
+        self._reply(
+            m, CHANGED,
+            options=[(OPT_BLOCK1, b1)] if b1 is not None else None,
+        )
 
     def _handle_subscribe(self, m: CoapMessage, flt: str) -> None:
         if not self.broker.access.authorize(self.client, SUBSCRIBE, flt):
@@ -354,3 +446,11 @@ class CoapGateway(UdpGateway):
     name = "coap"
     frame_class = CoapCodec
     channel_class = CoapChannel
+    # gateway-wide Block1 assembly budget: abandoned transfers from
+    # spoofed sources pin at most this much until the idle reaper runs
+    block_budget = 32 * 1024 * 1024
+
+    def __init__(self, broker, bind: str = "0.0.0.0",
+                 port: int = 0) -> None:
+        super().__init__(broker, bind, port)
+        self._block_total = 0

@@ -16,6 +16,7 @@ doesn't know the topic id yet)."""
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import secrets
 import struct
@@ -617,7 +618,65 @@ class MqttSnGateway(UdpGateway):
     channel_class = SnChannel
 
     def __init__(self, broker, bind: str = "0.0.0.0", port: int = 0,
-                 predefined: Optional[Dict[int, str]] = None) -> None:
+                 predefined: Optional[Dict[int, str]] = None,
+                 advertise_interval: float = 0.0,
+                 broadcast_addr: str = "255.255.255.255",
+                 advertise_port: Optional[int] = None) -> None:
         super().__init__(broker, bind, port)
         # predefined topic ids (gateway.mqttsn.predefined config table)
         self.predefined: Dict[int, str] = dict(predefined or {})
+        # gateway ADVERTISE broadcast (spec §6.1 / the reference's
+        # mqttsn broadcast option): clients on the segment discover
+        # the gateway passively; 0 disables (SEARCHGW still answered).
+        # advertise_port defaults to the gateway's own port (clients
+        # listen where they'd talk).
+        self.advertise_interval = float(advertise_interval)
+        self.broadcast_addr = broadcast_addr
+        self.advertise_port = advertise_port
+        self._advertiser: Optional[asyncio.Task] = None
+
+    async def start(self) -> None:
+        await super().start()
+        if self.advertise_interval > 0:
+            import socket as _socket
+
+            sock = self._transport.get_extra_info("socket")
+            if sock is not None:
+                sock.setsockopt(
+                    _socket.SOL_SOCKET, _socket.SO_BROADCAST, 1
+                )
+            self._advertiser = asyncio.get_running_loop().create_task(
+                self._advertise_loop()
+            )
+
+    async def stop(self) -> None:
+        if self._advertiser is not None:
+            self._advertiser.cancel()
+            try:
+                await self._advertiser
+            except asyncio.CancelledError:
+                pass
+            self._advertiser = None
+        await super().stop()
+
+    async def _advertise_loop(self) -> None:
+        # duration tells clients when to expect the NEXT advertise
+        # (spec: T_ADV); rounded UP so a sub-second interval never
+        # advertises 0 (= "already stale"), capped to the u16 field
+        import math
+
+        frame = SnFrame(
+            ADVERTISE,
+            gw_id=GATEWAY_ID,
+            duration=min(
+                max(1, math.ceil(self.advertise_interval)), 0xFFFF
+            ),
+        )
+        data = self.frame.serialize(frame)
+        target = (self.broadcast_addr, self.advertise_port or self.port)
+        while True:
+            try:
+                self._transport.sendto(data, target)
+            except OSError:
+                log.debug("mqttsn advertise send failed", exc_info=True)
+            await asyncio.sleep(self.advertise_interval)

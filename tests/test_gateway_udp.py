@@ -245,6 +245,51 @@ def test_mqttsn_will_fires_on_drop():
     run(t())
 
 
+def test_mqttsn_advertise_broadcast():
+    """The gateway ADVERTISEs itself periodically (spec §6.1): a
+    listener socket on the advertise target receives gw_id+duration."""
+
+    async def t():
+        import socket as _socket
+
+        loop = asyncio.get_running_loop()
+        frames: asyncio.Queue = asyncio.Queue()
+
+        class _Listener(asyncio.DatagramProtocol):
+            def datagram_received(self, data, addr):
+                got, _ = SN.SnCodec().parse(
+                    SN.SnCodec().initial_state(), data
+                )
+                for f in got:
+                    frames.put_nowait(f)
+
+        transport, _ = await loop.create_datagram_endpoint(
+            _Listener, local_addr=("127.0.0.1", 0)
+        )
+        adv_port = transport.get_extra_info("sockname")[1]
+
+        # unicast loopback stands in for the broadcast segment
+        srv = await make_server([{
+            "type": "mqttsn", "bind": "127.0.0.1", "port": 0,
+            "advertise_interval": 0.1,
+            "broadcast_addr": "127.0.0.1",
+            "advertise_port": adv_port,
+        }])
+
+        adv = await asyncio.wait_for(frames.get(), 3.0)
+        assert adv.msg_type == SN.ADVERTISE
+        assert adv.gw_id == SN.GATEWAY_ID
+        # T_ADV is rounded UP: a 0.1s interval must not advertise 0
+        # ("already stale") to conforming clients
+        assert adv.duration == 1
+        adv2 = await asyncio.wait_for(frames.get(), 3.0)  # periodic
+        assert adv2.msg_type == SN.ADVERTISE
+        transport.close()
+        await srv.stop()
+
+    run(t())
+
+
 def test_mqttsn_malformed_datagram_is_ignored():
     async def t():
         srv = await make_server(
@@ -269,12 +314,18 @@ def test_mqttsn_malformed_datagram_is_ignored():
 
 
 def coap_msg(code, path, *, mtype=CO.CON, mid=1, token=b"\x01",
-             queries=(), observe=None, payload=b""):
+             queries=(), observe=None, payload=b"", block1=None):
     opts = [(CO.OPT_URI_PATH, seg.encode()) for seg in path.split("/")]
     opts += [(CO.OPT_URI_QUERY, q.encode()) for q in queries]
     if observe is not None:
         opts.append((CO.OPT_OBSERVE,
                      observe.to_bytes(1, "big") if observe else b""))
+    if block1 is not None:
+        num, more, szx = block1
+        v = (num << 4) | (0x08 if more else 0) | szx
+        opts.append((CO.OPT_BLOCK1,
+                     v.to_bytes(max(1, (v.bit_length() + 7) // 8),
+                                "big")))
     return CO.CoapMessage(mtype, code, mid, token, opts, payload)
 
 
@@ -326,6 +377,80 @@ def test_coap_publish_subscribe():
 
         c.close()
         await m.close()
+        await srv.stop()
+
+    run(t())
+
+
+def test_coap_block1_large_publish():
+    """RFC 7959 Block1: a large payload arrives in 16-byte blocks,
+    each non-final block gets 2.31 Continue, and the assembled whole
+    is published once; out-of-order restarts get 4.08."""
+
+    async def t():
+        srv = await make_server(
+            [{"type": "coap", "bind": "127.0.0.1", "port": 0}]
+        )
+        gw = srv.broker.gateways.get("coap")
+        m = TestClient(srv.listeners[0].port, "cm-blk")
+        await m.connect()
+        await m.subscribe("co/big")
+
+        c = await UdpTestClient(gw.port, CO.CoapCodec()).start()
+        body = bytes(range(48))  # 3 blocks of 16 (szx=0)
+        for num in range(3):
+            more = num < 2
+            c.send(coap_msg(
+                CO.PUT, "ps/co/big", mid=20 + num, token=b"\x07",
+                queries=["clientid=coapB"],
+                payload=body[num * 16:(num + 1) * 16],
+                block1=(num, more, 0),
+            ))
+            ack = await c.expect(CO.ACK)
+            assert ack.code == (CO.CONTINUE if more else CO.CHANGED)
+        pub = await m.recv_publish()
+        assert pub.topic == "co/big" and pub.payload == body
+
+        # a mid-transfer block with no transfer in flight -> 4.08
+        c.send(coap_msg(
+            CO.PUT, "ps/co/big", mid=30, token=b"\x08",
+            queries=["clientid=coapB"], payload=b"x" * 16,
+            block1=(2, True, 0),
+        ))
+        ack = await c.expect(CO.ACK)
+        assert ack.code == CO.ENTITY_INCOMPLETE
+
+        # retransmits (lost ACKs, RFC 7252 §4.2) must not abort the
+        # transfer or double-publish
+        body2 = bytes(range(32))
+        c.send(coap_msg(CO.PUT, "ps/co/big", mid=40, token=b"\x09",
+                        queries=["clientid=coapB"],
+                        payload=body2[:16], block1=(0, True, 0)))
+        assert (await c.expect(CO.ACK)).code == CO.CONTINUE
+        # duplicate of block 0: re-ACKed, not treated as out-of-order
+        c.send(coap_msg(CO.PUT, "ps/co/big", mid=40, token=b"\x09",
+                        queries=["clientid=coapB"],
+                        payload=body2[:16], block1=(0, True, 0)))
+        assert (await c.expect(CO.ACK)).code == CO.CONTINUE
+        c.send(coap_msg(CO.PUT, "ps/co/big", mid=41, token=b"\x09",
+                        queries=["clientid=coapB"],
+                        payload=body2[16:], block1=(1, False, 0)))
+        assert (await c.expect(CO.ACK)).code == CO.CHANGED
+        pub2 = await m.recv_publish()
+        assert pub2.payload == body2
+        # duplicate FINAL block: re-ACK CHANGED, no second publish
+        c.send(coap_msg(CO.PUT, "ps/co/big", mid=41, token=b"\x09",
+                        queries=["clientid=coapB"],
+                        payload=body2[16:], block1=(1, False, 0)))
+        assert (await c.expect(CO.ACK)).code == CO.CHANGED
+        try:
+            dup = await m.recv_publish(timeout=0.4)
+            raise AssertionError(f"duplicate publish: {dup!r}")
+        except asyncio.TimeoutError:
+            pass
+
+        c.close()
+        await m.disconnect()
         await srv.stop()
 
     run(t())
