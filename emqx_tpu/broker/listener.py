@@ -424,6 +424,19 @@ class BrokerServer:
                     port=int(gw_cfg.get("port", 5683)),
                 )
             )
+        elif kind == "lwm2m":
+            from ..gateway.lwm2m import Lwm2mGateway
+
+            await self.broker.gateways.load(
+                Lwm2mGateway(
+                    self.broker,
+                    bind=gw_cfg.get("bind", "0.0.0.0"),
+                    port=int(gw_cfg.get("port", 5783)),
+                    mountpoint=gw_cfg.get("mountpoint", "lwm2m/{ep}/"),
+                    translators=gw_cfg.get("translators"),
+                    qos=int(gw_cfg.get("qos", 0)),
+                )
+            )
         elif kind == "exproto":
             from ..gateway.exproto import ExprotoGateway
 

@@ -1,0 +1,353 @@
+"""LwM2M gateway: device management over CoAP, bridged to MQTT.
+
+The `emqx_gateway_lwm2m` role (/root/reference/apps/emqx_gateway_lwm2m/
+src/emqx_lwm2m_session.erl:93 `?PREFIX rd`, emqx_lwm2m_cmd.erl:44-196
+mqtt_to_coap/coap_to_mqtt): devices register over the OMA LwM2M
+registration interface (CoAP POST /rd), the gateway opens an MQTT
+session under the endpoint name, and device management flows as JSON
+over MQTT topics — commands arrive on the downlink topic
+(``lwm2m/{ep}/dn/#``) as ``{"reqID", "msgType":
+read|write|execute|discover|observe|cancel-observe, "data": {"path":
+"/3/0/0", ...}}``, are translated to CoAP requests to the device, and
+responses/notifications are published to the uplink topics
+(``up/resp`` / ``up/notify``).
+
+Scope: the registration interface (register/update/deregister), the
+device-management command bridge, and observe notifications.  Payloads
+cross raw (UTF-8 when possible, base64 otherwise) — the reference's
+TLV/JSON content decoding (emqx_lwm2m_tlv.erl) and XML object DB are
+not modelled; DTLS is unavailable (Python `ssl` has no DTLS).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import secrets
+import time
+from typing import Dict, Optional, Tuple
+
+from ..access import ClientInfo
+from ..message import Message
+from . import GatewayChannel, UdpGateway
+from .coap import (
+    ACK,
+    BAD_REQUEST,
+    CHANGED,
+    CON,
+    CoapCodec,
+    CoapMessage,
+    CONTENT,
+    CREATED,
+    DELETE,
+    DELETED,
+    GET,
+    NON,
+    NOT_FOUND,
+    OPT_CONTENT_FORMAT,
+    OPT_OBSERVE,
+    OPT_URI_PATH,
+    OPT_URI_QUERY,
+    POST,
+    PUT,
+    RST,
+    _encode_uint,
+)
+
+log = logging.getLogger("emqx_tpu.gateway.lwm2m")
+
+OPT_LOCATION_PATH = 8
+
+# msgType -> CoAP method (emqx_lwm2m_cmd.erl mqtt_to_coap clauses)
+_CMD_METHODS = {
+    "read": GET,
+    "discover": GET,
+    "write": PUT,
+    "write-attr": PUT,
+    "execute": POST,
+    "create": POST,
+    "delete": DELETE,
+    "observe": GET,
+    "cancel-observe": GET,
+}
+
+_CODE_NAMES = {
+    CREATED: "2.01", DELETED: "2.02", 0x43: "2.03", CHANGED: "2.04",
+    CONTENT: "2.05", BAD_REQUEST: "4.00", 0x81: "4.01", 0x84: "4.04",
+    0x85: "4.05",
+}
+
+
+def _code_name(code: int) -> str:
+    return _CODE_NAMES.get(code, f"{code >> 5}.{code & 0x1F:02d}")
+
+
+def _payload_json(data: bytes):
+    """Raw device payload -> JSON-safe value."""
+    try:
+        return data.decode("utf-8")
+    except UnicodeDecodeError:
+        return {"base64": base64.b64encode(data).decode()}
+
+
+class Lwm2mChannel(GatewayChannel):
+    """One device (one UDP peer): registration state + in-flight
+    device-management requests (token -> originating command)."""
+
+    def __init__(self, gateway, write, close, peer) -> None:
+        super().__init__(gateway, write, close, peer)
+        self.codec: CoapCodec = gateway.frame
+        self.endpoint: Optional[str] = None
+        self.location: Optional[str] = None
+        self.lifetime = 86400
+        # registered devices stay reachable for their LwM2M lifetime,
+        # not the UDP gateway's short idle default (reaper honors this)
+        self.idle_deadline: Optional[float] = None
+        self._next_mid = secrets.randbelow(0xFFFF)
+        # token -> command dict awaiting the device's response
+        self._pending: Dict[bytes, dict] = {}
+        # observed path -> token (so cancel-observe reuses it)
+        self._observes: Dict[str, bytes] = {}
+
+    # ------------------------------------------------------- helpers
+
+    def _alloc_mid(self) -> int:
+        self._next_mid = (self._next_mid + 1) % 0x10000
+        return self._next_mid
+
+    def _reply(self, req: CoapMessage, code: int, options=None,
+               payload: bytes = b"") -> None:
+        rtype = ACK if req.type == CON else NON
+        mid = req.message_id if req.type == CON else self._alloc_mid()
+        self.write(self.codec.serialize(CoapMessage(
+            rtype, code, mid, req.token, options or [], payload)))
+
+    def _uplink(self, kind: str, body: dict) -> None:
+        """Publish to the mounted uplink topic (translators.response /
+        .notify / .register / .update); ACL-checked like every other
+        gateway's publish path."""
+        from ..access import PUBLISH
+
+        gw = self.gateway
+        topic = f"{gw.mountpoint.format(ep=self.endpoint)}" \
+                f"{gw.translators.get(kind, 'up/resp')}"
+        if not self.broker.access.authorize(self.client, PUBLISH, topic):
+            self.broker.metrics.inc("authorization.deny")
+            return
+        self.broker_publish(Message(
+            topic=topic,
+            payload=json.dumps(body).encode(),
+            qos=gw.qos, from_client=self.clientid,
+        ))
+
+    # -------------------------------------------------- registration
+
+    def handle_frame(self, m: CoapMessage) -> None:
+        if m.type == RST:
+            return
+        if m.token and m.token in self._pending:
+            self._on_device_response(m)
+            return
+        if m.type == ACK or m.code == 0:
+            if m.type == CON and m.code == 0:
+                self.write(self.codec.serialize(
+                    CoapMessage(RST, 0, m.message_id, b"")))
+            return
+        path = m.uri_path
+        if not path or path[0] != "rd":
+            self._reply(m, NOT_FOUND)
+            return
+        if m.code == POST and len(path) == 1:
+            self._register(m)
+        elif m.code == POST and len(path) == 2:
+            self._update(m, path[1])
+        elif m.code == DELETE and len(path) == 2:
+            self._deregister(m, path[1])
+        else:
+            self._reply(m, BAD_REQUEST)
+
+    def _register(self, m: CoapMessage) -> None:
+        q = m.queries
+        ep = q.get("ep")
+        if not ep:
+            self._reply(m, BAD_REQUEST)
+            return
+        client = ClientInfo(clientid=ep, peerhost=self.peer)
+        if self.broker.banned.is_banned(
+            clientid=ep, peerhost=self.peer.rsplit(":", 1)[0]
+        ):
+            self._reply(m, BAD_REQUEST)
+            return
+        ok, client = self.broker.access.authenticate(client)
+        if not ok:
+            self._reply(m, 0x81)  # 4.01
+            return
+        gw = self.gateway
+        flt = f"{gw.mountpoint.format(ep=ep)}{gw.translators['command']}"
+        from ..access import SUBSCRIBE
+
+        if not self.broker.access.authorize(client, SUBSCRIBE, flt):
+            self._reply(m, 0x81)  # 4.01: authenticated but not allowed
+            return
+        self.client = client
+        self.endpoint = ep
+        self.lifetime = int(q.get("lt", "86400") or 86400)
+        self.idle_deadline = time.monotonic() + self.lifetime * 1.5
+        self.location = secrets.token_hex(4)
+        self.open_session(ep, clean_start=True)
+        # commands for this device arrive on the downlink filter
+        from ..broker.session import SubOpts
+
+        opts = SubOpts(qos=gw.qos)
+        is_new = self.session.subscribe(flt, opts)
+        self.broker.subscribe(ep, flt, opts, is_new_sub=is_new)
+        objects = m.payload.decode("utf-8", "replace") if m.payload \
+            else ""
+        self._uplink("register", {
+            "msgType": "register",
+            "data": {
+                "ep": ep, "lt": self.lifetime,
+                "lwm2m": q.get("lwm2m", "1.0"),
+                "objectList": [
+                    o.strip().strip("<>")
+                    for o in objects.split(",") if o.strip()
+                ],
+            },
+        })
+        self._reply(m, CREATED, options=[
+            (OPT_LOCATION_PATH, b"rd"),
+            (OPT_LOCATION_PATH, self.location.encode()),
+        ])
+
+    def _update(self, m: CoapMessage, loc: str) -> None:
+        if loc != self.location or self.endpoint is None:
+            self._reply(m, NOT_FOUND)
+            return
+        lt = m.queries.get("lt")
+        if lt:
+            self.lifetime = int(lt)
+        self.idle_deadline = time.monotonic() + self.lifetime * 1.5
+        self._uplink("update", {
+            "msgType": "update",
+            "data": {"ep": self.endpoint, "lt": self.lifetime},
+        })
+        self._reply(m, CHANGED)
+
+    def _deregister(self, m: CoapMessage, loc: str) -> None:
+        if loc != self.location:
+            self._reply(m, NOT_FOUND)
+            return
+        self._reply(m, DELETED)
+        self.close("deregistered")
+
+    # ------------------------------------------- command bridge (dn)
+
+    def deliver(self, packets) -> None:
+        for pkt in packets:
+            try:
+                cmd = json.loads(pkt.payload)
+                self._send_command(cmd)
+            except (ValueError, KeyError, TypeError,
+                    AttributeError) as exc:
+                # malformed command must never escape into the
+                # broker's delivery fan-out — error goes back uplink
+                log.debug("lwm2m bad command: %s", exc)
+                self._uplink("response", {
+                    "msgType": "error",
+                    "data": {"reason": str(exc)},
+                })
+
+    def _send_command(self, cmd: dict) -> None:
+        mtype = cmd["msgType"]
+        method = _CMD_METHODS[mtype]
+        data = cmd.get("data", {})
+        path = str(data.get("path", "")).strip("/")
+        token = secrets.token_bytes(4)
+        options = [(OPT_URI_PATH, seg.encode())
+                   for seg in path.split("/") if seg]
+        payload = b""
+        if mtype == "observe":
+            options.append((OPT_OBSERVE, b""))  # register (0)
+            # a re-observe of the same path supersedes the old one:
+            # drop its pending entry so stale-token notifications stop
+            old = self._observes.pop(path, None)
+            if old is not None:
+                self._pending.pop(old, None)
+            self._observes[path] = token
+        elif mtype == "cancel-observe":
+            options.append((OPT_OBSERVE, _encode_uint(1)))
+            token = self._observes.pop(path, token)
+        elif mtype in ("write", "create"):
+            value = data.get("value", "")
+            payload = value.encode() if isinstance(value, str) \
+                else json.dumps(value).encode()
+            options.append((OPT_CONTENT_FORMAT, b""))  # text/plain
+        elif mtype == "execute":
+            payload = str(data.get("args", "")).encode()
+        elif mtype == "write-attr":
+            for attr in ("pmin", "pmax", "gt", "lt", "st"):
+                if attr in data:
+                    options.append((
+                        OPT_URI_QUERY,
+                        f"{attr}={data[attr]}".encode(),
+                    ))
+        elif mtype == "discover":
+            pass  # GET with Accept link-format; raw GET suffices here
+        self._pending[token] = cmd
+        self.write(self.codec.serialize(CoapMessage(
+            CON, method, self._alloc_mid(), token, options, payload)))
+
+    def _on_device_response(self, m: CoapMessage) -> None:
+        cmd = self._pending.get(m.token)
+        if cmd is None:
+            return
+        if cmd.get("msgType") == "observe":
+            # the observe stays pending: every notification reuses the
+            # token; the FIRST response answers the command, the rest
+            # are notifications (emqx_lwm2m_cmd coap_to_mqtt observe)
+            is_notify = bool(cmd.get("_answered"))
+            cmd["_answered"] = True
+        else:
+            is_notify = False
+            self._pending.pop(m.token, None)
+        body = {
+            "reqID": cmd.get("reqID"),
+            "msgType": cmd.get("msgType"),
+            "data": {
+                "code": _code_name(m.code),
+                "reqPath": cmd.get("data", {}).get("path"),
+                "content": _payload_json(m.payload),
+            },
+        }
+        self._uplink("notify" if is_notify else "response", body)
+        if m.type == CON:
+            self.write(self.codec.serialize(
+                CoapMessage(ACK, 0, m.message_id, b"")))
+
+    def connection_lost(self, reason: str) -> None:
+        self._pending.clear()
+        super().connection_lost(reason)
+
+
+class Lwm2mGateway(UdpGateway):
+    name = "lwm2m"
+    frame_class = CoapCodec
+    channel_class = Lwm2mChannel
+
+    def __init__(self, broker, bind: str = "0.0.0.0", port: int = 0,
+                 mountpoint: str = "lwm2m/{ep}/",
+                 translators: Optional[Dict[str, str]] = None,
+                 qos: int = 0) -> None:
+        super().__init__(broker, bind, port)
+        self.mountpoint = mountpoint
+        # relative topics under the mountpoint (gateway.lwm2m.translators)
+        self.translators = {
+            "command": "dn/#",
+            "response": "up/resp",
+            "register": "up/resp",
+            "update": "up/resp",
+            "notify": "up/notify",
+            **(translators or {}),
+        }
+        self.qos = max(0, min(int(qos), 2))

@@ -3,6 +3,7 @@ clients through the broker core (emqx_gateway_mqttsn /
 emqx_gateway_coap parity)."""
 
 import asyncio
+import json
 import struct
 
 from emqx_tpu.broker.listener import BrokerServer
@@ -470,6 +471,98 @@ def test_coap_not_found_and_garbage():
         assert rsp.code == CO.NOT_FOUND
         assert len(gw._channels) == 1  # garbage registered nothing
         c.close()
+        await srv.stop()
+
+    run(t())
+
+
+# -------------------------------------------------------------- LwM2M
+
+
+def test_lwm2m_register_command_observe():
+    """Register over POST /rd, drive a read command dn->device->up,
+    observe with notifications, then deregister (emqx_gateway_lwm2m
+    registration + dm-bridge parity)."""
+    from emqx_tpu.gateway import lwm2m as LW
+
+    async def t():
+        srv = await make_server(
+            [{"type": "lwm2m", "bind": "127.0.0.1", "port": 0}]
+        )
+        gw = srv.broker.gateways.get("lwm2m")
+        m = TestClient(srv.listeners[0].port, "dm-app")
+        await m.connect()
+        await m.subscribe("lwm2m/ep-1/up/#", qos=0)
+
+        dev = await UdpTestClient(gw.port, CO.CoapCodec()).start()
+        # -------- register
+        dev.send(coap_msg(
+            CO.POST, "rd", mid=1, token=b"\x11",
+            queries=["ep=ep-1", "lt=120", "lwm2m=1.0"],
+            payload=b"</1/0>,</3/0>",
+        ))
+        ack = await dev.expect(CO.ACK)
+        assert ack.code == CO.CREATED
+        loc = [v for n, v in ack.options if n == LW.OPT_LOCATION_PATH]
+        assert loc[0] == b"rd" and len(loc) == 2
+        reg = await m.recv_publish()
+        assert reg.topic == "lwm2m/ep-1/up/resp"
+        body = json.loads(reg.payload)
+        assert body["msgType"] == "register"
+        assert body["data"]["objectList"] == ["/1/0", "/3/0"]
+
+        # -------- read command: app -> dn topic -> device
+        await m.publish("lwm2m/ep-1/dn/dm", json.dumps({
+            "reqID": "42", "msgType": "read",
+            "data": {"path": "/3/0/0"},
+        }).encode())
+        req = await dev.expect(CO.CON)
+        assert req.code == CO.GET
+        assert req.uri_path == ["3", "0", "0"]
+        # device answers with the resource value
+        dev.send_raw(CO.CoapCodec().serialize(CO.CoapMessage(
+            CO.ACK, CO.CONTENT, req.message_id, req.token, [],
+            b"emqx_tpu device",
+        )))
+        resp = await m.recv_publish()
+        assert resp.topic == "lwm2m/ep-1/up/resp"
+        body = json.loads(resp.payload)
+        assert body["reqID"] == "42" and body["msgType"] == "read"
+        assert body["data"]["code"] == "2.05"
+        assert body["data"]["content"] == "emqx_tpu device"
+
+        # -------- observe: first reply answers, later ones notify
+        await m.publish("lwm2m/ep-1/dn/dm", json.dumps({
+            "reqID": "43", "msgType": "observe",
+            "data": {"path": "/3/0/1"},
+        }).encode())
+        req = await dev.expect(CO.CON)
+        assert any(n == CO.OPT_OBSERVE for n, _ in req.options)
+        dev.send_raw(CO.CoapCodec().serialize(CO.CoapMessage(
+            CO.ACK, CO.CONTENT, req.message_id, req.token,
+            [(CO.OPT_OBSERVE, b"\x01")], b"v1",
+        )))
+        first = await m.recv_publish()
+        assert first.topic == "lwm2m/ep-1/up/resp"
+        # an unsolicited notification on the same token
+        dev.send_raw(CO.CoapCodec().serialize(CO.CoapMessage(
+            CO.NON, CO.CONTENT, 999, req.token,
+            [(CO.OPT_OBSERVE, b"\x02")], b"v2",
+        )))
+        note = await m.recv_publish()
+        assert note.topic == "lwm2m/ep-1/up/notify"
+        body = json.loads(note.payload)
+        assert body["data"]["content"] == "v2"
+
+        # -------- deregister
+        dev.send(coap_msg(
+            CO.DELETE, "rd/" + loc[1].decode(), mid=9, token=b"\x12",
+        ))
+        ack = await dev.expect(CO.ACK)
+        assert ack.code == CO.DELETED
+
+        dev.close()
+        await m.disconnect()
         await srv.stop()
 
     run(t())
