@@ -424,6 +424,18 @@ class BrokerServer:
                     port=int(gw_cfg.get("port", 5683)),
                 )
             )
+        elif kind == "ocpp":
+            from ..gateway.ocpp import OcppGateway
+
+            await self.broker.gateways.load(
+                OcppGateway(
+                    self.broker,
+                    bind=gw_cfg.get("bind", "0.0.0.0"),
+                    port=int(gw_cfg.get("port", 33033)),
+                    mountpoint=gw_cfg.get("mountpoint", "ocpp/"),
+                    qos=int(gw_cfg.get("qos", 2)),
+                )
+            )
         elif kind == "lwm2m":
             from ..gateway.lwm2m import Lwm2mGateway
 

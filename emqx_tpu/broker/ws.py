@@ -33,11 +33,19 @@ class WsError(Exception):
 
 
 async def server_handshake(
-    reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    reader: asyncio.StreamReader,
+    writer: asyncio.StreamWriter,
+    accept_protocols: Tuple[str, ...] = ("mqtt",),
+    require_protocol: bool = False,
 ) -> str:
     """Read the HTTP upgrade request and reply 101; returns the request
-    path.  Raises WsError (after sending an HTTP error) on a
-    non-websocket request."""
+    path.  The first requested subprotocol present in
+    ``accept_protocols`` is echoed (MQTT listeners accept "mqtt", the
+    OCPP gateway "ocpp1.6"); with ``require_protocol`` the upgrade is
+    REJECTED when the client offers none of them (RFC 6455 §4.1 — a
+    conforming client would fail the connection on a missing echo, a
+    non-conforming one would speak the wrong framing).  Raises WsError
+    (after sending an HTTP error) on a non-websocket request."""
     raw = await reader.readuntil(b"\r\n\r\n")
     lines = raw.decode("latin1").split("\r\n")
     request = lines[0].split(" ")
@@ -70,8 +78,20 @@ async def server_handshake(
         "Connection: Upgrade",
         f"Sec-WebSocket-Accept: {accept}",
     ]
-    if "mqtt" in protos:
-        resp.append("Sec-WebSocket-Protocol: mqtt")
+    matched = next(
+        (p for p in protos if p in accept_protocols), None
+    )
+    if matched is not None:
+        resp.append(f"Sec-WebSocket-Protocol: {matched}")
+    elif require_protocol:
+        writer.write(
+            b"HTTP/1.1 400 Bad Request\r\n\r\n"
+        )
+        await writer.drain()
+        raise WsError(
+            f"unsupported subprotocols {protos!r}, "
+            f"need one of {accept_protocols!r}"
+        )
     writer.write(("\r\n".join(resp) + "\r\n\r\n").encode())
     await writer.drain()
     return request[1]

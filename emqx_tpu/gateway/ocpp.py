@@ -1,0 +1,238 @@
+"""OCPP-J gateway: charge points over WebSocket, bridged to MQTT.
+
+The `emqx_gateway_ocpp` role (/root/reference/apps/emqx_gateway_ocpp/
+src/emqx_ocpp_frame.erl:70-117 CALL/CALLRESULT/CALLERROR parsing,
+emqx_ocpp_schema.erl topic defaults): a charge point connects to
+``ws://host:port/ocpp/{cpid}`` with subprotocol ``ocpp1.6`` and speaks
+OCPP-J JSON arrays:
+
+    [2, id, action, payload]      CALL
+    [3, id, payload]              CALLRESULT
+    [4, id, code, desc, details]  CALLERROR
+
+Upstream frames publish as JSON objects (``{"type", "id", "action",
+"payload"}``) to ``{mountpoint}cp/{cpid}`` (replies/errors to
+``cp/{cpid}/Reply``); the charging-station side publishes downstream
+commands to ``{mountpoint}cs/{cpid}``, which this gateway frames back
+to the socket.  Schema validation of action payloads (the reference's
+JSON-schema directory) is not modelled.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Optional
+from urllib.parse import unquote
+
+from ..access import PUBLISH, SUBSCRIBE, ClientInfo
+from ..broker.session import SubOpts
+from ..broker.ws import WsError, WsServerStream, frame as ws_frame, \
+    server_handshake
+from ..message import Message
+from . import Gateway, GatewayChannel
+
+log = logging.getLogger("emqx_tpu.gateway.ocpp")
+
+CALL, CALLRESULT, CALLERROR = 2, 3, 4
+
+_OP_TEXT, _OP_CLOSE = 0x1, 0x8
+
+
+def _cpid_from_path(path: str) -> Optional[str]:
+    """Charge-point id = last path segment, minus any query string,
+    url-decoded LAST — a cpid must not smuggle topic syntax
+    (``+``/``#``/``/``, e.g. ``/ocpp/%23``) into the subscription
+    filter, where the default-allow ACL would hand it every other
+    charge point's downstream commands."""
+    segment = path.split("?", 1)[0].rstrip("/").rsplit("/", 1)[-1]
+    cpid = unquote(segment)
+    if not cpid or any(
+        c in "+#/" or ord(c) < 0x20 for c in cpid
+    ):
+        return None
+    return cpid
+
+
+class OcppChannel(GatewayChannel):
+    """One charge point: WS frames in, MQTT topics out and back."""
+
+    def __init__(self, gateway, write, close, peer) -> None:
+        super().__init__(gateway, write, close, peer)
+        self.cpid: Optional[str] = None
+
+    # -------------------------------------------------------- uplink
+
+    def attach(self, cpid: str) -> bool:
+        """Authenticate + open the MQTT session and subscribe the
+        downstream topic; False rejects the socket."""
+        gw = self.gateway
+        client = ClientInfo(clientid=cpid, peerhost=self.peer)
+        if self.broker.banned.is_banned(
+            clientid=cpid, peerhost=self.peer.rsplit(":", 1)[0]
+        ):
+            return False
+        ok, client = self.broker.access.authenticate(client)
+        if not ok:
+            return False
+        dn = f"{gw.mountpoint}cs/{cpid}"
+        if not self.broker.access.authorize(client, SUBSCRIBE, dn):
+            return False
+        self.client = client
+        self.cpid = cpid
+        self.open_session(cpid, clean_start=False)
+        opts = SubOpts(qos=gw.qos)
+        is_new = self.session.subscribe(dn, opts)
+        self.broker.subscribe(cpid, dn, opts, is_new_sub=is_new)
+        return True
+
+    def handle_frame(self, text: bytes) -> None:
+        """One OCPP-J array -> one upstream publish."""
+        try:
+            arr = json.loads(text)
+            mtype = arr[0]
+            if mtype == CALL:
+                _, mid, action, payload = arr
+                body = {"type": CALL, "id": mid, "action": action,
+                        "payload": payload}
+                topic = f"{self.gateway.mountpoint}cp/{self.cpid}"
+            elif mtype == CALLRESULT:
+                _, mid, payload = arr
+                body = {"type": CALLRESULT, "id": mid,
+                        "payload": payload}
+                topic = (f"{self.gateway.mountpoint}cp/"
+                         f"{self.cpid}/Reply")
+            elif mtype == CALLERROR:
+                _, mid, code, desc, details = arr
+                body = {"type": CALLERROR, "id": mid,
+                        "error_code": code, "error_desc": desc,
+                        "error_details": details}
+                topic = (f"{self.gateway.mountpoint}cp/"
+                         f"{self.cpid}/Reply")
+            else:
+                raise ValueError(f"unknown MessageTypeId {mtype}")
+        except (ValueError, IndexError, KeyError, TypeError) as exc:
+            log.debug("ocpp bad frame from %s: %s", self.cpid, exc)
+            self.write(ws_frame(_OP_TEXT, json.dumps([
+                CALLERROR, "", "ProtocolError", str(exc), {},
+            ]).encode()))
+            return
+        if not self.broker.access.authorize(self.client, PUBLISH, topic):
+            self.broker.metrics.inc("authorization.deny")
+            return
+        self.broker_publish(Message(
+            topic=topic, payload=json.dumps(body).encode(),
+            qos=self.gateway.qos, from_client=self.cpid,
+        ))
+
+    # ------------------------------------------------------ downlink
+
+    def deliver(self, packets) -> None:
+        pending = list(packets)
+        while pending:
+            pkt = pending.pop(0)
+            try:
+                body = json.loads(pkt.payload)
+                mtype = body.get("type", CALL)
+                if mtype == CALL:
+                    arr = [CALL, body["id"], body["action"],
+                           body.get("payload", {})]
+                elif mtype == CALLRESULT:
+                    arr = [CALLRESULT, body["id"],
+                           body.get("payload", {})]
+                else:
+                    arr = [CALLERROR, body["id"],
+                           body.get("error_code", "GenericError"),
+                           body.get("error_desc", ""),
+                           body.get("error_details", {})]
+                self.write(ws_frame(
+                    _OP_TEXT, json.dumps(arr).encode()
+                ))
+            except (ValueError, KeyError, TypeError,
+                    AttributeError) as exc:
+                log.debug("ocpp bad dn command for %s: %s",
+                          self.cpid, exc)
+            # broker-side QoS deliveries settle on handoff (the
+            # socket is the terminal hop, like exproto.py) — without
+            # this the 32-slot inflight window fills and downstream
+            # commands stall forever
+            if pkt.packet_id and self.session is not None:
+                _ok, follow = self.session.puback(pkt.packet_id)
+                if follow:
+                    pending.extend(follow)
+
+
+class OcppGateway(Gateway):
+    """WebSocket listener (the reference rides cowboy; here the same
+    hand-rolled RFC 6455 server the broker's ws listeners use)."""
+
+    name = "ocpp"
+    channel_class = OcppChannel
+
+    def __init__(self, broker, bind: str = "0.0.0.0", port: int = 0,
+                 mountpoint: str = "ocpp/", qos: int = 2) -> None:
+        super().__init__(broker, bind, port)
+        self.mountpoint = mountpoint
+        self.qos = max(0, min(int(qos), 2))
+
+    async def _on_client(self, reader, writer) -> None:
+        task = asyncio.current_task()
+        self._conns.add(task)
+        peername = writer.get_extra_info("peername")
+        peer = f"{peername[0]}:{peername[1]}" if peername else "?"
+        closed = asyncio.Event()
+
+        def write(data: bytes) -> None:
+            if not writer.is_closing():
+                writer.write(data)
+
+        def close(reason: str) -> None:
+            if not writer.is_closing():
+                writer.close()
+            closed.set()
+
+        channel = self.channel_class(self, write, close, peer)
+        reason = "closed"
+        try:
+            path = await asyncio.wait_for(
+                server_handshake(
+                    reader, writer,
+                    accept_protocols=("ocpp1.6", "ocpp1.5"),
+                    require_protocol=True,
+                ),
+                10.0,
+            )
+            cpid = _cpid_from_path(path)
+            if cpid is None or not channel.attach(cpid):
+                write(ws_frame(_OP_CLOSE, b"\x03\xe8"))  # 1000
+                return
+            # WsServerStream does the RFC 6455 legwork (ping/pong,
+            # close echo, fragment reassembly, size bound); each
+            # read() returns one complete message — exactly an
+            # OCPP-J array
+            stream = WsServerStream(
+                reader, writer,
+                max_size=self.broker.config.mqtt.max_packet_size * 2,
+            )
+            while not closed.is_set():
+                data = await stream.read()
+                if not data:
+                    break
+                channel.handle_frame(data)
+                await writer.drain()
+        except (WsError, asyncio.TimeoutError) as exc:
+            reason = f"handshake: {exc}"
+        except (asyncio.IncompleteReadError, ConnectionError):
+            reason = "peer_reset"
+        except asyncio.CancelledError:
+            reason = "server_stopped"
+        finally:
+            channel.connection_lost(reason)
+            if not writer.is_closing():
+                writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, asyncio.CancelledError):
+                pass
+            self._conns.discard(task)
