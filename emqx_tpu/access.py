@@ -195,6 +195,20 @@ class AccessControl:
             return True
         return None
 
+    async def _hook_verdict_async(
+        self, client: ClientInfo
+    ) -> Optional[bool]:
+        if self.hooks is None:
+            return None
+        res = await self.hooks.run_fold_async(
+            "client.authenticate", (client,), IGNORE
+        )
+        if res == DENY:
+            return False
+        if res == ALLOW:
+            return True
+        return None
+
     @staticmethod
     def _apply_decision(
         decision: str, updates: Dict, client: ClientInfo
@@ -224,6 +238,9 @@ class AccessControl:
     def has_async_authn(self) -> bool:
         return any(
             getattr(a, "is_async", False) for a in self.authenticators
+        ) or (
+            self.hooks is not None
+            and self.hooks.has_async("client.authenticate")
         )
 
     async def authenticate_async(
@@ -231,7 +248,7 @@ class AccessControl:
     ) -> Tuple[bool, ClientInfo]:
         """Same chain walk, awaiting IO providers in order (the
         per-listener chain of emqx_authn_chains with IO providers)."""
-        verdict = self._hook_verdict(client)
+        verdict = await self._hook_verdict_async(client)
         if verdict is not None:
             return verdict, client
         for auth in self.authenticators:
@@ -266,6 +283,36 @@ class AccessControl:
             )
             if res in (ALLOW, DENY):
                 return res == ALLOW
+        return self._authorize_local(client, action, topic)
+
+    @property
+    def has_async_authz_hooks(self) -> bool:
+        """True when an IO-backed ``client.authorize`` hook (exhook) is
+        registered: channels then defer publish/subscribe handling to
+        an ordered async continuation instead of blocking the loop."""
+        return self.hooks is not None and self.hooks.has_async(
+            "client.authorize"
+        )
+
+    async def authorize_async(
+        self, client: ClientInfo, action: str, topic: str
+    ) -> bool:
+        """`authorize` with the hook chain awaited off-loop (used by
+        the channel's deferred publish/subscribe path when an exhook
+        authorize provider is loaded)."""
+        if client.is_superuser:
+            return True
+        if self.hooks is not None:
+            res = await self.hooks.run_fold_async(
+                "client.authorize", (client, action, topic), IGNORE
+            )
+            if res in (ALLOW, DENY):
+                return res == ALLOW
+        return self._authorize_local(client, action, topic)
+
+    def _authorize_local(
+        self, client: ClientInfo, action: str, topic: str
+    ) -> bool:
         for src in self.authz_sources:
             decision = src.authorize(client, action, topic)
             if decision in (ALLOW, DENY):

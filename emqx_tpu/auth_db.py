@@ -63,6 +63,38 @@ def _bcrypt_verify(password: str, stored: str) -> bool:
         return False
 
 
+_bcrypt_ok: Optional[bool] = None
+
+
+def bcrypt_supported() -> bool:
+    """One-time platform probe: round-trip a known password through
+    crypt(3) $2b$.  Used at authenticator CONSTRUCTION so an
+    algorithm=bcrypt config on a platform without libxcrypt (or on
+    Python >= 3.13, where stdlib crypt is gone) fails loudly at boot
+    instead of silently DENYing every bcrypt credential at runtime."""
+    global _bcrypt_ok
+    if _bcrypt_ok is None:
+        try:
+            crypt = _crypt()
+            salt = crypt.mksalt(crypt.METHOD_BLOWFISH)
+            probe = crypt.crypt("probe", salt)
+            _bcrypt_ok = bool(probe) and crypt.crypt("probe", probe) == probe
+        except Exception:
+            _bcrypt_ok = False
+    return _bcrypt_ok
+
+
+def check_algorithm_supported(algorithm: str) -> None:
+    """Raise at construction time for algorithms this platform cannot
+    verify (currently: bcrypt without a working crypt(3))."""
+    if algorithm == "bcrypt" and not bcrypt_supported():
+        raise RuntimeError(
+            "password_hash algorithm 'bcrypt' is not supported on this "
+            "platform (no stdlib crypt module or crypt(3) lacks $2b$); "
+            "every bcrypt credential would silently fail closed"
+        )
+
+
 _SIMPLE = {
     "plain": None,
     "md5": hashlib.md5,
@@ -331,6 +363,7 @@ class SqlAuthenticator(Authenticator):
         salt_position: str = "prefix",
         iterations: int = 50_000,
     ) -> None:
+        check_algorithm_supported(algorithm)
         self.connector = connector
         self.sql, self._getters = compile_query(
             query, connector.paramstyle
@@ -458,6 +491,7 @@ class RedisAuthenticator(Authenticator):
         salt_position: str = "prefix",
         iterations: int = 50_000,
     ) -> None:
+        check_algorithm_supported(algorithm)
         self.connector = connector
         parts = cmd.split()
         if not parts or parts[0].upper() != "HMGET" or len(parts) < 3:

@@ -31,6 +31,10 @@ from ..retainer import Retainer
 from ..router import Router
 
 log = logging.getLogger("emqx_tpu.broker")
+
+# sentinel marking a message whose publish-hook fold raised (stage 1
+# keeps per-message isolation across both the sync and async folds)
+_PREPARE_ERROR = object()
 from .. import topic as T
 from .cm import ConnectionManager
 from .session import Session, SubOpts
@@ -580,19 +584,59 @@ class Broker:
     ) -> Tuple[List[Message], List[Optional[int]]]:
         """Stage 1 (loop thread): publish hooks, retained store, and the
         durable persistence gate."""
-        live: List[Message] = []
-        results: List[Optional[int]] = []
+        outs: List[object] = []
         for msg in msgs:
             # per-message isolation: one hook/retainer failure must not
             # poison the other up-to-4095 messages in the window
             try:
-                out = self.hooks.run_fold("message.publish", (), msg)
-                if out is None:
-                    self.metrics.inc("messages.dropped")
-                    self.hooks.run("message.dropped", msg, "by_hook")
-                    results.append(0)
-                    continue
-                msg = out
+                outs.append(self.hooks.run_fold("message.publish", (), msg))
+            except Exception:
+                log.exception("publish prepare failed for %s", msg.topic)
+                outs.append(_PREPARE_ERROR)
+        return self._prepare_finish(msgs, outs)
+
+    async def publish_prepare_async(
+        self, msgs: Sequence[Message]
+    ) -> Tuple[List[Message], List[Optional[int]]]:
+        """`publish_prepare` for the batcher: when an IO-backed
+        ``message.publish`` hook is loaded (exhook verdict RPC), the
+        folds await off-loop concurrently instead of serializing
+        blocking round-trips on the event loop; without one this is
+        exactly the sync path."""
+        if not self.hooks.has_async("message.publish"):
+            return self.publish_prepare(msgs)
+
+        async def fold_one(msg: Message) -> object:
+            try:
+                return await self.hooks.run_fold_async(
+                    "message.publish", (), msg
+                )
+            except Exception:
+                log.exception("publish prepare failed for %s", msg.topic)
+                return _PREPARE_ERROR
+
+        outs = await asyncio.gather(*(fold_one(m) for m in msgs))
+        return self._prepare_finish(msgs, list(outs))
+
+    def _prepare_finish(
+        self, msgs: Sequence[Message], outs: List[object]
+    ) -> Tuple[List[Message], List[Optional[int]]]:
+        """Shared tail of stage 1: apply fold verdicts, store retained,
+        persist the surviving window."""
+        live: List[Message] = []
+        results: List[Optional[int]] = []
+        for msg, out in zip(msgs, outs):
+            if out is _PREPARE_ERROR:
+                self.metrics.inc("messages.publish.error")
+                results.append(0)
+                continue
+            if out is None:
+                self.metrics.inc("messages.dropped")
+                self.hooks.run("message.dropped", msg, "by_hook")
+                results.append(0)
+                continue
+            msg = out  # type: ignore[assignment]
+            try:
                 self.metrics.inc("messages.publish")
                 if msg.retain and not msg.sys:
                     if self.retainer.store(msg):
@@ -945,6 +989,11 @@ class PublishBatcher:
         self._task: Optional[asyncio.Task] = None
         self._dispatch_task: Optional[asyncio.Task] = None
         self._inflight_q: Optional[asyncio.Queue] = None
+        # real count of messages popped from the queue but not yet
+        # dispatched (collector batch + pipelined windows).  Counting
+        # windows as batch_max each would read 2 partially-filled
+        # windows as congestion and stop-and-go the ingest.
+        self._inflight_count = 0
         # connection read loops pause above the high watermark and
         # resume below the low one (TCP backpressure; bounds both
         # memory and queueing delay under a publish flood).  The bound
@@ -960,8 +1009,7 @@ class PublishBatcher:
         return self._queue.qsize() + self._inflight_msgs()
 
     def _inflight_msgs(self) -> int:
-        q = self._inflight_q
-        return 0 if q is None else q.qsize() * self.batch_max
+        return self._inflight_count
 
     def _depth_below_low(self) -> bool:
         return self.depth() <= self.low_watermark
@@ -1039,14 +1087,19 @@ class PublishBatcher:
                     except asyncio.TimeoutError:
                         break
                 msgs = [m for m, _ in batch]
+                self._inflight_count += len(batch)
                 try:
                     # hooks/retain/persist mutate broker state: loop
-                    # thread only, and in window order
-                    live, results = self.broker.publish_prepare(msgs)
+                    # thread only, and in window order (IO-backed
+                    # publish hooks await off-loop inside)
+                    live, results = (
+                        await self.broker.publish_prepare_async(msgs)
+                    )
                     match_fut = loop.run_in_executor(
                         None, self.broker.publish_match, live
                     )
                 except Exception as exc:
+                    self._inflight_count -= len(batch)
                     for _, fut in batch:
                         if fut is not None and not fut.done():
                             fut.set_exception(exc)
@@ -1076,13 +1129,20 @@ class PublishBatcher:
                     if fut is not None and not fut.done():
                         fut.set_exception(exc)
             self._inflight_q = None
+            self._inflight_count = 0
 
     async def _dispatch_loop(self, inflight: asyncio.Queue) -> None:
         while True:
             batch, live, results, match_fut = await inflight.get()
             counts = None
             try:
-                matched, remote = await match_fut
+                try:
+                    matched, remote = await match_fut
+                finally:
+                    # leave the congestion ledger on every path
+                    # (success, match failure, cancellation) or depth
+                    # never drains below the low watermark
+                    self._inflight_count -= len(batch)
                 counts = self.broker.publish_dispatch(
                     live, matched, remote, results
                 )

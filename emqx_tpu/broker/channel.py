@@ -10,6 +10,7 @@ tears it down; the asyncio connection drives timers.
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import secrets
 import time
@@ -109,6 +110,7 @@ class Channel:
         self._closing = False
         self._pending_connect = None  # in-flight async-connect task
         self._connect_backlog: List[C.Packet] = []  # pipelined pre-CONNACK
+        self._defer_tail = None  # ordered async-verdict continuation
 
     # ---------------------------------------------------------- util
 
@@ -145,7 +147,36 @@ class Channel:
     def _shutdown(self, reason: str) -> None:
         self._closing = True
         self.state = DISCONNECTED
+        if self._defer_tail is not None:
+            self._defer_tail.cancel()
+            self._defer_tail = None
         self._close(reason)
+
+    def _defer(self, coro) -> None:
+        """Chain an async continuation behind any previously deferred
+        packet so per-connection packet ORDER survives the off-loop
+        verdict wait (exhook authorize): each deferred handler runs
+        only after its predecessor resolves."""
+        prev = self._defer_tail
+
+        async def run() -> None:
+            if prev is not None:
+                # wait() swallows the predecessor's failure/cancel (it
+                # must never skip THIS packet) while still propagating
+                # our own cancellation from _shutdown
+                try:
+                    await asyncio.wait({prev})
+                except asyncio.CancelledError:
+                    coro.close()  # un-started coroutine: no RuntimeWarning
+                    raise
+            try:
+                await coro
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("deferred packet handling failed")
+
+        self._defer_tail = asyncio.get_running_loop().create_task(run())
 
     def _mount(self, topic: str) -> str:
         return self.mountpoint + topic if self.mountpoint else topic
@@ -540,6 +571,37 @@ class Channel:
         return self._alias_in.get(alias)
 
     def _handle_publish(self, pkt: C.Publish) -> None:
+        if self.broker.access.has_async_authz_hooks:
+            # IO-backed authorize (exhook): the verdict RPC must not
+            # block the loop — defer this packet's handling into the
+            # channel's ordered continuation chain
+            try:
+                asyncio.get_running_loop()
+            except RuntimeError:
+                pass  # no loop (unit tests): fall through, block
+            else:
+                self._defer(self._handle_publish_async(pkt))
+                return
+        full_topic = self._publish_validate(pkt)
+        if full_topic is None:
+            return
+        ok = self.broker.access.authorize(self.client, PUBLISH, full_topic)
+        self._publish_post_auth(pkt, full_topic, ok)
+
+    async def _handle_publish_async(self, pkt: C.Publish) -> None:
+        full_topic = self._publish_validate(pkt)
+        if full_topic is None:
+            return
+        ok = await self.broker.access.authorize_async(
+            self.client, PUBLISH, full_topic
+        )
+        if self._closing or self.state != CONNECTED:
+            return  # channel died while the verdict was in flight
+        self._publish_post_auth(pkt, full_topic, ok)
+
+    def _publish_validate(self, pkt: C.Publish) -> Optional[str]:
+        """Pre-authorize validation; returns the mounted topic, or
+        None after responding/disconnecting."""
         m = self.broker.metrics
         recv = Channel._recv_slots
         if recv is None:
@@ -552,23 +614,27 @@ class Channel:
         topic = self._resolve_alias(pkt) if self.version == C.MQTT_V5 else pkt.topic
         if topic is None:
             self._disconnect_with(RC_TOPIC_ALIAS_INVALID)
-            return
+            return None
         try:
             T.validate_name(topic)
         except ValueError:
             m.inc("packets.publish.error")
             self._disconnect_with(RC_TOPIC_NAME_INVALID)
-            return
+            return None
         mqtt = self.broker.config.mqtt
         if pkt.qos > mqtt.max_qos_allowed:
             self._disconnect_with(0x9B)  # QoS not supported
-            return
+            return None
         if pkt.retain and not mqtt.retain_available:
             self._disconnect_with(0x9A)  # retain not supported
-            return
+            return None
+        return self._mount(topic)
 
-        full_topic = self._mount(topic)
-        if not self.broker.access.authorize(self.client, PUBLISH, full_topic):
+    def _publish_post_auth(
+        self, pkt: C.Publish, full_topic: str, ok: bool
+    ) -> None:
+        m = self.broker.metrics
+        if not ok:
             m.inc("client.authorize")
             m.inc("authorization.deny")
             m.inc("packets.publish.auth_error")
@@ -680,6 +746,54 @@ class Channel:
     # ----------------------------------------------------- subscribe
 
     def _handle_subscribe(self, pkt: C.Subscribe) -> None:
+        if self.broker.access.has_async_authz_hooks:
+            try:
+                asyncio.get_running_loop()
+            except RuntimeError:
+                pass  # no loop (unit tests): fall through, block
+            else:
+                self._defer(self._handle_subscribe_async(pkt))
+                return
+        self._subscribe_body(pkt, None)
+
+    async def _handle_subscribe_async(self, pkt: C.Subscribe) -> None:
+        """Precompute the per-filter authz verdicts off-loop, then run
+        the synchronous subscribe body with them."""
+        verdicts: List[Optional[bool]] = []
+        for sub in pkt.subscriptions:
+            real = self._sub_authz_topic(sub.topic_filter)
+            if real is None:
+                verdicts.append(None)  # validation fails in the body
+            else:
+                verdicts.append(
+                    await self.broker.access.authorize_async(
+                        self.client, SUBSCRIBE, real
+                    )
+                )
+        if self._closing or self.state != CONNECTED:
+            return
+        self._subscribe_body(pkt, verdicts)
+
+    def _sub_authz_topic(self, topic_filter: str) -> Optional[str]:
+        """The mounted real topic a filter authorizes against (the
+        derivation `_do_subscribe` performs before its authorize
+        call); None when validation would reject the filter anyway."""
+        flt = self.broker.rewrite.rewrite_sub(topic_filter)
+        try:
+            T.validate_filter(flt)
+        except ValueError:
+            return None
+        if flt.startswith("$exclusive/"):
+            flt = flt[len("$exclusive/"):]
+            if not flt:
+                return None
+        shared = T.parse_share(flt)
+        real = shared.topic if shared else flt
+        return self._mount(real)
+
+    def _subscribe_body(
+        self, pkt: C.Subscribe, verdicts: Optional[List[Optional[bool]]]
+    ) -> None:
         m = self.broker.metrics
         m.inc("packets.subscribe.received")
         mqtt = self.broker.config.mqtt
@@ -688,8 +802,10 @@ class Channel:
             subid = subid[0] if subid else None
         rcs: List[int] = []
         retained_jobs: List[Tuple[Message, SubOpts]] = []
-        for sub in pkt.subscriptions:
-            rc = self._do_subscribe(sub, subid, mqtt, retained_jobs)
+        for i, sub in enumerate(pkt.subscriptions):
+            authz = verdicts[i] if verdicts is not None else None
+            rc = self._do_subscribe(sub, subid, mqtt, retained_jobs,
+                                    authz=authz)
             rcs.append(rc)
         if self.version != C.MQTT_V5:
             rcs = [rc if rc <= 2 else 0x80 for rc in rcs]
@@ -704,6 +820,7 @@ class Channel:
         subid: Optional[int],
         mqtt,
         retained_jobs: List[Tuple[Message, SubOpts]],
+        authz: Optional[bool] = None,
     ) -> int:
         flt = self.broker.rewrite.rewrite_sub(sub.topic_filter)
         try:
@@ -730,9 +847,14 @@ class Channel:
             return RC_TOPIC_FILTER_INVALID
         full = self._mount(flt) if shared is None else flt
         self.broker.metrics.inc("client.authorize")
-        if not self.broker.access.authorize(
-            self.client, SUBSCRIBE, self._mount(real)
-        ):
+        allowed = (
+            authz
+            if authz is not None  # verdict precomputed off-loop
+            else self.broker.access.authorize(
+                self.client, SUBSCRIBE, self._mount(real)
+            )
+        )
+        if not allowed:
             self.broker.metrics.inc("authorization.deny")
             self.broker.metrics.inc("packets.subscribe.auth_error")
             return RC_NOT_AUTHORIZED

@@ -25,13 +25,14 @@ synchronous calls with the configured timeout, as in the reference.
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import time
 from typing import Dict, List, Optional, Sequence
 
 import grpc
 
-from ..hooks import STOP_WITH
+from ..hooks import STOP_WITH, with_async
 from ..message import Message
 from . import pb
 
@@ -220,15 +221,28 @@ class ExhookClient:
             reg.delete(name, cb)
         self._registered = []
         for name in names:
+            # verdict hooks register sync+async pairs: the broker's
+            # async chain walkers (batched publish fold, channel authn/
+            # authz deferral) await the RPC off the event loop, while
+            # plain sync callers (tests, non-loop threads) still block
             if name == "message.publish":
-                cb = reg.add("message.publish", self._on_message_publish,
-                             priority=50)
+                cb = reg.add(
+                    "message.publish",
+                    with_async(self._on_message_publish,
+                               self._on_message_publish_async),
+                    priority=50)
             elif name == "client.authenticate":
-                cb = reg.add("client.authenticate", self._on_authenticate,
-                             priority=50)
+                cb = reg.add(
+                    "client.authenticate",
+                    with_async(self._on_authenticate,
+                               self._on_authenticate_async),
+                    priority=50)
             elif name == "client.authorize":
-                cb = reg.add("client.authorize", self._on_authorize,
-                             priority=50)
+                cb = reg.add(
+                    "client.authorize",
+                    with_async(self._on_authorize,
+                               self._on_authorize_async),
+                    priority=50)
             elif name in _NOTIFY_RPC:
                 cb = reg.add(name, self._notify_handler(name), priority=50)
             else:
@@ -286,6 +300,18 @@ class ExhookClient:
                             self.name, rpc, exc.code())
             return None
 
+    async def _call_async(self, rpc: str, req_cls, resp_cls, req):
+        """`_call` awaited off the event loop: the blocking gRPC wait
+        happens on an executor thread, so a slow provider delays only
+        the publish/connect being folded — never keepalives, other
+        connections, or raft timers sharing the loop."""
+        if time.monotonic() < self._open_until:
+            self.stats["fast_failed"] += 1
+            return None
+        return await asyncio.get_running_loop().run_in_executor(
+            None, lambda: self._call(rpc, req_cls, resp_cls, req)
+        )
+
     # -------------------------------------------------- verdict hooks
 
     def _client_pb(self, client) -> "pb.ClientInfo":
@@ -300,21 +326,13 @@ class ExhookClient:
             anonymous=not getattr(client, "username", None),
         )
 
-    def _on_message_publish(self, msg: Message):
-        if msg.sys or msg.topic.startswith("$"):
-            return None  # the reference skips $-topics (is_sys check)
-        if not self.loaded:
-            # dial never succeeded: fail closed without a wire attempt
-            return STOP_WITH(None) if self.failure_action == "deny" \
-                else None
-        out = self._call(
-            "OnMessagePublish", pb.MessagePublishRequest,
-            pb.ValuedResponse,
-            pb.MessagePublishRequest(
-                message=_msg_to_pb(msg, self.broker.config.node_name),
-                meta=self._meta(),
-            ),
+    def _publish_req(self, msg: Message):
+        return pb.MessagePublishRequest(
+            message=_msg_to_pb(msg, self.broker.config.node_name),
+            meta=self._meta(),
         )
+
+    def _fold_publish_out(self, out, msg: Message):
         if out is None:  # transport failure
             if self.failure_action == "deny":
                 return STOP_WITH(None)  # drop the message
@@ -330,57 +348,104 @@ class ExhookClient:
             return STOP_WITH(folded)
         return folded  # CONTINUE with the mutated message
 
-    def _on_authenticate(self, client, acc):
+    def _publish_skip(self, msg: Message):
+        """Pre-wire gate; returns (handled, verdict)."""
+        if msg.sys or msg.topic.startswith("$"):
+            return True, None  # the reference skips $-topics (is_sys)
+        if not self.loaded:
+            # dial never succeeded: fail closed without a wire attempt
+            return True, (STOP_WITH(None)
+                          if self.failure_action == "deny" else None)
+        return False, None
+
+    def _on_message_publish(self, msg: Message):
+        handled, verdict = self._publish_skip(msg)
+        if handled:
+            return verdict
+        out = self._call("OnMessagePublish", pb.MessagePublishRequest,
+                         pb.ValuedResponse, self._publish_req(msg))
+        return self._fold_publish_out(out, msg)
+
+    async def _on_message_publish_async(self, msg: Message):
+        handled, verdict = self._publish_skip(msg)
+        if handled:
+            return verdict
+        out = await self._call_async(
+            "OnMessagePublish", pb.MessagePublishRequest,
+            pb.ValuedResponse, self._publish_req(msg))
+        return self._fold_publish_out(out, msg)
+
+    def _authn_req(self, client, acc):
+        from ..access import ALLOW
+
+        return pb.ClientAuthenticateRequest(
+            clientinfo=self._client_pb(client),
+            result=acc == ALLOW,
+            meta=self._meta(),
+        )
+
+    def _authz_req(self, client, action, topic, acc):
+        from ..access import ALLOW, PUBLISH
+
+        return pb.ClientAuthorizeRequest(
+            clientinfo=self._client_pb(client),
+            type=(pb.ClientAuthorizeRequest.PUBLISH
+                  if action == PUBLISH
+                  else pb.ClientAuthorizeRequest.SUBSCRIBE),
+            topic=topic,
+            result=acc == ALLOW,
+            meta=self._meta(),
+        )
+
+    def _fold_bool_out(self, out):
         from ..access import ALLOW, DENY
 
-        if not self.loaded:
+        if out is None:
             return DENY if self.failure_action == "deny" else None
+        if out.type == pb.ValuedResponse.IGNORE or \
+                out.WhichOneof("value") != "bool_result":
+            return None
+        verdict = ALLOW if out.bool_result else DENY
+        if out.type == pb.ValuedResponse.STOP_AND_RETURN:
+            return STOP_WITH(verdict)
+        return verdict
+
+    def _unloaded_verdict(self):
+        from ..access import DENY
+
+        return DENY if self.failure_action == "deny" else None
+
+    def _on_authenticate(self, client, acc):
+        if not self.loaded:
+            return self._unloaded_verdict()
         out = self._call(
             "OnClientAuthenticate", pb.ClientAuthenticateRequest,
-            pb.ValuedResponse,
-            pb.ClientAuthenticateRequest(
-                clientinfo=self._client_pb(client),
-                result=acc == ALLOW,
-                meta=self._meta(),
-            ),
-        )
-        if out is None:
-            return DENY if self.failure_action == "deny" else None
-        if out.type == pb.ValuedResponse.IGNORE or \
-                out.WhichOneof("value") != "bool_result":
-            return None
-        verdict = ALLOW if out.bool_result else DENY
-        if out.type == pb.ValuedResponse.STOP_AND_RETURN:
-            return STOP_WITH(verdict)
-        return verdict
+            pb.ValuedResponse, self._authn_req(client, acc))
+        return self._fold_bool_out(out)
+
+    async def _on_authenticate_async(self, client, acc):
+        if not self.loaded:
+            return self._unloaded_verdict()
+        out = await self._call_async(
+            "OnClientAuthenticate", pb.ClientAuthenticateRequest,
+            pb.ValuedResponse, self._authn_req(client, acc))
+        return self._fold_bool_out(out)
 
     def _on_authorize(self, client, action, topic, acc):
-        from ..access import ALLOW, DENY, PUBLISH
-
         if not self.loaded:
-            return DENY if self.failure_action == "deny" else None
+            return self._unloaded_verdict()
         out = self._call(
             "OnClientAuthorize", pb.ClientAuthorizeRequest,
-            pb.ValuedResponse,
-            pb.ClientAuthorizeRequest(
-                clientinfo=self._client_pb(client),
-                type=(pb.ClientAuthorizeRequest.PUBLISH
-                      if action == PUBLISH
-                      else pb.ClientAuthorizeRequest.SUBSCRIBE),
-                topic=topic,
-                result=acc == ALLOW,
-                meta=self._meta(),
-            ),
-        )
-        if out is None:
-            return DENY if self.failure_action == "deny" else None
-        if out.type == pb.ValuedResponse.IGNORE or \
-                out.WhichOneof("value") != "bool_result":
-            return None
-        verdict = ALLOW if out.bool_result else DENY
-        if out.type == pb.ValuedResponse.STOP_AND_RETURN:
-            return STOP_WITH(verdict)
-        return verdict
+            pb.ValuedResponse, self._authz_req(client, action, topic, acc))
+        return self._fold_bool_out(out)
+
+    async def _on_authorize_async(self, client, action, topic, acc):
+        if not self.loaded:
+            return self._unloaded_verdict()
+        out = await self._call_async(
+            "OnClientAuthorize", pb.ClientAuthorizeRequest,
+            pb.ValuedResponse, self._authz_req(client, action, topic, acc))
+        return self._fold_bool_out(out)
 
     # --------------------------------------------------- notify hooks
 

@@ -17,6 +17,7 @@ registration order.  Callbacks signal flow control by return value:
 from __future__ import annotations
 
 import bisect
+import functools
 import itertools
 from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
 
@@ -65,6 +66,21 @@ def STOP_WITH(value: Any) -> _Stop:
     return _Stop(value, True)
 
 
+def with_async(sync_fn: Callable[..., Any],
+               async_fn: Callable[..., Any]) -> Callable[..., Any]:
+    """Pair a blocking callback with a coroutine twin.  Chains walked
+    by ``run_fold``/``run`` call ``sync_fn``; the ``*_async`` walkers
+    prefer ``async_fn`` so IO-backed hooks (exhook verdict RPCs) wait
+    off the event loop instead of stalling every connection on it."""
+
+    @functools.wraps(sync_fn)
+    def wrapper(*args: Any) -> Any:
+        return sync_fn(*args)
+
+    wrapper.async_fn = async_fn  # type: ignore[attr-defined]
+    return wrapper
+
+
 class Callback(NamedTuple):
     priority: int
     seq: int
@@ -79,6 +95,10 @@ class HookRegistry:
     def __init__(self) -> None:
         self._chains: Dict[str, List[Callback]] = {}
         self._seq = itertools.count()
+        # names with >=1 async-capable callback, kept as counts so
+        # `has_async` is an O(1) hot-path check (the publish/authorize
+        # paths consult it per packet)
+        self._async_counts: Dict[str, int] = {}
 
     def add(
         self, name: str, fn: Callable[..., Any], priority: int = 0
@@ -86,6 +106,8 @@ class HookRegistry:
         cb = Callback(priority, next(self._seq), fn)
         chain = self._chains.setdefault(name, [])
         bisect.insort(chain, cb, key=Callback.sort_key)
+        if getattr(fn, "async_fn", None) is not None:
+            self._async_counts[name] = self._async_counts.get(name, 0) + 1
         return cb
 
     def delete(self, name: str, fn_or_cb: Any) -> bool:
@@ -93,8 +115,17 @@ class HookRegistry:
         for i, cb in enumerate(chain):
             if cb is fn_or_cb or cb.fn is fn_or_cb:
                 del chain[i]
+                if getattr(cb.fn, "async_fn", None) is not None:
+                    n = self._async_counts.get(name, 1) - 1
+                    if n <= 0:
+                        self._async_counts.pop(name, None)
+                    else:
+                        self._async_counts[name] = n
                 return True
         return False
+
+    def has_async(self, name: str) -> bool:
+        return name in self._async_counts
 
     def callbacks(self, name: str) -> List[Callback]:
         return list(self._chains.get(name, ()))
@@ -115,6 +146,24 @@ class HookRegistry:
         iteration, as in `run`."""
         for cb in tuple(self._chains.get(name, ())):
             res = cb.fn(*args, acc)
+            if isinstance(res, _Stop):
+                return res.value if res.has_value else acc
+            if res is not None:
+                acc = res
+        return acc
+
+    async def run_fold_async(
+        self, name: str, args: Tuple[Any, ...], acc: Any
+    ) -> Any:
+        """`run_fold` that awaits async-capable callbacks (registered
+        via `with_async`) so IO hooks never block the event loop; pure
+        callbacks run inline with identical semantics."""
+        for cb in tuple(self._chains.get(name, ())):
+            afn = getattr(cb.fn, "async_fn", None)
+            if afn is not None:
+                res = await afn(*args, acc)
+            else:
+                res = cb.fn(*args, acc)
             if isinstance(res, _Stop):
                 return res.value if res.has_value else acc
             if res is not None:

@@ -128,8 +128,12 @@ class MgmtAuth:
 
     @staticmethod
     def _save(path: str, data: Dict[str, Any]) -> None:
+        # owner-only like the jwt secret: these stores hold credential
+        # hashes/salts, and the default umask would leave them
+        # world-readable
         tmp = path + ".tmp"
-        with open(tmp, "w") as f:
+        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
             json.dump(data, f, indent=1)
         os.replace(tmp, path)
 

@@ -92,6 +92,7 @@ def load():
                 ctypes.POINTER(ctypes.c_int32),
                 ctypes.POINTER(ctypes.c_int64),
                 ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64),
             ]
             _lib = lib
         except Exception:
@@ -161,6 +162,7 @@ class NativeEncoder:
         new_ids = np.empty(max(cap, 1), np.int32)
         new_spans = np.empty(max(2 * cap, 2), np.int64)
         assert mat.flags["C_CONTIGUOUS"]
+        err_i = ctypes.c_int64(-1)
         rc = self._lib.td_encode_filters(
             self._h, blob, _ptr(starts, ctypes.c_int64),
             _ptr(lens, ctypes.c_int64), n,
@@ -169,15 +171,21 @@ class NativeEncoder:
             _ptr(ish.view(np.uint8), ctypes.c_uint8),
             _ptr(new_ids, ctypes.c_int32),
             _ptr(new_spans, ctypes.c_int64), cap,
+            ctypes.byref(err_i),
         )
-        if rc < 0:
-            fid, ws = items[int(-rc - 1)]
-            raise ValueError(
-                f"filter deeper than max_levels={max_levels}: {ws}"
-            )
+        # mirror new words BEFORE any failure handling: the native map
+        # already holds words inserted ahead of a too-deep filter, and
+        # skipping the mirror would desynchronize the two dictionaries
+        # permanently (topic encodes would see UNKNOWN_TOK for words
+        # arena rows reference)
         for k in range(int(rc)):
             o, ln = new_spans[2 * k], new_spans[2 * k + 1]
             ids[blob[o:o + ln].decode()] = int(new_ids[k])
+        if err_i.value >= 0:
+            fid, ws = items[int(err_i.value)]
+            raise ValueError(
+                f"filter deeper than max_levels={max_levels}: {ws}"
+            )
 
     def encode_topics_into(
         self, topics, levels: int,

@@ -78,17 +78,21 @@ int32_t td_get(void* h, const char* w, int64_t len) {
 // Encode `n` filters out of `blob` (filter i = blob[starts[i],
 // starts[i]+lens[i])), writing mat[i*max_levels ..], blen[i], ish[i].
 // New words are reported as new_ids[k] / new_spans[2k]=offset /
-// new_spans[2k+1]=len.  Returns the count of new words (>= 0), or
-// -(i+1) when filter i's body exceeds max_levels (nothing before it
-// is rolled back — the caller treats the whole call as failed and may
-// not reuse the arena rows it targeted).
+// new_spans[2k+1]=len.  Returns the count of new words (>= 0) —
+// ALWAYS, including on failure, because words inserted before the
+// failing filter are already in this map and the caller's Python
+// mirror must learn them or the two dictionaries diverge for good.
+// *err_i reports the first filter whose body exceeds max_levels (the
+// call stops there; its arena rows are not usable), or -1 on success.
 int64_t td_encode_filters(void* h, const char* blob, const int64_t* starts,
                           const int64_t* lens, int64_t n,
                           int32_t max_levels, int32_t* mat,
                           int32_t* blen, uint8_t* ish, int32_t* new_ids,
-                          int64_t* new_spans, int64_t new_cap) {
+                          int64_t* new_spans, int64_t new_cap,
+                          int64_t* err_i) {
     auto* d = static_cast<TokDict*>(h);
     int64_t n_new = 0;
+    *err_i = -1;
     for (int64_t i = 0; i < n; i++) {
         const char* s = blob + starts[i];
         const int64_t len = lens[i];
@@ -110,7 +114,10 @@ int64_t td_encode_filters(void* h, const char* blob, const int64_t* starts,
             int64_t start = 0;
             for (int64_t p = 0; p <= body_len; p++) {
                 if (p == body_len || s[p] == '/') {
-                    if (nlev >= max_levels) return -(i + 1);
+                    if (nlev >= max_levels) {
+                        *err_i = i;
+                        return n_new;
+                    }
                     const char* w = s + start;
                     const int64_t wl = p - start;
                     if (wl == 1 && w[0] == '+') {

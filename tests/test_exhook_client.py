@@ -39,6 +39,7 @@ class StubProvider:
         ]
         self.seen = []
         self.lock = threading.Lock()
+        self.delay = 0.0  # simulated provider latency (verdict RPCs)
 
     def _record(self, name, req):
         with self.lock:
@@ -65,6 +66,8 @@ class StubProvider:
 
         def on_publish(req, ctx):
             self._record("publish", req)
+            if self.delay:
+                time.sleep(self.delay)
             m = req.message
             if m.topic == "secret/x":
                 out = pb.Message()
@@ -290,3 +293,97 @@ def test_unreachable_provider_fails_closed_then_recovers(provider):
     broker2.publish(Message(topic="t/1", payload=b"y", qos=0))
     assert [p.payload for p in ch2.sent] == [b"y"]
     client2.stop()
+
+
+def test_async_verdicts_keep_loop_live(provider):
+    """Advisor r4 (medium): verdict RPCs must not block the event
+    loop.  With a slow provider, the async hook path (used by the
+    publish batcher and the channel's deferred authorize) must let
+    other loop tasks run during the round-trip, and fold the same
+    verdicts as the sync path."""
+    import asyncio
+
+    stub, port = provider
+    broker, client = make_client(port)
+    try:
+        # verdict hooks advertise async twins; the access layer and
+        # batcher key their off-loop deferral on these
+        assert broker.hooks.has_async("message.publish")
+        assert broker.access.has_async_authz_hooks
+        assert broker.access.has_async_authn
+
+        stub.delay = 0.3
+
+        async def main():
+            ticks = 0
+
+            async def ticker():
+                nonlocal ticks
+                while True:
+                    ticks += 1
+                    await asyncio.sleep(0.01)
+
+            t = asyncio.create_task(ticker())
+            out = await broker.hooks.run_fold_async(
+                "message.publish", (),
+                Message(topic="t/1", payload=b"x", qos=0),
+            )
+            from emqx_tpu.access import ClientInfo, PUBLISH
+            allowed = await broker.access.authorize_async(
+                ClientInfo(clientid="a"), PUBLISH, "ok/t")
+            denied = await broker.access.authorize_async(
+                ClientInfo(clientid="a"), PUBLISH, "forbidden/t")
+            t.cancel()
+            return ticks, out, allowed, denied
+
+        ticks, out, allowed, denied = asyncio.run(main())
+        assert out.payload == b"x!ext"  # provider mutation folded
+        assert allowed and not denied
+        # 3 sequential 0.3s RPCs; a blocked loop would leave ticks ~0
+        assert ticks >= 30
+    finally:
+        stub.delay = 0.0
+        client.stop()
+
+
+def test_batcher_prepare_uses_async_hook_path(provider):
+    """End-to-end through the PublishBatcher: a window folded against
+    a slow provider must not starve concurrent loop work."""
+    import asyncio
+
+    stub, port = provider
+    broker, client = make_client(port)
+    ch = attach(broker, "c1", "t/#")
+    try:
+        stub.delay = 0.2
+
+        async def main():
+            from emqx_tpu.broker.broker import PublishBatcher
+
+            batcher = PublishBatcher(broker, window=0.001)
+            await batcher.start()
+            ticks = 0
+
+            async def ticker():
+                nonlocal ticks
+                while True:
+                    ticks += 1
+                    await asyncio.sleep(0.01)
+
+            t = asyncio.create_task(ticker())
+            n = await asyncio.wait_for(
+                batcher.publish(Message(topic="t/1", payload=b"e",
+                                        qos=1)),
+                timeout=10,
+            )
+            t.cancel()
+            await batcher.stop()
+            return ticks, n
+
+        ticks, n = asyncio.run(main())
+        assert n == 1
+        assert [p.payload for p in ch.sent] == [b"e!ext"]
+        assert ticks >= 10
+    finally:
+        stub.delay = 0.0
+        client.stop()
