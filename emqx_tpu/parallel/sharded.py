@@ -363,6 +363,12 @@ class ShardedMatchEngine(MatchEngine):
             snap = self._snapshot_refs()
         return self._flat_from_snapshot(snap, words)
 
+    def _flat_submit(self, snap, words: Sequence[T.Words]):
+        # the shard_map call is synchronous end-to-end (collectives
+        # inside); compute eagerly and hand the finished triple back
+        # through the submit/finish protocol
+        return ("done", self._flat_from_snapshot(snap, words))
+
     def _flat_from_snapshot(self, snap, words: Sequence[T.Words]):
         from ..ops.automaton import expand_codes_host
 

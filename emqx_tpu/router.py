@@ -133,10 +133,12 @@ class Router:
     # --------------------------------------------------------- match
 
     def match_batch(
-        self, topics: Sequence[str]
+        self, topics: Sequence[str], congested: bool = False
     ) -> List[Set[str]]:
-        """Real filters matching each topic (batched on device)."""
-        return self.engine.match_batch(topics)
+        """Real filters matching each topic (batched on device).  The
+        ``congested`` hint flips the engine's auto policy into
+        throughput mode (compare host CPU, not wall time)."""
+        return self.engine.match_batch(topics, congested=congested)
 
     def subscribers(
         self, real: str

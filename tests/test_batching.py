@@ -55,13 +55,13 @@ def test_concurrent_publishers_one_window():
             await p.connect()
 
         match_calls = [0]
-        orig_match = srv.broker.publish_match
+        orig_match = srv.broker.publish_match_submit
 
-        def counting_match(live):
+        def counting_match(live, congested=False):
             match_calls[0] += 1
-            return orig_match(live)
+            return orig_match(live, congested)
 
-        srv.broker.publish_match = counting_match
+        srv.broker.publish_match_submit = counting_match
 
         async def blast(p, i):
             for k in range(10):
@@ -159,7 +159,7 @@ def test_batcher_failure_does_not_ack():
         def boom(*a, **k):
             raise RuntimeError("injected")
 
-        srv.broker.publish_match = boom
+        srv.broker.publish_match_submit = boom
         pub = TestClient(port, "pub")
         await pub.connect()
         with pytest.raises(Exception):

@@ -16,6 +16,10 @@ def build_engine(n=400, threshold=64):
     eng = MatchEngine(
         max_levels=8, rebuild_threshold=10**9,
         delta_aut_threshold=threshold,
+        # pinned: these tests force interleavings on the DEVICE match
+        # path (snapshot/overlay vs fold adoption); auto would route
+        # the small windows to the host and never reach them
+        use_device=True,
     )
     oracle = HostTrie()
     for i in range(n):
