@@ -786,6 +786,28 @@ def main():
         except Exception as exc:
             log(f"sharded bench failed: {exc}")
 
+    if os.environ.get("BENCH_CLUSTER_SHARDED", "1") != "0":
+        # cluster-sharded route index: 2 OS-process nodes, the filter
+        # set partitioned by rendezvous hash (~1/N each), scatter-
+        # gather matching checked against the full-knowledge oracle
+        import subprocess
+
+        log("cluster-sharded bench (2-process subprocess)...")
+        try:
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "tools", "bench_cluster_sharded.py")],
+                capture_output=True, text=True, timeout=600,
+                env=dict(os.environ, BENCH_SHARD_FILTERS=os.environ.get(
+                    "BENCH_SHARD_FILTERS", "1000000")),
+            )
+            cs = json.loads(out.stdout.strip().splitlines()[-1])
+            sharded_stats.update(cs)
+            log(f"cluster-sharded: {cs}")
+        except Exception as exc:
+            log(f"cluster-sharded bench failed: {exc}")
+
     if os.environ.get("BENCH_MC", "1") != "0":
         # multi-core broker: worker processes + loadgen processes (the
         # whole phase lives outside this TPU-holding process)

@@ -117,6 +117,7 @@ class ClusterNode:
         consensus: str = "lww",  # lww | raft
         raft_data_dir: Optional[str] = None,
         raft_fsync: bool = True,
+        sharded_routes: bool = False,
     ) -> None:
         self.name = name
         self.broker = broker
@@ -130,6 +131,17 @@ class ClusterNode:
         self.raft_ds = None
         self.transport = NodeTransport(name, bind, port)
         self.routes = ClusterRouteTable()
+        # sharded mode: the cluster's filter set is PARTITIONED by
+        # rendezvous hash instead of fully replicated — each node
+        # indexes ~1/N of it and publish windows scatter-gather
+        # (cluster/sharded_routes.py).  self.routes then holds only
+        # this node's own filters (for sync compat), never peers'.
+        self.shard = None
+        if sharded_routes:
+            from .sharded_routes import ShardedRouteIndex
+
+            self.shard = ShardedRouteIndex(self)
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
         self.heartbeat_interval = heartbeat_interval
         self.down_after = down_after
         self.flush_interval = flush_interval
@@ -199,6 +211,15 @@ class ClusterNode:
         self.transport.on("rebalance_shed", self._handle_rebalance_shed)
         self.transport.on("session_purge", self._handle_session_purge)
         self.transport.on("sync", self._handle_sync)
+        if self.shard is not None:
+            self.transport.on("shard_ops", self.shard.handle_ops)
+            self.transport.on("shard_sync", self.shard.handle_sync)
+            # concurrent: a shard_match may arrive while this node's
+            # own scatter call is outstanding on the same link pair —
+            # inline handling would deadlock the two calls against
+            # each other
+            self.transport.on("shard_match", self.shard.handle_match,
+                              concurrent=True)
 
         # wire into the broker: route-change notifications + forward
         broker.router.on_route_added = self._route_added
@@ -207,8 +228,13 @@ class ClusterNode:
         # adopt routes created before the cluster layer attached (e.g.
         # boot-advertised persistent-session filters after a restart) so
         # the initial full sync carries them to peers
-        for flt in broker.router.topics():
-            self.routes.add_route(flt, self.name)
+        if self.shard is not None:
+            # sharded: the first resync (post-join) announces every
+            # local filter to its owner
+            self.shard.resync_due = True
+        else:
+            for flt in broker.router.topics():
+                self.routes.add_route(flt, self.name)
 
     # ------------------------------------------------------- lifecycle
 
@@ -217,6 +243,7 @@ class ClusterNode:
         discovery analogue): exchange full route sets with each seed."""
         await self.transport.start()
         self._started = True
+        self._loop = asyncio.get_running_loop()
         for name, host, port in seeds or ():
             self.add_peer(name, host, port)
         if self.consensus == "raft":
@@ -291,10 +318,16 @@ class ClusterNode:
     # ----------------------------------------------- route replication
 
     def _route_added(self, flt: str) -> None:
+        if self.shard is not None:
+            self.shard.local_op("add", flt)
+            return
         self.routes.add_route(flt, self.name)
         self._queue_op("add", flt)
 
     def _route_removed(self, flt: str) -> None:
+        if self.shard is not None:
+            self.shard.local_op("del", flt)
+            return
         self.routes.delete_route(flt, self.name)
         self._queue_op("del", flt)
 
@@ -347,6 +380,8 @@ class ClusterNode:
                 # background quorum flush (bounded staleness for sync
                 # callers; the batcher's barrier gates PUBACKs itself)
                 self._track_quorum(self.flush_ds())
+            if self.shard is not None and self.shard.has_work:
+                await self.shard.flush()
 
     def _check_epoch(self, node: str, epoch: int) -> None:
         """A new epoch means the peer restarted: its op stream starts
@@ -415,7 +450,9 @@ class ClusterNode:
         """Full bidirectional route exchange (the mria bootstrap copy a
         joining node gets).  Failure is retried from the heartbeat loop
         until it succeeds — a joiner must not silently miss pre-existing
-        routes."""
+        routes.  Sharded mode skips the route payloads (no full
+        replica exists to exchange) and schedules a shard resync
+        instead — the membership just changed from this node's view."""
         reply = await self.transport.call(
             peer,
             {
@@ -424,7 +461,9 @@ class ClusterNode:
                 "listen": [self.transport.bind, self.transport.port],
                 "epoch": self._epoch,
                 "seq": self._op_seq,
-                "routes": self._local_routes(),
+                "routes": (
+                    [] if self.shard is not None else self._local_routes()
+                ),
                 "clients": self._local_clients(),
                 "conf": self._conf_dump(),
                 "peers": self._peer_list(),
@@ -435,6 +474,8 @@ class ClusterNode:
             return
         self._mark_alive(peer)
         self._synced.add(peer)
+        if self.shard is not None:
+            self.shard.on_membership_change()
         self._check_epoch(peer, reply.get("epoch", 0))
         self._apply_clients(
             peer, reply.get("clients", ()), reply.get("seq", 0)
@@ -475,8 +516,12 @@ class ClusterNode:
         for cnt, n2, path, value in obj.get("conf", ()):
             self._conf_apply((cnt, n2), path, value)
         self._adopt_peers(obj.get("peers", ()))
+        if self.shard is not None:
+            self.shard.on_membership_change()
         return {
-            "routes": self.routes.all_routes(),
+            "routes": (
+                [] if self.shard is not None else self.routes.all_routes()
+            ),
             "clients": self._local_clients(),
             "conf": self._conf_dump(),
             "peers": self._peer_list(),
@@ -1012,8 +1057,36 @@ class ClusterNode:
     # ----------------------------------------------------- forwarding
 
     def match_remote(self, topics: List[str]) -> List[set]:
-        """Nodes (other than self) with matching routes, per topic."""
-        return self.routes.match_nodes(topics, exclude=self.name)
+        """Nodes (other than self) with matching routes, per topic.
+
+        Sharded mode scatter-gathers the window across the shard
+        owners.  Called from the batcher's executor thread, it blocks
+        that thread on the cluster round-trip (the window is pipelined
+        anyway); called ON the event loop (rare sync publishes: wills,
+        $SYS), it cannot wait for network — it floods the window to
+        all alive peers, which is correct (receivers match locally
+        before dispatch) just not minimal."""
+        if self.shard is None:
+            return self.routes.match_nodes(topics, exclude=self.name)
+        try:
+            asyncio.get_running_loop()
+            on_loop = True
+        except RuntimeError:
+            on_loop = False
+        if on_loop or self._loop is None:
+            self.shard.stats["flood"] += 1
+            alive = set(self.peers_alive())
+            return [set(alive) for _ in topics]
+        fut = asyncio.run_coroutine_threadsafe(
+            self.shard.match_scatter(list(topics)), self._loop
+        )
+        try:
+            return fut.result(timeout=5.0)
+        except Exception:
+            log.exception("%s: shard scatter failed; flooding", self.name)
+            self.shard.stats["flood"] += 1
+            alive = set(self.peers_alive())
+            return [set(alive) for _ in topics]
 
     def forward(self, msg: Message, nodes: set) -> None:
         """Buffer the message per destination; the flush loop coalesces
@@ -1158,6 +1231,11 @@ class ClusterNode:
         self._down.add(node)
         self._synced.discard(node)
         purged = self.routes.purge_node(node)
+        if self.shard is not None:
+            # drop the dead node's entries from OUR shard, and
+            # re-announce local filters — ownership reshuffled
+            purged += self.shard.table.purge_node(node)
+            self.shard.on_membership_change()
         orphan_cids = [
             cid for cid, n in self.clients.items() if n == node
         ]
