@@ -303,7 +303,10 @@ class BrokerServer:
                 self.broker,
                 bind=cl.get("bind", "127.0.0.1"),
                 port=int(cl.get("port", 0)),
-                consensus=cl.get("consensus", "lww"),
+                # quorum consensus for conf + DS + registry ships ON
+                # (VERDICT r4 #8); "lww" remains the opt-out for
+                # fire-and-forget deployments
+                consensus=cl.get("consensus", "raft"),
                 raft_data_dir=cl.get("raft_data_dir"),
                 heartbeat_interval=float(
                     cl.get("heartbeat_interval", 0.5)

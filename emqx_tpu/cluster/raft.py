@@ -36,6 +36,7 @@ import json
 import logging
 import os
 import random
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -92,6 +93,10 @@ class RaftNode:
         self._timer: Optional[asyncio.TimerHandle] = None
         self._hb_task: Optional[asyncio.Task] = None
         self._stopped = False
+        self._meta_lock = threading.Lock()
+        # when we last heard a (valid-term) AppendEntries: prevote
+        # denial window — a live leader means no election is needed
+        self._last_leader_contact = 0.0
 
         self._dir = data_dir
         self._log_f = None
@@ -145,21 +150,30 @@ class RaftNode:
         below)."""
         if self._dir is None:
             return
-        tmp = self._meta_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"term": self.term, "voted_for": self.voted_for}, f)
-        os.replace(tmp, self._meta_path)
+        with self._meta_lock:
+            tmp = self._meta_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(
+                    {"term": self.term, "voted_for": self.voted_for}, f
+                )
+            os.replace(tmp, self._meta_path)
 
     def _persist_meta_fsync_blocking(self) -> None:
         if self._dir is None:
             return
-        tmp = self._meta_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"term": self.term, "voted_for": self.voted_for}, f)
-            if self.fsync:
-                f.flush()
-                os.fsync(f.fileno())
-        os.replace(tmp, self._meta_path)
+        # serialized: rapid term churn (a healing partition's dueling
+        # elections) queues several executor jobs; two sharing the one
+        # .tmp path race write-vs-replace and crash with ENOENT
+        with self._meta_lock:
+            tmp = self._meta_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(
+                    {"term": self.term, "voted_for": self.voted_for}, f
+                )
+                if self.fsync:
+                    f.flush()
+                    os.fsync(f.fileno())
+            os.replace(tmp, self._meta_path)
 
     async def _persist_meta_durable(self) -> None:
         """votedFor must hit disk BEFORE a vote is granted or a
@@ -209,6 +223,29 @@ class RaftNode:
             os.fsync(f.fileno())
 
     # ------------------------------------------------------ lifecycle
+
+    def add_member(self, node: str) -> bool:
+        """Pre-bootstrap membership adoption: a peer learned via
+        gossip may join the quorum ONLY while nothing has been
+        committed or logged here — chained bring-up (n1 alone, n2
+        seeding n1, ...) otherwise leaves asymmetric membership views
+        and a silently-broken quorum.  Once entries exist, membership
+        is frozen (joint consensus is out of scope, as in start())."""
+        if node == self.node or node in self.peers:
+            return False
+        if self.log or self.commit_index > 0:
+            log.warning(
+                "raft[%s] %s: refusing post-bootstrap member %s "
+                "(membership frozen; restart with full seeds)",
+                self.group, self.node, node,
+            )
+            return False
+        self.peers.append(node)
+        self.next_index.setdefault(node, 1)
+        self.match_index.setdefault(node, 0)
+        log.info("raft[%s] %s: adopted member %s (pre-bootstrap)",
+                 self.group, self.node, node)
+        return True
 
     def start(self) -> None:
         self._stopped = False
@@ -263,6 +300,16 @@ class RaftNode:
         return len(self.log), self.log[-1][0]
 
     async def _run_election(self) -> None:
+        # PreVote (§9.6, the raft dissertation): before bumping the
+        # term, ask whether a majority WOULD vote for us.  A node cut
+        # off by a partition otherwise inflates its term unboundedly
+        # and, at heal time, forces the healthy majority through
+        # step-downs and dueling re-elections for seconds; with
+        # prevote it rejoins as a follower at the cluster's term and
+        # converges on the next heartbeat.
+        if self.peers and not await self._prevote():
+            self._reset_election_timer()
+            return
         self.role = CANDIDATE
         self.term += 1
         self.voted_for = self.node
@@ -297,6 +344,31 @@ class RaftNode:
                 if votes * 2 > len(self.peers) + 1:
                     self._become_leader()
                     return
+
+    async def _prevote(self) -> bool:
+        term = self.term
+        last_idx, last_term = self._last()
+
+        async def ask(peer: str):
+            return await self.transport.call(peer, {
+                "type": f"raft.{self.group}",
+                "kind": "prevote",
+                "term": term + 1,
+                "candidate": self.node,
+                "last_log_index": last_idx,
+                "last_log_term": last_term,
+            }, timeout=self.election_timeout[0])
+
+        granted = 1  # self
+        for coro in asyncio.as_completed([ask(p) for p in self.peers]):
+            resp = await coro
+            if self.term != term or self.role == LEADER:
+                return False
+            if resp is not None and resp.get("granted"):
+                granted += 1
+                if granted * 2 > len(self.peers) + 1:
+                    return True
+        return granted * 2 > len(self.peers) + 1
 
     def _become_follower(self, term: int, leader: Optional[str]) -> None:
         if term > self.term:
@@ -456,6 +528,8 @@ class RaftNode:
         kind = obj.get("kind")
         if kind == "vote":
             return await self._on_vote(obj)
+        if kind == "prevote":
+            return self._on_prevote(obj)
         if kind == "append":
             return await self._on_append(obj)
         if kind == "propose":
@@ -469,6 +543,22 @@ class RaftNode:
             except (NotLeader, asyncio.TimeoutError):
                 return {"ok": False, "leader": self.leader}
         return None
+
+    def _on_prevote(self, obj: Dict) -> Dict:
+        """Non-binding poll: grants do NOT bump terms, persist
+        anything, or reset timers.  Denied while we hear from a live
+        leader (heartbeat within the minimum election timeout) so a
+        rejoining partitioned node cannot disrupt a healthy quorum."""
+        granted = False
+        if int(obj["term"]) >= self.term and (
+            time.monotonic() - self._last_leader_contact
+            >= self.election_timeout[0]
+        ):
+            my_idx, my_term = self._last()
+            c_idx = int(obj["last_log_index"])
+            c_term = int(obj["last_log_term"])
+            granted = (c_term, c_idx) >= (my_term, my_idx)
+        return {"term": self.term, "granted": granted}
 
     async def _on_vote(self, obj: Dict) -> Dict:
         term = int(obj["term"])
@@ -498,6 +588,7 @@ class RaftNode:
         else:
             self.leader = obj.get("leader")
             self._reset_election_timer()
+        self._last_leader_contact = time.monotonic()
         prev_idx = int(obj["prev_log_index"])
         prev_term = int(obj["prev_log_term"])
         last_idx, _ = self._last()

@@ -214,6 +214,10 @@ class NodeTransport:
         self._peer_addrs: Dict[str, Tuple[str, int]] = {}
         self._inbound: set = set()  # live inbound connection writers
         self._tasks: set = set()
+        # fault-injection surface (partition tests, tp.py philosophy):
+        # outbound traffic to a blocked peer is dropped as if the
+        # network ate it — both sides blocking = a full partition
+        self.blocked: set = set()
 
     def on(self, mtype: str, handler: Handler,
            concurrent: bool = False) -> None:
@@ -274,16 +278,22 @@ class NodeTransport:
         return link
 
     async def cast(self, node: str, obj: Dict[str, Any]) -> bool:
+        if node in self.blocked:
+            return False
         link = self._link(node)
         return False if link is None else await link.cast(obj)
 
     async def cast_bin(self, node: str, mtype: str, payload: bytes) -> bool:
+        if node in self.blocked:
+            return False
         link = self._link(node)
         return False if link is None else await link.cast_bin(mtype, payload)
 
     async def call(
         self, node: str, obj: Dict[str, Any], timeout: float = 5.0
     ) -> Optional[Dict[str, Any]]:
+        if node in self.blocked:
+            return None
         link = self._link(node)
         return None if link is None else await link.call(obj, timeout)
 

@@ -20,12 +20,12 @@ def run(coro):
     return asyncio.run(coro)
 
 
-async def start_node(name, seeds=()):
+async def start_node(name, seeds=(), **kw):
     cfg = BrokerConfig()
     cfg.listeners[0].port = 0
     srv = BrokerServer(cfg)
     await srv.start()
-    node = ClusterNode(name, srv.broker, **FAST)
+    node = ClusterNode(name, srv.broker, **{**FAST, **kw})
     await node.start(seeds=list(seeds))
     return srv, node
 
@@ -497,8 +497,13 @@ def test_forward_batching_coalesces_frames():
     messages, and every message arrives."""
 
     async def t():
-        srv_a, a = await start_node("a")
-        srv_b, b = await start_node("b", seeds=[("a", "127.0.0.1", a.port)])
+        # lww pinned: this test asserts the async cast_bin frame
+        # coalescing; raft mode routes forwards through the
+        # commit-confirmed forward_sync path instead
+        srv_a, a = await start_node("a", consensus="lww")
+        srv_b, b = await start_node(
+            "b", seeds=[("a", "127.0.0.1", a.port)], consensus="lww"
+        )
         await settle(0.3)
 
         sent_frames = [0]
@@ -591,11 +596,15 @@ def test_clean_start_elsewhere_kicks_remote_duplicate():
 def test_cluster_wide_config_update():
     """A config update on one node journals to every node (emqx_conf /
     emqx_cluster_rpc multicall semantics), including late joiners via
-    sync catch-up."""
+    sync catch-up.  lww pinned: this validates the journal layer,
+    including a POST-COMMIT late joiner — raft mode freezes membership
+    at bootstrap (raft-mode config propagation is covered by
+    test_raft_cluster / test_raft_partition)."""
 
     async def t():
-        srv_a, a = await start_node("a")
-        srv_b, b = await start_node("b", seeds=[("a", "127.0.0.1", a.port)])
+        srv_a, a = await start_node("a", consensus="lww")
+        srv_b, b = await start_node("b", seeds=[("a", "127.0.0.1", a.port)],
+                                    consensus="lww")
         await settle(0.3)
 
         a.update_config("mqtt.max_inflight", 64)
@@ -604,7 +613,8 @@ def test_cluster_wide_config_update():
         assert srv_b.broker.config.mqtt.max_inflight == 64
 
         # a late joiner catches up from the journal at sync time
-        srv_c, c = await start_node("c", seeds=[("a", "127.0.0.1", a.port)])
+        srv_c, c = await start_node("c", seeds=[("a", "127.0.0.1", a.port)],
+                                    consensus="lww")
         await settle(0.4)
         assert srv_c.broker.config.mqtt.max_inflight == 64
 
@@ -627,8 +637,11 @@ def test_session_survives_node_death_via_replication():
     node that owned them — the client resumes on the buddy."""
 
     async def t():
-        srv_a, a = await start_node("a")
-        srv_b, b = await start_node("b", seeds=[("a", "127.0.0.1", a.port)])
+        # lww pinned: buddy replication is the NON-raft DS path (raft
+        # mode's quorum store is covered by test_raft_cluster)
+        srv_a, a = await start_node("a", consensus="lww")
+        srv_b, b = await start_node("b", seeds=[("a", "127.0.0.1", a.port)],
+                                    consensus="lww")
         await settle(0.3)
 
         c = TestClient(srv_a.listeners[0].port, "phoenix")
@@ -682,8 +695,10 @@ def test_replica_dropped_when_client_returns_to_owner():
     (the cadd registry op), preventing a later stale double-restore."""
 
     async def t():
-        srv_a, a = await start_node("a")
-        srv_b, b = await start_node("b", seeds=[("a", "127.0.0.1", a.port)])
+        # lww pinned: replica-drop-on-cadd is the NON-raft DS path
+        srv_a, a = await start_node("a", consensus="lww")
+        srv_b, b = await start_node("b", seeds=[("a", "127.0.0.1", a.port)],
+                                    consensus="lww")
         await settle(0.3)
         c = TestClient(srv_a.listeners[0].port, "rt")
         await c.connect(

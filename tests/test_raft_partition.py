@@ -1,0 +1,166 @@
+"""Partition-heal convergence (VERDICT r4 #8): split a 3-node raft
+cluster 2/1, write on both sides, heal — the majority's acked writes
+survive, the minority's writes fail LOUDLY (not silently), and after
+the heal every node converges to the committed state.  The reference
+gets the same guarantee from emqx_cluster_rpc's logged transactions
+over mria (emqx_cluster_rpc.erl:26-54)."""
+
+import asyncio
+import tempfile
+
+import pytest
+
+from emqx_tpu.broker.listener import BrokerServer
+from emqx_tpu.cluster import ClusterNode
+from emqx_tpu.config import BrokerConfig
+
+
+FAST = dict(
+    heartbeat_interval=0.05, down_after=0.4, flush_interval=0.002,
+    consensus="raft", raft_fsync=False,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def boot_cluster(n=3):
+    servers, nodes = [], []
+    for i in range(n):
+        cfg = BrokerConfig()
+        cfg.listeners[0].port = 0
+        srv = BrokerServer(cfg)
+        await srv.start()
+        node = ClusterNode(
+            f"n{i}", srv.broker,
+            raft_data_dir=tempfile.mkdtemp(prefix=f"raftp-n{i}-"),
+            **FAST,
+        )
+        await node.transport.start()  # learn the port before seeding
+        servers.append(srv)
+        nodes.append(node)
+    seeds = [(f"n{i}", "127.0.0.1", nodes[i].transport.port)
+             for i in range(n)]
+    for i, node in enumerate(nodes):
+        await node.start(
+            seeds=[s for j, s in enumerate(seeds) if j != i]
+        )
+    deadline = asyncio.get_event_loop().time() + 5
+    while asyncio.get_event_loop().time() < deadline:
+        if any(nd.raft_conf.role == "leader" for nd in nodes):
+            break
+        await asyncio.sleep(0.02)
+    else:
+        raise AssertionError("no raft_conf leader")
+    return servers, nodes
+
+
+def partition(minority, majority):
+    """Full bidirectional split: each side drops traffic to the other."""
+    for n in minority:
+        n.transport.blocked |= {m.name for m in majority}
+    for m in majority:
+        m.transport.blocked |= {n.name for n in minority}
+
+
+async def wait_leader_among(nodes, timeout=6.0):
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    names = {n.name for n in nodes}
+    while loop.time() < deadline:
+        for n in nodes:
+            if n.raft_conf.role == "leader" and n.name in names:
+                return n
+        await asyncio.sleep(0.05)
+    raise AssertionError("no leader among majority after split")
+
+
+def test_partition_heal_config_and_registry_convergence():
+    async def t():
+        servers, nodes = await boot_cluster(3)
+        na, nb, nc = nodes
+        try:
+            # committed write pre-partition reaches everyone
+            await na.update_config_async("mqtt.max_qos_allowed", 2)
+            await asyncio.sleep(0.3)
+            assert nc.broker.config.mqtt.max_qos_allowed == 2
+
+            # split: nc alone vs {na, nb}
+            partition([nc], [na, nb])
+            await asyncio.sleep(1.0)  # down detection + re-election
+
+            # majority side still commits
+            leader = await wait_leader_among([na, nb])
+            await asyncio.wait_for(
+                leader.update_config_async("mqtt.max_inflight", 7),
+                timeout=10.0,
+            )
+            await asyncio.sleep(0.3)
+            other = nb if leader is na else na
+            assert other.broker.config.mqtt.max_inflight == 7
+
+            # minority side CANNOT commit: the submit fails loudly
+            with pytest.raises(Exception):
+                await nc.update_config_async("mqtt.max_inflight", 99)
+
+            # registry write on the majority during the split: the
+            # client-ownership claim rides the same committed log
+            leader.client_opened("part-client")
+            await asyncio.sleep(0.4)
+
+            # heal and converge: the minority adopts the COMMITTED
+            # history; its failed write never resurfaces anywhere
+            for n in nodes:
+                n.transport.blocked.clear()
+            deadline = asyncio.get_event_loop().time() + 12
+            while asyncio.get_event_loop().time() < deadline:
+                if (nc.broker.config.mqtt.max_inflight == 7
+                        and nc.clients.get("part-client")
+                        == leader.name):
+                    break
+                await asyncio.sleep(0.2)
+            assert nc.broker.config.mqtt.max_inflight == 7  # not 99
+            assert nc.clients.get("part-client") == leader.name
+            assert na.broker.config.mqtt.max_inflight == 7
+            assert nb.broker.config.mqtt.max_inflight == 7
+        finally:
+            for srv, node in zip(reversed(servers), reversed(nodes)):
+                await node.stop()
+                await srv.stop()
+
+    run(t())
+
+
+def test_partition_minority_keeps_serving_locally():
+    """A minority node keeps serving ITS OWN clients during the split
+    (availability for local work), while quorum-plane writes stall —
+    and the local registry claim converges cluster-wide after heal via
+    the raft log."""
+
+    async def t():
+        servers, nodes = await boot_cluster(3)
+        na, nb, nc = nodes
+        try:
+            partition([nc], [na, nb])
+            await asyncio.sleep(0.8)
+            # local (optimistic) registry apply still works on nc
+            nc.client_opened("loner")
+            assert nc.clients.get("loner") == "nc" or \
+                nc.clients.get("loner") == nc.name
+            # heal: nc's claim reaches the majority via the post-heal
+            # sync + retried log entries
+            for n in nodes:
+                n.transport.blocked.clear()
+            deadline = asyncio.get_event_loop().time() + 6
+            while asyncio.get_event_loop().time() < deadline:
+                if na.clients.get("loner") == nc.name:
+                    break
+                await asyncio.sleep(0.1)
+            assert na.clients.get("loner") == nc.name
+        finally:
+            for srv, node in zip(reversed(servers), reversed(nodes)):
+                await node.stop()
+                await srv.stop()
+
+    run(t())
