@@ -334,6 +334,12 @@ class BrokerServer:
                 None, client.start
             )
             self.exhook_clients.append(client)
+        for sink_cfg in cfg.sinks:
+            try:
+                await self._start_sink(sink_cfg)
+            except Exception:
+                log.exception("sink %r failed to start",
+                              sink_cfg.get("id"))
         if cfg.ft.enable and cfg.ft.s3:
             from ..s3 import S3Client, S3Sink
 
@@ -493,6 +499,38 @@ class BrokerServer:
                     )
             for lst in self.listeners:
                 lst.maybe_reload_crl()
+
+    async def _start_sink(self, sink_cfg: dict) -> None:
+        """One config-declared data-integration sink: registered with
+        the resource manager under its id, addressable from rule
+        SinkActions (the emqx_bridge boot path)."""
+        sid = sink_cfg["id"]
+        stype = sink_cfg.get("type", "http")
+        if stype == "kafka":
+            from ..kafka import KafkaProducerResource
+
+            res = KafkaProducerResource(
+                [tuple(b) for b in sink_cfg["bootstrap"]],
+                topic=sink_cfg["topic"],
+                acks=int(sink_cfg.get("acks", -1)),
+                client_id=sink_cfg.get(
+                    "client_id", self.broker.config.node_name
+                ),
+            )
+        elif stype == "http":
+            from ..resources import HttpSink
+
+            res = HttpSink(
+                sink_cfg["url"],
+                method=sink_cfg.get("method", "POST"),
+                headers=sink_cfg.get("headers"),
+            )
+        else:
+            raise ValueError(f"unknown sink type {stype!r}")
+        await self.broker.resources.create(
+            sid, res,
+            max_buffer=int(sink_cfg.get("max_buffer", 10_000)),
+        )
 
     async def stop(self) -> None:
         # elastic-ops agents first: their loops kick sessions and must

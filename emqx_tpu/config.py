@@ -241,6 +241,11 @@ class BrokerConfig:
     # {"enable": bool, "bind": str, "port": int,
     #  "seeds": [[name, host, port], ...],
     #  "consensus": "lww"|"raft", "raft_data_dir": str}
+    # data-integration sinks started at boot, addressable from rule
+    # SinkActions by id (the emqx_bridge config role):
+    # [{"id", "type": "http"|"kafka", ...type-specific fields}]
+    # kafka: {"bootstrap": [[host, port], ...], "topic", "acks"}
+    sinks: List[Dict[str, Any]] = field(default_factory=list)
     otel: OtelConfig = field(default_factory=OtelConfig)
     log: LogConfig = field(default_factory=LogConfig)
 

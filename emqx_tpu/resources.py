@@ -213,11 +213,34 @@ class BufferWorker:
                         CONNECTED if healthy else DISCONNECTED
                     )
                     continue
+            # batching sinks (Kafka): drain up to resource.max_batch
+            # queries into one on_query_batch call, which returns how
+            # many it consumed — a partial consume leaves the tail at
+            # the head for the retry path (the reference's buffer
+            # workers batch the same way)
+            n_batch = getattr(self.resource, "max_batch", 1)
             query = self._buf[0]  # keep at head until delivered
             try:
-                await self.resource.on_query(query)
-                self._buf.popleft()
-                self.stats["success"] += 1
+                if n_batch > 1 and hasattr(
+                    self.resource, "on_query_batch"
+                ):
+                    batch = [
+                        self._buf[i]
+                        for i in range(min(n_batch, len(self._buf)))
+                    ]
+                    done = await self.resource.on_query_batch(batch)
+                    done = len(batch) if done is None else int(done)
+                    for _ in range(done):
+                        self._buf.popleft()
+                    self.stats["success"] += done
+                    if done < len(batch):
+                        raise RuntimeError(
+                            f"sink consumed {done}/{len(batch)}"
+                        )
+                else:
+                    await self.resource.on_query(query)
+                    self._buf.popleft()
+                    self.stats["success"] += 1
                 self._set_status(CONNECTED)
                 backoff = self.retry_base
                 retries = 0
