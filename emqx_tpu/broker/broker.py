@@ -202,6 +202,7 @@ class Broker:
                 self.config.durable.data_dir,
                 n_streams=self.config.durable.n_streams,
                 store_qos0=self.config.durable.store_qos0,
+                layout=self.config.durable.layout,
             )
             # advertise boot-state filters as live routes so peers keep
             # forwarding (and this node keeps persisting) for sessions

@@ -169,7 +169,11 @@ class DurableConfig:
 
     enable: bool = False
     data_dir: str = "data/ds"
-    n_streams: int = 16
+    # storage layout: "lts" (learned topic structure + bitmask keys —
+    # wildcard replay scans only overlapping structures) or "hash"
+    # (2-level topic-prefix hash shards); pinned per data directory
+    layout: str = "lts"
+    n_streams: int = 16  # hash layout only
     store_qos0: bool = False
     sync_interval: float = 5.0  # fsync + census checkpoint cadence
     retention_hours: float = 168.0  # segment GC horizon (7 days)

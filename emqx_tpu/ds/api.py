@@ -177,6 +177,13 @@ def _props_restore(props: Dict) -> Dict:
 
 
 class DurableStorage:
+    def stream_key(self, topic: str) -> int:
+        """The write-side stream a topic maps to — the key layer
+        callers (the beamformer's store-notify) must share with
+        `store_batch`.  Layouts override; the default is the 2-level
+        hash partitioning."""
+        return stream_of(topic, getattr(self, "n_streams", 16))
+
     """Backend behavior (emqx_ds.erl:255-261 callback set)."""
 
     def store_batch(

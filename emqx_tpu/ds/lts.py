@@ -1,0 +1,310 @@
+"""Learned-topic-structure (LTS) storage layout + bitmask keymapper.
+
+The reference's storage moat is `emqx_ds_lts`
+(/root/reference/apps/emqx_durable_storage/src/emqx_ds_lts.erl:100-143):
+a trie learned from observed topics discovers which levels are
+"wildcard-worthy" (high-variability — device ids, session ids), and
+`emqx_ds_bitmask_keymapper.erl:20-70` composes storage keys from the
+static topic structure, the varying-level hashes, and time, so replay
+touches only the key ranges a filter can match.
+
+Same idea, TPU-repo shape, on the native dslog engine:
+
+  * LEARNING — a trie counts distinct children per level; a level
+    whose branching exceeds ``var_threshold`` flips (stickily) to
+    VARYING.  A topic's STRUCTURE is the topic with varying levels
+    replaced by '+': ``vehicles/v123/sensors/temp`` under a varying
+    level 1 has structure ``vehicles/+/sensors/temp``.
+  * KEYMAPPER — the dslog stream id is the composite
+    ``structure_id << VAR_BITS | crc32(varying words) & VAR_MASK``:
+    one structure spreads over up to 2^VAR_BITS sub-streams keyed by
+    its varying words, and (stream, ts) keys order records in time.
+  * REPLAY — a CONCRETE filter maps to exactly one composite stream
+    (structure + var hash).  A wildcard filter scans only the
+    sub-streams of the structures it OVERLAPS — sub-linear in the
+    total record count because non-matching structures are never
+    touched, where the flat hash layout decodes and match-tests every
+    record of a 2-level hash shard.
+
+Structure evolution is append-only: when a level flips to varying,
+records already written keep their old (concrete-structure) streams
+and new writes use the '+' structure; replay consults every structure
+overlapping the filter, so nothing is rewritten and nothing is lost.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .. import topic as T
+from ..message import Message
+from .api import (
+    DurableStorage,
+    IterRef,
+    StreamRef,
+    decode_message,
+    encode_message,
+)
+from .native import DsLog
+
+VAR_BITS = 12
+VAR_MASK = (1 << VAR_BITS) - 1
+
+
+def _overlaps(fw: Sequence[str], pw: Sequence[str]) -> bool:
+    """Can filter `fw` match any topic of structure `pw`?  Patterns
+    contain only literals and '+' (never '#')."""
+    i = 0
+    while True:
+        if i == len(fw):
+            return i == len(pw)
+        if fw[i] == "#":
+            return True
+        if i == len(pw):
+            return False
+        if fw[i] != "+" and pw[i] != "+" and fw[i] != pw[i]:
+            return False
+        i += 1
+
+
+class LtsIndex:
+    """The learned trie + structure registry + keymapper."""
+
+    def __init__(self, var_threshold: int = 32) -> None:
+        self.var_threshold = var_threshold
+        self._root = self._node()
+        self._sids: Dict[str, int] = {}  # pattern -> structure id
+        self._patterns: List[str] = []   # sid -> pattern
+
+    @staticmethod
+    def _node() -> Dict:
+        return {"c": {}, "v": False, "p": None}
+
+    def _sid(self, pattern: str) -> int:
+        sid = self._sids.get(pattern)
+        if sid is None:
+            sid = self._sids[pattern] = len(self._patterns)
+            self._patterns.append(pattern)
+        return sid
+
+    def learn(self, words: Sequence[str]) -> Tuple[int, List[str]]:
+        """Insert a topic; returns (structure id, varying words)."""
+        node = self._root
+        pattern: List[str] = []
+        varw: List[str] = []
+        for w in words:
+            if not node["v"]:
+                child = node["c"].get(w)
+                if child is None:
+                    if len(node["c"]) >= self.var_threshold:
+                        # flip (sticky): this level is wildcard-worthy.
+                        # Existing concrete children stay reachable as
+                        # their OLD structures (append-only evolution);
+                        # new descents merge under the '+' child.
+                        node["v"] = True
+                        node["p"] = self._node()
+                        node["c"] = {}
+                    else:
+                        child = node["c"][w] = self._node()
+            if node["v"]:
+                pattern.append("+")
+                varw.append(w)
+                node = node["p"]
+            else:
+                pattern.append(w)
+                node = node["c"][w]
+        return self._sid("/".join(pattern)), varw
+
+    def key_of(self, topic: str) -> int:
+        sid, varw = self.learn(T.words(topic))
+        vh = (
+            zlib.crc32("/".join(varw).encode()) & VAR_MASK
+            if varw else 0
+        )
+        return (sid << VAR_BITS) | vh
+
+    def shards_for_filter(
+        self, flt: str, present: Iterable[int]
+    ) -> List[int]:
+        """Composite streams that could hold matches for `flt` —
+        concrete var words collapse a structure to ONE sub-stream."""
+        fw = T.words(flt)
+        present = sorted(set(present))
+        by_sid: Dict[int, List[int]] = {}
+        for shard in present:
+            by_sid.setdefault(shard >> VAR_BITS, []).append(shard)
+        out: List[int] = []
+        for sid, shards in by_sid.items():
+            if sid >= len(self._patterns):
+                out.extend(shards)  # unknown structure: cannot prune
+                continue
+            pw = self._patterns[sid].split("/")
+            if not _overlaps(fw, pw):
+                continue
+            varw: Optional[List[str]] = []
+            for i, p in enumerate(pw):
+                if p != "+":
+                    continue
+                # positions at/after a trailing '#' (or beyond the
+                # filter, only reachable under one) are unconstrained
+                if i >= len(fw) or fw[i] in ("+", "#"):
+                    varw = None  # wildcard over a varying level
+                    break
+                varw.append(fw[i])
+            if varw is None:
+                out.extend(shards)
+            else:
+                vh = (
+                    zlib.crc32("/".join(varw).encode()) & VAR_MASK
+                    if varw else 0
+                )
+                key = (sid << VAR_BITS) | vh
+                if key in shards:
+                    out.append(key)
+        return sorted(out)
+
+    # --------------------------------------------------- persistence
+
+    def to_json(self) -> Dict:
+        return {
+            "var_threshold": self.var_threshold,
+            "patterns": self._patterns,
+            "trie": self._root,
+        }
+
+    @classmethod
+    def from_json(cls, obj: Dict) -> "LtsIndex":
+        idx = cls(var_threshold=int(obj.get("var_threshold", 32)))
+        idx._patterns = list(obj.get("patterns", ()))
+        idx._sids = {p: i for i, p in enumerate(idx._patterns)}
+        idx._root = obj.get("trie") or cls._node()
+        return idx
+
+
+class LtsStorage(DurableStorage):
+    """dslog-backed storage with the LTS layout (drop-in sibling of
+    builtin_local.LocalStorage; differential-tested against
+    ds/reference.py)."""
+
+    def __init__(
+        self,
+        directory: str,
+        var_threshold: int = 32,
+        seg_bytes: int = 0,
+    ) -> None:
+        self.directory = directory
+        self._log = DsLog(directory, seg_bytes=seg_bytes)
+        self._index_path = os.path.join(directory, "lts_index.json")
+        self.index = self._load_index(var_threshold)
+
+    # ----------------------------------------------------------- write
+
+    def store_batch(
+        self, msgs: Sequence[Message], sync: bool = False
+    ) -> None:
+        for msg in msgs:
+            key = self.index.key_of(msg.topic)
+            ts_us = int(msg.timestamp * 1e6)
+            self._log.append(key, ts_us, encode_message(msg))
+        if sync:
+            self._log.sync()
+            self._save_index()
+
+    def stream_key(self, topic: str) -> int:
+        return self.index.key_of(topic)
+
+    # ------------------------------------------------------------ read
+
+    def get_streams(
+        self, topic_filter: str, start_time_us: int = 0
+    ) -> List[StreamRef]:
+        shards = self.index.shards_for_filter(
+            topic_filter, self._log.streams()
+        )
+        return [StreamRef(shard=s) for s in shards]
+
+    def next(self, it: IterRef, n: int) -> Tuple[IterRef, List[Message]]:
+        # the layout prunes WHICH streams are scanned; each record is
+        # still filter-checked, so correctness never rests on the
+        # learned structure being right
+        out: List[Message] = []
+        ts, seq = it.ts, it.seq
+        fwords = T.words(it.topic_filter)
+        for ets, eseq, payload in self._log.scan(it.stream.shard, ts):
+            if (ets, eseq) <= (ts, seq):
+                continue
+            if len(out) >= n:
+                break
+            msg = decode_message(payload)
+            if T.match_words(T.words(msg.topic), fwords):
+                out.append(msg)
+            ts, seq = ets, eseq
+        return IterRef(it.stream, it.topic_filter, ts, seq), out
+
+    # ------------------------------------------------------ lifecycle
+
+    def _load_index(self, var_threshold: int) -> LtsIndex:
+        try:
+            with open(self._index_path) as f:
+                obj = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            obj = None
+        if obj is not None and obj.get("count") == self._record_count():
+            return LtsIndex.from_json(obj["index"])
+        # stale or absent (crash after the last save): rebuild from
+        # the log — it is the source of truth, and a wrong index
+        # would mis-place NEW writes relative to old ones
+        idx = LtsIndex(var_threshold)
+        rebuilt = False
+        for shard in self._log.streams():
+            for _ts, _seq, payload in self._log.scan(shard, 0):
+                msg = decode_message(payload)
+                idx.learn(T.words(msg.topic))
+                rebuilt = True
+        if rebuilt or obj is not None:
+            self.index = idx
+            self._save_index()
+        return idx
+
+    def _record_count(self) -> int:
+        return sum(
+            self._log.stream_count(s) for s in self._log.streams()
+        )
+
+    def _save_index(self) -> None:
+        tmp = self._index_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {"count": self._record_count(),
+                 "index": self.index.to_json()}, f
+            )
+        os.replace(tmp, self._index_path)
+
+    def gc(self, cutoff_ts_us: int) -> int:
+        return self._log.gc(cutoff_ts_us)
+
+    def sync(self) -> None:
+        self._log.sync()
+        self._save_index()
+
+    def stats(self) -> Dict[str, int]:
+        n = self._record_count()
+        return {
+            "streams": len(self._log.streams()),
+            "structures": len(self.index._patterns),
+            "messages": n,
+            "records": n,
+        }
+
+    def close(self) -> None:
+        if getattr(self, "_closed", False):
+            return  # idempotent: server stop + explicit close both land
+        self._closed = True
+        try:
+            self._save_index()
+        except OSError:
+            pass
+        self._log.close()

@@ -87,10 +87,42 @@ class DurableSessions:
         directory: str,
         n_streams: int = 16,
         store_qos0: bool = False,
+        layout: str = "lts",
     ) -> None:
-        self.storage = LocalStorage(
-            os.path.join(directory, "messages"), n_streams=n_streams
-        )
+        msg_dir = os.path.join(directory, "messages")
+        os.makedirs(msg_dir, exist_ok=True)
+        # the layout is a property of the DATA: records written under
+        # one keymapper are unreadable under another, so a directory
+        # marker pins it and wins over a changed config (with a loud
+        # log) instead of silently orphaning the history.  Pre-marker
+        # directories (older builds) are the hash layout — their
+        # census.json gives them away.
+        marker = os.path.join(msg_dir, "LAYOUT")
+        on_disk = None
+        try:
+            with open(marker) as f:
+                on_disk = f.read().strip()
+        except OSError:
+            if os.path.exists(os.path.join(msg_dir, "census.json")):
+                on_disk = "hash"
+        if on_disk and on_disk != layout:
+            import logging
+
+            logging.getLogger("emqx_tpu.ds").warning(
+                "durable layout pinned to %r by existing data "
+                "(config asked for %r)", on_disk, layout,
+            )
+            layout = on_disk
+        if on_disk is None:
+            with open(marker, "w") as f:
+                f.write(layout)
+        self.layout = layout
+        if layout == "lts":
+            from .lts import LtsStorage
+
+            self.storage = LtsStorage(msg_dir)
+        else:
+            self.storage = LocalStorage(msg_dir, n_streams=n_streams)
         self.state_dir = os.path.join(directory, "sessions")
         os.makedirs(self.state_dir, exist_ok=True)
         self.store_qos0 = store_qos0
@@ -171,11 +203,8 @@ class DurableSessions:
         if batch:
             self.storage.store_batch(batch)
             if self.beamformer.has_parked():
-                from .api import stream_of
-
                 self.beamformer.notify({
-                    stream_of(m.topic, self.storage.n_streams)
-                    for m in batch
+                    self.storage.stream_key(m.topic) for m in batch
                 })
         return len(batch)
 

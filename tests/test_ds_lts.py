@@ -1,0 +1,167 @@
+"""LTS storage layout (ds/lts.py): learned topic structures + bitmask
+composite keys, differential-tested against the in-memory oracle (the
+emqx_ds_storage_reference pattern) and benchmarked for the property
+that justifies it — wildcard replay scans only overlapping structures
+instead of every record (emqx_ds_lts.erl:100-143,
+emqx_ds_bitmask_keymapper.erl:20-70)."""
+
+import random
+import time
+
+import pytest
+
+from emqx_tpu.ds import ReferenceStorage
+from emqx_tpu.ds.lts import VAR_BITS, LtsIndex, LtsStorage, _overlaps
+from emqx_tpu.message import Message
+
+from test_ds import drain, make_msgs
+
+
+# ----------------------------------------------------------- index unit
+
+def test_overlap_matrix():
+    cases = [
+        ("a/b", "a/b", True),
+        ("a/+", "a/b", True),
+        ("a/#", "a/b/c", True),
+        ("#", "x/y", True),
+        ("a/b", "a/+", True),   # structure's var level
+        ("a/b/c", "a/b", False),
+        ("a/b", "a/b/c", False),
+        ("x/+", "y/+", False),
+    ]
+    for f, p, want in cases:
+        assert _overlaps(f.split("/"), p.split("/")) == want, (f, p)
+
+
+def test_level_discovery_flips_to_varying():
+    idx = LtsIndex(var_threshold=4)
+    for i in range(10):
+        idx.learn(["fleet", f"v{i}", "temp"])
+    # after the threshold, new vehicle ids merge under '+'
+    assert "fleet/+/temp" in idx._sids
+    sid, varw = idx.learn(["fleet", "v999", "temp"])
+    assert idx._patterns[sid] == "fleet/+/temp"
+    assert varw == ["v999"]
+    # low-variability structures stay concrete
+    sid2, varw2 = idx.learn(["cfg", "global"])
+    assert idx._patterns[sid2] == "cfg/global" and varw2 == []
+
+
+def test_concrete_filter_maps_to_one_stream():
+    idx = LtsIndex(var_threshold=4)
+    keys = set()
+    for i in range(50):
+        keys.add(idx.key_of(f"fleet/v{i}/temp"))
+    assert len(keys) > 1  # var hash spreads sub-streams
+    shards = idx.shards_for_filter("fleet/v7/temp", keys)
+    assert len(shards) == 1
+    assert shards[0] == idx.key_of("fleet/v7/temp")
+    # wildcard over the varying level: all of the structure's shards
+    assert set(idx.shards_for_filter("fleet/+/temp", keys)) == keys
+    # non-overlapping filter: nothing
+    assert idx.shards_for_filter("grid/+/load", keys) == []
+
+
+def test_index_json_roundtrip():
+    idx = LtsIndex(var_threshold=3)
+    for i in range(20):
+        idx.learn(["a", f"x{i}", "b"])
+    idx2 = LtsIndex.from_json(idx.to_json())
+    assert idx2.key_of("a/x5/b") == idx.key_of("a/x5/b")
+    assert idx2._patterns == idx._patterns
+
+
+# ----------------------------------------------------- oracle equivalence
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_lts_matches_reference_oracle(tmp_path, seed):
+    rng = random.Random(seed)
+    msgs = make_msgs(rng, 300)
+    # plus a high-variability family that exercises the var flip
+    t0 = 1_700_000_500.0
+    for i in range(200):
+        msgs.append(Message(
+            topic=f"veh/v{i % 60}/s/{rng.choice(['t', 'p'])}",
+            payload=f"vv-{i}".encode(),
+            timestamp=t0 + i * 0.001,
+        ))
+    lts = LtsStorage(str(tmp_path / "lts"), var_threshold=8)
+    oracle = ReferenceStorage(n_streams=8)
+    for i in range(0, len(msgs), 41):
+        batch = msgs[i: i + 41]
+        lts.store_batch(batch)
+        oracle.store_batch(batch)
+    for flt in ("#", "fleet/#", "dev/+", "a/b", "+/+/x7", "nomatch/+",
+                "veh/v7/s/t", "veh/+/s/t", "veh/v8/#", "veh/+/s/+"):
+        assert drain(lts, flt) == drain(oracle, flt), flt
+    lts.close()
+
+
+def test_lts_crash_recovery_rebuilds_index(tmp_path):
+    d = str(tmp_path / "ds")
+    store = LtsStorage(d, var_threshold=4)
+    msgs = [
+        Message(topic=f"iot/d{i}/x", payload=str(i).encode(),
+                timestamp=1_700_000_000.0 + i)
+        for i in range(30)
+    ]
+    store.store_batch(msgs)
+    store._log.sync()  # data durable, index NOT saved (crash window)
+    store._log.close()
+
+    store2 = LtsStorage(d, var_threshold=4)  # index rebuilt from log
+    got = drain(store2, "iot/+/x")
+    assert len(got) == 30
+    # and new writes keep mapping consistently with the old ones
+    store2.store_batch([Message(
+        topic="iot/d5/x", payload=b"new", timestamp=1_700_000_100.0
+    )])
+    got2 = drain(store2, "iot/d5/x")
+    assert (b"5" in dict((p, p) for _, p in got2)
+            or len(got2) == 2)
+    store2.close()
+
+
+# --------------------------------------------------------- the property
+
+def test_wildcard_replay_is_sublinear(tmp_path):
+    """The layout's reason to exist: with 100k+ topics across several
+    structures, replaying one structure's wildcard must NOT scan the
+    other structures' records, and a concrete filter must touch ~1
+    sub-stream.  The flat hash layout scans (and decodes) every record
+    of a 2-level hash shard."""
+    n_per_family = 40_000
+    fams = ["veh/%d/t", "grid/%d/load", "app/%d/evt"]
+    lts = LtsStorage(str(tmp_path / "big"), var_threshold=16)
+    t0 = 1_700_000_000.0
+    for f_i, fam in enumerate(fams):
+        batch = [
+            Message(topic=fam % i, payload=b"x",
+                    timestamp=t0 + f_i * n_per_family + i)
+            for i in range(n_per_family)
+        ]
+        lts.store_batch(batch)
+    total = lts.stats()["records"]
+    assert total == n_per_family * len(fams)  # 120k records
+
+    # wildcard over ONE family: scanned streams hold only that family
+    shards = lts.get_streams("veh/+/t")
+    scanned = sum(
+        lts._log.stream_count(s.shard) for s in shards
+    )
+    assert scanned == n_per_family  # not 120k: sub-linear vs flat scan
+
+    # concrete topic: ~1/(2^VAR_BITS) of the family
+    shards_c = lts.get_streams("veh/123/t")
+    assert len(shards_c) == 1
+    scanned_c = lts._log.stream_count(shards_c[0].shard)
+    assert scanned_c <= max(4 * n_per_family / (1 << VAR_BITS), 64)
+
+    # and the replay itself returns exactly the right record fast
+    t1 = time.perf_counter()
+    out = drain(lts, "veh/123/t", page=64)
+    dt = time.perf_counter() - t1
+    assert len(out) == 1
+    assert dt < 1.0  # decodes dozens of records, not 120k
+    lts.close()
