@@ -125,6 +125,9 @@ class Broker:
         from ..trace import TraceManager
 
         self.trace = TraceManager(self)
+        # OTel span factory (otel.Tracer), wired by the OtelExporter
+        # when trace export is enabled; None = zero-cost no-op
+        self.tracer = None
         self.alarms = AlarmRegistry(self)
         self.resources.alarms = self.alarms
         self.banned = BannedList()
@@ -639,6 +642,25 @@ class Broker:
             msg = out  # type: ignore[assignment]
             try:
                 self.metrics.inc("messages.publish")
+                if self.tracer is not None and not msg.sys:
+                    # one publish span per routed message; an upstream
+                    # traceparent (publisher's user property) becomes
+                    # the parent and the span's context is injected so
+                    # every subscriber receives the continued trace
+                    span = self.tracer.start(
+                        "message.publish",
+                        parent=self.tracer.extract(msg.properties),
+                        attrs={
+                            "messaging.system": "mqtt",
+                            "messaging.destination.name": msg.topic,
+                            "messaging.client_id": msg.from_client or "",
+                            "mqtt.qos": msg.qos,
+                        },
+                        kind=2,  # SERVER: the broker handling the inbound publish
+                    )
+                    if span is not None:
+                        self.tracer.inject(msg.properties, span)
+                        msg._otel_span = span
                 if msg.retain and not msg.sys:
                     if self.retainer.store(msg):
                         if msg.payload:
@@ -819,14 +841,34 @@ class Broker:
                       if not self._delivery_allowed(cid, msg)]
             for cid in denied:
                 del per_client[cid]
+        pub_span = getattr(msg, "_otel_span", None)
         if not per_client:
             self.metrics.inc("messages.dropped")
             self.metrics.inc("messages.dropped.no_subscribers")
             self.hooks.run("message.dropped", msg, "no_subscribers")
+            if pub_span is not None and self.tracer is not None:
+                pub_span.attrs["messaging.deliveries"] = 0
+                self.tracer.end(pub_span)
             return 0
         delivered = 0
         for clientid, deliveries in per_client.items():
             delivered += self._deliver_to(clientid, deliveries)
+            if pub_span is not None and self.tracer is not None:
+                # child deliver span per receiving client (the
+                # reference's message.deliver trace point)
+                self.tracer.end(self.tracer.start(
+                    "message.deliver",
+                    parent=pub_span,
+                    attrs={
+                        "messaging.system": "mqtt",
+                        "messaging.destination.name": msg.topic,
+                        "messaging.client_id": clientid,
+                    },
+                    kind=4,  # PRODUCER: broker pushing to subscriber
+                ))
+        if pub_span is not None and self.tracer is not None:
+            pub_span.attrs["messaging.deliveries"] = delivered
+            self.tracer.end(pub_span)
         self.metrics.inc("messages.delivered", delivered)
         return delivered
 

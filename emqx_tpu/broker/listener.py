@@ -363,6 +363,8 @@ class BrokerServer:
                 cfg.otel.endpoint,
                 interval=cfg.otel.interval,
                 export_logs=cfg.otel.export_logs,
+                export_traces=cfg.otel.export_traces,
+                trace_sample_ratio=cfg.otel.trace_sample_ratio,
             )
             await self.otel.start()
         if (cfg.log.format != "text" or cfg.log.level != "info"

@@ -187,6 +187,10 @@ class OtelConfig:
     endpoint: str = "http://127.0.0.1:4318"
     interval: float = 10.0
     export_logs: bool = False
+    # distributed trace spans (emqx_otel_trace): publish/deliver spans
+    # with W3C traceparent propagation through MQTT 5 user properties
+    export_traces: bool = False
+    trace_sample_ratio: float = 1.0
 
 
 @dataclass

@@ -2,9 +2,17 @@
 
 The `emqx_opentelemetry` role (/root/reference/apps/emqx_opentelemetry/
 src/emqx_otel_metrics.erl periodic metric push, emqx_otel_logger.erl
-log bridge): broker counters/gauges go out as OTLP `resourceMetrics`
-to ``{endpoint}/v1/metrics`` on an interval, and (optionally) log
-records as OTLP `resourceLogs` to ``{endpoint}/v1/logs``.
+log bridge, emqx_otel_trace.erl distributed spans behind the
+emqx_external_trace behavior): broker counters/gauges go out as OTLP
+`resourceMetrics` to ``{endpoint}/v1/metrics`` on an interval,
+(optionally) log records as OTLP `resourceLogs` to
+``{endpoint}/v1/logs``, and (optionally) TRACE SPANS — one
+``message.publish`` span per routed message with child
+``message.deliver`` spans per receiving client — as OTLP
+`resourceSpans` to ``{endpoint}/v1/traces``, with W3C ``traceparent``
+context extracted from / injected into MQTT 5 user properties so a
+publisher's trace continues through the broker to every subscriber
+(emqx_channel.erl:439-443's trace hooks).
 
 OTLP/HTTP has a stable JSON encoding (the protobuf JSON mapping), so a
 collector ingests these payloads natively — the environment just has
@@ -17,8 +25,10 @@ from __future__ import annotations
 
 import json
 import logging
+import random
+import secrets
 import time
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 from .resources import BufferWorker, HttpSink
 
@@ -37,8 +47,123 @@ def _attrs(d: Dict[str, str]) -> List[dict]:
     ]
 
 
+class Span:
+    """One in-flight span; finished spans serialize to the OTLP JSON
+    span shape."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "name",
+                 "start_ns", "end_ns", "attrs", "kind")
+
+    def __init__(self, trace_id: str, span_id: str,
+                 parent_id: Optional[str], name: str,
+                 attrs: Dict[str, Any], kind: int) -> None:
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.start_ns = time.time_ns()
+        self.end_ns = 0
+        self.attrs = attrs
+        self.kind = kind
+
+    @property
+    def traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+    def to_json(self) -> Dict:
+        out = {
+            "traceId": self.trace_id,
+            "spanId": self.span_id,
+            "name": self.name,
+            "kind": self.kind,
+            "startTimeUnixNano": str(self.start_ns),
+            "endTimeUnixNano": str(self.end_ns or time.time_ns()),
+            "attributes": _attrs(self.attrs),
+        }
+        if self.parent_id:
+            out["parentSpanId"] = self.parent_id
+        return out
+
+
+class Tracer:
+    """The span factory + batcher: finished spans accumulate and flush
+    through the exporter's traces worker.  Sampling: an upstream
+    ``traceparent`` is always honored (the publisher opted the message
+    in); root spans sample at ``sample_ratio``."""
+
+    USER_PROP_KEY = "traceparent"
+
+    def __init__(self, sample_ratio: float = 1.0,
+                 flush_at: int = 64) -> None:
+        self.sample_ratio = sample_ratio
+        self.flush_at = flush_at
+        self._done: List[Span] = []
+        self.on_flush = None  # set by the exporter
+        self.stats = {"spans": 0, "sampled_out": 0}
+
+    # ------------------------------------------------------ context
+
+    @classmethod
+    def extract(cls, properties: Dict) -> Optional[str]:
+        """W3C traceparent from MQTT 5 user properties."""
+        for k, v in properties.get("user_property", ()) or ():
+            if k == cls.USER_PROP_KEY:
+                return v
+        return None
+
+    @classmethod
+    def inject(cls, properties: Dict, span: "Span") -> None:
+        ups = [
+            (k, v)
+            for k, v in (properties.get("user_property", ()) or ())
+            if k != cls.USER_PROP_KEY
+        ]
+        ups.append((cls.USER_PROP_KEY, span.traceparent))
+        properties["user_property"] = ups
+
+    # -------------------------------------------------------- spans
+
+    def start(self, name: str, parent: Optional[Any] = None,
+              attrs: Optional[Dict] = None,
+              kind: int = 1) -> Optional[Span]:
+        trace_id = parent_id = None
+        if isinstance(parent, Span):
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        elif isinstance(parent, str):
+            try:  # "00-<32 hex>-<16 hex>-<flags>"
+                _, trace_id, parent_id, _ = parent.split("-")
+            except ValueError:
+                parent = None
+        if parent is None and random.random() >= self.sample_ratio:
+            self.stats["sampled_out"] += 1
+            return None
+        return Span(
+            trace_id or secrets.token_hex(16),
+            secrets.token_hex(8),
+            parent_id, name, dict(attrs or ()), kind,
+        )
+
+    def end(self, span: Optional[Span]) -> None:
+        if span is None:
+            return
+        span.end_ns = time.time_ns()
+        self._done.append(span)
+        self.stats["spans"] += 1
+        if len(self._done) >= self.flush_at:
+            self.flush()
+
+    def flush(self) -> None:
+        if self._done and self.on_flush is not None:
+            spans, self._done = self._done, []
+            try:
+                self.on_flush(spans)
+            except Exception:
+                pass
+
+
 class OtelExporter:
-    """Periodic OTLP metric push + optional log bridge for one broker."""
+    """Periodic OTLP metric push + optional log bridge + optional
+    span pipeline for one broker."""
 
     def __init__(
         self,
@@ -47,14 +172,22 @@ class OtelExporter:
         interval: float = 10.0,
         export_logs: bool = False,
         log_level: int = logging.WARNING,
+        export_traces: bool = False,
+        trace_sample_ratio: float = 1.0,
     ) -> None:
         self.broker = broker
         self.endpoint = endpoint.rstrip("/")
         self.interval = interval
         self.export_logs = export_logs
         self.log_level = log_level
+        self.export_traces = export_traces
+        self.tracer: Optional[Tracer] = (
+            Tracer(sample_ratio=trace_sample_ratio)
+            if export_traces else None
+        )
         self._metrics_worker: Optional[BufferWorker] = None
         self._logs_worker: Optional[BufferWorker] = None
+        self._traces_worker: Optional[BufferWorker] = None
         self._handler: Optional[logging.Handler] = None
         self._last: float = 0.0
         self._resource = {
@@ -88,17 +221,48 @@ class OtelExporter:
             self._handler = _OtelLogHandler(self)
             self._handler.setLevel(self.log_level)
             logging.getLogger("emqx_tpu").addHandler(self._handler)
+        if self.tracer is not None:
+            self._traces_worker = BufferWorker(
+                HttpSink(self.endpoint + "/v1/traces",
+                         headers={"Content-Type": "application/json"}),
+                max_buffer=256,
+                max_retries=3,
+            )
+            await self._traces_worker.start()
+            self.tracer.on_flush = self._flush_spans
+            # the broker's publish/dispatch path consults this handle
+            self.broker.tracer = self.tracer
 
     async def stop(self) -> None:
         if self._handler is not None:
             logging.getLogger("emqx_tpu").removeHandler(self._handler)
             self._handler = None
+        if self.tracer is not None:
+            self.broker.tracer = None
+            self.tracer.flush()
         if self._metrics_worker is not None:
             await self._metrics_worker.stop()
             self._metrics_worker = None
         if self._logs_worker is not None:
             await self._logs_worker.stop()
             self._logs_worker = None
+        if self._traces_worker is not None:
+            await self._traces_worker.stop()
+            self._traces_worker = None
+
+    def _flush_spans(self, spans: List[Span]) -> None:
+        if self._traces_worker is None:
+            return
+        body = json.dumps({
+            "resourceSpans": [{
+                "resource": self._resource,
+                "scopeSpans": [{
+                    "scope": {"name": "emqx_tpu"},
+                    "spans": [s.to_json() for s in spans],
+                }],
+            }]
+        }).encode()
+        self._traces_worker.enqueue(body)
 
     # -------------------------------------------------------- metrics
 
@@ -106,6 +270,8 @@ class OtelExporter:
         """Called from the broker's 1 Hz housekeeping; exports every
         ``interval`` seconds.  Returns True when a push was queued."""
         now = time.time() if now is None else now
+        if self.tracer is not None:
+            self.tracer.flush()  # bound span latency to the tick
         if now - self._last < self.interval:
             return False
         self._last = now

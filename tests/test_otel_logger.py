@@ -152,3 +152,85 @@ def test_log_throttler_windows_and_summary():
     finally:
         logger.removeHandler(ours)
         logger.removeHandler(sibling)
+
+
+def test_otel_trace_spans_capture():
+    """Distributed trace spans (emqx_otel_trace / emqx_external_trace
+    role): a publish produces a message.publish span with one
+    message.deliver child per receiving client, the publisher's W3C
+    traceparent user property is honored as the parent AND propagated
+    to subscribers, and the OTLP/JSON payload lands on a collector."""
+
+    async def t():
+        from aiohttp import web
+
+        from emqx_tpu.message import Message
+        from mqtt_client import TestClient
+
+        received = []
+
+        async def collect(request):
+            received.append(await request.json())
+            return web.Response(status=200)
+
+        async def head(request):
+            return web.Response()
+
+        app = web.Application()
+        for path in ("/v1/metrics", "/v1/traces"):
+            app.router.add_post(path, collect if path.endswith(
+                "traces") else head)
+            app.router.add_route("HEAD", path, head)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+
+        cfg = BrokerConfig()
+        cfg.listeners = [ListenerConfig(port=0)]
+        cfg.otel.enable = True
+        cfg.otel.endpoint = f"http://127.0.0.1:{port}"
+        cfg.otel.export_traces = True
+        srv = BrokerServer(cfg)
+        await srv.start()
+        assert srv.broker.tracer is not None
+
+        sub = TestClient(srv.listeners[0].port, "tsub")
+        await sub.connect()
+        await sub.subscribe("traced/#", qos=0)
+
+        upstream = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+        srv.broker.publish(Message(
+            topic="traced/x", payload=b"hi",
+            properties={"user_property": [("traceparent", upstream)]},
+        ))
+        # the subscriber receives the CONTINUED trace context
+        pkt = await sub.recv_publish(timeout=5)
+        ups = dict(pkt.properties.get("user_property", ()))
+        assert "traceparent" in ups
+        assert ups["traceparent"].split("-")[1] == "ab" * 16
+
+        srv.broker.tracer.flush()
+        for _ in range(100):
+            if received:
+                break
+            await asyncio.sleep(0.05)
+        assert received, "collector never received spans"
+        spans = received[0]["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        names = [s["name"] for s in spans]
+        assert "message.publish" in names and "message.deliver" in names
+        pub = next(s for s in spans if s["name"] == "message.publish")
+        dlv = next(s for s in spans if s["name"] == "message.deliver")
+        assert pub["traceId"] == "ab" * 16  # upstream trace honored
+        assert pub["parentSpanId"] == "cd" * 8
+        assert dlv["traceId"] == pub["traceId"]
+        assert dlv["parentSpanId"] == pub["spanId"]
+        attrs = {a["key"]: a["value"] for a in dlv["attributes"]}
+        assert attrs["messaging.client_id"]["stringValue"] == "tsub"
+
+        await sub.disconnect()
+        await srv.stop()
+        await runner.cleanup()
+
+    run(t())
