@@ -1,0 +1,264 @@
+"""MongoDB authentication/authorization backend — wire protocol.
+
+The reference's emqx_auth_mongodb
+(/root/reference/apps/emqx_auth_mongodb/src/) runs `find` commands
+against user/ACL collections through the mongodb driver; this module
+speaks the modern wire protocol directly (OP_MSG, opcode 2013, with a
+minimal BSON codec) so no driver dependency exists, and plugs the
+providers into the same async chain + prefetched-ACL pattern as the
+SQL/Redis backends (auth_db.py).
+
+BSON scope: the types auth documents actually use — string, double,
+int32/64, bool, null, embedded document, array.  `MongoConnector`
+pipelines one command at a time per connection (requestID matched).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+from .access import ALLOW, DENY, IGNORE, Authenticator, ClientInfo
+from .auth_db import check_algorithm_supported, verify_password
+
+log = logging.getLogger("emqx_tpu.auth_mongo")
+
+OP_MSG = 2013
+
+
+# ---------------------------------------------------------------- BSON
+
+def bson_encode(doc: Dict[str, Any]) -> bytes:
+    body = bytearray()
+    for key, val in doc.items():
+        kb = key.encode() + b"\x00"
+        if isinstance(val, bool):  # before int: bool is an int subtype
+            body += b"\x08" + kb + (b"\x01" if val else b"\x00")
+        elif isinstance(val, float):
+            body += b"\x01" + kb + struct.pack("<d", val)
+        elif isinstance(val, int):
+            if -(2 ** 31) <= val < 2 ** 31:
+                body += b"\x10" + kb + struct.pack("<i", val)
+            else:
+                body += b"\x12" + kb + struct.pack("<q", val)
+        elif isinstance(val, str):
+            vb = val.encode() + b"\x00"
+            body += b"\x02" + kb + struct.pack("<i", len(vb)) + vb
+        elif val is None:
+            body += b"\x0a" + kb
+        elif isinstance(val, dict):
+            body += b"\x03" + kb + bson_encode(val)
+        elif isinstance(val, (list, tuple)):
+            body += b"\x04" + kb + bson_encode(
+                {str(i): v for i, v in enumerate(val)}
+            )
+        else:
+            raise TypeError(f"bson: unsupported {type(val)!r}")
+    return struct.pack("<i", len(body) + 5) + bytes(body) + b"\x00"
+
+
+def bson_decode(data: bytes, offset: int = 0) -> Tuple[Dict[str, Any], int]:
+    (total,) = struct.unpack_from("<i", data, offset)
+    end = offset + total - 1  # trailing NUL
+    off = offset + 4
+    out: Dict[str, Any] = {}
+    while off < end:
+        etype = data[off]
+        off += 1
+        nul = data.index(b"\x00", off)
+        key = data[off:nul].decode()
+        off = nul + 1
+        if etype == 0x01:
+            (out[key],) = struct.unpack_from("<d", data, off)
+            off += 8
+        elif etype == 0x02:
+            (ln,) = struct.unpack_from("<i", data, off)
+            out[key] = data[off + 4:off + 4 + ln - 1].decode()
+            off += 4 + ln
+        elif etype in (0x03, 0x04):
+            sub, off = bson_decode(data, off)
+            out[key] = (
+                [sub[str(i)] for i in range(len(sub))]
+                if etype == 0x04 else sub
+            )
+        elif etype == 0x08:
+            out[key] = data[off] == 1
+            off += 1
+        elif etype == 0x0A:
+            out[key] = None
+        elif etype == 0x10:
+            (out[key],) = struct.unpack_from("<i", data, off)
+            off += 4
+        elif etype == 0x12:
+            (out[key],) = struct.unpack_from("<q", data, off)
+            off += 8
+        else:
+            raise ValueError(f"bson: unsupported type 0x{etype:02x}")
+    return out, end + 1
+
+
+# ------------------------------------------------------------ connector
+
+class MongoConnector:
+    """One OP_MSG connection; `command` runs one database command and
+    returns the reply document."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 27017,
+                 database: str = "mqtt") -> None:
+        self.host = host
+        self.port = port
+        self.database = database
+        self._r: Optional[asyncio.StreamReader] = None
+        self._w: Optional[asyncio.StreamWriter] = None
+        self._req = itertools.count(1)
+        self._lock = asyncio.Lock()
+
+    async def _ensure(self) -> None:
+        if self._w is None or self._w.is_closing():
+            self._r, self._w = await asyncio.open_connection(
+                self.host, self.port
+            )
+
+    async def command(self, doc: Dict[str, Any],
+                      timeout: float = 5.0) -> Dict[str, Any]:
+        async with self._lock:
+            await self._ensure()
+            rid = next(self._req)
+            doc = dict(doc)
+            doc.setdefault("$db", self.database)
+            body = struct.pack("<I", 0) + b"\x00" + bson_encode(doc)
+            msg = struct.pack(
+                "<iiii", 16 + len(body), rid, 0, OP_MSG
+            ) + body
+            self._w.write(msg)
+            await self._w.drain()
+            hdr = await asyncio.wait_for(
+                self._r.readexactly(16), timeout
+            )
+            length, _rid, _resp_to, opcode = struct.unpack("<iiii", hdr)
+            payload = await asyncio.wait_for(
+                self._r.readexactly(length - 16), timeout
+            )
+            if opcode != OP_MSG:
+                raise ConnectionError(f"unexpected opcode {opcode}")
+            # flagBits(4) + section kind(1) + document
+            reply, _ = bson_decode(payload, 5)
+            return reply
+
+    async def find_one(self, collection: str,
+                       flt: Dict[str, Any]) -> Optional[Dict]:
+        reply = await self.command({
+            "find": collection, "filter": flt, "limit": 1,
+        })
+        batch = reply.get("cursor", {}).get("firstBatch", [])
+        return batch[0] if batch else None
+
+    async def find(self, collection: str,
+                   flt: Dict[str, Any]) -> List[Dict]:
+        reply = await self.command({
+            "find": collection, "filter": flt,
+        })
+        return list(reply.get("cursor", {}).get("firstBatch", []))
+
+    async def close(self) -> None:
+        if self._w is not None:
+            self._w.close()
+            self._w = self._r = None
+
+
+# ------------------------------------------------------------ providers
+
+class MongoAuthenticator(Authenticator):
+    """find-one against the user collection, password verified with
+    the shared hashing suite (emqx_authn_mongodb)."""
+
+    is_async = True
+
+    def __init__(
+        self,
+        connector: MongoConnector,
+        collection: str = "mqtt_user",
+        filter_field: str = "username",
+        algorithm: str = "sha256",
+        salt_position: str = "prefix",
+        iterations: int = 50_000,
+    ) -> None:
+        check_algorithm_supported(algorithm)
+        self.connector = connector
+        self.collection = collection
+        self.filter_field = filter_field
+        self.algorithm = algorithm
+        self.salt_position = salt_position
+        self.iterations = iterations
+
+    def authenticate(self, client: ClientInfo):
+        return IGNORE, {}  # async-only provider
+
+    async def authenticate_async(self, client: ClientInfo):
+        if not client.username:
+            return IGNORE, {}
+        try:
+            row = await self.connector.find_one(
+                self.collection, {self.filter_field: client.username}
+            )
+        except Exception:
+            log.exception("mongo authn failed")
+            return IGNORE, {}
+        if not row or not row.get("password_hash"):
+            return IGNORE, {}
+        ok = verify_password(
+            client.password,
+            str(row["password_hash"]),
+            algorithm=self.algorithm,
+            salt=str(row.get("salt") or ""),
+            salt_position=self.salt_position,
+            iterations=self.iterations,
+        )
+        if not ok:
+            return DENY, {}
+        return ALLOW, {
+            "is_superuser": bool(row.get("is_superuser") or False)
+        }
+
+    async def close(self) -> None:
+        await self.connector.close()
+
+
+class MongoAuthorizer:
+    """ACL rows from a collection, prefetched at CONNECT into the
+    access layer's cache (emqx_authz_mongodb): documents carry
+    ``permission``, ``action``, and ``topics`` (list) or ``topic``."""
+
+    def __init__(
+        self,
+        connector: MongoConnector,
+        collection: str = "mqtt_acl",
+        filter_field: str = "username",
+    ) -> None:
+        self.connector = connector
+        self.collection = collection
+        self.filter_field = filter_field
+
+    async def fetch_rows(self, client: ClientInfo) -> List[Dict]:
+        docs = await self.connector.find(
+            self.collection,
+            {self.filter_field: client.username or ""},
+        )
+        rows: List[Dict] = []
+        for d in docs:
+            topics = d.get("topics") or (
+                [d["topic"]] if d.get("topic") else []
+            )
+            for t in topics:
+                rows.append({
+                    "permission": d.get("permission", ALLOW),
+                    "action": d.get("action", "all"),
+                    "topic": t,
+                })
+        return rows
+
+    async def close(self) -> None:
+        await self.connector.close()

@@ -12,6 +12,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import sys
 from typing import Dict, List, Optional
 
 from ..config import BrokerConfig, ListenerConfig
@@ -601,6 +602,11 @@ def main(argv: Optional[List[str]] = None) -> None:
         help="spawn N worker processes sharing the port "
         "(SO_REUSEPORT accept pool, clustered on loopback)",
     )
+    ap.add_argument(
+        "--check-config", action="store_true",
+        help="validate config (file + EMQX_TPU_* env overrides) and "
+        "exit: 0 = boots cleanly (bin/emqx check_config role)",
+    )
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
 
@@ -630,6 +636,30 @@ def main(argv: Optional[List[str]] = None) -> None:
         cfg = ConfigHandler.load(args.config).root
     else:
         cfg = BrokerConfig()
+    # EMQX_TPU_A__B=value environment overrides land between the file
+    # and the CLI flags (the reference's EMQX_* env layering)
+    from ..config import apply_env_overrides, check_config
+
+    try:
+        applied = apply_env_overrides(cfg)
+    except ValueError as exc:
+        print(f"config error: {exc}", file=sys.stderr)
+        raise SystemExit(2)
+    for path, value in applied:
+        log.info("env override: %s = %r", path, value)
+    if args.check_config:
+        problems = check_config(cfg)
+        for p in problems:
+            print(f"config error: {p}", file=sys.stderr)
+        print("config ok" if not problems else
+              f"{len(problems)} problem(s)",
+              file=sys.stderr if problems else sys.stdout)
+        raise SystemExit(1 if problems else 0)
+    problems = check_config(cfg)
+    if problems:
+        for p in problems:
+            print(f"config error: {p}", file=sys.stderr)
+        raise SystemExit(2)
     # CLI flags override the first listener only when given explicitly
     # (default 1883 / 0.0.0.0 must not clobber a config file)
     if args.port is not None:

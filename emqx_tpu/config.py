@@ -326,3 +326,117 @@ def _merge_dataclass(obj: Any, data: Dict[str, Any]) -> None:
             setattr(obj, key, [ListenerConfig(**item) for item in val])
         else:
             setattr(obj, key, val)
+
+
+# -------------------------------------------------- env-var overrides
+
+ENV_PREFIX = "EMQX_TPU_"
+
+
+def apply_env_overrides(
+    cfg: BrokerConfig, environ: Optional[Dict[str, str]] = None
+) -> List[Tuple[str, Any]]:
+    """The reference's ``EMQX_<PATH>__<KEY>`` environment overrides
+    (/root/reference/bin/emqx env handling): every variable
+    ``EMQX_TPU_A__B__C=value`` sets config path ``a.b.c`` BEFORE the
+    broker boots.  Values parse as JSON when they can (numbers, bools,
+    lists, objects) and fall back to plain strings; the target leaf
+    must exist — unknown paths are a hard error, exactly like an
+    unknown key in a config file.  Returns the applied (path, value)
+    list for boot logging."""
+    import os
+
+    environ = dict(os.environ) if environ is None else environ
+    applied: List[Tuple[str, Any]] = []
+    for name in sorted(environ):
+        if not name.startswith(ENV_PREFIX):
+            continue
+        path = name[len(ENV_PREFIX):].lower().replace("__", ".")
+        raw = environ[name]
+        try:
+            value: Any = json.loads(raw)
+        except (json.JSONDecodeError, ValueError):
+            value = raw
+        parts = path.split(".")
+        obj: Any = cfg
+        for part in parts[:-1]:
+            if isinstance(obj, dict):
+                if part not in obj:
+                    raise ValueError(f"unknown config path in {name}")
+                obj = obj[part]
+            else:
+                if not hasattr(obj, part):
+                    raise ValueError(f"unknown config path in {name}")
+                obj = getattr(obj, part)
+        leaf = parts[-1]
+        if isinstance(obj, dict):
+            obj[leaf] = value
+        else:
+            if not hasattr(obj, leaf):
+                raise ValueError(f"unknown config path in {name}")
+            old = getattr(obj, leaf)
+            if old is not None and value is not None \
+                    and not isinstance(value, type(old)) \
+                    and not isinstance(old, (dict, list)):
+                try:
+                    value = type(old)(value)
+                except (TypeError, ValueError) as exc:
+                    raise ValueError(
+                        f"{name}: cannot coerce {raw!r} to "
+                        f"{type(old).__name__}"
+                    ) from exc
+            setattr(obj, leaf, value)
+        applied.append((path, value))
+    return applied
+
+
+# ---------------------------------------------------- boot-time check
+
+def check_config(cfg: BrokerConfig) -> List[str]:
+    """Boot-time validation (the `bin/emqx check_config` role): returns
+    a list of problems, empty = boots cleanly.  Checks the enum-valued
+    and cross-field constraints a typo would silently break."""
+    problems: List[str] = []
+
+    def bad(msg: str) -> None:
+        problems.append(msg)
+
+    for i, lst in enumerate(cfg.listeners):
+        if lst.type not in ("tcp", "ssl", "ws", "wss", "quic"):
+            bad(f"listeners[{i}].type: unknown type {lst.type!r}")
+        if lst.type in ("ssl", "wss", "quic") and not (
+            getattr(lst, "certfile", None)
+            and getattr(lst, "keyfile", None)
+        ):
+            bad(f"listeners[{i}]: {lst.type} requires certfile+keyfile")
+        if not (0 <= int(lst.port) <= 65535):
+            bad(f"listeners[{i}].port: {lst.port} out of range")
+    if cfg.mqtt.max_qos_allowed not in (0, 1, 2):
+        bad(f"mqtt.max_qos_allowed: {cfg.mqtt.max_qos_allowed}")
+    if cfg.mqtt.mqueue_default_priority not in ("lowest", "highest"):
+        bad("mqtt.mqueue_default_priority must be lowest|highest")
+    if cfg.durable.layout not in ("lts", "hash"):
+        bad(f"durable.layout: {cfg.durable.layout!r} (lts|hash)")
+    if cfg.cluster.get("enable"):
+        if cfg.cluster.get("consensus", "raft") not in ("raft", "lww"):
+            bad("cluster.consensus must be raft|lww")
+        for j, s in enumerate(cfg.cluster.get("seeds", ())):
+            if len(s) != 3:
+                bad(f"cluster.seeds[{j}]: expected [name, host, port]")
+    for j, sink in enumerate(cfg.sinks):
+        if "id" not in sink:
+            bad(f"sinks[{j}]: missing id")
+        stype = sink.get("type", "http")
+        if stype == "kafka" and not (
+            sink.get("bootstrap") and sink.get("topic")
+        ):
+            bad(f"sinks[{j}]: kafka sink needs bootstrap + topic")
+        if stype == "http" and not sink.get("url"):
+            bad(f"sinks[{j}]: http sink needs url")
+        if stype not in ("http", "kafka"):
+            bad(f"sinks[{j}]: unknown type {stype!r}")
+    if not 0 <= float(cfg.otel.trace_sample_ratio) <= 1:
+        bad("otel.trace_sample_ratio must be in [0, 1]")
+    if cfg.engine.use_device not in (None, True, False):
+        bad("engine.use_device must be null|true|false")
+    return problems
