@@ -242,6 +242,21 @@ class BrokerServer:
             for lc in self.broker.config.listeners
             if lc.enable and lc.type in ("tcp", "ssl", "ws", "wss")
         ]
+        # QUIC listeners (UDP; the reference's MsQuic slot) start/stop
+        # alongside but are not stream-socket Listeners
+        self.quic_listeners: list = []
+        for lc in self.broker.config.listeners:
+            if lc.enable and lc.type == "quic":
+                from .quic_listener import QuicListener
+
+                self.quic_listeners.append(QuicListener(
+                    self.broker,
+                    bind=lc.bind,
+                    port=lc.port,
+                    certfile=lc.certfile,
+                    keyfile=lc.keyfile,
+                    mountpoint=lc.mountpoint,
+                ))
         self._housekeeper: Optional[asyncio.Task] = None
         self.telemetry = None
         from ..sys_topics import SysTopics
@@ -285,6 +300,8 @@ class BrokerServer:
             self.cluster_links.install()
         for lst in self.listeners:
             await lst.start()
+        for qlst in self.quic_listeners:
+            await qlst.start()
         api_cfg = self.broker.config.api
         if api_cfg.enable:
             from ..mgmt import MgmtApi
@@ -570,6 +587,8 @@ class BrokerServer:
             self.otel = None
         for lst in self.listeners:
             await lst.stop()
+        for qlst in self.quic_listeners:
+            await qlst.stop()
         if self.broker.batcher is not None:
             await self.broker.batcher.stop()
             self.broker.batcher = None

@@ -1,0 +1,10 @@
+"""QUIC v1 transport (RFC 9000/9001) — from-scratch, for the quic
+listener class the reference ships via MsQuic
+(/root/reference/apps/emqx/src/emqx_quic_connection.erl,
+emqx_listeners.erl:448).  Neither aioquic nor msquic exists in this
+environment, so the transport is implemented directly: a TLS 1.3
+handshake core (tls13.py) on `cryptography` primitives and the QUIC
+packet/frame/connection layer (connection.py), scoped to what an MQTT
+listener needs — see each module's docstring for the explicit cuts."""
+
+# connection imported lazily (listener/tests): from .connection import QuicConnection

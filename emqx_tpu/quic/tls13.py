@@ -1,0 +1,440 @@
+"""Minimal TLS 1.3 (RFC 8446) handshake core for QUIC.
+
+QUIC embeds the TLS 1.3 handshake in CRYPTO frames and takes its
+traffic secrets from the TLS key schedule (RFC 9001).  No Python ssl
+integration exists for that (CPython's ssl cannot export handshake
+secrets), so this module implements the handshake itself on
+`cryptography` primitives, scoped to one ciphersuite and one curve:
+
+  * TLS_AES_128_GCM_SHA256, key exchange x25519,
+    signature ecdsa_secp256r1_sha256 (the server cert is an EC P-256
+    key; tests mint self-signed certs);
+  * full 1-RTT handshake: CH, SH, EE, Cert, CertVerify, Finished both
+    ways; QUIC transport parameters ride their extension (0x39);
+  * NOT implemented (explicit cuts): PSK/resumption/0-RTT, HRR,
+    client certificates, key update, compatibility middlebox layers
+    (QUIC forbids them anyway), and certificate-chain VALIDATION on
+    the client (the in-repo test client pins by public key instead —
+    a production client would verify the chain).
+
+The class is sans-IO: feed handshake bytes per epoch, collect
+outgoing handshake bytes per epoch plus the derived secrets; the QUIC
+layer does all packetization."""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as hmac_mod
+import os
+import struct
+from typing import Dict, List, Optional, Tuple
+
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey,
+    X25519PublicKey,
+)
+
+# handshake message types
+CH, SH, EE, CERT, CV, FIN = 1, 2, 8, 11, 15, 20
+
+TLS_AES_128_GCM_SHA256 = 0x1301
+X25519 = 0x001D
+ECDSA_SECP256R1_SHA256 = 0x0403
+
+EXT_SNI = 0
+EXT_GROUPS = 10
+EXT_SIGALGS = 13
+EXT_ALPN = 16
+EXT_VERSIONS = 43
+EXT_KEYSHARE = 51
+EXT_QUIC_TP = 0x39
+
+HASHLEN = 32
+
+
+# ------------------------------------------------------- key schedule
+
+def hkdf_extract(salt: bytes, ikm: bytes) -> bytes:
+    return hmac_mod.new(salt, ikm, hashlib.sha256).digest()
+
+
+def hkdf_expand(prk: bytes, info: bytes, length: int) -> bytes:
+    out = b""
+    t = b""
+    i = 1
+    while len(out) < length:
+        t = hmac_mod.new(prk, t + info + bytes([i]),
+                         hashlib.sha256).digest()
+        out += t
+        i += 1
+    return out[:length]
+
+
+def hkdf_expand_label(secret: bytes, label: str, context: bytes,
+                      length: int) -> bytes:
+    lab = b"tls13 " + label.encode()
+    info = (struct.pack(">H", length) + bytes([len(lab)]) + lab
+            + bytes([len(context)]) + context)
+    return hkdf_expand(secret, info, length)
+
+
+def derive_secret(secret: bytes, label: str,
+                  transcript_hash: bytes) -> bytes:
+    return hkdf_expand_label(secret, label, transcript_hash, HASHLEN)
+
+
+def _hash(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+# --------------------------------------------------------- TLS records
+
+def _hs_msg(mtype: int, body: bytes) -> bytes:
+    return bytes([mtype]) + len(body).to_bytes(3, "big") + body
+
+
+def _ext(etype: int, body: bytes) -> bytes:
+    return struct.pack(">HH", etype, len(body)) + body
+
+
+def _parse_exts(data: bytes) -> Dict[int, bytes]:
+    out: Dict[int, bytes] = {}
+    off = 0
+    while off + 4 <= len(data):
+        et, ln = struct.unpack_from(">HH", data, off)
+        off += 4
+        out[et] = data[off:off + ln]
+        off += ln
+    return out
+
+
+class HandshakeError(Exception):
+    pass
+
+
+class Tls13:
+    """One endpoint's handshake state.  Epochs: 0=initial (cleartext
+    CRYPTO), 2=handshake, 3=application — matching the QUIC packet
+    number spaces that carry them."""
+
+    def __init__(
+        self,
+        is_server: bool,
+        alpn: str = "mqtt",
+        quic_tp: bytes = b"",
+        cert_der: Optional[bytes] = None,
+        key=None,  # ec.EllipticCurvePrivateKey (server)
+        server_name: str = "localhost",
+    ) -> None:
+        self.is_server = is_server
+        self.alpn = alpn
+        self.quic_tp = quic_tp
+        self.cert_der = cert_der
+        self.key = key
+        self.server_name = server_name
+        self.kx = X25519PrivateKey.generate()
+        self.transcript = b""
+        self.out: Dict[int, List[bytes]] = {0: [], 2: [], 3: []}
+        self.handshake_secrets: Optional[Tuple[bytes, bytes]] = None
+        self.app_secrets: Optional[Tuple[bytes, bytes]] = None
+        self.peer_quic_tp: Optional[bytes] = None
+        self.peer_cert_der: Optional[bytes] = None
+        self.negotiated_alpn: Optional[str] = None
+        self.complete = False
+        self._buf: Dict[int, bytes] = {0: b"", 2: b"", 3: b""}
+        self._master: Optional[bytes] = None
+        self._client_hs_traffic: Optional[bytes] = None
+        self._server_hs_traffic: Optional[bytes] = None
+
+    # ------------------------------------------------------- client
+
+    def client_hello(self) -> None:
+        assert not self.is_server
+        legacy_session = os.urandom(32)
+        pub = self.kx.public_key().public_bytes(
+            serialization.Encoding.Raw,
+            serialization.PublicFormat.Raw,
+        )
+        sni = self.server_name.encode()
+        exts = b"".join([
+            _ext(EXT_SNI, struct.pack(
+                ">HBH", len(sni) + 3, 0, len(sni)) + sni),
+            _ext(EXT_VERSIONS, b"\x02\x03\x04"),
+            _ext(EXT_GROUPS, struct.pack(">HH", 2, X25519)),
+            _ext(EXT_SIGALGS, struct.pack(
+                ">HH", 2, ECDSA_SECP256R1_SHA256)),
+            _ext(EXT_ALPN, struct.pack(
+                ">HB", len(self.alpn) + 1, len(self.alpn))
+                + self.alpn.encode()),
+            _ext(EXT_KEYSHARE, struct.pack(
+                ">HHH", len(pub) + 4, X25519, len(pub)) + pub),
+            _ext(EXT_QUIC_TP, self.quic_tp),
+        ])
+        body = (
+            b"\x03\x03" + os.urandom(32)
+            + bytes([len(legacy_session)]) + legacy_session
+            + struct.pack(">H", 2)
+            + struct.pack(">H", TLS_AES_128_GCM_SHA256)
+            + b"\x01\x00"  # legacy compression: null
+            + struct.pack(">H", len(exts)) + exts
+        )
+        msg = _hs_msg(CH, body)
+        self.transcript += msg
+        self.out[0].append(msg)
+
+    # -------------------------------------------------------- feeding
+
+    def feed(self, epoch: int, data: bytes) -> None:
+        """Consume handshake bytes arriving at an epoch; drives the
+        state machine and fills `out` / secrets."""
+        self._buf[epoch] += data
+        while True:
+            buf = self._buf[epoch]
+            if len(buf) < 4:
+                return
+            ln = int.from_bytes(buf[1:4], "big")
+            if len(buf) < 4 + ln:
+                return
+            msg, self._buf[epoch] = buf[:4 + ln], buf[4 + ln:]
+            self._on_message(epoch, msg[0], msg[4:], msg)
+
+    # ------------------------------------------------- state machine
+
+    def _on_message(self, epoch: int, mtype: int, body: bytes,
+                    raw: bytes) -> None:
+        if self.is_server:
+            if mtype == CH and epoch == 0:
+                self._server_on_client_hello(body, raw)
+            elif mtype == FIN and epoch == 2:
+                self._server_on_finished(body, raw)
+            else:
+                raise HandshakeError(
+                    f"server: unexpected msg {mtype} at epoch {epoch}"
+                )
+            return
+        if mtype == SH and epoch == 0:
+            self._client_on_server_hello(body, raw)
+        elif mtype == EE and epoch == 2:
+            self.transcript += raw
+            exts = _parse_exts(body[2:])
+            self.peer_quic_tp = exts.get(EXT_QUIC_TP)
+            if EXT_ALPN in exts:
+                alpn = exts[EXT_ALPN]
+                self.negotiated_alpn = alpn[3:].decode()
+        elif mtype == CERT and epoch == 2:
+            self.transcript += raw
+            # certificate_request_context (1B len) + cert list
+            off = 1 + body[0]
+            off += 3  # list length
+            cert_len = int.from_bytes(body[off:off + 3], "big")
+            self.peer_cert_der = body[off + 3:off + 3 + cert_len]
+        elif mtype == CV and epoch == 2:
+            self._client_on_cert_verify(body, raw)
+        elif mtype == FIN and epoch == 2:
+            self._client_on_finished(body, raw)
+        else:
+            raise HandshakeError(
+                f"client: unexpected msg {mtype} at epoch {epoch}"
+            )
+
+    # -------------------------------------------------- server flight
+
+    def _server_on_client_hello(self, body: bytes, raw: bytes) -> None:
+        self.transcript += raw
+        off = 34  # legacy_version(2) + random(32)
+        sess_len = body[off]
+        off += 1 + sess_len
+        (n_suites,) = struct.unpack_from(">H", body, off)
+        suites = body[off + 2:off + 2 + n_suites]
+        off += 2 + n_suites
+        off += 1 + body[off]  # compression
+        (ext_len,) = struct.unpack_from(">H", body, off)
+        exts = _parse_exts(body[off + 2:off + 2 + ext_len])
+        if struct.pack(">H", TLS_AES_128_GCM_SHA256) not in [
+            suites[i:i + 2] for i in range(0, len(suites), 2)
+        ]:
+            raise HandshakeError("no common ciphersuite")
+        ks = exts.get(EXT_KEYSHARE)
+        if ks is None:
+            raise HandshakeError("no key_share")
+        # client shares: 2B list len, then (group, len, key)*
+        koff = 2
+        client_pub = None
+        while koff + 4 <= len(ks):
+            grp, kl = struct.unpack_from(">HH", ks, koff)
+            if grp == X25519:
+                client_pub = ks[koff + 4:koff + 4 + kl]
+                break
+            koff += 4 + kl
+        if client_pub is None:
+            raise HandshakeError("no x25519 share")
+        if EXT_ALPN in exts:
+            alpn = exts[EXT_ALPN]
+            self.negotiated_alpn = alpn[3:].decode()
+        self.peer_quic_tp = exts.get(EXT_QUIC_TP)
+        shared = self.kx.exchange(
+            X25519PublicKey.from_public_bytes(client_pub)
+        )
+        # ServerHello
+        my_pub = self.kx.public_key().public_bytes(
+            serialization.Encoding.Raw,
+            serialization.PublicFormat.Raw,
+        )
+        sh_exts = b"".join([
+            _ext(EXT_VERSIONS, b"\x03\x04"),
+            _ext(EXT_KEYSHARE, struct.pack(
+                ">HH", X25519, len(my_pub)) + my_pub),
+        ])
+        sh = _hs_msg(SH, (
+            b"\x03\x03" + os.urandom(32)
+            + bytes([sess_len]) + body[35:35 + sess_len]
+            + struct.pack(">H", TLS_AES_128_GCM_SHA256)
+            + b"\x00"
+            + struct.pack(">H", len(sh_exts)) + sh_exts
+        ))
+        self.transcript += sh
+        self.out[0].append(sh)
+        self._derive_handshake(shared)
+        # EncryptedExtensions
+        ee_exts = _ext(EXT_QUIC_TP, self.quic_tp)
+        if self.negotiated_alpn:
+            a = self.negotiated_alpn.encode()
+            ee_exts += _ext(EXT_ALPN, struct.pack(
+                ">HB", len(a) + 1, len(a)) + a)
+        ee = _hs_msg(EE, struct.pack(">H", len(ee_exts)) + ee_exts)
+        self.transcript += ee
+        self.out[2].append(ee)
+        # Certificate
+        cert_entry = (
+            len(self.cert_der).to_bytes(3, "big") + self.cert_der
+            + struct.pack(">H", 0)  # no per-cert extensions
+        )
+        cert = _hs_msg(CERT, (
+            b"\x00" + len(cert_entry).to_bytes(3, "big") + cert_entry
+        ))
+        self.transcript += cert
+        self.out[2].append(cert)
+        # CertificateVerify
+        to_sign = (b"\x20" * 64
+                   + b"TLS 1.3, server CertificateVerify\x00"
+                   + _hash(self.transcript))
+        sig = self.key.sign(to_sign, ec.ECDSA(hashes.SHA256()))
+        cv = _hs_msg(CV, struct.pack(
+            ">HH", ECDSA_SECP256R1_SHA256, len(sig)) + sig)
+        self.transcript += cv
+        self.out[2].append(cv)
+        # Finished
+        fin_key = hkdf_expand_label(
+            self._server_hs_traffic, "finished", b"", HASHLEN
+        )
+        verify = hmac_mod.new(
+            fin_key, _hash(self.transcript), hashlib.sha256
+        ).digest()
+        fin = _hs_msg(FIN, verify)
+        self.transcript += fin
+        self.out[2].append(fin)
+        self._derive_app()
+
+    def _server_on_finished(self, body: bytes, raw: bytes) -> None:
+        fin_key = hkdf_expand_label(
+            self._client_hs_traffic, "finished", b"", HASHLEN
+        )
+        want = hmac_mod.new(
+            fin_key, _hash(self.transcript), hashlib.sha256
+        ).digest()
+        if not hmac_mod.compare_digest(want, body):
+            raise HandshakeError("client Finished mismatch")
+        self.transcript += raw
+        self.complete = True
+
+    # -------------------------------------------------- client flight
+
+    def _client_on_server_hello(self, body: bytes, raw: bytes) -> None:
+        self.transcript += raw
+        off = 34
+        off += 1 + body[34]  # session id echo
+        (suite,) = struct.unpack_from(">H", body, off)
+        if suite != TLS_AES_128_GCM_SHA256:
+            raise HandshakeError(f"suite {suite:#x}")
+        off += 2 + 1  # compression
+        (ext_len,) = struct.unpack_from(">H", body, off)
+        exts = _parse_exts(body[off + 2:off + 2 + ext_len])
+        ks = exts.get(EXT_KEYSHARE)
+        if ks is None:
+            raise HandshakeError("SH without key_share")
+        grp, kl = struct.unpack_from(">HH", ks, 0)
+        if grp != X25519:
+            raise HandshakeError("SH group")
+        server_pub = ks[4:4 + kl]
+        shared = self.kx.exchange(
+            X25519PublicKey.from_public_bytes(server_pub)
+        )
+        self._derive_handshake(shared)
+
+    def _client_on_cert_verify(self, body: bytes, raw: bytes) -> None:
+        (alg, slen) = struct.unpack_from(">HH", body, 0)
+        sig = body[4:4 + slen]
+        if alg != ECDSA_SECP256R1_SHA256:
+            raise HandshakeError(f"sig alg {alg:#x}")
+        to_sign = (b"\x20" * 64
+                   + b"TLS 1.3, server CertificateVerify\x00"
+                   + _hash(self.transcript))
+        from cryptography import x509
+
+        cert = x509.load_der_x509_certificate(self.peer_cert_der)
+        cert.public_key().verify(
+            sig, to_sign, ec.ECDSA(hashes.SHA256())
+        )
+        self.transcript += raw
+
+    def _client_on_finished(self, body: bytes, raw: bytes) -> None:
+        fin_key = hkdf_expand_label(
+            self._server_hs_traffic, "finished", b"", HASHLEN
+        )
+        want = hmac_mod.new(
+            fin_key, _hash(self.transcript), hashlib.sha256
+        ).digest()
+        if not hmac_mod.compare_digest(want, body):
+            raise HandshakeError("server Finished mismatch")
+        self.transcript += raw
+        self._derive_app()
+        # client Finished (epoch 2)
+        my_fin_key = hkdf_expand_label(
+            self._client_hs_traffic, "finished", b"", HASHLEN
+        )
+        verify = hmac_mod.new(
+            my_fin_key, _hash(self.transcript), hashlib.sha256
+        ).digest()
+        fin = _hs_msg(FIN, verify)
+        self.transcript += fin
+        self.out[2].append(fin)
+        self.complete = True
+
+    # ------------------------------------------------------- schedule
+
+    def _derive_handshake(self, shared: bytes) -> None:
+        early = hkdf_extract(b"\x00" * HASHLEN, b"\x00" * HASHLEN)
+        derived = derive_secret(early, "derived", _hash(b""))
+        hs = hkdf_extract(derived, shared)
+        th = _hash(self.transcript)
+        self._client_hs_traffic = derive_secret(hs, "c hs traffic", th)
+        self._server_hs_traffic = derive_secret(hs, "s hs traffic", th)
+        self.handshake_secrets = (
+            self._client_hs_traffic, self._server_hs_traffic
+        )
+        self._master = hkdf_extract(
+            derive_secret(hs, "derived", _hash(b"")), b"\x00" * HASHLEN
+        )
+
+    def _derive_app(self) -> None:
+        th = _hash(self.transcript)
+        self.app_secrets = (
+            derive_secret(self._master, "c ap traffic", th),
+            derive_secret(self._master, "s ap traffic", th),
+        )
+
+    def take_out(self, epoch: int) -> bytes:
+        msgs, self.out[epoch] = self.out[epoch], []
+        return b"".join(msgs)

@@ -1,0 +1,204 @@
+"""MQTT over QUIC (emqx_tpu/quic + broker/quic_listener.py): the
+listener class the reference ships via MsQuic
+(emqx_listeners.erl:448, emqx_quic_connection.erl), here on the
+from-scratch QUIC v1 / TLS 1.3 stack — handshake unit tests, loopback
+transport tests, and CONNECT/SUB/PUB through a real broker."""
+
+import asyncio
+import datetime
+
+import pytest
+
+from emqx_tpu.codec import mqtt as C
+from emqx_tpu.config import BrokerConfig, ListenerConfig
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_cert(tmp_path):
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    key = ec.generate_private_key(ec.SECP256R1())
+    name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, "localhost")]
+    )
+    cert = (
+        x509.CertificateBuilder().subject_name(name).issuer_name(name)
+        .public_key(key.public_key()).serial_number(1)
+        .not_valid_before(datetime.datetime(2020, 1, 1))
+        .not_valid_after(datetime.datetime(2040, 1, 1))
+        .sign(key, hashes.SHA256())
+    )
+    certfile = tmp_path / "cert.pem"
+    keyfile = tmp_path / "key.pem"
+    certfile.write_bytes(
+        cert.public_bytes(serialization.Encoding.PEM)
+    )
+    keyfile.write_bytes(key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption(),
+    ))
+    return str(certfile), str(keyfile), cert, key
+
+
+def _der(cert):
+    from cryptography.hazmat.primitives import serialization
+
+    return cert.public_bytes(serialization.Encoding.DER)
+
+
+def test_tls13_handshake_and_secrets(tmp_path):
+    from emqx_tpu.quic.tls13 import Tls13
+
+    _cf, _kf, cert, key = make_cert(tmp_path)
+    srv = Tls13(True, quic_tp=b"\x01", cert_der=_der(cert), key=key)
+    cli = Tls13(False, quic_tp=b"\x02")
+    cli.client_hello()
+    srv.feed(0, cli.take_out(0))
+    cli.feed(0, srv.take_out(0))
+    assert cli.handshake_secrets == srv.handshake_secrets
+    cli.feed(2, srv.take_out(2))
+    assert cli.complete
+    srv.feed(2, cli.take_out(2))
+    assert srv.complete
+    assert cli.app_secrets == srv.app_secrets
+    assert cli.negotiated_alpn == "mqtt"
+    assert srv.peer_quic_tp == b"\x02"
+
+
+def test_tls13_wrong_finished_rejected(tmp_path):
+    from emqx_tpu.quic.tls13 import HandshakeError, Tls13
+
+    _cf, _kf, cert, key = make_cert(tmp_path)
+    srv = Tls13(True, quic_tp=b"", cert_der=_der(cert), key=key)
+    cli = Tls13(False)
+    cli.client_hello()
+    srv.feed(0, cli.take_out(0))
+    cli.feed(0, srv.take_out(0))
+    cli.feed(2, srv.take_out(2))
+    fin = cli.take_out(2)
+    tampered = fin[:-1] + bytes([fin[-1] ^ 0xFF])
+    with pytest.raises(HandshakeError):
+        srv.feed(2, tampered)
+
+
+def test_quic_initial_keys_rfc9001_vector():
+    """RFC 9001 appendix A: client initial secrets for the published
+    DCID 0x8394c8f03e515708."""
+    from emqx_tpu.quic.connection import initial_keys
+
+    ck, _sk = initial_keys(bytes.fromhex("8394c8f03e515708"))
+    assert ck.iv.hex() == "fa044b2f42a3fd3b46fb255c"
+    assert ck.hp.hex() == "9f50449e04a0e810283a1e9933adedd2"
+
+
+def test_quic_loopback_streams(tmp_path):
+    from emqx_tpu.quic.connection import QuicConnection
+
+    _cf, _kf, cert, key = make_cert(tmp_path)
+    srv = QuicConnection(True, cert_der=_der(cert), key=key)
+    cli = QuicConnection(False)
+    cli.connect()
+
+    def pump(n=20):
+        for _ in range(n):
+            moved = False
+            for d in cli.datagrams_to_send():
+                srv.receive_datagram(d)
+                moved = True
+            for d in srv.datagrams_to_send():
+                cli.receive_datagram(d)
+                moved = True
+            if not moved:
+                return
+
+    pump()
+    assert cli.handshake_complete and srv.handshake_complete
+    sid = cli.open_stream()
+    cli.send_stream(sid, b"ping")
+    pump()
+    evs = [e for e in srv.events() if e[0] == "stream"]
+    assert evs[0][1] == sid and evs[0][2] == b"ping"
+    # bulk transfer splits across packets and reassembles in order
+    cli.send_stream(sid, bytes(range(256)) * 200)  # 51200 bytes
+    pump(100)
+    got = b"".join(e[2] for e in srv.events() if e[0] == "stream")
+    assert got == bytes(range(256)) * 200
+
+
+def test_mqtt_over_quic_end_to_end(tmp_path):
+    """CONNECT / SUBSCRIBE / PUBLISH over a quic listener, cross-
+    delivered to a TCP client — both directions."""
+
+    async def t():
+        from emqx_tpu.broker.listener import BrokerServer
+        from emqx_tpu.broker.quic_listener import QuicClientTransport
+        from mqtt_client import TestClient
+
+        certfile, keyfile, _c, _k = make_cert(tmp_path)
+        cfg = BrokerConfig()
+        cfg.listeners = [
+            ListenerConfig(port=0),
+            ListenerConfig(name="quic_default", type="quic", port=0,
+                           bind="127.0.0.1", certfile=certfile,
+                           keyfile=keyfile),
+        ]
+        srv = BrokerServer(cfg)
+        await srv.start()
+        assert srv.quic_listeners, "quic listener did not start"
+        qport = srv.quic_listeners[0].port
+
+        qc = QuicClientTransport("127.0.0.1", qport)
+        await qc.connect()
+        parser = C.StreamParser(version=C.MQTT_V5)
+
+        async def expect(ptype, timeout=5.0):
+            deadline = asyncio.get_event_loop().time() + timeout
+            while True:
+                for pkt in parser.feed(await qc.read(
+                    timeout=deadline - asyncio.get_event_loop().time()
+                )):
+                    assert pkt.type == ptype, pkt
+                    return pkt
+
+        qc.write(C.serialize(
+            C.Connect(client_id="quic-dev", proto_ver=C.MQTT_V5),
+            C.MQTT_V5,
+        ))
+        await expect(C.CONNACK)
+        qc.write(C.serialize(C.Subscribe(
+            packet_id=1,
+            subscriptions=[C.Subscription(topic_filter="q/#", qos=0)],
+        ), C.MQTT_V5))
+        await expect(C.SUBACK)
+
+        # TCP -> QUIC delivery
+        tcp = TestClient(srv.listeners[0].port, "tcp-peer")
+        await tcp.connect()
+        await tcp.subscribe("from-quic/#", qos=0)
+        await tcp.publish("q/hello", b"over-udp", qos=0)
+        pkt = await expect(C.PUBLISH)
+        assert pkt.topic == "q/hello" and pkt.payload == b"over-udp"
+
+        # QUIC -> TCP delivery
+        qc.write(C.serialize(C.Publish(
+            topic="from-quic/x", payload=b"hi-tcp", qos=0,
+        ), C.MQTT_V5))
+        msg = await tcp.recv_publish(timeout=5)
+        assert msg.topic == "from-quic/x" and msg.payload == b"hi-tcp"
+
+        # the quic client appears in the connection census like any
+        # other transport
+        assert srv.broker.cm.channel("quic-dev") is not None
+
+        qc.close()
+        await tcp.disconnect()
+        await srv.stop()
+
+    run(t())
