@@ -658,7 +658,7 @@ class Channel:
         batcher = self.broker.batcher
         if pkt.qos == 0:
             if batcher is not None:
-                batcher.publish_nowait(msg)  # fire-and-forget
+                batcher.publish_nowait(msg, source=self)  # fire-and-forget
             else:
                 self.broker.publish(msg)
             return
@@ -666,7 +666,7 @@ class Channel:
             if batcher is not None:
                 # ack resolves from the batch future — the whole window
                 # is one device step, PUBACKs stream out in batch order
-                batcher.publish(msg).add_done_callback(
+                batcher.publish(msg, source=self).add_done_callback(
                     lambda f, pid=pkt.packet_id: self._publish_acked(
                         pid, 1, f
                     )
@@ -688,7 +688,7 @@ class Channel:
             self._disconnect_with(RC_RECEIVE_MAX_EXCEEDED)
             return
         if batcher is not None:
-            batcher.publish(msg).add_done_callback(
+            batcher.publish(msg, source=self).add_done_callback(
                 lambda f, pid=pkt.packet_id: self._publish_acked(pid, 2, f)
             )
         else:

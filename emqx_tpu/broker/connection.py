@@ -121,12 +121,12 @@ class Connection:
                             break
                 await self._drain()
                 batcher = self.broker.batcher
-                if batcher is not None and batcher.congested():
+                if batcher is not None and batcher.congested(self.channel):
                     # stop reading until the publish queue drains: TCP
                     # backpressure propagates to the client, bounding
                     # broker memory and queueing delay (the esockd
                     # active_n / emqx_olp role)
-                    await batcher.wait_uncongested()
+                    await batcher.wait_uncongested(self.channel)
         except C.MqttError as exc:
             log.debug("codec error from %s: %s", self.channel.peer, exc)
             reason = "frame_error"
