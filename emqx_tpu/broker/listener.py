@@ -325,6 +325,8 @@ class BrokerServer:
                 # (VERDICT r4 #8); "lww" remains the opt-out for
                 # fire-and-forget deployments
                 consensus=cl.get("consensus", "raft"),
+                role=cl.get("role", "core"),
+                sharded_routes=bool(cl.get("sharded_routes", False)),
                 raft_data_dir=cl.get("raft_data_dir"),
                 heartbeat_interval=float(
                     cl.get("heartbeat_interval", 0.5)

@@ -118,9 +118,20 @@ class ClusterNode:
         raft_data_dir: Optional[str] = None,
         raft_fsync: bool = True,
         sharded_routes: bool = False,
+        role: str = "core",  # core | replicant
     ) -> None:
         self.name = name
         self.broker = broker
+        # mria's core/replicant split: CORES form the raft quorums and
+        # bear the write path; REPLICANTS never vote or count toward a
+        # majority — they serve clients, replicate routes/clients/conf
+        # through the same LWW streams, and submit quorum writes BY
+        # FORWARDING to a core.  Scaling the serving tier then never
+        # slows consensus down (adding replicants leaves quorum size
+        # untouched), exactly why the reference splits the roles.
+        self.role = role
+        if role == "replicant" and consensus == "raft":
+            consensus = "lww"  # local consensus machinery stays off
         # "raft" upgrades the conf journal and DS replication from
         # best-effort LWW to quorum commit (VERDICT r3 missing #1):
         # an acked write survives any single node failure
@@ -148,6 +159,7 @@ class ClusterNode:
         self.flush_max = flush_max
         # peers: name -> (host, port); alive tracking by last heartbeat
         self._peers: Dict[str, Tuple[str, int]] = {}
+        self._peer_roles: Dict[str, str] = {}
         self._last_seen: Dict[str, float] = {}
         self._down: set = set()
         self._synced: set = set()  # peers whose full sync succeeded
@@ -211,6 +223,11 @@ class ClusterNode:
         self.transport.on("rebalance_shed", self._handle_rebalance_shed)
         self.transport.on("session_purge", self._handle_session_purge)
         self.transport.on("sync", self._handle_sync)
+        # replicant-forwarded config writes land on a core (concurrent:
+        # the handler awaits a raft commit whose traffic may share the
+        # inbound link)
+        self.transport.on("conf_fwd", self._handle_conf_fwd,
+                          concurrent=True)
         if self.shard is not None:
             self.transport.on("shard_ops", self.shard.handle_ops)
             self.transport.on("shard_sync", self.shard.handle_sync)
@@ -458,6 +475,7 @@ class ClusterNode:
             {
                 "type": "sync",
                 "node": self.name,
+                "role": self.role,
                 "listen": [self.transport.bind, self.transport.port],
                 "epoch": self._epoch,
                 "seq": self._op_seq,
@@ -474,6 +492,7 @@ class ClusterNode:
             return
         self._mark_alive(peer)
         self._synced.add(peer)
+        self._peer_roles[peer] = reply.get("role", "core")
         if self.shard is not None:
             self.shard.on_membership_change()
         self._check_epoch(peer, reply.get("epoch", 0))
@@ -506,6 +525,7 @@ class ClusterNode:
 
     async def _handle_sync(self, peer: str, obj: Dict) -> Dict:
         node = obj.get("node", peer)
+        self._peer_roles[node] = obj.get("role", "core")
         self._learn_peer(node, obj.get("listen"))
         self._mark_alive(node)
         # peer's local routes replace whatever we had for it (seq-guarded
@@ -519,6 +539,7 @@ class ClusterNode:
         if self.shard is not None:
             self.shard.on_membership_change()
         return {
+            "role": self.role,
             "routes": (
                 [] if self.shard is not None else self.routes.all_routes()
             ),
@@ -541,7 +562,9 @@ class ClusterNode:
             name, host, port = entry[0], entry[1], int(entry[2])
             if name != self.name and name not in self._peers:
                 self.add_peer(name, host, port)
-            if name != self.name:
+            if name != self.name and self._peer_roles.get(
+                name, "core"
+            ) == "core":
                 for grp in (self.raft_conf, self.raft_ds):
                     if grp is not None:
                         grp.add_member(name)
@@ -576,7 +599,10 @@ class ClusterNode:
         membership before the first commit)."""
         if node != self.name and node not in self._peers and listen:
             self.add_peer(node, listen[0], int(listen[1]))
-        if node != self.name:
+        if node != self.name and self._peer_roles.get(
+            node, "core"
+        ) == "core":
+            # replicants never join the quorum (mria core/replicant)
             for grp in (self.raft_conf, self.raft_ds):
                 if grp is not None:
                     grp.add_member(node)
@@ -831,6 +857,20 @@ class ClusterNode:
             task.add_done_callback(self._fwd_tasks.discard)
             self._conf_counter += 1
             return (self._conf_counter, self.name)
+        if self.role == "replicant":
+            core = self._any_core()
+            if core is not None:
+                # fire the forward; the committed entry comes back via
+                # the cores' replicant broadcast
+                loop = asyncio.get_running_loop()
+                task = loop.create_task(self.transport.call(
+                    core, {"type": "conf_fwd", "path": path,
+                           "value": value}, timeout=10.0,
+                ))
+                self._fwd_tasks.add(task)
+                task.add_done_callback(self._fwd_tasks.discard)
+                self._conf_counter += 1
+                return (self._conf_counter, core)
         self._conf_counter += 1
         txn = (self._conf_counter, self.name)
         self._conf_apply(txn, path, value)
@@ -849,11 +889,42 @@ class ClusterNode:
     async def update_config_async(self, path: str, value) -> Tuple[int, str]:
         """Raft-mode config update that PROPAGATES failures to the
         caller (the management API awaits this): returns once the
-        entry is committed on a majority."""
+        entry is committed on a majority.  Replicants forward to a
+        core and await its commit."""
+        if self.role == "replicant":
+            core = self._any_core()
+            if core is None:
+                raise ConnectionError("replicant: no core reachable")
+            rep = await self.transport.call(
+                core, {"type": "conf_fwd", "path": path,
+                       "value": value}, timeout=10.0,
+            )
+            if not rep or not rep.get("ok"):
+                raise ConnectionError(
+                    f"core {core} rejected forwarded conf update"
+                )
+            return (int(rep.get("index", 0)), core)
         if self.raft_conf is None:
             return self.update_config(path, value)
         idx = await self._submit_conf(path, value, retries=0)
         return (idx, "raft")
+
+    def _any_core(self) -> Optional[str]:
+        for p in self.peers_alive():
+            if self._peer_roles.get(p, "core") == "core":
+                return p
+        return None
+
+    async def _handle_conf_fwd(self, peer: str, obj: Dict) -> Dict:
+        """A replicant forwarded a config write: commit it here (the
+        mria write-on-core path)."""
+        try:
+            txn = await self.update_config_async(
+                obj["path"], obj["value"]
+            )
+            return {"ok": True, "index": txn[0]}
+        except Exception as exc:
+            return {"ok": False, "error": str(exc)}
 
     async def _submit_conf(self, path: str, value,
                            retries: int = 3) -> int:
@@ -909,6 +980,21 @@ class ClusterNode:
         mnesia transaction log.  Registry ("reg") entries share the
         log: ownership claims replay identically everywhere, so healed
         partitions converge per clientid."""
+        if self.role == "core":
+            # replicants are outside the quorum: hand them every
+            # committed entry over the LWW conf stream
+            reps = [p for p in self.peers_alive()
+                    if self._peer_roles.get(p) == "replicant"]
+            if reps and payload.get("kind") != "reg":
+                self._conf_counter += 1
+                obj = {"type": "conf_txn", "node": self.name,
+                       "txns": [[self._conf_counter, self.name,
+                                 payload["path"], payload["value"]]]}
+                loop = asyncio.get_running_loop()
+                for p in reps:
+                    t = loop.create_task(self.transport.cast(p, obj))
+                    self._fwd_tasks.add(t)
+                    t.add_done_callback(self._fwd_tasks.discard)
         if payload.get("kind") == "reg":
             cid, node = payload.get("cid", ""), payload.get("node", "")
             if payload.get("op") == "cadd":
@@ -1200,6 +1286,7 @@ class ClusterNode:
             obj = {
                 "type": "heartbeat",
                 "node": self.name,
+                "role": self.role,
                 "listen": [self.transport.bind, self.transport.port],
             }
             # bound each cast so one blackholed peer can't stall the
@@ -1228,6 +1315,7 @@ class ClusterNode:
 
     async def _handle_heartbeat(self, peer: str, obj: Dict) -> None:
         node = obj.get("node", peer)
+        self._peer_roles[node] = obj.get("role", "core")
         self._learn_peer(node, obj.get("listen"))
         if node not in self._peers:
             return

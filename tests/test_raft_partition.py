@@ -164,3 +164,63 @@ def test_partition_minority_keeps_serving_locally():
                 await srv.stop()
 
     run(t())
+
+
+def test_replicant_role_serves_without_joining_quorum():
+    """mria core/replicant split: a replicant joins the cluster, never
+    enters the raft membership, forwards config writes to a core, and
+    receives committed entries — and adding it does not change the
+    cores' quorum size."""
+
+    async def t():
+        servers, nodes = await boot_cluster(3)
+        na, nb, nc = nodes
+        try:
+            from emqx_tpu.broker.listener import BrokerServer
+            from emqx_tpu.cluster import ClusterNode
+            from emqx_tpu.config import BrokerConfig
+
+            cfg = BrokerConfig()
+            cfg.listeners[0].port = 0
+            rsrv = BrokerServer(cfg)
+            await rsrv.start()
+            rep = ClusterNode(
+                "rep1", rsrv.broker, role="replicant",
+                heartbeat_interval=0.05, down_after=0.4,
+                flush_interval=0.002,
+            )
+            await rep.start(seeds=[
+                ("n0", "127.0.0.1", na.transport.port)
+            ])
+            await asyncio.sleep(0.8)  # gossip + sync + heartbeats
+
+            # the replicant never enters any core's raft membership
+            for core in nodes:
+                assert "rep1" not in core.raft_conf.peers
+                assert "rep1" not in core.raft_ds.peers
+            assert rep.raft_conf is None  # no local consensus machinery
+
+            # committed write on a core reaches the replicant
+            await na.update_config_async("mqtt.max_inflight", 9)
+            deadline = asyncio.get_event_loop().time() + 5
+            while asyncio.get_event_loop().time() < deadline:
+                if rsrv.broker.config.mqtt.max_inflight == 9:
+                    break
+                await asyncio.sleep(0.1)
+            assert rsrv.broker.config.mqtt.max_inflight == 9
+
+            # a write ORIGINATED on the replicant forwards to a core,
+            # commits through the quorum, and lands everywhere
+            await rep.update_config_async("mqtt.max_awaiting_rel", 55)
+            await asyncio.sleep(0.5)
+            assert na.broker.config.mqtt.max_awaiting_rel == 55
+            assert nb.broker.config.mqtt.max_awaiting_rel == 55
+
+            await rep.stop()
+            await rsrv.stop()
+        finally:
+            for srv, node in zip(reversed(servers), reversed(nodes)):
+                await node.stop()
+                await srv.stop()
+
+    run(t())
