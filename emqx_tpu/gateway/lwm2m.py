@@ -91,6 +91,124 @@ def _payload_json(data: bytes):
         return {"base64": base64.b64encode(data).decode()}
 
 
+# ------------------------------------------------------------ OMA-TLV
+
+TLV_CONTENT_FORMAT = 11542  # application/vnd.oma.lwm2m+tlv
+
+_TLV_OBJ_INST, _TLV_RES_INST, _TLV_MULTI, _TLV_RES = 0, 1, 2, 3
+
+
+def _tlv_value(v: bytes) -> dict:
+    """Typeless resource value: without the OMA object registry the
+    concrete type is unknowable, so every plausible reading ships —
+    the dm application picks the one its data model says."""
+    out: dict = {"hex": v.hex()}
+    if len(v) in (1, 2, 4, 8):
+        out["int"] = int.from_bytes(v, "big", signed=True)
+        if len(v) in (4, 8):
+            import struct as _s
+
+            out["float"] = _s.unpack(
+                ">f" if len(v) == 4 else ">d", v
+            )[0]
+    try:
+        s = v.decode("utf-8")
+        if s.isprintable() or s == "":
+            out["str"] = s
+    except UnicodeDecodeError:
+        pass
+    return out
+
+
+def decode_tlv(data: bytes) -> list:
+    """OMA-TLV (LwM2M TS 6.4.3): nested object-instance / resource /
+    multiple-resource entries."""
+    out = []
+    off = 0
+    n = len(data)
+    while off < n:
+        t = data[off]
+        off += 1
+        kind = (t >> 6) & 0x3
+        id_len = 2 if t & 0x20 else 1
+        ltype = (t >> 3) & 0x3
+        ident = int.from_bytes(data[off:off + id_len], "big")
+        off += id_len
+        if ltype == 0:
+            length = t & 0x7
+        else:
+            length = int.from_bytes(data[off:off + ltype], "big")
+            off += ltype
+        if off + length > n:
+            raise ValueError("tlv: truncated entry")
+        val = data[off:off + length]
+        off += length
+        if kind == _TLV_OBJ_INST:
+            out.append({"kind": "obj_inst", "id": ident,
+                        "resources": decode_tlv(val)})
+        elif kind == _TLV_MULTI:
+            out.append({"kind": "multiple", "id": ident,
+                        "instances": decode_tlv(val)})
+        else:
+            out.append({
+                "kind": "res_inst" if kind == _TLV_RES_INST else "res",
+                "id": ident,
+                "value": _tlv_value(val),
+            })
+    return out
+
+
+def _tlv_raw(value) -> bytes:
+    if isinstance(value, dict):
+        if "hex" in value:
+            return bytes.fromhex(value["hex"])
+        if "int" in value:
+            v = int(value["int"])
+            for size in (1, 2, 4, 8):
+                if -(1 << (8 * size - 1)) <= v < (1 << (8 * size - 1)):
+                    return v.to_bytes(size, "big", signed=True)
+        if "str" in value:
+            return str(value["str"]).encode()
+    if isinstance(value, bool):
+        return b"\x01" if value else b"\x00"
+    if isinstance(value, int):
+        return _tlv_raw({"int": value})
+    return str(value).encode()
+
+
+def encode_tlv(entries: list) -> bytes:
+    """Inverse of `decode_tlv` (downlink TLV writes)."""
+    out = bytearray()
+    for e in entries:
+        kind = {"obj_inst": _TLV_OBJ_INST, "res_inst": _TLV_RES_INST,
+                "multiple": _TLV_MULTI, "res": _TLV_RES}[e["kind"]]
+        if kind == _TLV_OBJ_INST:
+            val = encode_tlv(e.get("resources", []))
+        elif kind == _TLV_MULTI:
+            val = encode_tlv(e.get("instances", []))
+        else:
+            val = _tlv_raw(e.get("value"))
+        ident = int(e["id"])
+        t = kind << 6
+        id_bytes = (
+            ident.to_bytes(2, "big") if ident > 0xFF
+            else bytes([ident])
+        )
+        if len(id_bytes) == 2:
+            t |= 0x20
+        if len(val) < 8:
+            t |= len(val)
+            len_bytes = b""
+        else:
+            for lt, size in ((1, 1), (2, 2), (3, 3)):
+                if len(val) < (1 << (8 * size)):
+                    t |= lt << 3
+                    len_bytes = len(val).to_bytes(size, "big")
+                    break
+        out += bytes([t]) + id_bytes + len_bytes + val
+    return bytes(out)
+
+
 class Lwm2mChannel(GatewayChannel):
     """One device (one UDP peer): registration state + in-flight
     device-management requests (token -> originating command)."""
@@ -280,9 +398,17 @@ class Lwm2mChannel(GatewayChannel):
             token = self._observes.pop(path, token)
         elif mtype in ("write", "create"):
             value = data.get("value", "")
-            payload = value.encode() if isinstance(value, str) \
-                else json.dumps(value).encode()
-            options.append((OPT_CONTENT_FORMAT, b""))  # text/plain
+            if isinstance(value, dict) and "tlv" in value:
+                # structured write: encode the entries as OMA-TLV
+                payload = encode_tlv(value["tlv"])
+                options.append((
+                    OPT_CONTENT_FORMAT,
+                    TLV_CONTENT_FORMAT.to_bytes(2, "big"),
+                ))
+            else:
+                payload = value.encode() if isinstance(value, str) \
+                    else json.dumps(value).encode()
+                options.append((OPT_CONTENT_FORMAT, b""))  # text/plain
         elif mtype == "execute":
             payload = str(data.get("args", "")).encode()
         elif mtype == "write-attr":
@@ -311,13 +437,23 @@ class Lwm2mChannel(GatewayChannel):
         else:
             is_notify = False
             self._pending.pop(m.token, None)
+        # OMA-TLV responses decode to structured resources (the
+        # reference's emqx_lwm2m_message tlv path); anything else
+        # crosses as text/base64
+        content = _payload_json(m.payload)
+        cfv = [v for n, v in m.options if n == OPT_CONTENT_FORMAT]
+        if cfv and int.from_bytes(cfv[0], "big") == TLV_CONTENT_FORMAT:
+            try:
+                content = {"tlv": decode_tlv(m.payload)}
+            except ValueError:
+                pass  # malformed TLV: fall back to the raw form
         body = {
             "reqID": cmd.get("reqID"),
             "msgType": cmd.get("msgType"),
             "data": {
                 "code": _code_name(m.code),
                 "reqPath": cmd.get("data", {}).get("path"),
-                "content": _payload_json(m.payload),
+                "content": content,
             },
         }
         self._uplink("notify" if is_notify else "response", body)

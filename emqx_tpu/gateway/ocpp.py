@@ -14,8 +14,12 @@ Upstream frames publish as JSON objects (``{"type", "id", "action",
 "payload"}``) to ``{mountpoint}cp/{cpid}`` (replies/errors to
 ``cp/{cpid}/Reply``); the charging-station side publishes downstream
 commands to ``{mountpoint}cs/{cpid}``, which this gateway frames back
-to the socket.  Schema validation of action payloads (the reference's
-JSON-schema directory) is not modelled.
+to the socket.  CALL payloads validate against per-action JSON
+schemas for the OCPP 1.6 core profile (the reference's
+priv/schemas directory, emqx_ocpp_schemas.erl): a violation answers
+CALLERROR ``TypeConstraintViolation``/``ProtocolError`` without
+reaching the broker; unknown actions pass through unvalidated
+(forward-compatible, as the reference's strict=false mode).
 """
 
 from __future__ import annotations
@@ -38,6 +42,128 @@ log = logging.getLogger("emqx_tpu.gateway.ocpp")
 CALL, CALLRESULT, CALLERROR = 2, 3, 4
 
 _OP_TEXT, _OP_CLOSE = 0x1, 0x8
+
+# OCPP 1.6 core-profile action schemas (charge point -> central
+# system), transcribed from the spec's JSON schema files
+_CP_STATUS = [
+    "Available", "Preparing", "Charging", "SuspendedEVSE",
+    "SuspendedEV", "Finishing", "Reserved", "Unavailable", "Faulted",
+]
+_CP_ERROR = [
+    "ConnectorLockFailure", "EVCommunicationError", "GroundFailure",
+    "HighTemperature", "InternalError", "LocalListConflict",
+    "NoError", "OtherError", "OverCurrentFailure", "OverVoltage",
+    "PowerMeterFailure", "PowerSwitchFailure", "ReaderFailure",
+    "ResetFailure", "UnderVoltage", "WeakSignal",
+]
+ACTION_SCHEMAS = {
+    "BootNotification": {
+        "type": "object",
+        "required": ["chargePointVendor", "chargePointModel"],
+        "properties": {
+            "chargePointVendor": {"type": "string", "maxLength": 20},
+            "chargePointModel": {"type": "string", "maxLength": 20},
+            "chargePointSerialNumber": {"type": "string",
+                                        "maxLength": 25},
+            "chargeBoxSerialNumber": {"type": "string",
+                                      "maxLength": 25},
+            "firmwareVersion": {"type": "string", "maxLength": 50},
+            "iccid": {"type": "string", "maxLength": 20},
+            "imsi": {"type": "string", "maxLength": 20},
+            "meterType": {"type": "string", "maxLength": 25},
+            "meterSerialNumber": {"type": "string", "maxLength": 25},
+        },
+        "additionalProperties": False,
+    },
+    "Heartbeat": {
+        "type": "object", "additionalProperties": False,
+    },
+    "Authorize": {
+        "type": "object",
+        "required": ["idTag"],
+        "properties": {"idTag": {"type": "string", "maxLength": 20}},
+        "additionalProperties": False,
+    },
+    "StatusNotification": {
+        "type": "object",
+        "required": ["connectorId", "errorCode", "status"],
+        "properties": {
+            "connectorId": {"type": "integer", "minimum": 0},
+            "errorCode": {"enum": _CP_ERROR},
+            "status": {"enum": _CP_STATUS},
+            "info": {"type": "string", "maxLength": 50},
+            "timestamp": {"type": "string"},
+            "vendorId": {"type": "string", "maxLength": 255},
+            "vendorErrorCode": {"type": "string", "maxLength": 50},
+        },
+        "additionalProperties": False,
+    },
+    "StartTransaction": {
+        "type": "object",
+        "required": ["connectorId", "idTag", "meterStart",
+                     "timestamp"],
+        "properties": {
+            "connectorId": {"type": "integer", "minimum": 1},
+            "idTag": {"type": "string", "maxLength": 20},
+            "meterStart": {"type": "integer"},
+            "reservationId": {"type": "integer"},
+            "timestamp": {"type": "string"},
+        },
+        "additionalProperties": False,
+    },
+    "StopTransaction": {
+        "type": "object",
+        "required": ["meterStop", "timestamp", "transactionId"],
+        "properties": {
+            "idTag": {"type": "string", "maxLength": 20},
+            "meterStop": {"type": "integer"},
+            "timestamp": {"type": "string"},
+            "transactionId": {"type": "integer"},
+            "reason": {"enum": [
+                "EmergencyStop", "EVDisconnected", "HardReset",
+                "Local", "Other", "PowerLoss", "Reboot", "Remote",
+                "SoftReset", "UnlockCommand", "DeAuthorized",
+            ]},
+            "transactionData": {"type": "array"},
+        },
+        "additionalProperties": False,
+    },
+    "MeterValues": {
+        "type": "object",
+        "required": ["connectorId", "meterValue"],
+        "properties": {
+            "connectorId": {"type": "integer", "minimum": 0},
+            "transactionId": {"type": "integer"},
+            "meterValue": {
+                "type": "array",
+                "minItems": 1,
+                "items": {
+                    "type": "object",
+                    "required": ["timestamp", "sampledValue"],
+                },
+            },
+        },
+        "additionalProperties": False,
+    },
+}
+
+_validators: dict = {}
+
+
+def _validate_call(action: str, payload) -> Optional[str]:
+    """None = valid (or unknown action); else the violation text."""
+    schema = ACTION_SCHEMAS.get(action)
+    if schema is None:
+        return None
+    v = _validators.get(action)
+    if v is None:
+        import jsonschema
+
+        v = _validators[action] = jsonschema.Draft202012Validator(
+            schema
+        )
+    err = next(iter(v.iter_errors(payload)), None)
+    return None if err is None else err.message
 
 
 def _cpid_from_path(path: str) -> Optional[str]:
@@ -94,6 +220,15 @@ class OcppChannel(GatewayChannel):
             mtype = arr[0]
             if mtype == CALL:
                 _, mid, action, payload = arr
+                violation = _validate_call(action, payload)
+                if violation is not None:
+                    # spec: answer CALLERROR, never forward the frame
+                    self.broker.metrics.inc("gateway.ocpp.schema_error")
+                    self.write(ws_frame(_OP_TEXT, json.dumps([
+                        CALLERROR, mid, "TypeConstraintViolation",
+                        violation[:255], {"action": action},
+                    ]).encode()))
+                    return
                 body = {"type": CALL, "id": mid, "action": action,
                         "payload": payload}
                 topic = f"{self.gateway.mountpoint}cp/{self.cpid}"

@@ -225,3 +225,66 @@ def test_ocpp_session_registered_and_cleanup():
         await srv.stop()
 
     run(t())
+
+
+def test_ocpp_schema_validation():
+    """OCPP 1.6 core-profile CALL payloads validate against the
+    per-action schemas: violations answer CALLERROR
+    TypeConstraintViolation on-socket and never reach the broker;
+    valid frames and unknown actions pass."""
+
+    async def t():
+        cfg = BrokerConfig()
+        cfg.listeners = [ListenerConfig(port=0)]
+        cfg.gateways = [
+            {"type": "ocpp", "bind": "127.0.0.1", "port": 0}
+        ]
+        srv = BrokerServer(cfg)
+        await srv.start()
+        gw = srv.broker.gateways.get("ocpp")
+
+        csms = TestClient(srv.listeners[0].port, "csms")
+        await csms.connect()
+        await csms.subscribe("ocpp/cp/#", qos=1)
+        cp = await OcppClient(gw.port, "CP9").connect()
+
+        # missing required field
+        cp.send([2, "b1", "BootNotification",
+                 {"chargePointModel": "X1"}])
+        arr = await cp.recv()
+        assert arr[0] == 4 and arr[1] == "b1"
+        assert arr[2] == "TypeConstraintViolation"
+
+        # wrong type
+        cp.send([2, "s1", "StatusNotification",
+                 {"connectorId": "one", "errorCode": "NoError",
+                  "status": "Available"}])
+        arr = await cp.recv()
+        assert arr[2] == "TypeConstraintViolation"
+
+        # enum violation
+        cp.send([2, "s2", "StatusNotification",
+                 {"connectorId": 1, "errorCode": "NoError",
+                  "status": "Snoozing"}])
+        arr = await cp.recv()
+        assert arr[2] == "TypeConstraintViolation"
+
+        # valid frames reach the broker
+        cp.send([2, "s3", "StatusNotification",
+                 {"connectorId": 1, "errorCode": "NoError",
+                  "status": "Charging"}])
+        pub = await csms.recv_publish()
+        assert json.loads(pub.payload)["payload"]["status"] == \
+            "Charging"
+
+        # unknown actions pass through unvalidated (strict=false)
+        cp.send([2, "d1", "DataTransfer", {"vendorId": "x",
+                                           "weird": [1, 2]}])
+        pub = await csms.recv_publish()
+        assert json.loads(pub.payload)["action"] == "DataTransfer"
+
+        cp.close()
+        await csms.disconnect()
+        await srv.stop()
+
+    run(t())

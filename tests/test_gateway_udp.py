@@ -566,3 +566,116 @@ def test_lwm2m_register_command_observe():
         await srv.stop()
 
     run(t())
+
+
+def test_lwm2m_tlv_codec_roundtrip():
+    """OMA-TLV (LwM2M TS 6.4.3): decode the spec's Device-object
+    example structure and round-trip our encoder."""
+    from emqx_tpu.gateway.lwm2m import decode_tlv, encode_tlv
+
+    # resource 0 = "Open Mobile Alliance" (string, 8-bit len field),
+    # resource 1 = "Lightweight M2M Client", resource 9 = int 100
+    manu = b"Open Mobile Alliance"
+    model = b"Lightweight M2M Client"
+    data = (
+        bytes([0b11001000, 0, len(manu)]) + manu
+        + bytes([0b11001000, 1, len(model)]) + model
+        + bytes([0b11000001, 9]) + bytes([100])
+    )
+    entries = decode_tlv(data)
+    assert entries[0]["id"] == 0
+    assert entries[0]["value"]["str"] == "Open Mobile Alliance"
+    assert entries[2]["value"]["int"] == 100
+
+    # nested: object instance 0 wrapping a multiple resource
+    nested = [{
+        "kind": "obj_inst", "id": 0,
+        "resources": [
+            {"kind": "res", "id": 5, "value": {"int": -3}},
+            {"kind": "multiple", "id": 6, "instances": [
+                {"kind": "res_inst", "id": 0, "value": {"int": 1}},
+                {"kind": "res_inst", "id": 1, "value": {"int": 5}},
+            ]},
+            {"kind": "res", "id": 7, "value": {"str": "hello"}},
+        ],
+    }]
+    enc = encode_tlv(nested)
+    dec = decode_tlv(enc)
+    assert dec[0]["kind"] == "obj_inst" and dec[0]["id"] == 0
+    rs = dec[0]["resources"]
+    assert rs[0]["value"]["int"] == -3
+    assert rs[1]["kind"] == "multiple"
+    assert [i["value"]["int"] for i in rs[1]["instances"]] == [1, 5]
+    assert rs[2]["value"]["str"] == "hello"
+
+    # 16-bit ids and long values survive
+    long_val = b"x" * 300
+    enc2 = encode_tlv([{"kind": "res", "id": 500,
+                        "value": {"hex": long_val.hex()}}])
+    dec2 = decode_tlv(enc2)
+    assert dec2[0]["id"] == 500
+    assert bytes.fromhex(dec2[0]["value"]["hex"]) == long_val
+
+
+def test_lwm2m_tlv_read_response_decodes():
+    """A device answering a read with content-format 11542 crosses the
+    dm bridge as structured TLV resources, and a {"tlv": ...} write
+    value goes down as encoded TLV."""
+    from emqx_tpu.gateway import lwm2m as LW
+
+    async def t():
+        srv = await make_server(
+            [{"type": "lwm2m", "bind": "127.0.0.1", "port": 0}]
+        )
+        gw = srv.broker.gateways.get("lwm2m")
+        m = TestClient(srv.listeners[0].port, "dm-app")
+        await m.connect()
+        await m.subscribe("lwm2m/ep-9/up/#", qos=0)
+
+        dev = await UdpTestClient(gw.port, CO.CoapCodec()).start()
+        dev.send(coap_msg(
+            CO.POST, "rd", mid=1, token=b"\x21",
+            queries=["ep=ep-9", "lt=120"],
+            payload=b"</3/0>",
+        ))
+        await dev.expect(CO.ACK)
+        await m.recv_publish()  # register uplink
+
+        # dm commands a read; the device answers in OMA-TLV
+        await m.publish("lwm2m/ep-9/dn/dm", json.dumps({
+            "reqID": "r1", "msgType": "read",
+            "data": {"path": "/3/0"},
+        }).encode())
+        req = await dev.expect(CO.CON)
+        tlv = bytes([0b11000001, 9, 77])  # resource 9 = int 77
+        dev.send_raw(CO.CoapCodec().serialize(CO.CoapMessage(
+            CO.ACK, CO.CONTENT, req.message_id, req.token,
+            [(CO.OPT_CONTENT_FORMAT,
+              LW.TLV_CONTENT_FORMAT.to_bytes(2, "big"))],
+            tlv,
+        )))
+        resp = await m.recv_publish()
+        body = json.loads(resp.payload)
+        content = body["data"]["content"]
+        assert content["tlv"][0]["id"] == 9
+        assert content["tlv"][0]["value"]["int"] == 77
+
+        # structured write goes down as TLV with the CF option set
+        await m.publish("lwm2m/ep-9/dn/dm", json.dumps({
+            "reqID": "w1", "msgType": "write",
+            "data": {"path": "/3/0/14", "value": {"tlv": [
+                {"kind": "res", "id": 14, "value": {"str": "+02"}},
+            ]}},
+        }).encode())
+        wreq = await dev.expect(CO.CON)
+        cf = [v for n, v in wreq.options
+              if n == CO.OPT_CONTENT_FORMAT]
+        assert cf and int.from_bytes(cf[0], "big") == \
+            LW.TLV_CONTENT_FORMAT
+        assert LW.decode_tlv(wreq.payload)[0]["value"]["str"] == "+02"
+
+        dev.close()
+        await m.disconnect()
+        await srv.stop()
+
+    run(t())
