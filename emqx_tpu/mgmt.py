@@ -103,6 +103,19 @@ class MgmtApi:
                         'Basic realm="emqx_tpu api key"',
                     },
                 )
+            if ident.publish_only:
+                # the publisher role is an ingestion credential: the
+                # publish endpoint and nothing else, reads included
+                if method == "POST" and path in (
+                    "/api/v5/publish", "/api/v5/publish/bulk"
+                ):
+                    request["identity"] = ident
+                    return await self._audited(request, handler, ident)
+                return _json(
+                    {"code": "FORBIDDEN",
+                     "message": "publisher role: publish only"},
+                    status=403,
+                )
             if path.startswith("/api/v5/data/") and not ident.can_write:
                 # backup archives hold the full config (secrets
                 # included): administrator-only, even for downloads
@@ -126,6 +139,10 @@ class MgmtApi:
                     status=403,
                 )
         request["identity"] = ident
+        return await self._audited(request, handler, ident)
+
+    async def _audited(self, request, handler, ident):
+        method, path = request.method, request.path
         resp = await handler(request)
         if method in ("POST", "PUT", "DELETE") and path != "/api/v5/login":
             self.audit.append(

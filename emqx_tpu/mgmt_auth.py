@@ -34,7 +34,11 @@ log = logging.getLogger("emqx_tpu.mgmt_auth")
 
 ROLE_ADMIN = "administrator"
 ROLE_VIEWER = "viewer"
-_ROLES = (ROLE_ADMIN, ROLE_VIEWER)
+# the EE dashboard/API rbac's third role: may POST the message-publish
+# endpoints and NOTHING else — not even reads (an ingestion credential
+# that leaks cannot enumerate the deployment)
+ROLE_PUBLISHER = "publisher"
+_ROLES = (ROLE_ADMIN, ROLE_VIEWER, ROLE_PUBLISHER)
 
 _PBKDF2_ITERS = 50_000
 
@@ -68,6 +72,10 @@ class Identity:
     @property
     def can_write(self) -> bool:
         return self.role == ROLE_ADMIN
+
+    @property
+    def publish_only(self) -> bool:
+        return self.role == ROLE_PUBLISHER
 
 
 class MgmtAuth:

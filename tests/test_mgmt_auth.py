@@ -270,3 +270,45 @@ def test_viewer_can_rotate_own_password(tmp_path):
         await srv.stop()
 
     run(t())
+
+
+def test_publisher_role_publish_only(tmp_path):
+    """The publisher role (emqx EE api-key rbac): POST /api/v5/publish
+    works; every other endpoint — reads included — answers 403."""
+
+    async def t():
+        import base64
+
+        srv = make_server(tmp_path)
+        await srv.start()
+        http, api = await auth_session(srv)
+        async with http:
+            async with http.post(api + "/api/v5/api_key", json={
+                "name": "ingest", "role": "publisher",
+            }) as r:
+                assert r.status == 201
+                kd = await r.json()
+        basic = base64.b64encode(
+            f"{kd['api_key']}:{kd['api_secret']}".encode()
+        ).decode()
+        async with aiohttp.ClientSession(
+            headers={"Authorization": f"Basic {basic}"}
+        ) as keyed:
+            async with keyed.post(api + "/api/v5/publish", json={
+                "topic": "ingest/x", "payload": "hi",
+            }) as r:
+                assert r.status == 200
+            for method, path in (
+                ("GET", "/api/v5/clients"),
+                ("GET", "/api/v5/stats"),
+                ("POST", "/api/v5/users"),
+                ("DELETE", "/api/v5/api_key/zzz"),
+                ("POST", "/api/v5/data/export"),
+            ):
+                async with keyed.request(
+                    method, api + path, json={}
+                ) as r:
+                    assert r.status == 403, (method, path, r.status)
+        await srv.stop()
+
+    run(t())
