@@ -48,8 +48,14 @@ class Connection:
             max_packet_size=broker.config.mqtt.max_packet_size
         )
         self._closed = asyncio.Event()
+        self._congested = False
 
     # -------------------------------------------------------- output
+
+    # a socket whose kernel/transport send buffer holds more than this
+    # is a congested subscriber (emqx_congestion's alarm_congestion on
+    # sndbuf full); alarm per clientid, cleared when the buffer drains
+    CONGESTION_BYTES = 1 << 20
 
     def _send_packets(self, packets: List[C.Packet]) -> None:
         if self.writer.is_closing():
@@ -61,8 +67,39 @@ class Connection:
         m.inc("packets.sent", len(packets))
         m.inc("bytes.sent", len(data))
         self.writer.write(data)
+        try:
+            buffered = self.writer.transport.get_write_buffer_size()
+        except Exception:
+            return
+        cid = (
+            self.channel.client.clientid
+            if self.channel.client is not None else self.channel.peer
+        )
+        name = f"conn_congestion/{cid}"
+        if buffered >= self.CONGESTION_BYTES:
+            if not self._congested:
+                self._congested = True
+                self.broker.metrics.inc("connection.congested")
+                self.broker.alarms.activate(
+                    name,
+                    details={"clientid": cid, "buffered": buffered},
+                    message="connection send buffer congested "
+                    "(slow consumer)",
+                )
+        elif self._congested and buffered < self.CONGESTION_BYTES // 4:
+            self._congested = False
+            self.broker.alarms.deactivate(name)
 
     def _close(self, reason: str) -> None:
+        if self._congested:
+            # a congestion alarm must not outlive its connection
+            self._congested = False
+            cid = (
+                self.channel.client.clientid
+                if self.channel.client is not None
+                else self.channel.peer
+            )
+            self.broker.alarms.deactivate(f"conn_congestion/{cid}")
         if not self.writer.is_closing():
             self.writer.close()
         self._closed.set()

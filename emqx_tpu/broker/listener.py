@@ -260,8 +260,10 @@ class BrokerServer:
         self._housekeeper: Optional[asyncio.Task] = None
         self.telemetry = None
         from ..sys_topics import SysTopics
+        from ..sysmon import SysMonitor
 
         self.sys = SysTopics(self.broker)
+        self.sysmon = SysMonitor(self.broker)
         self.api = None  # MgmtApi when config.api.enable
         self.cluster_links = None  # ClusterLinks when config.cluster_links
         self.otel = None  # OtelExporter when config.otel.enable
@@ -504,6 +506,7 @@ class BrokerServer:
             await asyncio.sleep(1.0)
             self.broker.tick()
             self.sys.tick()
+            self.sysmon.tick()
             if self.telemetry is not None:
                 self.telemetry.tick()
             if self.otel is not None:

@@ -1,0 +1,127 @@
+"""OS / VM monitor — the emqx_os_mon + emqx_vm_mon role.
+
+The reference samples system memory, CPU load, and process counts on
+an interval and raises alarms over configured watermarks
+(/root/reference/apps/emqx/src/emqx_os_mon.erl sysmem/procmem
+watermarks, emqx_vm_mon.erl process_high_watermark).  Here the
+sampled VM is the Python process + host:
+
+  * ``high_sysmem``  — MemAvailable/MemTotal below the headroom
+    watermark (``sysmem_high_watermark`` of total in use);
+  * ``high_procmem`` — this process's RSS above
+    ``procmem_high_watermark`` of total;
+  * ``high_cpu``     — 1-min loadavg per core above
+    ``cpu_high_watermark`` (deactivates below ``cpu_low_watermark``);
+  * gauges land in broker stats either way (dashboards/otel pick them
+    up without any alarm firing).
+
+Driven by the broker server's 1 Hz housekeeping at ``interval``."""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Dict, Optional
+
+log = logging.getLogger("emqx_tpu.sysmon")
+
+
+def _meminfo() -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                parts = line.split()
+                if parts and parts[0].rstrip(":") in (
+                    "MemTotal", "MemAvailable"
+                ):
+                    out[parts[0].rstrip(":")] = int(parts[1]) * 1024
+    except OSError:
+        pass
+    return out
+
+
+def _rss_bytes() -> int:
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
+class SysMonitor:
+    def __init__(
+        self,
+        broker,
+        interval: float = 30.0,
+        sysmem_high_watermark: float = 0.70,
+        procmem_high_watermark: float = 0.05,
+        cpu_high_watermark: float = 0.80,
+        cpu_low_watermark: float = 0.60,
+    ) -> None:
+        self.broker = broker
+        self.interval = interval
+        self.sysmem_high_watermark = sysmem_high_watermark
+        self.procmem_high_watermark = procmem_high_watermark
+        self.cpu_high_watermark = cpu_high_watermark
+        self.cpu_low_watermark = cpu_low_watermark
+        self._last = 0.0
+
+    def tick(self, now: Optional[float] = None) -> bool:
+        now = time.time() if now is None else now
+        if now - self._last < self.interval:
+            return False
+        self._last = now
+        self.sample()
+        return True
+
+    def sample(self) -> Dict[str, float]:
+        alarms = self.broker.alarms
+        stats = self.broker.stats
+        mem = _meminfo()
+        total = mem.get("MemTotal", 0)
+        avail = mem.get("MemAvailable", 0)
+        rss = _rss_bytes()
+        try:
+            load1 = os.getloadavg()[0]
+        except OSError:
+            load1 = 0.0
+        cores = os.cpu_count() or 1
+        cpu = load1 / cores
+        used_frac = 1.0 - (avail / total) if total else 0.0
+        proc_frac = rss / total if total else 0.0
+
+        stats.set("vm.mem.rss_bytes", rss)
+        stats.set("os.mem.used_ratio_x1000", int(used_frac * 1000))
+        stats.set("os.cpu.load1_per_core_x1000", int(cpu * 1000))
+
+        if total and used_frac >= self.sysmem_high_watermark:
+            alarms.activate(
+                "high_sysmem",
+                details={"used_ratio": round(used_frac, 3)},
+                message="system memory above the high watermark",
+            )
+        else:
+            alarms.deactivate("high_sysmem")
+        if total and proc_frac >= self.procmem_high_watermark:
+            alarms.activate(
+                "high_procmem",
+                details={"rss": rss,
+                         "ratio": round(proc_frac, 3)},
+                message="broker process RSS above the high watermark",
+            )
+        else:
+            alarms.deactivate("high_procmem")
+        if cpu >= self.cpu_high_watermark:
+            alarms.activate(
+                "high_cpu",
+                details={"load1_per_core": round(cpu, 3)},
+                message="cpu load above the high watermark",
+            )
+        elif cpu <= self.cpu_low_watermark:
+            # hysteresis: deactivate only under the LOW mark, as the
+            # reference's cpu_check does
+            alarms.deactivate("high_cpu")
+        return {"used_frac": used_frac, "proc_frac": proc_frac,
+                "cpu": cpu}

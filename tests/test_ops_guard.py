@@ -231,3 +231,54 @@ def test_listener_hierarchy_over_socket():
         await srv.stop()
 
     run(t())
+
+
+def test_sysmon_samples_and_alarms():
+    """emqx_os_mon / emqx_vm_mon role: gauges always land in stats;
+    watermark breaches raise alarms with cpu hysteresis."""
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.config import BrokerConfig
+    from emqx_tpu.sysmon import SysMonitor
+
+    broker = Broker(BrokerConfig())
+    mon = SysMonitor(broker, interval=0.0,
+                     sysmem_high_watermark=2.0,  # never fires
+                     procmem_high_watermark=2.0,
+                     cpu_high_watermark=1e9,
+                     cpu_low_watermark=1e9 - 1)
+    out = mon.sample()
+    stats = broker.stats.all()
+    assert "vm.mem.rss_bytes" in stats and stats["vm.mem.rss_bytes"] > 0
+    assert "os.cpu.load1_per_core_x1000" in stats
+    assert not any(a.name == "high_sysmem"
+                   for a in broker.alarms.active())
+
+    # force every watermark under the observed readings: alarms fire
+    mon2 = SysMonitor(broker, interval=0.0,
+                      sysmem_high_watermark=0.0,
+                      procmem_high_watermark=0.0,
+                      cpu_high_watermark=-1.0,
+                      cpu_low_watermark=-2.0)
+    mon2.sample()
+    names = {a.name for a in broker.alarms.active()}
+    assert {"high_sysmem", "high_procmem", "high_cpu"} <= names
+
+    # hysteresis: readings between low and high KEEP the cpu alarm
+    mon3 = SysMonitor(broker, interval=0.0,
+                      sysmem_high_watermark=2.0,
+                      procmem_high_watermark=2.0,
+                      cpu_high_watermark=1e9,
+                      cpu_low_watermark=-1.0)
+    mon3.sample()
+    names = {a.name for a in broker.alarms.active()}
+    assert "high_sysmem" not in names  # cleared (above-threshold gone)
+    assert "high_cpu" in names         # still above LOW: alarm holds
+
+    # dropping under the low watermark finally clears it
+    mon4 = SysMonitor(broker, interval=0.0,
+                      sysmem_high_watermark=2.0,
+                      procmem_high_watermark=2.0,
+                      cpu_high_watermark=1e9,
+                      cpu_low_watermark=1e9 - 1)
+    mon4.sample()
+    assert "high_cpu" not in {a.name for a in broker.alarms.active()}
