@@ -447,6 +447,17 @@ class BrokerServer:
                     ),
                 )
             )
+        elif kind == "jt808":
+            from ..gateway.jt808 import Jt808Gateway
+
+            await self.broker.gateways.load(
+                Jt808Gateway(
+                    self.broker,
+                    bind=gw_cfg.get("bind", "0.0.0.0"),
+                    port=int(gw_cfg.get("port", 6808)),
+                    mountpoint=gw_cfg.get("mountpoint", "jt808/"),
+                )
+            )
         elif kind == "coap":
             from ..gateway.coap import CoapGateway
 

@@ -1,0 +1,303 @@
+"""JT/T 808 gateway: vehicle terminals bridged to MQTT.
+
+The `emqx_gateway_jt808` role (/root/reference/apps/emqx_gateway_jt808/
+src/emqx_jt808_frame.erl framing, emqx_jt808_channel.erl message
+handling); the codec is written from the public JT/T 808-2013
+specification:
+
+    frame   = 0x7e escaped(header body checksum) 0x7e
+    escape  : 0x7e -> 0x7d 0x02,  0x7d -> 0x7d 0x01
+    header  = msg_id(2) attrs(2) phone BCD(6) serial(2)
+              [package info(4) when attrs bit 13]
+    check   = XOR over header+body
+
+Terminal messages handled natively: 0x0100 register (answered 0x8100
+with a minted auth code), 0x0102 authenticate, 0x0002 heartbeat and
+0x0003 unregister (0x8001 general ack), 0x0200 location report
+(decoded: alarm/status bits, lat/lon x1e-6, altitude, speed x0.1km/h,
+direction, BCD time).  Every terminal frame also publishes upstream as
+JSON to ``{mountpoint}{phone}/up``; the platform side publishes JSON
+to ``{mountpoint}{phone}/dn`` — either ``{"msg_id": ..., "body_hex":
+...}`` raw passthrough or ``{"text": ...}`` (0x8300 text message) —
+which this gateway frames back to the terminal.
+
+Explicit cuts: subpackaged (multi-frame) messages and RSA encryption
+(attrs bits) are rejected, 2019-edition version markers are not
+parsed, and the auth-code store is in-memory per gateway."""
+
+from __future__ import annotations
+
+import json
+import secrets
+import struct
+from typing import Dict, List, Optional, Tuple
+
+from ..access import PUBLISH, SUBSCRIBE, ClientInfo
+from ..broker.session import SubOpts
+from ..message import Message
+from . import Gateway, GatewayChannel, GatewayFrame
+
+FLAG = 0x7E
+MAX_FRAME = 4096
+
+# terminal -> platform
+MSG_HEARTBEAT = 0x0002
+MSG_UNREGISTER = 0x0003
+MSG_REGISTER = 0x0100
+MSG_AUTH = 0x0102
+MSG_LOCATION = 0x0200
+# platform -> terminal
+MSG_GENERAL_ACK = 0x8001
+MSG_REGISTER_ACK = 0x8100
+MSG_TEXT = 0x8300
+
+
+def _escape(data: bytes) -> bytes:
+    return data.replace(b"\x7d", b"\x7d\x01").replace(
+        b"\x7e", b"\x7d\x02"
+    )
+
+
+def _unescape(data: bytes) -> bytes:
+    return data.replace(b"\x7d\x02", b"\x7e").replace(
+        b"\x7d\x01", b"\x7d"
+    )
+
+
+def _xor(data: bytes) -> int:
+    c = 0
+    for b in data:
+        c ^= b
+    return c
+
+
+def _bcd(data: bytes) -> str:
+    return data.hex()
+
+
+def _to_bcd(digits: str, width: int) -> bytes:
+    digits = digits.rjust(width * 2, "0")[-width * 2:]
+    return bytes.fromhex(digits)
+
+
+class Jt808Message:
+    __slots__ = ("msg_id", "phone", "serial", "body")
+
+    def __init__(self, msg_id: int, phone: str, serial: int,
+                 body: bytes = b"") -> None:
+        self.msg_id = msg_id
+        self.phone = phone
+        self.serial = serial
+        self.body = body
+
+
+class Jt808Codec(GatewayFrame):
+    def initial_state(self) -> bytes:
+        return b""
+
+    def parse(
+        self, state: bytes, data: bytes
+    ) -> Tuple[List[Jt808Message], bytes]:
+        buf = state + data
+        if len(buf) > MAX_FRAME * 4:
+            raise ValueError("jt808: buffer overflow")
+        out: List[Jt808Message] = []
+        while True:
+            start = buf.find(bytes([FLAG]))
+            if start < 0:
+                return out, b""
+            end = buf.find(bytes([FLAG]), start + 1)
+            if end < 0:
+                return out, buf[start:]
+            raw = buf[start + 1:end]
+            buf = buf[end + 1:]
+            if not raw:
+                continue  # back-to-back flags (end+start of frames)
+            frame = _unescape(raw)
+            if len(frame) < 13:
+                raise ValueError("jt808: short frame")
+            if _xor(frame[:-1]) != frame[-1]:
+                raise ValueError("jt808: checksum mismatch")
+            msg_id, attrs = struct.unpack_from(">HH", frame, 0)
+            if attrs & 0x2000:
+                raise ValueError("jt808: subpackage unsupported")
+            if attrs & 0x1C00:
+                raise ValueError("jt808: encryption unsupported")
+            body_len = attrs & 0x03FF
+            phone = _bcd(frame[4:10])
+            (serial,) = struct.unpack_from(">H", frame, 10)
+            body = frame[12:12 + body_len]
+            if len(body) != body_len:
+                raise ValueError("jt808: body length mismatch")
+            out.append(Jt808Message(msg_id, phone, serial, body))
+
+    def serialize(self, m: Jt808Message) -> bytes:
+        header = (
+            struct.pack(">HH", m.msg_id, len(m.body) & 0x03FF)
+            + _to_bcd(m.phone, 6)
+            + struct.pack(">H", m.serial)
+        )
+        payload = header + m.body
+        payload += bytes([_xor(payload)])
+        return bytes([FLAG]) + _escape(payload) + bytes([FLAG])
+
+
+def decode_location(body: bytes) -> Dict:
+    """0x0200 basic position block (extras pass through as hex)."""
+    alarm, status, lat, lon = struct.unpack_from(">IIII", body, 0)
+    alt, speed, direction = struct.unpack_from(">HHH", body, 16)
+    t = _bcd(body[22:28])
+    return {
+        "alarm": alarm,
+        "status": status,
+        "lat": lat / 1e6,
+        "lon": lon / 1e6,
+        "altitude": alt,
+        "speed_kmh": speed / 10.0,
+        "direction": direction,
+        "time": f"20{t[0:2]}-{t[2:4]}-{t[4:6]} "
+                f"{t[6:8]}:{t[8:10]}:{t[10:12]}",
+        "extras_hex": body[28:].hex(),
+    }
+
+
+class Jt808Channel(GatewayChannel):
+    def __init__(self, gateway, write, close, peer) -> None:
+        super().__init__(gateway, write, close, peer)
+        self.phone: Optional[str] = None
+        self.client: Optional[ClientInfo] = None
+        self.authed = False
+        self._serial = 0
+
+    def _next_serial(self) -> int:
+        self._serial = (self._serial + 1) & 0xFFFF
+        return self._serial
+
+    def _send(self, msg_id: int, body: bytes) -> None:
+        self.write(self.gateway.frame.serialize(Jt808Message(
+            msg_id, self.phone or "0", self._next_serial(), body
+        )))
+
+    def _general_ack(self, m: Jt808Message, result: int = 0) -> None:
+        self._send(MSG_GENERAL_ACK,
+                   struct.pack(">HHB", m.serial, m.msg_id, result))
+
+    def _uplink(self, kind: str, m: Jt808Message, extra: Dict) -> None:
+        topic = f"{self.gateway.mountpoint}{self.phone}/up"
+        if self.client is not None and not self.broker.access.authorize(
+            self.client, PUBLISH, topic
+        ):
+            self.broker.metrics.inc("authorization.deny")
+            return
+        self.broker_publish(Message(
+            topic=topic,
+            payload=json.dumps({
+                "msg_id": m.msg_id, "type": kind,
+                "serial": m.serial, **extra,
+            }).encode(),
+            qos=self.gateway.qos,
+            from_client=f"jt808-{self.phone}",
+        ))
+
+    # -------------------------------------------------------- frames
+
+    def handle_frame(self, m: Jt808Message) -> None:
+        if self.phone is None:
+            self.phone = m.phone
+        if m.msg_id == MSG_REGISTER:
+            self._on_register(m)
+            return
+        if m.msg_id == MSG_AUTH:
+            self._on_auth(m)
+            return
+        if not self.authed:
+            self._general_ack(m, result=1)  # failure: not authed
+            return
+        if m.msg_id == MSG_LOCATION:
+            try:
+                loc = decode_location(m.body)
+            except struct.error:
+                self._general_ack(m, result=2)
+                return
+            self._uplink("location", m, loc)
+            self._general_ack(m)
+        elif m.msg_id == MSG_HEARTBEAT:
+            self._uplink("heartbeat", m, {})
+            self._general_ack(m)
+        elif m.msg_id == MSG_UNREGISTER:
+            self.gateway.auth_codes.pop(self.phone, None)
+            self._general_ack(m)
+            self.close("unregistered")
+        else:
+            self._uplink("raw", m, {"body_hex": m.body.hex()})
+            self._general_ack(m)
+
+    def _on_register(self, m: Jt808Message) -> None:
+        code = secrets.token_hex(8)
+        self.gateway.auth_codes[m.phone] = code
+        # 0x8100: serial(2) result(1) auth code
+        self._send(MSG_REGISTER_ACK,
+                   struct.pack(">HB", m.serial, 0) + code.encode())
+        self._uplink("register", m, {"body_hex": m.body.hex()})
+
+    def _on_auth(self, m: Jt808Message) -> None:
+        want = self.gateway.auth_codes.get(m.phone)
+        given = m.body.decode("utf-8", "replace")
+        if want is None or given != want:
+            self._general_ack(m, result=1)
+            return
+        client = ClientInfo(clientid=f"jt808-{m.phone}",
+                            peerhost=self.peer)
+        ok, client = self.broker.access.authenticate(client)
+        dn = f"{self.gateway.mountpoint}{m.phone}/dn"
+        if not ok or not self.broker.access.authorize(
+            client, SUBSCRIBE, dn
+        ):
+            self._general_ack(m, result=1)
+            return
+        self.client = client
+        self.authed = True
+        self.open_session(client.clientid, clean_start=False)
+        opts = SubOpts(qos=self.gateway.qos)
+        is_new = self.session.subscribe(dn, opts)
+        self.broker.subscribe(client.clientid, dn, opts,
+                              is_new_sub=is_new)
+        self._general_ack(m, result=0)
+        self._uplink("auth", m, {})
+
+    # ------------------------------------------------------ downlink
+
+    def deliver(self, packets) -> None:
+        for pkt in packets:
+            try:
+                cmd = json.loads(pkt.payload)
+            except (ValueError, UnicodeDecodeError):
+                continue
+            if "text" in cmd:
+                # 0x8300: flags(1) + GBK text (ascii subset here)
+                body = b"\x01" + str(cmd["text"]).encode(
+                    "utf-8", "replace"
+                )
+                self._send(MSG_TEXT, body)
+            elif "msg_id" in cmd and "body_hex" in cmd:
+                try:
+                    self._send(int(cmd["msg_id"]),
+                               bytes.fromhex(cmd["body_hex"]))
+                except ValueError:
+                    continue
+
+    def connection_lost(self, reason: str) -> None:
+        super().connection_lost(reason)
+
+
+class Jt808Gateway(Gateway):
+    name = "jt808"
+    frame_class = Jt808Codec
+    channel_class = Jt808Channel
+
+    def __init__(self, broker, bind: str = "0.0.0.0", port: int = 0,
+                 mountpoint: str = "jt808/", qos: int = 1) -> None:
+        super().__init__(broker, bind, port)
+        self.mountpoint = mountpoint
+        self.qos = qos
+        self.auth_codes: Dict[str, str] = {}

@@ -1,0 +1,183 @@
+"""JT/T 808 gateway (gateway/jt808.py): framing/escaping/checksum,
+register -> auth-code -> authenticate flow, location decoding to the
+up topic, downlink text messages — written from the public JT/T
+808-2013 spec (the emqx_gateway_jt808 role)."""
+
+import asyncio
+import json
+import struct
+
+import pytest
+
+from emqx_tpu.broker.listener import BrokerServer
+from emqx_tpu.config import BrokerConfig, ListenerConfig
+from emqx_tpu.gateway.jt808 import (
+    FLAG,
+    Jt808Codec,
+    Jt808Message,
+    MSG_AUTH,
+    MSG_GENERAL_ACK,
+    MSG_HEARTBEAT,
+    MSG_LOCATION,
+    MSG_REGISTER,
+    MSG_REGISTER_ACK,
+    MSG_TEXT,
+    decode_location,
+)
+from mqtt_client import TestClient
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ------------------------------------------------------------- codec
+
+def test_jt808_codec_roundtrip_and_escaping():
+    codec = Jt808Codec()
+    # a body containing both escape-sensitive bytes
+    m = Jt808Message(MSG_LOCATION, "013812345678", 7,
+                     b"\x7e\x7d\x00data")
+    wire = codec.serialize(m)
+    assert wire[0] == FLAG and wire[-1] == FLAG
+    assert b"\x7e" not in wire[1:-1]  # escaped payload
+    frames, rest = codec.parse(codec.initial_state(), wire)
+    assert rest == b"" and len(frames) == 1
+    out = frames[0]
+    assert (out.msg_id, out.phone, out.serial) == (
+        MSG_LOCATION, "013812345678", 7
+    )
+    assert out.body == b"\x7e\x7d\x00data"
+
+    # split delivery reassembles; checksum corruption raises
+    half = len(wire) // 2
+    frames, state = codec.parse(codec.initial_state(), wire[:half])
+    assert frames == []
+    frames, _ = codec.parse(state, wire[half:])
+    assert len(frames) == 1
+    bad = bytearray(wire)
+    bad[-2] ^= 0xFF
+    with pytest.raises(ValueError):
+        codec.parse(codec.initial_state(), bytes(bad))
+
+
+def test_jt808_location_decode():
+    body = struct.pack(
+        ">IIII", 0x00000001, 0x00000002,
+        int(31.2304 * 1e6), int(121.4737 * 1e6),
+    ) + struct.pack(">HHH", 15, 605, 90) + bytes.fromhex(
+        "260731102530"
+    )
+    loc = decode_location(body)
+    assert abs(loc["lat"] - 31.2304) < 1e-6
+    assert abs(loc["lon"] - 121.4737) < 1e-6
+    assert loc["speed_kmh"] == 60.5 and loc["direction"] == 90
+    assert loc["time"] == "2026-07-31 10:25:30"
+
+
+# --------------------------------------------------------------- e2e
+
+class Terminal:
+    def __init__(self, port, phone):
+        self.port = port
+        self.phone = phone
+        self.codec = Jt808Codec()
+        self.state = b""
+        self.serial = 0
+
+    async def connect(self):
+        self.r, self.w = await asyncio.open_connection(
+            "127.0.0.1", self.port
+        )
+        return self
+
+    def send(self, msg_id, body=b""):
+        self.serial += 1
+        self.w.write(self.codec.serialize(Jt808Message(
+            msg_id, self.phone, self.serial, body
+        )))
+
+    async def recv(self, timeout=3.0):
+        while True:
+            frames, self.state = self.codec.parse(
+                self.state,
+                await asyncio.wait_for(self.r.read(4096), timeout),
+            )
+            if frames:
+                return frames[0]
+
+    def close(self):
+        self.w.close()
+
+
+def test_jt808_register_auth_location_downlink():
+    async def t():
+        cfg = BrokerConfig()
+        cfg.listeners = [ListenerConfig(port=0)]
+        cfg.gateways = [
+            {"type": "jt808", "bind": "127.0.0.1", "port": 0}
+        ]
+        srv = BrokerServer(cfg)
+        await srv.start()
+        gw = srv.broker.gateways.get("jt808")
+
+        app = TestClient(srv.listeners[0].port, "fleet-app")
+        await app.connect()
+        await app.subscribe("jt808/+/up", qos=1)
+
+        term = await Terminal(gw.port, "013800001111").connect()
+
+        # -------- location before auth is refused
+        term.send(MSG_HEARTBEAT)
+        ack = await term.recv()
+        assert ack.msg_id == MSG_GENERAL_ACK
+        assert ack.body[-1] == 1  # failure: not authenticated
+
+        # -------- register mints an auth code
+        term.send(MSG_REGISTER, b"\x00\x1f\x00\x23" + b"M" * 12)
+        rack = await term.recv()
+        assert rack.msg_id == MSG_REGISTER_ACK
+        r_serial, result = struct.unpack_from(">HB", rack.body, 0)
+        assert result == 0
+        auth_code = rack.body[3:]
+        reg_up = await app.recv_publish()
+        assert reg_up.topic == "jt808/013800001111/up"
+
+        # -------- wrong auth code denied, right one accepted
+        term.send(MSG_AUTH, b"wrong")
+        ack = await term.recv()
+        assert ack.body[-1] == 1
+        term.send(MSG_AUTH, auth_code)
+        ack = await term.recv()
+        assert ack.msg_id == MSG_GENERAL_ACK and ack.body[-1] == 0
+        auth_up = await app.recv_publish()
+        assert json.loads(auth_up.payload)["type"] == "auth"
+
+        # -------- location report decodes to the up topic
+        body = struct.pack(
+            ">IIII", 0, 0, int(31.2 * 1e6), int(121.5 * 1e6)
+        ) + struct.pack(">HHH", 10, 321, 180) + bytes.fromhex(
+            "260731120000"
+        )
+        term.send(MSG_LOCATION, body)
+        ack = await term.recv()
+        assert ack.body[-1] == 0
+        up = await app.recv_publish()
+        loc = json.loads(up.payload)
+        assert loc["type"] == "location"
+        assert abs(loc["lat"] - 31.2) < 1e-6
+        assert loc["speed_kmh"] == 32.1
+
+        # -------- downlink text message frames to the terminal
+        await app.publish("jt808/013800001111/dn", json.dumps({
+            "text": "return to depot",
+        }).encode(), qos=1)
+        dn = await term.recv()
+        assert dn.msg_id == MSG_TEXT
+        assert dn.body[1:] == b"return to depot"
+
+        term.close()
+        await app.disconnect()
+        await srv.stop()
+
+    run(t())
