@@ -2,22 +2,30 @@
 
 The `emqx_plugins` role (/root/reference/apps/emqx_plugins/src:
 installable packages registering hooks at boot, with enable/disable
-order): here a plugin is a Python module (a single ``<name>.py`` file
-in the plugin directory, or an importable module path) exposing
+order): a plugin is either
 
-    def setup(broker) -> None | object
+  * a single ``<name>.py`` file in the plugin directory (or an
+    importable module path), or
+  * an installable PACKAGE ``<name>-<vsn>.tar.gz`` (the reference's
+    release-package shape): a tarball holding ``release.json``
+    ({"name", "rel_vsn", "description", ...}) plus the plugin's
+    Python sources, installed into ``<dir>/<name>-<vsn>/`` via
+    `install_package` and loaded by its release name.
 
-``setup`` registers hooks/rules/resources against the broker; the
-optional return value is retained and, if it has ``teardown(broker)``,
-called at unload.  Plugins load in configured order at server start.
+Either form exposes ``def setup(broker) -> None | object``; ``setup``
+registers hooks/rules/resources against the broker; the optional
+return value is retained and, if it has ``teardown(broker)``, called
+at unload.  Plugins load in configured order at server start.
 """
 
 from __future__ import annotations
 
 import importlib
 import importlib.util
+import json
 import logging
 import os
+import tarfile
 from typing import Dict, List, Optional
 
 log = logging.getLogger("emqx_tpu.plugins")
@@ -29,14 +37,76 @@ class PluginManager:
         self.directory = directory
         self._loaded: Dict[str, object] = {}
 
+    def install_package(self, tgz_path: str) -> str:
+        """Install a ``<name>-<vsn>.tar.gz`` release package into the
+        plugin directory (emqx_plugins:ensure_installed): validates
+        release.json, extracts under ``<dir>/<name>-<vsn>/``, and
+        returns the release name for `load`.  Member paths are
+        sanitized — a package must not write outside its own tree."""
+        with tarfile.open(tgz_path, "r:gz") as tf:
+            names = tf.getnames()
+            rel_member = next(
+                (n for n in names
+                 if n.rstrip("/").endswith("release.json")), None
+            )
+            if rel_member is None:
+                raise ValueError("package has no release.json")
+            meta = json.load(tf.extractfile(rel_member))
+            name = meta.get("name")
+            vsn = meta.get("rel_vsn")
+            if not name or not vsn:
+                raise ValueError("release.json missing name/rel_vsn")
+            rel = f"{name}-{vsn}"
+            dest = os.path.join(self.directory, rel)
+            os.makedirs(dest, exist_ok=True)
+            for member in tf.getmembers():
+                target = os.path.normpath(member.name)
+                if target.startswith(("..", "/")):
+                    raise ValueError(
+                        f"unsafe member path {member.name!r}"
+                    )
+                if member.isfile():
+                    # flatten one leading '<rel>/' dir if present
+                    parts = target.split("/")
+                    if parts[0] == rel and len(parts) > 1:
+                        target = "/".join(parts[1:])
+                    out = os.path.join(dest, target)
+                    os.makedirs(os.path.dirname(out), exist_ok=True)
+                    with open(out, "wb") as f:
+                        f.write(tf.extractfile(member).read())
+        log.info("plugin package %s installed", rel)
+        return rel
+
+    def _package_module(self, name: str):
+        """A ``<name>-<vsn>`` directory with release.json is a
+        package: its entry module is ``<name>.py`` inside (or the
+        release.json "entry")."""
+        pdir = os.path.join(self.directory, name)
+        rel_path = os.path.join(pdir, "release.json")
+        if not os.path.isdir(pdir) or not os.path.exists(rel_path):
+            return None
+        with open(rel_path) as f:
+            meta = json.load(f)
+        entry = meta.get("entry", f"{meta.get('name', name)}.py")
+        spec = importlib.util.spec_from_file_location(
+            f"emqx_tpu_plugin_{name}", os.path.join(pdir, entry)
+        )
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+        return module
+
     def load(self, name: str) -> bool:
-        """Load one plugin by name: `<dir>/<name>.py` first, else an
-        importable module path."""
+        """Load one plugin by name: an installed package directory
+        first, then `<dir>/<name>.py`, else an importable module
+        path."""
         if name in self._loaded:
             return False
         path = os.path.join(self.directory, f"{name}.py")
         try:
-            if os.path.exists(path):
+            module = self._package_module(name)
+            if module is not None:
+                pass
+            elif os.path.exists(path):
                 spec = importlib.util.spec_from_file_location(
                     f"emqx_tpu_plugin_{name}", path
                 )

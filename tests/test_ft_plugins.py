@@ -149,3 +149,70 @@ def test_dashboard_page():
         await srv.stop()
 
     run(t())
+
+
+def test_plugin_package_install_and_load(tmp_path):
+    """Installable release packages (emqx_plugins ensure_installed):
+    a <name>-<vsn>.tar.gz with release.json + sources installs into
+    the plugin dir and loads by release name; unsafe member paths are
+    rejected."""
+    import io
+    import json as _json
+    import tarfile
+
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.config import BrokerConfig
+    from emqx_tpu.plugins import PluginManager
+
+    def make_pkg(path, member_prefix="counter_pkg-1.0.0/"):
+        with tarfile.open(path, "w:gz") as tf:
+            def add(name, data):
+                info = tarfile.TarInfo(member_prefix + name)
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+            add("release.json", _json.dumps({
+                "name": "counter_pkg", "rel_vsn": "1.0.0",
+                "description": "counts publishes",
+            }).encode())
+            add("counter_pkg.py", (
+                "def setup(broker):\n"
+                "    seen = []\n"
+                "    broker.hooks.add('message.publish',\n"
+                "                     lambda m: seen.append(m.topic) or m)\n"
+                "    class H:\n"
+                "        def teardown(self, broker):\n"
+                "            seen.clear()\n"
+                "    h = H(); h.seen = seen\n"
+                "    return h\n"
+            ).encode())
+
+    pkg = tmp_path / "counter_pkg-1.0.0.tar.gz"
+    make_pkg(str(pkg))
+    broker = Broker(BrokerConfig())
+    pm = PluginManager(broker, directory=str(tmp_path / "plugins"))
+    os_rel = pm.install_package(str(pkg))
+    assert os_rel == "counter_pkg-1.0.0"
+    assert pm.load(os_rel)
+
+    from emqx_tpu.message import Message
+
+    broker.publish(Message(topic="pkg/x", payload=b"1"))
+    handle = pm._loaded[os_rel]
+    assert handle.seen == ["pkg/x"]
+    assert pm.unload(os_rel)
+
+    # path traversal is rejected
+    import pytest as _pytest
+
+    evil = tmp_path / "evil-1.tar.gz"
+    with tarfile.open(str(evil), "w:gz") as tf:
+        data = _json.dumps({"name": "evil", "rel_vsn": "1"}).encode()
+        info = tarfile.TarInfo("evil-1/release.json")
+        info.size = len(data)
+        tf.addfile(info, io.BytesIO(data))
+        bad = tarfile.TarInfo("../../outside.py")
+        bad.size = 1
+        tf.addfile(bad, io.BytesIO(b"x"))
+    pm2 = PluginManager(broker, directory=str(tmp_path / "p2"))
+    with _pytest.raises(ValueError):
+        pm2.install_package(str(evil))
