@@ -458,6 +458,17 @@ class BrokerServer:
                     mountpoint=gw_cfg.get("mountpoint", "jt808/"),
                 )
             )
+        elif kind == "gbt32960":
+            from ..gateway.gbt32960 import GbtGateway
+
+            await self.broker.gateways.load(
+                GbtGateway(
+                    self.broker,
+                    bind=gw_cfg.get("bind", "0.0.0.0"),
+                    port=int(gw_cfg.get("port", 7325)),
+                    mountpoint=gw_cfg.get("mountpoint", "gbt32960/"),
+                )
+            )
         elif kind == "coap":
             from ..gateway.coap import CoapGateway
 

@@ -1,0 +1,260 @@
+"""GB/T 32960 gateway: electric-vehicle terminals bridged to MQTT.
+
+The `emqx_gateway_gbt32960` role (/root/reference/apps/
+emqx_gateway_gbt32960/src — frame codec + channel bridging EV
+telemetry onto pub/sub); the codec is written from the public GB/T
+32960.3-2016 specification:
+
+    frame = "##" cmd(1) ack(1) VIN(17 ascii) encryption(1)
+            length(2 BE) body BCC(1, XOR over cmd..body)
+
+Commands handled natively: 0x01 vehicle login (time BCD(6), serial(2),
+ICCID(20), battery-pack fields), 0x04 vehicle logout, 0x07/0x08
+heartbeat / platform time sync, 0x02 realtime info and 0x03 reissued
+(stored) info — realtime bodies decode their vehicle-state block
+(speed/mileage/voltage/current/SOC) when present, everything else
+passes as hex.  Uplinks publish JSON to ``{mountpoint}{vin}/up``;
+platform JSON on ``{mountpoint}{vin}/dn`` ({"cmd", "body_hex"})
+frames back with the platform-success ack flag.
+
+Explicit cuts: the encryption byte must be 0x01 (plaintext — RSA/AES
+variants rejected), and only the realtime vehicle-state information
+type is decoded field-by-field (the other six info types cross as
+hex; the reference decodes them via its own per-type codecs)."""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Dict, List, Optional, Tuple
+
+from ..access import PUBLISH, SUBSCRIBE, ClientInfo
+from ..broker.session import SubOpts
+from ..message import Message
+from . import Gateway, GatewayChannel, GatewayFrame
+
+MAX_FRAME = 65536
+
+CMD_LOGIN = 0x01
+CMD_REALTIME = 0x02
+CMD_REISSUE = 0x03
+CMD_LOGOUT = 0x04
+CMD_HEARTBEAT = 0x07
+CMD_TIMESYNC = 0x08
+
+ACK_SUCCESS = 0x01
+ACK_COMMAND = 0xFE  # a terminal-originated data frame
+
+ENC_PLAIN = 0x01
+
+
+class GbtMessage:
+    __slots__ = ("cmd", "ack", "vin", "body")
+
+    def __init__(self, cmd: int, ack: int, vin: str,
+                 body: bytes = b"") -> None:
+        self.cmd = cmd
+        self.ack = ack
+        self.vin = vin
+        self.body = body
+
+
+class GbtCodec(GatewayFrame):
+    def initial_state(self) -> bytes:
+        return b""
+
+    def parse(
+        self, state: bytes, data: bytes
+    ) -> Tuple[List[GbtMessage], bytes]:
+        buf = state + data
+        if len(buf) > MAX_FRAME * 2:
+            raise ValueError("gbt32960: buffer overflow")
+        out: List[GbtMessage] = []
+        while True:
+            start = buf.find(b"##")
+            if start < 0:
+                return out, buf[-1:] if buf.endswith(b"#") else b""
+            buf = buf[start:]
+            if len(buf) < 25:
+                return out, buf
+            cmd, ack = buf[2], buf[3]
+            vin = buf[4:21].decode("ascii", "replace").rstrip("\x00 ")
+            enc = buf[21]
+            (length,) = struct.unpack_from(">H", buf, 22)
+            if len(buf) < 25 + length:
+                return out, buf
+            body = buf[24:24 + length]
+            bcc = buf[24 + length]
+            check = 0
+            for b in buf[2:24 + length]:
+                check ^= b
+            buf = buf[25 + length:]
+            if check != bcc:
+                raise ValueError("gbt32960: BCC mismatch")
+            if enc != ENC_PLAIN:
+                raise ValueError("gbt32960: encrypted frames unsupported")
+            out.append(GbtMessage(cmd, ack, vin, body))
+
+    def serialize(self, m: GbtMessage) -> bytes:
+        vin = m.vin.encode("ascii", "replace")[:17].ljust(17, b"\x00")
+        inner = (
+            bytes([m.cmd, m.ack]) + vin + bytes([ENC_PLAIN])
+            + struct.pack(">H", len(m.body)) + m.body
+        )
+        check = 0
+        for b in inner:
+            check ^= b
+        return b"##" + inner + bytes([check])
+
+
+def _bcd_time(b: bytes) -> str:
+    t = b.hex()
+    return (f"20{t[0:2]}-{t[2:4]}-{t[4:6]} "
+            f"{t[6:8]}:{t[8:10]}:{t[10:12]}")
+
+
+def decode_realtime(body: bytes) -> Dict:
+    """0x02/0x03: time BCD(6) + typed info units; the vehicle-state
+    unit (type 0x01) decodes field-by-field, others pass as hex."""
+    out: Dict = {"time": _bcd_time(body[:6]), "infos": []}
+    off = 6
+    while off < len(body):
+        itype = body[off]
+        off += 1
+        if itype == 0x01 and off + 18 <= len(body):
+            (state, charge, mode, speed, mileage, voltage, current,
+             soc, dcdc, gear, resistance) = struct.unpack_from(
+                ">BBBHIHHBBBH", body, off)
+            out["infos"].append({
+                "type": "vehicle_state",
+                "state": state, "charge": charge, "mode": mode,
+                "speed_kmh": speed / 10.0,
+                "mileage_km": mileage / 10.0,
+                "voltage_v": voltage / 10.0,
+                "current_a": current / 10.0 - 1000.0,
+                "soc_pct": soc,
+                "gear": gear & 0x0F,
+                "insulation_kohm": resistance,
+            })
+            off += 18
+            # accelerator/brake pedal bytes (2016 edition) when they
+            # close the unit out
+            if 0 < len(body) - off <= 2:
+                off = len(body)
+        else:
+            # unknown unit: without the per-type length table the rest
+            # of the frame crosses as one opaque blob
+            out["infos"].append({
+                "type": f"raw_{itype:#04x}",
+                "hex": body[off:].hex(),
+            })
+            break
+    return out
+
+
+class GbtChannel(GatewayChannel):
+    def __init__(self, gateway, write, close, peer) -> None:
+        super().__init__(gateway, write, close, peer)
+        self.vin: Optional[str] = None
+        self.client: Optional[ClientInfo] = None
+        self.logged_in = False
+
+    def _reply(self, m: GbtMessage, ack: int = ACK_SUCCESS,
+               body: bytes = b"") -> None:
+        # platform replies echo the command with its original time
+        # body prefix (spec: the ack carries the data unit's time)
+        self.write(self.gateway.frame.serialize(GbtMessage(
+            m.cmd, ack, m.vin, body or m.body[:6]
+        )))
+
+    def _uplink(self, kind: str, m: GbtMessage, extra: Dict) -> None:
+        topic = f"{self.gateway.mountpoint}{self.vin}/up"
+        if self.client is not None and not self.broker.access.authorize(
+            self.client, PUBLISH, topic
+        ):
+            self.broker.metrics.inc("authorization.deny")
+            return
+        self.broker_publish(Message(
+            topic=topic,
+            payload=json.dumps(
+                {"cmd": m.cmd, "type": kind, **extra}
+            ).encode(),
+            qos=self.gateway.qos,
+            from_client=f"gbt-{self.vin}",
+        ))
+
+    def handle_frame(self, m: GbtMessage) -> None:
+        if self.vin is None:
+            self.vin = m.vin
+        if m.cmd == CMD_LOGIN:
+            self._on_login(m)
+            return
+        if not self.logged_in:
+            self._reply(m, ack=0x02)  # error: not logged in
+            return
+        if m.cmd in (CMD_REALTIME, CMD_REISSUE):
+            try:
+                info = decode_realtime(m.body)
+            except (struct.error, IndexError):
+                self._reply(m, ack=0x02)
+                return
+            kind = "realtime" if m.cmd == CMD_REALTIME else "reissue"
+            self._uplink(kind, m, info)
+            self._reply(m)
+        elif m.cmd == CMD_HEARTBEAT:
+            self._reply(m, body=b"")
+        elif m.cmd == CMD_LOGOUT:
+            self._uplink("logout", m, {"time": _bcd_time(m.body[:6])})
+            self._reply(m)
+            self.close("logout")
+        else:
+            self._uplink("raw", m, {"body_hex": m.body.hex()})
+            self._reply(m)
+
+    def _on_login(self, m: GbtMessage) -> None:
+        client = ClientInfo(clientid=f"gbt-{m.vin}",
+                            peerhost=self.peer)
+        ok, client = self.broker.access.authenticate(client)
+        dn = f"{self.gateway.mountpoint}{m.vin}/dn"
+        if not ok or not self.broker.access.authorize(
+            client, SUBSCRIBE, dn
+        ):
+            self._reply(m, ack=0x02)
+            return
+        self.client = client
+        self.logged_in = True
+        self.open_session(client.clientid, clean_start=False)
+        opts = SubOpts(qos=self.gateway.qos)
+        is_new = self.session.subscribe(dn, opts)
+        self.broker.subscribe(client.clientid, dn, opts,
+                              is_new_sub=is_new)
+        body = {"time": _bcd_time(m.body[:6])}
+        if len(m.body) >= 8:
+            body["serial"] = struct.unpack_from(">H", m.body, 6)[0]
+        if len(m.body) >= 28:
+            body["iccid"] = m.body[8:28].decode("ascii", "replace")
+        self._uplink("login", m, body)
+        self._reply(m)
+
+    def deliver(self, packets) -> None:
+        for pkt in packets:
+            try:
+                cmd = json.loads(pkt.payload)
+                self.write(self.gateway.frame.serialize(GbtMessage(
+                    int(cmd["cmd"]), ACK_COMMAND, self.vin or "",
+                    bytes.fromhex(cmd.get("body_hex", "")),
+                )))
+            except (ValueError, KeyError, UnicodeDecodeError):
+                continue
+
+
+class GbtGateway(Gateway):
+    name = "gbt32960"
+    frame_class = GbtCodec
+    channel_class = GbtChannel
+
+    def __init__(self, broker, bind: str = "0.0.0.0", port: int = 0,
+                 mountpoint: str = "gbt32960/", qos: int = 1) -> None:
+        super().__init__(broker, bind, port)
+        self.mountpoint = mountpoint
+        self.qos = qos

@@ -1,0 +1,165 @@
+"""GB/T 32960 gateway (gateway/gbt32960.py): framing/BCC, login flow,
+realtime vehicle-state decoding, downlink passthrough — written from
+the public GB/T 32960.3-2016 spec (the emqx_gateway_gbt32960 role)."""
+
+import asyncio
+import json
+import struct
+
+import pytest
+
+from emqx_tpu.broker.listener import BrokerServer
+from emqx_tpu.config import BrokerConfig, ListenerConfig
+from emqx_tpu.gateway.gbt32960 import (
+    ACK_SUCCESS,
+    CMD_HEARTBEAT,
+    CMD_LOGIN,
+    CMD_REALTIME,
+    GbtCodec,
+    GbtMessage,
+    decode_realtime,
+)
+from mqtt_client import TestClient
+
+VIN = "LSVNV2182E2100001"
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_gbt_codec_roundtrip_and_bcc():
+    codec = GbtCodec()
+    m = GbtMessage(CMD_REALTIME, 0xFE, VIN, b"\x26\x07\x31\x01\x02\x03xyz")
+    wire = codec.serialize(m)
+    assert wire[:2] == b"##"
+    frames, rest = codec.parse(codec.initial_state(), wire)
+    assert rest == b"" and len(frames) == 1
+    out = frames[0]
+    assert (out.cmd, out.vin) == (CMD_REALTIME, VIN)
+    assert out.body.endswith(b"xyz")
+
+    # split delivery; BCC corruption raises
+    frames, state = codec.parse(codec.initial_state(), wire[:10])
+    assert frames == []
+    frames, _ = codec.parse(state, wire[10:])
+    assert len(frames) == 1
+    bad = bytearray(wire)
+    bad[-1] ^= 0xFF
+    with pytest.raises(ValueError):
+        codec.parse(codec.initial_state(), bytes(bad))
+
+
+def test_gbt_realtime_decode():
+    body = bytes.fromhex("260731102530")  # time
+    body += bytes([0x01]) + struct.pack(
+        ">BBBHIHHBBBH",
+        1, 1, 1,          # started, charging, electric
+        605,              # speed x0.1
+        123456,           # mileage x0.1
+        3501,             # voltage x0.1
+        10250,            # current offset 1000A x0.1
+        87,               # soc
+        1, 0x1D,          # dcdc, gear (drive + flags)
+        5000,             # insulation
+    )
+    out = decode_realtime(body)
+    assert out["time"] == "2026-07-31 10:25:30"
+    info = out["infos"][0]
+    assert info["type"] == "vehicle_state"
+    assert info["speed_kmh"] == 60.5
+    assert info["mileage_km"] == 12345.6
+    assert info["current_a"] == 25.0
+    assert info["soc_pct"] == 87 and info["gear"] == 13
+
+
+class EvTerminal:
+    def __init__(self, port):
+        self.port = port
+        self.codec = GbtCodec()
+        self.state = b""
+
+    async def connect(self):
+        self.r, self.w = await asyncio.open_connection(
+            "127.0.0.1", self.port
+        )
+        return self
+
+    def send(self, cmd, body=b"", ack=0xFE):
+        self.w.write(self.codec.serialize(
+            GbtMessage(cmd, ack, VIN, body)
+        ))
+
+    async def recv(self, timeout=3.0):
+        while True:
+            frames, self.state = self.codec.parse(
+                self.state,
+                await asyncio.wait_for(self.r.read(4096), timeout),
+            )
+            if frames:
+                return frames[0]
+
+    def close(self):
+        self.w.close()
+
+
+def test_gbt_login_realtime_downlink():
+    async def t():
+        cfg = BrokerConfig()
+        cfg.listeners = [ListenerConfig(port=0)]
+        cfg.gateways = [
+            {"type": "gbt32960", "bind": "127.0.0.1", "port": 0}
+        ]
+        srv = BrokerServer(cfg)
+        await srv.start()
+        gw = srv.broker.gateways.get("gbt32960")
+
+        app = TestClient(srv.listeners[0].port, "ev-app")
+        await app.connect()
+        await app.subscribe("gbt32960/+/up", qos=1)
+
+        ev = await EvTerminal(gw.port).connect()
+
+        # data before login is refused
+        ev.send(CMD_HEARTBEAT)
+        ack = await ev.recv()
+        assert ack.ack == 0x02
+
+        # login: time + serial + iccid
+        login = (bytes.fromhex("260731090000")
+                 + struct.pack(">H", 3)
+                 + b"89860000000000000001")
+        ev.send(CMD_LOGIN, login)
+        ack = await ev.recv()
+        assert ack.cmd == CMD_LOGIN and ack.ack == ACK_SUCCESS
+        up = json.loads((await app.recv_publish()).payload)
+        assert up["type"] == "login" and up["serial"] == 3
+        assert up["iccid"].startswith("8986")
+
+        # realtime frame decodes to the up topic
+        body = bytes.fromhex("260731091500") + bytes([0x01]) + \
+            struct.pack(">BBBHIHHBBBH",
+                        1, 3, 1, 420, 100, 3400, 10000, 64, 1, 14,
+                        800)
+        ev.send(CMD_REALTIME, body)
+        ack = await ev.recv()
+        assert ack.ack == ACK_SUCCESS
+        up = json.loads((await app.recv_publish()).payload)
+        assert up["type"] == "realtime"
+        assert up["infos"][0]["speed_kmh"] == 42.0
+        assert up["infos"][0]["soc_pct"] == 64
+
+        # downlink command passthrough
+        await app.publish(f"gbt32960/{VIN}/dn", json.dumps({
+            "cmd": 0x80, "body_hex": "2607310916000101",
+        }).encode(), qos=1)
+        dn = await ev.recv()
+        assert dn.cmd == 0x80 and dn.body == bytes.fromhex(
+            "2607310916000101"
+        )
+
+        ev.close()
+        await app.disconnect()
+        await srv.stop()
+
+    run(t())
