@@ -116,6 +116,9 @@ class Broker:
         self.delayed = DelayedPublish(self)
         self.rewrite = TopicRewrite(self)
         self.exclusive = ExclusiveSub()
+        from ..modules import TopicMetrics
+
+        self.topic_metrics = TopicMetrics(self)
         from ..ops_guard import (
             AlarmRegistry,
             BannedList,
@@ -985,6 +988,7 @@ class Broker:
             _, will = self._pending_wills.pop(cid)
             self.publish(will)
         self.delayed.tick(now)
+        self.topic_metrics.tick(now)
         self.alarms.tick(now)
         self.ft.tick(now)
         self.cm.expire_sessions(now)

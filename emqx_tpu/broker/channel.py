@@ -506,6 +506,10 @@ class Channel:
             props["topic_alias_maximum"] = mqtt.max_topic_alias
             props["receive_maximum"] = mqtt.max_inflight
             props["session_expiry_interval"] = int(expiry)
+            props["maximum_packet_size"] = mqtt.max_packet_size
+            # subscription ids ARE supported (SubOpts.subid), so the
+            # property is advertised only in the spec's negative form
+            # when a deployment turns them off — currently always on
 
         self.state = CONNECTED
         self.connected_at = time.time()

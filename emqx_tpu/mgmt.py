@@ -178,6 +178,11 @@ class MgmtApi:
         r.add_delete("/api/v5/clients/{clientid}", self.kick_client)
         r.add_get("/api/v5/subscriptions", self.get_subscriptions)
         r.add_get("/api/v5/topics", self.get_topics)
+        r.add_get("/api/v5/mqtt/topic_metrics", self.get_topic_metrics)
+        r.add_post("/api/v5/mqtt/topic_metrics",
+                   self.post_topic_metrics)
+        r.add_delete("/api/v5/mqtt/topic_metrics/{topic}",
+                     self.delete_topic_metrics)
         r.add_get("/api/v5/stats", self.get_stats)
         r.add_get("/api/v5/metrics", self.get_metrics)
         r.add_get("/api/v5/nodes", self.get_nodes)
@@ -428,6 +433,33 @@ class MgmtApi:
                 "meta": {"count": len(topics)},
             }
         )
+
+    async def get_topic_metrics(self, request: web.Request):
+        return _json({"data": self.broker.topic_metrics.info()})
+
+    async def post_topic_metrics(self, request: web.Request):
+        body = await request.json()
+        topic = str(body.get("topic", ""))
+        try:
+            created = self.broker.topic_metrics.register(topic)
+        except ValueError as exc:
+            return _json({"code": "BAD_REQUEST",
+                          "message": str(exc)}, status=400)
+        if not created:
+            return _json({"code": "ALREADY_EXISTS",
+                          "message": "topic already registered"},
+                         status=409)
+        return _json({"topic": topic}, status=201)
+
+    async def delete_topic_metrics(self, request: web.Request):
+        from urllib.parse import unquote
+
+        topic = unquote(request.match_info["topic"])
+        if not self.broker.topic_metrics.unregister(topic):
+            return _json({"code": "NOT_FOUND",
+                          "message": "topic not registered"},
+                         status=404)
+        return web.Response(status=204)
 
     # ------------------------------------------------------ stats/meta
 

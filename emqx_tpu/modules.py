@@ -156,3 +156,89 @@ class ExclusiveSub:
             r for r, c in self._holders.items() if c == clientid
         ]:
             del self._holders[real]
+
+
+class TopicMetrics:
+    """Per-topic counters (the emqx_modules topic-metrics feature):
+    an operator registers a FILTER (wildcards allowed, up to ``cap``)
+    and every matching publish/delivery increments its counters, with
+    a rolling messages-in rate.  Registration rides the broker's
+    message.publish hook; delivery counts come from the dispatch path
+    calling `on_delivered`."""
+
+    CAP = 512
+
+    def __init__(self, broker) -> None:
+        from . import topic as T
+
+        self._T = T
+        self.broker = broker
+        self._metrics: Dict[str, Dict[str, float]] = {}
+        broker.hooks.add("message.publish", self._on_publish,
+                         priority=5)
+        broker.hooks.add("message.delivered", self._on_delivered,
+                         priority=5)
+
+    def register(self, flt: str) -> bool:
+        self._T.validate_filter(flt)
+        if flt in self._metrics:
+            return False
+        if len(self._metrics) >= self.CAP:
+            raise ValueError(f"topic-metrics cap {self.CAP} reached")
+        self._metrics[flt] = {
+            "messages.in": 0, "messages.out": 0, "messages.qos0.in": 0,
+            "messages.qos1.in": 0, "messages.qos2.in": 0,
+            "messages.dropped": 0, "created_at": time.time(),
+            "_rate_last_n": 0.0, "_rate_last_t": time.time(),
+            "rate.in": 0.0,
+        }
+        return True
+
+    def unregister(self, flt: str) -> bool:
+        return self._metrics.pop(flt, None) is not None
+
+    def _matching(self, topic: str):
+        tw = self._T.words(topic)
+        for flt, m in self._metrics.items():
+            if self._T.match_words(tw, self._T.words(flt)):
+                yield m
+
+    def _on_publish(self, msg: Message):
+        if not self._metrics or msg.sys:
+            return None
+        for m in self._matching(msg.topic):
+            m["messages.in"] += 1
+            m[f"messages.qos{msg.qos}.in"] += 1
+        return None
+
+    def _on_delivered(self, clientid, deliveries):
+        if not self._metrics:
+            return None
+        for entry in deliveries:
+            msg = entry[0] if isinstance(entry, tuple) else entry
+            topic = getattr(msg, "topic", None)
+            if topic is None:
+                continue
+            for m in self._matching(topic):
+                m["messages.out"] += 1
+        return None
+
+    def tick(self, now: Optional[float] = None) -> None:
+        """Refresh the rolling messages-in rates (1 Hz housekeeping)."""
+        now = time.time() if now is None else now
+        for m in self._metrics.values():
+            dt = now - m["_rate_last_t"]
+            if dt > 0:
+                m["rate.in"] = (
+                    (m["messages.in"] - m["_rate_last_n"]) / dt
+                )
+                m["_rate_last_n"] = m["messages.in"]
+                m["_rate_last_t"] = now
+
+    def info(self) -> List[Dict]:
+        return [
+            {"topic": flt,
+             **{k: v for k, v in m.items()
+                if not k.startswith("_")}}
+            for flt, m in self._metrics.items()
+        ]

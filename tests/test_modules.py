@@ -152,3 +152,49 @@ def test_auto_subscribe():
         await srv.stop()
 
     run(t())
+
+
+def test_topic_metrics_counters_and_rate():
+    """emqx_modules topic-metrics: registered filters count matching
+    publishes per qos and deliveries; rates refresh on tick; the cap
+    and double-registration guard hold."""
+    import time as _time
+
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.config import BrokerConfig
+    from emqx_tpu.message import Message
+    from tests_fakes import FakeChannel
+
+    broker = Broker(BrokerConfig())
+    tm = broker.topic_metrics
+    assert tm.register("metrics/+/t")
+    assert not tm.register("metrics/+/t")  # duplicate
+
+    ch = FakeChannel()
+    broker.cm.open_session(True, "watcher", ch)
+    from emqx_tpu.broker.session import SubOpts
+
+    broker.subscribe("watcher", "metrics/#", SubOpts(qos=0))
+
+    broker.publish(Message(topic="metrics/a/t", payload=b"1", qos=1))
+    broker.publish(Message(topic="metrics/a/t", payload=b"2", qos=0))
+    broker.publish(Message(topic="other/x", payload=b"3", qos=0))
+
+    (entry,) = tm.info()
+    assert entry["topic"] == "metrics/+/t"
+    assert entry["messages.in"] == 2
+    assert entry["messages.qos1.in"] == 1
+    assert entry["messages.out"] == 2  # delivered to the watcher
+
+    tm.tick(_time.time() + 2.0)
+    (entry,) = tm.info()
+    assert entry["rate.in"] > 0
+
+    assert tm.unregister("metrics/+/t")
+    assert not tm.unregister("metrics/+/t")
+
+    # invalid filters are rejected at registration
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        tm.register("bad/#/middle")
