@@ -786,6 +786,25 @@ def main():
         except Exception as exc:
             log(f"sharded bench failed: {exc}")
 
+    if os.environ.get("BENCH_DS", "1") != "0":
+        # DS layout: LTS learned-structure replay vs flat hash shards
+        import subprocess
+
+        log("ds layout bench (lts vs hash subprocess)...")
+        try:
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "tools", "bench_ds.py")],
+                capture_output=True, text=True, timeout=420,
+                env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            )
+            ds = json.loads(out.stdout.strip().splitlines()[-1])
+            sharded_stats.update(ds)
+            log(f"ds layouts: {ds}")
+        except Exception as exc:
+            log(f"ds bench failed: {exc}")
+
     if os.environ.get("BENCH_CLUSTER_SHARDED", "1") != "0":
         # cluster-sharded route index: 2 OS-process nodes, the filter
         # set partitioned by rendezvous hash (~1/N each), scatter-

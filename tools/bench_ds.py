@@ -1,0 +1,81 @@
+"""DS layout benchmark: LTS (learned-topic-structure) vs flat hash —
+the property that justifies the layout (emqx_ds_lts role): wildcard
+replay over a many-topic log must scan only the overlapping
+structures, and a concrete-topic replay ~one sub-stream.  Prints ONE
+JSON line with ds_* keys."""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    from emqx_tpu.ds.builtin_local import LocalStorage
+    from emqx_tpu.ds.lts import LtsStorage
+    from emqx_tpu.message import Message
+
+    n_per_family = int(os.environ.get("BENCH_DS_PER_FAMILY", "40000"))
+    fams = ["veh/%d/t", "grid/%d/load", "app/%d/evt"]
+    t0 = 1_700_000_000.0
+
+    def fill(store):
+        t_fill = time.perf_counter()
+        for f_i, fam in enumerate(fams):
+            batch = [
+                Message(topic=fam % i, payload=b"x" * 32,
+                        timestamp=t0 + f_i * n_per_family + i)
+                for i in range(n_per_family)
+            ]
+            store.store_batch(batch)
+        return time.perf_counter() - t_fill
+
+    def replay(store, flt, page=512):
+        n = 0
+        t_r = time.perf_counter()
+        for stream in store.get_streams(flt):
+            it = store.make_iterator(stream, flt, 0)
+            while True:
+                it, msgs = store.next(it, page)
+                if not msgs:
+                    break
+                n += len(msgs)
+        return n, time.perf_counter() - t_r
+
+    out = {}
+    total = n_per_family * len(fams)
+    for name, cls in (("lts", LtsStorage), ("hash", LocalStorage)):
+        d = tempfile.mkdtemp(prefix=f"benchds-{name}-")
+        try:
+            store = cls(d)
+            out[f"ds_{name}_fill_s"] = round(fill(store), 3)
+            # one structure's wildcard: must NOT pay for the other two
+            n, dt = replay(store, "veh/+/t")
+            assert n == n_per_family, (name, n)
+            out[f"ds_{name}_wildcard_replay_s"] = round(dt, 3)
+            out[f"ds_{name}_wildcard_msgs_per_s"] = round(n / dt, 1)
+            # concrete topic: point replay
+            n, dt = replay(store, "veh/123/t")
+            assert n == 1, (name, n)
+            out[f"ds_{name}_point_replay_ms"] = round(dt * 1e3, 2)
+            store.close()
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+    out["ds_records"] = total
+    out["ds_lts_vs_hash_wildcard_speedup"] = round(
+        out["ds_hash_wildcard_replay_s"]
+        / out["ds_lts_wildcard_replay_s"], 2
+    )
+    out["ds_lts_vs_hash_point_speedup"] = round(
+        out["ds_hash_point_replay_ms"]
+        / max(out["ds_lts_point_replay_ms"], 1e-3), 2
+    )
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
