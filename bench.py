@@ -767,84 +767,62 @@ def main():
         filters[: min(n_subs, 1_000_000)], n_insert, log
     )
 
+    def sub_bench(label: str, script: str, timeout: float,
+                  env=None) -> dict:
+        """One tool-subprocess bench phase: runs `tools/<script>`,
+        parses its one-line JSON, logs the child's stderr tail when it
+        fails (a swallowed traceback made every child failure read as
+        'list index out of range')."""
+        import subprocess
+
+        log(f"{label} (subprocess {script})...")
+        try:
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "tools", script)],
+                capture_output=True, text=True, timeout=timeout,
+                env=env,
+            )
+            if out.returncode != 0 or not out.stdout.strip():
+                log(f"{label} failed rc={out.returncode}: "
+                    f"{out.stderr[-2000:]}")
+                return {}
+            stats = json.loads(out.stdout.strip().splitlines()[-1])
+            log(f"{label}: {stats}")
+            return stats
+        except Exception as exc:
+            log(f"{label} failed: {exc}")
+            return {}
+
     sharded_stats = {}
     if os.environ.get("BENCH_SHARDED", "1") != "0":
         # the sharded engine runs on the driver's virtual 8-device CPU
         # mesh in a SUBPROCESS (this process must keep seeing the TPU)
-        import subprocess
-
-        log("sharded mesh bench (8-way CPU subprocess)...")
-        try:
-            out = subprocess.run(
-                [sys.executable,
-                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                              "tools", "bench_sharded.py")],
-                capture_output=True, text=True, timeout=420,
-            )
-            sharded_stats = json.loads(out.stdout.strip().splitlines()[-1])
-            log(f"sharded: {sharded_stats}")
-        except Exception as exc:
-            log(f"sharded bench failed: {exc}")
-
+        sharded_stats.update(sub_bench(
+            "sharded mesh bench", "bench_sharded.py", 420
+        ))
     if os.environ.get("BENCH_DS", "1") != "0":
         # DS layout: LTS learned-structure replay vs flat hash shards
-        import subprocess
-
-        log("ds layout bench (lts vs hash subprocess)...")
-        try:
-            out = subprocess.run(
-                [sys.executable,
-                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                              "tools", "bench_ds.py")],
-                capture_output=True, text=True, timeout=420,
-                env=dict(os.environ, JAX_PLATFORMS="cpu"),
-            )
-            ds = json.loads(out.stdout.strip().splitlines()[-1])
-            sharded_stats.update(ds)
-            log(f"ds layouts: {ds}")
-        except Exception as exc:
-            log(f"ds bench failed: {exc}")
-
+        sharded_stats.update(sub_bench(
+            "ds layout bench", "bench_ds.py", 420,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        ))
     if os.environ.get("BENCH_CLUSTER_SHARDED", "1") != "0":
         # cluster-sharded route index: 2 OS-process nodes, the filter
         # set partitioned by rendezvous hash (~1/N each), scatter-
         # gather matching checked against the full-knowledge oracle
-        import subprocess
-
-        log("cluster-sharded bench (2-process subprocess)...")
-        try:
-            out = subprocess.run(
-                [sys.executable,
-                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                              "tools", "bench_cluster_sharded.py")],
-                capture_output=True, text=True, timeout=600,
-                env=dict(os.environ, BENCH_SHARD_FILTERS=os.environ.get(
-                    "BENCH_SHARD_FILTERS", "1000000")),
-            )
-            cs = json.loads(out.stdout.strip().splitlines()[-1])
-            sharded_stats.update(cs)
-            log(f"cluster-sharded: {cs}")
-        except Exception as exc:
-            log(f"cluster-sharded bench failed: {exc}")
-
+        sharded_stats.update(sub_bench(
+            "cluster-sharded bench", "bench_cluster_sharded.py", 600,
+            env=dict(os.environ, BENCH_SHARD_FILTERS=os.environ.get(
+                "BENCH_SHARD_FILTERS", "1000000")),
+        ))
     if os.environ.get("BENCH_MC", "1") != "0":
         # multi-core broker: worker processes + loadgen processes (the
         # whole phase lives outside this TPU-holding process)
-        import subprocess
-
-        log("multicore broker bench (worker pool subprocess)...")
-        try:
-            out = subprocess.run(
-                [sys.executable,
-                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                              "tools", "bench_multicore.py")],
-                capture_output=True, text=True, timeout=540,
-            )
-            mc = json.loads(out.stdout.strip().splitlines()[-1])
-            sharded_stats.update(mc)
-            log(f"multicore: {mc}")
-        except Exception as exc:
-            log(f"multicore bench failed: {exc}")
+        sharded_stats.update(sub_bench(
+            "multicore broker bench", "bench_multicore.py", 540
+        ))
 
     broker_stats = {}
     if os.environ.get("BENCH_BROKER", "1") != "0":
