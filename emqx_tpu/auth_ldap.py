@@ -110,7 +110,10 @@ class LdapAuthenticator(Authenticator):
         return IGNORE, {}  # async-only provider
 
     async def authenticate_async(self, client: ClientInfo):
-        if not client.username:
+        if not client.username or not client.password:
+            # an empty password would be an RFC 4513 UNAUTHENTICATED
+            # bind — many directories answer it resultCode 0, which
+            # would turn "no credential" into ALLOW
             return IGNORE, {}
         dn = self.bind_dn.replace("${username}", client.username)
         self._msg_id += 1

@@ -46,6 +46,9 @@ class _QuicChannelBridge:
         self.listener = listener
         self.conn = conn
         self.addr = addr
+        now = asyncio.get_event_loop().time()
+        self.created = now
+        self.last_rx = now
         self.parser = C.StreamParser(
             max_packet_size=listener.broker.config.mqtt.max_packet_size
         )
@@ -162,6 +165,7 @@ class QuicListener:
         bridge = self._demux(data, addr)
         if bridge is None:
             return
+        bridge.last_rx = asyncio.get_event_loop().time()
         bridge.conn.receive_datagram(data)
         bridge.on_events()
         self.transmit(bridge)
@@ -200,13 +204,30 @@ class QuicListener:
         ]:
             del self._by_cid[cid]
 
+    # a handshake not done within this window is abandoned (spoofed/
+    # lost Initials must not be retransmitted-to forever), and a
+    # completed connection with no datagrams for idle_timeout is
+    # evicted — the advertised max_idle_timeout, enforced
+    HANDSHAKE_DEADLINE = 10.0
+    IDLE_TIMEOUT = 30.0
+
     async def _pto_loop(self) -> None:
         while True:
             await asyncio.sleep(_PTO)
-            for bridge in list(self._by_cid.values()):
+            now = asyncio.get_event_loop().time()
+            for bridge in set(self._by_cid.values()):
                 if not bridge.conn.handshake_complete:
+                    if now - bridge.created > self.HANDSHAKE_DEADLINE:
+                        bridge.conn.close(0)
+                        self.forget(bridge)
+                        continue
                     bridge.conn.on_timeout()
                     self.transmit(bridge)
+                elif now - bridge.last_rx > self.IDLE_TIMEOUT:
+                    bridge.channel.connection_lost("idle_timeout")
+                    bridge.conn.close(0)
+                    self.transmit(bridge)
+                    self.forget(bridge)
 
 
 class QuicClientTransport:

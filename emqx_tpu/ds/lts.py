@@ -78,6 +78,12 @@ class LtsIndex:
         self._root = self._node()
         self._sids: Dict[str, int] = {}  # pattern -> structure id
         self._patterns: List[str] = []   # sid -> pattern
+        # fired whenever a NEW structure id is minted: the storage
+        # persists the pattern registry IMMEDIATELY — sids are baked
+        # into on-disk stream keys, so the sid->pattern mapping must
+        # never be reconstructed by re-learning (a rebuild after gc
+        # could assign shifted ids and silently mis-prune replay)
+        self.on_new_pattern = None
 
     @staticmethod
     def _node() -> Dict:
@@ -88,7 +94,15 @@ class LtsIndex:
         if sid is None:
             sid = self._sids[pattern] = len(self._patterns)
             self._patterns.append(pattern)
+            if self.on_new_pattern is not None:
+                self.on_new_pattern()
         return sid
+
+    def seed_patterns(self, patterns: List[str]) -> None:
+        """Adopt a persisted sid->pattern table (authoritative: ids
+        must match the ones already baked into stream keys)."""
+        self._patterns = list(patterns)
+        self._sids = {p: i for i, p in enumerate(self._patterns)}
 
     def learn(self, words: Sequence[str]) -> Tuple[int, List[str]]:
         """Insert a topic; returns (structure id, varying words)."""
@@ -198,7 +212,15 @@ class LtsStorage(DurableStorage):
         self.directory = directory
         self._log = DsLog(directory, seg_bytes=seg_bytes)
         self._index_path = os.path.join(directory, "lts_index.json")
+        # the sid->pattern registry persists SEPARATELY and
+        # immediately on every new structure: stream keys embed sids,
+        # so this mapping is append-only ground truth that must
+        # survive any crash/gc combination the trie cache does not
+        self._patterns_path = os.path.join(
+            directory, "lts_patterns.json"
+        )
         self.index = self._load_index(var_threshold)
+        self.index.on_new_pattern = self._save_patterns
 
     # ----------------------------------------------------------- write
 
@@ -246,18 +268,44 @@ class LtsStorage(DurableStorage):
 
     # ------------------------------------------------------ lifecycle
 
+    def _load_patterns(self) -> List[str]:
+        try:
+            with open(self._patterns_path) as f:
+                return list(json.load(f))
+        except (OSError, json.JSONDecodeError):
+            return []
+
+    def _save_patterns(self) -> None:
+        tmp = self._patterns_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.index._patterns, f)
+        os.replace(tmp, self._patterns_path)
+
     def _load_index(self, var_threshold: int) -> LtsIndex:
         try:
             with open(self._index_path) as f:
                 obj = json.load(f)
         except (OSError, json.JSONDecodeError):
             obj = None
+        patterns = self._load_patterns()
+        if not patterns and obj is not None:
+            # pre-registry data dir: the stale index's table is still
+            # a better sid seed than renumbering from scratch
+            patterns = list(obj["index"].get("patterns", ()))
         if obj is not None and obj.get("count") == self._record_count():
-            return LtsIndex.from_json(obj["index"])
-        # stale or absent (crash after the last save): rebuild from
-        # the log — it is the source of truth, and a wrong index
-        # would mis-place NEW writes relative to old ones
+            idx = LtsIndex.from_json(obj["index"])
+            if len(patterns) > len(idx._patterns):
+                idx.seed_patterns(patterns)  # registry ran ahead
+            return idx
+        # stale or absent (crash after the last save): re-learn the
+        # TRIE from the log, but seed sid assignments from the
+        # persisted registry first — re-learning must never renumber
+        # structures whose ids are baked into on-disk stream keys
+        # (post-gc, an early structure's records may be gone entirely
+        # and a fresh numbering would shift every later sid)
         idx = LtsIndex(var_threshold)
+        if patterns:
+            idx.seed_patterns(patterns)
         rebuilt = False
         for shard in self._log.streams():
             for _ts, _seq, payload in self._log.scan(shard, 0):
@@ -267,6 +315,7 @@ class LtsStorage(DurableStorage):
         if rebuilt or obj is not None:
             self.index = idx
             self._save_index()
+            self._save_patterns()
         return idx
 
     def _record_count(self) -> int:

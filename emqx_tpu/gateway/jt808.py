@@ -204,6 +204,15 @@ class Jt808Channel(GatewayChannel):
     def handle_frame(self, m: Jt808Message) -> None:
         if self.phone is None:
             self.phone = m.phone
+        elif m.phone != self.phone:
+            # one connection = one terminal: a frame carrying another
+            # phone would let a terminal authenticate as ITSELF while
+            # publishing telemetry under a VICTIM's uplink topic (the
+            # channel identity was pinned by the first frame)
+            self.broker.metrics.inc("gateway.jt808.phone_mismatch")
+            self._general_ack(m, result=1)
+            self.close("phone_mismatch")
+            return
         if m.msg_id == MSG_REGISTER:
             self._on_register(m)
             return

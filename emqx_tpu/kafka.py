@@ -381,6 +381,7 @@ class KafkaProducerResource:
         self.max_attempts = max_attempts
         self._clients: Dict[Tuple[str, int], KafkaClient] = {}
         self._leaders: Dict[int, Tuple[str, int]] = {}  # partition->addr
+        self._n_partitions = 0  # topic TOTAL, incl leaderless ones
         self._rr = 0
         self.stats = {"produced": 0, "partition_retries": 0,
                       "abandoned": 0}
@@ -420,6 +421,7 @@ class KafkaProducerResource:
                     for pid, leader in parts.items()
                     if leader in md["brokers"]
                 }
+                self._n_partitions = max(parts, default=-1) + 1
                 return
             except Exception as exc:  # try the next bootstrap broker
                 last_exc = exc
@@ -439,13 +441,19 @@ class KafkaProducerResource:
     # ---------------------------------------------------------- flush
 
     def _partition_of(self, key: Optional[bytes]) -> int:
-        pids = sorted(self._leaders)
-        if not pids:
-            raise ConnectionError("no partition leaders known")
+        """Kafka's DefaultPartitioner mapping over the topic's TOTAL
+        partition count — keyed records land exactly where a Java/
+        librdkafka producer puts them (toPositive mask included), so
+        co-partitioned consumers keep their ordering guarantee.  A
+        currently-leaderless target partition parks the records on
+        the retry path instead of silently remapping them."""
+        if not self._n_partitions:
+            raise ConnectionError("no partition metadata")
         if key is None:
+            pids = sorted(self._leaders) or [0]
             self._rr += 1
             return pids[self._rr % len(pids)]
-        return pids[murmur2(key) % len(pids)]
+        return (murmur2(key) & 0x7FFFFFFF) % self._n_partitions
 
     @staticmethod
     def _to_record(query: Any) -> Tuple[Optional[bytes], bytes]:
@@ -467,28 +475,48 @@ class KafkaProducerResource:
         attempts) and ride the next flush or health tick, so a single
         wedged partition neither stalls the others nor double-produces
         the records that already landed."""
-        work: List[Tuple[int, Any]] = self._requeue + [
-            (0, q) for q in queries
-        ]
-        self._requeue = []
-        if not work:
-            return 0
-        if not self._leaders:
-            await self._refresh_metadata()
-        per_part: Dict[int, List[Tuple[int, Any]]] = {}
-        for attempt, q in work:
-            key, value = self._to_record(q)
-            per_part.setdefault(
-                self._partition_of(key), []
-            ).append((attempt, q))
-        by_broker: Dict[Tuple[str, int], Dict[Tuple[str, int], bytes]] = {}
-        for pid, items in per_part.items():
-            leader = self._leaders[pid]
-            batch = encode_record_batch(
-                [self._to_record(q) for _, q in items]
-            )
-            by_broker.setdefault(leader, {})[(self.topic, pid)] = batch
-        failed_parts: List[int] = []
+        parked, self._requeue = self._requeue, []
+        try:
+            work: List[Tuple[int, Any]] = parked + [
+                (0, q) for q in queries
+            ]
+            if not work:
+                return 0
+            if not self._leaders:
+                await self._refresh_metadata()
+            per_part: Dict[int, List[Tuple[int, Any]]] = {}
+            for attempt, q in work:
+                try:
+                    key, _value = self._to_record(q)
+                except Exception:
+                    # one malformed query must not poison the batch —
+                    # or discard previously parked records with it
+                    self.stats["abandoned"] += 1
+                    log.warning("kafka: unencodable query dropped")
+                    continue
+                per_part.setdefault(
+                    self._partition_of(key), []
+                ).append((attempt, q))
+            by_broker: Dict[
+                Tuple[str, int], Dict[Tuple[str, int], bytes]
+            ] = {}
+            failed_parts: List[int] = []
+            for pid, items in per_part.items():
+                leader = self._leaders.get(pid)
+                if leader is None:
+                    failed_parts.append(pid)  # leaderless: park + retry
+                    continue
+                batch = encode_record_batch(
+                    [self._to_record(q) for _, q in items]
+                )
+                by_broker.setdefault(
+                    leader, {}
+                )[(self.topic, pid)] = batch
+        except BaseException:
+            # nothing was sent: restore the parked retry records so a
+            # metadata failure cannot silently drop them
+            self._requeue = parked + self._requeue
+            raise
         for addr, tps in by_broker.items():
             try:
                 errs = await self._client(addr).produce(

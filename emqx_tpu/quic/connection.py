@@ -183,6 +183,11 @@ class QuicConnection:
         self._pn: Dict[int, int] = {0: 0, 2: 0, 3: 0}
         self._largest_recv: Dict[int, int] = {0: -1, 2: -1, 3: -1}
         self._recv_pns: Dict[int, set] = {0: set(), 2: set(), 3: set()}
+        # dedup/ACK window floor: pns below it are treated as already
+        # received and pruned from the set, bounding both the set and
+        # the ACK frame on long-lived connections
+        self._pn_floor: Dict[int, int] = {0: 0, 2: 0, 3: 0}
+        self._PN_WINDOW = 2048
         self._ack_due: Dict[int, bool] = {0: False, 2: False, 3: False}
         # crypto send state per epoch: buffer + contiguous acked/sent
         self._crypto_out: Dict[int, bytes] = {0: b"", 2: b"", 3: b""}
@@ -344,10 +349,16 @@ class QuicConnection:
             pt = recv.aead.decrypt(recv.nonce(pn), ct, header)
         except Exception:
             return 0
-        if pn in self._recv_pns[epoch]:
+        if pn < self._pn_floor[epoch] or pn in self._recv_pns[epoch]:
             return pn_off + pn_len + payload_len - pkt_start
         self._recv_pns[epoch].add(pn)
         self._largest_recv[epoch] = max(self._largest_recv[epoch], pn)
+        floor = self._largest_recv[epoch] - self._PN_WINDOW
+        if floor > self._pn_floor[epoch]:
+            self._pn_floor[epoch] = floor
+            self._recv_pns[epoch] = {
+                p for p in self._recv_pns[epoch] if p >= floor
+            }
         self._process_frames(epoch, pt)
         return pn_off + pn_len + payload_len - pkt_start
 

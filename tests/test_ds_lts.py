@@ -165,3 +165,51 @@ def test_wildcard_replay_is_sublinear(tmp_path):
     assert len(out) == 1
     assert dt < 1.0  # decodes dozens of records, not 120k
     lts.close()
+
+
+def test_lts_sids_stable_across_gc_and_rebuild(tmp_path):
+    """Review r5: stream keys bake structure ids in, so a crash-forced
+    index rebuild AFTER gc reclaimed an early structure's records must
+    not renumber the survivors — the persisted pattern registry is the
+    sid ground truth, and replay must keep finding the surviving
+    structures' records."""
+    import os
+    import time as _time
+
+    d = str(tmp_path / "ds")
+    store = LtsStorage(d, var_threshold=4, seg_bytes=512)
+    t_old = 1_700_000_000.0
+    t_new = 1_700_900_000.0
+    # structure 0: old records only (will be GC'd wholesale)
+    store.store_batch([
+        Message(topic=f"old/x{i}/t", payload=b"o",
+                timestamp=t_old + i)
+        for i in range(20)
+    ])
+    # structure(s) for the survivors, written much later
+    store.store_batch([
+        Message(topic=f"new/y{i}/t", payload=b"n",
+                timestamp=t_new + i)
+        for i in range(20)
+    ])
+    store.sync()
+    # reclaim everything older than the cutoff: structure "old/+/t"
+    # loses ALL its records
+    store.gc(int((t_old + 1000) * 1e6))
+    # crash window: the log moved but the index count was not re-saved
+    store._log.sync()
+    store._log.close()
+    idx_path = os.path.join(d, "lts_index.json")
+    if os.path.exists(idx_path):
+        os.remove(idx_path)  # worst case: trie cache gone entirely
+
+    store2 = LtsStorage(d, var_threshold=4, seg_bytes=512)
+    got = drain(store2, "new/+/t")
+    assert len(got) == 20, len(got)  # survivors still replay
+    # and new writes to the surviving structure join the same streams
+    store2.store_batch([Message(
+        topic="new/y3/t", payload=b"post", timestamp=t_new + 500,
+    )])
+    got2 = drain(store2, "new/y3/t")
+    assert len(got2) == 2
+    store2.close()

@@ -181,3 +181,35 @@ def test_jt808_register_auth_location_downlink():
         await srv.stop()
 
     run(t())
+
+
+def test_jt808_phone_mismatch_closes_connection():
+    """One connection = one terminal: a frame carrying a different
+    phone than the channel's pinned identity is refused and the
+    connection closed (uplink-topic spoofing guard)."""
+
+    async def t():
+        cfg = BrokerConfig()
+        cfg.listeners = [ListenerConfig(port=0)]
+        cfg.gateways = [
+            {"type": "jt808", "bind": "127.0.0.1", "port": 0}
+        ]
+        srv = BrokerServer(cfg)
+        await srv.start()
+        gw = srv.broker.gateways.get("jt808")
+
+        term = await Terminal(gw.port, "013800002222").connect()
+        term.send(MSG_REGISTER, b"\x00\x01\x00\x01" + b"M" * 12)
+        await term.recv()  # register ack pins the phone
+        # now claim a DIFFERENT phone on the same connection
+        term.phone = "013800009999"
+        term.send(MSG_HEARTBEAT)
+        ack = await term.recv()
+        assert ack.msg_id == MSG_GENERAL_ACK and ack.body[-1] == 1
+        # connection is torn down
+        data = await asyncio.wait_for(term.r.read(64), 3)
+        while data:
+            data = await asyncio.wait_for(term.r.read(64), 3)
+        await srv.stop()
+
+    run(t())
