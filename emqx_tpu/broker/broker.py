@@ -1092,7 +1092,12 @@ class PublishBatcher:
         # a 110 ms link), and anything beyond that is pure queueing
         # delay in front of every message — the loaded-probe p99.
         self._inflight_count = 0
-        self.inflight_max = max(batch_max // 4, 256)
+        # cap = 4 windows of limit-size each: window collection uses
+        # inflight_max // 4, so the pipeline keeps real depth (hiding
+        # the device RTT) while total in-flight stays bounded — an
+        # inflight_max equal to the window size would serialize the
+        # round-trips at depth 1
+        self.inflight_max = max(batch_max // 2, 512)
         self._inflight_drain = asyncio.Event()
         # a source's read loop pauses above ITS lane's high watermark,
         # or — when the TOTAL crosses the global bound — above its
@@ -1237,7 +1242,9 @@ class PublishBatcher:
                 while self._inflight_count >= self.inflight_max:
                     self._inflight_drain.clear()
                     await self._inflight_drain.wait()
-                limit = min(self.batch_max, self.inflight_max)
+                limit = min(
+                    self.batch_max, max(self.inflight_max // 4, 256)
+                )
                 batch = [self._rr_pop()]
                 # adaptive window: with nothing else queued and the
                 # pipeline idle, flush IMMEDIATELY — a lone publish on
@@ -1287,6 +1294,7 @@ class PublishBatcher:
                     )
                 except Exception as exc:
                     self._inflight_count -= len(batch)
+                    self._inflight_drain.set()
                     for _, fut in batch:
                         if fut is not None and not fut.done():
                             fut.set_exception(exc)
@@ -1294,6 +1302,9 @@ class PublishBatcher:
                         "publish window of %d failed in prepare",
                         len(batch),
                     )
+                    # failure paths must still wake paused read loops:
+                    # if this was the LAST window, nothing else will
+                    self._maybe_release()
                     continue
                 # blocks when pipeline_windows are already in flight —
                 # natural backpressure onto the collector
@@ -1315,6 +1326,15 @@ class PublishBatcher:
                 for _, fut in batch:
                     if fut is not None and not fut.done():
                         fut.set_exception(exc)
+            # entries still in the per-source lanes were never
+            # collected: their futures must not hang past shutdown
+            for q in self._queues.values():
+                for _msg, fut in q:
+                    if fut is not None and not fut.done():
+                        fut.set_exception(exc)
+            self._queues.clear()
+            self._rr.clear()
+            self._total = 0
             self._inflight_q = None
             self._inflight_count = 0
 
@@ -1366,6 +1386,13 @@ class PublishBatcher:
                 for _, fut in batch:
                     if fut is not None and not fut.done():
                         fut.set_exception(exc)
+                try:
+                    # failure must still wake paused read loops: a
+                    # failed FINAL window would otherwise leave them
+                    # in wait_uncongested() forever
+                    self._maybe_release()
+                except Exception:
+                    log.exception("congestion release failed")
                 continue
             # the tail is protected too: an exception here (e.g. the
             # alarm deactivation re-entering publish) must not kill

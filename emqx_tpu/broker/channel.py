@@ -110,7 +110,16 @@ class Channel:
         self._closing = False
         self._pending_connect = None  # in-flight async-connect task
         self._connect_backlog: List[C.Packet] = []  # pipelined pre-CONNACK
-        self._defer_tail = None  # ordered async-verdict continuation
+        # ordered async-verdict continuation chain: tail task, ALL
+        # live tasks (shutdown cancels every one, not just the tail),
+        # depth for backpressure (the chain is upstream of the batcher
+        # lanes, so the connection's read loop must pause on IT too)
+        self._defer_tail = None
+        self._defer_tasks: set = set()
+        self._defer_depth = 0
+        self._defer_drained: Optional[asyncio.Event] = None
+        self.DEFER_HIGH = 256
+        self.DEFER_LOW = 64
 
     # ---------------------------------------------------------- util
 
@@ -147,10 +156,30 @@ class Channel:
     def _shutdown(self, reason: str) -> None:
         self._closing = True
         self.state = DISCONNECTED
-        if self._defer_tail is not None:
-            self._defer_tail.cancel()
-            self._defer_tail = None
+        # cancel the WHOLE deferred chain: cancelling only the tail
+        # would leave every predecessor running verdict RPCs and
+        # touching channel state long after the socket died
+        for t in list(self._defer_tasks):
+            t.cancel()
+        self._defer_tasks.clear()
+        self._defer_tail = None
         self._close(reason)
+
+    @property
+    def defer_saturated(self) -> bool:
+        return self._defer_depth >= self.DEFER_HIGH
+
+    async def wait_defer_drain(self) -> None:
+        while self._defer_depth > self.DEFER_LOW and not self._closing:
+            if self._defer_drained is None:
+                self._defer_drained = asyncio.Event()
+            self._defer_drained.clear()
+            # depth transitions happen in done-callbacks on this same
+            # loop: no await between the check and the wait, so no
+            # lost wakeup
+            if self._defer_depth <= self.DEFER_LOW:
+                return
+            await self._defer_drained.wait()
 
     def _defer(self, coro) -> None:
         """Chain an async continuation behind any previously deferred
@@ -158,6 +187,7 @@ class Channel:
         verdict wait (exhook authorize): each deferred handler runs
         only after its predecessor resolves."""
         prev = self._defer_tail
+        self._defer_depth += 1
 
         async def run() -> None:
             if prev is not None:
@@ -176,7 +206,22 @@ class Channel:
             except Exception:
                 log.exception("deferred packet handling failed")
 
-        self._defer_tail = asyncio.get_running_loop().create_task(run())
+        task = asyncio.get_running_loop().create_task(run())
+        self._defer_tasks.add(task)
+
+        def done(t, channel=self):
+            channel._defer_tasks.discard(t)
+            channel._defer_depth -= 1
+            if channel._defer_tail is t:
+                channel._defer_tail = None
+            if (
+                channel._defer_drained is not None
+                and channel._defer_depth <= channel.DEFER_LOW
+            ):
+                channel._defer_drained.set()
+
+        task.add_done_callback(done)
+        self._defer_tail = task
 
     def _mount(self, topic: str) -> str:
         return self.mountpoint + topic if self.mountpoint else topic
@@ -575,7 +620,12 @@ class Channel:
         return self._alias_in.get(alias)
 
     def _handle_publish(self, pkt: C.Publish) -> None:
-        if self.broker.access.has_async_authz_hooks:
+        # STICKY while the chain is non-empty: if the async-authorize
+        # hook unloads mid-stream, later publishes must still queue
+        # BEHIND the ones already deferred or they would overtake them
+        # (per-publisher ordering, topic-alias state)
+        if (self.broker.access.has_async_authz_hooks
+                or self._defer_depth > 0):
             # IO-backed authorize (exhook): the verdict RPC must not
             # block the loop — defer this packet's handling into the
             # channel's ordered continuation chain
@@ -750,7 +800,8 @@ class Channel:
     # ----------------------------------------------------- subscribe
 
     def _handle_subscribe(self, pkt: C.Subscribe) -> None:
-        if self.broker.access.has_async_authz_hooks:
+        if (self.broker.access.has_async_authz_hooks
+                or self._defer_depth > 0):  # sticky, as in publish
             try:
                 asyncio.get_running_loop()
             except RuntimeError:

@@ -164,6 +164,12 @@ class Connection:
                     # broker memory and queueing delay (the esockd
                     # active_n / emqx_olp role)
                     await batcher.wait_uncongested(self.channel)
+                if self.channel.defer_saturated:
+                    # the async-verdict chain sits UPSTREAM of the
+                    # batcher lanes: without its own pause a flooder
+                    # could grow the chain without ever registering as
+                    # lane congestion
+                    await self.channel.wait_defer_drain()
         except C.MqttError as exc:
             log.debug("codec error from %s: %s", self.channel.peer, exc)
             reason = "frame_error"

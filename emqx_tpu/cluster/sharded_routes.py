@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from collections import deque
 from typing import Dict, List, Sequence, Set
 
@@ -58,6 +59,10 @@ class ShardedRouteIndex:
         self._origin_log: Dict[str, deque] = {}
         self.resync_due = False
         self.stats = {"scatter": 0, "flood": 0, "resync": 0}
+        # filters whose ownership moved AWAY from this node, with the
+        # time we first noticed: purged only after MOVED_GRACE
+        self._moved: Dict[str, float] = {}
+        self.MOVED_GRACE = 10.0
 
     # ------------------------------------------------------ ownership
 
@@ -204,16 +209,25 @@ class ShardedRouteIndex:
     async def resync(self) -> None:
         """Re-announce every local filter to its CURRENT owner (one
         call per alive peer, empty lists included so owners purge our
-        stale entries), and purge owned entries whose filters are no
-        longer ours."""
+        stale entries), and — after a GRACE PERIOD — purge owned
+        entries whose filters are no longer ours.  The grace matters:
+        each node detects a membership change on its own clock, so the
+        old owner must keep answering scatter queries for a moved
+        filter until every origin has had time to re-announce to the
+        new owner; an immediate purge opened a silent message-loss
+        window (review r5).  Stale double-answers are harmless — the
+        union's receivers match locally before dispatch."""
         self.stats["resync"] += 1
-        # entries whose ownership moved away: their subscriber origins
-        # re-announce to the new owner; holding them here would answer
-        # scatter queries with stale data after the origins move on
+        now = time.monotonic()
         for flt in list(self.table._nodes_by_filter):
             if self.owner_of(flt) != self.node.name:
-                for origin in list(self.table.nodes_for(flt)):
-                    self.table.delete_route(flt, origin)
+                moved_at = self._moved.setdefault(flt, now)
+                if now - moved_at >= self.MOVED_GRACE:
+                    for origin in list(self.table.nodes_for(flt)):
+                        self.table.delete_route(flt, origin)
+                    self._moved.pop(flt, None)
+            else:
+                self._moved.pop(flt, None)
         by_owner: Dict[str, List[str]] = {}
         for flt in self.node.broker.router.topics():
             by_owner.setdefault(self.owner_of(flt), []).append(flt)

@@ -679,7 +679,8 @@ class MatchEngine:
             dev = self._device_put(aut)
         return aut, dev, fid_arr, n_live, arena
 
-    def _device_put(self, aut, chunk_bytes: int = 1 << 17):
+    def _device_put(self, aut, chunk_bytes: int = 1 << 17,
+                    throttle: bool = True):
         """Upload the automaton tables, big ones in chunks concatenated
         ON DEVICE: one monolithic transfer of a 10M-sub table (~100 MB)
         monopolizes the host->device link for seconds, queueing the
@@ -707,7 +708,8 @@ class MatchEngine:
             parts = []
             for i in range(0, len(a), rows_per):
                 parts.append(jax.device_put(a[i:i + rows_per]))
-                time.sleep(0.002)
+                if throttle:
+                    time.sleep(0.002)
             out.append(jnp.concatenate(parts, axis=0))
         return tuple(out)
 
@@ -1106,7 +1108,12 @@ class MatchEngine:
 
     def _device_tables(self):
         if self._dev is None:
-            self._dev = self._device_put(self._aut)
+            # LAZY path (upload-failed / toggled corners): runs under
+            # _mlock on a match thread — no inter-chunk throttling
+            # here, or the sleeps would hold the lock and stall every
+            # SUBSCRIBE/match for seconds; the background fold/build
+            # uploads keep the throttled default
+            self._dev = self._device_put(self._aut, throttle=False)
         return self._dev
 
     # -------------------------------------------------------------- match
@@ -1175,23 +1182,27 @@ class MatchEngine:
             # latency mode: the window resolves when the caller gets
             # the result back — compare wall times
             use_dev = n * host_us * 1e-6 > self._dev_window_s
-        if not use_dev and congested and host_us > 15.0:
-            # refresh the device numbers ONLY when there is a live case
-            # for switching (sustained congestion + a host trie that is
-            # measurably expensive): an unconditional background probe
-            # measurably taxed a saturated single-core broker (~2x
-            # throughput in the r5 flood bench) for information it had
-            # no use for
-            self._maybe_probe()
+        if not use_dev:
+            # refresh the device numbers out-of-band: aggressively
+            # (30 s) when there is a live case for switching
+            # (congestion + an expensive host trie), lazily (120 s)
+            # otherwise — without the lazy tick a transient device
+            # slowdown would pin the policy to host FOREVER, because
+            # host windows never re-measure the device
+            self._maybe_probe(
+                urgent=congested and host_us > 15.0
+            )
         return use_dev
 
-    def _maybe_probe(self) -> None:
-        """Refresh the device EWMAs off-band at most every 30 s, on a
+    def _maybe_probe(self, urgent: bool = False) -> None:
+        """Refresh the device EWMAs off-band (30 s cadence when a
+        switch is plausible, 120 s maintenance otherwise), on a
         one-shot daemon thread, over recent real topics."""
         now = time.monotonic()
+        interval = 30.0 if urgent else 120.0
         if (
             self._probe_running
-            or now - self._probe_last < 30.0
+            or now - self._probe_last < interval
             or not self._probe_topics
         ):
             return
@@ -1325,6 +1336,7 @@ class MatchEngine:
         if pending[0] == "host":
             return pending[1]
         _, snap, pend_base, dpend, topics, words, t0, cpu0 = pending
+        t1w = time.perf_counter()
         c1 = time.thread_time()
         rows, gpos, ovf = self._flat_result(pend_base)
         dflat = self._flat_finish(dpend) if dpend is not None else None
@@ -1335,7 +1347,14 @@ class MatchEngine:
             cpu_us = (
                 (cpu0 + time.thread_time() - c1) / len(words) * 1e6
             )
-            wall = time.perf_counter() - t0
+            # wall = finish-phase wall only: under pipelined load a
+            # window queues behind its predecessors' dispatch between
+            # submit and finish, and charging that queueing to the
+            # DEVICE would let the policy disable the device path with
+            # its own backlog rather than its cost.  Quiet windows
+            # finish immediately after submit, so their measurement
+            # still captures the true solo round-trip.
+            wall = time.perf_counter() - t1w
             self._dev_cpu_us = (
                 cpu_us if self._dev_cpu_us is None
                 else 0.8 * self._dev_cpu_us + 0.2 * cpu_us

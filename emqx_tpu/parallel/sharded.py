@@ -352,7 +352,7 @@ class ShardedMatchEngine(MatchEngine):
         # kernel; its compile is warmed by the first sharded call
         return
 
-    def _device_put(self, index: ShardedIndex):
+    def _device_put(self, index: ShardedIndex, throttle: bool = True):
         return tuple(
             jax.device_put(t, NamedSharding(self.mesh, P("sub")))
             for t in index.tables

@@ -340,7 +340,9 @@ def test_async_verdicts_keep_loop_live(provider):
         assert out.payload == b"x!ext"  # provider mutation folded
         assert allowed and not denied
         # 3 sequential 0.3s RPCs; a blocked loop would leave ticks ~0
-        assert ticks >= 30
+        # (threshold is deliberately loose: a contended CI box ticks
+        # far below the theoretical ~90)
+        assert ticks >= 15
     finally:
         stub.delay = 0.0
         client.stop()
