@@ -444,7 +444,11 @@ class MatchEngine:
         # probe window delays the ordered dispatch of everything
         # behind it by a full device round-trip)
         self._probe_topics: List[str] = []
-        self._probe_last = 0.0
+        # first refresh waits a full interval: warmup() seeds the
+        # estimates at boot, and an immediate probe lands exactly in
+        # the first traffic burst (measured: one background probe ate
+        # ~40% of a 1.5s flood on a single-core host)
+        self._probe_last = time.monotonic()
         self._probe_running = False
         # compact-transfer capacity multiplier (x unique topics in the
         # window); doubles whenever the buffer clips, never shrinks
@@ -1172,12 +1176,24 @@ class MatchEngine:
             # at boot, and the probe below fires if host degrades
             use_dev = False
         elif congested:
-            # throughput mode: wall time is hidden by pipelining;
-            # compare host-side CPU per topic
+            # throughput mode: pipelining hides most of a device
+            # window's wall, but the window still occupies an ordered-
+            # dispatch slot for ~RTT/depth — a stall every HOST window
+            # queued behind it pays too.  Effective per-topic device
+            # cost = host-side CPU + that amortized slot: over a
+            # high-RTT link small windows stay host (49µs/topic of
+            # slot cost at n=512/RTT=100ms dwarfs the trie), while
+            # co-located the slot term vanishes and big windows
+            # offload (0.7µs at RTT=1.5ms).  The 1.2 margin resists
+            # path flapping, whose head-of-line mixing cost neither
+            # estimate sees.
             dev_cpu = (
                 self._dev_cpu_us if self._dev_cpu_us is not None else 2.0
             )
-            use_dev = host_us > dev_cpu
+            slot_us = (
+                self._dev_window_s / 4.0 / max(n, 1) * 1e6
+            )
+            use_dev = host_us > (dev_cpu + slot_us) * 1.2
         else:
             # latency mode: the window resolves when the caller gets
             # the result back — compare wall times
@@ -1300,7 +1316,8 @@ class MatchEngine:
                 )
                 self._auto_stats["host_windows"] += 1
                 # keep a fresh sample for the out-of-band device probe
-                self._probe_topics = list(topics[:1024])
+                # (small: each probe's host-side cost is paid in GIL)
+                self._probe_topics = list(topics[:256])
             return ("host", out)
         t0 = time.perf_counter()
         c0 = time.thread_time()
@@ -1347,22 +1364,25 @@ class MatchEngine:
             cpu_us = (
                 (cpu0 + time.thread_time() - c1) / len(words) * 1e6
             )
-            # wall = finish-phase wall only: under pipelined load a
-            # window queues behind its predecessors' dispatch between
-            # submit and finish, and charging that queueing to the
-            # DEVICE would let the policy disable the device path with
-            # its own backlog rather than its cost.  Quiet windows
-            # finish immediately after submit, so their measurement
-            # still captures the true solo round-trip.
-            wall = time.perf_counter() - t1w
             self._dev_cpu_us = (
                 cpu_us if self._dev_cpu_us is None
                 else 0.8 * self._dev_cpu_us + 0.2 * cpu_us
             )
-            self._dev_window_s = (
-                wall if self._dev_window_s is None
-                else 0.8 * self._dev_window_s + 0.2 * wall
-            )
+            # the wall EWMA feeds LATENCY-mode decisions, so it must
+            # estimate a SOLO window's round trip.  Only unqueued
+            # windows (finish started right after submit) qualify:
+            # a pipelined window's submit→finish wall includes time
+            # queued behind predecessors (charging that to the device
+            # disabled it with its own backlog — review r5), while its
+            # finish-only wall UNDER-estimates (the transfer already
+            # streamed during the queue wait) and flipped quiet
+            # windows onto the device.
+            if t1w - t0 < 0.005:
+                wall = time.perf_counter() - t0
+                self._dev_window_s = (
+                    wall if self._dev_window_s is None
+                    else 0.8 * self._dev_window_s + 0.2 * wall
+                )
             self._auto_stats["dev_windows"] += 1
         return out
 
