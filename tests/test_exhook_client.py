@@ -385,7 +385,9 @@ def test_batcher_prepare_uses_async_hook_path(provider):
         ticks, n = asyncio.run(main())
         assert n == 1
         assert [p.payload for p in ch.sent] == [b"e!ext"]
-        assert ticks >= 10
+        # a blocked loop ticks ~0 during the 0.2s RPC; loose threshold
+        # (contended CI boxes tick far below the theoretical ~20)
+        assert ticks >= 3
     finally:
         stub.delay = 0.0
         client.stop()
