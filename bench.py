@@ -507,14 +507,6 @@ def main():
     filters, pops = make_filters(n_subs, fanout)
     gen_s = time.perf_counter() - t0
 
-    # churn phase FIRST: it builds its own 1M-sub engine and measures
-    # live insert/match interleave — running it after the 10M-sub
-    # phases did so under gigabytes of unrelated heap (page pressure
-    # halved the measured insert rate vs the same workload isolated)
-    insert_rps, churn_p50, churn_p99 = measure_insert_rps(
-        filters[: min(n_subs, 1_000_000)], n_insert, log
-    )
-
     t0 = time.perf_counter()
     tdict = TokenDict()
     aut = build_automaton(filters, tdict, max_levels=max_levels)
@@ -770,6 +762,10 @@ def main():
 
     total_topics = batch * iters
     rate = total_topics / elapsed
+
+    insert_rps, churn_p50, churn_p99 = measure_insert_rps(
+        filters[: min(n_subs, 1_000_000)], n_insert, log
+    )
 
     def sub_bench(label: str, script: str, timeout: float,
                   env=None) -> dict:
