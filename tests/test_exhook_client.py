@@ -339,10 +339,11 @@ def test_async_verdicts_keep_loop_live(provider):
         ticks, out, allowed, denied = asyncio.run(main())
         assert out.payload == b"x!ext"  # provider mutation folded
         assert allowed and not denied
-        # 3 sequential 0.3s RPCs; a blocked loop would leave ticks ~0
-        # (threshold is deliberately loose: a contended CI box ticks
-        # far below the theoretical ~90)
-        assert ticks >= 15
+        # 3 sequential 0.3s RPCs; a BLOCKED loop yields 0-1 ticks while
+        # a live one yields dozens — the bound only separates those two
+        # regimes (contended CI boxes tick far below the theoretical
+        # ~90, so anything tighter flakes)
+        assert ticks >= 4
     finally:
         stub.delay = 0.0
         client.stop()
