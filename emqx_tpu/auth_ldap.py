@@ -26,6 +26,31 @@ log = logging.getLogger("emqx_tpu.auth_ldap")
 RES_SUCCESS = 0
 RES_INVALID_CREDENTIALS = 49
 
+# RFC 4514 §2.4: characters that must be backslash-escaped inside an
+# attribute value so a crafted username cannot rewrite the DN (e.g.
+# 'x,ou=admins,dc=example,dc=com' escaping the intended subtree)
+_DN_SPECIALS = set(',+"\\<>;=')
+
+
+def escape_dn_value(value: str) -> str:
+    """Escape one RDN attribute value per RFC 4514 before template
+    substitution: specials get a backslash, a leading '#'/space and a
+    trailing space are escaped positionally, NUL becomes ``\\00``."""
+    out = []
+    last = len(value) - 1
+    for i, ch in enumerate(value):
+        if ch == "\x00":
+            out.append("\\00")
+        elif ch in _DN_SPECIALS:
+            out.append("\\" + ch)
+        elif i == 0 and ch in "# ":
+            out.append("\\" + ch)
+        elif i == last and ch == " ":
+            out.append("\\ ")
+        else:
+            out.append(ch)
+    return "".join(out)
+
 
 # ----------------------------------------------------------------- BER
 
@@ -115,7 +140,11 @@ class LdapAuthenticator(Authenticator):
             # bind — many directories answer it resultCode 0, which
             # would turn "no credential" into ALLOW
             return IGNORE, {}
-        dn = self.bind_dn.replace("${username}", client.username)
+        # escaped substitution: the username is DATA inside the DN,
+        # never structure (authorization-scope bypass otherwise)
+        dn = self.bind_dn.replace(
+            "${username}", escape_dn_value(client.username)
+        )
         self._msg_id += 1
         try:
             r, w = await asyncio.wait_for(

@@ -134,6 +134,14 @@ class Broker:
         self.tracer = None
         self.alarms = AlarmRegistry(self)
         self.resources.alarms = self.alarms
+        # failure-driven device→host degradation: the match engine's
+        # circuit breaker reports trip/clear here, raising/clearing a
+        # $SYS alarm and bumping counters.  The callbacks fire on
+        # whichever thread ran the match (batcher executor, probe
+        # thread), so the alarm publish hops to the event loop.
+        self._loop = None  # captured by BrokerServer.start
+        self.router.engine.on_breaker_trip = self._engine_breaker_trip
+        self.router.engine.on_breaker_clear = self._engine_breaker_clear
         self.banned = BannedList()
         fl = self.config.flapping
         self.flapping = FlappingDetector(
@@ -1001,6 +1009,36 @@ class Broker:
                 self.durable.gc(
                     int((now - cfg.retention_hours * 3600.0) * 1e6)
                 )
+
+    # ---------------------------------------------- engine breaker
+
+    def _on_loop(self, fn) -> None:
+        """Run `fn` on the broker's event loop when one is live (the
+        breaker callbacks fire from executor/probe threads; a full
+        $SYS publish must not run off-loop), else inline (unit tests
+        driving the engine synchronously)."""
+        loop = self._loop
+        if loop is not None and not loop.is_closed():
+            try:
+                loop.call_soon_threadsafe(fn)
+                return
+            except RuntimeError:
+                pass
+        fn()
+
+    def _engine_breaker_trip(self, info: Dict) -> None:
+        self.metrics.inc("engine.breaker.trip")
+        self._on_loop(lambda: self.alarms.activate(
+            "engine_device_path",
+            details=info,
+            message="device match path tripped; serving host-only",
+        ))
+
+    def _engine_breaker_clear(self, info: Dict) -> None:
+        self.metrics.inc("engine.breaker.clear")
+        self._on_loop(
+            lambda: self.alarms.deactivate("engine_device_path")
+        )
 
     def shutdown(self) -> None:
         """Flush and close durable state (called by BrokerServer.stop)."""

@@ -271,6 +271,12 @@ class BrokerServer:
         self.cluster_node = None  # ClusterNode when config.cluster
 
     async def start(self) -> None:
+        from .. import failpoints
+
+        # arm any EMQX_FAILPOINTS chaos spec before traffic flows (a
+        # no-op when the env var is unset — the production default)
+        failpoints.load_env()
+        self.broker._loop = asyncio.get_running_loop()
         eng_cfg = self.broker.config.engine
         if self.broker.router.engine.use_device is not False:
             # persistent XLA cache: automaton capacity-class compiles

@@ -49,6 +49,12 @@ class _QuicChannelBridge:
         now = asyncio.get_event_loop().time()
         self.created = now
         self.last_rx = now
+        # anti-amplification accounting (RFC 9000 §8.1): until the
+        # peer's address validates, sends are capped at 3x receives
+        self.bytes_rx = 0
+        self.bytes_tx = 0
+        self.rx_datagrams = 0
+        self.hs_counted = True  # in the per-source handshake census
         self.parser = C.StreamParser(
             max_packet_size=listener.broker.config.mqtt.max_packet_size
         )
@@ -130,6 +136,9 @@ class QuicListener:
         self._by_cid: Dict[bytes, _QuicChannelBridge] = {}
         self._transport = None
         self._pto_task: Optional[asyncio.Task] = None
+        # handshake-phase connections per source IP: spoofed Initials
+        # must not mint unbounded half-open conn+Channel state
+        self._hs_per_src: Dict[str, int] = {}
 
     async def start(self) -> None:
         loop = asyncio.get_running_loop()
@@ -166,9 +175,25 @@ class QuicListener:
         if bridge is None:
             return
         bridge.last_rx = asyncio.get_event_loop().time()
+        bridge.bytes_rx += len(data)
+        bridge.rx_datagrams += 1
         bridge.conn.receive_datagram(data)
+        if bridge.hs_counted and bridge.conn.handshake_complete:
+            self._hs_uncount(bridge)
         bridge.on_events()
         self.transmit(bridge)
+        if (
+            not bridge.conn.address_validated
+            and not bridge.conn.handshake_complete
+            and bridge.rx_datagrams > 1
+        ):
+            # the client is still sending Initials: our flight was
+            # lost or clipped by the amplification cap.  Re-arm it
+            # NOW, driven by received bytes (each datagram grows the
+            # 3x budget) — never by the timer, which a spoofed source
+            # could turn into a reflector.
+            bridge.conn.on_timeout()
+            self.transmit(bridge)
 
     def _demux(self, data: bytes,
                addr) -> Optional[_QuicChannelBridge]:
@@ -182,34 +207,65 @@ class QuicListener:
             return bridge
         if not (data[0] & 0x80):
             return None  # short packet for an unknown connection
+        if len(data) < 1200:
+            return None  # a client Initial flight must fill 1200 bytes
+        src = addr[0]
+        if self._hs_per_src.get(src, 0) >= self.MAX_HANDSHAKES_PER_SOURCE:
+            log.debug("quic: handshake flood from %s; Initial ignored",
+                      src)
+            return None
         conn = QuicConnection(
             True, cert_der=self.cert_der, key=self.key
         )
         bridge = _QuicChannelBridge(self, conn, addr)
+        self._hs_per_src[src] = self._hs_per_src.get(src, 0) + 1
         # reachable by the client's original dcid (retransmitted
         # initials) AND by the scid we advertise
         self._by_cid[dcid] = bridge
         self._by_cid[conn.scid] = bridge
         return bridge
 
+    def _hs_uncount(self, bridge: _QuicChannelBridge) -> None:
+        if not bridge.hs_counted:
+            return
+        bridge.hs_counted = False
+        src = bridge.addr[0]
+        n = self._hs_per_src.get(src, 1) - 1
+        if n > 0:
+            self._hs_per_src[src] = n
+        else:
+            self._hs_per_src.pop(src, None)
+
     def transmit(self, bridge: _QuicChannelBridge) -> None:
         if self._transport is None:
             return
         for dgram in bridge.conn.datagrams_to_send():
+            if (
+                not bridge.conn.address_validated
+                and bridge.bytes_tx + len(dgram) > 3 * bridge.bytes_rx
+            ):
+                # RFC 9000 §8.1 3x cap: a spoofed 1200-byte Initial
+                # can reflect at most ~3600 bytes.  A clipped (or
+                # lost) flight re-arms when the real client
+                # retransmits — more rx budget — see on_datagram.
+                continue
+            bridge.bytes_tx += len(dgram)
             self._transport.sendto(dgram, bridge.addr)
 
     def forget(self, bridge: _QuicChannelBridge) -> None:
+        self._hs_uncount(bridge)
         for cid in [
             cid for cid, b in self._by_cid.items() if b is bridge
         ]:
             del self._by_cid[cid]
 
     # a handshake not done within this window is abandoned (spoofed/
-    # lost Initials must not be retransmitted-to forever), and a
+    # lost Initials must not hold half-open state forever), and a
     # completed connection with no datagrams for idle_timeout is
     # evicted — the advertised max_idle_timeout, enforced
     HANDSHAKE_DEADLINE = 10.0
     IDLE_TIMEOUT = 30.0
+    MAX_HANDSHAKES_PER_SOURCE = 32
 
     async def _pto_loop(self) -> None:
         while True:
@@ -220,6 +276,12 @@ class QuicListener:
                     if now - bridge.created > self.HANDSHAKE_DEADLINE:
                         bridge.conn.close(0)
                         self.forget(bridge)
+                        continue
+                    if not bridge.conn.address_validated:
+                        # no timer-driven retransmits to unvalidated
+                        # peers: a spoofed Initial must not buy a 10s
+                        # stream of cert flights to the victim.  Loss
+                        # recovery is rx-driven (on_datagram).
                         continue
                     bridge.conn.on_timeout()
                     self.transmit(bridge)

@@ -40,6 +40,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from .. import failpoints
+
 log = logging.getLogger("emqx_tpu.cluster.raft")
 
 FOLLOWER = "follower"
@@ -526,6 +528,19 @@ class RaftNode:
 
     async def _on_rpc(self, peer: str, obj: Dict) -> Optional[Dict]:
         kind = obj.get("kind")
+        if failpoints.enabled:
+            # RPC-loss seam: drop suppresses the reply frame entirely
+            # (NO_REPLY sentinel — the caller burns its full RPC
+            # timeout, exactly like a lost reply); delay injects
+            # consensus latency; error resets the handler like a peer
+            # crash
+            act = await failpoints.evaluate_async(
+                "cluster.raft.rpc", key=f"{self.group}:{kind}@{self.node}"
+            )
+            if act == "drop":
+                from .transport import NO_REPLY
+
+                return NO_REPLY
         if kind == "vote":
             return await self._on_vote(obj)
         if kind == "prevote":

@@ -20,9 +20,16 @@ import json
 import logging
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
+from .. import failpoints
+
 log = logging.getLogger("emqx_tpu.cluster.transport")
 
 PROTO_VER = (3, 0)
+
+# a handler returning this sentinel suppresses the reply frame even
+# for a call: the caller consumes its full RPC timeout, exactly like a
+# reply the network lost (the raft failpoint seam relies on it)
+NO_REPLY = object()
 
 Handler = Callable[[str, Dict[str, Any]], Awaitable[Optional[Dict[str, Any]]]]
 
@@ -277,15 +284,49 @@ class NodeTransport:
             link = self._links[node] = PeerLink(self.node, addr)
         return link
 
+    async def _send_failpoint(self, node: str) -> Optional[str]:
+        """Chaos seam for every outbound frame to `node`.  ``drop``
+        swallows the frame as if the network ate it, ``duplicate``
+        asks the caller to send twice, ``delay`` adds link latency
+        inline, ``error`` raises `FailpointError` (a ConnectionError —
+        the detected-failure path).  Keyed ``self->peer`` so a
+        ``match`` substring can partition one node in both
+        directions."""
+        return await failpoints.evaluate_async(
+            "cluster.transport.send", key=f"{self.node}->{node}"
+        )
+
     async def cast(self, node: str, obj: Dict[str, Any]) -> bool:
         if node in self.blocked:
             return False
+        if failpoints.enabled:
+            try:
+                act = await self._send_failpoint(node)
+            except failpoints.FailpointError:
+                return False
+            if act == "drop":
+                return True  # silent loss: the sender believes it went
+            if act == "duplicate":
+                link = self._link(node)
+                if link is not None:
+                    await link.cast(obj)
         link = self._link(node)
         return False if link is None else await link.cast(obj)
 
     async def cast_bin(self, node: str, mtype: str, payload: bytes) -> bool:
         if node in self.blocked:
             return False
+        if failpoints.enabled:
+            try:
+                act = await self._send_failpoint(node)
+            except failpoints.FailpointError:
+                return False
+            if act == "drop":
+                return True
+            if act == "duplicate":
+                link = self._link(node)
+                if link is not None:
+                    await link.cast_bin(mtype, payload)
         link = self._link(node)
         return False if link is None else await link.cast_bin(mtype, payload)
 
@@ -294,6 +335,13 @@ class NodeTransport:
     ) -> Optional[Dict[str, Any]]:
         if node in self.blocked:
             return None
+        if failpoints.enabled:
+            try:
+                act = await self._send_failpoint(node)
+            except failpoints.FailpointError:
+                return None
+            if act == "drop":
+                return None  # the reply will never come
         link = self._link(node)
         return None if link is None else await link.call(obj, timeout)
 
@@ -303,6 +351,8 @@ class NodeTransport:
     ) -> None:
         try:
             result = await handler(peer, obj)
+            if result is NO_REPLY:
+                return
             if "call_id" in obj and not writer.is_closing():
                 writer.write(_pack_json({
                     "type": "reply",
@@ -339,6 +389,16 @@ class NodeTransport:
                 obj = await read_frame(reader)
                 if obj is None:
                     return
+                if failpoints.enabled:
+                    # inbound chaos seam: drop loses the frame after
+                    # the wire delivered it; error (ConnectionError)
+                    # resets the inbound link like a real peer fault
+                    act = await failpoints.evaluate_async(
+                        "cluster.transport.recv",
+                        key=f"{peer}->{self.node}",
+                    )
+                    if act == "drop":
+                        continue
                 mtype = obj.get("type", "")
                 handler = self._handlers.get(mtype)
                 if handler is None:
@@ -352,7 +412,7 @@ class NodeTransport:
                     task.add_done_callback(self._tasks.discard)
                     continue
                 result = await handler(peer, obj)
-                if "call_id" in obj:
+                if "call_id" in obj and result is not NO_REPLY:
                     writer.write(
                         _pack_json(
                             {

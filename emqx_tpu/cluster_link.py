@@ -49,6 +49,7 @@ import logging
 from typing import Dict, List, Optional, Sequence, Set
 
 from . import topic as T
+from .aio import cancel_and_wait
 from .client import MqttClient
 from .message import Message
 
@@ -154,11 +155,11 @@ class LinkAgent:
 
     async def stop(self) -> None:
         if self._pusher is not None:
-            self._pusher.cancel()
-            try:
-                await self._pusher
-            except asyncio.CancelledError:
-                pass
+            # a push's PUBACK resolving exactly as stop() cancels used
+            # to swallow the cancellation (bpo-37658) and hang the
+            # whole broker shutdown on this await — hence the re-
+            # cancelling helper
+            await cancel_and_wait(self._pusher)
             self._pusher = None
         await self.client.stop()
 

@@ -16,6 +16,8 @@ any reachable broker:
     python -m emqx_tpu.ctl rebalance [start|stop|status]
     python -m emqx_tpu.ctl rebalance evacuation start|stop
     python -m emqx_tpu.ctl rebalance purge start|stop
+    python -m emqx_tpu.ctl failpoints [list|set <name> <action> [k=v ...]
+                                       |clear [name]]
 """
 
 from __future__ import annotations
@@ -250,6 +252,52 @@ class Ctl:
         else:
             raise SystemExit(f"unknown rebalance action {action!r}")
 
+    def failpoints(self, action: str = "list", *args: str) -> None:
+        """Chaos controls: list/arm/clear failpoints on a live broker.
+
+            failpoints list
+            failpoints set <name> <action> [prob=0.3] [delay=0.1]
+                           [after=10] [times=5] [seed=7] [match=n0]
+            failpoints clear [name]
+        """
+        if action == "list":
+            info = self._req("/api/v5/failpoints")
+            brk = info.get("engine_breaker", {})
+            print(
+                f"framework {'ARMED' if info['enabled'] else 'disabled'}"
+                f"; engine breaker "
+                f"{'OPEN' if brk.get('open') else 'closed'} "
+                f"(trips={brk.get('trips')})"
+            )
+            for p in info["data"]:
+                opts = " ".join(
+                    f"{k}={p[k]}"
+                    for k in ("prob", "delay", "after", "times",
+                              "match", "seed")
+                    if p.get(k) not in (None, "")
+                )
+                print(f"{p['name']}\t{p['action']}\t{opts}\t"
+                      f"hits={p['hits']} fires={p['fires']}")
+        elif action == "set":
+            if len(args) < 2:
+                raise SystemExit(
+                    "usage: failpoints set <name> <action> [k=v ...]"
+                )
+            body = {"action": args[1]}
+            for kv in args[2:]:
+                k, _, v = kv.partition("=")
+                body[k] = v
+            out = self._req(
+                f"/api/v5/failpoints/{args[0]}", method="PUT", body=body
+            )
+            print(f"armed {out['name']}: {out['action']}")
+        elif action == "clear":
+            path = "/api/v5/failpoints" + (f"/{args[0]}" if args else "")
+            self._req(path, method="DELETE")
+            print(f"cleared {args[0] if args else 'all failpoints'}")
+        else:
+            raise SystemExit(f"unknown failpoints action {action!r}")
+
     def banned(self, action: str = "list", *args: str) -> None:
         if action == "list":
             for b in self._req("/api/v5/banned")["data"]:
@@ -291,7 +339,7 @@ def main(argv=None) -> None:
     )
     ap.add_argument("command", help="status|clients|subscriptions|topics|"
                     "rules|metrics|stats|publish|trace|banned|data|"
-                    "rebalance")
+                    "rebalance|failpoints")
     ap.add_argument("args", nargs="*")
     ap.add_argument("--qos", type=int, default=0)
     ns = ap.parse_args(argv)
@@ -319,6 +367,8 @@ def main(argv=None) -> None:
         ctl.trace(ns.args[0] if ns.args else "list", *ns.args[1:])
     elif cmd == "banned":
         ctl.banned(ns.args[0] if ns.args else "list", *ns.args[1:])
+    elif cmd == "failpoints":
+        ctl.failpoints(ns.args[0] if ns.args else "list", *ns.args[1:])
     elif cmd == "data":
         ctl.data(ns.args[0] if ns.args else "export", *ns.args[1:])
     elif cmd == "rebalance":

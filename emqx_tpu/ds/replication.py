@@ -24,6 +24,8 @@ import logging
 import time
 from typing import Dict, List, Optional
 
+from .. import failpoints
+
 log = logging.getLogger("emqx_tpu.ds.replication")
 
 
@@ -74,6 +76,17 @@ class ReplicaStore:
         racing the log tail) may apply AFTER a message entry it never
         saw, and clearing wholesale would destroy that entry's only
         replica copy."""
+        if failpoints.enabled:
+            # replica-write seam: drop loses this checkpoint silently
+            # (the documented async-replication tail loss); error
+            # raises out to the replication handler.  NOTE: this is a
+            # sync seam on the event-loop thread — an armed `delay`
+            # blocks the whole loop, not just this write; inject
+            # latency at cluster.transport.* instead
+            if failpoints.evaluate(
+                "ds.replication.store", key=clientid
+            ) == "drop":
+                return
         self._checkpoints[clientid] = state
         buf = self._messages.get(clientid)
         if buf:
@@ -95,6 +108,11 @@ class ReplicaStore:
     def append_messages(self, clientid: str, msgs: List[Dict]) -> None:
         """Messages arrive (and stay) in wire-dict form — only a
         restore pays the decode."""
+        if failpoints.enabled:
+            if failpoints.evaluate(
+                "ds.replication.store", key=clientid
+            ) == "drop":
+                return
         buf = self._messages.setdefault(clientid, [])
         self._msg_since.setdefault(clientid, time.time())
         buf.extend(msgs)

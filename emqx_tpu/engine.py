@@ -26,6 +26,7 @@ from typing import Dict, Hashable, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
+from . import failpoints
 from . import topic as T
 from .tp import tp
 from .ops.automaton import Automaton, build_automaton
@@ -455,6 +456,28 @@ class MatchEngine:
         self._ccap_mult = 2
         # (nodes, buckets, levels) classes already shape-warmed
         self._warmed_shapes: Set[Tuple[int, int, int]] = set()
+        # ---- device-path circuit breaker (failure-driven degradation)
+        # The auto policy above switches paths on measured COST; the
+        # breaker switches on FAILURE: `breaker_threshold` consecutive
+        # device-step exceptions (XLA compile/OOM, tunnel loss) — or a
+        # window exceeding `breaker_deadline` seconds of wall, the
+        # watchdog — trip matching to host-only.  A background probe
+        # re-tries the device every `breaker_probe_interval` seconds
+        # and re-closes the breaker on success.  The broker wires the
+        # trip/clear callbacks into its AlarmRegistry ($SYS alarm) and
+        # metrics.
+        self.breaker_threshold = 3
+        self.breaker_probe_interval = 5.0
+        self.breaker_deadline: Optional[float] = 30.0
+        self.on_breaker_trip = None  # callable(info_dict)
+        self.on_breaker_clear = None  # callable(info_dict)
+        self._brk_failures = 0  # consecutive device-step failures
+        self._brk_open = False
+        self._brk_opened_at = 0.0
+        self._brk_probe_last = 0.0
+        self._brk_probing = False
+        self._brk_stats = {"trips": 0, "device_errors": 0,
+                           "slow_windows": 0, "probes": 0}
 
     # ------------------------------------------------------------- mutation
 
@@ -1102,6 +1125,9 @@ class MatchEngine:
             "folding": self._folding,
             "auto_host_windows": self._auto_stats["host_windows"],
             "auto_dev_windows": self._auto_stats["dev_windows"],
+            "breaker_open": self._brk_open,
+            "breaker_trips": self._brk_stats["trips"],
+            "breaker_device_errors": self._brk_stats["device_errors"],
             "host_us_ewma": self._host_us,
             "dev_cpu_us_ewma": self._dev_cpu_us,
             "dev_window_ms_ewma": (
@@ -1119,6 +1145,133 @@ class MatchEngine:
             # uploads keep the throttled default
             self._dev = self._device_put(self._aut, throttle=False)
         return self._dev
+
+    # ---------------------------------------------------------- breaker
+
+    def _device_failure(self, reason: str = "error") -> None:
+        """Record one device-step failure; trips the breaker after
+        `breaker_threshold` CONSECUTIVE ones.  Called from whatever
+        thread ran the match — the trip callback must be thread-safe
+        (the broker's is: it schedules onto the event loop)."""
+        self._brk_stats["device_errors"] += 1
+        self._brk_failures += 1
+        if not self._brk_open and (
+            self._brk_failures >= self.breaker_threshold
+        ):
+            self._trip_breaker(reason)
+
+    def _device_ok(self, wall: float) -> None:
+        """A device window completed.  A wall time past the watchdog
+        deadline still counts as a failure: a wedged-but-eventually-
+        returning device (tunnel stall, compile storm) must degrade to
+        the host path, not hold every window hostage."""
+        if (
+            self.breaker_deadline is not None
+            and wall > self.breaker_deadline
+        ):
+            self._brk_stats["slow_windows"] += 1
+            self._device_failure(reason="deadline")
+            return
+        self._brk_failures = 0
+
+    def _trip_breaker(self, reason: str) -> None:
+        self._brk_open = True
+        self._brk_opened_at = time.monotonic()
+        self._brk_probe_last = self._brk_opened_at
+        self._brk_stats["trips"] += 1
+        info = {"reason": reason, "failures": self._brk_failures,
+                "trips": self._brk_stats["trips"]}
+        import logging
+
+        logging.getLogger("emqx_tpu.engine").warning(
+            "device-path breaker OPEN (%s after %d consecutive "
+            "failures): matching degrades to host-only; background "
+            "probe every %.1fs", reason, self._brk_failures,
+            self.breaker_probe_interval,
+        )
+        tp("breaker_trip", reason=reason)
+        if self.on_breaker_trip is not None:
+            try:
+                self.on_breaker_trip(info)
+            except Exception:
+                logging.getLogger("emqx_tpu.engine").exception(
+                    "breaker trip callback failed"
+                )
+
+    def _close_breaker(self) -> None:
+        self._brk_open = False
+        self._brk_failures = 0
+        info = {"open_for": time.monotonic() - self._brk_opened_at,
+                "trips": self._brk_stats["trips"]}
+        import logging
+
+        logging.getLogger("emqx_tpu.engine").warning(
+            "device-path breaker CLOSED after %.1fs: device matching "
+            "re-enabled", info["open_for"],
+        )
+        tp("breaker_clear")
+        if self.on_breaker_clear is not None:
+            try:
+                self.on_breaker_clear(info)
+            except Exception:
+                logging.getLogger("emqx_tpu.engine").exception(
+                    "breaker clear callback failed"
+                )
+
+    def _brk_maybe_probe(self) -> None:
+        """While the breaker is open, re-try the device path out-of-
+        band on a one-shot daemon thread (never as head-of-line latency
+        in the live window stream); success re-closes the breaker."""
+        now = time.monotonic()
+        if (
+            self._brk_probing
+            or now - self._brk_probe_last < self.breaker_probe_interval
+        ):
+            return
+        self._brk_probing = True
+        self._brk_probe_last = now
+        sample = list(self._probe_topics[:64]) or [
+            f"\x00brkprobe/{i}" for i in range(64)
+        ]
+
+        def work() -> None:
+            ok = False
+            try:
+                errs0 = self._brk_stats["device_errors"]
+                pending = self.match_batch_submit(
+                    sample, _force_device=True
+                )
+                self.match_batch_finish(pending)
+                # success = the submit really chose the device ("host"
+                # means it fell back internally) AND the finish side
+                # recorded no new failure — finish catches its own
+                # transfer faults and returns host results without
+                # raising, which must NOT close the breaker
+                ok = (
+                    pending[0] == "dev"
+                    and self._brk_stats["device_errors"] == errs0
+                )
+            except Exception:
+                ok = False
+            finally:
+                self._brk_stats["probes"] += 1
+                self._brk_probing = False
+            if ok and self._brk_open:
+                self._close_breaker()
+
+        threading.Thread(
+            target=work, name="engine-brk-probe", daemon=True
+        ).start()
+
+    def breaker_info(self) -> Dict[str, object]:
+        return {
+            "open": self._brk_open,
+            "consecutive_failures": self._brk_failures,
+            "threshold": self.breaker_threshold,
+            "probe_interval": self.breaker_probe_interval,
+            "deadline": self.breaker_deadline,
+            **self._brk_stats,
+        }
 
     # -------------------------------------------------------------- match
 
@@ -1286,6 +1439,11 @@ class MatchEngine:
                 and self._aut is not None
                 and self._aut.n_nodes > 1
             )
+            if device_capable and self._brk_open and not _force_device:
+                # breaker open: host-only until the background probe
+                # re-closes it (failure-driven degradation)
+                device_capable = False
+                self._brk_maybe_probe()
             if _force_device and device_capable:
                 device_on = True
             elif device_capable and self.use_device is None:
@@ -1296,8 +1454,22 @@ class MatchEngine:
             else:
                 device_on = device_capable
             if device_on:
-                snap = self._snapshot_refs()
-                tp("match_snapshot", watermark=self._fold_watermark)
+                try:
+                    snap = self._snapshot_refs()
+                except Exception:
+                    # lazy device upload failed: a device fault, so it
+                    # feeds the breaker and the window serves on host
+                    import logging
+
+                    logging.getLogger("emqx_tpu.engine").exception(
+                        "device snapshot failed; window falls back to "
+                        "host matching"
+                    )
+                    device_on = False
+                    self._device_failure()
+                else:
+                    tp("match_snapshot",
+                       watermark=self._fold_watermark)
         if not device_on:
             # per-topic locking: holding _mlock across the whole batch
             # would stall a loop-thread SUBSCRIBE (and with it the
@@ -1321,15 +1493,39 @@ class MatchEngine:
             return ("host", out)
         t0 = time.perf_counter()
         c0 = time.thread_time()
-        # dispatch the delta kernel FIRST (async JAX dispatch) so the
-        # small fixed-shape call overlaps the base kernel + transfer
-        daut, ddev, _ = snap[6]
-        dpend = (
-            self._flat_dispatch(daut, ddev, words)
-            if daut is not None
-            else None
-        )
-        pend_base = self._flat_submit(snap, words)
+        try:
+            # dispatch the delta kernel FIRST (async JAX dispatch) so
+            # the small fixed-shape call overlaps the base kernel +
+            # transfer
+            daut, ddev, _ = snap[6]
+            dpend = (
+                self._flat_dispatch(daut, ddev, words)
+                if daut is not None
+                else None
+            )
+            pend_base = self._flat_submit(snap, words)
+        except Exception:
+            # a dispatch-side device fault (encode upload, compile,
+            # injected engine.device_step error): count it toward the
+            # breaker and serve THIS window on the host oracle —
+            # per-topic locking, as in the host branch above
+            import logging
+
+            logging.getLogger("emqx_tpu.engine").exception(
+                "device dispatch failed for window of %d; host "
+                "fallback", len(words),
+            )
+            self._device_failure()
+            out = []
+            for ws in words:
+                with self._mlock:
+                    out.append(self.match_host(ws))
+            return ("host", out)
+        if len(words) >= 64:
+            # keep a fresh sample for the breaker probe: after a trip
+            # the device path stops running, and probing with recent
+            # REAL topics measures what production windows would see
+            self._probe_topics = list(topics[:256])
         cpu0 = time.thread_time() - c0  # encode + dispatch CPU
         return ("dev", snap, pend_base, dpend, topics, words, t0, cpu0)
 
@@ -1355,8 +1551,23 @@ class MatchEngine:
         _, snap, pend_base, dpend, topics, words, t0, cpu0 = pending
         t1w = time.perf_counter()
         c1 = time.thread_time()
-        rows, gpos, ovf = self._flat_result(pend_base)
-        dflat = self._flat_finish(dpend) if dpend is not None else None
+        try:
+            rows, gpos, ovf = self._flat_result(pend_base)
+            dflat = (
+                self._flat_finish(dpend) if dpend is not None else None
+            )
+        except Exception:
+            # the wait/transfer side of the device step failed: breaker
+            # food, and the window re-matches on the host oracle
+            import logging
+
+            logging.getLogger("emqx_tpu.engine").exception(
+                "device result failed for window of %d; host fallback",
+                len(words),
+            )
+            self._device_failure()
+            return self.match_batch_host(list(topics))
+        self._device_ok(time.perf_counter() - t0)
         tp("match_overlay")
         with self._mlock:
             out = self._overlay(topics, words, rows, gpos, ovf, snap, dflat)
@@ -1550,6 +1761,10 @@ class MatchEngine:
         links slower than PCIe (the axon tunnel moves ~10 MB/s)."""
         from .ops.match_kernel import match_batch_compact
 
+        if failpoints.enabled:
+            # chaos seam: error raises (breaker food), delay stalls the
+            # step (watchdog food); evaluated per kernel dispatch
+            failpoints.evaluate("engine.device_step")
         idx, mat, lens, dol = self._encode_rows(words, aut.kernel_levels)
         uniq, inv = np.unique(idx, return_inverse=True)
         tokens, lengths, dollar = _pad_batch(

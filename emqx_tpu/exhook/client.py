@@ -32,6 +32,7 @@ from typing import Dict, List, Optional, Sequence
 
 import grpc
 
+from .. import failpoints
 from ..hooks import STOP_WITH, with_async
 from ..message import Message
 from . import pb
@@ -280,12 +281,17 @@ class ExhookClient:
             return None
         try:
             self.stats["calls"] += 1
+            if failpoints.enabled:
+                # chaos seam: FailpointError carries a grpc-compatible
+                # .code(), so an injected fault walks the SAME breaker
+                # and failure-policy path as a real transport error
+                failpoints.evaluate("exhook.call", key=self.name)
             out = self._method(rpc, req_cls, resp_cls)(
                 req, timeout=self.timeout
             )
             self._failures = 0
             return out
-        except grpc.RpcError as exc:
+        except (grpc.RpcError, failpoints.FailpointError) as exc:
             self.stats["failures"] += 1
             self._failures += 1
             if self._failures >= self.breaker_threshold:

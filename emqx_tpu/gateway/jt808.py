@@ -15,7 +15,8 @@ Terminal messages handled natively: 0x0100 register (answered 0x8100
 with a minted auth code), 0x0102 authenticate, 0x0002 heartbeat and
 0x0003 unregister (0x8001 general ack), 0x0200 location report
 (decoded: alarm/status bits, lat/lon x1e-6, altitude, speed x0.1km/h,
-direction, BCD time).  Every terminal frame also publishes upstream as
+direction, BCD time).  Once the channel is AUTHENTICATED, terminal
+frames also publish upstream as
 JSON to ``{mountpoint}{phone}/up``; the platform side publishes JSON
 to ``{mountpoint}{phone}/dn`` — either ``{"msg_id": ..., "body_hex":
 ...}`` raw passthrough or ``{"text": ...}`` (0x8300 text message) —
@@ -183,8 +184,14 @@ class Jt808Channel(GatewayChannel):
                    struct.pack(">HHB", m.serial, m.msg_id, result))
 
     def _uplink(self, kind: str, m: Jt808Message, extra: Dict) -> None:
+        if not self.authed or self.client is None:
+            # pre-auth frames (register path) must not publish: an
+            # attacker-chosen phone would otherwise reach
+            # {mountpoint}{phone}/up with no authentication at all
+            self.broker.metrics.inc("gateway.jt808.preauth_drop")
+            return
         topic = f"{self.gateway.mountpoint}{self.phone}/up"
-        if self.client is not None and not self.broker.access.authorize(
+        if not self.broker.access.authorize(
             self.client, PUBLISH, topic
         ):
             self.broker.metrics.inc("authorization.deny")
@@ -242,7 +249,18 @@ class Jt808Channel(GatewayChannel):
             self._general_ack(m)
 
     def _on_register(self, m: Jt808Message) -> None:
-        code = secrets.token_hex(8)
+        existing = self.gateway.auth_codes.get(m.phone)
+        if existing is not None and not self.authed:
+            # 0x8100 result 3: terminal already registered.  A fresh
+            # connection re-registering a victim's phone must not mint
+            # (and silently overwrite) its auth code — that would let
+            # any peer impersonate an enrolled terminal.  The real
+            # terminal unregisters (0x0003) before re-enrolling.
+            self.broker.metrics.inc("gateway.jt808.reregister_denied")
+            self._send(MSG_REGISTER_ACK,
+                       struct.pack(">HB", m.serial, 3))
+            return
+        code = existing or secrets.token_hex(8)
         self.gateway.auth_codes[m.phone] = code
         # 0x8100: serial(2) result(1) auth code
         self._send(MSG_REGISTER_ACK,

@@ -33,6 +33,8 @@ import struct
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from . import failpoints
+
 log = logging.getLogger("emqx_tpu.kafka")
 
 API_PRODUCE = 0
@@ -317,6 +319,17 @@ class KafkaClient:
     ) -> Dict[Tuple[str, int], int]:
         """Produce v3: {(topic, partition): record_batch} -> error
         code per partition."""
+        act = None
+        if failpoints.enabled:
+            # error (ConnectionError) exercises the park-and-retry
+            # path; drop answers REQUEST_TIMED_OUT (retriable) without
+            # touching the wire; duplicate really produces twice
+            # (at-least-once duplication)
+            act = await failpoints.evaluate_async(
+                "kafka.produce", key=f"{self.host}:{self.port}"
+            )
+            if act == "drop":
+                return {tp: 7 for tp in topic_partitions}
         by_topic: Dict[str, List[Tuple[int, bytes]]] = {}
         for (t, p), batch in topic_partitions.items():
             by_topic.setdefault(t, []).append((p, batch))
@@ -331,6 +344,10 @@ class KafkaClient:
                 body += struct.pack(">i", p)
                 body += _bytes32(batch)
         resp = await self.request(API_PRODUCE, 3, bytes(body), timeout)
+        if act == "duplicate":
+            resp = await self.request(
+                API_PRODUCE, 3, bytes(body), timeout
+            )
         off = 0
         out: Dict[Tuple[str, int], int] = {}
         (n_topics,) = struct.unpack_from(">i", resp, off)

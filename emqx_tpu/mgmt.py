@@ -192,6 +192,10 @@ class MgmtApi:
         r.add_post("/api/v5/publish", self.post_publish)
         r.add_get("/api/v5/alarms", self.get_alarms)
         r.add_delete("/api/v5/alarms", self.clear_alarms)
+        r.add_get("/api/v5/failpoints", self.get_failpoints)
+        r.add_put("/api/v5/failpoints/{name}", self.put_failpoint)
+        r.add_delete("/api/v5/failpoints/{name}", self.delete_failpoint)
+        r.add_delete("/api/v5/failpoints", self.delete_failpoints)
         r.add_get("/api/v5/banned", self.get_banned)
         r.add_post("/api/v5/banned", self.post_banned)
         r.add_delete("/api/v5/banned/{kind}/{who}", self.delete_banned)
@@ -554,6 +558,63 @@ class MgmtApi:
     async def clear_alarms(self, request: web.Request) -> web.Response:
         for a in self.broker.alarms.active():
             self.broker.alarms.deactivate(a.name)
+        return web.Response(status=204)
+
+    # ------------------------------------------------------ failpoints
+
+    async def get_failpoints(self, request: web.Request) -> web.Response:
+        from . import failpoints
+
+        eng = self.broker.router.engine
+        return _json({
+            "enabled": failpoints.enabled,
+            "data": failpoints.list_points(),
+            "seams": list(failpoints.SEAMS),
+            "engine_breaker": eng.breaker_info(),
+        })
+
+    async def put_failpoint(self, request: web.Request) -> web.Response:
+        from . import failpoints
+
+        body = await _body_json(request)
+        action = body.get("action")
+        if action not in failpoints.ACTIONS:
+            return _json(
+                {"error": f"action must be one of {failpoints.ACTIONS}"},
+                status=400,
+            )
+        kw = {}
+        try:
+            for k in ("prob", "delay"):
+                if body.get(k) is not None:
+                    kw[k] = float(body[k])
+            for k in ("after", "times", "seed"):
+                if body.get(k) is not None:
+                    kw[k] = int(body[k])
+        except (TypeError, ValueError):
+            return _json(
+                {"error": "prob/delay must be numbers; "
+                          "after/times/seed integers"},
+                status=400,
+            )
+        if body.get("match") is not None:
+            kw["match"] = str(body["match"])
+        info = failpoints.configure(
+            request.match_info["name"], action, **kw
+        )
+        return _json(info)
+
+    async def delete_failpoint(self, request: web.Request) -> web.Response:
+        from . import failpoints
+
+        if not failpoints.clear(request.match_info["name"]):
+            return _json({"error": "no such failpoint"}, status=404)
+        return web.Response(status=204)
+
+    async def delete_failpoints(self, request: web.Request) -> web.Response:
+        from . import failpoints
+
+        failpoints.clear()
         return web.Response(status=204)
 
     async def get_banned(self, request: web.Request) -> web.Response:

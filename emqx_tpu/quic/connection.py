@@ -132,11 +132,12 @@ def encode_transport_params(scid: bytes,
 
 
 class _SendStream:
-    __slots__ = ("data", "acked", "fin", "fin_sent")
+    __slots__ = ("data", "base", "acked", "fin", "fin_sent")
 
     def __init__(self) -> None:
-        self.data = b""     # everything ever written
-        self.acked = 0      # contiguous acked prefix
+        self.data = b""     # unacked tail: stream bytes [base:]
+        self.base = 0       # absolute offset of data[0] (acked prefix
+        self.acked = 0      # is trimmed, so base tracks acked)
         self.fin = False
         self.fin_sent = False
 
@@ -203,6 +204,13 @@ class QuicConnection:
         self.handshake_complete = False
         self._handshake_done_sent = False
         self._handshake_confirmed = False
+        # RFC 9000 §8.1: a server treats the client address as
+        # validated once a packet protected with handshake (or 1-RTT)
+        # keys decrypts — those keys require the client to have
+        # received our Initial flight at that address.  Until then the
+        # listener caps send volume at 3x received and skips
+        # timer-driven retransmits (anti-amplification).
+        self.address_validated = not is_server
         self.closed = False
         self.close_code: Optional[int] = None
         self._out_datagrams: List[bytes] = []
@@ -349,6 +357,8 @@ class QuicConnection:
             pt = recv.aead.decrypt(recv.nonce(pn), ct, header)
         except Exception:
             return 0
+        if self.is_server and epoch != EPOCH_INITIAL:
+            self.address_validated = True
         if pn < self._pn_floor[epoch] or pn in self._recv_pns[epoch]:
             return pn_off + pn_len + payload_len - pkt_start
         self._recv_pns[epoch].add(pn)
@@ -552,6 +562,13 @@ class QuicConnection:
                 for sid, st in self._streams_out.items():
                     sent = self._streams_sent.get(sid, 0)
                     st.acked = max(st.acked, sent)
+                    if st.acked > st.base:
+                        # drop the acked prefix: a long-lived
+                        # subscriber must not retain every byte ever
+                        # delivered to it (offsets stay absolute;
+                        # only indexing into `data` rebases)
+                        st.data = st.data[st.acked - st.base:]
+                        st.base = st.acked
 
     # -------------------------------------------------------- sending
 
@@ -606,7 +623,7 @@ class QuicConnection:
         if self.handshake_complete:
             for sid, st in self._streams_out.items():
                 sent = self._streams_sent.get(sid, 0)
-                pending = st.data[sent:]
+                pending = st.data[sent - st.base:]
                 send_fin = st.fin and not st.fin_sent
                 while pending or send_fin:
                     chunk = pending[:1100]

@@ -21,6 +21,9 @@ import time
 from collections import deque
 from typing import Any, Dict, Optional
 
+from . import failpoints
+from .aio import cancel_and_wait
+
 log = logging.getLogger("emqx_tpu.resources")
 
 CONNECTING = "connecting"
@@ -109,6 +112,7 @@ class BufferWorker:
         health_interval: float = 1.0,
     ) -> None:
         self.resource = resource
+        self.name = ""  # resource_id when owned by a ResourceManager
         self.max_buffer = max_buffer
         self.max_retries = max_retries
         self.retry_base = retry_base
@@ -135,11 +139,9 @@ class BufferWorker:
 
     async def stop(self) -> None:
         if self._task is not None:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
+            # cancel_and_wait: the drain loop's wait_for can swallow a
+            # cancel that lands as the wake future resolves (bpo-37658)
+            await cancel_and_wait(self._task)
             self._task = None
         await self.resource.on_stop()
 
@@ -221,6 +223,14 @@ class BufferWorker:
             n_batch = getattr(self.resource, "max_batch", 1)
             query = self._buf[0]  # keep at head until delivered
             try:
+                if failpoints.enabled:
+                    # chaos seam INSIDE the try: an injected error
+                    # rides the worker's real retry/backoff path with
+                    # the query still at the buffer head (no loss)
+                    await failpoints.evaluate_async(
+                        "resource.buffer.query",
+                        key=self.name or type(self.resource).__name__,
+                    )
                 if n_batch > 1 and hasattr(
                     self.resource, "on_query_batch"
                 ):
@@ -281,6 +291,7 @@ class ResourceManager:
     ) -> BufferWorker:
         await self.remove(resource_id)
         worker = BufferWorker(resource, **worker_kw)
+        worker.name = resource_id
         if self.alarms is not None:
             def status_alarm(down: bool, rid=resource_id):
                 if down:

@@ -216,6 +216,40 @@ def test_ldap_bind_auth():
     run(t())
 
 
+def test_ldap_dn_metacharacters_are_escaped():
+    """RFC 4514 escaping closes the authorization-scope bypass: a
+    username like 'x,ou=admins,...' must reach the directory as DATA
+    inside uid=..., never as extra RDNs rewriting the bind DN."""
+    from emqx_tpu.auth_ldap import escape_dn_value
+
+    assert escape_dn_value("alice") == "alice"
+    assert escape_dn_value("x,ou=admins") == "x\\,ou\\=admins"
+    assert escape_dn_value("#lead ") == "\\#lead\\ "
+    assert escape_dn_value(" a+b<c>d;e\"f\\g") == \
+        "\\ a\\+b\\<c\\>d\\;e\\\"f\\\\g"
+    assert escape_dn_value("n\x00ul") == "n\\00ul"
+
+    async def t():
+        evil = "bob,ou=admins,dc=example,dc=com"
+        fl = FakeLdap({
+            # the directory would accept the ADMIN entry's password:
+            # reachable only if the DN arrives unescaped
+            "uid=bob,ou=admins,dc=example,dc=com": b"adminpw",
+        })
+        await fl.start()
+        ld = LdapAuthenticator("127.0.0.1", fl.port)
+        d, _ = await ld.authenticate_async(ClientInfo(
+            clientid="c", username=evil, password=b"adminpw",
+        ))
+        assert d == DENY  # the escaped DN does not match the admin DN
+        seen_dn = fl.seen[0][0]
+        assert seen_dn.startswith("uid=bob\\,ou\\=admins")
+        assert seen_dn.endswith(",ou=users,dc=example,dc=com")
+        await fl.stop()
+
+    run(t())
+
+
 # ----------------------------------------------------------------- psk
 
 def test_psk_store_file_and_lookup(tmp_path):

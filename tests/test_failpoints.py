@@ -1,0 +1,348 @@
+"""Failpoint framework (emqx_tpu/failpoints.py): registry semantics,
+seeded determinism, hit windows, env/REST/ctl configuration surfaces,
+the disabled-is-a-no-op guard the hot paths rely on, and the
+BufferWorker retry/backoff + disconnect→replay satellite driven
+through injection (no sleeps for correctness, deterministic seed)."""
+
+import asyncio
+import tempfile
+import time
+
+import pytest
+
+from emqx_tpu import failpoints as fp
+from emqx_tpu.resources import (
+    CONNECTED, DISCONNECTED, BufferWorker, Resource,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    fp.clear()
+    yield
+    fp.clear()
+
+
+# ---------------------------------------------------------- registry
+
+def test_actions_error_drop_duplicate_panic():
+    fp.configure("t.err", "error")
+    with pytest.raises(fp.FailpointError):
+        fp.evaluate("t.err")
+    # FailpointError IS a ConnectionError: seams recover through their
+    # real transport-failure paths
+    assert issubclass(fp.FailpointError, ConnectionError)
+    assert fp.FailpointError("x").code() == "FAILPOINT"
+
+    fp.configure("t.drop", "drop")
+    assert fp.evaluate("t.drop") == "drop"
+    fp.configure("t.dup", "duplicate")
+    assert fp.evaluate("t.dup") == "duplicate"
+
+    fp.configure("t.panic", "panic")
+    with pytest.raises(fp.FailpointPanic):
+        fp.evaluate("t.panic")
+    # panic must NOT be absorbed by ordinary Exception recovery
+    assert not issubclass(fp.FailpointPanic, Exception)
+
+    with pytest.raises(ValueError):
+        fp.configure("t.bad", "explode")
+
+
+def test_delay_sync_and_async():
+    fp.configure("t.delay", "delay", delay=0.05)
+    t0 = time.monotonic()
+    assert fp.evaluate("t.delay") is None
+    assert time.monotonic() - t0 >= 0.045
+
+    async def t():
+        t0 = time.monotonic()
+        assert await fp.evaluate_async("t.delay") is None
+        assert time.monotonic() - t0 >= 0.045
+
+    run(t())
+
+
+def test_seeded_probability_is_reproducible():
+    fp.configure("t.p", "drop", prob=0.4, seed=1234)
+    a = [fp.evaluate("t.p") for _ in range(64)]
+    fp.configure("t.p", "drop", prob=0.4, seed=1234)  # re-arm resets
+    b = [fp.evaluate("t.p") for _ in range(64)]
+    assert a == b
+    fires = sum(1 for x in a if x == "drop")
+    assert 0 < fires < 64  # actually probabilistic
+
+
+def test_hit_count_windows_after_and_times():
+    fp.configure("t.w", "drop", after=3, times=2)
+    out = [fp.evaluate("t.w") for _ in range(8)]
+    # first 3 hits skipped, then exactly 2 fires, then exhausted
+    assert out == [None, None, None, "drop", "drop", None, None, None]
+    info = fp.list_points()[0]
+    assert info["hits"] == 8 and info["fires"] == 2
+
+
+def test_match_substring_filter_on_key():
+    fp.configure("t.m", "drop", match="n0")
+    assert fp.evaluate("t.m", key="n0->n1") == "drop"
+    assert fp.evaluate("t.m", key="n1->n0") == "drop"
+    assert fp.evaluate("t.m", key="n1->n2") is None
+    assert fp.evaluate("t.m") is None  # no key at the site
+
+
+def test_env_spec_round_trip():
+    n = fp.load_env(
+        "engine.device_step=error;"
+        "cluster.transport.send=drop,prob=0.25,seed=9,match=n2;"
+        "cluster.raft.rpc=delay,delay=0.01,after=5,times=3"
+    )
+    assert n == 3 and fp.enabled
+    by_name = {p["name"]: p for p in fp.list_points()}
+    assert by_name["cluster.transport.send"]["prob"] == 0.25
+    assert by_name["cluster.transport.send"]["match"] == "n2"
+    assert by_name["cluster.raft.rpc"]["times"] == 3
+    assert fp.load_env("") == 0  # unset env is a no-op
+    with pytest.raises(ValueError):
+        fp.parse_spec("name.only")
+    with pytest.raises(ValueError):
+        fp.parse_spec("a=error,bogus=1")
+    fp.clear("engine.device_step")
+    assert len(fp.list_points()) == 2
+    fp.clear()
+    assert fp.list_points() == [] and not fp.enabled
+
+
+# ------------------------------------------------- disabled guard
+
+def test_disabled_framework_is_a_noop_on_every_seam():
+    """The guard the hot paths rely on: with nothing armed, every
+    instrumented seam evaluates to None, counts nothing, and costs
+    (far) less than a microsecond-scale budget per call — chaos hooks
+    can never regress the disabled hot path."""
+    assert fp.enabled is False
+    for name in fp.SEAMS:
+        assert fp.evaluate(name) is None
+        assert run(fp.evaluate_async(name)) is None
+    assert fp.list_points() == []  # nothing counted, nothing armed
+
+    n = 200_000
+    t0 = time.perf_counter()
+    ev = fp.evaluate
+    for _ in range(n):
+        ev("engine.device_step")
+    per_call = (time.perf_counter() - t0) / n
+    # a disabled evaluate is one bool check; 5 µs/call is ~50x headroom
+    # over any sane interpreter so this cannot flake, while still
+    # catching an accidental lock/dict walk on the disabled path
+    assert per_call < 5e-6, f"disabled failpoint costs {per_call:.2e}s"
+
+    # armed-but-different-name is also a miss for every other seam
+    fp.configure("only.this.one", "error")
+    for name in fp.SEAMS:
+        assert fp.evaluate(name) is None
+
+
+def test_disabled_paths_behave_identically():
+    """Instrumented code runs with the framework disabled exactly as
+    if the seam were absent: a transport send and a replica store are
+    bit-identical with and without a cleared registry."""
+    from emqx_tpu.ds.replication import ReplicaStore
+
+    store = ReplicaStore()
+    store.store_checkpoint("c1", {"subs": {"a/b": {}}, "expiry": 60,
+                                  "queued": []})
+    store.append_messages("c1", [{"topic": "a/b", "mid": 1}])
+    assert store.peek("c1")["queued"] == [{"topic": "a/b", "mid": 1}]
+
+    # armed drop on the store seam: the same calls now lose the write
+    fp.configure("ds.replication.store", "drop")
+    store.store_checkpoint("c2", {"subs": {}, "expiry": 60})
+    assert store.peek("c2") is None
+    fp.clear()
+    store.store_checkpoint("c2", {"subs": {}, "expiry": 60})
+    assert store.peek("c2") is not None
+
+
+# ------------------------------------------- resource buffer satellite
+
+class CountingSink(Resource):
+    """Sink that records delivered queries; failures come ONLY from
+    the injected failpoint, so the retry path is deterministic."""
+
+    def __init__(self):
+        self.delivered = []
+
+    async def on_query(self, query):
+        self.delivered.append(query)
+
+    async def health_check(self):
+        return True
+
+
+async def _drain(worker, sink, want, deadline=5.0):
+    t0 = time.monotonic()
+    while len(sink.delivered) < want:
+        assert time.monotonic() - t0 < deadline, (
+            f"delivered {len(sink.delivered)}/{want}"
+        )
+        await asyncio.sleep(0.005)
+
+
+def test_buffer_worker_retry_backoff_through_failpoint():
+    """First 3 drain attempts fail via injection: the worker retries
+    with backoff, keeps the query at the buffer head, and delivers
+    everything in order — no loss within buffer bounds."""
+
+    async def t():
+        sink = CountingSink()
+        w = BufferWorker(sink, retry_base=0.005, retry_cap=0.02)
+        w.name = "chaos-sink"
+        fp.configure("resource.buffer.query", "error", times=3,
+                     match="chaos-sink")
+        await w.start()
+        for i in range(5):
+            w.enqueue(f"q{i}")
+        await _drain(w, sink, 5)
+        assert sink.delivered == [f"q{i}" for i in range(5)]
+        assert w.stats["retried"] == 3
+        assert w.stats["success"] == 5
+        assert w.stats["dropped"] == 0 and w.stats["failed"] == 0
+        assert w.status == CONNECTED
+        await w.stop()
+
+    run(t())
+
+
+def test_buffer_worker_disconnect_then_replay():
+    """A dead sink (every query errors) flips the worker to
+    DISCONNECTED and buffers the backlog; clearing the injection
+    replays the whole backlog in order and re-connects."""
+
+    async def t():
+        sink = CountingSink()
+        w = BufferWorker(sink, retry_base=0.005, retry_cap=0.02)
+        w.name = "outage-sink"
+        fp.configure("resource.buffer.query", "error",
+                     match="outage-sink")
+        await w.start()
+        for i in range(20):
+            w.enqueue(i)
+        t0 = time.monotonic()
+        while not (w.status == DISCONNECTED and w.stats["retried"] >= 2):
+            assert time.monotonic() - t0 < 5.0
+            await asyncio.sleep(0.005)
+        assert sink.delivered == [] and len(w) == 20
+        fp.clear("resource.buffer.query")  # sink "comes back"
+        await _drain(w, sink, 20)
+        assert sink.delivered == list(range(20))
+        assert w.status == CONNECTED and len(w) == 0
+        await w.stop()
+
+    run(t())
+
+
+def test_buffer_worker_panic_is_not_absorbed():
+    """An injected panic (BaseException) escapes the worker's
+    except-Exception retry clause — the drain task dies the way a
+    process would, instead of being silently retried."""
+
+    async def t():
+        sink = CountingSink()
+        w = BufferWorker(sink, retry_base=0.005)
+        w.name = "panic-sink"
+        fp.configure("resource.buffer.query", "panic", times=1,
+                     match="panic-sink")
+        await w.start()
+        w.enqueue("boom")
+        for _ in range(100):
+            if w._task.done():
+                break
+            await asyncio.sleep(0.005)
+        assert w._task.done()
+        with pytest.raises(fp.FailpointPanic):
+            w._task.result()
+
+    run(t())
+
+
+# -------------------------------------------------- REST + ctl surface
+
+def test_failpoints_rest_and_ctl(tmp_path):
+    from api_helper import auth_session
+    from emqx_tpu.broker.listener import BrokerServer
+    from emqx_tpu.config import BrokerConfig, ListenerConfig
+
+    async def t():
+        cfg = BrokerConfig()
+        cfg.listeners = [ListenerConfig(port=0)]
+        cfg.api.enable = True
+        cfg.api.data_dir = tempfile.mkdtemp(dir=str(tmp_path))
+        cfg.api.port = 0
+        srv = BrokerServer(cfg)
+        await srv.start()
+        http, api = await auth_session(srv)
+        try:
+            async with http.get(api + "/api/v5/failpoints") as r:
+                body = await r.json()
+                assert r.status == 200
+                assert body["enabled"] is False and body["data"] == []
+                assert "engine.device_step" in body["seams"]
+                assert body["engine_breaker"]["open"] is False
+
+            async with http.put(
+                api + "/api/v5/failpoints/cluster.transport.send",
+                json={"action": "drop", "prob": 0.5, "seed": 7,
+                      "match": "n0", "times": 10},
+            ) as r:
+                assert r.status == 200
+                info = await r.json()
+                assert info["action"] == "drop" and info["seed"] == 7
+            assert fp.enabled
+
+            async with http.put(
+                api + "/api/v5/failpoints/x", json={"action": "nope"}
+            ) as r:
+                assert r.status == 400
+            async with http.put(
+                api + "/api/v5/failpoints/x",
+                json={"action": "delay", "delay": "fast"},
+            ) as r:
+                assert r.status == 400  # bad numeric -> clean 400
+
+            async with http.get(api + "/api/v5/failpoints") as r:
+                body = await r.json()
+                assert [p["name"] for p in body["data"]] == [
+                    "cluster.transport.send"
+                ]
+
+            # the ctl CLI drives the same endpoints end to end
+            from emqx_tpu.ctl import Ctl
+
+            def drive_ctl():
+                ctl = Ctl(api, user="admin:public")
+                ctl.failpoints("set", "engine.device_step", "error",
+                               "times=5")
+                ctl.failpoints("list")
+                ctl.failpoints("clear", "engine.device_step")
+
+            await asyncio.get_running_loop().run_in_executor(
+                None, drive_ctl
+            )
+            assert [p["name"] for p in fp.list_points()] == [
+                "cluster.transport.send"
+            ]
+
+            async with http.delete(api + "/api/v5/failpoints/nope") as r:
+                assert r.status == 404
+            async with http.delete(api + "/api/v5/failpoints") as r:
+                assert r.status == 204
+            assert not fp.enabled
+        finally:
+            await http.close()
+            await srv.stop()
+
+    run(t())

@@ -22,6 +22,7 @@ from emqx_tpu.gateway.jt808 import (
     MSG_REGISTER,
     MSG_REGISTER_ACK,
     MSG_TEXT,
+    MSG_UNREGISTER,
     decode_location,
 )
 from mqtt_client import TestClient
@@ -133,15 +134,14 @@ def test_jt808_register_auth_location_downlink():
         assert ack.msg_id == MSG_GENERAL_ACK
         assert ack.body[-1] == 1  # failure: not authenticated
 
-        # -------- register mints an auth code
+        # -------- register mints an auth code (NO uplink publish yet:
+        # pre-auth frames must not reach the broker, ADVICE #5)
         term.send(MSG_REGISTER, b"\x00\x1f\x00\x23" + b"M" * 12)
         rack = await term.recv()
         assert rack.msg_id == MSG_REGISTER_ACK
         r_serial, result = struct.unpack_from(">HB", rack.body, 0)
         assert result == 0
         auth_code = rack.body[3:]
-        reg_up = await app.recv_publish()
-        assert reg_up.topic == "jt808/013800001111/up"
 
         # -------- wrong auth code denied, right one accepted
         term.send(MSG_AUTH, b"wrong")
@@ -150,8 +150,12 @@ def test_jt808_register_auth_location_downlink():
         term.send(MSG_AUTH, auth_code)
         ack = await term.recv()
         assert ack.msg_id == MSG_GENERAL_ACK and ack.body[-1] == 0
+        # the FIRST uplink the app sees is the post-auth one — nothing
+        # leaked from the pre-auth register/denied-auth frames
         auth_up = await app.recv_publish()
+        assert auth_up.topic == "jt808/013800001111/up"
         assert json.loads(auth_up.payload)["type"] == "auth"
+        assert srv.broker.metrics.val("gateway.jt808.preauth_drop") >= 1
 
         # -------- location report decodes to the up topic
         body = struct.pack(
@@ -178,6 +182,57 @@ def test_jt808_register_auth_location_downlink():
 
         term.close()
         await app.disconnect()
+        await srv.stop()
+
+    run(t())
+
+
+def test_jt808_reregister_does_not_overwrite_auth_code():
+    """A new connection re-registering an enrolled phone is refused
+    (0x8100 result 3) and the victim's auth code survives; after the
+    real terminal unregisters, a fresh register succeeds."""
+
+    async def t():
+        cfg = BrokerConfig()
+        cfg.listeners = [ListenerConfig(port=0)]
+        cfg.gateways = [
+            {"type": "jt808", "bind": "127.0.0.1", "port": 0}
+        ]
+        srv = BrokerServer(cfg)
+        await srv.start()
+        gw = srv.broker.gateways.get("jt808")
+        phone = "013800003333"
+
+        victim = await Terminal(gw.port, phone).connect()
+        victim.send(MSG_REGISTER, b"\x00\x01\x00\x01" + b"M" * 12)
+        rack = await victim.recv()
+        assert rack.body[2] == 0
+        code = rack.body[3:]
+
+        # attacker: same phone, new connection — refused, code intact
+        thief = await Terminal(gw.port, phone).connect()
+        thief.send(MSG_REGISTER, b"\x00\x01\x00\x01" + b"X" * 12)
+        tack = await thief.recv()
+        assert tack.msg_id == MSG_REGISTER_ACK
+        assert tack.body[2] == 3  # already registered: no code minted
+        assert tack.body[3:] == b""
+        assert gw.auth_codes[phone] == code.decode()
+        thief.close()
+
+        # the victim's code still authenticates
+        victim.send(MSG_AUTH, code)
+        ack = await victim.recv()
+        assert ack.msg_id == MSG_GENERAL_ACK and ack.body[-1] == 0
+
+        # unregister frees the phone; a fresh register then succeeds
+        victim.send(MSG_UNREGISTER)
+        await victim.recv()
+        fresh = await Terminal(gw.port, phone).connect()
+        fresh.send(MSG_REGISTER, b"\x00\x01\x00\x01" + b"M" * 12)
+        rack2 = await fresh.recv()
+        assert rack2.body[2] == 0 and rack2.body[3:] != b""
+        fresh.close()
+        victim.close()
         await srv.stop()
 
     run(t())

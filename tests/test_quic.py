@@ -132,6 +132,149 @@ def test_quic_loopback_streams(tmp_path):
     assert got == bytes(range(256)) * 200
 
 
+def test_send_stream_acked_prefix_trimmed(tmp_path):
+    """A long-lived connection must not retain every byte ever sent:
+    the acked prefix of a send stream is trimmed (base-offset rebase),
+    and PTO retransmission stays exact across the trim."""
+    pytest.importorskip("cryptography")
+    from emqx_tpu.quic.connection import QuicConnection
+
+    _cf, _kf, cert, key = make_cert(tmp_path)
+    srv = QuicConnection(True, cert_der=_der(cert), key=key)
+    cli = QuicConnection(False)
+    cli.connect()
+
+    def pump(n=50):
+        for _ in range(n):
+            moved = False
+            for d in cli.datagrams_to_send():
+                srv.receive_datagram(d)
+                moved = True
+            for d in srv.datagrams_to_send():
+                cli.receive_datagram(d)
+                moved = True
+            if not moved:
+                return
+
+    pump()
+    assert cli.handshake_complete
+    sid = cli.open_stream()
+    payload = bytes(range(256)) * 400  # 102400 bytes
+    cli.send_stream(sid, payload)
+    pump(200)
+    got = b"".join(e[2] for e in srv.events() if e[0] == "stream")
+    assert got == payload
+    st = cli._streams_out[sid]
+    # the server acked the stream: the buffer holds only the unacked
+    # tail, not the 100 KiB history
+    assert st.base > 90_000
+    assert len(st.data) < 8192
+    # a PTO after the trim retransmits only real data (no corruption)
+    cli.on_timeout()
+    pump(50)
+    cli.send_stream(sid, b"more-after-trim")
+    pump(50)
+    tail = b"".join(e[2] for e in srv.events() if e[0] == "stream")
+    assert tail.endswith(b"more-after-trim")
+
+
+def test_initial_flood_amplification_bounded(tmp_path):
+    """RFC 9000 §8.1: a spoofed-source Initial (valid ClientHello,
+    then silence) reflects at most 3x the received bytes — no
+    timer-driven PTO stream of cert flights to the victim."""
+    pytest.importorskip("cryptography")
+
+    async def t():
+        from emqx_tpu.broker.listener import BrokerServer
+        from emqx_tpu.quic.connection import QuicConnection
+
+        certfile, keyfile, _c, _k = make_cert(tmp_path)
+        cfg = BrokerConfig()
+        cfg.listeners = [
+            ListenerConfig(port=0),
+            ListenerConfig(name="q", type="quic", port=0,
+                           bind="127.0.0.1", certfile=certfile,
+                           keyfile=keyfile),
+        ]
+        srv = BrokerServer(cfg)
+        await srv.start()
+        qport = srv.quic_listeners[0].port
+
+        rx = []
+
+        class _Spoof(asyncio.DatagramProtocol):
+            def datagram_received(self, data, addr):
+                rx.append(len(data))
+
+        loop = asyncio.get_running_loop()
+        transport, _ = await loop.create_datagram_endpoint(
+            lambda: _Spoof(), remote_addr=("127.0.0.1", qport)
+        )
+        attacker = QuicConnection(False)
+        attacker.connect()
+        flights = attacker.datagrams_to_send()
+        sent = sum(len(d) for d in flights)
+        assert sent >= 1200
+        for d in flights:
+            transport.sendto(d)
+        # four PTO periods of silence: the old listener re-sent the
+        # full Initial+Handshake cert flight every 300ms
+        await asyncio.sleep(1.3)
+        reflected = sum(rx)
+        assert reflected <= 3 * sent, (
+            f"amplification {reflected}/{sent} exceeds 3x"
+        )
+        transport.close()
+        await srv.stop()
+
+    run(t())
+
+
+def test_handshake_phase_bridges_bounded_per_source(tmp_path):
+    """Half-open state is bounded: one source IP cannot mint unlimited
+    handshake-phase conn+Channel bridges, and runt (sub-1200-byte)
+    Initials never create state at all."""
+    pytest.importorskip("cryptography")
+
+    async def t():
+        from emqx_tpu.broker.listener import BrokerServer
+        from emqx_tpu.quic.connection import QuicConnection
+
+        certfile, keyfile, _c, _k = make_cert(tmp_path)
+        cfg = BrokerConfig()
+        cfg.listeners = [
+            ListenerConfig(port=0),
+            ListenerConfig(name="q", type="quic", port=0,
+                           bind="127.0.0.1", certfile=certfile,
+                           keyfile=keyfile),
+        ]
+        srv = BrokerServer(cfg)
+        await srv.start()
+        lst = srv.quic_listeners[0]
+        qport = lst.port
+        loop = asyncio.get_running_loop()
+        transport, _ = await loop.create_datagram_endpoint(
+            asyncio.DatagramProtocol,
+            remote_addr=("127.0.0.1", qport),
+        )
+        cap = lst.MAX_HANDSHAKES_PER_SOURCE
+        for _ in range(cap + 8):
+            c = QuicConnection(False)
+            c.connect()
+            for d in c.datagrams_to_send():
+                transport.sendto(d)
+        # a runt "Initial" (long header, no 1200-byte padding)
+        transport.sendto(b"\xc0\x00\x00\x00\x01\x08" + b"r" * 60)
+        await asyncio.sleep(0.3)
+        bridges = set(lst._by_cid.values())
+        assert len(bridges) <= cap
+        assert lst._hs_per_src.get("127.0.0.1", 0) <= cap
+        transport.close()
+        await srv.stop()
+
+    run(t())
+
+
 def test_mqtt_over_quic_end_to_end(tmp_path):
     """CONNECT / SUBSCRIBE / PUBLISH over a quic listener, cross-
     delivered to a TCP client — both directions."""
