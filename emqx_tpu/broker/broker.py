@@ -22,6 +22,7 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
+from ..aio import cancel_and_wait
 from ..access import AccessControl
 from ..config import BrokerConfig
 from ..engine import MatchEngine
@@ -1216,11 +1217,7 @@ class PublishBatcher:
 
     async def stop(self) -> None:
         if self._task is not None:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
+            await cancel_and_wait(self._task)
             self._task = None
 
     def _enqueue(self, source: object, entry: tuple) -> None:
@@ -1348,11 +1345,7 @@ class PublishBatcher:
                 # natural backpressure onto the collector
                 await inflight.put((batch, live, results, match_fut))
         finally:
-            self._dispatch_task.cancel()
-            try:
-                await self._dispatch_task
-            except asyncio.CancelledError:
-                pass
+            await cancel_and_wait(self._dispatch_task)
             self._dispatch_task = None
             # fail the futures of windows abandoned in flight: their
             # callers (mgmt publish, QoS ack callbacks) must not hang

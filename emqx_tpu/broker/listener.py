@@ -15,6 +15,7 @@ import os
 import sys
 from typing import Dict, List, Optional
 
+from ..aio import cancel_and_wait
 from ..config import BrokerConfig, ListenerConfig
 from .broker import Broker
 from .connection import Connection
@@ -592,11 +593,7 @@ class BrokerServer:
         await self.broker.rebalance.stop()
         await self.broker.purger.stop_purge()
         if self._housekeeper is not None:
-            self._housekeeper.cancel()
-            try:
-                await self._housekeeper
-            except asyncio.CancelledError:
-                pass
+            await cancel_and_wait(self._housekeeper)
             self._housekeeper = None
         if self.api is not None:
             await self.api.stop()

@@ -18,6 +18,7 @@ import asyncio
 import logging
 from typing import Dict, List, Optional, Tuple
 
+from ..aio import cancel_and_wait
 from ..codec import mqtt as C
 from ..quic.connection import QuicConnection
 from .channel import Channel
@@ -152,11 +153,7 @@ class QuicListener:
 
     async def stop(self) -> None:
         if self._pto_task is not None:
-            self._pto_task.cancel()
-            try:
-                await self._pto_task
-            except asyncio.CancelledError:
-                pass
+            await cancel_and_wait(self._pto_task)
             self._pto_task = None
         for bridge in list(self._by_cid.values()):
             bridge.conn.close(0)

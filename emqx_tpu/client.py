@@ -12,6 +12,7 @@ import itertools
 import logging
 from typing import Awaitable, Callable, Dict, List, Optional, Tuple
 
+from .aio import cancel_and_wait
 from .codec import mqtt as C
 from .message import Message
 
@@ -72,11 +73,7 @@ class MqttClient:
                 pass
             self._writer.close()
         if self._task is not None:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
+            await cancel_and_wait(self._task)
             self._task = None
 
     # ------------------------------------------------------- main loop

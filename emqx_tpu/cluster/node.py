@@ -25,6 +25,7 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..aio import cancel_and_wait
 from ..ds.replication import ReplicaStore, rendezvous_pick
 from ..message import Message
 from .routes import ClusterRouteTable
@@ -305,12 +306,9 @@ class ClusterNode:
     async def stop(self) -> None:
         self._started = False
         for t in self._tasks:
-            t.cancel()
+            t.cancel()  # request them all first, then reap
         for t in self._tasks:
-            try:
-                await t
-            except asyncio.CancelledError:
-                pass
+            await cancel_and_wait(t)
         self._tasks = []
         if self.raft_conf is not None:
             await self.raft_conf.stop()

@@ -41,6 +41,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .. import failpoints
+from ..aio import cancel_and_wait
 
 log = logging.getLogger("emqx_tpu.cluster.raft")
 
@@ -94,6 +95,10 @@ class RaftNode:
 
         self._timer: Optional[asyncio.TimerHandle] = None
         self._hb_task: Optional[asyncio.Task] = None
+        # fire-and-forget work (elections, replication nudges): the
+        # set keeps a strong reference until each task ends, so none
+        # is garbage-collected mid-flight with its exception dropped
+        self._bg: set = set()
         self._stopped = False
         self._meta_lock = threading.Lock()
         # when we last heard a (valid-term) AppendEntries: prevote
@@ -257,12 +262,11 @@ class RaftNode:
         self._stopped = True
         self._cancel_timer()
         if self._hb_task is not None:
-            self._hb_task.cancel()
-            try:
-                await self._hb_task
-            except asyncio.CancelledError:
-                pass
+            await cancel_and_wait(self._hb_task)
             self._hb_task = None
+        for task in list(self._bg):  # in-flight elections/nudges
+            await cancel_and_wait(task)
+        self._bg.clear()
         for waiters in self._commit_waiters.values():
             for fut in waiters:
                 if not fut.done():
@@ -288,10 +292,18 @@ class RaftNode:
             delay, self._election_timeout_fired
         )
 
+    def _spawn(self, coro) -> asyncio.Task:
+        """Retained fire-and-forget task (ASYNC105: a bare
+        ``create_task`` is GC-bait and swallows crashes)."""
+        task = asyncio.get_running_loop().create_task(coro)
+        self._bg.add(task)
+        task.add_done_callback(self._bg.discard)
+        return task
+
     def _election_timeout_fired(self) -> None:
         if self._stopped or self.role == LEADER:
             return
-        asyncio.get_running_loop().create_task(self._run_election())
+        self._spawn(self._run_election())
 
     # ------------------------------------------------------ elections
 
@@ -513,9 +525,7 @@ class RaftNode:
             self._set_commit(idx)
         else:
             # nudge replication now instead of waiting a heartbeat
-            asyncio.get_running_loop().create_task(
-                self._replicate_all_once()
-            )
+            self._spawn(self._replicate_all_once())
         return await asyncio.wait_for(fut, timeout)
 
     async def _replicate_all_once(self) -> None:

@@ -125,6 +125,11 @@ class PeerLink:
     async def cast_bin(self, mtype: str, payload: bytes) -> bool:
         """Fire-and-forget binary frame: payload bytes travel raw (no
         JSON/base64 re-encode — the message-forward hot path)."""
+        # per-peer FIFO + backpressure: holding the lock across
+        # connect/write/drain IS the design — it caps buffered bytes
+        # at one frame over the high-water mark per peer, and send
+        # order is the route-op stream's consistency guarantee
+        # brokerlint: ignore[ASYNC103]
         async with self._lock:
             try:
                 await self._ensure()
@@ -139,6 +144,8 @@ class PeerLink:
         """Fire-and-forget; returns False when the peer is unreachable
         (the caller decides whether that matters — async forward mode,
         emqx_broker.erl:387-391 forward_async)."""
+        # same per-peer FIFO/backpressure rationale as cast_bin
+        # brokerlint: ignore[ASYNC103]
         async with self._lock:
             try:
                 await self._ensure()
@@ -151,6 +158,10 @@ class PeerLink:
     async def call(
         self, obj: Dict[str, Any], timeout: float = 5.0
     ) -> Optional[Dict[str, Any]]:
+        # lock covers connect+register+write only — the reply is
+        # awaited OUTSIDE it, so slow calls don't serialize; the
+        # remaining IO under the lock is the FIFO/backpressure bound
+        # brokerlint: ignore[ASYNC103]
         async with self._lock:
             try:
                 await self._ensure()

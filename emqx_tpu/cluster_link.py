@@ -48,6 +48,7 @@ import json
 import logging
 from typing import Dict, List, Optional, Sequence, Set
 
+from . import failpoints
 from . import topic as T
 from .aio import cancel_and_wait
 from .client import MqttClient
@@ -384,6 +385,18 @@ class LinkServer:
             return None
         for cluster, filters in self.extern_routes.items():
             if any(T.match(topic, f) for f in filters):
+                if failpoints.enabled:
+                    # link-forward chaos seam, keyed by peer cluster so
+                    # a `match` filter partitions one link.  `drop`
+                    # loses the forward silently (the remote never
+                    # sees it); `error` raises into the publish hook's
+                    # recovery.  Sync seam on the loop thread — inject
+                    # latency at cluster.transport.* instead of here
+                    act = failpoints.evaluate(
+                        "cluster.link.forward", key=cluster
+                    )
+                    if act == "drop":
+                        continue
                 self.broker.metrics.inc("cluster_link.egress")
                 self.broker.publish(Message(
                     topic=MSG_PREFIX + cluster,

@@ -18,6 +18,7 @@ import asyncio
 import logging
 from typing import Dict, List, Set, Tuple
 
+from .. import failpoints
 from ..message import Message
 from .api import IterRef
 
@@ -36,6 +37,16 @@ class Beamformer:
     ) -> Tuple[IterRef, List[Message]]:
         """Long-poll one iterator: (advanced iterator, messages);
         empty after `timeout` with no new matching data."""
+        if failpoints.enabled:
+            # chaos seam: `delay` injects long-poll latency, `drop`
+            # answers this poll empty immediately (the timeout shape —
+            # a beam the reader missed; callers re-poll), `error`
+            # raises out to the poller's own recovery
+            act = await failpoints.evaluate_async(
+                "ds.beamformer.poll", key=str(it.stream.shard)
+            )
+            if act == "drop":
+                return it, []
         self.stats["polls"] += 1
         loop = asyncio.get_running_loop()
         deadline = loop.time() + timeout

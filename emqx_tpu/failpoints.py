@@ -61,6 +61,9 @@ SEAMS = (
     "kafka.produce",
     "resource.buffer.query",
     "exhook.call",
+    "ds.beamformer.poll",
+    "cluster.link.forward",
+    "s3.request",
 )
 
 enabled = False  # fast-path gate: disabled brokers pay one bool check

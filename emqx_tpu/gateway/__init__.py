@@ -17,6 +17,8 @@ import struct
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..aio import cancel_and_wait
+
 log = logging.getLogger("emqx_tpu.gateway")
 
 
@@ -230,11 +232,7 @@ class UdpGateway(Gateway):
 
     async def stop(self) -> None:
         if self._reaper is not None:
-            self._reaper.cancel()
-            try:
-                await self._reaper
-            except asyncio.CancelledError:
-                pass
+            await cancel_and_wait(self._reaper)
             self._reaper = None
         for addr in list(self._channels):
             self._drop_peer(addr, "server_stopped")

@@ -24,6 +24,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from ..access import PUBLISH, SUBSCRIBE, ClientInfo
+from ..aio import cancel_and_wait
 from ..codec import mqtt as C
 from ..message import Message
 from ..broker.session import SubOpts
@@ -651,11 +652,7 @@ class MqttSnGateway(UdpGateway):
 
     async def stop(self) -> None:
         if self._advertiser is not None:
-            self._advertiser.cancel()
-            try:
-                await self._advertiser
-            except asyncio.CancelledError:
-                pass
+            await cancel_and_wait(self._advertiser)
             self._advertiser = None
         await super().stop()
 

@@ -10,10 +10,14 @@ quicer/MsQuic slot, emqx_quic_connection.erl):
     HANDSHAKE_DONE frames;
   * client coalesces + pads its first flight to 1200 bytes; server
     coalesces Initial+Handshake replies;
-  * loss recovery is PTO-retransmission of unacked CRYPTO/STREAM data
-    (offset-tracked, so retransmits are exact); congestion control is
-    a fixed window — honest cut: loopback/LAN listeners, not WAN
-    bulk transfer;
+  * loss recovery is selective-ack based (recovery.py): each outgoing
+    packet records the (offset, length) CRYPTO/STREAM ranges it
+    carried, an ACK advances exactly those ranges, a packet 3 below
+    the largest acked (or any in-flight packet at PTO) is declared
+    lost and its unacked ranges retransmitted — so an earlier lost
+    packet is recovered even while later packets keep being acked;
+    congestion control is a fixed window — honest cut: loopback/LAN
+    listeners, not WAN bulk transfer;
   * explicit cuts: version negotiation, Retry, 0-RTT, key update,
     connection migration, stateless reset, flow-control ENFORCEMENT
     (windows are advertised large and respected by our own peer).
@@ -32,6 +36,7 @@ from cryptography.hazmat.primitives.ciphers import (
 )
 from cryptography.hazmat.primitives.ciphers.aead import AESGCM
 
+from .recovery import RangeTracker, RecoverySpace, SentPacket
 from .tls13 import HandshakeError, Tls13, hkdf_expand_label, hkdf_extract
 
 INITIAL_SALT_V1 = bytes.fromhex(
@@ -132,7 +137,8 @@ def encode_transport_params(scid: bytes,
 
 
 class _SendStream:
-    __slots__ = ("data", "base", "acked", "fin", "fin_sent")
+    __slots__ = ("data", "base", "acked", "fin", "fin_sent",
+                 "fin_acked", "acked_ranges", "retx")
 
     def __init__(self) -> None:
         self.data = b""     # unacked tail: stream bytes [base:]
@@ -140,6 +146,12 @@ class _SendStream:
         self.acked = 0      # is trimmed, so base tracks acked)
         self.fin = False
         self.fin_sent = False
+        self.fin_acked = False
+        # selective-ack state: which absolute ranges the peer acked
+        # (watermarks advance only over the contiguous prefix) and
+        # which lost ranges await retransmission
+        self.acked_ranges = RangeTracker()
+        self.retx: List[Tuple[int, int]] = []
 
 
 class _RecvStream:
@@ -199,6 +211,14 @@ class QuicConnection:
         }
         self._streams_out: Dict[int, _SendStream] = {}
         self._streams_sent: Dict[int, int] = {}
+        # selective-ack loss recovery: per-space record of which
+        # (offset, length) ranges each outgoing packet carried
+        # (recovery.py; an ack advances exactly those ranges)
+        self._spaces: Dict[int, RecoverySpace] = {
+            EPOCH_INITIAL: RecoverySpace(),
+            EPOCH_HANDSHAKE: RecoverySpace(),
+            EPOCH_APP: RecoverySpace(),
+        }
         self._streams_in: Dict[int, _RecvStream] = {}
         self._events: List[tuple] = []
         self.handshake_complete = False
@@ -265,17 +285,32 @@ class QuicConnection:
         return out
 
     def on_timeout(self) -> None:
-        """PTO: re-arm unacked crypto/stream data for retransmission
-        and emit a fresh flight."""
+        """PTO: the ack stream went quiet — declare every in-flight
+        packet lost, queue its still-unacked ranges, emit a fresh
+        flight (exact ranges, not a full-history replay)."""
         for epoch in (EPOCH_INITIAL, EPOCH_HANDSHAKE, EPOCH_APP):
-            self._crypto_sent[epoch] = min(
-                self._crypto_sent[epoch], 0
-            )
-        for sid, st in self._streams_out.items():
-            self._streams_sent[sid] = st.acked
-            if st.fin:
-                st.fin_sent = False
+            self._requeue_lost(epoch, self._spaces[epoch].on_pto())
         self._flush()
+
+    def _requeue_lost(self, epoch: int, lost: List[SentPacket]) -> None:
+        """Queue the not-yet-acked ranges of lost packets for
+        retransmission (acks that raced the loss declaration win)."""
+        space = self._spaces[epoch]
+        crypto: List[Tuple[int, int]] = []
+        for pkt in lost:
+            crypto.extend(pkt.crypto)
+            for sid, off, end in pkt.streams:
+                st = self._streams_out.get(sid)
+                if st is None:
+                    continue
+                st.retx.extend(
+                    st.acked_ranges.missing_within(off, end)
+                )
+            for sid in pkt.fins:
+                st = self._streams_out.get(sid)
+                if st is not None and not st.fin_acked:
+                    st.fin_sent = False  # re-send the FIN
+        space.queue_crypto_retx(crypto)
 
     # ------------------------------------------------------ receiving
 
@@ -548,27 +583,43 @@ class QuicConnection:
         if ftype == F_ACK + 1:  # ECN counts
             for _ in range(3):
                 _v, off = dec_varint(payload, off)
+        # all ranges of this ACK processed: anything still in flight
+        # PACKET_THRESHOLD below the largest acked pn was lost under
+        # selective loss — queue its ranges for retransmission (the
+        # ensuing _flush sends them)
+        self._requeue_lost(epoch, self._spaces[epoch].detect_lost())
         return off
 
     def _on_acked_range(self, epoch: int, lo: int, hi: int) -> None:
-        # minimal recovery bookkeeping: an ack of our latest pn means
-        # the crypto/stream data sent so far arrived — advance the
-        # acked watermarks so PTO retransmits only the real tail
-        if hi >= self._pn[epoch] - 1:
-            self._crypto_sent[epoch] = max(
-                self._crypto_sent[epoch], len(self._crypto_out[epoch])
-            )
-            if epoch == EPOCH_APP:
-                for sid, st in self._streams_out.items():
-                    sent = self._streams_sent.get(sid, 0)
-                    st.acked = max(st.acked, sent)
-                    if st.acked > st.base:
-                        # drop the acked prefix: a long-lived
-                        # subscriber must not retain every byte ever
-                        # delivered to it (offsets stay absolute;
-                        # only indexing into `data` rebases)
-                        st.data = st.data[st.acked - st.base:]
-                        st.base = st.acked
+        """Selective ack: advance EXACTLY the ranges the acked packet
+        numbers carried (recovery.py records them per packet).  The
+        old model treated an ack of the latest pn as cumulative — a
+        lost earlier packet's bytes were never retransmitted and the
+        receiver wedged until idle timeout."""
+        touched = set()
+        for pkt in self._spaces[epoch].on_ack_range(lo, hi):
+            for sid, soff, send_ in pkt.streams:
+                st = self._streams_out.get(sid)
+                if st is not None:
+                    st.acked_ranges.add(soff, send_)
+                    touched.add(sid)
+            for sid in pkt.fins:
+                st = self._streams_out.get(sid)
+                if st is not None:
+                    st.fin_acked = True
+        for sid in touched:
+            st = self._streams_out[sid]
+            new_acked = st.acked_ranges.contiguous_from(st.acked)
+            if new_acked > st.acked:
+                st.acked = new_acked
+                if st.acked > st.base:
+                    # drop the acked prefix: a long-lived subscriber
+                    # must not retain every byte ever delivered to it
+                    # (offsets stay absolute; only indexing into
+                    # `data` rebases)
+                    st.data = st.data[st.acked - st.base:]
+                    st.base = st.acked
+                st.acked_ranges.prune_below(st.acked)
 
     # -------------------------------------------------------- sending
 
@@ -594,25 +645,50 @@ class QuicConnection:
         send, _recv = self._keys[epoch]
         if send is None:
             return b""
+        space = self._spaces[epoch]
         frames = b""
+        rec = SentPacket()
         if self._ack_due[epoch]:
             frames += self._ack_frame(epoch)
             self._ack_due[epoch] = False
+        # lost ranges first (exact retransmission), then the new tail
+        for off, end in space.take_crypto_retx():
+            data = self._crypto_out[epoch][off:end]
+            if not data:
+                continue
+            frames += (bytes([F_CRYPTO]) + enc_varint(off)
+                       + enc_varint(len(data)) + data)
+            rec.crypto.append((off, off + len(data)))
         pending = self._crypto_out[epoch][self._crypto_sent[epoch]:]
         if pending:
-            frames += (bytes([F_CRYPTO])
-                       + enc_varint(self._crypto_sent[epoch])
+            off = self._crypto_sent[epoch]
+            frames += (bytes([F_CRYPTO]) + enc_varint(off)
                        + enc_varint(len(pending)) + pending)
+            rec.crypto.append((off, off + len(pending)))
             self._crypto_sent[epoch] = len(self._crypto_out[epoch])
         if not frames:
             return b""
-        return self._build_packet(epoch, frames)
+        pkt = self._build_packet(epoch, frames)
+        if pkt:
+            space.record(self._pn[epoch] - 1, rec)
+        return pkt
+
+    @staticmethod
+    def _stream_frame(sid: int, off: int, chunk: bytes,
+                      fin: bool) -> bytes:
+        return (
+            bytes([F_STREAM_BASE | 0x04 | 0x02 | (0x01 if fin else 0)])
+            + enc_varint(sid) + enc_varint(off)
+            + enc_varint(len(chunk)) + chunk
+        )
 
     def _build_app_packet(self) -> bytes:
         send, _ = self._keys[EPOCH_APP]
         if send is None:
             return b""
+        space = self._spaces[EPOCH_APP]
         frames = b""
+        rec = SentPacket()
         if self._ack_due[EPOCH_APP]:
             frames += self._ack_frame(EPOCH_APP)
             self._ack_due[EPOCH_APP] = False
@@ -620,8 +696,44 @@ class QuicConnection:
                 and not self._handshake_done_sent):
             frames += bytes([F_DONE])
             self._handshake_done_sent = True
+
+        def flush_packet() -> None:
+            # split across datagrams, recording per-packet carriage
+            nonlocal frames, rec
+            pkt = self._build_packet(EPOCH_APP, frames)
+            if pkt:
+                space.record(self._pn[EPOCH_APP] - 1, rec)
+                self._out_datagrams.append(pkt)
+            frames = b""
+            rec = SentPacket()
+
         if self.handshake_complete:
             for sid, st in self._streams_out.items():
+                # 1) lost ranges (selective retransmission), re-checked
+                #    against acks that landed after the loss call
+                retx, st.retx = st.retx, []
+                for lo, hi in retx:
+                    for roff, rend in st.acked_ranges.missing_within(
+                        lo, hi
+                    ):
+                        roff = max(roff, st.base)  # below base == acked
+                        while roff < rend:
+                            chunk = st.data[
+                                roff - st.base:
+                                min(rend, roff + 1100) - st.base
+                            ]
+                            if not chunk:
+                                break
+                            frames += self._stream_frame(
+                                sid, roff, chunk, False
+                            )
+                            rec.streams.append(
+                                (sid, roff, roff + len(chunk))
+                            )
+                            roff += len(chunk)
+                            if len(frames) > 1100:
+                                flush_packet()
+                # 2) the new tail
                 sent = self._streams_sent.get(sid, 0)
                 pending = st.data[sent - st.base:]
                 send_fin = st.fin and not st.fin_sent
@@ -629,25 +741,27 @@ class QuicConnection:
                     chunk = pending[:1100]
                     pending = pending[len(chunk):]
                     fin_flag = st.fin and not pending
-                    frames += (
-                        bytes([F_STREAM_BASE | 0x04 | 0x02
-                               | (0x01 if fin_flag else 0)])
-                        + enc_varint(sid) + enc_varint(sent)
-                        + enc_varint(len(chunk)) + chunk
+                    frames += self._stream_frame(
+                        sid, sent, chunk, fin_flag
                     )
+                    if chunk:
+                        rec.streams.append(
+                            (sid, sent, sent + len(chunk))
+                        )
                     sent += len(chunk)
                     if fin_flag:
+                        rec.fins.append(sid)
                         st.fin_sent = True
                         send_fin = False
                     if len(frames) > 1100:
-                        # split across packets
-                        pkt = self._build_packet(EPOCH_APP, frames)
-                        self._out_datagrams.append(pkt)
-                        frames = b""
+                        flush_packet()
                 self._streams_sent[sid] = sent
         if not frames:
             return b""
-        return self._build_packet(EPOCH_APP, frames)
+        pkt = self._build_packet(EPOCH_APP, frames)
+        if pkt:
+            space.record(self._pn[EPOCH_APP] - 1, rec)
+        return pkt
 
     def _ack_frame(self, epoch: int) -> bytes:
         pns = sorted(self._recv_pns[epoch])

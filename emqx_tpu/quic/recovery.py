@@ -1,0 +1,175 @@
+"""Selective-ACK loss recovery bookkeeping (RFC 9002 shapes).
+
+Crypto-free on purpose: connection.py needs the ``cryptography``
+package for packet protection, but WHICH bytes each packet carried and
+WHICH of them were acked is pure range arithmetic — keeping it here
+lets the recovery model be unit-tested in environments without the
+crypto dependency.
+
+The model (per packet-number space):
+
+  * every ack-eliciting packet records the (offset, length) ranges of
+    CRYPTO and STREAM data it carried (`SentPacket`);
+  * an ACK frame acks exact packet numbers — only the ranges THOSE
+    packets carried become acked (`RangeTracker`), so an ack of the
+    latest packet no longer implies anything about earlier ones
+    (the pre-selective-ack model treated it as cumulative, and a lost
+    earlier packet's bytes were never retransmitted: the receiver
+    wedged until idle timeout);
+  * a packet ``kPacketThreshold`` (3, RFC 9002 §6.1.1) below the
+    largest acked is declared lost: its still-unacked ranges are
+    queued for retransmission;
+  * PTO declares every in-flight packet lost the same way (the
+    timer-driven fallback when acks stop entirely).
+
+Send-stream watermarks advance only over the CONTIGUOUS acked prefix,
+so the buffer trim (base-offset rebase, PR 1) stays exact under
+selective loss.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+PACKET_THRESHOLD = 3  # RFC 9002 §6.1.1 kPacketThreshold
+
+
+class RangeTracker:
+    """Sorted, disjoint, half-open ``[start, end)`` ranges."""
+
+    __slots__ = ("ranges",)
+
+    def __init__(self) -> None:
+        self.ranges: List[Tuple[int, int]] = []
+
+    def add(self, start: int, end: int) -> None:
+        if end <= start:
+            return
+        out: List[Tuple[int, int]] = []
+        for s, e in self.ranges:
+            if e < start or s > end:  # disjoint (touching merges)
+                out.append((s, e))
+            else:
+                start, end = min(s, start), max(e, end)
+        out.append((start, end))
+        out.sort()
+        self.ranges = out
+
+    def contiguous_from(self, base: int) -> int:
+        """Furthest offset reachable from `base` through acked ranges
+        (== `base` when the next byte is unacked)."""
+        for s, e in self.ranges:
+            if s <= base < e or s == base:
+                return max(e, base)
+        return base
+
+    def missing_within(self, start: int, end: int) -> List[Tuple[int, int]]:
+        """The sub-ranges of ``[start, end)`` NOT yet acked."""
+        out: List[Tuple[int, int]] = []
+        cur = start
+        for s, e in self.ranges:
+            if e <= cur:
+                continue
+            if s >= end:
+                break
+            if s > cur:
+                out.append((cur, min(s, end)))
+            cur = max(cur, e)
+            if cur >= end:
+                return out
+        if cur < end:
+            out.append((cur, end))
+        return out
+
+    def prune_below(self, floor: int) -> None:
+        """Drop bookkeeping for bytes below `floor` (already consumed
+        by the contiguous watermark) to bound long-lived connections."""
+        self.ranges = [
+            (max(s, floor), e) for s, e in self.ranges if e > floor
+        ]
+
+
+class SentPacket:
+    """What one ack-eliciting packet carried."""
+
+    __slots__ = ("crypto", "streams", "fins")
+
+    def __init__(self) -> None:
+        self.crypto: List[Tuple[int, int]] = []        # (off, end)
+        self.streams: List[Tuple[int, int, int]] = []  # (sid, off, end)
+        self.fins: List[int] = []                      # sids with FIN
+
+    @property
+    def ack_eliciting(self) -> bool:
+        return bool(self.crypto or self.streams or self.fins)
+
+
+class RecoverySpace:
+    """Per packet-number space: in-flight packets + acked-range state
+    for the crypto stream (application streams keep their trackers on
+    the stream objects; this class still routes their packet records).
+    """
+
+    __slots__ = ("sent", "crypto_acked", "crypto_retx",
+                 "largest_acked")
+
+    def __init__(self) -> None:
+        self.sent: Dict[int, SentPacket] = {}
+        self.crypto_acked = RangeTracker()
+        self.crypto_retx: List[Tuple[int, int]] = []
+        self.largest_acked = -1
+
+    # ------------------------------------------------------ recording
+
+    def record(self, pn: int, pkt: SentPacket) -> None:
+        if pkt.ack_eliciting:
+            self.sent[pn] = pkt
+
+    # ----------------------------------------------------------- acks
+
+    def on_ack_range(self, lo: int, hi: int) -> List[SentPacket]:
+        """Pop and return the records of acked packet numbers."""
+        lo = max(lo, 0)
+        self.largest_acked = max(self.largest_acked, hi)
+        out: List[SentPacket] = []
+        if hi - lo > len(self.sent) * 4:  # sparse dict, wide range
+            for pn in [p for p in self.sent if lo <= p <= hi]:
+                out.append(self.sent.pop(pn))
+        else:
+            for pn in range(lo, hi + 1):
+                pkt = self.sent.pop(pn, None)
+                if pkt is not None:
+                    out.append(pkt)
+        for pkt in out:
+            for off, end in pkt.crypto:
+                self.crypto_acked.add(off, end)
+        return out
+
+    def detect_lost(self) -> List[SentPacket]:
+        """Packets `PACKET_THRESHOLD` below the largest acked are lost
+        (RFC 9002 time-threshold is approximated by the PTO timer)."""
+        cutoff = self.largest_acked - PACKET_THRESHOLD
+        lost_pns = sorted(pn for pn in self.sent if pn <= cutoff)
+        return [self.sent.pop(pn) for pn in lost_pns]
+
+    def on_pto(self) -> List[SentPacket]:
+        """Declare everything in flight lost (ack stream went quiet)."""
+        pns = sorted(self.sent)
+        return [self.sent.pop(pn) for pn in pns]
+
+    # ------------------------------------------------- retransmission
+
+    def queue_crypto_retx(self, ranges: List[Tuple[int, int]]) -> None:
+        """Queue the still-unacked parts of lost crypto ranges."""
+        for off, end in ranges:
+            for s, e in self.crypto_acked.missing_within(off, end):
+                self.crypto_retx.append((s, e))
+
+    def take_crypto_retx(self) -> List[Tuple[int, int]]:
+        """Drain the retx queue, re-filtering against acks that landed
+        after queueing (a spurious-loss ack beats a retransmit)."""
+        out: List[Tuple[int, int]] = []
+        for off, end in self.crypto_retx:
+            out.extend(self.crypto_acked.missing_within(off, end))
+        self.crypto_retx = []
+        return out

@@ -15,6 +15,8 @@ import logging
 import time
 from typing import Optional
 
+from .aio import cancel_and_wait
+
 log = logging.getLogger("emqx_tpu.rebalance")
 
 RC_USE_ANOTHER_SERVER = 0x9C
@@ -73,11 +75,7 @@ class EvictionAgent:
 
     async def stop_evacuation(self) -> None:
         if self._task is not None:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
+            await cancel_and_wait(self._task)
             self._task = None
         if self.status == "evacuating":
             self.status = "stopped"
@@ -138,11 +136,7 @@ class PurgeAgent:
 
     async def stop_purge(self) -> None:
         if self._task is not None:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
+            await cancel_and_wait(self._task)
             self._task = None
         if self.status == "purging":
             self.status = "stopped"
@@ -275,11 +269,7 @@ class RebalanceCoordinator:
     async def stop_local(self) -> None:
         """Cancel this node's shed only (a remote coordinator's stop)."""
         if self._task is not None:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
+            await cancel_and_wait(self._task)
             self._task = None
         self.status = "idle"
 
@@ -287,11 +277,7 @@ class RebalanceCoordinator:
         """Stop the local shed AND any remote donors this coordinator
         started (the plan remembers them)."""
         if self._task is not None:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
+            await cancel_and_wait(self._task)
             self._task = None
         ext = self.broker.external
         if ext is not None and self.plan:

@@ -21,6 +21,8 @@ import logging
 from typing import Dict, Optional, Tuple
 from urllib.parse import quote
 
+from . import failpoints
+
 log = logging.getLogger("emqx_tpu.s3")
 
 
@@ -105,6 +107,19 @@ class S3Client:
     async def _request(self, method: str, key: str, payload: bytes = b""):
         import aiohttp
 
+        if failpoints.enabled:
+            # exporter chaos seam: `error` (a ConnectionError) rides
+            # the sink's real retry/health-check path, `delay` injects
+            # slow-S3 latency, `drop` models a response the network
+            # ate — surfaced immediately as the ConnectionError the
+            # client timeout would eventually raise
+            act = await failpoints.evaluate_async(
+                "s3.request", key=f"{method} {key}"
+            )
+            if act == "drop":
+                raise failpoints.FailpointError(
+                    f"s3.request response dropped ({method} {key})"
+                )
         if self._session is None:
             self._session = aiohttp.ClientSession(
                 timeout=aiohttp.ClientTimeout(total=30)

@@ -96,7 +96,10 @@ def test_probe_recloses_breaker_after_fault_clears():
     fp.clear("engine.device_step")
     eng.match_batch(["dev/1/x"])
     wait_until(lambda: not eng.breaker_info()["open"], what="re-close")
-    assert len(clears) == 1
+    # the probe thread flips `open` BEFORE it runs the clear callback:
+    # waiting on the flag alone races the callback (observed flaky
+    # under load) — wait for the callback itself
+    wait_until(lambda: len(clears) == 1, what="clear callback")
     assert eng.match_batch(["dev/2/x"])[0] == {"w2"}
     assert eng.breaker_info()["consecutive_failures"] == 0
 

@@ -346,3 +346,127 @@ def test_failpoints_rest_and_ctl(tmp_path):
             await srv.stop()
 
     run(t())
+
+
+# ------------------------------------- new seams (brokerlint FP301)
+# ds.beamformer.poll / cluster.link.forward / s3.request — each seam
+# is declared in tools/brokerlint/failpointrules.py:SEAM_FUNCS, so
+# removing the evaluate call from the production function fails the
+# tier-1 lint gate, and each gets one chaos test here.
+
+
+def test_beamformer_poll_failpoint_drop_error_delay():
+    """`drop` answers a poll empty immediately (the timeout shape,
+    even though data IS available), `error` raises to the poller,
+    `delay` injects long-poll latency — all keyed by shard."""
+    from emqx_tpu.ds.api import IterRef, StreamRef
+    from emqx_tpu.ds.beamformer import Beamformer
+
+    class OneShotStorage:
+        def next(self, it, n):
+            return it, ["msg"]  # data is always there
+
+    bf = Beamformer(OneShotStorage())
+    it = IterRef(StreamRef(shard=3), "t/#")
+
+    async def t():
+        # baseline: data comes straight back
+        _it2, msgs = await bf.poll(it, timeout=0.5)
+        assert msgs == ["msg"]
+
+        fp.configure("ds.beamformer.poll", "drop")
+        _it2, msgs = await bf.poll(it, timeout=5.0)
+        assert msgs == []  # dropped despite available data, no park
+
+        # match filter partitions one shard: shard 3 matches, fires
+        fp.configure("ds.beamformer.poll", "error", match="3")
+        with pytest.raises(fp.FailpointError):
+            await bf.poll(it, timeout=0.5)
+        # a different shard's poll sails through
+        other = IterRef(StreamRef(shard=7), "t/#")
+        _it2, msgs = await bf.poll(other, timeout=0.5)
+        assert msgs == ["msg"]
+
+        fp.configure("ds.beamformer.poll", "delay", delay=0.05)
+        t0 = time.monotonic()
+        _it2, msgs = await bf.poll(it, timeout=5.0)
+        assert msgs == ["msg"]
+        assert time.monotonic() - t0 >= 0.045
+
+    run(t())
+
+
+def test_cluster_link_forward_failpoint_partitions_one_peer():
+    """`drop` on cluster.link.forward loses the egress copy for the
+    MATCHED peer cluster only — the other linked cluster still gets
+    its wrapped message (a one-link partition)."""
+    from emqx_tpu.cluster_link import MSG_PREFIX, LinkServer
+    from emqx_tpu.message import Message
+
+    class FakeMetrics:
+        def __init__(self):
+            self.counts = {}
+
+        def inc(self, k, n=1):
+            self.counts[k] = self.counts.get(k, 0) + n
+
+    class FakeBroker:
+        def __init__(self):
+            self.metrics = FakeMetrics()
+            self.published = []
+
+        def publish(self, msg):
+            self.published.append(msg)
+            return 1
+
+    broker = FakeBroker()
+    srv = LinkServer(broker, "local", allowed={"east", "west"})
+    srv.extern_routes = {"east": {"t/#"}, "west": {"t/#"}}
+
+    msg = Message(topic="t/x", payload=b"hi")
+    srv._on_publish(msg)
+    assert sorted(m.topic for m in broker.published) == [
+        MSG_PREFIX + "east", MSG_PREFIX + "west",
+    ]
+
+    broker.published.clear()
+    fp.configure("cluster.link.forward", "drop", match="east")
+    srv._on_publish(msg)
+    assert [m.topic for m in broker.published] == [MSG_PREFIX + "west"]
+    assert broker.metrics.counts.get("cluster_link.egress") == 3  # 2+1
+
+    # unarmed again: both flow (the seam is behavior-free when clear)
+    fp.clear()
+    broker.published.clear()
+    srv._on_publish(msg)
+    assert len(broker.published) == 2
+
+
+def test_s3_request_failpoint_rides_sink_health_path():
+    """An injected s3.request fault is a ConnectionError: S3Sink's
+    health probe reports down, and the resource layer's retry path
+    sees the same exception shape a real S3 outage produces — without
+    aiohttp ever being touched."""
+    from emqx_tpu.s3 import S3Client, S3Sink
+
+    client = S3Client("http://s3.test", "bkt", "ak", "sk")
+    sink = S3Sink(client)
+
+    async def t():
+        fp.configure("s3.request", "error")
+        with pytest.raises(fp.FailpointError):
+            await client.put_object("k", b"v")
+        assert await sink.health_check() is False
+
+        # drop: the response never arrives — surfaced as the same
+        # ConnectionError family the client timeout would raise
+        fp.configure("s3.request", "drop")
+        with pytest.raises(ConnectionError):
+            await client.get_object("k")
+
+        # match keys on "METHOD key": partition deletes only
+        fp.configure("s3.request", "error", match="DELETE ")
+        with pytest.raises(fp.FailpointError):
+            await client.delete_object("k")
+
+    run(t())

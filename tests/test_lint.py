@@ -1,0 +1,472 @@
+"""brokerlint (tools/brokerlint): per-rule fixtures — each rule family
+fires on a known-bad snippet, stays silent on the fixed shape, and
+honors `# brokerlint: ignore[...]` — plus the tier-1 GATE: the repo
+must produce zero findings beyond the checked-in baseline, and the
+baseline must match a fresh run exactly (no stale entries: burned-down
+debt leaves the file too).
+
+The gate is why this lives in tests/: `python -m pytest tests/` and
+`python -m tools.brokerlint` enforce the identical contract (same
+run_lint/diff_baseline code path)."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+from emqx_tpu import failpoints
+from tools.brokerlint import (
+    DEFAULT_BASELINE, SEAM_FUNCS, Seam, analyze_source, diff_baseline,
+    load_baseline, run_lint,
+)
+
+
+def rules_of(src, path="fixture.py", seams=()):
+    return [f.rule for f in analyze_source(src, path, seams=seams)]
+
+
+# ----------------------------------------------------------- ASYNC101
+
+def test_async101_blocking_call():
+    bad = (
+        "import time\n"
+        "async def f():\n"
+        "    time.sleep(1)\n"
+    )
+    assert "ASYNC101" in rules_of(bad)
+    # sync function: fine
+    ok = "import time\ndef f():\n    time.sleep(1)\n"
+    assert "ASYNC101" not in rules_of(ok)
+    # the async equivalent: fine
+    ok2 = "import asyncio\nasync def f():\n    await asyncio.sleep(1)\n"
+    assert rules_of(ok2) == []
+    # a sync closure INSIDE an async def is sync code
+    ok3 = (
+        "import time\n"
+        "async def f():\n"
+        "    def cb():\n"
+        "        time.sleep(1)\n"
+        "    return cb\n"
+    )
+    assert "ASYNC101" not in rules_of(ok3)
+
+
+def test_async101_suppression_comment():
+    bad = (
+        "import time\n"
+        "async def f():\n"
+        "    time.sleep(1)  # brokerlint: ignore[ASYNC101]\n"
+    )
+    assert rules_of(bad) == []
+    above = (
+        "import time\n"
+        "async def f():\n"
+        "    # justified because fixture\n"
+        "    # brokerlint: ignore[*]\n"
+        "    time.sleep(1)\n"
+    )
+    assert rules_of(above) == []
+    # suppressing a DIFFERENT rule does not silence this one
+    wrong = (
+        "import time\n"
+        "async def f():\n"
+        "    time.sleep(1)  # brokerlint: ignore[ASYNC102]\n"
+    )
+    assert "ASYNC101" in rules_of(wrong)
+
+
+# ----------------------------------------------------------- ASYNC102
+
+def test_async102_sync_wait():
+    bad = (
+        "async def f(fut):\n"
+        "    return fut.result()\n"
+    )
+    assert "ASYNC102" in rules_of(bad)
+    bad_join = "async def f(t):\n    t.join()\n"
+    assert "ASYNC102" in rules_of(bad_join)
+    bad_join_to = "async def f(t):\n    t.join(5)\n"
+    assert "ASYNC102" in rules_of(bad_join_to)
+    # str.join shapes must NOT fire (their signature differs)
+    ok = (
+        "async def f(parts):\n"
+        "    return ', '.join(parts)\n"
+    )
+    assert "ASYNC102" not in rules_of(ok)
+    # a done-callback (sync def nested in async) legally calls result()
+    ok2 = (
+        "async def f(task):\n"
+        "    def done(t):\n"
+        "        return t.result()\n"
+        "    task.add_done_callback(done)\n"
+    )
+    assert "ASYNC102" not in rules_of(ok2)
+
+
+# ----------------------------------------------------------- ASYNC103
+
+def test_async103_lock_across_io():
+    bad = (
+        "import asyncio\n"
+        "class C:\n"
+        "    async def send(self, w):\n"
+        "        async with self._lock:\n"
+        "            w.write(b'x')\n"
+        "            await w.drain()\n"
+    )
+    assert "ASYNC103" in rules_of(bad)
+    # one level of same-module indirection resolves
+    indirect = (
+        "import asyncio\n"
+        "class C:\n"
+        "    async def _ensure(self):\n"
+        "        await asyncio.open_connection('h', 1)\n"
+        "    async def send(self):\n"
+        "        async with self._lock:\n"
+        "            await self._ensure()\n"
+    )
+    assert "ASYNC103" in rules_of(indirect)
+    # lock around pure computation: fine
+    ok = (
+        "import asyncio\n"
+        "class C:\n"
+        "    async def bump(self):\n"
+        "        async with self._lock:\n"
+        "            self.n += 1\n"
+    )
+    assert "ASYNC103" not in rules_of(ok)
+    # suppression on the async-with line
+    suppressed = (
+        "import asyncio\n"
+        "class C:\n"
+        "    async def send(self, w):\n"
+        "        # brokerlint: ignore[ASYNC103]\n"
+        "        async with self._lock:\n"
+        "            await w.drain()\n"
+    )
+    assert rules_of(suppressed) == []
+
+
+def test_async103_nested_def_under_lock_not_flagged():
+    """An IO-awaiting closure DEFINED (not run) under the lock is not
+    a lock-across-IO: the subtree is pruned."""
+    ok = (
+        "import asyncio\n"
+        "class C:\n"
+        "    async def send(self, w):\n"
+        "        async with self._lock:\n"
+        "            async def helper():\n"
+        "                await w.drain()\n"
+        "            self.h = helper\n"
+    )
+    assert "ASYNC103" not in rules_of(ok)
+
+
+# ----------------------------------------------------------- ASYNC104
+
+def test_async104_cancel_then_await_in_stop():
+    bad = (
+        "import asyncio\n"
+        "class C:\n"
+        "    async def stop(self):\n"
+        "        self._task.cancel()\n"
+        "        try:\n"
+        "            await self._task\n"
+        "        except asyncio.CancelledError:\n"
+        "            pass\n"
+    )
+    assert "ASYNC104" in rules_of(bad)
+    bad_wf = (
+        "import asyncio\n"
+        "class C:\n"
+        "    async def close(self):\n"
+        "        self._task.cancel()\n"
+        "        await asyncio.wait_for(self._task, 2)\n"
+    )
+    assert "ASYNC104" in rules_of(bad_wf)
+    # the fixed shape: aio.cancel_and_wait
+    ok = (
+        "from emqx_tpu.aio import cancel_and_wait\n"
+        "class C:\n"
+        "    async def stop(self):\n"
+        "        await cancel_and_wait(self._task)\n"
+    )
+    assert "ASYNC104" not in rules_of(ok)
+    # wait_for around a fresh COROUTINE (not a stored task): fine
+    ok2 = (
+        "import asyncio\n"
+        "class C:\n"
+        "    async def stop(self):\n"
+        "        self._server.close()\n"
+        "        await asyncio.wait_for(self._server.wait_closed(), 2)\n"
+    )
+    assert "ASYNC104" not in rules_of(ok2)
+    # same pattern OUTSIDE a stop path: not this rule's business
+    ok3 = (
+        "import asyncio\n"
+        "class C:\n"
+        "    async def rotate(self):\n"
+        "        self._task.cancel()\n"
+        "        await self._task\n"
+    )
+    assert "ASYNC104" not in rules_of(ok3)
+
+
+# ----------------------------------------------------------- ASYNC105
+
+def test_async105_dropped_task():
+    bad = (
+        "import asyncio\n"
+        "def kick(loop):\n"
+        "    loop.create_task(work())\n"
+    )
+    assert "ASYNC105" in rules_of(bad)
+    ok_kept = (
+        "import asyncio\n"
+        "def kick(self, loop):\n"
+        "    self._t = loop.create_task(work())\n"
+    )
+    assert "ASYNC105" not in rules_of(ok_kept)
+    ok_cb = (
+        "import asyncio\n"
+        "def kick(loop, tasks):\n"
+        "    loop.create_task(work()).add_done_callback(tasks.discard)\n"
+    )
+    assert "ASYNC105" not in rules_of(ok_cb)
+
+
+# ---------------------------------------------------------- DEVICE2xx
+
+def test_device201_host_sync_in_jit():
+    bad = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x.sum().item()\n"
+    )
+    assert "DEVICE201" in rules_of(bad)
+    bad_cast = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return float(x)\n"
+    )
+    assert "DEVICE201" in rules_of(bad_cast)
+    # float() of a STATIC arg is host math at trace time: fine
+    ok = (
+        "import jax\n"
+        "from functools import partial\n"
+        "@partial(jax.jit, static_argnames=('n',))\n"
+        "def f(x, *, n):\n"
+        "    return x * float(n)\n"
+    )
+    assert "DEVICE201" not in rules_of(ok)
+    # .item() outside jit is ordinary host code
+    ok2 = "def g(x):\n    return x.item()\n"
+    assert rules_of(ok2) == []
+
+
+def test_device202_tracer_branch_in_jit():
+    bad = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if x > 0:\n"
+        "        return x\n"
+        "    return -x\n"
+    )
+    assert "DEVICE202" in rules_of(bad)
+    # branching on shape or a static arg is resolved at trace time
+    ok = (
+        "import jax\n"
+        "from functools import partial\n"
+        "@partial(jax.jit, static_argnames=('n',))\n"
+        "def f(x, *, n):\n"
+        "    if n > 0 and x.shape[0] > 1:\n"
+        "        return x\n"
+        "    return -x\n"
+    )
+    assert "DEVICE202" not in rules_of(ok)
+
+
+def test_device203_host_numpy_in_jit():
+    bad = (
+        "import jax\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return np.asarray(x)\n"
+    )
+    assert "DEVICE203" in rules_of(bad)
+    # np on static/constant values builds trace-time constants: fine
+    # (the match kernel's `h0 & np.uint32(nb - 1)` shape)
+    ok = (
+        "import jax\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    nb = x.shape[0]\n"
+        "    return x & np.uint32(nb - 1)\n"
+    )
+    assert "DEVICE203" not in rules_of(ok)
+
+
+def test_device204_unhashable_static():
+    bad_default = (
+        "import jax\n"
+        "from functools import partial\n"
+        "@partial(jax.jit, static_argnames=('caps',))\n"
+        "def f(x, caps=[1, 2]):\n"
+        "    return x\n"
+    )
+    assert "DEVICE204" in rules_of(bad_default)
+    bad_call = (
+        "import jax\n"
+        "from functools import partial\n"
+        "@partial(jax.jit, static_argnames=('caps',))\n"
+        "def f(x, *, caps=(1, 2)):\n"
+        "    return x\n"
+        "def g(x):\n"
+        "    return f(x, caps=[1, 2])\n"
+    )
+    assert "DEVICE204" in rules_of(bad_call)
+    ok = bad_call.replace("caps=[1, 2]", "caps=(1, 2)")
+    assert "DEVICE204" not in rules_of(ok)
+
+
+def test_device_rules_cover_jit_wrapped_functions():
+    """`self._jit = jax.jit(fn)` (rules/predicate.py shape) marks `fn`
+    as device code without a decorator."""
+    bad = (
+        "import jax\n"
+        "def fn(x):\n"
+        "    return x.item()\n"
+        "g = jax.jit(fn)\n"
+    )
+    assert "DEVICE201" in rules_of(bad)
+
+
+# -------------------------------------------------------------- FP301
+
+_SEAM = [Seam("pkg/mod.py", "C.send", "test.seam")]
+
+
+def test_fp301_seam_coverage():
+    bad = (
+        "class C:\n"
+        "    async def send(self):\n"
+        "        return 1\n"
+    )
+    assert "FP301" in rules_of(bad, path="pkg/mod.py", seams=_SEAM)
+    ok = (
+        "from . import failpoints\n"
+        "class C:\n"
+        "    async def send(self):\n"
+        "        await failpoints.evaluate_async('test.seam')\n"
+    )
+    assert "FP301" not in rules_of(ok, path="pkg/mod.py", seams=_SEAM)
+    # one level of indirection through a helper resolves
+    ok2 = (
+        "from . import failpoints\n"
+        "class C:\n"
+        "    async def _seam(self):\n"
+        "        return await failpoints.evaluate_async('test.seam')\n"
+        "    async def send(self):\n"
+        "        await self._seam()\n"
+    )
+    assert "FP301" not in rules_of(ok2, path="pkg/mod.py", seams=_SEAM)
+    # an unrelated module is not checked
+    assert "FP301" not in rules_of(bad, path="pkg/other.py",
+                                   seams=_SEAM)
+    # a renamed/deleted seam function is itself a finding, so the
+    # declaration list cannot silently rot
+    gone = "class C:\n    async def send2(self):\n        return 1\n"
+    assert "FP301" in rules_of(gone, path="pkg/mod.py", seams=_SEAM)
+
+
+def test_seam_declarations_match_failpoints_tuple():
+    """Every declared seam name exists in failpoints.SEAMS (the
+    disabled-guard test iterates that tuple), and vice versa for the
+    function-level seams."""
+    declared = {s.seam for s in SEAM_FUNCS}
+    assert declared <= set(failpoints.SEAMS), (
+        declared - set(failpoints.SEAMS)
+    )
+    # ...and the reverse: a name added to failpoints.SEAMS without a
+    # SEAM_FUNCS entry would leave FP301 blind to its function — the
+    # "coverage grows by construction" guarantee requires both
+    assert set(failpoints.SEAMS) <= declared, (
+        set(failpoints.SEAMS) - declared
+    )
+
+
+# ------------------------------------------------------------ the gate
+
+def test_repo_has_no_findings_beyond_baseline():
+    """The tier-1 gate: zero NEW findings over emqx_tpu/, and zero
+    STALE baseline entries (fixed debt must leave the baseline so it
+    only ever shrinks)."""
+    findings = run_lint(["emqx_tpu"])
+    baseline = load_baseline(DEFAULT_BASELINE)
+    new, stale = diff_baseline(findings, baseline)
+    assert not new, "new brokerlint findings:\n" + "\n".join(
+        f.render() for f in new
+    )
+    assert not stale, (
+        "stale baseline entries (fixed? remove them):\n"
+        + "\n".join(sorted(stale))
+    )
+
+
+def test_baseline_diff_is_count_aware():
+    """Fingerprints are line-number free, so two identical-shape
+    violations in one function collide — the diff must compare COUNTS
+    or one baseline entry would mask a newly added duplicate."""
+    from collections import Counter
+
+    src = (
+        "import time\n"
+        "async def f():\n"
+        "    time.sleep(1)\n"
+        "    time.sleep(2)\n"
+    )
+    findings = analyze_source(src)
+    assert [f.rule for f in findings] == ["ASYNC101", "ASYNC101"]
+    fp = findings[0].fingerprint
+    assert findings[1].fingerprint == fp
+    # one baselined, a second added later: the second is NEW
+    new, stale = diff_baseline(findings, Counter({fp: 1}))
+    assert len(new) == 1 and not stale
+    # two baselined, one fixed: the burned-down copy reads stale
+    new, stale = diff_baseline(findings[:1], Counter({fp: 2}))
+    assert not new and stale == {fp}
+
+
+def test_baseline_is_small_and_justified():
+    """< 10 entries, each carrying a justification comment directly
+    above it (the baseline documents debt, not mystery)."""
+    lines = Path(DEFAULT_BASELINE).read_text().splitlines()
+    entries = [l for l in lines if l.strip()
+               and not l.strip().startswith("#")]
+    assert len(entries) < 10, entries
+    for i, line in enumerate(lines):
+        if line.strip() and not line.strip().startswith("#"):
+            prev = [l for l in lines[:i] if l.strip()]
+            assert prev and prev[-1].strip().startswith("#"), (
+                f"baseline entry lacks a justification comment: {line}"
+            )
+
+
+def test_cli_matches_gate():
+    """`python -m tools.brokerlint` (what CI/dev runs) agrees with the
+    pytest gate: exit 0, and --json round-trips."""
+    repo = Path(__file__).resolve().parents[1]
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.brokerlint", "--json"],
+        cwd=repo, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    import json
+
+    out = json.loads(proc.stdout)
+    assert out["new"] == []
+    assert out["stale_baseline"] == []

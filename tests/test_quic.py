@@ -345,3 +345,299 @@ def test_mqtt_over_quic_end_to_end(tmp_path):
         await srv.stop()
 
     run(t())
+
+
+# ------------------------------------------------- selective-ack loss
+
+
+def test_recovery_range_tracker():
+    """Crypto-free unit test of the loss-recovery range arithmetic
+    (quic/recovery.py is deliberately importable without the
+    `cryptography` package)."""
+    from emqx_tpu.quic.recovery import RangeTracker
+
+    rt = RangeTracker()
+    rt.add(0, 100)
+    rt.add(200, 300)
+    rt.add(100, 150)  # touching ranges merge
+    assert rt.ranges == [(0, 150), (200, 300)]
+    assert rt.contiguous_from(0) == 150
+    assert rt.contiguous_from(150) == 150  # next byte unacked
+    assert rt.missing_within(0, 400) == [(150, 200), (300, 400)]
+    assert rt.missing_within(0, 120) == []
+    rt.prune_below(140)
+    assert rt.ranges == [(140, 150), (200, 300)]
+
+
+def test_recovery_selective_ack_model():
+    """An ack of LATER packet numbers must not imply earlier ones: the
+    lost packet's ranges stay unacked, get declared lost at the
+    3-packet threshold, and requeue only their unacked parts."""
+    from emqx_tpu.quic.recovery import RecoverySpace, SentPacket
+
+    sp = RecoverySpace()
+    for pn in range(6):
+        pkt = SentPacket()
+        pkt.streams.append((0, pn * 1000, (pn + 1) * 1000))
+        sp.record(pn, pkt)
+    # packets 0 and 2..5 acked; packet 1 lost on the wire
+    sp.on_ack_range(0, 0)
+    acked = sp.on_ack_range(2, 5)
+    assert len(acked) == 4
+    lost = sp.detect_lost()  # cutoff = 5 - 3 = 2 -> pn 1
+    assert [p.streams[0] for p in lost] == [(0, 1000, 2000)]
+    assert sp.sent == {}  # nothing left in flight
+    # crypto path: queued retx is re-filtered against later acks
+    sp.crypto_acked.add(0, 40)
+    sp.queue_crypto_retx([(0, 100)])
+    assert sp.take_crypto_retx() == [(40, 100)]
+    assert sp.take_crypto_retx() == []  # drained
+
+
+def test_selective_loss_retransmitted(tmp_path):
+    """ROADMAP open item: under selective loss (an earlier data packet
+    lost, later ones acked) the lost stream bytes must be
+    retransmitted from the ack stream alone — no PTO, no idle-timeout
+    wedge.  The pre-selective-ack model treated an ack of the latest
+    pn as cumulative and never resent them."""
+    pytest.importorskip("cryptography")
+    from emqx_tpu.quic.connection import QuicConnection
+
+    _cf, _kf, cert, key = make_cert(tmp_path)
+    srv = QuicConnection(True, cert_der=_der(cert), key=key)
+    cli = QuicConnection(False)
+    cli.connect()
+
+    def pump(n=200):
+        for _ in range(n):
+            moved = False
+            for d in cli.datagrams_to_send():
+                srv.receive_datagram(d)
+                moved = True
+            for d in srv.datagrams_to_send():
+                cli.receive_datagram(d)
+                moved = True
+            if not moved:
+                return
+
+    pump()
+    assert cli.handshake_complete and srv.handshake_complete
+    sid = cli.open_stream()
+
+    payload = bytes(range(256)) * 200  # 51200 bytes, ~50 packets
+    # eat the SECOND datagram of the flight: everything after it is
+    # received and acked, the gap must be loss-detected + resent
+    cli.send_stream(sid, payload)
+    flight = cli.datagrams_to_send()
+    assert len(flight) > 5
+    for i, d in enumerate(flight):
+        if i != 1:
+            srv.receive_datagram(d)
+    pump()
+    got = b"".join(e[2] for e in srv.events() if e[0] == "stream")
+    assert got == payload
+    # and the sender's buffer trimmed through the recovered range
+    st = cli._streams_out[sid]
+    assert st.base == len(payload)
+    assert st.data == b""
+
+    # a second loss epoch on the same long-lived stream still works
+    # (absolute offsets survive the base rebase)
+    more = b"tail-after-recovery" * 500
+    cli.send_stream(sid, more)
+    flight = cli.datagrams_to_send()
+    for i, d in enumerate(flight):
+        if i != 0:
+            srv.receive_datagram(d)
+    pump()
+    got2 = got + b"".join(
+        e[2] for e in srv.events() if e[0] == "stream"
+    )
+    assert got2 == payload + more
+
+
+def test_selective_loss_recovery_without_crypto(monkeypatch):
+    """The connection-level recovery integration, runnable in the
+    tier-1 environment (no `cryptography` package): AEAD and header
+    protection are stubbed at the import boundary — passthrough
+    ciphertext, identity HP mask — while the REAL packetizer, ack
+    parser, recovery spaces, and stream buffers run end to end.  In
+    environments with the real package this skips in favor of
+    test_selective_loss_retransmitted (true crypto path)."""
+    try:
+        import cryptography  # noqa: F401
+        pytest.skip("real cryptography present: the full-stack "
+                    "selective-loss test covers this path")
+    except ImportError:
+        pass
+    import sys
+    import types
+
+    def mod(name):
+        m = types.ModuleType(name)
+        monkeypatch.setitem(sys.modules, name, m)
+        return m
+
+    class FakeAESGCM:
+        def __init__(self, key):
+            pass
+
+        def encrypt(self, nonce, data, aad):
+            return data + b"\x00" * 16
+
+        def decrypt(self, nonce, ct, aad):
+            return ct[:-16]
+
+    class _Enc:
+        def update(self, data):
+            return bytes(data)
+
+    class FakeCipher:
+        def __init__(self, alg, mode):
+            pass
+
+        def encryptor(self):
+            return _Enc()
+
+    mod("cryptography")
+    mod("cryptography.hazmat")
+    prims = mod("cryptography.hazmat.primitives")
+    ciphers = mod("cryptography.hazmat.primitives.ciphers")
+    aead = mod("cryptography.hazmat.primitives.ciphers.aead")
+    aead.AESGCM = FakeAESGCM
+    ciphers.Cipher = FakeCipher
+    ciphers.algorithms = types.SimpleNamespace(
+        AES=lambda key: None
+    )
+    ciphers.modes = types.SimpleNamespace(ECB=lambda: None)
+    prims.hashes = types.SimpleNamespace()
+    prims.serialization = types.SimpleNamespace()
+    asym = mod("cryptography.hazmat.primitives.asymmetric")
+    asym.ec = types.SimpleNamespace()
+    x = mod("cryptography.hazmat.primitives.asymmetric.x25519")
+    x.X25519PrivateKey = object
+    x.X25519PublicKey = object
+
+    # import against the stubs; evict cached copies both ways so other
+    # tests never see a stub-built module
+    for name in ("emqx_tpu.quic.connection", "emqx_tpu.quic.tls13"):
+        monkeypatch.delitem(sys.modules, name, raising=False)
+    import importlib
+
+    conn_mod = importlib.import_module("emqx_tpu.quic.connection")
+    try:
+        _run_stubbed_loss_scenarios(conn_mod)
+    finally:
+        for name in ("emqx_tpu.quic.connection",
+                     "emqx_tpu.quic.tls13"):
+            sys.modules.pop(name, None)
+
+
+def _run_stubbed_loss_scenarios(conn_mod):
+    from emqx_tpu.quic.recovery import RecoverySpace
+
+    class _FakeTls:
+        complete = True
+        handshake_secrets = None
+        app_secrets = None
+
+        def take_out(self, epoch):
+            return b""
+
+    def make_conn(is_server, scid, dcid):
+        c = object.__new__(conn_mod.QuicConnection)
+        c.is_server = is_server
+        c.scid = scid
+        c.dcid = dcid
+        c.original_dcid = dcid
+        c.tls = _FakeTls()
+        k = conn_mod.Keys(b"\x11" * 32)
+        c._keys = {0: (None, None), 2: (None, None), 3: (k, k)}
+        c._pn = {0: 0, 2: 0, 3: 0}
+        c._largest_recv = {0: -1, 2: -1, 3: -1}
+        c._recv_pns = {0: set(), 2: set(), 3: set()}
+        c._pn_floor = {0: 0, 2: 0, 3: 0}
+        c._PN_WINDOW = 2048
+        c._ack_due = {0: False, 2: False, 3: False}
+        c._crypto_out = {0: b"", 2: b"", 3: b""}
+        c._crypto_sent = {0: 0, 2: 0, 3: 0}
+        c._crypto_recv_off = {0: 0, 2: 0, 3: 0}
+        c._crypto_chunks = {0: {}, 2: {}, 3: {}}
+        c._streams_out = {}
+        c._streams_sent = {}
+        c._streams_in = {}
+        c._events = []
+        c.handshake_complete = True
+        c._handshake_done_sent = True
+        c._handshake_confirmed = True
+        c.address_validated = True
+        c.closed = False
+        c.close_code = None
+        c._out_datagrams = []
+        c._next_stream_id = 0
+        c._spaces = {0: RecoverySpace(), 2: RecoverySpace(),
+                     3: RecoverySpace()}
+        return c
+
+    def pair():
+        return (make_conn(False, b"C" * 8, b"S" * 8),
+                make_conn(True, b"S" * 8, b"C" * 8))
+
+    def pump(a, b, n=50, drop=None):
+        for r in range(n):
+            moved = False
+            for i, d in enumerate(a.datagrams_to_send()):
+                if drop is not None and drop(r, i):
+                    continue
+                b.receive_datagram(d)
+                moved = True
+            for d in b.datagrams_to_send():
+                a.receive_datagram(d)
+                moved = True
+            if not moved:
+                return
+
+    def delivered(conn, sid=0):
+        return b"".join(
+            e[2] for e in conn.events() if e[0] == "stream"
+        )
+
+    payload = bytes(range(256)) * 20  # 5120 B, several packets
+
+    # 1) no loss: plain delivery + full trim
+    cli, srv = pair()
+    cli.send_stream(0, payload)
+    pump(cli, srv)
+    assert delivered(srv) == payload
+
+    # 2) selective loss, ack-driven: drop one mid-flight datagram;
+    #    later acks trigger threshold loss detection + exact resend
+    cli, srv = pair()
+    cli.send_stream(0, payload)
+    pump(cli, srv, drop=lambda r, i: r == 0 and i == 1)
+    assert delivered(srv) == payload
+    st = cli._streams_out[0]
+    assert st.base == len(payload) and st.data == b""
+
+    # 3) tail loss: no later acks exist — PTO requeues exactly the
+    #    missing ranges
+    cli, srv = pair()
+    cli.send_stream(0, payload)
+    flight = cli.datagrams_to_send()
+    for d in flight[:-1]:
+        srv.receive_datagram(d)
+    pump(cli, srv)
+    assert srv._streams_in[0].delivered < len(payload)
+    cli.on_timeout()
+    pump(cli, srv)
+    assert srv._streams_in[0].delivered == len(payload)
+
+    # 4) FIN lost: retransmitted after PTO
+    cli, srv = pair()
+    cli.send_stream(0, b"x" * 100, fin=True)
+    cli.datagrams_to_send()  # whole flight eaten
+    cli.on_timeout()
+    pump(cli, srv)
+    assert any(
+        e[0] == "stream" and e[3] for e in srv.events()
+    ), "FIN not retransmitted"

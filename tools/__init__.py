@@ -1,0 +1,3 @@
+"""Developer tooling (benches, profilers, and the brokerlint static
+analyzer).  A package so `python -m tools.brokerlint` works from the
+repo root — the same invocation CI's tier-1 gate uses."""

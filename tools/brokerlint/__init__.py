@@ -1,0 +1,18 @@
+"""brokerlint: repo-aware AST analysis for the broker.
+
+Rule families: async-concurrency (ASYNC1xx), device-purity
+(DEVICE2xx), failpoint-coverage (FP301).  Run as a tier-1 gate by
+tests/test_lint.py and standalone via ``python -m tools.brokerlint``.
+"""
+
+from .engine import (
+    DEFAULT_BASELINE, DEFAULT_PATHS, Finding, analyze_source,
+    diff_baseline, load_baseline, run_lint,
+)
+from .failpointrules import SEAM_FUNCS, Seam
+
+__all__ = [
+    "DEFAULT_BASELINE", "DEFAULT_PATHS", "Finding", "SEAM_FUNCS",
+    "Seam", "analyze_source", "diff_baseline", "load_baseline",
+    "run_lint",
+]

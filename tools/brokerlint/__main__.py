@@ -1,0 +1,78 @@
+"""CLI: ``python -m tools.brokerlint [paths...] [--baseline F]
+[--json] [--write-baseline]``.
+
+Exit codes: 0 clean (baselined findings and stale entries are
+reported but don't fail), 1 on any NEW finding — identical behavior
+to the tier-1 pytest gate (tests/test_lint.py), which calls the same
+`run_lint`/`diff_baseline`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .engine import (
+    DEFAULT_BASELINE, DEFAULT_PATHS, diff_baseline, load_baseline,
+    run_lint,
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.brokerlint",
+        description="repo-aware AST lint: async-race, device-purity, "
+                    "failpoint-coverage",
+    )
+    ap.add_argument("paths", nargs="*", default=list(DEFAULT_PATHS),
+                    help="files/dirs to lint (default: emqx_tpu/)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file of accepted fingerprints")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignore the baseline")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline file from this run "
+                         "(each entry still deserves a justification "
+                         "comment — add them before committing)")
+    args = ap.parse_args(argv)
+
+    findings = run_lint(args.paths or list(DEFAULT_PATHS))
+    baseline = set() if args.no_baseline else load_baseline(
+        args.baseline
+    )
+    new, stale = diff_baseline(findings, baseline)
+
+    if args.write_baseline:
+        with open(args.baseline, "w") as f:
+            f.write("# brokerlint baseline — accepted pre-existing "
+                    "findings (burn these down).\n"
+                    "# One fingerprint per line; '#' comments hold "
+                    "the justification.\n")
+            for fi in sorted(findings, key=lambda x: x.fingerprint):
+                f.write(fi.fingerprint + "\n")
+        print(f"wrote {len(findings)} entries to {args.baseline}")
+        return 0
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.as_dict() for f in findings],
+            "new": [f.as_dict() for f in new],
+            "stale_baseline": sorted(stale),
+        }, indent=1))
+    else:
+        for f in findings:
+            mark = "" if f.fingerprint in baseline else " [NEW]"
+            print(f.render() + mark)
+        for s in sorted(stale):
+            print(f"stale baseline entry (no longer found): {s}")
+        print(f"brokerlint: {len(findings)} finding(s), "
+              f"{len(new)} new, {len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'}")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,255 @@
+"""Async-concurrency rules (ASYNC1xx).
+
+The PR-1 postmortem family: the flaky-shutdown deadlock (bpo-37658
+cancel-swallow under ``asyncio.wait_for``) froze tier-1 at ~25% and
+was found by luck.  These rules catch that class statically:
+
+  ASYNC101  blocking call (``time.sleep``, sync subprocess/socket/
+            HTTP) inside ``async def`` — stalls the whole event loop.
+  ASYNC102  sync wait (``Future.result()`` / thread-style ``join()``)
+            inside ``async def`` — deadlocks when the result is
+            produced by the same loop.
+  ASYNC103  ``asyncio.Lock``/``Condition``/``Semaphore`` held across
+            an await that performs IO — one slow peer stalls every
+            other holder; when the serialization IS the design (per-
+            connection ordering / backpressure), suppress with a
+            justification comment.
+  ASYNC104  ``task.cancel()`` then ``await task`` (bare or under
+            ``asyncio.wait_for``) in a stop/close path — a cancel
+            landing as an inner ``wait_for``'s future resolves is
+            swallowed (bpo-37658) and the await hangs shutdown
+            forever; use ``aio.cancel_and_wait``.
+  ASYNC105  ``create_task``/``ensure_future`` result dropped — the
+            task is GC-bait (may vanish mid-flight) and its exception
+            is never retrieved.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .engine import (
+    IO_AWAIT_NAMES, ModuleContext, awaits_io, call_tail, dotted_name,
+)
+
+# dotted callee names that block the event loop (ASYNC101)
+_BLOCKING_EXACT = {
+    "time.sleep",
+    "os.system",
+    "os.popen",
+    "os.waitpid",
+    "select.select",
+    "socket.create_connection",
+    "socket.getaddrinfo",
+    "urllib.request.urlopen",
+    "subprocess.run",
+    "subprocess.call",
+    "subprocess.check_call",
+    "subprocess.check_output",
+    "subprocess.getoutput",
+}
+_BLOCKING_PREFIXES = ("requests.",)
+
+_STOPPISH = ("stop", "close", "shutdown", "aclose", "terminate")
+
+_LOCKISH = ("lock", "sem", "cond", "mutex")
+
+
+def _is_stop_path(name: str) -> bool:
+    low = name.lower()
+    return any(s in low for s in _STOPPISH)
+
+
+def _is_lockish(expr: ast.AST) -> bool:
+    return any(tok in dotted_name(expr).lower() for tok in _LOCKISH)
+
+
+def _numeric_const(node: ast.AST) -> bool:
+    return isinstance(node, ast.Constant) and isinstance(
+        node.value, (int, float)
+    ) and not isinstance(node.value, bool)
+
+
+class _AsyncVisitor(ast.NodeVisitor):
+    def __init__(self, ctx: ModuleContext) -> None:
+        self.ctx = ctx
+        self.stack: List[str] = []          # qualname parts
+        self.fn_stack: List[bool] = []      # is-async per function frame
+
+    # ------------------------------------------------------- plumbing
+
+    @property
+    def qualname(self) -> str:
+        return ".".join(self.stack) or "<module>"
+
+    @property
+    def in_async(self) -> bool:
+        """True when the INNERMOST enclosing function is async (a sync
+        closure inside an async def — e.g. a done-callback — is sync
+        code and may legally call ``.result()``)."""
+        return bool(self.fn_stack) and self.fn_stack[-1]
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    def _visit_fn(self, node, is_async: bool) -> None:
+        self.stack.append(node.name)
+        self.fn_stack.append(is_async)
+        if is_async:
+            self._check_cancel_await(node)
+        self.generic_visit(node)
+        self.fn_stack.pop()
+        self.stack.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_fn(node, False)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._visit_fn(node, True)
+
+    # -------------------------------------------------- ASYNC101/102
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.in_async:
+            name = dotted_name(node.func)
+            if name in _BLOCKING_EXACT or name.startswith(
+                _BLOCKING_PREFIXES
+            ):
+                self.ctx.report(
+                    node, "ASYNC101", self.qualname,
+                    f"blocking call `{name}` inside async function "
+                    f"stalls the event loop (await the async "
+                    f"equivalent instead)",
+                    detail=name,
+                )
+            tail = call_tail(node)
+            if tail == "result" and not node.args and not node.keywords:
+                self.ctx.report(
+                    node, "ASYNC102", self.qualname,
+                    "`.result()` inside async function blocks the "
+                    "loop (await the future instead)",
+                    detail="result",
+                )
+            elif tail == "join" and self._thread_join_shaped(node):
+                self.ctx.report(
+                    node, "ASYNC102", self.qualname,
+                    "thread-style `.join()` inside async function "
+                    "blocks the loop (await, or run in an executor)",
+                    detail="join",
+                )
+        self.generic_visit(node)
+
+    @staticmethod
+    def _thread_join_shaped(node: ast.Call) -> bool:
+        """``t.join()`` / ``t.join(5)`` / ``t.join(timeout=5)`` —
+        signatures ``str.join``/``os.path.join`` can never have."""
+        if node.keywords:
+            return all(k.arg == "timeout" for k in node.keywords)
+        if not node.args:
+            return True
+        return len(node.args) == 1 and _numeric_const(node.args[0])
+
+    # ------------------------------------------------------- ASYNC103
+
+    def visit_AsyncWith(self, node: ast.AsyncWith) -> None:
+        if any(_is_lockish(item.context_expr) for item in node.items):
+            io_call = self._body_io_await(node.body)
+            if io_call is not None:
+                self.ctx.report(
+                    node, "ASYNC103", self.qualname,
+                    f"asyncio lock held across IO await "
+                    f"(`{io_call}`): one slow peer stalls every other "
+                    f"holder; narrow the critical section (suppress "
+                    f"with a justification when the serialization is "
+                    f"the design)",
+                    detail=io_call,
+                )
+        self.generic_visit(node)
+
+    def _body_io_await(self, body) -> Optional[str]:
+        hits: List[str] = []
+
+        def walk(node: ast.AST) -> None:
+            # a PRUNING walk (ast.walk can't skip subtrees): nested
+            # defs/lambdas don't run under the lock, so their awaits
+            # must not count against it
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue
+                if isinstance(child, ast.Await) and not hits:
+                    hit = awaits_io(child.value, self.ctx.io_methods)
+                    if hit is not None:
+                        hits.append(hit)
+                        return
+                walk(child)
+
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # a def statement directly in the with body
+            walk(stmt)
+            if hits:
+                return hits[0]
+        return None
+
+    # ------------------------------------------------------- ASYNC104
+
+    def _check_cancel_await(self, fn: ast.AsyncFunctionDef) -> None:
+        if not _is_stop_path(fn.name):
+            return
+        cancelled: Set[str] = set()
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Call)
+                    and call_tail(node) == "cancel"
+                    and isinstance(node.func, ast.Attribute)):
+                cancelled.add(ast.dump(node.func.value))
+        if not cancelled:
+            return
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Await):
+                continue
+            target = node.value
+            if isinstance(target, ast.Call) and call_tail(target) in (
+                "wait_for", "wait"
+            ) and target.args:
+                target = target.args[0]
+                if isinstance(target, ast.List):  # asyncio.wait([t])
+                    target = target.elts[0] if target.elts else target
+            if isinstance(target, ast.Call):
+                continue  # awaiting a fresh coroutine, not the task
+            if ast.dump(target) in cancelled:
+                self.ctx.report(
+                    node, "ASYNC104", self.qualname,
+                    "cancel()-then-await in a stop/close path hangs "
+                    "when the cancel is swallowed by an inner "
+                    "wait_for (bpo-37658) — use "
+                    "aio.cancel_and_wait(task)",
+                    detail=dotted_name(target) or "task",
+                )
+
+    # ------------------------------------------------------- ASYNC105
+
+    def visit_Expr(self, node: ast.Expr) -> None:
+        if isinstance(node.value, ast.Call):
+            tail = call_tail(node.value)
+            if tail in ("create_task", "ensure_future"):
+                self.ctx.report(
+                    node, "ASYNC105", self.qualname,
+                    f"`{tail}` result dropped: the task may be "
+                    f"garbage-collected mid-flight and its exception "
+                    f"is never retrieved — retain a reference or add "
+                    f"a done-callback",
+                    detail=tail,
+                )
+        self.generic_visit(node)
+
+
+def check(ctx: ModuleContext) -> None:
+    _AsyncVisitor(ctx).visit(ctx.tree)
+
+
+__all__ = ["check", "IO_AWAIT_NAMES"]

@@ -1,0 +1,271 @@
+"""Device-purity rules (DEVICE2xx).
+
+The paper's premise is that matching lives in batched XLA kernels: a
+host sync or a tracer-branching ``if`` silently falling into a
+``@jax.jit`` function destroys the perf story (forced device->host
+round-trip per step, or a recompile per distinct value).  These rules
+walk every function the module jit-compiles — decorated with
+``@jax.jit`` / ``@partial(jax.jit, ...)`` or wrapped via
+``jax.jit(fn)`` — and flag host escapes:
+
+  DEVICE201  host sync inside jit: ``.item()`` / ``.tolist()``, or
+             ``float()``/``int()``/``bool()`` on a traced value —
+             each forces a blocking device->host transfer (and
+             tracer-boolean conversion raises at trace time).
+  DEVICE202  python ``if``/``while`` on a tracer-valued expression
+             inside jit: branches on data must be ``jnp.where`` /
+             ``lax.cond`` (shape/dtype/static-arg branches are fine).
+  DEVICE203  host-numpy call (``np.*``) on a traced value inside jit:
+             silently pulls the array off-device (constants built
+             from static values are fine).
+  DEVICE204  unhashable static arg: a ``static_argnums``/
+             ``static_argnames`` parameter defaulted to (or called
+             with) a list/dict/set — every call re-hashes, fails, and
+             forces a retrace.
+
+Staticness is decided structurally: constants, shape/dtype/size/ndim
+attributes, ``len()``/``isinstance()`` results, and declared static
+parameters are static; anything referencing a non-static parameter is
+traced.  Names the analysis cannot see (locals, globals) are assumed
+static — the rules under-approximate rather than spam.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set
+
+from .engine import ModuleContext, call_tail, dotted_name
+
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "at"}
+_STATIC_FNS = {"len", "isinstance", "hasattr", "range", "type"}
+_CASTS = {"float", "int", "bool", "complex"}
+
+
+def _jit_decorated(fn) -> Optional[ast.expr]:
+    """The jit decorator node when `fn` is jit-compiled directly."""
+    for dec in fn.decorator_list:
+        name = dotted_name(dec if not isinstance(dec, ast.Call)
+                           else dec.func)
+        if name.endswith("jit"):
+            return dec
+        if isinstance(dec, ast.Call) and name.endswith("partial"):
+            if dec.args and dotted_name(dec.args[0]).endswith("jit"):
+                return dec
+    return None
+
+
+def _wrapped_names(tree: ast.Module) -> Set[str]:
+    """Functions compiled indirectly: any ``jax.jit(fn)`` call whose
+    argument is a bare name (``self._jit = jax.jit(fn)`` and friends)."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and dotted_name(
+            node.func
+        ).endswith("jit"):
+            for arg in node.args[:1]:
+                if isinstance(arg, ast.Name):
+                    out.add(arg.id)
+    return out
+
+
+def _static_params(fn, dec: Optional[ast.expr]) -> Set[str]:
+    """Parameter names declared static on the jit decorator."""
+    params = [a.arg for a in (fn.args.posonlyargs + fn.args.args)]
+    static: Set[str] = set()
+    if dec is None or not isinstance(dec, ast.Call):
+        return static
+    for kw in dec.keywords:
+        val = kw.value
+        if kw.arg == "static_argnames":
+            for sub in ast.walk(val):
+                if isinstance(sub, ast.Constant) and isinstance(
+                    sub.value, str
+                ):
+                    static.add(sub.value)
+        elif kw.arg == "static_argnums":
+            for sub in ast.walk(val):
+                if isinstance(sub, ast.Constant) and isinstance(
+                    sub.value, int
+                ) and 0 <= sub.value < len(params):
+                    static.add(params[sub.value])
+    # keyword-only args are static by construction in jax only when
+    # named; treat declared names as the whole static set
+    return static
+
+
+class _Staticness:
+    """Structural static/traced classifier for one jit function."""
+
+    def __init__(self, traced: Set[str]) -> None:
+        self.traced = traced  # parameter names that carry tracers
+
+    def is_static(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Constant):
+            return True
+        if isinstance(node, ast.Name):
+            return node.id not in self.traced
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return True
+            return self.is_static(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.is_static(node.value)
+        if isinstance(node, ast.Call):
+            fname = call_tail(node)
+            if fname in _STATIC_FNS:
+                return True
+            return all(self.is_static(a) for a in node.args) and all(
+                self.is_static(k.value) for k in node.keywords
+            )
+        if isinstance(node, (ast.BoolOp, ast.BinOp, ast.UnaryOp,
+                             ast.Compare, ast.IfExp, ast.Tuple,
+                             ast.List)):
+            return all(
+                self.is_static(c) for c in ast.iter_child_nodes(node)
+                if isinstance(c, ast.expr)
+            )
+        if isinstance(node, (ast.boolop, ast.operator, ast.unaryop,
+                             ast.cmpop)):
+            return True
+        return False
+
+
+def _check_jit_body(ctx: ModuleContext, fn, qualname: str,
+                    static: Set[str]) -> None:
+    params = {
+        a.arg
+        for a in (fn.args.posonlyargs + fn.args.args
+                  + fn.args.kwonlyargs)
+    } - static - {"self", "cls"}
+    cls = _Staticness(params)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            tail = call_tail(node)
+            name = dotted_name(node.func)
+            if tail in ("item", "tolist") and not node.args:
+                ctx.report(
+                    node, "DEVICE201", qualname,
+                    f"`.{tail}()` inside jit forces a blocking "
+                    f"device->host sync",
+                    detail=tail,
+                )
+            elif (isinstance(node.func, ast.Name)
+                    and node.func.id in _CASTS and node.args
+                    and not cls.is_static(node.args[0])):
+                ctx.report(
+                    node, "DEVICE201", qualname,
+                    f"`{node.func.id}()` on a traced value inside jit "
+                    f"forces a host sync (tracer bool/int conversion "
+                    f"raises at trace time)",
+                    detail=node.func.id,
+                )
+            elif (name.startswith(("np.", "numpy."))
+                    and node.args
+                    and any(not cls.is_static(a) for a in node.args)):
+                ctx.report(
+                    node, "DEVICE203", qualname,
+                    f"host-numpy call `{name}` on a traced value "
+                    f"inside jit pulls the array off-device — use "
+                    f"jnp/lax",
+                    detail=name,
+                )
+        elif isinstance(node, (ast.If, ast.While)):
+            if not cls.is_static(node.test):
+                kind = "if" if isinstance(node, ast.If) else "while"
+                ctx.report(
+                    node, "DEVICE202", qualname,
+                    f"python `{kind}` on a tracer-valued expression "
+                    f"inside jit (use jnp.where / lax.cond; branch "
+                    f"on shapes or static args instead)",
+                    detail=kind,
+                )
+
+
+def _check_static_hashability(ctx: ModuleContext, fn, qualname: str,
+                              static: Set[str]) -> None:
+    """DEVICE204: a static param defaulted to a mutable literal."""
+    args = fn.args
+    pos = args.posonlyargs + args.args
+    for a, d in zip(pos[len(pos) - len(args.defaults):], args.defaults):
+        if a.arg in static and isinstance(
+            d, (ast.List, ast.Dict, ast.Set)
+        ):
+            ctx.report(
+                d, "DEVICE204", qualname,
+                f"static arg `{a.arg}` defaults to an unhashable "
+                f"{type(d).__name__.lower()} — jit re-hashes statics "
+                f"per call; use a tuple/frozen value",
+                detail=a.arg,
+            )
+    for a, d in zip(args.kwonlyargs, args.kw_defaults):
+        if d is not None and a.arg in static and isinstance(
+            d, (ast.List, ast.Dict, ast.Set)
+        ):
+            ctx.report(
+                d, "DEVICE204", qualname,
+                f"static arg `{a.arg}` defaults to an unhashable "
+                f"{type(d).__name__.lower()} — use a tuple/frozen "
+                f"value",
+                detail=a.arg,
+            )
+
+
+def _check_call_sites(ctx: ModuleContext, tree: ast.Module,
+                      static_by_fn: Dict[str, Set[str]]) -> None:
+    """DEVICE204 at call sites: passing a list/dict/set literal for a
+    known static kwarg of a module-local jit function."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        static = static_by_fn.get(call_tail(node))
+        if not static:
+            continue
+        for kw in node.keywords:
+            if kw.arg in static and isinstance(
+                kw.value, (ast.List, ast.Dict, ast.Set)
+            ):
+                ctx.report(
+                    kw.value, "DEVICE204", "<module>",
+                    f"unhashable {type(kw.value).__name__.lower()} "
+                    f"passed for static arg `{kw.arg}` — every call "
+                    f"fails the static hash and retraces",
+                    detail=f"call:{kw.arg}",
+                )
+
+
+def check(ctx: ModuleContext) -> None:
+    wrapped = _wrapped_names(ctx.tree)
+    static_by_fn: Dict[str, Set[str]] = {}
+    stack: List[str] = []
+
+    def walk(node) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                stack.append(child.name)
+                walk(child)
+                stack.pop()
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                stack.append(child.name)
+                qual = ".".join(stack)
+                dec = _jit_decorated(child)
+                if dec is not None or child.name in wrapped:
+                    static = _static_params(child, dec)
+                    static_by_fn[child.name] = static
+                    _check_jit_body(ctx, child, qual, static)
+                    _check_static_hashability(ctx, child, qual, static)
+                    # nested defs inside a jit body are traced too and
+                    # already covered by the ast.walk over the parent —
+                    # don't descend and double-report
+                else:
+                    walk(child)
+                stack.pop()
+            else:
+                walk(child)
+
+    walk(ctx.tree)
+    _check_call_sites(ctx, ctx.tree, static_by_fn)
+
+
+__all__ = ["check"]

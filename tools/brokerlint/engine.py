@@ -1,0 +1,309 @@
+"""brokerlint core: findings, suppressions, baselines, the runner.
+
+Repo-aware AST analysis for the broker (the role clippy lints +
+erlang's dialyzer checks play for the reference).  Three rule
+families (see the sibling modules):
+
+  * async-concurrency  (``ASYNC1xx``, asyncrules.py)   — blocking
+    calls / sync waits inside ``async def``, asyncio locks held
+    across IO awaits, cancel-then-await shutdown hangs (bpo-37658),
+    dropped ``create_task`` results;
+  * device-purity      (``DEVICE2xx``, devicerules.py) — host syncs,
+    host-numpy calls, and tracer-valued python branches inside
+    ``@jax.jit`` code, unhashable static args;
+  * failpoint-coverage (``FP301``, failpointrules.py)  — declared IO
+    seams must carry a ``failpoints.evaluate`` call.
+
+Suppression: a ``# brokerlint: ignore[RULE]`` comment on the finding's
+line (or on a comment-only line directly above it) silences that rule
+there; ``ignore[*]`` silences every rule on the line.  Suppressions
+are for *intentional* designs (e.g. a lock that IS the per-peer
+ordering/backpressure bound) and should carry a justification comment.
+
+Baseline: a checked-in file of finding fingerprints (line-number free,
+so unrelated edits don't churn it).  The gate fails on any finding NOT
+in the baseline; baselined findings are debt to burn down, and stale
+entries (baselined but no longer found) are reported so the file
+shrinks with the debt.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from collections import Counter
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*brokerlint:\s*ignore\[([A-Za-z0-9_*,\s]+)\]"
+)
+_COMMENT_ONLY_RE = re.compile(r"^\s*#")
+
+# call names whose *await* performs (or unboundedly waits on) IO —
+# used by the lock-across-IO rule and by the one-level "does this
+# method do IO" resolution below
+IO_AWAIT_NAMES: Set[str] = {
+    "open_connection", "open_unix_connection", "start_server",
+    "create_connection", "create_datagram_endpoint", "connect",
+    "drain", "read", "readline", "readexactly", "readuntil",
+    "recv", "recv_into", "recvfrom", "send", "sendall", "sendto",
+    "request", "get", "post", "put", "delete", "fetch",
+    "wait_closed", "wait_for", "wait", "getaddrinfo",
+}
+
+
+@dataclass
+class Finding:
+    path: str       # repo-relative posix path
+    line: int
+    rule: str
+    qualname: str   # dotted function/class context ("<module>" at top)
+    message: str
+    detail: str = ""  # stable token for the fingerprint (no line nos)
+
+    @property
+    def fingerprint(self) -> str:
+        parts = [self.path, self.qualname, self.rule]
+        if self.detail:
+            parts.append(self.detail)
+        return "::".join(parts)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: {self.rule} "
+                f"[{self.qualname}] {self.message}")
+
+    def as_dict(self) -> Dict:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "rule": self.rule,
+            "qualname": self.qualname,
+            "message": self.message,
+            "fingerprint": self.fingerprint,
+        }
+
+
+class ModuleContext:
+    """Everything the rule visitors need about one source file."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module) -> None:
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.findings: List[Finding] = []
+        # one-level indirection maps, filled by _index():
+        #   method qualname -> its FunctionDef node
+        self.functions: Dict[str, ast.AST] = {}
+        #   bare method name -> does its body await IO / evaluate a
+        #   failpoint (class-blind on purpose: one level, best effort)
+        self.io_methods: Set[str] = set()
+        self.failpoint_methods: Set[str] = set()
+        self._index()
+
+    # ------------------------------------------------------- indexing
+
+    def _index(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                name = node.name
+                self.functions.setdefault(name, node)
+                if _body_awaits_io(node):
+                    self.io_methods.add(name)
+                if _body_calls_failpoint(node):
+                    self.failpoint_methods.add(name)
+
+    # ----------------------------------------------------- reporting
+
+    def report(self, node: ast.AST, rule: str, qualname: str,
+               message: str, detail: str = "") -> None:
+        line = getattr(node, "lineno", 1)
+        if self._suppressed(line, rule):
+            return
+        self.findings.append(Finding(
+            path=self.path, line=line, rule=rule,
+            qualname=qualname, message=message, detail=detail,
+        ))
+
+    def _suppressed(self, line: int, rule: str) -> bool:
+        for cand in (line, line - 1):
+            if not (1 <= cand <= len(self.lines)):
+                continue
+            text = self.lines[cand - 1]
+            if cand != line and not _COMMENT_ONLY_RE.match(text):
+                continue  # the line above only counts if comment-only
+            m = _SUPPRESS_RE.search(text)
+            if m is None:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",")}
+            if "*" in rules or rule in rules:
+                return True
+        return False
+
+
+# ---------------------------------------------------------- AST helpers
+
+def dotted_name(node: ast.AST) -> str:
+    """``a.b.c`` for Name/Attribute chains, '' when dynamic."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif isinstance(node, ast.Call):
+        # e.g. get_running_loop().create_task -> keep the tail only
+        inner = dotted_name(node.func)
+        if inner:
+            parts.append(inner + "()")
+    else:
+        return ""
+    return ".".join(reversed(parts))
+
+
+def call_tail(call: ast.Call) -> str:
+    """The final attribute/name of a call's callee (``drain`` for
+    ``self._writer.drain()``)."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def awaits_io(expr: ast.AST, io_methods: Set[str] = frozenset()) -> Optional[str]:
+    """If `expr` (an awaited value) contains an IO-performing call,
+    return that call's name.  `io_methods` extends the builtin set with
+    same-module methods known to await IO (one-level resolution of
+    ``await self._ensure()``-style indirection)."""
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Call):
+            tail = call_tail(sub)
+            if tail in IO_AWAIT_NAMES or tail in io_methods:
+                return tail
+    return None
+
+
+def _body_awaits_io(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Await):
+            if awaits_io(node.value) is not None:
+                return True
+    return False
+
+
+def is_failpoint_call(call: ast.Call) -> bool:
+    name = dotted_name(call.func)
+    return name.endswith("failpoints.evaluate") or \
+        name.endswith("failpoints.evaluate_async") or \
+        name in ("evaluate", "evaluate_async")
+
+
+def _body_calls_failpoint(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and is_failpoint_call(node):
+            return True
+    return False
+
+
+# -------------------------------------------------------------- runner
+
+def analyze_source(source: str, path: str = "<string>",
+                   seams: Optional[Sequence] = None) -> List[Finding]:
+    """Run every rule family over one source string (fixture tests use
+    this directly; `run_lint` maps it over the tree)."""
+    from . import asyncrules, devicerules, failpointrules
+
+    tree = ast.parse(source, filename=path)
+    ctx = ModuleContext(path, source, tree)
+    asyncrules.check(ctx)
+    devicerules.check(ctx)
+    failpointrules.check(
+        ctx, failpointrules.SEAM_FUNCS if seams is None else seams
+    )
+    ctx.findings.sort(key=lambda f: (f.line, f.rule))
+    return ctx.findings
+
+
+def iter_py_files(paths: Sequence[str], root: Path) -> Iterable[Path]:
+    for p in paths:
+        path = (root / p) if not Path(p).is_absolute() else Path(p)
+        if path.is_dir():
+            yield from sorted(path.rglob("*.py"))
+        elif path.suffix == ".py":
+            yield path
+
+
+def run_lint(paths: Sequence[str], root: Optional[str] = None,
+             seams: Optional[Sequence] = None) -> List[Finding]:
+    """Lint every .py under `paths` (files or directories), returning
+    findings with repo-relative posix paths."""
+    root_path = Path(root) if root else Path(__file__).resolve().parents[2]
+    out: List[Finding] = []
+    for f in iter_py_files(paths, root_path):
+        try:
+            rel = f.resolve().relative_to(root_path.resolve()).as_posix()
+        except ValueError:
+            rel = f.as_posix()
+        try:
+            src = f.read_text()
+        except (OSError, UnicodeDecodeError):
+            continue
+        try:
+            out.extend(analyze_source(src, rel, seams=seams))
+        except SyntaxError as exc:
+            out.append(Finding(
+                path=rel, line=exc.lineno or 1, rule="PARSE000",
+                qualname="<module>",
+                message=f"syntax error: {exc.msg}",
+            ))
+    return out
+
+
+# ------------------------------------------------------------ baseline
+
+def load_baseline(path: str) -> Counter:
+    """Fingerprint MULTISET from a baseline file ('#' comments and
+    blank lines ignored; each entry should carry a justification
+    comment).  A multiset because fingerprints are line-number free:
+    two identical-shape violations in the same function share one
+    fingerprint and need two baseline lines."""
+    fps: Counter = Counter()
+    p = Path(path)
+    if not p.exists():
+        return fps
+    for line in p.read_text().splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        fps[line] += 1
+    return fps
+
+
+def diff_baseline(
+    findings: Sequence[Finding], baseline
+) -> Tuple[List[Finding], Set[str]]:
+    """(new findings beyond the baselined COUNT per fingerprint, stale
+    baseline entries no longer matched).  Count-aware: one baseline
+    entry must not mask a SECOND identical-shape violation added later
+    to the same function."""
+    base = baseline if isinstance(baseline, Counter) else Counter(
+        baseline
+    )
+    seen: Counter = Counter()
+    new: List[Finding] = []
+    for f in findings:
+        seen[f.fingerprint] += 1
+        if seen[f.fingerprint] > base.get(f.fingerprint, 0):
+            new.append(f)
+    stale = {
+        fp for fp, n in base.items() if seen.get(fp, 0) < n
+    }
+    return new, stale
+
+
+DEFAULT_BASELINE = str(Path(__file__).parent / "baseline.txt")
+DEFAULT_PATHS = ("emqx_tpu",)
