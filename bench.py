@@ -152,6 +152,78 @@ def measure_insert_rps(base_filters, n_insert, log):
     return rps, float(p50), float(p99)
 
 
+def run_dispatch_fanout_bench(log):
+    """Dispatch-half microbench: fixed fan-out sweep (1 / 16 / 256
+    subscribers per message) through the REAL window pipeline —
+    publish_many → CSR expansion → per-client grouping →
+    single-encode → corked per-connection write — with wire encode +
+    write counted (each channel's send serializes every packet and
+    appends to a sink, exactly Connection._send_packets minus the
+    socket).  Host matching (the match half has its own benches);
+    QoS 0 subscribers so the clock sees fan-out, not ack windows.
+
+    Reports routed msg/s per fan-out level as
+    ``dispatch_fanout_msgs_per_s``."""
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.broker.channel import Channel
+    from emqx_tpu.broker.session import SubOpts
+    from emqx_tpu.codec import mqtt as C
+    from emqx_tpu.config import BrokerConfig
+    from emqx_tpu.message import Message
+
+    window = 64
+    n_for = {1: 20000, 16: 4000, 256: 500}
+    out = {}
+    for fanout in (1, 16, 256):
+        cfg = BrokerConfig()
+        cfg.engine.use_device = False
+        b = Broker(config=cfg)
+        sink = [0, 0]  # bytes written, write calls
+
+        def make_send(version):
+            def _send(pkts):
+                data = b"".join(C.serialize(p, version) for p in pkts)
+                sink[0] += len(data)
+                sink[1] += 1
+            return _send
+
+        flt = f"fan/{fanout}"
+        for i in range(fanout):
+            ch = Channel(b, send=make_send(C.MQTT_V5),
+                         close=lambda r: None)
+            cid = f"fs{i}"
+            session, _ = b.cm.open_session(True, cid, ch)
+            session.subscribe(flt, SubOpts(qos=0))
+            b.subscribe(cid, flt, SubOpts(qos=0))
+
+        n = n_for[fanout]
+        msgs = [Message(topic=flt, payload=b"x" * 64) for _ in range(n)]
+        b.publish_many(msgs[:window])  # warm
+        t0 = time.perf_counter()
+        total = 0
+        for w0 in range(window, n, window):
+            total += sum(b.publish_many(msgs[w0:w0 + window]))
+        dt = time.perf_counter() - t0
+        routed = n - window
+        assert total == routed * fanout, (total, routed * fanout)
+        out[f"fanout_{fanout}"] = routed / dt
+        log(
+            f"dispatch fanout {fanout}: {routed / dt:,.0f} msg/s "
+            f"({routed * fanout / dt:,.0f} deliveries/s, "
+            f"{sink[1]} writes, {sink[0] / (1 << 20):.1f} MiB)"
+        )
+    out["note"] = (
+        "publish_many windows of 64, QoS0, 64 B payloads, host "
+        "matching; encode+write counted (every packet serialized "
+        "into a per-connection sink).  Pre-PR3 per-subscriber "
+        "dispatch on this harness: fanout 1 -> 33,314, 16 -> 4,709, "
+        "256 -> 267 msg/s (one transport write per delivery); the "
+        "window path (CSR expand -> encode-once -> corked flush) "
+        "must hold fanout 256 at >= 3x that 267 baseline."
+    )
+    return out
+
+
 def run_broker_bench(log, mode="auto"):
     """End-to-end socket benchmark (BASELINE config 1 shape, the
     emqtt_bench workload): N publishers / M wildcard subscribers over
@@ -824,6 +896,12 @@ def main():
             "multicore broker bench", "bench_multicore.py", 540
         ))
 
+    fanout_stats = {}
+    if os.environ.get("BENCH_FANOUT_DISPATCH", "1") != "0":
+        # the dispatch half of the pipeline (BENCH_r06+ tracks the
+        # PR 3 tentpole): fixed fan-out sweep, encode+write counted
+        fanout_stats = run_dispatch_fanout_bench(log)
+
     broker_stats = {}
     if os.environ.get("BENCH_BROKER", "1") != "0":
         # three rows at >=1M background subs: host-pinned (the
@@ -874,6 +952,7 @@ def main():
         "Zipf-hit-rate dependent — matches the production engine's "
         "cache) + device match + async compact-code transfer + "
         "vectorized host CSR expand to per-topic fid lists",
+        "dispatch_fanout_msgs_per_s": fanout_stats,
         **sharded_stats,
         **broker_stats,
     }

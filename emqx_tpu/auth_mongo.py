@@ -104,7 +104,14 @@ def bson_decode(data: bytes, offset: int = 0) -> Tuple[Dict[str, Any], int]:
 
 class MongoConnector:
     """One OP_MSG connection; `command` runs one database command and
-    returns the reply document."""
+    returns the reply document.
+
+    Commands PIPELINE on the single connection: OP_MSG replies carry
+    ``responseTo``, so each caller registers a future under its
+    request id, writes its frame, and a shared reader pump
+    demultiplexes replies back — concurrent CONNECT-time auth
+    lookups no longer serialize on a lock held across the full
+    round-trip."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 27017,
                  database: str = "mqtt") -> None:
@@ -114,39 +121,97 @@ class MongoConnector:
         self._r: Optional[asyncio.StreamReader] = None
         self._w: Optional[asyncio.StreamWriter] = None
         self._req = itertools.count(1)
-        self._lock = asyncio.Lock()
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._reader: Optional[asyncio.Task] = None
+        self._connecting: Optional[asyncio.Task] = None
+
+    async def _connect(self) -> None:
+        self._r, self._w = await asyncio.open_connection(
+            self.host, self.port
+        )
+        # fresh pending map per connection: a stale pump's teardown
+        # must never fail futures registered against its successor
+        self._pending = {}
+        self._reader = asyncio.get_running_loop().create_task(
+            self._read_loop(self._r, self._pending)
+        )
 
     async def _ensure(self) -> None:
-        if self._w is None or self._w.is_closing():
-            self._r, self._w = await asyncio.open_connection(
-                self.host, self.port
+        """Connect once, even under concurrent callers: the first
+        starts the dial, the rest await the same task."""
+        if self._w is not None and not self._w.is_closing():
+            return
+        if self._connecting is None or self._connecting.done():
+            self._connecting = asyncio.get_running_loop().create_task(
+                self._connect()
             )
+        await asyncio.shield(self._connecting)
+
+    async def _read_loop(
+        self, r: asyncio.StreamReader,
+        pending: Dict[int, "asyncio.Future"],
+    ) -> None:
+        """Reader pump: demultiplex replies by ``responseTo``."""
+        try:
+            while True:
+                hdr = await r.readexactly(16)
+                length, _rid, resp_to, opcode = struct.unpack(
+                    "<iiii", hdr
+                )
+                payload = await r.readexactly(length - 16)
+                fut = pending.pop(resp_to, None)
+                if fut is None or fut.done():
+                    continue
+                if opcode != OP_MSG:
+                    fut.set_exception(
+                        ConnectionError(f"unexpected opcode {opcode}")
+                    )
+                    continue
+                try:
+                    # flagBits(4) + section kind(1) + document
+                    reply, _ = bson_decode(payload, 5)
+                except Exception as exc:
+                    fut.set_exception(exc)
+                else:
+                    fut.set_result(reply)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            pass  # connection loss surfaces via the pending futures
+        finally:
+            exc = ConnectionError(
+                f"mongo connection {self.host}:{self.port} lost"
+            )
+            for fut in pending.values():
+                if not fut.done():
+                    fut.set_exception(exc)
+            pending.clear()
+            # tear the transport down with the pump: a half-closed
+            # socket must read as disconnected, or every later
+            # command() would register in an unpumped map and stall
+            # CONNECT-time auth to its timeout instead of re-dialing
+            if self._r is r and self._w is not None:
+                w, self._w, self._r = self._w, None, None
+                w.close()
 
     async def command(self, doc: Dict[str, Any],
                       timeout: float = 5.0) -> Dict[str, Any]:
-        async with self._lock:
-            await self._ensure()
-            rid = next(self._req)
-            doc = dict(doc)
-            doc.setdefault("$db", self.database)
-            body = struct.pack("<I", 0) + b"\x00" + bson_encode(doc)
-            msg = struct.pack(
-                "<iiii", 16 + len(body), rid, 0, OP_MSG
-            ) + body
+        await self._ensure()
+        rid = next(self._req)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        doc = dict(doc)
+        doc.setdefault("$db", self.database)
+        body = struct.pack("<I", 0) + b"\x00" + bson_encode(doc)
+        msg = struct.pack(
+            "<iiii", 16 + len(body), rid, 0, OP_MSG
+        ) + body
+        try:
             self._w.write(msg)
             await self._w.drain()
-            hdr = await asyncio.wait_for(
-                self._r.readexactly(16), timeout
-            )
-            length, _rid, _resp_to, opcode = struct.unpack("<iiii", hdr)
-            payload = await asyncio.wait_for(
-                self._r.readexactly(length - 16), timeout
-            )
-            if opcode != OP_MSG:
-                raise ConnectionError(f"unexpected opcode {opcode}")
-            # flagBits(4) + section kind(1) + document
-            reply, _ = bson_decode(payload, 5)
-            return reply
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(rid, None)
 
     async def find_one(self, collection: str,
                        flt: Dict[str, Any]) -> Optional[Dict]:
@@ -164,6 +229,10 @@ class MongoConnector:
         return list(reply.get("cursor", {}).get("firstBatch", []))
 
     async def close(self) -> None:
+        if self._reader is not None:
+            self._reader.cancel()
+            self._reader = None
+        self._connecting = None
         if self._w is not None:
             self._w.close()
             self._w = self._r = None

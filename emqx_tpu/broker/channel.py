@@ -120,8 +120,30 @@ class Channel:
         self._defer_drained: Optional[asyncio.Event] = None
         self.DEFER_HIGH = 256
         self.DEFER_LOW = 64
+        # write coalescing: while corked (dispatch window / batched ack
+        # resolution), outgoing packets buffer and flush as ONE
+        # concatenated transport.write on uncork
+        self._cork_depth = 0
+        self._cork_buf: List[C.Packet] = []
 
     # ---------------------------------------------------------- util
+
+    def cork(self) -> None:
+        """Begin a write-coalescing scope: until the matching
+        `uncork`, `send_packets` buffers instead of writing, so a
+        dispatch window's deliveries (or a batch's acks) reach the
+        transport as one concatenated write per connection.  Scopes
+        are synchronous on the loop thread — nothing interleaves —
+        and nest via a depth counter."""
+        self._cork_depth += 1
+
+    def uncork(self) -> None:
+        if self._cork_depth:
+            self._cork_depth -= 1
+        if self._cork_depth == 0 and self._cork_buf:
+            buf, self._cork_buf = self._cork_buf, []
+            if not self._closing:
+                self._send(buf)
 
     def send_packets(self, packets: List[C.Packet]) -> None:
         if packets and not self._closing:
@@ -132,9 +154,18 @@ class Channel:
                     m.slots("messages.sent", q, "packets.publish.sent")
                     for q in _QOS_SENT
                 )
+            # count per qos first, then ONE locked bump per class —
+            # a 256-subscriber fan-out was 768 lock acquisitions
+            npub = [0, 0, 0]
             for p in packets:
                 if p.type == C.PUBLISH:
-                    m.inc_slots(sent[p.qos])
+                    npub[p.qos] += 1
+            for q in (0, 1, 2):
+                if npub[q]:
+                    m.inc_slots(sent[q], npub[q])
+            if self._cork_depth:
+                self._cork_buf.extend(packets)
+                return
             self._send(packets)
 
     def close(self, reason: str) -> None:
@@ -156,6 +187,7 @@ class Channel:
     def _shutdown(self, reason: str) -> None:
         self._closing = True
         self.state = DISCONNECTED
+        self._cork_buf = []  # never flush past teardown
         # cancel the WHOLE deferred chain: cancelling only the tail
         # would leave every predecessor running verdict RPCs and
         # touching channel state long after the socket died

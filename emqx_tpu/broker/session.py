@@ -137,18 +137,39 @@ class Session:
     # -------------------------------------------------- deliver (out)
 
     def deliver(
-        self, deliveries: List[Tuple[Message, SubOpts]]
+        self,
+        deliveries: List[Tuple[Message, SubOpts]],
+        encoder: Optional["C.DispatchEncoder"] = None,
+        version: Optional[int] = None,
     ) -> List[C.Packet]:
         """Accept matched messages for this session; returns the wire
         packets that can go out now (window permitting) — the
-        `emqx_session:deliver/3` path."""
+        `emqx_session:deliver/3` path.
+
+        With a window ``encoder`` (and the channel's negotiated
+        ``version``), standard deliveries come back as pre-rendered
+        single-encode packets: the PUBLISH body is serialized once per
+        window and only the packet id is patched per subscriber.
+        Deliveries carrying a subscription identifier (per-subscriber
+        properties) fall back to the ordinary per-packet encode."""
         out: List[C.Packet] = []
+        enc = encoder if version is not None else None
+        cid = self.clientid
+        upgrade = self.upgrade_qos
         for msg, opts in deliveries:
-            if opts.no_local and msg.from_client == self.clientid:
+            if opts.no_local and msg.from_client == cid:
                 continue  # [MQTT-3.8.3-3]
-            qos = self._effective_qos(msg.qos, opts)
+            # inline _effective_qos: this loop runs once per delivery
+            # of every fan-out window
+            mq, oq = msg.qos, opts.qos
+            qos = (mq if mq > oq else oq) if upgrade else (
+                mq if mq < oq else oq
+            )
             if qos == 0:
-                out.append(self._publish_packet(msg, opts, 0, None))
+                if enc is not None and opts.subid is None:
+                    out.append(enc.publish_qos0(msg, opts, version))
+                else:
+                    out.append(self._publish_packet(msg, opts, 0, None))
                 continue
             if self.inflight.is_full():
                 evicted = self.mqueue.insert(self._queued(msg, opts, qos))
@@ -159,7 +180,10 @@ class Session:
             self.inflight.insert(
                 pid, _InflightEntry(_PUBLISHING, msg, qos, time.time())
             )
-            out.append(self._publish_packet(msg, opts, qos, pid))
+            if enc is not None and opts.subid is None:
+                out.append(enc.publish(msg, opts, qos, pid, version))
+            else:
+                out.append(self._publish_packet(msg, opts, qos, pid))
         return out
 
     def _effective_qos(self, msg_qos: int, opts: SubOpts) -> int:

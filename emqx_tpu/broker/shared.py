@@ -40,6 +40,10 @@ class SharedSubManager:
         self._rng = random.Random(seed)
         # (group, filter) -> ordered members (insertion order = join order)
         self._members: Dict[Tuple[str, str], Dict[str, None]] = {}
+        # filter -> live groups: dispatch asks "which groups for this
+        # matched filter" once per (msg, filter) — an index beats
+        # scanning every (group, filter) pair on the hot path
+        self._groups_by_filter: Dict[str, Set[str]] = {}
         self._rr: Dict[Tuple[str, str], int] = {}
         self._rr_group: Dict[str, int] = {}
         self._sticky: Dict[Tuple[str, str], str] = {}
@@ -53,6 +57,7 @@ class SharedSubManager:
         members = self._members.get(key)
         if members is None:
             members = self._members[key] = {}
+            self._groups_by_filter.setdefault(flt, set()).add(group)
         fresh = not members
         members[clientid] = None
         return fresh
@@ -70,6 +75,11 @@ class SharedSubManager:
         if not members:
             del self._members[key]
             self._rr.pop(key, None)
+            groups = self._groups_by_filter.get(flt)
+            if groups is not None:
+                groups.discard(group)
+                if not groups:
+                    del self._groups_by_filter[flt]
             return True
         return False
 
@@ -84,7 +94,8 @@ class SharedSubManager:
         return emptied
 
     def groups_for(self, flt: str) -> List[str]:
-        return [g for (g, f) in self._members if f == flt]
+        groups = self._groups_by_filter.get(flt)
+        return list(groups) if groups else []
 
     def members(self, group: str, flt: str) -> List[str]:
         return list(self._members.get((group, flt), ()))

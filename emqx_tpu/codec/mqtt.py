@@ -848,6 +848,12 @@ def _ser_auth(p: Auth) -> Tuple[int, bytes]:
 
 def serialize(pkt: Packet, version: int = MQTT_V5) -> bytes:
     """Serialize a packet for the given negotiated protocol version."""
+    wire = getattr(pkt, "_wire", None)
+    if wire is not None and wire[0] == version:
+        # pre-rendered by a DispatchEncoder (single-encode fan-out):
+        # the frame was built once for this version and patched per
+        # subscriber — bit-identical to the re-encode below
+        return wire[1]
     t = pkt.type
     if t == PUBLISH and not pkt.properties:
         # hot path: a handful of C-level joins, no per-byte Python work
@@ -907,3 +913,96 @@ def serialize(pkt: Packet, version: int = MQTT_V5) -> bytes:
     else:
         raise MqttError(f"cannot serialize {pkt!r}")
     return bytes([(t << 4) | flags]) + _varint(len(body)) + body
+
+
+# ---------------------------------------------------------------------------
+# single-encode fan-out
+
+_PID_STRUCT = struct.Struct(">H")
+
+
+class DispatchEncoder:
+    """Window-scoped encode-once cache for PUBLISH fan-out.
+
+    The per-subscriber re-encode was the dispatch hot loop's main cost:
+    the same (topic, payload, effective-QoS, retain-as-published) body
+    serialized once PER SUBSCRIBER.  This encoder serializes each
+    unique body once per window and hands out packets whose ``_wire``
+    attribute carries the pre-rendered frame (`serialize` returns it
+    verbatim when the negotiated version matches):
+
+      * QoS 0: one shared ``Publish`` object + one shared frame for
+        every subscriber — zero per-subscriber work;
+      * QoS > 0: the frame is split around the packet-id slot into
+        shared ``memoryview`` segments; per subscriber only the 2-byte
+        packet id is patched in (one small join, no re-encode).
+
+    Only the standard delivery shape qualifies (no per-subscriber
+    subscription identifier); anything else falls back to the normal
+    per-packet encode, so the wire stays bit-identical either way.
+    The cache keys on ``id(msg)``: the encoder must not outlive its
+    dispatch window (messages do)."""
+
+    __slots__ = ("_parts", "_q0")
+
+    def __init__(self) -> None:
+        self._parts: Dict[Tuple, Tuple] = {}
+        self._q0: Dict[Tuple, Publish] = {}
+
+    def _parts_for(self, msg, qos: int, retain: bool, version: int):
+        key = (id(msg), qos, retain, version)
+        entry = self._parts.get(key)
+        if entry is None:
+            props: Properties = dict(msg.properties)
+            left = msg.remaining_expiry()
+            if left is not None:
+                props["message_expiry_interval"] = left  # [MQTT-3.3.2-6]
+            wire = serialize(
+                Publish(
+                    topic=msg.topic,
+                    payload=msg.payload,
+                    qos=qos,
+                    retain=retain,
+                    packet_id=1 if qos else None,
+                    properties=props,
+                ),
+                version,
+            )
+            if qos == 0:
+                entry = (props, wire, b"")
+            else:
+                i = 1  # skip fixed header byte + remaining-length varint
+                while wire[i] & 0x80:
+                    i += 1
+                off = i + 1 + 2 + len(msg.topic.encode("utf-8"))
+                mv = memoryview(wire)
+                entry = (props, mv[:off], mv[off + 2:])
+            self._parts[key] = entry
+        return entry
+
+    def publish_qos0(self, msg, opts, version: int) -> Publish:
+        retain = msg.retain and opts.retain_as_published
+        key = (id(msg), retain, version)
+        pkt = self._q0.get(key)
+        if pkt is None:
+            props, wire, _ = self._parts_for(msg, 0, retain, version)
+            pkt = Publish(
+                topic=msg.topic, payload=msg.payload, qos=0,
+                retain=retain, properties=props,
+            )
+            pkt._wire = (version, wire)  # type: ignore[attr-defined]
+            self._q0[key] = pkt
+        return pkt
+
+    def publish(self, msg, opts, qos: int, pid: int,
+                version: int) -> Publish:
+        retain = msg.retain and opts.retain_as_published
+        props, head, tail = self._parts_for(msg, qos, retain, version)
+        pkt = Publish(
+            topic=msg.topic, payload=msg.payload, qos=qos,
+            retain=retain, packet_id=pid, properties=props,
+        )
+        pkt._wire = (  # type: ignore[attr-defined]
+            version, b"".join((head, _PID_STRUCT.pack(pid), tail))
+        )
+        return pkt

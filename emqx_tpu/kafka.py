@@ -197,7 +197,14 @@ def decode_batch_record_count(batch: bytes) -> int:
 
 class KafkaClient:
     """One broker connection: framed requests, correlation-id matched
-    responses (responses arrive in order per connection)."""
+    responses.
+
+    Requests PIPELINE: each caller registers a future under its
+    correlation id, writes its frame, and awaits the future; a single
+    reader pump matches responses (in order per connection, but the
+    id does the matching) back to their futures.  Concurrent produces
+    no longer serialize on a lock held across the full round-trip —
+    a slow broker delays only its own callers' futures."""
 
     def __init__(self, host: str, port: int,
                  client_id: str = "emqx_tpu") -> None:
@@ -207,14 +214,73 @@ class KafkaClient:
         self._r: Optional[asyncio.StreamReader] = None
         self._w: Optional[asyncio.StreamWriter] = None
         self._corr = 0
-        self._lock = asyncio.Lock()
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._reader: Optional[asyncio.Task] = None
+        self._connecting: Optional[asyncio.Task] = None
 
     async def connect(self) -> None:
         self._r, self._w = await asyncio.open_connection(
             self.host, self.port
         )
+        # fresh pending map per connection: a stale pump's teardown
+        # must never fail futures registered against its successor
+        self._pending = {}
+        self._reader = asyncio.get_running_loop().create_task(
+            self._read_loop(self._r, self._pending)
+        )
+
+    async def _ensure(self) -> None:
+        """Connect once, even under concurrent callers: the first
+        caller starts the dial, the rest await the same task (a
+        failure propagates to all and the next call retries)."""
+        if self.connected:
+            return
+        if self._connecting is None or self._connecting.done():
+            self._connecting = asyncio.get_running_loop().create_task(
+                self.connect()
+            )
+        await asyncio.shield(self._connecting)
+
+    async def _read_loop(
+        self, r: asyncio.StreamReader,
+        pending: Dict[int, asyncio.Future],
+    ) -> None:
+        """Reader pump: one task demultiplexes every response to its
+        caller's future by correlation id."""
+        try:
+            while True:
+                raw = await r.readexactly(4)
+                (size,) = struct.unpack(">i", raw)
+                payload = await r.readexactly(size)
+                (corr,) = struct.unpack_from(">i", payload, 0)
+                fut = pending.pop(corr, None)
+                if fut is not None and not fut.done():
+                    fut.set_result(payload[4:])
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            pass  # connection loss surfaces via the pending futures
+        finally:
+            exc = ConnectionError(
+                f"kafka connection {self.host}:{self.port} lost"
+            )
+            for fut in pending.values():
+                if not fut.done():
+                    fut.set_exception(exc)
+            pending.clear()
+            # tear the transport down with the pump: a half-closed
+            # socket must read as disconnected, or every later
+            # request() would register in an unpumped map and hang to
+            # its timeout instead of re-dialing
+            if self._r is r and self._w is not None:
+                w, self._w, self._r = self._w, None, None
+                w.close()
 
     def close(self) -> None:
+        if self._reader is not None:
+            self._reader.cancel()
+            self._reader = None
+        self._connecting = None
         if self._w is not None:
             self._w.close()
             self._r = self._w = None
@@ -225,31 +291,22 @@ class KafkaClient:
 
     async def request(self, api_key: int, api_version: int,
                       body: bytes, timeout: float = 10.0) -> bytes:
-        async with self._lock:  # serialize: in-order responses
-            if not self.connected:
-                await self.connect()
-            self._corr += 1
-            corr = self._corr
-            header = (
-                struct.pack(">hhi", api_key, api_version, corr)
-                + _string(self.client_id)
-            )
-            msg = header + body
+        await self._ensure()
+        self._corr += 1
+        corr = self._corr
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[corr] = fut
+        header = (
+            struct.pack(">hhi", api_key, api_version, corr)
+            + _string(self.client_id)
+        )
+        msg = header + body
+        try:
             self._w.write(struct.pack(">i", len(msg)) + msg)
             await self._w.drain()
-            raw = await asyncio.wait_for(
-                self._r.readexactly(4), timeout
-            )
-            (size,) = struct.unpack(">i", raw)
-            payload = await asyncio.wait_for(
-                self._r.readexactly(size), timeout
-            )
-            (got_corr,) = struct.unpack_from(">i", payload, 0)
-            if got_corr != corr:
-                raise ConnectionError(
-                    f"correlation mismatch {got_corr} != {corr}"
-                )
-            return payload[4:]
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(corr, None)
 
     # ------------------------------------------------------- metadata
 

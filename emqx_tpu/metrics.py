@@ -135,6 +135,23 @@ class Metrics:
             for i in slots:
                 c[i] += by
 
+    def inc_bulk(self, updates: Dict[str, int]) -> None:
+        """Apply a batch of counter deltas under ONE lock acquisition —
+        the dispatch window accumulates its per-delivery bookkeeping
+        locally and flushes here once per window instead of locking
+        per delivery."""
+        if not updates:
+            return
+        c = self._c
+        extra = self._extra
+        with self._lock:
+            for name, by in updates.items():
+                i = _SLOT.get(name)
+                if i is None:
+                    extra[name] = extra.get(name, 0) + by
+                else:
+                    c[i] += by
+
     def val(self, name: str) -> int:
         i = _SLOT.get(name)
         return self._extra.get(name, 0) if i is None else self._c[i]

@@ -8,6 +8,14 @@ schema).  Here one object owns both because a single host is one
 (fid = the filter string), and per-filter subscriber maps carry
 (clientid -> SubOpts) fan-out, CSR-expanded at dispatch time.
 
+Fan-out expansion is vectorized: client ids intern to integer rows and
+each SubOpts to a table slot, and each filter keeps an incrementally
+maintained CSR column of (client_row, opts_row) pairs.  A window's
+matched fid sets expand to flat ``(msg_idx, client_row, opts_row)``
+arrays in one pass (`expand_window`) instead of per-filter dict churn —
+rule fids and shared-group fids split off as distinct columns feeding
+the rule sink and the shared-pick path.
+
 Shared subscriptions route through the same engine entry for the real
 filter; group membership and per-message picks live in
 `SharedSubManager`.
@@ -17,10 +25,65 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
+import numpy as np
+
 from . import topic as T
 from .broker.session import SubOpts
 from .broker.shared import SharedSubManager
 from .engine import MatchEngine
+
+_EMPTY_I64 = np.empty(0, dtype=np.int64)
+
+
+class _CsrBucket:
+    """One filter's subscriber column: parallel (client_row, opts_row)
+    lists with O(1) append and swap-remove, plus lazily rebuilt numpy
+    views so a window expansion is array concatenation, not dict
+    iteration."""
+
+    __slots__ = ("rows", "opts_rows", "pos", "_arr")
+
+    def __init__(self) -> None:
+        self.rows: List[int] = []
+        self.opts_rows: List[int] = []
+        self.pos: Dict[int, int] = {}  # client_row -> index
+        self._arr: Optional[Tuple[np.ndarray, np.ndarray]] = None
+
+    def add(self, client_row: int, opts_row: int) -> None:
+        self.pos[client_row] = len(self.rows)
+        self.rows.append(client_row)
+        self.opts_rows.append(opts_row)
+        self._arr = None
+
+    def opts_row_of(self, client_row: int) -> Optional[int]:
+        i = self.pos.get(client_row)
+        return None if i is None else self.opts_rows[i]
+
+    def remove(self, client_row: int) -> Optional[int]:
+        """Swap-remove; returns the freed opts row (None if absent)."""
+        i = self.pos.pop(client_row, None)
+        if i is None:
+            return None
+        freed = self.opts_rows[i]
+        last_row = self.rows[-1]
+        last_opts = self.opts_rows[-1]
+        self.rows.pop()
+        self.opts_rows.pop()
+        if i < len(self.rows):
+            self.rows[i] = last_row
+            self.opts_rows[i] = last_opts
+            self.pos[last_row] = i
+        self._arr = None
+        return freed
+
+    def arrays(self) -> Tuple[np.ndarray, np.ndarray]:
+        a = self._arr
+        if a is None:
+            a = self._arr = (
+                np.asarray(self.rows, dtype=np.int64),
+                np.asarray(self.opts_rows, dtype=np.int64),
+            )
+        return a
 
 
 class Router:
@@ -38,12 +101,63 @@ class Router:
         # points, emqx_broker.erl:691-721) — ClusterNode broadcasts them
         self.on_route_added = None
         self.on_route_removed = None
-        # real filter -> {clientid -> SubOpts} (direct, non-shared)
+        # real filter -> {clientid -> SubOpts} (direct, non-shared).
+        # Stays the source of truth (mgmt dumps, counts, the legacy
+        # walk the CSR property test checks against).
         self._subs: Dict[str, Dict[str, SubOpts]] = {}
         # real filter -> {(group, clientid) -> SubOpts} (shared)
         self._shared_opts: Dict[str, Dict[Tuple[str, str], SubOpts]] = {}
         # clientid -> set of full filter strings (incl. $share prefix)
         self._by_client: Dict[str, Set[str]] = {}
+        # --- interning tables + CSR fan-out index -------------------
+        self._client_rows: Dict[str, int] = {}   # clientid -> row
+        self._row_clients: List[str] = []        # row -> clientid
+        self._row_free: List[int] = []
+        self._opts_table: List[Optional[SubOpts]] = []
+        self._opts_free: List[int] = []
+        self._csr: Dict[str, _CsrBucket] = {}
+
+    # ---------------------------------------------------- interning
+
+    def _intern(self, clientid: str) -> int:
+        row = self._client_rows.get(clientid)
+        if row is None:
+            if self._row_free:
+                row = self._row_free.pop()
+                self._row_clients[row] = clientid
+            else:
+                row = len(self._row_clients)
+                self._row_clients.append(clientid)
+            self._client_rows[clientid] = row
+        return row
+
+    def _release_client(self, clientid: str) -> None:
+        row = self._client_rows.pop(clientid, None)
+        if row is not None:
+            self._row_clients[row] = ""
+            self._row_free.append(row)
+
+    def _alloc_opts(self, opts: SubOpts) -> int:
+        if self._opts_free:
+            slot = self._opts_free.pop()
+            self._opts_table[slot] = opts
+        else:
+            slot = len(self._opts_table)
+            self._opts_table.append(opts)
+        return slot
+
+    def _free_opts(self, slot: int) -> None:
+        self._opts_table[slot] = None
+        self._opts_free.append(slot)
+
+    def client_of_row(self, row: int) -> str:
+        return self._row_clients[row]
+
+    def row_of_client(self, clientid: str) -> Optional[int]:
+        return self._client_rows.get(clientid)
+
+    def opts_at(self, slot: int) -> SubOpts:
+        return self._opts_table[slot]  # type: ignore[return-value]
 
     # ------------------------------------------------------- mutation
 
@@ -55,6 +169,7 @@ class Router:
         if shared is not None:
             real = shared.topic
             opts.share_group = shared.group
+            self._intern(clientid)  # picks resolve to rows at dispatch
             need_route = self.shared.join(shared.group, real, clientid)
             self._shared_opts.setdefault(real, {})[
                 (shared.group, clientid)
@@ -73,6 +188,15 @@ class Router:
                     if self.on_route_added is not None:
                         self.on_route_added(real)
             subs[clientid] = opts
+            row = self._intern(clientid)
+            bucket = self._csr.get(real)
+            if bucket is None:
+                bucket = self._csr[real] = _CsrBucket()
+            slot = bucket.opts_row_of(row)
+            if slot is None:
+                bucket.add(row, self._alloc_opts(opts))
+            else:  # options refresh of an existing subscription
+                self._opts_table[slot] = opts
         self._by_client.setdefault(clientid, set()).add(flt)
 
     def unsubscribe(self, clientid: str, flt: str) -> bool:
@@ -95,6 +219,14 @@ class Router:
                 del subs[clientid]
                 if not subs:
                     del self._subs[real]
+                bucket = self._csr.get(real)
+                row = self._client_rows.get(clientid)
+                if bucket is not None and row is not None:
+                    freed = bucket.remove(row)
+                    if freed is not None:
+                        self._free_opts(freed)
+                    if not bucket.rows:
+                        del self._csr[real]
                 removed = True
         self._maybe_drop_route(real)
         filters = self._by_client.get(clientid)
@@ -102,6 +234,7 @@ class Router:
             filters.discard(flt)
             if not filters:
                 del self._by_client[clientid]
+                self._release_client(clientid)
         return removed
 
     def _maybe_drop_route(self, real: str) -> None:
@@ -143,7 +276,8 @@ class Router:
     def subscribers(
         self, real: str
     ) -> List[Tuple[str, SubOpts]]:
-        """Direct (non-shared) subscribers of a matched filter."""
+        """Direct (non-shared) subscribers of a matched filter (the
+        legacy per-filter walk; `expand_window` is the batched path)."""
         return list(self._subs.get(real, {}).items())
 
     def shared_opts(
@@ -151,3 +285,55 @@ class Router:
     ) -> Optional[SubOpts]:
         m = self._shared_opts.get(real)
         return None if m is None else m.get((group, clientid))
+
+    # ----------------------------------------------- window expansion
+
+    def expand_window(
+        self, matched: Sequence[Set]
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+               List[Tuple[int, str]], List[Tuple[int, str, str]]]:
+        """CSR-expand one window's matched fid sets to flat delivery
+        columns.
+
+        Returns ``(msg_idx, client_rows, opts_rows, rules, shared)``:
+        the three aligned int64 arrays cover every DIRECT (non-shared)
+        delivery in the window — one vectorized concatenation over the
+        per-filter CSR columns — while rule fids come back as
+        ``(msg_idx, rule_id)`` and shared-group fids as
+        ``(msg_idx, real_filter, group)`` for the rule-sink and
+        shared-pick paths.  Fids with no local state (e.g. raw engine
+        fids preloaded by benchmarks) cost one dict miss each."""
+        seg_rows: List[np.ndarray] = []
+        seg_opts: List[np.ndarray] = []
+        seg_msg: List[int] = []
+        seg_len: List[int] = []
+        rules: List[Tuple[int, str]] = []
+        shared: List[Tuple[int, str, str]] = []
+        csr = self._csr
+        groups_for = self.shared.groups_for
+        for i, fids in enumerate(matched):
+            for fid in fids:
+                if isinstance(fid, tuple):  # ("rule", rule_id, i)
+                    rules.append((i, fid[1]))
+                    continue
+                bucket = csr.get(fid)
+                if bucket is not None and bucket.rows:
+                    r, o = bucket.arrays()
+                    seg_rows.append(r)
+                    seg_opts.append(o)
+                    seg_msg.append(i)
+                    seg_len.append(len(r))
+                for group in groups_for(fid):
+                    shared.append((i, fid, group))
+        if not seg_rows:
+            return _EMPTY_I64, _EMPTY_I64, _EMPTY_I64, rules, shared
+        if len(seg_rows) == 1:
+            client_rows, opts_rows = seg_rows[0], seg_opts[0]
+            msg_idx = np.full(seg_len[0], seg_msg[0], dtype=np.int64)
+        else:
+            client_rows = np.concatenate(seg_rows)
+            opts_rows = np.concatenate(seg_opts)
+            msg_idx = np.repeat(
+                np.asarray(seg_msg, dtype=np.int64), seg_len
+            )
+        return msg_idx, client_rows, opts_rows, rules, shared

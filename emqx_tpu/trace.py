@@ -178,6 +178,8 @@ class TraceManager:
         self._emit("session.unsubscribed", clientid, flt)
 
     def _on_publish(self, msg):
+        if not self._rules:
+            return None  # no active traces: skip the format work
         self._emit(
             "message.publish",
             msg.from_client or None,
@@ -187,6 +189,8 @@ class TraceManager:
         return None  # never alters the fold accumulator
 
     def _on_delivered(self, clientid, deliveries) -> None:
+        if not self._rules:
+            return  # no active traces: stay off the fan-out hot path
         for msg, _opts in deliveries:
             self._emit(
                 "message.delivered", clientid, msg.topic, f"qos={msg.qos}"

@@ -136,6 +136,54 @@ def test_mongo_authn_and_acl_prefetch():
     run(t())
 
 
+def test_mongo_commands_pipeline_on_one_connection():
+    """PR 3 burn-down: commands no longer serialize on a lock held
+    across the round-trip.  The server here collects TWO complete
+    OP_MSG requests before answering either (impossible under the old
+    lock) and answers in REVERSE order — replies must demultiplex by
+    ``responseTo``, each caller seeing its own echoed document."""
+
+    async def t():
+        conns = []
+
+        async def handler(r, w):
+            conns.append(w)
+            seen = []
+            for _ in range(2):
+                hdr = await r.readexactly(16)
+                length, rid, _rto, _op = struct.unpack("<iiii", hdr)
+                payload = await r.readexactly(length - 16)
+                doc, _ = bson_decode(payload, 5)
+                seen.append((rid, doc))
+            for rid, doc in reversed(seen):
+                reply = bson_encode({
+                    "echo": doc.get("find", ""), "ok": 1.0,
+                })
+                body = struct.pack("<I", 0) + b"\x00" + reply
+                w.write(struct.pack(
+                    "<iiii", 16 + len(body), 99, rid, 2013
+                ) + body)
+            await w.drain()
+
+        server = await asyncio.start_server(handler, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        conn = MongoConnector("127.0.0.1", port)
+        r1, r2 = await asyncio.wait_for(
+            asyncio.gather(
+                conn.command({"find": "alpha"}),
+                conn.command({"find": "beta"}),
+            ),
+            5.0,
+        )
+        assert r1["echo"] == "alpha" and r2["echo"] == "beta"
+        assert len(conns) == 1  # both rode one pipelined connection
+        await conn.close()
+        server.close()
+        await server.wait_closed()
+
+    run(t())
+
+
 # ---------------------------------------------------------------- ldap
 
 def test_ber_bind_codec():
@@ -308,3 +356,33 @@ def test_env_overrides_and_boot_check():
     bad.listeners[0].type = "quic"  # no certfile
     problems = check_config(bad)
     assert len(problems) == 2
+
+
+def test_mongo_redials_after_connection_loss():
+    """Pump teardown closes the transport, so a later command re-dials
+    instead of stalling CONNECT-time auth to its timeout."""
+
+    async def t():
+        fm = FakeMongo()
+        fm.users["alice"] = {"username": "alice",
+                             "password_hash": "x", "salt": ""}
+        await fm.start()
+        conn = MongoConnector("127.0.0.1", fm.port)
+        assert (await conn.find_one(
+            "mqtt_user", {"username": "alice"}
+        ))["username"] == "alice"
+        first_w = conn._w
+        await fm.stop()
+        first_w.close()
+        await asyncio.sleep(0.05)
+        assert conn._w is None  # pump teardown reset the transport
+        await fm.start()
+        conn.port = fm.port
+        row = await asyncio.wait_for(
+            conn.find_one("mqtt_user", {"username": "alice"}), 5.0
+        )
+        assert row["username"] == "alice"
+        await conn.close()
+        await fm.stop()
+
+    run(t())

@@ -351,3 +351,104 @@ def test_config_declared_kafka_sink_boots():
         await fk.stop()
 
     run(t())
+
+
+def test_requests_pipeline_on_one_connection():
+    """PR 3 burn-down: requests no longer serialize on a lock held
+    across the full round-trip.  The server here collects TWO complete
+    requests before answering either (impossible under the old lock —
+    the second frame was only written after the first response), then
+    answers in REVERSE order to prove responses demultiplex by
+    correlation id, not arrival order."""
+
+    async def t():
+        conns = []
+
+        async def handler(r, w):
+            conns.append(w)
+            corrs = []
+            for _ in range(2):
+                raw = await r.readexactly(4)
+                (size,) = struct.unpack(">i", raw)
+                req = await r.readexactly(size)
+                _api, _ver, corr = struct.unpack_from(">hhi", req, 0)
+                corrs.append(corr)
+            for corr in reversed(corrs):
+                payload = struct.pack(">ii", corr, corr)
+                w.write(struct.pack(">i", len(payload)) + payload)
+            await w.drain()
+
+        server = await asyncio.start_server(handler, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        client = KafkaClient("127.0.0.1", port)
+        r1, r2 = await asyncio.wait_for(
+            asyncio.gather(
+                client.request(0, 0, b""), client.request(0, 0, b"")
+            ),
+            5.0,
+        )
+        # each caller got ITS body back despite reversed responses
+        assert struct.unpack(">i", r1)[0] == 1
+        assert struct.unpack(">i", r2)[0] == 2
+        assert len(conns) == 1  # both rode one pipelined connection
+        client.close()
+        server.close()
+        await server.wait_closed()
+
+    run(t())
+
+
+def test_connection_loss_fails_pending_requests():
+    """A dead connection must fail every in-flight future (the reader
+    pump's teardown), not leave callers hanging until their timeout."""
+
+    async def t():
+        async def handler(r, w):
+            await r.readexactly(4)  # swallow, never answer
+
+        server = await asyncio.start_server(handler, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        client = KafkaClient("127.0.0.1", port)
+        stuck = asyncio.ensure_future(
+            client.request(0, 0, b"", timeout=30.0)
+        )
+        await asyncio.sleep(0.05)
+        assert not stuck.done()
+        client._w.close()  # connection dies under the pending request
+        try:
+            await asyncio.wait_for(stuck, 5.0)
+            assert False, "expected the pending request to fail"
+        except ConnectionError:
+            pass
+        client.close()
+        server.close()
+        await server.wait_closed()
+
+    run(t())
+
+
+def test_client_redials_after_connection_loss():
+    """The reader pump tears the transport down with itself: after a
+    server-side close, the NEXT request must re-dial and succeed
+    instead of registering in an unpumped map and hanging."""
+
+    async def t():
+        fk = FakeKafka(n_partitions=1)
+        await fk.start()
+        client = KafkaClient("127.0.0.1", fk.port)
+        assert (await client.metadata(["t"]))["topics"]["t"] == {0: 0}
+        # kill the live connection server-side and let the pump die
+        first_w = client._w
+        fk.server.close()
+        await fk.server.wait_closed()
+        first_w.close()
+        await asyncio.sleep(0.05)
+        assert not client.connected  # pump teardown closed the writer
+        await fk.start()  # server back (new port)
+        client.port = fk.port
+        md = await asyncio.wait_for(client.metadata(["t"]), 5.0)
+        assert md["topics"]["t"] == {0: 0}
+        client.close()
+        await fk.stop()
+
+    run(t())

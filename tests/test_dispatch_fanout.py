@@ -1,0 +1,459 @@
+"""Vectorized fan-out (PR 3): CSR expansion equivalence + batched-path
+regression semantics.
+
+The referee for the window dispatch rewrite: the CSR expansion must
+equal the legacy per-filter walk under random sub/unsub churn, and the
+delivery-guard / shared skip-dead / no-local / RAP semantics must
+survive the batched path bit-identically — including the
+single-encode wire bytes and the one-write-per-connection corked
+flush."""
+
+import random
+
+import pytest
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.channel import Channel
+from emqx_tpu.broker.session import Session, SubOpts
+from emqx_tpu.codec import mqtt as C
+from emqx_tpu.message import Message
+from emqx_tpu.router import Router
+
+
+class FakeChannel:
+    """Versionless channel stub (legacy per-packet encode path)."""
+
+    def __init__(self):
+        self.sent = []
+        self.closed = None
+
+    def send_packets(self, pkts):
+        self.sent.extend(pkts)
+
+    def close(self, reason):
+        self.closed = reason
+
+
+class WireChannel(Channel):
+    """Real Channel over a capturing transport: counts writes and
+    serializes every packet exactly as Connection._send_packets does,
+    so tests see the true wire bytes and the real cork behavior."""
+
+    def __init__(self, broker, version=C.MQTT_V5):
+        self.writes = []
+        self.packets = []
+
+        def send(pkts):
+            self.packets.extend(pkts)
+            self.writes.append(
+                b"".join(C.serialize(p, self.version) for p in pkts)
+            )
+
+        super().__init__(broker, send=send, close=lambda r: None)
+        self.version = version
+
+
+def _connect(broker, clientid, channel=None, clean_start=True,
+             expiry=0.0):
+    ch = channel if channel is not None else FakeChannel()
+    session, _ = broker.cm.open_session(
+        clean_start, clientid, ch, expiry_interval=expiry
+    )
+    return ch, session
+
+
+# ------------------------------------------------ CSR property test
+
+
+def _legacy_expand(router, matched):
+    """The pre-PR3 per-filter walk, reconstructed per message."""
+    out = []
+    for fids in matched:
+        per_msg = []
+        rules = []
+        shared = []
+        for fid in fids:
+            if isinstance(fid, tuple):
+                rules.append(fid[1])
+                continue
+            for clientid, opts in router.subscribers(fid):
+                per_msg.append((clientid, id(opts)))
+            for group in router.shared.groups_for(fid):
+                shared.append((fid, group))
+        out.append((sorted(per_msg), sorted(rules), sorted(shared)))
+    return out
+
+
+def _csr_expand(router, matched):
+    """The batched expansion, regrouped to the legacy shape."""
+    msg_idx, rows, opts_rows, rules, shared = router.expand_window(
+        matched
+    )
+    n = len(matched)
+    per_msg = [[] for _ in range(n)]
+    for i, row, slot in zip(
+        msg_idx.tolist(), rows.tolist(), opts_rows.tolist()
+    ):
+        per_msg[i].append(
+            (router.client_of_row(row), id(router.opts_at(slot)))
+        )
+    rule_by = [[] for _ in range(n)]
+    for i, rid in rules:
+        rule_by[i].append(rid)
+    shared_by = [[] for _ in range(n)]
+    for i, real, group in shared:
+        shared_by[i].append((real, group))
+    return [
+        (sorted(per_msg[i]), sorted(rule_by[i]), sorted(shared_by[i]))
+        for i in range(n)
+    ]
+
+
+def test_csr_expansion_equals_legacy_walk_under_churn():
+    """Property test: random subscribe/unsubscribe churn (direct +
+    shared + option refreshes + full client cleanup) interleaved with
+    window expansions — the CSR path and the legacy per-filter walk
+    must agree on every (client, opts-identity) delivery, every rule
+    hit, and every shared-group hit."""
+    rng = random.Random(7)
+    r = Router()
+    clients = [f"c{i}" for i in range(24)]
+    filters = [f"t/{i}" for i in range(12)] + ["t/+", "a/#", "$sys/x"]
+    share_filters = [f"$share/g{i}/t/{i % 4}" for i in range(6)]
+    live = set()
+    for step in range(600):
+        op = rng.random()
+        cid = rng.choice(clients)
+        if op < 0.45:
+            flt = rng.choice(filters + share_filters)
+            r.subscribe(cid, flt, SubOpts(qos=rng.randint(0, 2)))
+            live.add((cid, flt))
+        elif op < 0.70 and live:
+            cid2, flt = rng.choice(sorted(live))
+            r.unsubscribe(cid2, flt)
+            live.discard((cid2, flt))
+        elif op < 0.78:
+            r.cleanup_client(cid)
+            live = {(c, f) for (c, f) in live if c != cid}
+        if step % 20 == 0:
+            # a window of matched fid sets: real filters, absent
+            # filters, raw int fids (bench-style), and rule tuples
+            matched = []
+            for _ in range(rng.randint(1, 6)):
+                fids = set(rng.sample(filters, rng.randint(0, 4)))
+                if rng.random() < 0.4:
+                    fids.add(("rule", f"r{rng.randint(0, 3)}", 0))
+                if rng.random() < 0.3:
+                    fids.add(1_000_000_000 + rng.randint(0, 5))
+                if rng.random() < 0.4:
+                    sf = rng.choice(share_filters)
+                    fids.add(sf.split("/", 2)[2])
+                matched.append(fids)
+            assert _csr_expand(r, matched) == _legacy_expand(r, matched)
+
+
+def test_pure_rule_window_short_circuits_subscriber_expansion():
+    """A window whose only hits are rule fids must reach the rule sink
+    without touching the CSR (empty expansion arrays) and account each
+    message as a no-subscriber drop — the PR3 satellite fix."""
+    b = Broker()
+    matched = [
+        {("rule", "r1", 0)},
+        {("rule", "r1", 1), ("rule", "r2", 1)},
+    ]
+    msg_idx, rows, opts_rows, rules, shared = b.router.expand_window(
+        matched
+    )
+    assert len(rows) == 0 and len(msg_idx) == 0 and not shared
+    assert sorted(rules) == [(0, "r1"), (1, "r1"), (1, "r2")]
+    sink = []
+    msgs = [Message(topic="x"), Message(topic="y")]
+    counts = b._dispatch_window(msgs, matched, rule_sink=sink)
+    assert counts == [0, 0]
+    assert [ids for _m, ids in sink] == [["r1"], ["r1", "r2"]]
+    assert b.metrics.val("messages.dropped.no_subscribers") == 2
+
+
+# -------------------------------------------- batched-path semantics
+
+
+def test_delivery_guards_survive_batched_path():
+    b = Broker()
+    for cid in ("allowed", "denied"):
+        ch, s = _connect(b, cid)
+        s.subscribe("$link/+", SubOpts(qos=0))
+        b.subscribe(cid, "$link/+", SubOpts(qos=0))
+        s.subscribe("plain", SubOpts(qos=0))
+        b.subscribe(cid, "plain", SubOpts(qos=0))
+    chans = {cid: b.cm.channel(cid) for cid in ("allowed", "denied")}
+    b.delivery_guards.append(
+        lambda cid, msg: cid == "allowed"
+    )
+    counts = b.publish_many([
+        Message(topic="$link/a"),
+        Message(topic="plain"),
+        Message(topic="$link/b"),
+    ])
+    # guards apply to $-topics only; 'plain' reaches both clients
+    assert counts == [1, 2, 1]
+    assert [p.topic for p in chans["allowed"].sent] == [
+        "$link/a", "plain", "$link/b"
+    ]
+    assert [p.topic for p in chans["denied"].sent] == ["plain"]
+
+
+def test_guard_denying_everyone_counts_no_subscribers():
+    b = Broker()
+    ch, s = _connect(b, "c1")
+    s.subscribe("$link/x", SubOpts(qos=0))
+    b.subscribe("c1", "$link/x", SubOpts(qos=0))
+    b.delivery_guards.append(lambda cid, msg: False)
+    assert b.publish(Message(topic="$link/x")) == 0
+    assert b.metrics.val("messages.dropped.no_subscribers") == 1
+
+
+def test_shared_pick_skips_dead_in_batched_window():
+    """_shared_pick redispatch (skip-dead) semantics through the
+    multi-message window path."""
+    b = Broker(shared_strategy="round_robin")
+    for cid in ("c1", "c2"):
+        ch, s = _connect(b, cid)
+        s.subscribe("$share/g/t", SubOpts(qos=0))
+        b.subscribe(cid, "$share/g/t", SubOpts(qos=0))
+    chans = {cid: b.cm.channel(cid) for cid in ("c1", "c2")}
+    counts = b.publish_many([Message(topic="t") for _ in range(4)])
+    assert counts == [1, 1, 1, 1]
+    assert len(chans["c1"].sent) == 2 and len(chans["c2"].sent) == 2
+    b.cm.kick("c1")
+    counts = b.publish_many([Message(topic="t") for _ in range(3)])
+    assert counts == [1, 1, 1]
+    assert len(chans["c2"].sent) == 5
+
+
+def test_no_local_and_rap_survive_batched_path():
+    b = Broker()
+    ch_nl, s_nl = _connect(b, "selfpub")
+    s_nl.subscribe("t", SubOpts(qos=0, no_local=True))
+    b.subscribe("selfpub", "t", SubOpts(qos=0, no_local=True))
+    ch_rap, s_rap = _connect(b, "rap")
+    s_rap.subscribe("t", SubOpts(qos=0, retain_as_published=True))
+    b.subscribe("rap", "t", SubOpts(qos=0, retain_as_published=True))
+    ch_plain, s_plain = _connect(b, "plain")
+    s_plain.subscribe("t", SubOpts(qos=0))
+    b.subscribe("plain", "t", SubOpts(qos=0))
+
+    b.publish_many([
+        Message(topic="t", payload=b"r", retain=True,
+                from_client="selfpub"),
+    ])
+    # no_local: the publisher's own subscription is skipped
+    # ([MQTT-3.8.3-3]) but still counts as a delivery target
+    assert ch_nl.sent == []
+    # retain-as-published: the RAP subscriber sees retain=1, the
+    # plain subscriber retain=0 [MQTT-3.3.1-9]
+    assert ch_rap.sent[0].retain is True
+    assert ch_plain.sent[0].retain is False
+
+
+def test_subscription_option_refresh_updates_csr():
+    """A re-subscribe with new options must change what the CSR path
+    delivers (the opts-table slot is replaced in place)."""
+    b = Broker()
+    ch, s = _connect(b, "c1")
+    s.subscribe("t", SubOpts(qos=0))
+    b.subscribe("c1", "t", SubOpts(qos=0))
+    b.publish(Message(topic="t", qos=1))
+    assert ch.sent[-1].qos == 0
+    s.subscribe("t", SubOpts(qos=1))
+    b.subscribe("c1", "t", SubOpts(qos=1), is_new_sub=False)
+    b.publish(Message(topic="t", qos=1))
+    assert ch.sent[-1].qos == 1
+
+
+# ------------------------------------------------- single-encode wire
+
+
+def _stripped(pkt):
+    """Re-build the packet without its pre-rendered wire."""
+    return C.Publish(
+        topic=pkt.topic, payload=pkt.payload, qos=pkt.qos,
+        retain=pkt.retain, dup=pkt.dup, packet_id=pkt.packet_id,
+        properties=dict(pkt.properties),
+    )
+
+
+@pytest.mark.parametrize("version", [C.MQTT_V4, C.MQTT_V5])
+def test_single_encode_is_bit_identical(version):
+    """The DispatchEncoder's pre-rendered frames must equal a from-
+    scratch serialize of the same packet — for QoS 0/1/2, RAP, large
+    payloads (multi-byte varint), and v5 properties."""
+    enc = C.DispatchEncoder()
+    cases = [
+        Message(topic="a/b", payload=b"x"),
+        Message(topic="a/b", payload=b"y" * 500, retain=True),
+        Message(topic="t/long/topic", payload=b"z" * 3,
+                properties={"user_property": [("k", "v")]}
+                if version == C.MQTT_V5 else {}),
+    ]
+    for msg in cases:
+        for qos in (0, 1, 2):
+            for rap in (False, True):
+                opts = SubOpts(qos=qos, retain_as_published=rap)
+                if qos == 0:
+                    pkt = enc.publish_qos0(msg, opts, version)
+                else:
+                    pkt = enc.publish(msg, opts, qos, 0x1234, version)
+                ver, wire = pkt._wire
+                assert ver == version
+                assert wire == C.serialize(_stripped(pkt), version)
+                # and serialize() itself returns the cached frame for
+                # the matching version, re-encodes for any other
+                assert C.serialize(pkt, version) == wire
+                other = C.MQTT_V4 if version == C.MQTT_V5 else C.MQTT_V5
+                assert C.serialize(pkt, other) == C.serialize(
+                    _stripped(pkt), other
+                )
+
+
+def test_session_deliver_uses_encoder_and_matches_legacy_wire():
+    """A session delivering through the window encoder must put the
+    same bytes on the wire as the legacy per-packet path, and QoS 0
+    fan-out must share ONE packet object across subscribers."""
+    msg = Message(topic="t", payload=b"hello")
+    opts = SubOpts(qos=0)
+    enc = C.DispatchEncoder()
+    s1 = Session("a")
+    s2 = Session("b")
+    p1 = s1.deliver([(msg, opts)], encoder=enc, version=C.MQTT_V5)[0]
+    p2 = s2.deliver([(msg, opts)], encoder=enc, version=C.MQTT_V5)[0]
+    assert p1 is p2  # one shared frame for the whole fan-out
+    legacy = Session("c").deliver([(msg, opts)])[0]
+    assert C.serialize(p1, C.MQTT_V5) == C.serialize(legacy, C.MQTT_V5)
+    # QoS>0: per-subscriber packet ids patched into the shared buffer
+    mq = Message(topic="t", payload=b"hi", qos=1)
+    q1 = Session("d").deliver(
+        [(mq, SubOpts(qos=1))], encoder=enc, version=C.MQTT_V5
+    )[0]
+    lq = Session("e").deliver([(mq, SubOpts(qos=1))])[0]
+    assert q1.packet_id == lq.packet_id == 1
+    assert C.serialize(q1, C.MQTT_V5) == C.serialize(lq, C.MQTT_V5)
+
+
+def test_subid_falls_back_to_per_packet_encode():
+    """A subscription identifier is per-subscriber state: the encoder
+    must NOT be used (no _wire) and the property must survive."""
+    msg = Message(topic="t", payload=b"p")
+    enc = C.DispatchEncoder()
+    pkt = Session("a").deliver(
+        [(msg, SubOpts(qos=0, subid=42))],
+        encoder=enc, version=C.MQTT_V5,
+    )[0]
+    assert getattr(pkt, "_wire", None) is None
+    assert pkt.properties["subscription_identifier"] == [42]
+
+
+def test_end_to_end_wire_bytes_with_real_channel():
+    """Full broker window through a real Channel: the captured wire
+    must decode back to the published messages (v5 AND v3.1.1)."""
+    b = Broker()
+    ch5 = WireChannel(b, version=C.MQTT_V5)
+    _connect(b, "v5", channel=ch5)
+    ch4 = WireChannel(b, version=C.MQTT_V4)
+    _connect(b, "v4", channel=ch4)
+    for cid in ("v5", "v4"):
+        sess = b.cm.lookup(cid)
+        sess.subscribe("w/#", SubOpts(qos=0))
+        b.subscribe(cid, "w/#", SubOpts(qos=0))
+    msgs = [Message(topic=f"w/{i}", payload=bytes([i]) * i)
+            for i in range(5)]
+    counts = b.publish_many(msgs)
+    assert counts == [2] * 5
+    for ch, ver in ((ch5, C.MQTT_V5), (ch4, C.MQTT_V4)):
+        # ONE corked write for the whole window per connection
+        assert len(ch.writes) == 1
+        parser = C.StreamParser(version=ver)
+        decoded = list(parser.feed(ch.writes[0]))
+        assert [p.topic for p in decoded] == [m.topic for m in msgs]
+        assert [p.payload for p in decoded] == [m.payload for m in msgs]
+
+
+# --------------------------------------------------- write coalescing
+
+
+def test_channel_cork_buffers_and_flushes_once():
+    b = Broker()
+    ch = WireChannel(b)
+    ch.cork()
+    ch.send_packets([C.Publish(topic="a", payload=b"1")])
+    ch.send_packets([C.Publish(topic="b", payload=b"2")])
+    assert ch.writes == []  # buffered while corked
+    ch.uncork()
+    assert len(ch.writes) == 1
+    assert [p.topic for p in ch.packets] == ["a", "b"]
+    # nested cork scopes flush once, at the outermost uncork
+    ch.cork()
+    ch.cork()
+    ch.send_packets([C.Publish(topic="c", payload=b"3")])
+    ch.uncork()
+    assert len(ch.writes) == 1
+    ch.uncork()
+    assert len(ch.writes) == 2
+
+
+def test_cork_drops_buffer_on_shutdown():
+    b = Broker()
+    ch = WireChannel(b)
+    ch.cork()
+    ch.send_packets([C.Publish(topic="a", payload=b"1")])
+    ch._shutdown("test")
+    ch.uncork()
+    assert ch.writes == []  # never flush past teardown
+
+
+def test_window_coalesces_to_one_write_per_connection():
+    b = Broker()
+    ch = WireChannel(b)
+    _connect(b, "sub", channel=ch)
+    sess = b.cm.lookup("sub")
+    sess.subscribe("t/#", SubOpts(qos=0))
+    b.subscribe("sub", "t/#", SubOpts(qos=0))
+    b.publish_many([Message(topic=f"t/{i}") for i in range(16)])
+    assert len(ch.writes) == 1  # 16 deliveries, one transport write
+    b.publish_many([Message(topic=f"t/{i}") for i in range(4)])
+    assert len(ch.writes) == 2
+
+
+# ------------------------------------------------ batched bookkeeping
+
+
+def test_window_metrics_match_legacy_semantics():
+    b = Broker()
+    ch, s = _connect(b, "c1")
+    s.subscribe("t", SubOpts(qos=0))
+    b.subscribe("c1", "t", SubOpts(qos=0))
+    counts = b.publish_many([
+        Message(topic="t"),
+        Message(topic="nobody"),
+        Message(topic="t"),
+    ])
+    assert counts == [1, 0, 1]
+    assert b.metrics.val("messages.delivered") == 2
+    assert b.metrics.val("messages.dropped.no_subscribers") == 1
+    assert b.metrics.val("messages.publish") == 3
+
+
+def test_delivered_hook_fires_once_per_window_client():
+    """Bookkeeping amortization: the message.delivered hook gets ONE
+    call per (window, client) carrying every delivery, not one call
+    per delivery."""
+    b = Broker()
+    ch, s = _connect(b, "c1")
+    s.subscribe("t/#", SubOpts(qos=0))
+    b.subscribe("c1", "t/#", SubOpts(qos=0))
+    calls = []
+    b.hooks.add(
+        "message.delivered",
+        lambda cid, deliveries: calls.append((cid, len(deliveries))),
+    )
+    b.publish_many([Message(topic=f"t/{i}") for i in range(5)])
+    assert calls == [("c1", 5)]

@@ -1,8 +1,9 @@
 """brokerlint: repo-aware AST analysis for the broker.
 
 Rule families: async-concurrency (ASYNC1xx), device-purity
-(DEVICE2xx), failpoint-coverage (FP301).  Run as a tier-1 gate by
-tests/test_lint.py and standalone via ``python -m tools.brokerlint``.
+(DEVICE2xx), failpoint-coverage (FP301), dispatch-perf (PERF401).
+Run as a tier-1 gate by tests/test_lint.py and standalone via
+``python -m tools.brokerlint``.
 """
 
 from .engine import (
@@ -10,9 +11,10 @@ from .engine import (
     diff_baseline, load_baseline, run_lint,
 )
 from .failpointrules import SEAM_FUNCS, Seam
+from .perfrules import DISPATCH_FUNCS, DispatchFn
 
 __all__ = [
-    "DEFAULT_BASELINE", "DEFAULT_PATHS", "Finding", "SEAM_FUNCS",
-    "Seam", "analyze_source", "diff_baseline", "load_baseline",
-    "run_lint",
+    "DEFAULT_BASELINE", "DEFAULT_PATHS", "DISPATCH_FUNCS",
+    "DispatchFn", "Finding", "SEAM_FUNCS", "Seam", "analyze_source",
+    "diff_baseline", "load_baseline", "run_lint",
 ]

@@ -12,7 +12,10 @@ families (see the sibling modules):
     host-numpy calls, and tracer-valued python branches inside
     ``@jax.jit`` code, unhashable static args;
   * failpoint-coverage (``FP301``, failpointrules.py)  — declared IO
-    seams must carry a ``failpoints.evaluate`` call.
+    seams must carry a ``failpoints.evaluate`` call;
+  * dispatch-perf     (``PERF401``, perfrules.py)      — no
+    per-subscriber encode calls inside dispatch-marked hot loops
+    (the single-encode fan-out invariant).
 
 Suppression: a ``# brokerlint: ignore[RULE]`` comment on the finding's
 line (or on a comment-only line directly above it) silences that rule
@@ -212,10 +215,11 @@ def _body_calls_failpoint(fn: ast.AST) -> bool:
 # -------------------------------------------------------------- runner
 
 def analyze_source(source: str, path: str = "<string>",
-                   seams: Optional[Sequence] = None) -> List[Finding]:
+                   seams: Optional[Sequence] = None,
+                   dispatch: Optional[Sequence] = None) -> List[Finding]:
     """Run every rule family over one source string (fixture tests use
     this directly; `run_lint` maps it over the tree)."""
-    from . import asyncrules, devicerules, failpointrules
+    from . import asyncrules, devicerules, failpointrules, perfrules
 
     tree = ast.parse(source, filename=path)
     ctx = ModuleContext(path, source, tree)
@@ -223,6 +227,9 @@ def analyze_source(source: str, path: str = "<string>",
     devicerules.check(ctx)
     failpointrules.check(
         ctx, failpointrules.SEAM_FUNCS if seams is None else seams
+    )
+    perfrules.check(
+        ctx, perfrules.DISPATCH_FUNCS if dispatch is None else dispatch
     )
     ctx.findings.sort(key=lambda f: (f.line, f.rule))
     return ctx.findings

@@ -1,0 +1,114 @@
+"""Dispatch-perf rule (PERF401).
+
+PR 3 made fan-out single-encode: each unique PUBLISH body is
+serialized once per dispatch window and only the packet id is patched
+per subscriber (`codec.mqtt.DispatchEncoder`).  This rule enforces
+that invariant the same way FP301 enforces failpoint seams:
+``DISPATCH_FUNCS`` declares the dispatch-marked hot-loop functions,
+and any ``serialize(``/``encode(`` call nested inside a loop in one
+of them fires PERF401 — a per-subscriber re-encode sneaking back into
+the fan-out path fails tier-1 instead of silently re-paying the cost
+the window encoder removed.
+
+An intentional in-loop encode (there should be none on the delivery
+path) takes a justified inline ``# brokerlint: ignore[PERF401]``.
+A declared function that no longer exists is itself a finding, so the
+declaration list cannot silently rot.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, NamedTuple, Sequence
+
+from .engine import ModuleContext, call_tail
+
+
+class DispatchFn(NamedTuple):
+    path_suffix: str   # module path suffix, posix ('broker/broker.py')
+    qualname: str      # dotted function name inside the module
+
+
+# the window fan-out hot loops: expansion/grouping, per-client
+# delivery, and the session's packet builder
+DISPATCH_FUNCS = (
+    DispatchFn("emqx_tpu/broker/broker.py", "Broker._dispatch_window"),
+    DispatchFn("emqx_tpu/broker/broker.py", "Broker._deliver_run"),
+    DispatchFn("emqx_tpu/broker/session.py", "Session.deliver"),
+)
+
+# callee tails that mean "re-encode a wire frame"
+_ENCODE_TAILS = {"serialize", "encode", "encode_publish"}
+
+
+def _function_map(tree: ast.Module):
+    """qualname -> FunctionDef/AsyncFunctionDef for the whole module."""
+    out = {}
+
+    def walk(node, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                walk(child, f"{prefix}{child.name}.")
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                out[f"{prefix}{child.name}"] = child
+                walk(child, f"{prefix}{child.name}.")
+
+    walk(tree, "")
+    return out
+
+
+def _loop_encode_calls(fn: ast.AST) -> List[ast.Call]:
+    """Encode-tailed calls lexically inside a for/while loop of `fn`
+    (nested def/lambda subtrees are pruned: a closure DEFINED in the
+    loop is not a per-subscriber encode)."""
+    hits: List[ast.Call] = []
+
+    def walk(node: ast.AST, in_loop: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)) and child is not fn:
+                continue
+            child_in_loop = in_loop or isinstance(
+                child, (ast.For, ast.AsyncFor, ast.While)
+            )
+            if (
+                in_loop
+                and isinstance(child, ast.Call)
+                and call_tail(child) in _ENCODE_TAILS
+            ):
+                hits.append(child)
+            walk(child, child_in_loop)
+
+    walk(fn, False)
+    return hits
+
+
+def check(ctx: ModuleContext,
+          dispatch: Sequence[DispatchFn] = DISPATCH_FUNCS) -> None:
+    relevant = [d for d in dispatch if ctx.path.endswith(d.path_suffix)]
+    if not relevant:
+        return
+    fns = _function_map(ctx.tree)
+    for d in relevant:
+        fn = fns.get(d.qualname)
+        if fn is None:
+            ctx.report(
+                ctx.tree, "PERF401", d.qualname,
+                f"declared dispatch function `{d.qualname}` not found "
+                f"in {ctx.path} — update "
+                f"tools/brokerlint/perfrules.py:DISPATCH_FUNCS",
+                detail="missing",
+            )
+            continue
+        for call in _loop_encode_calls(fn):
+            ctx.report(
+                call, "PERF401", d.qualname,
+                f"per-subscriber `{call_tail(call)}(` inside the "
+                f"dispatch hot loop `{d.qualname}` — encode once per "
+                f"window via codec.mqtt.DispatchEncoder instead",
+                detail=call_tail(call),
+            )
+
+
+__all__ = ["check", "DispatchFn", "DISPATCH_FUNCS"]
