@@ -57,6 +57,16 @@ class Broker:
         self.hooks = hooks or HookRegistry()
         self.metrics = Metrics()
         self.stats = Stats()
+        # hot-path window profiler: stage histograms + flight recorder
+        # (observability.py); always on by default, near-free per window
+        from ..observability import Profiler
+
+        prof_cfg = self.config.profiler
+        self.profiler = Profiler(
+            ring_size=prof_cfg.ring_size,
+            events_cap=prof_cfg.events_cap,
+            enabled=prof_cfg.enable,
+        )
         eng_cfg = self.config.engine
         self.router = Router(
             engine=MatchEngine(
@@ -69,6 +79,9 @@ class Broker:
             ),
             shared=SharedSubManager(strategy=shared_strategy),
         )
+        # engine lifecycle events (XLA compiles, device_put transfers,
+        # delta folds) land in the same profiler as the window stages
+        self.router.engine.profiler = self.profiler
         ret_cfg = self.config.retainer
         self.retainer = Retainer(
             max_retained_messages=ret_cfg.max_retained_messages,
@@ -155,7 +168,16 @@ class Broker:
             ban_time=fl.ban_time,
             enable=fl.enable,
         )
-        self.slow_subs = SlowSubs()
+        ss = self.config.slow_subs
+        self.slow_subs = SlowSubs(
+            top_k=ss.top_k,
+            # disabled = an unreachable threshold: the hot path's
+            # hoisted floor check then never calls record()
+            threshold_ms=(
+                ss.threshold_ms if ss.enable else float("inf")
+            ),
+            expire_interval=ss.expire_interval,
+        )
         # node/zone-aggregate ingress limiter (top of the hierarchy)
         self.zone_limiter = None
         zm = self.config.mqtt.zone_messages_rate
@@ -595,9 +617,12 @@ class Broker:
         device-bound middle stage in an executor (keeping the event loop
         reading sockets during the kernel round-trip) while the
         state-mutating stages stay on the loop thread."""
+        rec = self.profiler.begin(len(msgs))
         live, results = self.publish_prepare(msgs)
-        matched, remote = self.publish_match(live)
-        return self.publish_dispatch(live, matched, remote, results)
+        if rec is not None:
+            rec.lap("prepare")
+        matched, remote = self.publish_match(live, rec=rec)
+        return self.publish_dispatch(live, matched, remote, results, rec)
 
     def publish_prepare(
         self, msgs: Sequence[Message]
@@ -696,24 +721,28 @@ class Broker:
         return live, results
 
     def publish_match(
-        self, live: Sequence[Message], congested: bool = False
+        self, live: Sequence[Message], congested: bool = False, rec=None
     ) -> Tuple[List[Set[str]], Optional[List[Set[str]]]]:
         """Stage 2 (any thread): one batched match step for local
         filters + remote route nodes.  Only reads engine state the
         MatchEngine locks internally."""
         return self.publish_match_finish(
-            self.publish_match_submit(live, congested)
+            self.publish_match_submit(live, congested, rec)
         )
 
     def publish_match_submit(
-        self, live: Sequence[Message], congested: bool = False
+        self, live: Sequence[Message], congested: bool = False, rec=None
     ):
         """Stage 2a: dispatch the window's match WITHOUT waiting on the
         device (JAX async dispatch), so the batcher can submit the next
         windows while this one's transfer streams back — the pipelining
-        that amortizes the host<->device round-trip from one thread."""
+        that amortizes the host<->device round-trip from one thread.
+
+        ``rec`` (the window's flight-recorder entry) rides the handle
+        to the finish side: the two match stages may run on different
+        executor threads, but strictly one after the other."""
         if not live:
-            return (None, [], None)
+            return (None, [], rec)
         topics = [m.topic for m in live]
         try:
             pending = self.router.engine.match_batch_submit(
@@ -725,7 +754,9 @@ class Broker:
                 len(topics),
             )
             pending = None
-        return (pending, topics, None)
+        if rec is not None:
+            rec.lap("match_submit")
+        return (pending, topics, rec)
 
     def publish_match_finish(
         self, handle
@@ -734,20 +765,32 @@ class Broker:
         and run the remote route match.  Any failure degrades to the
         host oracle instead of failing (and disconnecting) the whole
         window."""
-        pending, topics, _ = handle
+        pending, topics, rec = handle
         if not topics:
             return [], None
+        path = "host-fallback"
         try:
             if pending is None:
                 matched = self.router.engine.match_batch_host(topics)
             else:
-                matched = self.router.engine.match_batch_finish(pending)
+                # the engine reports the path that ACTUALLY served the
+                # window (an internal device fault degrades to host
+                # without raising — the flight record must say so)
+                info: Dict[str, str] = {}
+                matched = self.router.engine.match_batch_finish(
+                    pending, info=info
+                )
+                path = info.get("path", pending[0])
         except Exception:
             log.exception(
                 "device match failed for window of %d; host fallback",
                 len(topics),
             )
             matched = self.router.engine.match_batch_host(topics)
+        if rec is not None:
+            rec.lap("match_wait")
+            rec.path = path
+            rec.breaker_open = self.router.engine.breaker_open
         remote: Optional[List[Set[str]]] = None
         if self.external is not None:
             try:
@@ -762,16 +805,22 @@ class Broker:
         matched: Sequence[Set[str]],
         remote: Optional[Sequence[Set[str]]],
         results: List[Optional[int]],
+        rec=None,
     ) -> List[int]:
         """Stage 3 (loop thread): fan the WHOLE window out to sessions
         in one vectorized pass, forward to peers, then run all rule
-        hits over the batch in one predicate step."""
+        hits over the batch in one predicate step.  Commits ``rec`` —
+        the window's profiler record — whatever happens above."""
+        if rec is not None:
+            # time queued behind predecessor windows in the ordered
+            # dispatch loop: its own span, not smeared into expand
+            rec.lap("dispatch_wait")
         rule_sink: List[Tuple[Message, List[str]]] = []
         counts: List[int] = []
         if live:
             try:
                 counts = self._dispatch_window(
-                    live, matched, rule_sink=rule_sink
+                    live, matched, rule_sink=rule_sink, rec=rec
                 )
             except Exception:
                 log.exception(
@@ -799,6 +848,10 @@ class Broker:
                 self.rules.apply_batch(rule_sink)
             except Exception:
                 log.exception("rule batch failed for window")
+            if rec is not None:
+                rec.lap("rules")
+        if rec is not None:
+            self.profiler.commit(rec)
         return [r if r is not None else 0 for r in results]
 
     def dispatch_forwarded(self, msg: Message) -> int:
@@ -823,16 +876,23 @@ class Broker:
                 self.durable.persist(list(msgs))
             except Exception:
                 log.exception("durable persist failed for forwarded batch")
+        rec = self.profiler.begin(len(msgs), source="forwarded")
         matched = self.router.match_batch([m.topic for m in msgs])
+        if rec is not None:
+            rec.lap("match_submit")
+            rec.path = "host"
         try:
             return sum(self._dispatch_window(
-                list(msgs), matched, run_rules=False
+                list(msgs), matched, run_rules=False, rec=rec
             ))
         except Exception:
             log.exception(
                 "forwarded dispatch failed for window of %d", len(msgs)
             )
             return 0
+        finally:
+            if rec is not None:
+                self.profiler.commit(rec)
 
     # ----------------------------------------------------- dispatch
 
@@ -854,6 +914,7 @@ class Broker:
         matched: Sequence[Set[str]],
         run_rules: bool = True,
         rule_sink: Optional[List] = None,
+        rec=None,
     ) -> List[int]:
         """Fan a whole routed window out to subscriber sessions
         (emqx_broker:dispatch + do_dispatch, :408-420, :639-673),
@@ -881,6 +942,8 @@ class Broker:
         msg_idx, rows, opts_rows, rules, shared = router.expand_window(
             matched
         )
+        if rec is not None:
+            rec.lap("expand")
         if rules and run_rules:
             by_msg: Dict[int, set] = {}
             for i, rid in rules:
@@ -902,6 +965,7 @@ class Broker:
         mloc: Counter = Counter()  # batched counter deltas (one lock)
         touched = bytearray(n)
         corked: List = []
+        n_clients = 0
         if n_direct or s_rows:
             if s_rows:
                 all_rows = np.concatenate(
@@ -960,6 +1024,7 @@ class Broker:
                         touched[i] = 1
                     if not deliveries:
                         continue
+                n_clients += 1
                 try:
                     flags = self._deliver_run(
                         clientid, deliveries, enc, mloc, corked
@@ -977,6 +1042,8 @@ class Broker:
                     for i, f in zip(d_idx, flags):
                         if f:
                             counts[i] += 1
+        if rec is not None:
+            rec.lap("deliver")
         # flush: ONE concatenated transport.write per connection for
         # the whole window (each channel was corked on first touch)
         for ch in corked:
@@ -987,6 +1054,18 @@ class Broker:
         delivered = sum(counts)
         if delivered:
             mloc["messages.delivered"] += delivered
+        if rec is not None:
+            rec.lap("flush")
+            rec.n_deliveries = delivered
+            rec.n_clients = n_clients
+            if delivered:
+                # end-to-end publish→delivery latency per delivered
+                # message (Message.timestamp is stamped at ingress)
+                now_e2e = time.time()
+                e2e = rec.e2e_ms
+                for i, msg in enumerate(msgs):
+                    if counts[i] and msg.timestamp:
+                        e2e.append((now_e2e - msg.timestamp) * 1e3)
         tracer = self.tracer
         for i, msg in enumerate(msgs):
             if not touched[i]:
@@ -1181,6 +1260,7 @@ class Broker:
         self.delayed.tick(now)
         self.topic_metrics.tick(now)
         self.alarms.tick(now)
+        self.slow_subs.tick(now)
         self.ft.tick(now)
         self.cm.expire_sessions(now)
         if self.durable is not None:
@@ -1462,6 +1542,9 @@ class PublishBatcher:
                 limit = min(
                     self.batch_max, max(self.inflight_max // 4, 256)
                 )
+                # flight-recorder entry opens at collection start so
+                # the accumulation wait shows up as its own stage
+                rec = self.broker.profiler.begin(0, source="batcher")
                 batch = [self._rr_pop()]
                 # adaptive window: with nothing else queued and the
                 # pipeline idle, flush IMMEDIATELY — a lone publish on
@@ -1486,6 +1569,9 @@ class PublishBatcher:
                         except asyncio.TimeoutError:
                             break
                 msgs = [m for m, _fut, _src in batch]
+                if rec is not None:
+                    rec.n_msgs = len(batch)
+                    rec.lap("batch_wait")
                 self._inflight_count += len(batch)
                 # throughput-mode hint for the engine's auto policy:
                 # another window's worth already queued means windows
@@ -1498,6 +1584,8 @@ class PublishBatcher:
                     live, results = (
                         await self.broker.publish_prepare_async(msgs)
                     )
+                    if rec is not None:
+                        rec.lap("prepare")
                     # submit ONLY (encode + async kernel dispatch, no
                     # wait): the device crunches this window while the
                     # collector fills and submits the next ones — the
@@ -1508,6 +1596,7 @@ class PublishBatcher:
                         self.broker.publish_match_submit,
                         live,
                         congested,
+                        rec,
                     )
                 except Exception as exc:
                     self._inflight_count -= len(batch)
@@ -1525,7 +1614,7 @@ class PublishBatcher:
                     continue
                 # blocks when pipeline_windows are already in flight —
                 # natural backpressure onto the collector
-                await inflight.put((batch, live, results, match_fut))
+                await inflight.put((batch, live, results, match_fut, rec))
         finally:
             await cancel_and_wait(self._dispatch_task)
             self._dispatch_task = None
@@ -1534,7 +1623,7 @@ class PublishBatcher:
             # past shutdown
             exc = ConnectionError("broker stopping")
             while not inflight.empty():
-                batch, _live, _res, match_fut = inflight.get_nowait()
+                batch, _live, _res, match_fut, _rec = inflight.get_nowait()
                 match_fut.cancel()
                 for _, fut, _src in batch:
                     if fut is not None and not fut.done():
@@ -1553,7 +1642,7 @@ class PublishBatcher:
 
     async def _dispatch_loop(self, inflight: asyncio.Queue) -> None:
         while True:
-            batch, live, results, match_fut = await inflight.get()
+            batch, live, results, match_fut, rec = await inflight.get()
             counts = None
             try:
                 try:
@@ -1569,7 +1658,7 @@ class PublishBatcher:
                     self._inflight_count -= len(batch)
                     self._inflight_drain.set()
                 counts = self.broker.publish_dispatch(
-                    live, matched, remote, results
+                    live, matched, remote, results, rec
                 )
                 ext = self.broker.external
                 if ext is not None and getattr(

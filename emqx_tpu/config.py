@@ -128,6 +128,31 @@ class FlappingConfig:
 
 
 @dataclass
+class SlowSubsConfig:
+    """Slow-subscriber top-K table (emqx_slow_subs): deliveries slower
+    than ``threshold_ms`` enter a top-K board; entries expire after
+    ``expire_interval`` seconds (the reference's expire_interval) so a
+    one-off stall from last week stops shadowing today's slowest."""
+
+    enable: bool = True
+    threshold_ms: float = 500.0
+    top_k: int = 10
+    expire_interval: float = 300.0
+
+
+@dataclass
+class ProfilerConfig:
+    """Hot-path window profiler (observability.py): stage-latency
+    histograms + a flight-recorder ring of the last ``ring_size``
+    dispatch windows, always on by default (near-free: ~2
+    perf_counter reads per stage, one lock per window)."""
+
+    enable: bool = True
+    ring_size: int = 256
+    events_cap: int = 256
+
+
+@dataclass
 class ApiConfig:
     """Management REST + Prometheus endpoint (emqx_management slice).
 
@@ -214,6 +239,8 @@ class BrokerConfig:
     sys: SysConfig = field(default_factory=SysConfig)
     api: ApiConfig = field(default_factory=ApiConfig)
     flapping: FlappingConfig = field(default_factory=FlappingConfig)
+    slow_subs: SlowSubsConfig = field(default_factory=SlowSubsConfig)
+    profiler: ProfilerConfig = field(default_factory=ProfilerConfig)
     # server-side auto-subscribe on connect (emqx_auto_subscribe):
     # entries {"topic": ..., "qos": 0}; %c/%u placeholders supported
     auto_subscribe: List[Dict[str, Any]] = field(default_factory=list)

@@ -18,6 +18,8 @@ any reachable broker:
     python -m emqx_tpu.ctl rebalance purge start|stop
     python -m emqx_tpu.ctl failpoints [list|set <name> <action> [k=v ...]
                                        |clear [name]]
+    python -m emqx_tpu.ctl profiler [summary|windows|reset
+                                     |trace [out.json]]
 """
 
 from __future__ import annotations
@@ -298,6 +300,63 @@ class Ctl:
         else:
             raise SystemExit(f"unknown failpoints action {action!r}")
 
+    def profiler(self, action: str = "summary", *args: str) -> None:
+        """Window-pipeline profiler: stage latencies, the flight
+        recorder's recent windows, Perfetto trace export.
+
+            profiler summary
+            profiler windows [n]
+            profiler trace [out.json] [n]
+            profiler reset
+        """
+        if action == "summary":
+            info = self._req("/api/v5/profiler")
+            print(f"profiler {'on' if info['enabled'] else 'OFF'}")
+            print("stage\tcount\tp50_us\tp95_us\tp99_us")
+            for name, d in sorted(info["histograms_us"].items()):
+                if not d["count"]:
+                    continue
+                print(f"{name}\t{d['count']}\t{d['p50']:.0f}"
+                      f"\t{d['p95']:.0f}\t{d['p99']:.0f}")
+            eng = info.get("engine", {})
+            line = " ".join(
+                f"{k}={eng[k]}"
+                for k in ("base", "delta", "residual", "deep",
+                          "auto_host_windows", "auto_dev_windows",
+                          "breaker_open")
+                if k in eng
+            )
+            print(f"engine: {line}")
+        elif action == "windows":
+            n = int(args[0]) if args else 16
+            info = self._req(f"/api/v5/profiler?windows={n}")
+            for w in info["windows"]:
+                stages = " ".join(
+                    f"{k}={v:.0f}us"
+                    for k, v in w["stages_us"].items()
+                )
+                print(
+                    f"#{w['seq']}\t{w['source']}\tmsgs={w['n_msgs']}"
+                    f"\tdeliv={w['n_deliveries']}\tpath={w['path']}"
+                    f"\t{stages}"
+                )
+        elif action == "trace":
+            out_path = args[0] if args else "profiler_trace.json"
+            q = f"?windows={args[1]}" if len(args) > 1 else ""
+            trace = self._req(f"/api/v5/profiler/trace{q}")
+            with open(out_path, "w") as f:
+                json.dump(trace, f)
+            print(
+                f"wrote {len(trace['traceEvents'])} trace events to "
+                f"{out_path}; open it at https://ui.perfetto.dev or "
+                "chrome://tracing"
+            )
+        elif action == "reset":
+            self._req("/api/v5/profiler", method="DELETE")
+            print("profiler histograms + flight recorder reset")
+        else:
+            raise SystemExit(f"unknown profiler action {action!r}")
+
     def banned(self, action: str = "list", *args: str) -> None:
         if action == "list":
             for b in self._req("/api/v5/banned")["data"]:
@@ -339,7 +398,7 @@ def main(argv=None) -> None:
     )
     ap.add_argument("command", help="status|clients|subscriptions|topics|"
                     "rules|metrics|stats|publish|trace|banned|data|"
-                    "rebalance|failpoints")
+                    "rebalance|failpoints|profiler")
     ap.add_argument("args", nargs="*")
     ap.add_argument("--qos", type=int, default=0)
     ns = ap.parse_args(argv)
@@ -369,6 +428,8 @@ def main(argv=None) -> None:
         ctl.banned(ns.args[0] if ns.args else "list", *ns.args[1:])
     elif cmd == "failpoints":
         ctl.failpoints(ns.args[0] if ns.args else "list", *ns.args[1:])
+    elif cmd == "profiler":
+        ctl.profiler(ns.args[0] if ns.args else "summary", *ns.args[1:])
     elif cmd == "data":
         ctl.data(ns.args[0] if ns.args else "export", *ns.args[1:])
     elif cmd == "rebalance":

@@ -478,6 +478,11 @@ class MatchEngine:
         self._brk_probing = False
         self._brk_stats = {"trips": 0, "device_errors": 0,
                            "slow_windows": 0, "probes": 0}
+        # observability.Profiler installed by the broker: lifecycle
+        # events (XLA shape compiles, device_put transfer bytes, delta
+        # folds, rebuilds) + the tokenize stage histogram.  None =
+        # zero-cost no-op (standalone engines, benches)
+        self.profiler = None
 
     # ------------------------------------------------------------- mutation
 
@@ -723,8 +728,12 @@ class MatchEngine:
         import jax
         import jax.numpy as jnp
 
+        t0 = time.perf_counter()
+        total_bytes = 0
         out = []
         for a in aut.device_arrays():
+            if isinstance(a, np.ndarray):
+                total_bytes += a.nbytes
             if (
                 not isinstance(a, np.ndarray)
                 or a.nbytes <= 2 * chunk_bytes
@@ -738,6 +747,12 @@ class MatchEngine:
                 if throttle:
                     time.sleep(0.002)
             out.append(jnp.concatenate(parts, axis=0))
+        prof = self.profiler
+        if prof is not None:
+            prof.event(
+                "device_put", time.perf_counter() - t0,
+                bytes=total_bytes, throttled=throttle,
+            )
         return tuple(out)
 
     def _fold_delta_aut(self) -> None:
@@ -791,6 +806,7 @@ class MatchEngine:
 
         def work():
             aut = None
+            t_fold = time.perf_counter()
             try:
                 with self._enc_lock:
                     if cache is None:
@@ -892,6 +908,12 @@ class MatchEngine:
                     for fid, seq in self._residual_log
                     if self._delta_seq.get(fid) == seq
                 )
+            prof = self.profiler
+            if prof is not None:
+                prof.event(
+                    "delta_fold", time.perf_counter() - t_fold,
+                    n_new=len(new_items),
+                )
 
         if self._fold_async:
             self._fold_thread = threading.Thread(
@@ -919,6 +941,7 @@ class MatchEngine:
         if sig in self._warmed_shapes:
             return
         self._warmed_shapes.add(sig)
+        t0 = time.perf_counter()
         tokens = np.full((16, aut.kernel_levels), -4, np.int32)
         lengths = np.zeros(16, np.int32)
         dollar = np.zeros(16, bool)
@@ -935,6 +958,12 @@ class MatchEngine:
             f_width=self.f_width, m_cap=self.m_cap,
         )
         out[0].block_until_ready()
+        prof = self.profiler
+        if prof is not None:
+            prof.event(
+                "xla_compile", time.perf_counter() - t0,
+                nodes=sig[0], buckets=sig[1], levels=sig[2],
+            )
 
     def _drop_delta_aut(self) -> None:
         self._daut = None
@@ -993,6 +1022,7 @@ class MatchEngine:
 
         def work():
             try:
+                t_build = time.perf_counter()
                 built = self._build(inputs, device_put=True)
                 # compile the kernel for the new table shapes HERE, in
                 # the builder thread, so the first post-swap match never
@@ -1005,6 +1035,12 @@ class MatchEngine:
 
                     logging.getLogger("emqx_tpu.engine").debug(
                         "base shape warm failed", exc_info=True
+                    )
+                prof = self.profiler
+                if prof is not None:
+                    prof.event(
+                        "rebuild", time.perf_counter() - t_build,
+                        n_filters=n_filters,
                     )
             except Exception:  # build failure must not wedge the engine
                 import logging
@@ -1263,6 +1299,10 @@ class MatchEngine:
             target=work, name="engine-brk-probe", daemon=True
         ).start()
 
+    @property
+    def breaker_open(self) -> bool:
+        return self._brk_open
+
     def breaker_info(self) -> Dict[str, object]:
         return {
             "open": self._brk_open,
@@ -1272,6 +1312,16 @@ class MatchEngine:
             "deadline": self.breaker_deadline,
             **self._brk_stats,
         }
+
+    def stats(self) -> Dict[str, object]:
+        """The engine's full gauge surface for exposition (Prometheus
+        scrape, OTLP metrics, $SYS): index tier sizes, auto-policy
+        window counts, the cost EWMAs and breaker state."""
+        out = self.index_stats()
+        out["auto_probes"] = self._auto_stats["probes"]
+        out["breaker_slow_windows"] = self._brk_stats["slow_windows"]
+        out["breaker_probes"] = self._brk_stats["probes"]
+        return out
 
     # -------------------------------------------------------------- match
 
@@ -1430,7 +1480,13 @@ class MatchEngine:
         thread — executor-thread concurrency does NOT overlap the
         transfer wait (the blocking conversion serializes), async
         dispatch does (the standalone bench's depth-8 scheme)."""
-        words = [T.words(t) for t in topics]
+        prof = self.profiler
+        if prof is not None and prof.enabled:
+            _t_tok = time.perf_counter()
+            words = [T.words(t) for t in topics]
+            prof.stage("tokenize", time.perf_counter() - _t_tok)
+        else:
+            words = [T.words(t) for t in topics]
         with self._mlock:
             if self._built is not None:
                 self._poll_swap()
@@ -1453,6 +1509,7 @@ class MatchEngine:
                 )
             else:
                 device_on = device_capable
+            snap_failed = False
             if device_on:
                 try:
                     snap = self._snapshot_refs()
@@ -1466,6 +1523,7 @@ class MatchEngine:
                         "host matching"
                     )
                     device_on = False
+                    snap_failed = True
                     self._device_failure()
                 else:
                     tp("match_snapshot",
@@ -1490,7 +1548,7 @@ class MatchEngine:
                 # keep a fresh sample for the out-of-band device probe
                 # (small: each probe's host-side cost is paid in GIL)
                 self._probe_topics = list(topics[:256])
-            return ("host", out)
+            return ("host-fallback" if snap_failed else "host", out)
         t0 = time.perf_counter()
         c0 = time.thread_time()
         try:
@@ -1508,7 +1566,9 @@ class MatchEngine:
             # a dispatch-side device fault (encode upload, compile,
             # injected engine.device_step error): count it toward the
             # breaker and serve THIS window on the host oracle —
-            # per-topic locking, as in the host branch above
+            # per-topic locking, as in the host branch above.  The
+            # distinct tag keeps the profiler's path attribution
+            # honest: this window is a FALLBACK, not a policy choice
             import logging
 
             logging.getLogger("emqx_tpu.engine").exception(
@@ -1520,7 +1580,7 @@ class MatchEngine:
             for ws in words:
                 with self._mlock:
                     out.append(self.match_host(ws))
-            return ("host", out)
+            return ("host-fallback", out)
         if len(words) >= 64:
             # keep a fresh sample for the breaker probe: after a trip
             # the device path stops running, and probing with recent
@@ -1539,15 +1599,25 @@ class MatchEngine:
         kind, v = token
         return self._flat_finish(v) if kind == "pend" else v
 
-    def match_batch_finish(self, pending) -> List[Set[Hashable]]:
+    def match_batch_finish(self, pending, info=None) -> List[Set[Hashable]]:
         """Phase 2: wait for the device results (if any), overlay the
         host tiers, update the auto-policy cost EWMAs.  CPU is
         accounted with thread_time so a transfer wait that BURNS
         cycles (tunnel client polling) is charged to the device path
         honestly, while a true DMA wait (co-located hardware, GIL
-        released) is not."""
-        if pending[0] == "host":
+        released) is not.
+
+        ``info`` (optional dict) receives ``path``: the path that
+        ACTUALLY served the window — ``dev``, ``host``, or
+        ``host-fallback`` when a device fault degraded it here — so
+        the profiler's flight record never labels a fallback window
+        as a device window."""
+        if pending[0] != "dev":
+            if info is not None:
+                info["path"] = pending[0]
             return pending[1]
+        if info is not None:
+            info["path"] = "dev"
         _, snap, pend_base, dpend, topics, words, t0, cpu0 = pending
         t1w = time.perf_counter()
         c1 = time.thread_time()
@@ -1566,6 +1636,8 @@ class MatchEngine:
                 len(words),
             )
             self._device_failure()
+            if info is not None:
+                info["path"] = "host-fallback"
             return self.match_batch_host(list(topics))
         self._device_ok(time.perf_counter() - t0)
         tp("match_overlay")

@@ -200,6 +200,9 @@ class MgmtApi:
         r.add_post("/api/v5/banned", self.post_banned)
         r.add_delete("/api/v5/banned/{kind}/{who}", self.delete_banned)
         r.add_get("/api/v5/slow_subscriptions", self.get_slow_subs)
+        r.add_get("/api/v5/profiler", self.get_profiler)
+        r.add_get("/api/v5/profiler/trace", self.get_profiler_trace)
+        r.add_delete("/api/v5/profiler", self.reset_profiler)
         r.add_get("/api/v5/trace", self.get_traces)
         r.add_post("/api/v5/trace", self.post_trace)
         r.add_delete("/api/v5/trace/{name}", self.delete_trace)
@@ -642,6 +645,47 @@ class MgmtApi:
     async def get_slow_subs(self, request: web.Request) -> web.Response:
         return _json({"data": self.broker.slow_subs.top()})
 
+    # -------------------------------------------------------- profiler
+
+    async def get_profiler(self, request: web.Request) -> web.Response:
+        """Window-pipeline profiler dump: stage-latency histogram
+        summaries, the engine's gauge surface, and the flight
+        recorder's most recent windows + engine lifecycle events
+        (``?windows=N`` bounds the dump)."""
+        prof = self.broker.profiler
+        try:
+            limit = int(request.query.get("windows", 32))
+        except ValueError:
+            return _json({"code": "BAD_REQUEST",
+                          "message": "windows must be an integer"}, 400)
+        return _json({
+            "enabled": prof.enabled,
+            "histograms_us": prof.summary(),
+            "engine": self.broker.router.engine.stats(),
+            "slow_subs": self.broker.slow_subs.top(),
+            "windows": prof.windows(limit),
+            "events": prof.events(limit),
+        })
+
+    async def get_profiler_trace(self, request: web.Request) -> web.Response:
+        """The flight recorder as Chrome trace-event JSON — loads
+        directly in Perfetto (ui.perfetto.dev) or chrome://tracing, so
+        a stall is diagnosable post-hoc without a reproducer."""
+        prof = self.broker.profiler
+        limit = None
+        if "windows" in request.query:
+            try:
+                limit = int(request.query["windows"])
+            except ValueError:
+                return _json({"code": "BAD_REQUEST",
+                              "message": "windows must be an integer"},
+                             400)
+        return _json(prof.chrome_trace(limit))
+
+    async def reset_profiler(self, request: web.Request) -> web.Response:
+        self.broker.profiler.reset()
+        return web.Response(status=204)
+
     # ----------------------------------------------------- trace/audit
 
     async def get_traces(self, request: web.Request) -> web.Response:
@@ -969,13 +1013,25 @@ class MgmtApi:
     # ------------------------------------------------------ prometheus
 
     async def prometheus(self, request: web.Request) -> web.Response:
-        """Prometheus text exposition of counters + gauges
-        (emqx_prometheus.erl's collect families, minimally)."""
-        lines = []
+        """Prometheus text exposition (emqx_prometheus.erl's collect
+        families): counters + gauges with sanitized names and one
+        HELP/TYPE per family, engine index/breaker/EWMA gauges, and
+        the window profiler's stage-latency histograms as proper
+        ``_bucket``/``_sum``/``_count`` families."""
+        from .observability import prom_histogram_lines, prom_name
 
-        def emit(name: str, kind: str, value) -> None:
-            metric = "emqx_" + name.replace(".", "_").replace("-", "_")
-            lines.append(f"# TYPE {metric} {kind}")
+        lines: list = []
+        seen: set = set()
+
+        def emit(name: str, kind: str, value, help_text: str = "") -> None:
+            metric = prom_name("emqx_" + name.replace(".", "_"))
+            if metric not in seen:
+                # one HELP/TYPE per FAMILY — a repeated TYPE line (or a
+                # name colliding after sanitization) breaks strict
+                # text-format parsers
+                seen.add(metric)
+                lines.append(f"# HELP {metric} {help_text or name}")
+                lines.append(f"# TYPE {metric} {kind}")
             lines.append(f"{metric} {value}")
 
         for name, value in sorted(self.broker.metrics.all().items()):
@@ -990,6 +1046,31 @@ class MgmtApi:
             "gauge",
             int(time.time() - self.broker.metrics.start_time),
         )
+        # engine observability gauges (index tier sizes, auto-policy
+        # window counts, cost EWMAs, breaker state) — previously only
+        # reachable from bench harness code
+        for name, value in sorted(
+            self.broker.router.engine.stats().items()
+        ):
+            if value is None:
+                continue
+            if isinstance(value, bool):
+                value = int(value)
+            if not isinstance(value, (int, float)):
+                continue
+            emit("engine_" + name, "gauge", value,
+                 help_text=f"match engine {name}")
+        prof = self.broker.profiler
+        for name, snap in sorted(prof.snapshots().items()):
+            family = prom_name(f"emqx_profiler_{name}_us")
+            if family in seen:
+                continue
+            seen.add(family)
+            lines.extend(prom_histogram_lines(
+                family, snap,
+                help_text=f"window pipeline stage '{name}' latency "
+                          "in microseconds",
+            ))
         return web.Response(
             text="\n".join(lines) + "\n",
             content_type="text/plain",

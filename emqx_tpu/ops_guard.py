@@ -14,8 +14,9 @@ from __future__ import annotations
 
 import heapq
 import time
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Deque, Dict, List, Optional, Tuple
 
 
 @dataclass
@@ -182,7 +183,10 @@ class FlappingDetector:
         self.window = window
         self.ban_time = ban_time
         self.enable = enable
-        self._hits: Dict[str, List[float]] = {}
+        # deque per client: trimming the window is popleft (O(1) per
+        # expired hit) — list.pop(0) shifted the whole window on every
+        # reconnect of a burst (O(window) per hit)
+        self._hits: Dict[str, Deque[float]] = {}
 
     def on_disconnect(self, clientid: str) -> bool:
         """Record a connection cycle; returns True when it tripped the
@@ -198,11 +202,11 @@ class FlappingDetector:
                 for cid, ts in self._hits.items()
                 if ts and ts[-1] >= cutoff_all
             }
-        hits = self._hits.setdefault(clientid, [])
+        hits = self._hits.setdefault(clientid, deque())
         hits.append(now)
         cutoff = now - self.window
         while hits and hits[0] < cutoff:
-            hits.pop(0)
+            hits.popleft()
         if len(hits) >= self.max_count:
             self.banned.ban(
                 "clientid",
@@ -217,11 +221,20 @@ class FlappingDetector:
 
 class SlowSubs:
     """Top-K delivery-latency table (emqx_slow_subs): every delivery
-    reports (clientid, topic, latency); the slowest K stick."""
+    reports (clientid, topic, latency); the slowest K stick — but only
+    for ``expire_interval`` seconds (emqx_slow_subs' expire_interval):
+    without expiry a one-off stall from hours ago shadows the board
+    forever, until an operator ``clear()``."""
 
-    def __init__(self, top_k: int = 10, threshold_ms: float = 500.0) -> None:
+    def __init__(
+        self,
+        top_k: int = 10,
+        threshold_ms: float = 500.0,
+        expire_interval: float = 300.0,
+    ) -> None:
         self.top_k = top_k
         self.threshold_ms = threshold_ms
+        self.expire_interval = expire_interval
         # min-heap of (latency_ms, seq, clientid, topic, ts)
         self._heap: List[Tuple] = []
         self._seq = 0
@@ -235,6 +248,20 @@ class SlowSubs:
             heapq.heappush(self._heap, item)
         elif item > self._heap[0]:
             heapq.heapreplace(self._heap, item)
+
+    def tick(self, now: Optional[float] = None) -> int:
+        """Drop entries older than ``expire_interval``; returns the
+        number expired.  Driven by the broker's 1 Hz housekeeping."""
+        if not self._heap or self.expire_interval <= 0:
+            return 0
+        now = now if now is not None else time.time()
+        cutoff = now - self.expire_interval
+        live = [it for it in self._heap if it[4] >= cutoff]
+        expired = len(self._heap) - len(live)
+        if expired:
+            heapq.heapify(live)
+            self._heap = live
+        return expired
 
     def top(self) -> List[Dict]:
         return [

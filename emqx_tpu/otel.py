@@ -282,6 +282,7 @@ class OtelExporter:
 
     def metrics_payload(self, now: float) -> bytes:
         t_ns = str(int(now * 1e9))
+        start_ns = str(int(self.broker.metrics.start_time * 1e9))
         metrics = []
         for name, val in sorted(self.broker.metrics.all().items()):
             metrics.append({
@@ -301,6 +302,51 @@ class OtelExporter:
                                     "asInt": str(int(val))}],
                 },
             })
+        # engine gauge surface (index tiers, auto-policy, breaker,
+        # cost EWMAs) — MatchEngine.stats(), floats as asDouble
+        for name, val in sorted(self.broker.router.engine.stats().items()):
+            if val is None:
+                continue
+            if isinstance(val, bool):
+                val = int(val)
+            if not isinstance(val, (int, float)):
+                continue
+            dp: Dict[str, Any] = {"timeUnixNano": t_ns}
+            if isinstance(val, float):
+                dp["asDouble"] = val
+            else:
+                dp["asInt"] = str(val)
+            metrics.append({
+                "name": "emqx_engine_" + name.replace(".", "_"),
+                "gauge": {"dataPoints": [dp]},
+            })
+        # window profiler stage histograms as OTLP histogram
+        # datapoints (per-bucket counts + explicit log2 bounds)
+        prof = getattr(self.broker, "profiler", None)
+        if prof is not None and prof.enabled:
+            from .observability import BOUNDS
+
+            bounds = list(BOUNDS)
+            for name, snap in sorted(prof.snapshots().items()):
+                if not snap.count:
+                    continue
+                metrics.append({
+                    "name": f"emqx_profiler_{name}_us",
+                    "unit": "us",
+                    "histogram": {
+                        "dataPoints": [{
+                            "startTimeUnixNano": start_ns,
+                            "timeUnixNano": t_ns,
+                            "count": str(snap.count),
+                            "sum": snap.sum,
+                            "bucketCounts": [
+                                str(c) for c in snap.counts
+                            ],
+                            "explicitBounds": bounds,
+                        }],
+                        "aggregationTemporality": 2,  # CUMULATIVE
+                    },
+                })
         return json.dumps({
             "resourceMetrics": [{
                 "resource": self._resource,

@@ -47,7 +47,7 @@ class SysTopics:
         stats["connections.count"] = len(b.cm)
         stats["topics.count"] = len(b.router.topics())
         stats["retained.count"] = len(b.retainer)
-        return [
+        out = [
             self._msg("version", VERSION),
             self._msg("uptime", str(uptime)),
             self._msg("datetime", time.strftime("%Y-%m-%dT%H:%M:%S%z")),
@@ -59,6 +59,24 @@ class SysTopics:
                 "subscriptions/count", str(b.router.subscription_count())
             ),
         ]
+        prof = getattr(b, "profiler", None)
+        if prof is not None and prof.enabled:
+            # periodic window-pipeline summary: per-stage p50/p99 +
+            # the engine gauge surface, so a plain MQTT monitor on
+            # $SYS/# sees where window time goes
+            out.append(self._msg("profiler", {
+                "stages_us": {
+                    name: {
+                        "count": d["count"],
+                        "p50": d["p50"],
+                        "p99": d["p99"],
+                    }
+                    for name, d in prof.summary().items()
+                    if d["count"]
+                },
+                "engine": b.router.engine.stats(),
+            }))
+        return out
 
     def tick(self, now: float | None = None) -> int:
         """Publish the heartbeat when the configured interval elapsed;

@@ -57,9 +57,9 @@ def test_concurrent_publishers_one_window():
         match_calls = [0]
         orig_match = srv.broker.publish_match_submit
 
-        def counting_match(live, congested=False):
+        def counting_match(live, congested=False, rec=None):
             match_calls[0] += 1
-            return orig_match(live, congested)
+            return orig_match(live, congested, rec)
 
         srv.broker.publish_match_submit = counting_match
 
