@@ -174,39 +174,52 @@ def run_dispatch_fanout_bench(log):
     window = 64
     n_for = {1: 20000, 16: 4000, 256: 500}
     out = {}
-    for fanout in (1, 16, 256):
+
+    def setup(fanout, qos, label, max_inflight=None):
+        """One broker + `fanout` subscribed channels writing into a
+        byte/write-count sink."""
         cfg = BrokerConfig()
         cfg.engine.use_device = False
         b = Broker(config=cfg)
         sink = [0, 0]  # bytes written, write calls
 
-        def make_send(version):
-            def _send(pkts):
-                data = b"".join(C.serialize(p, version) for p in pkts)
-                sink[0] += len(data)
-                sink[1] += 1
-            return _send
+        def send(pkts):
+            data = b"".join(C.serialize(p, C.MQTT_V5) for p in pkts)
+            sink[0] += len(data)
+            sink[1] += 1
 
-        flt = f"fan/{fanout}"
+        flt = f"fan/{label}"
+        kw = {} if max_inflight is None else {
+            "max_inflight": max_inflight
+        }
         for i in range(fanout):
-            ch = Channel(b, send=make_send(C.MQTT_V5),
-                         close=lambda r: None)
-            cid = f"fs{i}"
-            session, _ = b.cm.open_session(True, cid, ch)
-            session.subscribe(flt, SubOpts(qos=0))
-            b.subscribe(cid, flt, SubOpts(qos=0))
+            ch = Channel(b, send=send, close=lambda r: None)
+            cid = f"f{label}-{i}"
+            session, _ = b.cm.open_session(True, cid, ch, **kw)
+            session.subscribe(flt, SubOpts(qos=qos))
+            b.subscribe(cid, flt, SubOpts(qos=qos))
+        return b, sink, flt
 
-        n = n_for[fanout]
-        msgs = [Message(topic=flt, payload=b"x" * 64) for _ in range(n)]
+    def pump(b, flt, fanout, qos):
+        """Warm, then route n_for windows; returns (rate, stages)."""
+        n = n_for[fanout] if fanout in n_for else n_for[256]
+        msgs = [Message(topic=flt, payload=b"x" * 64, qos=qos)
+                for _ in range(n)]
         b.publish_many(msgs[:window])  # warm
         t0 = time.perf_counter()
         total = 0
         for w0 in range(window, n, window):
-            total += sum(b.publish_many(msgs[w0:w0 + window]))
+            w = msgs[w0:w0 + window]
+            # stamp at "ingress": pre-built messages would otherwise
+            # age across the run and trip the slow-subs scan on every
+            # delivery — a harness artifact production never pays
+            now = time.time()
+            for m in w:
+                m.timestamp = now
+            total += sum(b.publish_many(w))
         dt = time.perf_counter() - t0
         routed = n - window
         assert total == routed * fanout, (total, routed * fanout)
-        out[f"fanout_{fanout}"] = routed / dt
         # the profiler rides the instrumented hot path (its shipping
         # default): per-stage p50/p99 says WHERE window time goes, not
         # just msg/s.  "e2e" is excluded: this harness constructs all
@@ -222,26 +235,50 @@ def run_dispatch_fanout_bench(log):
                     "p50_us": round(snap.percentile(50), 1),
                     "p99_us": round(snap.percentile(99), 1),
                 }
-        out[f"fanout_{fanout}_stages"] = stages
+        return routed / dt, routed, dt, stages
+
+    def report(tag, fanout, rate, routed, dt, stages, sink):
         stage_str = " ".join(
             f"{k}={v['p50_us']:.0f}us"
             for k, v in sorted(stages.items())
-            if k in ("expand", "deliver", "flush", "match_submit")
+            if k in ("expand", "deliver", "assemble", "flush",
+                     "match_submit")
         )
         log(
-            f"dispatch fanout {fanout}: {routed / dt:,.0f} msg/s "
+            f"dispatch fanout {tag}: {rate:,.0f} msg/s "
             f"({routed * fanout / dt:,.0f} deliveries/s, "
             f"{sink[1]} writes, {sink[0] / (1 << 20):.1f} MiB; "
             f"stage p50 {stage_str})"
         )
+
+    for fanout in (1, 16, 256):
+        b, sink, flt = setup(fanout, qos=0, label=str(fanout))
+        rate, routed, dt, stages = pump(b, flt, fanout, qos=0)
+        out[f"fanout_{fanout}"] = rate
+        out[f"fanout_{fanout}_stages"] = stages
+        report(str(fanout), fanout, rate, routed, dt, stages, sink)
+
+    # QoS1 row: the per-delivery session bookkeeping (packet-id
+    # alloc, inflight insert, pid splice into the shared body) that
+    # QoS0 fan-out never exercises — the half PR 5's native assembly
+    # + block bookkeeping attack.  Unbounded inflight (the clients
+    # never ack): the clock sees assembly, not window backpressure.
+    b, sink, flt = setup(256, qos=1, label="256q1", max_inflight=0)
+    rate, routed, dt, stages = pump(b, flt, 256, qos=1)
+    out["fanout_256_qos1"] = rate
+    out["fanout_256_qos1_stages"] = stages
+    report("256 qos1", 256, rate, routed, dt, stages, sink)
     out["note"] = (
-        "publish_many windows of 64, QoS0, 64 B payloads, host "
-        "matching; encode+write counted (every packet serialized "
-        "into a per-connection sink).  Pre-PR3 per-subscriber "
-        "dispatch on this harness: fanout 1 -> 33,314, 16 -> 4,709, "
-        "256 -> 267 msg/s (one transport write per delivery); the "
-        "window path (CSR expand -> encode-once -> corked flush) "
-        "must hold fanout 256 at >= 3x that 267 baseline."
+        "publish_many windows of 64, QoS0, 64 B payloads stamped at "
+        "ingress, host matching; encode+write counted (every packet "
+        "serialized into a per-connection sink).  Pre-PR3 "
+        "per-subscriber dispatch on this harness: fanout 1 -> "
+        "33,314, 16 -> 4,709, 256 -> 267 msg/s (one transport write "
+        "per delivery); PR3's window path (CSR expand -> encode-once "
+        "-> corked flush) must hold fanout 256 at >= 3x that 267 "
+        "baseline, and PR5's native assemble path (per-run decision "
+        "scan -> GIL-released arena splice, the 'assemble' sub-stage) "
+        "must hold >= 2x the PR4 number on the same box."
     )
     return out
 

@@ -225,6 +225,13 @@ class Broker:
         # nothing. Cluster linking uses this to pin $LINK/msg delivery
         # to the peer's agent session.
         self.delivery_guards: List[Callable[[str, Message], bool]] = []
+        # window-level delivered observers: called ONCE per dispatch
+        # window with [(clientid, deliveries), ...] — the batched
+        # bridge point the exhook client uses so a 256-client window
+        # costs one bridge call, not 256 hook-chain walks.  The
+        # in-process per-(window, client) ``message.delivered`` hook
+        # keeps firing with its stable signature regardless.
+        self.delivered_batch_sinks: List[Callable] = []
         # ClusterNode installs itself here (the emqx_external_broker
         # registration point, emqx_broker.erl:379-380): provides
         # match_remote(topics) and forward(msg, nodes)
@@ -966,6 +973,16 @@ class Broker:
         touched = bytearray(n)
         corked: List = []
         n_clients = 0
+        bake_cache: Dict = {}  # shared detached-window mqueue bakes
+        delivered_runs: Optional[List] = (
+            [] if self.delivered_batch_sinks else None
+        )
+        asm = [0.0] if rec is not None else None  # native assemble time
+        # oldest publish timestamp in the window: the per-run slow-subs
+        # scan only runs when this could possibly cross the threshold
+        ts_min = min(
+            (m.timestamp for m in msgs if m.timestamp), default=0.0
+        )
         if n_direct or s_rows:
             if s_rows:
                 all_rows = np.concatenate(
@@ -1027,7 +1044,11 @@ class Broker:
                 n_clients += 1
                 try:
                     flags = self._deliver_run(
-                        clientid, deliveries, enc, mloc, corked
+                        clientid, deliveries, enc, mloc, corked,
+                        bake_cache=bake_cache,
+                        delivered_runs=delivered_runs,
+                        asm=asm,
+                        ts_min=ts_min,
                     )
                 except Exception:
                     log.exception("dispatch to %s failed", clientid)
@@ -1044,6 +1065,9 @@ class Broker:
                             counts[i] += 1
         if rec is not None:
             rec.lap("deliver")
+            if asm[0]:
+                # nested sub-stage: the native splice share of deliver
+                rec.sub("assemble", asm[0])
         # flush: ONE concatenated transport.write per connection for
         # the whole window (each channel was corked on first touch)
         for ch in corked:
@@ -1051,6 +1075,14 @@ class Broker:
                 ch.uncork()
             except Exception:
                 log.exception("window uncork failed")
+        if delivered_runs:
+            # ONE bridge call per window per sink (exhook coalescing);
+            # fired after the flush so the wire never waits on it
+            for sink in self.delivered_batch_sinks:
+                try:
+                    sink(delivered_runs)
+                except Exception:
+                    log.exception("delivered batch sink failed")
         delivered = sum(counts)
         if delivered:
             mloc["messages.delivered"] += delivered
@@ -1135,13 +1167,27 @@ class Broker:
         encoder: "C.DispatchEncoder",
         mloc: Counter,
         corked: List,
+        bake_cache: Optional[Dict] = None,
+        delivered_runs: Optional[List] = None,
+        asm: Optional[List[float]] = None,
+        ts_min: float = 0.0,
     ) -> Optional[List[int]]:
         """Deliver one client's slice of the window; returns a 0/1
         kept flag per delivery so counts attribute back to their
         messages (``None`` = the all-kept connected fast path, so the
         hot case allocates no flag list).  Counter deltas accumulate
         into ``mloc`` (flushed once per window); the client's channel
-        is corked on first touch and flushed by the window."""
+        is corked on first touch and flushed by the window.
+
+        Connected channels take the native window fast path when the
+        run qualifies (`Session.deliver_run_native`): one GIL-released
+        splice builds the whole run's wire buffer, written into the
+        cork buffer as one blob — per-delivery ``Packet`` objects only
+        exist on the fallback loop.  ``asm`` accumulates the native
+        splice time for the profiler's ``assemble`` sub-stage;
+        ``bake_cache`` shares detached-session mqueue bakes across the
+        window; ``delivered_runs`` collects (clientid, deliveries) for
+        the window-level delivered sinks."""
         session = self.cm.lookup(clientid)
         nd = len(deliveries)
         if session is None:
@@ -1160,27 +1206,57 @@ class Broker:
             if cork is not None:
                 cork()
                 corked.append(channel)
-            packets = session.deliver(
-                deliveries,
-                encoder=encoder,
-                version=getattr(channel, "version", None),
-            )
+            version = getattr(channel, "version", None)
+            res = None
+            send_wire = getattr(channel, "send_wire", None)
+            if encoder is not None and version is not None \
+                    and send_wire is not None:
+                if asm is not None:
+                    t0 = time.perf_counter()
+                    res = session.deliver_run_native(
+                        deliveries, encoder, version
+                    )
+                    if res is not None:  # only count runs it served
+                        asm[0] += time.perf_counter() - t0
+                else:
+                    res = session.deliver_run_native(
+                        deliveries, encoder, version
+                    )
+            if res is not None:
+                data, npub = res
+                if data:
+                    send_wire(data, npub)
+            else:
+                packets = session.deliver(
+                    deliveries, encoder=encoder, version=version
+                )
+                channel.send_packets(packets)
             self.hooks.run("message.delivered", clientid, deliveries)
-            channel.send_packets(packets)
+            if delivered_runs is not None:
+                delivered_runs.append((clientid, deliveries))
             now = time.time()
             slow = self.slow_subs
             floor = now - slow.threshold_ms / 1000.0
-            for m, _opts in deliveries:
-                # hoisted threshold: only genuinely slow deliveries
-                # pay the record() call
-                if m.timestamp and m.timestamp < floor:
-                    slow.record(
-                        clientid, m.topic, (now - m.timestamp) * 1000.0
-                    )
+            if ts_min and ts_min < floor:
+                # only scan the run when the window's OLDEST publish
+                # could cross the threshold (the common all-fresh
+                # window pays one compare, not one per delivery)
+                for m, _opts in deliveries:
+                    if m.timestamp and m.timestamp < floor:
+                        slow.record(
+                            clientid, m.topic,
+                            (now - m.timestamp) * 1000.0,
+                        )
             if self.tracer is not None:
                 self._deliver_span(clientid, deliveries)
             return None  # all delivered
-        # detached persistent session: queue QoS>0, drop QoS0
+        # detached persistent session: queue QoS>0, drop QoS0.  The
+        # baked queued copy (effective qos + subopts folded in) is
+        # shared across every detached session in the window via
+        # ``bake_cache`` — one bake per (msg, qos, retain, subid)
+        # signature instead of one per (client, delivery); queued
+        # copies are never mutated downstream, so sharing is safe and
+        # `replicate_queued` wire output is unchanged.
         flags = [0] * nd
         replicated = []
         for k, (m, opts) in enumerate(deliveries):
@@ -1188,7 +1264,19 @@ class Broker:
             if qos == 0:
                 mloc["delivery.dropped"] += 1
                 continue
-            baked = session._queued(m, opts, qos)
+            if bake_cache is None:
+                baked = session._queued(m, opts, qos)
+            else:
+                bkey = (
+                    id(m), qos,
+                    m.retain and opts.retain_as_published,
+                    opts.subid,
+                )
+                baked = bake_cache.get(bkey)
+                if baked is None:
+                    baked = bake_cache[bkey] = session._queued(
+                        m, opts, qos
+                    )
             dropped = session.mqueue.insert(baked)
             if dropped is not None:
                 mloc["delivery.dropped.queue_full"] += 1

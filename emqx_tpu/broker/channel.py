@@ -145,15 +145,19 @@ class Channel:
             if not self._closing:
                 self._send(buf)
 
+    def _pub_sent_slots(self, m):
+        sent = Channel._sent_slots
+        if sent is None:
+            sent = Channel._sent_slots = tuple(
+                m.slots("messages.sent", q, "packets.publish.sent")
+                for q in _QOS_SENT
+            )
+        return sent
+
     def send_packets(self, packets: List[C.Packet]) -> None:
         if packets and not self._closing:
             m = self.broker.metrics
-            sent = self._sent_slots
-            if sent is None:
-                sent = Channel._sent_slots = tuple(
-                    m.slots("messages.sent", q, "packets.publish.sent")
-                    for q in _QOS_SENT
-                )
+            sent = self._pub_sent_slots(m)
             # count per qos first, then ONE locked bump per class —
             # a 256-subscriber fan-out was 768 lock acquisitions
             npub = [0, 0, 0]
@@ -167,6 +171,26 @@ class Channel:
                 self._cork_buf.extend(packets)
                 return
             self._send(packets)
+
+    def send_wire(self, data, npub: Tuple[int, int, int]) -> None:
+        """One pre-assembled delivery run (the native window fast
+        path): the same per-qos metric slots `send_packets` bumps,
+        then ONE `Raw` blob into the corked buffer — per delivery the
+        channel does no Python work at all."""
+        if self._closing:
+            return
+        m = self.broker.metrics
+        sent = self._pub_sent_slots(m)
+        total = 0
+        for q in (0, 1, 2):
+            if npub[q]:
+                m.inc_slots(sent[q], npub[q])
+                total += npub[q]
+        pkt = C.Raw(data, self.version, total)
+        if self._cork_depth:
+            self._cork_buf.append(pkt)
+            return
+        self._send([pkt])
 
     def close(self, reason: str) -> None:
         """CM-initiated close (takeover/kick): tell a v5 client why."""

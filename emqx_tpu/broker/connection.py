@@ -61,10 +61,16 @@ class Connection:
         if self.writer.is_closing():
             return
         m = self.broker.metrics
-        data = b"".join(
-            C.serialize(p, self.channel.version) for p in packets
-        )
-        m.inc("packets.sent", len(packets))
+        version = self.channel.version
+        n = 0
+        parts = []
+        for p in packets:
+            parts.append(C.serialize(p, version))
+            # a Raw blob (native window assembly) carries a whole
+            # delivery run in one buffer — count its real packets
+            n += getattr(p, "n_packets", 1)
+        data = b"".join(parts)
+        m.inc("packets.sent", n)
         m.inc("bytes.sent", len(data))
         self.writer.write(data)
         try:

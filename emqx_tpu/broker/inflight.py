@@ -30,6 +30,18 @@ class Inflight:
             raise KeyError(f"packet id {key} already in flight")
         self._d[key] = value
 
+    def insert_run(self, keys, values) -> None:
+        """Bulk insert for one delivery run: one pass over aligned
+        (key, value) sequences with the same duplicate check as
+        `insert` — the caller builds all values with ONE clock read,
+        so a 64-delivery run costs one scan instead of 64 insert calls
+        (and 64 ``time.time()``s)."""
+        d = self._d
+        for key, value in zip(keys, values):
+            if key in d:
+                raise KeyError(f"packet id {key} already in flight")
+            d[key] = value
+
     def update(self, key: int, value: Any) -> None:
         if key not in self._d:
             raise KeyError(key)

@@ -18,14 +18,32 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
+import numpy as np
+
 from ..codec import mqtt as C
 from ..message import Message
+from ..ops import dispatchasm
 from .inflight import Inflight
 from .mqueue import MQueue
 
 # inflight entry phases (server→client delivery)
 _PUBLISHING = "publish"  # sent PUBLISH, awaiting PUBACK (q1) / PUBREC (q2)
 _PUBREL = "pubrel"  # sent PUBREL, awaiting PUBCOMP
+
+# shared all--1 pid column for pure-QoS0 runs (the native assembler
+# reads pid[i] per delivery; -1 = no packet-id splice), grown on
+# demand; the ctypes pointer is cached so QoS0 runs pay zero per-run
+# conversion cost
+_NEG1 = np.full(256, -1, dtype=np.int64)
+_NEG1_PTR = _NEG1.ctypes.data_as(dispatchasm._I64P)
+
+
+def _neg1_ptr(n: int):
+    global _NEG1, _NEG1_PTR
+    if n > len(_NEG1):
+        _NEG1 = np.full(max(n, 2 * len(_NEG1)), -1, dtype=np.int64)
+        _NEG1_PTR = _NEG1.ctypes.data_as(dispatchasm._I64P)
+    return _NEG1_PTR
 
 
 @dataclass
@@ -122,6 +140,28 @@ class Session:
                 return self._next_pid
         raise RuntimeError("no free packet id")
 
+    def alloc_packet_ids(self, n: int) -> List[int]:
+        """Block packet-id allocation for a delivery run: ``n`` ids
+        with wraparound and in-use-skip semantics identical to ``n``
+        sequential `_alloc_packet_id` calls — ids granted earlier in
+        the block count as in use even though their inflight inserts
+        land afterwards (`Inflight.insert_run`)."""
+        out: List[int] = []
+        inflight = self.inflight
+        taken = set()
+        pid = self._next_pid
+        for _ in range(n):
+            for _ in range(65535):
+                pid = pid % 65535 + 1
+                if pid not in inflight and pid not in taken:
+                    out.append(pid)
+                    taken.add(pid)
+                    break
+            else:
+                raise RuntimeError("no free packet id")
+        self._next_pid = pid
+        return out
+
     # ------------------------------------------------------ subscribe
 
     def subscribe(self, flt: str, opts: SubOpts) -> bool:
@@ -156,6 +196,7 @@ class Session:
         enc = encoder if version is not None else None
         cid = self.clientid
         upgrade = self.upgrade_qos
+        now = time.time()  # ONE clock read per run (PERF402)
         for msg, opts in deliveries:
             if opts.no_local and msg.from_client == cid:
                 continue  # [MQTT-3.8.3-3]
@@ -178,13 +219,118 @@ class Session:
                 continue
             pid = self._alloc_packet_id()
             self.inflight.insert(
-                pid, _InflightEntry(_PUBLISHING, msg, qos, time.time())
+                pid, _InflightEntry(_PUBLISHING, msg, qos, now)
             )
             if enc is not None and opts.subid is None:
                 out.append(enc.publish(msg, opts, qos, pid, version))
             else:
                 out.append(self._publish_packet(msg, opts, qos, pid))
         return out
+
+    def deliver_run_native(
+        self,
+        deliveries: List[Tuple[Message, SubOpts]],
+        encoder: "C.DispatchEncoder",
+        version: int,
+    ) -> Optional[Tuple[bytearray, Tuple[int, int, int]]]:
+        """The window fast path for one client's run: Python makes the
+        *decisions* in one pass — the no-local mask, effective QoS, a
+        block packet-id allocation and one bulk inflight insert with a
+        single clock read — then the native assembler
+        (``ops.dispatchasm``) splices the encoder's arena spans into
+        ONE contiguous wire buffer (head, 2-byte pid patch, tail per
+        delivery) with the GIL released.  Returns
+        ``(wire, (n_qos0, n_qos1, n_qos2))``.
+
+        ``None`` = ineligible run, caller takes the per-delivery
+        `deliver` loop (bit-identical wire): the native lib is absent,
+        a delivery carries a subscription identifier, or the inflight
+        window cannot absorb every QoS>0 delivery (the fallback loop
+        queues the overflow per delivery)."""
+        lib = dispatchasm.load()
+        if lib is None:
+            return None
+        cid = self.clientid
+        upgrade = self.upgrade_qos
+        si = encoder.slot_index
+        slot_for = encoder.slot_for
+        hls = encoder.head_lens
+        tls = encoder.tail_lens
+        slots: List[int] = []
+        pid_pos: List[int] = []
+        pend: List[Tuple[Message, int]] = []
+        n0 = 0
+        total = 0
+        # ONE pass makes every per-delivery decision; the loop body is
+        # the entire per-delivery Python cost of the fast path.  A
+        # run's deliveries overwhelmingly share one SubOpts object
+        # (one subscription matched the whole window), so the opts
+        # fields are re-read only when the identity changes.
+        last_opts = None
+        oq = nl = rap = 0
+        for msg, opts in deliveries:
+            if opts is not last_opts:
+                if opts.subid is not None:
+                    return None  # per-subscriber props: fall back
+                oq = opts.qos
+                nl = opts.no_local
+                rap = opts.retain_as_published
+                last_opts = opts
+            mq = msg.qos
+            qos = (mq if mq > oq else oq) if upgrade else (
+                mq if mq < oq else oq
+            )
+            if nl and msg.from_client == cid:
+                continue  # [MQTT-3.8.3-3]
+            retain = rap if msg.retain else False
+            slot = si.get((id(msg), qos, retain, version))
+            if slot is None:
+                slot = slot_for(msg, qos, retain, version)
+            if qos == 0:
+                n0 += 1
+            else:
+                pid_pos.append(len(slots))
+                pend.append((msg, qos))
+            slots.append(slot)
+            total += hls[slot] + tls[slot]
+        k = len(pend)
+        inflight = self.inflight
+        if k and inflight.max_size > 0 and (
+            len(inflight) + k > inflight.max_size
+        ):
+            return None  # full/near-full window: fallback queues overflow
+        n = len(slots)
+        n1 = n2 = 0
+        if n == 0:
+            return bytearray(), (0, 0, 0)
+        body = np.asarray(slots, dtype=np.int64)
+        if k:
+            total += 2 * k
+            pid_arr = np.full(n, -1, dtype=np.int64)
+            pids = self.alloc_packet_ids(k)
+            pid_arr[pid_pos] = pids
+            now = time.time()  # ONE clock read per run
+            inflight.insert_run(
+                pids,
+                [_InflightEntry(_PUBLISHING, m, q, now) for m, q in pend],
+            )
+            for _m, q in pend:
+                if q == 1:
+                    n1 += 1
+                else:
+                    n2 += 1
+            pid_ptr = pid_arr.ctypes.data_as(dispatchasm._I64P)
+        else:
+            pid_ptr = _neg1_ptr(n)
+        out = bytearray(total)
+        wrote = dispatchasm.assemble_run(
+            lib, encoder.native_views(), body, pid_ptr, n, out,
+        )
+        if wrote != total:  # defensive: never ship a short splice
+            raise RuntimeError(
+                f"native assembly wrote {wrote} of {total} bytes"
+            )
+        return out, (n0, n1, n2)
 
     def _effective_qos(self, msg_qos: int, opts: SubOpts) -> int:
         if self.upgrade_qos:

@@ -18,6 +18,8 @@ import struct
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional, Tuple, Union
 
+import numpy as np
+
 # protocol versions (CONNECT 'Protocol Level' byte)
 MQTT_V3 = 3  # MQIsdp, MQTT 3.1
 MQTT_V4 = 4  # MQTT 3.1.1
@@ -921,6 +923,24 @@ def serialize(pkt: Packet, version: int = MQTT_V5) -> bytes:
 _PID_STRUCT = struct.Struct(">H")
 
 
+class Raw:
+    """Pre-assembled wire bytes riding the packet pipeline: one blob
+    carries a whole delivery run (native window assembly), and
+    ``serialize`` returns the buffer verbatim via the ``_wire``
+    contract.  ``n_packets`` keeps packet-count metrics honest (one
+    blob = many PUBLISHes); ``type`` is the reserved packet type 0 so
+    per-packet send loops never mistake it for a PUBLISH (its per-qos
+    counters were already bumped by ``Channel.send_wire``)."""
+
+    __slots__ = ("_wire", "n_packets")
+    type = 0
+    qos = 0
+
+    def __init__(self, data, version: int, n_packets: int) -> None:
+        self._wire = (version, data)
+        self.n_packets = n_packets
+
+
 class DispatchEncoder:
     """Window-scoped encode-once cache for PUBLISH fan-out.
 
@@ -941,13 +961,123 @@ class DispatchEncoder:
     subscription identifier); anything else falls back to the normal
     per-packet encode, so the wire stays bit-identical either way.
     The cache keys on ``id(msg)``: the encoder must not outlive its
-    dispatch window (messages do)."""
+    dispatch window (messages do).
 
-    __slots__ = ("_parts", "_q0")
+    For the native window assembler (``ops.dispatchasm``) the encoder
+    additionally keeps an **arena**: every unique body's full frame
+    appended to one bytearray, with per-body head/tail span tables
+    (the spans around the 2-byte packet-id slot) in parallel lists —
+    ``Session.deliver_run_native`` resolves each delivery to a slot
+    through ``slot_index`` (one dict probe on the hot path) and hands
+    the run's ``(body, pid)`` columns to one GIL-released splice
+    call over the cached ctypes span pointers."""
+
+    __slots__ = ("_parts", "_q0", "arena", "slot_index",
+                 "head_lens", "tail_lens",
+                 "_head_off", "_tail_off", "_span_np", "_span_ptrs",
+                 "_arena_export")
 
     def __init__(self) -> None:
         self._parts: Dict[Tuple, Tuple] = {}
         self._q0: Dict[Tuple, Publish] = {}
+        # native-assembly arena + span tables (slot = list index);
+        # slot_index: (id(msg), qos, retain, version) -> slot
+        self.arena = bytearray()
+        self.slot_index: Dict[Tuple, int] = {}
+        self.head_lens: List[int] = []
+        self.tail_lens: List[int] = []
+        self._head_off: List[int] = []
+        self._tail_off: List[int] = []
+        self._span_np: Optional[Tuple] = None
+        self._span_ptrs: Optional[Tuple] = None
+        self._arena_export = None  # pinned ctypes view of the arena
+
+    # ------------------------------------------- native window assembly
+
+    def slot_for(self, msg, qos: int, retain: bool, version: int) -> int:
+        """Arena slot for one unique body: serialize once, append the
+        frame to the arena, and record the head/tail spans around the
+        packet-id slot (QoS 0: the head span is the whole frame).
+        Hot-path callers probe ``slot_index`` first and only land here
+        on a miss."""
+        key = (id(msg), qos, retain, version)
+        s = self.slot_index.get(key)
+        if s is None:
+            props: Properties = dict(msg.properties)
+            left = msg.remaining_expiry()
+            if left is not None:
+                props["message_expiry_interval"] = left  # [MQTT-3.3.2-6]
+            wire = serialize(
+                Publish(
+                    topic=msg.topic,
+                    payload=msg.payload,
+                    qos=qos,
+                    retain=retain,
+                    packet_id=1 if qos else None,
+                    properties=props,
+                ),
+                version,
+            )
+            # release the pinned ctypes export BEFORE growing the
+            # arena (a live export blocks bytearray resizing)
+            self._arena_export = None
+            off = len(self.arena)
+            self.arena += wire
+            if qos == 0:
+                hl, to, tl = len(wire), 0, 0
+            else:
+                i = 1  # skip fixed header byte + remaining-length varint
+                while wire[i] & 0x80:
+                    i += 1
+                hl = i + 1 + 2 + len(msg.topic.encode("utf-8"))
+                to = off + hl + 2
+                tl = len(wire) - hl - 2
+            s = len(self._head_off)
+            self._head_off.append(off)
+            self.head_lens.append(hl)
+            self._tail_off.append(to)
+            self.tail_lens.append(tl)
+            self._span_np = None
+            self._span_ptrs = None
+            self.slot_index[key] = s
+        return s
+
+    def span_arrays(self) -> Tuple:
+        """The span tables as contiguous int64 arrays (lazily rebuilt
+        after new slots), indexed by a run's ``body`` column."""
+        a = self._span_np
+        if a is None:
+            a = self._span_np = (
+                np.asarray(self._head_off, dtype=np.int64),
+                np.asarray(self.head_lens, dtype=np.int64),
+                np.asarray(self._tail_off, dtype=np.int64),
+                np.asarray(self.tail_lens, dtype=np.int64),
+            )
+        return a
+
+    def native_views(self) -> Tuple:
+        """(arena_ctypes_view, head_off_p, head_len_p, tail_off_p,
+        tail_len_p) for the native splice — ctypes conversions cached
+        across runs (slot misses stop after the window's first few
+        clients, so the rest of the fan-out pays zero per-run
+        conversion cost).  The cached arena export is released by
+        `slot_for` before any append, so the bytearray can still
+        grow."""
+        ptrs = self._span_ptrs
+        if ptrs is None:
+            from ..ops import dispatchasm as _da
+
+            ho, hl, to, tl = self.span_arrays()
+            ptrs = self._span_ptrs = tuple(
+                a.ctypes.data_as(_da._I64P) for a in (ho, hl, to, tl)
+            )
+        if self._arena_export is None:
+            import ctypes as _ct
+
+            self._arena_export = (
+                _ct.c_uint8 * len(self.arena)
+            ).from_buffer(self.arena) if self.arena else None
+        return (self._arena_export,) + ptrs
 
     def _parts_for(self, msg, qos: int, retain: bool, version: int):
         key = (id(msg), qos, retain, version)

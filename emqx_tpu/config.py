@@ -359,6 +359,18 @@ def _merge_dataclass(obj: Any, data: Dict[str, Any]) -> None:
 
 ENV_PREFIX = "EMQX_TPU_"
 
+# runtime switches that share the prefix but are NOT config paths:
+# the native-lib kill switches read directly by the emqx_tpu.ops
+# loaders.  Without this carve-out a worker subprocess booted with
+# one in its environment (e.g. a fallback-mode test run) died with
+# "unknown config path".
+ENV_RESERVED = {
+    "EMQX_TPU_NO_NATIVE_SORT",
+    "EMQX_TPU_NO_NATIVE_TOKDICT",
+    "EMQX_TPU_NO_NATIVE_TRIE",
+    "EMQX_TPU_NO_NATIVE_DISPATCH",
+}
+
 
 def apply_env_overrides(
     cfg: BrokerConfig, environ: Optional[Dict[str, str]] = None
@@ -376,7 +388,7 @@ def apply_env_overrides(
     environ = dict(os.environ) if environ is None else environ
     applied: List[Tuple[str, Any]] = []
     for name in sorted(environ):
-        if not name.startswith(ENV_PREFIX):
+        if not name.startswith(ENV_PREFIX) or name in ENV_RESERVED:
             continue
         path = name[len(ENV_PREFIX):].lower().replace("__", ".")
         raw = environ[name]

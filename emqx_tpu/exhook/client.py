@@ -216,11 +216,19 @@ class ExhookClient:
         except grpc.RpcError:
             pass
 
+    def _unregister_all(self) -> None:
+        reg = self.broker.hooks
+        sinks = getattr(self.broker, "delivered_batch_sinks", None)
+        for name, cb in self._registered:
+            if sinks is not None and cb in sinks:
+                sinks.remove(cb)
+            else:
+                reg.delete(name, cb)
+        self._registered = []
+
     def _register(self, names: Sequence[str]) -> None:
         reg = self.broker.hooks
-        for name, cb in self._registered:
-            reg.delete(name, cb)
-        self._registered = []
+        self._unregister_all()
         for name in names:
             # verdict hooks register sync+async pairs: the broker's
             # async chain walkers (batched publish fold, channel authn/
@@ -244,6 +252,16 @@ class ExhookClient:
                     with_async(self._on_authorize,
                                self._on_authorize_async),
                     priority=50)
+            elif name == "message.delivered" and hasattr(
+                self.broker, "delivered_batch_sinks"
+            ):
+                # window-batched bridge: instead of a hook walked once
+                # per (window, client), ONE sink call per dispatch
+                # window carries every client's delivery run (the
+                # in-process hook keeps its per-client signature for
+                # local consumers — trace, topic metrics)
+                cb = self._delivered_window_sink
+                self.broker.delivered_batch_sinks.append(cb)
             elif name in _NOTIFY_RPC:
                 cb = reg.add(name, self._notify_handler(name), priority=50)
             else:
@@ -251,9 +269,7 @@ class ExhookClient:
             self._registered.append((name, cb))
 
     def stop(self) -> None:
-        for name, cb in self._registered:
-            self.broker.hooks.delete(name, cb)
-        self._registered = []
+        self._unregister_all()
         if self._channel is not None:
             if self.loaded:
                 try:
@@ -478,6 +494,40 @@ class ExhookClient:
             return None
 
         return handler
+
+    def _delivered_window_sink(self, runs) -> None:
+        """ONE bridge call per dispatch window
+        (``broker.delivered_batch_sinks``): the per-(window, client)
+        hook walks collapse into a single call carrying every client's
+        delivery run.  Breaker state and method resolution are checked
+        once per window; each run still produces the same
+        ``OnMessageDelivered`` RPC (first delivery of the run) the
+        per-client handler sent — the proto is per-message, so the
+        coalescing amortizes the Python bridge, not the wire."""
+        if time.monotonic() < self._open_until:
+            self.stats["fast_failed"] += 1
+            return
+        if self._channel is None:
+            return
+        method = self._method(
+            "OnMessageDelivered", pb.MessageDeliveredRequest,
+            pb.EmptySuccess,
+        )
+        for clientid, deliveries in runs:
+            try:
+                # the per-client handler's request builder is the
+                # single source of truth for the RPC shape
+                req = self._notify_request(
+                    "message.delivered", (clientid, deliveries)
+                )
+            except Exception:
+                log.debug("exhook delivered batch: request build "
+                          "failed", exc_info=True)
+                continue
+            if req is None:
+                continue
+            fut = method.future(req, timeout=self.timeout)
+            fut.add_done_callback(self._notify_done)
 
     def _notify_done(self, fut) -> None:
         exc = fut.exception()

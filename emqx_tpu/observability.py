@@ -170,7 +170,8 @@ class WindowRecord:
 
     __slots__ = (
         "seq", "wall0", "t0", "_t_last", "n_msgs", "n_deliveries",
-        "n_clients", "path", "breaker_open", "source", "spans", "e2e_ms",
+        "n_clients", "path", "breaker_open", "source", "spans",
+        "subs", "e2e_ms",
     )
 
     def __init__(self, seq: int, n_msgs: int, source: str) -> None:
@@ -186,6 +187,11 @@ class WindowRecord:
         self.breaker_open = False
         self.source = source  # "publish" | "batcher" | "forwarded"
         self.spans: List[Tuple[str, float, float]] = []  # (name, off, dur)
+        # nested sub-stages: (name, dur) accumulated inside a parent
+        # span (e.g. the native ``assemble`` share of ``deliver``) —
+        # histogrammed like spans but kept out of the trace's B/E
+        # track, whose spans must stay contiguous
+        self.subs: List[Tuple[str, float]] = []
         self.e2e_ms: List[float] = []
 
     def lap(self, name: str) -> None:
@@ -195,6 +201,10 @@ class WindowRecord:
         now = time.perf_counter()
         self.spans.append((name, self._t_last - self.t0, now - self._t_last))
         self._t_last = now
+
+    def sub(self, name: str, dur_s: float) -> None:
+        """Record a nested sub-stage total (caller-accumulated)."""
+        self.subs.append((name, dur_s))
 
     def to_dict(self) -> Dict[str, object]:
         return {
@@ -207,7 +217,14 @@ class WindowRecord:
             "path": self.path,
             "breaker_open": self.breaker_open,
             "stages_us": {
-                name: round(dur * 1e6, 1) for name, _off, dur in self.spans
+                **{
+                    name: round(dur * 1e6, 1)
+                    for name, _off, dur in self.spans
+                },
+                **{
+                    name: round(dur * 1e6, 1)
+                    for name, dur in self.subs
+                },
             },
             "e2e_ms": [round(v, 3) for v in self.e2e_ms[:8]],
         }
@@ -222,8 +239,8 @@ class Profiler:
     # stage histograms pre-created so exposition order is stable
     STAGES = (
         "batch_wait", "prepare", "match_submit", "match_wait",
-        "dispatch_wait", "expand", "deliver", "flush", "rules",
-        "tokenize", "e2e",
+        "dispatch_wait", "expand", "deliver", "assemble", "flush",
+        "rules", "tokenize", "e2e",
     )
 
     def __init__(
@@ -260,6 +277,11 @@ class Profiler:
         hist = self._hist
         with self._hlock:
             for name, _off, dur in rec.spans:
+                h = hist.get(name)
+                if h is None:
+                    h = hist[name] = Histogram(lock=self._hlock)
+                h._record_locked(dur * 1e6)
+            for name, dur in rec.subs:
                 h = hist.get(name)
                 if h is None:
                     h = hist[name] = Histogram(lock=self._hlock)

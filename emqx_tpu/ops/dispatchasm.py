@@ -1,0 +1,89 @@
+"""ctypes binding for native/dispatchasm.cpp: GIL-released per-run
+PUBLISH assembly for the dispatch fan-out.
+
+One call splices a whole client run — head span, 2-byte packet-id
+patch, tail span per delivery — out of the window encoder's arena into
+one contiguous wire buffer (the connection's corked write), replacing
+the per-delivery Python join + ``Packet`` object churn that dominated
+the ``deliver`` stage p99 at high fan-out.  Same load/fallback
+contract as ``sortutil_native``/``tokdict_native``: a missing or
+unbuildable ``.so`` (or ``EMQX_TPU_NO_NATIVE_DISPATCH=1``) degrades to
+the pure-Python per-delivery loop in ``Session.deliver``, which stays
+bit-identical (property-tested in tests/test_dispatch_native.py)."""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+_REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_SRC = os.path.join(_REPO, "native", "dispatchasm.cpp")
+_SO = os.path.join(_REPO, "native", "build", "libdispatchasm.so")
+
+_lock = threading.Lock()
+_lib = None
+_lib_failed = False
+
+_I64P = ctypes.POINTER(ctypes.c_int64)
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+
+
+def load():
+    global _lib, _lib_failed
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        if os.environ.get("EMQX_TPU_NO_NATIVE_DISPATCH") == "1":
+            _lib_failed = True
+            return None
+        try:
+            if not os.path.exists(_SO) or os.path.getmtime(
+                _SO
+            ) < os.path.getmtime(_SRC):
+                os.makedirs(os.path.dirname(_SO), exist_ok=True)
+                subprocess.run(
+                    ["g++", "-O3", "-fPIC", "-shared", "-std=c++20",
+                     "-Wall", "-o", _SO, _SRC],
+                    check=True,
+                    capture_output=True,
+                )
+            lib = ctypes.CDLL(_SO)
+            lib.da_assemble_run.restype = ctypes.c_int64
+            lib.da_assemble_run.argtypes = [
+                _U8P,                    # arena
+                _I64P, _I64P,            # head_off, head_len
+                _I64P, _I64P,            # tail_off, tail_len
+                _I64P, _I64P,            # body idx, pid (-1 = no pid)
+                ctypes.c_int64,          # n deliveries
+                _U8P,                    # out
+            ]
+            _lib = lib
+        except Exception:
+            logging.getLogger("emqx_tpu.ops").exception(
+                "native dispatchasm build failed; "
+                "using the per-delivery Python loop"
+            )
+            _lib_failed = True
+        return _lib
+
+
+def assemble_run(lib, views, body, pid_ptr, n: int,
+                 out: bytearray) -> int:
+    """Splice one run into ``out`` (sized by the caller).  ``views``
+    is the encoder's cached ``native_views()`` tuple (arena export +
+    span-table pointers); ``body`` is a contiguous int64 numpy column
+    and ``pid_ptr`` an already-converted int64 pointer (QoS0 runs
+    reuse one cached all--1 column); ``out`` is wrapped in place
+    (``from_buffer`` pins it only for the call)."""
+    arena, ho, hl, to, tl = views
+    return lib.da_assemble_run(
+        arena, ho, hl, to, tl,
+        body.ctypes.data_as(_I64P), pid_ptr,
+        n,
+        (ctypes.c_uint8 * len(out)).from_buffer(out),
+    )

@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Rebuild every native helper .so with the exact flags the checked-in
+# binaries (and the on-demand rebuilders in emqx_tpu/ops/*_native.py /
+# dispatchasm.py) use.  Each loader also rebuilds its own lib lazily
+# when the source is newer than the binary, so running this script is
+# only needed for a clean rebuild or a toolchain bump.
+#
+# A lib that fails to build is reported and SKIPPED: every native lib
+# has a pure-Python fallback, and tier-1 skips the native parity tests
+# when the lib is absent (mirroring tests/test_tokdict_native.py) —
+# e.g. hosttrie.cpp needs GCC >= 11 (C++20 heterogeneous
+# unordered_map lookup) and degrades to the Python host trie on older
+# toolchains.
+
+set -u
+cd "$(dirname "$0")"
+mkdir -p build
+
+FLAGS="-O3 -fPIC -shared -std=c++20 -Wall"
+status=0
+
+for src in sortutil tokdict dslog hosttrie dispatchasm; do
+    out="build/lib${src}.so"
+    if g++ $FLAGS -o "$out" "${src}.cpp"; then
+        echo "built $out"
+    else
+        echo "SKIPPED $out (build failed; pure-Python fallback will serve)" >&2
+        status=1
+    fi
+done
+
+exit $status

@@ -1,0 +1,51 @@
+// GIL-released per-run packet assembly for the dispatch fan-out.
+//
+// The dispatch window encoder (codec.mqtt.DispatchEncoder) serializes
+// each unique PUBLISH body once per window into a contiguous arena and
+// records, per body, the head span (fixed header .. topic) and tail
+// span (properties + payload) around the 2-byte packet-id slot.  The
+// Python hot loop used to splice those per subscriber (one bytes join
+// + one Packet object per delivery); this kernel does the whole run —
+// every delivery for ONE client — in a single ctypes call: head
+// splice, big-endian pid patch, tail splice, straight into one
+// caller-sized output buffer that becomes the connection's corked
+// write.  ctypes releases the GIL for the duration, so a large run's
+// memcpy work overlaps the batcher's executor threads.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Assemble one client's delivery run into `out` (caller-allocated to
+// the exact total size).  Per delivery i: body[i] indexes the arena
+// span tables; pid[i] >= 0 means a QoS>0 frame whose 2-byte packet id
+// is spliced between head and tail, pid[i] < 0 a QoS 0 frame whose
+// head span IS the whole frame (tail_len 0).  Returns bytes written
+// (the caller asserts it equals the precomputed total).
+int64_t da_assemble_run(const uint8_t* arena,
+                        const int64_t* head_off, const int64_t* head_len,
+                        const int64_t* tail_off, const int64_t* tail_len,
+                        const int64_t* body, const int64_t* pid,
+                        int64_t n, uint8_t* out) {
+    uint8_t* w = out;
+    for (int64_t i = 0; i < n; i++) {
+        const int64_t b = body[i];
+        const int64_t hl = head_len[b];
+        std::memcpy(w, arena + head_off[b], (size_t)hl);
+        w += hl;
+        const int64_t p = pid[i];
+        if (p >= 0) {
+            *w++ = (uint8_t)((p >> 8) & 0xFF);
+            *w++ = (uint8_t)(p & 0xFF);
+        }
+        const int64_t tl = tail_len[b];
+        if (tl) {
+            std::memcpy(w, arena + tail_off[b], (size_t)tl);
+            w += tl;
+        }
+    }
+    return (int64_t)(w - out);
+}
+
+}  // extern "C"

@@ -350,6 +350,14 @@ def test_env_overrides_and_boot_check():
         apply_env_overrides(BrokerConfig(),
                             {"EMQX_TPU_MQTT__NO_SUCH_KEY": "1"})
 
+    # the native-lib kill switches share the prefix but are runtime
+    # flags, not config paths: a worker booted with one must not die
+    applied = apply_env_overrides(BrokerConfig(), {
+        "EMQX_TPU_NO_NATIVE_DISPATCH": "1",
+        "EMQX_TPU_NO_NATIVE_SORT": "1",
+    })
+    assert applied == []
+
     assert check_config(BrokerConfig()) == []
     bad = BrokerConfig()
     bad.durable.layout = "bogus"

@@ -459,6 +459,77 @@ def test_perf401_declared_function_must_exist():
                                  dispatch=_DISPATCH)
 
 
+# ------------------------------------------------------------- PERF402
+
+def test_perf402_per_delivery_clock():
+    bad = (
+        "import time\n"
+        "class B:\n"
+        "    def fan_out(self, subs):\n"
+        "        for s in subs:\n"
+        "            s.ts = time.time()\n"
+    )
+    assert "PERF402" in rules_of(bad, path="pkg/disp.py",
+                                 dispatch=_DISPATCH)
+    # datetime-shaped per-iteration clocks fire too
+    bad2 = bad.replace("time.time()", "datetime.now()")
+    assert "PERF402" in rules_of(bad2, path="pkg/disp.py",
+                                 dispatch=_DISPATCH)
+    # the clock hoisted above the loop (one read per run): fine
+    ok = (
+        "import time\n"
+        "class B:\n"
+        "    def fan_out(self, subs):\n"
+        "        now = time.time()\n"
+        "        for s in subs:\n"
+        "            s.ts = now\n"
+    )
+    assert "PERF402" not in rules_of(ok, path="pkg/disp.py",
+                                     dispatch=_DISPATCH)
+    # a closure DEFINED in the loop is not a per-delivery clock
+    ok2 = (
+        "import time\n"
+        "class B:\n"
+        "    def fan_out(self, subs):\n"
+        "        for s in subs:\n"
+        "            def stamp():\n"
+        "                return time.time()\n"
+        "            s.stamp = stamp\n"
+    )
+    assert "PERF402" not in rules_of(ok2, path="pkg/disp.py",
+                                     dispatch=_DISPATCH)
+    # an unrelated module is not checked
+    assert "PERF402" not in rules_of(bad, path="pkg/other.py",
+                                     dispatch=_DISPATCH)
+
+
+def test_perf402_suppression_comment():
+    sup = (
+        "import time\n"
+        "class B:\n"
+        "    def fan_out(self, subs):\n"
+        "        for s in subs:\n"
+        "            s.ts = time.time()"
+        "  # brokerlint: ignore[PERF402]\n"
+    )
+    assert "PERF402" not in rules_of(sup, path="pkg/disp.py",
+                                     dispatch=_DISPATCH)
+    # suppressing PERF402 does not silence a PERF401 on the same line
+    both = (
+        "from codec import serialize\n"
+        "import time\n"
+        "class B:\n"
+        "    def fan_out(self, subs, pkt):\n"
+        "        for s in subs:\n"
+        "            s.write(serialize(pkt, time.time()))"
+        "  # brokerlint: ignore[PERF402]\n"
+    )
+    assert "PERF401" in rules_of(both, path="pkg/disp.py",
+                                 dispatch=_DISPATCH)
+    assert "PERF402" not in rules_of(both, path="pkg/disp.py",
+                                     dispatch=_DISPATCH)
+
+
 def test_perf401_declared_functions_exist_in_repo():
     """The shipped DISPATCH_FUNCS point at real functions (the repo
     gate below would fail with `missing` findings otherwise — this

@@ -1,7 +1,8 @@
 """brokerlint: repo-aware AST analysis for the broker.
 
 Rule families: async-concurrency (ASYNC1xx), device-purity
-(DEVICE2xx), failpoint-coverage (FP301), dispatch-perf (PERF401).
+(DEVICE2xx), failpoint-coverage (FP301), dispatch-perf
+(PERF401/PERF402).
 Run as a tier-1 gate by tests/test_lint.py and standalone via
 ``python -m tools.brokerlint``.
 """

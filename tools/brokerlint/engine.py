@@ -13,9 +13,10 @@ families (see the sibling modules):
     ``@jax.jit`` code, unhashable static args;
   * failpoint-coverage (``FP301``, failpointrules.py)  — declared IO
     seams must carry a ``failpoints.evaluate`` call;
-  * dispatch-perf     (``PERF401``, perfrules.py)      — no
-    per-subscriber encode calls inside dispatch-marked hot loops
-    (the single-encode fan-out invariant).
+  * dispatch-perf     (``PERF4xx``, perfrules.py)      — no
+    per-subscriber encode calls (PERF401) or per-delivery clock
+    reads (PERF402) inside dispatch-marked hot loops (the
+    single-encode / one-clock-per-run fan-out invariants).
 
 Suppression: a ``# brokerlint: ignore[RULE]`` comment on the finding's
 line (or on a comment-only line directly above it) silences that rule

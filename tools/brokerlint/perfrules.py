@@ -1,18 +1,25 @@
-"""Dispatch-perf rule (PERF401).
+"""Dispatch-perf rules (PERF401, PERF402).
 
 PR 3 made fan-out single-encode: each unique PUBLISH body is
 serialized once per dispatch window and only the packet id is patched
-per subscriber (`codec.mqtt.DispatchEncoder`).  This rule enforces
-that invariant the same way FP301 enforces failpoint seams:
+per subscriber (`codec.mqtt.DispatchEncoder`).  PERF401 enforces that
+invariant the same way FP301 enforces failpoint seams:
 ``DISPATCH_FUNCS`` declares the dispatch-marked hot-loop functions,
 and any ``serialize(``/``encode(`` call nested inside a loop in one
 of them fires PERF401 — a per-subscriber re-encode sneaking back into
 the fan-out path fails tier-1 instead of silently re-paying the cost
 the window encoder removed.
 
-An intentional in-loop encode (there should be none on the delivery
-path) takes a justified inline ``# brokerlint: ignore[PERF401]``.
-A declared function that no longer exists is itself a finding, so the
+PERF402 guards the other per-delivery cost PR 5 amortized: a clock
+read (``time.time()``/``perf_counter()``/``datetime.now()``-shaped
+call) inside a dispatch-marked loop.  The delivery runs take ONE
+clock read per run (`Session.deliver`'s hoisted ``now``,
+`deliver_run_native`'s bulk `Inflight.insert_run`); a per-iteration
+clock sneaking back in is a finding.
+
+An intentional in-loop call takes a justified inline
+``# brokerlint: ignore[PERF401]`` / ``ignore[PERF402]``.  A declared
+function that no longer exists is itself a finding, so the
 declaration list cannot silently rot.
 """
 
@@ -30,15 +37,26 @@ class DispatchFn(NamedTuple):
 
 
 # the window fan-out hot loops: expansion/grouping, per-client
-# delivery, and the session's packet builder
+# delivery, the session's packet builder, and the native-run fast
+# path (decision scan + block bookkeeping)
 DISPATCH_FUNCS = (
     DispatchFn("emqx_tpu/broker/broker.py", "Broker._dispatch_window"),
     DispatchFn("emqx_tpu/broker/broker.py", "Broker._deliver_run"),
     DispatchFn("emqx_tpu/broker/session.py", "Session.deliver"),
+    DispatchFn("emqx_tpu/broker/session.py", "Session.deliver_run_native"),
+    DispatchFn("emqx_tpu/broker/session.py", "Session.alloc_packet_ids"),
 )
 
 # callee tails that mean "re-encode a wire frame"
 _ENCODE_TAILS = {"serialize", "encode", "encode_publish"}
+
+# callee tails that mean "read a clock" (time module, datetime
+# classmethods, monotonic/perf counters) — once per run, not per
+# delivery (PERF402)
+_CLOCK_TAILS = {
+    "time", "time_ns", "monotonic", "monotonic_ns",
+    "perf_counter", "perf_counter_ns", "now", "utcnow", "today",
+}
 
 
 def _function_map(tree: ast.Module):
@@ -58,10 +76,10 @@ def _function_map(tree: ast.Module):
     return out
 
 
-def _loop_encode_calls(fn: ast.AST) -> List[ast.Call]:
-    """Encode-tailed calls lexically inside a for/while loop of `fn`
-    (nested def/lambda subtrees are pruned: a closure DEFINED in the
-    loop is not a per-subscriber encode)."""
+def _loop_calls(fn: ast.AST, tails) -> List[ast.Call]:
+    """Calls with a callee tail in ``tails`` lexically inside a
+    for/while loop of `fn` (nested def/lambda subtrees are pruned: a
+    closure DEFINED in the loop is not a per-subscriber call)."""
     hits: List[ast.Call] = []
 
     def walk(node: ast.AST, in_loop: bool) -> None:
@@ -75,7 +93,7 @@ def _loop_encode_calls(fn: ast.AST) -> List[ast.Call]:
             if (
                 in_loop
                 and isinstance(child, ast.Call)
-                and call_tail(child) in _ENCODE_TAILS
+                and call_tail(child) in tails
             ):
                 hits.append(child)
             walk(child, child_in_loop)
@@ -101,12 +119,20 @@ def check(ctx: ModuleContext,
                 detail="missing",
             )
             continue
-        for call in _loop_encode_calls(fn):
+        for call in _loop_calls(fn, _ENCODE_TAILS):
             ctx.report(
                 call, "PERF401", d.qualname,
                 f"per-subscriber `{call_tail(call)}(` inside the "
                 f"dispatch hot loop `{d.qualname}` — encode once per "
                 f"window via codec.mqtt.DispatchEncoder instead",
+                detail=call_tail(call),
+            )
+        for call in _loop_calls(fn, _CLOCK_TAILS):
+            ctx.report(
+                call, "PERF402", d.qualname,
+                f"per-delivery clock read `{call_tail(call)}(` inside "
+                f"the dispatch hot loop `{d.qualname}` — read the "
+                f"clock once per run (hoist it above the loop)",
                 detail=call_tail(call),
             )
 
