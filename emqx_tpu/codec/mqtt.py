@@ -1074,6 +1074,11 @@ class DispatchEncoder:
         if self._arena_export is None:
             import ctypes as _ct
 
+            # release-before-growth discipline: `slot_for` drops this
+            # export before ANY arena append, so the pinned pointer
+            # can never observe a resize (NATIVE501 checks callers
+            # hold no stale views across slot misses)
+            # brokerlint: ignore[NATIVE502]
             self._arena_export = (
                 _ct.c_uint8 * len(self.arena)
             ).from_buffer(self.arena) if self.arena else None

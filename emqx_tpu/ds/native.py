@@ -22,6 +22,10 @@ _lib = None
 
 def _build() -> None:
     os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    # one-time toolchain rebuild of a stale .so (dev boxes only;
+    # production loads the checked-in binary) — never on the
+    # steady-state path, so the loop stall is accepted
+    # brokerlint: ignore[ASYNC101]
     subprocess.run(
         [
             "g++",

@@ -488,6 +488,11 @@ class MatchEngine:
 
     def insert(self, flt: str, fid: Hashable) -> None:
         with self._mlock:
+            # _mlock IS the mutation/snapshot serialization for the
+            # native token matrix the call mutates with the GIL
+            # released — holding it across the native span is the
+            # design, not an accident
+            # brokerlint: ignore[LOCK402]
             self._insert_locked(flt, fid)
 
     def insert_many(self, pairs: Sequence[Tuple[str, Hashable]]) -> None:
@@ -520,6 +525,8 @@ class MatchEngine:
                 if prev is not None:
                     if prev == flt:
                         continue
+                    # same _mlock-serializes-the-native-matrix design
+                    # as `insert` # brokerlint: ignore[LOCK402]
                     self._insert_locked(flt, fid)
                     continue
                 if not wild:
@@ -527,6 +534,7 @@ class MatchEngine:
                     self._exact.setdefault(flt, set()).add(fid)
                     continue
                 if len(ws) - (1 if n_hash else 0) > self.max_levels:
+                    # same _mlock design # brokerlint: ignore[LOCK402]
                     self._insert_locked(flt, fid)
                     continue
                 self._by_fid[fid] = flt
@@ -553,6 +561,10 @@ class MatchEngine:
                 if self.background_rebuild:
                     self._start_background_rebuild()
                 else:
+                    # synchronous rebuild variant keeps _mlock across
+                    # the native sort on purpose: mutations must not
+                    # interleave with the table swap
+                    # brokerlint: ignore[LOCK402]
                     self.rebuild()
             if self.use_device is not False and (
                 self._residual_count
@@ -745,6 +757,11 @@ class MatchEngine:
             for i in range(0, len(a), rows_per):
                 parts.append(jax.device_put(a[i:i + rows_per]))
                 if throttle:
+                    # throttled uploads only run on the background
+                    # fold/build threads; the loop-reachable
+                    # _device_tables path passes throttle=False, so
+                    # this sleep never parks the event loop
+                    # brokerlint: ignore[ASYNC101]
                     time.sleep(0.002)
             out.append(jnp.concatenate(parts, axis=0))
         prof = self.profiler
@@ -1796,6 +1813,10 @@ class MatchEngine:
                     lens = np.resize(lens, cap)
                     dol = np.resize(dol, cap)
                     entry[1], entry[2], entry[3] = mat, lens, dol
+                # _enc_mutex exists precisely to serialize the
+                # native dictionary the first-use seeding touches
+                # (see TokenDict.native's race note)
+                # brokerlint: ignore[LOCK402]
                 nat = self._tdict.native()
                 if nat is not None and len(miss_ws) >= 16:
                     # batch the misses through the native tokenizer

@@ -78,7 +78,12 @@ class TokenDict:
                     try:
                         from .tokdict_native import NativeEncoder, load
 
+                        # _nat_lock exists to serialize exactly
+                        # this seeding (two encoders seeded moments
+                        # apart would alias token ids); holding it
+                        # across the GIL-released td_seed IS the point
                         self._native = (
+                            # brokerlint: ignore[LOCK402]
                             NativeEncoder(self._ids)
                             if load() is not None else False
                         )

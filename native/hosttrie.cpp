@@ -22,20 +22,20 @@
 
 namespace {
 
-// heterogeneous string hashing: lookups take string_views of the
-// incoming topic bytes, so the hot path allocates no level strings
-struct SvHash {
-    using is_transparent = void;
-    size_t operator()(std::string_view sv) const noexcept {
-        return std::hash<std::string_view>{}(sv);
-    }
-    size_t operator()(const std::string& s) const noexcept {
-        return std::hash<std::string_view>{}(s);
-    }
-};
+// C++17-portable child lookup: heterogeneous unordered_map find()
+// (P0919) needs libstdc++ >= 11, so lookups go through one
+// thread_local std::string key buffer instead — assign() reuses its
+// capacity, so the hot path still allocates no level strings after
+// warm-up, on every toolchain this repo meets.
+using ChildMap = std::unordered_map<std::string, int32_t>;
 
-using ChildMap =
-    std::unordered_map<std::string, int32_t, SvHash, std::equal_to<>>;
+thread_local std::string tl_key;
+
+static inline ChildMap::iterator find_sv(ChildMap& ch,
+                                         std::string_view sv) {
+    tl_key.assign(sv.data(), sv.size());
+    return ch.find(tl_key);
+}
 
 struct Node {
     ChildMap children;
@@ -101,7 +101,7 @@ static void remove_path(Trie* t, const std::string& flt, int64_t fid) {
     std::vector<int32_t> path;  // nodes along the walk (excluding root)
     int32_t node = 0;
     for (size_t i = 0; i < body; ++i) {
-        auto it = t->nodes[node].children.find(ws[i]);
+        auto it = find_sv(t->nodes[node].children, ws[i]);
         if (it == t->nodes[node].children.end()) return;
         path.push_back(node);
         node = it->second;
@@ -113,7 +113,7 @@ static void remove_path(Trie* t, const std::string& flt, int64_t fid) {
     // prune now-empty nodes bottom-up
     for (size_t i = body; i-- > 0;) {
         int32_t parent = path[i];
-        auto it = t->nodes[parent].children.find(ws[i]);
+        auto it = find_sv(t->nodes[parent].children, ws[i]);
         if (it == t->nodes[parent].children.end()) break;
         int32_t child = it->second;
         if (!t->nodes[child].empty()) break;
@@ -152,7 +152,7 @@ int64_t ht_insert(void* h, const char* flt, int64_t fid) {
     int32_t node = 0;
     for (size_t i = 0; i < body; ++i) {
         auto& ch = t->nodes[node].children;
-        auto cit = ch.find(ws[i]);
+        auto cit = find_sv(ch, ws[i]);
         if (cit == ch.end()) {
             int32_t nn = t->alloc();
             // alloc() may reallocate nodes; re-find the child map
@@ -172,7 +172,7 @@ int64_t ht_insert(void* h, const char* flt, int64_t fid) {
     node = 0;
     t->nodes[0].max_seq = seq;
     for (size_t i = 0; i < body; ++i) {
-        node = t->nodes[node].children.find(ws[i])->second;
+        node = find_sv(t->nodes[node].children, ws[i])->second;
         t->nodes[node].max_seq = seq;
     }
     return seq;
@@ -231,10 +231,10 @@ int64_t ht_match(void* h, const char* topic, int64_t* out, int64_t cap) {
             continue;
         }
         auto& ch = t->nodes[node].children;
-        auto lit = ch.find(name[i]);
+        auto lit = find_sv(ch, name[i]);
         if (lit != ch.end()) stack.emplace_back(lit->second, i + 1);
         if (!(dollar && i == 0)) {
-            auto plus = ch.find(std::string_view("+", 1));
+            auto plus = find_sv(ch, std::string_view("+", 1));
             if (plus != ch.end()) stack.emplace_back(plus->second, i + 1);
         }
     }
@@ -270,11 +270,11 @@ int64_t ht_match_since(void* h, const char* topic, int64_t min_seq,
             continue;
         }
         auto& ch = t->nodes[node].children;
-        auto lit = ch.find(name[i]);
+        auto lit = find_sv(ch, name[i]);
         if (lit != ch.end() && t->nodes[lit->second].max_seq >= min_seq)
             stack.emplace_back(lit->second, i + 1);
         if (!(dollar && i == 0)) {
-            auto plus = ch.find(std::string_view("+", 1));
+            auto plus = find_sv(ch, std::string_view("+", 1));
             if (plus != ch.end() && t->nodes[plus->second].max_seq >= min_seq)
                 stack.emplace_back(plus->second, i + 1);
         }

@@ -9,20 +9,31 @@ The gate is why this lives in tests/: `python -m pytest tests/` and
 `python -m tools.brokerlint` enforce the identical contract (same
 run_lint/diff_baseline code path)."""
 
+import json
+import os
 import subprocess
 import sys
+import time
 from pathlib import Path
 
 from emqx_tpu import failpoints
 from tools.brokerlint import (
-    DEFAULT_BASELINE, DISPATCH_FUNCS, DispatchFn, SEAM_FUNCS, Seam,
-    analyze_source, diff_baseline, load_baseline, run_lint,
+    DEFAULT_BASELINE, DEFAULT_PATHS, DISPATCH_FUNCS, DispatchFn,
+    SEAM_FUNCS, Seam, analyze_program, analyze_source, diff_baseline,
+    load_baseline, run_lint,
 )
 
 
 def rules_of(src, path="fixture.py", seams=(), dispatch=()):
     return [f.rule for f in analyze_source(src, path, seams=seams,
                                            dispatch=dispatch)]
+
+
+def prog_rules(sources, seams=(), dispatch=()):
+    """[(path, rule), ...] over a multi-module fixture tree."""
+    return [(f.path, f.rule) for f in analyze_program(
+        sources, seams=seams, dispatch=dispatch
+    )]
 
 
 # ----------------------------------------------------------- ASYNC101
@@ -542,10 +553,11 @@ def test_perf401_declared_functions_exist_in_repo():
 # ------------------------------------------------------------ the gate
 
 def test_repo_has_no_findings_beyond_baseline():
-    """The tier-1 gate: zero NEW findings over emqx_tpu/, and zero
-    STALE baseline entries (fixed debt must leave the baseline so it
-    only ever shrinks)."""
-    findings = run_lint(["emqx_tpu"])
+    """The tier-1 gate: zero NEW findings over the whole default
+    surface — emqx_tpu/ AND tools/ AND bench.py (the analyzer eats
+    its own dog food) — and zero STALE baseline entries (fixed debt
+    must leave the baseline so it only ever shrinks)."""
+    findings = run_lint(list(DEFAULT_PATHS))
     baseline = load_baseline(DEFAULT_BASELINE)
     new, stale = diff_baseline(findings, baseline)
     assert not new, "new brokerlint findings:\n" + "\n".join(
@@ -555,6 +567,22 @@ def test_repo_has_no_findings_beyond_baseline():
         "stale baseline entries (fixed? remove them):\n"
         + "\n".join(sorted(stale))
     )
+
+
+def test_default_paths_cover_tools_and_bench():
+    assert "tools" in DEFAULT_PATHS and "bench.py" in DEFAULT_PATHS
+
+
+def test_cached_whole_tree_run_stays_fast():
+    """The mtime cache keeps the tier-1 gate cheap: a warm whole-tree
+    run (parse+index cached per file) must finish well under the
+    budget.  Generous bound — the point is catching an accidental
+    O(tree²) regression, not micro-benchmarking."""
+    run_lint(list(DEFAULT_PATHS))  # warm the per-file caches
+    t0 = time.perf_counter()
+    run_lint(list(DEFAULT_PATHS))
+    warm = time.perf_counter() - t0
+    assert warm < 12.0, f"warm whole-tree lint took {warm:.1f}s"
 
 
 def test_baseline_diff_is_count_aware():
@@ -608,3 +636,835 @@ def test_cli_matches_gate():
     out = json.loads(proc.stdout)
     assert out["new"] == []
     assert out["stale_baseline"] == []
+
+
+# ======================================================= interprocedural
+# The PR-7 layer: whole-program call graph (callgraph.py), bottom-up
+# SCC summaries (dataflow.py), and the rule families built on them.
+
+# ------------------------------------------- transitive ASYNC101
+
+def test_async101_transitive_two_levels():
+    """async -> sync helper -> sync helper2 -> time.sleep: invisible
+    to the intra rule, flagged by the summary chain."""
+    src = (
+        "import time\n"
+        "def helper2():\n"
+        "    time.sleep(1)\n"
+        "def helper():\n"
+        "    helper2()\n"
+        "async def f():\n"
+        "    helper()\n"
+    )
+    assert "ASYNC101" in rules_of(src)
+    # each module alone is clean; the PROGRAM is not
+    mods = {
+        "pkg/util.py": (
+            "import time\n"
+            "def helper2():\n"
+            "    time.sleep(1)\n"
+            "def helper():\n"
+            "    helper2()\n"
+        ),
+        "pkg/srv.py": (
+            "from .util import helper\n"
+            "async def f():\n"
+            "    helper()\n"
+        ),
+    }
+    for path, m in mods.items():
+        assert rules_of(m, path=path) == [], path
+    assert ("pkg/srv.py", "ASYNC101") in prog_rules(mods)
+
+
+def test_async101_transitive_base_site_suppression():
+    """An inline ignore at the BLOCKING SITE stops the fact from
+    propagating: one annotation, not one per caller."""
+    src = (
+        "import time\n"
+        "def helper():\n"
+        "    # justified: one-time init\n"
+        "    time.sleep(1)  # brokerlint: ignore[ASYNC101]\n"
+        "async def f():\n"
+        "    helper()\n"
+    )
+    assert "ASYNC101" not in rules_of(src)
+
+
+def test_async101_transitive_call_site_suppression():
+    src = (
+        "import time\n"
+        "def helper():\n"
+        "    time.sleep(1)\n"
+        "async def f():\n"
+        "    helper()  # brokerlint: ignore[ASYNC101]\n"
+    )
+    assert "ASYNC101" not in rules_of(src)
+
+
+def test_async101_sleep_zero_is_gil_yield_not_block():
+    """time.sleep(0) is the GIL-yield idiom (engine chunked copies);
+    neither the intra rule nor the summary counts it."""
+    direct = (
+        "import time\n"
+        "async def f():\n"
+        "    time.sleep(0)\n"
+    )
+    assert "ASYNC101" not in rules_of(direct)
+    via = (
+        "import time\n"
+        "def helper():\n"
+        "    time.sleep(0)\n"
+        "async def f():\n"
+        "    helper()\n"
+    )
+    assert "ASYNC101" not in rules_of(via)
+    # a non-zero sleep still fires both ways
+    assert "ASYNC101" in rules_of(direct.replace("sleep(0)", "sleep(1)"))
+
+
+def test_async101_transitive_async_callee_not_flagged():
+    """Calling an async function only builds a coroutine — the
+    blocking body is the CALLEE's intra finding, not the caller's."""
+    src = (
+        "import time\n"
+        "async def bad():\n"
+        "    time.sleep(1)\n"
+        "async def f():\n"
+        "    await bad()\n"
+    )
+    rules = [x.rule for x in analyze_source(src)]
+    # exactly one ASYNC101 (inside `bad`), not a second at the await
+    assert rules.count("ASYNC101") == 1
+
+
+# ------------------------------------------- transitive DEVICE201/203
+
+_DEV_TREE = {
+    "pkg/kern.py": (
+        "import jax\n"
+        "from .helpers import helper1\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return helper1(x)\n"
+    ),
+    "pkg/helpers.py": (
+        "def helper2(y):\n"
+        "    return y.item()\n"
+        "def helper1(z):\n"
+        "    return helper2(z)\n"
+    ),
+}
+
+
+def test_device201_transitive_two_modules_deep():
+    """The acceptance fixture: a jit-called helper two levels deep
+    (across modules) does a host sync."""
+    for path, m in _DEV_TREE.items():
+        assert rules_of(m, path=path) == [], path  # intra: clean
+    assert ("pkg/kern.py", "DEVICE201") in prog_rules(_DEV_TREE)
+
+
+def test_device203_transitive_param_aware():
+    """np.* on a helper param flags only when the jit call site feeds
+    a TRACED value into THAT param — a trace-time constant does not
+    propagate (parameter-aware taint)."""
+    bad = {
+        "pkg/kern.py": (
+            "import jax\n"
+            "from .helpers import norm\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return norm(x)\n"
+        ),
+        "pkg/helpers.py": (
+            "import numpy as np\n"
+            "def norm(a):\n"
+            "    return np.asarray(a)\n"
+        ),
+    }
+    assert ("pkg/kern.py", "DEVICE203") in prog_rules(bad)
+    # constant fed to the syncing param: no finding
+    ok = dict(bad)
+    ok["pkg/kern.py"] = (
+        "import jax\n"
+        "from .helpers import norm\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x + norm((1, 2))\n"
+    )
+    assert ("pkg/kern.py", "DEVICE203") not in prog_rules(ok)
+    # traced value into an UNRELATED param of a two-param helper:
+    # still no finding (the sync touches only `cfg`)
+    split = {
+        "pkg/kern.py": (
+            "import jax\n"
+            "from .helpers import mix\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return mix((1, 2), x)\n"
+        ),
+        "pkg/helpers.py": (
+            "import numpy as np\n"
+            "def mix(cfg, data):\n"
+            "    return data * np.asarray(cfg)\n"
+        ),
+    }
+    assert ("pkg/kern.py", "DEVICE203") not in prog_rules(split)
+
+
+def test_device_transitive_suppression():
+    sup = dict(_DEV_TREE)
+    sup["pkg/helpers.py"] = (
+        "def helper2(y):\n"
+        "    return y.item()  # brokerlint: ignore[DEVICE201]\n"
+        "def helper1(z):\n"
+        "    return helper2(z)\n"
+    )
+    assert ("pkg/kern.py", "DEVICE201") not in prog_rules(sup)
+
+
+# --------------------------------------------------------- NATIVE501
+
+_ENC = (
+    "class Enc:\n"
+    "    def __init__(self):\n"
+    "        self.arena = bytearray()\n"
+    "    def slot_for(self, m):\n"
+    "        self.arena += m\n"
+    "        return 0\n"
+    "    def native_views(self):\n"
+    "        return ()\n"
+)
+
+
+def test_native501_views_held_across_arena_growth():
+    bad = _ENC + (
+        "def run(enc: \"Enc\", msgs, lib):\n"
+        "    views = enc.native_views()\n"
+        "    for m in msgs:\n"
+        "        enc.slot_for(m)\n"
+        "    lib.da_go(views)\n"
+    )
+    assert "NATIVE501" in rules_of(bad)
+    # views taken AFTER the last slot miss (deliver_run_native shape)
+    ok = _ENC + (
+        "def run(enc: \"Enc\", msgs, lib):\n"
+        "    for m in msgs:\n"
+        "        enc.slot_for(m)\n"
+        "    views = enc.native_views()\n"
+        "    lib.da_go(views)\n"
+    )
+    assert "NATIVE501" not in rules_of(ok)
+    # dead views (no use after the growth) are not a finding
+    dead = _ENC + (
+        "def run(enc: \"Enc\", msgs, lib):\n"
+        "    views = enc.native_views()\n"
+        "    for m in msgs:\n"
+        "        enc.slot_for(m)\n"
+    )
+    assert "NATIVE501" not in rules_of(dead)
+
+
+def test_native501_invalidation_through_helper():
+    """The growth hides one call deep: enc.slot_for reached through a
+    module helper still invalidates the cached views."""
+    bad = _ENC + (
+        "def fill(enc: \"Enc\", msgs):\n"
+        "    for m in msgs:\n"
+        "        enc.slot_for(m)\n"
+        "def run(enc: \"Enc\", msgs, lib):\n"
+        "    views = enc.native_views()\n"
+        "    fill(enc, msgs)\n"
+        "    lib.da_go(views)\n"
+    )
+    assert "NATIVE501" in rules_of(bad)
+
+
+def test_native501_suppression():
+    sup = _ENC + (
+        "def run(enc: \"Enc\", msgs, lib):\n"
+        "    views = enc.native_views()\n"
+        "    for m in msgs:\n"
+        "        enc.slot_for(m)  # brokerlint: ignore[NATIVE501]\n"
+        "    lib.da_go(views)\n"
+    )
+    assert "NATIVE501" not in rules_of(sup)
+
+
+# --------------------------------------------------------- NATIVE502
+
+def test_native502_temp_buffers_at_ctypes_boundary():
+    tmp_ptr = (
+        "import numpy as np\n"
+        "def f(x, p, lib):\n"
+        "    lib.su_go(np.asarray(x).ctypes.data_as(p))\n"
+    )
+    assert "NATIVE502" in rules_of(tmp_ptr)
+    tmp_buf = (
+        "import ctypes\n"
+        "def f(n, lib):\n"
+        "    lib.su_go((ctypes.c_uint8 * n).from_buffer(bytearray(n)))\n"
+    )
+    assert "NATIVE502" in rules_of(tmp_buf)
+    raw_addr = (
+        "def f(arr):\n"
+        "    return arr.ctypes.data\n"
+    )
+    assert "NATIVE502" in rules_of(raw_addr)
+    # the safe shapes: pointer/pin from a bound local
+    ok = (
+        "import ctypes\n"
+        "import numpy as np\n"
+        "def f(x, p, lib):\n"
+        "    a = np.asarray(x)\n"
+        "    out = bytearray(8)\n"
+        "    lib.su_go(a.ctypes.data_as(p),\n"
+        "              (ctypes.c_uint8 * len(out)).from_buffer(out))\n"
+    )
+    assert "NATIVE502" not in rules_of(ok)
+
+
+def test_native502_resizable_arena_export_needs_justification():
+    bad = (
+        "import ctypes\n"
+        "class Enc:\n"
+        "    def export(self):\n"
+        "        return (ctypes.c_uint8 * 4).from_buffer(self.arena)\n"
+    )
+    assert "NATIVE502" in rules_of(bad)
+    sup = bad.replace(
+        "return (ctypes.c_uint8 * 4).from_buffer(self.arena)",
+        "# release-before-growth\n"
+        "        # brokerlint: ignore[NATIVE502]\n"
+        "        return (ctypes.c_uint8 * 4).from_buffer(self.arena)",
+    )
+    assert "NATIVE502" not in rules_of(sup)
+
+
+# ----------------------------------------------------------- LOCK401
+
+_LOCKS_MOD = (
+    "import threading\n"
+    "la = threading.Lock()\n"
+    "lb = threading.Lock()\n"
+)
+
+
+def test_lock401_cross_module_inversion():
+    """The acceptance fixture: two modules acquire the same pair of
+    locks in opposite order — flagged at both edges."""
+    mods = {
+        "pkg/locks.py": _LOCKS_MOD,
+        "pkg/m1.py": (
+            "from .locks import la, lb\n"
+            "def f():\n"
+            "    with la:\n"
+            "        with lb:\n"
+            "            pass\n"
+        ),
+        "pkg/m2.py": (
+            "from .locks import la, lb\n"
+            "def g():\n"
+            "    with lb:\n"
+            "        with la:\n"
+            "            pass\n"
+        ),
+    }
+    got = prog_rules(mods)
+    assert ("pkg/m1.py", "LOCK401") in got
+    assert ("pkg/m2.py", "LOCK401") in got
+    # consistent order everywhere: clean
+    ok = dict(mods)
+    ok["pkg/m2.py"] = ok["pkg/m1.py"].replace("def f", "def g")
+    assert not [r for r in prog_rules(ok) if r[1] == "LOCK401"]
+
+
+def test_lock401_inversion_through_callee():
+    """One side of the cycle hides inside a called function: the
+    callee's `acquires` summary closes the loop."""
+    mods = {
+        "pkg/locks.py": _LOCKS_MOD,
+        "pkg/m1.py": (
+            "from .locks import la, lb\n"
+            "def inner():\n"
+            "    with lb:\n"
+            "        pass\n"
+            "def f():\n"
+            "    with la:\n"
+            "        inner()\n"
+        ),
+        "pkg/m2.py": (
+            "from .locks import la, lb\n"
+            "def g():\n"
+            "    with lb:\n"
+            "        with la:\n"
+            "            pass\n"
+        ),
+    }
+    got = prog_rules(mods)
+    assert ("pkg/m1.py", "LOCK401") in got
+    assert ("pkg/m2.py", "LOCK401") in got
+
+
+def test_lock401_suppression():
+    mods = {
+        "pkg/locks.py": _LOCKS_MOD,
+        "pkg/m1.py": (
+            "from .locks import la, lb\n"
+            "def f():\n"
+            "    with la:\n"
+            "        # brokerlint: ignore[LOCK401]\n"
+            "        with lb:\n"
+            "            pass\n"
+        ),
+        "pkg/m2.py": (
+            "from .locks import la, lb\n"
+            "def g():\n"
+            "    with lb:\n"
+            "        # brokerlint: ignore[LOCK401]\n"
+            "        with la:\n"
+            "            pass\n"
+        ),
+    }
+    assert not [r for r in prog_rules(mods) if r[1] == "LOCK401"]
+
+
+# ----------------------------------------------------------- LOCK402
+
+def test_lock402_lock_across_native_call():
+    direct = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def f(self, lib, x):\n"
+        "        with self._lock:\n"
+        "            lib.td_add(x)\n"
+    )
+    assert "LOCK402" in rules_of(direct)
+    # one helper deep: the callee's `native` summary carries it
+    via = (
+        "import threading\n"
+        "def _go(lib, x):\n"
+        "    lib.td_add(x)\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def f(self, lib, x):\n"
+        "        with self._lock:\n"
+        "            _go(lib, x)\n"
+    )
+    assert "LOCK402" in rules_of(via)
+    # native call OUTSIDE the lock: clean
+    ok = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def f(self, lib, x):\n"
+        "        with self._lock:\n"
+        "            n = x + 1\n"
+        "        lib.td_add(n)\n"
+    )
+    assert "LOCK402" not in rules_of(ok)
+    sup = direct.replace(
+        "lib.td_add(x)",
+        "lib.td_add(x)  # brokerlint: ignore[LOCK402]",
+    )
+    assert "LOCK402" not in rules_of(sup)
+
+
+def test_lock402_transitive_io_await_beyond_async103():
+    """The awaited helper's helper does the IO — one level past what
+    ASYNC103's class-blind map resolves, so LOCK402 reports it (and
+    ASYNC103 does not double-report)."""
+    mods = {
+        "pkg/io2.py": (
+            "import asyncio\n"
+            "async def dial():\n"
+            "    await asyncio.open_connection('h', 1)\n"
+        ),
+        "pkg/io1.py": (
+            "from .io2 import dial\n"
+            "async def ensure():\n"
+            "    await dial()\n"
+        ),
+        "pkg/srv.py": (
+            "from .io1 import ensure\n"
+            "class C:\n"
+            "    async def send(self):\n"
+            "        async with self._lock:\n"
+            "            await ensure()\n"
+        ),
+    }
+    got = prog_rules(mods)
+    assert ("pkg/srv.py", "LOCK402") in got
+    assert ("pkg/srv.py", "ASYNC103") not in got
+
+
+def test_lock402_sync_with_lock_across_io_await():
+    """A sync `with` lock wrapping an IO await is invisible to
+    ASYNC103 (which only sees async-with) — LOCK402's beat."""
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    async def send(self, w):\n"
+        "        with self._lock:\n"
+        "            await w.drain()\n"
+    )
+    got = rules_of(src)
+    assert "LOCK402" in got and "ASYNC103" not in got
+
+
+def test_lock402_does_not_double_report_async103_territory():
+    """Direct lock-across-IO in an async-with belongs to ASYNC103
+    alone."""
+    src = (
+        "import asyncio\n"
+        "class C:\n"
+        "    async def send(self, w):\n"
+        "        async with self._lock:\n"
+        "            await w.drain()\n"
+    )
+    got = rules_of(src)
+    assert got.count("ASYNC103") == 1 and "LOCK402" not in got
+
+
+# ----------------------------------------------------------- LOCK403
+
+_DUAL = (
+    "import threading\n"
+    "class C:\n"
+    "    def __init__(self):\n"
+    "        self._state_lock = threading.Lock()\n"
+    "    def worker(self):\n"
+    "        with self._state_lock:\n"
+    "            pass\n"
+    "    async def on_loop(self):\n"
+    "        with self._state_lock:\n"
+    "            pass\n"
+)
+
+
+def test_lock403_dual_context_lock():
+    assert "LOCK403" in rules_of(_DUAL)
+    # one context only: clean
+    sync_only = _DUAL.replace("async def on_loop", "def on_loop")
+    assert "LOCK403" not in rules_of(sync_only)
+
+
+def test_lock403_ownership_comment_documents():
+    doc = _DUAL.replace(
+        "    async def on_loop(self):\n"
+        "        with self._state_lock:\n",
+        "    async def on_loop(self):\n"
+        "        # lock-ownership: loop reads, worker writes; held\n"
+        "        # for O(1) dict ops only\n"
+        "        with self._state_lock:\n",
+    )
+    assert "LOCK403" not in rules_of(doc)
+    sup = _DUAL.replace(
+        "    async def on_loop(self):\n"
+        "        with self._state_lock:\n",
+        "    async def on_loop(self):\n"
+        "        # brokerlint: ignore[LOCK403]\n"
+        "        with self._state_lock:\n",
+    )
+    assert "LOCK403" not in rules_of(sup)
+
+
+# ------------------------------------------------- call-graph layer
+
+def test_callgraph_cycle_summaries_converge():
+    """Mutual recursion: the SCC fixpoint terminates and both
+    members carry the blocking fact."""
+    src = (
+        "import time\n"
+        "def even(n):\n"
+        "    time.sleep(1)\n"
+        "    return n == 0 or odd(n - 1)\n"
+        "def odd(n):\n"
+        "    return n != 0 and even(n - 1)\n"
+        "async def f():\n"
+        "    odd(3)\n"
+        "    even(2)\n"
+    )
+    rules = [x.rule for x in analyze_source(src)]
+    # both call sites flagged: the fact crossed the cycle both ways
+    assert rules.count("ASYNC101") == 2
+
+
+def test_callgraph_one_level_aliasing():
+    """`h = self._m; h()`, `self.x = self._m; self.x()`, and
+    functools.partial all resolve to the method."""
+    alias_local = (
+        "import time\n"
+        "class C:\n"
+        "    def _m(self):\n"
+        "        time.sleep(1)\n"
+        "    async def f(self):\n"
+        "        h = self._m\n"
+        "        h()\n"
+    )
+    assert "ASYNC101" in rules_of(alias_local)
+    alias_attr = (
+        "import time\n"
+        "class C:\n"
+        "    def _m(self):\n"
+        "        time.sleep(1)\n"
+        "    def __init__(self):\n"
+        "        self.cb = self._m\n"
+        "    async def f(self):\n"
+        "        self.cb()\n"
+    )
+    assert "ASYNC101" in rules_of(alias_attr)
+    partial = (
+        "import time\n"
+        "from functools import partial\n"
+        "def _m(flag):\n"
+        "    time.sleep(1)\n"
+        "go = partial(_m, True)\n"
+        "async def f():\n"
+        "    go()\n"
+    )
+    assert "ASYNC101" in rules_of(partial)
+
+
+def test_callgraph_mtime_cache_invalidation(tmp_path):
+    from tools.brokerlint import callgraph
+
+    p = tmp_path / "mod.py"
+    p.write_text("def one():\n    return 1\n")
+    idx1 = callgraph.index_file(str(p), "mod.py")
+    assert "one" in idx1.funcs
+    # unchanged (mtime, size): the SAME index object comes back
+    assert callgraph.index_file(str(p), "mod.py") is idx1
+    # edit the file (force a distinct mtime even on coarse clocks)
+    p.write_text("def two():\n    return 2\n")
+    st = p.stat()
+    os.utime(p, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+    idx2 = callgraph.index_file(str(p), "mod.py")
+    assert idx2 is not idx1
+    assert "two" in idx2.funcs and "one" not in idx2.funcs
+
+
+def test_callgraph_intra_clean_interprocedural_dirty():
+    """The acceptance fixture tree: every module passes the
+    intra-function pass alone, and the program pass finds NATIVE,
+    DEVICE and ASYNC violations across the seams."""
+    mods = {
+        "pkg/enc.py": (
+            "class Enc:\n"
+            "    def __init__(self):\n"
+            "        self.arena = bytearray()\n"
+            "    def slot_for(self, m):\n"
+            "        self.arena += m\n"
+            "        return 0\n"
+            "    def native_views(self):\n"
+            "        return ()\n"
+        ),
+        "pkg/disp.py": (
+            "from .enc import Enc\n"
+            "def run(enc: \"Enc\", msgs, lib):\n"
+            "    views = enc.native_views()\n"
+            "    for m in msgs:\n"
+            "        enc.slot_for(m)\n"
+            "    lib.da_go(views)\n"
+        ),
+        "pkg/kern.py": (
+            "import jax\n"
+            "from .helpers import helper1\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return helper1(x)\n"
+        ),
+        "pkg/helpers.py": (
+            "import time\n"
+            "def helper2(y):\n"
+            "    return y.item()\n"
+            "def helper1(z):\n"
+            "    return helper2(z)\n"
+            "def slow():\n"
+            "    time.sleep(1)\n"
+        ),
+        "pkg/srv.py": (
+            "from .helpers import slow\n"
+            "async def handle():\n"
+            "    slow()\n"
+        ),
+    }
+    for path, m in mods.items():
+        assert rules_of(m, path=path) == [], path
+    got = prog_rules(mods)
+    assert ("pkg/disp.py", "NATIVE501") in got
+    assert ("pkg/kern.py", "DEVICE201") in got
+    assert ("pkg/srv.py", "ASYNC101") in got
+
+
+# ------------------------------------- suppression: decorated defs
+
+def test_suppression_on_decorator_line(monkeypatch):
+    """FP301 reports at the (decorated) function: an ignore on the
+    decorator line, or a comment line above the decorator, must
+    attach to the function's findings."""
+    on_dec = (
+        "def deco(f):\n"
+        "    return f\n"
+        "class C:\n"
+        "    @deco  # brokerlint: ignore[FP301]\n"
+        "    async def send(self):\n"
+        "        return 1\n"
+    )
+    assert "FP301" not in rules_of(on_dec, path="pkg/mod.py",
+                                   seams=_SEAM)
+    above_dec = (
+        "def deco(f):\n"
+        "    return f\n"
+        "class C:\n"
+        "    # justified: seam evaluated by the wrapper\n"
+        "    # brokerlint: ignore[FP301]\n"
+        "    @deco\n"
+        "    async def send(self):\n"
+        "        return 1\n"
+    )
+    assert "FP301" not in rules_of(above_dec, path="pkg/mod.py",
+                                   seams=_SEAM)
+    # an unrelated rule's ignore on the decorator does NOT silence it
+    wrong = on_dec.replace("ignore[FP301]", "ignore[ASYNC101]")
+    assert "FP301" in rules_of(wrong, path="pkg/mod.py", seams=_SEAM)
+
+
+def test_suppression_on_multiline_def_header():
+    """The ignore sits on the closing-paren line of a long signature;
+    the finding line is the `def` line — it must still attach."""
+    src = (
+        "class C:\n"
+        "    async def send(\n"
+        "        self,\n"
+        "        payload,\n"
+        "    ):  # brokerlint: ignore[FP301]\n"
+        "        return 1\n"
+    )
+    assert "FP301" not in rules_of(src, path="pkg/mod.py",
+                                   seams=_SEAM)
+
+
+# --------------------------------------------------- CLI round-trips
+
+def test_cli_sarif_output():
+    repo = Path(__file__).resolve().parents[1]
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.brokerlint", "--sarif"],
+        cwd=repo, capture_output=True, text=True, timeout=240,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "brokerlint"
+    # the tree is clean, so results must be empty — and the schema
+    # shape stable
+    assert isinstance(run["results"], list)
+
+
+def test_cli_changed_mode(tmp_path):
+    """--changed REF lints the whole program but reports only files
+    changed vs the ref: with a clean tree vs HEAD there can be no
+    findings at all, and the flag must round-trip exit 0."""
+    repo = Path(__file__).resolve().parents[1]
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.brokerlint",
+         "--changed", "HEAD", "--json"],
+        cwd=repo, capture_output=True, text=True, timeout=240,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout)
+    assert out["new"] == []
+    # every reported finding (if any) names a changed .py file
+    changed = subprocess.run(
+        ["git", "diff", "--name-only", "HEAD", "--"],
+        cwd=repo, capture_output=True, text=True, timeout=30,
+    ).stdout.split()
+    untracked = subprocess.run(
+        ["git", "ls-files", "--others", "--exclude-standard"],
+        cwd=repo, capture_output=True, text=True, timeout=30,
+    ).stdout.split()
+    allowed = set(changed) | set(untracked)
+    for f in out["findings"]:
+        assert f["path"] in allowed, f
+
+
+def test_native501_rebind_after_misses_is_clean():
+    """The remediation the rule message recommends — re-take the
+    views into the SAME local after the last slot miss — must not
+    itself trigger the finding (a rebind ends the previous window)."""
+    ok = _ENC + (
+        "def run(enc: \"Enc\", msgs, lib):\n"
+        "    views = enc.native_views()\n"
+        "    for m in msgs:\n"
+        "        enc.slot_for(m)\n"
+        "    views = enc.native_views()\n"
+        "    lib.da_go(views)\n"
+    )
+    assert "NATIVE501" not in rules_of(ok)
+    # ... but a USE of the stale binding before the rebind still fires
+    bad = _ENC + (
+        "def run(enc: \"Enc\", msgs, lib):\n"
+        "    views = enc.native_views()\n"
+        "    for m in msgs:\n"
+        "        enc.slot_for(m)\n"
+        "    lib.da_go(views)\n"
+        "    views = enc.native_views()\n"
+        "    lib.da_go(views)\n"
+    )
+    assert "NATIVE501" in rules_of(bad)
+
+
+def test_write_baseline_ignores_changed_filter(tmp_path):
+    """--changed --write-baseline must write the UNFILTERED run: the
+    filter scopes the report, never the baseline (a truncated rewrite
+    would drop every unchanged file's accepted entries)."""
+    repo = Path(__file__).resolve().parents[1]
+    out = tmp_path / "baseline.txt"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.brokerlint",
+         "--changed", "HEAD", "--write-baseline",
+         "--baseline", str(out)],
+        cwd=repo, capture_output=True, text=True, timeout=240,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    full = subprocess.run(
+        [sys.executable, "-m", "tools.brokerlint",
+         "--write-baseline", "--baseline", str(tmp_path / "b2.txt")],
+        cwd=repo, capture_output=True, text=True, timeout=240,
+    )
+    assert full.returncode == 0
+    entries = [l for l in out.read_text().splitlines()
+               if l.strip() and not l.startswith("#")]
+    entries2 = [l for l in (tmp_path / "b2.txt").read_text()
+                .splitlines() if l.strip() and not l.startswith("#")]
+    assert entries == entries2
+
+
+def test_device_transitive_class_qualified_call_mapping():
+    """`Cls.m(obj, x)` carries the receiver IN call.args — the taint
+    mapping must not shift positions as if it were a bound call
+    (receiver-in-args vs `obj.m(x)`)."""
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "class Helper:\n"
+        "    def compute(self, v):\n"
+        "        return np.asarray(v)\n"
+        "@jax.jit\n"
+        "def f(x, h):\n"
+        "    return Helper.compute(h, 0.0)\n"
+    )
+    # only a static 0.0 feeds the syncing param `v`: clean
+    assert "DEVICE203" not in rules_of(src)
+    # traced x into `v` through the class-qualified call: finding
+    bad = src.replace("Helper.compute(h, 0.0)", "Helper.compute(h, x)")
+    assert "DEVICE203" in rules_of(bad)

@@ -195,3 +195,21 @@ def test_native_trie_large_matchset_grows_buffer():
         native.insert("big/#", i)
     got = native.match("big/one/two")
     assert got == set(range(5000))
+
+
+def test_make_trie_python_fallback(monkeypatch):
+    """The Python HostTrie serves when the native lib is unavailable
+    (kill switch or failed build) — the fallback path must survive
+    the C++17 rewrite making the native trie available everywhere."""
+    from emqx_tpu.ops import trie_native
+    from emqx_tpu.ops.trie_host import HostTrie
+
+    monkeypatch.setenv("EMQX_TPU_NO_NATIVE_TRIE", "1")
+    t = trie_native.make_trie()
+    assert isinstance(t, HostTrie)
+    t.insert("a/+/c", "f1")
+    t.insert("a/#", "f2")
+    assert t.match("a/b/c") == {"f1", "f2"}
+    monkeypatch.delenv("EMQX_TPU_NO_NATIVE_TRIE")
+    if trie_native.load() is not None:
+        assert not isinstance(trie_native.make_trie(), HostTrie)

@@ -2,20 +2,22 @@
 
 Rule families: async-concurrency (ASYNC1xx), device-purity
 (DEVICE2xx), failpoint-coverage (FP301), dispatch-perf
-(PERF401/PERF402).
+(PERF401/PERF402), native buffer-lifetime (NATIVE5xx), lock
+discipline (LOCK4xx).  ASYNC101 and DEVICE201/203 also run
+transitively over the resolved call graph (callgraph.py/dataflow.py).
 Run as a tier-1 gate by tests/test_lint.py and standalone via
 ``python -m tools.brokerlint``.
 """
 
 from .engine import (
-    DEFAULT_BASELINE, DEFAULT_PATHS, Finding, analyze_source,
-    diff_baseline, load_baseline, run_lint,
+    DEFAULT_BASELINE, DEFAULT_PATHS, Finding, analyze_program,
+    analyze_source, diff_baseline, load_baseline, run_lint,
 )
 from .failpointrules import SEAM_FUNCS, Seam
 from .perfrules import DISPATCH_FUNCS, DispatchFn
 
 __all__ = [
     "DEFAULT_BASELINE", "DEFAULT_PATHS", "DISPATCH_FUNCS",
-    "DispatchFn", "Finding", "SEAM_FUNCS", "Seam", "analyze_source",
-    "diff_baseline", "load_baseline", "run_lint",
+    "DispatchFn", "Finding", "SEAM_FUNCS", "Seam", "analyze_program",
+    "analyze_source", "diff_baseline", "load_baseline", "run_lint",
 ]

@@ -1,38 +1,113 @@
 """CLI: ``python -m tools.brokerlint [paths...] [--baseline F]
-[--json] [--write-baseline]``.
+[--json | --sarif] [--changed [REF]] [--write-baseline]``.
 
 Exit codes: 0 clean (baselined findings and stale entries are
 reported but don't fail), 1 on any NEW finding — identical behavior
 to the tier-1 pytest gate (tests/test_lint.py), which calls the same
-`run_lint`/`diff_baseline`.
+`run_lint`/`diff_baseline` code path.
+
+``--changed [REF]`` lints the whole default surface (the
+interprocedural pass needs the full program for correct summaries)
+but only REPORTS findings in files changed vs the git ref (default
+HEAD) — the editor/pre-push fast path.  ``--sarif`` emits SARIF
+2.1.0 for editor and CI annotation consumers.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
+from pathlib import Path
 
 from .engine import (
     DEFAULT_BASELINE, DEFAULT_PATHS, diff_baseline, load_baseline,
     run_lint,
 )
 
+_REPO = Path(__file__).resolve().parents[2]
+
+
+def _changed_files(ref: str) -> set:
+    """Repo-relative posix paths of .py files changed vs `ref`
+    (committed + staged + worktree), plus untracked ones."""
+    out = set()
+    for args in (
+        ["git", "diff", "--name-only", ref, "--"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        proc = subprocess.run(
+            args, cwd=_REPO, capture_output=True, text=True,
+            timeout=30,
+        )
+        if proc.returncode != 0:
+            raise SystemExit(
+                f"brokerlint: git failed: {proc.stderr.strip()}"
+            )
+        out.update(
+            line.strip() for line in proc.stdout.splitlines()
+            if line.strip().endswith(".py")
+        )
+    return out
+
+
+def _sarif(findings, new_fps) -> dict:
+    rules = sorted({f.rule for f in findings})
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0"
+                    ".json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "brokerlint",
+                "informationUri":
+                    "tools/brokerlint (repo-local analyzer)",
+                "rules": [{"id": r} for r in rules],
+            }},
+            "results": [{
+                "ruleId": f.rule,
+                "level": ("error" if f.fingerprint in new_fps
+                          else "note"),
+                "message": {"text": f"[{f.qualname}] {f.message}"},
+                "locations": [{
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f.path},
+                        "region": {"startLine": f.line},
+                    },
+                }],
+                "fingerprints": {"brokerlint/v1": f.fingerprint},
+            } for f in findings],
+        }],
+    }
+
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.brokerlint",
         description="repo-aware AST lint: async-race, device-purity, "
-                    "failpoint-coverage",
+                    "failpoint-coverage, dispatch-perf, native "
+                    "buffer-lifetime, lock discipline "
+                    "(interprocedural)",
     )
     ap.add_argument("paths", nargs="*", default=list(DEFAULT_PATHS),
-                    help="files/dirs to lint (default: emqx_tpu/)")
+                    help="files/dirs to lint (default: emqx_tpu/ "
+                         "tools/ bench.py)")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
                     help="baseline file of accepted fingerprints")
     ap.add_argument("--no-baseline", action="store_true",
                     help="report every finding, ignore the baseline")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable output")
+    ap.add_argument("--sarif", action="store_true",
+                    help="SARIF 2.1.0 output (editor/CI annotations)")
+    ap.add_argument("--changed", nargs="?", const="HEAD",
+                    default=None, metavar="REF",
+                    help="only report findings in files changed vs "
+                         "REF (default HEAD); the whole program is "
+                         "still indexed for interprocedural "
+                         "summaries")
     ap.add_argument("--write-baseline", action="store_true",
                     help="rewrite the baseline file from this run "
                          "(each entry still deserves a justification "
@@ -40,23 +115,39 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     findings = run_lint(args.paths or list(DEFAULT_PATHS))
+    all_findings = findings
+    if args.changed is not None:
+        changed = _changed_files(args.changed)
+        findings = [f for f in findings if f.path in changed]
     baseline = set() if args.no_baseline else load_baseline(
         args.baseline
     )
-    new, stale = diff_baseline(findings, baseline)
+    # staleness is a whole-run property: diff against the UNFILTERED
+    # findings so --changed never misreports unchanged files' baseline
+    # entries as stale; only the NEW list is scoped to the filter
+    new, stale = diff_baseline(all_findings, baseline)
+    if args.changed is not None:
+        new = [f for f in new if f.path in changed]
 
     if args.write_baseline:
+        # ALWAYS write the unfiltered run: a --changed filter must
+        # never truncate the baseline's entries for unchanged files
         with open(args.baseline, "w") as f:
             f.write("# brokerlint baseline — accepted pre-existing "
                     "findings (burn these down).\n"
                     "# One fingerprint per line; '#' comments hold "
                     "the justification.\n")
-            for fi in sorted(findings, key=lambda x: x.fingerprint):
+            for fi in sorted(all_findings,
+                             key=lambda x: x.fingerprint):
                 f.write(fi.fingerprint + "\n")
-        print(f"wrote {len(findings)} entries to {args.baseline}")
+        print(f"wrote {len(all_findings)} entries to {args.baseline}")
         return 0
 
-    if args.as_json:
+    if args.sarif:
+        print(json.dumps(
+            _sarif(findings, {f.fingerprint for f in new}), indent=1
+        ))
+    elif args.as_json:
         print(json.dumps({
             "findings": [f.as_dict() for f in findings],
             "new": [f.as_dict() for f in new],

@@ -56,6 +56,20 @@ _STOPPISH = ("stop", "close", "shutdown", "aclose", "terminate")
 _LOCKISH = ("lock", "sem", "cond", "mutex")
 
 
+def is_blocking_call(name: str, node: ast.Call) -> bool:
+    """Event-loop-blocking callee?  ``time.sleep(0)`` — the literal
+    GIL-yield idiom the engine's chunked copies use — is NOT a block:
+    it never parks the thread, it only lets another one run."""
+    if not (name in _BLOCKING_EXACT
+            or name.startswith(_BLOCKING_PREFIXES)):
+        return False
+    if name == "time.sleep" and len(node.args) == 1 and isinstance(
+        node.args[0], ast.Constant
+    ) and node.args[0].value == 0:
+        return False
+    return True
+
+
 def _is_stop_path(name: str) -> bool:
     low = name.lower()
     return any(s in low for s in _STOPPISH)
@@ -115,9 +129,7 @@ class _AsyncVisitor(ast.NodeVisitor):
     def visit_Call(self, node: ast.Call) -> None:
         if self.in_async:
             name = dotted_name(node.func)
-            if name in _BLOCKING_EXACT or name.startswith(
-                _BLOCKING_PREFIXES
-            ):
+            if is_blocking_call(name, node):
                 self.ctx.report(
                     node, "ASYNC101", self.qualname,
                     f"blocking call `{name}` inside async function "
@@ -252,4 +264,38 @@ def check(ctx: ModuleContext) -> None:
     _AsyncVisitor(ctx).visit(ctx.tree)
 
 
-__all__ = ["check", "IO_AWAIT_NAMES"]
+def check_program(program, summaries, ctxs) -> None:
+    """Transitive ASYNC101: a plain call from ``async def`` to a SYNC
+    function whose summary (transitively, through the resolved call
+    graph) executes a blocking call.  The intra-function rule sees
+    ``time.sleep`` in the async body; this one sees
+    ``self._helper()`` → ``helper2()`` → ``subprocess.run`` across
+    modules.  A justified inline ignore at the BLOCKING SITE stops
+    the fact from propagating at the source (one annotation instead
+    of one per caller)."""
+    for fn in program.functions():
+        if not fn.is_async:
+            continue
+        ctx = ctxs.get(fn.module.path)
+        if ctx is None:
+            continue
+        for call, callee in program.callees(fn):
+            if callee.is_async:
+                continue  # calling an async fn only builds a coroutine
+            s = summaries.get(callee.key)
+            if s is None or s.blocks is None:
+                continue
+            bname, via = s.blocks
+            chain = f"{callee.name} -> {via}" if via else callee.name
+            ctx.report(
+                call, "ASYNC101", fn.qualname,
+                f"`{callee.name}()` transitively executes blocking "
+                f"`{bname}` (via `{chain}`) inside async function — "
+                f"stalls the event loop; offload to an executor, "
+                f"make the chain async, or justify with an inline "
+                f"ignore at the blocking site",
+                detail=f"via:{callee.name}:{bname}",
+            )
+
+
+__all__ = ["check", "check_program", "IO_AWAIT_NAMES"]

@@ -1,0 +1,480 @@
+"""Forward dataflow over the resolved call graph: per-function
+summaries computed bottom-up over SCCs.
+
+Each function gets one ``FnSummary`` describing the facts the
+interprocedural rules consume:
+
+  * ``blocks``       — calling this SYNC function (transitively)
+                       executes an event-loop-blocking call
+                       (``time.sleep``, sync subprocess/socket/HTTP);
+                       feeds the transitive ASYNC101 upgrade.
+  * ``awaits_io``    — awaiting this ASYNC function (transitively)
+                       performs IO; feeds LOCK402 and the
+                       interprocedural ASYNC103 generalization.
+  * ``sync_always``  — this function (transitively) host-syncs
+                       unconditionally (``.item()``/``.tolist()``);
+                       feeds transitive DEVICE201.
+  * ``sync_traced``  — this function host-syncs IF a traced value
+                       flows into it (``float(x)``/``np.f(x)`` on a
+                       parameter-derived value); feeds transitive
+                       DEVICE201/203 — the caller side checks that the
+                       jit call site actually passes a traced arg.
+  * ``invalidates``  — this function (transitively) grows or clears
+                       an encoder ``arena`` buffer, which dangles any
+                       cached ``native_views``/``span_arrays`` ctypes
+                       pointer (NATIVE501).
+  * ``native``       — this function (transitively) enters a
+                       GIL-released native entry point
+                       (``da_``/``ht_``/``td_``/``su_``/``dslog_``
+                       C-ABI symbols); feeds LOCK402.
+  * ``acquires``     — normalized lock tokens this function
+                       (transitively) acquires; feeds the LOCK401
+                       lock-order graph.
+
+Facts are monotone (None -> value, sets grow), so mutual recursion
+converges: Tarjan emits SCCs callee-first and each SCC iterates to a
+fixpoint before its callers are summarized.  Base facts respect inline
+``# brokerlint: ignore[RULE]`` suppressions at their site — a
+justified blocking call in a loader does not poison every transitive
+caller.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from . import callgraph
+from .asyncrules import _is_lockish, is_blocking_call
+from .devicerules import _CASTS, _Staticness
+from .engine import awaits_io, call_tail, dotted_name
+
+Key = Tuple[str, str]  # (path, qualname)
+
+
+@dataclass
+class FnSummary:
+    blocks: Optional[Tuple[str, str]] = None       # (name, via)
+    awaits_io: Optional[Tuple[str, str]] = None    # (name, via)
+    sync_always: Optional[Tuple[str, str, str]] = None  # (rule, name, via)
+    sync_traced: Optional[Tuple[str, str, str]] = None  # (rule, name, via)
+    # the function's OWN param names that feed the sync_traced site
+    # (parameter-aware taint: a constant fed to them does not sync)
+    sync_traced_params: Tuple[str, ...] = ()
+    invalidates: Optional[str] = None              # site token
+    native: Optional[str] = None                   # entry name
+    acquires: Set[str] = field(default_factory=set)
+    # does the BODY contain a token-resolved lock acquisition?  (the
+    # lock rules skip their held-walk for lock-free functions)
+    has_lock_ctx: bool = False
+
+
+# ----------------------------------------------------------- helpers
+
+def walk_pruned(fn_node: ast.AST) -> Iterable[ast.AST]:
+    """Walk a function body, skipping nested def/lambda subtrees
+    (they are their own functions and must not leak facts)."""
+    stack: List[ast.AST] = [fn_node]
+    while stack:
+        node = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            yield child
+            stack.append(child)
+
+
+def awaited_calls(fn_node: ast.AST) -> Set[int]:
+    """id()s of Call nodes that execute under an ``await`` (directly
+    or nested in the awaited expression, e.g.
+    ``await wait_for(self._io(), 2)``)."""
+    out: Set[int] = set()
+    for node in walk_pruned(fn_node):
+        if isinstance(node, ast.Await):
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Call):
+                    out.add(id(sub))
+    return out
+
+
+def traced_params(fn_node: ast.AST) -> Set[str]:
+    args = fn_node.args
+    return {
+        a.arg for a in (args.posonlyargs + args.args + args.kwonlyargs)
+    } - {"self", "cls"}
+
+
+def flow_params(call: ast.Call, callee: callgraph.FuncInfo,
+                target_params: Tuple[str, ...],
+                static_cls: _Staticness) -> Optional[Set[str]]:
+    """Parameter-aware taint step: does this call feed a NON-STATIC
+    (caller-traced) value into any of the callee's `target_params`?
+    Returns the caller-side names appearing in the feeding
+    expressions (for the caller's own summary), or None when only
+    static values flow — ``helper(self.where, cols)`` does not
+    propagate a sync that only touches ``where``.  Falls back to
+    every argument when the call uses *args/**kwargs or the targets
+    are unknown."""
+    args = callee.node.args
+    pos = [a.arg for a in (args.posonlyargs + args.args)]
+    # bound-method calls (`obj.m(x)`) don't carry the receiver in
+    # call.args; class-qualified calls (`Cls.m(obj, x)`) DO — detect
+    # the latter by the receiver naming the callee's own class
+    bound = isinstance(call.func, ast.Attribute) and not (
+        isinstance(call.func.value, ast.Name)
+        and callee.cls is not None
+        and call.func.value.id == callee.cls
+    )
+    offset = 1 if pos and pos[0] in ("self", "cls") and bound else 0
+    exprs: List[ast.expr] = []
+    unmappable = (
+        not target_params
+        or any(isinstance(a, ast.Starred) for a in call.args)
+        or any(kw.arg is None for kw in call.keywords)
+    )
+    if unmappable:
+        exprs = list(call.args) + [kw.value for kw in call.keywords]
+    else:
+        for p in target_params:
+            e: Optional[ast.expr] = None
+            for kw in call.keywords:
+                if kw.arg == p:
+                    e = kw.value
+                    break
+            if e is None and p in pos:
+                i = pos.index(p) - offset
+                if 0 <= i < len(call.args):
+                    e = call.args[i]
+            if e is not None:
+                exprs.append(e)
+    traced = [e for e in exprs if not static_cls.is_static(e)]
+    if not traced:
+        return None
+    names: Set[str] = set()
+    for e in traced:
+        for sub in ast.walk(e):
+            if isinstance(sub, ast.Name):
+                names.add(sub.id)
+    return names
+
+
+def _is_arena_buf(expr: ast.AST) -> bool:
+    if isinstance(expr, ast.Attribute):
+        return expr.attr == "arena"
+    if isinstance(expr, ast.Name):
+        return expr.id == "arena"
+    return False
+
+
+def stmt_invalidates_arena(node: ast.AST) -> bool:
+    """Does this single node grow/clear/reassign an ``arena`` buffer
+    (the base NATIVE501 invalidation fact)?"""
+    if isinstance(node, ast.AugAssign) and _is_arena_buf(node.target):
+        return True
+    if isinstance(node, ast.Assign) and any(
+        _is_arena_buf(t) for t in node.targets
+    ):
+        return True
+    if isinstance(node, ast.Call) and isinstance(
+        node.func, ast.Attribute
+    ) and node.func.attr in ("clear", "extend", "append") and \
+            _is_arena_buf(node.func.value):
+        return True
+    return False
+
+
+_LOCK_CTORS = {"Lock", "RLock", "Semaphore", "BoundedSemaphore",
+               "Condition"}
+
+
+def _ctor_is_lock(ctor: Optional[ast.expr]) -> bool:
+    if ctor is None:
+        return False
+    name = dotted_name(ctor)
+    return name.rpartition(".")[2] in _LOCK_CTORS
+
+
+def _lock_typed(expr: ast.expr, fn: callgraph.FuncInfo,
+                program: Optional[callgraph.Program]) -> bool:
+    """Is this expression's KNOWN assignment a Lock-family
+    constructor?  Complements the name heuristic so a lock called
+    ``self._mu`` or ``gate`` still gets a graph identity."""
+    mod = fn.module
+    if isinstance(expr, ast.Name):
+        if _ctor_is_lock(mod.mod_types.get(expr.id)):
+            return True
+        if program is not None and expr.id in mod.from_imports:
+            b, orig = mod.from_imports[expr.id]
+            origin = program.by_dotted.get(b)
+            if origin is not None and _ctor_is_lock(
+                origin.mod_types.get(orig)
+            ):
+                return True
+        return False
+    if isinstance(expr, ast.Attribute):
+        base = expr.value
+        if isinstance(base, ast.Name) and base.id in ("self", "cls") \
+                and fn.cls is not None:
+            ci = mod.classes.get(fn.cls)
+            if ci is not None and _ctor_is_lock(
+                ci.attr_types.get(expr.attr)
+            ):
+                return True
+        elif isinstance(base, ast.Name) and program is not None:
+            if base.id in mod.import_mods:
+                origin = program.by_dotted.get(
+                    mod.import_mods[base.id]
+                )
+                if origin is not None and _ctor_is_lock(
+                    origin.mod_types.get(expr.attr)
+                ):
+                    return True
+    return False
+
+
+def lock_token(expr: ast.expr, fn: callgraph.FuncInfo,
+               program: Optional[callgraph.Program] = None
+               ) -> Optional[str]:
+    """Normalize a lock-acquisition expression to a program-wide
+    identity, so the SAME lock acquired in two modules maps to one
+    graph node:
+
+      * ``self._lock`` in class K of module M  -> ``M.K._lock``
+      * module-level ``with state_lock:``      -> ``M.state_lock``
+      * a from-imported lock                   -> ``origin.name``
+
+    A context expression counts as a lock when its NAME looks lockish
+    (lock/sem/cond/mutex) or its known assignment is a Lock-family
+    constructor.  Unknown receivers (a parameter, a dynamic
+    attribute) yield None: no token, no edge — under-approximate,
+    never guess."""
+    if not (_is_lockish(expr) or _lock_typed(expr, fn, program)):
+        return None
+    mod = fn.module
+    if isinstance(expr, ast.Attribute):
+        base = expr.value
+        if isinstance(base, ast.Name) and base.id in ("self", "cls"):
+            if fn.cls is None:
+                return None
+            return f"{mod.dotted}.{fn.cls}.{expr.attr}"
+        if isinstance(base, ast.Name):
+            # imported module's lock: mod_alias.LOCK
+            if base.id in mod.import_mods:
+                return f"{mod.import_mods[base.id]}.{expr.attr}"
+            if base.id in mod.from_imports:
+                b, orig = mod.from_imports[base.id]
+                return f"{b}.{orig}.{expr.attr}"
+        return None
+    if isinstance(expr, ast.Name):
+        if expr.id in mod.from_imports:
+            b, orig = mod.from_imports[expr.id]
+            return f"{b}.{orig}"
+        if expr.id in mod.mod_types or expr.id in mod.mod_aliases:
+            return f"{mod.dotted}.{expr.id}"
+        # a module-level lock assigned `_lock = threading.Lock()` is
+        # recorded in mod_types; anything else (param/local) is unknown
+        return None
+    return None
+
+
+# --------------------------------------------------------------- SCCs
+
+def sccs(program: callgraph.Program) -> List[List[callgraph.FuncInfo]]:
+    """Tarjan over caller->callee edges; emitted callee-SCCs-first
+    (each SCC appears before every SCC that can reach it), which is
+    exactly the bottom-up summary order."""
+    fns = program.functions()
+    index: Dict[Key, int] = {}
+    low: Dict[Key, int] = {}
+    on_stack: Set[Key] = set()
+    stack: List[callgraph.FuncInfo] = []
+    out: List[List[callgraph.FuncInfo]] = []
+    counter = [0]
+
+    def strongconnect(root: callgraph.FuncInfo) -> None:
+        work = [(root, iter(program.callees(root)))]
+        index[root.key] = low[root.key] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root.key)
+        while work:
+            fn, it = work[-1]
+            advanced = False
+            for _call, callee in it:
+                k = callee.key
+                if k not in index:
+                    index[k] = low[k] = counter[0]
+                    counter[0] += 1
+                    stack.append(callee)
+                    on_stack.add(k)
+                    work.append((callee, iter(program.callees(callee))))
+                    advanced = True
+                    break
+                if k in on_stack:
+                    low[fn.key] = min(low[fn.key], index[k])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent.key] = min(low[parent.key], low[fn.key])
+            if low[fn.key] == index[fn.key]:
+                comp: List[callgraph.FuncInfo] = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w.key)
+                    comp.append(w)
+                    if w.key == fn.key:
+                        break
+                out.append(comp)
+
+    for fn in fns:
+        if fn.key not in index:
+            strongconnect(fn)
+    return out
+
+
+# ------------------------------------------------------- base facts
+
+def _base_summary(fn: callgraph.FuncInfo,
+                  program: Optional[callgraph.Program] = None
+                  ) -> FnSummary:
+    s = FnSummary()
+    mod = fn.module
+    node = fn.node
+    tracked = _Staticness(traced_params(node))
+    for sub in walk_pruned(node):
+        if isinstance(sub, ast.Await):
+            hit = awaits_io(sub.value)
+            if hit is not None and s.awaits_io is None and fn.is_async:
+                s.awaits_io = (hit, "")
+        if stmt_invalidates_arena(sub) and s.invalidates is None:
+            s.invalidates = "arena"
+        if isinstance(sub, (ast.With, ast.AsyncWith)):
+            for item in sub.items:
+                tok = lock_token(item.context_expr, fn, program)
+                if tok is not None:
+                    s.acquires.add(tok)
+                    s.has_lock_ctx = True
+        if not isinstance(sub, ast.Call):
+            continue
+        tail = call_tail(sub)
+        line = getattr(sub, "lineno", 1)
+        if callgraph.is_native_entry(tail) and s.native is None:
+            s.native = tail
+        name = dotted_name(sub.func)
+        if not fn.is_async and s.blocks is None and \
+                is_blocking_call(name, sub) and \
+                not mod.suppressed(line, "ASYNC101"):
+            s.blocks = (name, "")
+        if tail in ("item", "tolist") and not sub.args and \
+                s.sync_always is None and not mod.suppressed(
+                    line, "DEVICE201"):
+            s.sync_always = ("DEVICE201", tail, "")
+        elif (isinstance(sub.func, ast.Name)
+                and sub.func.id in _CASTS and sub.args
+                and not tracked.is_static(sub.args[0])
+                and s.sync_traced is None
+                and not mod.suppressed(line, "DEVICE201")):
+            s.sync_traced = ("DEVICE201", sub.func.id, "")
+            s.sync_traced_params = _expr_params(
+                [sub.args[0]], tracked.traced
+            )
+        elif (name.startswith(("np.", "numpy."))
+                and sub.args
+                and any(not tracked.is_static(a) for a in sub.args)
+                and s.sync_traced is None
+                and not mod.suppressed(line, "DEVICE203")):
+            s.sync_traced = ("DEVICE203", name, "")
+            s.sync_traced_params = _expr_params(
+                sub.args, tracked.traced
+            )
+    return s
+
+
+def _expr_params(exprs, params: Set[str]) -> Tuple[str, ...]:
+    names: Set[str] = set()
+    for e in exprs:
+        for sub in ast.walk(e):
+            if isinstance(sub, ast.Name) and sub.id in params:
+                names.add(sub.id)
+    return tuple(sorted(names))
+
+
+# ------------------------------------------------------- propagation
+
+def _update(fn: callgraph.FuncInfo, s: FnSummary,
+            program: callgraph.Program,
+            summaries: Dict[Key, FnSummary]) -> bool:
+    changed = False
+    awaited = None
+    tracked = None
+    for call, callee in program.callees(fn):
+        cs = summaries.get(callee.key)
+        if cs is None:
+            continue
+        if s.blocks is None and cs.blocks is not None and \
+                not fn.is_async and not callee.is_async:
+            s.blocks = (cs.blocks[0], callee.name)
+            changed = True
+        if s.awaits_io is None and cs.awaits_io is not None and \
+                fn.is_async and callee.is_async:
+            if awaited is None:
+                awaited = awaited_calls(fn.node)
+            if id(call) in awaited:
+                s.awaits_io = (cs.awaits_io[0], callee.name)
+                changed = True
+        if s.sync_always is None and cs.sync_always is not None:
+            rule, nm, _via = cs.sync_always
+            s.sync_always = (rule, nm, callee.name)
+            changed = True
+        if s.sync_traced is None and cs.sync_traced is not None:
+            if tracked is None:
+                tracked = _Staticness(traced_params(fn.node))
+            flow = flow_params(call, callee, cs.sync_traced_params,
+                               tracked)
+            if flow is not None:
+                rule, nm, _via = cs.sync_traced
+                s.sync_traced = (rule, nm, callee.name)
+                s.sync_traced_params = tuple(sorted(
+                    flow & traced_params(fn.node)
+                ))
+                changed = True
+        if s.invalidates is None and cs.invalidates is not None:
+            s.invalidates = f"via:{callee.name}"
+            changed = True
+        if s.native is None and cs.native is not None:
+            s.native = cs.native
+            changed = True
+        if not cs.acquires <= s.acquires:
+            s.acquires |= cs.acquires
+            changed = True
+    return changed
+
+
+def summarize(
+    program: callgraph.Program,
+) -> Dict[Key, FnSummary]:
+    summaries: Dict[Key, FnSummary] = {}
+    for comp in sccs(program):
+        for fn in comp:
+            summaries[fn.key] = _base_summary(fn, program)
+        # iterate the SCC to a fixpoint (singletons converge in one
+        # pass; mutual recursion in a few — facts are monotone)
+        for _ in range(len(comp) + 1):
+            any_change = False
+            for fn in comp:
+                if _update(fn, summaries[fn.key], program, summaries):
+                    any_change = True
+            if not any_change:
+                break
+    return summaries
+
+
+__all__ = [
+    "FnSummary", "awaited_calls", "flow_params", "lock_token",
+    "sccs", "stmt_invalidates_arena", "summarize", "traced_params",
+    "walk_pruned",
+]

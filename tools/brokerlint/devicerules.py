@@ -268,4 +268,70 @@ def check(ctx: ModuleContext) -> None:
     _check_call_sites(ctx, ctx.tree, static_by_fn)
 
 
-__all__ = ["check"]
+def check_program(program, summaries, ctxs) -> None:
+    """Transitive DEVICE201/203: a helper call chain inside a
+    ``@jax.jit`` body that ends in a host sync.  The intra rule sees
+    ``x.item()`` in the jit body; this one sees
+    ``helper(x)`` → ``helper2(x)`` → ``x.item()`` across modules.
+    ``sync_always`` summaries (``.item()``/``.tolist()``) propagate
+    unconditionally; ``sync_traced`` ones (``float(p)``/``np.f(p)``
+    on a parameter-derived value) only fire when the jit call site
+    actually passes a traced argument — constants stay host math at
+    trace time, exactly like the intra staticness contract."""
+    from .dataflow import flow_params
+
+    # every jit-compiled function in the program: its own intra pass
+    # covers its body, so edges INTO another jit fn are not re-flagged
+    jit = {}
+    for mod in program.modules.values():
+        wrapped = mod.wrapped_cache
+        if wrapped is None:
+            wrapped = mod.wrapped_cache = _wrapped_names(mod.tree)
+        for fn in mod.funcs.values():
+            dec = _jit_decorated(fn.node)
+            if dec is not None or fn.name in wrapped:
+                jit[fn.key] = _static_params(fn.node, dec)
+    for fn in program.functions():
+        static = jit.get(fn.key)
+        if static is None:
+            continue
+        ctx = ctxs.get(fn.module.path)
+        if ctx is None:
+            continue
+        args = fn.node.args
+        traced = {
+            a.arg for a in (args.posonlyargs + args.args
+                            + args.kwonlyargs)
+        } - static - {"self", "cls"}
+        cls = _Staticness(traced)
+        for call, callee in program.callees(fn):
+            if callee.key in jit:
+                continue
+            s = summaries.get(callee.key)
+            if s is None:
+                continue
+            hit = None
+            if s.sync_always is not None:
+                hit = s.sync_always
+            elif s.sync_traced is not None and flow_params(
+                call, callee, s.sync_traced_params, cls
+            ) is not None:
+                hit = s.sync_traced
+            if hit is None:
+                continue
+            rule, name, via = hit
+            chain = f"{callee.name} -> {via}" if via else callee.name
+            what = ("host sync" if rule == "DEVICE201"
+                    else "host-numpy call")
+            ctx.report(
+                call, rule, fn.qualname,
+                f"`{callee.name}()` transitively performs a {what} "
+                f"(`{name}`, via `{chain}`) inside jit — a blocking "
+                f"device->host round-trip per step; keep the helper "
+                f"chain on-device (jnp/lax) or hoist the sync out of "
+                f"the jit region",
+                detail=f"via:{callee.name}:{name}",
+            )
+
+
+__all__ = ["check", "check_program"]

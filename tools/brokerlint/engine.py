@@ -1,7 +1,7 @@
 """brokerlint core: findings, suppressions, baselines, the runner.
 
 Repo-aware AST analysis for the broker (the role clippy lints +
-erlang's dialyzer checks play for the reference).  Three rule
+erlang's dialyzer checks play for the reference).  Six rule
 families (see the sibling modules):
 
   * async-concurrency  (``ASYNC1xx``, asyncrules.py)   — blocking
@@ -16,7 +16,18 @@ families (see the sibling modules):
   * dispatch-perf     (``PERF4xx``, perfrules.py)      — no
     per-subscriber encode calls (PERF401) or per-delivery clock
     reads (PERF402) inside dispatch-marked hot loops (the
-    single-encode / one-clock-per-run fan-out invariants).
+    single-encode / one-clock-per-run fan-out invariants);
+  * native buffer-lifetime (``NATIVE5xx``, nativerules.py) — cached
+    ctypes views must not survive arena growth, no temporary buffers
+    at GIL-released boundaries (interprocedural);
+  * lock discipline   (``LOCK4xx``, lockrules.py)      — program-wide
+    lock-order inversions, locks held across await/native
+    boundaries, async+thread dual-context locks (interprocedural).
+
+The interprocedural substrate (callgraph.py: whole-program index +
+resolved call graph, mtime-cached; dataflow.py: bottom-up SCC
+summaries) also upgrades ASYNC101 and DEVICE201/203 to see through
+resolved helper calls.
 
 Suppression: a ``# brokerlint: ignore[RULE]`` comment on the finding's
 line (or on a comment-only line directly above it) silences that rule
@@ -44,6 +55,33 @@ _SUPPRESS_RE = re.compile(
     r"#\s*brokerlint:\s*ignore\[([A-Za-z0-9_*,\s]+)\]"
 )
 _COMMENT_ONLY_RE = re.compile(r"^\s*#")
+
+
+def ignore_matches(text: str, rule: str) -> bool:
+    """Does this source line carry `# brokerlint: ignore[...]` for
+    `rule` (or `*`)?"""
+    m = _SUPPRESS_RE.search(text)
+    if m is None:
+        return False
+    rules = {r.strip() for r in m.group(1).split(",")}
+    return "*" in rules or rule in rules
+
+
+def site_suppressed(lines: Sequence[str], line: int,
+                    rule: str) -> bool:
+    """THE suppression contract for one site: an ignore on the line
+    itself, or on a comment-only line directly above.  Shared by
+    finding reporting (ModuleContext) and summary base facts
+    (callgraph.ModuleIndex) so the two can never drift."""
+    for cand in (line, line - 1):
+        if not (1 <= cand <= len(lines)):
+            continue
+        text = lines[cand - 1]
+        if cand != line and not _COMMENT_ONLY_RE.match(text):
+            continue  # the line above only counts if comment-only
+        if ignore_matches(text, rule):
+            return True
+    return False
 
 # call names whose *await* performs (or unboundedly waits on) IO —
 # used by the lock-across-IO rule and by the one-level "does this
@@ -92,7 +130,9 @@ class Finding:
 class ModuleContext:
     """Everything the rule visitors need about one source file."""
 
-    def __init__(self, path: str, source: str, tree: ast.Module) -> None:
+    def __init__(self, path: str, source: str, tree: ast.Module,
+                 methods: Optional[Tuple[Set[str], Set[str]]] = None
+                 ) -> None:
         self.path = path
         self.source = source
         self.lines = source.splitlines()
@@ -105,25 +145,62 @@ class ModuleContext:
         #   failpoint (class-blind on purpose: one level, best effort)
         self.io_methods: Set[str] = set()
         self.failpoint_methods: Set[str] = set()
-        self._index()
+        if methods is not None:
+            # cached from a previous run over the same (mtime, size)
+            self.io_methods, self.failpoint_methods = methods
+        else:
+            self._index()
 
     # ------------------------------------------------------- indexing
 
     def _index(self) -> None:
+        # ONE walk: collect function nodes + the lines of IO awaits
+        # and failpoint calls, then attribute them to functions by
+        # line interval (equivalent to the old per-function re-walks
+        # — a nested def's hit marked its enclosing method there too —
+        # at O(tree + f log n) instead of O(tree × depth))
+        import bisect
+
+        fns: List[ast.AST] = []
+        io_lines: List[int] = []
+        fp_lines: List[int] = []
         for node in ast.walk(self.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                name = node.name
-                self.functions.setdefault(name, node)
-                if _body_awaits_io(node):
-                    self.io_methods.add(name)
-                if _body_calls_failpoint(node):
-                    self.failpoint_methods.add(name)
+                self.functions.setdefault(node.name, node)
+                fns.append(node)
+            elif isinstance(node, ast.Await):
+                if awaits_io(node.value) is not None:
+                    io_lines.append(node.lineno)
+            elif isinstance(node, ast.Call) and is_failpoint_call(node):
+                fp_lines.append(node.lineno)
+        io_lines.sort()
+        fp_lines.sort()
+        for node in fns:
+            lo, hi = node.lineno, getattr(node, "end_lineno",
+                                          node.lineno)
+            i = bisect.bisect_left(io_lines, lo)
+            if i < len(io_lines) and io_lines[i] <= hi:
+                self.io_methods.add(node.name)
+            i = bisect.bisect_left(fp_lines, lo)
+            if i < len(fp_lines) and fp_lines[i] <= hi:
+                self.failpoint_methods.add(node.name)
 
     # ----------------------------------------------------- reporting
 
     def report(self, node: ast.AST, rule: str, qualname: str,
                message: str, detail: str = "") -> None:
         line = getattr(node, "lineno", 1)
+        if self._suppressed(line, rule, node):
+            return
+        self.findings.append(Finding(
+            path=self.path, line=line, rule=rule,
+            qualname=qualname, message=message, detail=detail,
+        ))
+
+    def report_at(self, line: int, rule: str, qualname: str,
+                  message: str, detail: str = "") -> None:
+        """Report by line number (program-level rules that carry a
+        site rather than a node)."""
         if self._suppressed(line, rule):
             return
         self.findings.append(Finding(
@@ -131,18 +208,28 @@ class ModuleContext:
             qualname=qualname, message=message, detail=detail,
         ))
 
-    def _suppressed(self, line: int, rule: str) -> bool:
-        for cand in (line, line - 1):
-            if not (1 <= cand <= len(self.lines)):
-                continue
-            text = self.lines[cand - 1]
-            if cand != line and not _COMMENT_ONLY_RE.match(text):
-                continue  # the line above only counts if comment-only
-            m = _SUPPRESS_RE.search(text)
-            if m is None:
-                continue
-            rules = {r.strip() for r in m.group(1).split(",")}
-            if "*" in rules or rule in rules:
+    def _suppressed(self, line: int, rule: str,
+                    node: Optional[ast.AST] = None) -> bool:
+        if site_suppressed(self.lines, line, rule):
+            return True
+        # function-level findings additionally honor ignores on every
+        # decorator line, the comment line above the first decorator,
+        # and the whole (possibly multi-line) def header — so the
+        # closing-paren line of a long signature works too
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            decs = getattr(node, "decorator_list", [])
+            first = min((d.lineno for d in decs), default=node.lineno)
+            body_start = (node.body[0].lineno if node.body
+                          else node.lineno + 1)
+            extra = {d.lineno for d in decs} | set(
+                range(node.lineno, body_start)
+            )
+            for cand in sorted(extra):
+                if 1 <= cand <= len(self.lines) and ignore_matches(
+                    self.lines[cand - 1], rule
+                ):
+                    return True
+            if site_suppressed(self.lines, first, rule):
                 return True
         return False
 
@@ -191,14 +278,6 @@ def awaits_io(expr: ast.AST, io_methods: Set[str] = frozenset()) -> Optional[str
     return None
 
 
-def _body_awaits_io(fn: ast.AST) -> bool:
-    for node in ast.walk(fn):
-        if isinstance(node, ast.Await):
-            if awaits_io(node.value) is not None:
-                return True
-    return False
-
-
 def is_failpoint_call(call: ast.Call) -> bool:
     name = dotted_name(call.func)
     return name.endswith("failpoints.evaluate") or \
@@ -206,24 +285,13 @@ def is_failpoint_call(call: ast.Call) -> bool:
         name in ("evaluate", "evaluate_async")
 
 
-def _body_calls_failpoint(fn: ast.AST) -> bool:
-    for node in ast.walk(fn):
-        if isinstance(node, ast.Call) and is_failpoint_call(node):
-            return True
-    return False
-
-
 # -------------------------------------------------------------- runner
 
-def analyze_source(source: str, path: str = "<string>",
-                   seams: Optional[Sequence] = None,
-                   dispatch: Optional[Sequence] = None) -> List[Finding]:
-    """Run every rule family over one source string (fixture tests use
-    this directly; `run_lint` maps it over the tree)."""
+def _run_file_checks(ctx: ModuleContext,
+                     seams: Optional[Sequence],
+                     dispatch: Optional[Sequence]) -> None:
     from . import asyncrules, devicerules, failpointrules, perfrules
 
-    tree = ast.parse(source, filename=path)
-    ctx = ModuleContext(path, source, tree)
     asyncrules.check(ctx)
     devicerules.check(ctx)
     failpointrules.check(
@@ -232,8 +300,69 @@ def analyze_source(source: str, path: str = "<string>",
     perfrules.check(
         ctx, perfrules.DISPATCH_FUNCS if dispatch is None else dispatch
     )
+
+
+def _run_program_checks(modules: Dict, ctxs: Dict[str, ModuleContext]
+                        ) -> None:
+    """The interprocedural pass: call-graph + summaries once, then
+    every whole-program rule family (transitive ASYNC101,
+    transitive DEVICE201/203, NATIVE5xx, LOCK4xx) reports through the
+    per-file contexts so suppression/fingerprints behave identically
+    to the intra-function rules."""
+    from . import (
+        asyncrules, callgraph, dataflow, devicerules, lockrules,
+        nativerules,
+    )
+
+    program = callgraph.build_program(modules)
+    summaries = dataflow.summarize(program)
+    asyncrules.check_program(program, summaries, ctxs)
+    devicerules.check_program(program, summaries, ctxs)
+    nativerules.check_program(program, summaries, ctxs)
+    lockrules.check_program(program, summaries, ctxs)
+
+
+def analyze_source(source: str, path: str = "<string>",
+                   seams: Optional[Sequence] = None,
+                   dispatch: Optional[Sequence] = None) -> List[Finding]:
+    """Run every rule family — intra-function AND the interprocedural
+    pass, over this one module — on a source string (fixture tests
+    use this directly; `run_lint` maps the same checks over the
+    tree)."""
+    from . import callgraph
+
+    idx = callgraph.ModuleIndex(path, source)  # ONE parse, shared
+    ctx = ModuleContext(path, source, idx.tree)
+    _run_file_checks(ctx, seams, dispatch)
+    _run_program_checks({path: idx}, {path: ctx})
     ctx.findings.sort(key=lambda f: (f.line, f.rule))
     return ctx.findings
+
+
+def analyze_program(sources: Dict[str, str],
+                    seams: Optional[Sequence] = None,
+                    dispatch: Optional[Sequence] = None
+                    ) -> List[Finding]:
+    """Run every rule family over a MULTI-module fixture tree
+    ({path: source}): the cross-module test surface for the
+    interprocedural rules (a jit helper two modules deep, opposite
+    lock orders in two files)."""
+    from . import callgraph
+
+    ctxs: Dict[str, ModuleContext] = {}
+    modules: Dict[str, callgraph.ModuleIndex] = {}
+    for path, source in sources.items():
+        idx = callgraph.ModuleIndex(path, source)
+        ctx = ModuleContext(path, source, idx.tree)
+        _run_file_checks(ctx, seams, dispatch)
+        ctxs[path] = ctx
+        modules[path] = idx
+    _run_program_checks(modules, ctxs)
+    out: List[Finding] = []
+    for ctx in ctxs.values():
+        out.extend(ctx.findings)
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
 
 
 def iter_py_files(paths: Sequence[str], root: Path) -> Iterable[Path]:
@@ -248,26 +377,56 @@ def iter_py_files(paths: Sequence[str], root: Path) -> Iterable[Path]:
 def run_lint(paths: Sequence[str], root: Optional[str] = None,
              seams: Optional[Sequence] = None) -> List[Finding]:
     """Lint every .py under `paths` (files or directories), returning
-    findings with repo-relative posix paths."""
+    findings with repo-relative posix paths.  Parsing + indexing is
+    cached per (file, mtime, size) — see callgraph._INDEX_CACHE — so
+    repeated whole-tree runs only re-parse what changed; the
+    interprocedural pass runs over the files of THIS invocation."""
+    from . import callgraph
+
     root_path = Path(root) if root else Path(__file__).resolve().parents[2]
     out: List[Finding] = []
+    ctxs: Dict[str, ModuleContext] = {}
+    modules: Dict[str, callgraph.ModuleIndex] = {}
     for f in iter_py_files(paths, root_path):
         try:
             rel = f.resolve().relative_to(root_path.resolve()).as_posix()
         except ValueError:
             rel = f.as_posix()
         try:
-            src = f.read_text()
+            idx = callgraph.index_file(str(f), rel)
         except (OSError, UnicodeDecodeError):
             continue
-        try:
-            out.extend(analyze_source(src, rel, seams=seams))
         except SyntaxError as exc:
             out.append(Finding(
                 path=rel, line=exc.lineno or 1, rule="PARSE000",
                 qualname="<module>",
                 message=f"syntax error: {exc.msg}",
             ))
+            continue
+        cache = getattr(idx, "file_cache", None) if seams is None \
+            else None
+        if cache is not None:
+            # per-file findings are deterministic in the source, so a
+            # mtime-cached index replays them without re-running the
+            # intra-function families
+            base, io_m, fp_m = cache
+            ctx = ModuleContext(rel, idx.source, idx.tree,
+                                methods=(io_m, fp_m))
+            ctx.findings = list(base)
+        else:
+            ctx = ModuleContext(rel, idx.source, idx.tree)
+            _run_file_checks(ctx, seams, None)
+            if seams is None:
+                idx.file_cache = (
+                    tuple(ctx.findings), ctx.io_methods,
+                    ctx.failpoint_methods,
+                )
+        ctxs[rel] = ctx
+        modules[rel] = idx
+    _run_program_checks(modules, ctxs)
+    for ctx in ctxs.values():
+        out.extend(ctx.findings)
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
     return out
 
 
@@ -314,4 +473,6 @@ def diff_baseline(
 
 
 DEFAULT_BASELINE = str(Path(__file__).parent / "baseline.txt")
-DEFAULT_PATHS = ("emqx_tpu",)
+# the analyzer eats its own dog food: tools/ (brokerlint itself) and
+# bench.py are part of the default gate surface alongside the broker
+DEFAULT_PATHS = ("emqx_tpu", "tools", "bench.py")

@@ -1,0 +1,294 @@
+"""Lock-discipline rules (LOCK4xx) — interprocedural.
+
+The multicore scale-out (N broker workers × one match service over a
+shared-memory ring) hangs on lock discipline that today lives in
+comments: which locks nest in which order, which may be held at a
+GIL-released native boundary, and which are shared between the event
+loop and worker threads.  These rules build the program-wide
+lock-acquisition graph (lock identities normalized across modules by
+`dataflow.lock_token`) and enforce the discipline statically:
+
+  LOCK401  potential lock-order inversion: the acquisition graph has
+           A→B (B acquired — directly or through any resolved callee
+           — while A is held) and a path B⇝A somewhere else in the
+           program.  Two threads taking the two paths deadlock.
+           Reported at every edge on the cycle.
+  LOCK402  lock held across a suspension boundary the intra-function
+           ASYNC103 cannot see: an await that (transitively, through
+           resolved async callees) performs IO, a sync ``with`` lock
+           wrapping an IO await, or any call that (transitively)
+           enters a GIL-released native entry point — one slow peer
+           or one long native splice stalls every other holder.
+           When the serialization IS the design (e.g. a lock that
+           exists precisely because the native call drops the GIL),
+           suppress with a justification saying so.
+  LOCK403  one lock acquired both inside ``async def`` (event-loop
+           context) and inside sync ``def`` (thread context) without
+           a documented owner: a threading lock taken on the loop
+           stalls the loop for as long as any thread holds it.
+           Document with a ``# lock-ownership: <rule>`` comment on
+           the loop-side acquisition (or restructure).
+
+ASYNC103 stays the fast intra-function rule; LOCK402 only reports
+what it cannot see (≥2-level IO resolution, sync-with shapes, native
+boundaries), so the two never double-report one site.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import callgraph, dataflow
+from .engine import ModuleContext, awaits_io, call_tail
+
+# a site: (path, qualname, line)
+_Site = Tuple[str, str, int]
+
+_OWNERSHIP_TOKEN = "lock-ownership:"
+
+
+class _LockGraph:
+    def __init__(self) -> None:
+        # (a, b) -> sites where b was acquired while a held
+        self.edges: Dict[Tuple[str, str], List[_Site]] = {}
+
+    def add(self, a: str, b: str, site: _Site) -> None:
+        if a == b:
+            return
+        sites = self.edges.setdefault((a, b), [])
+        if site not in sites:
+            sites.append(site)
+
+    def succ(self) -> Dict[str, Set[str]]:
+        out: Dict[str, Set[str]] = {}
+        for (a, b) in self.edges:
+            out.setdefault(a, set()).add(b)
+        return out
+
+    def cycle_edges(self) -> List[Tuple[str, str]]:
+        succ = self.succ()
+        out = []
+        for (a, b) in sorted(self.edges):
+            # inversion iff a is reachable back from b
+            seen: Set[str] = set()
+            stack = [b]
+            hit = False
+            while stack:
+                n = stack.pop()
+                if n == a:
+                    hit = True
+                    break
+                if n in seen:
+                    continue
+                seen.add(n)
+                stack.extend(succ.get(n, ()))
+            if hit:
+                out.append((a, b))
+        return out
+
+
+def _has_ownership_comment(ctx: ModuleContext, line: int) -> bool:
+    """``# lock-ownership: ...`` on the acquisition line or anywhere
+    in the contiguous comment block directly above it."""
+    if 1 <= line <= len(ctx.lines) and \
+            _OWNERSHIP_TOKEN in ctx.lines[line - 1]:
+        return True
+    cand = line - 1
+    while 1 <= cand <= len(ctx.lines) and \
+            ctx.lines[cand - 1].lstrip().startswith("#"):
+        if _OWNERSHIP_TOKEN in ctx.lines[cand - 1]:
+            return True
+        cand -= 1
+    return False
+
+
+class _FnLockWalk:
+    """One function's held-lock walk: collects order edges (direct
+    nesting AND held-across-call via callee ``acquires`` summaries),
+    dual-context acquisitions, and LOCK402 findings."""
+
+    def __init__(self, fn: callgraph.FuncInfo,
+                 program: callgraph.Program, summaries: Dict,
+                 ctx: ModuleContext, graph: _LockGraph,
+                 acq_ctx: Dict[str, Dict[str, List[_Site]]]) -> None:
+        self.fn = fn
+        self.program = program
+        self.summaries = summaries
+        self.ctx = ctx
+        self.graph = graph
+        self.acq_ctx = acq_ctx
+        self._callees = {
+            id(call): callee for call, callee in program.callees(fn)
+        }
+
+    def run(self) -> None:
+        for child in ast.iter_child_nodes(self.fn.node):
+            self._process(child, [])
+
+    # held: list of (token, is_sync_with) innermost-last
+    def _process(self, node: ast.AST,
+                 held: List[Tuple[str, bool]]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # a nested def does not run under the lock
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new: List[Tuple[str, bool]] = []
+            is_sync = isinstance(node, ast.With)
+            for item in node.items:
+                tok = dataflow.lock_token(item.context_expr, self.fn,
+                                              self.program)
+                if tok is None:
+                    continue
+                site = (self.fn.module.path, self.fn.qualname,
+                        node.lineno)
+                for h, _s in held + new:
+                    self.graph.add(h, tok, site)
+                kind = "async" if self.fn.is_async else "sync"
+                self.acq_ctx.setdefault(tok, {}).setdefault(
+                    kind, []
+                ).append(site)
+                new.append((tok, is_sync))
+            inner = held + new
+            for stmt in node.body:
+                self._process(stmt, inner)
+            return
+        if held:
+            if isinstance(node, ast.Await):
+                self._check_await(node, held)
+            elif isinstance(node, ast.Call):
+                self._check_call(node, held)
+        for child in ast.iter_child_nodes(node):
+            self._process(child, held)
+
+    def _check_await(self, node: ast.Await, held) -> None:
+        direct = awaits_io(node.value, self.ctx.io_methods)
+        held_sync = [h for h, s in held if s]
+        if direct is not None:
+            # ASYNC103 sees async-with holders; a SYNC `with` lock
+            # wrapping an IO await is invisible to it — ours
+            if held_sync:
+                self.ctx.report(
+                    node, "LOCK402", self.fn.qualname,
+                    f"sync `with` lock `{held_sync[-1]}` held across "
+                    f"IO await (`{direct}`): the loop parks here "
+                    f"with the lock taken and every thread contender "
+                    f"blocks — narrow the critical section",
+                    detail=f"sync-with:{direct}",
+                )
+            return
+        # transitive: the awaited call resolves to an async callee
+        # whose summary (≥1 level deeper than ASYNC103's one-level
+        # map) performs IO
+        for sub in ast.walk(node.value):
+            if not isinstance(sub, ast.Call):
+                continue
+            callee = self._callees.get(id(sub))
+            if callee is None:
+                continue
+            cs = self.summaries.get(callee.key)
+            if cs is None or cs.awaits_io is None:
+                continue
+            io_name, via = cs.awaits_io
+            chain = f"{callee.name} -> {via}" if via else callee.name
+            self.ctx.report(
+                node, "LOCK402", self.fn.qualname,
+                f"lock `{held[-1][0]}` held across await of "
+                f"`{callee.name}()` which (transitively via "
+                f"`{chain}`) performs IO (`{io_name}`): one slow "
+                f"peer stalls every other holder",
+                detail=f"await:{callee.name}:{io_name}",
+            )
+            return
+
+    def _check_call(self, node: ast.Call, held) -> None:
+        tail = call_tail(node)
+        native: Optional[str] = None
+        chain = ""
+        if callgraph.is_native_entry(tail):
+            native = tail
+        else:
+            callee = self._callees.get(id(node))
+            if callee is not None:
+                cs = self.summaries.get(callee.key)
+                if cs is not None and cs.native is not None:
+                    native = cs.native
+                    chain = callee.name
+        if native is not None:
+            via = f" (via `{chain}`)" if chain else ""
+            self.ctx.report(
+                node, "LOCK402", self.fn.qualname,
+                f"lock `{held[-1][0]}` held across GIL-released "
+                f"native call `{native}`{via}: the holder drops the "
+                f"GIL with the lock taken, so every contender stalls "
+                f"for the whole native span (suppress with a "
+                f"justification when the lock exists to serialize "
+                f"the native structure itself)",
+                detail=f"native:{native}",
+            )
+            return
+        # held-across-call acquisition edges (H→T for every T the
+        # callee transitively acquires)
+        callee = self._callees.get(id(node))
+        if callee is None:
+            return
+        cs = self.summaries.get(callee.key)
+        if cs is None or not cs.acquires:
+            return
+        site = (self.fn.module.path, self.fn.qualname, node.lineno)
+        for h, _s in held:
+            for t in cs.acquires:
+                self.graph.add(h, t, site)
+
+
+def check_program(
+    program: callgraph.Program,
+    summaries: Dict,
+    ctxs: Dict[str, ModuleContext],
+) -> None:
+    graph = _LockGraph()
+    acq_ctx: Dict[str, Dict[str, List[_Site]]] = {}
+    # 1. per-function walks: LOCK402 findings + graph/context data
+    for fn in program.functions():
+        ctx = ctxs.get(fn.module.path)
+        if ctx is None:
+            continue
+        s = summaries.get(fn.key)
+        if s is None or not s.has_lock_ctx:
+            continue  # no token-resolved lock in the body: no walk
+        _FnLockWalk(fn, program, summaries, ctx, graph, acq_ctx).run()
+    # 2. LOCK401: lock-order inversions
+    for (a, b) in graph.cycle_edges():
+        for (path, qual, line) in graph.edges[(a, b)]:
+            ctx = ctxs.get(path)
+            if ctx is None:
+                continue
+            ctx.report_at(
+                line, "LOCK401", qual,
+                f"potential lock-order inversion: `{b}` acquired "
+                f"while `{a}` is held, but elsewhere the program "
+                f"acquires them in the opposite order — two threads "
+                f"taking the two paths deadlock; pick ONE order and "
+                f"enforce it",
+                detail=f"{a}->{b}",
+            )
+    # 3. LOCK403: dual-context locks without documented ownership
+    for tok, kinds in sorted(acq_ctx.items()):
+        if "async" not in kinds or "sync" not in kinds:
+            continue
+        for (path, qual, line) in kinds["async"]:
+            ctx = ctxs.get(path)
+            if ctx is None or _has_ownership_comment(ctx, line):
+                continue
+            ctx.report_at(
+                line, "LOCK403", qual,
+                f"lock `{tok}` is acquired both here (event-loop "
+                f"context) and in sync/thread context elsewhere: a "
+                f"thread holding it stalls the loop — document the "
+                f"ownership rule with a `# lock-ownership: ...` "
+                f"comment or restructure",
+                detail=tok,
+            )
+
+
+__all__ = ["check_program"]

@@ -1,0 +1,193 @@
+"""Native buffer-lifetime rules (NATIVE5xx) — interprocedural.
+
+PR 5's native dispatch fast path hangs correctness on buffer-lifetime
+conventions no test can fully cover: the ``DispatchEncoder`` keeps
+cached ctypes pointers (``native_views``/``span_arrays``) into a
+growable ``arena`` bytearray, and the GIL-released ``da_assemble_run``
+call dereferences them with no Python object keeping anything alive.
+These rules make the conventions machine-checked:
+
+  NATIVE501  use-after-invalidation: a local bound to cached
+             ``native_views()``/``span_arrays()`` pointers is still
+             live when a call that can (transitively) grow or clear
+             the encoder arena runs — ``slot_for`` appends to
+             ``self.arena``, a bytearray resize moves the buffer, and
+             the cached pointer now dangles into freed memory.  Take
+             the views AFTER the last slot miss (the shape
+             ``Session.deliver_run_native`` uses).
+  NATIVE502  unstable buffer at a ctypes boundary:
+               * ``X.ctypes.data`` — a raw address with no owning
+                 reference; if ``X`` is a temporary the pointer
+                 dangles immediately (use ``data_as`` on a bound
+                 array);
+               * ``<call>.ctypes.data_as(...)`` — pointer taken from
+                 an unnamed temporary array; bind the array to a
+                 local that outlives the native call;
+               * ``from_buffer(<call>)`` — pinning a temporary
+                 buffer that dies with the expression;
+               * ``from_buffer(self.arena)``-style exports of a
+                 RESIZABLE buffer — legal only under the
+                 release-before-growth discipline; the site must
+                 carry a justified inline ignore documenting it.
+
+Both families run on the whole-program pass: the invalidation summary
+(`FnSummary.invalidates`) propagates through the resolved call graph,
+so ``enc.slot_for`` two helpers deep still invalidates the caller's
+cached views.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from . import callgraph, dataflow
+from .engine import ModuleContext, call_tail
+
+# calls that hand out cached ctypes pointers into the arena
+_VIEW_TAILS = {"native_views", "span_arrays"}
+
+
+def _check_fn(
+    ctx: ModuleContext,
+    fn: callgraph.FuncInfo,
+    program: callgraph.Program,
+    summaries: Dict,
+) -> None:
+    """ONE pruned walk per function: NATIVE502 shapes inline, plus
+    the per-function facts NATIVE501 needs (view binds, direct
+    invalidation sites, last-use lines)."""
+    qual = fn.qualname
+    binds: List[Tuple[str, int]] = []      # views local -> bind line
+    inv_sites: List[Tuple[int, str]] = []  # direct arena mutations
+    loads: Dict[str, List[int]] = {}       # name -> Load lines
+    stores: Dict[str, List[int]] = {}      # name -> Store lines
+    for node in dataflow.walk_pruned(fn.node):
+        if isinstance(node, ast.Name):
+            if isinstance(node.ctx, ast.Load):
+                loads.setdefault(node.id, []).append(node.lineno)
+            elif isinstance(node.ctx, ast.Store):
+                stores.setdefault(node.id, []).append(node.lineno)
+            continue
+        if dataflow.stmt_invalidates_arena(node):
+            inv_sites.append((node.lineno, "arena"))
+        if isinstance(node, ast.Assign):
+            targets: List[ast.Name] = []
+            if len(node.targets) == 1 and isinstance(
+                node.targets[0], ast.Name
+            ):
+                targets = [node.targets[0]]
+            elif len(node.targets) == 1 and isinstance(
+                node.targets[0], ast.Tuple
+            ):
+                targets = [e for e in node.targets[0].elts
+                           if isinstance(e, ast.Name)]
+            if targets and any(
+                isinstance(sub, ast.Call)
+                and call_tail(sub) in _VIEW_TAILS
+                for sub in ast.walk(node.value)
+            ):
+                binds.extend((t.id, node.lineno) for t in targets)
+            continue
+        if isinstance(node, ast.Attribute) and node.attr == "data" \
+                and isinstance(node.value, ast.Attribute) \
+                and node.value.attr == "ctypes":
+            ctx.report(
+                node, "NATIVE502", qual,
+                "`.ctypes.data` yields a raw address with no owning "
+                "reference — a GIL-released callee can observe freed "
+                "memory; use `.ctypes.data_as(...)` on an array bound "
+                "to a local that outlives the call",
+                detail="ctypes.data",
+            )
+        if not isinstance(node, ast.Call):
+            continue
+        tail = call_tail(node)
+        if tail == "data_as" and isinstance(node.func, ast.Attribute):
+            recv = node.func.value
+            if isinstance(recv, ast.Attribute) and \
+                    recv.attr == "ctypes" and isinstance(
+                        recv.value, ast.Call):
+                ctx.report(
+                    node, "NATIVE502", qual,
+                    "pointer taken from an unnamed temporary array "
+                    "(`<call>.ctypes.data_as`): nothing keeps the "
+                    "array alive across the native call — bind it to "
+                    "a local first",
+                    detail="temp-data_as",
+                )
+        elif tail == "from_buffer" and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Call):
+                ctx.report(
+                    node, "NATIVE502", qual,
+                    "`from_buffer` pins a TEMPORARY buffer that dies "
+                    "with this expression — the native callee "
+                    "dereferences freed memory; bind the buffer to a "
+                    "local that outlives the call",
+                    detail="temp-from_buffer",
+                )
+            elif (isinstance(arg, ast.Attribute)
+                  and arg.attr == "arena") or (
+                      isinstance(arg, ast.Name) and arg.id == "arena"):
+                ctx.report(
+                    node, "NATIVE502", qual,
+                    "`from_buffer` export of a RESIZABLE arena "
+                    "buffer: any growth while the export lives moves "
+                    "the bytes under the pointer — only legal under "
+                    "the release-before-growth discipline (suppress "
+                    "with a justification naming it)",
+                    detail="resizable-from_buffer",
+                )
+    if not binds:
+        return
+    # NATIVE501: add call-edge invalidations, then window-check each
+    # views local between its bind and last use
+    for call, callee in program.callees(fn):
+        cs = summaries.get(callee.key)
+        if cs is not None and cs.invalidates is not None:
+            inv_sites.append((call.lineno, callee.name))
+    if not inv_sites:
+        return
+    for name, bind_line in binds:
+        # this bind's live window ends at the next Store of the same
+        # name: re-taking the views after the last slot miss (the
+        # remediation the message recommends) starts a NEW window
+        next_store = min(
+            (s for s in stores.get(name, ()) if s > bind_line),
+            default=None,
+        )
+        last = max(
+            (l for l in loads.get(name, ())
+             if l > bind_line
+             and (next_store is None or l <= next_store)),
+            default=0,
+        )
+        if last <= bind_line:
+            continue
+        for line, what in inv_sites:
+            if bind_line < line <= last:
+                ctx.report_at(
+                    line, "NATIVE501", fn.qualname,
+                    f"cached native views `{name}` (bound line "
+                    f"{bind_line}) are still live here, but "
+                    f"`{what}` can grow/clear the encoder arena — "
+                    f"the ctypes pointers dangle after a resize; "
+                    f"re-take the views after the last slot miss",
+                    detail=f"{name}:{what}",
+                )
+
+
+def check_program(
+    program: callgraph.Program,
+    summaries: Dict,
+    ctxs: Dict[str, ModuleContext],
+) -> None:
+    for fn in program.functions():
+        ctx = ctxs.get(fn.module.path)
+        if ctx is None:
+            continue
+        _check_fn(ctx, fn, program, summaries)
+
+
+__all__ = ["check_program"]
