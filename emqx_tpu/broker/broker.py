@@ -33,6 +33,7 @@ from ..message import Message
 from ..metrics import Metrics, Stats
 from ..retainer import Retainer
 from ..router import Router
+from ..tracecontext import extract_strip as _strip_ctx
 
 log = logging.getLogger("emqx_tpu.broker")
 
@@ -66,6 +67,17 @@ class Broker:
             ring_size=prof_cfg.ring_size,
             events_cap=prof_cfg.events_cap,
             enabled=prof_cfg.enable,
+            process_label=self.config.node_name,
+        )
+        # per-message lifecycle tracer (tracecontext.py): head-sampled
+        # trace contexts through the batched path, spans cut from the
+        # profiler's WindowRecords, propagated across cluster/worker
+        # hops.  Inactive (the default) = one attribute load per
+        # window on the hot path.
+        from ..tracecontext import LifecycleTracer
+
+        self.lifecycle = LifecycleTracer(
+            self.config.tracing, node=self.config.node_name
         )
         eng_cfg = self.config.engine
         self.router = Router(
@@ -636,6 +648,13 @@ class Broker:
     ) -> Tuple[List[Message], List[Optional[int]]]:
         """Stage 1 (loop thread): publish hooks, retained store, and the
         durable persistence gate."""
+        lifecycle = self.lifecycle
+        if lifecycle.active:
+            # head-sample BEFORE the hook fold so egress taps that run
+            # inside it (cluster-link forward) see the context; an
+            # inactive tracer costs this one bool per window
+            for msg in msgs:
+                lifecycle.ingress(msg)
         outs: List[object] = []
         for msg in msgs:
             # per-message isolation: one hook/retainer failure must not
@@ -657,6 +676,10 @@ class Broker:
         exactly the sync path."""
         if not self.hooks.has_async("message.publish"):
             return self.publish_prepare(msgs)
+        lifecycle = self.lifecycle
+        if lifecycle.active:
+            for msg in msgs:  # idempotent: see publish_prepare
+                lifecycle.ingress(msg)
 
         async def fold_one(msg: Message) -> object:
             try:
@@ -874,6 +897,21 @@ class Broker:
         per inbound cluster frame."""
         if not msgs:
             return 0
+        lifecycle = self.lifecycle
+        if lifecycle.active:
+            # adopt the origin node's sampled contexts (stripped from
+            # the wire properties) so this node's dispatch spans parent
+            # to the origin's forward span — the cross-node half of one
+            # connected trace.  sample=False: the head decision was
+            # made ONCE, at the origin's ingress
+            for msg in msgs:
+                lifecycle.ingress(msg, sample=False)
+        else:
+            # tracing off on this node: still strip the carrier so the
+            # internal property never reaches a subscriber's wire
+            for msg in msgs:
+                if msg.properties:
+                    _strip_ctx(msg.properties)
         if self.durable is not None:
             # each node durably stores what its own gate needs: DS is
             # node-local here (unlike the reference's replicated DS), so
@@ -1098,6 +1136,13 @@ class Broker:
                 for i, msg in enumerate(msgs):
                     if counts[i] and msg.timestamp:
                         e2e.append((now_e2e - msg.timestamp) * 1e3)
+        lifecycle = self.lifecycle
+        if lifecycle.active:
+            # lifecycle spans for the window's SAMPLED messages, cut
+            # entirely from the flight record's existing timestamps —
+            # one call per window, outside the dispatch loops, zero
+            # additional clock reads (the OBS601 gate pins this down)
+            lifecycle.window_spans(msgs, counts, rec, n_clients)
         tracer = self.tracer
         for i, msg in enumerate(msgs):
             if not touched[i]:
@@ -1243,9 +1288,16 @@ class Broker:
                 # window pays one compare, not one per delivery)
                 for m, _opts in deliveries:
                     if m.timestamp and m.timestamp < floor:
+                        # a sampled slow delivery records its trace id,
+                        # so the slow-subs board links straight to the
+                        # offending message's full lifecycle trace
+                        tctx = getattr(m, "_trace_ctx", None)
                         slow.record(
                             clientid, m.topic,
                             (now - m.timestamp) * 1000.0,
+                            trace_id=(
+                                tctx.trace_id if tctx is not None else ""
+                            ),
                         )
             if self.tracer is not None:
                 self._deliver_span(clientid, deliveries)

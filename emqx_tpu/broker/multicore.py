@@ -59,13 +59,32 @@ def worker_configs(
     bind: str = "0.0.0.0",
     base_config: Optional[Dict] = None,
     use_device: Optional[bool] = False,
+    tracing: Optional[Dict] = None,
 ) -> List[Dict]:
     """Per-worker config dicts: shared REUSEPORT listener + loopback
     cluster full-mesh seeds.  ``use_device=False`` by default — worker
     processes must not fight over one TPU; run a single-process broker
     for the device match path, or give exactly one worker the device.
+
+    ``tracing`` (a TracingConfig-shaped dict) arms the lifecycle
+    tracer in EVERY worker: cross-worker submissions ride the ordinary
+    inter-node forward, so a sampled publish accepted by worker A and
+    delivered by worker B yields one connected trace with per-worker
+    process tracks (node_name = ``worker<i>``) in the merged Perfetto
+    timeline.  When the base config enables the management API, each
+    worker gets its OWN api port (they cannot share one), so every
+    worker's trace store is REST-queryable for the merge.
     """
-    cluster_ports = _free_ports(n_workers)
+    base_api = dict((base_config or {}).get("api") or {})
+    # ONE probe for every port this pool needs: drawing api ports from
+    # a second call could hand back a just-released cluster port (the
+    # probe sockets close between calls) and a worker would fail to
+    # bind; a single call holds all sockets open simultaneously, so
+    # the ports are guaranteed distinct
+    want_api = bool(base_api.get("enable"))
+    ports = _free_ports(n_workers * 2 if want_api else n_workers)
+    cluster_ports = ports[:n_workers]
+    api_ports = ports[n_workers:] if want_api else None
     configs = []
     for i in range(n_workers):
         cfg = dict(base_config or {})
@@ -80,6 +99,10 @@ def worker_configs(
         if use_device is not None:
             engine["use_device"] = use_device
         cfg["engine"] = engine
+        if tracing is not None:
+            cfg["tracing"] = dict(tracing)
+        if api_ports is not None:
+            cfg["api"] = {**base_api, "port": api_ports[i]}
         cfg["cluster"] = {
             "enable": True,
             "bind": "127.0.0.1",
@@ -181,10 +204,11 @@ def spawn_workers(
     bind: str = "0.0.0.0",
     base_config: Optional[Dict] = None,
     use_device: Optional[bool] = False,
+    tracing: Optional[Dict] = None,
 ) -> WorkerPool:
     pool = WorkerPool(worker_configs(
         n_workers, port, bind=bind, base_config=base_config,
-        use_device=use_device,
+        use_device=use_device, tracing=tracing,
     ))
     pool.start()
     return pool

@@ -87,6 +87,30 @@ def msg_to_wire(msg: Message) -> Dict[str, Any]:
     }
 
 
+def _fwd_spans(msgs) -> list:
+    """Pending forward spans riding a buffered window's traced copies
+    (unsampled messages carry none)."""
+    out = []
+    for m in msgs:
+        span = getattr(m, "_trace_fwd", None)
+        if span is not None:
+            out.append(span)
+    return out
+
+
+def strip_wire_trace_ctx(wires) -> None:
+    """Strip the lifecycle-trace user property from wire-form message
+    dicts IN PLACE.  Used on paths that hand wires to a session mqueue
+    WITHOUT passing a broker ingress (quorum-orphan storage → restore):
+    everywhere else the receiving node's ingress strips the carrier."""
+    from ..tracecontext import extract_strip
+
+    for w in wires:
+        props = w.get("properties")
+        if props:
+            extract_strip(props)
+
+
 def msg_from_wire(obj: Dict[str, Any]) -> Message:
     return Message(
         topic=obj["topic"],
@@ -1053,8 +1077,23 @@ class ClusterNode:
             reply = await self.transport.call(node, {
                 "type": "forward_sync", "msgs": wires,
             }, timeout=timeout)
+            spans = _fwd_spans(msgs)
             if reply and reply.get("ok"):
+                for span in spans:
+                    span.end(True)
                 return
+            # close the forward spans on the retry/orphan path BEFORE
+            # the quorum submit (which may raise and re-queue): the
+            # publisher-side trace must close even when the target died
+            # mid-window.  PendingForward.end is once-only, so the
+            # re-queued retry cannot double-emit.
+            for span in spans:
+                span.end(False, "no ack; quorum-orphaned")
+            # orphaned wires bypass the peer's ingress strip (they
+            # restore straight into session mqueues), so the trace
+            # carrier must come OFF here or it reaches a subscriber's
+            # wire on replay
+            strip_wire_trace_ctx(wires)
             self.broker.metrics.inc("messages.forward.failed",
                                     len(msgs))
             await self.raft_ds.submit(
@@ -1227,11 +1266,28 @@ class ClusterNode:
         """Buffer the message per destination; the flush loop coalesces
         each window into ONE binary frame per peer (payload bytes raw)
         — the batched, re-encode-free analogue of async forward casts
-        (rpc.mode=async, emqx_broker.erl:387-391; VERDICT r2 weak #7)."""
+        (rpc.mode=async, emqx_broker.erl:387-391; VERDICT r2 weak #7).
+
+        A SAMPLED message buffers a traced copy per peer instead: a
+        ``message.forward`` span opens here and its id rides the
+        copy's user properties across the wire, so the peer's
+        forwarded-dispatch span parents to it — one connected trace
+        per hop.  The span is closed by whichever flush path learns
+        the outcome; unsampled messages buffer the original object
+        untouched."""
+        lifecycle = getattr(self.broker, "lifecycle", None)
+        ctx = (
+            getattr(msg, "_trace_ctx", None)
+            if lifecycle is not None and lifecycle.active else None
+        )
         for node in nodes:
             if node in self._down:
                 continue
-            self._pending_fwd.setdefault(node, []).append(msg)
+            m = (
+                lifecycle.forward_copy(msg, ctx, node)
+                if ctx is not None else msg
+            )
+            self._pending_fwd.setdefault(node, []).append(m)
             if len(self._pending_fwd[node]) >= self.flush_max:
                 self._flush_wakeup.set()
 
@@ -1243,7 +1299,8 @@ class ClusterNode:
         for node, msgs in pending.items():
             blob = encode_messages(msgs)
             task = loop.create_task(
-                self._forward_blob(node, blob, len(msgs))
+                self._forward_blob(node, blob, len(msgs),
+                                   _fwd_spans(msgs))
             )
             self._fwd_tasks.add(task)
             task.add_done_callback(self._fwd_done)
@@ -1256,10 +1313,16 @@ class ClusterNode:
                 "%s: forward task crashed", self.name, exc_info=task.exception()
             )
 
-    async def _forward_blob(self, node: str, blob: bytes, n: int) -> None:
+    async def _forward_blob(self, node: str, blob: bytes, n: int,
+                            spans=()) -> None:
         ok = await self.transport.cast_bin(node, "forward_batch", blob)
         if not ok:
             self.broker.metrics.inc("messages.forward.failed", n)
+        for span in spans:
+            # async mode: the span closes at the handoff outcome (sent
+            # vs peer unreachable) — a dropped or timed-out leg still
+            # yields a CLOSED span on the publisher, never a leak
+            span.end(ok, "" if ok else "peer unreachable")
 
     async def _handle_forward_batch(self, peer: str, obj: Dict) -> None:
         from .wire import decode_messages

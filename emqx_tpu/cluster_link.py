@@ -81,27 +81,41 @@ def filters_intersect(a: str, b: str) -> bool:
         i += 1
 
 
-def _wrap(msg: Message, origin: str) -> bytes:
-    return json.dumps({
+def _wrap(msg: Message, origin: str,
+          trace: Optional[str] = None) -> bytes:
+    out = {
         "t": msg.topic,
         "p": base64.b64encode(msg.payload).decode(),
         "q": msg.qos,
         "r": msg.retain,
         "o": origin,
         "c": msg.from_client,
-    }).encode()
+    }
+    if trace:
+        # lifecycle trace context ("<trace32>-<link.forward span16>"),
+        # the same v5-user-property-shaped value the cluster forward
+        # wire carries: the importing broker's spans parent to this
+        # link's forward span
+        out["x"] = trace
+    return json.dumps(out).encode()
 
 
 def _unwrap(payload: bytes) -> Optional[Message]:
     try:
         d = json.loads(payload)
+        headers = {"cluster_origin": d.get("o", "?")}
+        if d.get("x"):
+            # broker-internal header, adopted (and popped) by the
+            # importing broker's publish ingress when ITS tracing is
+            # on; never serialized toward subscribers either way
+            headers["trace_ctx"] = str(d["x"])
         return Message(
             topic=d["t"],
             payload=base64.b64decode(d["p"]),
             qos=int(d.get("q", 0)),
             retain=bool(d.get("r", False)),
             from_client=d.get("c", ""),
-            headers={"cluster_origin": d.get("o", "?")},
+            headers=headers,
         )
     except (ValueError, KeyError, TypeError):
         return None
@@ -383,8 +397,27 @@ class LinkServer:
             # re-forwarding duplicates deliveries, and in a cycle it
             # ping-pongs forever
             return None
+        lifecycle = getattr(self.broker, "lifecycle", None)
+        ctx = getattr(msg, "_trace_ctx", None) if (
+            lifecycle is not None and lifecycle.active
+        ) else None
         for cluster, filters in self.extern_routes.items():
             if any(T.match(topic, f) for f in filters):
+                pend = None
+                trace = None
+                if ctx is not None:
+                    # a sampled message's link hop gets its own span;
+                    # the wrapper carries (trace, span) so the
+                    # importing cluster parents to it.  Closed on
+                    # EVERY outcome below — a failpoint-eaten egress
+                    # still closes the publisher-side trace.
+                    from .tracecontext import encode_ctx
+
+                    pend = lifecycle.begin_forward(
+                        ctx, "link.forward", cluster,
+                        topic=msg.topic, mid=msg.mid.hex(),
+                    )
+                    trace = encode_ctx(ctx.trace_id, pend.span_id)
                 if failpoints.enabled:
                     # link-forward chaos seam, keyed by peer cluster so
                     # a `match` filter partitions one link.  `drop`
@@ -392,18 +425,27 @@ class LinkServer:
                     # sees it); `error` raises into the publish hook's
                     # recovery.  Sync seam on the loop thread — inject
                     # latency at cluster.transport.* instead of here
-                    act = failpoints.evaluate(
-                        "cluster.link.forward", key=cluster
-                    )
+                    try:
+                        act = failpoints.evaluate(
+                            "cluster.link.forward", key=cluster
+                        )
+                    except Exception:
+                        if pend is not None:
+                            pend.end(False, "failpoint error")
+                        raise
                     if act == "drop":
+                        if pend is not None:
+                            pend.end(False, "failpoint drop")
                         continue
                 self.broker.metrics.inc("cluster_link.egress")
                 self.broker.publish(Message(
                     topic=MSG_PREFIX + cluster,
-                    payload=_wrap(msg, self.local_cluster),
+                    payload=_wrap(msg, self.local_cluster, trace=trace),
                     qos=1,
                     headers={"link_egress": True},
                 ))
+                if pend is not None:
+                    pend.end(True)
         return None
 
     def _route_op(self, cluster: str, payload: bytes,

@@ -153,6 +153,23 @@ class ProfilerConfig:
 
 
 @dataclass
+class TracingConfig:
+    """Message-lifecycle tracing (tracecontext.py): head-sampled trace
+    contexts carried through the batched hot path and across cluster /
+    multicore boundaries.  ``sample_rate`` is the head-sampling
+    probability; ``topic_filters`` always-sample matching topics
+    (debug a specific flow at rate 0); ``seed`` makes sampling
+    decisions reproducible (chaos runs); ``store_max`` bounds the
+    in-process trace store (whole-trace FIFO eviction)."""
+
+    enable: bool = False
+    sample_rate: float = 0.0
+    topic_filters: List[str] = field(default_factory=list)
+    store_max: int = 512
+    seed: Optional[int] = None
+
+
+@dataclass
 class ApiConfig:
     """Management REST + Prometheus endpoint (emqx_management slice).
 
@@ -241,6 +258,7 @@ class BrokerConfig:
     flapping: FlappingConfig = field(default_factory=FlappingConfig)
     slow_subs: SlowSubsConfig = field(default_factory=SlowSubsConfig)
     profiler: ProfilerConfig = field(default_factory=ProfilerConfig)
+    tracing: TracingConfig = field(default_factory=TracingConfig)
     # server-side auto-subscribe on connect (emqx_auto_subscribe):
     # entries {"topic": ..., "qos": 0}; %c/%u placeholders supported
     auto_subscribe: List[Dict[str, Any]] = field(default_factory=list)
@@ -476,6 +494,10 @@ def check_config(cfg: BrokerConfig) -> List[str]:
             bad(f"sinks[{j}]: unknown type {stype!r}")
     if not 0 <= float(cfg.otel.trace_sample_ratio) <= 1:
         bad("otel.trace_sample_ratio must be in [0, 1]")
+    if not 0 <= float(cfg.tracing.sample_rate) <= 1:
+        bad("tracing.sample_rate must be in [0, 1]")
+    if int(cfg.tracing.store_max) < 1:
+        bad("tracing.store_max must be >= 1")
     if cfg.engine.use_device not in (None, True, False):
         bad("engine.use_device must be null|true|false")
     return problems

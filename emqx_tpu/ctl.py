@@ -20,6 +20,11 @@ any reachable broker:
                                        |clear [name]]
     python -m emqx_tpu.ctl profiler [summary|windows|reset
                                      |trace [out.json]]
+    python -m emqx_tpu.ctl tracing [status|on [rate]|off|rate <r>
+                                    |filter <topic> ...|traces [n]
+                                    |show <trace_id>|mid <hex>
+                                    |perfetto [out.json] [peer-url ...]
+                                    |reset]
 """
 
 from __future__ import annotations
@@ -40,6 +45,10 @@ class Ctl:
         """`user`/`api_key` are "name:secret" pairs; user logs in for a
         Bearer token, api_key goes as HTTP Basic (emqx_mgmt_auth)."""
         self.base = base.rstrip("/")
+        # remembered so peer-node clients (tracing perfetto merge) can
+        # authenticate the same way
+        self._peer_user = user
+        self._peer_api_key = api_key
         self._auth: Optional[str] = None
         if api_key:
             self._auth = "Basic " + base64.b64encode(
@@ -357,6 +366,116 @@ class Ctl:
         else:
             raise SystemExit(f"unknown profiler action {action!r}")
 
+    def tracing(self, action: str = "status", *args: str) -> None:
+        """Per-message lifecycle tracing: sampler control, trace/mid
+        queries, merged multi-node Perfetto export.
+
+            tracing status
+            tracing on [rate] | off | rate <r> | filter <topic> ...
+            tracing traces [n]
+            tracing show <trace_id>
+            tracing mid <message-id-hex>
+            tracing perfetto [out.json] [peer-api-url ...]
+            tracing reset
+        """
+        if action == "status":
+            info = self._req("/api/v5/tracing")
+            state = (
+                ("ACTIVE" if info["sampling"]
+                 else "on (adopting upstream contexts only)")
+                if info["active"] else "off"
+            )
+            print(f"lifecycle tracing {state}; node {info['node']}")
+            print(
+                f"rate={info['sample_rate']} "
+                f"filters={info['topic_filters']} "
+                f"traces={info['traces']}/{info['store_max']} "
+                f"spans={info['spans']} sampled={info['sampled']} "
+                f"remote={info['remote']} forwards={info['forwards']} "
+                f"evicted={info['evicted']}"
+            )
+        elif action in ("on", "off", "rate", "filter"):
+            body: dict = {}
+            if action == "on":
+                body["enable"] = True
+                if args:
+                    body["sample_rate"] = float(args[0])
+            elif action == "off":
+                body["enable"] = False
+            elif action == "rate":
+                body["enable"] = True
+                body["sample_rate"] = float(args[0])
+            else:
+                body["enable"] = True
+                body["topic_filters"] = list(args)
+            info = self._req("/api/v5/tracing", method="PUT", body=body)
+            print(f"tracing {'ACTIVE' if info['active'] else 'off'}: "
+                  f"rate={info['sample_rate']} "
+                  f"filters={info['topic_filters']}")
+        elif action == "traces":
+            n = int(args[0]) if args else 32
+            data = self._req(f"/api/v5/tracing/traces?limit={n}")["data"]
+            for t in data:
+                print(
+                    f"{t['trace_id']}\t{t['topic']}\t"
+                    f"{t['duration_ms']}ms\tspans={t['n_spans']}\t"
+                    f"nodes={','.join(t['nodes'])}"
+                )
+            print(f"({len(data)} traces)")
+        elif action == "show":
+            out = self._req(f"/api/v5/tracing/traces/{args[0]}")
+            self._print_spans(out["spans"])
+        elif action == "mid":
+            out = self._req(f"/api/v5/tracing/messages/{args[0]}")
+            print(f"trace {out['trace_id']}")
+            self._print_spans(out["spans"])
+        elif action == "perfetto":
+            from .tracecontext import chrome_trace
+
+            out_path = args[0] if args else "tracing_timeline.json"
+            spans = list(self._req("/api/v5/tracing/spans")["data"])
+            # extra operands are PEER api base URLs: merge their span
+            # dumps into ONE timeline (per-node process tracks + flow
+            # events come from the spans' own node labels)
+            for peer in args[1:]:
+                peer_ctl = Ctl(peer, user=self._peer_user,
+                               api_key=self._peer_api_key)
+                spans.extend(
+                    peer_ctl._req("/api/v5/tracing/spans")["data"]
+                )
+            trace = chrome_trace(spans)
+            with open(out_path, "w") as f:
+                json.dump(trace, f)
+            print(
+                f"wrote {len(trace['traceEvents'])} events "
+                f"({len(spans)} spans) to {out_path}; open it at "
+                "https://ui.perfetto.dev or chrome://tracing"
+            )
+        elif action == "reset":
+            self._req("/api/v5/tracing", method="DELETE")
+            print("trace store cleared")
+        else:
+            raise SystemExit(f"unknown tracing action {action!r}")
+
+    @staticmethod
+    def _print_spans(spans: list) -> None:
+        spans = sorted(spans, key=lambda s: s["start_ns"])
+        t0 = spans[0]["start_ns"] if spans else 0
+        for s in spans:
+            off_ms = (s["start_ns"] - t0) / 1e6
+            dur_ms = (s["end_ns"] - s["start_ns"]) / 1e6
+            a = s.get("attrs", {})
+            extra = " ".join(
+                f"{k}={a[k]}" for k in
+                ("topic", "deliveries", "target", "ok", "path")
+                if k in a
+            )
+            print(
+                f"+{off_ms:8.3f}ms {dur_ms:8.3f}ms  {s['node']:<14} "
+                f"{s['name']:<18} span={s['span_id'][:8]} "
+                f"parent={(s.get('parent_id') or '-')[:8]} {extra}"
+            )
+
     def banned(self, action: str = "list", *args: str) -> None:
         if action == "list":
             for b in self._req("/api/v5/banned")["data"]:
@@ -398,7 +517,7 @@ def main(argv=None) -> None:
     )
     ap.add_argument("command", help="status|clients|subscriptions|topics|"
                     "rules|metrics|stats|publish|trace|banned|data|"
-                    "rebalance|failpoints|profiler")
+                    "rebalance|failpoints|profiler|tracing")
     ap.add_argument("args", nargs="*")
     ap.add_argument("--qos", type=int, default=0)
     ns = ap.parse_args(argv)
@@ -430,6 +549,8 @@ def main(argv=None) -> None:
         ctl.failpoints(ns.args[0] if ns.args else "list", *ns.args[1:])
     elif cmd == "profiler":
         ctl.profiler(ns.args[0] if ns.args else "summary", *ns.args[1:])
+    elif cmd == "tracing":
+        ctl.tracing(ns.args[0] if ns.args else "status", *ns.args[1:])
     elif cmd == "data":
         ctl.data(ns.args[0] if ns.args else "export", *ns.args[1:])
     elif cmd == "rebalance":

@@ -46,6 +46,7 @@ import os
 import random
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional
 
 ACTIONS = ("error", "delay", "drop", "duplicate", "panic")
@@ -67,6 +68,11 @@ SEAMS = (
 )
 
 enabled = False  # fast-path gate: disabled brokers pay one bool check
+
+# last fires (wall_ts, name, action, key): the lifecycle tracer reads
+# this ring to attach in-window failpoint hits as span events (chaos
+# attribution); deque.append is atomic, so no lock is needed
+RECENT_FIRES: "deque" = deque(maxlen=256)
 
 
 class FailpointError(ConnectionError):
@@ -208,6 +214,7 @@ class FailpointRegistry:
         d = self._decide(name, key)
         if d is None:
             return None
+        RECENT_FIRES.append((time.time(), name, d[0], key))
         if d[0] == "delay":
             time.sleep(d[1])
             return None
@@ -225,6 +232,7 @@ class FailpointRegistry:
         d = self._decide(name, key)
         if d is None:
             return None
+        RECENT_FIRES.append((time.time(), name, d[0], key))
         if d[0] == "delay":
             await asyncio.sleep(d[1])
             return None

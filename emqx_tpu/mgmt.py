@@ -203,6 +203,18 @@ class MgmtApi:
         r.add_get("/api/v5/profiler", self.get_profiler)
         r.add_get("/api/v5/profiler/trace", self.get_profiler_trace)
         r.add_delete("/api/v5/profiler", self.reset_profiler)
+        r.add_get("/api/v5/tracing", self.get_tracing)
+        r.add_put("/api/v5/tracing", self.put_tracing)
+        r.add_delete("/api/v5/tracing", self.reset_tracing)
+        r.add_get("/api/v5/tracing/traces", self.get_tracing_traces)
+        r.add_get(
+            "/api/v5/tracing/traces/{trace_id}", self.get_tracing_trace
+        )
+        r.add_get(
+            "/api/v5/tracing/messages/{mid}", self.get_tracing_by_mid
+        )
+        r.add_get("/api/v5/tracing/spans", self.get_tracing_spans)
+        r.add_get("/api/v5/tracing/trace", self.get_tracing_perfetto)
         r.add_get("/api/v5/trace", self.get_traces)
         r.add_post("/api/v5/trace", self.post_trace)
         r.add_delete("/api/v5/trace/{name}", self.delete_trace)
@@ -685,6 +697,91 @@ class MgmtApi:
     async def reset_profiler(self, request: web.Request) -> web.Response:
         self.broker.profiler.reset()
         return web.Response(status=204)
+
+    # ------------------------------------------- lifecycle tracing
+
+    async def get_tracing(self, request: web.Request) -> web.Response:
+        """Sampler configuration + store stats for the per-message
+        lifecycle tracer (tracecontext.py)."""
+        return _json(self.broker.lifecycle.info())
+
+    async def put_tracing(self, request: web.Request) -> web.Response:
+        """Runtime sampler update: enable, sample_rate, topic_filters,
+        seed — debug a live flow without a restart."""
+        try:
+            body = await request.json()
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+            rate = body.get("sample_rate")
+            if rate is not None:
+                rate = float(rate)
+                if not 0.0 <= rate <= 1.0:
+                    raise ValueError("sample_rate must be in [0, 1]")
+            filters = body.get("topic_filters")
+            if filters is not None:
+                filters = [str(f) for f in filters]
+            self.broker.lifecycle.configure(
+                enable=body.get("enable"),
+                sample_rate=rate,
+                topic_filters=filters,
+                seed=body.get("seed"),
+            )
+        except (TypeError, ValueError, json.JSONDecodeError) as exc:
+            return _json({"code": "BAD_REQUEST", "message": str(exc)}, 400)
+        return _json(self.broker.lifecycle.info())
+
+    async def reset_tracing(self, request: web.Request) -> web.Response:
+        self.broker.lifecycle.store.clear()
+        return web.Response(status=204)
+
+    async def get_tracing_traces(self, request: web.Request) -> web.Response:
+        try:
+            limit = int(request.query.get("limit", 64))
+        except ValueError:
+            return _json({"code": "BAD_REQUEST",
+                          "message": "limit must be an integer"}, 400)
+        return _json({"data": self.broker.lifecycle.store.traces(limit)})
+
+    async def get_tracing_trace(self, request: web.Request) -> web.Response:
+        tid = request.match_info["trace_id"]
+        spans = self.broker.lifecycle.store.get(tid)
+        if not spans:
+            return _json({"code": "NOT_FOUND",
+                          "message": f"no trace {tid}"}, 404)
+        return _json({"trace_id": tid, "spans": spans})
+
+    async def get_tracing_by_mid(self, request: web.Request) -> web.Response:
+        """Message-id lookup: the hex mid every span carries (and the
+        slow-subs board reports) opens directly as its full trace."""
+        mid = request.match_info["mid"]
+        store = self.broker.lifecycle.store
+        tid = store.by_mid(mid)
+        if tid is None:
+            return _json({"code": "NOT_FOUND",
+                          "message": f"no trace for message {mid}"}, 404)
+        return _json({"trace_id": tid, "mid": mid,
+                      "spans": store.get(tid)})
+
+    async def get_tracing_spans(self, request: web.Request) -> web.Response:
+        """Raw span dump (this node only) — the merge feed for a
+        multi-node Perfetto timeline (``ctl tracing perfetto``
+        concatenates several nodes' dumps)."""
+        return _json({
+            "node": self.broker.lifecycle.node,
+            "data": self.broker.lifecycle.store.spans(),
+        })
+
+    async def get_tracing_perfetto(self, request: web.Request) -> web.Response:
+        """The trace store as a Perfetto-loadable timeline: one
+        process track per node/worker seen in the spans, flow events
+        linking each forward hop (``?trace_id=`` narrows to one
+        trace)."""
+        from .tracecontext import chrome_trace
+
+        store = self.broker.lifecycle.store
+        tid = request.query.get("trace_id")
+        spans = store.get(tid) if tid else store.spans()
+        return _json(chrome_trace(spans))
 
     # ----------------------------------------------------- trace/audit
 

@@ -34,6 +34,7 @@ Flight recorder
 
 from __future__ import annotations
 
+import os
 import re
 import threading
 import time
@@ -248,8 +249,16 @@ class Profiler:
         ring_size: int = 256,
         events_cap: int = 256,
         enabled: bool = True,
+        process_label: str = "emqx_tpu",
+        pid: Optional[int] = None,
     ) -> None:
         self.enabled = enabled
+        # explicit process identity for the trace export: without a
+        # real pid + node label every node/worker's tracks land under
+        # one implicit process and merged multi-node timelines
+        # interleave into a single row group
+        self.process_label = process_label
+        self.pid = pid if pid is not None else os.getpid()
         self._hlock = threading.Lock()  # ONE lock for all histograms
         self._hist: Dict[str, Histogram] = {
             name: Histogram(lock=self._hlock) for name in self.STAGES
@@ -386,15 +395,21 @@ class Profiler:
             ts - dur for _k, ts, dur, _m in engine_events
         ]
         epoch = min(starts) if starts else 0.0
+        pid = self.pid
         events: List[Dict[str, object]] = [
-            {"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
-             "args": {"name": "emqx_tpu window pipeline"}},
+            {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+             "args": {"name": (
+                 f"emqx_tpu window pipeline [{self.process_label} "
+                 f"pid={pid}]"
+             )}},
+            {"name": "process_sort_index", "ph": "M", "pid": pid,
+             "tid": 0, "args": {"sort_index": pid}},
         ]
         for rec in recs:
             tid = rec.seq
             base_us = (rec.wall0 - epoch) * 1e6
             events.append({
-                "name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+                "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
                 "args": {"name": f"window {rec.seq} ({rec.source})"},
             })
             cursor = base_us  # monotonic clamp: contiguous span
@@ -410,16 +425,16 @@ class Profiler:
                     "breaker_open": rec.breaker_open,
                 }
                 events.append({
-                    "name": name, "ph": "B", "pid": 1, "tid": tid,
+                    "name": name, "ph": "B", "pid": pid, "tid": tid,
                     "ts": b_ts, "args": args,
                 })
                 events.append({
-                    "name": name, "ph": "E", "pid": 1, "tid": tid,
+                    "name": name, "ph": "E", "pid": pid, "tid": tid,
                     "ts": e_ts,
                 })
         for kind, ts, dur, meta in engine_events:
             events.append({
-                "name": kind, "ph": "X", "pid": 1, "tid": 0,
+                "name": kind, "ph": "X", "pid": pid, "tid": 0,
                 "ts": (ts - dur - epoch) * 1e6, "dur": dur * 1e6,
                 "args": dict(meta),
             })

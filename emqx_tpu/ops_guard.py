@@ -239,11 +239,17 @@ class SlowSubs:
         self._heap: List[Tuple] = []
         self._seq = 0
 
-    def record(self, clientid: str, topic: str, latency_ms: float) -> None:
+    def record(self, clientid: str, topic: str, latency_ms: float,
+               trace_id: str = "") -> None:
+        """``trace_id``: a sampled message's lifecycle trace id, so a
+        slow delivery is directly openable as a full trace (empty for
+        unsampled deliveries).  Rides the END of the heap tuple —
+        (latency, seq) stay the unique ordering keys."""
         if latency_ms < self.threshold_ms:
             return
         self._seq += 1
-        item = (latency_ms, self._seq, clientid, topic, time.time())
+        item = (latency_ms, self._seq, clientid, topic, time.time(),
+                trace_id)
         if len(self._heap) < self.top_k:
             heapq.heappush(self._heap, item)
         elif item > self._heap[0]:
@@ -270,8 +276,10 @@ class SlowSubs:
                 "topic": topic,
                 "latency_ms": round(lat, 3),
                 "at": ts,
+                "trace_id": trace_id,
             }
-            for lat, _, cid, topic, ts in sorted(self._heap, reverse=True)
+            for lat, _, cid, topic, ts, trace_id
+            in sorted(self._heap, reverse=True)
         ]
 
     def clear(self) -> None:

@@ -47,6 +47,38 @@ def _attrs(d: Dict[str, str]) -> List[dict]:
     ]
 
 
+def lifecycle_span_json(d: Dict) -> Dict:
+    """A lifecycle-tracer span dict (tracecontext.py shape) as OTLP
+    JSON — span events included, so the window's stage boundaries and
+    failpoint hits arrive at the collector attached to the span."""
+    out = {
+        "traceId": d["trace_id"],
+        "spanId": d["span_id"],
+        "name": d["name"],
+        "kind": 1,  # INTERNAL: broker pipeline stages
+        "startTimeUnixNano": str(d["start_ns"]),
+        "endTimeUnixNano": str(d["end_ns"]),
+        "attributes": _attrs({
+            **d.get("attrs", {}),
+            "node": d.get("node", ""),
+            "mid": d.get("mid", ""),
+        }),
+    }
+    if d.get("parent_id"):
+        out["parentSpanId"] = d["parent_id"]
+    events = d.get("events")
+    if events:
+        out["events"] = [
+            {
+                "timeUnixNano": str(e["ts_ns"]),
+                "name": e["name"],
+                "attributes": _attrs(e.get("attrs", {})),
+            }
+            for e in events
+        ]
+    return out
+
+
 class Span:
     """One in-flight span; finished spans serialize to the OTLP JSON
     span shape."""
@@ -188,6 +220,7 @@ class OtelExporter:
         self._metrics_worker: Optional[BufferWorker] = None
         self._logs_worker: Optional[BufferWorker] = None
         self._traces_worker: Optional[BufferWorker] = None
+        self._lc_pending: List[Dict] = []  # lifecycle spans awaiting flush
         self._handler: Optional[logging.Handler] = None
         self._last: float = 0.0
         self._resource = {
@@ -232,6 +265,12 @@ class OtelExporter:
             self.tracer.on_flush = self._flush_spans
             # the broker's publish/dispatch path consults this handle
             self.broker.tracer = self.tracer
+            # lifecycle-tracer spans (tracecontext.py) flow out through
+            # the SAME traces worker: the in-process store serves local
+            # queries, the collector gets the distributed picture
+            lifecycle = getattr(self.broker, "lifecycle", None)
+            if lifecycle is not None:
+                lifecycle.on_export = self._export_lifecycle
 
     async def stop(self) -> None:
         if self._handler is not None:
@@ -240,6 +279,11 @@ class OtelExporter:
         if self.tracer is not None:
             self.broker.tracer = None
             self.tracer.flush()
+            lifecycle = getattr(self.broker, "lifecycle", None)
+            if lifecycle is not None and \
+                    lifecycle.on_export == self._export_lifecycle:
+                lifecycle.on_export = None
+            self._flush_lifecycle()
         if self._metrics_worker is not None:
             await self._metrics_worker.stop()
             self._metrics_worker = None
@@ -253,16 +297,35 @@ class OtelExporter:
     def _flush_spans(self, spans: List[Span]) -> None:
         if self._traces_worker is None:
             return
+        self._enqueue_span_json([s.to_json() for s in spans])
+
+    def _enqueue_span_json(self, spans: List[Dict]) -> None:
         body = json.dumps({
             "resourceSpans": [{
                 "resource": self._resource,
                 "scopeSpans": [{
                     "scope": {"name": "emqx_tpu"},
-                    "spans": [s.to_json() for s in spans],
+                    "spans": spans,
                 }],
             }]
         }).encode()
         self._traces_worker.enqueue(body)
+
+    def _export_lifecycle(self, span: Dict) -> None:
+        """LifecycleTracer.on_export target: batch finished lifecycle
+        spans and flush them with the ordinary span cadence (size
+        threshold here, the 1 Hz tick below bounds latency)."""
+        self._lc_pending.append(lifecycle_span_json(span))
+        if len(self._lc_pending) >= 64:
+            self._flush_lifecycle()
+
+    def _flush_lifecycle(self) -> None:
+        if self._lc_pending and self._traces_worker is not None:
+            pending, self._lc_pending = self._lc_pending, []
+            try:
+                self._enqueue_span_json(pending)
+            except Exception:
+                pass  # export must never affect dispatch
 
     # -------------------------------------------------------- metrics
 
@@ -272,6 +335,7 @@ class OtelExporter:
         now = time.time() if now is None else now
         if self.tracer is not None:
             self.tracer.flush()  # bound span latency to the tick
+            self._flush_lifecycle()
         if now - self._last < self.interval:
             return False
         self._last = now

@@ -177,6 +177,14 @@ class TraceManager:
     def _on_unsubscribed(self, clientid, flt, *rest) -> None:
         self._emit("session.unsubscribed", clientid, flt)
 
+    @staticmethod
+    def _trace_tag(msg) -> str:
+        """``trace=<id>`` for a lifecycle-sampled message: the line in
+        the operator's per-trace log file links straight to the full
+        distributed trace (``ctl tracing show <id>``)."""
+        ctx = getattr(msg, "_trace_ctx", None)
+        return f" trace={ctx.trace_id}" if ctx is not None else ""
+
     def _on_publish(self, msg):
         if not self._rules:
             return None  # no active traces: skip the format work
@@ -184,7 +192,8 @@ class TraceManager:
             "message.publish",
             msg.from_client or None,
             msg.topic,
-            f"qos={msg.qos} len={len(msg.payload)}",
+            f"qos={msg.qos} len={len(msg.payload)}"
+            f"{self._trace_tag(msg)}",
         )
         return None  # never alters the fold accumulator
 
@@ -193,5 +202,6 @@ class TraceManager:
             return  # no active traces: stay off the fan-out hot path
         for msg, _opts in deliveries:
             self._emit(
-                "message.delivered", clientid, msg.topic, f"qos={msg.qos}"
+                "message.delivered", clientid, msg.topic,
+                f"qos={msg.qos}{self._trace_tag(msg)}",
             )

@@ -550,6 +550,121 @@ def test_perf401_declared_functions_exist_in_repo():
         assert (repo / d.path_suffix).exists(), d
 
 
+# ------------------------------------------------------------- OBS601
+
+def test_obs601_unguarded_tracer_in_dispatch_loop():
+    bad = (
+        "class B:\n"
+        "    def fan_out(self, deliveries):\n"
+        "        for m, opts in deliveries:\n"
+        "            self.tracer.start('message.deliver', m.topic)\n"
+    )
+    assert "OBS601" in rules_of(bad, path="pkg/disp.py",
+                                dispatch=_DISPATCH)
+    # trace-context ALLOCATION in the loop fires too
+    ctor = bad.replace(
+        "self.tracer.start('message.deliver', m.topic)",
+        "m.ctx = TraceContext('t', 's')",
+    )
+    assert "OBS601" in rules_of(ctor, path="pkg/disp.py",
+                                dispatch=_DISPATCH)
+    # deep receiver chains resolve (`self.broker.lifecycle.emit`)
+    chain = bad.replace(
+        "self.tracer.start('message.deliver', m.topic)",
+        "self.broker.lifecycle.emit(m)",
+    )
+    assert "OBS601" in rules_of(chain, path="pkg/disp.py",
+                                dispatch=_DISPATCH)
+    # an unrelated module is not checked
+    assert "OBS601" not in rules_of(bad, path="pkg/other.py",
+                                    dispatch=_DISPATCH)
+    # a loop that is a DIRECT child of a (non-sampling) if body is
+    # still a dispatch loop — the walker must flip in_loop for it
+    loop_under_if = (
+        "class B:\n"
+        "    def fan_out(self, deliveries):\n"
+        "        if self.enabled:\n"
+        "            for m, opts in deliveries:\n"
+        "                self.tracer.start('deliver', m.topic)\n"
+    )
+    assert "OBS601" in rules_of(loop_under_if, path="pkg/disp.py",
+                                dispatch=_DISPATCH)
+
+
+def test_obs601_sampled_guard_and_hoist_pass():
+    # the sampled-check idiom: per-message ctx probe, tracer work only
+    # inside `if ctx is not None:`
+    guarded = (
+        "class B:\n"
+        "    def fan_out(self, deliveries):\n"
+        "        for m, opts in deliveries:\n"
+        "            ctx = getattr(m, '_trace_ctx', None)\n"
+        "            if ctx is not None:\n"
+        "                self.tracer.start('deliver', m.topic)\n"
+    )
+    assert "OBS601" not in rules_of(guarded, path="pkg/disp.py",
+                                    dispatch=_DISPATCH)
+    # guard NESTED under an unrelated if still counts (walker descends
+    # ifs at entry, not only as direct children)
+    nested = (
+        "class B:\n"
+        "    def fan_out(self, deliveries):\n"
+        "        for m, opts in deliveries:\n"
+        "            if self.tracer is not None:\n"
+        "                span = getattr(m, '_span', None)\n"
+        "                if span is not None:\n"
+        "                    self.tracer.end(span)\n"
+    )
+    assert "OBS601" not in rules_of(nested, path="pkg/disp.py",
+                                    dispatch=_DISPATCH)
+    # the else branch of a guard is NOT guarded
+    unguarded_else = (
+        "class B:\n"
+        "    def fan_out(self, deliveries):\n"
+        "        for m, opts in deliveries:\n"
+        "            if m.sampled:\n"
+        "                pass\n"
+        "            else:\n"
+        "                self.tracer.start('deliver', m.topic)\n"
+    )
+    assert "OBS601" in rules_of(unguarded_else, path="pkg/disp.py",
+                                dispatch=_DISPATCH)
+    # once-per-window emission OUTSIDE the loop: fine
+    hoisted = (
+        "class B:\n"
+        "    def fan_out(self, deliveries):\n"
+        "        for m, opts in deliveries:\n"
+        "            m.deliver()\n"
+        "        self.lifecycle.window_spans(deliveries)\n"
+    )
+    assert "OBS601" not in rules_of(hoisted, path="pkg/disp.py",
+                                    dispatch=_DISPATCH)
+
+
+def test_obs601_suppression_comment():
+    sup = (
+        "class B:\n"
+        "    def fan_out(self, deliveries):\n"
+        "        for m, opts in deliveries:\n"
+        "            self.tracer.start('deliver')"
+        "  # brokerlint: ignore[OBS601]\n"
+    )
+    assert "OBS601" not in rules_of(sup, path="pkg/disp.py",
+                                    dispatch=_DISPATCH)
+
+
+def test_obs601_instrumented_dispatch_path_clean():
+    """The acceptance gate: the PR's own instrumentation of the
+    dispatch path (ingress stamping, window_spans emission, slow-subs
+    trace ids) introduces NO unguarded tracing work in the dispatch
+    hot loops."""
+    findings = [
+        f for f in run_lint(["emqx_tpu/broker"])
+        if f.rule == "OBS601"
+    ]
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
 # ------------------------------------------------------------ the gate
 
 def test_repo_has_no_findings_beyond_baseline():

@@ -1,0 +1,805 @@
+"""End-to-end message-lifecycle tracing (PR 8): head-sampled trace
+contexts through the batched hot path, across cluster links and
+multicore-style worker hops.
+
+The referees:
+  * sampler/store units (seeded determinism, whole-trace FIFO
+    eviction, message-id index hygiene);
+  * local publish→dispatch spans cut from the window profiler's
+    timestamps, queryable by trace id AND message id over REST;
+  * the acceptance hop — a publish on node A delivered via cluster
+    forward on node B yields ONE connected trace (B's dispatch span
+    parents to A's forward span) and a merged Perfetto timeline with
+    both nodes as distinct processes linked by a flow event; the same
+    shape for worker-labeled nodes (the multicore hop rides the same
+    inter-node transport);
+  * chaos: with the cluster.link.forward failpoint eating egress,
+    publisher-side traces still CLOSE and the bounded store never
+    leaks (and spans never hold payload bytes);
+  * the hot-path bargain: sampling off (rate=0) is byte-identical on
+    every connection's wire vs. tracing disabled, adds zero store
+    entries and zero per-message objects, and a paired A/B fanout-256
+    run stays within noise.
+"""
+
+import asyncio
+import json
+import time
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.channel import Channel
+from emqx_tpu.broker.listener import BrokerServer
+from emqx_tpu.broker.session import SubOpts
+from emqx_tpu.cluster import ClusterNode
+from emqx_tpu.codec import mqtt as C
+from emqx_tpu.config import BrokerConfig
+from emqx_tpu.message import Message
+from emqx_tpu import failpoints
+from emqx_tpu.tracecontext import (
+    TRACE_PROP,
+    HeadSampler,
+    TraceStore,
+    chrome_trace,
+    decode_ctx,
+    encode_ctx,
+    extract_strip,
+    inject_props,
+)
+from mqtt_client import TestClient
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _cfg(enable=True, rate=1.0, filters=(), seed=7, store_max=512):
+    cfg = BrokerConfig()
+    cfg.engine.use_device = False
+    cfg.tracing.enable = enable
+    cfg.tracing.sample_rate = rate
+    cfg.tracing.topic_filters = list(filters)
+    cfg.tracing.seed = seed
+    cfg.tracing.store_max = store_max
+    return cfg
+
+
+class WireChannel(Channel):
+    """Real Channel over a capturing transport (true wire bytes, true
+    cork behavior), as in test_dispatch_native."""
+
+    def __init__(self, broker, version=C.MQTT_V5):
+        self.writes = []
+
+        def send(pkts):
+            self.writes.append(
+                b"".join(C.serialize(p, self.version) for p in pkts)
+            )
+
+        super().__init__(broker, send=send, close=lambda r: None)
+        self.version = version
+
+
+def _fanout_broker(cfg, n_subs=3, flt="t/#", qos=0):
+    b = Broker(config=cfg)
+    chans = {}
+    for i in range(n_subs):
+        ch = WireChannel(b)
+        cid = f"c{i}"
+        session, _ = b.cm.open_session(True, cid, ch)
+        session.subscribe(flt, SubOpts(qos=qos))
+        b.subscribe(cid, flt, SubOpts(qos=qos))
+        chans[cid] = ch
+    return b, chans
+
+
+# ------------------------------------------------------------ sampler
+
+
+def test_sampler_rate_and_filters():
+    off = HeadSampler(rate=0.0)
+    assert not off.active
+    assert not off.decide("t/x")
+    always = HeadSampler(rate=1.0)
+    assert always.decide("t/x")
+    # rate-sampling skips $-reserved topics (broker plumbing)...
+    assert not always.decide("$SYS/brokers")
+    # ...but an explicit topic filter still pins them
+    pinned = HeadSampler(rate=0.0, topic_filters=["$SYS/#", "fleet/+/t"])
+    assert pinned.active
+    assert pinned.decide("$SYS/brokers")
+    assert pinned.decide("fleet/v9/t")
+    assert not pinned.decide("fleet/v9/other")
+
+
+def test_sampler_seeded_determinism():
+    a = HeadSampler(rate=0.3, seed=42)
+    b = HeadSampler(rate=0.3, seed=42)
+    decisions_a = [a.decide(f"t/{i}") for i in range(200)]
+    decisions_b = [b.decide(f"t/{i}") for i in range(200)]
+    assert decisions_a == decisions_b
+    assert any(decisions_a) and not all(decisions_a)
+    assert a.span_id() == b.span_id()
+    assert a.trace_id() == b.trace_id()
+
+
+def test_context_codec_roundtrip_and_strip():
+    props = {"user_property": [("k", "v")]}
+    inject_props(props, "a" * 32, "b" * 16)
+    assert (TRACE_PROP, encode_ctx("a" * 32, "b" * 16)) \
+        in props["user_property"]
+    # list-shaped pairs (the binary wire JSON round-trip) decode too
+    props["user_property"] = [
+        list(p) for p in props["user_property"]
+    ]
+    got = extract_strip(props)
+    assert got == ("a" * 32, "b" * 16)
+    # only the carrier pair is stripped; foreign pairs survive
+    assert props["user_property"] == [["k", "v"]]
+    # absent/foreign-only properties: untouched, None
+    assert extract_strip(props) is None
+    assert decode_ctx("junk") is None
+
+
+def test_store_bounded_eviction_with_mid_index():
+    store = TraceStore(max_traces=4)
+    for i in range(10):
+        store.add({
+            "trace_id": f"{i:032x}", "span_id": f"{i:016x}",
+            "parent_id": None, "name": "message.publish",
+            "node": "n", "start_ns": i, "end_ns": i + 1,
+            "mid": f"{i:08x}", "attrs": {"topic": "t"}, "events": [],
+        })
+    assert len(store) == 4
+    assert store.stats["evicted"] == 6
+    # evicted traces took their mid-index entries with them
+    assert store.by_mid(f"{0:08x}") is None
+    assert store.by_mid(f"{9:08x}") == f"{9:032x}"
+    assert len(store.traces(100)) == 4
+    store.clear()
+    assert len(store) == 0 and store.spans() == []
+
+
+# ----------------------------------------------------- local pipeline
+
+
+def test_local_publish_spans_from_window_record():
+    b, _ = _fanout_broker(_cfg(rate=1.0), n_subs=3)
+    counts = b.publish_many(
+        [Message(topic="t/1", payload=b"x") for _ in range(4)]
+    )
+    assert counts == [3, 3, 3, 3]
+    spans = b.lifecycle.store.spans()
+    assert len(spans) == 4  # one span per sampled message
+    for s in spans:
+        assert s["name"] == "message.publish"
+        assert s["parent_id"] is None
+        assert s["attrs"]["deliveries"] == 3
+        assert s["attrs"]["n_clients"] == 3
+        assert s["attrs"]["path"] == "host"
+        assert s["end_ns"] > s["start_ns"]
+        # stage events come from the EXISTING WindowRecord timestamps
+        names = {e["name"] for e in s["events"]}
+        assert {"stage.expand", "stage.deliver", "stage.flush"} <= names
+        # spans carry ids and scalars only — never the message body
+        assert "payload" not in json.dumps(s)
+    # queryable by message id
+    mid = spans[0]["mid"]
+    assert b.lifecycle.store.by_mid(mid) == spans[0]["trace_id"]
+    # distinct messages get distinct traces
+    assert len({s["trace_id"] for s in spans}) == 4
+
+
+def test_spans_emitted_with_profiler_disabled():
+    cfg = _cfg(rate=1.0)
+    cfg.profiler.enable = False
+    b, _ = _fanout_broker(cfg, n_subs=1)
+    assert b.publish_many([Message(topic="t/1")]) == [1]
+    (span,) = b.lifecycle.store.spans()
+    assert span["end_ns"] >= span["start_ns"] > 0
+    assert span["events"] == []  # no flight record, no stage events
+
+
+def test_topic_filter_pins_flow_at_rate_zero():
+    b, _ = _fanout_broker(_cfg(rate=0.0, filters=["fleet/+/temp"]),
+                          n_subs=1, flt="#")
+    b.publish_many([
+        Message(topic="fleet/v1/temp"),
+        Message(topic="other/x"),
+    ])
+    spans = b.lifecycle.store.spans()
+    assert [s["attrs"]["topic"] for s in spans] == ["fleet/v1/temp"]
+
+
+def test_slow_subs_entry_links_trace_id():
+    cfg = _cfg(rate=1.0)
+    cfg.slow_subs.threshold_ms = 1.0
+    b, _ = _fanout_broker(cfg, n_subs=1)
+    stale = Message(topic="t/slow", timestamp=time.time() - 5.0)
+    b.publish_many([stale])
+    (entry,) = b.slow_subs.top()
+    assert entry["topic"] == "t/slow"
+    tid = entry["trace_id"]
+    assert tid and b.lifecycle.store.get(tid)
+
+
+def test_runtime_configure_flips_active():
+    b, _ = _fanout_broker(_cfg(enable=False, rate=0.0), n_subs=1)
+    assert not b.lifecycle.active
+    b.publish_many([Message(topic="t/1")])
+    assert b.lifecycle.store.spans() == []
+    b.lifecycle.configure(enable=True, sample_rate=1.0)
+    assert b.lifecycle.active
+    b.publish_many([Message(topic="t/1")])
+    assert len(b.lifecycle.store.spans()) == 1
+    # rate back to 0: still ACTIVE (adopts upstream contexts) but no
+    # fresh sampling
+    b.lifecycle.configure(sample_rate=0.0)
+    assert b.lifecycle.active and not b.lifecycle.sampler.active
+    b.publish_many([Message(topic="t/1")])
+    assert len(b.lifecycle.store.spans()) == 1
+    b.lifecycle.configure(enable=False)
+    assert not b.lifecycle.active
+
+
+# ------------------------------------- unsampled hot path: zero cost
+
+
+def _world_wires(cfg):
+    """Deterministic multi-window fan-out run; returns per-connection
+    wire bytes + delivery counts (the byte-identity referee)."""
+    b, chans = _fanout_broker(cfg, n_subs=6, flt="t/#", qos=1)
+    counts = []
+    ts = 1.0e9  # fixed stamps: identical expiry/slow-sub math
+    for w in range(4):
+        counts.append(b.publish_many([
+            Message(
+                topic=f"t/{i}", qos=i % 3, retain=(i % 4 == 0),
+                payload=bytes([w, i]) * (i + 1), from_client="pub",
+                timestamp=ts,
+                properties=(
+                    {"user_property": [("app", "v")]} if i % 2 else {}
+                ),
+            )
+            for i in range(8)
+        ]))
+    return b, counts, {cid: b"".join(ch.writes)
+                       for cid, ch in chans.items()}
+
+
+def test_rate_zero_is_byte_identical_and_stores_nothing():
+    """Satellite: sampling OFF (enable=True, rate=0) must be
+    byte-identical on every connection's wire vs. the tracer disabled
+    outright, stamp no per-message context objects, and add zero trace
+    store entries."""
+    b_off, counts_off, wires_off = _world_wires(_cfg(enable=False))
+    b_zero, counts_zero, wires_zero = _world_wires(
+        _cfg(enable=True, rate=0.0)
+    )
+    assert counts_off == counts_zero
+    assert wires_off == wires_zero
+    for b in (b_off, b_zero):
+        assert b.lifecycle.store.spans() == []
+        assert len(b.lifecycle.store) == 0
+    # and rate=1 still delivers the SAME bytes (context rides broker-
+    # internal state, never the subscriber wire)
+    _b1, counts_one, wires_one = _world_wires(_cfg(enable=True, rate=1.0))
+    assert counts_off == counts_one
+    assert wires_off == wires_one
+
+
+def test_unsampled_messages_carry_no_context_objects():
+    b, _ = _fanout_broker(_cfg(enable=True, rate=0.0), n_subs=1)
+    msgs = [Message(topic=f"t/{i}") for i in range(16)]
+    b.publish_many(msgs)
+    assert all(getattr(m, "_trace_ctx", None) is None for m in msgs)
+    # enabled+sampled stamps exactly one context per message
+    b2, _ = _fanout_broker(_cfg(enable=True, rate=1.0), n_subs=1)
+    msgs2 = [Message(topic=f"t/{i}") for i in range(4)]
+    b2.publish_many(msgs2)
+    assert all(m._trace_ctx is not None for m in msgs2)
+
+
+def test_unsampled_overhead_within_noise_fanout_256():
+    """Paired A/B at fanout-256 (PR 4's pattern): tracing enabled with
+    rate=0 vs. disabled, interleaved runs, compare medians.  The
+    unsampled path adds one bool + one attribute probe per window, so
+    the bound is generous to stay robust on loaded CI boxes — the real
+    referee for exact cost is the byte-identity + zero-allocation
+    tests above."""
+    import statistics
+
+    def build(cfg):
+        return _fanout_broker(cfg, n_subs=256, flt="t/#", qos=0)[0]
+
+    base = build(_cfg(enable=False))
+    traced = build(_cfg(enable=True, rate=0.0))
+    msgs = [Message(topic="t/x", payload=b"p" * 64) for _ in range(16)]
+
+    def one(b):
+        t0 = time.perf_counter()
+        b.publish_many(list(msgs))
+        return time.perf_counter() - t0
+
+    one(base), one(traced)  # warm both paths (encoder pools, caches)
+    a, t = [], []
+    for _ in range(7):  # interleaved: shared box noise hits both
+        a.append(one(base))
+        t.append(one(traced))
+    assert statistics.median(t) <= statistics.median(a) * 1.5, (a, t)
+
+
+# -------------------------------------------------- cluster-hop trace
+
+FAST = dict(heartbeat_interval=0.05, down_after=0.25,
+            flush_interval=0.002)
+
+
+async def _start_node(name, seeds=(), rate=1.0):
+    cfg = BrokerConfig()
+    cfg.listeners[0].port = 0
+    cfg.node_name = name
+    cfg.tracing.enable = True
+    cfg.tracing.sample_rate = rate
+    cfg.tracing.seed = 3
+    srv = BrokerServer(cfg)
+    await srv.start()
+    node = ClusterNode(name, srv.broker, **FAST)
+    await node.start(seeds=list(seeds))
+    return srv, node
+
+
+async def _settle(check, timeout=5.0):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        if check():
+            return True
+        await asyncio.sleep(0.05)
+    return False
+
+
+def _hop_trace(name_a="nodeA", name_b="nodeB"):
+    """Publish on A, deliver via cluster forward on B; returns both
+    stores' spans after the hop settles."""
+
+    async def t():
+        s1, n1 = await _start_node(name_a)
+        s2, n2 = await _start_node(
+            name_b, seeds=[(name_a, "127.0.0.1", n1.port)]
+        )
+        try:
+            sub = TestClient(s2.listeners[0].port, "subB")
+            await sub.connect()
+            await sub.subscribe("fleet/+/temp", qos=1)
+            assert await _settle(
+                lambda: n1.routes.nodes_for("fleet/+/temp") == {name_b}
+            )
+            pub = TestClient(s1.listeners[0].port, "pubA")
+            await pub.connect()
+            await pub.publish("fleet/v1/temp", b"22C", qos=1)
+            m = await sub.recv_publish(timeout=5)
+            assert m.payload == b"22C"
+            # the internal carrier never reaches the subscriber wire
+            assert TRACE_PROP not in str(m.properties)
+            assert await _settle(
+                lambda: any(
+                    s["name"] == "message.dispatch"
+                    for s in s2.broker.lifecycle.store.spans()
+                )
+            )
+            await sub.disconnect()
+            await pub.disconnect()
+            return (s1.broker.lifecycle.store.spans(),
+                    s2.broker.lifecycle.store.spans())
+        finally:
+            await n2.stop()
+            await s2.stop()
+            await n1.stop()
+            await s1.stop()
+
+    return run(t())
+
+
+def test_cluster_hop_yields_one_connected_trace():
+    """THE acceptance criterion: a publish on node A delivered via
+    cluster forward on node B is ONE trace — B's dispatch span parents
+    to A's forward span — queryable by trace id and message id on both
+    sides."""
+    a_spans, b_spans = _hop_trace()
+    pub = [s for s in a_spans if s["name"] == "message.publish"]
+    fwd = [s for s in a_spans if s["name"] == "message.forward"]
+    disp = [s for s in b_spans if s["name"] == "message.dispatch"]
+    assert pub and fwd and disp
+    tid = pub[0]["trace_id"]
+    assert fwd[0]["trace_id"] == tid and disp[0]["trace_id"] == tid
+    # the connected-parentage chain: publish -> forward -> dispatch
+    assert fwd[0]["parent_id"] == pub[0]["span_id"]
+    assert disp[0]["parent_id"] == fwd[0]["span_id"]
+    assert fwd[0]["attrs"]["ok"] is True
+    assert fwd[0]["attrs"]["target"] == "nodeB"
+    assert disp[0]["attrs"]["deliveries"] == 1
+    # every span closed; same mid end to end
+    for s in a_spans + b_spans:
+        assert s["end_ns"] > 0
+    assert disp[0]["mid"] == pub[0]["mid"]
+
+
+def test_merged_perfetto_timeline_processes_and_flow():
+    """Merged multi-node Perfetto export: both nodes as DISTINCT
+    processes (explicit process_name metadata), the hop linked by a
+    flow event pair, and every event timeline-valid."""
+    a_spans, b_spans = _hop_trace()
+    merged = chrome_trace(a_spans + b_spans)
+    events = merged["traceEvents"]
+    procs = {
+        e["pid"]: e["args"]["name"]
+        for e in events if e["name"] == "process_name"
+    }
+    assert len(procs) == 2
+    assert {"emqx_tpu nodeA", "emqx_tpu nodeB"} == set(procs.values())
+    for e in events:
+        assert "ph" in e and "pid" in e and "tid" in e
+        if e["ph"] in ("X", "i", "s", "f"):
+            assert e["ts"] >= 0
+        if e["ph"] == "X":
+            assert e["dur"] > 0
+    flows = [e for e in events if e["ph"] in ("s", "f")]
+    assert len(flows) == 2
+    s_ev = next(e for e in flows if e["ph"] == "s")
+    f_ev = next(e for e in flows if e["ph"] == "f")
+    assert s_ev["id"] == f_ev["id"]
+    assert s_ev["pid"] != f_ev["pid"]  # the hop crosses processes
+
+
+def test_multicore_worker_hop_same_trace_shape():
+    """The multicore worker hop rides the SAME inter-node transport
+    (workers cluster over loopback), so worker-labeled nodes produce
+    the identical connected-trace + per-worker process tracks."""
+    a_spans, b_spans = _hop_trace("worker0", "worker1")
+    fwd = [s for s in a_spans if s["name"] == "message.forward"]
+    disp = [s for s in b_spans if s["name"] == "message.dispatch"]
+    assert disp[0]["parent_id"] == fwd[0]["span_id"]
+    merged = chrome_trace(a_spans + b_spans)
+    procs = {
+        e["args"]["name"]
+        for e in merged["traceEvents"] if e["name"] == "process_name"
+    }
+    assert procs == {"emqx_tpu worker0", "emqx_tpu worker1"}
+
+
+def test_multicore_worker_configs_carry_tracing_and_api_ports():
+    from emqx_tpu.broker.multicore import worker_configs
+
+    cfgs = worker_configs(
+        3, 1883,
+        base_config={"api": {"enable": True}},
+        tracing={"enable": True, "sample_rate": 0.05, "seed": 1},
+    )
+    api_ports = set()
+    for i, cfg in enumerate(cfgs):
+        assert cfg["tracing"] == {
+            "enable": True, "sample_rate": 0.05, "seed": 1,
+        }
+        assert cfg["node_name"] == f"worker{i}"
+        assert cfg["api"]["enable"] is True
+        api_ports.add(cfg["api"]["port"])
+    # every worker gets its OWN api port (they cannot share one)
+    assert len(api_ports) == 3
+    # and the tracing dict round-trips through the typed config
+    from emqx_tpu.config import ConfigHandler
+
+    handler = ConfigHandler.from_dict(cfgs[0])
+    assert handler.root.tracing.enable is True
+    assert handler.root.tracing.sample_rate == 0.05
+
+
+# ----------------------------------------------------- link-drop chaos
+
+
+def test_link_forward_drop_closes_traces_and_bounds_store():
+    """Satellite chaos test: with the cluster.link.forward failpoint
+    injecting drops, sampled traces on the publisher still CLOSE (the
+    link.forward span ends on the drop path with ok=False and the
+    failpoint fire attached), the bounded store never leaks, and no
+    span holds message payload bytes."""
+    from emqx_tpu.cluster_link import LinkServer
+
+    cfg = _cfg(rate=1.0, store_max=16)
+    b, _ = _fanout_broker(cfg, n_subs=1)
+    server = LinkServer(b, "east", allowed={"west"})
+    server.start()
+    server.extern_routes["west"] = {"fleet/#"}
+    payload = b"SECRET-PAYLOAD-BYTES" * 10
+    try:
+        failpoints.configure(
+            "cluster.link.forward", "drop", prob=0.5, seed=11
+        )
+        for i in range(40):
+            b.publish(Message(topic=f"fleet/{i}", payload=payload,
+                              from_client="pub"))
+        spans = b.lifecycle.store.spans()
+        link = [s for s in spans if s["name"] == "link.forward"]
+        dropped = [s for s in link if s["attrs"]["ok"] is False]
+        sent = [s for s in link if s["attrs"]["ok"] is True]
+        assert dropped and sent  # prob=0.5 seed=11: both outcomes
+        for s in link:
+            assert s["end_ns"] > 0  # every forward span CLOSED
+        assert any(
+            s["attrs"].get("detail") == "failpoint drop" for s in dropped
+        )
+        # store stays bounded under chaos (whole-trace eviction)
+        assert len(b.lifecycle.store) <= 16
+        # spans never hold message bodies alive
+        assert b"SECRET" not in json.dumps(spans).encode()
+    finally:
+        failpoints.clear()
+        server.stop()
+    # the publisher-side publish spans closed too (local delivery)
+    pubs = [s for s in b.lifecycle.store.spans()
+            if s["name"] == "message.publish"]
+    assert pubs and all(s["end_ns"] > 0 for s in pubs)
+
+
+def test_link_wrap_carries_context_end_to_end():
+    """The $LINK wrapper's trace field round-trips: the importing
+    broker adopts the context (as a remote parent) and its local
+    dispatch joins the SAME trace, parented to the link.forward
+    span."""
+    from emqx_tpu.cluster_link import _unwrap, _wrap
+
+    src = Message(topic="fleet/1", payload=b"x", from_client="c")
+    wrapped = _wrap(src, "east", trace=encode_ctx("a" * 32, "b" * 16))
+    inner = _unwrap(wrapped)
+    assert inner.headers["trace_ctx"] == encode_ctx("a" * 32, "b" * 16)
+    assert inner.headers["cluster_origin"] == "east"
+    # no trace field -> no header (sampling off adds nothing)
+    assert "trace_ctx" not in _unwrap(_wrap(src, "east")).headers
+    # importing broker ingress: same trace, parent = link.forward span
+    b, _ = _fanout_broker(_cfg(rate=0.0), n_subs=1, flt="fleet/#")
+    b.publish(inner)
+    (span,) = b.lifecycle.store.spans()
+    assert span["trace_id"] == "a" * 32
+    assert span["parent_id"] == "b" * 16
+    # a link import is a full local PUBLISH on the importing cluster
+    # (hooks/retain run, unlike a node-forward's dispatch-only path),
+    # so it keeps the publish span name — with the remote parent
+    assert span["name"] == "message.publish"
+
+
+def test_orphan_wires_strip_trace_carrier():
+    """The quorum-orphan path stores wire dicts that later restore
+    STRAIGHT into session mqueues (no broker ingress to strip the
+    carrier) — strip_wire_trace_ctx must remove exactly the trace
+    pair, tuple- or list-shaped, leaving foreign properties alone."""
+    from emqx_tpu.cluster.node import msg_to_wire, strip_wire_trace_ctx
+    from emqx_tpu.tracecontext import LifecycleTracer, TraceContext
+
+    class _Cfg:
+        enable, sample_rate, topic_filters = True, 1.0, ()
+        store_max, seed = 16, 1
+
+    lc = LifecycleTracer(_Cfg(), node="n")
+    msg = Message(topic="t/1", payload=b"x",
+                  properties={"user_property": [("app", "v")]})
+    clone = lc.forward_copy(
+        msg, TraceContext("a" * 32, "b" * 16), "peer"
+    )
+    wires = [msg_to_wire(clone), msg_to_wire(msg)]
+    assert TRACE_PROP in json.dumps(wires)
+    strip_wire_trace_ctx(wires)
+    assert TRACE_PROP not in json.dumps(wires)
+    # the foreign user property survived on both wires
+    for w in wires:
+        assert ["app", "v"] in [
+            list(p) for p in w["properties"]["user_property"]
+        ]
+
+
+def test_failpoint_fires_attach_as_span_events():
+    """A seam that fires INSIDE the window (the link-forward tap runs
+    in the publish hook fold) lands on the sampled message's span as a
+    ``failpoint.*`` event — chaos runs attribute an anomalous window
+    to its fault without log correlation."""
+    from emqx_tpu.cluster_link import LinkServer
+
+    b, _ = _fanout_broker(_cfg(rate=1.0), n_subs=1)
+    server = LinkServer(b, "east", allowed={"west"})
+    server.start()
+    server.extern_routes["west"] = {"t/#"}
+    failpoints.configure("cluster.link.forward", "drop")
+    try:
+        b.publish(Message(topic="t/1", from_client="pub"))
+    finally:
+        failpoints.clear()
+        server.stop()
+    pub = [s for s in b.lifecycle.store.spans()
+           if s["name"] == "message.publish"]
+    fp = [e for s in pub for e in s["events"]
+          if e["name"] == "failpoint.cluster.link.forward"]
+    assert fp and fp[0]["attrs"]["action"] == "drop"
+
+
+# ----------------------------------------------------- REST + ctl
+
+
+async def _api_server(tmp_path):
+    import tempfile
+
+    cfg = _cfg(rate=1.0)
+    cfg.listeners[0].port = 0
+    cfg.api.enable = True
+    cfg.api.port = 0
+    cfg.api.data_dir = tempfile.mkdtemp(dir=str(tmp_path))
+    srv = BrokerServer(cfg)
+    await srv.start()
+    return srv
+
+
+def test_rest_tracing_surface(tmp_path):
+    async def t():
+        from api_helper import auth_session
+
+        srv = await _api_server(tmp_path)
+        try:
+            port = srv.listeners[0].port
+            sub = TestClient(port, "s1")
+            await sub.connect()
+            await sub.subscribe("t/#", qos=1)
+            pub = TestClient(port, "p1")
+            await pub.connect()
+            await pub.publish("t/hello", b"hi", qos=1)
+            await sub.recv_publish()
+            await asyncio.sleep(0.05)
+
+            http, api = await auth_session(srv)
+            async with http:
+                async with http.get(api + "/api/v5/tracing") as r:
+                    info = await r.json()
+                    assert info["active"] and info["sample_rate"] == 1.0
+                async with http.get(
+                    api + "/api/v5/tracing/traces"
+                ) as r:
+                    traces = (await r.json())["data"]
+                    assert traces and traces[0]["topic"] == "t/hello"
+                tid = traces[0]["trace_id"]
+                async with http.get(
+                    api + f"/api/v5/tracing/traces/{tid}"
+                ) as r:
+                    spans = (await r.json())["spans"]
+                    assert spans[0]["trace_id"] == tid
+                mid = spans[0]["mid"]
+                # lookup by MESSAGE id resolves to the same trace
+                async with http.get(
+                    api + f"/api/v5/tracing/messages/{mid}"
+                ) as r:
+                    assert (await r.json())["trace_id"] == tid
+                async with http.get(
+                    api + "/api/v5/tracing/messages/feedbeef"
+                ) as r:
+                    assert r.status == 404
+                # perfetto export of the store
+                async with http.get(
+                    api + f"/api/v5/tracing/trace?trace_id={tid}"
+                ) as r:
+                    trace = await r.json()
+                    assert any(
+                        e["name"] == "message.publish"
+                        for e in trace["traceEvents"]
+                    )
+                # raw span dump (the multi-node merge feed)
+                async with http.get(
+                    api + "/api/v5/tracing/spans"
+                ) as r:
+                    dump = await r.json()
+                    assert dump["node"] and dump["data"]
+                # runtime sampler update
+                async with http.put(
+                    api + "/api/v5/tracing",
+                    json={"sample_rate": 0.0,
+                          "topic_filters": ["dbg/#"]},
+                ) as r:
+                    info = await r.json()
+                    assert info["sample_rate"] == 0.0
+                    assert info["topic_filters"] == ["dbg/#"]
+                    assert info["active"]  # filters keep it live
+                async with http.put(
+                    api + "/api/v5/tracing", json={"sample_rate": 7}
+                ) as r:
+                    assert r.status == 400
+                # clear
+                async with http.delete(api + "/api/v5/tracing") as r:
+                    assert r.status == 204
+                async with http.get(
+                    api + "/api/v5/tracing/traces"
+                ) as r:
+                    assert (await r.json())["data"] == []
+            await sub.disconnect()
+            await pub.disconnect()
+        finally:
+            await srv.stop()
+
+    run(t())
+
+
+def test_ctl_tracing_roundtrip(tmp_path):
+    """Black-box ctl: status + traces + perfetto export through the
+    real CLI subprocess against a live broker."""
+    import subprocess
+    import sys
+
+    async def t():
+        srv = await _api_server(tmp_path)
+        try:
+            port = srv.listeners[0].port
+            sub = TestClient(port, "s1")
+            await sub.connect()
+            await sub.subscribe("t/#", qos=1)
+            pub = TestClient(port, "p1")
+            await pub.connect()
+            await pub.publish("t/cli", b"x", qos=1)
+            await sub.recv_publish()
+            await asyncio.sleep(0.05)
+            api = f"http://127.0.0.1:{srv.api.port}"
+
+            def ctl(*args):
+                out = subprocess.run(
+                    [sys.executable, "-m", "emqx_tpu.ctl",
+                     "--api", api, *args],
+                    capture_output=True, text=True, timeout=30,
+                    cwd="/root/repo",
+                )
+                assert out.returncode == 0, out.stderr
+                return out.stdout
+
+            loop = asyncio.get_running_loop()
+            status = await loop.run_in_executor(
+                None, ctl, "tracing", "status"
+            )
+            assert "ACTIVE" in status
+            traces = await loop.run_in_executor(
+                None, ctl, "tracing", "traces"
+            )
+            assert "t/cli" in traces
+            out_path = str(tmp_path / "merged.json")
+            perfetto = await loop.run_in_executor(
+                None, ctl, "tracing", "perfetto", out_path
+            )
+            assert "wrote" in perfetto
+            with open(out_path) as f:
+                merged = json.load(f)
+            assert any(
+                e["name"] == "message.publish"
+                for e in merged["traceEvents"]
+            )
+            await sub.disconnect()
+            await pub.disconnect()
+        finally:
+            await srv.stop()
+
+    run(t())
+
+
+# ------------------------------------------- profiler process tracks
+
+
+def test_profiler_trace_names_its_process():
+    """Satellite: the window profiler's Chrome export carries explicit
+    process metadata (real pid + node label), so merged multi-node /
+    multi-worker profiler timelines keep each broker's tracks in its
+    own process group instead of interleaving into one implicit row."""
+    import os
+
+    cfg = _cfg(rate=0.0)
+    cfg.node_name = "workerX"
+    b, _ = _fanout_broker(cfg, n_subs=1)
+    b.publish_many([Message(topic="t/1")])
+    trace = b.profiler.chrome_trace()
+    procs = [e for e in trace["traceEvents"]
+             if e["name"] == "process_name"]
+    assert len(procs) == 1
+    assert "workerX" in procs[0]["args"]["name"]
+    assert procs[0]["pid"] == os.getpid()
+    assert any(
+        e["name"] == "process_sort_index" for e in trace["traceEvents"]
+    )
+    # every event rides the explicit pid
+    assert all(e["pid"] == os.getpid() for e in trace["traceEvents"])

@@ -290,7 +290,9 @@ def is_failpoint_call(call: ast.Call) -> bool:
 def _run_file_checks(ctx: ModuleContext,
                      seams: Optional[Sequence],
                      dispatch: Optional[Sequence]) -> None:
-    from . import asyncrules, devicerules, failpointrules, perfrules
+    from . import (
+        asyncrules, devicerules, failpointrules, obsrules, perfrules,
+    )
 
     asyncrules.check(ctx)
     devicerules.check(ctx)
@@ -298,6 +300,9 @@ def _run_file_checks(ctx: ModuleContext,
         ctx, failpointrules.SEAM_FUNCS if seams is None else seams
     )
     perfrules.check(
+        ctx, perfrules.DISPATCH_FUNCS if dispatch is None else dispatch
+    )
+    obsrules.check(
         ctx, perfrules.DISPATCH_FUNCS if dispatch is None else dispatch
     )
 

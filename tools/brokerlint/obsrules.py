@@ -1,0 +1,131 @@
+"""Observability-cost rule (OBS601).
+
+PR 8 threads a per-message lifecycle tracer through the dispatch path
+under one invariant: tracing work happens OUTSIDE the dispatch hot
+loops (one ``window_spans`` call per window), and anything span- or
+context-shaped that DOES sit in a loop must be behind a sampled-check
+— otherwise every unsampled delivery pays allocation for a feature
+that is off 99%+ of the time, un-doing the PR 3/5 wins the batched
+pipeline bought.
+
+OBS601 enforces it the way PERF401/402 guard encode and clock costs:
+inside a loop of a ``DISPATCH_FUNCS``-marked function, a call whose
+receiver chain names the tracer (``tracer``/``lifecycle``/
+``profiler`` attribute segments) or that constructs a trace object
+(``TraceContext``/``Span``/``WindowRecord``) is a finding UNLESS an
+enclosing ``if``'s test mentions the sampling decision (``sampled``,
+``trace_ctx``/``tctx``/``ctx``, or ``_trace_fwd``).  Intentional
+exceptions take a justified inline ``# brokerlint: ignore[OBS601]``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Sequence, Tuple
+
+from .engine import ModuleContext, dotted_name
+from .perfrules import DISPATCH_FUNCS, DispatchFn, _function_map
+
+# attribute-chain segments that mean "this receiver is a tracer"
+_TRACER_SEGMENTS = {"tracer", "lifecycle", "profiler"}
+
+# constructors that allocate per-message trace objects
+_TRACE_CTORS = {"TraceContext", "Span", "WindowRecord", "PendingForward"}
+
+# an enclosing if-test mentioning any of these counts as the
+# sampled-guard (the decision object, or the decision itself —
+# ``span``/``ctx`` cover the `if span is not None:` idiom, where the
+# object only exists because the message was sampled)
+_GUARD_TOKENS = ("sampled", "trace_ctx", "tctx", "_trace_fwd", "ctx",
+                 "span")
+
+
+def _is_tracing_call(call: ast.Call) -> bool:
+    name = dotted_name(call.func)
+    if not name:
+        return False
+    segments = name.split(".")
+    if segments[-1] in _TRACE_CTORS:
+        return True
+    # receiver segments only: `self.tracer.start(...)` is a tracer
+    # call; a function named `tracer()` alone is not a receiver chain
+    return any(seg in _TRACER_SEGMENTS for seg in segments[:-1])
+
+
+def _guard_hit(test: ast.AST) -> bool:
+    try:
+        src = ast.unparse(test)
+    except Exception:  # pragma: no cover - unparse of exotic nodes
+        return False
+    return any(tok in src for tok in _GUARD_TOKENS)
+
+
+def _walk(fn: ast.AST) -> List[Tuple[ast.Call, bool]]:
+    """(tracing_call, guarded) pairs lexically inside a loop of `fn`;
+    nested def/lambda subtrees are pruned (a closure defined in the
+    loop is not per-delivery work), and descending into the body of an
+    ``if`` whose test mentions the sampling decision marks everything
+    under it as guarded."""
+    hits: List[Tuple[ast.Call, bool]] = []
+
+    def walk(node: ast.AST, in_loop: bool, guarded: bool) -> None:
+        if isinstance(node, ast.If):
+            # handled at ENTRY (not only as someone's child) so guards
+            # nested under other ifs/loops still mark their bodies; a
+            # loop that is itself a DIRECT child of the if body must
+            # still flip in_loop for its subtree
+            hit = _guard_hit(node.test)
+            walk(node.test, in_loop, guarded)
+            for sub in node.body:
+                walk(sub, in_loop or isinstance(
+                    sub, (ast.For, ast.AsyncFor, ast.While)
+                ), guarded or hit)
+            for sub in node.orelse:
+                walk(sub, in_loop or isinstance(
+                    sub, (ast.For, ast.AsyncFor, ast.While)
+                ), guarded)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)) and child is not fn:
+                continue
+            child_in_loop = in_loop or isinstance(
+                child, (ast.For, ast.AsyncFor, ast.While)
+            )
+            if (
+                in_loop
+                and isinstance(child, ast.Call)
+                and _is_tracing_call(child)
+            ):
+                hits.append((child, guarded))
+            walk(child, child_in_loop, guarded)
+
+    walk(fn, False, False)
+    return hits
+
+
+def check(ctx: ModuleContext,
+          dispatch: Sequence[DispatchFn] = DISPATCH_FUNCS) -> None:
+    relevant = [d for d in dispatch if ctx.path.endswith(d.path_suffix)]
+    if not relevant:
+        return
+    fns = _function_map(ctx.tree)
+    for d in relevant:
+        fn = fns.get(d.qualname)
+        if fn is None:
+            continue  # PERF401 already reports the missing declaration
+        for call, guarded in _walk(fn):
+            if guarded:
+                continue
+            name = dotted_name(call.func)
+            ctx.report(
+                call, "OBS601", d.qualname,
+                f"unguarded trace/span work `{name}(` inside the "
+                f"dispatch hot loop `{d.qualname}` — gate it behind "
+                f"the sampled-check (`if <ctx> is not None:`) or hoist "
+                f"it to the once-per-window emission",
+                detail=name,
+            )
+
+
+__all__ = ["check"]
