@@ -241,8 +241,8 @@ def run_dispatch_fanout_bench(log):
         stage_str = " ".join(
             f"{k}={v['p50_us']:.0f}us"
             for k, v in sorted(stages.items())
-            if k in ("expand", "deliver", "assemble", "flush",
-                     "match_submit")
+            if k in ("expand", "decide", "deliver", "assemble",
+                     "flush", "match_submit")
         )
         log(
             f"dispatch fanout {tag}: {rate:,.0f} msg/s "
@@ -263,11 +263,26 @@ def run_dispatch_fanout_bench(log):
     # QoS0 fan-out never exercises — the half PR 5's native assembly
     # + block bookkeeping attack.  Unbounded inflight (the clients
     # never ack): the clock sees assembly, not window backpressure.
+    # Since PR 9 this row registers a no-op `message.delivered` hook:
+    # it measures the HOOK-CONSUMER case (per-run delivery lists
+    # materialized for the callback), directly comparable to the
+    # always-materializing pre-PR9 path.
     b, sink, flt = setup(256, qos=1, label="256q1", max_inflight=0)
+    b.hooks.add("message.delivered", lambda cid, ds: None)
     rate, routed, dt, stages = pump(b, flt, 256, qos=1)
     out["fanout_256_qos1"] = rate
     out["fanout_256_qos1_stages"] = stages
     report("256 qos1", 256, rate, routed, dt, stages, sink)
+
+    # the no-hooks twin: nothing consumes per-delivery lists, so the
+    # window skips the hook walk AND the delivery-tuple
+    # materialization — the lazy-deliveries win shows up as the gap
+    # between this row and fanout_256_qos1
+    b, sink, flt = setup(256, qos=1, label="256q1nh", max_inflight=0)
+    rate, routed, dt, stages = pump(b, flt, 256, qos=1)
+    out["fanout_256_qos1_nohooks"] = rate
+    out["fanout_256_qos1_nohooks_stages"] = stages
+    report("256 qos1 nohooks", 256, rate, routed, dt, stages, sink)
     out["note"] = (
         "publish_many windows of 64, QoS0, 64 B payloads stamped at "
         "ingress, host matching; encode+write counted (every packet "
@@ -278,7 +293,11 @@ def run_dispatch_fanout_bench(log):
         "-> corked flush) must hold fanout 256 at >= 3x that 267 "
         "baseline, and PR5's native assemble path (per-run decision "
         "scan -> GIL-released arena splice, the 'assemble' sub-stage) "
-        "must hold >= 2x the PR4 number on the same box."
+        "must hold >= 2x the PR4 number on the same box.  PR9 adds "
+        "the 'decide' stage (window decision columns) and the "
+        "fanout_256_qos1_nohooks row (lazy delivery lists: "
+        "fanout_256_qos1 registers a no-op delivered hook, the "
+        "nohooks row does not)."
     )
     return out
 

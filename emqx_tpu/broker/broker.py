@@ -31,6 +31,10 @@ from ..engine import MatchEngine
 from ..hooks import HookRegistry
 from ..message import Message
 from ..metrics import Metrics, Stats
+from ..ops import dispatchasm
+from ..ops.match_kernel import (
+    DEC_DROP_BIT, DEC_QMAX_SHIFT, DEC_RETAIN_BIT, DEC_SUBID_BIT,
+)
 from ..retainer import Retainer
 from ..router import Router
 from ..tracecontext import extract_strip as _strip_ctx
@@ -43,8 +47,12 @@ _PREPARE_ERROR = object()
 from .. import topic as T
 from ..codec import mqtt as C
 from .cm import ConnectionManager
-from .session import Session, SubOpts
+from .session import Session, SubOpts, publish_entries
 from .shared import SharedSubManager
+
+# shared all--1 pid segment for pure-QoS0 planned runs (views of one
+# buffer instead of one np.full per run)
+_NEG1_SEG = np.full(4096, -1, dtype=np.int64)
 
 
 class Broker:
@@ -287,6 +295,14 @@ class Broker:
         # clientid -> (fire_at, will message): MQTT 5 delayed wills
         self._pending_wills: Dict[str, Tuple[float, Message]] = {}
         self._last_ds_sync = time.time()
+        # window decision columns (PR 9): per-delivery QoS/no-local/
+        # body-slot decisions computed as ONE vectorized pass per
+        # window (host numpy or the device decide kernel, chosen by
+        # the engine's cost model).  EMQX_TPU_NO_DECIDE=1 pins the
+        # scalar per-run path — the property-tested referee.
+        self._decide_columns = (
+            os.environ.get("EMQX_TPU_NO_DECIDE") != "1"
+        )
 
     # -------------------------------------------------- session setup
 
@@ -1002,19 +1018,24 @@ class Broker:
         # shared-group columns: one live member per (msg, filter, group)
         s_msg: List[int] = []
         s_rows: List[int] = []
-        s_opts: List[SubOpts] = []
+        s_opts_rows: List[int] = []
         for i, real, group in shared:
             self._shared_pick(msgs[i], i, real, group,
-                              s_msg, s_rows, s_opts)
+                              s_msg, s_rows, s_opts_rows)
         n_direct = len(rows)
         mloc: Counter = Counter()  # batched counter deltas (one lock)
         touched = bytearray(n)
         corked: List = []
         n_clients = 0
+        traced_clients: Optional[Dict] = None
         bake_cache: Dict = {}  # shared detached-window mqueue bakes
         delivered_runs: Optional[List] = (
             [] if self.delivered_batch_sinks else None
         )
+        # one O(1) registry probe per window: with no hook registered
+        # (the common deployment) every run skips the hook walk AND the
+        # per-run delivery-list materialization feeding it
+        deliver_hook = self.hooks.has("message.delivered")
         asm = [0.0] if rec is not None else None  # native assemble time
         # oldest publish timestamp in the window: the per-run slow-subs
         # scan only runs when this could possibly cross the threshold
@@ -1029,78 +1050,44 @@ class Broker:
                 all_msg = np.concatenate(
                     [msg_idx, np.asarray(s_msg, dtype=np.int64)]
                 )
+                all_opts_rows = np.concatenate(
+                    [opts_rows, np.asarray(s_opts_rows, dtype=np.int64)]
+                )
             else:
                 all_rows, all_msg = rows, msg_idx
+                all_opts_rows = opts_rows
             # stable sort: per-client deliveries keep publish order,
             # and direct entries stay ahead of shared for equal keys
             order = np.lexsort((all_msg, all_rows))
             sra = all_rows[order]
-            srl = sra.tolist()
-            sm = all_msg[order].tolist()
-            # resolve every delivery's (msg, opts) object refs once,
-            # with C-speed maps over the flat columns — the vectorized
-            # replacement for per-subscriber dict churn
-            all_opts = list(map(router.opts_at, opts_rows.tolist()))
-            if s_opts:
-                all_opts += s_opts  # shared entries follow direct
-            msg_seq = list(map(msgs.__getitem__, sm))
-            opts_seq = list(map(all_opts.__getitem__, order.tolist()))
-            cuts = np.flatnonzero(sra[1:] != sra[:-1]) + 1
-            bounds = [0, *cuts.tolist(), len(srl)]
-            dollar = (
-                [m.topic.startswith("$") for m in msgs]
-                if self.delivery_guards else None
-            )
+            sm_a = all_msg[order]
+            so_a = all_opts_rows[order]
+            dollar = None
+            if self.delivery_guards:
+                # guards are only ever consulted for $-topics, so a
+                # guarded broker with none in the window still takes
+                # the vectorized path
+                dollar = [m.topic.startswith("$") for m in msgs]
+                if not any(dollar):
+                    dollar = None
             if dollar is None:
                 # every expanded delivery reaches a target: mark the
                 # window's matched messages in one pass
                 for i in np.unique(all_msg).tolist():
                     touched[i] = 1
             enc = C.DispatchEncoder()
-            client_of = router.client_of_row
-            for bi in range(len(bounds) - 1):
-                k, e = bounds[bi], bounds[bi + 1]
-                clientid = client_of(srl[k])
-                if dollar is None:
-                    deliveries = list(zip(msg_seq[k:e], opts_seq[k:e]))
-                    d_idx = sm[k:e]
-                else:
-                    deliveries = []
-                    d_idx = []
-                    for t in range(k, e):
-                        i = sm[t]
-                        msg = msg_seq[t]
-                        if dollar[i] and not self._delivery_allowed(
-                            clientid, msg
-                        ):
-                            continue
-                        deliveries.append((msg, opts_seq[t]))
-                        d_idx.append(i)
-                        touched[i] = 1
-                    if not deliveries:
-                        continue
-                n_clients += 1
-                try:
-                    flags = self._deliver_run(
-                        clientid, deliveries, enc, mloc, corked,
-                        bake_cache=bake_cache,
-                        delivered_runs=delivered_runs,
-                        asm=asm,
-                        ts_min=ts_min,
-                    )
-                except Exception:
-                    log.exception("dispatch to %s failed", clientid)
-                    # keep the error observable: the legacy per-message
-                    # path bumped this counter on any dispatch failure
-                    mloc["messages.publish.error"] += 1
-                    continue
-                if flags is None:  # connected channel: all delivered
-                    for i in d_idx:
-                        counts[i] += 1
-                else:
-                    for i, f in zip(d_idx, flags):
-                        if f:
-                            counts[i] += 1
+            if dollar is None and self._decide_columns:
+                n_clients, traced_clients = self._dispatch_columns(
+                    msgs, sra, sm_a, so_a, counts, enc, mloc, corked,
+                    bake_cache, delivered_runs, deliver_hook, asm,
+                    ts_min, rec,
+                )
+            else:
+                n_clients = self._dispatch_scalar(
+                    msgs, sra, sm_a, so_a, dollar, touched, counts,
+                    enc, mloc, corked, bake_cache, delivered_runs,
+                    deliver_hook, asm, ts_min,
+                )
         if rec is not None:
             rec.lap("deliver")
             if asm[0]:
@@ -1141,8 +1128,12 @@ class Broker:
             # lifecycle spans for the window's SAMPLED messages, cut
             # entirely from the flight record's existing timestamps —
             # one call per window, outside the dispatch loops, zero
-            # additional clock reads (the OBS601 gate pins this down)
-            lifecycle.window_spans(msgs, counts, rec, n_clients)
+            # additional clock reads (the OBS601 gate pins this down).
+            # ``traced_clients`` (columns mode) names each sampled
+            # message's delivering clients on its span.
+            lifecycle.window_spans(
+                msgs, counts, rec, n_clients, clients=traced_clients
+            )
         tracer = self.tracer
         for i, msg in enumerate(msgs):
             if not touched[i]:
@@ -1156,6 +1147,557 @@ class Broker:
                     tracer.end(span)
         self.metrics.inc_bulk(mloc)
         return counts
+
+    def _dispatch_scalar(
+        self,
+        msgs: Sequence[Message],
+        sra: np.ndarray,
+        sm_a: np.ndarray,
+        so_a: np.ndarray,
+        dollar: Optional[List[bool]],
+        touched: bytearray,
+        counts: List[int],
+        enc: "C.DispatchEncoder",
+        mloc: Counter,
+        corked: List,
+        bake_cache: Dict,
+        delivered_runs: Optional[List],
+        deliver_hook: bool,
+        asm: Optional[List[float]],
+        ts_min: float,
+    ) -> int:
+        """The scalar per-run window fan-out: one `_deliver_run` per
+        client with eagerly materialized delivery lists — the
+        decision-column path's property-tested referee, and the only
+        path for $-topic windows with delivery guards (whose
+        per-delivery predicate has no columnar form)."""
+        router = self.router
+        srl = sra.tolist()
+        sm = sm_a.tolist()
+        # resolve every delivery's (msg, opts) object refs once, with
+        # C-speed maps over the flat columns — the vectorized
+        # replacement for per-subscriber dict churn
+        msg_seq = list(map(msgs.__getitem__, sm))
+        opts_seq = list(map(router.opts_at, so_a.tolist()))
+        cuts = np.flatnonzero(sra[1:] != sra[:-1]) + 1
+        bounds = [0, *cuts.tolist(), len(srl)]
+        client_of = router.client_of_row
+        n_clients = 0
+        for bi in range(len(bounds) - 1):
+            k, e = bounds[bi], bounds[bi + 1]
+            clientid = client_of(srl[k])
+            if dollar is None:
+                deliveries = list(zip(msg_seq[k:e], opts_seq[k:e]))
+                d_idx = sm[k:e]
+            else:
+                deliveries = []
+                d_idx = []
+                for t in range(k, e):
+                    i = sm[t]
+                    msg = msg_seq[t]
+                    if dollar[i] and not self._delivery_allowed(
+                        clientid, msg
+                    ):
+                        continue
+                    deliveries.append((msg, opts_seq[t]))
+                    d_idx.append(i)
+                    touched[i] = 1
+                if not deliveries:
+                    continue
+            n_clients += 1
+            try:
+                flags = self._deliver_run(
+                    clientid, deliveries, enc, mloc, corked,
+                    bake_cache=bake_cache,
+                    delivered_runs=delivered_runs,
+                    deliver_hook=deliver_hook,
+                    asm=asm,
+                    ts_min=ts_min,
+                )
+            except Exception:
+                log.exception("dispatch to %s failed", clientid)
+                # keep the error observable: the legacy per-message
+                # path bumped this counter on any dispatch failure
+                mloc["messages.publish.error"] += 1
+                continue
+            if flags is None:  # connected channel: all delivered
+                for i in d_idx:
+                    counts[i] += 1
+            else:
+                for i, f in zip(d_idx, flags):
+                    if f:
+                        counts[i] += 1
+        return n_clients
+
+    @staticmethod
+    def _materialize_run(msgs, router, sm_l, so_a, k: int, e: int):
+        """One client run's ``[(msg, opts)]`` delivery list.  The
+        columns path builds this ONLY when a consumer actually needs
+        it — a registered ``message.delivered`` hook, a batch sink, an
+        OTel deliver span, or a lifecycle-sampled message in the run —
+        so an unconsumed fanout window allocates zero per-delivery
+        tuples (the regression suite spies on this exact method)."""
+        opts_at = router.opts_at
+        so = so_a[k:e].tolist()
+        return [
+            (msgs[sm_l[t]], opts_at(so[t - k])) for t in range(k, e)
+        ]
+
+    def _dispatch_columns(
+        self,
+        msgs: Sequence[Message],
+        sra: np.ndarray,
+        sm_a: np.ndarray,
+        so_a: np.ndarray,
+        counts: List[int],
+        enc: "C.DispatchEncoder",
+        mloc: Counter,
+        corked: List,
+        bake_cache: Dict,
+        delivered_runs: Optional[List],
+        deliver_hook: bool,
+        asm: Optional[List[float]],
+        ts_min: float,
+        rec=None,
+    ) -> Tuple[int, Optional[Dict]]:
+        """Decision-column window fan-out: every per-delivery decision
+        — effective QoS (both upgrade variants), the no-local drop
+        mask, retain-as-published, subscription-identifier presence,
+        the DispatchEncoder body-slot key, the QoS1-needs-pid mask —
+        computes in ONE vectorized pass over the sorted ``(msg_idx,
+        client_rows, opts_rows)`` columns (host numpy or the device
+        decide kernel, per the engine's cost model), and the whole
+        window's wire assembles in ONE GIL-released native splice with
+        per-client output slices.  Per run, Python touches only
+        session state (packet-id block + bulk inflight insert) and the
+        consumers that asked for per-delivery objects; delivery lists
+        materialize lazily via `_materialize_run`.
+
+        Wire bytes, counts, per-qos sent metrics and inflight windows
+        are bit-identical to `_dispatch_scalar` (the property suite in
+        tests/test_decide_columns.py is the referee).  Returns
+        ``(n_clients, traced_clients)``."""
+        router = self.router
+        n = len(msgs)
+        nd_total = len(sra)
+        row_of = router.row_of_client
+
+        def from_row(m) -> int:
+            r = row_of(m.from_client) if m.from_client else None
+            return -1 if r is None else r
+
+        # per-message attribute vectors: one short pass over the
+        # window's B messages, never its N deliveries
+        m_qos = np.fromiter((m.qos for m in msgs), np.int8, n)
+        m_retain = np.fromiter((m.retain for m in msgs), bool, n)
+        m_from = np.fromiter((from_row(m) for m in msgs), np.int32, n)
+        packed, _dec_path = router.engine.decide_window(
+            router.opts_columns(), router.opts_rev,
+            so_a, sra, sm_a, m_qos, m_retain, m_from,
+        )
+        # unpack the compact column into the window-wide decision
+        # views (numpy bit ops; one byte per delivery came back)
+        qmin = (packed & 3).astype(np.int64)
+        qmax = ((packed >> DEC_QMAX_SHIFT) & 3).astype(np.int64)
+        drop = (packed & DEC_DROP_BIT) != 0
+        retn = (packed & DEC_RETAIN_BIT) != 0
+        sidb = (packed & DEC_SUBID_BIT) != 0
+        # body-slot keys for both effective-QoS variants (the run
+        # picks one by its session's upgrade_qos)
+        ri = retn.astype(np.int64)
+        base_key = sm_a * 6 + ri
+        kmin = base_key + qmin * 2
+        kmax = base_key + qmax * 2
+        if rec is not None:
+            rec.lap("decide")
+        # per-message tracing masks, computed ONCE per window: a run
+        # materializes its deliveries for the OTel span / lifecycle
+        # trace only when it actually carries a traced message
+        tracer = self.tracer
+        otel = None
+        if tracer is not None:
+            otel = np.fromiter(
+                (getattr(m, "_otel_span", None) is not None
+                 for m in msgs), bool, n,
+            )
+            if not otel.any():
+                otel = None
+        samp = None
+        if self.lifecycle.active:
+            samp = np.fromiter(
+                (getattr(m, "_trace_ctx", None) is not None
+                 for m in msgs), bool, n,
+            )
+            if not samp.any():
+                samp = None
+        traced_clients: Optional[Dict] = {} if samp is not None else None
+        lib = dispatchasm.load()
+        native_ok = lib is not None
+        # the window splice plan: per-run body/pid columns accumulate
+        # here and ONE native call after the loop assembles every
+        # client's wire into one buffer with per-run output offsets
+        plan_bodies: List[np.ndarray] = []
+        plan_pids: List[np.ndarray] = []
+        plan_sends: List[Tuple] = []  # (send_wire, (n0, n1, n2))
+        plan_counts: List[Tuple[int, int]] = []  # (k, e) per planned run
+        cnt = np.zeros(n, dtype=np.int64)
+        now_w = time.time()  # ONE clock read for the whole window
+        floor = now_w - self.slow_subs.threshold_ms / 1000.0
+        scan_slow = bool(ts_min) and ts_min < floor
+        cm_lookup = self.cm.lookup
+        cm_channel = self.cm.channel
+        client_of = router.client_of_row
+        sm_l = sm_a.tolist()
+        cuts = np.flatnonzero(sra[1:] != sra[:-1]) + 1
+        bounds = [0, *cuts.tolist(), nd_total]
+        # per-RUN aggregates, reduced window-wide in a handful of
+        # vectorized passes so the run loop does no per-run numpy
+        # reductions: subid/no-local presence, kept counts, pending
+        # (QoS>0) and QoS1 counts for BOTH effective-QoS variants
+        starts = np.asarray(bounds[:-1], dtype=np.int64)
+        keepw = ~drop
+        keep_i = keepw.astype(np.int64)
+        run_subid = np.maximum.reduceat(sidb, starts)
+        run_drop = np.maximum.reduceat(drop, starts)
+        run_kq_min = np.add.reduceat(keep_i * (qmin > 0), starts)
+        run_kq_max = np.add.reduceat(keep_i * (qmax > 0), starts)
+        run_n1_min = np.add.reduceat(keep_i * (qmin == 1), starts)
+        run_n1_max = np.add.reduceat(keep_i * (qmax == 1), starts)
+        # one shareable inflight-entry list / pid layout per unique
+        # run shape: a fanout window's runs overwhelmingly repeat the
+        # same (deliveries, qos) pattern, so entry construction runs
+        # once per SHAPE, not once per subscriber (entries are
+        # replace-not-mutate; see session._InflightEntry)
+        ecache: Dict = {}
+        bcache: Dict = {}
+        # a full run (every window message once, in order) bumps every
+        # count by one — recognized by byte-compare against the iota
+        # pattern so the hot fanout shape skips per-element scatter
+        iota_b = np.arange(n, dtype=sm_a.dtype).tobytes()
+        full_runs = 0
+        n_clients = 0
+        for bi in range(len(bounds) - 1):
+            k, e = bounds[bi], bounds[bi + 1]
+            clientid = client_of(int(sra[k]))
+            n_clients += 1
+            try:
+                session = cm_lookup(clientid)
+                if session is None:
+                    if self.durable is not None and \
+                            self.durable.has_checkpoint(clientid):
+                        # detached across a restart: already persisted
+                        # by the gate, replays on resume — not a drop
+                        continue
+                    mloc["delivery.dropped"] += e - k
+                    continue
+                upgrade = session.upgrade_qos
+                eff = (qmax if upgrade else qmin)[k:e]
+                channel = cm_channel(clientid)
+                if channel is None:
+                    # detached persistent session: materialize the run
+                    # (off the wire hot path) and take the SAME
+                    # queue/bake/replicate code the scalar path uses
+                    flags = self._queue_detached_run(
+                        session, clientid,
+                        self._materialize_run(
+                            msgs, router, sm_l, so_a, k, e
+                        ),
+                        mloc, bake_cache,
+                    )
+                    for t, f in enumerate(flags):
+                        if f:
+                            cnt[sm_l[k + t]] += 1
+                    continue
+                cork = getattr(channel, "cork", None)
+                if cork is not None:
+                    cork()
+                    corked.append(channel)
+                version = getattr(channel, "version", None)
+                send_wire = getattr(channel, "send_wire", None)
+                # lazy delivery lists: materialize ONLY for an actual
+                # consumer — hook/batch sink (window-wide), or a
+                # traced/sampled message in THIS run
+                deliveries = None
+                need = deliver_hook or delivered_runs is not None
+                if not need and otel is not None:
+                    need = bool(otel[sm_a[k:e]].any())
+                sampled_run = (
+                    samp is not None and bool(samp[sm_a[k:e]].any())
+                )
+                if need or sampled_run:
+                    deliveries = self._materialize_run(
+                        msgs, router, sm_l, so_a, k, e
+                    )
+                kq = int(
+                    (run_kq_max if upgrade else run_kq_min)[bi]
+                )
+                planned = False
+                native = (
+                    native_ok
+                    and version is not None
+                    and send_wire is not None
+                    and not run_subid[bi]
+                )
+                if native and kq and not session.inflight.room_for(kq):
+                    # full/near-full inflight window: the scalar
+                    # loop queues the overflow per delivery
+                    native = False
+                if native:
+                    has_drop = bool(run_drop[bi])
+                    keysw = kmax if upgrade else kmin
+                    if has_drop:
+                        keep = keepw[k:e]
+                        keys = keysw[k:e][keep]
+                    else:
+                        keys = keysw[k:e]
+                    # per-window body-column cache: fanout runs repeat
+                    # the same key pattern, so the slot gather runs
+                    # once per distinct (version, keys) shape
+                    bkey = (version, keys.tobytes())
+                    body = bcache.get(bkey)
+                    if body is None:
+                        body = bcache[bkey] = enc.key_slots(
+                            msgs, version, keys
+                        )
+                    nk = len(body)
+                    n1 = n2 = 0
+                    if kq == 0:
+                        pid_seg = _NEG1_SEG[:nk] if nk <= len(
+                            _NEG1_SEG
+                        ) else np.full(nk, -1, dtype=np.int64)
+                    else:
+                        n1 = int(
+                            (run_n1_max if upgrade else run_n1_min)[bi]
+                        )
+                        n2 = kq - n1
+                        if has_drop or kq != nk:
+                            # mixed run: locate the pending positions
+                            effk = eff[keepw[k:e]] if has_drop else eff
+                            pend_pos = np.flatnonzero(effk > 0)
+                            if has_drop:
+                                pend_abs = (
+                                    np.flatnonzero(keepw[k:e])[pend_pos]
+                                    + k
+                                )
+                            else:
+                                pend_abs = pend_pos + k
+                            pend_sm = sm_a[pend_abs]
+                            pend_q = effk[pend_pos]
+                            ekey = (
+                                pend_sm.tobytes(), pend_q.tobytes()
+                            )
+                            entries = ecache.get(ekey)
+                            if entries is None:
+                                entries = ecache[ekey] = \
+                                    publish_entries(
+                                        zip(
+                                            map(msgs.__getitem__,
+                                                pend_sm.tolist()),
+                                            pend_q.tolist(),
+                                        ),
+                                        now_w,
+                                    )
+                            pids = session.bookkeep_entries(entries)
+                            pid_seg = np.full(nk, -1, dtype=np.int64)
+                            pid_seg[pend_pos] = (
+                                np.arange(
+                                    pids, pids + kq, dtype=np.int64
+                                )
+                                if type(pids) is int else pids
+                            )
+                        else:
+                            # the common shape: every delivery kept
+                            # and pending — the run's entry list is
+                            # the cached window shape, pids are the
+                            # whole segment
+                            ekey = (
+                                sm_a[k:e].tobytes(), eff.tobytes()
+                            )
+                            entries = ecache.get(ekey)
+                            if entries is None:
+                                entries = ecache[ekey] = \
+                                    publish_entries(
+                                        zip(
+                                            map(msgs.__getitem__,
+                                                sm_l[k:e]),
+                                            eff.tolist(),
+                                        ),
+                                        now_w,
+                                    )
+                            pids = session.bookkeep_entries(entries)
+                            pid_seg = (
+                                np.arange(
+                                    pids, pids + nk, dtype=np.int64
+                                )
+                                if type(pids) is int
+                                else np.asarray(pids, dtype=np.int64)
+                            )
+                    if nk:  # an all-dropped run has no wire (and
+                        # would break the assemble plan's reduceat)
+                        plan_bodies.append(body)
+                        plan_pids.append(pid_seg)
+                        plan_sends.append(
+                            (send_wire, (nk - kq, n1, n2))
+                        )
+                        # counts for planned runs are deferred until
+                        # the window splice SUCCEEDS (parity with the
+                        # scalar path, where a native failure raises
+                        # before counting)
+                        plan_counts.append((k, e))
+                        planned = True
+                else:
+                    if deliveries is None:
+                        deliveries = self._materialize_run(
+                            msgs, router, sm_l, so_a, k, e
+                        )
+                    packets = session.deliver(
+                        deliveries, encoder=enc, version=version
+                    )
+                    channel.send_packets(packets)
+                if deliver_hook:
+                    self.hooks.run(
+                        "message.delivered", clientid, deliveries
+                    )
+                if delivered_runs is not None:
+                    delivered_runs.append((clientid, deliveries))
+                if sampled_run:
+                    # a sampled message's lifecycle span names the
+                    # clients that RECEIVED it (guard: sampled runs
+                    # only — unsampled windows never enter here); a
+                    # no-local-dropped delivery never reached this
+                    # client, so the drop column gates the attribution
+                    dropr = drop[k:e]
+                    for t, (dm, _o) in enumerate(deliveries):
+                        if dropr[t]:
+                            continue
+                        tctx = getattr(dm, "_trace_ctx", None)
+                        if tctx is not None:
+                            traced_clients.setdefault(
+                                id(dm), []
+                            ).append(clientid)
+                if scan_slow:
+                    self._slow_scan_run(
+                        clientid,
+                        map(msgs.__getitem__, sm_l[k:e]),
+                        now_w, floor,
+                    )
+                if tracer is not None and deliveries is not None:
+                    self._deliver_span(clientid, deliveries)
+                # a connected run counts every delivery (parity with
+                # the scalar path's all-delivered return), counted
+                # LAST so a failed run contributes none; native-
+                # planned runs count after the window splice succeeds
+                if not planned:
+                    sm_run = sm_a[k:e]
+                    if e - k == n and sm_run.tobytes() == iota_b:
+                        full_runs += 1
+                    else:
+                        np.add.at(cnt, sm_run, 1)
+            except Exception:
+                log.exception("dispatch to %s failed", clientid)
+                mloc["messages.publish.error"] += 1
+                continue
+        if plan_bodies:
+            if self._assemble_window_native(
+                lib, enc, plan_bodies, plan_pids, plan_sends, mloc, asm
+            ):
+                for k, e in plan_counts:
+                    if e - k == n and sm_a[k:e].tobytes() == iota_b:
+                        full_runs += 1
+                    else:
+                        np.add.at(cnt, sm_a[k:e], 1)
+        if full_runs:
+            cnt += full_runs
+        if cnt.any():
+            for i in np.flatnonzero(cnt).tolist():
+                counts[i] += int(cnt[i])
+        return n_clients, traced_clients
+
+    def _assemble_window_native(
+        self, lib, enc, plan_bodies, plan_pids, plan_sends, mloc, asm
+    ) -> bool:
+        """Execute the window's splice plan: ONE GIL-released
+        `da_assemble_window` call builds every planned run's wire into
+        one buffer, then each connection gets its zero-copy slice as a
+        corked ``Raw`` blob.  On a span-table mismatch (negative
+        return) NO run's bytes ship — QoS>0 deliveries redeliver via
+        the inflight retry path with dup=1, QoS0 are lost as on any
+        failed write — because a partially shifted buffer could
+        interleave one client's frames into another's stream.
+        Returns False on that failure so the caller skips the planned
+        runs' delivery counts too (the ``message.delivered`` hooks may
+        already have fired — that asymmetry is accepted on this
+        defensive invariant-violated path)."""
+        nruns = len(plan_bodies)
+        run_lens = np.fromiter(
+            (len(b) for b in plan_bodies), np.int64, nruns
+        )
+        run_start = np.zeros(nruns, dtype=np.int64)
+        np.cumsum(run_lens[:-1], out=run_start[1:])
+        body_all = (
+            plan_bodies[0] if nruns == 1
+            else np.concatenate(plan_bodies)
+        )
+        pid_all = (
+            plan_pids[0] if nruns == 1 else np.concatenate(plan_pids)
+        )
+        # per-run byte sizes from the (now complete) span tables in
+        # ONE vectorized pass over the window columns; the exclusive
+        # cumsum is each run's planned output offset.  Zero-length
+        # runs never enter the plan, so reduceat boundaries are sound.
+        ho, hl, to, tl = enc.span_arrays()
+        d_sizes = hl[body_all] + tl[body_all] + 2 * (pid_all >= 0)
+        sizes = np.add.reduceat(d_sizes, run_start)
+        run_out = np.zeros(nruns, dtype=np.int64)
+        np.cumsum(sizes[:-1], out=run_out[1:])
+        total = int(sizes.sum())
+        out = bytearray(total)
+        t0 = time.perf_counter() if asm is not None else 0.0
+        try:
+            wrote = dispatchasm.assemble_window(
+                lib, enc.native_views(), body_all, pid_all,
+                run_start, run_out, nruns, len(body_all), out,
+            )
+            if wrote != total:
+                raise RuntimeError(
+                    f"native window assembly wrote {wrote} of "
+                    f"{total} bytes across {nruns} runs"
+                )
+        except Exception:
+            log.exception(
+                "native window assembly failed; dropping %d runs' "
+                "wire (QoS>0 redelivers via retry)", nruns,
+            )
+            mloc["messages.publish.error"] += nruns
+            return False
+        finally:
+            if asm is not None:
+                asm[0] += time.perf_counter() - t0
+        mv = memoryview(out)
+        w0 = w1 = w2 = 0
+        for (send_wire, npub), o, ln in zip(
+            plan_sends, run_out.tolist(), sizes.tolist()
+        ):
+            # a channel that started closing mid-window drops its blob
+            # (send_wire returns False) — its counters must not flush
+            if send_wire(mv[o:o + ln], npub, count=False):
+                w0 += npub[0]
+                w1 += npub[1]
+                w2 += npub[2]
+        # ONE window-level flush of the sent counters (same registry
+        # names `Channel.send_wire`/`send_packets` bump; inc_bulk
+        # lands them under one lock with the rest of the window)
+        total_pub = w0 + w1 + w2
+        if total_pub:
+            mloc["messages.sent"] += total_pub
+            mloc["packets.publish.sent"] += total_pub
+            if w0:
+                mloc["messages.qos0.sent"] += w0
+            if w1:
+                mloc["messages.qos1.sent"] += w1
+            if w2:
+                mloc["messages.qos2.sent"] += w2
+        return True
 
     def _delivery_allowed(self, clientid: str, msg: Message) -> bool:
         """Delivery-guard check; must gate EVERY path that puts a
@@ -1174,15 +1716,16 @@ class Broker:
         group: str,
         s_msg: List[int],
         s_rows: List[int],
-        s_opts: List[SubOpts],
+        s_opts_rows: List[int],
     ) -> None:
         """Pick one live group member, skipping dead ones
         (redispatch, emqx_shared_sub.erl:144-166), appending the pick
-        to the window's shared delivery columns.  With durable
-        storage on, DETACHED persistent members are skipped too: their
-        share of the group's traffic arrives via stream-assigned
-        replay (durable shared subs) — queueing here as well would
-        double-deliver the offline interval."""
+        to the window's shared delivery columns (the opts-TABLE slot,
+        so shared deliveries ride the decision columns like direct
+        ones).  With durable storage on, DETACHED persistent members
+        are skipped too: their share of the group's traffic arrives
+        via stream-assigned replay (durable shared subs) — queueing
+        here as well would double-deliver the offline interval."""
         tried: Set[str] = set()
         while True:
             picked = self.router.shared.pick(group, real, msg, exclude=tried)
@@ -1194,14 +1737,14 @@ class Broker:
                 or self.cm.channel(picked) is not None
                 or session.expiry_interval <= 0
             ):
-                opts = self.router.shared_opts(real, group, picked)
-                if opts is not None:
+                slot = self.router.shared_slot_of(real, group, picked)
+                if slot is not None:
                     row = self.router.row_of_client(picked)
                     if row is None:  # defensive: intern on demand
                         row = self.router._intern(picked)
                     s_msg.append(msg_i)
                     s_rows.append(row)
-                    s_opts.append(opts)
+                    s_opts_rows.append(slot)
                 return
             tried.add(picked)
 
@@ -1214,6 +1757,7 @@ class Broker:
         corked: List,
         bake_cache: Optional[Dict] = None,
         delivered_runs: Optional[List] = None,
+        deliver_hook: bool = True,
         asm: Optional[List[float]] = None,
         ts_min: float = 0.0,
     ) -> Optional[List[int]]:
@@ -1276,40 +1820,49 @@ class Broker:
                     deliveries, encoder=encoder, version=version
                 )
                 channel.send_packets(packets)
-            self.hooks.run("message.delivered", clientid, deliveries)
+            if deliver_hook:
+                # skipped entirely (no method resolution, no chain
+                # walk) when nothing registered for the hookpoint
+                self.hooks.run("message.delivered", clientid, deliveries)
             if delivered_runs is not None:
                 delivered_runs.append((clientid, deliveries))
             now = time.time()
-            slow = self.slow_subs
-            floor = now - slow.threshold_ms / 1000.0
+            floor = now - self.slow_subs.threshold_ms / 1000.0
             if ts_min and ts_min < floor:
                 # only scan the run when the window's OLDEST publish
                 # could cross the threshold (the common all-fresh
                 # window pays one compare, not one per delivery)
-                for m, _opts in deliveries:
-                    if m.timestamp and m.timestamp < floor:
-                        # a sampled slow delivery records its trace id,
-                        # so the slow-subs board links straight to the
-                        # offending message's full lifecycle trace
-                        tctx = getattr(m, "_trace_ctx", None)
-                        slow.record(
-                            clientid, m.topic,
-                            (now - m.timestamp) * 1000.0,
-                            trace_id=(
-                                tctx.trace_id if tctx is not None else ""
-                            ),
-                        )
+                self._slow_scan_run(
+                    clientid, (m for m, _o in deliveries), now, floor
+                )
             if self.tracer is not None:
                 self._deliver_span(clientid, deliveries)
             return None  # all delivered
-        # detached persistent session: queue QoS>0, drop QoS0.  The
-        # baked queued copy (effective qos + subopts folded in) is
-        # shared across every detached session in the window via
-        # ``bake_cache`` — one bake per (msg, qos, retain, subid)
-        # signature instead of one per (client, delivery); queued
-        # copies are never mutated downstream, so sharing is safe and
-        # `replicate_queued` wire output is unchanged.
-        flags = [0] * nd
+        # detached persistent session
+        return self._queue_detached_run(
+            session, clientid, deliveries, mloc, bake_cache
+        )
+
+    def _queue_detached_run(
+        self,
+        session: Session,
+        clientid: str,
+        deliveries: List[Tuple[Message, SubOpts]],
+        mloc: Counter,
+        bake_cache: Optional[Dict],
+    ) -> List[int]:
+        """Queue one DETACHED persistent session's run: QoS>0 queued,
+        QoS0 dropped; returns per-delivery kept flags.  The baked
+        queued copy (effective qos + subopts folded in) is shared
+        across every detached session in the window via ``bake_cache``
+        — one bake per (msg, qos, retain, subid) signature instead of
+        one per (client, delivery); queued copies are never mutated
+        downstream, so sharing is safe and `replicate_queued` wire
+        output is unchanged.  ONE implementation serves both the
+        scalar and the decision-column dispatch paths, so the bake
+        signature and queue_full accounting can never diverge.  (Off
+        the wire hot path: detached runs queue, they don't encode.)"""
+        flags = [0] * len(deliveries)
         replicated = []
         for k, (m, opts) in enumerate(deliveries):
             qos = session._effective_qos(m.qos, opts)
@@ -1342,6 +1895,28 @@ class Broker:
                 clientid, [msg_to_wire(m) for m in replicated]
             )
         return flags
+
+    def _slow_scan_run(
+        self, clientid: str, run_msgs, now: float, floor: float
+    ) -> None:
+        """Record slow deliveries for one client run (the caller has
+        already pre-checked the window's oldest timestamp against the
+        floor).  A sampled slow delivery records its trace id, so the
+        slow-subs board links straight to the offending message's
+        full lifecycle trace.  ONE implementation serves the scalar
+        and columns paths — the threshold semantics and trace linkage
+        cannot diverge."""
+        slow = self.slow_subs
+        for m in run_msgs:
+            if m.timestamp and m.timestamp < floor:
+                tctx = getattr(m, "_trace_ctx", None)
+                slow.record(
+                    clientid, m.topic,
+                    (now - m.timestamp) * 1000.0,
+                    trace_id=(
+                        tctx.trace_id if tctx is not None else ""
+                    ),
+                )
 
     def _deliver_span(
         self, clientid: str, deliveries: List[Tuple[Message, SubOpts]]

@@ -172,25 +172,31 @@ class Channel:
                 return
             self._send(packets)
 
-    def send_wire(self, data, npub: Tuple[int, int, int]) -> None:
+    def send_wire(self, data, npub: Tuple[int, int, int],
+                  count: bool = True) -> bool:
         """One pre-assembled delivery run (the native window fast
         path): the same per-qos metric slots `send_packets` bumps,
         then ONE `Raw` blob into the corked buffer — per delivery the
-        channel does no Python work at all."""
+        channel does no Python work at all.  ``count=False`` skips
+        the metric bumps for callers that batch a whole WINDOW's
+        sent counters into one flush (the splice-plan dispatch);
+        returns False when the blob was dropped (closing channel) so
+        those callers don't count bytes that never shipped."""
         if self._closing:
-            return
-        m = self.broker.metrics
-        sent = self._pub_sent_slots(m)
-        total = 0
-        for q in (0, 1, 2):
-            if npub[q]:
-                m.inc_slots(sent[q], npub[q])
-                total += npub[q]
+            return False
+        total = npub[0] + npub[1] + npub[2]
+        if count:
+            m = self.broker.metrics
+            sent = self._pub_sent_slots(m)
+            for q in (0, 1, 2):
+                if npub[q]:
+                    m.inc_slots(sent[q], npub[q])
         pkt = C.Raw(data, self.version, total)
         if self._cork_depth:
             self._cork_buf.append(pkt)
-            return
+            return True
         self._send([pkt])
+        return True
 
     def close(self, reason: str) -> None:
         """CM-initiated close (takeover/kick): tell a v5 client why."""

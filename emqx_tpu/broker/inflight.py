@@ -25,22 +25,51 @@ class Inflight:
     def is_full(self) -> bool:
         return self.max_size > 0 and len(self._d) >= self.max_size
 
+    def room_for(self, n: int) -> bool:
+        """Can the window absorb ``n`` more entries right now?  The
+        native run paths use this as their all-or-nothing gate: a run
+        that would overflow falls back to the per-delivery loop, which
+        queues the overflow one delivery at a time."""
+        return self.max_size <= 0 or len(self._d) + n <= self.max_size
+
     def insert(self, key: int, value: Any) -> None:
         if key in self._d:
             raise KeyError(f"packet id {key} already in flight")
         self._d[key] = value
 
     def insert_run(self, keys, values) -> None:
-        """Bulk insert for one delivery run: one pass over aligned
-        (key, value) sequences with the same duplicate check as
-        `insert` — the caller builds all values with ONE clock read,
-        so a 64-delivery run costs one scan instead of 64 insert calls
+        """Bulk insert for one delivery run: the same duplicate check
+        as `insert`, but the clean case (no key already in flight) is
+        ONE C-speed disjointness probe plus one dict.update — the
+        caller builds all values with ONE clock read, so a
+        64-delivery run costs two C calls instead of 64 insert calls
         (and 64 ``time.time()``s)."""
         d = self._d
-        for key, value in zip(keys, values):
+        kl = keys if isinstance(keys, list) else list(keys)
+        # batch-internal duplicates must raise as loudly as in-flight
+        # ones (two PUBLISHes sharing one pid would ack as one)
+        if len(set(kl)) == len(kl) and d.keys().isdisjoint(kl):
+            d.update(zip(kl, values))
+            return
+        # a colliding run keeps insert-by-insert semantics: entries
+        # before the duplicate land, the duplicate raises (a batch-
+        # internal dup's first occurrence is in `d` by the time the
+        # second is checked)
+        for key, value in zip(kl, values):
             if key in d:
                 raise KeyError(f"packet id {key} already in flight")
             d[key] = value
+
+    def insert_seq(self, lo: int, values) -> None:
+        """Insert ``values`` under consecutive keys ``lo..lo+n-1``
+        the caller has already proven free (`free_range`) — one
+        dict.update, no per-key Python."""
+        self._d.update(zip(range(lo, lo + len(values)), values))
+
+    def free_range(self, lo: int, hi: int) -> bool:
+        """True when no key lies in [lo, hi] — one C-speed scan, the
+        block allocator's consecutive-ids fast path."""
+        return self._d.keys().isdisjoint(range(lo, hi + 1))
 
     def update(self, key: int, value: Any) -> None:
         if key not in self._d:

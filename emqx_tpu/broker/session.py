@@ -46,6 +46,14 @@ def _neg1_ptr(n: int):
     return _NEG1_PTR
 
 
+def publish_entries(pairs, now: float) -> List["_InflightEntry"]:
+    """Fresh PUBLISHING-phase inflight entries for ``(msg, qos)``
+    pairs, all stamped with one clock read — the factory the window
+    dispatch uses to build each unique run shape's shareable entry
+    list (`Session.bookkeep_entries`)."""
+    return [_InflightEntry(_PUBLISHING, m, q, now) for m, q in pairs]
+
+
 @dataclass
 class SubOpts:
     """Per-subscription options (the reference's subopts map)."""
@@ -79,12 +87,23 @@ class SubOpts:
         return cls(**data)
 
 
-@dataclass
 class _InflightEntry:
-    phase: str
-    msg: Optional[Message]
-    qos: int
-    ts: float
+    """One inflight-window entry.  A plain __slots__ class (not a
+    dataclass): fanout windows construct tens of thousands of these
+    per second, and the generated dataclass __init__ was a measurable
+    share of the deliver stage.  Entries are immutable by convention —
+    every transition REPLACES the entry (`Inflight.update`), never
+    mutates one — which is what lets the window dispatch share one
+    entry across every subscriber of the same (msg, qos) delivery."""
+
+    __slots__ = ("phase", "msg", "qos", "ts")
+
+    def __init__(self, phase: str, msg: Optional[Message], qos: int,
+                 ts: float) -> None:
+        self.phase = phase
+        self.msg = msg
+        self.qos = qos
+        self.ts = ts
 
 
 class Session:
@@ -145,11 +164,25 @@ class Session:
         with wraparound and in-use-skip semantics identical to ``n``
         sequential `_alloc_packet_id` calls — ids granted earlier in
         the block count as in use even though their inflight inserts
-        land afterwards (`Inflight.insert_run`)."""
-        out: List[int] = []
+        land afterwards (`Inflight.insert_run`).
+
+        Fast path: away from the 65535 wrap, the next ``n``
+        consecutive ids are almost always all free (sessions that ack
+        keep the window tiny), so one C-speed membership scan replaces
+        the per-id skip loop; any collision falls back to the exact
+        sequential semantics."""
+        lo = self._consecutive_block(n)
+        if lo is not None:
+            return list(range(lo, lo + n))
+        return self._alloc_exact(n)
+
+    def _alloc_exact(self, n: int) -> List[int]:
+        """The exact sequential-semantics allocator (wraparound +
+        in-use skip), for blocks the consecutive probe rejected."""
         inflight = self.inflight
-        taken = set()
         pid = self._next_pid
+        out: List[int] = []
+        taken = set()
         for _ in range(n):
             for _ in range(65535):
                 pid = pid % 65535 + 1
@@ -161,6 +194,22 @@ class Session:
                 raise RuntimeError("no free packet id")
         self._next_pid = pid
         return out
+
+    def _consecutive_block(self, n: int) -> Optional[int]:
+        """Claim ``n`` consecutive free packet ids starting after
+        ``_next_pid`` in one C-speed probe; returns the first id, or
+        None when the block would wrap or collide (callers fall back
+        to the exact sequential allocator).  The ONE home of the
+        fast-path predicate, shared by `alloc_packet_ids` and
+        `bookkeep_entries`."""
+        pid = self._next_pid
+        if pid + n <= 65535 and (
+            len(self.inflight) == 0
+            or self.inflight.free_range(pid + 1, pid + n)
+        ):
+            self._next_pid = pid + n
+            return pid + 1
+        return None
 
     # ------------------------------------------------------ subscribe
 
@@ -197,17 +246,20 @@ class Session:
         cid = self.clientid
         upgrade = self.upgrade_qos
         now = time.time()  # ONE clock read per run (PERF402)
+        # PERF403 ignores below: this loop IS the scalar referee — the
+        # per-delivery reads here define the semantics the window
+        # decision columns are property-tested bit-identical against
         for msg, opts in deliveries:
-            if opts.no_local and msg.from_client == cid:
+            if opts.no_local and msg.from_client == cid:  # brokerlint: ignore[PERF403]
                 continue  # [MQTT-3.8.3-3]
             # inline _effective_qos: this loop runs once per delivery
             # of every fan-out window
-            mq, oq = msg.qos, opts.qos
+            mq, oq = msg.qos, opts.qos  # brokerlint: ignore[PERF403]
             qos = (mq if mq > oq else oq) if upgrade else (
                 mq if mq < oq else oq
             )
             if qos == 0:
-                if enc is not None and opts.subid is None:
+                if enc is not None and opts.subid is None:  # brokerlint: ignore[PERF403]
                     out.append(enc.publish_qos0(msg, opts, version))
                 else:
                     out.append(self._publish_packet(msg, opts, 0, None))
@@ -221,7 +273,7 @@ class Session:
             self.inflight.insert(
                 pid, _InflightEntry(_PUBLISHING, msg, qos, now)
             )
-            if enc is not None and opts.subid is None:
+            if enc is not None and opts.subid is None:  # brokerlint: ignore[PERF403]
                 out.append(enc.publish(msg, opts, qos, pid, version))
             else:
                 out.append(self._publish_packet(msg, opts, qos, pid))
@@ -270,11 +322,14 @@ class Session:
         oq = nl = rap = 0
         for msg, opts in deliveries:
             if opts is not last_opts:
-                if opts.subid is not None:
+                # PERF403 ignores: already amortized to one read per
+                # opts IDENTITY (not per delivery), and this run-local
+                # path is the columns' scalar fallback
+                if opts.subid is not None:  # brokerlint: ignore[PERF403]
                     return None  # per-subscriber props: fall back
-                oq = opts.qos
-                nl = opts.no_local
-                rap = opts.retain_as_published
+                oq = opts.qos  # brokerlint: ignore[PERF403]
+                nl = opts.no_local  # brokerlint: ignore[PERF403]
+                rap = opts.retain_as_published  # brokerlint: ignore[PERF403]
                 last_opts = opts
             mq = msg.qos
             qos = (mq if mq > oq else oq) if upgrade else (
@@ -294,10 +349,7 @@ class Session:
             slots.append(slot)
             total += hls[slot] + tls[slot]
         k = len(pend)
-        inflight = self.inflight
-        if k and inflight.max_size > 0 and (
-            len(inflight) + k > inflight.max_size
-        ):
+        if k and not self.inflight.room_for(k):
             return None  # full/near-full window: fallback queues overflow
         n = len(slots)
         n1 = n2 = 0
@@ -307,13 +359,9 @@ class Session:
         if k:
             total += 2 * k
             pid_arr = np.full(n, -1, dtype=np.int64)
-            pids = self.alloc_packet_ids(k)
-            pid_arr[pid_pos] = pids
             now = time.time()  # ONE clock read per run
-            inflight.insert_run(
-                pids,
-                [_InflightEntry(_PUBLISHING, m, q, now) for m, q in pend],
-            )
+            pids = self.bookkeep_run(pend, now)
+            pid_arr[pid_pos] = pids
             for _m, q in pend:
                 if q == 1:
                     n1 += 1
@@ -331,6 +379,44 @@ class Session:
                 f"native assembly wrote {wrote} of {total} bytes"
             )
         return out, (n0, n1, n2)
+
+    def bookkeep_run(
+        self, pend: List[Tuple[Message, int]], now: float
+    ) -> List[int]:
+        """QoS>0 bookkeeping for one delivery run: block packet-id
+        allocation plus ONE bulk inflight insert, all entries stamped
+        with the caller's single clock read.  ``pend`` is the run's
+        kept QoS>0 deliveries as ``(msg, effective_qos)`` in delivery
+        order; the caller has already checked `Inflight.room_for`.
+        Shared by `deliver_run_native` and the window decision-column
+        path (which makes one call per run but assembles the whole
+        window's wire in one native splice)."""
+        pids = self.alloc_packet_ids(len(pend))
+        self.inflight.insert_run(
+            pids,
+            [_InflightEntry(_PUBLISHING, m, q, now) for m, q in pend],
+        )
+        return pids
+
+    def bookkeep_entries(self, entries: List[_InflightEntry]):
+        """`bookkeep_run` for pre-built entries: the columns dispatch
+        builds ONE entry list per unique (deliveries, qos) run shape
+        and shares it across every subscriber in the window (entries
+        are replace-not-mutate, see `_InflightEntry`), so a fanout-256
+        window constructs 64 entries instead of 16384.
+
+        Returns an ``int`` first-pid when the block is the consecutive
+        fast path (ids ``pid..pid+n-1``, no list ever materialized) or
+        the explicit pid ``List[int]`` from the exact allocator."""
+        lo = self._consecutive_block(len(entries))
+        if lo is not None:
+            self.inflight.insert_seq(lo, entries)
+            return lo
+        # straight to the exact allocator: the probe just failed, so
+        # alloc_packet_ids' fast path would only repeat the scan
+        pids = self._alloc_exact(len(entries))
+        self.inflight.insert_run(pids, entries)
+        return pids
 
     def _effective_qos(self, msg_qos: int, opts: SubOpts) -> int:
         if self.upgrade_qos:

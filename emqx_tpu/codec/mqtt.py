@@ -975,7 +975,7 @@ class DispatchEncoder:
     __slots__ = ("_parts", "_q0", "arena", "slot_index",
                  "head_lens", "tail_lens",
                  "_head_off", "_tail_off", "_span_np", "_span_ptrs",
-                 "_arena_export")
+                 "_arena_export", "_key_tbl")
 
     def __init__(self) -> None:
         self._parts: Dict[Tuple, Tuple] = {}
@@ -991,6 +991,10 @@ class DispatchEncoder:
         self._span_np: Optional[Tuple] = None
         self._span_ptrs: Optional[Tuple] = None
         self._arena_export = None  # pinned ctypes view of the arena
+        # per-version numpy body-key -> slot maps (key = msg_idx*6 +
+        # effective_qos*2 + retain), the vectorized front of
+        # `slot_for` used by the window decision columns
+        self._key_tbl: Dict[int, "np.ndarray"] = {}
 
     # ------------------------------------------- native window assembly
 
@@ -1041,6 +1045,30 @@ class DispatchEncoder:
             self._span_ptrs = None
             self.slot_index[key] = s
         return s
+
+    def key_slots(self, msgs, version: int, keys) -> "np.ndarray":
+        """Vectorized slot resolution for one run's body-key column
+        (``key = msg_idx*6 + effective_qos*2 + retain``): one numpy
+        table gather for every delivery whose body the window already
+        encoded, `slot_for` only for the run's NEW unique bodies —
+        per-delivery Python vanishes after a window's first few
+        clients.  Returns the int64 ``body`` (arena slot) column."""
+        tbl = self._key_tbl.get(version)
+        need = 6 * len(msgs)
+        if tbl is None or len(tbl) < need:
+            tbl = self._key_tbl[version] = np.full(
+                need, -1, dtype=np.int64
+            )
+        body = tbl[keys]
+        if len(body) and body.min() < 0:
+            for key in np.unique(keys[body < 0]).tolist():
+                i, qr = divmod(key, 6)
+                qos, retain = divmod(qr, 2)
+                tbl[key] = self.slot_for(
+                    msgs[i], qos, bool(retain), version
+                )
+            body = tbl[keys]
+        return body
 
     def span_arrays(self) -> Tuple:
         """The span tables as contiguous int64 arrays (lazily rebuilt

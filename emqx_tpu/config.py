@@ -387,6 +387,7 @@ ENV_RESERVED = {
     "EMQX_TPU_NO_NATIVE_TOKDICT",
     "EMQX_TPU_NO_NATIVE_TRIE",
     "EMQX_TPU_NO_NATIVE_DISPATCH",
+    "EMQX_TPU_NO_DECIDE",
 }
 
 

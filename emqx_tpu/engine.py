@@ -456,6 +456,27 @@ class MatchEngine:
         self._ccap_mult = 2
         # (nodes, buckets, levels) classes already shape-warmed
         self._warmed_shapes: Set[Tuple[int, int, int]] = set()
+        # ---- window decide step (dispatch decision columns) --------
+        # The dispatch half's per-delivery decisions compute as one
+        # vectorized pass (ops.match_kernel.decide_batch + its numpy
+        # twin); host-vs-device resolves per window from per-delivery
+        # cost EWMAs the same way `_auto_choose` does for matching,
+        # and device faults feed the SAME circuit breaker, so 100%
+        # device failure degrades both steps to host together.
+        self.decide_force: Optional[str] = None  # "host"/"dev" pin (tests)
+        self._dec_host_us: Optional[float] = None  # µs/delivery EWMAs
+        self._dec_dev_us: Optional[float] = None
+        self._dec_stats = {"host_windows": 0, "dev_windows": 0,
+                           "dev_errors": 0}
+        self._dec_cols_cache: Optional[Tuple] = None  # (rev, dev arrays)
+        # EWMA hygiene: the FIRST device decide window pays the JIT
+        # compile and must not poison the cost estimate, and a rare
+        # in-band re-probe keeps it fresh while host is winning (the
+        # step is micro-scale, so no out-of-band probe thread is
+        # warranted the way matching's is)
+        self._dec_dev_warm = False
+        self._dec_seq = 0
+        self._dec_probe_seq = 0
         # ---- device-path circuit breaker (failure-driven degradation)
         # The auto policy above switches paths on measured COST; the
         # breaker switches on FAILURE: `breaker_threshold` consecutive
@@ -1338,6 +1359,9 @@ class MatchEngine:
         out["auto_probes"] = self._auto_stats["probes"]
         out["breaker_slow_windows"] = self._brk_stats["slow_windows"]
         out["breaker_probes"] = self._brk_stats["probes"]
+        out["decide_host_windows"] = self._dec_stats["host_windows"]
+        out["decide_dev_windows"] = self._dec_stats["dev_windows"]
+        out["decide_dev_errors"] = self._dec_stats["dev_errors"]
         return out
 
     # -------------------------------------------------------------- match
@@ -1468,6 +1492,153 @@ class MatchEngine:
         pending = self.match_batch_submit(topics, _force_device=True)
         self.match_batch_finish(pending)
         self._auto_stats["probes"] += 1
+
+    # ------------------------------------------ window decide columns
+
+    def decide_window(
+        self,
+        cols: Tuple,
+        rev: int,
+        opts_rows: np.ndarray,
+        client_rows: np.ndarray,
+        msg_idx: np.ndarray,
+        m_qos: np.ndarray,
+        m_retain: np.ndarray,
+        m_from_row: np.ndarray,
+    ) -> Tuple[np.ndarray, str]:
+        """Compute one window's packed per-delivery decision column
+        (see ops.match_kernel's bit layout) on the host or the device,
+        chosen per window by the measured per-delivery cost EWMAs.
+
+        ``cols`` are the router's SubOpts attribute columns and ``rev``
+        their mutation counter (the device copies cache on it).  A
+        device fault degrades THIS window to the bit-identical numpy
+        twin and counts against the shared PR 1 circuit breaker, so a
+        dead device path trips matching AND deciding to host-only
+        together; the background breaker probe heals both."""
+        n = len(opts_rows)
+        if n and self._decide_choose(n):
+            try:
+                t0 = time.perf_counter()
+                packed = self._decide_device(
+                    cols, rev, opts_rows, client_rows, msg_idx,
+                    m_qos, m_retain, m_from_row,
+                )
+                us = (time.perf_counter() - t0) * 1e6 / n
+                if self._dec_dev_warm:
+                    self._dec_dev_us = (
+                        us if self._dec_dev_us is None
+                        else 0.2 * us + 0.8 * self._dec_dev_us
+                    )
+                else:
+                    # first device window: the JIT compile dominated
+                    # the wall time — warm only, don't record
+                    self._dec_dev_warm = True
+                self._dec_stats["dev_windows"] += 1
+                return packed, "dev"
+            except Exception:
+                self._dec_stats["dev_errors"] += 1
+                self._device_failure("decide")
+                import logging
+
+                logging.getLogger("emqx_tpu.engine").exception(
+                    "device decide step failed for window of %d; "
+                    "host columns", n,
+                )
+        from .ops.match_kernel import decide_batch_host
+
+        t0 = time.perf_counter()
+        packed = decide_batch_host(
+            *cols, opts_rows, client_rows, msg_idx,
+            m_qos, m_retain, m_from_row,
+        )
+        if n:
+            us = (time.perf_counter() - t0) * 1e6 / n
+            self._dec_host_us = (
+                us if self._dec_host_us is None
+                else 0.2 * us + 0.8 * self._dec_host_us
+            )
+        self._dec_stats["host_windows"] += 1
+        return packed, "host"
+
+    def _decide_choose(self, n: int) -> bool:
+        """Host (False) or device (True) for a decide window of ``n``
+        deliveries.  ``decide_force`` pins the path (tests / property
+        suites); the breaker overrides everything but a host pin."""
+        force = self.decide_force
+        if force is not None:
+            return force == "dev" and not self._brk_open
+        if self._brk_open or self.use_device is False:
+            return False
+        if self.use_device is True:
+            return True
+        # auto: the columns are one elementwise pass, so the host twin
+        # wins until windows are large enough to amortize a dispatch —
+        # measure rather than guess, seeding the device EWMA on the
+        # first big window
+        self._dec_seq += 1
+        host = self._dec_host_us if self._dec_host_us is not None else 0.05
+        dev = self._dec_dev_us
+        if dev is None:
+            use_dev = n >= 4096
+        elif n >= 512 and host > dev * 1.2:
+            use_dev = True
+        else:
+            # periodic in-band re-probe on a big window so a
+            # transient device slowdown can't pin the policy to host
+            # forever (host windows never re-measure the device)
+            use_dev = (
+                n >= 4096
+                and self._dec_seq - self._dec_probe_seq >= 1024
+            )
+        if use_dev:
+            self._dec_probe_seq = self._dec_seq
+        return use_dev
+
+    def _decide_device(
+        self, cols, rev, opts_rows, client_rows, msg_idx,
+        m_qos, m_retain, m_from_row,
+    ) -> np.ndarray:
+        """One device decide step: upload the attribute columns (cached
+        by ``rev``), pad the delivery/message columns to power-of-two
+        buckets (bounded shape classes, as `_pad_batch` does for the
+        match kernel), run the fused kernel, slice the padding off."""
+        from .ops.match_kernel import decide_batch
+
+        if failpoints.enabled:
+            # chaos seam: an injected error degrades this window to the
+            # host columns and feeds the shared device breaker
+            failpoints.evaluate("dispatch.decide.device")
+        cache = self._dec_cols_cache
+        if cache is None or cache[0] != rev:
+            import jax
+
+            cache = (rev, tuple(jax.device_put(c) for c in cols))
+            self._dec_cols_cache = cache
+        n = len(opts_rows)
+        npad = 64
+        while npad < n:
+            npad *= 2
+        b = len(m_qos)
+        bpad = 16
+        while bpad < b:
+            bpad *= 2
+
+        def pad(a, cap, fill, dtype):
+            out = np.full(cap, fill, dtype=dtype)
+            out[: len(a)] = a
+            return out
+
+        packed = decide_batch(
+            *cache[1],
+            pad(opts_rows, npad, 0, np.int32),
+            pad(client_rows, npad, -1, np.int32),
+            pad(msg_idx, npad, 0, np.int32),
+            pad(m_qos, bpad, 0, np.int8),
+            pad(m_retain, bpad, False, bool),
+            pad(m_from_row, bpad, -1, np.int32),
+        )
+        return np.asarray(packed)[:n]
 
     def match_batch(
         self, topics: Sequence[str], congested: bool = False

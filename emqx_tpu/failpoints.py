@@ -55,6 +55,7 @@ ACTIONS = ("error", "delay", "drop", "duplicate", "panic")
 # test iterates this list to prove each is a no-op when disabled)
 SEAMS = (
     "engine.device_step",
+    "dispatch.decide.device",
     "cluster.transport.send",
     "cluster.transport.recv",
     "cluster.raft.rpc",

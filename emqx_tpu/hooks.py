@@ -127,6 +127,13 @@ class HookRegistry:
     def has_async(self, name: str) -> bool:
         return name in self._async_counts
 
+    def has(self, name: str) -> bool:
+        """O(1) is-anything-registered probe: the dispatch window uses
+        it to skip the ``message.delivered`` walk (and the per-run
+        delivery-list materialization feeding it) entirely when nobody
+        registered a callback."""
+        return bool(self._chains.get(name))
+
     def callbacks(self, name: str) -> List[Callback]:
         return list(self._chains.get(name, ()))
 

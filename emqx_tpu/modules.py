@@ -176,8 +176,10 @@ class TopicMetrics:
         self._metrics: Dict[str, Dict[str, float]] = {}
         broker.hooks.add("message.publish", self._on_publish,
                          priority=5)
-        broker.hooks.add("message.delivered", self._on_delivered,
-                         priority=5)
+        # delivered tap registered lazily with the first topic filter
+        # (dropped with the last): an unused TopicMetrics must leave
+        # the hookpoint empty — the dispatch window's early return
+        self._delivered_cb = None
 
     def register(self, flt: str) -> bool:
         self._T.validate_filter(flt)
@@ -192,10 +194,20 @@ class TopicMetrics:
             "_rate_last_n": 0.0, "_rate_last_t": time.time(),
             "rate.in": 0.0,
         }
+        if self._delivered_cb is None:
+            self._delivered_cb = self.broker.hooks.add(
+                "message.delivered", self._on_delivered, priority=5
+            )
         return True
 
     def unregister(self, flt: str) -> bool:
-        return self._metrics.pop(flt, None) is not None
+        ok = self._metrics.pop(flt, None) is not None
+        if ok and not self._metrics and self._delivered_cb is not None:
+            self.broker.hooks.delete(
+                "message.delivered", self._delivered_cb
+            )
+            self._delivered_cb = None
+        return ok
 
     def _matching(self, topic: str):
         tw = self._T.words(topic)

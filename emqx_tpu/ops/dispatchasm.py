@@ -66,6 +66,17 @@ def load():
                 ctypes.c_int64,          # n deliveries
                 _U8P,                    # out
             ]
+            lib.da_assemble_window.restype = ctypes.c_int64
+            lib.da_assemble_window.argtypes = [
+                _U8P,                    # arena
+                _I64P, _I64P,            # head_off, head_len
+                _I64P, _I64P,            # tail_off, tail_len
+                _I64P, _I64P,            # body idx, pid (-1 = no pid)
+                _I64P, _I64P,            # run_start, run_out_off
+                ctypes.c_int64,          # n runs
+                ctypes.c_int64,          # n deliveries total
+                _U8P,                    # out
+            ]
             _lib = lib
         except Exception:
             logging.getLogger("emqx_tpu.ops").exception(
@@ -89,5 +100,26 @@ def assemble_run(lib, views, body, pid_ptr, n: int,
         arena, ho, hl, to, tl,
         body.ctypes.data_as(_I64P), pid_ptr,
         n,
+        (ctypes.c_uint8 * len(out)).from_buffer(out),
+    )
+
+
+def assemble_window(lib, views, body, pid, run_start, run_out_off,
+                    n_runs: int, n_total: int, out: bytearray) -> int:
+    """Splice one whole dispatch window — every client's run — into
+    ``out`` with a single GIL-released call.  ``body``/``pid`` are the
+    window-wide int64 delivery columns; ``run_start`` indexes each
+    run's first delivery and ``run_out_off`` its precomputed byte
+    offset into ``out`` (the splice plan).  Returns bytes written, or
+    a NEGATIVE -(j+1) when run ``j``'s bytes would not land at its
+    planned offset (a span-table mismatch the caller must treat as a
+    failed window, never as wire)."""
+    arena, ho, hl, to, tl = views
+    return lib.da_assemble_window(
+        arena, ho, hl, to, tl,
+        body.ctypes.data_as(_I64P), pid.ctypes.data_as(_I64P),
+        run_start.ctypes.data_as(_I64P),
+        run_out_off.ctypes.data_as(_I64P),
+        n_runs, n_total,
         (ctypes.c_uint8 * len(out)).from_buffer(out),
     )

@@ -233,3 +233,86 @@ def match_batch_compact(
     counts_out = jnp.where(ovf, -count_c - 1, count_c).astype(jnp.int16)
     total = (row_start[-1] + count_c[-1]).astype(jnp.int32)[None]
     return flat, counts_out, total
+
+
+# --------------------------------------------------- decision columns
+#
+# The dispatch half's per-delivery decisions — effective QoS, the
+# no-local drop, retain-as-published, subscription-identifier presence
+# — are pure functions of ``(opts_row, msg attrs)``: exactly the shape
+# the match step already emits, so they compute as ONE vectorized pass
+# over the window's expanded ``(msg_idx, client_row, opts_row)``
+# columns instead of a Python branch per delivery.  The result is a
+# COMPACT packed-uint8 column (one byte per delivery), same spirit as
+# `match_batch_compact`'s flat layout: cheap to stream back from the
+# device, cheap to unpack with numpy bit ops on the host.
+#
+# Packing (bit layout of each delivery's byte):
+#   bits 0-1  min(msg_qos, sub_qos)   — effective QoS, upgrade_qos off
+#   bits 2-3  max(msg_qos, sub_qos)   — effective QoS, upgrade_qos on
+#   bit 4     no-local drop (subscriber row == publisher row)
+#   bit 5     retain on the wire (msg.retain & retain_as_published)
+#   bit 6     subscription identifier present (per-subscriber props:
+#             the run must take the per-packet fallback)
+#
+# Both effective-QoS variants ride along because upgrade_qos is
+# per-session state the kernel must not depend on: the consumer
+# selects min or max per client run with one slice.  The numpy twin
+# below is bit-identical (property-tested) and serves as the host
+# path of the auto policy plus the reference for the device one.
+
+DEC_QMAX_SHIFT = 2
+DEC_DROP_BIT = 1 << 4
+DEC_RETAIN_BIT = 1 << 5
+DEC_SUBID_BIT = 1 << 6
+
+
+@jax.jit
+def decide_batch(
+    oa_qos,       # [R] int8   per-opts-row subscription QoS
+    oa_nl,        # [R] bool   no_local
+    oa_rap,       # [R] bool   retain_as_published
+    oa_subid,     # [R] bool   subscription identifier present
+    opts_rows,    # [N] int32  per-delivery opts row
+    client_rows,  # [N] int32  per-delivery subscriber row
+    msg_idx,      # [N] int32  per-delivery window message index
+    m_qos,        # [B] int8   per-message publish QoS
+    m_retain,     # [B] bool   per-message retain flag
+    m_from_row,   # [B] int32  publisher's client row (-1 = not local)
+):
+    """Device decide step: the window's packed decision column in one
+    fused elementwise pass (static shapes come from the caller's
+    padded buckets, as everywhere else in this kernel)."""
+    oq = oa_qos[opts_rows].astype(jnp.int32)
+    mq = m_qos[msg_idx].astype(jnp.int32)
+    drop = oa_nl[opts_rows] & (client_rows == m_from_row[msg_idx])
+    ret = m_retain[msg_idx] & oa_rap[opts_rows]
+    packed = (
+        jnp.minimum(mq, oq)
+        | (jnp.maximum(mq, oq) << DEC_QMAX_SHIFT)
+        | jnp.where(drop, DEC_DROP_BIT, 0)
+        | jnp.where(ret, DEC_RETAIN_BIT, 0)
+        | jnp.where(oa_subid[opts_rows], DEC_SUBID_BIT, 0)
+    )
+    return packed.astype(jnp.uint8)
+
+
+def decide_batch_host(
+    oa_qos, oa_nl, oa_rap, oa_subid,
+    opts_rows, client_rows, msg_idx,
+    m_qos, m_retain, m_from_row,
+):
+    """`decide_batch`'s bit-identical numpy twin (the host path of the
+    auto policy and the referee the device output is tested against)."""
+    oq = oa_qos[opts_rows].astype(np.int32)
+    mq = m_qos[msg_idx].astype(np.int32)
+    drop = oa_nl[opts_rows] & (client_rows == m_from_row[msg_idx])
+    ret = m_retain[msg_idx] & oa_rap[opts_rows]
+    packed = (
+        np.minimum(mq, oq)
+        | (np.maximum(mq, oq) << DEC_QMAX_SHIFT)
+        | np.where(drop, DEC_DROP_BIT, 0)
+        | np.where(ret, DEC_RETAIN_BIT, 0)
+        | np.where(oa_subid[opts_rows], DEC_SUBID_BIT, 0)
+    )
+    return packed.astype(np.uint8)

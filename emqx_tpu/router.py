@@ -34,6 +34,10 @@ from .engine import MatchEngine
 
 _EMPTY_I64 = np.empty(0, dtype=np.int64)
 
+# initial capacity of the parallel SubOpts attribute columns; grown by
+# doubling so the device decide path sees few distinct table shapes
+_OPTS_CAP0 = 64
+
 
 class _CsrBucket:
     """One filter's subscriber column: parallel (client_row, opts_row)
@@ -107,6 +111,10 @@ class Router:
         self._subs: Dict[str, Dict[str, SubOpts]] = {}
         # real filter -> {(group, clientid) -> SubOpts} (shared)
         self._shared_opts: Dict[str, Dict[Tuple[str, str], SubOpts]] = {}
+        # (real, group, clientid) -> opts table slot: shared-sub opts
+        # intern into the SAME table as direct ones, so a window's
+        # shared picks ride the decision columns like any delivery
+        self._shared_slot: Dict[Tuple[str, str, str], int] = {}
         # clientid -> set of full filter strings (incl. $share prefix)
         self._by_client: Dict[str, Set[str]] = {}
         # --- interning tables + CSR fan-out index -------------------
@@ -116,6 +124,18 @@ class Router:
         self._opts_table: List[Optional[SubOpts]] = []
         self._opts_free: List[int] = []
         self._csr: Dict[str, _CsrBucket] = {}
+        # --- parallel SubOpts attribute columns ---------------------
+        # numpy twins of `_opts_table`, maintained on every alloc/free/
+        # refresh, so a window's per-delivery decisions (effective QoS,
+        # no-local drop, RAP retain, subid presence) come from ONE
+        # vectorized gather instead of a Python attribute read per
+        # delivery.  `opts_rev` bumps on every write so the engine's
+        # device decide path can cache its uploaded copies.
+        self._oa_qos = np.zeros(_OPTS_CAP0, dtype=np.int8)
+        self._oa_nl = np.zeros(_OPTS_CAP0, dtype=bool)
+        self._oa_rap = np.zeros(_OPTS_CAP0, dtype=bool)
+        self._oa_subid = np.zeros(_OPTS_CAP0, dtype=bool)
+        self.opts_rev = 0
 
     # ---------------------------------------------------- interning
 
@@ -144,11 +164,44 @@ class Router:
         else:
             slot = len(self._opts_table)
             self._opts_table.append(opts)
+            if slot >= len(self._oa_qos):
+                # double the attribute columns: few distinct shapes
+                # keep the device decide path's recompiles bounded
+                cap = 2 * len(self._oa_qos)
+                for name in ("_oa_qos", "_oa_nl", "_oa_rap",
+                             "_oa_subid"):
+                    old = getattr(self, name)
+                    new = np.zeros(cap, dtype=old.dtype)
+                    new[: len(old)] = old
+                    setattr(self, name, new)
+        self._set_opts_attrs(slot, opts)
         return slot
+
+    def _set_opts_attrs(self, slot: int, opts: SubOpts) -> None:
+        """Mirror one SubOpts into the attribute columns (alloc AND
+        options-refresh paths — the columns must never go stale, they
+        are what the window decisions read)."""
+        self._oa_qos[slot] = opts.qos
+        self._oa_nl[slot] = opts.no_local
+        self._oa_rap[slot] = opts.retain_as_published
+        self._oa_subid[slot] = opts.subid is not None
+        self.opts_rev += 1
 
     def _free_opts(self, slot: int) -> None:
         self._opts_table[slot] = None
+        self._oa_qos[slot] = 0
+        self._oa_nl[slot] = False
+        self._oa_rap[slot] = False
+        self._oa_subid[slot] = False
+        self.opts_rev += 1
         self._opts_free.append(slot)
+
+    def opts_columns(self) -> Tuple[np.ndarray, np.ndarray,
+                                    np.ndarray, np.ndarray]:
+        """(qos, no_local, retain_as_published, has_subid) attribute
+        columns, indexed by opts row — the vectorized read side of the
+        table `_set_opts_attrs` maintains."""
+        return self._oa_qos, self._oa_nl, self._oa_rap, self._oa_subid
 
     def client_of_row(self, row: int) -> str:
         return self._row_clients[row]
@@ -174,6 +227,13 @@ class Router:
             self._shared_opts.setdefault(real, {})[
                 (shared.group, clientid)
             ] = opts
+            skey = (real, shared.group, clientid)
+            sslot = self._shared_slot.get(skey)
+            if sslot is None:
+                self._shared_slot[skey] = self._alloc_opts(opts)
+            else:  # options refresh of an existing shared subscription
+                self._opts_table[sslot] = opts
+                self._set_opts_attrs(sslot, opts)
             if need_route and real not in self._subs:
                 self.engine.insert(real, real)
                 if self.on_route_added is not None:
@@ -197,6 +257,7 @@ class Router:
                 bucket.add(row, self._alloc_opts(opts))
             else:  # options refresh of an existing subscription
                 self._opts_table[slot] = opts
+                self._set_opts_attrs(slot, opts)
         self._by_client.setdefault(clientid, set()).add(flt)
 
     def unsubscribe(self, clientid: str, flt: str) -> bool:
@@ -209,6 +270,11 @@ class Router:
                 opts_map.pop((shared.group, clientid), None)
                 if not opts_map:
                     del self._shared_opts[real]
+            sslot = self._shared_slot.pop(
+                (real, shared.group, clientid), None
+            )
+            if sslot is not None:
+                self._free_opts(sslot)
             removed = True
         else:
             real = flt
@@ -285,6 +351,13 @@ class Router:
     ) -> Optional[SubOpts]:
         m = self._shared_opts.get(real)
         return None if m is None else m.get((group, clientid))
+
+    def shared_slot_of(
+        self, real: str, group: str, clientid: str
+    ) -> Optional[int]:
+        """Opts-table slot of one shared subscription (the row a
+        window's shared pick contributes to the decision columns)."""
+        return self._shared_slot.get((real, group, clientid))
 
     # ----------------------------------------------- window expansion
 

@@ -66,7 +66,11 @@ class TraceManager:
             "session.unsubscribed", self._on_unsubscribed, priority=-100
         )
         hooks.add("message.publish", self._on_publish, priority=-200)
-        hooks.add("message.delivered", self._on_delivered, priority=-100)
+        # the delivered tap registers lazily with the FIRST rule (and
+        # unregisters with the last): an idle TraceManager must leave
+        # the hookpoint EMPTY so the dispatch window skips the hook
+        # walk and the per-run delivery-list materialization entirely
+        self._delivered_cb = None
 
     # ------------------------------------------------------ management
 
@@ -97,6 +101,10 @@ class TraceManager:
         )
         self._rules[name] = rule
         self._files[name] = open(path, "a", buffering=1)
+        if self._delivered_cb is None:
+            self._delivered_cb = self.broker.hooks.add(
+                "message.delivered", self._on_delivered, priority=-100
+            )
         return rule
 
     def stop(self, name: str) -> bool:
@@ -104,6 +112,11 @@ class TraceManager:
         f = self._files.pop(name, None)
         if f is not None:
             f.close()
+        if not self._rules and self._delivered_cb is not None:
+            self.broker.hooks.delete(
+                "message.delivered", self._delivered_cb
+            )
+            self._delivered_cb = None
         return rule is not None
 
     def list(self) -> List[Dict]:

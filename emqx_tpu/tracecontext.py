@@ -379,7 +379,8 @@ class LifecycleTracer:
     # ------------------------------------------------------- windows
 
     def window_spans(self, msgs: Sequence, counts: Sequence[int],
-                     rec=None, n_clients: int = 0) -> None:
+                     rec=None, n_clients: int = 0,
+                     clients: Optional[Dict] = None) -> None:
         """Emit one span per SAMPLED message of a finished dispatch
         window, timed entirely from the window's flight-recorder entry
         (``rec``): span = ingress→flush for a local publish, window
@@ -456,6 +457,15 @@ class LifecycleTracer:
                 },
                 "events": stage_events + fp_events,
             }
+            if clients is not None:
+                # delivering client ids for this sampled message
+                # (recorded by the columns dispatch ONLY for runs that
+                # carried a sampled message — capped so a fanout-10k
+                # span stays bounded)
+                cl = clients.get(id(msg))
+                if cl:
+                    span["attrs"]["clients"] = cl[:32]
+                    span["attrs"]["clients_total"] = len(cl)
             self.emit(span)
 
     # ------------------------------------------------------ forwards

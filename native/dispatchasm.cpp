@@ -48,4 +48,45 @@ int64_t da_assemble_run(const uint8_t* arena,
     return (int64_t)(w - out);
 }
 
+// Assemble an entire dispatch window — EVERY client's run — in one
+// GIL-released call.  The caller concatenates the per-run (body, pid)
+// columns into window-wide arrays and precomputes each run's byte
+// offset into the shared output buffer (the "splice plan"): run j
+// covers deliveries [run_start[j], run_start[j+1]) and its bytes must
+// land exactly at out + run_out_off[j], so each client's slice of the
+// window buffer becomes that connection's corked write with zero
+// re-copy.  The per-run offset is re-checked at every run boundary:
+// one corrupt span table mis-sizing run j returns -(j+1) immediately
+// instead of silently shifting every later client's wire bytes.
+int64_t da_assemble_window(const uint8_t* arena,
+                           const int64_t* head_off, const int64_t* head_len,
+                           const int64_t* tail_off, const int64_t* tail_len,
+                           const int64_t* body, const int64_t* pid,
+                           const int64_t* run_start,
+                           const int64_t* run_out_off,
+                           int64_t n_runs, int64_t n_total, uint8_t* out) {
+    uint8_t* w = out;
+    for (int64_t j = 0; j < n_runs; j++) {
+        if (w != out + run_out_off[j]) return -(j + 1);
+        const int64_t end = (j + 1 < n_runs) ? run_start[j + 1] : n_total;
+        for (int64_t i = run_start[j]; i < end; i++) {
+            const int64_t b = body[i];
+            const int64_t hl = head_len[b];
+            std::memcpy(w, arena + head_off[b], (size_t)hl);
+            w += hl;
+            const int64_t p = pid[i];
+            if (p >= 0) {
+                *w++ = (uint8_t)((p >> 8) & 0xFF);
+                *w++ = (uint8_t)(p & 0xFF);
+            }
+            const int64_t tl = tail_len[b];
+            if (tl) {
+                std::memcpy(w, arena + tail_off[b], (size_t)tl);
+                w += tl;
+            }
+        }
+    }
+    return (int64_t)(w - out);
+}
+
 }  // extern "C"

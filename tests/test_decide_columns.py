@@ -1,0 +1,544 @@
+"""Window decision columns (PR 9): vectorized per-delivery QoS /
+no-local / body-slot decisions, fused into the window pipeline.
+
+The referee suite for the three dispatch paths:
+
+  * device-fused   — `engine.decide_force = "dev"` runs the packed
+    column through ops.match_kernel.decide_batch (JAX);
+  * host-vectorized — `"host"` pins the numpy twin;
+  * scalar fallback — `Broker._decide_columns = False` takes the
+    pre-columns per-run path (`_dispatch_scalar` → deliver_run_native
+    / Session.deliver).
+
+All three must put bit-identical bytes on every connection's wire,
+with identical delivery counts, per-qos sent metrics, and (pid, qos)
+inflight windows, over random worlds mixing qos / no_local / RAP /
+subid / upgrade_qos / v4-v5 / inflight pressure.  Plus: the lazy
+delivery-list materialization (zero per-delivery tuples for windows
+nobody consumes), the sampled-run tracer guard, the router attribute
+columns staying in sync under churn, and the chaos criterion — 100%
+device decide failure mid-stream still delivers QoS1 through the PR 1
+circuit breaker.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from emqx_tpu import failpoints as fp
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.channel import Channel
+from emqx_tpu.broker.session import SubOpts
+from emqx_tpu.codec import mqtt as C
+from emqx_tpu.config import BrokerConfig
+from emqx_tpu.message import Message
+from emqx_tpu.ops import dispatchasm, match_kernel
+from emqx_tpu.router import Router
+
+_native = dispatchasm.load()
+
+
+def _broker(decide=None, columns=True):
+    cfg = BrokerConfig()
+    cfg.engine.use_device = False
+    b = Broker(config=cfg)
+    b._decide_columns = columns
+    if decide is not None:
+        b.router.engine.decide_force = decide
+    return b
+
+
+class WireChannel(Channel):
+    def __init__(self, broker, version=C.MQTT_V5):
+        self.writes = []
+
+        def send(pkts):
+            self.writes.append(
+                b"".join(C.serialize(p, self.version) for p in pkts)
+            )
+
+        super().__init__(broker, send=send, close=lambda r: None)
+        self.version = version
+
+
+# ------------------------------------------------ three-path parity
+
+def _build_world(seed):
+    rng = random.Random(seed)
+    clients = []
+    for i in range(12):
+        subs = []
+        for f in range(rng.randint(1, 3)):
+            flt = rng.choice(
+                ["t/#", "t/+/x", f"t/{f}/x", "s/only",
+                 "$share/g1/t/+/x"]
+            )
+            subs.append({
+                "flt": flt,
+                "qos": rng.randint(0, 2),
+                "rap": rng.random() < 0.4,
+                "no_local": rng.random() < 0.3,
+                "subid": rng.randint(1, 9)
+                if rng.random() < 0.2 else None,
+            })
+        clients.append({
+            "cid": f"c{i}",
+            "version": rng.choice([C.MQTT_V4, C.MQTT_V5]),
+            "upgrade": rng.random() < 0.3,
+            "max_inflight": rng.choice([2, 4, 32]),
+            "subs": subs,
+        })
+    windows = []
+    for _ in range(4):
+        win = []
+        for _ in range(rng.randint(1, 12)):
+            win.append({
+                "topic": rng.choice(
+                    ["t/1/x", "t/2/x", "t/0/x", "s/only", "t/deep/x"]
+                ),
+                "qos": rng.randint(0, 2),
+                "retain": rng.random() < 0.3,
+                "payload": bytes(
+                    rng.randrange(256)
+                    for _ in range(rng.randint(0, 200))
+                ),
+                "from": rng.choice(["c0", "c1", "pub"]),
+            })
+        windows.append(win)
+    return clients, windows
+
+
+def _run_world(clients, windows, mode):
+    b = _broker(
+        decide=mode if mode in ("host", "dev") else None,
+        columns=mode != "scalar",
+    )
+    # deterministic shared-group picks so all three runs pick the
+    # same member for every message
+    b.router.shared._rng.seed(1234)
+    chans = {}
+    for c in clients:
+        ch = WireChannel(b, version=c["version"])
+        session, _ = b.cm.open_session(
+            True, c["cid"], ch, max_inflight=c["max_inflight"]
+        )
+        session.upgrade_qos = c["upgrade"]
+        for s in c["subs"]:
+            opts = SubOpts(
+                qos=s["qos"], retain_as_published=s["rap"],
+                no_local=s["no_local"], subid=s["subid"],
+            )
+            session.subscribe(s["flt"], opts)
+            b.subscribe(c["cid"], s["flt"], opts)
+        chans[c["cid"]] = ch
+    counts = []
+    ts = 1.0e9
+    for win in windows:
+        msgs = [
+            Message(
+                topic=w["topic"], qos=w["qos"], retain=w["retain"],
+                payload=w["payload"], from_client=w["from"],
+                timestamp=ts,
+            )
+            for w in win
+        ]
+        counts.append(b.publish_many(msgs))
+    wires = {
+        cid: b"".join(bytes(x) for x in ch.writes)
+        for cid, ch in chans.items()
+    }
+    sent = {
+        k: b.metrics.val(k)
+        for k in ("messages.sent", "messages.qos0.sent",
+                  "messages.qos1.sent", "messages.qos2.sent",
+                  "packets.publish.sent", "messages.delivered")
+    }
+    inflights = {
+        c["cid"]: sorted(
+            (pid, e.qos)
+            for pid, e in b.cm.lookup(c["cid"]).inflight.items()
+        )
+        for c in clients
+    }
+    stats = b.router.engine.stats()
+    return counts, wires, sent, inflights, stats
+
+
+@pytest.mark.skipif(_native is None, reason="native dispatchasm unavailable")
+@pytest.mark.parametrize("seed", [1, 2, 7, 23, 41])
+def test_three_paths_bit_identical(seed):
+    clients, windows = _build_world(seed)
+    scalar = _run_world(clients, windows, "scalar")
+    host = _run_world(clients, windows, "host")
+    dev = _run_world(clients, windows, "dev")
+    for other, label in ((host, "host"), (dev, "dev")):
+        assert scalar[0] == other[0], (label, "counts")
+        for cid in scalar[1]:
+            assert scalar[1][cid] == other[1][cid], (label, cid)
+        assert scalar[2] == other[2], (label, "sent metrics")
+        assert scalar[3] == other[3], (label, "inflight")
+    # the pinned paths really ran where they claim
+    assert host[4]["decide_host_windows"] > 0
+    assert host[4]["decide_dev_windows"] == 0
+    assert dev[4]["decide_dev_windows"] > 0
+    # and the parity run exercised every decoded byte stream
+    for cid, wire in dev[1].items():
+        version = next(
+            c["version"] for c in clients if c["cid"] == cid
+        )
+        for pkt in C.StreamParser(version=version).feed(wire):
+            assert pkt.type == C.PUBLISH
+
+
+def test_decide_kernel_twins_bit_identical():
+    """decide_batch (device) vs decide_batch_host (numpy) over random
+    columns, including the padded-bucket path the engine uses."""
+    rng = np.random.default_rng(7)
+    for _ in range(20):
+        r, n, b = 64, int(rng.integers(1, 700)), int(rng.integers(1, 40))
+        cols = (
+            rng.integers(0, 3, r).astype(np.int8),
+            rng.random(r) < 0.3,
+            rng.random(r) < 0.4,
+            rng.random(r) < 0.2,
+        )
+        orows = rng.integers(0, r, n)
+        crows = rng.integers(0, 100, n)
+        midx = rng.integers(0, b, n)
+        mq = rng.integers(0, 3, b).astype(np.int8)
+        mr = rng.random(b) < 0.5
+        mf = rng.integers(-1, 100, b).astype(np.int32)
+        host = match_kernel.decide_batch_host(
+            *cols, orows, crows, midx, mq, mr, mf
+        )
+        from emqx_tpu.engine import MatchEngine
+
+        eng = MatchEngine(use_device=False)
+        dev = eng._decide_device(
+            cols, 0, orows, crows, midx, mq, mr, mf
+        )
+        assert np.array_equal(host, dev)
+
+
+# --------------------------------------------- router attribute table
+
+def test_router_opts_columns_track_churn():
+    """Random subscribe/refresh/unsubscribe churn (direct + shared):
+    the numpy attribute columns must mirror the opts table exactly."""
+    rng = random.Random(5)
+    r = Router()
+    live = {}
+    for step in range(400):
+        cid = f"c{rng.randrange(8)}"
+        flt = rng.choice(
+            ["a/#", "b/+", "c/d", "$share/g/a/#", "$share/h/b/+"]
+        )
+        if (cid, flt) in live and rng.random() < 0.4:
+            r.unsubscribe(cid, flt)
+            del live[(cid, flt)]
+        else:
+            opts = SubOpts(
+                qos=rng.randint(0, 2),
+                no_local=rng.random() < 0.5,
+                retain_as_published=rng.random() < 0.5,
+                subid=rng.randint(1, 5)
+                if rng.random() < 0.3 else None,
+            )
+            r.subscribe(cid, flt, opts)
+            live[(cid, flt)] = opts
+    qos, nl, rap, sid = r.opts_columns()
+    checked = 0
+    for slot, opts in enumerate(r._opts_table):
+        if opts is None:
+            continue
+        checked += 1
+        assert qos[slot] == opts.qos
+        assert nl[slot] == opts.no_local
+        assert rap[slot] == opts.retain_as_published
+        assert sid[slot] == (opts.subid is not None)
+    assert checked == len(
+        [o for o in r._opts_table if o is not None]
+    ) and checked > 0
+
+
+# --------------------------------------------------- lazy deliveries
+
+def _fanout_broker(n=8, qos=1, **kw):
+    b = _broker(**kw)
+    for i in range(n):
+        cid = f"f{i}"
+        ch = WireChannel(b)
+        s, _ = b.cm.open_session(True, cid, ch)
+        s.subscribe("t/#", SubOpts(qos=qos))
+        b.subscribe(cid, "t/#", SubOpts(qos=qos))
+    return b
+
+
+def test_no_consumer_materializes_zero_delivery_tuples(monkeypatch):
+    """No hook, no batch sink, no tracer: a whole fanout window must
+    allocate ZERO per-delivery (msg, opts) tuples."""
+    b = _fanout_broker(8)
+    calls = []
+    orig = Broker._materialize_run
+
+    def spy(msgs, router, sm_l, so_a, k, e):
+        calls.append((k, e))
+        return orig(msgs, router, sm_l, so_a, k, e)
+
+    monkeypatch.setattr(Broker, "_materialize_run", staticmethod(spy))
+    counts = b.publish_many(
+        [Message(topic=f"t/{i}", qos=1) for i in range(6)]
+    )
+    assert counts == [8] * 6
+    assert calls == []
+
+
+def test_delivered_hook_still_gets_per_run_lists():
+    """Satellite 1 must not change the hook contract: with a callback
+    registered, `message.delivered` fires once per (window, client)
+    with the full delivery list."""
+    b = _fanout_broker(3)
+    got = []
+    b.hooks.add(
+        "message.delivered",
+        lambda cid, ds: got.append((cid, len(ds), ds[0][0].topic)),
+    )
+    b.publish_many([Message(topic="t/a", qos=0)] * 2)
+    assert sorted(got) == [
+        ("f0", 2, "t/a"), ("f1", 2, "t/a"), ("f2", 2, "t/a")
+    ]
+
+
+def test_empty_hook_registry_skips_hook_walk(monkeypatch):
+    """Satellite 1: with nothing registered, the window never calls
+    hooks.run("message.delivered", ...) at all."""
+    b = _fanout_broker(4)
+    names = []
+    orig_run = b.hooks.run
+
+    def spy(name, *a):
+        names.append(name)
+        return orig_run(name, *a)
+
+    monkeypatch.setattr(b.hooks, "run", spy)
+    b.publish_many([Message(topic="t/x", qos=0)] * 3)
+    assert "message.delivered" not in names
+
+
+# ------------------------------------------- sampled-run tracer guard
+
+def _tracing_broker(rate, n=6, filters=()):
+    cfg = BrokerConfig()
+    cfg.engine.use_device = False
+    cfg.tracing.enable = True
+    cfg.tracing.sample_rate = rate
+    cfg.tracing.topic_filters = list(filters)
+    b = Broker(config=cfg)
+    for i in range(n):
+        cid = f"f{i}"
+        ch = WireChannel(b)
+        s, _ = b.cm.open_session(True, cid, ch)
+        s.subscribe("t/#", SubOpts(qos=1))
+        b.subscribe(cid, "t/#", SubOpts(qos=1))
+    return b
+
+
+def test_unsampled_window_materializes_nothing(monkeypatch):
+    """Lifecycle tracing ACTIVE but nothing sampled (rate 0): the
+    fanout window still allocates zero per-delivery tuples — the
+    OBS601 sampled-guard idiom applied to materialization."""
+    b = _tracing_broker(rate=0.0)
+    calls = []
+    orig = Broker._materialize_run
+    monkeypatch.setattr(
+        Broker, "_materialize_run",
+        staticmethod(lambda *a: calls.append(a) or orig(*a)),
+    )
+    assert b.lifecycle.active
+    counts = b.publish_many(
+        [Message(topic=f"t/{i}", qos=1) for i in range(6)]
+    )
+    assert counts == [6] * 6
+    assert calls == []
+
+
+def test_sampled_message_materializes_only_its_runs(monkeypatch):
+    """A pinned-topic sample mid-window materializes the delivery
+    lists ONLY for runs that carry the sampled message, and its
+    lifecycle span names the delivering clients."""
+    b = _tracing_broker(rate=0.0, n=0, filters=["hot/#"])
+    # two disjoint subscriber groups: only g* receive the sampled topic
+    for i in range(3):
+        cid = f"g{i}"
+        ch = WireChannel(b)
+        s, _ = b.cm.open_session(True, cid, ch)
+        s.subscribe("hot/#", SubOpts(qos=1))
+        b.subscribe(cid, "hot/#", SubOpts(qos=1))
+    for i in range(3):
+        cid = f"h{i}"
+        ch = WireChannel(b)
+        s, _ = b.cm.open_session(True, cid, ch)
+        s.subscribe("cold/#", SubOpts(qos=1))
+        b.subscribe(cid, "cold/#", SubOpts(qos=1))
+    runs = []
+    orig = Broker._materialize_run
+    monkeypatch.setattr(
+        Broker, "_materialize_run",
+        staticmethod(lambda *a: runs.append(a[-2:]) or orig(*a)),
+    )
+    counts = b.publish_many([
+        Message(topic="hot/x", qos=1),
+        Message(topic="cold/x", qos=1),
+    ])
+    assert counts == [3, 3]
+    # exactly the three hot-subscriber runs materialized (1 delivery
+    # each); the three cold runs allocated nothing
+    assert len(runs) == 3
+    assert all(e - k == 1 for k, e in runs)
+    (span,) = b.lifecycle.store.spans()
+    assert sorted(span["attrs"]["clients"]) == ["g0", "g1", "g2"]
+    assert span["attrs"]["clients_total"] == 3
+
+
+# --------------------------------------------------- chaos: breaker
+
+@pytest.fixture(autouse=True)
+def _clear_failpoints():
+    fp.clear()
+    yield
+    fp.clear()
+
+
+def test_device_decide_failure_midstream_still_delivers_qos1():
+    """Acceptance chaos criterion: 100% device decide failure
+    mid-stream — every QoS1 window still delivers (host columns), and
+    enough consecutive faults trip the shared PR 1 breaker, after
+    which the decide step stops even trying the device."""
+    b = _fanout_broker(4, decide="dev")
+    eng = b.router.engine
+    assert b.publish_many(
+        [Message(topic="t/ok", qos=1)] * 2
+    ) == [4, 4]
+    assert eng.stats()["decide_dev_windows"] >= 1
+    trips = []
+    eng.on_breaker_trip = lambda info: trips.append(info)
+    fp.configure("dispatch.decide.device", "error", prob=1.0)
+    for i in range(4):  # breaker_threshold is 3
+        assert b.publish_many(
+            [Message(topic=f"t/{i}", qos=1)] * 2
+        ) == [4, 4]
+    stats = eng.stats()
+    assert stats["decide_dev_errors"] >= 3
+    assert stats["breaker_open"] is True
+    assert trips and trips[0]["reason"] == "decide"
+    # breaker open: no further device attempts, still delivering
+    errs = stats["decide_dev_errors"]
+    assert b.publish_many([Message(topic="t/z", qos=1)]) == [4]
+    assert eng.stats()["decide_dev_errors"] == errs
+
+
+# ------------------------------------------------ columns plumbing
+
+def test_columns_path_engages_and_records_decide_stage():
+    b = _fanout_broker(4)
+    counts = b.publish_many(
+        [Message(topic=f"t/{i}", qos=1) for i in range(8)]
+    )
+    assert counts == [4] * 8
+    (win,) = b.profiler.windows(1)
+    assert "decide" in win["stages_us"]
+    if _native is not None:
+        assert "assemble" in win["stages_us"]
+    assert b.profiler.summary()["decide"]["count"] >= 1
+
+
+def test_scalar_env_kill_switch(monkeypatch):
+    monkeypatch.setenv("EMQX_TPU_NO_DECIDE", "1")
+    cfg = BrokerConfig()
+    cfg.engine.use_device = False
+    b = Broker(config=cfg)
+    assert b._decide_columns is False
+    ch = WireChannel(b)
+    s, _ = b.cm.open_session(True, "c1", ch)
+    s.subscribe("t/#", SubOpts(qos=1))
+    b.subscribe("c1", "t/#", SubOpts(qos=1))
+    assert b.publish(Message(topic="t/a", qos=1)) == 1
+    (win,) = b.profiler.windows(1)
+    assert "decide" not in win["stages_us"]
+
+
+def test_shared_sub_single_delivery_through_columns():
+    """One shared group member gets each message; group opts ride the
+    interned opts-table slots."""
+    b = _broker()
+    for cid in ("s1", "s2"):
+        ch = WireChannel(b)
+        sess, _ = b.cm.open_session(True, cid, ch)
+        opts = SubOpts(qos=1)
+        sess.subscribe("$share/g/t/#", opts)
+        b.subscribe(cid, "$share/g/t/#", opts)
+    counts = b.publish_many(
+        [Message(topic=f"t/{i}", qos=1) for i in range(10)]
+    )
+    assert counts == [1] * 10
+    total = sum(
+        len(b.cm.lookup(cid).inflight) for cid in ("s1", "s2")
+    )
+    assert total == 10
+
+
+def test_closing_channel_run_not_counted_as_sent():
+    """A channel that started closing mid-window drops its blob; the
+    window-level sent flush must not count it (parity with the scalar
+    path, which checks _closing before bumping)."""
+    b = _fanout_broker(2)
+    b.cm.channel("f0")._closing = True
+    before = b.metrics.val("messages.sent")
+    b.publish_many([Message(topic="t/a", qos=1)])
+    assert b.metrics.val("messages.sent") - before == 1
+    assert b.metrics.val("messages.qos1.sent") == 1
+
+
+def test_decide_auto_first_device_window_warms_not_records():
+    """Auto policy hygiene: the first device decide window pays the
+    JIT compile and must not seed the cost EWMA (which would pin the
+    policy to host forever); the second window records."""
+    from emqx_tpu.engine import MatchEngine
+
+    eng = MatchEngine(use_device=None)
+    rng = np.random.default_rng(3)
+    r, n, bsz = 64, 4096, 16
+    cols = (
+        rng.integers(0, 3, r).astype(np.int8),
+        rng.random(r) < 0.3, rng.random(r) < 0.3, rng.random(r) < 0.1,
+    )
+    args = (
+        rng.integers(0, r, n), rng.integers(0, 50, n),
+        rng.integers(0, bsz, n),
+        rng.integers(0, 3, bsz).astype(np.int8),
+        rng.random(bsz) < 0.5,
+        rng.integers(-1, 50, bsz).astype(np.int32),
+    )
+    _, path1 = eng.decide_window(cols, 1, *args)
+    assert path1 == "dev"  # unmeasured big window probes the device
+    assert eng._dec_dev_us is None  # compile window not recorded
+    _, path2 = eng.decide_window(cols, 1, *args)
+    assert path2 == "dev"
+    assert eng._dec_dev_us is not None
+
+
+def test_sampled_span_clients_exclude_no_local_drops():
+    """The span's delivering-clients list must not name a client whose
+    only delivery was no-local-dropped."""
+    b = _tracing_broker(rate=0.0, n=0, filters=["hot/#"])
+    for cid, nl in (("gx", True), ("gy", False)):
+        ch = WireChannel(b)
+        s, _ = b.cm.open_session(True, cid, ch)
+        opts = SubOpts(qos=1, no_local=nl)
+        s.subscribe("hot/#", opts)
+        b.subscribe(cid, "hot/#", opts)
+    # published BY gx: gx's no_local subscription drops it on gx only
+    assert b.publish(Message(topic="hot/x", qos=1, from_client="gx")) == 2
+    (span,) = b.lifecycle.store.spans()
+    assert span["attrs"]["clients"] == ["gy"]

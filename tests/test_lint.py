@@ -550,6 +550,118 @@ def test_perf401_declared_functions_exist_in_repo():
         assert (repo / d.path_suffix).exists(), d
 
 
+# ------------------------------------------------------------- PERF403
+
+def test_perf403_per_delivery_opts_read():
+    """With the window decision columns in place, a per-delivery
+    SubOpts attribute read inside a dispatch loop is a finding."""
+    bad = (
+        "class B:\n"
+        "    def fan_out(self, deliveries):\n"
+        "        for msg, opts in deliveries:\n"
+        "            if opts.no_local and msg.from_client == 'c':\n"
+        "                continue\n"
+        "            q = opts.qos\n"
+    )
+    rules = rules_of(bad, path="pkg/disp.py", dispatch=_DISPATCH)
+    assert rules.count("PERF403") == 2
+    # attr-chained opts bindings (self.last_opts) fire too
+    chained = (
+        "class B:\n"
+        "    def fan_out(self, deliveries):\n"
+        "        for msg in deliveries:\n"
+        "            s = self.last_opts.subid\n"
+    )
+    assert "PERF403" in rules_of(chained, path="pkg/disp.py",
+                                 dispatch=_DISPATCH)
+    # MESSAGE attribute reads are not findings (only opts bindings)
+    ok = (
+        "class B:\n"
+        "    def fan_out(self, deliveries):\n"
+        "        for msg in deliveries:\n"
+        "            q = msg.qos\n"
+    )
+    assert "PERF403" not in rules_of(ok, path="pkg/disp.py",
+                                     dispatch=_DISPATCH)
+    # the columns shape — per-run hoist + vectorized consumption: fine
+    ok2 = (
+        "class B:\n"
+        "    def fan_out(self, eff, opts, deliveries):\n"
+        "        oq = opts.qos\n"
+        "        for t, msg in enumerate(deliveries):\n"
+        "            q = eff[t] if eff is not None else oq\n"
+    )
+    assert "PERF403" not in rules_of(ok2, path="pkg/disp.py",
+                                     dispatch=_DISPATCH)
+    # a for statement's ITERABLE evaluates once per loop, not per
+    # iteration — no finding at function level...
+    ok3 = (
+        "class B:\n"
+        "    def fan_out(self, opts):\n"
+        "        for t in range(opts.qos):\n"
+        "            self.emit(t)\n"
+    )
+    assert "PERF403" not in rules_of(ok3, path="pkg/disp.py",
+                                     dispatch=_DISPATCH)
+    # ...but nested inside an outer loop it IS per-delivery, and a
+    # while test re-evaluates every iteration
+    bad2 = (
+        "class B:\n"
+        "    def fan_out(self, deliveries):\n"
+        "        for msg, opts in deliveries:\n"
+        "            for t in range(opts.qos):\n"
+        "                self.emit(t)\n"
+    )
+    assert "PERF403" in rules_of(bad2, path="pkg/disp.py",
+                                 dispatch=_DISPATCH)
+    bad3 = (
+        "class B:\n"
+        "    def fan_out(self, opts):\n"
+        "        while opts.qos:\n"
+        "            self.step()\n"
+    )
+    assert "PERF403" in rules_of(bad3, path="pkg/disp.py",
+                                 dispatch=_DISPATCH)
+    # a for-else suite executes once per LOOP, not per iteration
+    ok4 = (
+        "class B:\n"
+        "    def fan_out(self, opts, deliveries):\n"
+        "        for msg in deliveries:\n"
+        "            self.emit(msg)\n"
+        "        else:\n"
+        "            last = opts.qos\n"
+    )
+    assert "PERF403" not in rules_of(ok4, path="pkg/disp.py",
+                                     dispatch=_DISPATCH)
+    # an unrelated module is not checked
+    assert "PERF403" not in rules_of(bad, path="pkg/other.py",
+                                     dispatch=_DISPATCH)
+
+
+def test_perf403_suppression_comment():
+    sup = (
+        "class B:\n"
+        "    def fan_out(self, deliveries):\n"
+        "        for msg, opts in deliveries:\n"
+        "            q = opts.qos  # brokerlint: ignore[PERF403]\n"
+    )
+    assert "PERF403" not in rules_of(sup, path="pkg/disp.py",
+                                     dispatch=_DISPATCH)
+    # suppressing PERF403 does not silence a PERF402 on the same line
+    both = (
+        "import time\n"
+        "class B:\n"
+        "    def fan_out(self, deliveries):\n"
+        "        for msg, opts in deliveries:\n"
+        "            q = (opts.qos, time.time())"
+        "  # brokerlint: ignore[PERF403]\n"
+    )
+    assert "PERF402" in rules_of(both, path="pkg/disp.py",
+                                 dispatch=_DISPATCH)
+    assert "PERF403" not in rules_of(both, path="pkg/disp.py",
+                                     dispatch=_DISPATCH)
+
+
 # ------------------------------------------------------------- OBS601
 
 def test_obs601_unguarded_tracer_in_dispatch_loop():

@@ -33,6 +33,8 @@ class Seam(NamedTuple):
 SEAM_FUNCS: Tuple[Seam, ...] = (
     Seam("emqx_tpu/engine.py", "MatchEngine._flat_dispatch",
          "engine.device_step"),
+    Seam("emqx_tpu/engine.py", "MatchEngine._decide_device",
+         "dispatch.decide.device"),
     Seam("emqx_tpu/cluster/transport.py", "NodeTransport.cast",
          "cluster.transport.send"),
     Seam("emqx_tpu/cluster/transport.py", "NodeTransport.cast_bin",

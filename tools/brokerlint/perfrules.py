@@ -1,4 +1,4 @@
-"""Dispatch-perf rules (PERF401, PERF402).
+"""Dispatch-perf rules (PERF401, PERF402, PERF403).
 
 PR 3 made fan-out single-encode: each unique PUBLISH body is
 serialized once per dispatch window and only the packet id is patched
@@ -17,10 +17,22 @@ clock read per run (`Session.deliver`'s hoisted ``now``,
 `deliver_run_native`'s bulk `Inflight.insert_run`); a per-iteration
 clock sneaking back in is a finding.
 
-An intentional in-loop call takes a justified inline
-``# brokerlint: ignore[PERF401]`` / ``ignore[PERF402]``.  A declared
-function that no longer exists is itself a finding, so the
-declaration list cannot silently rot.
+PERF403 guards what PR 9's decision columns amortized: a SubOpts
+field read (``opts.qos``, ``opts.no_local``, ``opts.
+retain_as_published``, ``opts.subid``, ...) inside a dispatch-marked
+loop.  The window computes every per-delivery decision as ONE
+vectorized pass over the router's attribute columns
+(`Router.opts_columns` + `ops.match_kernel.decide_batch[_host]`); a
+per-delivery Python attribute read sneaking back into the hot loops
+re-pays the cost the columns removed.  The scalar referee paths
+(`Session.deliver`, `deliver_run_native`, the detached-queue branch)
+keep their reads under justified inline ignores — they ARE the
+reference semantics the columns are property-tested against.
+
+An intentional in-loop site takes a justified inline
+``# brokerlint: ignore[PERF401]`` / ``[PERF402]`` / ``[PERF403]``.
+A declared function that no longer exists is itself a finding, so
+the declaration list cannot silently rot.
 """
 
 from __future__ import annotations
@@ -28,7 +40,7 @@ from __future__ import annotations
 import ast
 from typing import List, NamedTuple, Sequence
 
-from .engine import ModuleContext, call_tail
+from .engine import ModuleContext, call_tail, dotted_name
 
 
 class DispatchFn(NamedTuple):
@@ -37,10 +49,12 @@ class DispatchFn(NamedTuple):
 
 
 # the window fan-out hot loops: expansion/grouping, per-client
-# delivery, the session's packet builder, and the native-run fast
-# path (decision scan + block bookkeeping)
+# delivery (columns + scalar), the session's packet builder, and the
+# native-run fast path (decision scan + block bookkeeping)
 DISPATCH_FUNCS = (
     DispatchFn("emqx_tpu/broker/broker.py", "Broker._dispatch_window"),
+    DispatchFn("emqx_tpu/broker/broker.py", "Broker._dispatch_columns"),
+    DispatchFn("emqx_tpu/broker/broker.py", "Broker._dispatch_scalar"),
     DispatchFn("emqx_tpu/broker/broker.py", "Broker._deliver_run"),
     DispatchFn("emqx_tpu/broker/session.py", "Session.deliver"),
     DispatchFn("emqx_tpu/broker/session.py", "Session.deliver_run_native"),
@@ -56,6 +70,15 @@ _ENCODE_TAILS = {"serialize", "encode", "encode_publish"}
 _CLOCK_TAILS = {
     "time", "time_ns", "monotonic", "monotonic_ns",
     "perf_counter", "perf_counter_ns", "now", "utcnow", "today",
+}
+
+# SubOpts fields the window decision columns replace: reading one of
+# these per delivery inside a dispatch loop is PERF403.  The receiver
+# must LOOK like a SubOpts binding (its dotted tail contains "opts"),
+# so `msg.qos` and `packet.qos` stay clean.
+_SUBOPT_FIELDS = {
+    "qos", "no_local", "retain_as_published", "retain_handling",
+    "subid", "share_group",
 }
 
 
@@ -102,6 +125,50 @@ def _loop_calls(fn: ast.AST, tails) -> List[ast.Call]:
     return hits
 
 
+def _loop_opts_reads(fn: ast.AST) -> List[ast.Attribute]:
+    """SubOpts field reads (`opts.qos`-shaped Attribute nodes whose
+    receiver's dotted tail names an opts binding) executed PER
+    ITERATION of a for/while loop in `fn`.  A ``for`` statement's
+    target/iterable evaluate once per loop, so they inherit the
+    enclosing context; a ``while`` test runs every iteration, so it
+    counts as loop body.  Nested def/lambda subtrees pruned as in
+    `_loop_calls`."""
+    hits: List[ast.Attribute] = []
+
+    def visit(node: ast.AST, in_loop: bool) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not fn:
+            return
+        if (
+            in_loop
+            and isinstance(node, ast.Attribute)
+            and node.attr in _SUBOPT_FIELDS
+        ):
+            base = dotted_name(node.value)
+            if base and "opts" in base.split(".")[-1]:
+                hits.append(node)
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            visit(node.target, in_loop)
+            visit(node.iter, in_loop)
+            for sub in node.body:
+                visit(sub, True)
+            for sub in node.orelse:  # else-suite: once per loop
+                visit(sub, in_loop)
+            return
+        if isinstance(node, ast.While):
+            visit(node.test, True)  # re-evaluated every iteration
+            for sub in node.body:
+                visit(sub, True)
+            for sub in node.orelse:
+                visit(sub, in_loop)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, in_loop)
+
+    visit(fn, False)
+    return hits
+
+
 def check(ctx: ModuleContext,
           dispatch: Sequence[DispatchFn] = DISPATCH_FUNCS) -> None:
     relevant = [d for d in dispatch if ctx.path.endswith(d.path_suffix)]
@@ -134,6 +201,16 @@ def check(ctx: ModuleContext,
                 f"the dispatch hot loop `{d.qualname}` — read the "
                 f"clock once per run (hoist it above the loop)",
                 detail=call_tail(call),
+            )
+        for attr in _loop_opts_reads(fn):
+            ctx.report(
+                attr, "PERF403", d.qualname,
+                f"per-delivery SubOpts read `.{attr.attr}` inside the "
+                f"dispatch hot loop `{d.qualname}` — consume the "
+                f"window decision columns (Router.opts_columns + "
+                f"decide_batch) instead of per-delivery attribute "
+                f"reads",
+                detail=attr.attr,
             )
 
 
