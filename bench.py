@@ -302,6 +302,212 @@ def run_dispatch_fanout_bench(log):
     return out
 
 
+def run_replay_bench(log, n_sessions=256, n_backlog=64,
+                     storm_sessions=2000):
+    """Durable-replay bench (the mass-reconnect scenario): N
+    checkpointed sessions, each owed an M-message QoS1 backlog from
+    shared streams, reconnect and drain through the resume scheduler.
+
+    ``replay_sessions_per_s``: scalar (per-session mqueue bake +
+    per-packet encode) vs windowed (batched multi-session DS reads +
+    dispatch windows through decide columns / encode-once / native
+    splice) on identical worlds — run interleaved by the caller for
+    A/B medians.  Encode+write counted exactly like the fanout bench
+    (every packet serialized into a per-connection sink).
+
+    ``reconnect_storm``: a larger storm with live publishes
+    interleaved between scheduler rounds — drain wall time, live
+    delivery p50/p99 while draining, and the max parked depth."""
+    import shutil
+    import tempfile
+
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.broker.channel import Channel
+    from emqx_tpu.broker.session import SubOpts
+    from emqx_tpu.codec import mqtt as C
+    from emqx_tpu.config import BrokerConfig
+    from emqx_tpu.ds.persist import DurableSessions
+    from emqx_tpu.message import Message
+
+    def seed(data_dir, n_sess, n_msgs):
+        ds = DurableSessions(str(data_dir))
+        t0 = time.time() - 60.0
+        for i in range(n_sess):
+            ds.save(f"r{i}", {"r/#": {"qos": 1}}, 7200.0, now=t0)
+        ds.add_filter("r/#")
+        # shared streams: every session replays the SAME backlog (the
+        # broadcast-outage shape where windowed reads coalesce)
+        ds.persist([
+            Message(topic=f"r/{k % 8}/x", qos=1, payload=b"x" * 64,
+                    timestamp=time.time())
+            for k in range(n_msgs)
+        ])
+        ds.sync()
+        ds.close()
+
+    def drain(data_dir, n_sess, mode):
+        """``scalar`` = the pre-scheduler shape (per-session
+        `replay_chunk` reads, no sharing, mqueue bake + per-packet
+        encode — what the resume loop did before this subsystem);
+        ``sched_scalar`` = the scheduler pacing the SAME mqueue path
+        with batched reads; ``windowed`` = batched reads + dispatch
+        windows through decide columns / encode-once / native
+        splice."""
+        cfg = BrokerConfig()
+        cfg.engine.use_device = False
+        cfg.durable.enable = True
+        cfg.durable.data_dir = str(data_dir)
+        cfg.durable.resume.windowed = mode == "windowed"
+        cfg.durable.resume.max_concurrent = 64
+        cfg.durable.resume.park_queue_cap = n_sess
+        b = Broker(config=cfg)
+        scheduled = mode != "scalar"
+        if scheduled:
+            b.resume.running = True
+        sink = [0, 0]
+
+        def send(pkts):
+            data = b"".join(C.serialize(p, C.MQTT_V5) for p in pkts)
+            sink[0] += len(data)
+            sink[1] += 1
+
+        cids = [f"r{i}" for i in range(n_sess)]
+        t0 = time.perf_counter()
+        for cid in cids:
+            ch = Channel(b, send=send, close=lambda r: None)
+            ch.version = C.MQTT_V5
+            session, present = b.open_session(
+                False, cid, ch, expiry_interval=7200.0, max_inflight=0
+            )
+            assert present
+            if not scheduled:
+                # the legacy flow: replay filled the mqueue inside
+                # open_session; CONNACK is followed by resume()
+                ch.send_packets(session.resume())
+        rounds = 0
+        if scheduled:
+            while any(b.resume.pending(c) for c in cids):
+                b.resume.drain_once()
+                rounds += 1
+        dt = time.perf_counter() - t0
+        sent = b.metrics.all().get("messages.sent", 0)
+        stages = {}
+        for name, snap in b.profiler.snapshots().items():
+            if snap.count and name in (
+                "replay_read", "expand", "decide", "deliver",
+                "assemble", "flush",
+            ):
+                stages[name] = {
+                    "count": snap.count,
+                    "p50_us": round(snap.percentile(50), 1),
+                    "p99_us": round(snap.percentile(99), 1),
+                }
+        b.durable.close()
+        return n_sess / dt, sent, dt, rounds, stages, sink
+
+    out = {}
+    for tag in ("scalar", "sched_scalar", "windowed"):
+        d = tempfile.mkdtemp(prefix=f"replay_{tag}_")
+        try:
+            seed(d, n_sessions, n_backlog)
+            rate, sent, dt, rounds, stages, sink = drain(
+                d, n_sessions, tag
+            )
+            assert sent >= n_sessions * n_backlog, (sent, tag)
+            out[f"replay_sessions_per_s_{tag}"] = rate
+            out[f"replay_{tag}_stages"] = stages
+            log(
+                f"replay {tag}: {rate:,.1f} sessions/s "
+                f"({n_sessions} sessions x {n_backlog} qos1 msgs in "
+                f"{dt:.2f}s, {rounds} rounds, {sent:,} deliveries, "
+                f"{sink[0] / (1 << 20):.1f} MiB wire)"
+            )
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+    if out.get("replay_sessions_per_s_scalar"):
+        out["replay_windowed_vs_scalar"] = (
+            out["replay_sessions_per_s_windowed"]
+            / out["replay_sessions_per_s_scalar"]
+        )
+
+    # reconnect storm: drain a big park queue while live publishes
+    # measure event-loop availability between scheduler rounds
+    d = tempfile.mkdtemp(prefix="replay_storm_")
+    try:
+        seed(d, storm_sessions, 8)
+        cfg = BrokerConfig()
+        cfg.engine.use_device = False
+        cfg.durable.enable = True
+        cfg.durable.data_dir = d
+        cfg.durable.resume.max_concurrent = 64
+        cfg.durable.resume.park_queue_cap = storm_sessions
+        b = Broker(config=cfg)
+        b.resume.running = True
+        sink = [0]
+
+        def send2(pkts):
+            sink[0] += sum(
+                len(C.serialize(p, C.MQTT_V5)) for p in pkts
+            )
+
+        cids = [f"r{i}" for i in range(storm_sessions)]
+        for cid in cids:
+            ch = Channel(b, send=send2, close=lambda r: None)
+            ch.version = C.MQTT_V5
+            b.open_session(False, cid, ch, expiry_interval=7200.0,
+                           max_inflight=0)
+        parked_max = b.resume.info()["parked"]
+        live_ch = Channel(b, send=send2, close=lambda r: None)
+        live_ch.version = C.MQTT_V5
+        ls, _ = b.cm.open_session(True, "live", live_ch)
+        ls.subscribe("live/x", SubOpts(qos=0))
+        b.subscribe("live", "live/x", SubOpts(qos=0))
+        live_lat = []
+        pending = set(cids)
+        t0 = time.perf_counter()
+        rounds = 0
+        while pending:
+            b.resume.drain_once()
+            rounds += 1
+            if rounds % 5 == 0:
+                t1 = time.perf_counter()
+                b.publish_many([Message(
+                    topic="live/x", qos=0, payload=b"hb",
+                    timestamp=time.time(),
+                )])
+                live_lat.append(time.perf_counter() - t1)
+            if rounds % 50 == 0 or len(pending) < 128:
+                pending = {c for c in pending
+                           if b.resume.pending(c)}
+        storm_dt = time.perf_counter() - t0
+        live_lat.sort()
+        out["reconnect_storm"] = {
+            "sessions": storm_sessions,
+            "backlog_per_session": 8,
+            "drain_s": storm_dt,
+            "sessions_per_s": storm_sessions / storm_dt,
+            "parked_max": parked_max,
+            "live_publish_p50_ms": (
+                live_lat[len(live_lat) // 2] * 1e3 if live_lat else 0
+            ),
+            "live_publish_p99_ms": (
+                live_lat[int(len(live_lat) * 0.99)] * 1e3
+                if live_lat else 0
+            ),
+        }
+        log(
+            f"reconnect storm: {storm_sessions} sessions drained in "
+            f"{storm_dt:.2f}s "
+            f"({storm_sessions / storm_dt:,.0f} sessions/s), "
+            f"parked_max={parked_max}, live publish p99 "
+            f"{out['reconnect_storm']['live_publish_p99_ms']:.1f} ms"
+        )
+        b.durable.close()
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    return out
+
+
 def run_broker_bench(log, mode="auto"):
     """End-to-end socket benchmark (BASELINE config 1 shape, the
     emqtt_bench workload): N publishers / M wildcard subscribers over
@@ -994,6 +1200,12 @@ def main():
         # PR 3 tentpole): fixed fan-out sweep, encode+write counted
         fanout_stats = run_dispatch_fanout_bench(log)
 
+    replay_stats = {}
+    if os.environ.get("BENCH_REPLAY", "1") != "0":
+        # mass-reconnect durable replay (BENCH_r08 tracks the resume
+        # scheduler): scalar vs windowed sessions/s + storm drain
+        replay_stats = run_replay_bench(log)
+
     broker_stats = {}
     if os.environ.get("BENCH_BROKER", "1") != "0":
         # three rows at >=1M background subs: host-pinned (the
@@ -1045,6 +1257,7 @@ def main():
         "cache) + device match + async compact-code transfer + "
         "vectorized host CSR expand to per-topic fid lists",
         "dispatch_fanout_msgs_per_s": fanout_stats,
+        "replay": replay_stats,
         **sharded_stats,
         **broker_stats,
     }

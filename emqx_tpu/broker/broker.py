@@ -263,6 +263,11 @@ class Broker:
         # durable storage + persistent sessions (emqx_persistent_message
         # gate + emqx_persistent_session_ds restore-on-reconnect)
         self.durable = None
+        # mass-reconnect admission control + windowed replay (resume.py):
+        # constructed with durable storage, DRIVEN by BrokerServer (its
+        # async task flips `running`; loop-less unit tests keep the
+        # synchronous scalar resume inside open_session)
+        self.resume = None
         if self.config.durable.enable:
             from ..ds.persist import DurableSessions
 
@@ -292,6 +297,16 @@ class Broker:
                     self.router.subscribe(
                         state.clientid, flt, SubOpts.from_dict(opts_dict)
                     )
+            from .resume import ResumeScheduler
+
+            self.resume = ResumeScheduler(
+                self, self.config.durable.resume
+            )
+            # every channel-detach path (MQTT teardown, gateway
+            # adapters) releases a mid-replay session's slot at once;
+            # the job — and its boot checkpoint — survive for the
+            # reconnect (or, after a crash, the on-disk re-replay)
+            self.cm.on_detached = self.resume.pause
         # clientid -> (fire_at, will message): MQTT 5 delayed wills
         self._pending_wills: Dict[str, Tuple[float, Message]] = {}
         self._last_ds_sync = time.time()
@@ -338,6 +353,10 @@ class Broker:
 
     def _session_discarded(self, session: Session) -> None:
         self.metrics.inc("session.discarded")
+        if self.resume is not None:
+            # a discarded session is owed nothing: drop any in-flight
+            # replay job (its checkpoint teardown follows right below)
+            self.resume.cancel(session.clientid)
         if self.durable is not None:
             # the persistence gate must not outlive the session, or the
             # DS log grows forever for a subscriber that can never return
@@ -370,6 +389,16 @@ class Broker:
         lowered session_expiry_interval to 0): drop router state AND the
         gate refs, or the gate persists messages for a session that can
         never return (emqx_channel session-expiry handling)."""
+        if self.resume is not None:
+            # the client explicitly abandoned the session: nothing is
+            # owed — drop any in-flight replay job AND the boot
+            # checkpoint it was draining (a later reconnect must not
+            # resurrect state the protocol says is gone).  discard,
+            # not drop_checkpoint: the boot state's gate refs were
+            # transferred to the live session at restore and are
+            # released exactly once by _release_gate below.
+            self.resume.cancel(clientid)
+            self.durable.discard(clientid)
         self._release_gate(session)
         self.router.cleanup_client(clientid)
         self.exclusive.release_all(clientid)
@@ -443,7 +472,31 @@ class Broker:
         restarted and the in-memory session is gone, a clean_start=false
         reconnect rebuilds the session from its DS checkpoint and
         replays messages persisted since disconnect
-        (emqx_persistent_session_ds resume)."""
+        (emqx_persistent_session_ds resume).
+
+        Under a running server the replay itself is handed to the
+        resume scheduler (CONNACK-then-drain: the session returns
+        immediately, its backlog streams in as dispatch windows under
+        admission control); with no scheduler running (unit tests
+        driving the broker synchronously) the legacy in-line scalar
+        replay fills the mqueue before returning.  Raises `ResumeBusy`
+        — BEFORE creating any session state — when admission is
+        saturated, so the channel answers CONNACK server-busy and the
+        client backs off."""
+        resume = self.resume
+        if (
+            resume is not None
+            and resume.running
+            and not clean_start
+            and self.cm.lookup(clientid) is None
+            and self.durable.has_checkpoint(clientid)
+            and not resume.pending(clientid)
+            and resume.saturated()
+        ):
+            from .resume import ResumeBusy
+
+            self.metrics.inc("session.resume.busy")
+            raise ResumeBusy(clientid)
         session, present = self.cm.open_session(
             clean_start, clientid, channel, **session_kwargs
         )
@@ -451,12 +504,26 @@ class Broker:
             self.external.client_opened(clientid)
         if present or clean_start or self.durable is None:
             if self.durable is not None and (clean_start or present):
-                # a live resume or clean start invalidates any on-disk
-                # checkpoint — else a later restart would double-replay
-                # messages already delivered live.  drop_checkpoint also
-                # releases the gate refs _load_states took for the boot
-                # state, which no live session carries.
-                self.durable.drop_checkpoint(clientid)
+                if (
+                    present
+                    and not clean_start
+                    and resume is not None
+                    and resume.pending(clientid)
+                ):
+                    # reconnect of a session still mid-replay: the new
+                    # channel takes over and the scheduler continues
+                    # where the cursors left off.  The boot checkpoint
+                    # STAYS until commit — its on-disk cursors are the
+                    # crash-recovery story for the un-replayed tail.
+                    resume.reattach(clientid)
+                else:
+                    # a live resume or clean start invalidates any
+                    # on-disk checkpoint — else a later restart would
+                    # double-replay messages already delivered live.
+                    # drop_checkpoint also releases the gate refs
+                    # _load_states took for the boot state, which no
+                    # live session carries.
+                    self.durable.drop_checkpoint(clientid)
             if (
                 present
                 and not clean_start
@@ -471,8 +538,8 @@ class Broker:
         state = self.durable.load(clientid)
         if state is None:
             return session, False
-        # rebuild subscriptions, then replay the missed interval into
-        # the fresh session's mqueue (flushed after CONNACK by resume())
+        # rebuild subscriptions, then replay the missed interval —
+        # scheduled (windows after CONNACK) or in-line (scalar referee)
         for flt, opts_dict in state.subs.items():
             opts = SubOpts.from_dict(opts_dict)
             session.subscribe(flt, opts)
@@ -481,34 +548,80 @@ class Broker:
             # filters included) transfer to the live session, to be
             # released exactly once on its eventual discard/termination
             session.gate_filters.add(flt)
-        replayed = 0
+        if resume is not None and resume.running:
+            # CONNACK-then-drain: the backlog arrives as replay windows
+            # under admission control; commit (checkpoint discard +
+            # session.resumed) fires when the last window is handed off
+            resume.admit(clientid, state, session)
+            return session, True
+        complete = self._resume_scalar(session, state)
+        if complete:
+            # live again; saved on next disconnect.  An INCOMPLETE
+            # replay (a chaos-dropped read with no scheduler to retry)
+            # keeps the checkpoint — a restart re-replays the interval
+            # instead of skipping the blocked tail — and does NOT
+            # count as resumed: the backlog was never fully handed off.
+            self.durable.discard(clientid)
+            self.metrics.inc("session.resumed")
+            self.hooks.run("session.resumed", clientid)
+        return session, True
+
+    def _resume_scalar(self, session: Session, state) -> bool:
+        """The scalar per-session resume loop — chunked `replay_chunk`
+        reads baked into the session's mqueue, drained into the send
+        window after CONNACK by `session.resume()`.  The referee the
+        windowed resume path is property-tested bit-identical against
+        (per-connection wire bytes, per-qos sent metrics, inflight
+        windows), and the synchronous fallback when no scheduler task
+        is running.  Returns True when the whole interval was read
+        (False = a blocked read stopped progress; the checkpoint must
+        survive)."""
         while True:
             msgs, done = self.durable.replay_chunk(state)
-            for flt, msg in msgs:
-                opts = session.subscriptions.get(flt)
-                if opts is None:
-                    continue
-                if not self._delivery_allowed(clientid, msg):
-                    continue
-                qos = session._effective_qos(msg.qos, opts)
-                if qos == 0 and not self.config.mqtt.mqueue_store_qos0:
-                    continue
-                session.mqueue.insert(
-                    session._queued(msg, opts, max(qos, 0))
-                )
-                replayed += 1
+            self._resume_enqueue(session, msgs)
             if done:
-                break
+                return True
+            if not msgs:
+                # no progress and not done: a blocked (chaos-dropped)
+                # read — bail instead of spinning the event loop
+                return False
             # NOTE: the iterator cursors are NOT checkpointed here.
             # Chunk messages live only in the in-memory mqueue until
             # the client drains them — persisting advanced cursors now
             # would skip those messages if we crash before delivery.
             # Chunking bounds replay memory; save_state is for callers
             # that durably hand off each chunk before advancing.
-        self.durable.discard(clientid)  # live again; saved on disconnect
-        self.metrics.inc("session.resumed")
-        self.hooks.run("session.resumed", clientid)
-        return session, True
+
+    def _resume_enqueue(self, session: Session, msgs) -> int:
+        """Bake one replay chunk into a session's mqueue (the scalar
+        resume path's delivery half; the scheduler's scalar mode calls
+        it per chunk).  Applies the replay admission filters: the
+        subscription must still exist, delivery guards for $-topics,
+        no-local ([MQTT-3.8.3-3] — live-delivery parity: a client's
+        own publishes never replay to a no_local subscription), and
+        the mqueue's QoS0 store gate."""
+        clientid = session.clientid
+        store_q0 = self.config.mqtt.mqueue_store_qos0
+        replayed = 0
+        # PERF403 ignores: this loop is the scalar REFEREE — its
+        # per-delivery reads define the semantics the windowed replay
+        # columns are property-tested bit-identical against
+        for flt, msg in msgs:
+            opts = session.subscriptions.get(flt)
+            if opts is None:
+                continue
+            if not self._delivery_allowed(clientid, msg):
+                continue
+            if opts.no_local and msg.from_client == clientid:  # brokerlint: ignore[PERF403]
+                continue
+            qos = session._effective_qos(msg.qos, opts)
+            if qos == 0 and not store_q0:
+                continue
+            session.mqueue.insert(
+                session._queued(msg, opts, max(qos, 0))
+            )
+            replayed += 1
+        return replayed
 
     # ------------------------------------------- cross-node takeover
 
@@ -557,6 +670,14 @@ class Broker:
             "awaiting_rel": list(session.awaiting_rel.keys()),
         }
         self._release_gate(session)
+        if self.resume is not None:
+            # the session leaves this node: drop any pending replay
+            # job with it.  A takeover racing a mid-replay drain
+            # exports only inflight+mqueue (the DS tail travels as far
+            # as it was drained) — the pre-scheduler code had no such
+            # window because replay completed inside CONNECT, but it
+            # also stalled the broker for the whole backlog to get it.
+            self.resume.cancel(clientid)
         if self.durable is not None:
             self.durable.discard(clientid)
         self.router.cleanup_client(clientid)
@@ -621,9 +742,25 @@ class Broker:
             and session.subscriptions
         ):
             if self.durable is not None:
-                self.durable.save(
-                    clientid, session.subscriptions, session.expiry_interval
-                )
+                if self.resume is not None and self.resume.pending(
+                    clientid
+                ):
+                    # disconnected MID-REPLAY: do NOT overwrite the
+                    # boot checkpoint — a fresh disconnected_at=now
+                    # checkpoint would skip the un-replayed tail after
+                    # a restart (QoS1 loss).  The original checkpoint
+                    # still covers the whole interval; the paused job
+                    # continues on reconnect, or a restart re-replays
+                    # from disk (at-least-once).  Subscription changes
+                    # the live window made DO need to reach disk, with
+                    # the original disconnected_at/cursors preserved.
+                    self.resume.pause(clientid)
+                    self.resume.refresh_checkpoint(clientid, session)
+                else:
+                    self.durable.save(
+                        clientid, session.subscriptions,
+                        session.expiry_interval,
+                    )
             if self.external is not None:
                 # buddy replication (simplified emqx_ds_builtin_raft):
                 # the checkpoint + everything pending survives this
@@ -972,10 +1109,12 @@ class Broker:
     def _dispatch_window(
         self,
         msgs: Sequence[Message],
-        matched: Sequence[Set[str]],
+        matched: Optional[Sequence[Set[str]]],
         run_rules: bool = True,
         rule_sink: Optional[List] = None,
         rec=None,
+        preexpanded: Optional[Tuple] = None,
+        replay: bool = False,
     ) -> List[int]:
         """Fan a whole routed window out to subscriber sessions
         (emqx_broker:dispatch + do_dispatch, :408-420, :639-673),
@@ -996,13 +1135,31 @@ class Broker:
         predicate pass over the window (or run per message without
         one).  Delivery-guard, shared-pick skip-dead, no-local and
         RAP semantics are bit-identical to the per-message walk (the
-        CSR property/regression suites are the referee)."""
+        CSR property/regression suites are the referee).
+
+        ``preexpanded`` (the durable-replay window path) supplies the
+        ``(msg_idx, client_rows, opts_rows)`` delivery columns
+        directly — already client-contiguous, each client's entries in
+        its own replay order — bypassing route expansion AND the
+        per-client lexsort: replay targets are explicit (the resuming
+        client, not every subscriber of the filter) and their
+        per-client order is the replay-cursor order the scalar referee
+        produces.  ``replay`` suppresses the live-traffic accounting
+        that has no meaning for catch-up backlogs (no-subscriber
+        drops, e2e latency samples, slow-subs scans), while decision
+        columns, encode-once slots, the native window splice, and
+        lifecycle spans run exactly as for live fan-out."""
         router = self.router
         n = len(msgs)
         counts = [0] * n
-        msg_idx, rows, opts_rows, rules, shared = router.expand_window(
-            matched
-        )
+        if preexpanded is None:
+            msg_idx, rows, opts_rows, rules, shared = (
+                router.expand_window(matched)
+            )
+        else:
+            msg_idx, rows, opts_rows = preexpanded
+            rules = []
+            shared = []
         if rec is not None:
             rec.lap("expand")
         if rules and run_rules:
@@ -1038,8 +1195,10 @@ class Broker:
         deliver_hook = self.hooks.has("message.delivered")
         asm = [0.0] if rec is not None else None  # native assemble time
         # oldest publish timestamp in the window: the per-run slow-subs
-        # scan only runs when this could possibly cross the threshold
-        ts_min = min(
+        # scan only runs when this could possibly cross the threshold.
+        # Replay windows carry hours-old timestamps by construction —
+        # a catch-up backlog is not a slow subscriber.
+        ts_min = 0.0 if replay else min(
             (m.timestamp for m in msgs if m.timestamp), default=0.0
         )
         if n_direct or s_rows:
@@ -1056,14 +1215,23 @@ class Broker:
             else:
                 all_rows, all_msg = rows, msg_idx
                 all_opts_rows = opts_rows
-            # stable sort: per-client deliveries keep publish order,
-            # and direct entries stay ahead of shared for equal keys
-            order = np.lexsort((all_msg, all_rows))
-            sra = all_rows[order]
-            sm_a = all_msg[order]
-            so_a = all_opts_rows[order]
+            if preexpanded is None:
+                # stable sort: per-client deliveries keep publish
+                # order, and direct entries stay ahead of shared for
+                # equal keys
+                order = np.lexsort((all_msg, all_rows))
+                sra = all_rows[order]
+                sm_a = all_msg[order]
+                so_a = all_opts_rows[order]
+            else:
+                # replay columns arrive client-contiguous with each
+                # client's entries in REPLAY order (not msg_idx order
+                # — two resuming clients may legitimately see shared
+                # messages in different per-filter orders); the run
+                # machinery only needs contiguity
+                sra, sm_a, so_a = all_rows, all_msg, all_opts_rows
             dollar = None
-            if self.delivery_guards:
+            if self.delivery_guards and not replay:
                 # guards are only ever consulted for $-topics, so a
                 # guarded broker with none in the window still takes
                 # the vectorized path
@@ -1115,9 +1283,11 @@ class Broker:
             rec.lap("flush")
             rec.n_deliveries = delivered
             rec.n_clients = n_clients
-            if delivered:
+            if delivered and not replay:
                 # end-to-end publish→delivery latency per delivered
-                # message (Message.timestamp is stamped at ingress)
+                # message (Message.timestamp is stamped at ingress —
+                # replay windows would only pollute the histogram with
+                # outage-length "latencies")
                 now_e2e = time.time()
                 e2e = rec.e2e_ms
                 for i, msg in enumerate(msgs):
@@ -1135,16 +1305,22 @@ class Broker:
                 msgs, counts, rec, n_clients, clients=traced_clients
             )
         tracer = self.tracer
-        for i, msg in enumerate(msgs):
-            if not touched[i]:
-                mloc["messages.dropped"] += 1
-                mloc["messages.dropped.no_subscribers"] += 1
-                self.hooks.run("message.dropped", msg, "no_subscribers")
-            if tracer is not None:
-                span = getattr(msg, "_otel_span", None)
-                if span is not None:
-                    span.attrs["messaging.deliveries"] = counts[i]
-                    tracer.end(span)
+        if not replay:
+            # replay windows never account "no subscribers": a backlog
+            # entry filtered at window build (unsubscribed since the
+            # checkpoint, QoS0 store gate) was not a dropped publish
+            for i, msg in enumerate(msgs):
+                if not touched[i]:
+                    mloc["messages.dropped"] += 1
+                    mloc["messages.dropped.no_subscribers"] += 1
+                    self.hooks.run(
+                        "message.dropped", msg, "no_subscribers"
+                    )
+                if tracer is not None:
+                    span = getattr(msg, "_otel_span", None)
+                    if span is not None:
+                        span.attrs["messaging.deliveries"] = counts[i]
+                        tracer.end(span)
         self.metrics.inc_bulk(mloc)
         return counts
 

@@ -21,6 +21,7 @@ from ..codec import mqtt as C
 from ..message import Message
 from .. import topic as T
 from .broker import Broker
+from .resume import ResumeBusy
 from .session import Session, SubOpts
 
 log = logging.getLogger("emqx_tpu.channel")
@@ -558,15 +559,22 @@ class Channel:
             # local checkpoint — drop it or open_session would resurrect
             # the older state and discard the fresh import
             self.broker.durable.drop_checkpoint(clientid)
-        session, present = self.broker.open_session(
-            pkt.clean_start,
-            clientid,
-            self,
-            expiry_interval=expiry,
-            max_inflight=min(
-                mqtt.max_inflight, receive_max or mqtt.max_inflight
-            ),
-        )
+        try:
+            session, present = self.broker.open_session(
+                pkt.clean_start,
+                clientid,
+                self,
+                expiry_interval=expiry,
+                max_inflight=min(
+                    mqtt.max_inflight, receive_max or mqtt.max_inflight
+                ),
+            )
+        except ResumeBusy:
+            # resume admission saturated (mass-reconnect storm): the
+            # client backs off and retries instead of the broker
+            # buffering another session's replay state
+            self._connack_error(RC_SERVER_BUSY)
+            return
         self.session = session
         if imported is not None and not present:
             self.broker.import_session(session, imported)

@@ -41,6 +41,12 @@ class ConnectionManager:
         # stats callbacks wired by the broker
         self.on_discarded: Optional[Callable[[Session], None]] = None
         self.on_takenover: Optional[Callable[[Session], None]] = None
+        # fired with the clientid whenever a live channel detaches
+        # (MQTT teardown AND gateway adapters, which never reach
+        # Broker.channel_disconnected): the resume scheduler uses it
+        # to pause a mid-replay job the moment its channel dies, so a
+        # replay slot never idles behind a dead connection
+        self.on_detached: Optional[Callable[[str], None]] = None
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -107,6 +113,10 @@ class ConnectionManager:
         e.disconnected_at = time.time()
         if e.session.expiry_interval <= 0:
             del self._entries[clientid]
+        elif self.on_detached is not None:
+            # persistent session detached: a pending resume job must
+            # release its replay slot (and keep its boot checkpoint)
+            self.on_detached(clientid)
 
     def attach_detached(self, clientid: str, session: Session) -> None:
         """Register a session with no live channel (orphaned takeover

@@ -296,6 +296,11 @@ class BrokerServer:
                 pipeline_windows=eng_cfg.pipeline_windows,
             )
             await self.broker.batcher.start()
+        if self.broker.resume is not None:
+            # resume scheduler BEFORE listeners accept: the first
+            # reconnect of a mass-reconnect storm must already route
+            # through admission control, not the synchronous fallback
+            await self.broker.resume.start()
         cfg = self.broker.config
         if cfg.cluster_links:
             from ..cluster_link import ClusterLinks
@@ -619,6 +624,12 @@ class BrokerServer:
             await lst.stop()
         for qlst in self.quic_listeners:
             await qlst.stop()
+        if self.broker.resume is not None:
+            # after the listeners (no new resumes), before the batcher:
+            # uncommitted jobs keep their boot checkpoints on disk, so
+            # the NEXT boot replays their intervals — a stop mid-storm
+            # is the crash case, handled the crash way (at-least-once)
+            await self.broker.resume.stop()
         if self.broker.batcher is not None:
             await self.broker.batcher.stop()
             self.broker.batcher = None

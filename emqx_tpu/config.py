@@ -206,6 +206,36 @@ class FtConfig:
 
 
 @dataclass
+class ResumeConfig:
+    """Resume admission control (the mass-reconnect scheduler): a
+    bounded number of sessions replay their durable backlog
+    concurrently, each scheduler round reads at most
+    ``replay_byte_budget`` payload bytes before yielding the event
+    loop back to live traffic, and reconnects beyond
+    ``max_concurrent`` park in a FIFO (CONNACK-then-drain: the client
+    is connected and live immediately, its backlog streams in when a
+    replay slot frees).  Past ``park_queue_cap`` the broker answers
+    CONNACK server-busy so the client backs off — a 100k-session
+    storm degrades to bounded latency and bounded memory instead of
+    event-loop starvation."""
+
+    # sessions replaying concurrently (active replay slots)
+    max_concurrent: int = 64
+    # payload bytes read per scheduler round before yielding
+    replay_byte_budget: int = 4 * 1024 * 1024
+    # parked (admitted-but-waiting) sessions beyond the active slots;
+    # past this, reconnects get CONNACK server-busy (client backoff)
+    park_queue_cap: int = 4096
+    # messages pulled per session per round (cursor-batch granular)
+    chunk_msgs: int = 1024
+    # windowed replay: batch DS reads across resuming sessions and
+    # dispatch backlogs through the window pipeline (decide columns +
+    # encode-once + native splice).  False pins the scalar per-session
+    # mqueue path — the property-tested referee.
+    windowed: bool = True
+
+
+@dataclass
 class DurableConfig:
     """Durable storage + persistent sessions (emqx_durable_storage)."""
 
@@ -219,6 +249,8 @@ class DurableConfig:
     store_qos0: bool = False
     sync_interval: float = 5.0  # fsync + census checkpoint cadence
     retention_hours: float = 168.0  # segment GC horizon (7 days)
+    # mass-reconnect admission control + windowed replay
+    resume: ResumeConfig = field(default_factory=ResumeConfig)
 
 
 @dataclass
@@ -475,6 +507,15 @@ def check_config(cfg: BrokerConfig) -> List[str]:
         bad("mqtt.mqueue_default_priority must be lowest|highest")
     if cfg.durable.layout not in ("lts", "hash"):
         bad(f"durable.layout: {cfg.durable.layout!r} (lts|hash)")
+    res = cfg.durable.resume
+    if int(res.max_concurrent) < 1:
+        bad("durable.resume.max_concurrent must be >= 1")
+    if int(res.replay_byte_budget) < 4096:
+        bad("durable.resume.replay_byte_budget must be >= 4096")
+    if int(res.park_queue_cap) < 0:
+        bad("durable.resume.park_queue_cap must be >= 0")
+    if int(res.chunk_msgs) < 1:
+        bad("durable.resume.chunk_msgs must be >= 1")
     if cfg.cluster.get("enable"):
         if cfg.cluster.get("consensus", "raft") not in ("raft", "lww"):
             bad("cluster.consensus must be raft|lww")

@@ -99,6 +99,16 @@ class Ctl:
                 f"node {n['node']} is {n['node_status']}; "
                 f"uptime {n['uptime']}s; {n['connections']} connections"
             )
+            resume = n.get("resume")
+            if resume:
+                print(
+                    f"  resume queue: {resume['active']} active / "
+                    f"{resume['parked']} parked / "
+                    f"{resume['paused']} paused "
+                    f"(max_concurrent={resume['max_concurrent']}, "
+                    f"park_cap={resume['park_queue_cap']}, "
+                    f"windowed={resume['windowed']})"
+                )
         cluster = nodes.get("cluster") or {}
         if cluster:
             print(

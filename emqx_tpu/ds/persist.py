@@ -22,6 +22,7 @@ import os
 import time
 from typing import Dict, List, Optional, Tuple
 
+from .. import failpoints
 from .. import topic as T
 from ..engine import MatchEngine
 from ..message import Message
@@ -373,26 +374,51 @@ class DurableSessions:
                 members.add(cid)
         return sorted(members)
 
-    def replay_chunk(
-        self, state: SessionState, max_msgs: int = 1024
-    ) -> Tuple[List[Tuple[str, Message]], bool]:
-        """Up to ``max_msgs`` messages persisted since the checkpoint,
-        advancing the state's per-(filter, stream) iterator cursors.
-        A caller that durably hands off each chunk may checkpoint the
-        cursors between chunks (`save_state`) so a crash resumes
-        mid-interval; a caller that only buffers in memory (the
-        broker's resume path) must NOT, or a crash would skip the
-        buffered chunk — chunking still bounds its replay memory.
-        Returns ``(messages, done)``; message ids dedup across
-        overlapping filters within one run (at-least-once across a
-        crash)."""
+    def _replay_read(
+        self, it: IterRef, n: int
+    ) -> Tuple[IterRef, List[Message], bool]:
+        """ONE storage read on the replay path — the ``ds.replay.read``
+        failpoint seam (chaos: a DS read failing/stalling exactly when
+        a reconnect storm replays millions of backlogs).  Returns
+        ``(iterator, messages, ok)``:
+
+          * ``error``/``panic`` raise out to the caller's recovery
+            (the resume scheduler backs the session off and retries);
+          * ``delay`` stalls the read (storm pacing under slow disk);
+          * ``drop`` returns ``ok=False`` with the cursor UNCHANGED —
+            a dropped read must never look like stream exhaustion, or
+            replay would silently skip the interval behind it (QoS1
+            loss); callers treat it like a budget stop and retry;
+          * ``duplicate`` returns the batch with the PRE-read cursor,
+            so the next read re-reads it — at-least-once duplication
+            through the mid-dedup/inflight path.
+        """
+        if failpoints.enabled:
+            act = failpoints.evaluate(  # brokerlint: ignore[ASYNC101] — delay action is the chaos point; production paths run this from the scheduler's bounded round
+                "ds.replay.read",
+                key=f"{it.stream.shard}:{it.topic_filter}",
+            )
+            if act == "drop":
+                return it, [], False
+            if act == "duplicate":
+                _it2, msgs = self.storage.next(it, n)
+                return it, msgs, True
+        it2, msgs = self.storage.next(it, n)
+        return it2, msgs, True
+
+    def _ensure_iters(self, state: SessionState) -> None:
+        """Lazily materialize the state's per-(filter, stream) replay
+        cursors (shared by the scalar and windowed replay paths).
+        Built into a LOCAL dict and assigned in one step: a storage
+        fault midway must leave ``state.iters`` None, or the next call
+        would skip the missing filters' whole intervals (loss)."""
         if state.iters is None:
             since_us = int(state.disconnected_at * 1e6)
-            state.iters = {}
+            iters: Dict[str, List[Dict]] = {}
             for flt in state.subs:
                 share = T.parse_share(flt)
                 if share is None:
-                    state.iters[flt] = [
+                    iters[flt] = [
                         self.storage.make_iterator(
                             s, flt, since_us
                         ).to_json()
@@ -431,9 +457,65 @@ class DurableSessions:
                             ts=p[0], seq=p[1],
                         )
                     its.append(it.to_json())
-                state.iters[flt] = its
+                iters[flt] = its
+            state.iters = iters
+
+    def replay_chunk(
+        self, state: SessionState, max_msgs: int = 1024
+    ) -> Tuple[List[Tuple[str, Message]], bool]:
+        """Up to ``max_msgs`` messages persisted since the checkpoint,
+        advancing the state's per-(filter, stream) iterator cursors.
+        A caller that durably hands off each chunk may checkpoint the
+        cursors between chunks (`save_state`) so a crash resumes
+        mid-interval; a caller that only buffers in memory (the
+        broker's resume path) must NOT, or a crash would skip the
+        buffered chunk — chunking still bounds its replay memory.
+        Returns ``(messages, done)``; message ids dedup across
+        overlapping filters within one run (at-least-once across a
+        crash)."""
+        out, done, _nbytes, _err = self._replay_one(
+            state, max_msgs, None
+        )
+        return out, done
+
+    def _replay_one(
+        self,
+        state: SessionState,
+        max_msgs: int,
+        cache: Optional[Dict],
+    ) -> Tuple[List[Tuple[str, Message]], bool, int,
+               Optional[BaseException]]:
+        """One session's replay round: the cursor walk shared by the
+        scalar `replay_chunk` and the windowed `replay_chunk_many`.
+
+        With ``cache`` (windowed mode) reads are a fixed 256 records
+        and shared through it — sessions whose cursors sit at the same
+        (stream, filter, position) cost ONE storage read, the
+        mass-reconnect shape where thousands of sessions checkpointed
+        at the same outage walk the same streams.  A chunk may then
+        overshoot ``max_msgs`` by up to one read batch (cursors move
+        batch-at-a-time; messages a read returned cannot be dropped
+        once the cursor passed them).  Without a cache the reads size
+        themselves to the remaining budget — `replay_chunk`'s exact
+        legacy shape.  Message ORDER per session is identical either
+        way: (filter, stream, record) order, which is what lets the
+        windowed dispatch be property-tested bit-identical against
+        the scalar resume wire.
+
+        Returns ``(messages, done, payload_bytes_read, error)``:
+        ``error`` is the exception of a read that FAULTED mid-round —
+        the already-read prefix is still returned (its dedup/cursor
+        state is committed and correct) and the faulted cursor is
+        UNCHANGED, so the retry re-reads exactly the unread region.
+        Raising past the mutations instead would poison the dedup
+        set: the discarded prefix's mids would read as "seen" on
+        retry, the cursor would skip them, and the interval would be
+        silently lost.  `FailpointPanic` (process death) still flies
+        — in-memory state dies with the process."""
+        self._ensure_iters(state)
         seen = state._replay_seen
         out: List[Tuple[str, Message]] = []
+        nbytes = 0
         for flt, cursors in state.iters.items():
             is_shared = T.parse_share(flt) is not None
             i = 0
@@ -441,16 +523,67 @@ class DurableSessions:
                 it = IterRef.from_json(cursors[i])
                 exhausted = False
                 while len(out) < max_msgs:
-                    it, msgs = self.storage.next(
-                        it, min(256, max_msgs - len(out))
-                    )
+                    try:
+                        if cache is None:
+                            it2, msgs, ok = self._replay_read(
+                                it, min(256, max_msgs - len(out))
+                            )
+                            mids = mbytes = None
+                        else:
+                            ckey = (
+                                it.stream.shard, it.topic_filter,
+                                it.ts, it.seq,
+                            )
+                            hit = cache.get(ckey)
+                            if hit is None:
+                                it2, msgs, ok = self._replay_read(
+                                    it, 256
+                                )
+                                hit = cache[ckey] = (
+                                    it2, msgs, ok,
+                                    frozenset(m.mid for m in msgs),
+                                    sum(
+                                        len(m.payload) + len(m.topic)
+                                        for m in msgs
+                                    ),
+                                )
+                            it2, msgs, ok, mids, mbytes = hit
+                    except Exception as exc:
+                        # fault mid-round: commit the prefix, keep
+                        # the cursor (see docstring) — never raise
+                        # past the dedup/cursor mutations
+                        cursors[i] = it.to_json()
+                        return out, False, nbytes, exc
+                    if not ok:
+                        # dropped read (chaos): NOT exhaustion — keep
+                        # the cursor and come back, or the interval
+                        # behind it would be skipped
+                        break
+                    dup = it2.ts == it.ts and it2.seq == it.seq
+                    it = it2
                     if not msgs:
                         exhausted = True
                         break
+                    if mids is not None and seen.isdisjoint(mids):
+                        # batch fast path (the mass-reconnect shape:
+                        # thousands of sessions consuming the same
+                        # cached batches): no overlap with this
+                        # session's seen-set, so the whole batch
+                        # appends in one C-speed extend
+                        seen.update(mids)
+                        out.extend((flt, m) for m in msgs)
+                        nbytes += mbytes
+                        continue
                     for msg in msgs:
                         if msg.mid not in seen:
                             seen.add(msg.mid)
                             out.append((flt, msg))
+                            nbytes += len(msg.payload) + len(msg.topic)
+                    if dup:
+                        # duplicate-action read: cursor did not move;
+                        # stop this cursor for the round so an armed
+                        # unlimited duplicate cannot livelock the loop
+                        break
                 if is_shared:
                     # group progress: the interval up to this cursor is
                     # CONSUMED for the whole group — survivors must not
@@ -458,11 +591,66 @@ class DurableSessions:
                     self._advance_share_progress(flt, it)
                 if exhausted:
                     cursors.pop(i)
-                else:  # budget hit: persist progress, come back later
+                else:  # budget hit / blocked read: keep progress in
+                    # memory, come back later
                     cursors[i] = it.to_json()
-                    return out, False
+                    return out, False, nbytes, None
         state.iters = {f: c for f, c in state.iters.items() if c}
-        return out, not any(state.iters.values())
+        return out, not any(state.iters.values()), nbytes, None
+
+    def replay_chunk_many(
+        self,
+        states: List[SessionState],
+        max_msgs: int = 1024,
+        byte_budget: Optional[int] = None,
+    ) -> Tuple[Dict[str, List[Tuple[str, Message]]], Dict[str, bool],
+               int, Dict[str, str]]:
+        """Windowed multi-session replay: one pass pulls up to
+        ``max_msgs`` messages for EACH of ``states``, sharing storage
+        reads across sessions whose cursors sit at the same (stream,
+        filter, position) — the beamformer idea applied to resume:
+        coherent readers are served by one sweep instead of one read
+        cycle each.  ``byte_budget`` caps the total payload bytes one
+        call pulls (the resume scheduler's per-round budget); sessions
+        past the cap read nothing this round and simply go next round.
+
+        Returns ``(chunks, done, bytes_read, errors)``: per-clientid
+        message lists in exactly the order `replay_chunk` would
+        produce them, per-clientid completion flags, the payload byte
+        total, and per-clientid error strings for sessions whose read
+        raised (failpoint or real IO fault) — an error on one
+        session's stream must not abort the other thousand resumes in
+        the window.  Cursor discipline is `replay_chunk`'s: cursors
+        advance in MEMORY only; the caller checkpoints nothing until
+        its window is durably handed off (a crash re-replays —
+        at-least-once, never loss)."""
+        cache: Dict = {}
+        chunks: Dict[str, List[Tuple[str, Message]]] = {}
+        done: Dict[str, bool] = {}
+        errors: Dict[str, str] = {}
+        total = 0
+        for state in states:
+            if byte_budget is not None and total >= byte_budget:
+                break  # over budget: the rest go next round
+            try:
+                out, fin, nbytes, err = self._replay_one(
+                    state, max_msgs, cache
+                )
+            except Exception as exc:
+                # defensive only: read faults fail SOFT inside
+                # _replay_one (partial prefix committed + returned);
+                # panic (BaseException) flies
+                errors[state.clientid] = repr(exc)
+                continue
+            chunks[state.clientid] = out
+            done[state.clientid] = fin
+            total += nbytes
+            if err is not None:
+                # partial round: the prefix in chunks[cid] is good and
+                # MUST be delivered; the caller backs the session off
+                # before the next read
+                errors[state.clientid] = repr(err)
+        return chunks, done, total, errors
 
     def save_state(self, state: SessionState) -> None:
         """Persist a state object as-is (mid-replay checkpoint)."""

@@ -66,6 +66,8 @@ SEAMS = (
     "ds.beamformer.poll",
     "cluster.link.forward",
     "s3.request",
+    "ds.replay.read",
+    "session.resume.commit",
 )
 
 enabled = False  # fast-path gate: disabled brokers pay one bool check

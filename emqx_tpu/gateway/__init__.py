@@ -91,10 +91,18 @@ class GatewayChannel:
             def close(reason: str) -> None:
                 channel.close(reason)
 
+        from ..broker.resume import ResumeBusy
+
         self._adapter = _Adapter()
-        session, present = self.broker.open_session(
-            clean_start, clientid, self._adapter
-        )
+        try:
+            session, present = self.broker.open_session(
+                clean_start, clientid, self._adapter
+            )
+        except ResumeBusy as exc:
+            # gateway protocols have no CONNACK server-busy: refuse
+            # the connect (the transport closes; devices retry)
+            channel.close("resume_busy")
+            raise ConnectionError("resume admission saturated") from exc
         self.clientid = clientid
         self.session = session
         self.broker.metrics.inc(f"gateway.{self.gateway.name}.connected")

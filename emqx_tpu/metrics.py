@@ -74,6 +74,10 @@ METRICS = (
     "delivery.dropped.expired",
     "session.created",
     "session.resumed",
+    "session.resume.parked",
+    "session.resume.busy",
+    "session.replay.windows",
+    "session.replay.messages",
     "session.takenover",
     "session.discarded",
     "session.terminated",

@@ -498,6 +498,10 @@ class MgmtApi:
             "connections": len(self.broker.cm),
             "node_status": "running",
         }
+        if self.broker.resume is not None:
+            # resume-queue depth (mass-reconnect admission control):
+            # active replay slots, parked FIFO, paused mid-replay jobs
+            node["resume"] = self.broker.resume.info()
         ext = self.broker.external
         cluster = ext.info() if ext is not None else {}
         return _json({"data": [node], "cluster": cluster})

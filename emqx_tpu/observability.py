@@ -240,8 +240,8 @@ class Profiler:
     # stage histograms pre-created so exposition order is stable
     STAGES = (
         "batch_wait", "prepare", "match_submit", "match_wait",
-        "dispatch_wait", "expand", "decide", "deliver", "assemble",
-        "flush", "rules", "tokenize", "e2e",
+        "dispatch_wait", "replay_read", "expand", "decide", "deliver",
+        "assemble", "flush", "rules", "tokenize", "e2e",
     )
 
     def __init__(

@@ -1,0 +1,958 @@
+"""Crash-safe mass reconnect: batched durable-session replay through
+the window pipeline, with resume admission control.
+
+The referee suite for the resume scheduler (broker/resume.py):
+
+  * windowed replay — resume backlogs batched across sessions
+    (`DurableSessions.replay_chunk_many`) and dispatched through the
+    SAME pipeline as live fan-out (decision columns, encode-once
+    slots, the native window splice) — must put bit-identical bytes
+    on every resuming connection's wire vs the scalar per-session
+    mqueue resume path, with identical per-qos sent metrics and
+    (pid, qos) inflight windows, over random subs / QoS /
+    overlapping-filter / shared-group / no_local / RAP / subid /
+    upgrade_qos / v4-v5 / inflight-pressure worlds (the
+    test_decide_columns referee pattern applied to resume);
+
+  * admission control — max_concurrent replay slots, park FIFO,
+    CONNACK server-busy past park_queue_cap, parked sessions
+    self-draining as slots free;
+
+  * crash safety — the boot checkpoint survives until the
+    ``session.resume.commit`` seam fires AFTER the last window's
+    inflight/mqueue handoff; ``ds.replay.read`` faults (error, drop,
+    kill-mid-replay via panic + broker restart in-test) never lose a
+    persisted QoS1 message — duplicates only within at-least-once
+    bounds;
+
+  * the reconnect storm — 10k resuming sessions with QoS1 backlogs
+    plus concurrent live publishes: bounded live latency, bounded
+    per-round replay bytes, parked depth observable;
+
+  * the PR 8 "for free" claim — a lifecycle-sampled replayed message
+    gets spans through the replay window, delivering clients named.
+"""
+
+import json
+import random
+import time
+
+import pytest
+
+from emqx_tpu import failpoints as fp
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.channel import Channel
+from emqx_tpu.broker.resume import ResumeBusy
+from emqx_tpu.broker.session import SubOpts
+from emqx_tpu.codec import mqtt as C
+from emqx_tpu.config import BrokerConfig, check_config
+from emqx_tpu.message import Message
+
+
+class WireChannel(Channel):
+    def __init__(self, broker, version=C.MQTT_V5):
+        self.writes = []
+
+        def send(pkts):
+            self.writes.append(
+                b"".join(C.serialize(p, self.version) for p in pkts)
+            )
+
+        super().__init__(broker, send=send, close=lambda r: None)
+        self.version = version
+
+    def wire(self) -> bytes:
+        return b"".join(bytes(x) for x in self.writes)
+
+
+def _cfg(data_dir, windowed=True, **resume_kw):
+    cfg = BrokerConfig()
+    cfg.engine.use_device = False
+    cfg.durable.enable = True
+    cfg.durable.data_dir = str(data_dir)
+    cfg.durable.resume.windowed = windowed
+    for k, v in resume_kw.items():
+        setattr(cfg.durable.resume, k, v)
+    return cfg
+
+
+# --------------------------------------------------- world generator
+
+def _build_world(seed):
+    rng = random.Random(seed)
+    clients = []
+    for i in range(10):
+        subs = []
+        for f in range(rng.randint(1, 3)):
+            flt = rng.choice(
+                ["t/#", "t/+/x", f"t/{f}/x", "s/only",
+                 "$share/g1/t/+/x"]
+            )
+            subs.append({
+                "flt": flt,
+                "qos": rng.randint(0, 2),
+                "rap": rng.random() < 0.4,
+                "no_local": rng.random() < 0.3,
+                "subid": rng.randint(1, 9)
+                if rng.random() < 0.2 else None,
+            })
+        clients.append({
+            "cid": f"c{i}",
+            "version": rng.choice([C.MQTT_V4, C.MQTT_V5]),
+            "upgrade": rng.random() < 0.3,
+            "max_inflight": rng.choice([2, 4, 32]),
+            "subs": subs,
+        })
+    msgs = []
+    for j in range(rng.randint(10, 40)):
+        msgs.append({
+            "topic": rng.choice(
+                ["t/1/x", "t/2/x", "t/0/x", "s/only", "t/deep/x"]
+            ),
+            "qos": rng.randint(0, 2),
+            "retain": rng.random() < 0.3,
+            "payload": bytes(
+                rng.randrange(256)
+                for _ in range(rng.randint(0, 120))
+            ),
+            "from": rng.choice(["c0", "c1", "pub"]),
+        })
+    return clients, msgs
+
+
+def _seed_dir(data_dir, clients, msgs):
+    """Subscribe + checkpoint every client, then persist the backlog
+    while they are away (the outage interval)."""
+    b = Broker(config=_cfg(data_dir))
+    chans = {}
+    for c in clients:
+        ch = WireChannel(b, version=c["version"])
+        session, _ = b.cm.open_session(
+            False, c["cid"], ch, expiry_interval=3600.0
+        )
+        session.upgrade_qos = c["upgrade"]
+        for s in c["subs"]:
+            opts = SubOpts(
+                qos=s["qos"], retain_as_published=s["rap"],
+                no_local=s["no_local"], subid=s["subid"],
+            )
+            session.subscribe(s["flt"], opts)
+            b.subscribe(c["cid"], s["flt"], opts)
+        chans[c["cid"]] = ch
+    for c in clients:
+        b.cm.disconnect(c["cid"], chans[c["cid"]])
+        b.channel_disconnected(c["cid"])
+    out = [
+        Message(
+            topic=m["topic"], qos=m["qos"], retain=m["retain"],
+            payload=m["payload"], from_client=m["from"],
+            timestamp=time.time(),
+        )
+        for m in msgs
+    ]
+    b.publish_many(out)
+    b.durable.sync()
+    b.durable.close()
+
+
+def _drain_resume(b, clients):
+    rounds = 0
+    while any(b.resume.pending(c["cid"]) for c in clients):
+        b.resume.drain_once()
+        rounds += 1
+        assert rounds < 10_000, "resume never completed"
+        if b.resume.drain_once.__self__ is not b.resume:  # pragma: no cover
+            break
+    return rounds
+
+
+def _ack_until_quiet(b, clients, chans, sessions):
+    """Client side of the ack dance: decode every connection's new
+    wire bytes, answer PUBACK/PUBREC+PUBCOMP through the session
+    (which drains the mqueue into the window), repeat to fixpoint."""
+    parsers = {
+        c["cid"]: C.StreamParser(version=c["version"]) for c in clients
+    }
+    seen = {c["cid"]: 0 for c in clients}
+    progress = True
+    while progress:
+        progress = False
+        for c in clients:
+            cid = c["cid"]
+            ch = chans[cid]
+            wire = ch.wire()
+            new = wire[seen[cid]:]
+            if not new:
+                continue
+            seen[cid] = len(wire)
+            session = sessions[cid]
+            for pkt in parsers[cid].feed(new):
+                if pkt.type != C.PUBLISH or pkt.packet_id is None:
+                    continue
+                progress = True
+                if pkt.qos == 1:
+                    _ok, follow = session.puback(pkt.packet_id)
+                    ch.send_packets(follow)
+                elif pkt.qos == 2:
+                    _ok, follow = session.pubrec(pkt.packet_id)
+                    ch.send_packets(follow)
+                    _ok, follow = session.pubcomp(pkt.packet_id)
+                    ch.send_packets(follow)
+
+
+def _resume_run(data_dir, clients, windowed, ack=True):
+    """Reconnect every client against a fresh broker on ``data_dir``
+    and drain the whole resume through the scheduler; returns
+    (per-connection wire bytes, per-qos sent metrics, (pid, qos)
+    inflight windows, broker)."""
+    b = Broker(config=_cfg(data_dir, windowed=windowed,
+                           max_concurrent=3, chunk_msgs=16))
+    b.resume.running = True
+    b.router.shared._rng.seed(1234)
+    chans = {}
+    sessions = {}
+    for c in clients:
+        ch = WireChannel(b, version=c["version"])
+        session, present = b.open_session(
+            False, c["cid"], ch, expiry_interval=3600.0,
+            max_inflight=c["max_inflight"],
+        )
+        assert present, c["cid"]
+        session.upgrade_qos = c["upgrade"]
+        ch.session = session
+        ch.send_packets(session.resume())  # post-CONNACK redelivery
+        chans[c["cid"]] = ch
+        sessions[c["cid"]] = session
+    _drain_resume(b, clients)
+    if ack:
+        _ack_until_quiet(b, clients, chans, sessions)
+    wires = {c["cid"]: chans[c["cid"]].wire() for c in clients}
+    sent = {
+        k: b.metrics.all().get(k, 0)
+        for k in ("messages.sent", "messages.qos0.sent",
+                  "messages.qos1.sent", "messages.qos2.sent")
+    }
+    inflight = {
+        c["cid"]: sorted(
+            (pid, e.qos)
+            for pid, e in sessions[c["cid"]].inflight.items()
+        )
+        for c in clients
+    }
+    b.durable.close()
+    return wires, sent, inflight, b
+
+
+# ------------------------------------------------ bit-identity referee
+
+@pytest.mark.parametrize("seed", range(6))
+def test_windowed_replay_bit_identical_to_scalar(tmp_path, seed):
+    """The acceptance referee: windowed replay (batched DS reads +
+    dispatch windows through decide columns / encode-once / native
+    splice) vs the scalar per-session mqueue resume — bit-identical
+    per-connection wire bytes, per-qos sent metrics, and (pid, qos)
+    inflight windows, including the ack-driven drain of backlogs
+    larger than the inflight window."""
+    clients, msgs = _build_world(seed)
+    d_win = tmp_path / "win"
+    d_sca = tmp_path / "sca"
+    _seed_dir(d_win, clients, msgs)
+    _seed_dir(d_sca, clients, msgs)
+    w_wire, w_sent, w_inf, _ = _resume_run(d_win, clients, True)
+    s_wire, s_sent, s_inf, _ = _resume_run(d_sca, clients, False)
+    assert w_sent == s_sent
+    assert w_inf == s_inf
+    for c in clients:
+        cid = c["cid"]
+        assert w_wire[cid] == s_wire[cid], (
+            seed, cid, len(w_wire[cid]), len(s_wire[cid])
+        )
+
+
+def test_windowed_replay_matches_legacy_inline_resume(tmp_path):
+    """The windowed path must also agree with the LEGACY shape: no
+    scheduler running, the whole interval replayed synchronously
+    inside open_session (the pre-scheduler behavior unit tests and
+    loop-less embedders still get).  upgrade_qos pinned off: it is
+    broker-level config in production, but the harness sets it on the
+    session object AFTER open_session returns — too late for the
+    in-line replay to see (a harness artifact, not a path
+    difference)."""
+    clients, msgs = _build_world(99)
+    for c in clients:
+        c["upgrade"] = False
+    d_win = tmp_path / "win"
+    d_leg = tmp_path / "leg"
+    _seed_dir(d_win, clients, msgs)
+    _seed_dir(d_leg, clients, msgs)
+    w_wire, w_sent, w_inf, _ = _resume_run(d_win, clients, True)
+
+    b = Broker(config=_cfg(d_leg))  # resume.running stays False
+    b.router.shared._rng.seed(1234)
+    chans = {}
+    sessions = {}
+    for c in clients:
+        ch = WireChannel(b, version=c["version"])
+        session, present = b.open_session(
+            False, c["cid"], ch, expiry_interval=3600.0,
+            max_inflight=c["max_inflight"],
+        )
+        assert present
+        session.upgrade_qos = c["upgrade"]
+        ch.send_packets(session.resume())
+        chans[c["cid"]] = ch
+        sessions[c["cid"]] = session
+    _ack_until_quiet(b, clients, chans, sessions)
+    l_sent = {
+        k: b.metrics.all().get(k, 0)
+        for k in ("messages.sent", "messages.qos0.sent",
+                  "messages.qos1.sent", "messages.qos2.sent")
+    }
+    assert w_sent == l_sent
+    for c in clients:
+        assert w_wire[c["cid"]] == chans[c["cid"]].wire(), c["cid"]
+    b.durable.close()
+
+
+# --------------------------------------------------- admission control
+
+def _seed_simple(data_dir, cids, n_msgs=6, topic_of=None, qos=1):
+    """One filter per client, ``n_msgs`` QoS1 backlog each."""
+    from emqx_tpu.ds.persist import DurableSessions
+
+    ds = DurableSessions(str(data_dir))
+    t0 = time.time() - 30.0
+    for cid in cids:
+        ds.save(cid, {"q/" + cid + "/#": {"qos": 1}}, 3600.0, now=t0)
+        ds.add_filter("q/" + cid + "/#")
+    msgs = []
+    for cid in cids:
+        for j in range(n_msgs):
+            msgs.append(Message(
+                topic=(topic_of(cid, j) if topic_of
+                       else f"q/{cid}/{j}"),
+                qos=qos, payload=f"{cid}-{j}".encode(),
+                timestamp=time.time(),
+            ))
+    ds.persist(msgs)
+    ds.sync()
+    ds.close()
+
+
+def test_admission_caps_park_fifo_and_busy(tmp_path):
+    cids = [f"a{i}" for i in range(4)]
+    _seed_simple(tmp_path / "ds", cids)
+    b = Broker(config=_cfg(tmp_path / "ds", max_concurrent=1,
+                           park_queue_cap=2, chunk_msgs=4))
+    b.resume.running = True
+    chans = {}
+    for cid in cids[:3]:
+        ch = WireChannel(b)
+        _s, present = b.open_session(
+            False, cid, ch, expiry_interval=3600.0
+        )
+        assert present
+        chans[cid] = ch
+    info = b.resume.info()
+    assert info["active"] == 1 and info["parked"] == 2
+    assert b.metrics.all()["session.resume.parked"] == 2
+    # saturated: the 4th reconnect is refused BEFORE any state exists
+    with pytest.raises(ResumeBusy):
+        b.open_session(False, cids[3], WireChannel(b),
+                       expiry_interval=3600.0)
+    assert b.metrics.all()["session.resume.busy"] == 1
+    assert b.cm.lookup(cids[3]) is None
+    assert b.durable.has_checkpoint(cids[3])  # nothing was lost
+    # parked sessions self-drain in FIFO order as slots free
+    rounds = 0
+    while any(b.resume.pending(c) for c in cids[:3]):
+        b.resume.drain_once()
+        assert b.resume.info()["active"] <= 1
+        rounds += 1
+        assert rounds < 500
+    assert b.metrics.all()["session.resumed"] == 3
+    assert b.metrics.all()["session.replay.windows"] >= 3
+    for cid in cids[:3]:
+        assert not b.durable.has_checkpoint(cid)  # committed
+        assert chans[cid].wire()  # backlog arrived
+    # the refused client retries and is admitted now
+    ch = WireChannel(b)
+    _s, present = b.open_session(False, cids[3], ch,
+                                 expiry_interval=3600.0)
+    assert present
+    while b.resume.pending(cids[3]):
+        b.resume.drain_once()
+    assert ch.wire()
+    b.durable.close()
+
+
+def test_disconnect_mid_replay_keeps_checkpoint_then_resumes(tmp_path):
+    """Disconnect while the backlog is still draining: the boot
+    checkpoint must NOT be overwritten (its on-disk cursors cover the
+    un-replayed tail — the crash-recovery story), and the next
+    reconnect continues the replay where it stopped."""
+    _seed_simple(tmp_path / "ds", ["m0"], n_msgs=40)
+    b = Broker(config=_cfg(tmp_path / "ds", chunk_msgs=5))
+    b.resume.running = True
+    ch1 = WireChannel(b)
+    session, present = b.open_session(
+        False, "m0", ch1, expiry_interval=3600.0, max_inflight=1000
+    )
+    assert present
+    ch1.send_packets(session.resume())
+    b.resume.drain_once()  # partial: 5 of 40
+    assert b.resume.pending("m0")
+    state_path = b.durable._state_path("m0")
+    before = json.load(open(state_path))
+    b.cm.disconnect("m0", ch1)
+    b.channel_disconnected("m0")
+    # checkpoint NOT overwritten with a fresh disconnected_at (that
+    # would skip the un-replayed tail after a restart)
+    after = json.load(open(state_path))
+    assert after == before
+    assert b.durable.has_checkpoint("m0")
+    info = b.resume.info()
+    assert info["paused"] == 1 and info["active"] == 0
+    # reconnect: the detached session takes the new channel and the
+    # scheduler picks the job back up
+    ch2 = WireChannel(b)
+    session2, present = b.open_session(
+        False, "m0", ch2, expiry_interval=3600.0
+    )
+    assert present and session2 is session
+    ch2.send_packets(session2.resume())
+    while b.resume.pending("m0"):
+        b.resume.drain_once()
+    assert not b.durable.has_checkpoint("m0")  # committed
+    got = set()
+    for ch, ver in ((ch1, C.MQTT_V5), (ch2, C.MQTT_V5)):
+        parser = C.StreamParser(version=ver)
+        for pkt in parser.feed(ch.wire()):
+            if pkt.type == C.PUBLISH:
+                got.add(bytes(pkt.payload))
+    assert got == {f"m0-{j}".encode() for j in range(40)}
+    b.durable.close()
+
+
+# ------------------------------------------------------- chaos: seams
+
+def _collect_payloads(ch, version=C.MQTT_V5):
+    out = []
+    parser = C.StreamParser(version=version)
+    for pkt in parser.feed(ch.wire()):
+        if pkt.type == C.PUBLISH:
+            out.append(bytes(pkt.payload))
+    return out
+
+
+def test_replay_read_fault_backoff_and_self_drain(tmp_path):
+    """``ds.replay.read`` error: the session backs off, keeps its
+    checkpoint, and self-drains to a complete backlog once the fault
+    clears — zero loss."""
+    _seed_simple(tmp_path / "ds", ["e0"], n_msgs=20)
+    b = Broker(config=_cfg(tmp_path / "ds", chunk_msgs=4))
+    b.resume.running = True
+    fp.configure("ds.replay.read", "error", times=3)
+    try:
+        ch = WireChannel(b)
+        session, present = b.open_session(
+            False, "e0", ch, expiry_interval=3600.0, max_inflight=1000
+        )
+        assert present
+        ch.send_packets(session.resume())
+        deadline = time.time() + 10.0
+        while b.resume.pending("e0"):
+            b.resume.drain_once()
+            assert time.time() < deadline, "fault never self-drained"
+            time.sleep(0.01)  # let the backoff deadline pass
+        got = _collect_payloads(ch)
+        assert sorted(got) == sorted(
+            f"e0-{j}".encode() for j in range(20)
+        )
+        assert not b.durable.has_checkpoint("e0")
+    finally:
+        fp.clear()
+        b.durable.close()
+
+
+def test_replay_read_drop_never_skips_the_interval(tmp_path):
+    """``drop`` answers a replay read with nothing — which must read
+    as "retry later", NEVER as stream exhaustion: the interval behind
+    a dropped read would otherwise be silently skipped (QoS1 loss)."""
+    _seed_simple(tmp_path / "ds", ["d0"], n_msgs=24)
+    b = Broker(config=_cfg(tmp_path / "ds", chunk_msgs=6))
+    b.resume.running = True
+    fp.configure("ds.replay.read", "drop", times=4)
+    try:
+        ch = WireChannel(b)
+        session, present = b.open_session(
+            False, "d0", ch, expiry_interval=3600.0, max_inflight=1000
+        )
+        assert present
+        ch.send_packets(session.resume())
+        deadline = time.time() + 10.0
+        while b.resume.pending("d0"):
+            b.resume.drain_once()
+            assert time.time() < deadline
+        got = _collect_payloads(ch)
+        # complete coverage — dups allowed (at-least-once), loss not
+        assert set(got) == {f"d0-{j}".encode() for j in range(24)}
+    finally:
+        fp.clear()
+        b.durable.close()
+
+
+def test_resume_commit_fault_keeps_checkpoint_until_it_clears(tmp_path):
+    """``session.resume.commit`` error: the backlog is delivered but
+    the checkpoint SURVIVES (a crash now re-replays — at-least-once;
+    dropping it early would be loss); when the fault clears the
+    commit lands, the checkpoint is discarded and session.resumed
+    fires."""
+    _seed_simple(tmp_path / "ds", ["k0"], n_msgs=8)
+    b = Broker(config=_cfg(tmp_path / "ds", chunk_msgs=50))
+    b.resume.running = True
+    fp.configure("session.resume.commit", "error", times=2)
+    try:
+        ch = WireChannel(b)
+        session, present = b.open_session(
+            False, "k0", ch, expiry_interval=3600.0, max_inflight=1000
+        )
+        assert present
+        ch.send_packets(session.resume())
+        b.resume.drain_once()  # reads all + delivery + failed commit
+        assert sorted(_collect_payloads(ch)) == sorted(
+            f"k0-{j}".encode() for j in range(8)
+        )
+        assert b.durable.has_checkpoint("k0")  # commit blocked
+        assert b.metrics.all().get("session.resumed", 0) == 0
+        deadline = time.time() + 10.0
+        while b.resume.pending("k0"):
+            b.resume.drain_once()
+            assert time.time() < deadline
+            time.sleep(0.02)
+        assert not b.durable.has_checkpoint("k0")
+        assert b.metrics.all()["session.resumed"] == 1
+    finally:
+        fp.clear()
+        b.durable.close()
+
+
+def test_kill_mid_replay_zero_qos1_loss_on_restart(tmp_path):
+    """THE crash-safety acceptance: the broker dies (failpoint panic —
+    BaseException, absorbed by no recovery path) in the middle of a
+    windowed mass replay; a fresh broker on the same data directory
+    re-resumes, and every QoS1 message persisted before the outage is
+    delivered — duplicates allowed (at-least-once), loss not."""
+    cids = ["v0", "v1", "v2"]
+    _seed_simple(tmp_path / "ds", cids, n_msgs=30)
+    b1 = Broker(config=_cfg(tmp_path / "ds", chunk_msgs=5,
+                            max_concurrent=2))
+    b1.resume.running = True
+    chans1 = {}
+    for cid in cids:
+        ch = WireChannel(b1)
+        session, present = b1.open_session(
+            False, cid, ch, expiry_interval=3600.0, max_inflight=1000
+        )
+        assert present
+        ch.send_packets(session.resume())
+        chans1[cid] = ch
+    # a few windows land, then the "process dies" mid-replay
+    fp.configure("ds.replay.read", "panic", after=4)
+    died = False
+    try:
+        for _ in range(200):
+            b1.resume.drain_once()
+    except fp.FailpointPanic:
+        died = True
+    finally:
+        fp.clear()
+    assert died, "panic failpoint never fired"
+    delivered_before = {
+        cid: set(_collect_payloads(chans1[cid])) for cid in cids
+    }
+    # b1 is abandoned exactly as a dead process would be: no commit,
+    # no checkpoint write, no close.  The restart boots from disk.
+    b2 = Broker(config=_cfg(tmp_path / "ds", chunk_msgs=7,
+                            max_concurrent=3))
+    b2.resume.running = True
+    for cid in cids:
+        assert b2.durable.has_checkpoint(cid)  # survived the crash
+    chans2 = {}
+    for cid in cids:
+        ch = WireChannel(b2)
+        session, present = b2.open_session(
+            False, cid, ch, expiry_interval=3600.0, max_inflight=1000
+        )
+        assert present
+        ch.send_packets(session.resume())
+        chans2[cid] = ch
+    while any(b2.resume.pending(cid) for cid in cids):
+        b2.resume.drain_once()
+    for cid in cids:
+        want = {f"{cid}-{j}".encode() for j in range(30)}
+        got = delivered_before[cid] | set(
+            _collect_payloads(chans2[cid])
+        )
+        assert got >= want, (cid, sorted(want - got)[:5])
+    b2.durable.close()
+
+
+def test_scalar_inline_resume_survives_dropped_read(tmp_path):
+    """The loop-less fallback (no scheduler running): a chaos-dropped
+    read stops the in-line replay WITHOUT discarding the checkpoint,
+    so the next reconnect (or restart) replays the blocked tail
+    instead of losing it — and without spinning the caller forever."""
+    _seed_simple(tmp_path / "ds", ["s0"], n_msgs=12)
+    b = Broker(config=_cfg(tmp_path / "ds"))
+    fp.configure("ds.replay.read", "drop", after=1)
+    try:
+        ch = WireChannel(b)
+        session, present = b.open_session(
+            False, "s0", ch, expiry_interval=3600.0, max_inflight=1000
+        )
+        assert present
+        ch.send_packets(session.resume())
+        # blocked mid-interval: the checkpoint MUST survive, and the
+        # session must NOT count as resumed (backlog never handed off)
+        assert b.durable.has_checkpoint("s0")
+        assert b.metrics.all().get("session.resumed", 0) == 0
+    finally:
+        fp.clear()
+        b.durable.close()
+    b2 = Broker(config=_cfg(tmp_path / "ds"))
+    ch2 = WireChannel(b2)
+    session2, present = b2.open_session(
+        False, "s0", ch2, expiry_interval=3600.0, max_inflight=1000
+    )
+    assert present
+    ch2.send_packets(session2.resume())
+    got = set(_collect_payloads(ch)) | set(_collect_payloads(ch2))
+    assert got == {f"s0-{j}".encode() for j in range(12)}
+    assert not b2.durable.has_checkpoint("s0")
+    b2.durable.close()
+
+
+def test_read_fault_after_partial_progress_loses_nothing(tmp_path):
+    """A fault on a LATER storage read of the same round must not
+    poison the dedup set: the already-read prefix is delivered, the
+    faulted cursor stays put, and the retry re-reads exactly the
+    unread region — the full 600-message backlog arrives.  (The
+    broken shape: raising past the mutated seen-set made the retry
+    skip the discarded prefix's region as 'seen' and marked the
+    session done — silent QoS1 loss.)"""
+    _seed_simple(tmp_path / "ds", ["p0"], n_msgs=600)
+    b = Broker(config=_cfg(tmp_path / "ds", chunk_msgs=600))
+    b.resume.running = True
+    # first read (256 msgs) succeeds, the second FAULTS, mid-round
+    fp.configure("ds.replay.read", "error", after=1, times=1)
+    try:
+        ch = WireChannel(b)
+        session, present = b.open_session(
+            False, "p0", ch, expiry_interval=3600.0, max_inflight=0
+        )
+        assert present
+        ch.send_packets(session.resume())
+        deadline = time.time() + 15.0
+        while b.resume.pending("p0"):
+            b.resume.drain_once()
+            assert time.time() < deadline
+            time.sleep(0.01)
+        got = set(_collect_payloads(ch))
+        assert got == {f"p0-{j}".encode() for j in range(600)}, (
+            len(got)
+        )
+    finally:
+        fp.clear()
+        b.durable.close()
+
+
+def test_persistent_drop_backs_off_instead_of_spinning(tmp_path):
+    """A PERSISTENT dropped read (prob=1, no times cap) must read as
+    a fault — backoff, no progress — not as an empty-chunk success
+    that busy-spins the drive loop at 100% CPU."""
+    _seed_simple(tmp_path / "ds", ["z0"], n_msgs=10)
+    b = Broker(config=_cfg(tmp_path / "ds", chunk_msgs=4))
+    b.resume.running = True
+    fp.configure("ds.replay.read", "drop")
+    try:
+        ch = WireChannel(b)
+        _s, present = b.open_session(
+            False, "z0", ch, expiry_interval=3600.0, max_inflight=1000
+        )
+        assert present
+        assert b.resume.drain_once() == 0  # blocked, not "progress"
+        assert b.resume.drain_once() == 0  # backoff holds
+        assert b.resume.pending("z0")
+        assert b.durable.has_checkpoint("z0")
+    finally:
+        fp.clear()
+        b.durable.close()
+
+
+def test_mid_replay_subscribe_survives_in_checkpoint(tmp_path):
+    """A filter subscribed DURING the live mid-replay window must
+    reach the kept checkpoint (subs refreshed, original
+    disconnected_at and virgin cursors preserved) — or a restart
+    would rebuild the session without it and lose every QoS1 message
+    the new filter gated into storage."""
+    _seed_simple(tmp_path / "ds", ["w0"], n_msgs=40)
+    b = Broker(config=_cfg(tmp_path / "ds", chunk_msgs=5))
+    b.resume.running = True
+    ch = WireChannel(b)
+    session, present = b.open_session(
+        False, "w0", ch, expiry_interval=3600.0, max_inflight=1000
+    )
+    assert present
+    ch.send_packets(session.resume())
+    b.resume.drain_once()  # partial
+    before = json.load(open(b.durable._state_path("w0")))
+    opts = SubOpts(qos=1)
+    session.subscribe("extra/#", opts)
+    b.subscribe("w0", "extra/#", opts)
+    b.cm.disconnect("w0", ch)
+    b.channel_disconnected("w0")
+    after = json.load(open(b.durable._state_path("w0")))
+    assert "extra/#" in after["subs"]  # the live change persisted
+    assert after["disconnected_at"] == before["disconnected_at"]
+    assert "iters" not in after  # never the advanced in-memory cursors
+    b.durable.close()
+
+
+def test_expiry_zero_termination_mid_replay_drops_job(tmp_path):
+    """A session that ends with expiry 0 mid-replay abandoned its
+    state by protocol: the replay job AND the boot checkpoint go with
+    it — a later reconnect starts clean instead of resurrecting it."""
+    _seed_simple(tmp_path / "ds", ["x0"], n_msgs=40)
+    b = Broker(config=_cfg(tmp_path / "ds", chunk_msgs=5))
+    b.resume.running = True
+    ch = WireChannel(b)
+    session, present = b.open_session(
+        False, "x0", ch, expiry_interval=3600.0, max_inflight=1000
+    )
+    assert present
+    b.resume.drain_once()  # partial
+    assert b.resume.pending("x0")
+    session.expiry_interval = 0.0  # MQTT5 DISCONNECT lowered it
+    b.cm.disconnect("x0", ch)
+    b.session_terminated("x0", session)
+    assert not b.resume.pending("x0")
+    assert not b.durable.has_checkpoint("x0")
+    b.durable.close()
+
+
+# ----------------------------------------------------- reconnect storm
+
+class SinkChannel:
+    """Minimal ChannelLike for the storm: counts packets/bytes, takes
+    the native wire path (cork/send_wire), encodes nothing."""
+
+    version = C.MQTT_V5
+
+    __slots__ = ("n_pub", "n_bytes")
+
+    def __init__(self):
+        self.n_pub = 0
+        self.n_bytes = 0
+
+    def cork(self):
+        pass
+
+    def uncork(self):
+        pass
+
+    def send_packets(self, pkts):
+        self.n_pub += sum(
+            1 for p in pkts if getattr(p, "type", None) == C.PUBLISH
+            or isinstance(p, C.Publish)
+        )
+
+    def send_wire(self, data, npub, count=True):
+        self.n_bytes += len(data)
+        self.n_pub += sum(npub)
+        return True
+
+    def close(self, reason):
+        pass
+
+
+def test_reconnect_storm_bounded_latency_and_memory(tmp_path):
+    """The storm acceptance: >= 10k resuming sessions with QoS1
+    backlogs + concurrent live publishes.  Asserts the degradation
+    CONTRACT: active replay slots never exceed max_concurrent, each
+    round's DS reads stay under the byte budget, parked depth is
+    observable while the queue drains, live publish windows stay
+    fast while the storm drains, and every session's full backlog
+    arrives (zero loss)."""
+    n_sessions = 10_000
+    n_backlog = 5
+    from emqx_tpu.ds.persist import DurableSessions
+
+    ds = DurableSessions(str(tmp_path / "ds"))
+    t0 = time.time() - 60.0
+    cids = [f"s{i}" for i in range(n_sessions)]
+    for cid in cids:
+        ds.save(cid, {"storm/#": {"qos": 1}}, 7200.0, now=t0)
+    ds.add_filter("storm/#")
+    ds.persist([
+        Message(topic=f"storm/{k}", qos=1, payload=b"x" * 96,
+                timestamp=time.time())
+        for k in range(n_backlog)
+    ])
+    ds.sync()
+    ds.close()
+
+    budget = 256 * 1024
+    b = Broker(config=_cfg(tmp_path / "ds", max_concurrent=64,
+                           park_queue_cap=n_sessions,
+                           replay_byte_budget=budget,
+                           chunk_msgs=64))
+    b.resume.running = True
+    # spy on the read layer: every round's byte pull must respect the
+    # budget (+ one session's chunk of slack — cursor granularity)
+    rounds_bytes = []
+    orig = b.durable.replay_chunk_many
+
+    def spy(states, max_msgs=1024, byte_budget=None):
+        out = orig(states, max_msgs=max_msgs, byte_budget=byte_budget)
+        rounds_bytes.append(out[2])
+        return out
+
+    b.durable.replay_chunk_many = spy
+    chans = {}
+    for cid in cids:
+        ch = SinkChannel()
+        _s, present = b.open_session(
+            False, cid, ch, expiry_interval=7200.0, max_inflight=1000
+        )
+        assert present
+        chans[cid] = ch
+    m = b.metrics.all()
+    assert m["session.resume.parked"] == n_sessions - 64
+    assert b.resume.info()["parked"] == n_sessions - 64
+
+    # one live subscriber rides along; live publishes must stay fast
+    # while the storm drains
+    live = SinkChannel()
+    ls, _ = b.cm.open_session(True, "live-sub", live)
+    ls.subscribe("live/x", SubOpts(qos=0))
+    b.subscribe("live-sub", "live/x", SubOpts(qos=0))
+    live_lat = []
+    pending = set(cids)
+    rounds = 0
+    while pending:
+        b.resume.drain_once()
+        rounds += 1
+        assert rounds < 20_000
+        assert b.resume.info()["active"] <= 64
+        if rounds % 10 == 0:
+            t_live = time.perf_counter()
+            b.publish_many([Message(topic="live/x", qos=0,
+                                    payload=b"hb",
+                                    timestamp=time.time())])
+            live_lat.append(time.perf_counter() - t_live)
+        if rounds % 50 == 0 or len(pending) < 256:
+            pending = {c for c in pending if b.resume.pending(c)}
+    assert rounds_bytes and max(rounds_bytes) <= budget + 64 * 1024
+    # live traffic stayed bounded while 10k sessions drained: p99 of
+    # a 1-message live window under 200 ms is loose enough for CI
+    # noise while catching event-loop starvation outright
+    live_lat.sort()
+    assert live_lat, "no live publishes interleaved"
+    assert live_lat[int(len(live_lat) * 0.99)] < 0.2
+    assert live.n_pub == len(live_lat)
+    # zero loss: every session received its whole backlog
+    short = [c for c in cids if chans[c].n_pub < n_backlog]
+    assert not short, (len(short), short[:5])
+    assert b.metrics.all()["session.resumed"] == n_sessions
+    assert b.metrics.all()["session.replay.windows"] >= (
+        n_sessions // 64
+    )
+    b.durable.close()
+
+
+# ------------------------------------------- lifecycle spans for free
+
+def test_replayed_sampled_message_gets_lifecycle_spans(tmp_path):
+    """The PR 8 'for free' claim, proven: replay windows ride the
+    dispatch pipeline, so a lifecycle-sampled REPLAYED message gets a
+    span cut from the replay window's flight record — source tagged,
+    delivering clients named."""
+    _seed_simple(tmp_path / "ds", ["t0", "t1"], n_msgs=3,
+                 topic_of=lambda cid, j: f"q/{cid}/{j}")
+    cfg = _cfg(tmp_path / "ds", chunk_msgs=50)
+    cfg.tracing.enable = True
+    cfg.tracing.sample_rate = 1.0
+    cfg.tracing.seed = 7
+    b = Broker(config=cfg)
+    b.resume.running = True
+    chans = {}
+    for cid in ("t0", "t1"):
+        ch = WireChannel(b)
+        session, present = b.open_session(
+            False, cid, ch, expiry_interval=3600.0, max_inflight=1000
+        )
+        assert present
+        ch.send_packets(session.resume())
+        chans[cid] = ch
+    while b.resume.pending("t0") or b.resume.pending("t1"):
+        b.resume.drain_once()
+    store = b.lifecycle.store
+    assert len(store) >= 1
+    spans = [s for t in store.traces(limit=64)
+             for s in store.get(t["trace_id"])]
+    replay_spans = [
+        s for s in spans if s["attrs"].get("source") == "replay"
+    ]
+    assert replay_spans, "no replay-window spans were cut"
+    for s in replay_spans:
+        assert s["attrs"]["deliveries"] >= 1
+        # the delivering client is named on the span (decision-column
+        # attribution, exactly as for live fan-out)
+        assert s["attrs"].get("clients"), s
+        assert s["attrs"]["clients"][0] in ("t0", "t1")
+        # stage events from the replay window's flight record,
+        # including the new replay_read stage
+        names = {e["name"] for e in s["events"]}
+        assert "stage.replay_read" in names
+    # and the wire still carried NO trace context (the property the
+    # rate-0 suite proves for live traffic holds for replay too: the
+    # context never reaches a subscriber wire)
+    for cid, ch in chans.items():
+        parser = C.StreamParser(version=C.MQTT_V5)
+        for pkt in parser.feed(ch.wire()):
+            if pkt.type == C.PUBLISH:
+                assert "emqx-tp-trace" not in (
+                    pkt.properties.get("user_properties") or {}
+                )
+    b.durable.close()
+
+
+# --------------------------------------------------- config + surfaces
+
+def test_resume_config_bounds():
+    cfg = BrokerConfig()
+    cfg.durable.resume.max_concurrent = 0
+    cfg.durable.resume.replay_byte_budget = 16
+    cfg.durable.resume.park_queue_cap = -1
+    cfg.durable.resume.chunk_msgs = 0
+    problems = check_config(cfg)
+    assert any("max_concurrent" in p for p in problems)
+    assert any("replay_byte_budget" in p for p in problems)
+    assert any("park_queue_cap" in p for p in problems)
+    assert any("chunk_msgs" in p for p in problems)
+    assert not check_config(BrokerConfig())
+
+
+def test_resume_counters_in_metrics_registry():
+    from emqx_tpu.metrics import METRICS
+
+    for name in ("session.resume.parked", "session.resume.busy",
+                 "session.replay.windows", "session.replay.messages"):
+        assert name in METRICS  # fixed slot => /metrics exposition
+
+
+def test_profiler_has_replay_read_stage():
+    from emqx_tpu.observability import Profiler
+
+    assert "replay_read" in Profiler.STAGES

@@ -59,6 +59,10 @@ SEAM_FUNCS: Tuple[Seam, ...] = (
     Seam("emqx_tpu/cluster_link.py", "LinkServer._on_publish",
          "cluster.link.forward"),
     Seam("emqx_tpu/s3.py", "S3Client._request", "s3.request"),
+    Seam("emqx_tpu/ds/persist.py", "DurableSessions._replay_read",
+         "ds.replay.read"),
+    Seam("emqx_tpu/broker/resume.py", "ResumeScheduler._commit",
+         "session.resume.commit"),
 )
 
 

@@ -49,16 +49,25 @@ class DispatchFn(NamedTuple):
 
 
 # the window fan-out hot loops: expansion/grouping, per-client
-# delivery (columns + scalar), the session's packet builder, and the
-# native-run fast path (decision scan + block bookkeeping)
+# delivery (columns + scalar), the session's packet builder, the
+# native-run fast path (decision scan + block bookkeeping), and the
+# durable-replay hot path (scheduler round + window build + the
+# scalar resume referee) — a mass reconnect drives these exactly as
+# hard as live fan-out drives the rest
 DISPATCH_FUNCS = (
     DispatchFn("emqx_tpu/broker/broker.py", "Broker._dispatch_window"),
     DispatchFn("emqx_tpu/broker/broker.py", "Broker._dispatch_columns"),
     DispatchFn("emqx_tpu/broker/broker.py", "Broker._dispatch_scalar"),
     DispatchFn("emqx_tpu/broker/broker.py", "Broker._deliver_run"),
+    DispatchFn("emqx_tpu/broker/broker.py", "Broker._resume_enqueue"),
     DispatchFn("emqx_tpu/broker/session.py", "Session.deliver"),
     DispatchFn("emqx_tpu/broker/session.py", "Session.deliver_run_native"),
     DispatchFn("emqx_tpu/broker/session.py", "Session.alloc_packet_ids"),
+    DispatchFn("emqx_tpu/broker/resume.py", "ResumeScheduler.drain_once"),
+    DispatchFn("emqx_tpu/broker/resume.py",
+               "ResumeScheduler._drain_window"),
+    DispatchFn("emqx_tpu/broker/resume.py",
+               "ResumeScheduler._append_run"),
 )
 
 # callee tails that mean "re-encode a wire frame"
