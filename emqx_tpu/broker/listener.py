@@ -346,6 +346,12 @@ class BrokerServer:
                     cl.get("heartbeat_interval", 0.5)
                 ),
                 down_after=float(cl.get("down_after", 2.0)),
+                # inter-node link layer: tcp (default) | quic | auto
+                # (QUIC preferred, graceful TCP degradation per peer)
+                transport_mode=cl.get("transport_mode", "tcp"),
+                quic_psk=str(cl.get("quic_psk", "")),
+                fwd_inflight_max=int(cl.get("fwd_inflight_max", 512)),
+                fwd_ack_timeout=float(cl.get("fwd_ack_timeout", 1.0)),
             )
             await self.cluster_node.start(seeds=[
                 (s[0], s[1], int(s[2])) for s in cl.get("seeds", ())

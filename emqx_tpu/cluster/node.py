@@ -20,11 +20,14 @@ engine:
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import logging
+import random
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Tuple
 
+from .. import failpoints
 from ..aio import cancel_and_wait
 from ..ds.replication import ReplicaStore, rendezvous_pick
 from ..message import Message
@@ -32,6 +35,46 @@ from .routes import ClusterRouteTable
 from .transport import NodeTransport, pack_bytes, unpack_bytes
 
 log = logging.getLogger("emqx_tpu.cluster")
+
+
+class _FwdFrame:
+    """One sequenced forward window held until the peer acks it."""
+
+    __slots__ = ("seq", "blob", "n", "max_qos", "spans", "sent_at",
+                 "retx")
+
+    def __init__(self, seq: int, blob: bytes, n: int, max_qos: int,
+                 spans) -> None:
+        self.seq = seq
+        self.blob = blob
+        self.n = n
+        self.max_qos = max_qos
+        self.spans = spans
+        self.sent_at: Optional[float] = None  # None = not sent yet
+        self.retx = 0
+
+
+class _FwdPeer:
+    """Per-peer sender state for at-least-once window forwarding:
+    monotonic frame sequence, bounded in-flight replay buffer, and
+    the failure-driven breaker (closed -> suspect -> open, probed
+    back closed — the PR 1 device-breaker shape on a peer link)."""
+
+    __slots__ = ("seq", "inflight", "fail_streak", "suspect",
+                 "breaker_open", "next_probe", "acked", "shed")
+
+    def __init__(self) -> None:
+        self.seq = 0
+        # seq -> _FwdFrame, insertion-ordered (seqs ascend), so the
+        # first entry is always the OLDEST unacked frame
+        self.inflight: "OrderedDict[int, _FwdFrame]" = OrderedDict()
+        self.fail_streak = 0
+        self.suspect = False
+        self.breaker_open = False
+        self.next_probe = 0.0
+        self.acked = 0  # frames confirmed (stats)
+        self.shed = 0   # messages dropped by overflow/departure (stats)
+
 
 
 def _props_to_wire(props: Dict[str, Any]) -> Dict[str, Any]:
@@ -144,6 +187,14 @@ class ClusterNode:
         raft_fsync: bool = True,
         sharded_routes: bool = False,
         role: str = "core",  # core | replicant
+        transport_mode: str = "tcp",  # tcp | quic | auto
+        quic_psk: str = "",
+        fwd_inflight_max: int = 512,
+        fwd_ack_timeout: float = 1.0,
+        fwd_backoff_max: float = 5.0,
+        fwd_suspect_threshold: int = 3,
+        fwd_breaker_threshold: int = 8,
+        fwd_probe_interval: float = 1.0,
     ) -> None:
         self.name = name
         self.broker = broker
@@ -165,8 +216,34 @@ class ClusterNode:
         self.raft_fsync = raft_fsync
         self.raft_conf = None
         self.raft_ds = None
-        self.transport = NodeTransport(name, bind, port)
+        # the inter-node link layer: TCP always listens; quic/auto
+        # additionally bind the QUIC UDP endpoint on the same port
+        # number and dial peers over it (auto degrades per peer to
+        # TCP on handshake failure and re-probes — see transport.py)
+        self.transport = NodeTransport(
+            name, bind, port,
+            transport_mode=transport_mode,
+            quic_psk=hashlib.sha256(
+                b"emqx_tpu-cluster-psk:" + quic_psk.encode()
+            ).digest(),
+        )
         self.routes = ClusterRouteTable()
+        # at-least-once window forwarding (lww/async mode; raft mode
+        # confirms through forward_sync instead): per-peer sequenced
+        # frames held in a bounded replay buffer until acked
+        self.fwd_inflight_max = fwd_inflight_max
+        self.fwd_ack_timeout = fwd_ack_timeout
+        self.fwd_backoff_max = fwd_backoff_max
+        self.fwd_suspect_threshold = fwd_suspect_threshold
+        self.fwd_breaker_threshold = fwd_breaker_threshold
+        self.fwd_probe_interval = fwd_probe_interval
+        self._fwd_out: Dict[str, _FwdPeer] = {}
+        # receiver dedup: origin -> [epoch, floor, seen-set]; a frame
+        # with seq <= floor or in seen is a retransmit duplicate —
+        # re-acked, never re-dispatched (at-least-once stays
+        # at-least-once, not duplicate-dispatch)
+        self._fwd_in: Dict[str, List] = {}
+        self._fwd_rng = random.Random(hash(name) & 0xFFFFFFFF)
         # sharded mode: the cluster's filter set is PARTITIONED by
         # rendezvous hash instead of fully replicated — each node
         # indexes ~1/N of it and publish windows scatter-gather
@@ -238,6 +315,7 @@ class ClusterNode:
         self.transport.on("ds_msgs", self._handle_ds_msgs)
         self.transport.on("ds_take", self._handle_ds_take)
         self.transport.on("forward_batch", self._handle_forward_batch)
+        self.transport.on("fwd_ack", self._handle_fwd_ack)
         # concurrent: this handler AWAITS a raft commit whose quorum
         # traffic may share the inbound connection — inline it would
         # deadlock-by-stall every failover window
@@ -323,6 +401,7 @@ class ClusterNode:
         self._tasks = [
             loop.create_task(self._flush_loop()),
             loop.create_task(self._heartbeat_loop()),
+            loop.create_task(self._fwd_retx_loop()),
         ]
         for name in list(self._peers):
             await self._sync_with(name)
@@ -1061,6 +1140,15 @@ class ClusterNode:
         task.add_done_callback(self._fwd_done)
         return task
 
+    def _fwd_done(self, task: asyncio.Task) -> None:
+        self._fwd_tasks.discard(task)
+        if not task.cancelled() and task.exception() is not None:
+            self.broker.metrics.inc("messages.forward.failed")
+            log.error(
+                "%s: forward task crashed", self.name,
+                exc_info=task.exception(),
+            )
+
     async def _forward_sync_drain(self, timeout: float = 5.0) -> None:
         """Raft-mode forward flush: each target must CONFIRM it
         committed the resulting DS entries; a dead target's window is
@@ -1291,53 +1379,444 @@ class ClusterNode:
             if len(self._pending_fwd[node]) >= self.flush_max:
                 self._flush_wakeup.set()
 
+    # -- sender side: sequenced frames, bounded replay buffer, breaker
+
+    def _fwd_state(self, node: str) -> _FwdPeer:
+        st = self._fwd_out.get(node)
+        if st is None:
+            st = self._fwd_out[node] = _FwdPeer()
+        return st
+
     async def _flush_forwards(self) -> None:
-        from .wire import encode_messages
+        """Flush buffered windows as ONE sequenced frame per peer.
+
+        Unlike the old fire-and-forget cast, each frame enters the
+        peer's in-flight replay buffer and stays there until the peer
+        acks its (epoch, seq) — link loss, a dead peer, or a dropped
+        datagram only delays it.  Overflow sheds QoS0-only frames
+        first (counted ``messages.forward.dropped``); an open breaker
+        parks frames for the probe loop instead of burning sends."""
+        from .wire import encode_window
 
         pending, self._pending_fwd = self._pending_fwd, {}
         loop = asyncio.get_running_loop()
         for node, msgs in pending.items():
-            blob = encode_messages(msgs)
-            task = loop.create_task(
-                self._forward_blob(node, blob, len(msgs),
-                                   _fwd_spans(msgs))
-            )
-            self._fwd_tasks.add(task)
-            task.add_done_callback(self._fwd_done)
+            st = self._fwd_state(node)
+            self._fwd_make_room(node, st)
+            st.seq += 1
+            seq = st.seq
+            max_qos = max((m.qos for m in msgs), default=0)
+            base = next(iter(st.inflight), seq)
+            blob = encode_window(self._epoch, seq, base, msgs)
+            frame = _FwdFrame(seq, blob, len(msgs), max_qos,
+                              _fwd_spans(msgs))
+            st.inflight[seq] = frame
+            if st.breaker_open:
+                continue  # the probe loop owns sends while open
+            self._spawn_frame_send(node, st, frame)
 
-    def _fwd_done(self, task: asyncio.Task) -> None:
+    def _fwd_make_room(self, node: str, st: _FwdPeer) -> None:
+        """Shed policy for a full replay buffer: QoS0-only frames go
+        first (their contract allows loss), then the oldest frame —
+        bounded memory beats an unbounded queue to a dead peer."""
+        while len(st.inflight) >= self.fwd_inflight_max:
+            victim = None
+            for frame in st.inflight.values():
+                if frame.max_qos == 0:
+                    victim = frame
+                    break
+            if victim is None:
+                victim = next(iter(st.inflight.values()))
+            del st.inflight[victim.seq]
+            self._fwd_shed(node, st, victim, "replay buffer overflow")
+
+    def _fwd_shed(self, node: str, st: _FwdPeer, frame: _FwdFrame,
+                  why: str) -> None:
+        st.shed += frame.n
+        self.broker.metrics.inc("messages.forward.dropped", frame.n)
+        if frame.spans:
+            for span in frame.spans:
+                span.end(False, why)
+        if frame.max_qos > 0:
+            log.warning(
+                "%s: shed QoS%d forward frame seq=%d (%d msgs) for "
+                "%s: %s", self.name, frame.max_qos, frame.seq,
+                frame.n, node, why,
+            )
+
+    def _spawn_frame_send(self, node: str, st: _FwdPeer,
+                          frame: _FwdFrame) -> None:
+        task = asyncio.get_running_loop().create_task(
+            self._send_frame(node, st, frame)
+        )
+        self._fwd_tasks.add(task)
+        task.add_done_callback(
+            lambda t, f=frame: self._fwd_send_done(t, f)
+        )
+
+    def _fwd_send_done(self, task: asyncio.Task,
+                       frame: _FwdFrame) -> None:
         self._fwd_tasks.discard(task)
         if not task.cancelled() and task.exception() is not None:
-            self.broker.metrics.inc("messages.forward.failed")
+            if frame.retx == 0:
+                # same units + same once-per-frame guard as the
+                # ok=False path in _send_frame: message count, first
+                # failure only
+                self.broker.metrics.inc(
+                    "messages.forward.failed", frame.n
+                )
             log.error(
-                "%s: forward task crashed", self.name, exc_info=task.exception()
+                "%s: forward task crashed", self.name,
+                exc_info=task.exception(),
+            )
+            # arm the retransmit timer: a send that died BEFORE the
+            # cast returned never set sent_at, and a None timestamp
+            # would park the frame forever
+            if frame.sent_at is None:
+                frame.sent_at = time.monotonic()
+            # the frame's spans CLOSE here (PR 8 invariant: a dropped
+            # leg still yields a closed span; PendingForward.end is
+            # once-only, so the frame's eventual retransmit-ack close
+            # becomes a no-op).  The frame itself stays in the replay
+            # buffer — a crashed send never loses the window.
+            if frame.spans:
+                for span in frame.spans:
+                    span.end(False, "forward task crashed")
+
+    async def _send_frame(self, node: str, st: _FwdPeer,
+                          frame: _FwdFrame) -> None:
+        if frame.seq not in st.inflight:
+            return  # acked or shed while this send was queued
+        ok = await self.transport.cast_bin(
+            node, "forward_batch", frame.blob
+        )
+        now = time.monotonic()
+        if ok:
+            # the ack timer starts at the SEND, so a lost ack is
+            # detected by the retx loop, not trusted forever
+            frame.sent_at = now
+            return
+        frame.sent_at = now  # failed send backs off like a lost ack
+        if frame.retx == 0:
+            # count each frame's messages failed ONCE — a breaker
+            # probe or retransmit failing again must not re-inflate
+            # the counter for messages that will still be delivered
+            # on recovery
+            self.broker.metrics.inc(
+                "messages.forward.failed", frame.n
+            )
+        self._fwd_failure(node, st)
+
+    def _fwd_failure(self, node: str, st: _FwdPeer) -> None:
+        """One delivery failure signal (failed send or ack timeout):
+        advances closed -> suspect -> open, the PR 1 breaker shape."""
+        st.fail_streak += 1
+        if not st.suspect and st.fail_streak >= \
+                self.fwd_suspect_threshold:
+            st.suspect = True
+            log.warning("%s: peer %s forward link SUSPECT after %d "
+                        "failures", self.name, node, st.fail_streak)
+        if not st.breaker_open and st.fail_streak >= \
+                self.fwd_breaker_threshold:
+            st.breaker_open = True
+            st.next_probe = time.monotonic() + self.fwd_probe_interval
+            self.broker.metrics.inc("cluster.forward.breaker.open")
+            self.broker.alarms.activate(
+                f"cluster_forward_breaker_{node}",
+                details={"peer": node,
+                         "unacked_frames": len(st.inflight),
+                         "failures": st.fail_streak},
+                message=f"forward breaker OPEN for peer {node}: "
+                        f"sends parked, probing every "
+                        f"{self.fwd_probe_interval}s",
+            )
+            log.warning(
+                "%s: forward breaker OPEN for %s (%d consecutive "
+                "failures, %d frames parked)", self.name, node,
+                st.fail_streak, len(st.inflight),
             )
 
-    async def _forward_blob(self, node: str, blob: bytes, n: int,
-                            spans=()) -> None:
-        ok = await self.transport.cast_bin(node, "forward_batch", blob)
-        if not ok:
-            self.broker.metrics.inc("messages.forward.failed", n)
-        for span in spans:
-            # async mode: the span closes at the handoff outcome (sent
-            # vs peer unreachable) — a dropped or timed-out leg still
-            # yields a CLOSED span on the publisher, never a leak
-            span.end(ok, "" if ok else "peer unreachable")
+    def _fwd_recover(self, node: str, st: _FwdPeer) -> None:
+        """An ack arrived: the link works — reset the failure ladder
+        and, if the breaker was open, re-close it and resume."""
+        st.fail_streak = 0
+        st.suspect = False
+        if st.breaker_open:
+            st.breaker_open = False
+            self.broker.alarms.deactivate(
+                f"cluster_forward_breaker_{node}"
+            )
+            log.info("%s: forward breaker for %s re-CLOSED; "
+                     "%d frames to replay", self.name, node,
+                     len(st.inflight))
+            if st.inflight:
+                self._spawn_resend(node, st)
+
+    async def _fwd_retx_loop(self) -> None:
+        """Retransmission driver: exponential backoff + jitter on the
+        oldest unacked frame's age; an OPEN breaker downgrades to a
+        slow single-frame probe (the background probe that re-closes
+        it, same shape as the PR 1 device breaker's)."""
+        tick = max(0.01, min(self.fwd_ack_timeout / 4, 0.05))
+        while True:
+            await asyncio.sleep(tick)
+            now = time.monotonic()
+            for node, st in list(self._fwd_out.items()):
+                if node not in self._peers:
+                    # departed peer: a retained buffer would leak
+                    # forever (forget_peer is the explicit path; this
+                    # is the defensive reap)
+                    self._reap_fwd_state(node)
+                    continue
+                if not st.inflight:
+                    continue
+                if st.breaker_open:
+                    if now >= st.next_probe:
+                        st.next_probe = now + self.fwd_probe_interval
+                        frame = next(iter(st.inflight.values()))
+                        frame.retx += 1
+                        self.broker.metrics.inc("messages.forward.retx")
+                        self._spawn_frame_send(node, st, frame)
+                    continue
+                oldest = next(iter(st.inflight.values()))
+                if oldest.sent_at is None:
+                    continue  # initial send still queued
+                backoff = min(
+                    self.fwd_ack_timeout * (2 ** min(oldest.retx, 6)),
+                    self.fwd_backoff_max,
+                )
+                # jitter: +-20%, so a mass-reconnect of peers does not
+                # synchronize its retransmit bursts
+                backoff *= 0.8 + 0.4 * self._fwd_rng.random()
+                if now - oldest.sent_at < backoff:
+                    continue
+                self._fwd_failure(node, st)
+                if st.breaker_open:
+                    continue
+                ts_ns = time.time_ns()
+                for frame in st.inflight.values():
+                    frame.retx += 1
+                    if frame.spans:
+                        for span in frame.spans:
+                            span.span["events"].append({
+                                "name": "forward.retransmit",
+                                "ts_ns": ts_ns,
+                                "attrs": {"retx": frame.retx,
+                                          "seq": frame.seq},
+                            })
+                self.broker.metrics.inc("messages.forward.retx",
+                                        len(st.inflight))
+                self._spawn_resend(node, st)
+
+    def _spawn_resend(self, node: str, st: _FwdPeer) -> None:
+        task = asyncio.get_running_loop().create_task(
+            self._resend_unacked(node, st)
+        )
+        self._fwd_tasks.add(task)
+        task.add_done_callback(self._fwd_done)  # crash = logged
+
+    async def _resend_unacked(self, node: str, st: _FwdPeer) -> None:
+        """Retransmit every unacked frame in seq order (the receiver's
+        dedup window absorbs any that actually arrived)."""
+        for seq in list(st.inflight):
+            frame = st.inflight.get(seq)
+            if frame is None:
+                continue  # acked while we were resending
+            ok = await self.transport.cast_bin(
+                node, "forward_batch", frame.blob
+            )
+            frame.sent_at = time.monotonic()
+            if not ok:
+                self._fwd_failure(node, st)
+                return  # link is down; backoff/breaker takes over
+
+    async def _handle_fwd_ack(self, peer: str, obj: Dict) -> None:
+        """Ack from a forward target: release the frames, close their
+        spans with the measured ack latency, and reset the peer's
+        failure ladder (re-closing an open breaker)."""
+        if obj.get("epoch") != self._epoch:
+            return  # ack for a previous incarnation's stream
+        node = obj.get("node", peer)
+        st = self._fwd_out.get(node)
+        if st is None:
+            return
+        now = time.monotonic()
+        ts_ns = time.time_ns()
+        for seq in obj.get("seqs", ()):
+            frame = st.inflight.pop(seq, None)
+            if frame is None:
+                continue  # re-ack of an already-released frame
+            st.acked += 1
+            if frame.spans:
+                ack_ms = (
+                    round((now - frame.sent_at) * 1000.0, 3)
+                    if frame.sent_at is not None else 0.0
+                )
+                for span in frame.spans:
+                    span.span["events"].append({
+                        "name": "forward.acked",
+                        "ts_ns": ts_ns,
+                        "attrs": {"ack_ms": ack_ms,
+                                  "retx": frame.retx},
+                    })
+                    span.span["attrs"]["ack_ms"] = ack_ms
+                    span.span["attrs"]["retx"] = frame.retx
+                    span.end(True)
+        self._fwd_recover(node, st)
+
+    def _reap_fwd_state(self, node: str) -> None:
+        """Drop ALL forward state for a departed peer: pending
+        buffers, the replay buffer (shed + counted), receiver dedup
+        state, and any open breaker alarm."""
+        pending = self._pending_fwd.pop(node, None)
+        if pending:
+            self.broker.metrics.inc(
+                "messages.forward.dropped", len(pending)
+            )
+            for span in _fwd_spans(pending):
+                span.end(False, "peer removed")
+        st = self._fwd_out.pop(node, None)
+        if st is not None:
+            for frame in list(st.inflight.values()):
+                self._fwd_shed(node, st, frame, "peer removed")
+            st.inflight.clear()
+            if st.breaker_open:
+                self.broker.alarms.deactivate(
+                    f"cluster_forward_breaker_{node}"
+                )
+        self._fwd_in.pop(node, None)
+
+    def forget_peer(self, node: str) -> None:
+        """Remove a peer from membership PERMANENTLY (it left the
+        cluster, as opposed to ``_node_down``'s it-may-return): its
+        routes, client claims, links, and every forward buffer are
+        reaped — a departed peer must not retain replay state
+        forever."""
+        if node in self._peers or node in self._fwd_out \
+                or node in self._pending_fwd:
+            self._peers.pop(node, None)
+            self._peer_roles.pop(node, None)
+            self._last_seen.pop(node, None)
+            self._down.discard(node)
+            self._synced.discard(node)
+            self.routes.purge_node(node)
+            for cid, n in list(self.clients.items()):
+                if n == node:
+                    del self.clients[cid]
+            self.transport.drop_peer(node)
+            self._reap_fwd_state(node)
+            log.info("%s: peer %s removed from membership", self.name,
+                     node)
+
+    def forward_stats(self) -> Dict[str, Any]:
+        """Reliability-layer introspection (mgmt/ctl surfaces)."""
+        peers = {}
+        for node, st in self._fwd_out.items():
+            peers[node] = {
+                "unacked_frames": len(st.inflight),
+                "unacked_msgs": sum(
+                    f.n for f in st.inflight.values()
+                ),
+                "next_seq": st.seq + 1,
+                "acked_frames": st.acked,
+                "shed_msgs": st.shed,
+                "fail_streak": st.fail_streak,
+                "breaker": (
+                    "open" if st.breaker_open
+                    else "suspect" if st.suspect else "closed"
+                ),
+            }
+        return {
+            "mode": self.transport.transport_mode,
+            "quic_demotions": self.transport.stats["quic_demotions"],
+            "peers": peers,
+        }
+
+    # -- receiver side: dedup window + ack
 
     async def _handle_forward_batch(self, peer: str, obj: Dict) -> None:
-        from .wire import decode_messages
+        from .wire import decode_window
 
         try:
-            msgs = decode_messages(obj["_bin"])
+            epoch, seq, base, _max_qos, msgs = decode_window(
+                obj["_bin"]
+            )
         except Exception:
             # a malformed frame must not crash the serve loop
             log.exception("undecodable forward batch from %s", peer)
             return
-        self.broker.metrics.inc("messages.forward.received", len(msgs))
-        # dispatch-only: hooks/retain/rules already ran on the origin
-        # node (the reference's forward lands in dispatch/2 directly,
-        # emqx_broker.erl:408-420); one batched match step per frame
-        self.broker.dispatch_forwarded_many(msgs)
+        st = self._fwd_in.get(peer)
+        if st is not None and epoch < st[0]:
+            # reordered straggler from the origin's PREVIOUS
+            # incarnation: resetting on it would wipe the live
+            # epoch's dedup state (re-dispatching every in-flight
+            # retransmit) — drop it, un-acked; that sender is gone
+            return
+        if st is None or epoch > st[0]:
+            # first frame, or the origin restarted (newer epoch):
+            # fresh dedup window — the old incarnation's seqs are
+            # garbage
+            st = self._fwd_in[peer] = [epoch, 0, set()]
+        if base - 1 > st[1]:
+            # the origin will never (re)send below `base`: holes left
+            # by its overflow shedding must not wedge the floor
+            st[1] = base - 1
+            floor = st[1]
+            st[2] = {s for s in st[2] if s > floor}
+        if seq <= st[1] or seq in st[2]:
+            # retransmit duplicate: the ack the origin missed is
+            # re-sent, the window is NOT re-dispatched
+            self.broker.metrics.inc("messages.forward.dup", len(msgs))
+        elif len(st[2]) >= 65536 and seq != st[1] + 1:
+            # pathological reordering bound: REFUSE the frame (no
+            # dispatch, no ack, no state) instead of force-advancing
+            # the floor — a forced floor would ack the gap frames
+            # below it as "duplicates" without ever dispatching them,
+            # which is silent QoS>=1 loss.  Unacked, the origin
+            # retransmits (lowest seq first), the gaps fill, and the
+            # floor advances through the contiguity walk — bounded
+            # memory without breaking at-least-once.  The gap frame
+            # itself (seq == floor+1) is ALWAYS admitted: it advances
+            # the floor immediately and drains the set, so refusal
+            # can't wedge the stream it is protecting.
+            log.warning(
+                "%s: forward dedup window for %s at capacity "
+                "(floor=%d); refusing seq=%d until gaps fill",
+                self.name, peer, st[1], seq,
+            )
+            return
+        else:
+            self.broker.metrics.inc(
+                "messages.forward.received", len(msgs)
+            )
+            # dispatch-only: hooks/retain/rules already ran on the
+            # origin node (the reference's forward lands in dispatch/2
+            # directly, emqx_broker.erl:408-420); one batched match
+            # step per frame
+            self.broker.dispatch_forwarded_many(msgs)
+            st[2].add(seq)
+            while st[1] + 1 in st[2]:
+                st[1] += 1
+                st[2].discard(st[1])
+        await self._send_fwd_ack(peer, epoch, [seq])
+
+    async def _send_fwd_ack(self, peer: str, epoch: int,
+                            seqs: List[int]) -> None:
+        """Ack path seam: ``drop``/``error`` lose the ack — the
+        origin retransmits and the dedup window absorbs the
+        duplicate, which is exactly the at-least-once contract."""
+        try:
+            act = await failpoints.evaluate_async(
+                "cluster.forward.ack", key=f"{self.name}->{peer}"
+            )
+        except failpoints.FailpointError:
+            return
+        if act == "drop":
+            return
+        await self.transport.cast(peer, {
+            "type": "fwd_ack", "node": self.name,
+            "epoch": epoch, "seqs": seqs,
+        })
 
     # ----------------------------------------------------- membership
 
@@ -1385,6 +1864,15 @@ class ClusterNode:
         if came_back:
             log.info("%s: node %s is back, resyncing routes", self.name, node)
             await self._sync_with(node)
+            # unacked forwarded windows replay NOW: the restarted (or
+            # re-reachable) peer gets every frame it never acked —
+            # the reconnect half of at-least-once forwarding
+            st = self._fwd_out.get(node)
+            if st is not None and st.inflight:
+                if st.breaker_open:
+                    st.next_probe = 0.0  # probe on the next tick
+                else:
+                    self._spawn_resend(node, st)
 
     async def _handle_conn_count(self, peer: str, obj: Dict) -> Dict:
         """Live connection census for the rebalance planner."""
@@ -1488,4 +1976,5 @@ class ClusterNode:
             "alive": sorted(self.peers_alive()),
             "down": sorted(self._down),
             "routes": len(self.routes),
+            "forward": self.forward_stats(),
         }

@@ -1,0 +1,668 @@
+"""QUIC peer transport for the inter-node RPC plane.
+
+The window-forwarding hot path moves bulk frames between nodes; over
+the stock TCP links a single lost segment head-of-line-blocks every
+frame behind it until the kernel retransmit timer fires.  This module
+carries the SAME length-prefixed frames (transport.py's formats) over
+the in-repo QUIC stack instead: loss is handled by the selective-ACK /
+PTO machinery in ``quic/recovery.py`` — a 1% lossy link retransmits
+exactly the missing ranges while later frames keep flowing.
+
+Topology: ONE QUIC connection per peer pair, two client-initiated
+bidirectional streams —
+
+  * stream 0 (control): hello/hello_ack handshake, JSON casts, calls
+    and their replies;
+  * stream 4 (forward): binary ``forward_batch`` window frames, so a
+    fat retransmitting window never stalls control traffic.
+
+Protection is the PSK cluster profile (`quic.connection.PskKeys`):
+integrity-authenticated plaintext keyed by the shared cluster secret —
+the same trust model as the plaintext TCP inter-node transport, and
+deliberately free of the `cryptography` dependency so the transport
+runs everywhere the broker does.
+
+The server side (`QuicPeerEndpoint`) binds UDP on the SAME port number
+as the TCP listener: membership keeps one (host, port) per peer for
+both transports.  The application-level handshake is the hello frame:
+the dialer sends it on the control stream and waits for ``hello_ack``
+— `transport_mode=auto` treats a handshake timeout as "QUIC
+unavailable" and degrades that peer to the TCP PeerLink (transport.py
+owns the demotion/re-probe policy).
+
+Failpoint seams (chaos tests inject loss AT DATAGRAM GRANULARITY, so
+the QUIC recovery path is what gets exercised):
+
+  * ``cluster.quic.send`` — every outbound datagram, keyed
+    ``self->peer``; drop = the network ate it;
+  * ``cluster.quic.recv`` — every inbound datagram, keyed
+    ``peer_addr->self``; error resets the connection like a decrypt
+    failure storm would.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .. import failpoints
+from ..aio import cancel_and_wait
+from .transport import (
+    NO_REPLY, PROTO_VER, _pack_bin, _pack_json, drain_frames,
+)
+
+log = logging.getLogger("emqx_tpu.cluster.quic")
+
+CTL_STREAM = 0
+FWD_STREAM = 4
+
+# PTO probe cadence: the ack-threshold path recovers mid-flight loss
+# without waiting for this; the timer only covers tail loss (the last
+# datagrams of a burst with nothing behind them to trigger acks).
+# Fixed rather than smoothed-RTT-based (RFC 9002) — the same honest
+# loopback/LAN scope cut as quic/connection.py's fixed congestion
+# window; both driver loops throttle probes on rx/probe recency so a
+# link whose RTT flirts with the timer degrades to duplicates, not a
+# retransmit storm.
+_PTO = 0.1
+
+# a link with data in flight that has heard NOTHING for this long is
+# declared dead: the connection tears down (degraded), so auto mode's
+# next send demotes to TCP and hard quic mode redials fresh — without
+# this, an established link to a blackholed peer would keep buffering
+# heartbeats and PTO-spraying a dead address forever, because sends
+# into a UDP void "succeed"
+_DEAF_AFTER = 3.0
+
+
+def _make_conn(is_server: bool, psk: bytes, cid: Optional[bytes] = None):
+    from ..quic.connection import QuicConnection
+
+    return QuicConnection(is_server, psk=psk or b"\x00" * 16, cid=cid)
+
+
+def _send_datagrams(conn, sendto, key: str) -> None:
+    """The shared datagram-egress loop (link + endpoint sides): every
+    outbound datagram passes the ``cluster.quic.send`` seam — drop and
+    error both lose the datagram (QUIC recovery resends), duplicate
+    sends it twice — and OSError is swallowed (datagram loss, same
+    recovery)."""
+    for dgram in conn.datagrams_to_send():
+        if failpoints.enabled:
+            try:
+                act = failpoints.evaluate("cluster.quic.send", key=key)
+            except failpoints.FailpointError:
+                continue  # an errored send loses the datagram too
+            if act == "drop":
+                continue  # the network ate it; recovery resends
+            if act == "duplicate":
+                sendto(dgram)
+        try:
+            sendto(dgram)
+        except OSError:
+            pass  # datagram loss; QUIC recovery covers it
+
+
+class QuicPeerLink:
+    """One outgoing QUIC connection to a peer: the PeerLink-shaped
+    API (`cast`/`cast_bin`/`call`/`close`) over a connected UDP
+    socket.  ``degraded`` is True after a handshake failure — the
+    auto-mode router reads it to decide TCP fallback."""
+
+    def __init__(
+        self,
+        self_node: str,
+        peer_node: str,
+        addr: Tuple[str, int],
+        psk: bytes = b"",
+        connect_timeout: float = 1.0,
+    ) -> None:
+        self.self_node = self_node
+        self.peer_node = peer_node
+        self.addr = addr
+        self.psk = psk
+        self.connect_timeout = connect_timeout
+        self.degraded = False
+        self._conn = None
+        self._transport = None
+        self._lock = asyncio.Lock()
+        self._calls: Dict[int, asyncio.Future] = {}
+        self._call_seq = 0
+        self._bufs: Dict[int, bytearray] = {}
+        self._hello_ok = asyncio.Event()
+        self._pto_task: Optional[asyncio.Task] = None
+        self._last_rx = 0.0
+        self._last_pto = 0.0
+        self._deadline = 0.0  # handshake deadline (persists per dial)
+
+    # ------------------------------------------------------- connect
+
+    async def probe(self) -> None:
+        """Dial + application handshake, raising on failure — the
+        transport's background re-promotion probe."""
+        await self._ensure()
+
+    async def _ensure(self) -> None:
+        if self._conn is not None and not self._conn.closed \
+                and self._hello_ok.is_set():
+            return
+        if self.degraded:
+            # a failed handshake marks the OBJECT dead: waiters queued
+            # behind the failing dial fail fast instead of each paying
+            # the full timeout (the router re-probes with a fresh link)
+            raise ConnectionError(
+                f"quic link to {self.peer_node} degraded"
+            )
+        if self._conn is not None and not self._conn.closed:
+            # a cancelled earlier dial left the handshake pending:
+            # fall through to the wait loop below with a fresh deadline
+            conn = self._conn
+        else:
+            conn = None
+        if conn is None:
+            await self._dial()
+            conn = self._conn
+        loop = asyncio.get_running_loop()
+        # the handshake deadline lives on the LINK, not the call: a
+        # caller with a tighter bound (heartbeat wait_for) may cancel
+        # out of the wait, but the clock keeps running — the next call
+        # resumes the SAME handshake and fails it on time, so a
+        # blackholed peer still demotes even when every individual
+        # caller gives up early
+        deadline = self._deadline
+        try:
+            while not self._hello_ok.is_set():
+                if loop.time() > deadline:
+                    raise ConnectionError(
+                        f"quic handshake with {self.peer_node} "
+                        f"({self.addr}) timed out"
+                    )
+                try:
+                    await asyncio.wait_for(
+                        self._hello_ok.wait(),
+                        min(0.05, self.connect_timeout),
+                    )
+                except asyncio.TimeoutError:
+                    conn.on_timeout()  # re-probe the hello flight
+                    self._transmit()
+        except ConnectionError:
+            self.degraded = True
+            self._teardown()
+            raise
+        self.degraded = False
+        if self._pto_task is None:
+            self._pto_task = loop.create_task(self._pto_loop())
+
+    async def _dial(self) -> None:
+        self._teardown()
+        loop = asyncio.get_running_loop()
+        conn = _make_conn(False, self.psk, cid=os.urandom(8))
+        outer = self
+
+        class _Proto(asyncio.DatagramProtocol):
+            def datagram_received(self, data: bytes, addr) -> None:
+                outer._on_datagram(data)
+
+            def error_received(self, exc) -> None:
+                pass  # ICMP unreachable: the handshake timeout decides
+
+        try:
+            self._transport, _ = await loop.create_datagram_endpoint(
+                lambda: _Proto(), remote_addr=self.addr
+            )
+        except OSError as exc:
+            raise ConnectionError(
+                f"quic dial to {self.addr} failed: {exc}"
+            ) from exc
+        self._conn = conn
+        self._hello_ok.clear()
+        self._deadline = loop.time() + self.connect_timeout
+        # application handshake: hello on the control stream; _ensure
+        # waits for the endpoint's hello_ack (loss of either flight is
+        # covered by PTO-shaped probes, bounded by the timeout)
+        conn.send_stream(CTL_STREAM, _pack_json({
+            "type": "hello", "node": self.self_node,
+            "ver": list(PROTO_VER),
+        }))
+        self._transmit()
+
+    def _teardown(self) -> None:
+        if self._pto_task is not None:
+            self._pto_task.cancel()
+            self._pto_task = None
+        if self._conn is not None and not self._conn.closed:
+            self._conn.close(0)
+            self._transmit()
+        if self._transport is not None:
+            self._transport.close()
+            self._transport = None
+        self._conn = None
+        self._bufs.clear()
+        for fut in self._calls.values():
+            if not fut.done():
+                fut.set_exception(ConnectionError("quic link lost"))
+        self._calls.clear()
+
+    def close(self) -> None:
+        self._teardown()
+
+    # ---------------------------------------------------------- IO
+
+    def _transmit(self) -> None:
+        if self._transport is None or self._conn is None:
+            return
+        _send_datagrams(
+            self._conn, self._transport.sendto,
+            f"{self.self_node}->{self.peer_node}",
+        )
+
+    def _on_datagram(self, data: bytes) -> None:
+        conn = self._conn
+        if conn is None:
+            return
+        if failpoints.enabled:
+            try:
+                act = failpoints.evaluate(
+                    "cluster.quic.recv",
+                    key=f"{self.peer_node}->{self.self_node}",
+                )
+            except failpoints.FailpointError:
+                conn.close(0)  # reset like a poisoned connection
+                return
+            if act == "drop":
+                return
+        self._last_rx = time.monotonic()
+        conn.receive_datagram(data)
+        try:
+            self._drain_events(conn)
+        except ConnectionError:
+            log.warning("quic link %s->%s: malformed frame; resetting",
+                        self.self_node, self.peer_node)
+            conn.close(0)
+        if conn.closed:
+            # the peer reset us (endpoint restart / wedge reset):
+            # pending calls fail NOW; the next send redials fresh
+            for fut in self._calls.values():
+                if not fut.done():
+                    fut.set_exception(
+                        ConnectionError("quic link reset by peer")
+                    )
+            self._calls.clear()
+        self._transmit()
+
+    def _drain_events(self, conn) -> None:
+        for ev in conn.events():
+            if ev[0] != "stream":
+                continue
+            _, sid, data, _fin = ev
+            buf = self._bufs.setdefault(sid, bytearray())
+            buf += data
+            for obj in drain_frames(buf):
+                self._on_frame(obj)
+
+    def _on_frame(self, obj: Dict[str, Any]) -> None:
+        mtype = obj.get("type")
+        if mtype == "hello_ack":
+            ver = tuple(obj.get("ver", ()))
+            if ver and ver[0] == PROTO_VER[0]:
+                self._hello_ok.set()
+            return
+        if mtype == "reply":
+            fut = self._calls.pop(obj.get("call_id"), None)
+            if fut is not None and not fut.done():
+                fut.set_result(obj.get("result"))
+
+    async def _pto_loop(self) -> None:
+        # tick at half the PTO: ack-frequency tails flush BEFORE the
+        # peer's probe timer can fire on already-delivered data
+        while True:
+            await asyncio.sleep(_PTO / 2)
+            conn = self._conn
+            if conn is None or conn.closed:
+                return
+            conn.ack_flush()
+            self._transmit()
+            now = time.monotonic()
+            if not conn.has_inflight():
+                continue
+            if now - self._last_rx > _DEAF_AFTER:
+                # data in flight, nothing heard for _DEAF_AFTER: the
+                # peer is blackholed.  Sends into a UDP void look
+                # successful, so WE must fail the link: degraded makes
+                # the next cast fail -> auto demotes to TCP / quic
+                # redials; the frame replay buffer re-delivers
+                log.warning(
+                    "quic link %s->%s: no acks for %.1fs with data "
+                    "in flight; tearing down",
+                    self.self_node, self.peer_node, _DEAF_AFTER,
+                )
+                self.degraded = True
+                self._teardown()
+                return
+            # probe only when the link has gone quiet — an active ack
+            # stream does threshold recovery on its own, and a probe
+            # then would just spray duplicates
+            if now - max(self._last_rx, self._last_pto) >= _PTO:
+                self._last_pto = now
+                conn.on_timeout()
+                self._transmit()
+
+    # --------------------------------------------------------- sends
+
+    async def cast(self, obj: Dict[str, Any]) -> bool:
+        # per-peer FIFO: same ordered-send contract as the TCP
+        # PeerLink (route-op streams ride this)
+        # brokerlint: ignore[ASYNC103]
+        async with self._lock:
+            try:
+                await self._ensure()
+                self._conn.send_stream(CTL_STREAM, _pack_json(obj))
+                self._transmit()
+                return True
+            except (ConnectionError, OSError):
+                self._teardown()
+                return False
+
+    async def cast_bin(self, mtype: str, payload: bytes) -> bool:
+        """Binary frames ride the dedicated forward stream: a lossy
+        retransmitting window cannot head-of-line-block control
+        frames (acks, heartbeats, route ops)."""
+        # brokerlint: ignore[ASYNC103]
+        async with self._lock:
+            try:
+                await self._ensure()
+                self._conn.send_stream(
+                    FWD_STREAM, _pack_bin(mtype, payload)
+                )
+                self._transmit()
+                return True
+            except (ConnectionError, OSError):
+                self._teardown()
+                return False
+
+    async def call(
+        self, obj: Dict[str, Any], timeout: float = 5.0
+    ) -> Optional[Dict[str, Any]]:
+        # brokerlint: ignore[ASYNC103]
+        async with self._lock:
+            try:
+                await self._ensure()
+                self._call_seq += 1
+                cid = self._call_seq
+                obj = dict(obj, call_id=cid)
+                fut: asyncio.Future = (
+                    asyncio.get_running_loop().create_future()
+                )
+                self._calls[cid] = fut
+                self._conn.send_stream(CTL_STREAM, _pack_json(obj))
+                self._transmit()
+            except (ConnectionError, OSError):
+                self._teardown()
+                return None
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except (asyncio.TimeoutError, ConnectionError):
+            return None
+
+
+class _InboundQuic:
+    """One accepted peer connection on the endpoint: stream
+    reassembly, hello handling, and a serial dispatch pump (the
+    per-peer FIFO the TCP serve loop provides naturally)."""
+
+    def __init__(self, endpoint: "QuicPeerEndpoint", conn, addr) -> None:
+        self.endpoint = endpoint
+        self.conn = conn
+        self.addr = addr
+        self.peer = "?"
+        self.created = time.monotonic()
+        self.hello_seen = False
+        self._stash: List[Tuple[int, Dict]] = []  # frames before hello
+        self._bufs: Dict[int, bytearray] = {}
+        self._queue: "asyncio.Queue[Tuple[int, Dict]]" = asyncio.Queue()
+        self._pump = asyncio.get_running_loop().create_task(
+            self._serve()
+        )
+        self.last_rx = time.monotonic()
+        self.last_pto = 0.0
+
+    def feed(self, data: bytes) -> None:
+        self.last_rx = time.monotonic()
+        self.conn.receive_datagram(data)
+        try:
+            for ev in self.conn.events():
+                if ev[0] != "stream":
+                    continue
+                _, sid, payload, _fin = ev
+                buf = self._bufs.setdefault(sid, bytearray())
+                buf += payload
+                for obj in drain_frames(buf):
+                    self._on_frame(sid, obj)
+        except ConnectionError:
+            log.warning("quic endpoint: malformed frame from %s; "
+                        "resetting", self.peer)
+            self.conn.close(0)
+        self.endpoint.transmit(self)
+
+    def _on_frame(self, sid: int, obj: Dict) -> None:
+        if not self.hello_seen:
+            if obj.get("type") != "hello":
+                # streams are independent: a forward frame can land
+                # before the control stream's hello — hold it
+                self._stash.append((sid, obj))
+                return
+            ver = tuple(obj.get("ver", ()))
+            if not ver or ver[0] != PROTO_VER[0]:
+                log.warning(
+                    "rejecting quic peer %s: proto %s != %s",
+                    obj.get("node"), ver, PROTO_VER,
+                )
+                self.conn.close(0)
+                return
+            self.peer = obj.get("node", "?")
+            self.hello_seen = True
+            self.conn.send_stream(sid, _pack_json({
+                "type": "hello_ack", "node": self.endpoint.node,
+                "ver": list(PROTO_VER),
+            }))
+            for pending in self._stash:
+                self._queue.put_nowait(pending)
+            self._stash.clear()
+            return
+        self._queue.put_nowait((sid, obj))
+
+    async def _serve(self) -> None:
+        while True:
+            sid, obj = await self._queue.get()
+            try:
+                await self.endpoint.transport._dispatch_frame(
+                    self.peer, obj, _QuicReplyWriter(self, sid)
+                )
+            except asyncio.CancelledError:
+                raise
+            except ConnectionError:
+                # the TCP serve loop's semantic: a handler-leaked
+                # ConnectionError drops the CONNECTION — close it
+                # (the CLOSE reaches the dialer, which redials and
+                # replays) instead of dying silently while the conn
+                # keeps acking frames nobody will ever dispatch
+                log.warning(
+                    "quic handler %r from %s raised ConnectionError; "
+                    "resetting the connection",
+                    obj.get("type"), self.peer,
+                )
+                self.conn.close(0)
+                self.endpoint.transmit(self)
+                return
+            except Exception:
+                log.exception(
+                    "quic handler %r from %s crashed",
+                    obj.get("type"), self.peer,
+                )
+
+    def close(self) -> None:
+        if self._pump is not None:
+            self._pump.cancel()
+            self._pump = None
+        if not self.conn.closed:
+            self.conn.close(0)
+
+
+class _QuicReplyWriter:
+    """StreamWriter-shaped adapter: call replies go back on the
+    stream that carried the call."""
+
+    __slots__ = ("inbound", "sid")
+
+    def __init__(self, inbound: _InboundQuic, sid: int) -> None:
+        self.inbound = inbound
+        self.sid = sid
+
+    def write(self, data: bytes) -> None:
+        self.inbound.conn.send_stream(self.sid, data)
+
+    async def drain(self) -> None:
+        self.inbound.endpoint.transmit(self.inbound)
+
+    def is_closing(self) -> bool:
+        return self.inbound.conn.closed
+
+    def close(self) -> None:
+        pass
+
+
+class QuicPeerEndpoint:
+    """The node's QUIC server side: one UDP socket (same port number
+    as the TCP listener), connections demuxed by the symmetric 8-byte
+    connection id of the PSK profile."""
+
+    IDLE_TIMEOUT = 60.0
+    # a connection that has not completed the hello within this window
+    # is RESET.  This is not just handshake hygiene: when an endpoint
+    # conn dies (recv fault, endpoint restart) while the dialer's side
+    # survives, the dialer keeps sending MID-STREAM offsets under the
+    # same cid — the fresh endpoint conn can never reassemble from
+    # offset 0, so its hello never completes.  The reset's CLOSE frame
+    # reaches the dialer, whose next send redials a fresh connection
+    # (offset-0 streams, new hello) and the frame-level replay buffer
+    # re-delivers everything unacked.
+    HELLO_DEADLINE = 2.0
+
+    def __init__(self, transport, bind: str, port: int,
+                 psk: bytes = b"") -> None:
+        self.transport = transport  # the owning NodeTransport
+        self.node = transport.node
+        self.bind = bind
+        self.port = port
+        self.psk = psk
+        self._udp = None
+        self._by_cid: Dict[bytes, _InboundQuic] = {}
+        self._pto_task: Optional[asyncio.Task] = None
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        outer = self
+
+        class _Proto(asyncio.DatagramProtocol):
+            def datagram_received(self, data: bytes, addr) -> None:
+                outer.on_datagram(data, addr)
+
+        self._udp, _ = await loop.create_datagram_endpoint(
+            lambda: _Proto(), local_addr=(self.bind, self.port)
+        )
+        self._pto_task = loop.create_task(self._pto_loop())
+        log.info("quic peer endpoint on %s:%d (udp)", self.bind,
+                 self.port)
+
+    async def stop(self) -> None:
+        if self._pto_task is not None:
+            await cancel_and_wait(self._pto_task)
+            self._pto_task = None
+        for inbound in list(self._by_cid.values()):
+            inbound.close()
+            self.transmit(inbound)
+        self._by_cid.clear()
+        if self._udp is not None:
+            self._udp.close()
+            self._udp = None
+
+    def on_datagram(self, data: bytes, addr) -> None:
+        if len(data) < 9 or data[0] & 0x80:
+            return  # PSK profile peers speak short headers only
+        if failpoints.enabled:
+            try:
+                act = failpoints.evaluate(
+                    "cluster.quic.recv", key=f"{addr[0]}->{self.node}"
+                )
+            except failpoints.FailpointError:
+                # reset whichever connection this datagram belonged to
+                inbound = self._by_cid.pop(bytes(data[1:9]), None)
+                if inbound is not None:
+                    inbound.close()
+                return
+            if act == "drop":
+                return
+        cid = bytes(data[1:9])
+        inbound = self._by_cid.get(cid)
+        if inbound is None:
+            conn = _make_conn(True, self.psk, cid=cid)
+            inbound = self._by_cid[cid] = _InboundQuic(
+                self, conn, addr
+            )
+        inbound.addr = addr
+        inbound.feed(data)
+
+    def transmit(self, inbound: _InboundQuic) -> None:
+        if self._udp is None:
+            return
+        udp, addr = self._udp, inbound.addr
+        _send_datagrams(
+            inbound.conn,
+            lambda dgram: udp.sendto(dgram, addr),
+            f"{self.node}->{inbound.peer}",
+        )
+
+    async def _pto_loop(self) -> None:
+        while True:
+            await asyncio.sleep(_PTO / 2)
+            now = time.monotonic()
+            for cid, inbound in list(self._by_cid.items()):
+                if inbound.conn.closed:
+                    inbound.close()
+                    del self._by_cid[cid]
+                    continue
+                if now - inbound.last_rx > self.IDLE_TIMEOUT:
+                    # transmit the CLOSE (like the deadline/stop
+                    # paths): an un-notified dialer would keep
+                    # sending into a cid that can no longer
+                    # reassemble until the wedge reset catches it
+                    inbound.conn.close(0)
+                    self.transmit(inbound)
+                    inbound.close()
+                    del self._by_cid[cid]
+                    continue
+                if not inbound.hello_seen and (
+                    now - inbound.created > self.HELLO_DEADLINE
+                ):
+                    # wedged half-connection (see HELLO_DEADLINE):
+                    # reset it so the dialer redials from offset 0
+                    inbound.conn.close(0)
+                    self.transmit(inbound)
+                    inbound.close()
+                    del self._by_cid[cid]
+                    continue
+                inbound.conn.ack_flush()
+                self.transmit(inbound)
+                # same quiet-link throttle as the dialer side: probe
+                # only when neither rx nor a recent probe is fresher
+                # than one PTO (a ~PTO-RTT link degrades to the odd
+                # duplicate, not a per-tick full-backlog retransmit)
+                if inbound.conn.has_inflight() and now - max(
+                    inbound.last_rx, inbound.last_pto
+                ) >= _PTO:
+                    inbound.last_pto = now
+                    inbound.conn.on_timeout()
+                    self.transmit(inbound)

@@ -18,7 +18,7 @@ import asyncio
 import base64
 import json
 import logging
-from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
+from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 
 from .. import failpoints
 
@@ -194,6 +194,60 @@ class PeerLink:
         self._drop()
 
 
+MAX_FRAME = 64 * 1024 * 1024
+
+
+def parse_frame(data: bytes) -> Dict:
+    """Parse one frame body (the bytes after the length prefix).
+
+    EVERY malformed-frame failure normalizes to ``ConnectionError`` —
+    a peer feeding garbage (zero-length body, truncated binary header,
+    undecodable type, broken JSON) is treated exactly like a peer that
+    dropped the connection: the serve loop survives and resets the
+    link instead of crashing on a stray IndexError/UnicodeDecodeError.
+    """
+    try:
+        fmt = data[0]
+        if fmt == _F_JSON:
+            obj = json.loads(data[1:])
+            if not isinstance(obj, dict):
+                raise ConnectionError("non-object JSON cluster frame")
+            return obj
+        if fmt == _F_BIN:
+            tlen = data[1]
+            if 2 + tlen > len(data):
+                raise ConnectionError(
+                    "truncated binary cluster frame header"
+                )
+            mtype = data[2 : 2 + tlen].decode()
+            return {"type": mtype, "_bin": data[2 + tlen :]}
+        raise ConnectionError(f"unknown frame format {fmt}")
+    except ConnectionError:
+        raise
+    except (IndexError, UnicodeDecodeError, ValueError) as exc:
+        # IndexError: empty/short body; UnicodeDecodeError: bad type
+        # bytes; ValueError covers json.JSONDecodeError
+        raise ConnectionError(f"malformed cluster frame: {exc}") from exc
+
+
+def drain_frames(buf: bytearray) -> List[Dict]:
+    """Pop every complete length-prefixed frame from ``buf`` (a
+    stream-reassembly buffer — the QUIC peer transport's stream
+    deframer).  Raises ConnectionError on oversized/malformed frames,
+    mutating ``buf`` in place."""
+    out: List[Dict] = []
+    while len(buf) >= 4:
+        n = int.from_bytes(buf[:4], "big")
+        if n > MAX_FRAME:
+            raise ConnectionError(f"oversized cluster frame: {n}")
+        if len(buf) < 4 + n:
+            break
+        body = bytes(buf[4 : 4 + n])
+        del buf[: 4 + n]
+        out.append(parse_frame(body))
+    return out
+
+
 async def read_frame(reader: asyncio.StreamReader) -> Optional[Dict]:
     """Read one frame.  Format 0 = JSON control message; format 1 =
     binary: returned as {"type": mtype, "_bin": payload-bytes}."""
@@ -202,26 +256,27 @@ async def read_frame(reader: asyncio.StreamReader) -> Optional[Dict]:
     except (asyncio.IncompleteReadError, ConnectionError):
         return None
     n = int.from_bytes(head, "big")
-    if n > 64 * 1024 * 1024:
+    if n > MAX_FRAME:
         raise ConnectionError(f"oversized cluster frame: {n}")
     try:
         data = await reader.readexactly(n)
     except (asyncio.IncompleteReadError, ConnectionError):
         return None
-    fmt = data[0]
-    if fmt == _F_JSON:
-        return json.loads(data[1:])
-    if fmt == _F_BIN:
-        tlen = data[1]
-        mtype = data[2 : 2 + tlen].decode()
-        return {"type": mtype, "_bin": data[2 + tlen :]}
-    raise ConnectionError(f"unknown frame format {fmt}")
+    return parse_frame(data)
 
 
 class NodeTransport:
     """The node's RPC endpoint: a listening server plus peer links."""
 
-    def __init__(self, node: str, bind: str = "127.0.0.1", port: int = 0):
+    def __init__(self, node: str, bind: str = "127.0.0.1", port: int = 0,
+                 transport_mode: str = "tcp",
+                 quic_psk: Optional[bytes] = None,
+                 quic_reprobe_interval: float = 5.0):
+        if transport_mode not in ("tcp", "quic", "auto"):
+            raise ValueError(
+                f"transport_mode must be tcp|quic|auto, "
+                f"got {transport_mode!r}"
+            )
         self.node = node
         self.bind = bind
         self.port = port
@@ -236,6 +291,22 @@ class NodeTransport:
         # outbound traffic to a blocked peer is dropped as if the
         # network ate it — both sides blocking = a full partition
         self.blocked: set = set()
+        # QUIC peer transport (cluster/quic_transport.py): the UDP
+        # endpoint binds the SAME port number as the TCP listener, so
+        # membership carries one (host, port) per peer for both.
+        # "auto" prefers QUIC and degrades per peer to the TCP
+        # PeerLink on handshake failure, re-probing QUIC after
+        # `quic_reprobe_interval` seconds.
+        self.transport_mode = transport_mode
+        self.quic_psk = quic_psk
+        self.quic_reprobe_interval = quic_reprobe_interval
+        self.quic_connect_timeout = 1.0  # hello/hello_ack deadline
+        self.quic_endpoint = None  # QuicPeerEndpoint when mode != tcp
+        self._qlinks: Dict[str, Any] = {}  # QuicPeerLink per peer
+        self._quic_retry_at: Dict[str, float] = {}  # auto re-probe time
+        self._quic_probing: set = set()  # peers with a probe in flight
+        self.stats = {"quic_demotions": 0, "quic_promotions": 0,
+                      "quic_sends": 0, "tcp_sends": 0}
 
     def on(self, mtype: str, handler: Handler,
            concurrent: bool = False) -> None:
@@ -259,6 +330,10 @@ class NodeTransport:
         link = self._links.pop(node, None)
         if link is not None:
             link.close()
+        qlink = self._qlinks.pop(node, None)
+        if qlink is not None:
+            qlink.close()
+        self._quic_retry_at.pop(node, None)
 
     async def start(self) -> None:
         if self._server is not None:
@@ -267,6 +342,24 @@ class NodeTransport:
             self._on_conn, self.bind, self.port
         )
         self.port = self._server.sockets[0].getsockname()[1]
+        if self.transport_mode in ("quic", "auto"):
+            from .quic_transport import QuicPeerEndpoint
+
+            endpoint = QuicPeerEndpoint(
+                self, self.bind, self.port, psk=self.quic_psk or b""
+            )
+            try:
+                await endpoint.start()
+                self.quic_endpoint = endpoint
+            except OSError:
+                if self.transport_mode == "quic":
+                    raise
+                # auto: this node serves TCP only; its QUIC dials to
+                # peers still work (outbound needs no local bind)
+                log.warning(
+                    "transport %s: QUIC UDP bind failed; serving "
+                    "TCP only", self.node, exc_info=True,
+                )
 
     async def stop(self) -> None:
         # close OUR ends first: Python 3.12's Server.wait_closed()
@@ -275,6 +368,17 @@ class NodeTransport:
         for link in self._links.values():
             link.close()
         self._links.clear()
+        for qlink in self._qlinks.values():
+            qlink.close()
+        self._qlinks.clear()
+        # probe tasks dial on their own clock; reap them so a stopping
+        # node cannot leak a dial into a closing event loop
+        for task in list(self._tasks):
+            task.cancel()
+        self._tasks.clear()
+        if self.quic_endpoint is not None:
+            await self.quic_endpoint.stop()
+            self.quic_endpoint = None
         for w in list(self._inbound):
             w.close()
         if self._server is not None:
@@ -295,6 +399,117 @@ class NodeTransport:
             link = self._links[node] = PeerLink(self.node, addr)
         return link
 
+    def _qlink(self, node: str):
+        link = self._qlinks.get(node)
+        if link is not None and link.degraded:
+            # a degraded link object fails fast forever (by design —
+            # waiters queued behind the failed dial must not each pay
+            # the timeout); hard "quic" mode has no demotion path to
+            # replace it, so replace it HERE: the next send redials
+            link.close()
+            self._qlinks.pop(node, None)
+            link = None
+        if link is None:
+            addr = self._peer_addrs.get(node)
+            if addr is None:
+                return None
+            from .quic_transport import QuicPeerLink
+
+            link = self._qlinks[node] = QuicPeerLink(
+                self.node, node, addr, psk=self.quic_psk or b"",
+                connect_timeout=self.quic_connect_timeout,
+            )
+        return link
+
+    def _route(self, node: str) -> Tuple[Optional[Any], bool]:
+        """Pick the active link for ``node``: ``(link, is_quic)``.
+
+        tcp  -> the TCP PeerLink, always.
+        quic -> the QUIC link, always (hard mode: no silent fallback).
+        auto -> QUIC, unless this peer is demoted (handshake failure/
+                link fault).  A demoted peer's traffic stays on TCP —
+                after `quic_reprobe_interval` a BACKGROUND probe
+                re-dials QUIC and re-promotes on success, so re-probes
+                never stall live casts (a heartbeat bounded tighter
+                than the handshake timeout must not get eaten by an
+                in-band dial)."""
+        if self.transport_mode == "tcp":
+            return self._link(node), False
+        if self.transport_mode == "quic":
+            return self._qlink(node), True
+        if node not in self._quic_retry_at:
+            return self._qlink(node), True
+        import time
+
+        if time.monotonic() >= self._quic_retry_at[node]:
+            self._kick_quic_probe(node)
+        return self._link(node), False
+
+    def _demote_quic(self, node: str) -> None:
+        """auto mode: park this peer on TCP and schedule a QUIC
+        re-probe (the link object is dropped so the probe redials)."""
+        link = self._qlinks.pop(node, None)
+        if link is not None:
+            link.close()
+        import time
+
+        already = node in self._quic_retry_at
+        self._quic_retry_at[node] = (
+            time.monotonic() + self.quic_reprobe_interval
+        )
+        if not already:
+            self.stats["quic_demotions"] += 1
+            log.info(
+                "transport %s: peer %s demoted to TCP (QUIC re-probe "
+                "in %.1fs)", self.node, node,
+                self.quic_reprobe_interval,
+            )
+
+    def _kick_quic_probe(self, node: str) -> None:
+        if node in self._quic_probing:
+            return
+        self._quic_probing.add(node)
+        task = asyncio.get_running_loop().create_task(
+            self._quic_probe(node)
+        )
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    async def _quic_probe(self, node: str) -> None:
+        """Background QUIC re-promotion probe: dial + handshake on a
+        FRESH link while the peer's traffic keeps flowing over TCP;
+        success swaps the link in and clears the demotion."""
+        import time
+
+        try:
+            addr = self._peer_addrs.get(node)
+            if addr is None:
+                return
+            from .quic_transport import QuicPeerLink
+
+            link = QuicPeerLink(
+                self.node, node, addr, psk=self.quic_psk or b"",
+                connect_timeout=self.quic_connect_timeout,
+            )
+            try:
+                await link.probe()
+            except (ConnectionError, OSError):
+                link.close()
+                self._quic_retry_at[node] = (
+                    time.monotonic() + self.quic_reprobe_interval
+                )
+                return
+            old = self._qlinks.pop(node, None)
+            if old is not None:
+                old.close()
+            self._qlinks[node] = link
+            self._quic_retry_at.pop(node, None)
+            self.stats["quic_promotions"] += 1
+            log.info("transport %s: peer %s re-promoted to QUIC",
+                     self.node, node)
+        finally:
+            self._quic_probing.discard(node)
+
     async def _send_failpoint(self, node: str) -> Optional[str]:
         """Chaos seam for every outbound frame to `node`.  ``drop``
         swallows the frame as if the network ate it, ``duplicate``
@@ -307,6 +522,31 @@ class NodeTransport:
             "cluster.transport.send", key=f"{self.node}->{node}"
         )
 
+    async def _cast_routed(self, node: str, kind: str, obj, mtype: str,
+                           payload) -> bool:
+        """One cast over the routed link; ``auto`` retries ONCE over
+        TCP after demoting a failed QUIC link, so a degrading peer
+        loses no frame on the transition."""
+        link, is_quic = self._route(node)
+        if link is None:
+            return False
+        ok = await (
+            link.cast(obj) if kind == "cast"
+            else link.cast_bin(mtype, payload)
+        )
+        if not ok and is_quic and self.transport_mode == "auto":
+            self._demote_quic(node)
+            link = self._link(node)
+            if link is not None:
+                ok = await (
+                    link.cast(obj) if kind == "cast"
+                    else link.cast_bin(mtype, payload)
+                )
+                is_quic = False  # the frame that went out went on TCP
+        if ok:
+            self.stats["quic_sends" if is_quic else "tcp_sends"] += 1
+        return ok
+
     async def cast(self, node: str, obj: Dict[str, Any]) -> bool:
         if node in self.blocked:
             return False
@@ -318,11 +558,8 @@ class NodeTransport:
             if act == "drop":
                 return True  # silent loss: the sender believes it went
             if act == "duplicate":
-                link = self._link(node)
-                if link is not None:
-                    await link.cast(obj)
-        link = self._link(node)
-        return False if link is None else await link.cast(obj)
+                await self._cast_routed(node, "cast", obj, "", b"")
+        return await self._cast_routed(node, "cast", obj, "", b"")
 
     async def cast_bin(self, node: str, mtype: str, payload: bytes) -> bool:
         if node in self.blocked:
@@ -335,11 +572,8 @@ class NodeTransport:
             if act == "drop":
                 return True
             if act == "duplicate":
-                link = self._link(node)
-                if link is not None:
-                    await link.cast_bin(mtype, payload)
-        link = self._link(node)
-        return False if link is None else await link.cast_bin(mtype, payload)
+                await self._cast_routed(node, "bin", None, mtype, payload)
+        return await self._cast_routed(node, "bin", None, mtype, payload)
 
     async def call(
         self, node: str, obj: Dict[str, Any], timeout: float = 5.0
@@ -353,8 +587,52 @@ class NodeTransport:
                 return None
             if act == "drop":
                 return None  # the reply will never come
-        link = self._link(node)
-        return None if link is None else await link.call(obj, timeout)
+        link, is_quic = self._route(node)
+        if link is None:
+            return None
+        result = await link.call(obj, timeout)
+        if result is None and is_quic and self.transport_mode == "auto" \
+                and getattr(link, "degraded", False):
+            # only a DEAD QUIC link falls back (handshake/link fault);
+            # a timed-out reply over a healthy link must not re-issue
+            # the call on TCP — the peer may have executed it already
+            self._demote_quic(node)
+            tlink = self._link(node)
+            if tlink is not None:
+                result = await tlink.call(obj, timeout)
+                is_quic = False
+        if result is not None:
+            self.stats["quic_sends" if is_quic else "tcp_sends"] += 1
+        return result
+
+    async def _dispatch_frame(
+        self, peer: str, obj: Dict[str, Any], writer
+    ) -> None:
+        """Route one inbound frame to its handler and send the reply
+        (shared by the TCP serve loop and the QUIC endpoint's
+        per-connection pumps; ``writer`` only needs write/drain/
+        is_closing).  Serial handlers run inline — the CALLER's pump
+        is the per-peer FIFO; concurrent handlers spawn."""
+        mtype = obj.get("type", "")
+        handler = self._handlers.get(mtype)
+        if handler is None:
+            log.warning("no handler for %r from %s", mtype, peer)
+            return
+        if mtype in self._concurrent:
+            task = asyncio.get_running_loop().create_task(
+                self._handle_and_reply(handler, peer, obj, writer)
+            )
+            self._tasks.add(task)
+            task.add_done_callback(self._tasks.discard)
+            return
+        result = await handler(peer, obj)
+        if "call_id" in obj and result is not NO_REPLY:
+            writer.write(_pack_json({
+                "type": "reply",
+                "call_id": obj["call_id"],
+                "result": result,
+            }))
+            await writer.drain()
 
     async def _handle_and_reply(
         self, handler: Handler, peer: str, obj: Dict[str, Any],
@@ -410,30 +688,7 @@ class NodeTransport:
                     )
                     if act == "drop":
                         continue
-                mtype = obj.get("type", "")
-                handler = self._handlers.get(mtype)
-                if handler is None:
-                    log.warning("no handler for %r from %s", mtype, peer)
-                    continue
-                if mtype in self._concurrent:
-                    task = asyncio.get_running_loop().create_task(
-                        self._handle_and_reply(handler, peer, obj, writer)
-                    )
-                    self._tasks.add(task)
-                    task.add_done_callback(self._tasks.discard)
-                    continue
-                result = await handler(peer, obj)
-                if "call_id" in obj and result is not NO_REPLY:
-                    writer.write(
-                        _pack_json(
-                            {
-                                "type": "reply",
-                                "call_id": obj["call_id"],
-                                "result": result,
-                            }
-                        )
-                    )
-                    await writer.drain()
+                await self._dispatch_frame(peer, obj, writer)
         except (ConnectionError, asyncio.IncompleteReadError):
             pass
         except Exception:

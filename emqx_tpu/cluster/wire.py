@@ -72,6 +72,45 @@ def encode_messages(msgs: List[Message]) -> bytes:
     return bytes(out)
 
 
+# --------------------------------------------- sequenced window frames
+
+# header layout (big-endian), before the encode_messages body:
+#   u64 epoch  — the origin's process incarnation (ClusterNode._epoch
+#                truncated to 64 bits); a restart starts a new frame
+#                stream, so the receiver resets its dedup window
+#   u64 seq    — per-(origin, peer) frame sequence number, from 1
+#   u64 base   — the LOWEST seq the origin still holds unacked: every
+#                frame below it was acked or shed, so the receiver can
+#                advance its dedup floor past holes that overflow
+#                shedding punched into the stream
+#   u8  flags  — bit0-1: max QoS carried by the frame's messages
+_WINDOW_HDR = struct.Struct(">QQQB")
+
+
+def encode_window(epoch: int, seq: int, base: int,
+                  msgs: List[Message]) -> bytes:
+    """One at-least-once forward frame: reliability header + the
+    batched message body (`encode_messages`)."""
+    max_qos = 0
+    for m in msgs:
+        if m.qos > max_qos:
+            max_qos = m.qos
+            if max_qos >= 2:
+                break
+    return (
+        _WINDOW_HDR.pack(epoch & (2**64 - 1), seq, base, max_qos & 3)
+        + encode_messages(msgs)
+    )
+
+
+def decode_window(data: bytes):
+    """-> (epoch, seq, base, max_qos, msgs).  Malformed frames raise
+    (the caller's serve loop logs and drops them)."""
+    epoch, seq, base, flags = _WINDOW_HDR.unpack_from(data, 0)
+    msgs = decode_messages(data[_WINDOW_HDR.size:])
+    return epoch, seq, base, flags & 3, msgs
+
+
 def decode_messages(data: bytes) -> List[Message]:
     view = memoryview(data)
     (n,) = struct.unpack_from(">I", view, 0)

@@ -325,7 +325,15 @@ class BrokerConfig:
     cluster: Dict[str, Any] = field(default_factory=dict)
     # {"enable": bool, "bind": str, "port": int,
     #  "seeds": [[name, host, port], ...],
-    #  "consensus": "lww"|"raft", "raft_data_dir": str}
+    #  "consensus": "lww"|"raft", "raft_data_dir": str,
+    #  "transport_mode": "tcp"|"quic"|"auto" (inter-node link layer:
+    #   quic = in-repo QUIC peer transport, auto = QUIC with graceful
+    #   per-peer TCP degradation + re-probe),
+    #  "quic_psk": str (shared cluster secret for the QUIC PSK
+    #   integrity profile),
+    #  "fwd_inflight_max": int (at-least-once forward replay buffer,
+    #   frames per peer), "fwd_ack_timeout": float (seconds before a
+    #   frame retransmits)}
     # data-integration sinks started at boot, addressable from rule
     # SinkActions by id (the emqx_bridge config role):
     # [{"id", "type": "http"|"kafka", ...type-specific fields}]
@@ -519,6 +527,17 @@ def check_config(cfg: BrokerConfig) -> List[str]:
     if cfg.cluster.get("enable"):
         if cfg.cluster.get("consensus", "raft") not in ("raft", "lww"):
             bad("cluster.consensus must be raft|lww")
+        if cfg.cluster.get("transport_mode", "tcp") not in (
+            "tcp", "quic", "auto"
+        ):
+            bad("cluster.transport_mode must be tcp|quic|auto")
+        if not 1 <= int(cfg.cluster.get("fwd_inflight_max", 512)) \
+                <= 32768:
+            # upper bound keeps the sender's outstanding seq span well
+            # inside the receiver's 64k dedup window
+            bad("cluster.fwd_inflight_max must be in [1, 32768]")
+        if float(cfg.cluster.get("fwd_ack_timeout", 1.0)) <= 0:
+            bad("cluster.fwd_ack_timeout must be > 0")
         for j, s in enumerate(cfg.cluster.get("seeds", ())):
             if len(s) != 3:
                 bad(f"cluster.seeds[{j}]: expected [name, host, port]")

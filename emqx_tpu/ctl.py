@@ -116,6 +116,20 @@ class Ctl:
                 f"down={cluster.get('down', [])} "
                 f"routes={cluster.get('routes')}"
             )
+            fwd = cluster.get("forward") or {}
+            if fwd:
+                print(
+                    f"  forward: mode={fwd.get('mode')} "
+                    f"quic_demotions={fwd.get('quic_demotions')}"
+                )
+                for peer, st in (fwd.get("peers") or {}).items():
+                    print(
+                        f"    {peer}: breaker={st['breaker']} "
+                        f"unacked={st['unacked_frames']}f/"
+                        f"{st['unacked_msgs']}m "
+                        f"acked={st['acked_frames']} "
+                        f"shed={st['shed_msgs']}"
+                    )
 
     def clients(self, kick: Optional[str] = None) -> None:
         if kick:

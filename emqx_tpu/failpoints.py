@@ -68,6 +68,9 @@ SEAMS = (
     "s3.request",
     "ds.replay.read",
     "session.resume.commit",
+    "cluster.quic.send",
+    "cluster.quic.recv",
+    "cluster.forward.ack",
 )
 
 enabled = False  # fast-path gate: disabled brokers pay one bool check

@@ -65,8 +65,12 @@ METRICS = (
     "messages.forward",
     "messages.forward.failed",
     "messages.forward.received",
+    "messages.forward.dropped",
+    "messages.forward.retx",
+    "messages.forward.dup",
     "messages.retained",
     "cluster.nodes.down",
+    "cluster.forward.breaker.open",
     "delivery.dropped",
     "delivery.dropped.no_local",
     "delivery.dropped.too_large",

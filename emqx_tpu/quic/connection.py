@@ -27,17 +27,27 @@ for the listener; asyncio lives in broker/quic_listener.py."""
 
 from __future__ import annotations
 
+import hashlib
+import hmac
 import os
 import struct
 from typing import Dict, List, Optional, Tuple
 
-from cryptography.hazmat.primitives.ciphers import (
-    Cipher, algorithms, modes,
-)
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+# optional: AES-GCM packet protection needs `cryptography`; the PSK
+# cluster profile (integrity-only, stdlib hmac) does not, and the
+# inter-node transport must work in environments without the package
+try:
+    from cryptography.hazmat.primitives.ciphers import (
+        Cipher, algorithms, modes,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    HAVE_CRYPTO = True
+except ImportError:  # pragma: no cover - environment-dependent
+    Cipher = algorithms = modes = AESGCM = None  # type: ignore
+    HAVE_CRYPTO = False
 
 from .recovery import RangeTracker, RecoverySpace, SentPacket
-from .tls13 import HandshakeError, Tls13, hkdf_expand_label, hkdf_extract
+from .tls13 import HandshakeError, hkdf_expand_label, hkdf_extract
 
 INITIAL_SALT_V1 = bytes.fromhex(
     "38762cf7f55934b34d179ae6a4c80cadccbb7f0a"
@@ -91,6 +101,12 @@ def dec_varint(data: bytes, off: int) -> Tuple[int, int]:
 
 class Keys:
     def __init__(self, secret: bytes) -> None:
+        if AESGCM is None:
+            raise ImportError(
+                "AES-GCM packet protection requires the "
+                "`cryptography` package (the PSK cluster profile "
+                "does not)"
+            )
         self.aead = AESGCM(hkdf_expand_label(secret, "quic key", b"", 16))
         self.iv = hkdf_expand_label(secret, "quic iv", b"", 12)
         self.hp = hkdf_expand_label(secret, "quic hp", b"", 16)
@@ -104,6 +120,59 @@ class Keys:
     def hp_mask(self, sample: bytes) -> bytes:
         c = Cipher(algorithms.AES(self.hp), modes.ECB()).encryptor()
         return c.update(sample)[:5]
+
+
+class _PskAead:
+    """AEAD-shaped integrity protection keyed by a pre-shared secret:
+    ciphertext = plaintext || HMAC-SHA256(psk, nonce||aad||plaintext)
+    truncated to 16 bytes.  NO confidentiality — the payload travels
+    in the clear, authenticated.  This is the cluster peer transport's
+    profile: the TCP inter-node transport is plaintext too, and the
+    QUIC layer is used for its loss recovery and streams, not secrecy.
+    A tampered or wrong-psk packet fails the tag check and is dropped
+    exactly like an AEAD decrypt failure."""
+
+    __slots__ = ("psk",)
+
+    def __init__(self, psk: bytes) -> None:
+        self.psk = psk
+
+    def _tag(self, nonce: bytes, aad: bytes, data: bytes) -> bytes:
+        return hmac.new(
+            self.psk, nonce + aad + data, hashlib.sha256
+        ).digest()[:16]
+
+    def encrypt(self, nonce: bytes, data: bytes, aad: bytes) -> bytes:
+        return data + self._tag(nonce, aad, data)
+
+    def decrypt(self, nonce: bytes, ct: bytes, aad: bytes) -> bytes:
+        data, tag = ct[:-16], ct[-16:]
+        if not hmac.compare_digest(self._tag(nonce, aad, data), tag):
+            raise ValueError("psk integrity tag mismatch")
+        return data
+
+
+class PskKeys:
+    """`Keys`-shaped key material for the PSK profile: hmac integrity
+    tag, identity header-protection mask (headers unprotected — both
+    ends are in-repo cluster peers on a trusted network)."""
+
+    __slots__ = ("aead", "iv")
+
+    _ZERO_MASK = b"\x00" * 5
+
+    def __init__(self, psk: bytes) -> None:
+        self.aead = _PskAead(psk)
+        self.iv = hashlib.sha256(psk + b"quic-psk-iv").digest()[:12]
+
+    def nonce(self, pn: int) -> bytes:
+        return bytes(
+            b ^ ((pn >> (8 * (11 - i))) & 0xFF)
+            for i, b in enumerate(self.iv)
+        )
+
+    def hp_mask(self, sample: bytes) -> bytes:
+        return self._ZERO_MASK
 
 
 def initial_keys(dcid: bytes) -> Tuple[Keys, Keys]:
@@ -171,21 +240,40 @@ class QuicConnection:
         key=None,
         alpn: str = "mqtt",
         server_name: str = "localhost",
+        psk: Optional[bytes] = None,
+        cid: Optional[bytes] = None,
     ) -> None:
+        """``psk`` selects the CLUSTER profile: no TLS handshake, both
+        endpoints derive `PskKeys` from the shared secret and speak
+        1-RTT short-header packets from the first datagram — loss
+        recovery, streams, and packetization are the full QUIC
+        machinery, protection is integrity-only (see `_PskAead`).  The
+        connection id is symmetric (``cid``, scid == dcid): the server
+        endpoint demuxes short headers by it and constructs its side
+        with the same id."""
         self.is_server = is_server
-        self.scid = os.urandom(8)
-        self.dcid = os.urandom(8)  # client: until server's SCID learned
-        self.original_dcid = self.dcid
-        self.tls = Tls13(
-            is_server,
-            alpn=alpn,
-            quic_tp=encode_transport_params(
-                self.scid, self.dcid if is_server else None
-            ),
-            cert_der=cert_der,
-            key=key,
-            server_name=server_name,
-        )
+        if psk is not None:
+            c = cid if cid is not None else os.urandom(8)
+            self.scid = c
+            self.dcid = c
+            self.original_dcid = c
+            self.tls = None
+        else:
+            from .tls13 import Tls13  # requires `cryptography`
+
+            self.scid = os.urandom(8)
+            self.dcid = os.urandom(8)  # client: until server SCID learned
+            self.original_dcid = self.dcid
+            self.tls = Tls13(
+                is_server,
+                alpn=alpn,
+                quic_tp=encode_transport_params(
+                    self.scid, self.dcid if is_server else None
+                ),
+                cert_der=cert_der,
+                key=key,
+                server_name=server_name,
+            )
         self._client_keys: Optional[Keys] = None
         self._server_keys: Optional[Keys] = None
         self._keys: Dict[int, Tuple[Optional[Keys], Optional[Keys]]] = {
@@ -202,6 +290,12 @@ class QuicConnection:
         self._pn_floor: Dict[int, int] = {0: 0, 2: 0, 3: 0}
         self._PN_WINDOW = 2048
         self._ack_due: Dict[int, bool] = {0: False, 2: False, 3: False}
+        # ack frequency (RFC 9000 §13.2.2: ack at least every 2nd
+        # ack-eliciting packet): 1 = immediate; the PSK cluster
+        # profile uses 2 — halving ack datagrams on the bulk forward
+        # path — with `ack_flush()` (driver tick) covering tails
+        self._ack_every = 1
+        self._ack_pending: Dict[int, int] = {0: 0, 2: 0, 3: 0}
         # crypto send state per epoch: buffer + contiguous acked/sent
         self._crypto_out: Dict[int, bytes] = {0: b"", 2: b"", 3: b""}
         self._crypto_sent: Dict[int, int] = {0: 0, 2: 0, 3: 0}
@@ -235,7 +329,25 @@ class QuicConnection:
         self.close_code: Optional[int] = None
         self._out_datagrams: List[bytes] = []
         self._next_stream_id = 0 if is_server else 0
-        if is_server:
+        # stream-chunk size per packet: 1100 keeps TLS-profile packets
+        # under the 1280-byte internet path MTU floor (RFC 9000 §14);
+        # the PSK cluster profile runs on loopback/LAN links whose MTU
+        # the operator controls, so it packs bigger datagrams — fewer
+        # packets per window frame, less per-packet host work
+        self.max_stream_chunk = 1100
+        if psk is not None:
+            # PSK profile: app keys exist from the start, there is no
+            # handshake to complete and no address to validate (the
+            # transport's hello frame is the application handshake)
+            k = PskKeys(psk)
+            self._keys[EPOCH_APP] = (k, k)
+            self.handshake_complete = True
+            self._handshake_done_sent = True
+            self._handshake_confirmed = True
+            self.address_validated = True
+            self.max_stream_chunk = 8192
+            self._ack_every = 2
+        elif is_server:
             pass  # keys derive from the first Initial's DCID
         else:
             ck, sk = initial_keys(self.dcid)
@@ -245,6 +357,8 @@ class QuicConnection:
 
     def connect(self) -> None:
         assert not self.is_server
+        if self.tls is None:
+            return  # PSK profile: no handshake flight to send
         self.tls.client_hello()
         self._flush()
 
@@ -283,6 +397,25 @@ class QuicConnection:
     def datagrams_to_send(self) -> List[bytes]:
         out, self._out_datagrams = self._out_datagrams, []
         return out
+
+    def has_inflight(self) -> bool:
+        """Any ack-eliciting packet awaiting an ACK?  Drivers use this
+        to gate PTO probes: no in-flight data means nothing a timeout
+        could recover, so firing one would only spray duplicates."""
+        return any(s.sent for s in self._spaces.values())
+
+    def ack_flush(self) -> None:
+        """Force out any ack withheld by the ack-frequency threshold
+        (the driver's periodic tick calls this so a burst TAIL — one
+        odd packet with nothing behind it — still acks promptly and
+        the peer's PTO never fires on delivered data)."""
+        flush = False
+        for epoch, pending in self._ack_pending.items():
+            if pending > 0 and not self._ack_due[epoch]:
+                self._ack_due[epoch] = True
+                flush = True
+        if flush:
+            self._flush()
 
     def on_timeout(self) -> None:
         """PTO: the ack stream went quiet — declare every in-flight
@@ -326,6 +459,10 @@ class QuicConnection:
     def _receive_packet(self, data: bytes, off: int) -> int:
         first = data[off]
         if first & 0x80:  # long header
+            if self.tls is None:
+                # PSK profile peers never send long headers; a stray
+                # Initial (port scan, misdirected client) is ignored
+                return 0
             version = struct.unpack_from(">I", data, off + 1)[0]
             if version != VERSION_1:
                 return 0
@@ -477,9 +614,13 @@ class QuicConnection:
             # unknown frame: stop parsing this packet
             break
         if ack_eliciting:
-            self._ack_due[epoch] = True
+            self._ack_pending[epoch] += 1
+            if self._ack_pending[epoch] >= self._ack_every:
+                self._ack_due[epoch] = True
 
     def _on_crypto(self, epoch: int, coff: int, data: bytes) -> None:
+        if self.tls is None:
+            return  # PSK profile: no handshake stream exists
         chunks = self._crypto_chunks[epoch]
         chunks[coff] = data
         advanced = True
@@ -624,8 +765,9 @@ class QuicConnection:
     # -------------------------------------------------------- sending
 
     def _flush(self) -> None:
-        for epoch in (EPOCH_INITIAL, EPOCH_HANDSHAKE, EPOCH_APP):
-            self._crypto_out[epoch] += self.tls.take_out(epoch)
+        if self.tls is not None:
+            for epoch in (EPOCH_INITIAL, EPOCH_HANDSHAKE, EPOCH_APP):
+                self._crypto_out[epoch] += self.tls.take_out(epoch)
         datagram = b""
         for epoch in (EPOCH_INITIAL, EPOCH_HANDSHAKE):
             pkt = self._build_crypto_packet(epoch)
@@ -635,7 +777,8 @@ class QuicConnection:
         if app:
             datagram += app
         if datagram:
-            if not self.is_server and self._pn[EPOCH_HANDSHAKE] == 0 \
+            if self.tls is not None and not self.is_server \
+                    and self._pn[EPOCH_HANDSHAKE] == 0 \
                     and len(datagram) < 1200:
                 # a client Initial flight must fill 1200 bytes
                 datagram += b"\x00" * (1200 - len(datagram))
@@ -651,6 +794,7 @@ class QuicConnection:
         if self._ack_due[epoch]:
             frames += self._ack_frame(epoch)
             self._ack_due[epoch] = False
+            self._ack_pending[epoch] = 0
         # lost ranges first (exact retransmission), then the new tail
         for off, end in space.take_crypto_retx():
             data = self._crypto_out[epoch][off:end]
@@ -692,6 +836,7 @@ class QuicConnection:
         if self._ack_due[EPOCH_APP]:
             frames += self._ack_frame(EPOCH_APP)
             self._ack_due[EPOCH_APP] = False
+            self._ack_pending[EPOCH_APP] = 0
         if (self.is_server and self.handshake_complete
                 and not self._handshake_done_sent):
             frames += bytes([F_DONE])
@@ -708,6 +853,7 @@ class QuicConnection:
             rec = SentPacket()
 
         if self.handshake_complete:
+            max_chunk = self.max_stream_chunk
             for sid, st in self._streams_out.items():
                 # 1) lost ranges (selective retransmission), re-checked
                 #    against acks that landed after the loss call
@@ -720,7 +866,7 @@ class QuicConnection:
                         while roff < rend:
                             chunk = st.data[
                                 roff - st.base:
-                                min(rend, roff + 1100) - st.base
+                                min(rend, roff + max_chunk) - st.base
                             ]
                             if not chunk:
                                 break
@@ -731,14 +877,14 @@ class QuicConnection:
                                 (sid, roff, roff + len(chunk))
                             )
                             roff += len(chunk)
-                            if len(frames) > 1100:
+                            if len(frames) > max_chunk:
                                 flush_packet()
                 # 2) the new tail
                 sent = self._streams_sent.get(sid, 0)
                 pending = st.data[sent - st.base:]
                 send_fin = st.fin and not st.fin_sent
                 while pending or send_fin:
-                    chunk = pending[:1100]
+                    chunk = pending[:max_chunk]
                     pending = pending[len(chunk):]
                     fin_flag = st.fin and not pending
                     frames += self._stream_frame(
@@ -753,7 +899,7 @@ class QuicConnection:
                         rec.fins.append(sid)
                         st.fin_sent = True
                         send_fin = False
-                    if len(frames) > 1100:
+                    if len(frames) > max_chunk:
                         flush_packet()
                 self._streams_sent[sid] = sent
         if not frames:

@@ -29,12 +29,22 @@ import os
 import struct
 from typing import Dict, List, Optional, Tuple
 
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
+# optional: the TLS handshake needs `cryptography` primitives, but the
+# hkdf helpers (pure hashlib) and HandshakeError are used by modules
+# that can run without it (the cluster peer transport's PSK profile) —
+# importing this module must not require the package
+try:
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    HAVE_CRYPTO = True
+except ImportError:  # pragma: no cover - environment-dependent
+    hashes = serialization = ec = None  # type: ignore
+    X25519PrivateKey = X25519PublicKey = None  # type: ignore
+    HAVE_CRYPTO = False
 
 # handshake message types
 CH, SH, EE, CERT, CV, FIN = 1, 2, 8, 11, 15, 20
@@ -128,6 +138,12 @@ class Tls13:
         key=None,  # ec.EllipticCurvePrivateKey (server)
         server_name: str = "localhost",
     ) -> None:
+        if not HAVE_CRYPTO:
+            raise ImportError(
+                "the TLS 1.3 handshake requires the `cryptography` "
+                "package (the QUIC cluster transport's PSK profile "
+                "does not)"
+            )
         self.is_server = is_server
         self.alpn = alpn
         self.quic_tp = quic_tp

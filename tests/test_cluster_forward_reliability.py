@@ -1,0 +1,674 @@
+"""Loss-proof cluster window forwarding: the at-least-once reliability
+layer (sequenced frames, ack/replay, dedup, shed policy, per-peer
+breaker) plus the transport-hardening satellites.
+
+The chaos tests drive the REAL recovery paths through the failpoint
+seams and the `transport.blocked` partition hook: a killed peer's
+unacked windows replay after its restart with zero QoS>=1 loss, a
+lost ack produces a dedup'd duplicate (never a double-dispatch), and
+repeated failures trip a per-peer breaker that a background probe
+re-closes."""
+
+import asyncio
+import json
+
+import pytest
+
+from emqx_tpu import failpoints as fp
+from emqx_tpu.broker.listener import BrokerServer
+from emqx_tpu.cluster import ClusterNode
+from emqx_tpu.cluster.transport import (
+    drain_frames, parse_frame, read_frame, _pack_bin, _pack_json,
+)
+from emqx_tpu.config import BrokerConfig
+from emqx_tpu.message import Message
+from mqtt_client import TestClient
+
+FAST = dict(
+    heartbeat_interval=0.05, down_after=5.0, flush_interval=0.002,
+    consensus="lww", fwd_ack_timeout=0.15, fwd_backoff_max=0.6,
+    fwd_probe_interval=0.15,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    fp.clear()
+    yield
+    fp.clear()
+
+
+async def start_node(name, seeds=(), tracing=False, port=0, **kw):
+    cfg = BrokerConfig()
+    cfg.listeners[0].port = 0
+    cfg.node_name = name
+    if tracing:
+        cfg.tracing.enable = True
+        cfg.tracing.sample_rate = 1.0
+        cfg.tracing.seed = 5
+    srv = BrokerServer(cfg)
+    await srv.start()
+    node = ClusterNode(name, srv.broker, port=port, **{**FAST, **kw})
+    await node.start(seeds=list(seeds))
+    return srv, node
+
+
+async def stop_node(srv, node):
+    await node.stop()
+    await srv.stop()
+
+
+async def settle(cond, timeout=6.0):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        if cond():
+            return True
+        await asyncio.sleep(0.02)
+    return False
+
+
+# --------------------------------------------- satellite: read_frame
+
+
+def _feed(body: bytes):
+    reader = asyncio.StreamReader()
+    reader.feed_data(len(body).to_bytes(4, "big") + body)
+    reader.feed_eof()
+    return reader
+
+
+def test_read_frame_zero_length_body_is_connection_error():
+    async def t():
+        with pytest.raises(ConnectionError):
+            await read_frame(_feed(b""))
+
+    run(t())
+
+
+def test_read_frame_truncated_bin_header_is_connection_error():
+    async def t():
+        # format 1, declared type length 10, only 3 type bytes present
+        with pytest.raises(ConnectionError):
+            await read_frame(_feed(bytes([1, 10]) + b"abc"))
+
+    run(t())
+
+
+def test_read_frame_bad_type_utf8_is_connection_error():
+    async def t():
+        with pytest.raises(ConnectionError):
+            await read_frame(_feed(bytes([1, 2, 0xFF, 0xFE])))
+
+    run(t())
+
+
+def test_read_frame_bad_json_is_connection_error():
+    async def t():
+        with pytest.raises(ConnectionError):
+            await read_frame(_feed(bytes([0]) + b"{not json"))
+        with pytest.raises(ConnectionError):
+            await read_frame(_feed(bytes([0]) + b"[1,2]"))  # non-object
+
+    run(t())
+
+
+def test_read_frame_unknown_format_is_connection_error():
+    async def t():
+        with pytest.raises(ConnectionError):
+            await read_frame(_feed(bytes([9]) + b"x"))
+
+    run(t())
+
+
+def test_parse_frame_good_frames_roundtrip():
+    obj = parse_frame(_pack_json({"type": "hi", "n": 1})[4:])
+    assert obj == {"type": "hi", "n": 1}
+    obj = parse_frame(_pack_bin("fwd", b"\x00\x01")[4:])
+    assert obj["type"] == "fwd" and obj["_bin"] == b"\x00\x01"
+
+
+def test_drain_frames_partial_then_complete_and_malformed():
+    buf = bytearray()
+    frame = _pack_json({"type": "a"})
+    buf += frame[:3]
+    assert drain_frames(buf) == []
+    buf += frame[3:] + _pack_bin("b", b"xy")
+    out = drain_frames(buf)
+    assert [o["type"] for o in out] == ["a", "b"]
+    assert not buf
+    # malformed body inside a complete frame raises
+    buf += (1).to_bytes(4, "big") + bytes([9])
+    with pytest.raises(ConnectionError):
+        drain_frames(buf)
+
+
+def test_malformed_frame_resets_link_not_server():
+    """A peer feeding garbage gets ITS connection reset; the server
+    keeps serving other peers."""
+
+    async def t():
+        s1, n1 = await start_node("srv")
+        s2, n2 = await start_node(
+            "good", seeds=[("srv", "127.0.0.1", n1.port)]
+        )
+        try:
+            assert await settle(lambda: "good" in n1.peers_alive()
+                                or "good" in n1._peers)
+            # raw garbage peer: hello, then an empty frame body
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", n1.port
+            )
+            writer.write(_pack_json(
+                {"type": "hello", "node": "evil", "ver": [3, 0]}
+            ))
+            writer.write((0).to_bytes(4, "big"))  # zero-length body
+            await writer.drain()
+            data = await reader.read(1)  # server closes our link
+            assert data == b""
+            writer.close()
+            # the good peer still works: a heartbeat keeps flowing
+            n1._last_seen["good"] = 0.0
+            assert await settle(
+                lambda: n1._last_seen.get("good", 0.0) > 0.0
+            )
+        finally:
+            await stop_node(s2, n2)
+            await stop_node(s1, n1)
+
+    run(t())
+
+
+# --------------------------------------------- ack/replay reliability
+
+
+def test_link_loss_replays_unacked_windows():
+    """Windows buffered while the peer is unreachable retransmit
+    after the link heals: zero QoS1 loss, no duplicate dispatch."""
+
+    async def t():
+        sa, a = await start_node("a")
+        sb, b = await start_node(
+            "b", seeds=[("a", "127.0.0.1", a.port)]
+        )
+        try:
+            sub = TestClient(sb.listeners[0].port, "s")
+            await sub.connect()
+            await sub.subscribe("t/#", qos=1)
+            assert await settle(
+                lambda: a.routes.nodes_for("t/#") == {"b"}
+            )
+            a.transport.blocked.add("b")  # the network eats everything
+            pub = TestClient(sa.listeners[0].port, "p")
+            await pub.connect()
+            for i in range(40):
+                await pub.publish(f"t/{i}", b"x", qos=1)
+            # frames buffered, none delivered
+            assert await settle(
+                lambda: (st := a._fwd_out.get("b")) is not None
+                and len(st.inflight) > 0
+            )
+            await asyncio.sleep(0.3)  # a few failed retx cycles
+            a.transport.blocked.discard("b")
+            got = set()
+            for _ in range(40):
+                pkt = await sub.recv_publish(timeout=8)
+                got.add(pkt.topic)
+            assert got == {f"t/{i}" for i in range(40)}
+            assert await settle(
+                lambda: not a._fwd_out["b"].inflight
+            )
+            assert a.broker.metrics.val("messages.forward.retx") > 0
+            # dedup did its job: nothing dispatched twice
+            assert b.broker.metrics.val("messages.forward.received") \
+                == 40
+            await pub.disconnect()
+            await sub.disconnect()
+        finally:
+            await stop_node(sb, b)
+            await stop_node(sa, a)
+
+    run(t())
+
+
+def test_lost_ack_duplicates_dedup_not_redispatched():
+    """Chaos on the `cluster.forward.ack` seam: the first ack is
+    dropped, the origin retransmits, the receiver re-acks WITHOUT
+    re-dispatching — at-least-once stays at-least-once on the wire
+    and exactly-once at dispatch."""
+
+    async def t():
+        sa, a = await start_node("a")
+        sb, b = await start_node(
+            "b", seeds=[("a", "127.0.0.1", a.port)]
+        )
+        try:
+            sub = TestClient(sb.listeners[0].port, "s")
+            await sub.connect()
+            await sub.subscribe("t/#", qos=1)
+            assert await settle(
+                lambda: a.routes.nodes_for("t/#") == {"b"}
+            )
+            fp.configure("cluster.forward.ack", "drop", times=1)
+            pub = TestClient(sa.listeners[0].port, "p")
+            await pub.connect()
+            for i in range(10):
+                await pub.publish(f"t/{i}", b"x", qos=1)
+            got = set()
+            for _ in range(10):
+                pkt = await sub.recv_publish(timeout=8)
+                got.add(pkt.topic)
+            assert len(got) == 10
+            # the retransmit produced a duplicate frame, dedup'd
+            assert await settle(
+                lambda: b.broker.metrics.val("messages.forward.dup")
+                > 0
+            )
+            assert await settle(lambda: not a._fwd_out["b"].inflight)
+            # exactly-once dispatch: the duplicate never re-entered
+            assert b.broker.metrics.val(
+                "messages.forward.received") == 10
+            assert [p["fires"] for p in fp.list_points()
+                    if p["name"] == "cluster.forward.ack"] == [1]
+            await pub.disconnect()
+            await sub.disconnect()
+        finally:
+            await stop_node(sb, b)
+            await stop_node(sa, a)
+
+    run(t())
+
+
+def test_overflow_sheds_qos0_frames_first():
+    """A full replay buffer sheds QoS0-only frames before anything
+    carrying QoS>=1, counting ``messages.forward.dropped``."""
+
+    async def t():
+        sa, a = await start_node("a", fwd_inflight_max=3)
+        sb, b = await start_node(
+            "b", seeds=[("a", "127.0.0.1", a.port)]
+        )
+        try:
+            assert await settle(lambda: "b" in a._peers)
+            a.transport.blocked.add("b")
+
+            def msgs(qos, tag, n=2):
+                return [
+                    Message(topic=f"{tag}/{i}", payload=b"x", qos=qos)
+                    for i in range(n)
+                ]
+
+            # four frames into a 3-frame buffer: q0 frames shed first
+            for qos, tag in ((0, "z0"), (1, "q1a"), (0, "z1"),
+                             (1, "q1b")):
+                for m in msgs(qos, tag):
+                    a.forward(m, {"b"})
+                await a._flush_forwards()
+            st = a._fwd_out["b"]
+            kept = [f.max_qos for f in st.inflight.values()]
+            assert len(st.inflight) == 3
+            assert kept.count(1) == 2  # both QoS1 frames survived
+            assert a.broker.metrics.val(
+                "messages.forward.dropped") == 2  # one q0 frame shed
+            # push two more QoS1 frames: the last q0 goes, then the
+            # OLDEST QoS1 makes room (bounded memory wins)
+            for tag in ("q1c", "q1d"):
+                for m in msgs(1, tag):
+                    a.forward(m, {"b"})
+                await a._flush_forwards()
+            st = a._fwd_out["b"]
+            assert all(
+                f.max_qos == 1 for f in st.inflight.values()
+            )
+            assert a.broker.metrics.val(
+                "messages.forward.dropped") == 6
+        finally:
+            await stop_node(sb, b)
+            await stop_node(sa, a)
+
+    run(t())
+
+
+def test_breaker_trips_alarm_probes_and_recloses():
+    """Repeated forward failures walk closed -> suspect -> open: an
+    OPEN breaker parks frames and raises the $SYS alarm; the probe
+    re-closes it when the peer heals and the backlog replays."""
+
+    async def t():
+        sa, a = await start_node(
+            "a", fwd_suspect_threshold=1, fwd_breaker_threshold=2,
+        )
+        sb, b = await start_node(
+            "b", seeds=[("a", "127.0.0.1", a.port)]
+        )
+        try:
+            sub = TestClient(sb.listeners[0].port, "s")
+            await sub.connect()
+            await sub.subscribe("t/#", qos=1)
+            assert await settle(
+                lambda: a.routes.nodes_for("t/#") == {"b"}
+            )
+            a.transport.blocked.add("b")
+            pub = TestClient(sa.listeners[0].port, "p")
+            await pub.connect()
+            for i in range(5):
+                await pub.publish(f"t/{i}", b"x", qos=1)
+            assert await settle(
+                lambda: (st := a._fwd_out.get("b")) is not None
+                and st.breaker_open
+            )
+            names = [al.name for al in a.broker.alarms.active()]
+            assert "cluster_forward_breaker_b" in names
+            assert a.broker.metrics.val(
+                "cluster.forward.breaker.open") >= 1
+            assert a.forward_stats()["peers"]["b"]["breaker"] == \
+                "open"
+            # heal: the background probe's frame gets acked and the
+            # breaker re-closes; every window replays
+            a.transport.blocked.discard("b")
+            got = set()
+            for _ in range(5):
+                pkt = await sub.recv_publish(timeout=8)
+                got.add(pkt.topic)
+            assert got == {f"t/{i}" for i in range(5)}
+            assert await settle(
+                lambda: not a._fwd_out["b"].breaker_open
+            )
+            assert "cluster_forward_breaker_b" not in [
+                al.name for al in a.broker.alarms.active()
+            ]
+            await pub.disconnect()
+            await sub.disconnect()
+        finally:
+            await stop_node(sb, b)
+            await stop_node(sa, a)
+
+    run(t())
+
+
+def test_kill_peer_mid_window_restart_zero_qos1_loss():
+    """THE chaos gate: windows forwarded while the peer is dead
+    replay to its restarted incarnation — zero QoS1 loss end to end,
+    duplicates only within at-least-once bounds."""
+
+    async def t():
+        sa, a = await start_node("a")
+        sb, b = await start_node(
+            "b", seeds=[("a", "127.0.0.1", a.port)]
+        )
+        try:
+            sub = TestClient(sb.listeners[0].port, "s")
+            await sub.connect()
+            await sub.subscribe("t/#", qos=1)
+            assert await settle(
+                lambda: a.routes.nodes_for("t/#") == {"b"}
+            )
+            pub = TestClient(sa.listeners[0].port, "p")
+            await pub.connect()
+            # phase 1: live traffic flows
+            for i in range(10):
+                await pub.publish(f"t/{i}", b"x", qos=1)
+            got = set()
+            for _ in range(10):
+                got.add((await sub.recv_publish(timeout=8)).topic)
+            assert len(got) == 10
+
+            # phase 2: KILL b mid-stream (no clean handshake — the
+            # blocked hook plays the dead network while the process
+            # restarts); the window keeps publishing into the outage
+            cluster_port = b.port
+            a.transport.blocked.add("b")
+            await b.stop()
+            await sb.stop()
+            for i in range(10, 40):
+                await pub.publish(f"t/{i}", b"x", qos=1)
+            assert await settle(
+                lambda: (st := a._fwd_out.get("b")) is not None
+                and sum(f.n for f in st.inflight.values()) >= 30
+            )
+
+            # phase 3: b restarts at the same cluster address; its
+            # subscriber reattaches FIRST, then the network heals —
+            # every unacked window replays into the new incarnation
+            sb2, b2 = await start_node(
+                "b", seeds=[("a", "127.0.0.1", a.port)],
+                port=cluster_port,
+            )
+            sub2 = TestClient(sb2.listeners[0].port, "s2")
+            await sub2.connect()
+            await sub2.subscribe("t/#", qos=1)
+            assert await settle(
+                lambda: b2.routes.nodes_for("t/#") != set()
+                or True
+            )
+            a.transport.blocked.discard("b")
+            got2 = set()
+            try:
+                while len(got2) < 30:
+                    got2.add(
+                        (await sub2.recv_publish(timeout=8)).topic
+                    )
+            except asyncio.TimeoutError:
+                pass
+            assert got2 == {f"t/{i}" for i in range(10, 40)}, (
+                f"lost {30 - len(got2)} QoS1 forwarded messages"
+            )
+            assert await settle(lambda: not a._fwd_out["b"].inflight)
+            await pub.disconnect()
+            await sub2.disconnect()
+            await stop_node(sb2, b2)
+        finally:
+            await stop_node(sa, a)
+
+    run(t())
+
+
+# ------------------------------------------------------- satellites
+
+
+def test_departed_peer_buffers_reaped():
+    """A peer removed from membership frees its pending buffers,
+    replay state, and dedup window; the shed frames are counted."""
+
+    async def t():
+        sa, a = await start_node("a")
+        sb, b = await start_node(
+            "b", seeds=[("a", "127.0.0.1", a.port)]
+        )
+        try:
+            assert await settle(lambda: "b" in a._peers)
+            a.transport.blocked.add("b")
+            for i in range(6):
+                a.forward(
+                    Message(topic=f"t/{i}", payload=b"x", qos=1),
+                    {"b"},
+                )
+            await a._flush_forwards()
+            # plus a buffered-but-unflushed message
+            a.forward(Message(topic="t/x", payload=b"x", qos=1),
+                      {"b"})
+            assert a._fwd_out["b"].inflight
+            assert a._pending_fwd.get("b")
+            a._fwd_in["b"] = [1, 0, set()]
+
+            a.forget_peer("b")
+            assert "b" not in a._peers
+            assert "b" not in a._fwd_out
+            assert "b" not in a._pending_fwd
+            assert "b" not in a._fwd_in
+            assert a.broker.metrics.val(
+                "messages.forward.dropped") == 7
+            # the retx loop has nothing left to drive
+            await asyncio.sleep(0.25)
+            assert "b" not in a._fwd_out
+        finally:
+            await stop_node(sb, b)
+            await stop_node(sa, a)
+
+    run(t())
+
+
+def test_retx_loop_reaps_unknown_peer_state():
+    """Defensive reap: replay state for a peer that silently left
+    membership is dropped by the retx loop, not retained forever."""
+
+    async def t():
+        sa, a = await start_node("a")
+        try:
+            st = a._fwd_state("ghost")
+            st.seq = 1
+            from emqx_tpu.cluster.node import _FwdFrame
+
+            st.inflight[1] = _FwdFrame(1, b"", 3, 1, ())
+            assert await settle(lambda: "ghost" not in a._fwd_out)
+            assert a.broker.metrics.val(
+                "messages.forward.dropped") == 3
+        finally:
+            await stop_node(sa, a)
+
+    run(t())
+
+
+def test_forward_span_closes_on_task_crash():
+    """Satellite regression (PR 8 invariant: a dropped leg still
+    yields a CLOSED span): a forward task killed by an injected
+    panic closes its ``message.forward`` spans ok=False."""
+
+    async def t():
+        sa, a = await start_node("a", tracing=True)
+        sb, b = await start_node(
+            "b", seeds=[("a", "127.0.0.1", a.port)]
+        )
+        try:
+            sub = TestClient(sb.listeners[0].port, "s")
+            await sub.connect()
+            await sub.subscribe("t/#", qos=1)
+            assert await settle(
+                lambda: a.routes.nodes_for("t/#") == {"b"}
+            )
+            fp.configure("cluster.transport.send", "panic",
+                         match="a->b")
+            pub = TestClient(sa.listeners[0].port, "p")
+            await pub.connect()
+            await pub.publish("t/0", b"x", qos=1)
+
+            def crashed_span():
+                return [
+                    s for s in a.broker.lifecycle.store.spans()
+                    if s["name"] == "message.forward"
+                    and s["attrs"].get("detail")
+                    == "forward task crashed"
+                ]
+
+            assert await settle(lambda: bool(crashed_span()))
+            s = crashed_span()[0]
+            assert s["attrs"]["ok"] is False and s["end_ns"] > 0
+            # the frame itself SURVIVED the crash: clear the fault
+            # and the window still delivers (at-least-once held)
+            fp.clear("cluster.transport.send")
+            pkt = await sub.recv_publish(timeout=8)
+            assert pkt.topic == "t/0"
+            await pub.disconnect()
+            await sub.disconnect()
+        finally:
+            await stop_node(sb, b)
+            await stop_node(sa, a)
+
+    run(t())
+
+
+def test_ack_latency_and_retransmit_span_events():
+    """A sampled forwarded message's span carries the ack latency and
+    any retransmit events — a loss-induced p99 regression names its
+    hop."""
+
+    async def t():
+        sa, a = await start_node("a", tracing=True)
+        sb, b = await start_node(
+            "b", seeds=[("a", "127.0.0.1", a.port)]
+        )
+        try:
+            sub = TestClient(sb.listeners[0].port, "s")
+            await sub.connect()
+            await sub.subscribe("t/#", qos=1)
+            assert await settle(
+                lambda: a.routes.nodes_for("t/#") == {"b"}
+            )
+            # eat the first send(s) so the frame needs at least one
+            # retransmit before it acks
+            a.transport.blocked.add("b")
+            pub = TestClient(sa.listeners[0].port, "p")
+            await pub.connect()
+            await pub.publish("t/0", b"x", qos=1)
+            assert await settle(
+                lambda: (st := a._fwd_out.get("b")) is not None
+                and st.inflight
+                and next(iter(st.inflight.values())).retx >= 1
+            )
+            a.transport.blocked.discard("b")
+            pkt = await sub.recv_publish(timeout=8)
+            assert pkt.topic == "t/0"
+
+            def fwd_spans():
+                return [
+                    s for s in a.broker.lifecycle.store.spans()
+                    if s["name"] == "message.forward"
+                    and s["attrs"].get("ok") is True
+                ]
+
+            assert await settle(lambda: bool(fwd_spans()))
+            s = fwd_spans()[0]
+            assert s["attrs"]["ack_ms"] >= 0
+            names = [e["name"] for e in s["events"]]
+            assert "forward.acked" in names
+            assert s["attrs"]["retx"] >= 1
+            assert "forward.retransmit" in names
+            await pub.disconnect()
+            await sub.disconnect()
+        finally:
+            await stop_node(sb, b)
+            await stop_node(sa, a)
+
+    run(t())
+
+
+def test_forward_stats_surface():
+    """`ClusterNode.info()` (the /api/v5/nodes + ctl status payload)
+    carries the reliability introspection."""
+
+    async def t():
+        sa, a = await start_node("a")
+        sb, b = await start_node(
+            "b", seeds=[("a", "127.0.0.1", a.port)]
+        )
+        try:
+            sub = TestClient(sb.listeners[0].port, "s")
+            await sub.connect()
+            await sub.subscribe("t/#", qos=1)
+            assert await settle(
+                lambda: a.routes.nodes_for("t/#") == {"b"}
+            )
+            pub = TestClient(sa.listeners[0].port, "p")
+            await pub.connect()
+            await pub.publish("t/0", b"x", qos=1)
+            await sub.recv_publish(timeout=8)
+            assert await settle(
+                lambda: a.forward_stats()["peers"]
+                .get("b", {}).get("acked_frames", 0) >= 1
+            )
+            info = a.info()
+            assert info["forward"]["mode"] == "tcp"
+            st = info["forward"]["peers"]["b"]
+            assert st["breaker"] == "closed"
+            assert st["unacked_frames"] == 0
+            json.dumps(info)  # JSON-safe for the mgmt surface
+            await pub.disconnect()
+            await sub.disconnect()
+        finally:
+            await stop_node(sb, b)
+            await stop_node(sa, a)
+
+    run(t())

@@ -559,6 +559,9 @@ def _run_stubbed_loss_scenarios(conn_mod):
         c._pn_floor = {0: 0, 2: 0, 3: 0}
         c._PN_WINDOW = 2048
         c._ack_due = {0: False, 2: False, 3: False}
+        c._ack_every = 1
+        c._ack_pending = {0: 0, 2: 0, 3: 0}
+        c.max_stream_chunk = 1100
         c._crypto_out = {0: b"", 2: b"", 3: b""}
         c._crypto_sent = {0: 0, 2: 0, 3: 0}
         c._crypto_recv_off = {0: 0, 2: 0, 3: 0}

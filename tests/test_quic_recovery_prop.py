@@ -1,0 +1,212 @@
+"""Property tests: quic/recovery.py under adversarial ACK delivery.
+
+The recovery model's contract, exercised with seeded reordered,
+duplicated, and delayed ack ranges over randomized packetizations:
+
+  * the contiguous-prefix watermark NEVER regresses;
+  * no range is retransmitted after it was acked (a spurious-loss ack
+    beats a queued retransmit);
+  * PTO requeues EXACTLY the unacked ranges — nothing acked, nothing
+    missing.
+
+Crypto-free by design (recovery.py's whole point), so this runs in
+the tier-1 environment."""
+
+import random
+
+from emqx_tpu.quic.recovery import (
+    RangeTracker, RecoverySpace, SentPacket,
+)
+
+
+def _overlaps(a, b):
+    return a[0] < b[1] and b[0] < a[1]
+
+
+def _ranges_union_len(ranges):
+    total = 0
+    last = -1
+    for s, e in sorted(ranges):
+        s = max(s, last)
+        if e > s:
+            total += e - s
+            last = e
+    return total
+
+
+# ------------------------------------------------------- RangeTracker
+
+
+def test_range_tracker_matches_reference_set():
+    """`add`/`contiguous_from`/`missing_within` agree with a byte-set
+    reference model under random merges."""
+    for seed in range(6):
+        rng = random.Random(seed)
+        rt = RangeTracker()
+        ref = set()
+        for _ in range(200):
+            s = rng.randrange(0, 2000)
+            e = s + rng.randrange(0, 60)
+            rt.add(s, e)
+            ref.update(range(s, e))
+            # contiguous watermark from 0 == longest prefix in ref
+            wm = rt.contiguous_from(0)
+            expect = 0
+            while expect in ref:
+                expect += 1
+            assert wm == expect
+            # missing_within on a random window == ref complement
+            lo = rng.randrange(0, 2000)
+            hi = lo + rng.randrange(1, 200)
+            missing = set()
+            for ms, me in rt.missing_within(lo, hi):
+                missing.update(range(ms, me))
+            assert missing == {
+                b for b in range(lo, hi) if b not in ref
+            }
+        # ranges stay sorted + disjoint
+        for (s1, e1), (s2, e2) in zip(rt.ranges, rt.ranges[1:]):
+            assert e1 < s2 and s1 < e1
+
+
+def test_range_tracker_prune_below_keeps_tail_exact():
+    rt = RangeTracker()
+    rt.add(0, 10)
+    rt.add(20, 30)
+    rt.add(40, 50)
+    rt.prune_below(25)
+    assert rt.ranges == [(25, 30), (40, 50)]
+    assert rt.missing_within(25, 50) == [(30, 40)]
+
+
+# ---------------------------------------------- adversarial delivery
+
+
+def _world(seed):
+    """One seeded sender world: randomized packetization of a crypto
+    stream, an adversarial ack schedule (reordered, duplicated, a
+    delayed tail), interleaved threshold-loss + retransmission, then
+    a PTO sweep.  Returns nothing — asserts the three invariants
+    inline."""
+    rng = random.Random(seed)
+    space = RecoverySpace()
+    total = 0
+    next_pn = 0
+
+    def send(ranges):
+        nonlocal next_pn
+        pkt = SentPacket()
+        pkt.crypto.extend(ranges)
+        space.record(next_pn, pkt)
+        next_pn += 1
+        return next_pn - 1
+
+    # initial flight: contiguous stream in random-size packets
+    pns = []
+    while total < 20_000:
+        n = rng.randrange(200, 1400)
+        pns.append(send([(total, total + n)]))
+        total += n
+
+    # adversarial schedule: shuffle, duplicate ~20%, delay ~10% to
+    # the very end, and never ack ~15% at all
+    never = set(rng.sample(pns, len(pns) * 15 // 100))
+    order = [pn for pn in pns if pn not in never]
+    rng.shuffle(order)
+    delayed = set(rng.sample(order, len(order) // 10))
+    schedule = [pn for pn in order if pn not in delayed]
+    schedule += [
+        schedule[i]
+        for i in rng.sample(range(len(schedule)), len(schedule) // 5)
+    ]  # duplicates
+
+    watermark = 0
+    retransmitted = []  # (range, acked_snapshot) at queue time
+    for i, pn in enumerate(schedule):
+        space.on_ack_range(pn, pn)
+        wm = space.crypto_acked.contiguous_from(0)
+        assert wm >= watermark, "watermark regressed"
+        watermark = wm
+        if i % 7 == 3:
+            # threshold loss detection + retransmission round
+            lost = space.detect_lost()
+            space.queue_crypto_retx(
+                [r for p in lost for r in p.crypto]
+            )
+            for r in space.take_crypto_retx():
+                # invariant: nothing acked is ever retransmitted
+                for a in space.crypto_acked.ranges:
+                    assert not _overlaps(r, a), (
+                        f"acked range {a} retransmitted as {r}"
+                    )
+                retransmitted.append(r)
+                send([r])  # the retransmit goes back in flight
+
+    # delayed acks land AFTER loss declared them missing: the re-check
+    # in take_crypto_retx must drop them (ack beats retransmit)
+    for pn in delayed:
+        space.on_ack_range(pn, pn)
+        wm = space.crypto_acked.contiguous_from(0)
+        assert wm >= watermark
+        watermark = wm
+    lost = space.detect_lost()
+    space.queue_crypto_retx([r for p in lost for r in p.crypto])
+    for r in space.take_crypto_retx():
+        for a in space.crypto_acked.ranges:
+            assert not _overlaps(r, a)
+        send([r])
+
+    # PTO sweep: requeued ranges must be EXACTLY the unacked bytes
+    # still in flight — compare against the tracker's own complement
+    lost = space.on_pto()
+    assert not space.sent  # everything in flight was declared lost
+    inflight_ranges = [r for p in lost for r in p.crypto]
+    space.queue_crypto_retx(inflight_ranges)
+    requeued = space.take_crypto_retx()
+    expect = []
+    for r in inflight_ranges:
+        expect.extend(space.crypto_acked.missing_within(*r))
+    assert _ranges_union_len(requeued) == _ranges_union_len(expect)
+    for r in requeued:
+        for a in space.crypto_acked.ranges:
+            assert not _overlaps(r, a)
+    # and the unacked tail is fully covered: requeued ∪ acked ⊇ every
+    # byte the never-acked packets carried
+    covered = RangeTracker()
+    for s, e in requeued:
+        covered.add(s, e)
+    for a_s, a_e in space.crypto_acked.ranges:
+        covered.add(a_s, a_e)
+    assert covered.missing_within(0, total) == [], (
+        "PTO requeue left a hole"
+    )
+
+
+def test_adversarial_ack_delivery_six_seeds():
+    for seed in (1, 7, 42, 1337, 20260804, 9):
+        _world(seed)
+
+
+def test_duplicate_ack_is_idempotent():
+    """Acking the same pn twice releases its record once and changes
+    nothing the second time."""
+    space = RecoverySpace()
+    pkt = SentPacket()
+    pkt.crypto.append((0, 100))
+    space.record(0, pkt)
+    assert len(space.on_ack_range(0, 0)) == 1
+    assert space.on_ack_range(0, 0) == []
+    assert space.crypto_acked.ranges == [(0, 100)]
+
+
+def test_pto_then_late_ack_suppresses_retransmit():
+    """A PTO declares a packet lost; its ack lands before the flush —
+    the re-filter in take_crypto_retx must retransmit nothing."""
+    space = RecoverySpace()
+    pkt = SentPacket()
+    pkt.crypto.append((0, 500))
+    space.record(0, pkt)
+    lost = space.on_pto()
+    space.queue_crypto_retx([r for p in lost for r in p.crypto])
+    space.crypto_acked.add(0, 500)  # the "spurious loss" ack arrives
+    assert space.take_crypto_retx() == []

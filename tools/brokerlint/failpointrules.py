@@ -63,6 +63,16 @@ SEAM_FUNCS: Tuple[Seam, ...] = (
          "ds.replay.read"),
     Seam("emqx_tpu/broker/resume.py", "ResumeScheduler._commit",
          "session.resume.commit"),
+    Seam("emqx_tpu/cluster/quic_transport.py",
+         "QuicPeerLink._transmit", "cluster.quic.send"),
+    Seam("emqx_tpu/cluster/quic_transport.py",
+         "QuicPeerLink._on_datagram", "cluster.quic.recv"),
+    Seam("emqx_tpu/cluster/quic_transport.py",
+         "QuicPeerEndpoint.transmit", "cluster.quic.send"),
+    Seam("emqx_tpu/cluster/quic_transport.py",
+         "QuicPeerEndpoint.on_datagram", "cluster.quic.recv"),
+    Seam("emqx_tpu/cluster/node.py", "ClusterNode._send_fwd_ack",
+         "cluster.forward.ack"),
 )
 
 

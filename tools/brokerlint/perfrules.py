@@ -68,6 +68,16 @@ DISPATCH_FUNCS = (
                "ResumeScheduler._drain_window"),
     DispatchFn("emqx_tpu/broker/resume.py",
                "ResumeScheduler._append_run"),
+    # cluster forward reliability hot path (PR 11): one encode + one
+    # clock read per peer frame, span work gated on the sampled copy
+    DispatchFn("emqx_tpu/cluster/node.py",
+               "ClusterNode._flush_forwards"),
+    DispatchFn("emqx_tpu/cluster/node.py",
+               "ClusterNode._handle_forward_batch"),
+    DispatchFn("emqx_tpu/cluster/node.py",
+               "ClusterNode._handle_fwd_ack"),
+    DispatchFn("emqx_tpu/cluster/quic_transport.py",
+               "_send_datagrams"),
 )
 
 # callee tails that mean "re-encode a wire frame"
