@@ -1027,8 +1027,11 @@ class Broker:
                         results[i] = 0
                 j += 1
         if rule_sink:
+            # ONE registry pass for the whole window: shared column
+            # extraction + the rules x window matrix (rec carries the
+            # rules_extract/rules_eval sub-stage attribution)
             try:
-                self.rules.apply_batch(rule_sink)
+                self.rules.apply_batch(rule_sink, rec=rec)
             except Exception:
                 log.exception("rule batch failed for window")
             if rec is not None:
@@ -1163,15 +1166,15 @@ class Broker:
         if rec is not None:
             rec.lap("expand")
         if rules and run_rules:
-            by_msg: Dict[int, set] = {}
-            for i, rid in rules:
-                by_msg.setdefault(i, set()).add(rid)
-            for i, rids in by_msg.items():
-                ids = sorted(rids)
+            # ``rules`` is already grouped per message; the sink takes
+            # the RAW id lists (the rule engine's flatten cache dedups
+            # and canonicalizes vectorized), the per-message path
+            # dedups here
+            for i, rids in rules:
                 if rule_sink is not None:
-                    rule_sink.append((msgs[i], ids))
+                    rule_sink.append((msgs[i], rids))
                 else:
-                    self.rules.apply(msgs[i], ids)
+                    self.rules.apply(msgs[i], sorted(set(rids)))
         # shared-group columns: one live member per (msg, filter, group)
         s_msg: List[int] = []
         s_rows: List[int] = []

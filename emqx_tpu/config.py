@@ -109,6 +109,11 @@ class BrokerEngineConfig:
     # throughput stops serializing on the host<->device round-trip
     # (dispatch stays strictly in window order)
     pipeline_windows: int = 4
+    # rule-engine WHERE predicates evaluate as one rules x window
+    # boolean matrix over shared column planes (False pins the
+    # per-rule interpreter walk; EMQX_TPU_NO_RULES_MATRIX=1 is the
+    # env-level kill switch)
+    rules_matrix: bool = True
 
 
 @dataclass

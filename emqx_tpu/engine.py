@@ -477,6 +477,25 @@ class MatchEngine:
         self._dec_dev_warm = False
         self._dec_seq = 0
         self._dec_probe_seq = 0
+        # ---- rules x window matrix step (rule-engine predicates) ---
+        # The rule engine's stacked WHERE programs (rules/predicate.py
+        # StackedRules) evaluate over the window's shared column
+        # planes as one rules x window boolean matrix
+        # (ops.match_kernel.rules_eval_host / rules_eval_batch).
+        # Host-vs-device resolves per window from per-CELL (rule x
+        # message) cost EWMAs, device faults feed the SAME PR 1
+        # breaker, and the device path additionally gates on f32
+        # safety (the kernel computes in float32; arith programs and
+        # f32-lossy columns stay on the float64 host twin).
+        self.rules_force: Optional[str] = None  # "host"/"dev" pin
+        self._rul_host_us: Optional[float] = None  # µs/cell EWMAs
+        self._rul_dev_us: Optional[float] = None
+        self._rul_stats = {"host_windows": 0, "dev_windows": 0,
+                           "dev_errors": 0}
+        self._rul_prog_cache: Optional[Tuple] = None  # (rev, arrays)
+        self._rul_dev_warm = False
+        self._rul_seq = 0
+        self._rul_probe_seq = 0
         # ---- device-path circuit breaker (failure-driven degradation)
         # The auto policy above switches paths on measured COST; the
         # breaker switches on FAILURE: `breaker_threshold` consecutive
@@ -1362,6 +1381,11 @@ class MatchEngine:
         out["decide_host_windows"] = self._dec_stats["host_windows"]
         out["decide_dev_windows"] = self._dec_stats["dev_windows"]
         out["decide_dev_errors"] = self._dec_stats["dev_errors"]
+        out["rules_host_windows"] = self._rul_stats["host_windows"]
+        out["rules_dev_windows"] = self._rul_stats["dev_windows"]
+        out["rules_dev_errors"] = self._rul_stats["dev_errors"]
+        out["rules_host_us_ewma"] = self._rul_host_us
+        out["rules_dev_us_ewma"] = self._rul_dev_us
         return out
 
     # -------------------------------------------------------------- match
@@ -1639,6 +1663,193 @@ class MatchEngine:
             pad(m_from_row, bpad, -1, np.int32),
         )
         return np.asarray(packed)[:n]
+
+    # -------------------------------------- rules x window matrix
+
+    def rules_eval_window(self, stack, rev: int, cols, rows=None):
+        """Evaluate the rule registry's stacked WHERE program against
+        one window's column planes: the ``[n_rules, n_msgs]`` boolean
+        pass matrix, host numpy twin or the fused device kernel
+        chosen per window by the measured per-cell cost EWMAs.
+
+        ``stack`` is a `rules.predicate.StackedRules`, ``rev`` the
+        rule engine's mutation counter (the device program-array
+        cache keys on it), ``cols`` a `rules.columns.WindowColumns`.
+        ``rows`` (sorted int array) names the matrix rows whose rules
+        actually matched this window's topics: the host twin
+        row-slices the program to just those and scatters back (a
+        partitioned 10k-rule registry evaluates only the matched
+        slice), while the device path keeps the full rev-cached
+        program upload.  A device fault degrades THIS window to the
+        bit-identical host twin and counts against the shared PR 1
+        circuit breaker, so a dead device path trips matching,
+        deciding and rule eval to host together; the background
+        breaker probe heals all three."""
+        n_active = stack.n_rules if rows is None else len(rows)
+        n = n_active * cols.n
+        if n and self._rules_choose(stack, cols, n):
+            try:
+                t0 = time.perf_counter()
+                mat = self._rules_device(stack, rev, cols)
+                us = (time.perf_counter() - t0) * 1e6 / n
+                if self._rul_dev_warm:
+                    self._rul_dev_us = (
+                        us if self._rul_dev_us is None
+                        else 0.2 * us + 0.8 * self._rul_dev_us
+                    )
+                else:
+                    # first device window: JIT compile dominated the
+                    # wall time — warm only, don't record
+                    self._rul_dev_warm = True
+                self._rul_stats["dev_windows"] += 1
+                return mat, "dev"
+            except Exception:
+                self._rul_stats["dev_errors"] += 1
+                self._device_failure("rules")
+                import logging
+
+                logging.getLogger("emqx_tpu.engine").exception(
+                    "device rules eval failed for %dx%d matrix; "
+                    "host columns", stack.n_rules, cols.n,
+                )
+        from .ops.match_kernel import rules_eval_host
+
+        t0 = time.perf_counter()
+        if rows is not None and n_active < stack.n_rules:
+            sub = rules_eval_host(
+                stack.code[rows], stack.a0[rows], stack.a1[rows],
+                stack.a2[rows], stack.a3[rows], stack.litn[rows],
+                cols.lit_ranks, stack.last[rows],
+                cols.num, cols.sid, cols.err, cols.prs,
+            )
+            mat = np.zeros((stack.n_rules, cols.n), bool)
+            mat[rows] = sub
+        else:
+            mat = rules_eval_host(
+                stack.code, stack.a0, stack.a1, stack.a2, stack.a3,
+                stack.litn, cols.lit_ranks, stack.last,
+                cols.num, cols.sid, cols.err, cols.prs,
+            )
+        if n:
+            us = (time.perf_counter() - t0) * 1e6 / n
+            self._rul_host_us = (
+                us if self._rul_host_us is None
+                else 0.2 * us + 0.8 * self._rul_host_us
+            )
+        self._rul_stats["host_windows"] += 1
+        return mat, "host"
+
+    def _rules_choose(self, stack, cols, n: int) -> bool:
+        """Host (False) or device (True) for an ``n``-cell rules
+        matrix.  `rules_force` pins the path (tests / benches); the
+        breaker overrides everything but a host pin, and scheduling a
+        heal probe here keeps a rules-heavy broker from staying
+        host-pinned forever; the f32 gate (arith programs, f32-lossy
+        literals or columns) protects the float64 oracle semantics."""
+        force = self.rules_force
+        if self._brk_open:
+            self._brk_maybe_probe()
+            return False
+        if force == "host":
+            return False
+        if force is None and self.use_device is False:
+            return False
+        # resolve the COST decision before the f32 gate: the gate's
+        # full-plane scan is O(P x W), and a window the policy would
+        # serve on host anyway must not pay it
+        if force == "dev" or self.use_device is True:
+            use_dev = True
+        else:
+            self._rul_seq += 1
+            host = (
+                self._rul_host_us
+                if self._rul_host_us is not None else 0.02
+            )
+            dev = self._rul_dev_us
+            if dev is None:
+                use_dev = n >= 16384
+            elif n >= 2048 and host > dev * 1.2:
+                use_dev = True
+            else:
+                # periodic in-band re-probe on a big matrix so a
+                # transient device slowdown can't pin the policy to
+                # host forever
+                use_dev = (
+                    n >= 16384
+                    and self._rul_seq - self._rul_probe_seq >= 1024
+                )
+            if use_dev:
+                self._rul_probe_seq = self._rul_seq
+        if not use_dev:
+            return False
+        # the f32 gate binds even under a dev pin: the device kernel
+        # cannot produce float64-correct results for these windows
+        if stack.has_arith or not stack.f32_lits_safe:
+            return False
+        return cols.f32_safe()
+
+    def _rules_device(self, stack, rev: int, cols) -> np.ndarray:
+        """One device rules step: upload the stacked program (cached
+        by the registry's ``rev``), pad rules/window to power-of-two
+        buckets (bounded shape classes, as `_decide_device` does),
+        run the fused kernel, slice the padding off."""
+        from .ops.match_kernel import rules_eval_batch
+
+        if failpoints.enabled:
+            # chaos seam: an injected error degrades this window to
+            # the host twin and feeds the shared device breaker
+            failpoints.evaluate("dispatch.rules.device")
+        r_n, w_n = stack.n_rules, cols.n
+        rpad = 8
+        while rpad < r_n:
+            rpad *= 2
+        wpad = 16
+        while wpad < w_n:
+            wpad *= 2
+
+        def padr(a, fill, dtype):  # [R, S] -> [rpad, S]
+            out = np.full((rpad,) + a.shape[1:], fill, dtype=dtype)
+            out[: a.shape[0]] = a
+            return out
+
+        cache = self._rul_prog_cache
+        if cache is None or cache[0] != (rev, rpad):
+            import jax
+
+            prog = (
+                padr(stack.code, 0, np.int32),
+                padr(stack.a0, -1, np.int32),
+                padr(stack.a1, -1, np.int32),
+                padr(stack.a2, -1, np.int32),
+                padr(stack.a3, -1, np.int32),
+                padr(stack.litn, 0.0, np.float32),
+                padr(stack.last, 0, np.int32),
+            )
+            cache = (
+                (rev, rpad),
+                tuple(jax.device_put(a) for a in prog),
+            )
+            self._rul_prog_cache = cache
+        code, a0, a1, a2, a3, litn, last = cache[1]
+
+        def padw(a, fill, dtype):  # [P, W] -> [max(P,1), wpad]
+            out = np.full(
+                (max(a.shape[0], 1), wpad), fill, dtype=dtype
+            )
+            out[: a.shape[0], :w_n] = a
+            return out
+
+        lit_ranks = cols.lit_ranks
+        if lit_ranks.size == 0:
+            lit_ranks = np.zeros(1, np.int32)
+        mat = rules_eval_batch(
+            code, a0, a1, a2, a3, litn, lit_ranks, last,
+            padw(cols.num, np.nan, np.float32),
+            padw(cols.sid, -1, np.int32),
+            padw(cols.err, False, bool),
+            padw(cols.prs, False, bool),
+        )
+        return np.asarray(mat)[:r_n, :w_n]
 
     def match_batch(
         self, topics: Sequence[str], congested: bool = False

@@ -56,6 +56,7 @@ ACTIONS = ("error", "delay", "drop", "duplicate", "panic")
 SEAMS = (
     "engine.device_step",
     "dispatch.decide.device",
+    "dispatch.rules.device",
     "cluster.transport.send",
     "cluster.transport.recv",
     "cluster.raft.rpc",

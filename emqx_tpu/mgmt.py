@@ -509,7 +509,13 @@ class MgmtApi:
     # ----------------------------------------------------------- rules
 
     async def get_rules(self, request: web.Request) -> web.Response:
-        return _json({"data": self.broker.rules.info()})
+        # "stats" carries the columnar-eval surface: lowered-vs-
+        # fallback registry split, matrix/scalar window counts, the
+        # engine's per-cell cost EWMAs and breaker state
+        return _json({
+            "data": self.broker.rules.info(),
+            "stats": self.broker.rules.stats(),
+        })
 
     async def post_rule(self, request: web.Request) -> web.Response:
         try:
@@ -1161,6 +1167,17 @@ class MgmtApi:
                 continue
             emit("engine_" + name, "gauge", value,
                  help_text=f"match engine {name}")
+        # rule-engine columnar-eval gauges (lowered/fallback registry
+        # split, matrix vs scalar window counts, per-cell cost EWMAs)
+        for name, value in sorted(self.broker.rules.stats().items()):
+            if value is None:
+                continue
+            if isinstance(value, bool):
+                value = int(value)
+            if not isinstance(value, (int, float)):
+                continue
+            emit("rules_" + name, "gauge", value,
+                 help_text=f"rule engine {name}")
         prof = self.broker.profiler
         for name, snap in sorted(prof.snapshots().items()):
             family = prom_name(f"emqx_profiler_{name}_us")

@@ -297,6 +297,346 @@ def decide_batch(
     return packed.astype(jnp.uint8)
 
 
+# ------------------------------------------------- rules x window eval
+#
+# The rule engine's WHERE predicates, stacked (rules/predicate.py
+# StackedRules) into opcode/operand matrices over the shared window
+# column planes (rules/columns.py WindowColumns), evaluate here as ONE
+# rules x window boolean matrix — the third kernel-backed stage after
+# match and decide, same numpy-twin / fused-@jax.jit / auto-policy
+# discipline.  Step s of each rule's row writes register s; numeric
+# registers are (value, defined) pairs, boolean registers are the
+# predicate compiler's (T, F) short-circuit pairs, so the matrix is
+# bit-identical to the scalar interpreter referee (property-tested).
+#
+# The host twin groups rows by opcode per step (numpy fancy indexing
+# over just the rules running that op); the device kernel computes
+# every op masked and selects — all elementwise [R, W] work XLA fuses
+# into one pass.  The device computes in float32 (TPU-native): the
+# engine gates it on f32-safe columns/literals and arith-free
+# programs, exactly `PredicateProgram._f32_safe`.
+
+from ..rules.predicate import (  # opcode space (compiler-owned)
+    R_BAND, R_BLIT, R_BNOT, R_BOR, R_CGE, R_CGT, R_CLE, R_CLT,
+    R_EQC, R_EQSL, R_EQVL, R_EQVV, R_NADD, R_NDIV, R_NIDV, R_NLIT,
+    R_NLOAD, R_NMOD, R_NMUL, R_NNEG, R_NSUB, R_PRES,
+)
+
+# host-twin rule-block size: bounds the [S, R_BLOCK, W] register file
+# (a 10k-rule registry evaluates in slabs, not one 700 MB tensor)
+RULES_HOST_BLOCK = 2048
+
+
+def rules_eval_host(
+    code, a0, a1, a2, a3, litn, lit_ranks, last,
+    num, sid, err, prs,
+):
+    """Numpy twin: evaluate the stacked program over the window
+    planes.  ``code``/``a0..a3``/``litn`` are ``[R, S]``; ``last`` is
+    ``[R]`` (each rule's result register); ``num``/``sid``/``err``/
+    ``prs`` are ``[P, W]`` column planes; ``lit_ranks`` maps string-
+    literal indices to this window's interned ranks.  Returns the
+    ``[R, W]`` boolean pass matrix."""
+    n_rules = code.shape[0]
+    if n_rules > RULES_HOST_BLOCK:
+        return np.concatenate([
+            rules_eval_host(
+                code[k:k + RULES_HOST_BLOCK],
+                a0[k:k + RULES_HOST_BLOCK], a1[k:k + RULES_HOST_BLOCK],
+                a2[k:k + RULES_HOST_BLOCK], a3[k:k + RULES_HOST_BLOCK],
+                litn[k:k + RULES_HOST_BLOCK], lit_ranks,
+                last[k:k + RULES_HOST_BLOCK],
+                num, sid, err, prs,
+            )
+            for k in range(0, n_rules, RULES_HOST_BLOCK)
+        ])
+    r_n, s_n = code.shape
+    w = num.shape[1]
+    nv = np.zeros((s_n, r_n, w), np.float64)
+    nd = np.zeros((s_n, r_n, w), bool)
+    bt = np.zeros((s_n, r_n, w), bool)
+    bf = np.zeros((s_n, r_n, w), bool)
+    nul = ~err & ~prs  # value is null (lookup ok, nothing there)
+    for s in range(s_n):
+        oc = code[:, s]
+        for op in np.unique(oc):
+            rows = np.flatnonzero(oc == op)
+            i0 = a0[rows, s]
+            i1 = a1[rows, s]
+            i2 = a2[rows, s]
+            if op == R_NLOAD:
+                v = num[i0]
+                nv[s, rows] = v
+                nd[s, rows] = ~np.isnan(v)
+            elif op == R_NLIT:
+                nv[s, rows] = litn[rows, s][:, None]
+                nd[s, rows] = True
+            elif op == R_NNEG:
+                nv[s, rows] = -nv[i0, rows]
+                nd[s, rows] = nd[i0, rows]
+            elif op in (R_NADD, R_NSUB, R_NMUL, R_NDIV, R_NIDV,
+                        R_NMOD):
+                lv, ld = nv[i0, rows], nd[i0, rows]
+                rv, rd = nv[i1, rows], nd[i1, rows]
+                d = ld & rd
+                if op == R_NADD:
+                    nv[s, rows], nd[s, rows] = lv + rv, d
+                elif op == R_NSUB:
+                    nv[s, rows], nd[s, rows] = lv - rv, d
+                elif op == R_NMUL:
+                    nv[s, rows], nd[s, rows] = lv * rv, d
+                elif op == R_NDIV:
+                    ok = rv != 0
+                    nv[s, rows] = np.where(
+                        ok, lv / np.where(ok, rv, 1), 0
+                    )
+                    nd[s, rows] = d & ok
+                else:  # div / mod: trunc both, then floor-divide
+                    ta, tb = np.trunc(lv), np.trunc(rv)
+                    ok = tb != 0
+                    safe = np.where(ok, tb, 1)
+                    q = np.floor(ta / safe)
+                    nv[s, rows] = q if op == R_NIDV else ta - q * safe
+                    nd[s, rows] = d & ok
+            elif op == R_BLIT:
+                v = (i0 == 1)[:, None]
+                bt[s, rows] = v
+                bf[s, rows] = ~v
+            elif op == R_BNOT:
+                bt[s, rows] = bf[i0, rows]
+                bf[s, rows] = bt[i0, rows]
+            elif op == R_BAND:
+                tl, fl = bt[i0, rows], bf[i0, rows]
+                tr, fr = bt[i1, rows], bf[i1, rows]
+                bt[s, rows] = tl & tr
+                bf[s, rows] = fl | (tl & fr)
+            elif op == R_BOR:
+                tl, fl = bt[i0, rows], bf[i0, rows]
+                tr, fr = bt[i1, rows], bf[i1, rows]
+                bt[s, rows] = tl | (fl & tr)
+                bf[s, rows] = fl & fr
+            elif op in (R_CGT, R_CLT, R_CGE, R_CLE):
+                lv, ld = nv[i0, rows], nd[i0, rows]
+                rv, rd = nv[i1, rows], nd[i1, rows]
+                d = ld & rd
+                cmp = {
+                    R_CGT: lv > rv, R_CLT: lv < rv,
+                    R_CGE: lv >= rv, R_CLE: lv <= rv,
+                }[op]
+                t = d & cmp
+                f = d & ~cmp
+                i3 = a3[rows, s]
+                sv = (i2 >= 0) & (i3 >= 0)  # bare-var sides: strings
+                if sv.any():
+                    sl = sid[np.where(sv, i2, 0)]
+                    sr = sid[np.where(sv, i3, 0)]
+                    ds = sv[:, None] & (sl >= 0) & (sr >= 0)
+                    cmps = {
+                        R_CGT: sl > sr, R_CLT: sl < sr,
+                        R_CGE: sl >= sr, R_CLE: sl <= sr,
+                    }[op]
+                    t = t | (ds & cmps)
+                    f = f | (ds & ~cmps)
+                bt[s, rows], bf[s, rows] = t, f
+            elif op == R_EQVV:
+                lp, rp = num[i0], num[i1]
+                eqn = ~np.isnan(lp) & ~np.isnan(rp) & (lp == rp)
+                sl, sr = sid[i0], sid[i1]
+                eqs = (sl != -1) & (sl == sr)
+                eqz = nul[i0] & nul[i1]  # null = null is TRUE
+                e = eqn | eqs | eqz
+                ok = ~err[i0] & ~err[i1]
+                t, f = e & ok, ~e & ok
+                neg = (i2 == 1)[:, None]
+                bt[s, rows] = np.where(neg, f, t)
+                bf[s, rows] = np.where(neg, t, f)
+            elif op == R_EQVL:
+                v = num[i0]
+                e = ~np.isnan(v) & (v == litn[rows, s][:, None])
+                ok = ~err[i0]
+                t, f = e & ok, ~e & ok
+                neg = (i2 == 1)[:, None]
+                bt[s, rows] = np.where(neg, f, t)
+                bf[s, rows] = np.where(neg, t, f)
+            elif op == R_EQSL:
+                lid = lit_ranks[i1][:, None]
+                ok = ~err[i0]
+                e = ok & (sid[i0] == lid)
+                ne = ok & (sid[i0] != lid)
+                neg = (i2 == 1)[:, None]
+                bt[s, rows] = np.where(neg, ne, e)
+                bf[s, rows] = np.where(neg, e, ne)
+            elif op == R_EQC:
+                lv, ld = nv[i0, rows], nd[i0, rows]
+                rv, rd = nv[i1, rows], nd[i1, rows]
+                e = ld & rd & (lv == rv)
+                i3 = a3[rows, s]
+                has_ok = i3 >= 0
+                if has_ok.any():
+                    ok = np.where(
+                        has_ok[:, None],
+                        ~err[np.where(has_ok, i3, 0)],
+                        True,
+                    )
+                else:
+                    # no simple-var side anywhere in this op group:
+                    # err may be a zero-path plane, so don't gather
+                    ok = np.ones((len(rows), w), bool)
+                cd = np.where((i2 & 2).astype(bool)[:, None], ld, True)
+                cd &= np.where((i2 & 4).astype(bool)[:, None], rd, True)
+                t = e & ok
+                f = cd & ~e & ok
+                neg = (i2 & 1).astype(bool)[:, None]
+                bt[s, rows] = np.where(neg, f, t)
+                bf[s, rows] = np.where(neg, t, f)
+            elif op == R_PRES:
+                ok = ~err[i0]
+                t = ok & prs[i0]
+                f = ok & ~prs[i0]
+                neg = (i2 == 1)[:, None]
+                bt[s, rows] = np.where(neg, f, t)
+                bf[s, rows] = np.where(neg, t, f)
+    return bt[last, np.arange(r_n)]
+
+
+@jax.jit
+def rules_eval_batch(
+    code, a0, a1, a2, a3, litn, lit_ranks, last,
+    num, sid, err, prs,
+):
+    """`rules_eval_host`'s fused device twin: every opcode computed
+    masked per step (all elementwise [R, W], one XLA fusion), values
+    in float32 — the engine only routes f32-safe, arith-free windows
+    here.  Static shapes come from the caller's pow-2 padded rule /
+    window buckets, as everywhere else in this kernel."""
+    num = num.astype(jnp.float32)
+    litn = litn.astype(jnp.float32)
+    r_n, s_n = code.shape
+    p_n = num.shape[0]
+    w = num.shape[1]
+    rr = jnp.arange(r_n)
+    nv = jnp.zeros((s_n, r_n, w), jnp.float32)
+    nd = jnp.zeros((s_n, r_n, w), bool)
+    bt = jnp.zeros((s_n, r_n, w), bool)
+    bf = jnp.zeros((s_n, r_n, w), bool)
+    nul = ~err & ~prs
+    fin = ~jnp.isnan(num)
+    for s in range(s_n):
+        oc = code[:, s][:, None]  # [R, 1] broadcast against [R, W]
+        i0, i1 = a0[:, s], a1[:, s]
+        i2, i3 = a2[:, s], a3[:, s]
+        ln = litn[:, s][:, None]
+        # register operand planes (clipped gathers; opcode mask picks)
+        ra = jnp.clip(i0, 0, s_n - 1)
+        rb = jnp.clip(i1, 0, s_n - 1)
+        lv, ld = nv[ra, rr], nd[ra, rr]
+        rv, rd = nv[rb, rr], nd[rb, rr]
+        tl, fl = bt[ra, rr], bf[ra, rr]
+        tr, fr = bt[rb, rr], bf[rb, rr]
+        # column operand planes
+        p0 = jnp.clip(i0, 0, p_n - 1)
+        p1 = jnp.clip(i1, 0, p_n - 1)
+        p3 = jnp.clip(i3, 0, p_n - 1)
+        n0, n1 = num[p0], num[p1]
+        f0, f1 = fin[p0], fin[p1]
+        s0, s1 = sid[p0], sid[p1]
+        e0, e1 = err[p0], err[p1]
+        d = ld & rd
+        # ---- numeric candidates
+        c_nv = jnp.where(oc == R_NLOAD, n0, 0.0)
+        c_nd = (oc == R_NLOAD) & f0
+        c_nv = jnp.where(oc == R_NLIT, ln, c_nv)
+        c_nd = c_nd | ((oc == R_NLIT) & True)
+        c_nv = jnp.where(oc == R_NNEG, -lv, c_nv)
+        c_nd = c_nd | ((oc == R_NNEG) & ld)
+        for op, val in ((R_NADD, lv + rv), (R_NSUB, lv - rv),
+                        (R_NMUL, lv * rv)):
+            c_nv = jnp.where(oc == op, val, c_nv)
+            c_nd = c_nd | ((oc == op) & d)
+        okd = rv != 0
+        c_nv = jnp.where(
+            oc == R_NDIV, jnp.where(okd, lv / jnp.where(okd, rv, 1), 0),
+            c_nv,
+        )
+        c_nd = c_nd | ((oc == R_NDIV) & d & okd)
+        ta, tb = jnp.trunc(lv), jnp.trunc(rv)
+        oki = tb != 0
+        safe = jnp.where(oki, tb, 1)
+        q = jnp.floor(ta / safe)
+        c_nv = jnp.where(oc == R_NIDV, q, c_nv)
+        c_nv = jnp.where(oc == R_NMOD, ta - q * safe, c_nv)
+        c_nd = c_nd | (
+            ((oc == R_NIDV) | (oc == R_NMOD)) & d & oki
+        )
+        # ---- boolean candidates
+        blv = (i0 == 1)[:, None] & jnp.ones((r_n, w), bool)
+        c_t = jnp.where(oc == R_BLIT, blv, False)
+        c_f = jnp.where(oc == R_BLIT, ~blv, False)
+        c_t = jnp.where(oc == R_BNOT, fl, c_t)
+        c_f = jnp.where(oc == R_BNOT, tl, c_f)
+        c_t = jnp.where(oc == R_BAND, tl & tr, c_t)
+        c_f = jnp.where(oc == R_BAND, fl | (tl & fr), c_f)
+        c_t = jnp.where(oc == R_BOR, tl | (fl & tr), c_t)
+        c_f = jnp.where(oc == R_BOR, fl & fr, c_f)
+        # ordering (numeric + bare-var string ranks)
+        sv = ((i2 >= 0) & (i3 >= 0))[:, None]
+        p2 = jnp.clip(i2, 0, p_n - 1)
+        sl = sid[p2]
+        sr = sid[p3]
+        ds = sv & (sl >= 0) & (sr >= 0)
+        for op, cmp, cmps in (
+            (R_CGT, lv > rv, sl > sr), (R_CLT, lv < rv, sl < sr),
+            (R_CGE, lv >= rv, sl >= sr), (R_CLE, lv <= rv, sl <= sr),
+        ):
+            c_t = jnp.where(
+                oc == op, (d & cmp) | (ds & cmps), c_t
+            )
+            c_f = jnp.where(
+                oc == op, (d & ~cmp) | (ds & ~cmps), c_f
+            )
+        neg = (i2 == 1)[:, None]
+        # var = var
+        eq = (f0 & f1 & (n0 == n1)) | ((s0 != -1) & (s0 == s1)) | (
+            nul[p0] & nul[p1]
+        )
+        ok = ~e0 & ~e1
+        t, f = eq & ok, ~eq & ok
+        c_t = jnp.where(oc == R_EQVV, jnp.where(neg, f, t), c_t)
+        c_f = jnp.where(oc == R_EQVV, jnp.where(neg, t, f), c_f)
+        # var = numeric literal
+        eq = f0 & (n0 == ln)
+        ok = ~e0
+        t, f = eq & ok, ~eq & ok
+        c_t = jnp.where(oc == R_EQVL, jnp.where(neg, f, t), c_t)
+        c_f = jnp.where(oc == R_EQVL, jnp.where(neg, t, f), c_f)
+        # var = string literal
+        lid = lit_ranks[jnp.clip(i1, 0, lit_ranks.shape[0] - 1)]
+        eq = ~e0 & (s0 == lid[:, None])
+        ne = ~e0 & (s0 != lid[:, None])
+        c_t = jnp.where(oc == R_EQSL, jnp.where(neg, ne, eq), c_t)
+        c_f = jnp.where(oc == R_EQSL, jnp.where(neg, eq, ne), c_f)
+        # equality with compound side(s)
+        eq = d & (lv == rv)
+        ok = jnp.where((i3 >= 0)[:, None], ~err[p3], True)
+        cd = jnp.where((i2 & 2).astype(bool)[:, None], ld, True)
+        cd = cd & jnp.where((i2 & 4).astype(bool)[:, None], rd, True)
+        t = eq & ok
+        f = cd & ~eq & ok
+        negc = (i2 & 1).astype(bool)[:, None]
+        c_t = jnp.where(oc == R_EQC, jnp.where(negc, f, t), c_t)
+        c_f = jnp.where(oc == R_EQC, jnp.where(negc, t, f), c_f)
+        # presence
+        ok = ~e0
+        t, f = ok & prs[p0], ok & ~prs[p0]
+        c_t = jnp.where(oc == R_PRES, jnp.where(neg, f, t), c_t)
+        c_f = jnp.where(oc == R_PRES, jnp.where(neg, t, f), c_f)
+        nv = nv.at[s].set(c_nv)
+        nd = nd.at[s].set(c_nd)
+        bt = bt.at[s].set(c_t)
+        bf = bf.at[s].set(c_f)
+    return bt[last, jnp.arange(r_n)]
+
+
 def decide_batch_host(
     oa_qos, oa_nl, oa_rap, oa_subid,
     opts_rows, client_rows, msg_idx,

@@ -382,15 +382,18 @@ class Router:
     def expand_window(
         self, matched: Sequence[Set]
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
-               List[Tuple[int, str]], List[Tuple[int, str, str]]]:
+               List[Tuple[int, List[str]]],
+               List[Tuple[int, str, str]]]:
         """CSR-expand one window's matched fid sets to flat delivery
         columns.
 
         Returns ``(msg_idx, client_rows, opts_rows, rules, shared)``:
         the three aligned int64 arrays cover every DIRECT (non-shared)
         delivery in the window — one vectorized concatenation over the
-        per-filter CSR columns — while rule fids come back as
-        ``(msg_idx, rule_id)`` and shared-group fids as
+        per-filter CSR columns — while rule fids come back grouped
+        per message as ``(msg_idx, [rule_id, ...])`` (RAW: unsorted,
+        a multi-filter rule may repeat; the rule engine's flatten
+        cache dedups vectorized) and shared-group fids as
         ``(msg_idx, real_filter, group)`` for the rule-sink and
         shared-pick paths.  Fids with no local state (e.g. raw engine
         fids preloaded by benchmarks) cost one dict miss each."""
@@ -398,14 +401,20 @@ class Router:
         seg_opts: List[np.ndarray] = []
         seg_msg: List[int] = []
         seg_len: List[int] = []
-        rules: List[Tuple[int, str]] = []
+        rules: List[Tuple[int, List[str]]] = []
         shared: List[Tuple[int, str, str]] = []
         csr = self._csr
         groups_for = self.shared.groups_for
+        rule_i = -1
+        rule_ids: List[str] = []
         for i, fids in enumerate(matched):
             for fid in fids:
-                if isinstance(fid, tuple):  # ("rule", rule_id, i)
-                    rules.append((i, fid[1]))
+                if type(fid) is tuple:  # ("rule", rule_id, i)
+                    if rule_i != i:
+                        rule_i = i
+                        rule_ids = []
+                        rules.append((i, rule_ids))
+                    rule_ids.append(fid[1])
                     continue
                 bucket = csr.get(fid)
                 if bucket is not None and bucket.rows:

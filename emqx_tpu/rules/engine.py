@@ -13,18 +13,35 @@ Actions mirror the reference's builtins (emqx_rule_actions): republish
 (with ${var} placeholder templates, `emqx_placeholder` semantics),
 console, and arbitrary Python callables (the hook for
 resource/bridge-style sinks).
+
+Execution is window-at-a-time: `apply_batch` decodes the dispatch
+window ONCE into shared column planes and evaluates every lowerable
+rule's WHERE as one rules x window boolean matrix (host numpy twin or
+the fused device kernel in ops/match_kernel.py, per the match
+engine's cost EWMAs) — the PAPER.md blueprint's "rule engine's SQL
+predicates compiled into the same batched kernel".  Non-lowerable
+predicates degrade per RULE to the interpreter over the same lazily
+materialized envs, never pushing the window off the matrix path.
 """
 
 from __future__ import annotations
 
 import logging
+import os
 import re as _re
+import time
+from collections import Counter
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+import numpy as np
+
 from ..message import Message
-from .predicate import PredicateProgram, compile_where
-from .runtime import build_env, eval_select, eval_where
+from .columns import WindowColumns
+from .predicate import (
+    PredicateProgram, StackedRules, build_stack, compile_where,
+)
+from .runtime import LazyEnv, build_env, eval_select, eval_where
 from .sql import ParsedSql, parse_sql
 
 log = logging.getLogger("emqx_tpu.rules")
@@ -141,8 +158,57 @@ class RuleEngine:
     def __init__(self, broker=None) -> None:
         self.broker = broker
         self.rules: Dict[str, Rule] = {}
+        # registry mutation counter: the stacked matrix program and
+        # the engine's device program-array cache both key on it, so
+        # add/remove/enable churn invalidates them coherently
+        self.rules_rev = 0
+        self._stack_cache: Optional[Tuple[int, StackedRules]] = None
+        # "scalar" pins the per-rule interpreter referee (the
+        # property suites' oracle); None takes the matrix path with
+        # host-vs-device resolved by the match engine's cost EWMAs
+        self.eval_force: Optional[str] = None
+        self._stats = {
+            "matrix_windows": 0, "scalar_windows": 0,
+            "fallback_rule_evals": 0,
+        }
+        cfg_on = True
+        if broker is not None:
+            cfg_on = getattr(
+                broker.config.engine, "rules_matrix", True
+            )
+        self._matrix_enabled = cfg_on and (
+            os.environ.get("EMQX_TPU_NO_RULES_MATRIX") != "1"
+        )
+        # rev-keyed flatten tables: a stable position per rule (the
+        # REGISTRY enumeration order — deterministic, so action order
+        # is reproducible across paths and runs), its Rule object /
+        # liveness / matrix row resolved once per rev, and a cache
+        # mapping each distinct raw id-list the router's expansion
+        # emits to its deduped position array — same-topic messages
+        # share one entry, so steady-state windows flatten with ~one
+        # dict probe per MESSAGE instead of per (rule x message) pair
+        self._flat_key: Optional[Tuple[int, bool]] = None
+        self._pos_objs: List[Rule] = []
+        self._pos_live = np.zeros(0, bool)
+        self._pos_row = np.zeros(0, np.int64)
+        self._pos_of: Dict[str, int] = {}
+        self._ids_cache: Dict[Tuple[str, ...], np.ndarray] = {}
 
     # ------------------------------------------------------ registry
+
+    def _stacked(self) -> StackedRules:
+        """The enabled registry's stacked WHERE program, rebuilt only
+        when ``rules_rev`` moved (registry churn invalidates)."""
+        cached = self._stack_cache
+        if cached is not None and cached[0] == self.rules_rev:
+            return cached[1]
+        stack = build_stack([
+            (rid, r.parsed.where)
+            for rid, r in self.rules.items()
+            if r.enabled
+        ])
+        self._stack_cache = (self.rules_rev, stack)
+        return stack
 
     def add_rule(
         self,
@@ -171,6 +237,7 @@ class RuleEngine:
             program=compile_where(parsed.where),
         )
         self.rules[rule_id] = rule
+        self.rules_rev += 1
         if self.broker is not None:
             eng = self.broker.router.engine
             for i, flt in enumerate(parsed.froms):
@@ -181,6 +248,7 @@ class RuleEngine:
         rule = self.rules.pop(rule_id, None)
         if rule is None:
             return False
+        self.rules_rev += 1
         if self.broker is not None:
             eng = self.broker.router.engine
             for i in range(len(rule.parsed.froms)):
@@ -189,6 +257,7 @@ class RuleEngine:
 
     def enable_rule(self, rule_id: str, enabled: bool) -> None:
         self.rules[rule_id].enabled = enabled
+        self.rules_rev += 1
 
     # ----------------------------------------------------- execution
 
@@ -216,67 +285,214 @@ class RuleEngine:
         return hits
 
     def apply_batch(
-        self, items: List[Tuple[Message, List[str]]]
+        self, items: List[Tuple[Message, List[str]]], rec=None
     ) -> int:
-        """Run rule hits for a whole publish micro-batch: per rule, the
-        WHERE evaluates over all its matched messages in one vectorized
-        column pass (PredicateProgram; interpreter fallback for
-        uncompilable predicates) — the batched analogue of
-        emqx_rule_runtime:apply_rules/3 per message."""
+        """Run rule hits for a whole dispatch window in ONE registry
+        pass: the window's messages decode once into shared column
+        planes (`WindowColumns`), every lowerable rule's WHERE
+        evaluates as a row of the stacked rules x window boolean
+        matrix (numpy host twin or the fused device kernel, chosen by
+        the match engine's cost EWMAs), and only non-lowerable rules
+        (regex/UDF-shaped calls, CASE) degrade — per RULE, not per
+        window — to the interpreter over the SAME lazily-materialized
+        envs.  Matched/passed/failed counters update once per rule
+        and broker metrics flush in one `inc_bulk` pass.
+
+        ``rec`` (the window's profiler record) takes ``rules_extract``
+        / ``rules_eval`` sub-stages so the bench can attribute column
+        extraction vs matrix evaluation inside the ``rules`` lap."""
         if not items:
             return 0
-        if len(items) == 1:
-            return self.apply(items[0][0], items[0][1])
         msgs = [m for m, _ in items]
-        env_cache: List[Optional[Dict[str, Any]]] = [None] * len(items)
+        n = len(msgs)
+        envs: List[Optional[LazyEnv]] = [None] * n
 
-        def env(i: int) -> Dict[str, Any]:
-            e = env_cache[i]
+        def env(i: int) -> LazyEnv:
+            e = envs[i]
             if e is None:
-                e = env_cache[i] = build_env(msgs[i])
+                e = envs[i] = LazyEnv(msgs[i])
             return e
 
-        by_rule: Dict[str, List[int]] = {}
-        for i, (_, rids) in enumerate(items):
-            for rid in rids:
-                by_rule.setdefault(rid, []).append(i)
-        hits = 0
-        for rid, idxs in by_rule.items():
-            rule = self.rules.get(rid)
-            if rule is None or not rule.enabled:
-                continue
-            rule.matched += len(idxs)
-            if rule.program is not None and len(idxs) > 1:
-                mask = rule.program.eval_batch([env(i) for i in idxs])
-                passed = [i for i, ok in zip(idxs, mask.tolist()) if ok]
-            else:
-                passed = [
-                    i
-                    for i in idxs
-                    if eval_where(rule.parsed.where, env(i))
-                ]
-            rule.failed += len(idxs) - len(passed)
-            rule.passed += len(passed)
-            hits += len(passed)
-            for i in passed:
+        # flatten the sink to (rule-position, msg) pair columns over
+        # the rev-stable position space (see __init__): one flatten-
+        # cache probe per message on the steady state, with dedup and
+        # canonical ordering done by `np.unique` once per DISTINCT
+        # raw id list
+        use_matrix = (
+            self._matrix_enabled and self.eval_force != "scalar"
+        )
+        stack: Optional[StackedRules] = None
+        if use_matrix:
+            stack = self._stacked()
+        key = (self.rules_rev, use_matrix)
+        if self._flat_key != key:
+            self._flat_key = key
+            objs = list(self.rules.values())
+            n_all = len(objs)
+            self._pos_objs = objs
+            self._pos_of = {
+                r.rule_id: k for k, r in enumerate(objs)
+            }
+            self._pos_live = np.fromiter(
+                (r.enabled for r in objs), bool, n_all
+            )
+            row_of = stack.row_of if stack is not None else {}
+            self._pos_row = np.fromiter(
+                (
+                    row_of.get(r.rule_id, -1) if r.enabled else -1
+                    for r in objs
+                ),
+                np.int64, n_all,
+            )
+            self._ids_cache = {}
+        objs = self._pos_objs
+        n_pos = len(objs)
+        pos_of = self._pos_of
+        cache = self._ids_cache
+        parts: List[np.ndarray] = []
+        lens: List[int] = []
+        for _, rids in items:
+            ck = tuple(rids)
+            arr = cache.get(ck)
+            if arr is None:
+                if len(cache) > 4096:
+                    cache.clear()
+                arr = cache[ck] = np.unique(np.fromiter(
+                    (
+                        pos_of[r] for r in rids if r in pos_of
+                    ),
+                    np.int64,
+                ))
+            parts.append(arr)
+            lens.append(arr.size)
+        ppos = (
+            np.concatenate(parts) if parts
+            else np.zeros(0, np.int64)
+        )
+        pmsg = np.repeat(np.arange(n, dtype=np.int64), lens)
+        plive = self._pos_live[ppos]
+        prow = self._pos_row[ppos]
+        matrix = None
+        if use_matrix:
+            known = prow >= 0
+            active = np.unique(prow[known])
+            if active.size:
+                t0 = time.perf_counter()
+                cols = WindowColumns(
+                    msgs, stack.paths, stack.lit_strings, envs
+                )
+                t1 = time.perf_counter()
+                if cols.has_nan_value:
+                    # a literal NaN payload value aliases the num
+                    # lane's not-a-number sentinel: this window's
+                    # rules take the interpreter (bit-exactness over
+                    # speed for a pathological payload)
+                    pass
+                elif self.broker is not None:
+                    matrix, _path = (
+                        self.broker.router.engine.rules_eval_window(
+                            stack, self.rules_rev, cols, rows=active
+                        )
+                    )
+                else:  # standalone engines: the host twin directly
+                    from ..ops.match_kernel import rules_eval_host
+
+                    sub = rules_eval_host(
+                        stack.code[active], stack.a0[active],
+                        stack.a1[active], stack.a2[active],
+                        stack.a3[active], stack.litn[active],
+                        cols.lit_ranks, stack.last[active],
+                        cols.num, cols.sid, cols.err, cols.prs,
+                    )
+                    matrix = np.zeros(
+                        (stack.n_rules, cols.n), bool
+                    )
+                    matrix[active] = sub
+                if matrix is not None:
+                    self._stats["matrix_windows"] += 1
+                    if rec is not None:
+                        t2 = time.perf_counter()
+                        rec.sub("rules_extract", t1 - t0)
+                        rec.sub("rules_eval", t2 - t1)
+        if matrix is None:
+            self._stats["scalar_windows"] += 1
+            known = np.zeros(len(ppos), bool)
+        passmask = np.zeros(len(ppos), bool)
+        if matrix is not None:
+            passmask[known] = matrix[prow[known], pmsg[known]]
+        # per-RULE interpreter fallback riding the shared lazy envs
+        # (one JSON decode per message, window-wide)
+        fb = np.nonzero(plive & ~known)[0]
+        if fb.size:
+            self._stats["fallback_rule_evals"] += int(fb.size)
+            ppos_l = ppos.tolist()
+            pmsg_l = pmsg.tolist()
+            for j in fb.tolist():
+                rule = objs[ppos_l[j]]
+                passmask[j] = eval_where(
+                    rule.parsed.where, env(pmsg_l[j])
+                )
+        passmask &= plive
+        # matched/passed/failed flush: ONE bincount pass over the
+        # pair columns, one += per rule TOUCHED this window
+        m_cnt = np.bincount(ppos[plive], minlength=n_pos)
+        p_cnt = np.bincount(ppos[passmask], minlength=n_pos)
+        touched = np.nonzero(m_cnt)[0]
+        for pos, mc, pc in zip(
+            touched.tolist(),
+            m_cnt[touched].tolist(),
+            p_cnt[touched].tolist(),
+        ):
+            rule = objs[pos]
+            rule.matched += mc
+            rule.passed += pc
+            rule.failed += mc - pc
+        hits = int(passmask.sum())
+        mloc: Counter = Counter()  # one inc_bulk flush per window
+        sel = np.nonzero(passmask)[0]
+        if sel.size:
+            # canonical action order: rule-major in REGISTRY order,
+            # message index ascending within a rule — identical
+            # across the device / host / scalar-referee paths
+            order = np.lexsort((pmsg[sel], ppos[sel]))
+            sel_l = sel[order].tolist()
+            ppos_l = ppos.tolist()
+            pmsg_l = pmsg.tolist()
+            for j in sel_l:
+                rule = objs[ppos_l[j]]
+                if not rule.actions:
+                    # nothing consumes the SELECT columns: skip the
+                    # per-hit projection entirely (counter-only rules)
+                    continue
+                i = pmsg_l[j]
                 selected = eval_select(rule.parsed, env(i))
-                self._run_actions(rule, selected, msgs[i])
-        if self.broker is not None and hits:
-            self.broker.metrics.inc("rules.matched", hits)
+                self._run_actions(rule, selected, msgs[i], mloc)
+        if hits:
+            mloc["rules.matched"] += hits
+        if self.broker is not None and mloc:
+            self.broker.metrics.inc_bulk(mloc)
         return hits
 
     def _run_actions(
-        self, rule: Rule, selected: Dict[str, Any], msg: Message
+        self,
+        rule: Rule,
+        selected: Dict[str, Any],
+        msg: Message,
+        mloc: Optional[Counter] = None,
     ) -> None:
         for action in rule.actions:
             try:
                 self._run_action(action, selected, msg)
                 rule.actions_success += 1
-                if self.broker is not None:
+                if mloc is not None:
+                    mloc["actions.success"] += 1
+                elif self.broker is not None:
                     self.broker.metrics.inc("actions.success")
             except Exception as exc:
                 rule.actions_failed += 1
-                if self.broker is not None:
+                if mloc is not None:
+                    mloc["actions.failed"] += 1
+                elif self.broker is not None:
                     self.broker.metrics.inc("actions.failed")
                 log.warning(
                     "rule %s action %s failed: %s",
@@ -339,3 +555,29 @@ class RuleEngine:
             }
             for r in self.rules.values()
         ]
+
+    def stats(self) -> Dict[str, Any]:
+        """The rule-eval gauge surface (`MatchEngine.stats()`-form):
+        lowered-vs-fallback registry split, path window counts, the
+        engine's per-cell cost EWMAs and breaker state — exposed
+        through ``/metrics``, ``GET /api/v5/rules`` and $SYS."""
+        stack = self._stacked()
+        out: Dict[str, Any] = {
+            "rules": len(self.rules),
+            "lowered": stack.n_lowered,
+            "program_rows": stack.n_rules,  # after program dedup
+            "fallback": len(stack.fallback),
+            "matrix_enabled": self._matrix_enabled,
+            "matrix_windows": self._stats["matrix_windows"],
+            "scalar_windows": self._stats["scalar_windows"],
+            "fallback_rule_evals": self._stats["fallback_rule_evals"],
+        }
+        if self.broker is not None:
+            eng = self.broker.router.engine
+            out["host_windows"] = eng._rul_stats["host_windows"]
+            out["dev_windows"] = eng._rul_stats["dev_windows"]
+            out["dev_errors"] = eng._rul_stats["dev_errors"]
+            out["host_us_ewma"] = eng._rul_host_us
+            out["dev_us_ewma"] = eng._rul_dev_us
+            out["breaker_open"] = eng.breaker_open
+        return out

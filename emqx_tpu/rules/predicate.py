@@ -434,3 +434,400 @@ def _num_eval_pair(expr: tuple, cols, lit_ids, xp):
 
 def _batch_len(cols) -> int:
     return next(iter(cols.values())).shape[0]
+
+
+# ===================================================================
+# Registry-wide lowering: the rules x window matrix program
+#
+# The PredicateProgram above vectorizes ONE rule over a batch of envs;
+# a broker with thousands of rules still pays a Python step per rule
+# per window.  The lowering below goes the rest of the way: each
+# rule's WHERE compiles into a LINEAR instruction row over a SHARED
+# column space (`rules/columns.py` extracts the window once), and the
+# whole registry stacks into opcode/operand matrices that
+# `ops.match_kernel.rules_eval_host` / `rules_eval_batch` evaluate as
+# one rules x window boolean matrix — the `decide_batch` discipline
+# applied to the rule engine (ROADMAP "compile rule-engine SQL
+# predicates into the batched kernel").
+#
+# Register machine: step s writes register s.  Numeric registers are
+# (value, defined) pairs; boolean registers are the same (T, F)
+# "provably true / provably false without error" pairs the
+# short-circuit algebra above uses, so error semantics stay
+# bit-identical to the interpreter.  Column planes per referenced
+# path (see WindowColumns): ``num`` (float64, NaN = not a number),
+# ``sid`` (int32 per-window string RANK, order-preserving, so string
+# ordering comparisons lower too; -1 = not a string, -2/-3 = bool
+# true/false), ``err`` (lookup raised), ``prs`` (lookup succeeded and
+# value is not null).
+# ===================================================================
+
+R_NOP = 0
+R_NLOAD = 1   # a0=plane           -> (num[p], ~isnan)
+R_NLIT = 2    # litn[r,s]          -> (lit, True)
+R_NNEG = 3    # a0=reg
+R_NADD = 4    # a0,a1=regs
+R_NSUB = 5
+R_NMUL = 6
+R_NDIV = 7    # defined &= rhs != 0
+R_NIDV = 8    # trunc both, floor-divide (interpreter div)
+R_NMOD = 9
+R_BLIT = 10   # a0 = 0/1
+R_BNOT = 11   # a0=reg             -> (F, T)
+R_BAND = 12   # a0,a1=regs         -> (Tl&Tr, Fl|(Tl&Fr))
+R_BOR = 13    # a0,a1=regs         -> (Tl|(Fl&Tr), Fl&Fr)
+R_CGT = 14    # a0,a1=num regs; a2,a3=string planes (-1: not a bare
+R_CLT = 15    #   var) — rows where BOTH sides are strings compare by
+R_CGE = 16    #   per-window rank (interpreter string ordering)
+R_CLE = 17
+R_EQVV = 18   # a0=plane p, a1=plane q, a2=negate
+R_EQVL = 19   # a0=plane p, numeric literal in litn, a2=negate
+R_EQSL = 20   # a0=plane p, a1=string-literal index, a2=negate
+R_EQC = 21    # a0,a1=num regs; a2=flags(neg|lcomp<<1|rcomp<<2);
+              #   a3=simple-var err plane for totality (-1: none)
+R_PRES = 22   # a0=plane p, a2=negate (negate -> is_null)
+
+# rows deeper than this fall back to the interpreter (bounds the
+# stacked register file: S x R x W planes)
+MAX_STEPS = 48
+
+# presence-check calls that lower onto the prs/err planes
+_PRESENCE_FUNCS = {"is_null": 1, "is_not_null": 0}
+
+
+class LoweredRule:
+    """One rule's linear program over its LOCAL path/literal spaces
+    (the stacker remaps to the registry-global spaces)."""
+
+    __slots__ = ("steps", "paths", "lit_strings", "has_arith")
+
+    def __init__(self) -> None:
+        # (op, a0, a1, a2, a3, litn)
+        self.steps: List[Tuple[int, int, int, int, int, float]] = []
+        self.paths: List[Tuple[str, ...]] = []
+        self.lit_strings: List[str] = []
+        self.has_arith = False
+
+    # ------------------------------------------------------- emit
+
+    def _emit(self, op, a0=-1, a1=-1, a2=-1, a3=-1, litn=0.0) -> int:
+        if len(self.steps) >= MAX_STEPS:
+            raise _Unsupported("program too long")
+        self.steps.append((op, a0, a1, a2, a3, float(litn)))
+        return len(self.steps) - 1
+
+    def _plane(self, path: Tuple[str, ...]) -> int:
+        if path not in self.paths:
+            self.paths.append(path)
+        return self.paths.index(path)
+
+    def _slit(self, s: str) -> int:
+        if s not in self.lit_strings:
+            self.lit_strings.append(s)
+        return self.lit_strings.index(s)
+
+    # ------------------------------------------------- bool position
+
+    def lower_bool(self, expr: tuple) -> int:
+        kind = expr[0]
+        if kind == "lit" and isinstance(expr[1], bool):
+            return self._emit(R_BLIT, 1 if expr[1] else 0)
+        if kind == "not":
+            return self._emit(R_BNOT, self.lower_bool(expr[1]))
+        if kind == "in":
+            lt = _check_val(expr[1])
+            reg = None
+            for e in expr[2]:
+                et = _check_val(e)
+                if "bool" in (lt, et):
+                    raise _Unsupported("bool in IN")
+                if lt != "var" and et != "var" and et != lt:
+                    raise _Unsupported("mixed IN list")
+                r = self.lower_cmp("=", expr[1], e)
+                reg = r if reg is None else self._emit(R_BOR, reg, r)
+            if reg is None:
+                raise _Unsupported("empty IN")
+            return reg
+        if kind == "op":
+            sym = expr[1]
+            if sym == "and":
+                return self._emit(
+                    R_BAND,
+                    self.lower_bool(expr[2]),
+                    self.lower_bool(expr[3]),
+                )
+            if sym == "or":
+                return self._emit(
+                    R_BOR,
+                    self.lower_bool(expr[2]),
+                    self.lower_bool(expr[3]),
+                )
+            if sym in ("=", "!=", ">", "<", ">=", "<="):
+                return self.lower_cmp(sym, expr[2], expr[3])
+        if kind == "call":
+            neg = _PRESENCE_FUNCS.get(expr[1])
+            if (
+                neg is not None
+                and len(expr[2]) == 1
+                and expr[2][0][0] == "var"
+            ):
+                p = self._plane(expr[2][0][1])
+                return self._emit(R_PRES, p, -1, neg)
+        raise _Unsupported(f"{kind} at boolean position")
+
+    # ------------------------------------------------- comparisons
+
+    def lower_cmp(self, sym: str, le: tuple, re_: tuple) -> int:
+        lt, rt = _check_val(le), _check_val(re_)
+        if "bool" in (lt, rt):
+            raise _Unsupported("bool compare")
+        if "str" in (lt, rt):
+            if lt == "str" and rt == "str":
+                if sym in ("=", "!="):
+                    # constant-fold literal equality (IN lists build
+                    # these); _sql_eq semantics on two str literals
+                    eq = le[1] == re_[1]
+                    if sym == "!=":
+                        eq = not eq
+                    return self._emit(R_BLIT, 1 if eq else 0)
+                raise _Unsupported("str-str compare is constant")
+            other = rt if lt == "str" else lt
+            if other != "var":
+                raise _Unsupported("str vs num compare")
+            if sym not in ("=", "!="):
+                raise _Unsupported("string ordering vs literal")
+            lit, var = (le, re_) if lt == "str" else (re_, le)
+            return self._emit(
+                R_EQSL,
+                self._plane(var[1]),
+                self._slit(lit[1]),
+                1 if sym == "!=" else 0,
+            )
+        if sym in ("=", "!="):
+            neg = 1 if sym == "!=" else 0
+            if lt == "var" and rt == "var":
+                return self._emit(
+                    R_EQVV, self._plane(le[1]), self._plane(re_[1]), neg
+                )
+            if lt == "var" and rt == "num":
+                return self._emit(
+                    R_EQVL, self._plane(le[1]), -1, neg, -1, re_[1]
+                )
+            if lt == "num" and rt == "var":
+                return self._emit(
+                    R_EQVL, self._plane(re_[1]), -1, neg, -1, le[1]
+                )
+            if lt == "num" and rt == "num":
+                eq = float(le[1]) == float(re_[1])
+                if neg:
+                    eq = not eq
+                return self._emit(R_BLIT, 1 if eq else 0)
+            # a compound side carries its own error semantics
+            a = self.lower_num(le)
+            b = self.lower_num(re_)
+            flags = neg
+            if not _is_simple(le):
+                flags |= 2
+            if not _is_simple(re_):
+                flags |= 4
+            okp = -1
+            if le[0] == "var":
+                okp = self._plane(le[1])
+            elif re_[0] == "var":
+                okp = self._plane(re_[1])
+            return self._emit(R_EQC, a, b, flags, okp)
+        # ordering: numeric via the regs, string via per-window ranks
+        # when BOTH sides are bare vars (rank order == lex order)
+        a = self.lower_num(le)
+        b = self.lower_num(re_)
+        sp = self._plane(le[1]) if le[0] == "var" else -1
+        sq = self._plane(re_[1]) if re_[0] == "var" else -1
+        op = {">": R_CGT, "<": R_CLT, ">=": R_CGE, "<=": R_CLE}[sym]
+        return self._emit(op, a, b, sp, sq)
+
+    # --------------------------------------------------- value (num)
+
+    def lower_num(self, expr: tuple) -> int:
+        kind = expr[0]
+        if kind == "lit":
+            v = expr[1]
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                raise _Unsupported(f"numeric literal {v!r}")
+            return self._emit(R_NLIT, -1, -1, -1, -1, v)
+        if kind == "var":
+            return self._emit(R_NLOAD, self._plane(expr[1]))
+        if kind == "neg":
+            if _check_val(expr[1]) not in ("num", "var", "expr"):
+                raise _Unsupported("neg of non-number")
+            self.has_arith = True
+            return self._emit(R_NNEG, self.lower_num(expr[1]))
+        if kind == "op" and expr[1] in ("+", "-", "*", "/", "div", "mod"):
+            for sub in (expr[2], expr[3]):
+                if _check_val(sub) not in ("num", "var", "expr"):
+                    raise _Unsupported("arith on non-numbers")
+            if expr[1] == "+" and _could_be_str(expr[2]) and (
+                _could_be_str(expr[3])
+            ):
+                # interpreter '+' CONCATENATES two runtime strings;
+                # the numeric lanes cannot — degrade this rule.
+                # (string + number errors on both paths, so a single
+                # could-be-string side stays lowerable.)
+                raise _Unsupported("possible string concat")
+            self.has_arith = True
+            op = {
+                "+": R_NADD, "-": R_NSUB, "*": R_NMUL,
+                "/": R_NDIV, "div": R_NIDV, "mod": R_NMOD,
+            }[expr[1]]
+            return self._emit(
+                op, self.lower_num(expr[2]), self.lower_num(expr[3])
+            )
+        raise _Unsupported(kind)
+
+
+def _could_be_str(expr: tuple) -> bool:
+    """Can this value expression produce a STRING at runtime?  Bare
+    vars are dual-typed; a ``+`` of two could-be-strings can
+    concatenate; every other arith shape errors on strings (making
+    its result numeric-or-error on both paths)."""
+    if expr[0] == "var":
+        return True
+    if expr[0] == "op" and expr[1] == "+":
+        return _could_be_str(expr[2]) and _could_be_str(expr[3])
+    return False
+
+
+def lower_where(where: Optional[tuple]) -> Optional[LoweredRule]:
+    """Lower one WHERE into a linear program row, or None when any
+    node is outside the lowerable subset (regex/UDF-shaped calls,
+    CASE, bare vars in boolean position, over-long programs) — the
+    caller then degrades that RULE, not the window, to the
+    interpreter."""
+    prog = LoweredRule()
+    if where is None:
+        prog._emit(R_BLIT, 1)  # no WHERE: every routed message passes
+        return prog
+    try:
+        prog.lower_bool(where)
+    except _Unsupported:
+        return None
+    return prog
+
+
+class StackedRules:
+    """The whole registry's lowerable rules as one stacked program:
+    opcode/operand matrices ``[R, S]`` over a shared plane space, plus
+    the fallback set.  Built once per ``rules_rev`` (registry churn
+    invalidates); `ops.match_kernel.rules_eval_host`/`rules_eval_batch`
+    evaluate it against a `WindowColumns` extraction.
+
+    Identical programs DEDUP to one matrix row (``row_of`` maps every
+    rule id to its shared row): a fleet registry of thousands of
+    per-device rules differing only in topic filter — the IoT-pipeline
+    shape — evaluates its WHERE once per distinct program, not once
+    per rule, while per-rule matched/passed counters stay exact (the
+    pair bookkeeping is per rule, only the boolean matrix is
+    shared)."""
+
+    __slots__ = (
+        "row_of", "fallback", "paths", "lit_strings",
+        "code", "a0", "a1", "a2", "a3", "litn", "last",
+        "has_arith", "n_steps", "f32_lits_safe", "n_lowered",
+    )
+
+    def __init__(self, lowered: List[Tuple[str, LoweredRule]],
+                 fallback: List[str]) -> None:
+        self.fallback = fallback
+        self.n_lowered = len(lowered)
+        paths: List[Tuple[str, ...]] = []
+        path_ix: Dict[Tuple[str, ...], int] = {}
+        lits: List[str] = []
+        lit_ix: Dict[str, int] = {}
+        self.has_arith = any(p.has_arith for _, p in lowered)
+        n_steps = max((len(p.steps) for _, p in lowered), default=1)
+        self.n_steps = n_steps
+        # which operand slots hold a plane index (per opcode) — the
+        # stacker remaps those from rule-local to global planes
+        plane_slots = {
+            R_NLOAD: (0,), R_EQVV: (0, 1), R_EQVL: (0,),
+            R_EQSL: (0,), R_PRES: (0,),
+            R_CGT: (2, 3), R_CLT: (2, 3), R_CGE: (2, 3), R_CLE: (2, 3),
+            R_EQC: (3,),
+        }
+        row_of: Dict[str, int] = {}
+        uniq: Dict[Tuple, int] = {}
+        programs: List[Tuple] = []
+        for rid, prog in lowered:
+            pmap = []
+            for p in prog.paths:
+                if p not in path_ix:
+                    path_ix[p] = len(paths)
+                    paths.append(p)
+                pmap.append(path_ix[p])
+            lmap = []
+            for s in prog.lit_strings:
+                if s not in lit_ix:
+                    lit_ix[s] = len(lits)
+                    lits.append(s)
+                lmap.append(lit_ix[s])
+            remapped = []
+            for op, b0, b1, b2, b3, lv in prog.steps:
+                args = [b0, b1, b2, b3]
+                for slot in plane_slots.get(op, ()):
+                    if args[slot] >= 0:
+                        args[slot] = pmap[args[slot]]
+                if op == R_EQSL and args[1] >= 0:
+                    args[1] = lmap[args[1]]
+                remapped.append((op, *args, lv))
+            key = tuple(remapped)
+            row = uniq.get(key)
+            if row is None:
+                row = uniq[key] = len(programs)
+                programs.append(key)
+            row_of[rid] = row
+        self.row_of = row_of
+        n_rows = max(len(programs), 0)
+        code = np.zeros((n_rows, n_steps), np.int32)
+        a0 = np.full((n_rows, n_steps), -1, np.int32)
+        a1 = np.full((n_rows, n_steps), -1, np.int32)
+        a2 = np.full((n_rows, n_steps), -1, np.int32)
+        a3 = np.full((n_rows, n_steps), -1, np.int32)
+        litn = np.zeros((n_rows, n_steps), np.float64)
+        last = np.zeros(n_rows, np.int32)
+        for r, steps in enumerate(programs):
+            for s, (op, c0, c1, c2, c3, lv) in enumerate(steps):
+                code[r, s] = op
+                a0[r, s], a1[r, s] = c0, c1
+                a2[r, s], a3[r, s] = c2, c3
+                litn[r, s] = lv
+            last[r] = len(steps) - 1
+        self.paths = paths
+        self.lit_strings = lits
+        self.code, self.litn, self.last = code, litn, last
+        self.a0, self.a1, self.a2, self.a3 = a0, a1, a2, a3
+        # numeric literals that survive float32 (device path gate,
+        # same rule as PredicateProgram._f32_safe)
+        self.f32_lits_safe = all(
+            float(np.float32(v)) == v for v in litn.ravel().tolist()
+        )
+
+    @property
+    def n_rules(self) -> int:
+        """Distinct program rows (rules sharing a program share a
+        row; `n_lowered` counts the rules themselves)."""
+        return self.code.shape[0]
+
+
+def build_stack(
+    rules: Sequence[Tuple[str, Optional[tuple]]]
+) -> StackedRules:
+    """Stack every lowerable ``(rule_id, where)``; the rest land in
+    ``fallback`` (degrade per RULE, never per window)."""
+    lowered: List[Tuple[str, LoweredRule]] = []
+    fallback: List[str] = []
+    for rid, where in rules:
+        prog = lower_where(where)
+        if prog is None:
+            fallback.append(rid)
+        else:
+            lowered.append((rid, prog))
+    return StackedRules(lowered, fallback)

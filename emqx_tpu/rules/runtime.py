@@ -26,22 +26,10 @@ class EvalError(Exception):
 
 def build_env(msg: Message, node: str = "emqx_tpu@local") -> Dict[str, Any]:
     """The '$events/message_publish' env (emqx_rule_events.erl
-    eventmsg_publish): flat columns + lazily-decoded payload."""
-    return {
-        "event": "message.publish",
-        "id": msg.mid.hex(),
-        "clientid": msg.from_client,
-        "username": msg.from_username,
-        "topic": msg.topic,
-        "qos": msg.qos,
-        "payload": _PayloadStr(msg.payload),
-        "flags": {"retain": msg.retain, "dup": msg.dup, "sys": msg.sys},
-        "retain": msg.retain,
-        "pub_props": dict(msg.properties),
-        "timestamp": int(msg.timestamp * 1000),
-        "publish_received_at": int(msg.timestamp * 1000),
-        "node": node,
-    }
+    eventmsg_publish): flat columns + lazily-decoded payload.  Built
+    field-by-field from `_env_field` — the same single source of
+    truth `LazyEnv` materializes from on demand."""
+    return {k: _env_field(msg, k, node) for k in _ENV_KEYS}
 
 
 class _PayloadStr(str):
@@ -58,6 +46,75 @@ class _PayloadStr(str):
         if self._decoded is None:  # type: ignore[attr-defined]
             self._decoded = json.loads(str(self))  # type: ignore[attr-defined]
         return self._decoded  # type: ignore[attr-defined]
+
+
+def _env_field(msg: Message, key: str, node: str) -> Any:
+    """One `build_env` field, computed on demand (LazyEnv)."""
+    if key == "event":
+        return "message.publish"
+    if key == "id":
+        return msg.mid.hex()
+    if key == "clientid":
+        return msg.from_client
+    if key == "username":
+        return msg.from_username
+    if key == "topic":
+        return msg.topic
+    if key == "qos":
+        return msg.qos
+    if key == "payload":
+        return _PayloadStr(msg.payload)
+    if key == "flags":
+        return {"retain": msg.retain, "dup": msg.dup, "sys": msg.sys}
+    if key == "retain":
+        return msg.retain
+    if key == "pub_props":
+        return dict(msg.properties)
+    if key in ("timestamp", "publish_received_at"):
+        return int(msg.timestamp * 1000)
+    if key == "node":
+        return node
+    raise KeyError(key)
+
+
+_ENV_KEYS = (
+    "event", "id", "clientid", "username", "topic", "qos", "payload",
+    "flags", "retain", "pub_props", "timestamp",
+    "publish_received_at", "node",
+)
+_ENV_FIELDS = frozenset(_ENV_KEYS)
+
+
+class LazyEnv(dict):
+    """`build_env` that materializes only the fields a predicate or
+    SELECT actually touches.  A fallback rule reading one payload
+    field over a wide message costs one payload decode and ONE dict
+    entry, not the full 13-field env — and the decoded-JSON cache on
+    the shared `payload` entry means the window's column extractor,
+    fallback predicates, and SELECTs all decode each message at most
+    once (`len(env)` counts materialized fields; the regression suite
+    pins it)."""
+
+    __slots__ = ("_msg", "_node")
+
+    def __init__(self, msg: Message, node: str = "emqx_tpu@local"):
+        super().__init__()
+        self._msg = msg
+        self._node = node
+
+    def __missing__(self, key: str) -> Any:
+        v = _env_field(self._msg, key, self._node)  # KeyError: unknown
+        self[key] = v
+        return v
+
+    def __contains__(self, key: object) -> bool:
+        return dict.__contains__(self, key) or key in _ENV_FIELDS
+
+    def get(self, key: str, default: Any = None) -> Any:
+        try:
+            return self[key]
+        except KeyError:
+            return default
 
 
 def lookup_var(env: Dict[str, Any], path: Tuple[str, ...]) -> Any:

@@ -98,8 +98,8 @@ def _csr_expand(router, matched):
             (router.client_of_row(row), id(router.opts_at(slot)))
         )
     rule_by = [[] for _ in range(n)]
-    for i, rid in rules:
-        rule_by[i].append(rid)
+    for i, rids in rules:
+        rule_by[i].extend(rids)
     shared_by = [[] for _ in range(n)]
     for i, real, group in shared:
         shared_by[i].append((real, group))
@@ -165,12 +165,16 @@ def test_pure_rule_window_short_circuits_subscriber_expansion():
         matched
     )
     assert len(rows) == 0 and len(msg_idx) == 0 and not shared
-    assert sorted(rules) == [(0, "r1"), (1, "r1"), (1, "r2")]
+    assert [
+        (i, sorted(ids)) for i, ids in sorted(rules)
+    ] == [(0, ["r1"]), (1, ["r1", "r2"])]
     sink = []
     msgs = [Message(topic="x"), Message(topic="y")]
     counts = b._dispatch_window(msgs, matched, rule_sink=sink)
     assert counts == [0, 0]
-    assert [ids for _m, ids in sink] == [["r1"], ["r1", "r2"]]
+    assert [sorted(ids) for _m, ids in sink] == [
+        ["r1"], ["r1", "r2"]
+    ]
     assert b.metrics.val("messages.dropped.no_subscribers") == 2
 
 

@@ -35,6 +35,8 @@ SEAM_FUNCS: Tuple[Seam, ...] = (
          "engine.device_step"),
     Seam("emqx_tpu/engine.py", "MatchEngine._decide_device",
          "dispatch.decide.device"),
+    Seam("emqx_tpu/engine.py", "MatchEngine._rules_device",
+         "dispatch.rules.device"),
     Seam("emqx_tpu/cluster/transport.py", "NodeTransport.cast",
          "cluster.transport.send"),
     Seam("emqx_tpu/cluster/transport.py", "NodeTransport.cast_bin",

@@ -59,6 +59,13 @@ DISPATCH_FUNCS = (
     DispatchFn("emqx_tpu/broker/broker.py", "Broker._dispatch_columns"),
     DispatchFn("emqx_tpu/broker/broker.py", "Broker._dispatch_scalar"),
     DispatchFn("emqx_tpu/broker/broker.py", "Broker._deliver_run"),
+    # rule-engine hot path (the rules x window matrix): one column
+    # extraction + one matrix eval per window, actions per PASSING
+    # (rule, message) only — no per-candidate encode/clock/SubOpts
+    # work may creep back in
+    DispatchFn("emqx_tpu/rules/engine.py", "RuleEngine.apply_batch"),
+    DispatchFn("emqx_tpu/rules/columns.py", "WindowColumns.__init__"),
+    DispatchFn("emqx_tpu/engine.py", "MatchEngine.rules_eval_window"),
     DispatchFn("emqx_tpu/broker/broker.py", "Broker._resume_enqueue"),
     DispatchFn("emqx_tpu/broker/session.py", "Session.deliver"),
     DispatchFn("emqx_tpu/broker/session.py", "Session.deliver_run_native"),
