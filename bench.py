@@ -989,6 +989,362 @@ def run_rules_bench(log, iters=None, write_json=True):
     return results
 
 
+def run_overload_bench(log, iters=None, write_json=True):
+    """Overload-protection A/B (BENCH_r11): the PR 13 acceptance
+    counterfactual.  Two halves:
+
+    * **steady state** — fanout-256 QoS1 windows with the olp ladder
+      ENABLED AT LEVEL 0 vs disabled (disabled == pre-PR behavior;
+      the byte-identity is property-tested), paired interleaved —
+      the "overhead within noise" criterion;
+    * **flood + slow-subscriber storm** — real sockets: QoS0
+      flooders at well over dispatch capacity, a slow subscriber
+      that stops reading, a steady QoS1 publisher and a PINGREQ
+      control plane, run with OLP ON vs OFF (interleaved).  Reports
+      live QoS1 publish→PUBACK p50/p99, control-ping p99, peak RSS
+      delta, shed counters, max ladder level, recovery time back to
+      level 0, and asserts ZERO acked-QoS1 loss in every run.
+    """
+    import asyncio
+    import statistics
+
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.broker.channel import Channel
+    from emqx_tpu.broker.listener import BrokerServer
+    from emqx_tpu.broker.session import SubOpts
+    from emqx_tpu.codec import mqtt as C
+    from emqx_tpu.config import BrokerConfig, ListenerConfig
+    from emqx_tpu.message import Message
+    from emqx_tpu.sysmon import _rss_bytes
+
+    iters = int(
+        os.environ.get("BENCH_OVERLOAD_ITERS", iters or 3)
+    )
+    flood_s = float(os.environ.get("BENCH_OVERLOAD_FLOOD_S", 4.0))
+    out = {}
+
+    # ---------------------------------------- steady-state fanout A/B
+
+    def fanout_once(olp_on):
+        cfg = BrokerConfig()
+        cfg.engine.use_device = False
+        cfg.olp.enable = olp_on
+        b = Broker(config=cfg)
+        sink = [0]
+
+        def send(pkts):
+            sink[0] += sum(
+                len(C.serialize(p, C.MQTT_V5)) for p in pkts
+            )
+
+        flt = "fan/olp"
+        for i in range(256):
+            ch = Channel(b, send=send, close=lambda r: None)
+            cid = f"o{i}"
+            session, _ = b.cm.open_session(
+                True, cid, ch, max_inflight=0
+            )
+            session.subscribe(flt, SubOpts(qos=1))
+            b.subscribe(cid, flt, SubOpts(qos=1))
+        n = 500
+        msgs = [Message(topic=flt, payload=b"x" * 64, qos=1)
+                for _ in range(n)]
+        b.publish_many(msgs[:64])  # warm
+        t0 = time.perf_counter()
+        for w0 in range(64, n, 64):
+            w = msgs[w0:w0 + 64]
+            now = time.time()
+            for m in w:
+                m.timestamp = now
+            b.publish_many(w)
+        return (n - 64) / (time.perf_counter() - t0)
+
+    on_rates, off_rates = [], []
+    for _ in range(5):  # paired interleaved
+        off_rates.append(fanout_once(False))
+        on_rates.append(fanout_once(True))
+    off_med = statistics.median(off_rates)
+    on_med = statistics.median(on_rates)
+    out["steady_fanout256_qos1_olp_off_msgs_per_s"] = off_med
+    out["steady_fanout256_qos1_olp_on_msgs_per_s"] = on_med
+    out["steady_overhead_ratio"] = on_med / off_med
+    log(
+        f"overload steady-state fanout-256 qos1: olp-off "
+        f"{off_med:,.0f} msg/s vs olp-on(level 0) {on_med:,.0f} "
+        f"({on_med / off_med:.3f}x — must be within noise)"
+    )
+
+    # ------------------------------------------- flood counterfactual
+
+    async def flood_run(olp_on):
+        cfg = BrokerConfig()
+        cfg.listeners = [ListenerConfig(port=0)]
+        cfg.engine.batch_max = 128
+        cfg.olp.enable = olp_on
+        cfg.olp.sample_interval = 0.05
+        cfg.olp.min_hold = 0.3
+        cfg.olp.batcher_fill = [0.3, 0.6, 50.0]
+        # pin the machine-state signals inert: the flood signal
+        # (batcher fill) is the one this scenario exercises
+        cfg.olp.loop_lag_ms = [1e6, 1e6, 1e6]
+        cfg.olp.e2e_p99_ms = [1e6, 1e6, 1e6]
+        cfg.olp.mqueue_backlog = [1e9, 1e9, 1e9]
+        cfg.olp.sysmem = [0.999, 0.9995, 0.9999]
+        cfg.olp.procmem = [0.97, 0.98, 0.99]
+        cfg.olp.cpu = [1e6, 1e6, 1e6]
+        srv = BrokerServer(cfg)
+        await srv.start()
+        broker = srv.broker
+        port = srv.listeners[0].port
+        loop = asyncio.get_running_loop()
+        rss0 = _rss_bytes()
+        peak_rss = rss0
+        max_level = 0
+        stop = asyncio.Event()
+
+        async def sampler():
+            nonlocal peak_rss, max_level
+            while not stop.is_set():
+                broker.olp.tick(time.time())
+                max_level = max(max_level, broker.olp.level)
+                peak_rss = max(peak_rss, _rss_bytes())
+                await asyncio.sleep(0.02)
+
+        async def conn(cid):
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            w.write(C.serialize(
+                C.Connect(client_id=cid, proto_ver=C.MQTT_V5),
+                C.MQTT_V5,
+            ))
+            await w.drain()
+            p = C.StreamParser(version=C.MQTT_V5)
+            while True:
+                data = await r.read(1 << 16)
+                assert data
+                if any(pk.type == C.CONNACK for pk in p.feed(data)):
+                    return r, w, p
+
+        sam = loop.create_task(sampler())
+        # live subscriber: qos1 live traffic + the qos0 flood
+        sr, sw, sp = await conn("live_sub")
+        sw.write(C.serialize(C.Subscribe(
+            packet_id=1,
+            subscriptions=[C.Subscription("live/#", qos=1),
+                           C.Subscription("flood/#", qos=0)],
+        ), C.MQTT_V5))
+        await sw.drain()
+        got = set()
+        flood_got = [0]
+        done = asyncio.Event()
+
+        async def sub_loop():
+            while not done.is_set():
+                data = await sr.read(1 << 16)
+                if not data:
+                    return
+                acks = []
+                for pk in sp.feed(data):
+                    if pk.type != C.PUBLISH:
+                        continue
+                    if pk.topic.startswith("live/"):
+                        got.add(bytes(pk.payload))
+                        if pk.qos:
+                            acks.append(C.serialize(
+                                C.Puback(packet_id=pk.packet_id),
+                                C.MQTT_V5,
+                            ))
+                    else:
+                        flood_got[0] += 1
+                if acks:
+                    sw.write(b"".join(acks))
+
+        sub_task = loop.create_task(sub_loop())
+        # slow-subscriber storm: subscribe the flood, then stop reading
+        slow_ws = []
+        for i in range(2):
+            _zr, zw, _zp = await conn(f"slow{i}")
+            zw.write(C.serialize(C.Subscribe(
+                packet_id=1,
+                subscriptions=[C.Subscription("flood/#", qos=0)],
+            ), C.MQTT_V5))
+            await zw.drain()
+            slow_ws.append(zw)
+        flood_on = True
+        flood_sent = [0]
+
+        async def flooder(i):
+            _r, w, _p = await conn(f"flood{i}")
+            payload = b"f" * 2048
+            k = 0
+            while flood_on:
+                burst = b"".join(
+                    C.serialize(C.Publish(
+                        topic=f"flood/{i}/{k + j}", qos=0,
+                        payload=payload,
+                    ), C.MQTT_V5)
+                    for j in range(64)
+                )
+                k += 64
+                flood_sent[0] += 64
+                w.write(burst)
+                try:
+                    await asyncio.wait_for(w.drain(), 1.0)
+                except asyncio.TimeoutError:
+                    await asyncio.sleep(0.05)
+            w.close()
+
+        flooders = [loop.create_task(flooder(i)) for i in range(3)]
+        # steady qos1 publisher + control pings
+        pr, pw, pp = await conn("steady")
+        cr, cw, cp = await conn("control")
+        ack_lat = []
+        ping_lat = []
+        pending = {}
+        acked = set()
+
+        async def pub_reader():
+            while not done.is_set():
+                data = await pr.read(1 << 14)
+                if not data:
+                    return
+                for pk in pp.feed(data):
+                    if pk.type == C.PUBACK:
+                        t0 = pending.pop(pk.packet_id, None)
+                        if t0 is not None:
+                            ack_lat.append(
+                                (time.perf_counter() - t0) * 1e3
+                            )
+                        acked.add(pk.packet_id)
+
+        pub_rd = loop.create_task(pub_reader())
+        sent = []
+        t_end = time.time() + flood_s
+        seq = 0
+        while time.time() < t_end:
+            seq += 1
+            pid = (seq % 60000) + 1
+            pending[pid] = time.perf_counter()
+            sent.append(seq)
+            pw.write(C.serialize(C.Publish(
+                topic="live/x", qos=1, packet_id=pid,
+                payload=b"s%d" % seq,
+            ), C.MQTT_V5))
+            await pw.drain()
+            t0 = time.perf_counter()
+            cw.write(C.serialize(C.Pingreq(), C.MQTT_V5))
+            await cw.drain()
+            try:
+                data = await asyncio.wait_for(cr.read(1 << 10), 10.0)
+                if any(pk.type == C.PINGRESP for pk in cp.feed(data)):
+                    ping_lat.append(
+                        (time.perf_counter() - t0) * 1e3
+                    )
+            except asyncio.TimeoutError:
+                ping_lat.append(10_000.0)
+            await asyncio.sleep(0.05)
+        flood_on = False
+        await asyncio.gather(*flooders, return_exceptions=True)
+        # drain: every acked QoS1 must arrive (zero-loss assertion)
+        want = {b"s%d" % s for s in sent}
+        deadline = time.time() + 15.0
+        while time.time() < deadline and not want <= got:
+            await asyncio.sleep(0.1)
+        lost = len(want - got)
+        assert lost == 0, f"acked-QoS1 loss with olp_on={olp_on}"
+        recovery_s = None
+        if olp_on:
+            t0 = time.time()
+            while time.time() - t0 < 15.0 and broker.olp.level:
+                await asyncio.sleep(0.05)
+            recovery_s = round(time.time() - t0, 2)
+        m = broker.metrics
+        shed_total = (
+            m.val("delivery.dropped.olp_shed")
+            + m.val("messages.dropped.olp_shed")
+            + m.val("delivery.dropped.out_buffer")
+        )
+        res = {
+            "publish_ack_p50_ms": statistics.median(ack_lat or [0]),
+            "publish_ack_p99_ms": sorted(ack_lat or [0])[
+                max(0, int(len(ack_lat) * 0.99) - 1)
+            ],
+            "ping_p99_ms": sorted(ping_lat or [0])[
+                max(0, int(len(ping_lat) * 0.99) - 1)
+            ],
+            "peak_rss_delta_mb": round(
+                (peak_rss - rss0) / (1 << 20), 1
+            ),
+            "max_level": max_level,
+            "recovery_s": recovery_s,
+            "qos1_sent": len(sent),
+            "qos1_lost": lost,
+            "flood_published": flood_sent[0],
+            "flood_delivered_to_live_sub": flood_got[0],
+            "shed_total": shed_total,
+        }
+        done.set()
+        stop.set()
+        for w in (sw, pw, cw, *slow_ws):
+            w.close()
+        sub_task.cancel()
+        pub_rd.cancel()
+        await asyncio.gather(
+            sub_task, pub_rd, sam, return_exceptions=True
+        )
+        await srv.stop()
+        return res
+
+    runs = {"olp_on": [], "olp_off": []}
+    for i in range(iters):
+        # interleaved A/B, off first (the counterfactual baseline)
+        runs["olp_off"].append(asyncio.run(flood_run(False)))
+        runs["olp_on"].append(asyncio.run(flood_run(True)))
+
+    def med(mode, key):
+        vals = [r[key] for r in runs[mode] if r[key] is not None]
+        return statistics.median(vals) if vals else None
+
+    for mode in ("olp_off", "olp_on"):
+        out[mode] = {
+            k: med(mode, k)
+            for k in ("publish_ack_p50_ms", "publish_ack_p99_ms",
+                      "ping_p99_ms", "peak_rss_delta_mb",
+                      "max_level", "recovery_s", "qos1_lost",
+                      "flood_delivered_to_live_sub", "shed_total")
+        }
+        out[mode]["runs"] = runs[mode]
+        log(
+            f"overload flood [{mode}]: publish-ack p99 "
+            f"{out[mode]['publish_ack_p99_ms']:.1f} ms, ping p99 "
+            f"{out[mode]['ping_p99_ms']:.1f} ms, peak RSS delta "
+            f"{out[mode]['peak_rss_delta_mb']:.1f} MB, max level "
+            f"{out[mode]['max_level']}, shed {out[mode]['shed_total']}"
+            + (f", recovery {out[mode]['recovery_s']}s"
+               if out[mode]["recovery_s"] is not None else "")
+        )
+    out["note"] = (
+        "flood: 3 QoS0 flooder connections (2 KiB payloads, 64-msg "
+        "bursts) + 2 slow subscribers that stop reading + a steady "
+        "QoS1 publisher and a PINGREQ control plane, for "
+        f"{flood_s:.0f}s per run, interleaved OFF/ON x{iters}, "
+        "medians; batcher batch_max=128 so the batcher-fill signal "
+        "drives the ladder (L1@0.3, L2@0.6).  Zero acked-QoS1 loss "
+        "asserted in EVERY run.  olp_on must keep ping/publish p99 "
+        "bounded via L2 QoS0-delivery shedding and step back to "
+        "level 0 after the flood (recovery_s); olp_off is the "
+        "counterfactual the ladder prevents.  Steady-state: "
+        "fanout-256 QoS1 with olp enabled at level 0 vs disabled "
+        "(disabled == pre-PR dispatch byte-for-byte), paired "
+        "interleaved x5."
+    )
+    if write_json:
+        with open(os.path.join(
+            os.path.dirname(__file__) or ".", "BENCH_r11.json"
+        ), "w") as f:
+            json.dump(out, f, indent=2)
+    return out
+
+
 def run_broker_bench(log, mode="auto"):
     """End-to-end socket benchmark (BASELINE config 1 shape, the
     emqtt_bench workload): N publishers / M wildcard subscribers over
@@ -1693,6 +2049,12 @@ def main():
         # datagram loss (BENCH_r09 tracks the PR 11 tentpole)
         cluster_fwd_stats = run_cluster_forward_bench(log)
 
+    overload_stats = {}
+    if os.environ.get("BENCH_OVERLOAD", "1") != "0":
+        # overload ladder on/off counterfactual + steady-state A/B
+        # (BENCH_r11 tracks the PR 13 tentpole)
+        overload_stats = run_overload_bench(log)
+
     rules_stats = {}
     if os.environ.get("BENCH_RULES", "1") != "0":
         # rule-engine WHERE matrix vs the scalar interpreter referee
@@ -1754,6 +2116,7 @@ def main():
         "replay": replay_stats,
         "cluster_forward": cluster_fwd_stats,
         "rules": rules_stats,
+        "overload": overload_stats,
         **sharded_stats,
         **broker_stats,
     }

@@ -87,6 +87,13 @@ class Broker:
         self.lifecycle = LifecycleTracer(
             self.config.tracing, node=self.config.node_name
         )
+        # coordinated overload protection (olp.py): one load level 0-3
+        # driving the degradation ladder.  Constructed unconditionally
+        # (disabled by default) — hot paths read its precomputed flag
+        # attributes, one attribute load per window.
+        from ..olp import LoadMonitor
+
+        self.olp = LoadMonitor(self, self.config.olp)
         eng_cfg = self.config.engine
         self.router = Router(
             engine=MatchEngine(
@@ -102,6 +109,9 @@ class Broker:
         # engine lifecycle events (XLA compiles, device_put transfers,
         # delta folds) land in the same profiler as the window stages
         self.router.engine.profiler = self.profiler
+        # L1 ladder: background rebuilds defer while the broker is
+        # overloaded (the delta tiers keep serving correctness)
+        self.router.engine.defer_rebuild = self.olp.defer_rebuild
         ret_cfg = self.config.retainer
         self.retainer = Retainer(
             max_retained_messages=ret_cfg.max_retained_messages,
@@ -353,6 +363,9 @@ class Broker:
 
     def _session_discarded(self, session: Session) -> None:
         self.metrics.inc("session.discarded")
+        # a discarded session's parked retained catch-up dies with it
+        # (dead jobs must not exhaust the defer cap)
+        self.olp.cancel_retained_client(session.clientid)
         if self.resume is not None:
             # a discarded session is owed nothing: drop any in-flight
             # replay job (its checkpoint teardown follows right below)
@@ -389,6 +402,7 @@ class Broker:
         lowered session_expiry_interval to 0): drop router state AND the
         gate refs, or the gate persists messages for a session that can
         never return (emqx_channel session-expiry handling)."""
+        self.olp.cancel_retained_client(clientid)
         if self.resume is not None:
             # the client explicitly abandoned the session: nothing is
             # owed — drop any in-flight replay job AND the boot
@@ -412,10 +426,22 @@ class Broker:
     # ---------------------------------------------------- subscribe
 
     def subscribe(
-        self, clientid: str, flt: str, opts: SubOpts, is_new_sub: bool = True
+        self,
+        clientid: str,
+        flt: str,
+        opts: SubOpts,
+        is_new_sub: bool = True,
+        defer_ok: bool = False,
     ) -> List[Message]:
         """Register the subscription; returns retained messages to
-        replay per retain_handling ([MQTT-3.3.1-9..11])."""
+        replay per retain_handling ([MQTT-3.3.1-9..11]).
+
+        ``defer_ok``: the caller DELIVERS the returned retained list
+        (the MQTT SUBSCRIBE path), so under the olp ladder its
+        catch-up may park for a deferred flush.  Callers that discard
+        the return (gateway adapters, takeover import, auto-subscribe)
+        must leave it False — a parked job would later deliver a
+        retained burst those paths never produce."""
         self.router.subscribe(clientid, flt, opts)
         # gate refcount: only a NEW subscription counts (an options
         # refresh re-subscribe must not inflate it past drainability).
@@ -443,12 +469,30 @@ class Broker:
             return []  # retained never replay to shared subs [MQTT-4.8.2-27]
         rh = opts.retain_handling
         if rh == 2 or (rh == 1 and not is_new_sub):
+            # a re-subscribe whose options forbid retained also
+            # cancels any catch-up job a deferred earlier subscribe
+            # parked — the flush must honor the CURRENT options
+            self.olp.cancel_retained(clientid, flt)
             return []
+        if (
+            defer_ok
+            and self.olp.defer_admissions
+            and self.olp.defer_retained(clientid, flt)
+        ):
+            # L1 ladder: the retained match walk + catch-up burst park
+            # until the ladder steps back to 0 (counted + alarmed;
+            # flushed by the olp tick)
+            return []
+        # an inline replay supersedes any job still parked from an
+        # earlier deferred subscribe — delivering both would duplicate
+        # the retained burst (QoS1 included)
+        self.olp.cancel_retained(clientid, flt)
         return self.retainer.match(flt)
 
     def unsubscribe(self, clientid: str, flt: str) -> bool:
         ok = self.router.unsubscribe(clientid, flt)
         if ok:
+            self.olp.cancel_retained(clientid, flt)
             if self.durable is not None:
                 session = self.cm.lookup(clientid)
                 if session is not None and flt in session.gate_filters:
@@ -657,6 +701,7 @@ class Broker:
         channel = self.cm.channel(clientid)
         if channel is not None:
             channel.close("takenover")
+        self.olp.cancel_retained_client(clientid)  # leaves this node
         queued = self._serialize_pending(session)
         while session.mqueue.pop() is not None:
             pass  # drained: the session leaves this node
@@ -1542,6 +1587,38 @@ class Broker:
         run_kq_max = np.add.reduceat(keep_i * (qmax > 0), starts)
         run_n1_min = np.add.reduceat(keep_i * (qmin == 1), starts)
         run_n1_max = np.add.reduceat(keep_i * (qmax == 1), starts)
+        # L2 overload shed: effective-QoS0 deliveries fold out of the
+        # kept-for-wire set in ONE vectorized AND per QoS variant
+        # ($SYS messages exempt — the overload alarm itself must
+        # survive the ladder).  The kq/n1 aggregates above count only
+        # QoS>0 deliveries, so they need no variant forms; the kept
+        # masks and their per-run drop/shed aggregates do.
+        shed0 = self.olp.shed_qos0_mask
+        if shed0:
+            elig = np.fromiter(
+                (not m.sys for m in msgs), bool, n
+            )[sm_a]
+            shed_min = keepw & (qmin == 0) & elig
+            shed_max = keepw & (qmax == 0) & elig
+            kw_min = keepw & ~shed_min
+            kw_max = keepw & ~shed_max
+            rdrop_min = np.maximum.reduceat(~kw_min, starts)
+            rdrop_max = np.maximum.reduceat(~kw_max, starts)
+            rshed_min = np.add.reduceat(
+                shed_min.astype(np.int64), starts
+            )
+            rshed_max = np.add.reduceat(
+                shed_max.astype(np.int64), starts
+            )
+            shed_cell: Optional[List[int]] = [0]
+        else:
+            kw_min = kw_max = rdrop_min = rdrop_max = None
+            rshed_min = rshed_max = None
+            shed_cell = None
+        shed_native = 0
+        # per-connection outbound high-watermark: a stalled
+        # subscriber past it takes the drop/queue path, never the wire
+        out_wm = self.config.mqtt.outbound_high_watermark
         # one shareable inflight-entry list / pid layout per unique
         # run shape: a fanout window's runs overwhelmingly repeat the
         # same (deliveries, qos) pattern, so entry construction runs
@@ -1587,6 +1664,21 @@ class Broker:
                         if f:
                             cnt[sm_l[k + t]] += 1
                     continue
+                if out_wm and self._stalled(session, channel):
+                    # stalled subscriber past its outbound watermark:
+                    # the queue path keeps the wire buffers bounded
+                    # (see `_queue_stalled_run`, shared with scalar)
+                    flags = self._queue_stalled_run(
+                        session, clientid,
+                        self._materialize_run(
+                            msgs, router, sm_l, so_a, k, e
+                        ),
+                        mloc, bake_cache,
+                    )
+                    for t, f in enumerate(flags):
+                        if f:
+                            cnt[sm_l[k + t]] += 1
+                    continue
                 cork = getattr(channel, "cork", None)
                 if cork is not None:
                     cork()
@@ -1622,10 +1714,22 @@ class Broker:
                     # loop queues the overflow per delivery
                     native = False
                 if native:
-                    has_drop = bool(run_drop[bi])
+                    if shed0:
+                        # the run's variant kept mask folds the shed
+                        # in; its aggregates were reduced window-wide
+                        kww = kw_max if upgrade else kw_min
+                        has_drop = bool(
+                            (rdrop_max if upgrade else rdrop_min)[bi]
+                        )
+                        shed_native += int(
+                            (rshed_max if upgrade else rshed_min)[bi]
+                        )
+                    else:
+                        kww = keepw
+                        has_drop = bool(run_drop[bi])
                     keysw = kmax if upgrade else kmin
                     if has_drop:
-                        keep = keepw[k:e]
+                        keep = kww[k:e]
                         keys = keysw[k:e][keep]
                     else:
                         keys = keysw[k:e]
@@ -1651,11 +1755,11 @@ class Broker:
                         n2 = kq - n1
                         if has_drop or kq != nk:
                             # mixed run: locate the pending positions
-                            effk = eff[keepw[k:e]] if has_drop else eff
+                            effk = eff[kww[k:e]] if has_drop else eff
                             pend_pos = np.flatnonzero(effk > 0)
                             if has_drop:
                                 pend_abs = (
-                                    np.flatnonzero(keepw[k:e])[pend_pos]
+                                    np.flatnonzero(kww[k:e])[pend_pos]
                                     + k
                                 )
                             else:
@@ -1730,7 +1834,8 @@ class Broker:
                             msgs, router, sm_l, so_a, k, e
                         )
                     packets = session.deliver(
-                        deliveries, encoder=enc, version=version
+                        deliveries, encoder=enc, version=version,
+                        shed_qos0=shed0, shed_cell=shed_cell,
                     )
                     channel.send_packets(packets)
                 if deliver_hook:
@@ -1743,9 +1848,13 @@ class Broker:
                     # a sampled message's lifecycle span names the
                     # clients that RECEIVED it (guard: sampled runs
                     # only — unsampled windows never enter here); a
-                    # no-local-dropped delivery never reached this
-                    # client, so the drop column gates the attribution
-                    dropr = drop[k:e]
+                    # no-local-dropped (or olp-shed) delivery never
+                    # reached this client, so the run's kept mask
+                    # gates the attribution
+                    if shed0:
+                        dropr = ~(kw_max if upgrade else kw_min)[k:e]
+                    else:
+                        dropr = drop[k:e]
                     for t, (dm, _o) in enumerate(deliveries):
                         if dropr[t]:
                             continue
@@ -1776,6 +1885,14 @@ class Broker:
                 log.exception("dispatch to %s failed", clientid)
                 mloc["messages.publish.error"] += 1
                 continue
+        if shed0:
+            # shed units from BOTH sub-paths (native kept-mask fold +
+            # the session.deliver fallback's cell), flushed with the
+            # window's other counters — never silent
+            nshed = shed_native + shed_cell[0]
+            if nshed:
+                mloc["delivery.dropped"] += nshed
+                mloc["delivery.dropped.olp_shed"] += nshed
         if plan_bodies:
             if self._assemble_window_native(
                 lib, enc, plan_bodies, plan_pids, plan_sends, mloc, asm
@@ -1878,6 +1995,36 @@ class Broker:
                 mloc["messages.qos2.sent"] += w2
         return True
 
+    def _stalled(self, session: Session, channel) -> bool:
+        """Is this CONNECTED channel past its outbound high-watermark
+        (or still draining a watermark-parked backlog)?  ONE home for
+        the stall predicate on both dispatch paths."""
+        out_wm = self.config.mqtt.outbound_high_watermark
+        if not out_wm:
+            return False
+        ob = getattr(channel, "out_buffered", None)
+        return ob is not None and (
+            session.out_parked or ob() >= out_wm
+        )
+
+    def _queue_stalled_run(
+        self, session: Session, clientid: str, deliveries,
+        mloc: Counter, bake_cache: Optional[Dict],
+    ) -> List[int]:
+        """Route one stalled-subscriber run to the queue path: QoS0
+        drops (counted ``delivery.dropped.out_buffer``), QoS>0 parks
+        on the mqueue, and ``out_parked`` pins LATER deliveries behind
+        the parked backlog (same-topic QoS>0 order must not invert);
+        the channel's retry timer drains it once the buffer recovers.
+        ONE home for the stall action on both dispatch paths."""
+        flags = self._queue_detached_run(
+            session, clientid, deliveries, mloc, bake_cache,
+            q0_reason="out_buffer", replicate=False,
+        )
+        if any(flags):
+            session.out_parked = True
+        return flags
+
     def _delivery_allowed(self, clientid: str, msg: Message) -> bool:
         """Delivery-guard check; must gate EVERY path that puts a
         message in front of a session — live fan-out, durable replay,
@@ -1970,6 +2117,17 @@ class Broker:
             return [0] * nd
         channel = self.cm.channel(clientid)
         if channel is not None:
+            if self._stalled(session, channel):
+                # stalled subscriber past its outbound watermark: the
+                # queue path, shared with the columns gate
+                return self._queue_stalled_run(
+                    session, clientid, deliveries, mloc, bake_cache
+                )
+            # L2 overload shed on the scalar referee path: identical
+            # semantics to the columns' folded mask (QoS0-only, $SYS
+            # exempt), counted through the same registry names
+            shed0 = self.olp.shed_qos0_mask
+            shed_cell = [0] if shed0 else None
             cork = getattr(channel, "cork", None)
             if cork is not None:
                 cork()
@@ -1982,23 +2140,32 @@ class Broker:
                 if asm is not None:
                     t0 = time.perf_counter()
                     res = session.deliver_run_native(
-                        deliveries, encoder, version
+                        deliveries, encoder, version,
+                        shed_qos0=shed0, shed_cell=shed_cell,
                     )
                     if res is not None:  # only count runs it served
                         asm[0] += time.perf_counter() - t0
                 else:
                     res = session.deliver_run_native(
-                        deliveries, encoder, version
+                        deliveries, encoder, version,
+                        shed_qos0=shed0, shed_cell=shed_cell,
                     )
             if res is not None:
                 data, npub = res
                 if data:
                     send_wire(data, npub)
             else:
+                if shed_cell is not None:
+                    shed_cell[0] = 0  # ineligible native probe: the
+                    # fallback loop re-decides every delivery
                 packets = session.deliver(
-                    deliveries, encoder=encoder, version=version
+                    deliveries, encoder=encoder, version=version,
+                    shed_qos0=shed0, shed_cell=shed_cell,
                 )
                 channel.send_packets(packets)
+            if shed_cell is not None and shed_cell[0]:
+                mloc["delivery.dropped"] += shed_cell[0]
+                mloc["delivery.dropped.olp_shed"] += shed_cell[0]
             if deliver_hook:
                 # skipped entirely (no method resolution, no chain
                 # walk) when nothing registered for the hookpoint
@@ -2029,6 +2196,8 @@ class Broker:
         deliveries: List[Tuple[Message, SubOpts]],
         mloc: Counter,
         bake_cache: Optional[Dict],
+        q0_reason: Optional[str] = None,
+        replicate: bool = True,
     ) -> List[int]:
         """Queue one DETACHED persistent session's run: QoS>0 queued,
         QoS0 dropped; returns per-delivery kept flags.  The baked
@@ -2040,13 +2209,27 @@ class Broker:
         output is unchanged.  ONE implementation serves both the
         scalar and the decision-column dispatch paths, so the bake
         signature and queue_full accounting can never diverge.  (Off
-        the wire hot path: detached runs queue, they don't encode.)"""
+        the wire hot path: detached runs queue, they don't encode.)
+
+        Also serves the CONNECTED-but-stalled case (outbound
+        high-watermark): ``q0_reason`` attributes the QoS0 drops
+        (``delivery.dropped.<q0_reason>``) and ``replicate=False``
+        skips buddy replication — a live session's mqueue overflow is
+        never replicated on the deliver path either."""
         flags = [0] * len(deliveries)
         replicated = []
         for k, (m, opts) in enumerate(deliveries):
+            if opts.no_local and m.from_client == clientid:
+                # [MQTT-3.8.3-3] — live-delivery parity: the wire
+                # paths skip these via the drop column / deliver loop,
+                # and a CONNECTED-but-stalled session routed here must
+                # not have its own publishes queued back to it
+                continue
             qos = session._effective_qos(m.qos, opts)
             if qos == 0:
                 mloc["delivery.dropped"] += 1
+                if q0_reason is not None:
+                    mloc["delivery.dropped." + q0_reason] += 1
                 continue
             if bake_cache is None:
                 baked = session._queued(m, opts, qos)
@@ -2067,7 +2250,7 @@ class Broker:
                 self.hooks.run("delivery.dropped", clientid, dropped, "queue_full")
             replicated.append(baked)
             flags[k] = 1
-        if replicated and self.external is not None:
+        if replicated and replicate and self.external is not None:
             from ..cluster.node import msg_to_wire
 
             self.external.replicate_queued(
@@ -2153,6 +2336,7 @@ class Broker:
             self.publish(will)
         self.delayed.tick(now)
         self.topic_metrics.tick(now)
+        self.olp.tick(now)
         self.alarms.tick(now)
         self.slow_subs.tick(now)
         self.ft.tick(now)
@@ -2397,6 +2581,15 @@ class PublishBatcher:
             del self._queues[src]
         return entry
 
+    def _window_limit(self) -> int:
+        """Max messages collected into one window: the pipeline-depth
+        bound, capped by the olp ladder's L1 window shrink (smaller
+        windows = shorter event-loop holds per dispatch while the
+        broker is overloaded)."""
+        limit = min(self.batch_max, max(self.inflight_max // 4, 256))
+        cap = self.broker.olp.window_cap_now
+        return min(limit, cap) if cap else limit
+
     def publish(
         self, msg: Message, source: object = None
     ) -> "asyncio.Future[int]":
@@ -2433,9 +2626,7 @@ class PublishBatcher:
                 while self._inflight_count >= self.inflight_max:
                     self._inflight_drain.clear()
                     await self._inflight_drain.wait()
-                limit = min(
-                    self.batch_max, max(self.inflight_max // 4, 256)
-                )
+                limit = self._window_limit()
                 # flight-recorder entry opens at collection start so
                 # the accumulation wait shows up as its own stage
                 rec = self.broker.profiler.begin(0, source="batcher")

@@ -126,6 +126,23 @@ class Channel:
         # concatenated transport.write on uncork
         self._cork_depth = 0
         self._cork_buf: List[C.Packet] = []
+        # wired by the owning Connection: () -> bytes buffered in the
+        # transport toward this client (the outbound high-watermark
+        # signal; None = transport can't report, watermark inactive)
+        self.transport_buffered = None
+
+    def out_buffered(self) -> int:
+        """Bytes buffered toward this client in the transport (the
+        per-connection outbound high-watermark input; cork buffers
+        flush within the same window, so the transport buffer is the
+        unbounded part a stalled subscriber grows)."""
+        fn = self.transport_buffered
+        if fn is None:
+            return 0
+        try:
+            return fn()
+        except Exception:
+            return 0
 
     # ---------------------------------------------------------- util
 
@@ -207,6 +224,9 @@ class Channel:
             rc = {
                 "takenover": RC_SESSION_TAKEN_OVER,
                 "evacuated": 0x9C,  # use another server (rebalance)
+                # olp L3 force-close of a slow subscriber: server busy
+                # tells the client to back off, not that it misbehaved
+                "olp_overloaded": RC_SERVER_BUSY,
             }.get(reason, RC_UNSPECIFIED)
             self._send([C.Disconnect(reason_code=rc)])
         if reason == "takenover":
@@ -407,6 +427,12 @@ class Channel:
         ):
             m.inc("client.banned")
             self._connack_error(0x8A)  # banned ([MQTT-3.2.2.2])
+            return
+        if self.broker.olp.refuse_connect():
+            # olp ladder L2: CONNECT burst over the admission budget —
+            # server-busy BEFORE auth/session work so refusal is the
+            # cheapest path through the broker (counted + alarmed)
+            self._connack_error(RC_SERVER_BUSY)
             return
         client = ClientInfo(
             clientid=clientid,
@@ -766,6 +792,16 @@ class Channel:
             return
         m.inc_slots(self._auth_ok_slots(m))
 
+        olp = self.broker.olp
+        if pkt.qos == 0 and olp.shed_ingress_qos0:
+            # olp ladder L3: QoS0 drops at publish ingress — no route,
+            # no persistence, no ack owed (QoS0 has none); counted and
+            # carried on the overload alarm, never silent
+            m.inc("messages.dropped")
+            m.inc("messages.dropped.olp_shed")
+            olp.shed("shed.publish_qos0")
+            return
+
         props = {
             k: v for k, v in pkt.properties.items() if k != "topic_alias"
         }
@@ -1007,7 +1043,8 @@ class Channel:
             return 0x97  # quota exceeded: already held (reference rc)
         is_new = self.session.subscribe(full, opts)
         retained = self.broker.subscribe(
-            self.client.clientid, full, opts, is_new_sub=is_new
+            self.client.clientid, full, opts, is_new_sub=is_new,
+            defer_ok=True,  # this path DELIVERS the returned list
         )
         for rmsg in retained:
             # retained replay keeps the retain bit set [MQTT-3.3.1-8]
@@ -1074,6 +1111,16 @@ class Channel:
         if self.session is not None and self.state == CONNECTED:
             self.send_packets(self.session.retry())
             self.session.expire_awaiting_rel()
+            wm = self.broker.config.mqtt.outbound_high_watermark
+            if self.session.out_parked and (
+                not wm or self.out_buffered() < wm
+            ):
+                # outbound-watermark backlog: the subscriber's buffer
+                # recovered but it may owe NO ack that would trigger
+                # the ack-driven dequeue — flush the parked queue (in
+                # order) from the timer; `_dequeue` clears the flag
+                # once the queue empties
+                self.send_packets(self.session._dequeue())
 
     # ----------------------------------------------------- teardown
 

@@ -68,6 +68,30 @@ class ConnectionManager:
     def clients(self) -> List[str]:
         return list(self._entries)
 
+    def total_mqueued(self, sample_cap: int = 20_000) -> int:
+        """Aggregate mqueue backlog across sessions — the olp
+        ladder's queue-pressure signal.  Up to ``sample_cap``
+        sessions are scanned exactly (len() per session is O(1));
+        past that the signal becomes a uniform-sample ESTIMATE, so
+        the per-sample-interval event-loop hold stays bounded at
+        mass-reconnect session counts instead of inflating the very
+        loop-lag signal the ladder reads."""
+        n = len(self._entries)
+        if n <= sample_cap:
+            return sum(
+                len(e.session.mqueue) for e in self._entries.values()
+            )
+        from itertools import islice
+
+        # stride sample: one C-speed pass over the dict iterator with
+        # len() only on every step-th entry — no list materialization
+        step = n // sample_cap
+        s = c = 0
+        for e in islice(self._entries.values(), 0, None, step):
+            s += len(e.session.mqueue)
+            c += 1
+        return int(s * (n / c)) if c else 0
+
     # ------------------------------------------------- session open
 
     def open_session(

@@ -44,6 +44,16 @@ class Connection:
             peer=peer,
             mountpoint=mountpoint,
         )
+        # outbound high-watermark input: the transport's write buffer
+        # is where a stalled subscriber's bytes pile up (WS streams
+        # that can't report simply leave the watermark inactive)
+        transport = getattr(writer, "transport", None)
+        if transport is not None and hasattr(
+            transport, "get_write_buffer_size"
+        ):
+            self.channel.transport_buffered = (
+                transport.get_write_buffer_size
+            )
         self.parser = C.StreamParser(
             max_packet_size=broker.config.mqtt.max_packet_size
         )
@@ -73,9 +83,11 @@ class Connection:
         m.inc("packets.sent", n)
         m.inc("bytes.sent", len(data))
         self.writer.write(data)
-        try:
-            buffered = self.writer.transport.get_write_buffer_size()
-        except Exception:
+        # ONE accessor for the transport's write-buffer signal — the
+        # same `out_buffered` the dispatch watermark reads (0 when the
+        # transport can't report, which also skips the alarm below)
+        buffered = self.channel.out_buffered()
+        if buffered == 0 and not self._congested:
             return
         cid = (
             self.channel.client.clientid

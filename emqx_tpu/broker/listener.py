@@ -301,6 +301,19 @@ class BrokerServer:
             # reconnect of a mass-reconnect storm must already route
             # through admission control, not the synchronous fallback
             await self.broker.resume.start()
+        # the olp ladder's L2 clamp scales the SHARED (aggregate)
+        # buckets — listener level + node/zone level; per-connection
+        # private buckets stay untouched (a clamped aggregate already
+        # throttles everyone proportionally)
+        for lst in self.listeners:
+            if lst._shared_limiter is not None:
+                self.broker.olp.clamp_targets.append(
+                    lst._shared_limiter
+                )
+        if self.broker.zone_limiter is not None:
+            self.broker.olp.clamp_targets.append(
+                self.broker.zone_limiter
+            )
         cfg = self.broker.config
         if cfg.cluster_links:
             from ..cluster_link import ClusterLinks

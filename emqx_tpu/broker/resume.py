@@ -138,6 +138,13 @@ class ResumeScheduler:
     # ------------------------------------------------------ admission
 
     def saturated(self) -> bool:
+        if self.broker.olp.defer_admissions:
+            # L1+ ladder: every admission parks (no active slot is
+            # ever taken), so the park FIFO is the ONLY capacity that
+            # matters — without this, a mass-reconnect storm during
+            # exactly the overload episode olp bounds would grow the
+            # FIFO without ever answering server-busy
+            return len(self._parked) >= int(self.cfg.park_queue_cap)
         return (
             len(self._active) >= int(self.cfg.max_concurrent)
             and len(self._parked) >= int(self.cfg.park_queue_cap)
@@ -187,10 +194,20 @@ class ResumeScheduler:
 
     def _place(self, job: _Job) -> str:
         """Put a job into a free replay slot, else the park FIFO
-        (counted) — the ONE home of the placement rule."""
-        if len(self._active) < int(self.cfg.max_concurrent):
+        (counted) — the ONE home of the placement rule.  While the
+        olp ladder is raised (L1+) every placement parks: already-
+        active replays keep draining, but no NEW admission takes a
+        slot until the broker recovers (counted ``olp.deferred.
+        resume``; past ``park_queue_cap`` CONNECTs answer
+        server-busy via `saturated`, exactly as before)."""
+        olp_defer = self.broker.olp.defer_admissions
+        if not olp_defer and (
+            len(self._active) < int(self.cfg.max_concurrent)
+        ):
             self._active[job.clientid] = job
             return "active"
+        if olp_defer:
+            self.broker.olp.shed("deferred.resume")
         self._parked.append(job)
         self._parked_ids.add(job.clientid)
         self.broker.metrics.inc("session.resume.parked")
@@ -273,6 +290,10 @@ class ResumeScheduler:
         self._unpark()
 
     def _unpark(self) -> None:
+        if self.broker.olp.defer_admissions:
+            # L1 ladder: parked replay admissions stay parked until
+            # the broker steps back to level 0
+            return
         while self._parked and (
             len(self._active) < int(self.cfg.max_concurrent)
         ):

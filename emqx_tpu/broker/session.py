@@ -146,6 +146,16 @@ class Session:
         self.expiry_interval = expiry_interval
         self.upgrade_qos = upgrade_qos
         self._next_pid = 0
+        # outbound-watermark parking: True while this CONNECTED
+        # session holds mqueue entries parked by the out-buffer
+        # watermark.  While set, dispatch keeps routing new QoS>0
+        # deliveries through the mqueue (same-topic order must not
+        # invert past the parked backlog), and the channel's retry
+        # timer drains the queue once the buffer recovers — the
+        # ack-driven `_dequeue` alone may never fire (the stall can
+        # begin with an empty inflight window).  Cleared by
+        # `_dequeue` when the queue empties.
+        self.out_parked = False
         # wired by the broker: called with (dropped_msg, reason) when a
         # delivery is lost to queue overflow or expiry
         self.on_dropped: Optional[Callable[[Message, str], None]] = None
@@ -230,6 +240,8 @@ class Session:
         deliveries: List[Tuple[Message, SubOpts]],
         encoder: Optional["C.DispatchEncoder"] = None,
         version: Optional[int] = None,
+        shed_qos0: bool = False,
+        shed_cell: Optional[List[int]] = None,
     ) -> List[C.Packet]:
         """Accept matched messages for this session; returns the wire
         packets that can go out now (window permitting) — the
@@ -240,7 +252,13 @@ class Session:
         single-encode packets: the PUBLISH body is serialized once per
         window and only the packet id is patched per subscriber.
         Deliveries carrying a subscription identifier (per-subscriber
-        properties) fall back to the ordinary per-packet encode."""
+        properties) fall back to the ordinary per-packet encode.
+
+        ``shed_qos0`` (olp ladder level 2): effective-QoS0 deliveries
+        are shed — skipped, counted into ``shed_cell`` by the caller's
+        window accounting — except $SYS messages, whose operator
+        signals must survive the ladder.  The referee semantics the
+        columns path's folded shed mask is property-tested against."""
         out: List[C.Packet] = []
         enc = encoder if version is not None else None
         cid = self.clientid
@@ -259,6 +277,10 @@ class Session:
                 mq if mq < oq else oq
             )
             if qos == 0:
+                if shed_qos0 and not msg.sys:
+                    if shed_cell is not None:
+                        shed_cell[0] += 1
+                    continue
                 if enc is not None and opts.subid is None:  # brokerlint: ignore[PERF403]
                     out.append(enc.publish_qos0(msg, opts, version))
                 else:
@@ -284,6 +306,8 @@ class Session:
         deliveries: List[Tuple[Message, SubOpts]],
         encoder: "C.DispatchEncoder",
         version: int,
+        shed_qos0: bool = False,
+        shed_cell: Optional[List[int]] = None,
     ) -> Optional[Tuple[bytearray, Tuple[int, int, int]]]:
         """The window fast path for one client's run: Python makes the
         *decisions* in one pass — the no-local mask, effective QoS, a
@@ -337,6 +361,11 @@ class Session:
             )
             if nl and msg.from_client == cid:
                 continue  # [MQTT-3.8.3-3]
+            if shed_qos0 and qos == 0 and not msg.sys:
+                # olp L2: effective-QoS0 deliveries shed ($SYS exempt)
+                if shed_cell is not None:
+                    shed_cell[0] += 1
+                continue
             retain = rap if msg.retain else False
             slot = si.get((id(msg), qos, retain, version))
             if slot is None:
@@ -484,6 +513,10 @@ class Session:
                 pid, _InflightEntry(_PUBLISHING, msg, msg.qos, time.time())
             )
             out.append(self._publish_packet(msg, None, msg.qos, pid))
+        if not len(self.mqueue):
+            # the watermark-parked backlog (if any) fully drained:
+            # new deliveries may ride the fast path again
+            self.out_parked = False
         return out
 
     # ------------------------------------------- client acks (out path)

@@ -43,6 +43,12 @@ class MqttConfig:
     server_keepalive: Optional[int] = None
     retry_interval: float = 30.0
     idle_timeout: float = 15.0
+    # per-connection OUTBOUND high watermark (bytes buffered in the
+    # transport toward one subscriber): past it, QoS0 deliveries for
+    # that connection drop (``delivery.dropped.out_buffer``) and
+    # QoS>0 falls back to the mqueue path, so a stalled subscriber's
+    # corked wire blobs stay bounded.  0 = disabled.
+    outbound_high_watermark: int = 4 * 1024 * 1024
 
 
 @dataclass
@@ -175,6 +181,72 @@ class TracingConfig:
 
 
 @dataclass
+class OlpConfig:
+    """Coordinated overload protection (olp.py): one broker-wide load
+    level 0-3 sampled from the event loop, batcher, mqueues, profiler
+    p99s and sysmon, driving a degradation ladder (park resume
+    admissions / defer retained catch-up + rebuilds / shrink windows
+    at L1; shed QoS0 deliveries + clamp listener buckets + budget
+    CONNECTs at L2; drop QoS0 at ingress + force-close the slowest
+    subscribers at L3).  Shedding is QoS0-only — zero QoS>=1 loss for
+    admitted traffic — and every shed unit is counted and alarmed.
+
+    Each signal carries an (L1, L2, L3) enter-threshold triple; exit
+    is enter * ``exit_factor`` and the ladder steps down one level at
+    a time after ``min_hold`` seconds (hysteresis).  Disabled by
+    default, like the reference's ``overload_protection``."""
+
+    enable: bool = False
+    sample_interval: float = 1.0
+    min_hold: float = 5.0
+    exit_factor: float = 0.8
+    # signal enter thresholds, one per level (non-decreasing)
+    loop_lag_ms: List[float] = field(
+        default_factory=lambda: [100.0, 500.0, 2000.0]
+    )
+    # PublishBatcher depth as a fraction of its global high watermark
+    batcher_fill: List[float] = field(
+        default_factory=lambda: [0.75, 1.5, 3.0]
+    )
+    # aggregate mqueue backlog (messages) across all sessions
+    mqueue_backlog: List[float] = field(
+        default_factory=lambda: [50_000.0, 200_000.0, 1_000_000.0]
+    )
+    # EWMA of the profiler's interval publish->delivery p99 (ms)
+    e2e_p99_ms: List[float] = field(
+        default_factory=lambda: [500.0, 2000.0, 8000.0]
+    )
+    # sysmon watermarks: system memory used fraction, process RSS
+    # fraction of total, 1-min loadavg per core
+    sysmem: List[float] = field(
+        default_factory=lambda: [0.90, 0.95, 0.98]
+    )
+    procmem: List[float] = field(
+        default_factory=lambda: [0.40, 0.55, 0.70]
+    )
+    cpu: List[float] = field(
+        default_factory=lambda: [2.0, 4.0, 8.0]
+    )
+    # L1: max dispatch-window size while the ladder is raised
+    window_cap: int = 1024
+    # L2: listener/zone shared-bucket rate factor while clamped
+    limiter_clamp: float = 0.5
+    # L2: CONNECTs admitted per second (over budget -> server-busy)
+    connect_budget: float = 100.0
+    # L1: deferred retained-catch-up queue bound + flush pacing
+    # (MESSAGES per recovery tick; a huge filter chunks across ticks)
+    retained_defer_cap: int = 10_000
+    retained_flush_per_tick: int = 256
+    # L3: slow-subscriber force-close batch + re-check cadence
+    slow_kill_max: int = 10
+    slow_kill_interval: float = 10.0
+    # $SYS alarm flap damping (AlarmRegistry): min seconds between
+    # re-raise publishes, and the deactivate hysteresis hold
+    alarm_min_reraise: float = 10.0
+    alarm_hold: float = 5.0
+
+
+@dataclass
 class ApiConfig:
     """Management REST + Prometheus endpoint (emqx_management slice).
 
@@ -294,6 +366,7 @@ class BrokerConfig:
     api: ApiConfig = field(default_factory=ApiConfig)
     flapping: FlappingConfig = field(default_factory=FlappingConfig)
     slow_subs: SlowSubsConfig = field(default_factory=SlowSubsConfig)
+    olp: OlpConfig = field(default_factory=OlpConfig)
     profiler: ProfilerConfig = field(default_factory=ProfilerConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
     # server-side auto-subscribe on connect (emqx_auto_subscribe):
@@ -566,4 +639,39 @@ def check_config(cfg: BrokerConfig) -> List[str]:
         bad("tracing.store_max must be >= 1")
     if cfg.engine.use_device not in (None, True, False):
         bad("engine.use_device must be null|true|false")
+    olp = cfg.olp
+    if float(olp.sample_interval) <= 0:
+        bad("olp.sample_interval must be > 0")
+    if float(olp.min_hold) < 0:
+        bad("olp.min_hold must be >= 0")
+    if not 0 < float(olp.exit_factor) <= 1:
+        bad("olp.exit_factor must be in (0, 1]")
+    for name in ("loop_lag_ms", "batcher_fill", "mqueue_backlog",
+                 "e2e_p99_ms", "sysmem", "procmem", "cpu"):
+        t = list(getattr(olp, name))
+        if len(t) != 3:
+            bad(f"olp.{name} must be an [L1, L2, L3] triple")
+            continue
+        if any(float(v) <= 0 for v in t):
+            bad(f"olp.{name} thresholds must be > 0")
+        if not (t[0] <= t[1] <= t[2]):
+            bad(f"olp.{name} thresholds must be non-decreasing")
+    if int(olp.window_cap) < 1:
+        bad("olp.window_cap must be >= 1")
+    if not 0 < float(olp.limiter_clamp) <= 1:
+        bad("olp.limiter_clamp must be in (0, 1]")
+    if float(olp.connect_budget) < 0:
+        bad("olp.connect_budget must be >= 0")
+    if int(olp.retained_defer_cap) < 0:
+        bad("olp.retained_defer_cap must be >= 0")
+    if int(olp.retained_flush_per_tick) < 1:
+        bad("olp.retained_flush_per_tick must be >= 1")
+    if int(olp.slow_kill_max) < 0:
+        bad("olp.slow_kill_max must be >= 0")
+    if float(olp.slow_kill_interval) <= 0:
+        bad("olp.slow_kill_interval must be > 0")
+    if float(olp.alarm_min_reraise) < 0 or float(olp.alarm_hold) < 0:
+        bad("olp alarm damping intervals must be >= 0")
+    if int(cfg.mqtt.outbound_high_watermark) < 0:
+        bad("mqtt.outbound_high_watermark must be >= 0")
     return problems

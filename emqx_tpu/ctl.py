@@ -98,6 +98,8 @@ class Ctl:
             print(
                 f"node {n['node']} is {n['node_status']}; "
                 f"uptime {n['uptime']}s; {n['connections']} connections"
+                + (f"; olp level {n['olp_level']}"
+                   if "olp_level" in n else "")
             )
             resume = n.get("resume")
             if resume:
@@ -500,6 +502,38 @@ class Ctl:
                 f"parent={(s.get('parent_id') or '-')[:8]} {extra}"
             )
 
+    def olp(self) -> None:
+        """Overload-protection ladder: level, signals vs thresholds,
+        shed/deferred/refused accounting, recent transitions."""
+        info = self._req("/api/v5/olp")
+        state = "enabled" if info["enable"] else "disabled"
+        print(
+            f"olp {state}; level {info['level']}"
+            + (f" (hold {info['hold_remaining']}s)"
+               if info["hold_remaining"] else "")
+            + (f"; window_cap={info['window_cap']}"
+               if info["window_cap"] else "")
+            + ("; limiters clamped" if info["clamped"] else "")
+        )
+        ths = info["thresholds"]
+        for name, val in sorted(info["signals"].items()):
+            t = ths.get(name, [])
+            print(f"  {name:>16} = {val}\t(L1/L2/L3: "
+                  f"{'/'.join(str(x) for x in t)})")
+        counters = {
+            k: v for k, v in info["counters"].items() if v
+        }
+        if counters:
+            print("  shed/deferred/refused:")
+            for k, v in sorted(counters.items()):
+                print(f"    {k} = {v}")
+        if info["retained_deferred"]:
+            print(f"  retained catch-up deferred: "
+                  f"{info['retained_deferred']} jobs")
+        for t in info["transitions"][-8:]:
+            print(f"  transition {t['from']} -> {t['to']} at {t['at']:.1f}"
+                  f" (signals {t['signals']})")
+
     def banned(self, action: str = "list", *args: str) -> None:
         if action == "list":
             for b in self._req("/api/v5/banned")["data"]:
@@ -541,7 +575,7 @@ def main(argv=None) -> None:
     )
     ap.add_argument("command", help="status|clients|subscriptions|topics|"
                     "rules|metrics|stats|publish|trace|banned|data|"
-                    "rebalance|failpoints|profiler|tracing")
+                    "rebalance|failpoints|profiler|tracing|olp")
     ap.add_argument("args", nargs="*")
     ap.add_argument("--qos", type=int, default=0)
     ns = ap.parse_args(argv)
@@ -580,6 +614,8 @@ def main(argv=None) -> None:
     elif cmd == "rebalance":
         ctl.rebalance(ns.args[0] if ns.args else "status",
                       *ns.args[1:])
+    elif cmd == "olp":
+        ctl.olp()
     else:
         raise SystemExit(f"unknown command {cmd!r}")
 

@@ -318,6 +318,12 @@ class MatchEngine:
         self.rebuild_threshold = rebuild_threshold
         self.use_device = use_device
         self.background_rebuild = background_rebuild
+        # wired by the broker's overload ladder (olp L1): a truthy
+        # return defers scheduling a background rebuild — the delta
+        # tiers keep serving correctness, and the first post-recovery
+        # mutation past the threshold triggers it.  Must be cheap and
+        # non-raising; may be called with engine locks held.
+        self.defer_rebuild = None
         self.delta_aut_threshold = delta_aut_threshold
         # fold when the residual reaches delta/factor: a smaller factor
         # folds less often (less background assemble stealing the GIL
@@ -599,7 +605,9 @@ class MatchEngine:
                 )
             if len(delta) >= self.rebuild_threshold:
                 if self.background_rebuild:
-                    self._start_background_rebuild()
+                    if self.defer_rebuild is None or \
+                            not self.defer_rebuild():
+                        self._start_background_rebuild()
                 else:
                     # synchronous rebuild variant keeps _mlock across
                     # the native sort on purpose: mutations must not
@@ -661,7 +669,9 @@ class MatchEngine:
                     self._pending_inserts.append((flt, fid))
                 if len(self._delta) >= self.rebuild_threshold:
                     if self.background_rebuild:
-                        self._start_background_rebuild()
+                        if self.defer_rebuild is None or \
+                                not self.defer_rebuild():
+                            self._start_background_rebuild()
                     else:
                         self.rebuild()
                 if self.use_device is not False and (
@@ -1060,6 +1070,21 @@ class MatchEngine:
         self._drop_delta_aut()
         self._deleted_base = set()
         self._deleted_daut = set()
+
+    def kick_rebuild(self) -> bool:
+        """Start a background rebuild NOW if the delta has outgrown
+        the threshold — the olp ladder's recovery kick for rebuilds
+        deferred during overload (a stable fleet may otherwise never
+        mutate again, leaving the oversized delta tiers serving every
+        window forever).  Returns True when one was started."""
+        if (
+            self.background_rebuild
+            and len(self._delta) >= self.rebuild_threshold
+            and not self._building
+        ):
+            self._start_background_rebuild()
+            return True
+        return False
 
     def _start_background_rebuild(self) -> None:
         with self._lock:

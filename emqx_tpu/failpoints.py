@@ -72,6 +72,8 @@ SEAMS = (
     "cluster.quic.send",
     "cluster.quic.recv",
     "cluster.forward.ack",
+    "olp.sample",
+    "olp.shed",
 )
 
 enabled = False  # fast-path gate: disabled brokers pay one bool check

@@ -29,6 +29,9 @@ class TokenBucket:
         max_debt: Optional[float] = None,
     ) -> None:
         self.rate = float(rate)
+        # the configured rate: `clamp` scales the live rate against
+        # this base (olp L2 listener clamp), factor 1.0 restores it
+        self.base_rate = self.rate
         self.burst = float(burst if burst is not None else rate)
         # PRIVATE buckets cap debt at one burst: a single oversized
         # read must not become an unbounded pause (keepalives would
@@ -53,6 +56,13 @@ class TokenBucket:
         if self.tokens >= 0:
             return 0.0
         return -self.tokens / self.rate  # time until balance reaches 0
+
+    def clamp(self, factor: float) -> None:
+        """Scale the admitted rate to ``factor`` of the configured
+        base (the olp ladder's L2 aggregate-bucket clamp); 1.0
+        restores.  Outstanding debt drains at the clamped rate, so a
+        clamp under load tightens immediately."""
+        self.rate = self.base_rate * max(float(factor), 1e-9)
 
 
 class ConnectionLimiter:
@@ -91,6 +101,13 @@ class ConnectionLimiter:
         if self.msg_bucket is not None and n_messages:
             delay = max(delay, self.msg_bucket.consume(n_messages, now))
         return delay
+
+    def clamp(self, factor: float) -> None:
+        """Scale both buckets' rates (see `TokenBucket.clamp`)."""
+        if self.msg_bucket is not None:
+            self.msg_bucket.clamp(factor)
+        if self.byte_bucket is not None:
+            self.byte_bucket.clamp(factor)
 
 
 class HierarchicalLimiter:

@@ -76,6 +76,17 @@ METRICS = (
     "delivery.dropped.too_large",
     "delivery.dropped.queue_full",
     "delivery.dropped.expired",
+    "delivery.dropped.olp_shed",
+    "delivery.dropped.out_buffer",
+    "messages.dropped.olp_shed",
+    "olp.level.changed",
+    "olp.deferred.resume",
+    "olp.deferred.retained",
+    "olp.deferred.rebuild",
+    "olp.dropped.retained",
+    "olp.refused.connect",
+    "olp.shed.publish_qos0",
+    "olp.killed.slow_subs",
     "session.created",
     "session.resumed",
     "session.resume.parked",

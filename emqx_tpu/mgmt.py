@@ -200,6 +200,7 @@ class MgmtApi:
         r.add_post("/api/v5/banned", self.post_banned)
         r.add_delete("/api/v5/banned/{kind}/{who}", self.delete_banned)
         r.add_get("/api/v5/slow_subscriptions", self.get_slow_subs)
+        r.add_get("/api/v5/olp", self.get_olp)
         r.add_get("/api/v5/profiler", self.get_profiler)
         r.add_get("/api/v5/profiler/trace", self.get_profiler_trace)
         r.add_delete("/api/v5/profiler", self.reset_profiler)
@@ -502,6 +503,8 @@ class MgmtApi:
             # resume-queue depth (mass-reconnect admission control):
             # active replay slots, parked FIFO, paused mid-replay jobs
             node["resume"] = self.broker.resume.info()
+        if self.broker.olp.enabled:
+            node["olp_level"] = self.broker.olp.level
         ext = self.broker.external
         cluster = ext.info() if ext is not None else {}
         return _json({"data": [node], "cluster": cluster})
@@ -663,6 +666,12 @@ class MgmtApi:
             request.match_info["kind"], request.match_info["who"]
         )
         return web.Response(status=204 if ok else 404)
+
+    async def get_olp(self, request: web.Request) -> web.Response:
+        """Overload-protection ladder state: level, the last signal
+        snapshot vs thresholds, shed/deferred/refused counters, and
+        the recent transition ring."""
+        return _json(self.broker.olp.info())
 
     async def get_slow_subs(self, request: web.Request) -> web.Response:
         return _json({"data": self.broker.slow_subs.top()})

@@ -1,0 +1,631 @@
+"""Coordinated overload protection: the broker-wide load ladder.
+
+The `emqx_olp` role (/root/reference/apps/emqx/src/emqx_olp.erl plus
+the `emqx_os_mon`/`emqx_vm_mon` watermarks and `force_shutdown`): the
+broker already grew the *sensors* — sysmon watermark alarms, limiter
+token buckets, the profiler's stage histograms, the PublishBatcher
+watermark, resume admission — but each subsystem degraded alone.
+This module is the *coordinator*: one `LoadMonitor` folds the sensors
+into a single load **level 0–3** with per-level enter/exit
+thresholds, hysteresis (exit = enter × ``exit_factor``), and a
+minimum hold time, and a degradation ladder wires that level through
+the existing layers:
+
+  ========  ========================================================
+  level     degradation (cumulative: L2 includes L1, L3 includes L2)
+  ========  ========================================================
+  **L1**    new resume-scheduler admissions park (active replays keep
+            draining); retained catch-up on subscribe defers (flushed
+            when the ladder steps back to 0); background engine
+            rebuilds defer; the batcher's max dispatch-window size
+            shrinks to ``window_cap``.
+  **L2**    effective-QoS0 *deliveries* shed via a mask folded into
+            the window decision columns (one vectorized AND per QoS
+            variant; $SYS messages exempt so the overload alarm
+            itself survives); listener/zone shared token buckets
+            clamp to ``limiter_clamp`` of their rate; CONNECT bursts
+            over ``connect_budget``/s answer CONNACK server-busy.
+  **L3**    QoS0 publishes drop at ingress; the ``slow_subs`` top-K
+            slowest subscribers are force-closed with DISCONNECT
+            server-busy (the ``force_shutdown`` analogue).
+  ========  ========================================================
+
+Invariant at every level: **zero QoS≥1 loss for admitted traffic** —
+shedding is QoS0-only, refusals happen BEFORE state exists (CONNACK
+server-busy), and every shed/deferred/refused unit is counted
+(``olp.*`` / ``delivery.dropped.olp_shed`` counters), carried on the
+standing ``overload`` $SYS alarm, and surfaced over ``GET
+/api/v5/olp`` and ``ctl olp`` — never silent.
+
+Signals sampled every ``sample_interval`` (all normalized against
+config threshold triples, one per level):
+
+  * ``loop_lag_ms``     — event-loop scheduling lag, measured as the
+    housekeeping tick's overshoot past its 1 Hz cadence;
+  * ``batcher_fill``    — PublishBatcher depth as a fraction of its
+    global high watermark;
+  * ``mqueue_backlog``  — aggregate mqueue backlog across sessions;
+  * ``e2e_p99_ms``      — EWMA of the profiler's per-sample-interval
+    publish→delivery p99 (PR 4 stage histograms, delta snapshots);
+  * ``sysmem`` / ``procmem`` / ``cpu`` — the sysmon watermark inputs.
+
+Ladder transitions step UP immediately (protection must react fast,
+possibly jumping levels) and DOWN one level at a time, only after
+``min_hold`` seconds AND once every signal sits below the current
+level's exit threshold — the hysteresis that keeps a load square-wave
+near a threshold from flapping the ladder.
+
+Failpoint seams: ``olp.sample`` (a faulted sample round holds the
+previous level) and ``olp.shed`` (a faulted shed-accounting path must
+not break the protective action itself) — FP301-covered, chaos-tested.
+
+Disabled by default (``olp.enable``), like the reference's
+``overload_protection``: an unarmed broker pays one bool per tick and
+one attribute load per dispatch window.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from . import failpoints
+from .observability import HistogramSnapshot
+from .sysmon import _meminfo, _rss_bytes
+
+log = logging.getLogger("emqx_tpu.olp")
+
+# signal -> OlpConfig field carrying its (L1, L2, L3) enter thresholds
+_SIGNAL_FIELDS = (
+    "loop_lag_ms", "batcher_fill", "mqueue_backlog", "e2e_p99_ms",
+    "sysmem", "procmem", "cpu",
+)
+
+# every ladder counter the REST/ctl surface reports (registry names)
+COUNTERS = (
+    "olp.level.changed",
+    "olp.deferred.resume",
+    "olp.deferred.retained",
+    "olp.deferred.rebuild",
+    "olp.dropped.retained",
+    "olp.refused.connect",
+    "olp.shed.publish_qos0",
+    "olp.killed.slow_subs",
+    "delivery.dropped.olp_shed",
+    "delivery.dropped.out_buffer",
+    "messages.dropped.olp_shed",
+)
+
+# the housekeeping cadence the loop-lag signal measures overshoot
+# against (BrokerServer._housekeeping sleeps 1.0 s between ticks)
+_TICK_INTERVAL = 1.0
+# a tick gap beyond this is a clock jump or a test-injected timestamp,
+# not event-loop lag
+_LAG_CEILING_S = 60.0
+# EWMA weight for the e2e-p99 signal; an idle interval decays the
+# estimate by half so recovery is observable without fresh traffic
+_EWMA_ALPHA = 0.3
+
+
+class LoadMonitor:
+    """Samples the broker's load sensors into one level 0-3 and owns
+    the ladder's side effects.  Constructed unconditionally by the
+    Broker; everything is a no-op while ``cfg.enable`` is False.
+
+    Hot paths read the precomputed flag attributes only (one attribute
+    load per window/run): ``shed_qos0_mask`` (L2), ``shed_ingress_qos0``
+    (L3), ``defer_admissions`` (L1), ``window_cap_now`` (L1, 0 = off).
+    """
+
+    def __init__(self, broker, cfg) -> None:
+        self.broker = broker
+        self.cfg = cfg
+        self.enabled = bool(cfg.enable)
+        self.level = 0
+        # shared limiters the L2 clamp scales (listener aggregates +
+        # the node/zone bucket), registered by BrokerServer.start
+        self.clamp_targets: List = []
+        # hot-path flags (recomputed on every level transition)
+        self.shed_qos0_mask = False
+        self.shed_ingress_qos0 = False
+        self.defer_admissions = False
+        self.window_cap_now = 0
+        self._thresholds: Dict[str, Tuple[float, float, float]] = {
+            name: tuple(float(v) for v in getattr(cfg, name))
+            for name in _SIGNAL_FIELDS
+        }
+        self._hold_until = 0.0
+        self._clamped = False
+        self._last_tick = 0.0
+        self._last_sample = 0.0
+        self._lag_ms = 0.0
+        self._ewma_e2e = 0.0
+        self._prev_e2e: Optional[HistogramSnapshot] = None
+        self._signals: Dict[str, float] = {}
+        self._transitions: deque = deque(maxlen=64)
+        # deferred retained catch-up jobs, insertion-ordered (dict) so
+        # the level-0 flush replays oldest-first; the value is None
+        # (not matched yet) or the REMAINING message snapshot of a job
+        # chunking across ticks — a numeric offset into a re-run match
+        # would skip/duplicate messages when the retained set mutates
+        # between ticks.  Bounded by ``retained_defer_cap`` (overflow
+        # counted, never silent); snapshots exist only for the one job
+        # a tick leaves mid-chunk.
+        self._retained_defer: Dict[Tuple[str, str], Optional[List]] = {}
+        self._shed_totals: Dict[str, int] = {}
+        self._next_kill = 0.0
+        self._rebuild_note = 0.0
+        self._rebuild_deferred = False
+        # L2 CONNECT admission budget (token bucket; refusals never
+        # consume — a refused client's retry competes for the same
+        # tokens)
+        self._cb_tokens = float(cfg.connect_budget)
+        self._cb_at = time.monotonic()
+
+    # ------------------------------------------------------- sampling
+
+    def tick(self, now: Optional[float] = None) -> int:
+        """Driven at 1 Hz by `Broker.tick`: measures event-loop lag
+        from the tick cadence, runs a full sample every
+        ``sample_interval``, and advances the level-dependent
+        housekeeping (retained-catch-up flush at level 0, periodic
+        slow-subscriber kills at level 3)."""
+        if not self.enabled:
+            return self.level
+        now = time.time() if now is None else now
+        if self._last_tick:
+            overshoot = (now - self._last_tick) - _TICK_INTERVAL
+            # a forward jump past the ceiling is a clock jump (or a
+            # test driving tick with synthetic times), not loop lag
+            if 0.0 < overshoot < _LAG_CEILING_S:
+                self._lag_ms = overshoot * 1000.0
+            else:
+                self._lag_ms = 0.0
+        self._last_tick = now
+        if now - self._last_sample >= float(self.cfg.sample_interval):
+            self._last_sample = now
+            try:
+                self.sample(now)
+            except failpoints.FailpointPanic:
+                raise
+            except Exception:
+                # a faulted sample round must never take the broker
+                # down with it; the PREVIOUS level (and its ladder
+                # effects) hold until sampling recovers
+                log.exception("olp sample failed; level %d held",
+                              self.level)
+        if self.level == 0 and self._rebuild_deferred:
+            # sweep for the defer_rebuild/_set_level(0) race: an
+            # engine mutation thread may flag a deferral just as the
+            # ladder steps down — the tick catches it within a second
+            self._rebuild_deferred = False
+            try:
+                self.broker.router.engine.kick_rebuild()
+            except Exception:
+                log.exception("olp recovery rebuild kick failed")
+        if self.level == 0 and self._retained_defer:
+            self._flush_retained()
+        elif self.level >= 3 and now >= self._next_kill:
+            self._next_kill = now + float(self.cfg.slow_kill_interval)
+            self._kill_slow_subs()
+        return self.level
+
+    def sample(self, now: Optional[float] = None) -> Dict[str, float]:
+        """Collect one signal snapshot and feed the level machine.
+        Failpoint seam ``olp.sample``: drop = skip this round (level
+        held), error = the tick's guard holds the level, delay = a
+        slow sampler (chaos measures the ladder still converges)."""
+        now = time.time() if now is None else now
+        act = failpoints.evaluate("olp.sample")
+        if act == "drop":
+            return self._signals
+        b = self.broker
+        sig: Dict[str, float] = {"loop_lag_ms": self._lag_ms}
+        batcher = b.batcher
+        sig["batcher_fill"] = (
+            batcher.depth() / max(batcher.global_high, 1)
+            if batcher is not None else 0.0
+        )
+        sig["mqueue_backlog"] = float(b.cm.total_mqueued())
+        sig["e2e_p99_ms"] = self._stage_p99()
+        mem = _meminfo()
+        total = mem.get("MemTotal", 0)
+        avail = mem.get("MemAvailable", 0)
+        sig["sysmem"] = 1.0 - (avail / total) if total else 0.0
+        sig["procmem"] = (_rss_bytes() / total) if total else 0.0
+        try:
+            load1 = os.getloadavg()[0]
+        except OSError:
+            load1 = 0.0
+        sig["cpu"] = load1 / (os.cpu_count() or 1)
+        self.observe(sig, now)
+        return sig
+
+    def _stage_p99(self) -> float:
+        """EWMA of the per-sample-interval e2e (publish→delivery) p99
+        in ms, from delta snapshots of the profiler's cumulative
+        histogram; idle intervals decay the estimate by half so the
+        ladder can step down once traffic subsides."""
+        snap = self.broker.profiler.snapshots().get("e2e")
+        if snap is None:
+            return self._ewma_e2e
+        prev, self._prev_e2e = self._prev_e2e, snap
+        if prev is None:
+            return self._ewma_e2e
+        d_count = snap.count - prev.count
+        if d_count <= 0:
+            self._ewma_e2e *= 0.5
+            return self._ewma_e2e
+        delta = HistogramSnapshot(
+            tuple(a - b for a, b in zip(snap.counts, prev.counts)),
+            snap.sum - prev.sum, d_count,
+        )
+        p99_ms = delta.percentile(99) / 1000.0  # recorded in µs
+        self._ewma_e2e = (
+            p99_ms if self._ewma_e2e == 0.0
+            else _EWMA_ALPHA * p99_ms + (1 - _EWMA_ALPHA) * self._ewma_e2e
+        )
+        return self._ewma_e2e
+
+    # --------------------------------------------------- level machine
+
+    def observe(self, signals: Dict[str, float],
+                now: Optional[float] = None) -> int:
+        """Fold one signal snapshot into the level: UP transitions are
+        immediate (and may jump several levels), DOWN transitions step
+        ONE level at a time, only after ``min_hold`` seconds and once
+        every signal is below the exit threshold (enter ×
+        ``exit_factor``) of the current level — the hysteresis.  Pure
+        against injected ``now``/signals, which is what the seeded
+        property tests drive."""
+        if not self.enabled:
+            return self.level
+        now = time.time() if now is None else now
+        self._signals = dict(signals)
+        xf = float(self.cfg.exit_factor)
+        enter = 0
+        exit_floor = 0
+        for name, val in signals.items():
+            t = self._thresholds.get(name)
+            if t is None:
+                continue
+            for i in (2, 1, 0):
+                if val >= t[i]:
+                    if i + 1 > enter:
+                        enter = i + 1
+                    break
+            for i in (2, 1, 0):
+                if val >= t[i] * xf:
+                    if i + 1 > exit_floor:
+                        exit_floor = i + 1
+                    break
+        if enter > self.level:
+            self._set_level(enter, now)
+        elif exit_floor < self.level and now >= self._hold_until:
+            self._set_level(self.level - 1, now)
+        return self.level
+
+    def _set_level(self, new: int, now: float) -> None:
+        """One ladder transition: recompute the hot-path flags, apply
+        the side effects that live on level EDGES (limiter clamp,
+        slow-sub kill), and keep the operator surfaces honest ($SYS
+        alarm with flap damping, metrics, the transition ring)."""
+        old, self.level = self.level, new
+        self._hold_until = now + float(self.cfg.min_hold)
+        b = self.broker
+        b.metrics.inc("olp.level.changed")
+        b.stats.set("olp.level", new)
+        self._transitions.append({
+            "at": now, "from": old, "to": new,
+            "signals": {k: round(v, 3) for k, v in self._signals.items()},
+        })
+        self.shed_qos0_mask = new >= 2
+        self.shed_ingress_qos0 = new >= 3
+        self.defer_admissions = new >= 1
+        self.window_cap_now = int(self.cfg.window_cap) if new >= 1 else 0
+        want_clamp = new >= 2
+        if want_clamp != self._clamped:
+            self._clamped = want_clamp
+            factor = float(self.cfg.limiter_clamp) if want_clamp else 1.0
+            for lim in self.clamp_targets:
+                try:
+                    lim.clamp(factor)
+                except Exception:
+                    log.exception("olp limiter clamp failed")
+        try:
+            if new >= 1:
+                b.alarms.update(
+                    "overload",
+                    details={
+                        "level": new,
+                        "signals": {
+                            k: round(v, 3)
+                            for k, v in self._signals.items()
+                        },
+                        "shed": dict(self._shed_totals),
+                    },
+                    message=f"broker overload ladder at level {new}",
+                    min_reraise=float(self.cfg.alarm_min_reraise),
+                    now=now,
+                )
+            else:
+                # hysteresis hold on the deactivate too: a re-raise
+                # inside the hold cancels it without $SYS churn
+                b.alarms.deactivate(
+                    "overload", hold=float(self.cfg.alarm_hold), now=now
+                )
+        except Exception:
+            log.exception("olp alarm update failed")
+        if new >= 3 and old < 3:
+            self._next_kill = now + float(self.cfg.slow_kill_interval)
+            self._kill_slow_subs()
+        if new == 0 and self._rebuild_deferred:
+            # recovery kick: a rebuild deferred during the episode
+            # must not wait for the next unrelated mutation (a stable
+            # fleet may never mutate again)
+            self._rebuild_deferred = False
+            try:
+                b.router.engine.kick_rebuild()
+            except Exception:
+                log.exception("olp recovery rebuild kick failed")
+        (log.warning if new > old else log.info)(
+            "olp level %d -> %d (signals: %s)", old, new,
+            {k: round(v, 3) for k, v in self._signals.items()},
+        )
+
+    # ------------------------------------------------ shed accounting
+
+    def shed(self, kind: str, n: int = 1) -> None:
+        """The ONE accounting point for ladder shed/defer/refuse
+        EVENTS: counter (``olp.<kind>``), the REST ledger, and — via
+        the standing ``overload`` alarm details — $SYS.  (Per-DELIVERY
+        sheds are counted by the dispatch window itself, batched into
+        its ``mloc`` flush under the ``delivery.dropped.olp_shed``
+        registry names.)  Failpoint seam ``olp.shed``: an injected (or
+        real) accounting fault must never break the protective action
+        itself, so faults short of a panic still count through the
+        direct metrics path."""
+        try:
+            failpoints.evaluate("olp.shed", key=kind)
+            self._shed_totals[kind] = self._shed_totals.get(kind, 0) + n
+            self.broker.metrics.inc("olp." + kind, n)
+        except failpoints.FailpointPanic:
+            raise
+        except Exception:
+            # the shed itself already happened (or is about to): keep
+            # it observable even when the primary accounting faulted
+            try:
+                self.broker.metrics.inc("olp." + kind, n)
+            except Exception:
+                pass
+            log.exception("olp shed accounting failed for %s", kind)
+
+    # --------------------------------------------------- L1 deferrals
+
+    def defer_retained(self, clientid: str, flt: str) -> bool:
+        """L1: park a subscription's retained catch-up (the match walk
+        + delivery burst) until the ladder steps back to 0; the tick
+        then flushes ``retained_flush_per_tick`` jobs per second.
+        Returns True when the caller must answer with no retained
+        messages now.  Past ``retained_defer_cap`` the job is dropped
+        — counted (``olp.dropped.retained``), never silent; the
+        client re-subscribing after recovery replays normally."""
+        if self.level < 1:
+            return False
+        key = (clientid, flt)
+        if key not in self._retained_defer:
+            if len(self._retained_defer) >= int(
+                self.cfg.retained_defer_cap
+            ):
+                self.shed("dropped.retained")
+            else:
+                self._retained_defer[key] = None
+                self.shed("deferred.retained")
+        return True
+
+    def cancel_retained_client(self, clientid: str) -> None:
+        """Drop every parked catch-up job of a discarded/terminated/
+        exported session — dead clients' jobs must not exhaust
+        ``retained_defer_cap`` and crowd out live subscribers."""
+        if not self._retained_defer:
+            return
+        for key in [
+            k for k in self._retained_defer if k[0] == clientid
+        ]:
+            del self._retained_defer[key]
+
+    def cancel_retained(self, clientid: str, flt: str) -> None:
+        """Drop a parked catch-up job: the client unsubscribed, or
+        re-subscribed with retain_handling that forbids retained —
+        the flush must not deliver a burst the CURRENT subscription
+        options disallow."""
+        self._retained_defer.pop((clientid, flt), None)
+
+    def _flush_retained(self) -> None:
+        """Level back at 0: replay deferred retained catch-up, oldest
+        first, paced at ``retained_flush_per_tick`` MESSAGES per tick
+        — a single filter matching a huge retained set chunks across
+        ticks (the job re-parks with its offset) — so recovery itself
+        cannot stall the event loop and re-trigger the ladder.  Jobs
+        whose session/subscription vanished meanwhile (or whose
+        CURRENT options forbid retained) are skipped — a reconnect's
+        fresh SUBSCRIBE replays retained normally."""
+        from .broker.session import SubOpts
+
+        b = self.broker
+        budget = int(self.cfg.retained_flush_per_tick)
+        while self._retained_defer and budget > 0 and self.level == 0:
+            key = next(iter(self._retained_defer))
+            remaining = self._retained_defer.pop(key)
+            cid, flt = key
+            session = b.cm.lookup(cid)
+            if session is None:
+                budget -= 1  # every job costs >= 1 (bounded scans)
+                continue
+            opts = session.subscriptions.get(flt)
+            if (
+                opts is None
+                or opts.share_group is not None
+                or opts.retain_handling == 2
+            ):
+                budget -= 1
+                continue
+            if remaining is None:
+                # first chunk: ONE match walk per job; the tail (if
+                # any) re-parks as a message snapshot, so a mutating
+                # retained set can't skip or duplicate deliveries
+                try:
+                    msgs = b.retainer.match(flt)
+                except Exception:
+                    log.exception(
+                        "deferred retained match failed for %s", flt
+                    )
+                    budget -= 1
+                    continue
+            else:
+                msgs = remaining
+            if not msgs:
+                budget -= 1
+                continue
+            if len(msgs) > budget:
+                # chunk: deliver a budget's worth now, re-park the
+                # tail snapshot (FIFO end — other jobs go first)
+                self._retained_defer[key] = msgs[budget:]
+                msgs = msgs[:budget]
+            budget -= max(len(msgs), 1)
+            # retained replay keeps the retain bit set [MQTT-3.3.1-8],
+            # exactly as the in-line subscribe path builds it
+            ropts = SubOpts(
+                qos=opts.qos, retain_as_published=True, subid=opts.subid
+            )
+            jobs = [(m, ropts) for m in msgs]
+            channel = b.cm.channel(cid)
+            from collections import Counter
+
+            mloc: "Counter" = Counter()
+            try:
+                if channel is not None and not b._stalled(
+                    session, channel
+                ):
+                    channel.send_packets(session.deliver(jobs))
+                elif channel is not None:
+                    # still over its outbound watermark: the catch-up
+                    # burst must respect the SAME stall gate as live
+                    # dispatch (QoS0 dropped + counted, QoS>0 parked)
+                    # — not pile onto the overflowing buffer
+                    b._queue_stalled_run(
+                        session, cid, jobs, mloc, None
+                    )
+                else:
+                    # detached persistent session: the shared queue
+                    # path — QoS>0 to the mqueue, QoS0 dropped AND
+                    # counted (never silent), no_local respected
+                    b._queue_detached_run(
+                        session, cid, jobs, mloc, None
+                    )
+            except Exception:
+                log.exception("deferred retained flush to %s failed",
+                              cid)
+            if mloc:
+                b.metrics.inc_bulk(mloc)
+
+    def defer_rebuild(self) -> bool:
+        """L1: the match engine asks before scheduling a background
+        rebuild; True = defer (the delta tiers keep serving
+        correctness, the rebuild fires on the first post-recovery
+        delta).  Called from engine mutation paths — possibly off the
+        loop thread — so it touches only counters."""
+        if not self.defer_admissions:
+            return False
+        self._rebuild_deferred = True  # recovery kicks it (level 0)
+        now = time.time()
+        if now - self._rebuild_note >= 1.0:
+            # throttle: one counted deferral per second, not one per
+            # blocked insert batch
+            self._rebuild_note = now
+            self.shed("deferred.rebuild")
+        return True
+
+    # ------------------------------------------------ L2 connect gate
+
+    def refuse_connect(self, now: Optional[float] = None) -> bool:
+        """L2: CONNECT admission budget — ``connect_budget`` tokens/s,
+        refusals do NOT consume (a refused client's retry competes for
+        the same tokens).  True = answer CONNACK server-busy."""
+        if self.level < 2:
+            return False
+        rate = float(self.cfg.connect_budget)
+        if rate <= 0:
+            return False
+        now = time.monotonic() if now is None else now
+        self._cb_tokens = min(
+            rate, self._cb_tokens + (now - self._cb_at) * rate
+        )
+        self._cb_at = now
+        if self._cb_tokens >= 1.0:
+            self._cb_tokens -= 1.0
+            return False
+        self.shed("refused.connect")
+        return True
+
+    # ------------------------------------------------- L3 force close
+
+    def _kill_slow_subs(self) -> None:
+        """L3: force-close the slow-subs board's top-K slowest
+        subscribers (DISCONNECT server-busy — the `force_shutdown`
+        analogue).  Their sessions survive per their expiry, so a
+        persistent subscriber loses its socket, not its QoS1 state."""
+        b = self.broker
+        killed = 0
+        seen: set = set()
+        for entry in b.slow_subs.top():
+            if killed >= int(self.cfg.slow_kill_max):
+                break
+            cid = entry["clientid"]
+            if cid in seen:
+                continue  # one board entry per DELIVERY: dedupe, or
+                # one pathological client burns the whole kill budget
+            seen.add(cid)
+            channel = b.cm.channel(cid)
+            if channel is None or getattr(channel, "_closing", False):
+                continue
+            try:
+                channel.close("olp_overloaded")
+            except Exception:
+                log.exception("olp slow-sub close failed for %s", cid)
+                continue
+            killed += 1
+            log.warning("olp L3 force-closed slow subscriber %s "
+                        "(latency %.0f ms)", cid,
+                        entry["latency_ms"])
+        if killed:
+            self.shed("killed.slow_subs", killed)
+
+    # ----------------------------------------------------------- info
+
+    def info(self) -> Dict[str, object]:
+        """Operator surface (``GET /api/v5/olp``, ``ctl olp``)."""
+        m = self.broker.metrics
+        now = time.time()
+        return {
+            "enable": self.enabled,
+            "level": self.level,
+            "signals": {
+                k: round(v, 4) for k, v in self._signals.items()
+            },
+            "thresholds": {
+                k: list(v) for k, v in self._thresholds.items()
+            },
+            "exit_factor": float(self.cfg.exit_factor),
+            "min_hold": float(self.cfg.min_hold),
+            "hold_remaining": round(max(0.0, self._hold_until - now), 3),
+            "window_cap": self.window_cap_now,
+            "clamped": self._clamped,
+            "retained_deferred": len(self._retained_defer),
+            "shed": dict(self._shed_totals),
+            "counters": {name: m.val(name) for name in COUNTERS},
+            "transitions": list(self._transitions),
+        }

@@ -35,13 +35,28 @@ class Alarm:
 
 class AlarmRegistry:
     """activate/deactivate with history (emqx_alarm.erl), publishing
-    ``$SYS/brokers/<node>/alarms/...`` through the broker."""
+    ``$SYS/brokers/<node>/alarms/...`` through the broker.
+
+    Flap damping (per call, default off — legacy semantics hold):
+    ``deactivate(name, hold=N)`` parks the deactivation for N seconds
+    (processed by `tick`), and an ``activate``/``update`` inside the
+    hold CANCELS it — a condition square-waving near its threshold
+    costs one activate publish, one eventual deactivate, not one pair
+    per oscillation.  ``update(..., min_reraise=N)`` refreshes a
+    STANDING alarm's details with the re-publish throttled to one per
+    N seconds.  A PUBLISHED deactivate always resets the throttle:
+    state changes visible on $SYS are never suppressed — damping only
+    thins refreshes of an already-raised alarm."""
 
     def __init__(self, broker=None, history_cap: int = 256) -> None:
         self.broker = broker
         self.history_cap = history_cap
         self._active: Dict[str, Alarm] = {}
         self._history: List[Alarm] = []
+        # name -> wall ts of the last published *activate* (re-raise
+        # throttling) / pending-deactivation deadlines (hysteresis)
+        self._last_raise: Dict[str, float] = {}
+        self._pending_deact: Dict[str, float] = {}
 
     def activate(
         self,
@@ -49,10 +64,15 @@ class AlarmRegistry:
         details: Optional[Dict] = None,
         message: str = "",
         ttl: Optional[float] = None,
+        min_reraise: float = 0.0,
+        now: Optional[float] = None,
     ) -> bool:
+        now = time.time() if now is None else now
         if name in self._active:
+            # the condition re-asserted: a pending (held) deactivation
+            # is cancelled without any $SYS churn
+            self._pending_deact.pop(name, None)
             return False  # already active (duplicate activation ignored)
-        now = time.time()
         alarm = Alarm(
             name=name,
             details=dict(details or {}),
@@ -61,16 +81,80 @@ class AlarmRegistry:
             expires_at=None if ttl is None else now + ttl,
         )
         self._active[name] = alarm
+        if min_reraise > 0.0:
+            # an inactive->active transition ALWAYS publishes (any
+            # prior published deactivate cleared the throttle); the
+            # stamp arms `update`'s refresh damping.  Only damped
+            # alarms are tracked: per-client names (flapping/<cid>,
+            # conn_congestion/<cid>) never pass min_reraise, so
+            # client churn cannot grow this dict.
+            self._last_raise[name] = now
         self._publish("alarms/activate", alarm)
         return True
 
-    def deactivate(self, name: str) -> bool:
+    def update(
+        self,
+        name: str,
+        details: Optional[Dict] = None,
+        message: str = "",
+        min_reraise: float = 0.0,
+        now: Optional[float] = None,
+    ) -> bool:
+        """Refresh an ACTIVE alarm's details/message in place (or
+        activate it): publishes an activate message, throttled by
+        ``min_reraise`` — the olp ladder's level changes ride one
+        standing alarm instead of a deactivate/activate pair."""
+        now = time.time() if now is None else now
+        alarm = self._active.get(name)
+        if alarm is None:
+            return self.activate(
+                name, details=details, message=message,
+                min_reraise=min_reraise, now=now,
+            )
+        self._pending_deact.pop(name, None)
+        if details is not None:
+            alarm.details = dict(details)
+        if message:
+            alarm.message = message
+        if min_reraise > 0.0:
+            if (
+                now - self._last_raise.get(name, float("-inf"))
+                < min_reraise
+            ):
+                return False  # updated silently (damped)
+            self._last_raise[name] = now  # damped alarms only (churn)
+        self._publish("alarms/activate", alarm)
+        return True
+
+    def deactivate(
+        self,
+        name: str,
+        hold: float = 0.0,
+        now: Optional[float] = None,
+    ) -> bool:
+        now = time.time() if now is None else now
+        if hold > 0.0:
+            if name not in self._active:
+                return False
+            # hysteresis: park the deactivation; `tick` completes it
+            # unless an activate/update cancels it first.  setdefault:
+            # repeated held deactivates never push the deadline out.
+            self._pending_deact.setdefault(name, now + hold)
+            return False
+        self._pending_deact.pop(name, None)
         alarm = self._active.pop(name, None)
         if alarm is None:
             return False
-        alarm.deactivated_at = time.time()
+        alarm.deactivated_at = now
         self._history.append(alarm)
         del self._history[: -self.history_cap]
+        # a PUBLISHED deactivate resets the re-raise damping: the
+        # alarm's published state is now "inactive", so the next
+        # activation must publish whatever the damping window says —
+        # else a flap could leave a live alarm looking cleared for
+        # the rest of the episode.  (Also keeps `_last_raise` from
+        # outliving its alarm.)
+        self._last_raise.pop(name, None)
         self._publish("alarms/deactivate", alarm)
         return True
 
@@ -96,14 +180,19 @@ class AlarmRegistry:
 
     def tick(self, now: Optional[float] = None) -> None:
         """Auto-deactivate alarms past their ttl (per-client flapping
-        alarms would otherwise accumulate forever)."""
+        alarms would otherwise accumulate forever) and complete held
+        deactivations whose hysteresis hold elapsed un-cancelled."""
         now = now if now is not None else time.time()
         for name in [
             n
             for n, a in self._active.items()
             if a.expires_at is not None and now > a.expires_at
         ]:
-            self.deactivate(name)
+            self.deactivate(name, now=now)
+        for name in [
+            n for n, at in self._pending_deact.items() if now >= at
+        ]:
+            self.deactivate(name, now=now)
 
     def active(self) -> List[Alarm]:
         return list(self._active.values())

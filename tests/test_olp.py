@@ -1,0 +1,1429 @@
+"""Coordinated overload protection (olp.py): the broker-wide load
+ladder with QoS-aware shedding, admission clamps, and
+hysteresis-driven recovery.
+
+Four layers of coverage:
+
+  * the LEVEL MACHINE driven with synthetic signal traces (pure
+    ``observe`` with injected clocks): monotone one-step-down,
+    immediate (possibly multi-step) up, min-hold, exit-factor
+    hysteresis under square-wave load, seeded random-trace properties;
+  * the LADDER EFFECTS, each against its real subsystem: L1 resume
+    parking / retained deferral + flush / window shrink / rebuild
+    deferral, L2 shed-mask parity vs the scalar referee (bit-identical
+    wires across scalar / host-columns / device-columns with shedding
+    active), listener bucket clamps, CONNECT budget; L3 ingress QoS0
+    drop and slow-subscriber force-close;
+  * the satellites: per-connection outbound high-watermark (stub
+    transport + a REAL paused-transport regression) and AlarmRegistry
+    flap damping (square-wave churn bounds);
+  * the CHAOS gates: a publish flood plus slow-subscriber storm
+    through ladder-up → responsive control plane → ladder-down, with
+    zero QoS1 loss for admitted traffic; kill-mid-shed via the
+    ``olp.shed`` panic; ``olp.sample`` faults hold the level (FP301
+    coverage for both new seams).
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from emqx_tpu import failpoints as fp
+from emqx_tpu.broker.broker import Broker, PublishBatcher
+from emqx_tpu.broker.channel import Channel
+from emqx_tpu.broker.session import SubOpts
+from emqx_tpu.codec import mqtt as C
+from emqx_tpu.config import BrokerConfig, check_config
+from emqx_tpu.limiter import ConnectionLimiter
+from emqx_tpu.message import Message
+from emqx_tpu.metrics import Metrics
+from emqx_tpu.ops import dispatchasm
+from emqx_tpu.ops_guard import AlarmRegistry
+
+_native = dispatchasm.load()
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    fp.clear()
+    yield
+    fp.clear()
+
+
+def _broker(enable=True, columns=True, **olp_kw):
+    cfg = BrokerConfig()
+    cfg.engine.use_device = False
+    cfg.olp.enable = enable
+    # pin the REAL-machine signals inert so a loaded CI box can never
+    # move the ladder under a test; loop_lag_ms (100/500/2000) is the
+    # synthetic driver the tests inject through `observe`
+    cfg.olp.sysmem = [0.999, 0.9995, 0.9999]
+    cfg.olp.procmem = [0.97, 0.98, 0.99]
+    cfg.olp.cpu = [1e6, 2e6, 3e6]
+    cfg.olp.e2e_p99_ms = [1e6, 2e6, 3e6]
+    cfg.olp.mqueue_backlog = [1e9, 2e9, 3e9]
+    for k, v in olp_kw.items():
+        setattr(cfg.olp, k, v)
+    b = Broker(config=cfg)
+    b._decide_columns = columns
+    return b
+
+
+def lift(b, level, now=None):
+    """Drive the ladder to `level` with one synthetic loop-lag signal
+    (thresholds 100/500/2000 ms by default)."""
+    now = time.time() if now is None else now
+    val = {0: 0.0, 1: 100.0, 2: 500.0, 3: 2000.0}[level]
+    b.olp.observe({"loop_lag_ms": val}, now=now)
+    assert b.olp.level == level
+    return now
+
+
+def settle(b, now):
+    """Step the ladder all the way back to 0 (one held step at a
+    time), returning the final injected clock."""
+    while b.olp.level:
+        now += float(b.olp.cfg.min_hold) + 0.01
+        b.olp.observe({"loop_lag_ms": 0.0}, now=now)
+    return now
+
+
+class WireChannel(Channel):
+    def __init__(self, broker, version=C.MQTT_V5):
+        self.writes = []
+
+        def send(pkts):
+            self.writes.append(
+                b"".join(C.serialize(p, self.version) for p in pkts)
+            )
+
+        super().__init__(broker, send=send, close=lambda r: None)
+        self.version = version
+
+    def wire(self) -> bytes:
+        return b"".join(bytes(w) for w in self.writes)
+
+    def packets(self):
+        return list(
+            C.StreamParser(version=self.version).feed(self.wire())
+        )
+
+
+# ============================================================ levels
+
+def test_disabled_default_is_inert():
+    b = _broker(enable=False)
+    assert BrokerConfig().olp.enable is False  # ships off, like emqx
+    assert b.olp.observe({"loop_lag_ms": 1e9}, now=time.time()) == 0
+    assert b.olp.tick(time.time()) == 0
+    assert b.olp.shed_qos0_mask is False
+    assert b.olp.defer_admissions is False
+
+
+def test_enter_levels_and_max_across_signals():
+    b = _broker()
+    now = time.time()
+    assert b.olp.observe({"loop_lag_ms": 99.0}, now=now) == 0
+    assert b.olp.observe({"loop_lag_ms": 100.0}, now=now) == 1
+    # a second signal at a HIGHER level wins (max across signals),
+    # and up-transitions may jump several levels at once
+    assert b.olp.observe(
+        {"loop_lag_ms": 100.0, "batcher_fill": 3.0}, now=now
+    ) == 3
+    assert b.olp.shed_qos0_mask and b.olp.shed_ingress_qos0
+    assert b.olp.defer_admissions
+    assert b.olp.window_cap_now == b.olp.cfg.window_cap
+
+
+def test_down_steps_one_level_after_hold():
+    b = _broker(min_hold=5.0)
+    now = lift(b, 3)
+    # inside the hold nothing steps down, however quiet the signals
+    assert b.olp.observe({"loop_lag_ms": 0.0}, now=now + 1) == 3
+    # past the hold: exactly ONE step per observe, each re-arming it
+    assert b.olp.observe({"loop_lag_ms": 0.0}, now=now + 5.1) == 2
+    assert b.olp.observe({"loop_lag_ms": 0.0}, now=now + 5.2) == 2
+    assert b.olp.observe({"loop_lag_ms": 0.0}, now=now + 10.3) == 1
+    assert b.olp.observe({"loop_lag_ms": 0.0}, now=now + 15.5) == 0
+    assert not b.olp.defer_admissions and not b.olp.shed_qos0_mask
+    assert b.olp.window_cap_now == 0
+
+
+def test_exit_factor_hysteresis_square_wave():
+    """A load signal square-waving between just-above-enter and
+    just-below-enter-but-above-exit must cost ONE transition total —
+    the ladder neither flaps nor steps down while the signal sits in
+    the hysteresis band (enter * exit_factor .. enter)."""
+    b = _broker(min_hold=2.0, exit_factor=0.8)
+    t0 = time.time()
+    changes = 0
+    last = 0
+    for i in range(100):
+        val = 120.0 if i % 2 == 0 else 85.0  # L1 enter=100, exit=80
+        lvl = b.olp.observe({"loop_lag_ms": val}, now=t0 + i)
+        if lvl != last:
+            changes += 1
+            last = lvl
+    assert last == 1 and changes == 1
+    # dropping BELOW the exit threshold finally releases it
+    assert b.olp.observe({"loop_lag_ms": 79.0}, now=t0 + 200) == 0
+
+
+@pytest.mark.parametrize("seed", [3, 17, 29])
+def test_seeded_trace_level_properties(seed):
+    """Random signal walks: levels stay in [0, 3], down transitions
+    are exactly one step, up transitions only when a signal is at or
+    above its enter threshold, and a long quiet tail converges to 0."""
+    import random
+
+    rng = random.Random(seed)
+    b = _broker(min_hold=3.0)
+    t = time.time()
+    prev = 0
+    for _ in range(300):
+        t += rng.uniform(0.2, 2.0)
+        sig = {
+            "loop_lag_ms": rng.choice([0, 50, 90, 120, 600, 2500]),
+            "batcher_fill": rng.choice([0.0, 0.5, 0.9, 1.7]),
+        }
+        lvl = b.olp.observe(sig, now=t)
+        assert 0 <= lvl <= 3
+        if lvl < prev:
+            assert lvl == prev - 1, "down must step one level"
+        if lvl > prev:
+            assert (
+                sig["loop_lag_ms"] >= (100, 500, 2000)[lvl - 1]
+                or sig["batcher_fill"] >= (0.75, 1.5, 3.0)[lvl - 1]
+            )
+        prev = lvl
+    for _ in range(10):
+        t += 5.0
+        prev = b.olp.observe({"loop_lag_ms": 0.0}, now=t)
+    assert prev == 0
+    # every transition was recorded for the REST surface
+    assert len(b.olp._transitions) >= 1
+
+
+def test_overload_alarm_standing_and_damped():
+    b = _broker(min_hold=1.0, alarm_min_reraise=10.0, alarm_hold=5.0)
+    m = b.metrics
+    now = lift(b, 1)
+    assert m.val("alarms.activate") == 1
+    active = {a.name: a for a in b.alarms.active()}
+    assert active["overload"].details["level"] == 1
+    # level change UPDATES the standing alarm; the re-raise publish is
+    # damped inside min_reraise (no $SYS churn), details stay honest
+    b.olp.observe({"loop_lag_ms": 600.0}, now=now + 1)
+    assert b.olp.level == 2
+    assert m.val("alarms.activate") == 1
+    active = {a.name: a for a in b.alarms.active()}
+    assert active["overload"].details["level"] == 2
+    # recovery: the deactivate is HELD (hysteresis) — a re-raise
+    # inside the hold cancels it silently
+    now = settle(b, now + 1)
+    assert any(a.name == "overload" for a in b.alarms.active())
+    assert m.val("alarms.deactivate") == 0
+    b.olp.observe({"loop_lag_ms": 2000.0}, now=now + 1)  # re-raise
+    assert b.olp.level == 3
+    b.alarms.tick(now + 100)  # pending deact was cancelled
+    assert any(a.name == "overload" for a in b.alarms.active())
+    assert m.val("alarms.deactivate") == 0
+    # a QUIET recovery completes after the hold elapses un-cancelled
+    now = settle(b, now + 1)
+    b.alarms.tick(now + 5.1)
+    assert not any(a.name == "overload" for a in b.alarms.active())
+    assert m.val("alarms.deactivate") == 1
+
+
+# ============================================== alarm flap damping
+
+class _PubSpy:
+    """Minimal broker stand-in for a standalone AlarmRegistry."""
+
+    class _Cfg:
+        node_name = "spy@local"
+
+    def __init__(self):
+        self.metrics = Metrics()
+        self.config = self._Cfg()
+        self.published = []
+
+    def publish(self, msg):
+        self.published.append(msg.topic)
+        return 0
+
+
+def test_alarm_registry_square_wave_damping():
+    """The satellite acceptance: a square-wave condition (activate /
+    deactivate alternating every second for a minute) produces a
+    bounded number of $SYS publishes — one initial raise, damped
+    re-raises at most every ``min_reraise``, and ONE deactivate once
+    the wave stops."""
+    spy = _PubSpy()
+    reg = AlarmRegistry(spy)
+    t0 = 1000.0
+    for i in range(60):
+        now = t0 + i
+        if i % 2 == 0:
+            reg.activate("sq", message="square", min_reraise=10.0,
+                         now=now)
+        else:
+            reg.deactivate("sq", hold=5.0, now=now)
+        reg.tick(now)
+    # held deactivations were always cancelled by the next activate:
+    # zero deactivate publishes during the wave, and activates are
+    # bounded by ONE per min_reraise window (60s / 10s = 6 + slack)
+    acts = [t for t in spy.published if t.endswith("alarms/activate")]
+    deacts = [t for t in spy.published if t.endswith("alarms/deactivate")]
+    assert deacts == []
+    assert 1 <= len(acts) <= 7
+    # wave over: the hold elapses un-cancelled and ONE deactivate ships
+    reg.deactivate("sq", hold=5.0, now=t0 + 60)
+    reg.tick(t0 + 66)
+    deacts = [t for t in spy.published if t.endswith("alarms/deactivate")]
+    assert len(deacts) == 1
+    assert not any(a.name == "sq" for a in reg.active())
+    # undamped (legacy defaults) still deactivates immediately
+    reg.activate("legacy", now=t0 + 70)
+    assert reg.deactivate("legacy", now=t0 + 70.5) is True
+
+
+def test_alarm_update_refreshes_details_with_throttle():
+    spy = _PubSpy()
+    reg = AlarmRegistry(spy)
+    reg.update("u", details={"v": 1}, min_reraise=10.0, now=100.0)
+    reg.update("u", details={"v": 2}, min_reraise=10.0, now=101.0)
+    a = {x.name: x for x in reg.active()}["u"]
+    assert a.details == {"v": 2}  # details fresh, publish damped
+    acts = [t for t in spy.published if t.endswith("alarms/activate")]
+    assert len(acts) == 1
+    reg.update("u", details={"v": 3}, min_reraise=10.0, now=111.0)
+    acts = [t for t in spy.published if t.endswith("alarms/activate")]
+    assert len(acts) == 2
+
+
+def test_alarm_ttl_expiry_unchanged():
+    spy = _PubSpy()
+    reg = AlarmRegistry(spy)
+    reg.activate("ttl", ttl=5.0, now=100.0)
+    reg.tick(104.0)
+    assert any(a.name == "ttl" for a in reg.active())
+    reg.tick(106.0)
+    assert not any(a.name == "ttl" for a in reg.active())
+
+
+# ======================================================= L1 effects
+
+def test_l1_parks_new_resume_admissions():
+    from emqx_tpu.broker.resume import ResumeScheduler, _Job
+    from emqx_tpu.config import ResumeConfig
+
+    b = _broker()
+    rs = ResumeScheduler(b, ResumeConfig(max_concurrent=4))
+    assert rs._place(_Job("a", None, None)) == "active"
+    now = lift(b, 1)
+    assert rs._place(_Job("b", None, None)) == "parked"
+    assert b.metrics.val("olp.deferred.resume") == 1
+    rs._unpark()
+    assert "b" not in rs._active  # stays parked while raised
+    settle(b, now)
+    rs._unpark()
+    assert "b" in rs._active  # recovery drains the park FIFO
+
+
+def test_l1_defers_retained_catchup_and_flushes_on_recovery():
+    b = _broker(retained_flush_per_tick=16)
+    b.publish(Message(topic="t/r", payload=b"keep", qos=1, retain=True))
+    ch = WireChannel(b)
+    s, _ = b.cm.open_session(True, "sub", ch)
+    now = lift(b, 1)
+    opts = SubOpts(qos=1)
+    s.subscribe("t/#", opts)
+    retained = b.subscribe("sub", "t/#", opts, defer_ok=True)
+    assert retained == []  # deferred, not delivered
+    assert b.metrics.val("olp.deferred.retained") == 1
+    assert b.olp.info()["retained_deferred"] == 1
+    # while raised, the tick flushes nothing
+    b.olp.tick(now + 0.5)
+    assert ch.writes == []
+    # ladder back at 0: the tick replays the catch-up (retain bit set)
+    now = settle(b, now)
+    b.olp._last_tick = now  # keep the lag probe out of this test
+    b.olp.tick(now + 1.0)
+    pkts = [p for p in ch.packets() if p.type == C.PUBLISH]
+    assert len(pkts) == 1
+    assert pkts[0].payload == b"keep" and pkts[0].retain
+    assert b.olp.info()["retained_deferred"] == 0
+
+
+def test_l1_retained_flush_to_detached_session_drops_qos0():
+    """The deferred-catch-up flush to a DETACHED session queues QoS>0
+    only (exactly like `_queue_detached_run`): queueing best-effort
+    QoS0 retained could evict admitted QoS>=1 backlog from the
+    bounded mqueue — the zero-QoS>=1-loss invariant forbids it."""
+    b = _broker()
+    b.publish(Message(topic="t/q0", qos=0, payload=b"r0", retain=True))
+    b.publish(Message(topic="t/q1", qos=1, payload=b"r1", retain=True))
+    ch = WireChannel(b)
+    s, _ = b.cm.open_session(True, "det", ch)
+    s.expiry_interval = 3600.0
+    now = lift(b, 1)
+    opts = SubOpts(qos=1)
+    s.subscribe("t/#", opts)
+    assert b.subscribe("det", "t/#", opts, defer_ok=True) == []
+    # the channel detaches before recovery
+    b.cm.disconnect("det", ch)
+    now = settle(b, now)
+    b.olp._last_tick = now
+    b.olp.tick(now + 1.0)
+    # QoS1 retained queued for the reconnect; QoS0 dropped AND
+    # counted (never silent) via the shared detached queue path
+    assert [m.payload for m in s.mqueue] == [b"r1"]
+    assert b.metrics.val("delivery.dropped") >= 1
+
+
+def test_l1_retained_flush_respects_stall_gate():
+    """The recovery flush must not pile the catch-up burst onto a
+    subscriber still over its outbound watermark — it takes the same
+    stalled queue path as live dispatch (QoS0 counted, QoS>0 parked
+    on the mqueue for the retry-timer drain)."""
+    b = _broker()
+    b.config.mqtt.outbound_high_watermark = 1000
+    b.publish(Message(topic="w/q0", qos=0, payload=b"r0", retain=True))
+    b.publish(Message(topic="w/q1", qos=1, payload=b"r1", retain=True))
+    ch = WireChannel(b)
+    ch.transport_buffered = lambda: 10_000  # still stalled
+    s, _ = b.cm.open_session(True, "stall", ch)
+    now = lift(b, 1)
+    opts = SubOpts(qos=1)
+    s.subscribe("w/#", opts)
+    assert b.subscribe("stall", "w/#", opts, defer_ok=True) == []
+    now = settle(b, now)
+    b.olp._last_tick = now
+    b.olp.tick(now + 1)
+    assert ch.writes == []  # nothing onto the overflowing buffer
+    assert [m.payload for m in s.mqueue] == [b"r1"]  # parked
+    assert s.out_parked
+    assert b.metrics.val("delivery.dropped.out_buffer") == 1  # r0
+
+
+def test_l1_retained_flush_paced_by_messages_and_chunks_jobs():
+    """Recovery pacing counts MESSAGES, not jobs: one filter matching
+    a big retained set chunks across ticks instead of stalling the
+    loop with one giant burst at recovery."""
+    b = _broker(retained_flush_per_tick=2)
+    for i in range(5):
+        b.publish(Message(topic=f"big/{i}", qos=1,
+                          payload=b"r%d" % i, retain=True))
+    ch = WireChannel(b)
+    s, _ = b.cm.open_session(True, "chunky", ch)
+    now = lift(b, 1)
+    opts = SubOpts(qos=0)
+    s.subscribe("big/#", opts)
+    assert b.subscribe("chunky", "big/#", opts, defer_ok=True) == []
+    now = settle(b, now)
+    b.olp._last_tick = now
+    seen = 0
+    for k in range(1, 5):
+        b.olp.tick(now + k)
+        n = len([p for p in ch.packets() if p.type == C.PUBLISH])
+        assert n - seen <= 2, "flush burst exceeded the pacing budget"
+        seen = n
+    assert seen == 5  # the whole job drained, two messages per tick
+    assert b.olp.info()["retained_deferred"] == 0
+
+
+def test_l1_retained_defer_cancelled_by_rh2_and_unsubscribe():
+    """A re-subscribe with retain_handling=2 (or an unsubscribe)
+    cancels a parked catch-up job — the flush must honor the CURRENT
+    subscription options."""
+    b = _broker()
+    b.publish(Message(topic="c/x", qos=1, payload=b"keep", retain=True))
+    ch = WireChannel(b)
+    s, _ = b.cm.open_session(True, "cancels", ch)
+    now = lift(b, 1)
+    opts = SubOpts(qos=1)
+    s.subscribe("c/#", opts)
+    assert b.subscribe("cancels", "c/#", opts, defer_ok=True) == []
+    assert b.olp.info()["retained_deferred"] == 1
+    # re-subscribe with rh=2: "send no retained" — job cancelled
+    opts2 = SubOpts(qos=1, retain_handling=2)
+    s.subscribe("c/#", opts2)
+    assert b.subscribe("cancels", "c/#", opts2, is_new_sub=False,
+                       defer_ok=True) == []
+    assert b.olp.info()["retained_deferred"] == 0
+    now = settle(b, now)
+    b.olp._last_tick = now
+    b.olp.tick(now + 1)
+    assert [p for p in ch.packets() if p.type == C.PUBLISH] == []
+    # and the unsubscribe path cancels too
+    lift(b, 1, now + 2)
+    opts3 = SubOpts(qos=1)
+    s.subscribe("c/#", opts3)
+    b.subscribe("cancels", "c/#", opts3, defer_ok=True)
+    assert b.olp.info()["retained_deferred"] == 1
+    s.unsubscribe("c/#")
+    b.unsubscribe("cancels", "c/#")
+    assert b.olp.info()["retained_deferred"] == 0
+
+
+def test_l1_resume_park_fifo_bounded_under_defer():
+    """While the ladder defers admissions, `saturated` must bound on
+    the park FIFO alone — active slots drain and are never refilled,
+    so the old active-AND-parked condition would admit (and park)
+    storms without ever answering server-busy."""
+    from emqx_tpu.broker.resume import ResumeScheduler, _Job
+    from emqx_tpu.config import ResumeConfig
+
+    b = _broker()
+    rs = ResumeScheduler(
+        b, ResumeConfig(max_concurrent=4, park_queue_cap=2)
+    )
+    lift(b, 1)
+    assert not rs.saturated()
+    rs._place(_Job("a", None, None))
+    rs._place(_Job("b", None, None))
+    assert rs.saturated()  # park cap reached with EMPTY active slots
+
+
+def test_l1_retained_defers_only_for_delivering_callers():
+    """Callers that DISCARD the retained return (takeover import,
+    auto-subscribe, gateway adapters — defer_ok=False, the default)
+    must not park catch-up jobs: the flush would later deliver a
+    retained burst those paths never produce."""
+    b = _broker()
+    b.publish(Message(topic="d/x", qos=1, payload=b"r", retain=True))
+    ch = WireChannel(b)
+    s, _ = b.cm.open_session(True, "importer", ch)
+    lift(b, 1)
+    opts = SubOpts(qos=1)
+    s.subscribe("d/#", opts)
+    # the import/auto-subscribe shape: no defer_ok, return discarded
+    out = b.subscribe("importer", "d/#", opts)
+    assert [m.payload for m in out] == [b"r"]  # inline, as at level 0
+    assert b.olp.info()["retained_deferred"] == 0  # nothing parked
+
+
+def test_l1_inline_replay_supersedes_parked_job():
+    """A re-subscribe served INLINE (level back at 0) cancels the job
+    a deferred earlier subscribe parked — delivering both would
+    duplicate the retained burst."""
+    b = _broker()
+    b.publish(Message(topic="s/x", qos=1, payload=b"once", retain=True))
+    ch = WireChannel(b)
+    s, _ = b.cm.open_session(True, "resub", ch)
+    now = lift(b, 1)
+    opts = SubOpts(qos=1)
+    s.subscribe("s/#", opts)
+    assert b.subscribe("resub", "s/#", opts, defer_ok=True) == []
+    assert b.olp.info()["retained_deferred"] == 1
+    now = settle(b, now)
+    # before the flush runs, the client re-subscribes: inline replay
+    out = b.subscribe("resub", "s/#", opts, defer_ok=True)
+    assert [m.payload for m in out] == [b"once"]
+    assert b.olp.info()["retained_deferred"] == 0  # job cancelled
+    b.olp._last_tick = now
+    b.olp.tick(now + 1)
+    assert ch.writes == []  # the flush delivers nothing extra
+
+
+def test_l1_deferred_rebuild_kicked_at_recovery():
+    """A rebuild deferred during the episode fires at ladder-down to
+    0 even if no further mutation ever arrives (stable fleet)."""
+    from emqx_tpu.engine import MatchEngine
+
+    b = _broker()
+    eng = MatchEngine(
+        use_device=False, background_rebuild=True, rebuild_threshold=4
+    )
+    calls = []
+    eng._start_background_rebuild = lambda: calls.append(1)
+    eng.defer_rebuild = b.olp.defer_rebuild
+    b.router.engine = eng  # the recovery kick targets this engine
+    now = lift(b, 1)
+    for i in range(6):
+        eng.insert(f"kick/{i}/+", f"f{i}")
+    assert calls == []
+    settle(b, now)  # no mutation after this — the kick must fire
+    assert calls == [1]
+
+
+def test_l1_retained_chunk_snapshot_stable_under_mutation():
+    """A chunked job's tail is a message SNAPSHOT: clearing one of
+    the already-delivered retained topics between ticks must not make
+    the subscriber skip (or re-receive) any of the rest."""
+    b = _broker(retained_flush_per_tick=2)
+    for i in range(5):
+        b.publish(Message(topic=f"mut/{i}", qos=1,
+                          payload=b"m%d" % i, retain=True))
+    ch = WireChannel(b)
+    s, _ = b.cm.open_session(True, "mut", ch)
+    now = lift(b, 1)
+    opts = SubOpts(qos=1)
+    s.subscribe("mut/#", opts)
+    assert b.subscribe("mut", "mut/#", opts, defer_ok=True) == []
+    now = settle(b, now)
+    b.olp._last_tick = now
+    b.olp.tick(now + 1)  # first chunk: 2 delivered, tail snapshotted
+    # clear an ALREADY-DELIVERED retained topic: an offset-based
+    # resume over a fresh match would now skip one message
+    b.publish(Message(topic="mut/0", qos=1, payload=b"", retain=True))
+    b.olp.tick(now + 2)
+    b.olp.tick(now + 3)
+    got = sorted(
+        p.payload for p in ch.packets()
+        # the retained-CLEAR publish also delivers live (empty
+        # payload) — the invariant is about the catch-up set
+        if p.type == C.PUBLISH and p.payload
+    )
+    assert got == [b"m%d" % i for i in range(5)]  # none skipped/duped
+
+
+def test_l1_retained_defer_dies_with_the_session():
+    """Discarded/terminated sessions drop their parked catch-up jobs
+    — dead clients must not exhaust retained_defer_cap and crowd out
+    live subscribers."""
+    b = _broker()
+    b.publish(Message(topic="gone/x", qos=1, payload=b"r", retain=True))
+    ch = WireChannel(b)
+    s, _ = b.cm.open_session(True, "ghost", ch)
+    lift(b, 1)
+    opts = SubOpts(qos=1)
+    s.subscribe("gone/#", opts)
+    assert b.subscribe("ghost", "gone/#", opts, defer_ok=True) == []
+    assert b.olp.info()["retained_deferred"] == 1
+    b.cm.kick("ghost")  # discard path
+    assert b.olp.info()["retained_deferred"] == 0
+
+
+def test_l1_retained_defer_cap_counts_overflow():
+    b = _broker(retained_defer_cap=1)
+    lift(b, 1)
+    assert b.olp.defer_retained("c1", "a/#") is True
+    assert b.olp.defer_retained("c2", "b/#") is True  # over cap
+    assert b.metrics.val("olp.deferred.retained") == 1
+    assert b.metrics.val("olp.dropped.retained") == 1  # never silent
+
+
+def test_l1_shrinks_batch_window():
+    b = _broker(window_cap=128)
+    batcher = PublishBatcher(b, batch_max=4096)
+    base = batcher._window_limit()
+    assert base > 128
+    now = lift(b, 1)
+    assert batcher._window_limit() == 128
+    settle(b, now)
+    assert batcher._window_limit() == base
+
+
+def test_l1_defers_background_rebuild():
+    from emqx_tpu.engine import MatchEngine
+
+    b = _broker()
+    eng = MatchEngine(
+        use_device=False, background_rebuild=True, rebuild_threshold=4
+    )
+    calls = []
+    eng._start_background_rebuild = lambda: calls.append(1)
+    eng.defer_rebuild = b.olp.defer_rebuild
+    now = lift(b, 1)
+    for i in range(6):
+        eng.insert(f"defer/{i}/+", f"f{i}")
+    assert calls == []  # deferred while the ladder is raised
+    assert b.metrics.val("olp.deferred.rebuild") >= 1
+    settle(b, now)
+    eng.insert("defer/x/+", "fx")  # first post-recovery delta fires it
+    assert calls
+
+
+# ======================================================= L2 effects
+
+def _shed_world(seed):
+    """Random world for the shed-parity property: mixed QoS subs,
+    no_local, RAP, subid, upgrade_qos, v4/v5, shared groups."""
+    import random
+
+    rng = random.Random(seed)
+    clients = []
+    for i in range(10):
+        subs = []
+        for f in range(rng.randint(1, 3)):
+            subs.append({
+                "flt": rng.choice(
+                    ["t/#", "t/+/x", f"t/{f}/x", "s/only",
+                     "$share/g1/t/+/x"]
+                ),
+                "qos": rng.randint(0, 2),
+                "rap": rng.random() < 0.4,
+                "no_local": rng.random() < 0.3,
+                "subid": rng.randint(1, 9)
+                if rng.random() < 0.2 else None,
+            })
+        clients.append({
+            "cid": f"c{i}",
+            "version": rng.choice([C.MQTT_V4, C.MQTT_V5]),
+            "upgrade": rng.random() < 0.3,
+            "max_inflight": rng.choice([2, 4, 32]),
+            "subs": subs,
+        })
+    windows = []
+    for _ in range(3):
+        windows.append([
+            {
+                "topic": rng.choice(
+                    ["t/1/x", "t/2/x", "s/only", "t/deep/x"]
+                ),
+                "qos": rng.randint(0, 2),
+                "retain": rng.random() < 0.3,
+                "payload": bytes(
+                    rng.randrange(256)
+                    for _ in range(rng.randint(0, 150))
+                ),
+                "from": rng.choice(["c0", "c1", "pub"]),
+            }
+            for _ in range(rng.randint(1, 10))
+        ])
+    return clients, windows
+
+
+def _run_shed_world(clients, windows, mode):
+    b = _broker(columns=mode != "scalar")
+    if mode in ("host", "dev"):
+        b.router.engine.decide_force = mode
+    b.router.shared._rng.seed(1234)
+    lift(b, 2)
+    chans = {}
+    for c in clients:
+        ch = WireChannel(b, version=c["version"])
+        session, _ = b.cm.open_session(
+            True, c["cid"], ch, max_inflight=c["max_inflight"]
+        )
+        session.upgrade_qos = c["upgrade"]
+        for sub in c["subs"]:
+            opts = SubOpts(
+                qos=sub["qos"], retain_as_published=sub["rap"],
+                no_local=sub["no_local"], subid=sub["subid"],
+            )
+            session.subscribe(sub["flt"], opts)
+            b.subscribe(c["cid"], sub["flt"], opts)
+        chans[c["cid"]] = ch
+    counts = []
+    for win in windows:
+        msgs = [
+            Message(
+                topic=w["topic"], qos=w["qos"], retain=w["retain"],
+                payload=w["payload"], from_client=w["from"],
+                timestamp=1.0e9,
+            )
+            for w in win
+        ]
+        counts.append(b.publish_many(msgs))
+    wires = {cid: ch.wire() for cid, ch in chans.items()}
+    sent = {
+        k: b.metrics.val(k)
+        for k in ("messages.sent", "messages.qos0.sent",
+                  "messages.qos1.sent", "messages.qos2.sent",
+                  "delivery.dropped", "delivery.dropped.olp_shed")
+    }
+    inflights = {
+        c["cid"]: sorted(
+            (pid, e.qos)
+            for pid, e in b.cm.lookup(c["cid"]).inflight.items()
+        )
+        for c in clients
+    }
+    return counts, wires, sent, inflights, chans, clients
+
+
+@pytest.mark.parametrize("seed", [1, 5, 11, 23])
+def test_l2_shed_mask_parity_vs_scalar_referee(seed):
+    """With shedding active, the columns paths (host + device decide)
+    must put bit-identical bytes on every wire as the scalar referee —
+    and NO wire may carry a QoS0 PUBLISH (the shed contract), while
+    QoS>=1 deliveries all survive (zero-loss invariant)."""
+    clients, windows = _shed_world(seed)
+    scalar = _run_shed_world(clients, windows, "scalar")
+    host = _run_shed_world(clients, windows, "host")
+    dev = _run_shed_world(clients, windows, "dev")
+    for other, label in ((host, "host"), (dev, "dev")):
+        assert scalar[0] == other[0], (label, "counts")
+        for cid in scalar[1]:
+            assert scalar[1][cid] == other[1][cid], (label, cid)
+        assert scalar[2] == other[2], (label, "sent/shed metrics")
+        assert scalar[3] == other[3], (label, "inflight")
+    assert scalar[2]["messages.qos0.sent"] == 0
+    # decoded frames: every delivered PUBLISH is QoS >= 1
+    for cid, ch in scalar[4].items():
+        for p in ch.packets():
+            if p.type == C.PUBLISH:
+                assert p.qos >= 1, (cid, "shed leak")
+
+
+def test_l2_level0_identical_to_disabled():
+    """OLP enabled at level 0 must be byte-identical to disabled —
+    the steady-state-overhead contract's functional half."""
+    clients, windows = _shed_world(42)
+
+    def run_mode(enable):
+        b = _broker(enable=enable)
+        b.router.shared._rng.seed(99)
+        chans = {}
+        for c in clients:
+            ch = WireChannel(b, version=c["version"])
+            session, _ = b.cm.open_session(
+                True, c["cid"], ch, max_inflight=c["max_inflight"]
+            )
+            session.upgrade_qos = c["upgrade"]
+            for sub in c["subs"]:
+                opts = SubOpts(
+                    qos=sub["qos"], retain_as_published=sub["rap"],
+                    no_local=sub["no_local"], subid=sub["subid"],
+                )
+                session.subscribe(sub["flt"], opts)
+                b.subscribe(c["cid"], sub["flt"], opts)
+            chans[c["cid"]] = ch
+        for win in windows:
+            b.publish_many([
+                Message(topic=w["topic"], qos=w["qos"],
+                        retain=w["retain"], payload=w["payload"],
+                        from_client=w["from"], timestamp=1.0e9)
+                for w in win
+            ])
+        return {cid: ch.wire() for cid, ch in chans.items()}
+
+    on = run_mode(True)
+    off = run_mode(False)
+    assert on == off
+
+
+def test_l2_clamps_shared_buckets_and_restores():
+    b = _broker(limiter_clamp=0.5)
+    lim = ConnectionLimiter(messages_rate=100.0, bytes_rate=1000.0,
+                            shared=True)
+    b.olp.clamp_targets.append(lim)
+    now = lift(b, 2)
+    assert lim.msg_bucket.rate == pytest.approx(50.0)
+    assert lim.byte_bucket.rate == pytest.approx(500.0)
+    # stepping down to 1 already unclamps (the clamp is an L2 edge)
+    now += float(b.olp.cfg.min_hold) + 0.01
+    b.olp.observe({"loop_lag_ms": 100.0}, now=now)
+    assert b.olp.level == 1
+    assert lim.msg_bucket.rate == pytest.approx(100.0)
+    assert lim.byte_bucket.rate == pytest.approx(1000.0)
+
+
+def _connect(b, cid, version=C.MQTT_V5):
+    ch = WireChannel(b, version=version)
+    ch.handle_in(C.Connect(client_id=cid, proto_ver=version))
+    return ch
+
+
+def test_l2_connect_budget_answers_server_busy():
+    b = _broker(connect_budget=2.0)
+    lift(b, 2)
+    rcs = []
+    for i in range(4):
+        ch = _connect(b, f"burst{i}")
+        connacks = [p for p in ch.packets() if p.type == C.CONNACK]
+        assert len(connacks) == 1
+        rcs.append(connacks[0].reason_code)
+    assert rcs[:2] == [0, 0]
+    assert rcs[2] == 0x89 and rcs[3] == 0x89  # server busy
+    assert b.metrics.val("olp.refused.connect") == 2
+    # refused clients never created session state
+    assert b.cm.lookup("burst2") is None
+    # at level 0 the budget does not apply
+    now = settle(b, time.time())
+    b.olp._cb_tokens = 0.0
+    ch = _connect(b, "after")
+    assert ch.packets()[0].reason_code == 0
+
+
+def test_l2_connect_budget_v4_maps_to_server_unavailable():
+    b = _broker(connect_budget=0.5)
+    lift(b, 2)
+    b.olp._cb_tokens = 0.0
+    ch = _connect(b, "old", version=C.MQTT_V4)
+    assert ch.packets()[0].reason_code == 3  # v3 server unavailable
+
+
+# ======================================================= L3 effects
+
+def test_l3_drops_qos0_at_publish_ingress():
+    b = _broker()
+    sub = WireChannel(b)
+    s, _ = b.cm.open_session(True, "watcher", sub)
+    opts = SubOpts(qos=1)
+    s.subscribe("in/#", opts)
+    b.subscribe("watcher", "in/#", opts)
+    pub = _connect(b, "pub")
+    lift(b, 3)
+    pub.handle_in(C.Publish(topic="in/a", payload=b"q0", qos=0))
+    assert b.metrics.val("olp.shed.publish_qos0") == 1
+    assert b.metrics.val("messages.dropped.olp_shed") == 1
+    assert sub.writes == []  # never routed
+    # QoS1 still routes AND acks — zero loss for admitted traffic
+    pub.handle_in(
+        C.Publish(topic="in/a", payload=b"q1", qos=1, packet_id=7)
+    )
+    pubs = [p for p in sub.packets() if p.type == C.PUBLISH]
+    assert [p.payload for p in pubs] == [b"q1"]
+    acks = [p for p in pub.packets() if p.type == C.PUBACK]
+    assert [p.packet_id for p in acks] == [7]
+
+
+def test_l3_force_closes_slowest_subscribers():
+    b = _broker(slow_kill_max=2)
+    chans = {}
+    for i in range(3):
+        cid = f"slow{i}"
+        ch = _connect(b, cid)
+        chans[cid] = ch
+        b.slow_subs.record(cid, "t/x", 1000.0 + i)
+    lift(b, 3)
+    assert b.metrics.val("olp.killed.slow_subs") == 2
+    killed = [
+        cid for cid, ch in chans.items()
+        if any(p.type == C.DISCONNECT for p in ch.packets())
+    ]
+    assert len(killed) == 2
+    for cid in killed:
+        d = [p for p in chans[cid].packets()
+             if p.type == C.DISCONNECT][0]
+        assert d.reason_code == 0x89  # server busy, not a client fault
+
+
+# ==================================== outbound high-watermark (sat 1)
+
+@pytest.mark.parametrize("columns", [True, False])
+def test_out_buffer_watermark_drops_qos0_queues_qos1(columns):
+    cfg_wm = 1000
+    b = _broker(enable=False, columns=columns)
+    b.config.mqtt.outbound_high_watermark = cfg_wm
+    stalled = WireChannel(b)
+    stalled.transport_buffered = lambda: cfg_wm * 10  # past watermark
+    healthy = WireChannel(b)
+    for cid, ch in (("stalled", stalled), ("healthy", healthy)):
+        s, _ = b.cm.open_session(True, cid, ch)
+        for flt, q in (("w/q0", 0), ("w/q1", 1)):
+            opts = SubOpts(qos=q)
+            s.subscribe(flt, opts)
+            b.subscribe(cid, flt, opts)
+    counts = b.publish_many([
+        Message(topic="w/q0", qos=0, payload=b"a", timestamp=1e9),
+        Message(topic="w/q1", qos=1, payload=b"b", timestamp=1e9),
+    ])
+    # the healthy subscriber got both; the stalled one got NOTHING on
+    # the wire — its QoS0 dropped (counted), its QoS1 queued
+    assert [p.payload for p in healthy.packets()
+            if p.type == C.PUBLISH] == [b"a", b"b"]
+    assert stalled.writes == []
+    assert b.metrics.val("delivery.dropped.out_buffer") == 1
+    stalled_s = b.cm.lookup("stalled")
+    assert len(stalled_s.mqueue) == 1
+    assert list(stalled_s.mqueue)[0].payload == b"b"
+    # the dropped QoS0 does NOT count as handled (detached-path
+    # semantics); the queued QoS1 does
+    assert counts == [1, 2]
+    # no buddy replication for a live session's overflow
+    # (replicate=False path) — nothing external here anyway
+
+
+@pytest.mark.parametrize("columns", [True, False])
+def test_out_buffer_watermark_respects_no_local(columns):
+    """[MQTT-3.8.3-3] on the stalled path too: a stalled subscriber's
+    OWN publishes must not be queued back to it (and must not count
+    as out_buffer drops)."""
+    b = _broker(enable=False, columns=columns)
+    b.config.mqtt.outbound_high_watermark = 1000
+    ch = WireChannel(b)
+    ch.transport_buffered = lambda: 10_000
+    s, _ = b.cm.open_session(True, "selfpub", ch)
+    opts = SubOpts(qos=1, no_local=True)
+    s.subscribe("nl/#", opts)
+    b.subscribe("selfpub", "nl/#", opts)
+    b.publish(Message(topic="nl/t", qos=1, payload=b"own",
+                      from_client="selfpub", timestamp=1e9))
+    b.publish(Message(topic="nl/t", qos=0, payload=b"own0",
+                      from_client="selfpub", timestamp=1e9))
+    assert len(s.mqueue) == 0 and not s.out_parked
+    assert b.metrics.val("delivery.dropped.out_buffer") == 0
+
+
+def test_alarm_published_deactivate_resets_damping():
+    """A PUBLISHED deactivate must reset the re-raise damping: the
+    next activation publishes even inside min_reraise — otherwise a
+    flap could leave a live alarm looking cleared on $SYS for the
+    rest of the overload episode."""
+    spy = _PubSpy()
+    reg = AlarmRegistry(spy)
+    reg.activate("ov", min_reraise=30.0, now=100.0)
+    reg.deactivate("ov", now=105.0)  # published deactivate
+    reg.activate("ov", min_reraise=30.0, now=112.0)  # inside 30s
+    acts = [t for t in spy.published if t.endswith("alarms/activate")]
+    deacts = [t for t in spy.published
+              if t.endswith("alarms/deactivate")]
+    assert len(acts) == 2 and len(deacts) == 1
+    assert any(a.name == "ov" for a in reg.active())
+
+
+@pytest.mark.parametrize("columns", [True, False])
+def test_out_buffer_parked_backlog_keeps_order_and_timer_drains(
+    columns,
+):
+    """A watermark-parked QoS>0 backlog must not be overtaken by
+    later deliveries once the buffer recovers (same-topic order), and
+    the channel's retry timer must flush it even when the client owes
+    no ack (the ack-driven dequeue alone never fires)."""
+    from emqx_tpu.broker.channel import CONNECTED
+
+    b = _broker(enable=False, columns=columns)
+    b.config.mqtt.outbound_high_watermark = 1000
+    buf = [10_000]
+    ch = WireChannel(b)
+    ch.transport_buffered = lambda: buf[0]
+    s, _ = b.cm.open_session(True, "parked", ch)
+    ch.state = CONNECTED
+    ch.session = s
+    opts = SubOpts(qos=1)
+    s.subscribe("o/#", opts)
+    b.subscribe("parked", "o/#", opts)
+    b.publish(Message(topic="o/t", qos=1, payload=b"m1", timestamp=1e9))
+    assert ch.writes == [] and s.out_parked
+    buf[0] = 0  # the subscriber drained its buffer...
+    b.publish(Message(topic="o/t", qos=1, payload=b"m2", timestamp=1e9))
+    # ...but m2 must queue BEHIND the parked m1, not overtake it
+    assert ch.writes == []
+    assert [m.payload for m in s.mqueue] == [b"m1", b"m2"]
+    ch.retry_deliveries()  # the 5 s timer: flushes in order
+    pubs = [p for p in ch.packets() if p.type == C.PUBLISH]
+    assert [p.payload for p in pubs] == [b"m1", b"m2"]
+    assert not s.out_parked and len(s.mqueue) == 0
+    # recovered: the next delivery rides the fast path again
+    b.publish(Message(topic="o/t", qos=1, payload=b"m3", timestamp=1e9))
+    pubs = [p for p in ch.packets() if p.type == C.PUBLISH]
+    assert [p.payload for p in pubs] == [b"m1", b"m2", b"m3"]
+
+
+def test_out_buffer_watermark_paused_transport():
+    """The regression the satellite asks for: a REAL subscriber that
+    stops reading.  Once the kernel+transport buffers pass the
+    watermark, QoS0 deliveries drop (counted) instead of growing the
+    write buffer without bound."""
+    from emqx_tpu.broker.listener import BrokerServer
+    from emqx_tpu.config import BrokerConfig, ListenerConfig
+
+    async def t():
+        cfg = BrokerConfig()
+        cfg.listeners = [ListenerConfig(port=0)]
+        cfg.engine.batch_publish = False
+        cfg.mqtt.outbound_high_watermark = 64 * 1024
+        srv = BrokerServer(cfg)
+        await srv.start()
+        port = srv.listeners[0].port
+        try:
+            async def conn(cid):
+                r, w = await asyncio.open_connection("127.0.0.1", port)
+                w.write(C.serialize(
+                    C.Connect(client_id=cid, proto_ver=C.MQTT_V5),
+                    C.MQTT_V5,
+                ))
+                await w.drain()
+                p = C.StreamParser(version=C.MQTT_V5)
+                while True:
+                    data = await r.read(1 << 16)
+                    assert data
+                    if any(pk.type == C.CONNACK for pk in p.feed(data)):
+                        return r, w, p
+
+            sr, sw, sp = await conn("sleeper")
+            sw.write(C.serialize(C.Subscribe(
+                packet_id=1,
+                subscriptions=[C.Subscription("flood/#", qos=0)],
+            ), C.MQTT_V5))
+            await sw.drain()
+            await asyncio.sleep(0.1)
+            # the subscriber now STOPS reading; flood it with big
+            # QoS0 payloads until the watermark trips
+            payload = b"x" * 65536
+            broker = srv.broker
+            for i in range(400):
+                broker.publish(Message(
+                    topic="flood/a", qos=0, payload=payload,
+                    timestamp=time.time(),
+                ))
+                if broker.metrics.val(
+                    "delivery.dropped.out_buffer"
+                ) > 0:
+                    break
+                if i % 16 == 15:
+                    await asyncio.sleep(0)  # let writes hit the socket
+            assert broker.metrics.val(
+                "delivery.dropped.out_buffer"
+            ) > 0, "watermark never tripped"
+            # the broker is still responsive to a healthy client
+            hr, hw, hp = await conn("healthy")
+            hw.write(C.serialize(C.Pingreq(), C.MQTT_V5))
+            await hw.drain()
+            data = await asyncio.wait_for(hr.read(1 << 12), 5.0)
+            assert any(
+                pk.type == C.PINGRESP for pk in hp.feed(data)
+            )
+            hw.close()
+            sw.close()
+        finally:
+            await srv.stop()
+
+    run(t())
+
+
+# ============================================== chaos: the new seams
+
+def test_olp_sample_fault_holds_level():
+    b = _broker(sample_interval=0.0001)
+    now = lift(b, 2)
+    fp.configure("olp.sample", "error")
+    b.olp._last_tick = now
+    b.olp.tick(now + 1.0)  # sample raises inside; guard holds level
+    assert b.olp.level == 2
+    fp.configure("olp.sample", "drop")
+    b.olp.tick(now + 2.0)  # dropped round: level held too
+    assert b.olp.level == 2
+    fp.clear("olp.sample")
+    # sampling recovers: idle signals walk the ladder down
+    t = now + 3.0
+    for _ in range(10):
+        t += float(b.olp.cfg.min_hold) + 1.0
+        b.olp._last_tick = t - 1.0  # keep the lag probe quiet
+        b.olp.tick(t)
+    assert b.olp.level == 0
+
+
+def test_olp_shed_accounting_fault_still_counts():
+    b = _broker()
+    fp.configure("olp.shed", "error")
+    b.olp.shed("refused.connect")  # must not raise
+    assert b.metrics.val("olp.refused.connect") == 1  # fallback count
+    fp.clear("olp.shed")
+    b.olp.shed("refused.connect")
+    assert b.metrics.val("olp.refused.connect") == 2
+    assert b.olp._shed_totals["refused.connect"] == 1
+
+
+def test_olp_shed_panic_kills_mid_shed_without_qos1_loss():
+    """kill-mid-shed: a panic (process-death stand-in) fired inside
+    the shed accounting of a CONNECT refusal flows through the
+    channel — and the broker keeps serving admitted QoS1 traffic with
+    nothing lost."""
+    b = _broker(connect_budget=1.0)
+    sub = WireChannel(b)
+    s, _ = b.cm.open_session(True, "keeper", sub)
+    opts = SubOpts(qos=1)
+    s.subscribe("live/#", opts)
+    b.subscribe("keeper", "live/#", opts)
+    lift(b, 2)
+    b.olp._cb_tokens = 0.0
+    fp.configure("olp.shed", "panic", times=1)
+    with pytest.raises(fp.FailpointPanic):
+        _connect(b, "victim")
+    # the broker survives: QoS1 publish still routes and delivers
+    n = b.publish(Message(topic="live/x", qos=1, payload=b"ok",
+                          timestamp=time.time()))
+    assert n == 1
+    assert [p.payload for p in sub.packets()
+            if p.type == C.PUBLISH] == [b"ok"]
+
+
+# ========================================== chaos: flood + slow subs
+
+def test_chaos_flood_and_slow_sub_storm_ladder_cycle():
+    """The acceptance chaos gate, scaled to CI: a QoS0 publish flood
+    over capacity plus a slow subscriber drives the ladder up to L2+,
+    the control plane stays responsive (PINGREQ round-trips during
+    the flood), sheds are counted, every ACKED QoS1 publish is
+    delivered (zero admitted-QoS>=1 loss), and once the flood stops
+    the ladder steps back down to 0."""
+    from emqx_tpu.broker.listener import BrokerServer
+    from emqx_tpu.config import BrokerConfig, ListenerConfig
+
+    async def t():
+        cfg = BrokerConfig()
+        cfg.listeners = [ListenerConfig(port=0)]
+        cfg.engine.batch_max = 128
+        cfg.olp.enable = True
+        cfg.olp.sample_interval = 0.05
+        cfg.olp.min_hold = 0.3
+        cfg.olp.exit_factor = 0.8
+        cfg.olp.batcher_fill = [0.3, 0.6, 50.0]
+        cfg.olp.loop_lag_ms = [1e6, 1e6, 1e6]  # pin to one signal
+        cfg.olp.e2e_p99_ms = [1e6, 1e6, 1e6]
+        cfg.olp.mqueue_backlog = [1e9, 1e9, 1e9]
+        cfg.olp.sysmem = [0.999, 0.9995, 0.9999]
+        cfg.olp.procmem = [0.97, 0.98, 0.99]
+        cfg.olp.cpu = [1e6, 1e6, 1e6]
+        cfg.olp.alarm_min_reraise = 0.0
+        srv = BrokerServer(cfg)
+        await srv.start()
+        broker = srv.broker
+        port = srv.listeners[0].port
+        max_level = 0
+        stop_sampler = asyncio.Event()
+
+        async def sampler():
+            nonlocal max_level
+            while not stop_sampler.is_set():
+                broker.olp.tick(time.time())
+                max_level = max(max_level, broker.olp.level)
+                await asyncio.sleep(0.02)
+
+        async def conn(cid):
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            w.write(C.serialize(
+                C.Connect(client_id=cid, proto_ver=C.MQTT_V5),
+                C.MQTT_V5,
+            ))
+            await w.drain()
+            p = C.StreamParser(version=C.MQTT_V5)
+            while True:
+                data = await r.read(1 << 16)
+                assert data
+                if any(pk.type == C.CONNACK for pk in p.feed(data)):
+                    return r, w, p
+
+        try:
+            sam = asyncio.get_running_loop().create_task(sampler())
+            # subscriber: acks QoS1 promptly, records payloads
+            sr, sw, sp = await conn("subscriber")
+            sw.write(C.serialize(C.Subscribe(
+                packet_id=1,
+                subscriptions=[C.Subscription("live/#", qos=1),
+                               C.Subscription("flood/#", qos=0)],
+            ), C.MQTT_V5))
+            await sw.drain()
+            got = set()
+            sub_done = asyncio.Event()
+
+            async def sub_loop():
+                while True:
+                    data = await sr.read(1 << 16)
+                    if not data:
+                        return
+                    acks = []
+                    for pk in sp.feed(data):
+                        if pk.type == C.PUBLISH and \
+                                pk.topic.startswith("live/"):
+                            got.add(bytes(pk.payload))
+                            if pk.qos:
+                                acks.append(C.serialize(
+                                    C.Puback(packet_id=pk.packet_id),
+                                    C.MQTT_V5,
+                                ))
+                    if acks:
+                        sw.write(b"".join(acks))
+                    if sub_done.is_set():
+                        return
+
+            sub_task = asyncio.get_running_loop().create_task(
+                sub_loop()
+            )
+            # slow subscriber: subscribes the flood, then stops reading
+            zr, zw, zp = await conn("slowpoke")
+            zw.write(C.serialize(C.Subscribe(
+                packet_id=1,
+                subscriptions=[C.Subscription("flood/#", qos=0)],
+            ), C.MQTT_V5))
+            await zw.drain()
+
+            flood_on = True
+
+            async def flooder(i):
+                r, w, p = await conn(f"flood{i}")
+                payload = b"f" * 512
+                k = 0
+                while flood_on:
+                    burst = b"".join(
+                        C.serialize(C.Publish(
+                            topic=f"flood/{i}/{k + j}", qos=0,
+                            payload=payload,
+                        ), C.MQTT_V5)
+                        for j in range(64)
+                    )
+                    k += 64
+                    w.write(burst)
+                    try:
+                        await asyncio.wait_for(w.drain(), 1.0)
+                    except asyncio.TimeoutError:
+                        await asyncio.sleep(0.05)  # read-paused: good
+                w.close()
+
+            flooders = [
+                asyncio.get_running_loop().create_task(flooder(i))
+                for i in range(3)
+            ]
+            # steady QoS1 publisher: every ack'd seq must arrive
+            pr, pw, pp = await conn("steady")
+            acked = set()
+
+            async def qos1_publish(seq):
+                pw.write(C.serialize(C.Publish(
+                    topic="live/x", qos=1, packet_id=(seq % 60000) + 1,
+                    payload=b"s%d" % seq,
+                ), C.MQTT_V5))
+                await pw.drain()
+
+            async def pub_reader():
+                while not sub_done.is_set():
+                    data = await pr.read(1 << 14)
+                    if not data:
+                        return
+                    for pk in pp.feed(data):
+                        if pk.type == C.PUBACK:
+                            acked.add(pk.packet_id)
+
+            pub_rd = asyncio.get_running_loop().create_task(
+                pub_reader()
+            )
+            # control connection: PINGREQ must round-trip under flood
+            cr, cw, cp = await conn("control")
+            pings_ok = 0
+            sent_seqs = []
+            t_end = time.time() + 4.0
+            seq = 0
+            while time.time() < t_end:
+                await qos1_publish(seq)
+                sent_seqs.append(seq)
+                seq += 1
+                cw.write(C.serialize(C.Pingreq(), C.MQTT_V5))
+                await cw.drain()
+                try:
+                    data = await asyncio.wait_for(cr.read(1 << 10), 5.0)
+                    if any(pk.type == C.PINGRESP
+                           for pk in cp.feed(data)):
+                        pings_ok += 1
+                except asyncio.TimeoutError:
+                    pass
+                await asyncio.sleep(0.1)
+            flood_on = False
+            await asyncio.gather(*flooders, return_exceptions=True)
+            # ladder must have risen to shedding territory and shed
+            assert max_level >= 2, f"ladder only reached {max_level}"
+            assert pings_ok >= len(sent_seqs) - 2, "control starved"
+            shed = (
+                broker.metrics.val("delivery.dropped.olp_shed")
+                + broker.metrics.val("delivery.dropped.out_buffer")
+            )
+            assert shed > 0, "flood never shed"
+            # drain: every QoS1 the broker ACKED must reach the sub
+            want = {b"s%d" % s for s in sent_seqs}
+            deadline = time.time() + 10.0
+            while time.time() < deadline:
+                if want <= got:
+                    break
+                await asyncio.sleep(0.1)
+            missing = want - got
+            assert not missing, f"QoS1 loss: {sorted(missing)[:5]}"
+            # recovery: load gone, the ladder steps back down to 0
+            deadline = time.time() + 10.0
+            while time.time() < deadline and broker.olp.level:
+                await asyncio.sleep(0.1)
+            assert broker.olp.level == 0, "ladder never recovered"
+            assert broker.metrics.val("olp.level.changed") >= 2
+            sub_done.set()
+            stop_sampler.set()
+            for w in (sw, zw, pw, cw):
+                w.close()
+            sub_task.cancel()
+            pub_rd.cancel()
+            await asyncio.gather(
+                sub_task, pub_rd, return_exceptions=True
+            )
+            await asyncio.gather(sam, return_exceptions=True)
+        finally:
+            stop_sampler.set()
+            await srv.stop()
+
+    run(t())
+
+
+# ================================================ surfaces / config
+
+def test_check_config_rejects_bad_olp():
+    cfg = BrokerConfig()
+    cfg.olp.exit_factor = 1.5
+    cfg.olp.loop_lag_ms = [500.0, 100.0, 2000.0]
+    cfg.olp.limiter_clamp = 0.0
+    cfg.olp.window_cap = 0
+    cfg.mqtt.outbound_high_watermark = -1
+    problems = "\n".join(check_config(cfg))
+    assert "olp.exit_factor" in problems
+    assert "olp.loop_lag_ms" in problems
+    assert "olp.limiter_clamp" in problems
+    assert "olp.window_cap" in problems
+    assert "outbound_high_watermark" in problems
+
+
+def test_olp_info_shape():
+    b = _broker()
+    now = lift(b, 2)
+    b.olp.shed("refused.connect")
+    info = b.olp.info()
+    assert info["level"] == 2 and info["enable"] is True
+    assert info["signals"]["loop_lag_ms"] == 500.0
+    assert info["thresholds"]["loop_lag_ms"] == [100.0, 500.0, 2000.0]
+    assert info["shed"] == {"refused.connect": 1}
+    assert info["counters"]["olp.refused.connect"] == 1
+    assert info["transitions"][-1]["to"] == 2
+    assert info["clamped"] is True
+
+
+def test_rest_and_ctl_olp(tmp_path):
+    import tempfile
+
+    from api_helper import auth_session
+    from emqx_tpu.broker.listener import BrokerServer
+    from emqx_tpu.config import ListenerConfig
+
+    async def t():
+        cfg = BrokerConfig()
+        cfg.listeners = [ListenerConfig(port=0)]
+        cfg.api.enable = True
+        cfg.api.data_dir = tempfile.mkdtemp(dir=str(tmp_path))
+        cfg.api.port = 0
+        cfg.olp.enable = True
+        srv = BrokerServer(cfg)
+        await srv.start()
+        srv.broker.olp.observe(
+            {"loop_lag_ms": 600.0}, now=time.time()
+        )
+        http, api = await auth_session(srv)
+        try:
+            async with http.get(api + "/api/v5/olp") as r:
+                assert r.status == 200
+                body = await r.json()
+                assert body["level"] == 2
+                assert "loop_lag_ms" in body["signals"]
+                assert body["counters"]["olp.level.changed"] == 1
+            async with http.get(api + "/api/v5/nodes") as r:
+                nodes = await r.json()
+                assert nodes["data"][0]["olp_level"] == 2
+
+            from emqx_tpu.ctl import Ctl
+
+            def drive_ctl():
+                ctl = Ctl(api, user="admin:public")
+                ctl.olp()
+                ctl.status()
+
+            await asyncio.get_running_loop().run_in_executor(
+                None, drive_ctl
+            )
+        finally:
+            await http.close()
+            await srv.stop()
+
+    run(t())

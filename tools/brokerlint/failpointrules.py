@@ -75,6 +75,8 @@ SEAM_FUNCS: Tuple[Seam, ...] = (
          "QuicPeerEndpoint.on_datagram", "cluster.quic.recv"),
     Seam("emqx_tpu/cluster/node.py", "ClusterNode._send_fwd_ack",
          "cluster.forward.ack"),
+    Seam("emqx_tpu/olp.py", "LoadMonitor.sample", "olp.sample"),
+    Seam("emqx_tpu/olp.py", "LoadMonitor.shed", "olp.shed"),
 )
 
 

@@ -85,6 +85,12 @@ DISPATCH_FUNCS = (
                "ClusterNode._handle_fwd_ack"),
     DispatchFn("emqx_tpu/cluster/quic_transport.py",
                "_send_datagrams"),
+    # overload ladder (olp): the level machine and the shed
+    # accounting both sit inside dispatch/tick paths — no per-unit
+    # clock reads, encodes, or unguarded trace work may creep in
+    # (the shed MASK itself is policed via _dispatch_columns above)
+    DispatchFn("emqx_tpu/olp.py", "LoadMonitor.observe"),
+    DispatchFn("emqx_tpu/olp.py", "LoadMonitor.shed"),
 )
 
 # callee tails that mean "re-encode a wire frame"
