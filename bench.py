@@ -508,6 +508,188 @@ def run_replay_bench(log, n_sessions=256, n_backlog=64,
     return out
 
 
+def run_durability_bench(log, iters=None, n_msgs=None,
+                         recovery_msgs=None, write_json=True):
+    """Durability A/B (BENCH_r12, the PR 15 tentpole): persistent-
+    session QoS1 publish throughput under the four fsync disciplines —
+
+      * ``never``      no fsync anywhere (the pre-PR hot path);
+      * ``interval``   periodic group flush off the tick (acks free);
+      * ``always``     group-commit: ONE fsync amortized per dispatch
+                       window before the window's acks release;
+      * ``naive``      the counterfactual the group commit exists to
+                       beat: fsync per MESSAGE (window size 1).
+
+    Interleaved iterations, medians reported.  The acceptance bar:
+    ``always`` >= 5x ``naive`` and ``interval`` within ~10% of
+    ``never`` (no robustness tax on the default).
+
+    Plus cold-recovery numbers on a >=1M-message store: native
+    segment-scan reopen (index rebuild) and the full census rebuild
+    after metadata loss (the log-is-source-of-truth path).
+    """
+    import shutil
+    import statistics
+    import tempfile
+
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.config import BrokerConfig
+    from emqx_tpu.ds.builtin_local import LocalStorage
+    from emqx_tpu.ds.native import DsLog
+    from emqx_tpu.message import Message
+
+    iters = iters or int(os.environ.get("BENCH_DUR_ITERS", "5"))
+    n_msgs = n_msgs or int(os.environ.get("BENCH_DUR_MSGS", "2048"))
+    recovery_msgs = recovery_msgs or int(
+        os.environ.get("BENCH_DUR_RECOVERY_MSGS", "1000000")
+    )
+    window = 64
+
+    def one_run(mode):
+        """One measured pass: a detached persistent subscriber's
+        filter arms the gate, the publisher pushes QoS1 windows
+        through publish_many (the loop-less group-commit path: in
+        `always` mode each window ends with its covering flush, the
+        contract a socketed PUBACK rides)."""
+        d = tempfile.mkdtemp(prefix=f"dur_{mode}_")
+        try:
+            cfg = BrokerConfig()
+            cfg.engine.use_device = False
+            cfg.durable.enable = True
+            cfg.durable.data_dir = d
+            cfg.durable.fsync = "always" if mode == "naive" else mode
+            b = Broker(config=cfg)
+            b.durable.save(
+                "psub", {"bench/#": {"qos": 1}}, 7200.0,
+                now=time.time() - 30.0,
+            )
+            b.durable.add_filter("bench/#")
+            win = 1 if mode == "naive" else window
+            payload = b"x" * 64
+            msgs = [
+                Message(
+                    topic=f"bench/{i % 128}/t", qos=1,
+                    payload=payload, timestamp=time.time(),
+                )
+                for i in range(n_msgs)
+            ]
+            t0 = time.perf_counter()
+            for off in range(0, n_msgs, win):
+                b.publish_many(msgs[off:off + win])
+            dt = time.perf_counter() - t0
+            syncs = b.durable.gate.sync_count
+            stored = b.durable.storage.stats()["messages"]
+            assert stored == n_msgs, (mode, stored)
+            if mode in ("always", "naive"):
+                assert not b.durable.gate.dirty  # acked => flushed
+                assert syncs >= (n_msgs // win)
+            b.durable.close()
+            return n_msgs / dt, syncs
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+
+    modes = ("never", "interval", "always", "naive")
+    rates = {m: [] for m in modes}
+    syncs = {m: 0 for m in modes}
+    for it in range(iters):
+        for m in modes:  # interleaved: drift hits every mode equally
+            r, s = one_run(m)
+            rates[m].append(r)
+            syncs[m] = s
+        log(
+            f"durability iter {it}: " + ", ".join(
+                f"{m}={rates[m][-1]:,.0f}/s" for m in modes
+            )
+        )
+    med = {m: statistics.median(rates[m]) for m in modes}
+    out = {
+        "publish_qos1_msgs_per_s": {m: med[m] for m in modes},
+        "syncs_per_run": syncs,
+        "always_vs_naive": med["always"] / med["naive"],
+        "interval_vs_never": med["interval"] / med["never"],
+        "window": window,
+        "n_msgs": n_msgs,
+        "iters": iters,
+    }
+    log(
+        f"durability medians: never={med['never']:,.0f} "
+        f"interval={med['interval']:,.0f} always={med['always']:,.0f} "
+        f"naive={med['naive']:,.0f} msg/s; always/naive="
+        f"{out['always_vs_naive']:.1f}x (>=5x bar), interval/never="
+        f"{out['interval_vs_never']:.2f} (~0.9+ bar)"
+    )
+
+    # ---- cold recovery on a >=1M-message store (log scan + census
+    # rebuild after metadata loss)
+    d = tempfile.mkdtemp(prefix="dur_recovery_")
+    try:
+        store = LocalStorage(d, n_streams=16)
+        payload = b"r" * 16
+        t_fill0 = time.perf_counter()
+        batch = 4096
+        msgs = [
+            Message(
+                topic=f"f/{i % 512}/t", qos=1, payload=payload,
+                timestamp=1e9 + i,
+            )
+            for i in range(batch)
+        ]
+        filled = 0
+        while filled < recovery_msgs:
+            store.store_batch(msgs[: min(batch, recovery_msgs - filled)])
+            filled += batch
+        store.sync()
+        store.close()
+        fill_dt = time.perf_counter() - t_fill0
+        size_mb = sum(
+            os.path.getsize(os.path.join(d, f))
+            for f in os.listdir(d)
+        ) / (1 << 20)
+        # clean reopen: native segment scan rebuilds the (stream, ts)
+        # index; the census cache is valid and skips the decode pass
+        t0 = time.perf_counter()
+        store = LocalStorage(d, n_streams=16)
+        open_clean_s = time.perf_counter() - t0
+        n = store.stats()["messages"]
+        store.close()
+        # metadata loss: census gone — the log is the source of truth
+        # and the census rebuild decodes every record
+        os.unlink(os.path.join(d, "census.json"))
+        t0 = time.perf_counter()
+        store = LocalStorage(d, n_streams=16)
+        rebuild_s = time.perf_counter() - t0
+        assert store.stats()["messages"] == n >= recovery_msgs
+        store.close()
+        # native-only recovery floor (no census logic at all)
+        t0 = time.perf_counter()
+        lg = DsLog(d)
+        native_open_s = time.perf_counter() - t0
+        lg.close()
+        out["cold_recovery"] = {
+            "messages": int(n),
+            "store_mb": round(size_mb, 1),
+            "fill_s": round(fill_dt, 2),
+            "native_open_s": round(native_open_s, 3),
+            "open_clean_s": round(open_clean_s, 3),
+            "census_rebuild_s": round(rebuild_s, 2),
+        }
+        log(
+            f"cold recovery: {n:,} msgs ({size_mb:.0f} MiB) — native "
+            f"open {native_open_s:.2f}s, clean open {open_clean_s:.2f}s, "
+            f"census rebuild after meta loss {rebuild_s:.1f}s"
+        )
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+    if write_json:
+        path = os.path.join(
+            os.path.dirname(__file__) or ".", "BENCH_r12.json"
+        )
+        with open(path, "w") as f:
+            json.dump(out, f, indent=2)
+    return out
+
+
 def run_cluster_forward_bench(log, n_msgs=None, iters=None,
                               write_json=True):
     """Cluster window forwarding A/B (BENCH_r09): batched scatter
@@ -2043,6 +2225,12 @@ def main():
         # scheduler): scalar vs windowed sessions/s + storm drain
         replay_stats = run_replay_bench(log)
 
+    durability_stats = {}
+    if os.environ.get("BENCH_DURABILITY", "1") != "0":
+        # fsync-mode A/B + naive per-message-fsync counterfactual +
+        # cold recovery (BENCH_r12 tracks the PR 15 tentpole)
+        durability_stats = run_durability_bench(log)
+
     cluster_fwd_stats = {}
     if os.environ.get("BENCH_CLUSTER_FORWARD", "1") != "0":
         # at-least-once window forwarding over tcp vs quic vs quic@1%
@@ -2114,6 +2302,7 @@ def main():
         "vectorized host CSR expand to per-topic fid lists",
         "dispatch_fanout_msgs_per_s": fanout_stats,
         "replay": replay_stats,
+        "durability": durability_stats,
         "cluster_forward": cluster_fwd_stats,
         "rules": rules_stats,
         "overload": overload_stats,

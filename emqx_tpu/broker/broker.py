@@ -286,7 +286,19 @@ class Broker:
                 n_streams=self.config.durable.n_streams,
                 store_qos0=self.config.durable.store_qos0,
                 layout=self.config.durable.layout,
+                fsync=self.config.durable.fsync,
             )
+            # detected corruption (quarantined log records, unreadable
+            # sidecars) surfaces as $SYS alarms + counters — the
+            # constructor buffered anything its own loads found
+            self.durable.on_corruption = self._ds_corruption
+            for evt in self.durable.corruption_events:
+                self._ds_corruption(evt)
+            self.durable.corruption_events = []
+            # every group fsync is counted + histogrammed (the
+            # profiler's ds_sync stage feeds the sync-latency surface)
+            self.durable.gate.on_sync = self._ds_synced
+            self.durable.gate.on_error = self._ds_sync_error
             # advertise boot-state filters as live routes so peers keep
             # forwarding (and this node keeps persisting) for sessions
             # detached across the restart — the reference gets this from
@@ -320,6 +332,7 @@ class Broker:
         # clientid -> (fire_at, will message): MQTT 5 delayed wills
         self._pending_wills: Dict[str, Tuple[float, Message]] = {}
         self._last_ds_sync = time.time()
+        self._last_ds_fsync = time.time()
         # window decision columns (PR 9): per-delivery QoS/no-local/
         # body-slot decisions computed as ONE vectorized pass per
         # window (host numpy or the device decide kernel, chosen by
@@ -802,10 +815,20 @@ class Broker:
                     self.resume.pause(clientid)
                     self.resume.refresh_checkpoint(clientid, session)
                 else:
-                    self.durable.save(
-                        clientid, session.subscriptions,
-                        session.expiry_interval,
-                    )
+                    try:
+                        self.durable.save(
+                            clientid, session.subscriptions,
+                            session.expiry_interval,
+                        )
+                    except Exception:
+                        # a failed checkpoint write (disk fault,
+                        # ds.meta.write chaos) leaves the PREVIOUS
+                        # checkpoint in place: recovery replays from
+                        # the older disconnected_at — at-least-once,
+                        # and teardown must not die over it
+                        log.exception(
+                            "durable checkpoint failed for %s", clientid
+                        )
             if self.external is not None:
                 # buddy replication (simplified emqx_ds_builtin_raft):
                 # the checkpoint + everything pending survives this
@@ -835,11 +858,23 @@ class Broker:
         reading sockets during the kernel round-trip) while the
         state-mutating stages stay on the loop thread."""
         rec = self.profiler.begin(len(msgs))
+        dur = self.durable
+        always = dur is not None and dur.fsync_mode == "always"
+        wm0 = dur.gate.appended if always else 0
         live, results = self.publish_prepare(msgs)
         if rec is not None:
             rec.lap("prepare")
         matched, remote = self.publish_match(live, rec=rec)
-        return self.publish_dispatch(live, matched, remote, results, rec)
+        counts = self.publish_dispatch(live, matched, remote, results, rec)
+        if always and dur.gate.appended > wm0 and dur.gate.dirty:
+            # loop-less group commit (no batcher): the caller acks
+            # after this returns, so the covering flush happens here —
+            # still amortized once per publish_many window.  Gated on
+            # THIS window's captures (watermark moved), so a $SYS tick
+            # or other non-captured publish never pays a blocking
+            # fsync for the batcher's in-flight appends.
+            dur.gate.sync_now()
+        return counts
 
     def publish_prepare(
         self, msgs: Sequence[Message]
@@ -1121,6 +1156,13 @@ class Broker:
             try:
                 self.durable.persist(list(msgs))
             except Exception:
+                if self.durable.fsync_mode == "always":
+                    # the receiver must NOT fwd-ack a window it failed
+                    # to store — the origin's replay copy is the only
+                    # remaining one.  Raising leaves the frame un-acked
+                    # (and un-deduped), so the retransmit re-delivers:
+                    # at-least-once instead of silent loss.
+                    raise
                 log.exception("durable persist failed for forwarded batch")
         rec = self.profiler.begin(len(msgs), source="forwarded")
         matched = self.router.match_batch([m.topic for m in msgs])
@@ -2346,10 +2388,22 @@ class Broker:
             cfg = self.config.durable
             if now - self._last_ds_sync >= cfg.sync_interval:
                 self._last_ds_sync = now
-                self.durable.sync()  # fsync + census checkpoint
+                self.durable.checkpoint_meta()  # census/index + progress
                 self.durable.gc(
                     int((now - cfg.retention_hours * 3600.0) * 1e6)
                 )
+            if cfg.fsync != "never":
+                # interval-mode group flush (and the `always` mode's
+                # backstop for appends no dispatch barrier covered).
+                # olp L1+ stretches the cadence 2x — fewer disk stalls
+                # while shedding — but a parked-ack flush is the
+                # gate's own worker and is NEVER skipped.
+                eff = cfg.fsync_interval * (
+                    2.0 if self.olp.level >= 1 else 1.0
+                )
+                if now - self._last_ds_fsync >= eff:
+                    self._last_ds_fsync = now
+                    self.durable.sync_soon()
 
     # ---------------------------------------------- engine breaker
 
@@ -2366,6 +2420,38 @@ class Broker:
             except RuntimeError:
                 pass
         fn()
+
+    # ------------------------------------------------ ds durability
+
+    def _ds_corruption(self, evt: Dict) -> None:
+        """Detected DS corruption (quarantined log suffix / unreadable
+        metadata sidecar): counter + $SYS alarm.  The store already
+        fell back conservatively (intact prefix keeps serving, replay
+        restarts from the checkpoint) — this is the 'never silent'
+        half of the contract."""
+        kind = evt.get("kind", "meta")
+        if kind == "storage":
+            self.metrics.inc(
+                "ds.storage.corrupt_records",
+                int(evt.get("records", 1)),
+            )
+            name = "ds_storage_corruption"
+            msg = "dslog quarantined unreadable records"
+        else:
+            self.metrics.inc("ds.meta.corruption")
+            name = "ds_meta_corruption"
+            msg = ("DS metadata sidecar unreadable; recovered "
+                   "conservatively (at-least-once)")
+        self._on_loop(lambda: self.alarms.activate(
+            name, details=dict(evt), message=msg,
+        ))
+
+    def _ds_synced(self, dur_s: float) -> None:
+        self.metrics.inc("ds.sync.count")
+        self.profiler.stage("ds_sync", dur_s)
+
+    def _ds_sync_error(self, exc: BaseException) -> None:
+        self.metrics.inc("ds.sync.errors")
 
     def _engine_breaker_trip(self, info: Dict) -> None:
         self.metrics.inc("engine.breaker.trip")
@@ -2766,6 +2852,22 @@ class PublishBatcher:
                             if attempt == 9:
                                 raise
                             await asyncio.sleep(0.2)
+                dur = self.broker.durable
+                if (
+                    dur is not None
+                    and dur.fsync_mode == "always"
+                    and dur.gate.dirty
+                ):
+                    # group-commit barrier: a QoS>=1 PUBACK to a
+                    # publisher whose message the persistence gate
+                    # captured parks here until the covering
+                    # dslog_sync lands — ONE fsync amortized per
+                    # dispatch window, concurrent windows coalesced by
+                    # the gate's worker.  A sync fault keeps the acks
+                    # parked and retries (never an un-durable ack);
+                    # with nothing unsynced this is one integer
+                    # compare, so non-captured traffic pays nothing.
+                    await dur.wait_durable()
             except asyncio.CancelledError:
                 raise
             except Exception as exc:  # resolve futures either way

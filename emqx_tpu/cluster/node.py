@@ -1793,7 +1793,37 @@ class ClusterNode:
             # origin node (the reference's forward lands in dispatch/2
             # directly, emqx_broker.erl:408-420); one batched match
             # step per frame
-            self.broker.dispatch_forwarded_many(msgs)
+            try:
+                self.broker.dispatch_forwarded_many(msgs)
+                dur = self.broker.durable
+                if (
+                    dur is not None
+                    and dur.fsync_mode == "always"
+                    and dur.gate.dirty
+                ):
+                    # acked-to-origin means durable HERE too: on this
+                    # ack the origin drops its replay copy, so a
+                    # captured forwarded message must hit disk first
+                    # (the cluster hop of the group-commit contract).
+                    # BOUNDED wait: this handler runs in the per-peer
+                    # serial pump, so a disk stalled in the gate's
+                    # retry loop must not head-of-line-block the
+                    # peer's heartbeats/acks forever — on timeout the
+                    # frame stays un-acked/un-deduped and the origin's
+                    # retransmit retries once the disk recovers.
+                    await asyncio.wait_for(
+                        dur.wait_durable(), timeout=2.0
+                    )
+            except asyncio.TimeoutError:
+                return
+            except Exception:
+                # store/dispatch failure: no ack, no dedup state — the
+                # retransmit re-delivers (at-least-once, never a
+                # silently-dropped acked window)
+                log.exception(
+                    "forwarded window from %s not acked", peer
+                )
+                return
             st[2].add(seq)
             while st[1] + 1 in st[2]:
                 st[1] += 1

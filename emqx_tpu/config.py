@@ -324,7 +324,22 @@ class DurableConfig:
     layout: str = "lts"
     n_streams: int = 16  # hash layout only
     store_qos0: bool = False
-    sync_interval: float = 5.0  # fsync + census checkpoint cadence
+    # durability mode — what "acked" means for a captured QoS>=1
+    # publish (the PR 15 group-commit contract):
+    #   never    no fsync: a power cut may take everything since the
+    #            OS last flushed (process crashes still lose nothing
+    #            the log absorbed — appends are write()-complete)
+    #   interval periodic group flush off the broker tick every
+    #            `fsync_interval` s: a power cut loses at most that
+    #            window (olp L1 stretches the interval 2x, never
+    #            skips a flush a parked ack waits on)
+    #   always   group-commit: the PUBACK parks until the covering
+    #            dslog_sync lands — ONE fsync amortized per dispatch
+    #            window ("acked means durable", crash-tested by
+    #            tools/crashsim)
+    fsync: str = "interval"
+    fsync_interval: float = 5.0
+    sync_interval: float = 5.0  # metadata checkpoint + gc cadence
     retention_hours: float = 168.0  # segment GC horizon (7 days)
     # mass-reconnect admission control + windowed replay
     resume: ResumeConfig = field(default_factory=ResumeConfig)
@@ -593,6 +608,13 @@ def check_config(cfg: BrokerConfig) -> List[str]:
         bad("mqtt.mqueue_default_priority must be lowest|highest")
     if cfg.durable.layout not in ("lts", "hash"):
         bad(f"durable.layout: {cfg.durable.layout!r} (lts|hash)")
+    if cfg.durable.fsync not in ("never", "interval", "always"):
+        bad(
+            f"durable.fsync: {cfg.durable.fsync!r} "
+            "(never|interval|always)"
+        )
+    if not 0.05 <= float(cfg.durable.fsync_interval) <= 3600.0:
+        bad("durable.fsync_interval must be in [0.05, 3600]")
     res = cfg.durable.resume
     if int(res.max_concurrent) < 1:
         bad("durable.resume.max_concurrent must be >= 1")

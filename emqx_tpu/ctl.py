@@ -111,6 +111,17 @@ class Ctl:
                     f"park_cap={resume['park_queue_cap']}, "
                     f"windowed={resume['windowed']})"
                 )
+            dura = n.get("durability")
+            if dura:
+                print(
+                    f"  durability: fsync={dura['fsync']}; "
+                    f"{dura['sync_count']} syncs "
+                    f"({dura['sync_errors']} errors), "
+                    f"{dura['unsynced']} unsynced / "
+                    f"{dura['parked']} parked acks; "
+                    f"corruption: {dura['corrupt_records']} records "
+                    f"quarantined, {dura['meta_corruption']} meta"
+                )
         cluster = nodes.get("cluster") or {}
         if cluster:
             print(

@@ -177,12 +177,34 @@ def _props_restore(props: Dict) -> Dict:
 
 
 class DurableStorage:
+    # metadata sidecars fsync on every write only in the `always`
+    # durability mode (DurableSessions sets this from durable.fsync);
+    # atomic replace + CRC apply in every mode
+    meta_fsync = False
+
     def stream_key(self, topic: str) -> int:
         """The write-side stream a topic maps to — the key layer
         callers (the beamformer's store-notify) must share with
         `store_batch`.  Layouts override; the default is the 2-level
         hash partitioning."""
         return stream_of(topic, getattr(self, "n_streams", 16))
+
+    def _report_corruption(self, kind: str, path: str, detail: str,
+                           records: int = 0) -> None:
+        """Surface detected corruption (never swallow it): through
+        ``on_corruption`` when the owner wired one, else buffered in
+        ``corruption_events`` for the owner to drain after
+        construction (loads run inside ``__init__``, before any
+        callback can exist).  ``kind`` is ``storage`` (quarantined log
+        records) or ``meta`` (unreadable sidecar)."""
+        evt = {"kind": kind, "path": path, "detail": detail}
+        if records:
+            evt["records"] = records
+        cb = getattr(self, "on_corruption", None)
+        if cb is not None:
+            cb(evt)
+        else:
+            self.corruption_events.append(evt)
 
     """Backend behavior (emqx_ds.erl:255-261 callback set)."""
 
@@ -207,6 +229,22 @@ class DurableStorage:
         self, it: IterRef, n: int
     ) -> Tuple[IterRef, List[Message]]:
         raise NotImplementedError
+
+    def sync_data(self) -> None:
+        """fsync the message log ONLY — the group-commit gate's flush
+        (metadata checkpoints ride their own cadence via
+        `save_meta`).  In-memory backends no-op."""
+
+    def save_meta(self) -> None:
+        """Checkpoint the layout's metadata caches (atomic + CRC; no
+        fsync unless ``meta_fsync``)."""
+
+    def sync(self) -> None:
+        self.sync_data()
+        self.save_meta()
+
+    def corruption_stats(self) -> Dict[str, int]:
+        return {"corrupt_records": 0, "quarantined_segments": 0}
 
     def close(self) -> None:
         pass

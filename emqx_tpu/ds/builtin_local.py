@@ -11,12 +11,12 @@ and the stream then serves every filter)."""
 
 from __future__ import annotations
 
-import json
 import os
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from .. import topic as T
 from ..message import Message
+from . import atomicio
 from .api import (
     DurableStorage,
     IterRef,
@@ -40,7 +40,20 @@ class LocalStorage(DurableStorage):
     ) -> None:
         self.directory = directory
         self.n_streams = n_streams
+        self.on_corruption = None
+        self.corruption_events: List[Dict] = []
         self._log = DsLog(directory, seg_bytes=seg_bytes)
+        ncorrupt = self._log.corrupt_records()
+        if ncorrupt:
+            # the log recovery quarantined unreadable record suffixes:
+            # the intact data keeps serving, and the owner raises the
+            # ds_storage_corruption alarm — never a silent loss
+            self._report_corruption(
+                "storage", directory,
+                f"{ncorrupt} record(s) quarantined in "
+                f"{self._log.quarantined_count()} segment(s)",
+                records=ncorrupt,
+            )
         # learned topic structure: stream -> topics seen (None = opaque)
         self._census: Dict[int, Optional[Set[str]]] = {}
         self._census_path = os.path.join(directory, "census.json")
@@ -106,17 +119,28 @@ class LocalStorage(DurableStorage):
         """Load the census cache, validating it against the log (the
         log is the source of truth): a crash after the last save leaves
         the cache stale, and a stale census could wrongly prune streams
-        — rebuild whenever the record count disagrees."""
+        — rebuild whenever the record count disagrees.  Missing or
+        stale is the normal crash artifact (silent rebuild); an
+        UNREADABLE file (torn write, CRC break) also rebuilds — the
+        census is a cache, so the rebuild IS full recovery — but is
+        counted and alarmed, never silently absorbed."""
         try:
-            with open(self._census_path) as f:
-                raw = json.load(f)
+            raw = atomicio.load_json(self._census_path)
+        except FileNotFoundError:
+            self._rebuild_census()
+            return
+        except atomicio.MetaCorruption as exc:
+            self._report_corruption("meta", exc.path, exc.detail)
+            self._rebuild_census()
+            return
+        try:
             if raw.get("n") != self._total_count():
                 raise ValueError("census stale vs log")
             self._census = {
                 int(k): (None if v is None else set(v))
                 for k, v in raw["streams"].items()
             }
-        except (OSError, ValueError, KeyError):
+        except (ValueError, KeyError, AttributeError, TypeError):
             self._rebuild_census()
 
     def _rebuild_census(self) -> None:
@@ -134,19 +158,17 @@ class LocalStorage(DurableStorage):
             self._census[shard] = census
 
     def _save_census(self) -> None:
-        tmp = self._census_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(
-                {
-                    "n": self._total_count(),
-                    "streams": {
-                        str(k): (None if v is None else sorted(v))
-                        for k, v in self._census.items()
-                    },
+        atomicio.atomic_write_json(
+            self._census_path,
+            {
+                "n": self._total_count(),
+                "streams": {
+                    str(k): (None if v is None else sorted(v))
+                    for k, v in self._census.items()
                 },
-                f,
-            )
-        os.replace(tmp, self._census_path)
+            },
+            fsync=self.meta_fsync,
+        )
 
     def gc(self, cutoff_ts_us: int) -> int:
         """Retention: reclaim segments wholly older than the cutoff.
@@ -154,9 +176,19 @@ class LocalStorage(DurableStorage):
         when a topic is provably absent)."""
         return self._log.gc(cutoff_ts_us)
 
-    def sync(self) -> None:
+    def sync_data(self) -> None:
         self._log.sync()
+
+    def save_meta(self) -> None:
         self._save_census()
+
+    # sync() is the base composition: sync_data() + save_meta()
+
+    def corruption_stats(self) -> Dict[str, int]:
+        return {
+            "corrupt_records": self._log.corrupt_records(),
+            "quarantined_segments": self._log.quarantined_count(),
+        }
 
     def stats(self) -> Dict[str, int]:
         return {
@@ -164,6 +196,7 @@ class LocalStorage(DurableStorage):
             "messages": sum(
                 self._log.stream_count(s) for s in self._log.streams()
             ),
+            **self.corruption_stats(),
         }
 
     def close(self) -> None:

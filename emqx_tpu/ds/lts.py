@@ -34,13 +34,13 @@ overlapping the filter, so nothing is rewritten and nothing is lost.
 
 from __future__ import annotations
 
-import json
 import os
 import zlib
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 from .. import topic as T
 from ..message import Message
+from . import atomicio
 from .api import (
     DurableStorage,
     IterRef,
@@ -210,7 +210,17 @@ class LtsStorage(DurableStorage):
         seg_bytes: int = 0,
     ) -> None:
         self.directory = directory
+        self.on_corruption = None
+        self.corruption_events: List[Dict] = []
         self._log = DsLog(directory, seg_bytes=seg_bytes)
+        ncorrupt = self._log.corrupt_records()
+        if ncorrupt:
+            self._report_corruption(
+                "storage", directory,
+                f"{ncorrupt} record(s) quarantined in "
+                f"{self._log.quarantined_count()} segment(s)",
+                records=ncorrupt,
+            )
         self._index_path = os.path.join(directory, "lts_index.json")
         # the sid->pattern registry persists SEPARATELY and
         # immediately on every new structure: stream keys embed sids,
@@ -269,23 +279,39 @@ class LtsStorage(DurableStorage):
     # ------------------------------------------------------ lifecycle
 
     def _load_patterns(self) -> List[str]:
+        """Missing = fresh dir; unreadable = alarm + empty seed.  The
+        empty fallback is CONSERVATIVE for replay: an unknown sid can
+        never be pruned (`shards_for_filter` serves every stream of an
+        unregistered structure and `next` filter-checks each record),
+        so corruption degrades to wider scans, not loss."""
         try:
-            with open(self._patterns_path) as f:
-                return list(json.load(f))
-        except (OSError, json.JSONDecodeError):
+            return list(atomicio.load_json(self._patterns_path))
+        except FileNotFoundError:
+            return []
+        except atomicio.MetaCorruption as exc:
+            self._report_corruption("meta", exc.path, exc.detail)
+            return []
+        except (TypeError, ValueError):
+            self._report_corruption(
+                "meta", self._patterns_path, "pattern registry not a list"
+            )
             return []
 
     def _save_patterns(self) -> None:
-        tmp = self._patterns_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(self.index._patterns, f)
-        os.replace(tmp, self._patterns_path)
+        atomicio.atomic_write_json(
+            self._patterns_path, self.index._patterns,
+            fsync=self.meta_fsync,
+        )
 
     def _load_index(self, var_threshold: int) -> LtsIndex:
         try:
-            with open(self._index_path) as f:
-                obj = json.load(f)
-        except (OSError, json.JSONDecodeError):
+            obj = atomicio.load_json(self._index_path)
+        except FileNotFoundError:
+            obj = None
+        except atomicio.MetaCorruption as exc:
+            # the trie is a cache over the log: re-learning (below) is
+            # full recovery, but a torn index is still counted/alarmed
+            self._report_corruption("meta", exc.path, exc.detail)
             obj = None
         patterns = self._load_patterns()
         if not patterns and obj is not None:
@@ -324,20 +350,29 @@ class LtsStorage(DurableStorage):
         )
 
     def _save_index(self) -> None:
-        tmp = self._index_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(
-                {"count": self._record_count(),
-                 "index": self.index.to_json()}, f
-            )
-        os.replace(tmp, self._index_path)
+        atomicio.atomic_write_json(
+            self._index_path,
+            {"count": self._record_count(),
+             "index": self.index.to_json()},
+            fsync=self.meta_fsync,
+        )
 
     def gc(self, cutoff_ts_us: int) -> int:
         return self._log.gc(cutoff_ts_us)
 
-    def sync(self) -> None:
+    def sync_data(self) -> None:
         self._log.sync()
+
+    def save_meta(self) -> None:
         self._save_index()
+
+    # sync() is the base composition: sync_data() + save_meta()
+
+    def corruption_stats(self) -> Dict[str, int]:
+        return {
+            "corrupt_records": self._log.corrupt_records(),
+            "quarantined_segments": self._log.quarantined_count(),
+        }
 
     def stats(self) -> Dict[str, int]:
         n = self._record_count()
@@ -346,6 +381,7 @@ class LtsStorage(DurableStorage):
             "structures": len(self.index._patterns),
             "messages": n,
             "records": n,
+            **self.corruption_stats(),
         }
 
     def close(self) -> None:

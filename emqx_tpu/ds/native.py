@@ -12,6 +12,8 @@ import os
 import subprocess
 import threading
 
+from .. import failpoints
+
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _SRC = os.path.join(_REPO, "native", "dslog.cpp")
 _SO = os.path.join(_REPO, "native", "build", "libdslog.so")
@@ -90,6 +92,10 @@ def load():
         ]
         lib.dslog_stream_count.restype = ctypes.c_int64
         lib.dslog_stream_count.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+        lib.dslog_corrupt_records.restype = ctypes.c_int64
+        lib.dslog_corrupt_records.argtypes = [ctypes.c_void_p]
+        lib.dslog_quarantined_count.restype = ctypes.c_int
+        lib.dslog_quarantined_count.argtypes = [ctypes.c_void_p]
         lib.dslog_gc.restype = ctypes.c_int64
         lib.dslog_gc.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         _lib = lib
@@ -97,25 +103,75 @@ def load():
 
 
 class DsLog:
-    """Thin OO wrapper over the C ABI."""
+    """Thin OO wrapper over the C ABI.
+
+    The two write-side methods are the broker's deepest storage IO
+    seams: ``ds.store.append`` and ``ds.store.sync`` (chaos: a disk
+    failing/stalling/lying exactly under the durable hot path).  The
+    class-level ``recorder`` hook is the crash-point simulation
+    harness's tap (tools/crashsim): when set, every successful
+    open/append/sync is journaled so any crash prefix of the write
+    trace can be materialized and recovered (ALICE-style).
+    """
+
+    # crashsim write-trace tap (None in production: one attr test per op)
+    recorder = None
 
     def __init__(self, directory: str, seg_bytes: int = 0) -> None:
         self._lib = load()
         os.makedirs(directory, exist_ok=True)
+        self._dir = directory
+        self._seg_bytes = seg_bytes
         self._h = self._lib.dslog_open(directory.encode(), seg_bytes)
         if not self._h:
             raise OSError(f"dslog_open failed for {directory}")
+        if DsLog.recorder is not None:
+            DsLog.recorder.on_open(directory, seg_bytes)
 
     def append(self, stream: int, ts: int, data: bytes) -> int:
+        """Append one record; the ``ds.store.append`` failpoint seam.
+
+        * ``error``/``panic`` raise (callers see the same OSError path
+          a full disk produces);
+        * ``delay`` stalls the write (slow disk);
+        * ``drop`` silently loses the record (a lying disk whose write
+          never lands — what the crash-recovery property suite guards
+          the replay contract against);
+        * ``duplicate`` appends the record twice under distinct seqs
+          (replay-side mid dedup absorbs it: at-least-once).
+        """
+        if failpoints.enabled:
+            act = failpoints.evaluate("ds.store.append", key=str(stream))
+            if act == "drop":
+                return 0
+            if act == "duplicate":
+                self._append_raw(stream, ts, data)
+        return self._append_raw(stream, ts, data)
+
+    def _append_raw(self, stream: int, ts: int, data: bytes) -> int:
         seq = self._lib.dslog_append(self._h, stream, ts, data, len(data))
         if seq < 0:
             raise OSError(f"dslog_append failed: {seq}")
+        if DsLog.recorder is not None:
+            DsLog.recorder.on_append(self._dir, stream, ts, seq, data)
         return seq
 
     def sync(self) -> None:
+        """fsync the current segment; the ``ds.store.sync`` failpoint
+        seam.  ``error`` exercises the group-commit gate's
+        park-and-retry path (PUBACKs stay parked until a sync lands);
+        ``drop`` skips the fsync while reporting success — the lying
+        disk the crashsim harness models; ``duplicate`` fsyncs twice
+        (idempotent)."""
+        if failpoints.enabled:
+            act = failpoints.evaluate("ds.store.sync", key=self._dir)
+            if act == "drop":
+                return
         rc = self._lib.dslog_sync(self._h)
         if rc != 0:
             raise OSError(f"dslog_sync failed: {rc}")
+        if DsLog.recorder is not None:
+            DsLog.recorder.on_sync(self._dir)
 
     def streams(self) -> list:
         cap = 1024
@@ -128,6 +184,14 @@ class DsLog:
 
     def stream_count(self, stream: int) -> int:
         return self._lib.dslog_stream_count(self._h, stream)
+
+    def corrupt_records(self) -> int:
+        """Estimated records in quarantined suffixes (interior CRC
+        breaks the recovery preserved instead of serving)."""
+        return self._lib.dslog_corrupt_records(self._h)
+
+    def quarantined_count(self) -> int:
+        return self._lib.dslog_quarantined_count(self._h)
 
     def gc(self, cutoff_ts: int) -> int:
         """Reclaim whole segments older than cutoff_ts (microseconds);

@@ -17,7 +17,7 @@ persistent session's filter matches it.
 
 from __future__ import annotations
 
-import json
+import logging
 import os
 import time
 from typing import Dict, List, Optional, Tuple
@@ -26,9 +26,13 @@ from .. import failpoints
 from .. import topic as T
 from ..engine import MatchEngine
 from ..message import Message
+from . import atomicio
 from .api import IterRef
 from .builtin_local import LocalStorage
+from .durability import SyncGate
 from .replication import rendezvous_pick
+
+log = logging.getLogger("emqx_tpu.ds")
 
 
 class SessionState:
@@ -89,7 +93,23 @@ class DurableSessions:
         n_streams: int = 16,
         store_qos0: bool = False,
         layout: str = "lts",
+        fsync: str = "interval",
     ) -> None:
+        # durability mode (config `durable.fsync`): `never` = no
+        # fsyncs, `interval` = periodic group flush off the broker
+        # tick, `always` = group-commit — QoS>=1 acks for captured
+        # messages park until the covering dslog_sync lands, ONE fsync
+        # amortized per dispatch window.  Metadata sidecars fsync on
+        # every write only in `always` (atomic replace + CRC apply in
+        # every mode).
+        self.fsync_mode = fsync
+        self.meta_fsync = fsync == "always"
+        # detected-corruption surface: events buffer here until the
+        # broker wires `on_corruption` (alarm + counter); counts feed
+        # sync_stats either way
+        self.on_corruption = None
+        self.corruption_events: List[Dict] = []
+        self.corruption_counts = {"storage": 0, "meta": 0}
         msg_dir = os.path.join(directory, "messages")
         os.makedirs(msg_dir, exist_ok=True)
         # the layout is a property of the DATA: records written under
@@ -99,24 +119,17 @@ class DurableSessions:
         # directories (older builds) are the hash layout — their
         # census.json gives them away.
         marker = os.path.join(msg_dir, "LAYOUT")
-        on_disk = None
-        try:
-            with open(marker) as f:
-                on_disk = f.read().strip()
-        except OSError:
-            if os.path.exists(os.path.join(msg_dir, "census.json")):
-                on_disk = "hash"
+        on_disk = self._read_layout_marker(marker, msg_dir)
         if on_disk and on_disk != layout:
-            import logging
-
-            logging.getLogger("emqx_tpu.ds").warning(
+            log.warning(
                 "durable layout pinned to %r by existing data "
                 "(config asked for %r)", on_disk, layout,
             )
             layout = on_disk
         if on_disk is None:
-            with open(marker, "w") as f:
-                f.write(layout)
+            atomicio.atomic_write_json(
+                marker, layout, fsync=self.meta_fsync
+            )
         self.layout = layout
         if layout == "lts":
             from .lts import LtsStorage
@@ -124,6 +137,20 @@ class DurableSessions:
             self.storage = LtsStorage(msg_dir)
         else:
             self.storage = LocalStorage(msg_dir, n_streams=n_streams)
+        self.storage.meta_fsync = self.meta_fsync
+        # adopt corruption the storage detected during ITS load, then
+        # route everything after through our reporter
+        for evt in self.storage.corruption_events:
+            self._report_corruption(**evt)
+        self.storage.corruption_events = []
+        self.storage.on_corruption = (
+            lambda evt: self._report_corruption(**evt)
+        )
+        # the group-commit fsync gate (see ds/durability.py): persist()
+        # advances its watermark, the broker's dispatch loop parks acks
+        # on it in `always` mode, the tick flushes through it in
+        # `interval` mode — so every fsync is counted/attributed once
+        self.gate = SyncGate(self.storage.sync_data)
         self.state_dir = os.path.join(directory, "sessions")
         os.makedirs(self.state_dir, exist_ok=True)
         self.store_qos0 = store_qos0
@@ -148,13 +175,22 @@ class DurableSessions:
         # checkpoint presence
         self._share_members: Dict[str, List[str]] = {}
         self._share_path = os.path.join(directory, "share_members.json")
-        try:
-            with open(self._share_path) as f:
+        # missing = fresh start; UNREADABLE = alarm + conservative
+        # fallback — the persisted registry is gone, but
+        # `shared_group_members` still unions every checkpointed
+        # subscriber, so stream assignment degrades to the
+        # checkpoint-derived membership instead of silently shrinking
+        # to nobody
+        obj = self._load_meta(self._share_path, "share membership")
+        if obj is not None:
+            try:
                 self._share_members = {
-                    k: list(v) for k, v in json.load(f).items()
+                    k: list(v) for k, v in obj.items()
                 }
-        except (OSError, json.JSONDecodeError):
-            pass
+            except (AttributeError, TypeError):
+                self._report_corruption(
+                    "meta", self._share_path, "not a members mapping"
+                )
         # GROUP-level consumed progress per (share filter, stream):
         # the emqx_ds_shared_sub leader's per-stream offsets.  Replay
         # never re-reads below it, so membership churn (a member
@@ -164,11 +200,18 @@ class DurableSessions:
         self._share_prog_path = os.path.join(
             directory, "share_progress.json"
         )
-        try:
-            with open(self._share_prog_path) as f:
-                self._share_progress = json.load(f)
-        except (OSError, json.JSONDecodeError):
-            pass
+        # UNREADABLE progress falls back to empty — which means
+        # "nothing consumed yet": replay restarts from disconnected_at,
+        # strictly MORE redelivery (at-least-once), never loss — with
+        # the alarm raised (the pre-PR silent `{}` reset looked
+        # identical to a fresh directory)
+        obj = self._load_meta(self._share_prog_path, "share progress")
+        if isinstance(obj, dict):
+            self._share_progress = obj
+        elif obj is not None:
+            self._report_corruption(
+                "meta", self._share_prog_path, "not a progress mapping"
+            )
         self._load_states()
 
     def boot_states(self) -> List[SessionState]:
@@ -176,6 +219,69 @@ class DurableSessions:
 
     def has_checkpoint(self, clientid: str) -> bool:
         return clientid in self._boot_states
+
+    # ---------------------------------------------------- meta/alarms
+
+    def _report_corruption(self, kind: str, path: str, detail: str,
+                           records: int = 0) -> None:
+        """ONE funnel for every detected-corruption event (storage
+        quarantine or unreadable sidecar): counted, logged, and either
+        delivered to the broker's alarm wiring or buffered for it to
+        drain after construction."""
+        self.corruption_counts[kind] = (
+            self.corruption_counts.get(kind, 0) + 1
+        )
+        log.error("ds %s corruption at %s: %s", kind, path, detail)
+        evt = {"kind": kind, "path": path, "detail": detail}
+        if records:
+            evt["records"] = records
+        if self.on_corruption is not None:
+            self.on_corruption(evt)
+        else:
+            self.corruption_events.append(evt)
+
+    def _load_meta(self, path: str, what: str):
+        """Load one sidecar: None for missing (fresh start) OR
+        unreadable — but the unreadable case is alarmed first, so the
+        conservative fallback is never silent."""
+        try:
+            return atomicio.load_json(path)
+        except FileNotFoundError:
+            return None
+        except atomicio.MetaCorruption as exc:
+            self._report_corruption("meta", exc.path, exc.detail)
+            return None
+
+    def _read_layout_marker(self, marker: str,
+                            msg_dir: str) -> Optional[str]:
+        """The LAYOUT pin: legacy markers are the raw layout string,
+        new ones the checksummed document.  Garbage content is
+        corruption — fall back to the pre-marker heuristic (a
+        census.json means the hash layout) rather than pinning the
+        directory to an unreadable value."""
+        try:
+            with open(marker) as f:
+                raw = f.read()
+        except OSError:
+            if os.path.exists(os.path.join(msg_dir, "census.json")):
+                return "hash"
+            return None
+        if raw.strip() in ("lts", "hash"):
+            return raw.strip()
+        try:
+            val = atomicio.loads_checked(raw, marker)
+        except atomicio.MetaCorruption as exc:
+            self._report_corruption("meta", exc.path, exc.detail)
+            val = None
+        if val in ("lts", "hash"):
+            return val
+        if val is not None:
+            self._report_corruption(
+                "meta", marker, f"unknown layout {val!r}"
+            )
+        if os.path.exists(os.path.join(msg_dir, "census.json")):
+            return "hash"
+        return None
 
     # ------------------------------------------------------------ gate
 
@@ -203,6 +309,10 @@ class DurableSessions:
                 batch.append(msg)
         if batch:
             self.storage.store_batch(batch)
+            # advance the group-commit watermark: the broker's
+            # dispatch barrier ("always" mode) parks this window's
+            # acks until a flush covers it
+            self.gate.mark_appended(len(batch))
             if self.beamformer.has_parked():
                 self.beamformer.notify({
                     self.storage.stream_key(m.topic) for m in batch
@@ -233,10 +343,10 @@ class DurableSessions:
             expiry=expiry,
             disconnected_at=now if now is not None else time.time(),
         )
-        tmp = self._state_path(clientid) + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(state.to_json(), f)
-        os.replace(tmp, self._state_path(clientid))
+        atomicio.atomic_write_json(
+            self._state_path(clientid), state.to_json(),
+            fsync=self.meta_fsync,
+        )
         # group progress rides the checkpoint cadence (see
         # _advance_share_progress)
         self._flush_share_progress()
@@ -272,10 +382,19 @@ class DurableSessions:
         for name in os.listdir(self.state_dir):
             if not name.endswith(".json"):
                 continue
+            path = os.path.join(self.state_dir, name)
+            obj = self._load_meta(path, "session checkpoint")
+            if obj is None:
+                continue  # already alarmed (missing is impossible:
+                # listdir just returned it)
             try:
-                with open(os.path.join(self.state_dir, name)) as f:
-                    state = SessionState.from_json(json.load(f))
-            except (OSError, ValueError, KeyError):
+                state = SessionState.from_json(obj)
+            except (ValueError, KeyError, TypeError):
+                # parseable-but-wrong schema: the checkpoint cannot be
+                # trusted — alarm, never silently pretend it was absent
+                self._report_corruption(
+                    "meta", path, "checkpoint schema unreadable"
+                )
                 continue
             self._boot_states[state.clientid] = state
             for flt in state.subs:
@@ -315,13 +434,39 @@ class DurableSessions:
         return self.storage.gc(cutoff_ts_us)
 
     def sync(self) -> None:
-        self.storage.sync()
+        """Full flush: group fsync (through the gate, so it is counted
+        and releases any parked acks) + metadata checkpoint."""
+        self.gate.sync_now()
+        self.checkpoint_meta()
+
+    def checkpoint_meta(self) -> None:
+        """Metadata checkpoint cadence (the broker tick): storage
+        caches (census / LTS index) + dirty share progress."""
+        self.storage.save_meta()
+        self._flush_share_progress()
+
+    def sync_soon(self) -> None:
+        """Interval-mode flush kick (asynchronous when a loop runs)."""
+        self.gate.sync_soon()
+
+    async def wait_durable(self) -> None:
+        """The dispatch loop's group-commit barrier (`always` mode)."""
+        await self.gate.wait_durable()
+
+    def sync_stats(self) -> Dict:
+        """The durability ops surface (/api/v5/nodes, ctl status,
+        /metrics gauges)."""
+        out = {"fsync": self.fsync_mode}
+        out.update(self.gate.stats())
+        out.update(self.storage.corruption_stats())
+        out["meta_corruption"] = self.corruption_counts.get("meta", 0)
+        return out
 
     def _save_share_members(self) -> None:
-        tmp = self._share_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(self._share_members, f)
-        os.replace(tmp, self._share_path)
+        atomicio.atomic_write_json(
+            self._share_path, self._share_members,
+            fsync=self.meta_fsync,
+        )
 
     def shared_join(self, share_flt: str, clientid: str) -> None:
         members = self._share_members.setdefault(share_flt, [])
@@ -355,10 +500,10 @@ class DurableSessions:
     def _flush_share_progress(self) -> None:
         if not getattr(self, "_share_prog_dirty", False):
             return
-        tmp = self._share_prog_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(self._share_progress, f)
-        os.replace(tmp, self._share_prog_path)
+        atomicio.atomic_write_json(
+            self._share_prog_path, self._share_progress,
+            fsync=self.meta_fsync,
+        )
         self._share_prog_dirty = False
 
     def shared_group_members(self, share_flt: str) -> List[str]:
@@ -654,10 +799,10 @@ class DurableSessions:
 
     def save_state(self, state: SessionState) -> None:
         """Persist a state object as-is (mid-replay checkpoint)."""
-        tmp = self._state_path(state.clientid) + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(state.to_json(), f)
-        os.replace(tmp, self._state_path(state.clientid))
+        atomicio.atomic_write_json(
+            self._state_path(state.clientid), state.to_json(),
+            fsync=self.meta_fsync,
+        )
 
     def replay(
         self, state: SessionState
@@ -672,4 +817,11 @@ class DurableSessions:
 
     def close(self) -> None:
         self._flush_share_progress()
+        try:
+            # clean shutdown leaves the log durable in every mode (a
+            # mode says how much a POWER CUT may take, not a shutdown)
+            self.gate.sync_now()
+        except Exception:
+            log.exception("final ds sync failed")
+        self.gate.stop()
         self.storage.close()

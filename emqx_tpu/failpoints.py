@@ -68,6 +68,9 @@ SEAMS = (
     "cluster.link.forward",
     "s3.request",
     "ds.replay.read",
+    "ds.store.append",
+    "ds.store.sync",
+    "ds.meta.write",
     "session.resume.commit",
     "cluster.quic.send",
     "cluster.quic.recv",

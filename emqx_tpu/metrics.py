@@ -93,6 +93,10 @@ METRICS = (
     "session.resume.busy",
     "session.replay.windows",
     "session.replay.messages",
+    "ds.sync.count",
+    "ds.sync.errors",
+    "ds.storage.corrupt_records",
+    "ds.meta.corruption",
     "session.takenover",
     "session.discarded",
     "session.terminated",

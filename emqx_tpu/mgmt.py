@@ -505,6 +505,10 @@ class MgmtApi:
             node["resume"] = self.broker.resume.info()
         if self.broker.olp.enabled:
             node["olp_level"] = self.broker.olp.level
+        if self.broker.durable is not None:
+            # durability contract surface: fsync mode, group-commit
+            # flush counters, unsynced/parked backlog, corruption
+            node["durability"] = self.broker.durable.sync_stats()
         ext = self.broker.external
         cluster = ext.info() if ext is not None else {}
         return _json({"data": [node], "cluster": cluster})
@@ -1176,6 +1180,18 @@ class MgmtApi:
                 continue
             emit("engine_" + name, "gauge", value,
                  help_text=f"match engine {name}")
+        # durable-store durability gauges (group-commit gate
+        # watermarks, parked ack-windows, quarantine counts)
+        if self.broker.durable is not None:
+            for name, value in sorted(
+                self.broker.durable.sync_stats().items()
+            ):
+                if not isinstance(value, (int, float)) or isinstance(
+                    value, bool
+                ):
+                    continue
+                emit("ds_" + name, "gauge", value,
+                     help_text=f"durable store {name}")
         # rule-engine columnar-eval gauges (lowered/fallback registry
         # split, matrix vs scalar window counts, per-cell cost EWMAs)
         for name, value in sorted(self.broker.rules.stats().items()):

@@ -241,7 +241,7 @@ class Profiler:
     STAGES = (
         "batch_wait", "prepare", "match_submit", "match_wait",
         "dispatch_wait", "replay_read", "expand", "decide", "deliver",
-        "assemble", "flush", "rules", "tokenize", "e2e",
+        "assemble", "flush", "rules", "tokenize", "ds_sync", "e2e",
     )
 
     def __init__(

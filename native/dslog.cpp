@@ -7,12 +7,34 @@
 // actually needs from its KV store: append message batches under a
 // (stream-id, timestamp) key, replay a stream from a timestamp in
 // order, survive restart (log is the source of truth; the index
-// rebuilds on open), detect torn/corrupt tails via CRC and truncate.
+// rebuilds on open), and recover from damage without silent loss.
 //
 // Layout: <dir>/seg-<n>.log, records are
 //   [u32 len][u32 crc32(payload)][u32 stream][u64 ts][u64 seq][payload]
 // A segment rolls at seg_bytes.  Readers use pread on the segment fd,
 // so appends and iteration don't contend.
+//
+// Crash/corruption contract (the PR 15 durability tentpole):
+//
+//   * TORN TAIL — a record whose extent reaches EOF but fails its CRC
+//     (or an incomplete header/payload at EOF) is the artifact of an
+//     append cut by a crash: it is truncated away, exactly as before.
+//   * INTERIOR CORRUPTION — a record that fails its CRC but whose
+//     extent ends BEFORE EOF was once intact and got flipped on disk
+//     (bit rot, a misdirected write).  The segment's suffix from that
+//     record on is QUARANTINED: never indexed, never truncated (the
+//     bytes are preserved on disk for forensics), never appended into
+//     (a quarantined final segment rolls to a fresh one on open), and
+//     never reclaimed by gc.  The intact prefix keeps serving.  The
+//     walkable-record estimate of the suffix accumulates in
+//     `corrupt_records` so the binding can raise the
+//     `ds_storage_corruption` alarm instead of losing data silently —
+//     the old behavior (truncate at first CRC break) destroyed the
+//     whole suffix with no trace.
+//   * fsync ordering — rolling fsyncs the outgoing segment before
+//     closing it and fsyncs the directory after creating a segment
+//     file, so one dslog_sync on the current fd covers every record
+//     appended since the previous sync, across rolls.
 //
 // C ABI (ctypes-friendly): all functions return >=0 on success,
 // negative errno-style codes on failure.
@@ -26,6 +48,7 @@
 #include <fcntl.h>
 #include <map>
 #include <mutex>
+#include <set>
 #include <string>
 #include <sys/stat.h>
 #include <sys/types.h>
@@ -36,6 +59,7 @@ namespace {
 
 constexpr uint32_t kHeaderLen = 4 + 4 + 4 + 8 + 8;
 constexpr uint64_t kDefaultSegBytes = 64ull << 20;
+constexpr uint32_t kMaxRecordLen = 128u << 20;
 
 uint32_t crc32_table[256];
 struct CrcInit {
@@ -74,6 +98,9 @@ struct Db {
   int cur_fd = -1;
   uint64_t cur_size = 0;
   uint64_t next_seq = 1;
+  // interior-corruption quarantine (see header comment)
+  int64_t corrupt_records = 0;
+  std::set<uint32_t> quarantined;
   std::mutex mu;
 
   ~Db() {
@@ -106,7 +133,27 @@ int open_segment_fd(Db& db, uint32_t seg) {
   return fd;
 }
 
-// scan one segment, filling the index; truncate a torn tail.
+// walk the unreadable suffix by its length fields to estimate how
+// many records it holds (>= 1; trailing unwalkable garbage counts 1).
+int64_t count_suffix_records(int fd, uint64_t off, uint64_t size) {
+  int64_t n = 0;
+  uint64_t o = off;
+  while (o + kHeaderLen <= size) {
+    uint32_t len;
+    if (pread(fd, &len, 4, o) != 4) break;
+    if (len > kMaxRecordLen || o + kHeaderLen + len > size) break;
+    n++;
+    o += kHeaderLen + len;
+  }
+  if (o < size || n == 0) n++;
+  return n;
+}
+
+// scan one segment, filling the index.  A torn TAIL (partial append
+// cut by a crash: damage reaching EOF) truncates as before; damage
+// with intact bytes written after it is interior corruption and
+// quarantines the suffix (kept on disk, not served) instead of
+// silently destroying it.
 int recover_segment(Db& db, uint32_t seg) {
   std::string path = seg_path(db, seg);
   int fd = open(path.c_str(), O_RDWR);
@@ -115,9 +162,17 @@ int recover_segment(Db& db, uint32_t seg) {
   if (fstat(fd, &st) != 0) { int e = -errno; close(fd); return e; }
   uint64_t size = (uint64_t)st.st_size, off = 0;
   std::vector<uint8_t> buf;
+  bool quarantine = false;
   while (off + kHeaderLen <= size) {
     uint8_t head[kHeaderLen];
-    if (pread(fd, head, kHeaderLen, off) != (ssize_t)kHeaderLen) break;
+    if (pread(fd, head, kHeaderLen, off) != (ssize_t)kHeaderLen) {
+      // the header lies within the file (loop guard) yet could not
+      // be read: an IO error (bad sector), not a torn write —
+      // truncating would destroy whatever intact data follows, so
+      // quarantine conservatively
+      quarantine = true;
+      break;
+    }
     uint32_t len, crc, stream;
     uint64_t ts, seq;
     memcpy(&len, head, 4);
@@ -125,24 +180,68 @@ int recover_segment(Db& db, uint32_t seg) {
     memcpy(&stream, head + 8, 4);
     memcpy(&ts, head + 12, 8);
     memcpy(&seq, head + 20, 8);
-    if (len > (128u << 20) || off + kHeaderLen + len > size) break;
+    if (len > kMaxRecordLen) {
+      // a complete header with an implausible length was flipped on
+      // disk (writev writes the header atomically enough that a torn
+      // append leaves a prefix, not garbage); bytes beyond the bare
+      // header mean data follows it — interior corruption
+      quarantine = size - off > kHeaderLen;
+      break;
+    }
+    if (off + kHeaderLen + len > size) break;  // extends past EOF: torn
     buf.resize(len);
-    if (pread(fd, buf.data(), len, off + kHeaderLen) != (ssize_t)len) break;
-    if (crc32(buf.data(), len) != crc) break;  // torn/corrupt tail
+    if (pread(fd, buf.data(), len, off + kHeaderLen) != (ssize_t)len) {
+      // extent is fully inside the file: a short/failed read is a
+      // bad sector, not a crash artifact — quarantine, never truncate
+      quarantine = true;
+      break;
+    }
+    if (crc32(buf.data(), len) != crc) {
+      // extent ends before EOF -> something intact was written after
+      // this record, so it was once valid: interior corruption.  At
+      // EOF it is the torn tail of the crashed append.
+      quarantine = off + kHeaderLen + len < size;
+      break;
+    }
     db.index[stream][{ts, seq}] =
         Entry{ts, seq, seg, off + kHeaderLen, len};
     if (seq >= db.next_seq) db.next_seq = seq + 1;
     off += kHeaderLen + len;
   }
   if (off < size) {
-    if (ftruncate(fd, (off_t)off) != 0) { int e = -errno; close(fd); return e; }
+    if (quarantine) {
+      db.corrupt_records += count_suffix_records(fd, off, size);
+      db.quarantined.insert(seg);
+    } else if (ftruncate(fd, (off_t)off) != 0) {
+      int e = -errno;
+      close(fd);
+      return e;
+    }
   }
   close(fd);
   return 0;
 }
 
+int fsync_dir(const std::string& dir) {
+  int dfd = open(dir.c_str(), O_RDONLY);
+  if (dfd < 0) return -errno;
+  int rc = fsync(dfd) != 0 ? -errno : 0;
+  close(dfd);
+  return rc;
+}
+
 int roll_segment(Db& db) {
   if (db.cur_fd >= 0) {
+    // sync-ordering invariant: the outgoing segment is fully durable
+    // before it becomes unreachable from dslog_sync (which only
+    // fsyncs cur_fd) — a group-commit sync after a roll must cover
+    // the records appended before it.  A FAILED flush here must fail
+    // the roll (and so the append): swallowing it would let a later
+    // dslog_sync on the fresh segment report success over un-flushed
+    // records — the "acked means durable" contract broken silently.
+    // State stays consistent for a retry: cur_fd remains the old
+    // segment and cur_size still exceeds seg_bytes.
+    if (fsync(db.cur_fd) != 0) return -errno;
     close(db.cur_fd);
     // also close any cached READ fd for the rolled segment (distinct
     // from cur_fd) before dropping it from the map — else it leaks
@@ -154,8 +253,10 @@ int roll_segment(Db& db) {
     db.cur_seg++;
   }
   std::string path = seg_path(db, db.cur_seg);
+  bool fresh = access(path.c_str(), F_OK) != 0;
   db.cur_fd = open(path.c_str(), O_CREAT | O_WRONLY | O_APPEND, 0644);
   if (db.cur_fd < 0) return -errno;
+  if (fresh) fsync_dir(db.dir);  // the dir entry must survive too
   struct stat st;
   fstat(db.cur_fd, &st);
   db.cur_size = (uint64_t)st.st_size;
@@ -187,11 +288,19 @@ void* dslog_open(const char* dir, uint64_t seg_bytes) {
     if (s > max_seg) max_seg = s;
   }
   db->cur_seg = segs.empty() ? 0 : max_seg;
+  if (db->quarantined.count(db->cur_seg)) {
+    // the final segment carries a quarantined suffix: appends must
+    // never land after unreadable bytes (recovery would quarantine
+    // them too) — start a fresh segment instead
+    db->cur_seg = max_seg + 1;
+  }
   // open current segment for append (without rolling past it)
   {
     std::string path = seg_path(*db, db->cur_seg);
+    bool fresh = access(path.c_str(), F_OK) != 0;
     db->cur_fd = open(path.c_str(), O_CREAT | O_WRONLY | O_APPEND, 0644);
     if (db->cur_fd < 0) { delete db; return nullptr; }
+    if (fresh) fsync_dir(db->dir);
     struct stat st;
     fstat(db->cur_fd, &st);
     db->cur_size = (uint64_t)st.st_size;
@@ -318,6 +427,9 @@ int64_t dslog_gc(void* h, uint64_t cutoff_ts) {
   for (auto& kv : seg_stat) {
     uint32_t seg = kv.first;
     if (seg == db.cur_seg || kv.second.first >= cutoff_ts) continue;
+    // a quarantined segment is preserved for forensics: its suffix's
+    // timestamps are unknowable, so age-based reclaim never applies
+    if (db.quarantined.count(seg)) continue;
     auto fdit = db.seg_fds.find(seg);
     if (fdit != db.seg_fds.end()) {
       if (fdit->second >= 0) close(fdit->second);
@@ -332,6 +444,21 @@ int64_t dslog_gc(void* h, uint64_t cutoff_ts) {
     reclaimed += kv.second.second;
   }
   return reclaimed;
+}
+
+// estimated record count across quarantined suffixes (corruption the
+// recovery detected and preserved instead of serving or destroying)
+int64_t dslog_corrupt_records(void* h) {
+  Db& db = *static_cast<Db*>(h);
+  std::lock_guard<std::mutex> lock(db.mu);
+  return db.corrupt_records;
+}
+
+// number of segments carrying a quarantined suffix
+int dslog_quarantined_count(void* h) {
+  Db& db = *static_cast<Db*>(h);
+  std::lock_guard<std::mutex> lock(db.mu);
+  return (int)db.quarantined.size();
 }
 
 // record count for a stream (for stats/tests)

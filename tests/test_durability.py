@@ -1,0 +1,532 @@
+"""Group-commit fsync durability: the SyncGate, the atomic metadata
+helper, the three new chaos seams (``ds.store.append`` /
+``ds.store.sync`` / ``ds.meta.write``), and the broker-level "acked
+means durable" contract — a sync fault mid-window keeps PUBACKs parked
+and retried, concurrent windows coalesce onto one flush, and detected
+corruption surfaces as alarms + counters on every ops plane."""
+
+import asyncio
+import os
+import time
+
+import pytest
+
+from emqx_tpu import failpoints as fp
+from emqx_tpu.config import BrokerConfig, ListenerConfig, check_config
+from emqx_tpu.ds import atomicio
+from emqx_tpu.ds.durability import SyncGate
+from emqx_tpu.ds.persist import DurableSessions
+from emqx_tpu.message import Message
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    fp.clear()
+    yield
+    fp.clear()
+
+
+# ------------------------------------------------------------ SyncGate
+
+
+def test_gate_watermarks_and_sync_now():
+    flushed = []
+    gate = SyncGate(lambda: flushed.append(1))
+    assert not gate.dirty
+    gate.sync_now()
+    assert flushed == []  # nothing unsynced: no disk touch
+    gate.mark_appended(3)
+    assert gate.dirty and gate.unsynced == 3
+    gate.sync_now()
+    assert flushed == [1]
+    assert not gate.dirty and gate.sync_count == 1
+    gate.sync_now()
+    assert flushed == [1]  # idempotent
+
+
+def test_gate_wait_durable_coalesces_windows():
+    """N concurrent windows parked on the gate are released by at most
+    two flushes (one in flight + one covering the stragglers) — the
+    group-commit amortization claim."""
+    calls = []
+
+    def slow_sync():
+        calls.append(1)
+        time.sleep(0.02)
+
+    gate = SyncGate(slow_sync)
+
+    async def main():
+        async def window(i):
+            gate.mark_appended(1)
+            await gate.wait_durable()
+
+        await asyncio.gather(*(window(i) for i in range(16)))
+
+    run(main())
+    assert len(calls) <= 3
+    assert gate.parked == 0 and not gate.dirty
+
+
+def test_gate_fault_keeps_waiters_parked_and_retries():
+    boom = [3]
+
+    def flaky_sync():
+        if boom[0] > 0:
+            boom[0] -= 1
+            raise OSError("disk on fire")
+
+    gate = SyncGate(flaky_sync)
+    errors = []
+    gate.on_error = errors.append
+
+    async def main():
+        gate.mark_appended(1)
+        t0 = time.monotonic()
+        await asyncio.wait_for(gate.wait_durable(), timeout=5)
+        return time.monotonic() - t0
+
+    elapsed = run(main())
+    # three failed rounds back off 0.05 + 0.1 + 0.2 before the flush
+    assert elapsed > 0.3
+    assert gate.sync_errors == 3 and len(errors) == 3
+    assert gate.sync_count == 1 and not gate.dirty
+
+
+def test_gate_wait_returns_immediately_when_clean():
+    gate = SyncGate(lambda: (_ for _ in ()).throw(AssertionError))
+
+    async def main():
+        await asyncio.wait_for(gate.wait_durable(), timeout=1)
+
+    run(main())  # no append: never touches the disk
+
+
+def test_gate_stop_cancels_parked_windows():
+    gate = SyncGate(lambda: time.sleep(10))
+
+    async def main():
+        gate.mark_appended(1)
+        loop = asyncio.get_running_loop()
+        with gate._lock:
+            fut = loop.create_future()
+            gate._waiters.append((gate._appended, fut))
+        gate.stop()
+        assert fut.cancelled()
+
+    run(main())
+
+
+# ------------------------------------------------------------ atomicio
+
+
+def test_atomic_write_round_trip(tmp_path):
+    p = str(tmp_path / "meta.json")
+    atomicio.atomic_write_json(p, {"a": [1, 2], "b": "x"})
+    assert atomicio.load_json(p) == {"a": [1, 2], "b": "x"}
+    # no staging leftovers
+    assert not os.path.exists(p + ".tmp")
+
+
+def test_legacy_raw_json_still_loads(tmp_path):
+    p = str(tmp_path / "legacy.json")
+    with open(p, "w") as f:
+        f.write('{"k": 1}')
+    assert atomicio.load_json(p) == {"k": 1}
+
+
+def test_missing_vs_unreadable_are_distinct(tmp_path):
+    p = str(tmp_path / "gone.json")
+    with pytest.raises(FileNotFoundError):
+        atomicio.load_json(p)
+    atomicio.atomic_write_json(p, {"k": 1})
+    doc = open(p).read()
+    # torn write: any strict prefix must be detected, never parsed
+    # into an empty default
+    for cut in (1, len(doc) // 2, len(doc) - 1):
+        with open(p, "w") as f:
+            f.write(doc[:cut])
+        with pytest.raises(atomicio.MetaCorruption):
+            atomicio.load_json(p)
+
+
+def test_crc_detects_bit_rot(tmp_path):
+    p = str(tmp_path / "meta.json")
+    atomicio.atomic_write_json(p, {"progress": [123, 456]})
+    doc = open(p).read()
+    flipped = doc.replace("123", "124")
+    assert flipped != doc
+    with open(p, "w") as f:
+        f.write(flipped)
+    with pytest.raises(atomicio.MetaCorruption):
+        atomicio.load_json(p)
+
+
+def test_meta_write_failpoint_actions(tmp_path):
+    p = str(tmp_path / "meta.json")
+    atomicio.atomic_write_json(p, {"v": 1})
+    # error: raises BEFORE touching anything — old content survives
+    fp.configure("ds.meta.write", "error")
+    with pytest.raises(fp.FailpointError):
+        atomicio.atomic_write_json(p, {"v": 2})
+    assert atomicio.load_json(p) == {"v": 1}
+    # drop: the write is silently lost (rename never persisted)
+    fp.configure("ds.meta.write", "drop")
+    atomicio.atomic_write_json(p, {"v": 3})
+    assert atomicio.load_json(p) == {"v": 1}
+    # duplicate: idempotent
+    fp.configure("ds.meta.write", "duplicate")
+    atomicio.atomic_write_json(p, {"v": 4})
+    fp.clear()
+    assert atomicio.load_json(p) == {"v": 4}
+
+
+# ------------------------------------------------- store chaos seams
+
+
+def _mk_ds(tmp_path, mode="always", layout="hash"):
+    ds = DurableSessions(
+        str(tmp_path / "ds"), layout=layout, fsync=mode
+    )
+    ds.add_filter("t/#")
+    return ds
+
+
+def _msg(i, t=None):
+    return Message(
+        topic=f"t/{i}", payload=b"p%d" % i, qos=1,
+        timestamp=t if t is not None else time.time(),
+    )
+
+
+def test_append_error_fails_persist_not_silently(tmp_path):
+    ds = _mk_ds(tmp_path)
+    fp.configure("ds.store.append", "error")
+    with pytest.raises(OSError):
+        ds.persist([_msg(0)])
+    fp.clear()
+    ds.persist([_msg(1)])
+    assert ds.storage.stats()["messages"] == 1
+    ds.close()
+
+
+def test_append_drop_models_lying_disk(tmp_path):
+    """`drop` silently loses the record — exactly the failure class
+    the crash-point suite (and the always-mode sync barrier) exists
+    to bound; at the storage surface the loss is at least visible in
+    the record count."""
+    ds = _mk_ds(tmp_path)
+    fp.configure("ds.store.append", "drop")
+    ds.persist([_msg(0)])
+    fp.clear()
+    assert ds.storage.stats()["messages"] == 0
+    ds.close()
+
+
+def test_append_duplicate_deduped_by_replay(tmp_path):
+    ds = _mk_ds(tmp_path)
+    t0 = time.time()
+    ds.save("c1", {"t/#": {"qos": 1}}, expiry=3600.0, now=t0)
+    fp.configure("ds.store.append", "duplicate")
+    ds.persist([_msg(0, t=t0 + 1)])
+    fp.clear()
+    # two records on disk (at-least-once)...
+    assert ds.storage.stats()["messages"] == 2
+    ds.close()
+    # ...ONE delivery after the replay mid-dedup (reboot restores the
+    # checkpoint as a boot state)
+    ds2 = DurableSessions(str(tmp_path / "ds"), layout="hash",
+                          fsync="always")
+    state = ds2.load("c1")
+    assert state is not None
+    got = ds2.replay(state)
+    assert len(got) == 1
+    ds2.close()
+
+
+def test_sync_error_propagates_and_gate_counts(tmp_path):
+    ds = _mk_ds(tmp_path)
+    ds.persist([_msg(0)])
+    fp.configure("ds.store.sync", "error")
+    with pytest.raises(OSError):
+        ds.gate.sync_now()
+    assert ds.gate.sync_errors == 1 and ds.gate.dirty
+    fp.clear()
+    ds.gate.sync_now()
+    assert not ds.gate.dirty
+    ds.close()
+
+
+def test_meta_write_fault_keeps_old_checkpoint(tmp_path):
+    ds = _mk_ds(tmp_path)
+    t0 = time.time()
+    ds.save("c1", {"t/#": {"qos": 1}}, expiry=3600.0, now=t0)
+    fp.configure("ds.meta.write", "error", match="sessions")
+    with pytest.raises(fp.FailpointError):
+        ds.save("c1", {"t/#": {"qos": 1}, "u/#": {"qos": 1}},
+                expiry=3600.0, now=t0 + 5)
+    fp.clear()
+    # the old checkpoint survived the failed replace
+    obj = atomicio.load_json(ds._state_path("c1"))
+    assert obj["disconnected_at"] == t0
+    assert set(obj["subs"]) == {"t/#"}
+    ds.close()
+
+
+# ------------------------------------------- corruption surfacing
+
+
+def test_share_progress_corruption_alarms_not_silent(tmp_path):
+    d = str(tmp_path / "ds")
+    ds = DurableSessions(d, layout="hash", fsync="interval")
+    ds._share_progress = {"$share/g/t/#": {"0": [5, 5]}}
+    ds._share_prog_dirty = True
+    ds._flush_share_progress()
+    ds.close()
+    # tear the file (power fail without fsync)
+    p = os.path.join(d, "share_progress.json")
+    doc = open(p).read()
+    with open(p, "w") as f:
+        f.write(doc[: len(doc) // 2])
+    ds2 = DurableSessions(d, layout="hash", fsync="interval")
+    # conservative fallback: EMPTY progress (replay from the
+    # checkpoint: at-least-once), with the corruption counted —
+    # the pre-PR code reset silently
+    assert ds2._share_progress == {}
+    assert ds2.corruption_counts["meta"] >= 1
+    assert any(
+        e["path"].endswith("share_progress.json")
+        for e in ds2.corruption_events
+    )
+    ds2.close()
+
+
+def test_share_members_corruption_falls_back_to_checkpoints(tmp_path):
+    d = str(tmp_path / "ds")
+    ds = DurableSessions(d, layout="hash", fsync="interval")
+    flt = "$share/g/t/#"
+    ds.save("m1", {flt: {"qos": 1}}, expiry=3600.0)
+    ds.shared_join(flt, "m1")
+    ds.shared_join(flt, "m2")
+    ds.close()
+    p = os.path.join(d, "share_members.json")
+    with open(p, "w") as f:
+        f.write("{torn")
+    ds2 = DurableSessions(d, layout="hash", fsync="interval")
+    assert ds2.corruption_counts["meta"] >= 1
+    # the checkpointed member is still derivable (conservative union)
+    assert "m1" in ds2.shared_group_members(flt)
+    ds2.close()
+
+
+def test_storage_quarantine_reports_through_sessions(tmp_path):
+    d = str(tmp_path / "ds")
+    ds = DurableSessions(d, layout="hash", fsync="interval")
+    t0 = time.time()
+    ds.add_filter("t/#")
+    for i in range(6):
+        ds.persist([_msg(i, t=t0 + i)])
+    ds.sync()
+    ds.close()
+    # interior flip in the one stream's segment
+    msgdir = os.path.join(d, "messages")
+    seg = next(
+        os.path.join(msgdir, n) for n in sorted(os.listdir(msgdir))
+        if n.startswith("seg-")
+    )
+    with open(seg, "r+b") as f:
+        f.seek(28 + 2)
+        b = f.read(1)
+        f.seek(28 + 2)
+        f.write(bytes((b[0] ^ 0xFF,)))
+    ds2 = DurableSessions(d, layout="hash", fsync="interval")
+    stats = ds2.sync_stats()
+    assert stats["corrupt_records"] >= 1
+    assert stats["quarantined_segments"] == 1
+    assert ds2.corruption_counts["storage"] >= 1
+    assert any(
+        e["kind"] == "storage" for e in ds2.corruption_events
+    )
+    ds2.close()
+
+
+# --------------------------------------------------- config bounds
+
+
+def test_check_config_bounds_for_fsync_keys():
+    cfg = BrokerConfig()
+    cfg.durable.fsync = "sometimes"
+    assert any("durable.fsync" in p for p in check_config(cfg))
+    cfg.durable.fsync = "always"
+    cfg.durable.fsync_interval = 0.0
+    assert any("fsync_interval" in p for p in check_config(cfg))
+    cfg.durable.fsync_interval = 5.0
+    assert not check_config(cfg)
+
+
+# ------------------------------------------- broker group commit
+
+
+def _srv_cfg(tmp_path, mode):
+    cfg = BrokerConfig()
+    cfg.engine.use_device = False
+    cfg.listeners = [ListenerConfig(port=0)]
+    cfg.durable.enable = True
+    cfg.durable.data_dir = str(tmp_path / "ds")
+    cfg.durable.fsync = mode
+    return cfg
+
+
+async def _persistent_sub(port, cid="psub"):
+    from mqtt_client import TestClient
+
+    sub = TestClient(port, cid)
+    await sub.connect(
+        clean_start=True,
+        properties={"session_expiry_interval": 3600},
+    )
+    await sub.subscribe("dur/+/q", qos=1)
+    return sub
+
+
+def test_broker_always_mode_parks_acks_until_flush(tmp_path):
+    """The tentpole contract end to end: QoS1 publishes whose
+    messages the persistence gate captures PUBACK only after the
+    covering dslog_sync; concurrent publishes coalesce onto a handful
+    of flushes; everything acked is on disk."""
+    from emqx_tpu.broker.listener import BrokerServer
+    from mqtt_client import TestClient
+
+    async def main():
+        srv = BrokerServer(_srv_cfg(tmp_path, "always"))
+        await srv.start()
+        try:
+            port = srv.listeners[0].port
+            broker = srv.broker
+            sub = await _persistent_sub(port)
+            pub = TestClient(port, "pub")
+            await pub.connect()
+            base = broker.durable.gate.sync_count
+            acks = await asyncio.gather(*(
+                pub.publish(f"dur/{i}/q", b"x", qos=1, timeout=10)
+                for i in range(16)
+            ))
+            assert all(a is not None for a in acks)
+            synced = broker.durable.gate.sync_count - base
+            # at least one flush happened; the 16 acks did NOT cost 16
+            assert 1 <= synced < 16
+            assert not broker.durable.gate.dirty  # acked => flushed
+            assert broker.metrics.val("ds.sync.count") >= 1
+            # the captured copies are all on disk
+            assert broker.durable.storage.stats()["messages"] == 16
+            for i in range(16):
+                await sub.recv_publish(timeout=5)
+            await pub.disconnect()
+            await sub.disconnect()
+        finally:
+            await srv.stop()
+
+    run(main())
+
+
+def test_broker_sync_fault_parks_puback_and_retries(tmp_path):
+    """`ds.store.sync=error` mid-window: the PUBACK stays parked
+    while the gate retries with backoff, and releases (without
+    publisher disconnect) once the disk recovers."""
+    from emqx_tpu.broker.listener import BrokerServer
+    from mqtt_client import TestClient
+
+    async def main():
+        srv = BrokerServer(_srv_cfg(tmp_path, "always"))
+        await srv.start()
+        try:
+            port = srv.listeners[0].port
+            broker = srv.broker
+            sub = await _persistent_sub(port)
+            pub = TestClient(port, "pub")
+            await pub.connect()
+            # fail the next 3 fsyncs, then recover
+            fp.configure("ds.store.sync", "error", times=3)
+            t0 = time.monotonic()
+            ack = await pub.publish("dur/0/q", b"x", qos=1, timeout=10)
+            elapsed = time.monotonic() - t0
+            assert ack is not None
+            # three failed rounds backed off before the ack released
+            assert elapsed > 0.3, elapsed
+            assert broker.durable.gate.sync_errors >= 3
+            assert broker.metrics.val("ds.sync.errors") >= 3
+            assert not broker.durable.gate.dirty
+            await sub.recv_publish(timeout=5)
+            await pub.disconnect()
+            await sub.disconnect()
+        finally:
+            await srv.stop()
+            fp.clear()
+
+    run(main())
+
+
+def test_broker_interval_mode_acks_before_flush(tmp_path):
+    """`interval` keeps today's latency: the PUBACK does not wait on
+    the disk (the tick flushes on its own cadence)."""
+    from emqx_tpu.broker.listener import BrokerServer
+    from mqtt_client import TestClient
+
+    async def main():
+        srv = BrokerServer(_srv_cfg(tmp_path, "interval"))
+        await srv.start()
+        try:
+            port = srv.listeners[0].port
+            broker = srv.broker
+            sub = await _persistent_sub(port)
+            pub = TestClient(port, "pub")
+            await pub.connect()
+            # a sync fault cannot delay interval-mode acks
+            fp.configure("ds.store.sync", "error")
+            ack = await pub.publish("dur/0/q", b"x", qos=1, timeout=5)
+            assert ack is not None
+            assert broker.durable.gate.dirty  # flush owed, ack free
+            fp.clear()
+            broker.durable.sync_soon()
+            await asyncio.sleep(0.05)
+            assert not broker.durable.gate.dirty
+            await pub.disconnect()
+            await sub.disconnect()
+        finally:
+            await srv.stop()
+            fp.clear()
+
+    run(main())
+
+
+def test_broker_nodes_api_and_ctl_surface_durability(tmp_path):
+    from api_helper import auth_session
+    from emqx_tpu.broker.listener import BrokerServer
+    from emqx_tpu.config import ApiConfig
+
+    async def main():
+        cfg = _srv_cfg(tmp_path, "always")
+        cfg.api = ApiConfig(enable=True, port=0)
+        srv = BrokerServer(cfg)
+        await srv.start()
+        try:
+            http, api = await auth_session(srv)
+            async with http:
+                async with http.get(api + "/api/v5/nodes") as r:
+                    node = (await r.json())["data"][0]
+                assert node["durability"]["fsync"] == "always"
+                assert "unsynced" in node["durability"]
+                assert "corrupt_records" in node["durability"]
+                async with http.get(api + "/metrics") as r:
+                    text = await r.text()
+                assert "emqx_ds_unsynced" in text
+                assert "emqx_ds_sync_count" in text
+                assert "emqx_profiler_ds_sync_us" in text
+        finally:
+            await srv.stop()
+
+    run(main())

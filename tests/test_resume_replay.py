@@ -43,6 +43,7 @@ from emqx_tpu import failpoints as fp
 from emqx_tpu.broker.broker import Broker
 from emqx_tpu.broker.channel import Channel
 from emqx_tpu.broker.resume import ResumeBusy
+from emqx_tpu.ds import atomicio
 from emqx_tpu.broker.session import SubOpts
 from emqx_tpu.codec import mqtt as C
 from emqx_tpu.config import BrokerConfig, check_config
@@ -403,12 +404,12 @@ def test_disconnect_mid_replay_keeps_checkpoint_then_resumes(tmp_path):
     b.resume.drain_once()  # partial: 5 of 40
     assert b.resume.pending("m0")
     state_path = b.durable._state_path("m0")
-    before = json.load(open(state_path))
+    before = atomicio.load_json(state_path)
     b.cm.disconnect("m0", ch1)
     b.channel_disconnected("m0")
     # checkpoint NOT overwritten with a fresh disconnected_at (that
     # would skip the un-replayed tail after a restart)
-    after = json.load(open(state_path))
+    after = atomicio.load_json(state_path)
     assert after == before
     assert b.durable.has_checkpoint("m0")
     info = b.resume.info()
@@ -706,13 +707,13 @@ def test_mid_replay_subscribe_survives_in_checkpoint(tmp_path):
     assert present
     ch.send_packets(session.resume())
     b.resume.drain_once()  # partial
-    before = json.load(open(b.durable._state_path("w0")))
+    before = atomicio.load_json(b.durable._state_path("w0"))
     opts = SubOpts(qos=1)
     session.subscribe("extra/#", opts)
     b.subscribe("w0", "extra/#", opts)
     b.cm.disconnect("w0", ch)
     b.channel_disconnected("w0")
-    after = json.load(open(b.durable._state_path("w0")))
+    after = atomicio.load_json(b.durable._state_path("w0"))
     assert "extra/#" in after["subs"]  # the live change persisted
     assert after["disconnected_at"] == before["disconnected_at"]
     assert "iters" not in after  # never the advanced in-memory cursors

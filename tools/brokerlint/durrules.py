@@ -1,0 +1,148 @@
+"""Metadata-durability rule (DUR701).
+
+PR 15 made every DS metadata sidecar go through ONE write path —
+``emqx_tpu.ds.atomicio.atomic_write_json`` (tmp + fsync +
+``os.replace`` + dir fsync, CRC trailer, the ``ds.meta.write``
+failpoint seam).  The failure mode it closes: a bare
+``open(path, "w")`` / ``json.dump`` leaves a torn file at power fail,
+and the old ``except ...: {}`` loaders silently reset replay progress
+— acked QoS1 backlogs gone with no alarm.  This rule keeps the unsafe
+pattern from coming back.
+
+Scope: every module under ``emqx_tpu/ds/`` — the package that owns the
+sidecars and every module reachable from the
+``SEAM_FUNCS["ds.meta.write"]`` helper (the seam and all its callers
+live in this package; the path scope is the static, drift-free way to
+say so).
+
+Findings:
+
+  * ``open(<path>, "w")`` (or any write/append text mode) where the
+    path expression is not visibly a ``*.tmp`` staging file — metadata
+    must go through the atomic-write helper.  "Visibly tmp" is
+    intentionally syntactic: a ``... + ".tmp"`` concatenation, a
+    string literal / f-string ending in ``.tmp``, or a name/attribute
+    whose spelling contains ``tmp``.  (The helper's own staging write
+    passes this test; anything else takes a justified
+    ``# brokerlint: ignore[DUR701]``.)
+  * ``json.dump(obj, open(<non-tmp path>, "w"))`` — the inlined form
+    of the same mistake.
+
+Binary log writes (``"wb"`` etc.) are the storage engine's own domain
+(native dslog) and are not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import ModuleContext, dotted_name
+
+_DS_PATH_MARKER = "emqx_tpu/ds/"
+
+
+def _is_write_mode(call: ast.Call) -> str:
+    """The text-write mode string of an ``open`` call, or ''."""
+    if not (isinstance(call.func, ast.Name) and call.func.id == "open"):
+        return ""
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if not isinstance(mode, ast.Constant) or not isinstance(
+        mode.value, str
+    ):
+        return ""
+    m = mode.value
+    if "b" in m:
+        return ""  # binary: the log engine's domain, not a sidecar
+    return m if ("w" in m or "a" in m or "x" in m) else ""
+
+
+def _looks_tmp(node: ast.AST) -> bool:
+    """Is this path expression visibly a .tmp staging file?"""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, str) and node.value.endswith(".tmp")
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        return _looks_tmp(node.right) or _looks_tmp(node.left)
+    if isinstance(node, ast.JoinedStr):
+        vals = node.values
+        return bool(vals) and _looks_tmp(vals[-1])
+    if isinstance(node, ast.Name):
+        return "tmp" in node.id.lower()
+    if isinstance(node, ast.Attribute):
+        return "tmp" in node.attr.lower()
+    if isinstance(node, ast.Call):
+        # os.path.join(..., x): judge by the last component
+        if dotted_name(node.func).endswith("join") and node.args:
+            return _looks_tmp(node.args[-1])
+    return False
+
+
+def _qual_spans(tree: ast.Module):
+    """(lineno, end_lineno, qualname) for every function, for
+    enclosing-context naming."""
+    spans = []
+
+    def walk(node, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                walk(child, f"{prefix}{child.name}.")
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                spans.append((
+                    child.lineno,
+                    getattr(child, "end_lineno", child.lineno)
+                    or child.lineno,
+                    f"{prefix}{child.name}",
+                ))
+                walk(child, f"{prefix}{child.name}.")
+
+    walk(tree, "")
+    return spans
+
+
+def _qualname_at(spans, line: int) -> str:
+    best, best_width = "<module>", None
+    for lo, hi, q in spans:
+        if lo <= line <= hi and (best_width is None
+                                 or hi - lo <= best_width):
+            best, best_width = q, hi - lo
+    return best
+
+
+def _report(ctx: ModuleContext, spans, node: ast.AST,
+            what: str) -> None:
+    ctx.report(
+        node, "DUR701",
+        _qualname_at(spans, getattr(node, "lineno", 1)),
+        f"{what} to a non-.tmp path inside emqx_tpu/ds/ — metadata "
+        "sidecars must go through ds.atomicio.atomic_write_json "
+        "(atomic replace + fsync + CRC; the ds.meta.write seam)",
+        detail=what,
+    )
+
+
+def check(ctx: ModuleContext) -> None:
+    if _DS_PATH_MARKER not in ctx.path.replace("\\", "/"):
+        return
+    spans = _qual_spans(ctx.tree)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        mode = _is_write_mode(node)
+        if mode and node.args and not _looks_tmp(node.args[0]):
+            _report(ctx, spans, node, f'open(..., "{mode}")')
+            continue
+        if dotted_name(node.func).endswith("json.dump"):
+            # only the inlined open(...) form is judged here — a
+            # file-object variable was already judged at its open()
+            if len(node.args) >= 2 and isinstance(
+                node.args[1], ast.Call
+            ):
+                inner = node.args[1]
+                if _is_write_mode(inner) and inner.args and \
+                        not _looks_tmp(inner.args[0]):
+                    _report(ctx, spans, node, "json.dump")

@@ -291,11 +291,13 @@ def _run_file_checks(ctx: ModuleContext,
                      seams: Optional[Sequence],
                      dispatch: Optional[Sequence]) -> None:
     from . import (
-        asyncrules, devicerules, failpointrules, obsrules, perfrules,
+        asyncrules, devicerules, durrules, failpointrules, obsrules,
+        perfrules,
     )
 
     asyncrules.check(ctx)
     devicerules.check(ctx)
+    durrules.check(ctx)
     failpointrules.check(
         ctx, failpointrules.SEAM_FUNCS if seams is None else seams
     )

@@ -63,6 +63,10 @@ SEAM_FUNCS: Tuple[Seam, ...] = (
     Seam("emqx_tpu/s3.py", "S3Client._request", "s3.request"),
     Seam("emqx_tpu/ds/persist.py", "DurableSessions._replay_read",
          "ds.replay.read"),
+    Seam("emqx_tpu/ds/native.py", "DsLog.append", "ds.store.append"),
+    Seam("emqx_tpu/ds/native.py", "DsLog.sync", "ds.store.sync"),
+    Seam("emqx_tpu/ds/atomicio.py", "atomic_write_json",
+         "ds.meta.write"),
     Seam("emqx_tpu/broker/resume.py", "ResumeScheduler._commit",
          "session.resume.commit"),
     Seam("emqx_tpu/cluster/quic_transport.py",

@@ -1,0 +1,17 @@
+"""Crash-point simulation harness for the DS durability contract.
+
+See sim.py: a recording layer under the storage writes (the
+``ds.store.append`` / ``ds.store.sync`` / ``ds.meta.write`` seams'
+journaling taps) plus a materializer that can rebuild the on-disk
+state at ANY crash point of a recorded write trace — un-fsynced
+suffixes dropped, records torn mid-write at byte granularity, and
+metadata rename outcomes enumerated (old kept / staging file partial /
+replaced-but-torn) — so `tests/test_crash_recovery.py` can boot a
+fresh broker on every materialized prefix and assert the recovery
+invariants (ALICE, Pillai et al. OSDI '14; CrashMonkey, Mohan et al.
+OSDI '18).
+"""
+
+from .sim import CrashRecorder, Op, materialize, sync_covered_index
+
+__all__ = ["CrashRecorder", "Op", "materialize", "sync_covered_index"]

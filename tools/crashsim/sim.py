@@ -1,0 +1,229 @@
+"""Write-trace recording + crash-state materialization.
+
+The method is the crash-consistency literature's (ALICE, OSDI '14):
+capture the logical write trace of a workload, then for every crash
+point materialize the on-disk state a power failure there could have
+left, boot recovery on it, and assert invariants.  The trace is
+captured at the SAME seams chaos runs use — `DsLog` journals every
+open/append/sync through its class-level ``recorder`` hook, the
+atomic-write helper journals every completed metadata replace — so
+what the simulator replays is exactly what the broker wrote.
+
+Crash-state model (the legal-states envelope we enumerate):
+
+  * the dslog segment files are append-only and written sequentially,
+    so a crash persists a PREFIX of the append trace, with the record
+    at the cut possibly torn at any byte boundary (``torn_bytes``);
+    enumerating every prefix subsumes every "suffix beyond the last
+    fsync lost" state and is strictly more adversarial (it also
+    covers losing suffixes that HAD been fsynced — recovery must
+    merely never lose what the workload's acks claim);
+  * a metadata write (tmp + rename) at the cut can land as: nothing
+    (old file kept — rename not persisted), the staging ``.tmp`` file
+    holding a partial document next to the old file, or — the
+    no-fsync power-fail case the CRC trailer exists for — the rename
+    persisted with TORN content (``meta_variant="replaced-torn"``);
+  * cross-file reordering: a metadata write in the un-fsynced tail
+    may be lost while LATER appends persist (``skip_meta_index``) —
+    the ALICE reordering case that matters here, since sidecars and
+    the log live in different files.
+
+`sync_covered_index` maps a crash point to the last fsync the prefix
+completed, which is what the workload's ack ledger is keyed by: in
+``always`` mode a PUBACK exists only for messages a completed sync
+covers, so "zero acked loss at every crash point" is assertable
+purely from the trace.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from typing import List, NamedTuple, Optional
+
+_HDR = struct.Struct("<IIIQQ")  # len, crc32, stream, ts, seq
+_DEFAULT_SEG_BYTES = 64 << 20
+
+
+class Op(NamedTuple):
+    kind: str            # "open" | "append" | "sync" | "meta"
+    path: str            # dir (open/append/sync) or file path (meta)
+    stream: int = 0
+    ts: int = 0
+    seq: int = 0
+    data: bytes = b""    # record payload / final meta document
+    seg_bytes: int = 0   # open only
+    fsynced: bool = False  # meta only
+
+
+class CrashRecorder:
+    """Install on the live seams, run a workload, keep the trace."""
+
+    def __init__(self) -> None:
+        self.ops: List[Op] = []
+
+    # ------------------------------------------------- seam callbacks
+
+    def on_open(self, directory: str, seg_bytes: int) -> None:
+        self.ops.append(Op("open", directory, seg_bytes=seg_bytes))
+
+    def on_append(self, directory: str, stream: int, ts: int,
+                  seq: int, data: bytes) -> None:
+        self.ops.append(
+            Op("append", directory, stream=stream, ts=ts, seq=seq,
+               data=bytes(data))
+        )
+
+    def on_sync(self, directory: str) -> None:
+        self.ops.append(Op("sync", directory))
+
+    def on_meta(self, path: str, content: bytes,
+                fsynced: bool) -> None:
+        self.ops.append(Op("meta", path, data=content, fsynced=fsynced))
+
+    # ------------------------------------------------------- install
+
+    def install(self) -> None:
+        from emqx_tpu.ds import atomicio
+        from emqx_tpu.ds.native import DsLog
+
+        DsLog.recorder = self
+        atomicio.recorder = self
+
+    def uninstall(self) -> None:
+        from emqx_tpu.ds import atomicio
+        from emqx_tpu.ds.native import DsLog
+
+        DsLog.recorder = None
+        atomicio.recorder = None
+
+    def __enter__(self) -> "CrashRecorder":
+        self.install()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+
+def encode_record(op: Op) -> bytes:
+    """The exact native/dslog.cpp on-disk record for an append op."""
+    return _HDR.pack(
+        len(op.data), zlib.crc32(op.data), op.stream, op.ts, op.seq
+    ) + op.data
+
+
+def sync_covered_index(ops: List[Op], crash_at: int) -> int:
+    """Index of the last sync op the crash prefix COMPLETED, or -1.
+    (A sync op is journaled after its fsync returns, so presence in
+    the prefix == the flush landed.)"""
+    last = -1
+    for i in range(min(crash_at, len(ops))):
+        if ops[i].kind == "sync":
+            last = i
+    return last
+
+
+class _SegWriter:
+    """Mirror of the native segment-roll discipline, per store dir."""
+
+    def __init__(self, seg_bytes: int) -> None:
+        self.seg_bytes = seg_bytes or _DEFAULT_SEG_BYTES
+        self.cur_seg = 0
+        self.cur_size = 0
+        self.segs = {0: bytearray()}
+
+    def append(self, blob: bytes) -> None:
+        if self.cur_size >= self.seg_bytes:
+            self.cur_seg += 1
+            self.cur_size = 0
+            self.segs[self.cur_seg] = bytearray()
+        self.segs[self.cur_seg] += blob
+        self.cur_size += len(blob)
+
+    def write_out(self, out_dir: str) -> None:
+        os.makedirs(out_dir, exist_ok=True)
+        for seg, buf in self.segs.items():
+            with open(
+                os.path.join(out_dir, "seg-%06d.log" % seg), "wb"
+            ) as f:
+                f.write(buf)
+
+
+def materialize(
+    ops: List[Op],
+    crash_at: int,
+    src_root: str,
+    out_root: str,
+    torn_bytes: Optional[int] = None,
+    meta_variant: str = "old",
+    skip_meta_index: Optional[int] = None,
+) -> None:
+    """Build under ``out_root`` the on-disk state of a crash at op
+    index ``crash_at`` (ops[:crash_at] happened; the op AT crash_at is
+    the one possibly caught mid-flight).
+
+    ``torn_bytes``: when the op at ``crash_at`` is an append, how many
+    bytes of its record hit the disk (byte-granular tearing); when it
+    is a meta write, a prefix length of its document for the
+    ``meta_variant`` in play.
+
+    ``meta_variant`` (op at crash_at is a meta write):
+      * ``old``           rename did not persist: previous content
+                          (or absence) survives — the default;
+      * ``tmp-partial``   the staging file holds ``torn_bytes`` of the
+                          new document, target keeps the old content;
+      * ``replaced-torn`` the rename persisted but the data pages did
+                          not: target holds a torn prefix — the state
+                          the CRC trailer turns from silent reset into
+                          an alarmed conservative recovery.
+
+    ``skip_meta_index``: drop that meta op from the prefix while
+    keeping everything after it (cross-file reordering: the sidecar
+    write was lost although later log appends persisted).
+    """
+    crash_at = min(crash_at, len(ops))
+
+    def out_path(p: str) -> str:
+        rel = os.path.relpath(p, src_root)
+        assert not rel.startswith(".."), (p, src_root)
+        return os.path.join(out_root, rel)
+
+    writers = {}
+    metas = {}
+    for i in range(crash_at):
+        op = ops[i]
+        if op.kind == "open":
+            writers.setdefault(op.path, _SegWriter(op.seg_bytes))
+        elif op.kind == "append":
+            writers.setdefault(
+                op.path, _SegWriter(0)
+            ).append(encode_record(op))
+        elif op.kind == "meta":
+            if i != skip_meta_index:
+                metas[op.path] = op.data
+        # sync: no state transition to materialize
+
+    # the op caught mid-flight
+    if crash_at < len(ops) and torn_bytes is not None:
+        op = ops[crash_at]
+        if op.kind == "append":
+            blob = encode_record(op)
+            writers.setdefault(op.path, _SegWriter(0)).append(
+                blob[: max(0, min(torn_bytes, len(blob) - 1))]
+            )
+        elif op.kind == "meta":
+            cut = max(1, min(torn_bytes, len(op.data) - 1))
+            if meta_variant == "tmp-partial":
+                metas[op.path + ".tmp"] = op.data[:cut]
+            elif meta_variant == "replaced-torn":
+                metas[op.path] = op.data[:cut]
+            # "old": nothing — the previous content stands
+
+    for d, w in writers.items():
+        w.write_out(out_path(d))
+    for p, content in metas.items():
+        target = out_path(p)
+        os.makedirs(os.path.dirname(target), exist_ok=True)
+        with open(target, "wb") as f:
+            f.write(content)
