@@ -287,6 +287,7 @@ class Broker:
                 store_qos0=self.config.durable.store_qos0,
                 layout=self.config.durable.layout,
                 fsync=self.config.durable.fsync,
+                n_shards=self.config.durable.n_shards,
             )
             # detected corruption (quarantined log records, unreadable
             # sidecars) surfaces as $SYS alarms + counters — the
@@ -295,6 +296,14 @@ class Broker:
             for evt in self.durable.corruption_events:
                 self._ds_corruption(evt)
             self.durable.corruption_events = []
+            # background census rebuild lifecycle -> ds_meta_rebuild
+            # alarm (raised at start, cleared at completion); the store
+            # keeps SERVING during the rebuild — reads are
+            # correct-but-wider, which is what the alarm tells ops
+            self.durable.on_rebuild = self._ds_rebuild
+            for evt in self.durable.rebuild_events:
+                self._ds_rebuild(evt)
+            self.durable.rebuild_events = []
             # every group fsync is counted + histogrammed (the
             # profiler's ds_sync stage feeds the sync-latency surface)
             self.durable.gate.on_sync = self._ds_synced
@@ -2445,6 +2454,25 @@ class Broker:
         self._on_loop(lambda: self.alarms.activate(
             name, details=dict(evt), message=msg,
         ))
+
+    def _ds_rebuild(self, evt: Dict) -> None:
+        """Census-rebuild lifecycle: alarm up while a background
+        rebuild runs (the store serves correct-but-wider reads from
+        the log meanwhile), cleared when the scan lands.  An aborted
+        rebuild (fault/shutdown) leaves the alarm up — the next open
+        retries and ops can see the store is still unpruned."""
+        event = evt.get("event")
+        if event == "start":
+            self.metrics.inc("ds.meta.rebuild")
+            self._on_loop(lambda: self.alarms.activate(
+                "ds_meta_rebuild", details=dict(evt),
+                message=("DS census rebuilding in background; "
+                         "reads serve unpruned from the log"),
+            ))
+        elif event == "done":
+            self._on_loop(
+                lambda: self.alarms.deactivate("ds_meta_rebuild")
+            )
 
     def _ds_synced(self, dur_s: float) -> None:
         self.metrics.inc("ds.sync.count")

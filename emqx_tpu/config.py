@@ -323,6 +323,12 @@ class DurableConfig:
     # (2-level topic-prefix hash shards); pinned per data directory
     layout: str = "lts"
     n_streams: int = 16  # hash layout only
+    # physical store shards: each shard is an independent segment log
+    # + fsync barrier + metadata journal (append throughput scales
+    # with shards in `always` mode; restart recovery parallelizes
+    # naturally).  Pinned per data directory like the layout — it
+    # decides WHERE records live.
+    n_shards: int = 1
     store_qos0: bool = False
     # durability mode — what "acked" means for a captured QoS>=1
     # publish (the PR 15 group-commit contract):
@@ -608,6 +614,8 @@ def check_config(cfg: BrokerConfig) -> List[str]:
         bad("mqtt.mqueue_default_priority must be lowest|highest")
     if cfg.durable.layout not in ("lts", "hash"):
         bad(f"durable.layout: {cfg.durable.layout!r} (lts|hash)")
+    if not 1 <= int(cfg.durable.n_shards) <= 64:
+        bad("durable.n_shards must be in [1, 64]")
     if cfg.durable.fsync not in ("never", "interval", "always"):
         bad(
             f"durable.fsync: {cfg.durable.fsync!r} "

@@ -122,6 +122,23 @@ class Ctl:
                     f"corruption: {dura['corrupt_records']} records "
                     f"quarantined, {dura['meta_corruption']} meta"
                 )
+                if dura.get("meta_rebuild"):
+                    print(
+                        "    census rebuild in progress: "
+                        f"{dura.get('meta_rebuild_scanned', 0)}/"
+                        f"{dura.get('meta_rebuild_total', 0)} streams"
+                    )
+                for row in dura.get("per_shard") or ():
+                    print(
+                        f"    shard {row.get('shard')}: "
+                        f"{row.get('sync_count', 0)} syncs "
+                        f"({row.get('sync_errors', 0)} errors), "
+                        f"{row.get('unsynced', 0)} unsynced / "
+                        f"{row.get('parked', 0)} parked; "
+                        f"{row.get('corrupt_records', 0)} corrupt / "
+                        f"{row.get('quarantined_segments', 0)} "
+                        "quarantined segs"
+                    )
         cluster = nodes.get("cluster") or {}
         if cluster:
             print(

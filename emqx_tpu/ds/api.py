@@ -50,16 +50,24 @@ def filter_streams(flt: str, n_streams: int) -> Optional[int]:
 
 @dataclass(frozen=True)
 class StreamRef:
-    """Opaque-but-serializable stream handle (emqx_ds stream)."""
+    """Opaque-but-serializable stream handle (emqx_ds stream).
+
+    ``store`` addresses the physical shard in a sharded store (the
+    index of the inner segment-log + SyncGate pair); the single-shard
+    layouts leave it 0 and serialize without it, so checkpoints from
+    pre-sharded data dirs load unchanged."""
 
     shard: int
+    store: int = 0
 
     def to_json(self) -> Dict:
+        if self.store:
+            return {"shard": self.shard, "store": self.store}
         return {"shard": self.shard}
 
     @staticmethod
     def from_json(obj: Dict) -> "StreamRef":
-        return StreamRef(shard=obj["shard"])
+        return StreamRef(shard=obj["shard"], store=obj.get("store", 0))
 
 
 @dataclass(frozen=True)
@@ -242,6 +250,46 @@ class DurableStorage:
     def sync(self) -> None:
         self.sync_data()
         self.save_meta()
+
+    def save_meta_full(self) -> None:
+        """Force a full metadata compaction (journal fold) where the
+        layout keeps incremental metadata; plain checkpoint
+        otherwise."""
+        self.save_meta()
+
+    def gc(self, cutoff_ts_us: int,
+           pin_floor: Optional[int] = None) -> int:
+        """Reclaim records older than the cutoff; generations at/above
+        ``pin_floor`` survive (a replay cursor pins them).  In-memory
+        backends no-op."""
+        return 0
+
+    def gc_pinned(self, cutoff_ts_us: int,
+                  floors: Dict[int, int]) -> int:
+        """Retention with per-shard generation pins (``floors``: store
+        index -> lowest pinned generation).  Single-store backends use
+        store 0's floor; sharded storage overrides."""
+        return self.gc(cutoff_ts_us, pin_floor=floors.get(0))
+
+    def seg_for(self, stream: StreamRef, ts: int, seq: int) -> int:
+        """Generation the replay cursor (stream, ts, seq) pins; -1 if
+        exhausted (or the backend has no generations)."""
+        return -1
+
+    def generation(self) -> int:
+        """Current write generation (0 for ungenerational backends)."""
+        return 0
+
+    # ---------------------------------------------- census rebuild
+    # surface (layouts that background their metadata rebuild
+    # override; everything else reports "not rebuilding")
+
+    rebuilding = False
+    rebuild_progress = {"scanned": 0, "total": 0}
+
+    def rebuild_now(self) -> None:
+        """Block until any in-flight background metadata rebuild
+        completes."""
 
     def corruption_stats(self) -> Dict[str, int]:
         return {"corrupt_records": 0, "quarantined_segments": 0}

@@ -38,8 +38,9 @@ from __future__ import annotations
 
 import json
 import os
+import struct
 import zlib
-from typing import Any, Tuple
+from typing import Any, List, Optional, Tuple
 
 from .. import failpoints
 
@@ -152,6 +153,66 @@ def load_json(path: str) -> Any:
     except OSError as exc:
         raise MetaCorruption(path, f"unreadable: {exc}") from exc
     return loads_checked(raw, path)
+
+
+# ------------------------------------------------------ journal frames
+#
+# Binary frames for the incremental metadata journals (ds/journal.py):
+# ``[u32 len][u32 crc32(payload)][payload]`` where payload is compact
+# JSON.  Same discipline as the dslog record format: a frame whose
+# damage reaches EOF is the torn tail of a crashed append (stop
+# silently — the delta scan re-learns it); damage with intact bytes
+# AFTER it is interior corruption (stop AND report — the suffix's
+# records are lost, so recovery must widen to the snapshot watermark
+# and the alarm must fire).
+
+_FRAME_HDR = struct.Struct("<II")
+_MAX_FRAME_LEN = 16 << 20
+
+
+def pack_frame(obj: Any) -> bytes:
+    """One journal frame for ``obj``."""
+    payload = _canonical(obj).encode()
+    return _FRAME_HDR.pack(len(payload), zlib.crc32(payload)) + payload
+
+
+def iter_frames(
+    blob: bytes, path: str = "<mem>"
+) -> Tuple[List[Any], Optional[str]]:
+    """Decode a journal: ``(records, corrupt_detail)``.  The record
+    list is always the valid prefix; ``corrupt_detail`` is None for a
+    clean read OR a torn tail (the normal crash artifact), and a
+    description when the break is INTERIOR (bytes follow the damage —
+    a once-valid suffix was flipped on disk and its records are gone:
+    the caller must alarm and fall back conservatively)."""
+    out: List[Any] = []
+    off, total = 0, len(blob)
+    while off + _FRAME_HDR.size <= total:
+        ln, crc = _FRAME_HDR.unpack_from(blob, off)
+        end = off + _FRAME_HDR.size + ln
+        if ln > _MAX_FRAME_LEN:
+            # implausible length: flipped header.  Bytes beyond the
+            # bare header mean data followed it — interior corruption.
+            if total - off > _FRAME_HDR.size:
+                return out, f"{path}: frame length {ln} implausible"
+            return out, None
+        if end > total:
+            return out, None  # extends past EOF: torn tail
+        payload = blob[off + _FRAME_HDR.size:end]
+        if zlib.crc32(payload) != crc:
+            if end < total:
+                return out, f"{path}: interior frame crc break at {off}"
+            return out, None  # torn tail of the crashed append
+        try:
+            out.append(json.loads(payload.decode()))
+        except (json.JSONDecodeError, UnicodeDecodeError) as exc:
+            # crc passed but payload unparseable: the frame was
+            # WRITTEN corrupt — never a crash artifact, always report
+            return out, f"{path}: frame at {off} unparseable: {exc}"
+        off = end
+    if off < total:
+        return out, None  # partial header at EOF: torn tail
+    return out, None
 
 
 def try_load_json(path: str, default: Any) -> Tuple[Any, str]:

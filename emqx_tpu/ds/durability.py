@@ -230,3 +230,119 @@ class SyncGate:
 def _resolve_waiter(fut: "asyncio.Future") -> None:
     if not fut.done():
         fut.set_result(None)
+
+
+class GateGroup:
+    """Facade over the per-shard SyncGates of a sharded store, keeping
+    the broker's single-gate contract (``dur.gate.*``) intact.
+
+    Each shard owns an independent append watermark and fsync barrier
+    — that independence is the whole point of sharding (N disks' worth
+    of group-commit concurrency) — but ACK CONSISTENCY is cross-shard:
+    a dispatch window's barrier must cover every shard its appends
+    touched.  `wait_durable` gathers ALL member gates' barriers; a
+    clean gate returns immediately (one watermark compare), so the
+    cost is one parked future per DIRTY shard and the window's acks
+    can never straddle shards inconsistently (crash-suite-tested: a
+    crash between two shards' fsyncs un-acks the whole window).
+
+    There is deliberately no `mark_appended` here: the store's
+    persist path marks each shard's own gate with that shard's count —
+    the group only aggregates.
+    """
+
+    def __init__(self, gates: List[SyncGate]) -> None:
+        self._gates = list(gates)
+
+    def __iter__(self):
+        return iter(self._gates)
+
+    def __len__(self) -> int:
+        return len(self._gates)
+
+    @property
+    def gates(self) -> List[SyncGate]:
+        return self._gates
+
+    # ---------------------------------------------------- aggregates
+
+    @property
+    def appended(self) -> int:
+        return sum(g.appended for g in self._gates)
+
+    @property
+    def dirty(self) -> bool:
+        return any(g.dirty for g in self._gates)
+
+    @property
+    def unsynced(self) -> int:
+        return sum(g.unsynced for g in self._gates)
+
+    @property
+    def parked(self) -> int:
+        return sum(g.parked for g in self._gates)
+
+    @property
+    def sync_count(self) -> int:
+        return sum(g.sync_count for g in self._gates)
+
+    @property
+    def sync_errors(self) -> int:
+        return sum(g.sync_errors for g in self._gates)
+
+    def stats(self) -> dict:
+        return {
+            "sync_count": self.sync_count,
+            "sync_errors": self.sync_errors,
+            "unsynced": self.unsynced,
+            "parked": self.parked,
+        }
+
+    # ----------------------------------------------------- callbacks
+
+    @property
+    def on_sync(self):
+        return self._gates[0].on_sync if self._gates else None
+
+    @on_sync.setter
+    def on_sync(self, fn) -> None:
+        for g in self._gates:
+            g.on_sync = fn
+
+    @property
+    def on_error(self):
+        return self._gates[0].on_error if self._gates else None
+
+    @on_error.setter
+    def on_error(self, fn) -> None:
+        for g in self._gates:
+            g.on_error = fn
+
+    # ---------------------------------------------------- sync paths
+
+    def sync_now(self) -> None:
+        """Blocking flush of every dirty shard (tick fallback,
+        shutdown).  Per-shard: a clean shard costs one compare."""
+        for g in self._gates:
+            g.sync_now()
+
+    def sync_soon(self) -> None:
+        for g in self._gates:
+            g.sync_soon()
+
+    async def wait_durable(self) -> None:
+        """The cross-shard group-commit barrier: resolve only when a
+        flush on EVERY shard covers the records appended to it before
+        this call.  Dirty shards park concurrently — wall time is the
+        slowest single fsync, not the sum."""
+        dirty = [g for g in self._gates if g.dirty]
+        if not dirty:
+            return
+        if len(dirty) == 1:
+            await dirty[0].wait_durable()
+            return
+        await asyncio.gather(*(g.wait_durable() for g in dirty))
+
+    def stop(self) -> None:
+        for g in self._gates:
+            g.stop()

@@ -48,10 +48,15 @@ from .api import (
     decode_message,
     encode_message,
 )
+from .journal import MetaJournal
 from .native import DsLog
 
 VAR_BITS = 12
 VAR_MASK = (1 << VAR_BITS) - 1
+
+# journal size that triggers a fold into the snapshots at the next
+# metadata flush
+_FOLD_BYTES = 256 * 1024
 
 
 def _overlaps(fw: Sequence[str], pw: Sequence[str]) -> bool:
@@ -78,11 +83,12 @@ class LtsIndex:
         self._root = self._node()
         self._sids: Dict[str, int] = {}  # pattern -> structure id
         self._patterns: List[str] = []   # sid -> pattern
-        # fired whenever a NEW structure id is minted: the storage
-        # persists the pattern registry IMMEDIATELY — sids are baked
-        # into on-disk stream keys, so the sid->pattern mapping must
-        # never be reconstructed by re-learning (a rebuild after gc
-        # could assign shifted ids and silently mis-prune replay)
+        # fired with the pattern whenever a NEW structure id is
+        # minted: the storage journals it IMMEDIATELY (one O(1) delta
+        # frame, not a registry rewrite) — sids are baked into on-disk
+        # stream keys, so the sid->pattern mapping must never be
+        # reconstructed by re-learning (a rebuild after gc could
+        # assign shifted ids and silently mis-prune replay)
         self.on_new_pattern = None
 
     @staticmethod
@@ -95,7 +101,7 @@ class LtsIndex:
             sid = self._sids[pattern] = len(self._patterns)
             self._patterns.append(pattern)
             if self.on_new_pattern is not None:
-                self.on_new_pattern()
+                self.on_new_pattern(pattern)
         return sid
 
     def seed_patterns(self, patterns: List[str]) -> None:
@@ -222,15 +228,20 @@ class LtsStorage(DurableStorage):
                 records=ncorrupt,
             )
         self._index_path = os.path.join(directory, "lts_index.json")
-        # the sid->pattern registry persists SEPARATELY and
-        # immediately on every new structure: stream keys embed sids,
-        # so this mapping is append-only ground truth that must
-        # survive any crash/gc combination the trie cache does not
+        # the sid->pattern registry persists SEPARATELY from the trie
+        # cache: stream keys embed sids, so this mapping is append-only
+        # ground truth that must survive any crash/gc combination the
+        # trie does not.  New patterns journal as O(1) delta frames
+        # (the registry file itself is only rewritten by the fold)
         self._patterns_path = os.path.join(
             directory, "lts_patterns.json"
         )
+        self._journal = MetaJournal(os.path.join(directory, "lts.journal"))
+        self._wm = 0
+        self._max_ts_us = 0
+        self._need_fold = False
         self.index = self._load_index(var_threshold)
-        self.index.on_new_pattern = self._save_patterns
+        self.index.on_new_pattern = self._journal_pattern
 
     # ----------------------------------------------------------- write
 
@@ -241,9 +252,11 @@ class LtsStorage(DurableStorage):
             key = self.index.key_of(msg.topic)
             ts_us = int(msg.timestamp * 1e6)
             self._log.append(key, ts_us, encode_message(msg))
+            if ts_us > self._max_ts_us:
+                self._max_ts_us = ts_us
         if sync:
             self._log.sync()
-            self._save_index()
+            self.save_meta()
 
     def stream_key(self, topic: str) -> int:
         return self.index.key_of(topic)
@@ -297,13 +310,21 @@ class LtsStorage(DurableStorage):
             )
             return []
 
-    def _save_patterns(self) -> None:
-        atomicio.atomic_write_json(
-            self._patterns_path, self.index._patterns,
-            fsync=self.meta_fsync,
+    def _journal_pattern(self, pattern: str) -> None:
+        """A new structure id was minted: journal it NOW (one delta
+        frame) — the sid is about to be baked into stream keys, so it
+        cannot wait for the flush cadence the trie cache rides."""
+        self._journal.append(
+            [{"t": "pattern", "p": pattern}], fsync=self.meta_fsync
         )
 
     def _load_index(self, var_threshold: int) -> LtsIndex:
+        """Snapshot + journal replay + delta re-learn from the
+        watermark (O(records since the last flush)).  Only a store
+        with no usable watermark pays the full re-learn — which stays
+        SYNCHRONOUS (unlike the hash census): the trie and the sid
+        table feed `key_of` on the write path, so serving writes
+        against a half-learned trie would mint unstable structures."""
         try:
             obj = atomicio.load_json(self._index_path)
         except FileNotFoundError:
@@ -313,35 +334,74 @@ class LtsStorage(DurableStorage):
             # full recovery, but a torn index is still counted/alarmed
             self._report_corruption("meta", exc.path, exc.detail)
             obj = None
+        jrecs, jdetail = self._journal.load()
+        if jdetail:
+            self._report_corruption("meta", self._journal.path, jdetail)
         patterns = self._load_patterns()
         if not patterns and obj is not None:
             # pre-registry data dir: the stale index's table is still
             # a better sid seed than renumbering from scratch
             patterns = list(obj["index"].get("patterns", ()))
-        if obj is not None and obj.get("count") == self._record_count():
+        wm: Optional[int] = None
+        if obj is not None and "wm" in obj:
+            wm = int(obj["wm"])
+        for r in jrecs:
+            t = r.get("t")
+            if t == "pattern":
+                # minted after the registry was last folded; dedup
+                # absorbs a crash between the fold's two writes
+                if r["p"] not in patterns:
+                    patterns.append(r["p"])
+            elif t == "wm":
+                ts = int(r["ts"])
+                if wm is None or ts > wm:
+                    wm = ts
+        if obj is not None and wm is not None:
             idx = LtsIndex.from_json(obj["index"])
             if len(patterns) > len(idx._patterns):
                 idx.seed_patterns(patterns)  # registry ran ahead
+            maxts = wm
+            for shard in self._log.streams():
+                for ets, _seq, payload in self._log.scan(shard, wm):
+                    idx.learn(T.words(decode_message(payload).topic))
+                    if ets > maxts:
+                        maxts = ets
+            self._wm = wm
+            self._max_ts_us = maxts
+            if maxts > wm or jrecs:
+                # compact what replay accumulated — and persist any
+                # sid minted by the delta re-learn (deterministic
+                # until then: a crash re-learns the identical tail)
+                self._need_fold = True
             return idx
-        # stale or absent (crash after the last save): re-learn the
-        # TRIE from the log, but seed sid assignments from the
-        # persisted registry first — re-learning must never renumber
-        # structures whose ids are baked into on-disk stream keys
-        # (post-gc, an early structure's records may be gone entirely
-        # and a fresh numbering would shift every later sid)
+        if obj is not None and obj.get("count") == self._record_count():
+            # legacy snapshot (no watermark anywhere): the old count
+            # check — matching means the trie is complete
+            idx = LtsIndex.from_json(obj["index"])
+            if len(patterns) > len(idx._patterns):
+                idx.seed_patterns(patterns)
+            return idx
+        # stale-legacy or absent: re-learn the TRIE from the log, but
+        # seed sid assignments from the persisted registry first —
+        # re-learning must never renumber structures whose ids are
+        # baked into on-disk stream keys (post-gc, an early
+        # structure's records may be gone entirely and a fresh
+        # numbering would shift every later sid)
         idx = LtsIndex(var_threshold)
         if patterns:
             idx.seed_patterns(patterns)
         rebuilt = False
+        maxts = 0
         for shard in self._log.streams():
-            for _ts, _seq, payload in self._log.scan(shard, 0):
-                msg = decode_message(payload)
-                idx.learn(T.words(msg.topic))
+            for ets, _seq, payload in self._log.scan(shard, 0):
+                idx.learn(T.words(decode_message(payload).topic))
                 rebuilt = True
+                if ets > maxts:
+                    maxts = ets
+        self._max_ts_us = maxts
         if rebuilt or obj is not None:
             self.index = idx
-            self._save_index()
-            self._save_patterns()
+            self._fold_index()
         return idx
 
     def _record_count(self) -> int:
@@ -349,22 +409,50 @@ class LtsStorage(DurableStorage):
             self._log.stream_count(s) for s in self._log.streams()
         )
 
-    def _save_index(self) -> None:
-        atomicio.atomic_write_json(
+    def _fold_index(self) -> None:
+        """Compact journal + registry + trie snapshot (the ONE place
+        the LTS sidecars are rewritten — brokerlint DUR702 pins
+        snapshot writes in emqx_tpu/ds/ to the journal fold path)."""
+        self._journal.fold(
             self._index_path,
             {"count": self._record_count(),
+             "wm": self._max_ts_us,
              "index": self.index.to_json()},
             fsync=self.meta_fsync,
+            extra=[(self._patterns_path, self.index._patterns)],
         )
+        self._wm = self._max_ts_us
+        self._need_fold = False
 
-    def gc(self, cutoff_ts_us: int) -> int:
-        return self._log.gc(cutoff_ts_us)
+    def gc(self, cutoff_ts_us: int,
+           pin_floor: Optional[int] = None) -> int:
+        return self._log.gc(cutoff_ts_us, pin_floor=pin_floor)
+
+    def seg_for(self, stream: StreamRef, ts: int, seq: int) -> int:
+        return self._log.seg_for(stream.shard, ts, seq)
+
+    def generation(self) -> int:
+        return self._log.generation()
 
     def sync_data(self) -> None:
         self._log.sync()
 
     def save_meta(self) -> None:
-        self._save_index()
+        """O(delta) metadata flush: a watermark frame (new patterns
+        already journaled at mint time); fold only past the size
+        threshold or when boot replay flagged a compaction."""
+        if self._need_fold or self._journal.size() >= _FOLD_BYTES:
+            self._fold_index()
+            return
+        if self._max_ts_us <= self._wm:
+            return  # nothing new since the last flush
+        self._journal.append(
+            [{"t": "wm", "ts": self._max_ts_us}], fsync=self.meta_fsync
+        )
+        self._wm = self._max_ts_us
+
+    def save_meta_full(self) -> None:
+        self._fold_index()
 
     # sync() is the base composition: sync_data() + save_meta()
 
@@ -389,7 +477,7 @@ class LtsStorage(DurableStorage):
             return  # idempotent: server stop + explicit close both land
         self._closed = True
         try:
-            self._save_index()
+            self._fold_index()
         except OSError:
             pass
         self._log.close()

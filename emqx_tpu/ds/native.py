@@ -11,6 +11,7 @@ import ctypes
 import os
 import subprocess
 import threading
+from typing import Optional
 
 from .. import failpoints
 
@@ -98,6 +99,21 @@ def load():
         lib.dslog_quarantined_count.argtypes = [ctypes.c_void_p]
         lib.dslog_gc.restype = ctypes.c_int64
         lib.dslog_gc.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.dslog_gc2.restype = ctypes.c_int64
+        lib.dslog_gc2.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_uint64,
+            ctypes.c_uint32,
+        ]
+        lib.dslog_seg_for.restype = ctypes.c_int64
+        lib.dslog_seg_for.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_uint32,
+            ctypes.c_uint64,
+            ctypes.c_uint64,
+        ]
+        lib.dslog_cur_seg.restype = ctypes.c_int64
+        lib.dslog_cur_seg.argtypes = [ctypes.c_void_p]
         _lib = lib
         return lib
 
@@ -193,10 +209,41 @@ class DsLog:
     def quarantined_count(self) -> int:
         return self._lib.dslog_quarantined_count(self._h)
 
-    def gc(self, cutoff_ts: int) -> int:
+    def gc(self, cutoff_ts: int, pin_floor: Optional[int] = None) -> int:
         """Reclaim whole segments older than cutoff_ts (microseconds);
-        returns records dropped."""
-        return self._lib.dslog_gc(self._h, cutoff_ts)
+        returns records dropped.  ``pin_floor`` is the lowest GENERATION
+        (segment id) a live replay cursor still needs — generations at
+        or above it survive whatever their age (None = nothing pinned).
+
+        The ``ds.gc.reclaim`` failpoint seam: ``error``/``panic`` raise
+        out to the retention pass's recovery (the pass fails loudly and
+        reclaims nothing — data is never at risk from a gc fault);
+        ``delay`` stalls the reclaim (slow unlink on a loaded disk);
+        ``drop`` skips the pass silently (a gc that never runs: the
+        store only GROWS, which retention monitoring must surface);
+        ``duplicate`` runs it twice (idempotent — the second pass finds
+        nothing to reclaim)."""
+        if failpoints.enabled:
+            act = failpoints.evaluate("ds.gc.reclaim", key=self._dir)
+            if act == "drop":
+                return 0
+            if act == "duplicate":
+                self._gc_raw(cutoff_ts, pin_floor)
+        return self._gc_raw(cutoff_ts, pin_floor)
+
+    def _gc_raw(self, cutoff_ts: int, pin_floor: Optional[int]) -> int:
+        floor = 0xFFFFFFFF if pin_floor is None else pin_floor
+        return self._lib.dslog_gc2(self._h, cutoff_ts, floor)
+
+    def seg_for(self, stream: int, ts: int, seq: int) -> int:
+        """Generation (segment id) of the first record of ``stream``
+        strictly after cursor (ts, seq) — what a live replay cursor
+        pins; -1 when the cursor is exhausted."""
+        return self._lib.dslog_seg_for(self._h, stream, ts, seq)
+
+    def generation(self) -> int:
+        """The current generation (segment new appends land in)."""
+        return self._lib.dslog_cur_seg(self._h)
 
     def scan(self, stream: int, ts_from: int):
         """Generator over (ts, seq, payload) from ts_from (inclusive)."""

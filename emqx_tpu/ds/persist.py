@@ -27,12 +27,21 @@ from .. import topic as T
 from ..engine import MatchEngine
 from ..message import Message
 from . import atomicio
-from .api import IterRef
+from .api import IterRef, StreamRef
 from .builtin_local import LocalStorage
-from .durability import SyncGate
+from .durability import GateGroup, SyncGate
 from .replication import rendezvous_pick
 
 log = logging.getLogger("emqx_tpu.ds")
+
+
+def _stream_pkey(s: StreamRef) -> str:
+    """Stable progress/rendezvous key for a stream: the bare in-store
+    shard for store 0 (byte-compatible with pre-sharded progress
+    files), ``store:shard`` otherwise — shard numbers repeat across
+    stores, so the store index must disambiguate or two shards'
+    progress would clobber each other."""
+    return str(s.shard) if not s.store else f"{s.store}:{s.shard}"
 
 
 class SessionState:
@@ -94,6 +103,7 @@ class DurableSessions:
         store_qos0: bool = False,
         layout: str = "lts",
         fsync: str = "interval",
+        n_shards: int = 1,
     ) -> None:
         # durability mode (config `durable.fsync`): `never` = no
         # fsyncs, `interval` = periodic group flush off the broker
@@ -119,19 +129,39 @@ class DurableSessions:
         # directories (older builds) are the hash layout — their
         # census.json gives them away.
         marker = os.path.join(msg_dir, "LAYOUT")
-        on_disk = self._read_layout_marker(marker, msg_dir)
-        if on_disk and on_disk != layout:
+        on_layout, on_shards = self._read_layout_marker(marker, msg_dir)
+        if on_layout and on_layout != layout:
             log.warning(
                 "durable layout pinned to %r by existing data "
-                "(config asked for %r)", on_disk, layout,
+                "(config asked for %r)", on_layout, layout,
             )
-            layout = on_disk
-        if on_disk is None:
+            layout = on_layout
+        # the shard count is ALSO a property of the data: it decides
+        # which shard directory a topic's records live in, so existing
+        # data pins it the same way the keymapper layout is pinned
+        if on_shards is not None and on_shards != n_shards:
+            log.warning(
+                "durable shard count pinned to %d by existing data "
+                "(config asked for %d)", on_shards, n_shards,
+            )
+            n_shards = on_shards
+        if on_layout is None:
             atomicio.atomic_write_json(
-                marker, layout, fsync=self.meta_fsync
+                marker,
+                layout if n_shards == 1
+                else {"layout": layout, "shards": n_shards},
+                fsync=self.meta_fsync,
             )
         self.layout = layout
-        if layout == "lts":
+        self.n_shards = n_shards
+        if n_shards > 1:
+            from .sharded import ShardedStorage
+
+            self.storage = ShardedStorage(
+                msg_dir, n_shards=n_shards, layout=layout,
+                n_streams=n_streams,
+            )
+        elif layout == "lts":
             from .lts import LtsStorage
 
             self.storage = LtsStorage(msg_dir)
@@ -146,11 +176,32 @@ class DurableSessions:
         self.storage.on_corruption = (
             lambda evt: self._report_corruption(**evt)
         )
+        # census-rebuild surface (the ds_meta_rebuild alarm): same
+        # adoption shape — events buffer until the broker wires it
+        self.on_rebuild = None
+        self.rebuild_events: List[Dict] = []
+        for evt in getattr(self.storage, "rebuild_events", ()):
+            self._forward_rebuild(evt)
+        if hasattr(self.storage, "rebuild_events"):
+            self.storage.rebuild_events = []
+        if hasattr(self.storage, "on_rebuild"):
+            self.storage.on_rebuild = self._forward_rebuild
         # the group-commit fsync gate (see ds/durability.py): persist()
         # advances its watermark, the broker's dispatch loop parks acks
         # on it in `always` mode, the tick flushes through it in
-        # `interval` mode — so every fsync is counted/attributed once
-        self.gate = SyncGate(self.storage.sync_data)
+        # `interval` mode — so every fsync is counted/attributed once.
+        # Sharded: ONE gate per shard (independent append watermarks +
+        # fsync barriers — the scaling point) fronted by a GateGroup
+        # that keeps the broker's single-gate contract, including the
+        # cross-shard ack barrier.
+        if n_shards > 1:
+            self._shard_gates: Optional[List[SyncGate]] = [
+                SyncGate(st.sync_data) for st in self.storage.stores
+            ]
+            self.gate = GateGroup(self._shard_gates)
+        else:
+            self._shard_gates = None
+            self.gate = SyncGate(self.storage.sync_data)
         self.state_dir = os.path.join(directory, "sessions")
         os.makedirs(self.state_dir, exist_ok=True)
         self.store_qos0 = store_qos0
@@ -240,6 +291,24 @@ class DurableSessions:
         else:
             self.corruption_events.append(evt)
 
+    def _forward_rebuild(self, evt: Dict) -> None:
+        """Census-rebuild lifecycle events (start/done/aborted) flow
+        to the broker's alarm wiring, or buffer until it exists."""
+        log.warning(
+            "ds census rebuild %s at %s (%d/%d streams)",
+            evt.get("event"), evt.get("path"),
+            evt.get("scanned", 0), evt.get("total", 0),
+        )
+        if self.on_rebuild is not None:
+            self.on_rebuild(evt)
+        else:
+            self.rebuild_events.append(evt)
+
+    def rebuild_now(self) -> None:
+        """Block until any in-flight background census rebuild lands
+        (tests/ctl)."""
+        self.storage.rebuild_now()
+
     def _load_meta(self, path: str, what: str):
         """Load one sidecar: None for missing (fresh start) OR
         unreadable — but the unreadable case is alarmed first, so the
@@ -252,36 +321,45 @@ class DurableSessions:
             self._report_corruption("meta", exc.path, exc.detail)
             return None
 
-    def _read_layout_marker(self, marker: str,
-                            msg_dir: str) -> Optional[str]:
-        """The LAYOUT pin: legacy markers are the raw layout string,
-        new ones the checksummed document.  Garbage content is
+    def _read_layout_marker(
+        self, marker: str, msg_dir: str
+    ) -> Tuple[Optional[str], Optional[int]]:
+        """The LAYOUT pin as ``(layout, n_shards)``: legacy markers
+        are the raw layout string or its checksummed document (both
+        mean 1 shard — flat directory); sharded directories carry a
+        ``{"layout": ..., "shards": N}`` document.  Garbage content is
         corruption — fall back to the pre-marker heuristic (a
-        census.json means the hash layout) rather than pinning the
-        directory to an unreadable value."""
+        census.json means the flat hash layout) rather than pinning
+        the directory to an unreadable value."""
         try:
             with open(marker) as f:
                 raw = f.read()
         except OSError:
             if os.path.exists(os.path.join(msg_dir, "census.json")):
-                return "hash"
-            return None
+                return "hash", 1
+            return None, None
         if raw.strip() in ("lts", "hash"):
-            return raw.strip()
+            return raw.strip(), 1
         try:
             val = atomicio.loads_checked(raw, marker)
         except atomicio.MetaCorruption as exc:
             self._report_corruption("meta", exc.path, exc.detail)
             val = None
         if val in ("lts", "hash"):
-            return val
+            return val, 1
+        if isinstance(val, dict) and val.get("layout") in ("lts", "hash"):
+            try:
+                shards = int(val.get("shards", 1))
+            except (TypeError, ValueError):
+                shards = 1
+            return val["layout"], max(1, shards)
         if val is not None:
             self._report_corruption(
                 "meta", marker, f"unknown layout {val!r}"
             )
         if os.path.exists(os.path.join(msg_dir, "census.json")):
-            return "hash"
-        return None
+            return "hash", 1
+        return None, None
 
     # ------------------------------------------------------------ gate
 
@@ -308,11 +386,17 @@ class DurableSessions:
             if self._gate.match(msg.topic):
                 batch.append(msg)
         if batch:
-            self.storage.store_batch(batch)
+            counts = self.storage.store_batch(batch)
             # advance the group-commit watermark: the broker's
             # dispatch barrier ("always" mode) parks this window's
-            # acks until a flush covers it
-            self.gate.mark_appended(len(batch))
+            # acks until a flush covers it.  Sharded: each shard's OWN
+            # gate is marked with that shard's count — the barrier
+            # then only waits on shards this window actually touched.
+            if self._shard_gates is not None and counts:
+                for idx, n in counts.items():
+                    self._shard_gates[idx].mark_appended(n)
+            else:
+                self.gate.mark_appended(len(batch))
             if self.beamformer.has_parked():
                 self.beamformer.notify({
                     self.storage.stream_key(m.topic) for m in batch
@@ -430,8 +514,45 @@ class DurableSessions:
                 self.shared_leave(flt, clientid)
 
     def gc(self, cutoff_ts_us: int) -> int:
-        """Retention pass over the message log."""
-        return self.storage.gc(cutoff_ts_us)
+        """Retention pass over the message log, honoring GENERATION
+        PINS: a detached session mid-replay pins, per shard, every
+        generation at/after its replay cursor — GC reclaims only
+        unpinned generations, so retention can never pull a segment
+        out from under a resuming session's cursor (the property the
+        pin suite tests).  Sessions whose replay has not STARTED have
+        no cursors yet; they conservatively clamp the time cutoff to
+        their disconnect instant instead."""
+        floors, ts_floor = self._gc_pins()
+        cutoff = cutoff_ts_us
+        if ts_floor is not None and ts_floor < cutoff:
+            cutoff = ts_floor
+        return self.storage.gc_pinned(cutoff, floors)
+
+    def _gc_pins(self) -> Tuple[Dict[int, int], Optional[int]]:
+        """(per-store generation floors, time floor) derived from the
+        boot states: a state with materialized cursors pins each
+        cursor's generation (`seg_for`); one whose replay has not
+        started pins by TIME (everything since its disconnect)."""
+        floors: Dict[int, int] = {}
+        ts_floor: Optional[int] = None
+        for state in self._boot_states.values():
+            if state.iters is None:
+                t = int(state.disconnected_at * 1e6)
+                if ts_floor is None or t < ts_floor:
+                    ts_floor = t
+                continue
+            for cursors in state.iters.values():
+                for cur in cursors:
+                    it = IterRef.from_json(cur)
+                    seg = self.storage.seg_for(
+                        it.stream, it.ts, it.seq
+                    )
+                    if seg < 0:
+                        continue  # exhausted: pins nothing
+                    store = it.stream.store
+                    if store not in floors or seg < floors[store]:
+                        floors[store] = seg
+        return floors, ts_floor
 
     def sync(self) -> None:
         """Full flush: group fsync (through the gate, so it is counted
@@ -455,11 +576,29 @@ class DurableSessions:
 
     def sync_stats(self) -> Dict:
         """The durability ops surface (/api/v5/nodes, ctl status,
-        /metrics gauges)."""
+        /metrics gauges): rolled-up totals, the census-rebuild gauge,
+        and — sharded — a per-shard breakdown (each shard's own
+        unsynced watermark, parked windows and quarantine counts)."""
         out = {"fsync": self.fsync_mode}
         out.update(self.gate.stats())
         out.update(self.storage.corruption_stats())
         out["meta_corruption"] = self.corruption_counts.get("meta", 0)
+        out["shards"] = self.n_shards
+        # numeric top-level fields so /metrics emits them as gauges
+        out["meta_rebuild"] = 1 if self.storage.rebuilding else 0
+        prog = self.storage.rebuild_progress
+        out["meta_rebuild_scanned"] = prog.get("scanned", 0)
+        out["meta_rebuild_total"] = prog.get("total", 0)
+        if self._shard_gates is not None:
+            rows = []
+            for i, (g, st) in enumerate(
+                zip(self._shard_gates, self.storage.stores)
+            ):
+                row = {"shard": i}
+                row.update(g.stats())
+                row.update(st.corruption_stats())
+                rows.append(row)
+            out["per_shard"] = rows
         return out
 
     def _save_share_members(self) -> None:
@@ -491,7 +630,7 @@ class DurableSessions:
         skipping undelivered messages (the broker.py replay-cursor
         invariant, applied group-wide)."""
         prog = self._share_progress.setdefault(share_flt, {})
-        key = str(it.stream.shard)
+        key = _stream_pkey(it.stream)
         cur = prog.get(key)
         if cur is None or (it.ts, it.seq) > (cur[0], cur[1]):
             prog[key] = [it.ts, it.seq]
@@ -585,7 +724,7 @@ class DurableSessions:
                     )
                     if not members
                     or rendezvous_pick(
-                        f"{share.group}:{s.shard}", members, 1
+                        f"{share.group}:{_stream_pkey(s)}", members, 1
                     )[0] == state.clientid
                 ]
                 prog = self._share_progress.get(flt, {})
@@ -594,7 +733,7 @@ class DurableSessions:
                     it = self.storage.make_iterator(
                         s, share.topic, since_us
                     )
-                    p = prog.get(str(s.shard))
+                    p = prog.get(_stream_pkey(s))
                     if p and (p[0], p[1]) > (it.ts, it.seq):
                         # group already consumed past here
                         it = IterRef(
@@ -676,8 +815,8 @@ class DurableSessions:
                             mids = mbytes = None
                         else:
                             ckey = (
-                                it.stream.shard, it.topic_filter,
-                                it.ts, it.seq,
+                                it.stream.store, it.stream.shard,
+                                it.topic_filter, it.ts, it.seq,
                             )
                             hit = cache.get(ckey)
                             if hit is None:

@@ -77,6 +77,8 @@ SEAMS = (
     "cluster.forward.ack",
     "olp.sample",
     "olp.shed",
+    "ds.journal.append",
+    "ds.gc.reclaim",
 )
 
 enabled = False  # fast-path gate: disabled brokers pay one bool check

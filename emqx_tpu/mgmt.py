@@ -1143,7 +1143,8 @@ class MgmtApi:
         lines: list = []
         seen: set = set()
 
-        def emit(name: str, kind: str, value, help_text: str = "") -> None:
+        def emit(name: str, kind: str, value, help_text: str = "",
+                 labels=None) -> None:
             metric = prom_name("emqx_" + name.replace(".", "_"))
             if metric not in seen:
                 # one HELP/TYPE per FAMILY — a repeated TYPE line (or a
@@ -1152,7 +1153,13 @@ class MgmtApi:
                 seen.add(metric)
                 lines.append(f"# HELP {metric} {help_text or name}")
                 lines.append(f"# TYPE {metric} {kind}")
-            lines.append(f"{metric} {value}")
+            if labels:
+                lab = ",".join(
+                    f'{k}="{v}"' for k, v in sorted(labels.items())
+                )
+                lines.append(f"{metric}{{{lab}}} {value}")
+            else:
+                lines.append(f"{metric} {value}")
 
         for name, value in sorted(self.broker.metrics.all().items()):
             emit(name, "counter", value)
@@ -1183,15 +1190,29 @@ class MgmtApi:
         # durable-store durability gauges (group-commit gate
         # watermarks, parked ack-windows, quarantine counts)
         if self.broker.durable is not None:
-            for name, value in sorted(
-                self.broker.durable.sync_stats().items()
-            ):
+            ds_stats = self.broker.durable.sync_stats()
+            for name, value in sorted(ds_stats.items()):
                 if not isinstance(value, (int, float)) or isinstance(
                     value, bool
                 ):
                     continue
                 emit("ds_" + name, "gauge", value,
                      help_text=f"durable store {name}")
+            # sharded store: per-shard breakdown as labeled gauges
+            # (each shard's own unsynced watermark / parked windows /
+            # quarantine counts)
+            for row in ds_stats.get("per_shard") or ():
+                shard = row.get("shard")
+                for name, value in sorted(row.items()):
+                    if name == "shard" or not isinstance(
+                        value, (int, float)
+                    ) or isinstance(value, bool):
+                        continue
+                    emit(
+                        "ds_shard_" + name, "gauge", value,
+                        labels={"shard": str(shard)},
+                        help_text=f"durable store shard {name}",
+                    )
         # rule-engine columnar-eval gauges (lowered/fallback registry
         # split, matrix vs scalar window counts, per-cell cost EWMAs)
         for name, value in sorted(self.broker.rules.stats().items()):

@@ -411,8 +411,11 @@ int64_t dslog_iter_next(void* itp, uint8_t* buf, uint32_t cap,
 // retention GC: unlink whole segments whose every record is older than
 // cutoff_ts (the current segment is never dropped).  Returns the number
 // of records reclaimed.  Segment-granular like RocksDB generation drops
-// — cheap, no rewrite.
-int64_t dslog_gc(void* h, uint64_t cutoff_ts) {
+// — cheap, no rewrite.  A segment id doubles as the store's GENERATION:
+// `pin_floor` is the lowest generation some live replay cursor still
+// needs — segments at or above it are never reclaimed, whatever their
+// age (pass UINT32_MAX for "nothing pinned").
+int64_t dslog_gc2(void* h, uint64_t cutoff_ts, uint32_t pin_floor) {
   Db& db = *static_cast<Db*>(h);
   std::lock_guard<std::mutex> lock(db.mu);
   // per-segment max ts + record count
@@ -427,6 +430,7 @@ int64_t dslog_gc(void* h, uint64_t cutoff_ts) {
   for (auto& kv : seg_stat) {
     uint32_t seg = kv.first;
     if (seg == db.cur_seg || kv.second.first >= cutoff_ts) continue;
+    if (seg >= pin_floor) continue;  // generation pinned by a cursor
     // a quarantined segment is preserved for forensics: its suffix's
     // timestamps are unknowable, so age-based reclaim never applies
     if (db.quarantined.count(seg)) continue;
@@ -444,6 +448,32 @@ int64_t dslog_gc(void* h, uint64_t cutoff_ts) {
     reclaimed += kv.second.second;
   }
   return reclaimed;
+}
+
+int64_t dslog_gc(void* h, uint64_t cutoff_ts) {
+  return dslog_gc2(h, cutoff_ts, UINT32_MAX);
+}
+
+// generation (= segment id) of the first record of `stream` strictly
+// after cursor (ts, seq): the generation a resuming session's replay
+// cursor pins.  -1 when the cursor is exhausted (nothing left to read,
+// so nothing to pin).
+int64_t dslog_seg_for(void* h, uint32_t stream, uint64_t ts,
+                      uint64_t seq) {
+  Db& db = *static_cast<Db*>(h);
+  std::lock_guard<std::mutex> lock(db.mu);
+  auto sit = db.index.find(stream);
+  if (sit == db.index.end()) return -1;
+  auto mit = sit->second.upper_bound({ts, seq});
+  if (mit == sit->second.end()) return -1;
+  return (int64_t)mit->second.seg;
+}
+
+// the current generation (the segment new appends land in)
+int64_t dslog_cur_seg(void* h) {
+  Db& db = *static_cast<Db*>(h);
+  std::lock_guard<std::mutex> lock(db.mu);
+  return (int64_t)db.cur_seg;
 }
 
 // estimated record count across quarantined suffixes (corruption the

@@ -1785,3 +1785,83 @@ def test_dur701_repo_ds_package_is_clean():
         rel = f"emqx_tpu/ds/{p.name}"
         rules = rules_of(p.read_text(), path=rel)
         assert "DUR701" not in rules, rel
+
+
+# ------------------------------------------------------------- DUR702
+
+
+def test_dur702_direct_snapshot_write_in_ds():
+    """A direct atomic_write_json call in a ds/ store module is a
+    finding: store-metadata snapshots go through MetaJournal.fold."""
+    bad = (
+        "from . import atomicio\n"
+        "class S:\n"
+        "    def save_meta(self):\n"
+        "        atomicio.atomic_write_json(self._path, {'a': 1})\n"
+    )
+    assert "DUR702" in rules_of(bad, path="emqx_tpu/ds/store.py")
+    # ...including a bare-name import form
+    bad2 = (
+        "from .atomicio import atomic_write_json\n"
+        "def save(path, obj):\n"
+        "    atomic_write_json(path, obj)\n"
+    )
+    assert "DUR702" in rules_of(bad2, path="emqx_tpu/ds/store.py")
+
+
+def test_dur702_fold_path_and_allowlist_pass():
+    # the fold itself owns the snapshot write: clean
+    fold = (
+        "from . import atomicio\n"
+        "class MetaJournal:\n"
+        "    def fold(self, path, obj):\n"
+        "        atomicio.atomic_write_json(path, obj)\n"
+        "        self.truncate()\n"
+    )
+    assert "DUR702" not in rules_of(
+        fold, path="emqx_tpu/ds/journal.py"
+    )
+    # audited session-checkpoint writers in persist.py: clean
+    sess = (
+        "from . import atomicio\n"
+        "class DurableSessions:\n"
+        "    def save(self, cid):\n"
+        "        atomicio.atomic_write_json(self._p(cid), {})\n"
+    )
+    assert "DUR702" not in rules_of(
+        sess, path="emqx_tpu/ds/persist.py"
+    )
+    # ...but an UNaudited persist.py writer fires
+    stray = (
+        "from . import atomicio\n"
+        "class DurableSessions:\n"
+        "    def _save_census(self):\n"
+        "        atomicio.atomic_write_json(self._c, {})\n"
+    )
+    assert "DUR702" in rules_of(stray, path="emqx_tpu/ds/persist.py")
+    # outside emqx_tpu/ds/ the rule does not apply
+    assert "DUR702" not in rules_of(
+        stray, path="emqx_tpu/retainer.py"
+    )
+
+
+def test_dur702_suppression_comment():
+    src = (
+        "from . import atomicio\n"
+        "def export(path, obj):\n"
+        "    # justified: operator-facing export, no journal to sync\n"
+        "    # with  # brokerlint: ignore[DUR702]\n"
+        "    atomicio.atomic_write_json(path, obj)\n"
+    )
+    assert "DUR702" not in rules_of(src, path="emqx_tpu/ds/x.py")
+
+
+def test_dur702_repo_ds_package_is_clean():
+    """Every real snapshot write in ds/ goes through the fold (or the
+    audited persist.py session checkpoints)."""
+    import pathlib
+    base = pathlib.Path(__file__).resolve().parent.parent
+    for p in sorted((base / "emqx_tpu" / "ds").glob("*.py")):
+        rel = f"emqx_tpu/ds/{p.name}"
+        rules = rules_of(p.read_text(), path=rel)
+        assert "DUR702" not in rules, rel

@@ -1,4 +1,4 @@
-"""Metadata-durability rule (DUR701).
+"""Metadata-durability rules (DUR701, DUR702).
 
 PR 15 made every DS metadata sidecar go through ONE write path —
 ``emqx_tpu.ds.atomicio.atomic_write_json`` (tmp + fsync +
@@ -30,6 +30,20 @@ Findings:
 
 Binary log writes (``"wb"`` etc.) are the storage engine's own domain
 (native dslog) and are not flagged.
+
+DUR702 (PR 16): STORE-metadata snapshots (census, LTS index) must be
+written through ``ds.journal.MetaJournal.fold`` — never by a direct
+``atomic_write_json`` call.  The fold owns the snapshot-then-truncate
+ordering that makes a crash at any point idempotent; a stray direct
+snapshot write next to a live journal breaks that algebra (the journal
+would replay stale deltas over a newer snapshot, or the fold's
+truncation would discard deltas the stray write never folded).  So:
+any ``atomic_write_json`` call in ``emqx_tpu/ds/`` is a finding unless
+it lives in ``journal.py`` (the fold itself) or in one of the audited
+SESSION-checkpoint writers in ``persist.py`` (``_DUR702_ALLOWED``) —
+those sidecars are whole-file by design (small, bounded by session
+count, not store size) and carry no journal.  Intentional exceptions
+take a justified ``# brokerlint: ignore[DUR702]``.
 """
 
 from __future__ import annotations
@@ -39,6 +53,22 @@ import ast
 from .engine import ModuleContext, dotted_name
 
 _DS_PATH_MARKER = "emqx_tpu/ds/"
+
+# DUR702: the one module whose writes ARE the fold path, plus the
+# audited session-checkpoint writers (whole-file by design — bounded
+# by session count, not store size; no journal to get out of sync
+# with).  Growing persist.py?  A new sidecar either takes a journal
+# (then fold writes it) or joins this list with a review.
+_DUR702_FOLD_MODULE = "emqx_tpu/ds/journal.py"
+_DUR702_ALLOWED = {
+    "emqx_tpu/ds/persist.py": frozenset({
+        "DurableSessions.__init__",        # layout marker, once
+        "DurableSessions.save",            # session checkpoint
+        "DurableSessions.save_state",      # session checkpoint
+        "DurableSessions._save_share_members",
+        "DurableSessions._flush_share_progress",
+    }),
+}
 
 
 def _is_write_mode(call: ast.Call) -> str:
@@ -125,13 +155,40 @@ def _report(ctx: ModuleContext, spans, node: ast.AST,
     )
 
 
+def _check_dur702(ctx: ModuleContext, spans, node: ast.Call,
+                  path: str) -> None:
+    """Direct snapshot writes outside the fold path (DUR702)."""
+    if not dotted_name(node.func).endswith("atomic_write_json"):
+        return
+    if path.endswith(_DUR702_FOLD_MODULE):
+        return  # the fold itself
+    allowed = next(
+        (q for sfx, q in _DUR702_ALLOWED.items() if path.endswith(sfx)),
+        frozenset(),
+    )
+    qual = _qualname_at(spans, getattr(node, "lineno", 1))
+    if qual in allowed:
+        return
+    ctx.report(
+        node, "DUR702", qual,
+        "store-metadata snapshot written directly — snapshots in "
+        "emqx_tpu/ds/ must go through MetaJournal.fold (snapshot-"
+        "then-truncate keeps journal replay idempotent); session "
+        "checkpoints belong on the durrules._DUR702_ALLOWED audit "
+        "list",
+        detail="atomic_write_json",
+    )
+
+
 def check(ctx: ModuleContext) -> None:
-    if _DS_PATH_MARKER not in ctx.path.replace("\\", "/"):
+    path = ctx.path.replace("\\", "/")
+    if _DS_PATH_MARKER not in path:
         return
     spans = _qual_spans(ctx.tree)
     for node in ast.walk(ctx.tree):
         if not isinstance(node, ast.Call):
             continue
+        _check_dur702(ctx, spans, node, path)
         mode = _is_write_mode(node)
         if mode and node.args and not _looks_tmp(node.args[0]):
             _report(ctx, spans, node, f'open(..., "{mode}")')

@@ -81,6 +81,9 @@ SEAM_FUNCS: Tuple[Seam, ...] = (
          "cluster.forward.ack"),
     Seam("emqx_tpu/olp.py", "LoadMonitor.sample", "olp.sample"),
     Seam("emqx_tpu/olp.py", "LoadMonitor.shed", "olp.shed"),
+    Seam("emqx_tpu/ds/journal.py", "MetaJournal.append",
+         "ds.journal.append"),
+    Seam("emqx_tpu/ds/native.py", "DsLog.gc", "ds.gc.reclaim"),
 )
 
 

@@ -26,7 +26,11 @@ Crash-state model (the legal-states envelope we enumerate):
   * cross-file reordering: a metadata write in the un-fsynced tail
     may be lost while LATER appends persist (``skip_meta_index``) —
     the ALICE reordering case that matters here, since sidecars and
-    the log live in different files.
+    the log live in different files;
+  * the metadata JOURNALS (PR 16's incremental sidecars) are
+    append-only like the segment log: a crash mid-``jappend``
+    persists a torn prefix of the frame blob, and a ``jtrunc``
+    (the fold's truncation) resets the materialized journal.
 
 `sync_covered_index` maps a crash point to the last fsync the prefix
 completed, which is what the workload's ack ledger is keyed by: in
@@ -48,7 +52,9 @@ _DEFAULT_SEG_BYTES = 64 << 20
 
 class Op(NamedTuple):
     kind: str            # "open" | "append" | "sync" | "meta"
-    path: str            # dir (open/append/sync) or file path (meta)
+                         # | "jappend" | "jtrunc"
+    path: str            # dir (open/append/sync) or file path
+                         # (meta/jappend/jtrunc)
     stream: int = 0
     ts: int = 0
     seq: int = 0
@@ -81,6 +87,12 @@ class CrashRecorder:
     def on_meta(self, path: str, content: bytes,
                 fsynced: bool) -> None:
         self.ops.append(Op("meta", path, data=content, fsynced=fsynced))
+
+    def on_jappend(self, path: str, blob: bytes) -> None:
+        self.ops.append(Op("jappend", path, data=bytes(blob)))
+
+    def on_jtrunc(self, path: str) -> None:
+        self.ops.append(Op("jtrunc", path))
 
     # ------------------------------------------------------- install
 
@@ -191,6 +203,7 @@ def materialize(
 
     writers = {}
     metas = {}
+    journals = {}
     for i in range(crash_at):
         op = ops[i]
         if op.kind == "open":
@@ -202,6 +215,10 @@ def materialize(
         elif op.kind == "meta":
             if i != skip_meta_index:
                 metas[op.path] = op.data
+        elif op.kind == "jappend":
+            journals.setdefault(op.path, bytearray()).extend(op.data)
+        elif op.kind == "jtrunc":
+            journals[op.path] = bytearray()
         # sync: no state transition to materialize
 
     # the op caught mid-flight
@@ -212,6 +229,12 @@ def materialize(
             writers.setdefault(op.path, _SegWriter(0)).append(
                 blob[: max(0, min(torn_bytes, len(blob) - 1))]
             )
+        elif op.kind == "jappend":
+            # the journal is append-only like the segment log: a crash
+            # mid-append persists a torn prefix of the frame blob
+            journals.setdefault(op.path, bytearray()).extend(
+                op.data[: max(0, min(torn_bytes, len(op.data) - 1))]
+            )
         elif op.kind == "meta":
             cut = max(1, min(torn_bytes, len(op.data) - 1))
             if meta_variant == "tmp-partial":
@@ -219,6 +242,8 @@ def materialize(
             elif meta_variant == "replaced-torn":
                 metas[op.path] = op.data[:cut]
             # "old": nothing — the previous content stands
+        # jtrunc mid-flight: truncation either happened or it did not;
+        # both states are already enumerated by adjacent crash points
 
     for d, w in writers.items():
         w.write_out(out_path(d))
@@ -227,3 +252,8 @@ def materialize(
         os.makedirs(os.path.dirname(target), exist_ok=True)
         with open(target, "wb") as f:
             f.write(content)
+    for p, buf in journals.items():
+        target = out_path(p)
+        os.makedirs(os.path.dirname(target), exist_ok=True)
+        with open(target, "wb") as f:
+            f.write(bytes(buf))
