@@ -128,10 +128,14 @@ class ResumeScheduler:
             self.running = True
 
     async def stop(self) -> None:
+        # the running/_task pair transitions BEFORE the await: a
+        # start() scheduled while cancel_and_wait is parked must see
+        # the stopped state (running False, no task), not a torn
+        # running=False with a still-registered task it then leaks
         self.running = False
-        if self._task is not None:
-            await cancel_and_wait(self._task)
-            self._task = None
+        task, self._task = self._task, None
+        if task is not None:
+            await cancel_and_wait(task)
         # uncommitted jobs keep their boot checkpoints: a restart
         # replays their intervals from disk (at-least-once, no loss)
 
@@ -219,7 +223,10 @@ class ResumeScheduler:
         if clientid not in self._parked_ids:
             return None
         self._parked_ids.discard(clientid)
-        for j in self._parked:
+        # scan a snapshot: remove() under a live deque iterator only
+        # avoids RuntimeError today because we return immediately —
+        # don't leave that landmine for the next edit
+        for j in list(self._parked):
             if j.clientid == clientid:
                 self._parked.remove(j)
                 return j
@@ -317,6 +324,12 @@ class ResumeScheduler:
         backoff = 0.0
         while True:
             if not self._active and not self._parked:
+                # clear-before-wait, and the emptiness check and the
+                # clear are loop-atomic (no await between): a _kick()
+                # either lands before the clear (we re-check via the
+                # loop) or sets the event we are about to wait on —
+                # no lost wakeup
+                # brokerlint: ignore[RACE801]
                 self._wake.clear()
                 await self._wake.wait()
                 continue

@@ -404,15 +404,23 @@ class ClusterNode:
             loop.create_task(self._fwd_retx_loop()),
         ]
         for name in list(self._peers):
+            # deliberate snapshot iteration; a peer removed while an
+            # earlier sync is in flight just gets one harmless extra
+            # sync (_sync_with is idempotent full-state resend)
+            # brokerlint: ignore[RACE801]
             await self._sync_with(name)
 
     async def stop(self) -> None:
         self._started = False
-        for t in self._tasks:
+        # take the task list BEFORE the first await: a start() racing
+        # mid-stop repopulates self._tasks, and the old
+        # `self._tasks = []` after the reap loop would silently drop
+        # (leak, never cancel) those new tasks
+        tasks, self._tasks = self._tasks, []
+        for t in tasks:
             t.cancel()  # request them all first, then reap
-        for t in self._tasks:
+        for t in tasks:
             await cancel_and_wait(t)
-        self._tasks = []
         if self.raft_conf is not None:
             await self.raft_conf.stop()
         if self.raft_ds is not None:
@@ -465,6 +473,11 @@ class ClusterNode:
                 )
             except asyncio.TimeoutError:
                 pass
+            # clear BEFORE snapshotting _pending_ops (loop-atomic up
+            # to the take at the append below): an op enqueued during
+            # the casts re-sets the event and the next round flushes
+            # it — the pair is torn by design, never lost
+            # brokerlint: ignore[RACE804]
             self._flush_wakeup.clear()
             casts = []
             if self._pending_ops:
@@ -493,6 +506,9 @@ class ClusterNode:
                 else:
                     await self._flush_forwards()
             if self._pending_repl:
+                # _flush_replication re-snapshots _pending_repl itself
+                # (take-and-swap); this check is only an elision
+                # brokerlint: ignore[RACE801]
                 await self._flush_replication()
             if self._pending_repl_raft:
                 # background quorum flush (bounded staleness for sync
@@ -1214,7 +1230,13 @@ class ClusterNode:
             return
         for _ in range(3):
             inflight = list(self._quorum_inflight)
+            # the loop-exit emptiness checks below are convergence
+            # tests, not decisions acted on: both drains re-snapshot
+            # their pending sets internally, and a fill racing the
+            # check just means one more bounded round
+            # brokerlint: ignore[RACE801]
             await self._forward_sync_drain(timeout)
+            # brokerlint: ignore[RACE801]
             await self.flush_ds(timeout)
             errs = []
             if inflight:
@@ -1881,6 +1903,11 @@ class ClusterNode:
             # retry any initial sync that failed (peer was not yet up)
             for p in self.peers_alive():
                 if p not in self._synced:
+                    # the membership/liveness checks go stale across
+                    # each awaited sync, but _sync_with is an
+                    # idempotent full-state resend — a duplicate or
+                    # late sync is harmless
+                    # brokerlint: ignore[RACE801]
                     await self._sync_with(p)
 
     async def _handle_heartbeat(self, peer: str, obj: Dict) -> None:
@@ -1893,6 +1920,9 @@ class ClusterNode:
         self._mark_alive(node)
         if came_back:
             log.info("%s: node %s is back, resyncing routes", self.name, node)
+            # membership was checked before _mark_alive; a concurrent
+            # removal just makes this an extra idempotent sync
+            # brokerlint: ignore[RACE801]
             await self._sync_with(node)
             # unacked forwarded windows replay NOW: the restarted (or
             # re-reachable) peer gets every frame it never acked —

@@ -1,0 +1,3 @@
+"""Test-only runtime instrumentation shipped with the broker (so the
+racesim harness and downstream users can import it without reaching
+into the test tree).  Nothing in here runs in production paths."""

@@ -1,0 +1,209 @@
+"""Forced-interleaving sanitizer: adversarial task scheduling.
+
+The static RACE8xx rules (tools/brokerlint/racerules.py) reason about
+windows that open when an ``await`` yields the event loop.  This
+module is the runtime counterpart: it wraps every task the loop
+creates in a driver that intercepts each suspension point and — as
+directed by a :class:`SchedulePolicy` — forces extra trips through
+the ready queue before the task is allowed to park on its awaitable.
+A race that needs "another task ran in the window between my check
+and my act" stops being a one-in-a-million timing accident and
+becomes a schedule the policy can hit deterministically (and, with
+the same seed, hit again).
+
+Three policy modes, same spirit as crashsim's crash-point
+enumeration:
+
+  * ``random``   — seeded coin flip at every yieldpoint; the workhorse
+    for property suites (N seeds, same workload).
+  * ``targeted`` — preempt only at sites whose name matches one of
+    the given substrings (site names are ``<coro qualname>:<step>``
+    or ``seam:<failpoint seam>``); everything else runs undisturbed.
+  * ``script``   — an explicit 0/1 decision vector consumed in call
+    order, 0 once exhausted: the building block for exhaustive
+    small-schedule enumeration (see tools/racesim).
+
+Every decision is recorded in ``policy.trace`` — the schedule — so
+"same seed ⇒ same schedule" is a testable property and a failing
+schedule can be replayed as a script.
+
+Usage::
+
+    policy = SchedulePolicy(mode="random", seed=7, prob=1.0)
+    asyncio.run(drive(main(), policy))
+
+``drive`` installs a task factory on the running loop (every task
+spawned by the workload is instrumented too), runs the coroutine,
+and restores the loop on exit.  ``failpoint_yieldpoints`` extends
+coverage to the declared IO seams: inside the context every
+``failpoints.evaluate_async`` call becomes a yieldpoint named
+``seam:<name>``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import random
+import types
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "SchedulePolicy", "drive", "failpoint_yieldpoints",
+    "install", "uninstall",
+]
+
+
+class SchedulePolicy:
+    """Decides, per yieldpoint, how many extra passes through the
+    ready queue to force before the current task may proceed."""
+
+    def __init__(self, mode: str = "random", seed: int = 0,
+                 prob: float = 1.0, max_preempts: int = 64,
+                 sites: Sequence[str] = (),
+                 script: Optional[Iterable[int]] = None) -> None:
+        if mode not in ("random", "targeted", "script"):
+            raise ValueError(f"unknown schedule mode: {mode!r}")
+        self.mode = mode
+        self.seed = seed
+        self.prob = prob
+        # a global preemption budget bounds adversarial overhead: a
+        # hot loop with thousands of awaits still terminates
+        self.max_preempts = max_preempts
+        self.sites = tuple(sites)
+        self._script: List[int] = list(script or ())
+        self._cursor = 0
+        self._rng = random.Random(seed)
+        self._spent = 0
+        self.trace: List[Tuple[str, int]] = []
+
+    def decide(self, site: str) -> int:
+        if self._spent >= self.max_preempts:
+            self.trace.append((site, 0))
+            return 0
+        if self.mode == "script":
+            n = (self._script[self._cursor]
+                 if self._cursor < len(self._script) else 0)
+            self._cursor += 1
+        elif self.mode == "targeted":
+            if any(s in site for s in self.sites):
+                n = 1 if self._rng.random() < self.prob else 0
+            else:
+                n = 0
+        else:  # random
+            n = 1 if self._rng.random() < self.prob else 0
+        self._spent += n
+        self.trace.append((site, n))
+        return n
+
+
+@types.coroutine
+def _yield_once():
+    """One bare yield: parks the driver at the back of the ready
+    queue, so every other ready task runs first."""
+    yield
+
+
+@types.coroutine
+def _forward(obj):
+    """Re-yield the inner coroutine's awaitable outward (the Task
+    parks on the SAME future it would have without us) and hand the
+    loop's wake-up value back."""
+    return (yield obj)
+
+
+async def _drive_coro(coro, policy: SchedulePolicy) -> object:
+    """Manually step `coro`, consulting the policy at every
+    suspension point.  Semantics-preserving: the outer Task parks on
+    exactly the futures the inner coroutine yields; exceptions
+    (including cancellation) are thrown into the inner coroutine at
+    its own suspension point, as the Task would."""
+    qual = getattr(coro, "__qualname__", None) or getattr(
+        coro, "__name__", "coro"
+    )
+    step = 0
+    value: object = None
+    exc: Optional[BaseException] = None
+    while True:
+        try:
+            if exc is not None:
+                e, exc = exc, None
+                yielded = coro.throw(e)
+            else:
+                yielded = coro.send(value)
+        except StopIteration as si:
+            return si.value
+        step += 1
+        site = f"{qual}:{step}"
+        try:
+            for _ in range(policy.decide(site)):
+                await _yield_once()
+        except BaseException as e:  # cancelled during a forced yield
+            value, exc = None, e
+            continue
+        try:
+            value = await _forward(yielded)
+            exc = None
+        except BaseException as e:
+            value, exc = None, e
+
+
+def _task_factory(policy: SchedulePolicy):
+    def factory(loop, coro, **kwargs):
+        if isinstance(coro, types.CoroutineType):
+            coro = _drive_coro(coro, policy)
+        return asyncio.Task(coro, loop=loop, **kwargs)
+    return factory
+
+
+def install(policy: SchedulePolicy,
+            loop: Optional[asyncio.AbstractEventLoop] = None) -> None:
+    """Instrument `loop` (default: running loop): every task created
+    from here on steps through the policy's yieldpoints."""
+    loop = loop or asyncio.get_running_loop()
+    loop.set_task_factory(_task_factory(policy))
+
+
+def uninstall(
+    loop: Optional[asyncio.AbstractEventLoop] = None
+) -> None:
+    loop = loop or asyncio.get_running_loop()
+    loop.set_task_factory(None)
+
+
+async def drive(coro, policy: SchedulePolicy) -> object:
+    """Run `coro` (and every task it spawns) under the policy.
+    The workload itself runs as an instrumented child task so its
+    own awaits are yieldpoints too."""
+    install(policy)
+    try:
+        return await asyncio.get_running_loop().create_task(coro)
+    finally:
+        uninstall()
+
+
+@contextlib.contextmanager
+def failpoint_yieldpoints(policy: SchedulePolicy):
+    """Within the context, every ``failpoints.evaluate_async`` call
+    is also a yieldpoint (site ``seam:<name>``) — the declared IO
+    seams become schedule points even when the failpoint itself is
+    not armed."""
+    from emqx_tpu import failpoints
+
+    orig = failpoints.evaluate_async
+
+    async def seamed(name: str, key=None):
+        for _ in range(policy.decide(f"seam:{name}")):
+            await _yield_once()
+        return await orig(name, key)
+
+    failpoints.evaluate_async = seamed
+    prev_enabled = failpoints.enabled
+    # the seams fast-path on the module flag; without it armed the
+    # patched evaluator never runs
+    failpoints.enabled = True
+    try:
+        yield
+    finally:
+        failpoints.evaluate_async = orig
+        failpoints.enabled = prev_enabled

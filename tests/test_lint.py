@@ -1865,3 +1865,451 @@ def test_dur702_repo_ds_package_is_clean():
         rel = f"emqx_tpu/ds/{p.name}"
         rules = rules_of(p.read_text(), path=rel)
         assert "DUR702" not in rules, rel
+
+
+# ----------------------------------------------------------- RACE8xx
+
+from tools.brokerlint.racerules import (  # noqa: E402
+    SHARED_CLASSES, SharedClass,
+)
+
+# fixtures roster their own Hub class instead of the real one, so the
+# shapes stay minimal and independent of the production tree
+_HUB = [SharedClass("svc/hub.py", "Hub")]
+
+
+def race_rules(src, path="svc/hub.py"):
+    return [f.rule for f in analyze_source(src, path, shared=_HUB)]
+
+
+def race_prog(sources):
+    return [(f.path, f.rule) for f in analyze_program(
+        sources, shared=_HUB
+    )]
+
+
+def test_race801_check_then_act_across_await():
+    bad = (
+        "import asyncio\n"
+        "class Hub:\n"
+        "    def add(self, k, v):\n"
+        "        self.pending[k] = v\n"
+        "    async def take(self, k):\n"
+        "        if k in self.pending:\n"
+        "            await asyncio.sleep(0)\n"
+        "            return self.pending.pop(k)\n"
+        "        return None\n"
+    )
+    assert race_rules(bad) == ["RACE801"]
+    # the act re-validated AFTER the suspension: clean
+    ok = (
+        "import asyncio\n"
+        "class Hub:\n"
+        "    def add(self, k, v):\n"
+        "        self.pending[k] = v\n"
+        "    async def take(self, k):\n"
+        "        await asyncio.sleep(0)\n"
+        "        if k in self.pending:\n"
+        "            return self.pending.pop(k)\n"
+        "        return None\n"
+    )
+    assert race_rules(ok) == []
+
+
+def test_race801_suppression():
+    src = (
+        "import asyncio\n"
+        "class Hub:\n"
+        "    def add(self, k, v):\n"
+        "        self.pending[k] = v\n"
+        "    async def take(self, k):\n"
+        "        if k in self.pending:\n"
+        "            await asyncio.sleep(0)\n"
+        "            # brokerlint: ignore[RACE801] single taker\n"
+        "            return self.pending.pop(k)\n"
+        "        return None\n"
+    )
+    assert race_rules(src) == []
+
+
+def test_race801_suspension_two_calls_deep():
+    """The await that opens the window hides behind two helper
+    frames — the summary pass must carry `suspends` up the chain."""
+    src = (
+        "import asyncio\n"
+        "class Hub:\n"
+        "    def add(self, k, v):\n"
+        "        self.pending[k] = v\n"
+        "    async def _h2(self):\n"
+        "        await asyncio.sleep(0)\n"
+        "    async def _h1(self):\n"
+        "        await self._h2()\n"
+        "    async def take(self, k):\n"
+        "        if k in self.pending:\n"
+        "            await self._h1()\n"
+        "            return self.pending.pop(k)\n"
+        "        return None\n"
+    )
+    assert race_rules(src) == ["RACE801"]
+
+
+def test_race801_acceptance_helper_two_modules_deep():
+    """Acceptance fixture (a): the check-then-act window opens through
+    a helper chain spanning two OTHER modules; re-checking after the
+    await comes back clean."""
+    tree = {
+        "svc/io2.py": (
+            "import asyncio\n"
+            "async def flush2():\n"
+            "    await asyncio.sleep(0)\n"
+        ),
+        "svc/io1.py": (
+            "from .io2 import flush2\n"
+            "async def flush():\n"
+            "    await flush2()\n"
+        ),
+        "svc/hub.py": (
+            "from .io1 import flush\n"
+            "class Hub:\n"
+            "    def add(self, k, v):\n"
+            "        self.pending[k] = v\n"
+            "    async def take(self, k):\n"
+            "        if k in self.pending:\n"
+            "            await flush()\n"
+            "            return self.pending.pop(k)\n"
+            "        return None\n"
+        ),
+    }
+    assert race_prog(tree) == [("svc/hub.py", "RACE801")]
+    fixed = dict(tree)
+    fixed["svc/hub.py"] = (
+        "from .io1 import flush\n"
+        "class Hub:\n"
+        "    def add(self, k, v):\n"
+        "        self.pending[k] = v\n"
+        "    async def take(self, k):\n"
+        "        await flush()\n"
+        "        if k in self.pending:\n"
+        "            return self.pending.pop(k)\n"
+        "        return None\n"
+    )
+    assert race_prog(fixed) == []
+
+
+# ----------------------------------------------------------- RACE802
+
+def test_race802_suspension_inside_iteration():
+    bad = (
+        "import asyncio\n"
+        "class Hub:\n"
+        "    def add(self, k, s):\n"
+        "        self.subs[k] = s\n"
+        "    def drop(self, k):\n"
+        "        self.subs.pop(k, None)\n"
+        "    async def notify(self):\n"
+        "        for k in self.subs:\n"
+        "            await asyncio.sleep(0)\n"
+    )
+    assert race_rules(bad) == ["RACE802"]
+    # snapshot iteration: clean
+    ok = bad.replace("in self.subs:", "in list(self.subs):")
+    assert race_rules(ok) == []
+
+
+def test_race802_body_mutates_iterated_container():
+    src = (
+        "class Hub:\n"
+        "    def sweep(self, dead):\n"
+        "        for k in self.subs:\n"
+        "            if k in dead:\n"
+        "                self.subs.pop(k)\n"
+    )
+    assert race_rules(src) == ["RACE802"]
+
+
+def test_race802_alias_bound_mutator():
+    """The mutation hides behind `self.cb = self._drop`: the resolver
+    follows the one-level alias to the bound method's summary."""
+    src = (
+        "class Hub:\n"
+        "    def __init__(self):\n"
+        "        self.subs = {}\n"
+        "        self.cb = self._drop\n"
+        "    def _drop(self, k):\n"
+        "        self.subs.pop(k, None)\n"
+        "    def sweep(self, dead):\n"
+        "        for k in self.subs:\n"
+        "            if k in dead:\n"
+        "                self.cb(k)\n"
+    )
+    assert race_rules(src) == ["RACE802"]
+
+
+def test_race802_suppression():
+    src = (
+        "class Hub:\n"
+        "    def sweep(self, dead):\n"
+        "        # brokerlint: ignore[RACE802] returns right after\n"
+        "        for k in self.subs:\n"
+        "            if k in dead:\n"
+        "                self.subs.pop(k)\n"
+        "                return\n"
+    )
+    assert race_rules(src) == []
+
+
+# ----------------------------------------------------------- RACE803
+
+def test_race803_acceptance_thread_loop_crossing():
+    """Acceptance fixture (b): a worker thread mutates a dict the
+    event loop reads — flagged; clean once the mutation is handed to
+    the loop with call_soon_threadsafe, or once the ownership rule is
+    documented with `# loop-ownership:`."""
+    bad = (
+        "import threading\n"
+        "class Hub:\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._worker).start()\n"
+        "    def _worker(self):\n"
+        "        self.stats['n'] = 1\n"
+        "    async def report(self):\n"
+        "        return len(self.stats)\n"
+    )
+    assert race_rules(bad) == ["RACE803"]
+
+    handed_off = (
+        "import threading\n"
+        "class Hub:\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._worker).start()\n"
+        "    def _worker(self):\n"
+        "        self.loop.call_soon_threadsafe(self._apply, 1)\n"
+        "    def _apply(self, n):\n"
+        "        self.stats['n'] = n\n"
+        "    async def report(self):\n"
+        "        return len(self.stats)\n"
+    )
+    assert race_rules(handed_off) == []
+
+    annotated = (
+        "import threading\n"
+        "class Hub:\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._worker).start()\n"
+        "    def _worker(self):\n"
+        "        # loop-ownership: GIL-atomic store of a gauge the\n"
+        "        # loop only reads for display; torn sizes are fine\n"
+        "        self.stats['n'] = 1\n"
+        "    async def report(self):\n"
+        "        return len(self.stats)\n"
+    )
+    assert race_rules(annotated) == []
+
+
+def test_race803_locked_sites_are_lock403_territory():
+    """A lock around the thread-side mutation silences RACE803 — the
+    dual-context lock itself is LOCK403's beat (it wants its own
+    `# lock-ownership:` justification)."""
+    src = (
+        "import threading\n"
+        "class Hub:\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._worker).start()\n"
+        "    def _worker(self):\n"
+        "        with self._lock:\n"
+        "            self.stats['n'] = 1\n"
+        "    async def report(self):\n"
+        "        with self._lock:\n"
+        "            return len(self.stats)\n"
+    )
+    rules = race_rules(src)
+    assert "RACE803" not in rules
+    assert "LOCK403" in rules
+
+
+def test_race803_suppression():
+    src = (
+        "import threading\n"
+        "class Hub:\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._worker).start()\n"
+        "    def _worker(self):\n"
+        "        # brokerlint: ignore[RACE803] fixture reason\n"
+        "        self.stats['n'] = 1\n"
+        "    async def report(self):\n"
+        "        return len(self.stats)\n"
+    )
+    assert race_rules(src) == []
+
+
+# ----------------------------------------------------------- RACE804
+
+def test_race804_related_pair_torn_across_await():
+    bad = (
+        "import asyncio\n"
+        "class Hub:\n"
+        "    def reset(self):\n"
+        "        self.epoch = 0\n"
+        "        self.epoch_key = b''\n"
+        "    async def rotate(self):\n"
+        "        self.epoch = self.epoch + 1\n"
+        "        await asyncio.sleep(0)\n"
+        "        self.epoch_key = b'x'\n"
+    )
+    assert race_rules(bad) == ["RACE804"]
+    # both halves written before the suspension: clean
+    ok = (
+        "import asyncio\n"
+        "class Hub:\n"
+        "    def reset(self):\n"
+        "        self.epoch = 0\n"
+        "        self.epoch_key = b''\n"
+        "    async def rotate(self):\n"
+        "        self.epoch = self.epoch + 1\n"
+        "        self.epoch_key = b'x'\n"
+        "        await asyncio.sleep(0)\n"
+    )
+    assert race_rules(ok) == []
+
+
+def test_race804_suppression():
+    src = (
+        "import asyncio\n"
+        "class Hub:\n"
+        "    def reset(self):\n"
+        "        self.epoch = 0\n"
+        "        self.epoch_key = b''\n"
+        "    async def rotate(self):\n"
+        "        self.epoch = self.epoch + 1\n"
+        "        await asyncio.sleep(0)\n"
+        "        # brokerlint: ignore[RACE804] stale key tolerated\n"
+        "        self.epoch_key = b'x'\n"
+    )
+    assert race_rules(src) == []
+
+
+def test_shared_roster_matches_tree():
+    """Rot guard: every SHARED_CLASSES entry must still name a class
+    that exists in the real tree (a rename silently un-rosters the
+    singleton and the RACE rules go blind to it)."""
+    repo = Path(__file__).resolve().parents[1]
+    for spec in SHARED_CLASSES:
+        p = repo / spec.path_suffix
+        assert p.exists(), f"rostered module gone: {spec}"
+        assert f"class {spec.name}" in p.read_text(), \
+            f"rostered class gone: {spec}"
+
+
+# ------------------------------------------------------------ MET901
+
+def test_met901_unregistered_counter_name():
+    tree = {
+        "svc/metrics.py": (
+            "METRICS = (\n"
+            "    'messages.received',\n"
+            ")\n"
+            "EXTRA_METRIC_PREFIXES = ('gw.',)\n"
+        ),
+        "svc/app.py": (
+            "class App:\n"
+            "    def f(self):\n"
+            "        self.metrics.inc('messages.recieved')\n"
+            "    def g(self):\n"
+            "        self.metrics.inc('messages.received')\n"
+            "    def h(self):\n"
+            "        self.metrics.observe('gw.rtt', 3)\n"
+            "    def i(self, name):\n"
+            "        self.metrics.inc(name)\n"
+        ),
+    }
+    # only the typo'd literal fires: the registered name, the prefix
+    # family, and the dynamic name all pass
+    assert race_prog(tree) == [("svc/app.py", "MET901")]
+
+
+def test_met901_suppression_and_no_registry():
+    tree = {
+        "svc/metrics.py": "METRICS = ('a.b',)\n",
+        "svc/app.py": (
+            "class App:\n"
+            "    def f(self):\n"
+            "        # brokerlint: ignore[MET901] runtime-registered\n"
+            "        self.metrics.inc('a.typo')\n"
+        ),
+    }
+    assert race_prog(tree) == []
+    # a program with NO registry module skips MET901 entirely
+    assert race_prog({"svc/app.py": tree["svc/app.py"]}) == []
+
+
+def test_race_and_metrics_families_clean_on_repo():
+    """The burn-down's end state, asserted family-precisely (the gate
+    already covers it via the empty baseline): no RACE8xx or MET901
+    debt anywhere on the default surface."""
+    findings = [
+        f for f in run_lint(list(DEFAULT_PATHS))
+        if f.rule.startswith("RACE") or f.rule == "MET901"
+    ]
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+# ------------------------------------------- program-findings cache
+
+def test_program_cache_invalidates_on_callee_edit(tmp_path):
+    """THE cache-correctness property: a file's interprocedural
+    findings may replay from cache only while its dependency digest
+    holds — editing ONLY a callee module must re-lint the caller
+    (whose own mtime did not change) and surface the new transitive
+    finding there."""
+    from tools.brokerlint import engine
+
+    helpers = tmp_path / "helpers.py"
+    srv = tmp_path / "srv.py"
+    helpers.write_text("import time\ndef slow():\n    pass\n")
+    srv.write_text(
+        "from helpers import slow\nasync def handle():\n    slow()\n"
+    )
+    first = run_lint([str(tmp_path)], root=str(tmp_path))
+    assert [f.rule for f in first] == []
+    # warm run: everything replays from the per-file program cache
+    run_lint([str(tmp_path)], root=str(tmp_path))
+    prof = engine.LAST_PROFILE
+    assert prof["files"]["srv.py"] == {
+        "index": "hit", "program": "hit",
+    }
+    # edit ONLY the callee so it now blocks
+    helpers.write_text("import time\ndef slow():\n    time.sleep(1)\n")
+    st = helpers.stat()
+    os.utime(helpers, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+    third = run_lint([str(tmp_path)], root=str(tmp_path))
+    assert [(f.path, f.rule) for f in third] == [
+        ("srv.py", "ASYNC101")
+    ]
+    prof = engine.LAST_PROFILE
+    # srv.py's SOURCE cache held (unchanged file) but its program
+    # findings were recomputed — the dep digest saw slow()'s new
+    # summary through the call edge
+    assert prof["files"]["srv.py"] == {
+        "index": "hit", "program": "miss",
+    }
+    assert prof["files"]["helpers.py"]["index"] == "miss"
+
+
+def test_profile_shape_covers_race_families():
+    """--profile's data source: every run_lint rewrites LAST_PROFILE
+    with per-family timings (the RACE pass included) and per-file
+    cache verdicts."""
+    from tools.brokerlint import engine
+
+    run_lint(list(DEFAULT_PATHS))
+    prof = engine.LAST_PROFILE
+    assert {"program:summaries", "program:digest",
+            "program:race-local", "program:race-global"} <= set(
+        prof["families"]
+    )
+    assert all(v >= 0.0 for v in prof["families"].values())
+    assert prof["files"], "no per-file cache verdicts recorded"
+    assert all(
+        rec.get("index") in ("hit", "miss")
+        for rec in prof["files"].values()
+    )

@@ -1,0 +1,288 @@
+"""racesim (tools/racesim) + the forced-interleaving sanitizer
+(emqx_tpu.testing.interleave).
+
+Three layers, mirroring the crashsim suite's shape:
+
+  * harness properties — same seed => same schedule, a failing trace
+    replays as a script, the preemption budget bounds overhead, the
+    declared failpoint seams become yieldpoints;
+  * reproduction — the canonical check-then-act race fails under the
+    seeded sweep and the exhaustive small-schedule mode, the re-checked
+    fix survives every one of the same schedules;
+  * hostile-schedule regressions for the sites the RACE8xx burn-down
+    fixed (ResumeScheduler stop/start, ClusterNode.stop's task-list
+    swap, _take_parked's snapshot scan): the repaired shapes hold their
+    invariants under adversarial interleaving, pinned so a future edit
+    cannot quietly reintroduce the window.
+"""
+
+import asyncio
+
+import pytest
+
+from emqx_tpu import failpoints
+from emqx_tpu.broker.resume import ResumeScheduler, _Job
+from emqx_tpu.cluster.node import ClusterNode
+from emqx_tpu.testing.interleave import (
+    SchedulePolicy, drive, failpoint_yieldpoints,
+)
+from tools.racesim import run_exhaustive, run_schedule, run_seeds
+
+
+# ------------------------------------------------------ toy workloads
+
+class _CheckThenAct:
+    """The canonical RACE801 shape: membership check and pop separated
+    by an await, so two concurrent takers can both pass the check."""
+
+    def __init__(self):
+        self.pending = {"k": 1}
+
+    async def take_racy(self):
+        if "k" in self.pending:
+            await asyncio.sleep(0)       # the window
+            self.pending.pop("k")        # KeyError when raced
+
+    async def take_fixed(self):
+        if "k" in self.pending:
+            await asyncio.sleep(0)
+            self.pending.pop("k", None)  # act re-validates
+
+
+def _racy_workload():
+    async def main():
+        obj = _CheckThenAct()
+        await asyncio.gather(obj.take_racy(), obj.take_racy())
+    return main()
+
+
+def _fixed_workload():
+    async def main():
+        obj = _CheckThenAct()
+        await asyncio.gather(obj.take_fixed(), obj.take_fixed())
+    return main()
+
+
+# -------------------------------------------------- harness properties
+
+def test_same_seed_same_schedule():
+    a = run_schedule(_racy_workload,
+                     SchedulePolicy(mode="random", seed=42), label="a")
+    b = run_schedule(_racy_workload,
+                     SchedulePolicy(mode="random", seed=42), label="b")
+    assert a.trace == b.trace
+    assert a.trace, "no yieldpoints were exercised"
+    assert type(a.error) is type(b.error)
+
+
+def test_failing_trace_replays_as_script():
+    outcomes = run_seeds(_racy_workload, seeds=range(8))
+    failing = next(o for o in outcomes if o.failed)
+    script = [n for _site, n in failing.trace]
+    replay = run_schedule(
+        _racy_workload, SchedulePolicy(mode="script", script=script),
+        label="replay",
+    )
+    assert replay.failed
+    assert type(replay.error) is type(failing.error)
+
+
+def test_preemption_budget_bounds_overhead():
+    async def main():
+        for _ in range(50):
+            await asyncio.sleep(0)
+
+    policy = SchedulePolicy(mode="random", seed=1, prob=1.0,
+                            max_preempts=2)
+    asyncio.run(drive(main(), policy))
+    assert sum(n for _site, n in policy.trace) <= 2
+    assert len(policy.trace) >= 50  # every yieldpoint still consulted
+
+
+def test_failpoint_seams_become_yieldpoints():
+    policy = SchedulePolicy(mode="random", seed=3)
+
+    async def main():
+        await failpoints.evaluate_async("racesim.fixture.seam")
+
+    with failpoint_yieldpoints(policy):
+        asyncio.run(drive(main(), policy))
+    assert any(site == "seam:racesim.fixture.seam"
+               for site, _n in policy.trace)
+    # the context restored the module seam hooks on exit
+    assert not failpoints.enabled
+
+
+# ----------------------------------------------------- reproduction
+
+def test_seeded_sweep_reproduces_check_then_act():
+    outcomes = run_seeds(_racy_workload, seeds=range(8))
+    failing = [o for o in outcomes if o.failed]
+    assert failing, "no seed reproduced the race"
+    assert all(isinstance(o.error, KeyError) for o in failing)
+
+
+def test_fixed_shape_survives_every_seed():
+    outcomes = run_seeds(_fixed_workload, seeds=range(8))
+    bad = [o for o in outcomes if o.failed]
+    assert not bad, f"{bad[0].label}: {bad[0].error!r}"
+
+
+def test_exhaustive_small_schedules():
+    racy = run_exhaustive(_racy_workload, points=4)
+    assert len(racy) == 16
+    assert any(o.failed for o in racy)
+    fixed = run_exhaustive(_fixed_workload, points=4)
+    assert not any(o.failed for o in fixed)
+
+
+@pytest.mark.slow
+def test_exhaustive_large_schedule_space():
+    """The real exhaustive mode: 2^10 schedules each way."""
+    racy = run_exhaustive(_racy_workload, points=10)
+    assert any(o.failed for o in racy)
+    fixed = run_exhaustive(_fixed_workload, points=10)
+    bad = [o for o in fixed if o.failed]
+    assert not bad, f"{bad[0].label}: {bad[0].error!r}"
+
+
+def test_targeted_mode_finds_fifo_assumption():
+    """Forced preemption finds what the normal scheduler cannot: the
+    watcher's 'one turn per yield' FIFO assumption holds under the
+    undisturbed schedule and breaks once its awaits are widened."""
+
+    def workload():
+        async def main():
+            counter = {"n": 0}
+
+            async def ticker():
+                for _ in range(6):
+                    counter["n"] += 1
+                    await asyncio.sleep(0)
+
+            t = asyncio.get_running_loop().create_task(ticker())
+            await asyncio.sleep(0)
+            before = counter["n"]
+            await asyncio.sleep(0)  # "exactly one turn" assumption
+            assert counter["n"] - before <= 1, "FIFO assumption broken"
+            await t
+        return main()
+
+    undisturbed = run_schedule(
+        workload, SchedulePolicy(mode="script", script=()),
+        label="undisturbed",
+    )
+    assert not undisturbed.failed, repr(undisturbed.error)
+
+    # "main:" matches the driver sites of the outer coroutine only
+    # (ticker's qualname continues "...main.<locals>.ticker")
+    hostile = SchedulePolicy(mode="targeted", sites=("main:",),
+                             seed=0, prob=1.0)
+    out = run_schedule(workload, hostile, label="targeted")
+    assert out.failed and isinstance(out.error, AssertionError)
+    assert all(n == 0 for site, n in out.trace if "main:" not in site)
+
+
+# ------------------------- hostile-schedule regressions (fixed sites)
+
+class _Cfg:
+    max_concurrent = 4
+    park_queue_cap = 8
+
+
+class _Olp:
+    defer_admissions = False
+
+    def shed(self, *a):
+        pass
+
+
+class _Metrics:
+    def inc(self, *a, **k):
+        pass
+
+
+class _Broker:
+    def __init__(self):
+        self.olp = _Olp()
+        self.metrics = _Metrics()
+
+
+def _resume_stop_start_workload():
+    async def main():
+        sched = ResumeScheduler(_Broker(), _Cfg())
+        await sched.start()
+        await asyncio.sleep(0)  # let the drive task park on its event
+        # a stop() and a start() racing: the start lands inside stop's
+        # cancel window and must find the stopped state already
+        # committed (running False, no task) — not a torn running=False
+        # with the old task still registered, which made it no-op and
+        # leave the scheduler dead
+        await asyncio.gather(sched.stop(), sched.start())
+        assert sched.running, "start() during stop() left it dead"
+        assert sched._task is not None
+        await sched.stop()
+        assert not sched.running and sched._task is None
+    return main()
+
+
+def test_resume_scheduler_stop_start_race():
+    outcomes = run_seeds(_resume_stop_start_workload, seeds=range(10))
+    bad = [o for o in outcomes if o.failed]
+    assert not bad, f"{bad[0].label}: {bad[0].error!r}"
+
+
+def _node_stop_workload():
+    async def main():
+        node = object.__new__(ClusterNode)
+        node._started = True
+        node.raft_conf = None
+        node.raft_ds = None
+
+        class _Transport:
+            async def stop(self):
+                pass
+
+        node.transport = _Transport()
+        loop = asyncio.get_running_loop()
+        old = loop.create_task(asyncio.sleep(30))
+        node._tasks = [old]
+        late = loop.create_task(asyncio.sleep(30))
+
+        async def restarter():
+            # a start() racing mid-stop: repopulates _tasks while
+            # stop() is parked reaping the old generation
+            node._tasks.append(late)
+
+        await asyncio.gather(node.stop(), restarter())
+        try:
+            assert late in node._tasks, \
+                "stop() dropped the racing start()'s task"
+            assert not late.cancelled()
+        finally:
+            late.cancel()
+            try:
+                await late
+            except asyncio.CancelledError:
+                pass
+        assert old.cancelled() or old.done()
+    return main()
+
+
+def test_cluster_node_stop_keeps_racing_starts_tasks():
+    outcomes = run_seeds(_node_stop_workload, seeds=range(10))
+    bad = [o for o in outcomes if o.failed]
+    assert not bad, f"{bad[0].label}: {bad[0].error!r}"
+
+
+def test_take_parked_scans_a_snapshot():
+    sched = ResumeScheduler(_Broker(), _Cfg())
+    jobs = [_Job(cid, object(), object()) for cid in ("a", "b", "c")]
+    for j in jobs:
+        sched._parked.append(j)
+        sched._parked_ids.add(j.clientid)
+    got = sched._take_parked("b")
+    assert got is jobs[1]
+    assert [j.clientid for j in sched._parked] == ["a", "c"]
+    assert sched._parked_ids == {"a", "c"}
+    assert sched._take_parked("zz") is None

@@ -112,6 +112,10 @@ def main(argv=None) -> int:
                     help="rewrite the baseline file from this run "
                          "(each entry still deserves a justification "
                          "comment — add them before committing)")
+    ap.add_argument("--profile", action="store_true",
+                    help="print per-rule-family wall time and "
+                         "per-file cache hit/miss stats after the "
+                         "run")
     args = ap.parse_args(argv)
 
     findings = run_lint(args.paths or list(DEFAULT_PATHS))
@@ -162,7 +166,40 @@ def main(argv=None) -> int:
         print(f"brokerlint: {len(findings)} finding(s), "
               f"{len(new)} new, {len(stale)} stale baseline entr"
               f"{'y' if len(stale) == 1 else 'ies'}")
+    if args.profile:
+        _print_profile()
     return 1 if new else 0
+
+
+def _print_profile() -> None:
+    from .engine import LAST_PROFILE
+
+    fams = LAST_PROFILE.get("families", {})
+    files = LAST_PROFILE.get("files", {})
+    total = sum(fams.values())
+    print("\n-- profile: rule-family wall time "
+          f"(total {total * 1000:.1f} ms) --")
+    for name, secs in sorted(fams.items(), key=lambda kv: -kv[1]):
+        print(f"  {name:24s} {secs * 1000:9.1f} ms")
+    counts = {"index": {"hit": 0, "miss": 0},
+              "program": {"hit": 0, "miss": 0}}
+    for stats in files.values():
+        for kind, val in stats.items():
+            if kind in counts and val in counts[kind]:
+                counts[kind][val] += 1
+    print("-- caches: "
+          f"index {counts['index']['hit']} hit / "
+          f"{counts['index']['miss']} miss; "
+          f"program-findings {counts['program']['hit']} hit / "
+          f"{counts['program']['miss']} miss --")
+    cold = sorted(
+        path for path, stats in files.items()
+        if "miss" in (stats.get("index"), stats.get("program"))
+    )
+    for path in cold:
+        stats = files[path]
+        print(f"  {path}: index={stats.get('index', '-')} "
+              f"program={stats.get('program', '-')}")
 
 
 if __name__ == "__main__":

@@ -121,6 +121,12 @@ class ModuleIndex:
         # run-to-run caches (valid for this (mtime, size) index):
         self.file_cache = None     # (findings, io_methods, fp_methods)
         self.wrapped_cache = None  # devicerules._wrapped_names result
+        # per-file PROGRAM findings cache: (dep_digest, findings).
+        # NOT keyed by this file's identity alone — the digest covers
+        # the dependency summaries, so editing ONLY a callee
+        # invalidates the caller's entry (engine._dep_digest)
+        self.program_cache = None
+        self.from_cache = False    # did index_file serve this warm?
         self._index()
 
     # ------------------------------------------------------- indexing
@@ -277,6 +283,7 @@ def index_file(abspath: str, rel: str) -> ModuleIndex:
     hit = _INDEX_CACHE.get(abspath)
     if hit is not None and (hit[0], hit[1]) == key and \
             hit[2].path == rel:
+        hit[2].from_cache = True
         return hit[2]
     with open(abspath, "r") as f:
         source = f.read()

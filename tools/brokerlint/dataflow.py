@@ -30,6 +30,22 @@ interprocedural rules consume:
   * ``acquires``     — normalized lock tokens this function
                        (transitively) acquires; feeds the LOCK401
                        lock-order graph.
+  * ``suspends``     — awaiting this ASYNC function can GENUINELY
+                       yield the event loop to another task (it
+                       transitively awaits IO, a sleep/gather/queue/
+                       lock primitive, a bare future, or enters an
+                       ``async for``/``async with``).  Strictly wider
+                       than ``awaits_io`` — ``await asyncio.sleep(0)``
+                       suspends without IO — and the atomicity-window
+                       fact RACE801/802/804 hang on: an await of a
+                       pure async helper that never suspends does NOT
+                       open a task-switch window.
+  * ``mutates``      — ``module.Class.attr`` tokens for the
+                       self-attributes this function (transitively,
+                       through resolved calls — including
+                       ``self.cb = self._m`` aliases) mutates; feeds
+                       the RACE802 iterate-while-mutating check and
+                       the RACE801 act-through-helper resolution.
 
 Facts are monotone (None -> value, sets grow), so mutual recursion
 converges: Tarjan emits SCCs callee-first and each SCC iterates to a
@@ -48,9 +64,18 @@ from typing import Dict, Iterable, List, Optional, Set, Tuple
 from . import callgraph
 from .asyncrules import _is_lockish, is_blocking_call
 from .devicerules import _CASTS, _Staticness
-from .engine import awaits_io, call_tail, dotted_name
+from .engine import IO_AWAIT_NAMES, awaits_io, call_tail, dotted_name
 
 Key = Tuple[str, str]  # (path, qualname)
+
+# awaited call tails that suspend WITHOUT being IO: scheduling
+# primitives, queue/lock waits, executor hand-offs.  Together with
+# IO_AWAIT_NAMES these are the base "this await can yield the loop"
+# facts; `sleep` covers asyncio.sleep(0), the canonical pure yield.
+SUSPEND_AWAIT_NAMES: Set[str] = IO_AWAIT_NAMES | {
+    "sleep", "gather", "acquire", "join", "to_thread",
+    "run_in_executor", "shield", "wait_durable",
+}
 
 
 @dataclass
@@ -68,6 +93,8 @@ class FnSummary:
     # does the BODY contain a token-resolved lock acquisition?  (the
     # lock rules skip their held-walk for lock-free functions)
     has_lock_ctx: bool = False
+    suspends: Optional[Tuple[str, str]] = None     # (name, via)
+    mutates: Set[str] = field(default_factory=set)  # mod.Cls.attr
 
 
 # ----------------------------------------------------------- helpers
@@ -183,6 +210,85 @@ def stmt_invalidates_arena(node: ast.AST) -> bool:
             _is_arena_buf(node.func.value):
         return True
     return False
+
+
+# container-mutating method tails: receiver `self.X.<tail>(...)`
+# counts as a mutation of attribute X
+MUTATOR_TAILS: Set[str] = {
+    "append", "appendleft", "add", "remove", "discard", "pop",
+    "popleft", "popitem", "clear", "update", "extend", "insert",
+    "setdefault", "rotate", "sort",
+}
+
+
+def self_attr_of(expr: ast.AST) -> Optional[str]:
+    """``self.X``/``cls.X`` -> ``X`` (None for anything else)."""
+    if isinstance(expr, ast.Attribute) and isinstance(
+        expr.value, ast.Name
+    ) and expr.value.id in ("self", "cls"):
+        return expr.attr
+    return None
+
+
+def _mut_target_attr(target: ast.AST) -> Optional[str]:
+    """The self-attr a store/delete TARGET mutates: ``self.X``
+    (rebind), ``self.X[k]`` (item store/delete)."""
+    attr = self_attr_of(target)
+    if attr is not None:
+        return attr
+    if isinstance(target, ast.Subscript):
+        return self_attr_of(target.value)
+    return None
+
+
+def attr_mutations(node: ast.AST) -> List[str]:
+    """Self-attributes this single node mutates (base RACE fact):
+    assignment/augassign/del targets and container-mutator calls."""
+    out: List[str] = []
+    if isinstance(node, ast.Assign):
+        targets: List[ast.AST] = []
+        for t in node.targets:
+            targets.extend(t.elts if isinstance(
+                t, (ast.Tuple, ast.List)) else [t])
+        for t in targets:
+            attr = _mut_target_attr(t)
+            if attr is not None:
+                out.append(attr)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        if getattr(node, "value", True) is not None:
+            attr = _mut_target_attr(node.target)
+            if attr is not None:
+                out.append(attr)
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            attr = _mut_target_attr(t)
+            if attr is not None:
+                out.append(attr)
+    elif isinstance(node, ast.Call) and isinstance(
+        node.func, ast.Attribute
+    ) and node.func.attr in MUTATOR_TAILS:
+        attr = self_attr_of(node.func.value)
+        if attr is not None:
+            out.append(attr)
+    return out
+
+
+def await_suspends(node: ast.Await) -> Optional[str]:
+    """Base fact: can THIS await yield the loop?  A bare future/event
+    value always can; a call only when its tail is a known suspending
+    primitive (IO names + sleep/gather/queue/lock waits).  Awaits of
+    unresolved helper calls return None here — the propagation step
+    adds them when the resolved callee's summary suspends
+    (under-approximate, never guess)."""
+    v = node.value
+    if not any(isinstance(s, ast.Call) for s in ast.walk(v)):
+        return dotted_name(v) or "<future>"
+    for sub in ast.walk(v):
+        if isinstance(sub, ast.Call):
+            tail = call_tail(sub)
+            if tail in SUSPEND_AWAIT_NAMES:
+                return tail
+    return None
 
 
 _LOCK_CTORS = {"Lock", "RLock", "Semaphore", "BoundedSemaphore",
@@ -350,6 +456,19 @@ def _base_summary(fn: callgraph.FuncInfo,
             hit = awaits_io(sub.value)
             if hit is not None and s.awaits_io is None and fn.is_async:
                 s.awaits_io = (hit, "")
+            if s.suspends is None and fn.is_async:
+                sus = await_suspends(sub)
+                if sus is not None:
+                    s.suspends = (sus, "")
+        if isinstance(sub, (ast.AsyncFor, ast.AsyncWith)) and \
+                s.suspends is None and fn.is_async:
+            s.suspends = (
+                "async-for" if isinstance(sub, ast.AsyncFor)
+                else "async-with", "",
+            )
+        if fn.cls is not None:
+            for attr in attr_mutations(sub):
+                s.mutates.add(f"{mod.dotted}.{fn.cls}.{attr}")
         if stmt_invalidates_arena(sub) and s.invalidates is None:
             s.invalidates = "arena"
         if isinstance(sub, (ast.With, ast.AsyncWith)):
@@ -451,6 +570,16 @@ def _update(fn: callgraph.FuncInfo, s: FnSummary,
         if not cs.acquires <= s.acquires:
             s.acquires |= cs.acquires
             changed = True
+        if s.suspends is None and cs.suspends is not None and \
+                fn.is_async and callee.is_async:
+            if awaited is None:
+                awaited = awaited_calls(fn.node)
+            if id(call) in awaited:
+                s.suspends = (cs.suspends[0], callee.name)
+                changed = True
+        if not cs.mutates <= s.mutates:
+            s.mutates |= cs.mutates
+            changed = True
     return changed
 
 
@@ -473,8 +602,22 @@ def summarize(
     return summaries
 
 
+def summary_sig(s: FnSummary) -> str:
+    """Stable serialization of one summary — the unit the program-
+    findings cache digests: a caller's cached interprocedural findings
+    are valid exactly while its own source and its direct callees'
+    summary_sigs are unchanged."""
+    return repr((
+        s.blocks, s.awaits_io, s.sync_always, s.sync_traced,
+        s.sync_traced_params, s.invalidates, s.native,
+        tuple(sorted(s.acquires)), s.has_lock_ctx, s.suspends,
+        tuple(sorted(s.mutates)),
+    ))
+
+
 __all__ = [
-    "FnSummary", "awaited_calls", "flow_params", "lock_token",
-    "sccs", "stmt_invalidates_arena", "summarize", "traced_params",
-    "walk_pruned",
+    "FnSummary", "MUTATOR_TAILS", "SUSPEND_AWAIT_NAMES",
+    "attr_mutations", "await_suspends", "awaited_calls", "flow_params",
+    "lock_token", "sccs", "self_attr_of", "stmt_invalidates_arena",
+    "summarize", "summary_sig", "traced_params", "walk_pruned",
 ]

@@ -22,7 +22,12 @@ families (see the sibling modules):
     at GIL-released boundaries (interprocedural);
   * lock discipline   (``LOCK4xx``, lockrules.py)      — program-wide
     lock-order inversions, locks held across await/native
-    boundaries, async+thread dual-context locks (interprocedural).
+    boundaries, async+thread dual-context locks (interprocedural);
+  * async atomicity   (``RACE8xx``, racerules.py)      — check-then-
+    act windows across suspensions, unsafe shared-container
+    iteration, thread<->loop crossings, torn multi-field updates
+    over the shared-singleton roster (interprocedural), plus the
+    ``MET901`` metrics-registry contract.
 
 The interprocedural substrate (callgraph.py: whole-program index +
 resolved call graph, mtime-cached; dataflow.py: bottom-up SCC
@@ -45,7 +50,9 @@ shrinks with the debt.
 from __future__ import annotations
 
 import ast
+import hashlib
 import re
+import time
 from collections import Counter
 from dataclasses import dataclass
 from pathlib import Path
@@ -287,68 +294,189 @@ def is_failpoint_call(call: ast.Call) -> bool:
 
 # -------------------------------------------------------------- runner
 
+# last run's profile, rewritten by every run_lint call:
+#   {"families": {name: seconds}, "files": {path: {"index": "hit"|
+#    "miss", "program": "hit"|"miss"}}}
+# __main__ prints it under --profile; tests assert its shape.
+LAST_PROFILE: Dict = {}
+
+
+def _tick(prof: Optional[Dict], family: str, t0: float) -> None:
+    if prof is not None:
+        fam = prof["families"]
+        fam[family] = fam.get(family, 0.0) + (time.perf_counter() - t0)
+
+
+def _mark(prof: Optional[Dict], path: str, kind: str,
+          value: str) -> None:
+    if prof is not None:
+        prof["files"].setdefault(path, {})[kind] = value
+
+
 def _run_file_checks(ctx: ModuleContext,
                      seams: Optional[Sequence],
-                     dispatch: Optional[Sequence]) -> None:
+                     dispatch: Optional[Sequence],
+                     prof: Optional[Dict] = None) -> None:
     from . import (
         asyncrules, devicerules, durrules, failpointrules, obsrules,
         perfrules,
     )
 
-    asyncrules.check(ctx)
-    devicerules.check(ctx)
-    durrules.check(ctx)
-    failpointrules.check(
-        ctx, failpointrules.SEAM_FUNCS if seams is None else seams
-    )
-    perfrules.check(
-        ctx, perfrules.DISPATCH_FUNCS if dispatch is None else dispatch
-    )
-    obsrules.check(
-        ctx, perfrules.DISPATCH_FUNCS if dispatch is None else dispatch
-    )
+    for family, run in (
+        ("file:async", lambda: asyncrules.check(ctx)),
+        ("file:device", lambda: devicerules.check(ctx)),
+        ("file:dur", lambda: durrules.check(ctx)),
+        ("file:failpoint", lambda: failpointrules.check(
+            ctx, failpointrules.SEAM_FUNCS if seams is None else seams
+        )),
+        ("file:perf", lambda: perfrules.check(
+            ctx,
+            perfrules.DISPATCH_FUNCS if dispatch is None else dispatch
+        )),
+        ("file:obs", lambda: obsrules.check(
+            ctx,
+            perfrules.DISPATCH_FUNCS if dispatch is None else dispatch
+        )),
+    ):
+        t0 = time.perf_counter()
+        run()
+        _tick(prof, family, t0)
 
 
-def _run_program_checks(modules: Dict, ctxs: Dict[str, ModuleContext]
-                        ) -> None:
+def _dep_digest(path: str, program, summaries, extra: str) -> str:
+    """Cache key for one file's LOCAL program findings: every own
+    function's summary signature, every resolved direct callee's
+    (key, signature) — transitive facts are already folded into the
+    direct summaries by the SCC pass — plus the race/metrics context
+    slice (`extra`).  The file's own source is implicit: the cache
+    lives on its mtime-keyed ModuleIndex.  Editing ONLY a callee
+    changes that callee's signature and therefore this digest — the
+    invalidation the naive own-mtime key misses."""
+    from . import dataflow
+
+    h = hashlib.sha256()
+    h.update(extra.encode())
+    mod = program.modules[path]
+    for qual in sorted(mod.funcs):
+        fn = mod.funcs[qual]
+        s = summaries.get(fn.key)
+        h.update(qual.encode())
+        h.update(b"\x00")
+        h.update(dataflow.summary_sig(s).encode() if s else b"-")
+        for _call, callee in program.callees(fn):
+            cs = summaries.get(callee.key)
+            h.update(repr(callee.key).encode())
+            h.update(dataflow.summary_sig(cs).encode() if cs else b"-")
+        h.update(b"\x01")
+    return h.hexdigest()
+
+
+def _run_program_checks(modules: Dict, ctxs: Dict[str, ModuleContext],
+                        shared: Optional[Sequence] = None,
+                        prof: Optional[Dict] = None,
+                        use_cache: bool = False) -> None:
     """The interprocedural pass: call-graph + summaries once, then
     every whole-program rule family (transitive ASYNC101,
-    transitive DEVICE201/203, NATIVE5xx, LOCK4xx) reports through the
-    per-file contexts so suppression/fingerprints behave identically
-    to the intra-function rules."""
+    transitive DEVICE201/203, NATIVE5xx, LOCK4xx, RACE8xx, MET901)
+    reports through the per-file contexts so suppression and
+    fingerprints behave identically to the intra-function rules.
+
+    Families split two ways:
+      * LOCAL — findings land in the same file whose functions they
+        analyze and depend only on that file + its direct callee
+        summaries (async/device/native/race-local/metrics).  With
+        `use_cache`, each file's local findings replay from its
+        ModuleIndex when the dependency digest matches.
+      * GLOBAL — findings mix state from many files (lock cycles,
+        dual-context locks, thread<->loop crossings); always
+        recomputed, they're cheap (restricted walks).
+    """
     from . import (
         asyncrules, callgraph, dataflow, devicerules, lockrules,
-        nativerules,
+        nativerules, racerules,
     )
 
+    t0 = time.perf_counter()
     program = callgraph.build_program(modules)
     summaries = dataflow.summarize(program)
-    asyncrules.check_program(program, summaries, ctxs)
-    devicerules.check_program(program, summaries, ctxs)
-    nativerules.check_program(program, summaries, ctxs)
-    lockrules.check_program(program, summaries, ctxs)
+    rc = racerules.prepare(program, summaries, shared)
+    _tick(prof, "program:summaries", t0)
+
+    local_ctxs: Dict[str, ModuleContext] = ctxs
+    misses: List[Tuple[str, ModuleContext, int, str]] = []
+    if use_cache:
+        local_ctxs = {}
+        t0 = time.perf_counter()
+        for path, ctx in ctxs.items():
+            idx = modules[path]
+            digest = _dep_digest(path, program, summaries,
+                                 rc.file_extra(path))
+            cached = getattr(idx, "program_cache", None)
+            if cached is not None and cached[0] == digest:
+                ctx.findings.extend(cached[1])
+                _mark(prof, path, "program", "hit")
+            else:
+                local_ctxs[path] = ctx
+                misses.append((path, ctx, len(ctx.findings), digest))
+                _mark(prof, path, "program", "miss")
+        _tick(prof, "program:digest", t0)
+
+    for family, run in (
+        ("program:async", lambda: asyncrules.check_program(
+            program, summaries, local_ctxs)),
+        ("program:device", lambda: devicerules.check_program(
+            program, summaries, local_ctxs)),
+        ("program:native", lambda: nativerules.check_program(
+            program, summaries, local_ctxs)),
+        ("program:race-local", lambda: racerules.check_local(
+            rc, local_ctxs)),
+    ):
+        t0 = time.perf_counter()
+        run()
+        _tick(prof, family, t0)
+
+    if use_cache:
+        for path, ctx, start, digest in misses:
+            modules[path].program_cache = (
+                digest, tuple(ctx.findings[start:])
+            )
+
+    # global families AFTER the cache capture: their findings must
+    # never be frozen into a single file's cache entry
+    for family, run in (
+        ("program:lock", lambda: lockrules.check_program(
+            program, summaries, ctxs)),
+        ("program:race-global", lambda: racerules.check_global(
+            rc, ctxs)),
+    ):
+        t0 = time.perf_counter()
+        run()
+        _tick(prof, family, t0)
 
 
 def analyze_source(source: str, path: str = "<string>",
                    seams: Optional[Sequence] = None,
-                   dispatch: Optional[Sequence] = None) -> List[Finding]:
+                   dispatch: Optional[Sequence] = None,
+                   shared: Optional[Sequence] = None) -> List[Finding]:
     """Run every rule family — intra-function AND the interprocedural
     pass, over this one module — on a source string (fixture tests
     use this directly; `run_lint` maps the same checks over the
-    tree)."""
+    tree).  `shared` overrides the RACE8xx roster (racerules
+    .SHARED_CLASSES) for fixture classes."""
     from . import callgraph
 
     idx = callgraph.ModuleIndex(path, source)  # ONE parse, shared
     ctx = ModuleContext(path, source, idx.tree)
     _run_file_checks(ctx, seams, dispatch)
-    _run_program_checks({path: idx}, {path: ctx})
+    _run_program_checks({path: idx}, {path: ctx}, shared=shared)
     ctx.findings.sort(key=lambda f: (f.line, f.rule))
     return ctx.findings
 
 
 def analyze_program(sources: Dict[str, str],
                     seams: Optional[Sequence] = None,
-                    dispatch: Optional[Sequence] = None
+                    dispatch: Optional[Sequence] = None,
+                    shared: Optional[Sequence] = None
                     ) -> List[Finding]:
     """Run every rule family over a MULTI-module fixture tree
     ({path: source}): the cross-module test surface for the
@@ -364,7 +492,7 @@ def analyze_program(sources: Dict[str, str],
         _run_file_checks(ctx, seams, dispatch)
         ctxs[path] = ctx
         modules[path] = idx
-    _run_program_checks(modules, ctxs)
+    _run_program_checks(modules, ctxs, shared=shared)
     out: List[Finding] = []
     for ctx in ctxs.values():
         out.extend(ctx.findings)
@@ -390,6 +518,8 @@ def run_lint(paths: Sequence[str], root: Optional[str] = None,
     interprocedural pass runs over the files of THIS invocation."""
     from . import callgraph
 
+    global LAST_PROFILE
+    prof: Dict = {"families": {}, "files": {}}
     root_path = Path(root) if root else Path(__file__).resolve().parents[2]
     out: List[Finding] = []
     ctxs: Dict[str, ModuleContext] = {}
@@ -410,6 +540,8 @@ def run_lint(paths: Sequence[str], root: Optional[str] = None,
                 message=f"syntax error: {exc.msg}",
             ))
             continue
+        _mark(prof, rel, "index",
+              "hit" if idx.from_cache else "miss")
         cache = getattr(idx, "file_cache", None) if seams is None \
             else None
         if cache is not None:
@@ -422,7 +554,7 @@ def run_lint(paths: Sequence[str], root: Optional[str] = None,
             ctx.findings = list(base)
         else:
             ctx = ModuleContext(rel, idx.source, idx.tree)
-            _run_file_checks(ctx, seams, None)
+            _run_file_checks(ctx, seams, None, prof=prof)
             if seams is None:
                 idx.file_cache = (
                     tuple(ctx.findings), ctx.io_methods,
@@ -430,10 +562,12 @@ def run_lint(paths: Sequence[str], root: Optional[str] = None,
                 )
         ctxs[rel] = ctx
         modules[rel] = idx
-    _run_program_checks(modules, ctxs)
+    _run_program_checks(modules, ctxs, prof=prof,
+                        use_cache=seams is None)
     for ctx in ctxs.values():
         out.extend(ctx.findings)
     out.sort(key=lambda f: (f.path, f.line, f.rule))
+    LAST_PROFILE = prof
     return out
 
 

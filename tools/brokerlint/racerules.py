@@ -1,0 +1,937 @@
+"""Async-atomicity race rules (RACE8xx) + metrics contract (MET901).
+
+EMQX gets its concurrency safety from the BEAM: every broker/router
+singleton is a gen_server whose state only ONE process mutates.  Our
+port shares mutable singleton state across asyncio tasks, worker
+threads (SyncGate flusher, executors, rebuild threads) and
+GIL-released native calls; most of it is guarded by nothing except
+event-loop atomicity — which silently stops holding the moment
+someone adds an ``await`` (or a ``da_``/``dslog_`` call) in the
+middle of a read-modify-write.  These rules make that invariant
+machine-checked over the ``SHARED_CLASSES`` roster (the long-lived
+singletons whose attributes are multi-context state):
+
+  RACE801  check-then-act / read-modify-write on a shared attribute
+           spanning a SUSPENSION (an await that can genuinely yield
+           the loop — resolved transitively through the ``suspends``
+           summaries — or a GIL-released native boundary when the
+           attr is also thread-written).  Canonical hit:
+           ``if x in self._pending: … await … self._pending.pop(x)``.
+           A re-read of the attribute after the suspension (the
+           re-check remediation) closes the window.
+  RACE802  iteration over a shared dict/list/set while the loop body
+           can suspend (another task mutates mid-iteration) or calls
+           a known mutator of that same attribute (RuntimeError:
+           dict changed size — the in-production shape).  Iterate a
+           snapshot (``list(self.x)``) or restructure.
+  RACE803  thread<->loop crossing: an attribute mutated from worker-
+           thread context (functions reachable from Thread targets /
+           ``to_thread`` / ``run_in_executor`` / executor ``submit``)
+           and read on the event loop, with no lock around the
+           mutation, no ``call_soon_threadsafe`` hand-off, and no
+           ``# loop-ownership:`` comment (the annotation contract
+           mirrors LOCK403's ``# lock-ownership:``).
+  RACE804  non-idempotent multi-field update torn across a
+           suspension: two attributes the class elsewhere updates
+           ATOMICALLY (the relatedness evidence) updated here with a
+           suspension between them — a task scheduled in the window
+           observes one advanced without the other (cursor without
+           watermark).
+
+  MET901   metrics contract: a literal counter name at a
+           ``*.metrics.inc(...)`` site must exist in the metrics
+           registry (``METRICS``) or match a declared
+           ``EXTRA_METRIC_PREFIXES`` family — a typo'd name silently
+           lands in the ``_extra`` dict and no dashboard ever sees
+           it.  Dynamic names (f-strings, variables) are skipped:
+           under-approximate, never guess.
+
+Shared-state model: an attribute of a roster class is *shared* when
+it is written from >= 2 distinct methods (two task contexts can hold
+the pen) or from >= 1 thread-context function (the LOCK403 dual-
+context detection, generalized from locks to state).  Everything
+here under-approximates: unresolved calls are not suspension or
+mutation evidence, and a site under a token-resolved lock is treated
+as protected (lock *discipline* is LOCK4xx's job).
+
+The runtime counterpart is ``emqx_tpu/testing/interleave.py`` +
+``tools/racesim``: a seeded scheduler shim that forces adversarial
+task switches at exactly the suspension points these rules reason
+about, so every burned-down finding carries a reproduced-failure (or
+proven-fixed) schedule.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import (
+    Dict, Iterable, List, NamedTuple, Optional, Sequence, Set, Tuple,
+)
+
+from . import callgraph, dataflow
+from .engine import ModuleContext, call_tail, dotted_name
+
+Key = Tuple[str, str]
+
+_LOOP_OWNERSHIP_TOKEN = "loop-ownership:"
+
+
+class SharedClass(NamedTuple):
+    path_suffix: str   # module path suffix, posix
+    name: str          # class name inside that module
+
+
+# The long-lived singletons whose self-attributes are multi-context
+# shared state (one instance, touched by many tasks/threads for the
+# broker's whole life).  Per-connection/per-session objects do NOT
+# belong here: a channel's state is owned by its one reader task, and
+# rostering it would drown the signal.  tests/test_lint.py
+# cross-checks every entry against the real tree (rot guard).
+SHARED_CLASSES: Tuple[SharedClass, ...] = (
+    SharedClass("emqx_tpu/broker/broker.py", "Broker"),
+    SharedClass("emqx_tpu/router.py", "Router"),
+    SharedClass("emqx_tpu/cluster/node.py", "ClusterNode"),
+    SharedClass("emqx_tpu/ds/persist.py", "DurableSessions"),
+    SharedClass("emqx_tpu/ds/sharded.py", "ShardedStorage"),
+    SharedClass("emqx_tpu/broker/resume.py", "ResumeScheduler"),
+    SharedClass("emqx_tpu/ds/durability.py", "SyncGate"),
+    SharedClass("emqx_tpu/ds/durability.py", "GateGroup"),
+    SharedClass("emqx_tpu/olp.py", "LoadMonitor"),
+)
+
+_METRIC_CALL_TAILS = {"inc", "observe", "inc_bulk"}
+
+
+# ------------------------------------------------- thread-context map
+
+def _spawn_targets(call: ast.Call) -> Iterable[ast.expr]:
+    """Callable-reference argument positions of the thread-spawning
+    shapes: Thread(target=f), to_thread(f, ...),
+    loop.run_in_executor(exec, f, ...), executor.submit(f, ...)."""
+    tail = call_tail(call)
+    if tail == "Thread":
+        for kw in call.keywords:
+            if kw.arg == "target":
+                yield kw.value
+    elif tail == "to_thread" and call.args:
+        yield call.args[0]
+    elif tail == "run_in_executor" and len(call.args) >= 2:
+        yield call.args[1]
+    elif tail == "submit" and call.args:
+        yield call.args[0]
+
+
+def _unwrap_partial(expr: ast.expr) -> ast.expr:
+    if isinstance(expr, ast.Call) and call_tail(expr) == "partial" \
+            and expr.args:
+        return expr.args[0]
+    return expr
+
+
+def thread_context_keys(program: callgraph.Program) -> Set[Key]:
+    """Function keys that can execute on a worker thread: resolved
+    Thread/to_thread/run_in_executor/submit targets, ``run`` methods
+    of ``threading.Thread`` subclasses, and everything reachable from
+    them through resolved SYNC call edges.  (call_soon_threadsafe
+    hand-offs do NOT mark their callback: the callback runs on the
+    loop — that is exactly the remediation RACE803 accepts.)"""
+    entries: Set[Key] = set()
+    fns = program.functions()
+    by_key = {fn.key: fn for fn in fns}
+    for fn in fns:
+        for node in dataflow.walk_pruned(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            for argexpr in _spawn_targets(node):
+                tgt = program._resolve_expr(
+                    _unwrap_partial(argexpr), fn, depth=0
+                )
+                if tgt is not None and not tgt.is_async:
+                    entries.add(tgt.key)
+    for mod in program.modules.values():
+        for ci in mod.classes.values():
+            if any(
+                dotted_name(b).rpartition(".")[2] == "Thread"
+                for b in ci.bases
+            ):
+                run_q = ci.methods.get("run")
+                if run_q is not None and (mod.path, run_q) in by_key:
+                    entries.add((mod.path, run_q))
+    marked = set(entries)
+    work = list(entries)
+    while work:
+        fn = by_key.get(work.pop())
+        if fn is None:
+            continue
+        for _call, callee in program.callees(fn):
+            if callee.is_async:
+                continue  # a bare thread cannot run a coroutine
+            if callee.key not in marked:
+                marked.add(callee.key)
+                work.append(callee.key)
+    return marked
+
+
+# ------------------------------------------------- per-class modeling
+
+class _Site(NamedTuple):
+    fn: callgraph.FuncInfo
+    line: int
+    locked: bool
+
+
+class _ClassModel:
+    """One roster class's shared-state facts, collected by a flat
+    line-ordered scan of every method (the recursive window walk for
+    RACE801/804 runs separately, per async method)."""
+
+    def __init__(self, mod: callgraph.ModuleIndex, name: str) -> None:
+        self.mod = mod
+        self.name = name
+        self.token_prefix = f"{mod.dotted}.{name}."
+        self.methods: List[callgraph.FuncInfo] = []
+        self.writer_methods: Dict[str, Set[str]] = {}
+        self.written_attrs: Set[str] = set()
+        self.thread_written: Set[str] = set()
+        self.thread_write_sites: Dict[str, List[_Site]] = {}
+        self.loop_access: Dict[str, List[_Site]] = {}
+        self.related: Set[frozenset] = set()
+        self.shared: Set[str] = set()
+
+    def token(self, attr: str) -> str:
+        return self.token_prefix + attr
+
+
+def _lock_spans(fn: callgraph.FuncInfo,
+                program: callgraph.Program) -> List[Tuple[int, int]]:
+    """Line ranges of ``with <lock-token>`` bodies in this function —
+    a site inside one is treated as lock-protected."""
+    spans: List[Tuple[int, int]] = []
+    for node in dataflow.walk_pruned(fn.node):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        if any(
+            dataflow.lock_token(i.context_expr, fn, program) is not None
+            for i in node.items
+        ):
+            if node.body:
+                spans.append((
+                    node.body[0].lineno,
+                    getattr(node, "end_lineno", node.lineno),
+                ))
+    return spans
+
+
+def _in_spans(line: int, spans: Sequence[Tuple[int, int]]) -> bool:
+    return any(lo <= line <= hi for lo, hi in spans)
+
+
+def _suspension_name(node: ast.Await,
+                     callees: Dict[int, callgraph.FuncInfo],
+                     summaries: Dict) -> Optional[str]:
+    """Can this await genuinely yield the loop?  Base classification
+    first (bare future / known suspending tail), then the resolved
+    callee's transitive ``suspends`` summary."""
+    name = dataflow.await_suspends(node)
+    if name is not None:
+        return name
+    for sub in ast.walk(node.value):
+        if isinstance(sub, ast.Call):
+            callee = callees.get(id(sub))
+            if callee is None:
+                continue
+            cs = summaries.get(callee.key)
+            if cs is not None and cs.suspends is not None:
+                return f"{callee.name} -> {cs.suspends[0]}"
+    return None
+
+
+def _scan_method(model: _ClassModel, fn: callgraph.FuncInfo,
+                 program: callgraph.Program, summaries: Dict,
+                 thread_keys: Set[Key]) -> None:
+    """Flat facts for one method: writer attribution, thread-side
+    write sites, loop-side accesses, atomic co-write (relatedness)
+    runs, suspension lines."""
+    spans = _lock_spans(fn, program)
+    callees = {id(c): f for c, f in program.callees(fn)}
+    writes: List[Tuple[int, str]] = []
+    reads: List[Tuple[int, str]] = []
+    susp_lines: List[int] = []
+    mut_recv: Set[int] = set()
+    for node in dataflow.walk_pruned(fn.node):
+        for attr in dataflow.attr_mutations(node):
+            writes.append((node.lineno, attr))
+        if isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute
+        ) and node.func.attr in dataflow.MUTATOR_TAILS:
+            mut_recv.add(id(node.func.value))
+        elif isinstance(node, (ast.Assign, ast.AugAssign, ast.Delete)):
+            targets = node.targets if isinstance(
+                node, (ast.Assign, ast.Delete)) else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Subscript):
+                    mut_recv.add(id(t.value))
+        elif isinstance(node, ast.Await):
+            if fn.is_async and _suspension_name(
+                node, callees, summaries
+            ) is not None:
+                susp_lines.append(node.lineno)
+        elif isinstance(node, (ast.AsyncFor, ast.AsyncWith)):
+            susp_lines.append(node.lineno)
+        elif isinstance(node, ast.Attribute) and isinstance(
+            node.ctx, ast.Load
+        ) and id(node) not in mut_recv:
+            attr = dataflow.self_attr_of(node)
+            if attr is not None:
+                reads.append((node.lineno, attr))
+    is_thread = (not fn.is_async) and fn.key in thread_keys
+    for line, attr in writes:
+        model.writer_methods.setdefault(attr, set()).add(fn.qualname)
+        model.written_attrs.add(attr)
+        site = _Site(fn, line, _in_spans(line, spans))
+        if is_thread:
+            model.thread_written.add(attr)
+            model.thread_write_sites.setdefault(attr, []).append(site)
+        if fn.is_async:
+            model.loop_access.setdefault(attr, []).append(site)
+    if fn.is_async:
+        for line, attr in reads:
+            model.loop_access.setdefault(attr, []).append(
+                _Site(fn, line, _in_spans(line, spans))
+            )
+    # relatedness: all pairs of DIFFERENT attrs written within one
+    # suspension-free run are atomically co-updated somewhere — the
+    # evidence RACE804 requires before calling a torn pair a bug.
+    # Constructors don't count: __init__ assigns EVERY field in one
+    # run, which would make all pairs "related" and degenerate
+    # RACE804 into "any two writes torn across a suspension".
+    if fn.node.name in ("__init__", "__new__"):
+        return
+    susp_sorted = sorted(susp_lines)
+    run_attrs: Set[str] = set()
+    prev_line = None
+    for line, attr in sorted(writes):
+        if prev_line is not None and any(
+            prev_line < s <= line for s in susp_sorted
+        ):
+            _note_related(model, run_attrs)
+            run_attrs = set()
+        run_attrs.add(attr)
+        prev_line = line
+    _note_related(model, run_attrs)
+
+
+def _note_related(model: _ClassModel, attrs: Set[str]) -> None:
+    # 2-3 co-written fields is an atomic pair/triple (cursor +
+    # watermark); a wider run is a bulk reset (start() clearing ten
+    # dicts) and would cross-product RACE804 into noise
+    if not 2 <= len(attrs) <= 3:
+        return
+    ordered = sorted(attrs)
+    for i, a in enumerate(ordered):
+        for b in ordered[i + 1:]:
+            model.related.add(frozenset((a, b)))
+
+
+# ------------------------------------------- RACE801/804 window walk
+
+class _WindowWalk:
+    """Execution-ordered walk of one async method, tracking per shared
+    attr the read->suspend->write window (RACE801) and the
+    write->suspend->related-write tear (RACE804).  Branches are
+    processed independently and merged (worst rank wins); loop bodies
+    run twice so back-edge windows surface."""
+
+    def __init__(self, fn: callgraph.FuncInfo, model: _ClassModel,
+                 ctx: ModuleContext, program: callgraph.Program,
+                 summaries: Dict) -> None:
+        self.fn = fn
+        self.model = model
+        self.ctx = ctx
+        self.program = program
+        self.summaries = summaries
+        self.callees = {id(c): f for c, f in program.callees(fn)}
+        # attr -> (read_line,) armed / (read_line, sus_name, sus_line)
+        self.rank: Dict[str, Tuple] = {}
+        self.written: Dict[str, int] = {}
+        self.torn: Dict[str, Tuple[int, str, int]] = {}
+        self.reported: Set[Tuple] = set()
+
+    # ------------------------------------------------------- driving
+
+    def run(self) -> None:
+        self._stmts(self.fn.node.body, False)
+
+    def _stmts(self, body: Sequence[ast.stmt], locked: bool) -> None:
+        for st in body:
+            self._stmt(st, locked)
+
+    def _stmt(self, st: ast.stmt, locked: bool) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return
+        if isinstance(st, ast.If):
+            self._scan(st.test, locked)
+            snap = self._snapshot()
+            self._stmts(st.body, locked)
+            branch = self._snapshot()
+            self._restore(snap)
+            self._stmts(st.orelse, locked)
+            self._merge(branch)
+            return
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            self._scan(st.iter, locked)
+            for _ in range(2):
+                if isinstance(st, ast.AsyncFor):
+                    self._suspend("async-for", st.lineno)
+                self._stmts(st.body, locked)
+            self._stmts(st.orelse, locked)
+            return
+        if isinstance(st, ast.While):
+            self._scan(st.test, locked)
+            for _ in range(2):
+                self._stmts(st.body, locked)
+                self._scan(st.test, locked)
+            self._stmts(st.orelse, locked)
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            lk = locked
+            for item in st.items:
+                self._scan(item.context_expr, locked)
+                if dataflow.lock_token(
+                    item.context_expr, self.fn, self.program
+                ) is not None:
+                    lk = True
+            if isinstance(st, ast.AsyncWith):
+                self._suspend("async-with", st.lineno)
+            self._stmts(st.body, lk)
+            return
+        if isinstance(st, ast.Try):
+            self._stmts(st.body, locked)
+            for h in st.handlers:
+                self._stmts(h.body, locked)
+            self._stmts(st.orelse, locked)
+            self._stmts(st.finalbody, locked)
+            return
+        self._scan(st, locked)
+        if isinstance(st, (ast.Continue, ast.Break, ast.Return,
+                           ast.Raise)):
+            # the straight-line path ends here: a loop back-edge
+            # re-checks at the top, a return/raise leaves the method —
+            # no window survives the jump
+            self.rank.clear()
+            self.written.clear()
+            self.torn.clear()
+
+    # ------------------------------------------------- event scanning
+
+    def _scan(self, root: ast.AST, locked: bool) -> None:
+        """One simple statement / expression subtree: collect events
+        in source order (target writes of assignment statements are
+        scheduled at the statement END — the value is read first) and
+        apply them."""
+        events: List[Tuple[int, int, int, str, str]] = []
+        mut_recv: Set[int] = set()
+        seq = 0
+
+        def walk(node: ast.AST) -> None:
+            nonlocal seq
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue
+                walk(child)
+            self._node_events(node, events, mut_recv)
+            seq += 1
+
+        walk(root)
+        self._node_events(root, events, mut_recv)
+        # drop reads that are merely mutator receivers / store bases
+        out = [e for e in events
+               if e[3] != "read" or e[2] not in mut_recv]
+        out.sort(key=lambda e: (e[0], e[1]))
+        for line, _col, _nid, kind, arg in out:
+            if kind == "read":
+                self._read(arg, line, locked)
+            elif kind == "write":
+                self._write(arg, line, locked, direct=True)
+            elif kind == "write-callee":
+                self._write(arg, line, locked, direct=False)
+            elif kind == "suspend":
+                self._suspend(arg, line)
+            elif kind == "native":
+                self._native(arg, line)
+
+    def _node_events(self, node: ast.AST, events: List,
+                     mut_recv: Set[int]) -> None:
+        model = self.model
+        if isinstance(node, ast.Attribute) and isinstance(
+            node.ctx, ast.Load
+        ):
+            attr = dataflow.self_attr_of(node)
+            if attr is not None and attr in model.shared:
+                events.append((node.lineno, node.col_offset, id(node),
+                               "read", attr))
+            return
+        if isinstance(node, ast.Await):
+            name = _suspension_name(node, self.callees, self.summaries)
+            if name is not None:
+                events.append((node.lineno, node.col_offset, id(node),
+                               "suspend", name))
+            return
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in dataflow.MUTATOR_TAILS:
+                mut_recv.add(id(node.func.value))
+            for attr in dataflow.attr_mutations(node):
+                if attr in model.shared:
+                    events.append((node.lineno, node.col_offset,
+                                   id(node), "write", attr))
+            tail = call_tail(node)
+            native = None
+            if callgraph.is_native_entry(tail):
+                native = tail
+            else:
+                callee = self.callees.get(id(node))
+                if callee is not None:
+                    cs = self.summaries.get(callee.key)
+                    if cs is not None:
+                        if cs.native is not None:
+                            native = cs.native
+                        for tok in cs.mutates:
+                            if tok.startswith(model.token_prefix):
+                                attr = tok[len(model.token_prefix):]
+                                if attr in model.shared:
+                                    # callee writes complete RACE801
+                                    # windows but are NOT torn-pair
+                                    # events: a helper whose summary
+                                    # mutates a dozen attrs is a bulk
+                                    # transition, not a cursor+
+                                    # watermark pair
+                                    events.append((
+                                        node.lineno, node.col_offset,
+                                        id(node), "write-callee", attr,
+                                    ))
+            if native is not None:
+                events.append((node.lineno, node.col_offset, id(node),
+                               "native", native))
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.Delete,
+                             ast.AnnAssign)):
+            targets = node.targets if isinstance(
+                node, (ast.Assign, ast.Delete)
+            ) else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Subscript):
+                    mut_recv.add(id(t.value))
+            end = (getattr(node, "end_lineno", node.lineno),
+                   getattr(node, "end_col_offset", 10 ** 6))
+            for attr in dataflow.attr_mutations(node):
+                if attr in self.model.shared:
+                    events.append((end[0], end[1], id(node),
+                                   "write", attr))
+
+    # --------------------------------------------------- event effects
+
+    def _read(self, attr: str, line: int, locked: bool) -> None:
+        if locked:
+            return
+        self.rank[attr] = (line,)  # armed (a later read RE-arms:
+        # the re-check-after-await remediation clears the window)
+
+    def _suspend(self, name: str, line: int) -> None:
+        for attr, st in list(self.rank.items()):
+            if len(st) == 1:
+                self.rank[attr] = (st[0], name, line)
+        for attr, wline in self.written.items():
+            self.torn[attr] = (wline, name, line)
+        self.written.clear()
+
+    def _native(self, name: str, line: int) -> None:
+        # a GIL-released span only breaks loop-atomicity for state a
+        # worker thread also writes
+        for attr, st in list(self.rank.items()):
+            if len(st) == 1 and attr in self.model.thread_written:
+                self.rank[attr] = (st[0], f"native `{name}`", line)
+
+    def _write(self, attr: str, line: int, locked: bool,
+               direct: bool = True) -> None:
+        if locked:
+            self.rank.pop(attr, None)
+            self.written.pop(attr, None)
+            self.torn.pop(attr, None)
+            return
+        st = self.rank.get(attr)
+        if st is not None and len(st) == 3:
+            key = ("RACE801", attr)  # one report per attr per method
+            if key not in self.reported:
+                self.reported.add(key)
+                self.ctx.report_at(
+                    line, "RACE801", self.fn.qualname,
+                    f"check-then-act on shared `self.{attr}` spans a "
+                    f"suspension: read at line {st[0]}, but `{st[1]}` "
+                    f"(line {st[2]}) can yield the event loop before "
+                    f"this write — another task can mutate "
+                    f"`{attr}` in the window; re-check after the "
+                    f"await or restructure",
+                    detail=attr,
+                )
+        for other, (wline, sname, sline) in list(self.torn.items()):
+            if not direct or other == attr:
+                continue
+            if frozenset((other, attr)) not in self.model.related:
+                continue
+            # one report per torn pair per method
+            key = ("RACE804", frozenset((other, attr)))
+            if key in self.reported:
+                continue
+            self.reported.add(key)
+            self.ctx.report_at(
+                line, "RACE804", self.fn.qualname,
+                f"multi-field update torn across a suspension: "
+                f"`self.{other}` (line {wline}) and `self.{attr}` "
+                f"are updated atomically elsewhere in this class, "
+                f"but `{sname}` (line {sline}) can yield between "
+                f"them here — a task scheduled in the window sees "
+                f"`{other}` advanced without `{attr}`",
+                detail="+".join(sorted((other, attr))),
+            )
+        self.rank.pop(attr, None)
+        self.torn.pop(attr, None)
+        if direct:
+            self.written[attr] = line
+
+    # ------------------------------------------------- branch algebra
+
+    def _snapshot(self):
+        return (dict(self.rank), dict(self.written), dict(self.torn))
+
+    def _restore(self, snap) -> None:
+        self.rank = dict(snap[0])
+        self.written = dict(snap[1])
+        self.torn = dict(snap[2])
+
+    def _merge(self, other) -> None:
+        orank, owritten, otorn = other
+        for attr, st in orank.items():
+            cur = self.rank.get(attr)
+            if cur is None or len(st) > len(cur):
+                self.rank[attr] = st
+        for attr, line in owritten.items():
+            self.written.setdefault(attr, line)
+        for attr, t in otorn.items():
+            self.torn.setdefault(attr, t)
+
+
+# --------------------------------------------------------- RACE802
+
+def _iterated_attr(it: ast.expr) -> Optional[str]:
+    attr = dataflow.self_attr_of(it)
+    if attr is not None:
+        return attr
+    if isinstance(it, ast.Call) and isinstance(
+        it.func, ast.Attribute
+    ) and it.func.attr in ("items", "keys", "values") and not it.args:
+        return dataflow.self_attr_of(it.func.value)
+    return None
+
+
+def _check_iteration(model: _ClassModel, fn: callgraph.FuncInfo,
+                     ctx: ModuleContext, program: callgraph.Program,
+                     summaries: Dict) -> None:
+    callees = {id(c): f for c, f in program.callees(fn)}
+    for node in dataflow.walk_pruned(fn.node):
+        if not isinstance(node, ast.For):
+            continue
+        attr = _iterated_attr(node.iter)
+        if attr is None or attr not in model.written_attrs:
+            continue
+        token = model.token(attr)
+        cause: Optional[str] = None
+        for sub in ast.walk(node):
+            if sub is node.iter or isinstance(
+                sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            if attr in dataflow.attr_mutations(sub):
+                cause = f"the body mutates `self.{attr}` directly"
+                break
+            if isinstance(sub, ast.Call):
+                callee = callees.get(id(sub))
+                if callee is not None:
+                    cs = summaries.get(callee.key)
+                    if cs is not None and token in cs.mutates:
+                        cause = (f"`{callee.name}()` (resolved) "
+                                 f"mutates `self.{attr}`")
+                        break
+            if fn.is_async and attr in model.shared and isinstance(
+                sub, ast.Await
+            ):
+                name = _suspension_name(sub, callees, summaries)
+                if name is not None:
+                    cause = (f"`{name}` can suspend mid-iteration "
+                             f"and another task mutates "
+                             f"`self.{attr}`")
+                    break
+        if cause is None:
+            continue
+        ctx.report_at(
+            node.lineno, "RACE802", fn.qualname,
+            f"iterating `self.{attr}` while {cause}: the container "
+            f"can change under the live iterator (RuntimeError / "
+            f"skipped entries in production) — iterate a snapshot "
+            f"(`list(self.{attr})`) or restructure",
+            detail=attr,
+        )
+
+
+# --------------------------------------------------------- RACE803
+
+def _has_loop_comment(ctx: ModuleContext, line: int) -> bool:
+    """``# loop-ownership: ...`` on the mutation line or anywhere in
+    the contiguous comment block directly above it (the LOCK403
+    annotation contract, applied to state instead of locks)."""
+    if 1 <= line <= len(ctx.lines) and \
+            _LOOP_OWNERSHIP_TOKEN in ctx.lines[line - 1]:
+        return True
+    cand = line - 1
+    while 1 <= cand <= len(ctx.lines) and \
+            ctx.lines[cand - 1].lstrip().startswith("#"):
+        if _LOOP_OWNERSHIP_TOKEN in ctx.lines[cand - 1]:
+            return True
+        cand -= 1
+    return False
+
+
+def _check_thread_crossings(model: _ClassModel,
+                            ctxs: Dict[str, ModuleContext]) -> None:
+    for attr, sites in sorted(model.thread_write_sites.items()):
+        loop_sites = model.loop_access.get(attr)
+        if not loop_sites:
+            continue
+        ls = loop_sites[0]
+        for site in sites:
+            if site.locked:
+                continue  # lock discipline is LOCK4xx's beat
+            ctx = ctxs.get(site.fn.module.path)
+            if ctx is None or _has_loop_comment(ctx, site.line):
+                continue
+            ctx.report_at(
+                site.line, "RACE803", site.fn.qualname,
+                f"`self.{attr}` is mutated here on a WORKER THREAD "
+                f"but read on the event loop "
+                f"(`{ls.fn.qualname}` line {ls.line}) with no lock "
+                f"around this mutation — hand the mutation to the "
+                f"loop with `call_soon_threadsafe`, lock both "
+                f"sides, or document the ownership rule with a "
+                f"`# loop-ownership: ...` comment",
+                detail=attr,
+            )
+
+
+# ----------------------------------------------------------- MET901
+
+def _find_registry(program: callgraph.Program):
+    """(names, prefixes, registry_path) from the module defining a
+    top-level ``METRICS`` tuple of string literals (plus the optional
+    ``EXTRA_METRIC_PREFIXES`` families); None when the program has no
+    registry — fixture programs without one skip MET901 entirely."""
+    for path in sorted(program.modules):
+        mod = program.modules[path]
+        names: Optional[Set[str]] = None
+        prefixes: Tuple[str, ...] = ()
+        for st in mod.tree.body:
+            target = None
+            if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                    and isinstance(st.targets[0], ast.Name):
+                target = st.targets[0].id
+            elif isinstance(st, ast.AnnAssign) and isinstance(
+                st.target, ast.Name
+            ) and st.value is not None:
+                target = st.target.id
+            if target not in ("METRICS", "EXTRA_METRIC_PREFIXES"):
+                continue
+            value = st.value
+            if not isinstance(value, (ast.Tuple, ast.List)):
+                continue
+            lits = [
+                e.value for e in value.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, str)
+            ]
+            if target == "METRICS":
+                names = set(lits)
+            else:
+                prefixes = tuple(lits)
+        if names is not None:
+            return names, prefixes, path
+    return None
+
+
+def _metric_name_ok(name: str, names: Set[str],
+                    prefixes: Tuple[str, ...]) -> bool:
+    return name in names or any(name.startswith(p) for p in prefixes)
+
+
+def _is_metrics_recv(expr: ast.expr) -> bool:
+    name = dotted_name(expr)
+    return name == "metrics" or name.endswith(".metrics")
+
+
+def _check_metrics(registry, fn_node: ast.AST, qualname: str,
+                   ctx: ModuleContext) -> None:
+    names, prefixes, _reg_path = registry
+    # walk_pruned skips nested def/lambda subtrees for ANY root, so
+    # the module-level pass sees only top/class-level statements and
+    # every function gets exactly one pass of its own
+    for node in dataflow.walk_pruned(fn_node):
+        if not isinstance(node, ast.Call) or not isinstance(
+            node.func, ast.Attribute
+        ):
+            continue
+        if node.func.attr not in _METRIC_CALL_TAILS:
+            continue
+        if not _is_metrics_recv(node.func.value):
+            continue
+        if not node.args:
+            continue
+        arg = node.args[0]
+        if not (isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)):
+            continue  # dynamic name: under-approximate, skip
+        if _metric_name_ok(arg.value, names, prefixes):
+            continue
+        ctx.report(
+            node, "MET901", qualname,
+            f"counter `{arg.value}` is not in the metrics registry "
+            f"(METRICS) and matches no EXTRA_METRIC_PREFIXES family "
+            f"— it lands in the untyped `_extra` dict and no "
+            f"dashboard/aggregation ever sees it; register the name "
+            f"or fix the typo",
+            detail=arg.value,
+        )
+
+
+# ------------------------------------------------------ orchestration
+
+class RaceContext:
+    """Everything the race pass computed once per program: thread
+    marks, roster class models, the metrics registry.  The engine
+    also digests `file_extra` into each file's program-findings cache
+    key — the pieces of RACE/MET input that live OUTSIDE the file's
+    own source and its direct callee summaries."""
+
+    def __init__(self, program: callgraph.Program, summaries: Dict,
+                 shared: Optional[Sequence[SharedClass]]) -> None:
+        self.program = program
+        self.summaries = summaries
+        self.shared_spec = tuple(
+            SHARED_CLASSES if shared is None else shared
+        )
+        self.thread_keys = thread_context_keys(program)
+        self.registry = _find_registry(program)
+        self.models: List[_ClassModel] = []
+        self._build_models()
+
+    def _build_models(self) -> None:
+        program, summaries = self.program, self.summaries
+        by_mod: Dict[str, List[callgraph.FuncInfo]] = {}
+        for fn in program.functions():
+            by_mod.setdefault(fn.module.path, []).append(fn)
+        for spec in self.shared_spec:
+            for path in sorted(program.modules):
+                if not path.endswith(spec.path_suffix):
+                    continue
+                mod = program.modules[path]
+                if spec.name not in mod.classes:
+                    continue
+                model = _ClassModel(mod, spec.name)
+                for fn in by_mod.get(path, ()):
+                    if fn.cls == spec.name:
+                        model.methods.append(fn)
+                        _scan_method(model, fn, program, summaries,
+                                     self.thread_keys)
+                model.shared = {
+                    a for a, ms in model.writer_methods.items()
+                    if len(ms) >= 2
+                } | model.thread_written
+                self.models.append(model)
+
+    def file_extra(self, path: str) -> str:
+        """Cache-key component for one file: its functions' thread
+        marks, the registry signature, and whether a roster class
+        lives here (whose model mixes facts from EVERY method of the
+        class — all same-file — plus the thread marks above)."""
+        marks = sorted(
+            q for (p, q) in self.thread_keys if p == path
+        )
+        reg = None
+        if self.registry is not None:
+            names, prefixes, reg_path = self.registry
+            reg = (tuple(sorted(names)), prefixes, reg_path)
+        roster = sorted(
+            m.name for m in self.models if m.mod.path == path
+        )
+        return repr((marks, reg, roster, self.shared_spec))
+
+
+def prepare(program: callgraph.Program, summaries: Dict,
+            shared: Optional[Sequence[SharedClass]] = None
+            ) -> RaceContext:
+    return RaceContext(program, summaries, shared)
+
+
+def check_local(rc: RaceContext,
+                ctxs: Dict[str, ModuleContext]) -> None:
+    """The per-file families (cacheable by dependency digest):
+    RACE801/802/804 over roster classes, MET901 over every module."""
+    program, summaries = rc.program, rc.summaries
+    for model in rc.models:
+        ctx = ctxs.get(model.mod.path)
+        if ctx is None:
+            continue
+        for fn in model.methods:
+            if fn.is_async:
+                _WindowWalk(fn, model, ctx, program, summaries).run()
+            _check_iteration(model, fn, ctx, program, summaries)
+    if rc.registry is None:
+        return
+    reg_path = rc.registry[2]
+    for path, ctx in ctxs.items():
+        if path == reg_path:
+            continue
+        mod = program.modules.get(path)
+        if mod is None:
+            continue
+        for fn in mod.funcs.values():
+            _check_metrics(rc.registry, fn.node, fn.qualname, ctx)
+        _check_metrics(rc.registry, mod.tree, "<module>", ctx)
+
+
+def check_global(rc: RaceContext,
+                 ctxs: Dict[str, ModuleContext]) -> None:
+    """The cross-file family: RACE803 thread<->loop crossings (its
+    inputs — thread reachability — span the whole program, so its
+    findings are recomputed every run, never cached per-file)."""
+    for model in rc.models:
+        _check_thread_crossings(model, ctxs)
+
+
+def check_program(
+    program: callgraph.Program,
+    summaries: Dict,
+    ctxs: Dict[str, ModuleContext],
+    shared: Optional[Sequence[SharedClass]] = None,
+) -> None:
+    """One-shot entry (fixture tests / analyze_source): prepare +
+    local + global."""
+    rc = prepare(program, summaries, shared)
+    check_local(rc, ctxs)
+    check_global(rc, ctxs)
+
+
+__all__ = [
+    "RaceContext", "SHARED_CLASSES", "SharedClass", "check_global",
+    "check_local", "check_program", "prepare", "thread_context_keys",
+]

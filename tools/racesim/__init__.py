@@ -1,0 +1,8 @@
+"""racesim: schedule-space search harness over the forced-
+interleaving sanitizer (emqx_tpu.testing.interleave) — crashsim's
+enumeration idea applied to task schedules instead of crash points."""
+
+from .sim import (  # noqa: F401
+    Outcome, exhaustive_scripts, run_exhaustive, run_schedule,
+    run_seeds,
+)

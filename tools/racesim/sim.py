@@ -1,0 +1,95 @@
+"""Schedule-space search over the forced-interleaving sanitizer.
+
+Where crashsim (tools/crashsim) enumerates crash POINTS in a durable
+write sequence, racesim enumerates task SCHEDULES of an async
+workload:
+
+  * ``run_schedule``   — one workload run under one policy (its own
+    fresh event loop; the policy's trace is the schedule evidence).
+  * ``run_seeds``      — the seeded sweep: same workload, N seeds,
+    collect every failure with the trace that produced it.  The
+    property-suite workhorse (tier-1 budget: small N).
+  * ``run_exhaustive`` — every 0/1 preemption script up to a bounded
+    number of decision points (2^k schedules): the small-schedule
+    exhaustive mode, marked slow in CI.
+
+A workload is a zero-argument callable returning a fresh coroutine
+(it runs once per schedule).  A run FAILS when the coroutine raises;
+assertion-style invariants live inside the workload itself.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from itertools import product
+from typing import Callable, Iterable, List, NamedTuple, Optional, Tuple
+
+from emqx_tpu.testing.interleave import SchedulePolicy, drive
+
+
+class Outcome(NamedTuple):
+    label: str                      # "seed=7" / "script=(1,0,1)"
+    error: Optional[BaseException]  # None on a clean run
+    trace: Tuple[Tuple[str, int], ...]  # the schedule that ran
+
+    @property
+    def failed(self) -> bool:
+        return self.error is not None
+
+
+def run_schedule(workload: Callable[[], "asyncio.Future"],
+                 policy: SchedulePolicy,
+                 label: str = "",
+                 timeout: float = 30.0) -> Outcome:
+    """One run on a fresh event loop; the workload (and every task it
+    spawns) steps through the policy's yieldpoints."""
+    async def _main():
+        await asyncio.wait_for(drive(workload(), policy), timeout)
+
+    err: Optional[BaseException] = None
+    try:
+        asyncio.run(_main())
+    except BaseException as e:  # noqa: BLE001 — the outcome IS the data
+        err = e
+    return Outcome(label, err, tuple(policy.trace))
+
+
+def run_seeds(workload: Callable[[], "asyncio.Future"],
+              seeds: Iterable[int] = range(20),
+              prob: float = 1.0,
+              max_preempts: int = 64,
+              timeout: float = 30.0) -> List[Outcome]:
+    """Seeded sweep: same workload under N random schedules.  Returns
+    every outcome; callers assert ``not any(o.failed ...)`` (burned-
+    down sites) or ``any(o.failed ...)`` (reproducing a still-racy
+    fixture)."""
+    out: List[Outcome] = []
+    for seed in seeds:
+        policy = SchedulePolicy(mode="random", seed=seed, prob=prob,
+                                max_preempts=max_preempts)
+        out.append(run_schedule(workload, policy,
+                                label=f"seed={seed}", timeout=timeout))
+    return out
+
+
+def exhaustive_scripts(points: int) -> Iterable[Tuple[int, ...]]:
+    """Every 0/1 preemption decision vector over `points` yieldpoints
+    (2^points scripts, all-zeros first: the undisturbed schedule)."""
+    return product((0, 1), repeat=points)
+
+
+def run_exhaustive(workload: Callable[[], "asyncio.Future"],
+                   points: int = 8,
+                   timeout: float = 30.0) -> List[Outcome]:
+    """The exhaustive small-schedule mode: run the workload under
+    EVERY preemption script of `points` decisions.  Exponential —
+    keep `points` small (<= ~12); the CI variant behind the ``slow``
+    marker uses larger budgets than the tier-1 smoke run."""
+    out: List[Outcome] = []
+    for script in exhaustive_scripts(points):
+        policy = SchedulePolicy(mode="script", script=script)
+        out.append(run_schedule(
+            workload, policy, label=f"script={script}",
+            timeout=timeout,
+        ))
+    return out
