@@ -95,15 +95,34 @@ class Broker:
 
         self.olp = LoadMonitor(self, self.config.olp)
         eng_cfg = self.config.engine
+        mc_cfg = self.config.multicore
+        eng_kw = dict(
+            max_levels=eng_cfg.max_levels,
+            f_width=eng_cfg.f_width,
+            m_cap=eng_cfg.m_cap,
+            rebuild_threshold=eng_cfg.rebuild_threshold,
+            use_device=eng_cfg.use_device,
+            background_rebuild=eng_cfg.background_rebuild,
+        )
+        if mc_cfg.service_socket:
+            # multicore layer-1 worker: match/decide via the shared
+            # match service over the shm window ring, with a host-only
+            # in-process mirror as the per-window fallback referee
+            from .matchclient import ServiceMatchEngine
+
+            engine = ServiceMatchEngine(
+                socket_path=mc_cfg.service_socket,
+                worker_id=mc_cfg.worker_id,
+                ring_slots=mc_cfg.ring_slots,
+                ring_slot_bytes=mc_cfg.ring_slot_bytes,
+                decide_min=mc_cfg.decide_min,
+                rpc_timeout=mc_cfg.rpc_timeout,
+                **eng_kw,
+            )
+        else:
+            engine = MatchEngine(**eng_kw)
         self.router = Router(
-            engine=MatchEngine(
-                max_levels=eng_cfg.max_levels,
-                f_width=eng_cfg.f_width,
-                m_cap=eng_cfg.m_cap,
-                rebuild_threshold=eng_cfg.rebuild_threshold,
-                use_device=eng_cfg.use_device,
-                background_rebuild=eng_cfg.background_rebuild,
-            ),
+            engine=engine,
             shared=SharedSubManager(strategy=shared_strategy),
         )
         # engine lifecycle events (XLA compiles, device_put transfers,
@@ -823,6 +842,13 @@ class Broker:
                     # the original disconnected_at/cursors preserved.
                     self.resume.pause(clientid)
                     self.resume.refresh_checkpoint(clientid, session)
+                elif not self.resume_home_shard(clientid):
+                    # multicore foreign-shard worker: never checkpoint
+                    # here — the client's home worker keeps the ONE
+                    # canonical checkpoint (two data dirs holding rival
+                    # checkpoints for one client would split-brain the
+                    # next resume)
+                    self.metrics.inc("session.resume.foreign_shard")
                 else:
                     try:
                         self.durable.save(
@@ -2500,6 +2526,56 @@ class Broker:
         self.trace.stop_all()
         if self.durable is not None:
             self.durable.close()
+        close = getattr(self.router.engine, "close", None)
+        if close is not None:
+            # multicore worker: detach from the match service and
+            # unlink this worker's shm window ring
+            close()
+
+    def resume_home_shard(self, clientid: str) -> bool:
+        """Is this worker the durable home for ``clientid``?  True in
+        single-process brokers (shard_count 1); in a multicore pool,
+        the client-id hash picks exactly one worker whose data dir
+        holds the session's checkpoint + captures."""
+        rcfg = self.config.durable.resume
+        if int(rcfg.shard_count) <= 1:
+            return True
+        from .resume import shard_of
+
+        return shard_of(
+            clientid, int(rcfg.shard_count)
+        ) == int(rcfg.shard_index)
+
+    def node_info(self) -> Dict:
+        """This node's row for ``GET /api/v5/nodes`` — also served to
+        peers over the cluster ``node_info`` RPC so a multicore pool's
+        merged view carries every worker's olp level and durability
+        surface (the PR 13/PR 15 riders)."""
+        node: Dict = {
+            "node": self.config.node_name,
+            "uptime": int(time.time() - self.metrics.start_time),
+            "connections": len(self.cm),
+            "node_status": "running",
+        }
+        if self.resume is not None:
+            # resume-queue depth (mass-reconnect admission control)
+            node["resume"] = self.resume.info()
+        if self.olp.enabled:
+            node["olp_level"] = self.olp.level
+        if self.durable is not None:
+            # durability contract surface: fsync mode, group-commit
+            # flush counters, unsynced/parked backlog, corruption
+            node["durability"] = self.durable.sync_stats()
+        mc = self.config.multicore
+        if mc.service_socket or mc.n_workers:
+            node["multicore"] = {
+                "worker_id": mc.worker_id,
+                "n_workers": mc.n_workers,
+            }
+            svc_info = getattr(self.router.engine, "service_info", None)
+            if svc_info is not None:
+                node["multicore"]["service"] = svc_info()
+        return node
 
     # -------------------------------------------------- config updates
 

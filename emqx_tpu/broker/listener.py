@@ -682,6 +682,12 @@ def main(argv: Optional[List[str]] = None) -> None:
         "(SO_REUSEPORT accept pool, clustered on loopback)",
     )
     ap.add_argument(
+        "--no-match-service", action="store_true",
+        help="with --workers: legacy independent-worker pool (each "
+        "worker matches in-process) instead of the shared match "
+        "service + shm window ring topology",
+    )
+    ap.add_argument(
         "--check-config", action="store_true",
         help="validate config (file + EMQX_TPU_* env overrides) and "
         "exit: 0 = boots cleanly (bin/emqx check_config role)",
@@ -707,6 +713,7 @@ def main(argv: Optional[List[str]] = None) -> None:
             args.port or 1883,
             bind=args.bind or "0.0.0.0",
             base_config=base,
+            match_service=not args.no_match_service,
         )
         return
     if args.config:

@@ -1,22 +1,37 @@
-"""Multi-core broker: worker processes sharing one listening port.
+"""Multi-core broker: N worker processes × one shared match service.
 
-The reference runs on every BEAM scheduler via its broker/router
-pools (/root/reference/apps/emqx/src/emqx_broker.erl:539-540, esockd
-acceptor pools); a single asyncio loop caps this broker at one core.
-The multi-core launcher spawns N WORKER PROCESSES that each run the
-full broker:
+The reference runs one ``emqx_broker`` per BEAM scheduler over ONE
+shared ``emqx_router`` table (/root/reference/apps/emqx/src/
+emqx_broker.erl:539-540, esockd acceptor pools); a single asyncio
+loop caps this broker at one core.  The multi-core launcher maps that
+layer split onto processes:
 
-  * every worker binds the SAME MQTT port with SO_REUSEPORT — the
-    kernel spreads accepted connections across workers (the acceptor
-    pool);
-  * workers cluster over loopback using the ordinary inter-node
-    transport (route-delta replication + binary-wire forwards), so a
-    publish accepted by worker A reaches subscribers owned by worker
-    B exactly as it would cross real nodes — no new protocol, and a
-    multi-host deployment composes by seeding workers at other hosts.
+  * **Layer 1 — workers**: every worker binds the SAME MQTT port with
+    SO_REUSEPORT (the kernel spreads accepted connections — the
+    acceptor pool) and owns its connections' sessions, channels,
+    inflight windows, olp load ladder, and SyncGate durability
+    barrier.  Workers still cluster over loopback with the ordinary
+    inter-node transport (route-delta replication + binary-wire
+    forwards), so a publish accepted by worker A reaches subscribers
+    owned by worker B exactly as it would cross real nodes.
+  * **Layer 2 — the match service** (`ops.matchsvc`): one process
+    owns the trie-automaton, the router CSR with interned per-worker
+    fids, and the device decide kernel.  Workers submit dispatch
+    windows over per-worker shared-memory rings
+    (`broker.shmring.WindowRing`) via `broker.matchclient.
+    ServiceMatchEngine`; any service trouble degrades per-window to
+    each worker's bit-identical in-process host mirror, and workers
+    re-attach automatically when the service returns.
 
-Usage: ``python -m emqx_tpu.broker --workers N [--port P]`` or
-`spawn_workers()` programmatically (the bench drives it that way).
+Resuming durable sessions shard across workers by client-id hash
+(`broker.resume.shard_of`): each worker's durable data dir is its
+shard (``<data_dir>/worker<i>``), so a mass reconnect spreads its
+replay floor over the pool and no two workers ever hold rival
+checkpoints for one client.
+
+Usage: ``python -m emqx_tpu.broker --workers N [--port P]
+[--no-match-service]`` or `spawn_workers()` programmatically (the
+bench drives it that way).
 """
 
 from __future__ import annotations
@@ -32,25 +47,65 @@ import tempfile
 import time
 from typing import Dict, List, Optional
 
+from .resume import shard_of  # re-exported: the pool's shard rule
+
 log = logging.getLogger("emqx_tpu.multicore")
+
+__all__ = [
+    "PortReservation", "WorkerPool", "free_ports", "main",
+    "shard_of", "spawn_workers", "worker_configs",
+]
+
+
+class PortReservation:
+    """Loopback ports held OPEN (bound sockets) until their owner
+    spawns — the fix for the probe-then-close TOCTOU where two
+    concurrent pools could draw the same "free" port between the
+    probe socket closing and the worker binding.  `release(port)` is
+    called immediately before the spawn that binds it, shrinking the
+    race window from pool-setup-wide to one exec."""
+
+    def __init__(self, n: int, host: str = "127.0.0.1") -> None:
+        self._socks: Dict[int, socket.socket] = {}
+        self.ports: List[int] = []
+        for _ in range(n):
+            s = socket.socket()
+            # REUSEADDR so a just-closed reservation (TIME_WAIT-free
+            # loopback bind) never blocks the worker's real bind
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind((host, 0))
+            port = s.getsockname()[1]
+            self.ports.append(port)
+            self._socks[port] = s
+        self.host = host
+
+    def release(self, port: int) -> None:
+        """Free one port for its owner to bind (idempotent)."""
+        s = self._socks.pop(port, None)
+        if s is not None:
+            s.close()
+
+    def release_all(self) -> None:
+        for port in list(self._socks):
+            self.release(port)
+
+    def __enter__(self) -> "PortReservation":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release_all()
 
 
 def free_ports(n: int) -> List[int]:
-    """Probe N currently-free loopback ports (shared by the launcher,
-    its bench tool, and tests — TOCTOU applies, as with any probe)."""
-    return _free_ports(n)
+    """Probe N currently-free loopback ports.  Kept for callers that
+    only need numbers (their own TOCTOU to manage); pool spawning
+    itself uses `PortReservation` so concurrent pools can't collide."""
+    with PortReservation(n) as res:
+        return list(res.ports)
 
 
 def _free_ports(n: int) -> List[int]:
-    socks, ports = [], []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        ports.append(s.getsockname()[1])
-        socks.append(s)
-    for s in socks:
-        s.close()
-    return ports
+    return free_ports(n)
 
 
 def worker_configs(
@@ -60,11 +115,23 @@ def worker_configs(
     base_config: Optional[Dict] = None,
     use_device: Optional[bool] = False,
     tracing: Optional[Dict] = None,
+    olp: Optional[Dict] = None,
+    service_socket: Optional[str] = None,
+    reservation: Optional[PortReservation] = None,
 ) -> List[Dict]:
     """Per-worker config dicts: shared REUSEPORT listener + loopback
-    cluster full-mesh seeds.  ``use_device=False`` by default — worker
-    processes must not fight over one TPU; run a single-process broker
-    for the device match path, or give exactly one worker the device.
+    cluster full-mesh seeds (+ the match-service attachment when
+    ``service_socket`` is given).
+
+    ``use_device=False`` by default — worker processes must not fight
+    over one TPU; in the service topology the MATCH SERVICE owns the
+    device and workers keep host-only mirrors, which is exactly this
+    default.
+
+    ``olp`` (an OlpConfig-shaped dict) arms the SAME load ladder in
+    every worker — each worker samples its own loop lag/backlog and
+    degrades independently (per-worker ``olp_level`` surfaces in the
+    merged ``GET /api/v5/nodes``).
 
     ``tracing`` (a TracingConfig-shaped dict) arms the lifecycle
     tracer in EVERY worker: cross-worker submissions ride the ordinary
@@ -74,17 +141,26 @@ def worker_configs(
     timeline.  When the base config enables the management API, each
     worker gets its OWN api port (they cannot share one), so every
     worker's trace store is REST-queryable for the merge.
+
+    ``reservation`` (optional, created internally when omitted) holds
+    every drawn port's socket open; `WorkerPool` releases worker i's
+    ports immediately before spawning worker i.
     """
     base_api = dict((base_config or {}).get("api") or {})
-    # ONE probe for every port this pool needs: drawing api ports from
-    # a second call could hand back a just-released cluster port (the
-    # probe sockets close between calls) and a worker would fail to
-    # bind; a single call holds all sockets open simultaneously, so
-    # the ports are guaranteed distinct
+    # ONE reservation for every port this pool needs: drawing api
+    # ports from a second probe could hand back a just-released
+    # cluster port and a worker would fail to bind; one reservation
+    # holds all sockets open simultaneously AND keeps holding them
+    # until each owner spawns (the TOCTOU fix)
     want_api = bool(base_api.get("enable"))
-    ports = _free_ports(n_workers * 2 if want_api else n_workers)
+    own_res = reservation is None
+    res = reservation or PortReservation(
+        n_workers * 2 if want_api else n_workers
+    )
+    ports = res.ports
     cluster_ports = ports[:n_workers]
-    api_ports = ports[n_workers:] if want_api else None
+    api_ports = ports[n_workers:n_workers * 2] if want_api else None
+    base_durable = dict((base_config or {}).get("durable") or {})
     configs = []
     for i in range(n_workers):
         cfg = dict(base_config or {})
@@ -101,8 +177,30 @@ def worker_configs(
         cfg["engine"] = engine
         if tracing is not None:
             cfg["tracing"] = dict(tracing)
+        if olp is not None:
+            cfg["olp"] = {**dict(cfg.get("olp") or {}), **dict(olp)}
         if api_ports is not None:
             cfg["api"] = {**base_api, "port": api_ports[i]}
+        cfg["multicore"] = {
+            "n_workers": n_workers,
+            "worker_id": i,
+            "service_socket": service_socket or "",
+        }
+        if base_durable.get("enable"):
+            # durable home shards: worker i owns the checkpoints +
+            # captures of client ids hashing to shard i — separate
+            # dirs, ONE canonical checkpoint per client
+            resume = dict(base_durable.get("resume") or {})
+            resume["shard_index"] = i
+            resume["shard_count"] = n_workers
+            cfg["durable"] = {
+                **base_durable,
+                "data_dir": os.path.join(
+                    base_durable.get("data_dir", "data/ds"),
+                    f"worker{i}",
+                ),
+                "resume": resume,
+            }
         cfg["cluster"] = {
             "enable": True,
             "bind": "127.0.0.1",
@@ -115,27 +213,55 @@ def worker_configs(
             ],
         }
         configs.append(cfg)
+    if own_res:
+        # caller only wanted config dicts (the legacy probe shape);
+        # spawning callers pass/keep the reservation to hold the fix
+        res.release_all()
     return configs
 
 
 class WorkerPool:
-    """Spawn + supervise the worker processes."""
+    """Spawn + supervise the worker processes and (optionally) the
+    shared match service."""
 
     def __init__(self, configs: List[Dict],
-                 log_dir: Optional[str] = None) -> None:
+                 log_dir: Optional[str] = None,
+                 reservation: Optional[PortReservation] = None,
+                 service_socket: Optional[str] = None,
+                 service_engine: Optional[Dict] = None) -> None:
         self.configs = configs
         self.log_dir = log_dir or tempfile.mkdtemp(prefix="emqx-mc-")
+        self.reservation = reservation
+        self.service_socket = service_socket
+        self.service_engine = service_engine
         self.procs: List[subprocess.Popen] = []
+        self.service_proc: Optional[subprocess.Popen] = None
         self._conf_paths: List[str] = []
+
+    # ------------------------------------------------------- workers
+
+    def _release_ports(self, cfg: Dict) -> None:
+        """Free this worker's reserved ports right before its spawn —
+        the narrow end of the TOCTOU fix."""
+        if self.reservation is None:
+            return
+        cluster_port = (cfg.get("cluster") or {}).get("port")
+        if cluster_port:
+            self.reservation.release(int(cluster_port))
+        api = cfg.get("api") or {}
+        if api.get("enable") and api.get("port"):
+            self.reservation.release(int(api["port"]))
 
     def _spawn_one(self, i: int, mode: str = "w") -> subprocess.Popen:
         cfg = self.configs[i]
+        self._release_ports(cfg)
         env = dict(os.environ)
         if not (cfg.get("engine") or {}).get("use_device"):
             # host-engine workers must not initialize (or fight over)
             # the TPU backend a sitecustomize may pre-wire — the
             # RESTART path must apply the same override as the first
-            # spawn
+            # spawn.  In the service topology the device belongs to
+            # the match service alone.
             env["JAX_PLATFORMS"] = "cpu"
         log_f = open(
             os.path.join(self.log_dir, f"worker{i}.log"), mode
@@ -146,6 +272,67 @@ class WorkerPool:
             stdout=log_f, stderr=subprocess.STDOUT, env=env,
         )
 
+    # ------------------------------------------------- match service
+
+    def _spawn_service(self, mode: str = "w") -> subprocess.Popen:
+        assert self.service_socket is not None
+        # a stale socket file from a previous incarnation would make
+        # the fresh service fail its bind
+        try:
+            os.unlink(self.service_socket)
+        except FileNotFoundError:
+            pass
+        argv = [sys.executable, "-m", "emqx_tpu.ops.matchsvc",
+                "--socket", self.service_socket]
+        if self.service_engine:
+            argv += ["--engine-json", json.dumps(self.service_engine)]
+        log_f = open(
+            os.path.join(self.log_dir, "matchsvc.log"), mode
+        )
+        return subprocess.Popen(
+            argv, stdout=log_f, stderr=subprocess.STDOUT,
+        )
+
+    def restart_service(self) -> None:
+        """Kill + respawn the match service (chaos surface: workers
+        must degrade to their in-process mirrors and re-attach)."""
+        if self.service_proc is not None:
+            if self.service_proc.poll() is None:
+                self.service_proc.kill()
+                self.service_proc.wait()
+            self.service_proc = self._spawn_service(mode="a")
+
+    def service_alive(self) -> bool:
+        return (self.service_proc is not None
+                and self.service_proc.poll() is None)
+
+    def wait_service(self, timeout: float = 30.0) -> None:
+        """Block until the service's control socket accepts."""
+        assert self.service_socket is not None
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if (self.service_proc is not None
+                    and self.service_proc.poll() is not None):
+                raise RuntimeError(
+                    f"match service exited rc="
+                    f"{self.service_proc.returncode}; see "
+                    f"{self.log_dir}/matchsvc.log"
+                )
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            try:
+                s.connect(self.service_socket)
+                return
+            except OSError:
+                time.sleep(0.1)
+            finally:
+                s.close()
+        raise TimeoutError(
+            f"match service socket {self.service_socket} not "
+            f"accepting after {timeout}s"
+        )
+
+    # ----------------------------------------------------- lifecycle
+
     def start(self) -> None:
         os.makedirs(self.log_dir, exist_ok=True)
         for i, cfg in enumerate(self.configs):
@@ -153,11 +340,25 @@ class WorkerPool:
             with open(conf_path, "w") as f:
                 json.dump(cfg, f, indent=1)
             self._conf_paths.append(conf_path)
+        if self.service_socket is not None:
+            # service first: workers attach during startup instead of
+            # spending their first windows on the fallback path
+            self.service_proc = self._spawn_service()
+            try:
+                self.wait_service()
+            except Exception:
+                self.stop()
+                raise
         self.procs = [
             self._spawn_one(i) for i in range(len(self.configs))
         ]
-        log.info("spawned %d workers (logs in %s)",
-                 len(self.procs), self.log_dir)
+        if self.reservation is not None:
+            # every owner has spawned; nothing left to hold
+            self.reservation.release_all()
+        log.info("spawned %d workers%s (logs in %s)",
+                 len(self.procs),
+                 " + match service" if self.service_proc else "",
+                 self.log_dir)
 
     def wait_ready(self, port: int, timeout: float = 60.0) -> None:
         """Block until the shared port accepts (all workers share it,
@@ -196,6 +397,23 @@ class WorkerPool:
             except subprocess.TimeoutExpired:
                 p.kill()
         self.procs = []
+        # the service stops LAST: workers flush their final windows
+        # (or fall back) before their layer-2 half goes away
+        if self.service_proc is not None:
+            if self.service_proc.poll() is None:
+                self.service_proc.send_signal(signal.SIGTERM)
+                try:
+                    self.service_proc.wait(5.0)
+                except subprocess.TimeoutExpired:
+                    self.service_proc.kill()
+            self.service_proc = None
+        if self.service_socket is not None:
+            try:
+                os.unlink(self.service_socket)
+            except FileNotFoundError:
+                pass
+        if self.reservation is not None:
+            self.reservation.release_all()
 
 
 def spawn_workers(
@@ -205,21 +423,48 @@ def spawn_workers(
     base_config: Optional[Dict] = None,
     use_device: Optional[bool] = False,
     tracing: Optional[Dict] = None,
+    olp: Optional[Dict] = None,
+    match_service: bool = True,
+    service_engine: Optional[Dict] = None,
+    log_dir: Optional[str] = None,
 ) -> WorkerPool:
-    pool = WorkerPool(worker_configs(
-        n_workers, port, bind=bind, base_config=base_config,
-        use_device=use_device, tracing=tracing,
-    ))
+    """Spawn the full multicore topology: the shared match service
+    (unless ``match_service=False`` pins the legacy independent-worker
+    shape) plus N workers attached to it."""
+    log_dir = log_dir or tempfile.mkdtemp(prefix="emqx-mc-")
+    service_socket = (
+        os.path.join(log_dir, "matchsvc.sock") if match_service
+        else None
+    )
+    base_api = dict((base_config or {}).get("api") or {})
+    want_api = bool(base_api.get("enable"))
+    reservation = PortReservation(
+        n_workers * 2 if want_api else n_workers
+    )
+    pool = WorkerPool(
+        worker_configs(
+            n_workers, port, bind=bind, base_config=base_config,
+            use_device=use_device, tracing=tracing, olp=olp,
+            service_socket=service_socket, reservation=reservation,
+        ),
+        log_dir=log_dir,
+        reservation=reservation,
+        service_socket=service_socket,
+        service_engine=service_engine,
+    )
     pool.start()
     return pool
 
 
 def main(n_workers: int, port: int, bind: str = "0.0.0.0",
-         base_config: Optional[Dict] = None) -> None:
-    """Foreground supervisor: run the pool, restart dead workers,
-    terminate cleanly on SIGINT/SIGTERM."""
+         base_config: Optional[Dict] = None,
+         match_service: bool = True) -> None:
+    """Foreground supervisor: run the pool, restart dead workers AND a
+    dead match service (workers re-attach on their own), terminate
+    cleanly on SIGINT/SIGTERM."""
     pool = spawn_workers(n_workers, port, bind=bind,
-                         base_config=base_config)
+                         base_config=base_config,
+                         match_service=match_service)
     stopping = False
 
     def _stop(_sig, _frm):
@@ -232,8 +477,9 @@ def main(n_workers: int, port: int, bind: str = "0.0.0.0",
         # inside try/finally: a startup failure must stop the
         # SURVIVING workers too, or zombies keep sharing the port
         pool.wait_ready(port)
-        print(f"emqx_tpu multicore: {n_workers} workers on :{port} "
-              f"(logs: {pool.log_dir})", flush=True)
+        print(f"emqx_tpu multicore: {n_workers} workers on :{port}"
+              + (" + match service" if match_service else "")
+              + f" (logs: {pool.log_dir})", flush=True)
         while not stopping:
             time.sleep(1.0)
             for i, p in enumerate(pool.procs):
@@ -241,5 +487,10 @@ def main(n_workers: int, port: int, bind: str = "0.0.0.0",
                     log.warning("worker %d died (rc=%s); restarting",
                                 i, p.returncode)
                     pool.procs[i] = pool._spawn_one(i, mode="a")
+            if (pool.service_socket is not None
+                    and not pool.service_alive() and not stopping):
+                log.warning("match service died; restarting "
+                            "(workers serve from mirrors meanwhile)")
+                pool.restart_service()
     finally:
         pool.stop()

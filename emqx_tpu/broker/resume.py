@@ -65,6 +65,18 @@ _BACKOFF_BASE = 0.05
 _BACKOFF_CAP = 2.0
 
 
+def shard_of(client_id: str, shard_count: int) -> int:
+    """Stable client-id -> resume shard mapping (multicore pools hash
+    durable-session homes across workers with it).  crc32, not
+    `hash()`: the mapping must agree ACROSS worker processes and
+    across restarts (PYTHONHASHSEED varies per process)."""
+    import zlib
+
+    if shard_count <= 1:
+        return 0
+    return zlib.crc32(client_id.encode("utf-8")) % shard_count
+
+
 class ResumeBusy(Exception):
     """Resume admission is saturated (active slots full AND the park
     FIFO at ``park_queue_cap``): the CONNECT is refused with CONNACK

@@ -1,0 +1,214 @@
+"""Shared-memory window ring: the worker <-> match-service data plane.
+
+One segment per worker, created (and owned) by the worker, attached by
+the match service.  The segment is a fixed array of SLOTS; each slot
+carries one in-flight window (a match request, then — overwritten in
+place — its response), so the bulk payload (topic bytes, fid CSR
+columns, decide columns) crosses the process boundary through shared
+memory while only tiny doorbell lines ride the control socket.
+
+Slot lifetime is EXPLICIT, per the NATIVE5xx arena rules the dispatch
+arena already follows: a slot is FREE (owned by the worker's free
+list) -> REQUEST (worker wrote payload, doorbell sent) -> RESPONSE
+(service overwrote the payload, completion doorbell sent) -> FREE
+(worker consumed the response and released it).  Payload reads COPY
+out of the segment and release their views before returning, so no
+numpy/memoryview ever outlives the slot it points into — segment
+close can never pull a mapped buffer out from under a live view.
+
+Each slot's 16-byte header carries ``(epoch, seq, kind, len)``.  The
+epoch is bumped by the worker on every service re-attach, so a
+completion from a previous service incarnation (written before the
+crash, doorbelled never) can never be mistaken for the current
+window's response.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+from multiprocessing import shared_memory
+from typing import List, Optional, Tuple
+
+_HDR = struct.Struct("<IIII")     # segment: magic, slots, slot_bytes, rsvd
+_SLOT_HDR = struct.Struct("<IIII")  # per-slot: epoch, seq, kind, len
+_MAGIC = 0x4D435257  # "MCRW"
+
+SLOT_HDR_BYTES = _SLOT_HDR.size
+
+# payload kinds
+KIND_MATCH_REQ = 1
+KIND_MATCH_RESP = 2
+KIND_DECIDE_REQ = 3
+KIND_DECIDE_RESP = 4
+KIND_ERROR = 7
+
+
+class RingFull(Exception):
+    """No free slot: the submitter falls back to the in-process path
+    for this window instead of blocking on the service."""
+
+
+# segments CREATED by this process (the resource tracker rightly owns
+# their cleanup); `attach` must not unregister these — in-process
+# tests attach to their own segment, and stripping the registration
+# would double-unregister at unlink
+_OWNED: set = set()
+
+
+class WindowRing:
+    """Fixed-slot shared-memory ring (one per worker).
+
+    The OWNER side (the worker) runs the free list; the ATTACHED side
+    (the match service) only ever reads a slot it was doorbelled and
+    writes the response back into the same slot.  All owner-side state
+    is guarded by ``_lk`` — submits come from executor threads while
+    releases come from the client's reader thread.
+    """
+
+    def __init__(self, shm: shared_memory.SharedMemory, slots: int,
+                 slot_bytes: int, owner: bool) -> None:
+        self._shm = shm
+        self.slots = slots
+        self.slot_bytes = slot_bytes
+        self.owner = owner
+        self._lk = threading.Lock()
+        self._free: List[int] = list(range(slots)) if owner else []
+        self._closed = False
+
+    # ------------------------------------------------------ lifecycle
+
+    @classmethod
+    def create(cls, slots: int = 8,
+               slot_bytes: int = 1 << 18) -> "WindowRing":
+        if slots < 1 or slot_bytes <= SLOT_HDR_BYTES:
+            raise ValueError("ring needs >=1 slot and room for payload")
+        size = _HDR.size + slots * slot_bytes
+        shm = shared_memory.SharedMemory(create=True, size=size)
+        _OWNED.add(shm.name)
+        _HDR.pack_into(shm.buf, 0, _MAGIC, slots, slot_bytes, 0)
+        return cls(shm, slots, slot_bytes, owner=True)
+
+    @classmethod
+    def attach(cls, name: str) -> "WindowRing":
+        shm = shared_memory.SharedMemory(name=name, create=False)
+        # Python's resource tracker "adopts" attached segments and
+        # unlinks them when THIS process exits — but the worker owns
+        # the segment's lifetime, not the service.  Unregister the
+        # attach-side bookkeeping (3.10 has no track=False yet) —
+        # unless THIS process created the segment (in-process tests),
+        # whose registration belongs to the create side.
+        if shm.name not in _OWNED:
+            try:
+                from multiprocessing import resource_tracker
+
+                resource_tracker.unregister(shm._name, "shared_memory")
+            except Exception:
+                pass
+        magic, slots, slot_bytes, _ = _HDR.unpack_from(shm.buf, 0)
+        if magic != _MAGIC:
+            shm.close()
+            raise ValueError(f"{name} is not a window ring segment")
+        return cls(shm, slots, slot_bytes, owner=False)
+
+    @property
+    def name(self) -> str:
+        return self._shm.name
+
+    def close(self) -> None:
+        with self._lk:
+            if self._closed:
+                return
+            self._closed = True
+        self._shm.close()
+        if self.owner:
+            _OWNED.discard(self._shm.name)
+            try:
+                self._shm.unlink()
+            except FileNotFoundError:
+                pass
+
+    # ------------------------------------------------------ free list
+
+    def acquire(self) -> int:
+        """Take a free slot (owner side).  Raises `RingFull` when every
+        slot carries an in-flight window — the caller's cue to serve
+        this window in-process rather than queue behind the service."""
+        with self._lk:
+            if self._closed:
+                raise RingFull("ring closed")
+            if not self._free:
+                raise RingFull(
+                    f"all {self.slots} ring slots in flight"
+                )
+            return self._free.pop()
+
+    def release(self, slot: int) -> None:
+        """Return a consumed slot to the free list (owner side)."""
+        with self._lk:
+            if self._closed or slot in self._free:
+                return
+            self._free.append(slot)
+
+    def free_slots(self) -> int:
+        with self._lk:
+            return len(self._free)
+
+    # ----------------------------------------------------- slot io
+
+    def _off(self, slot: int) -> int:
+        if not 0 <= slot < self.slots:
+            raise IndexError(f"slot {slot} out of range")
+        return _HDR.size + slot * self.slot_bytes
+
+    @property
+    def payload_capacity(self) -> int:
+        return self.slot_bytes - SLOT_HDR_BYTES
+
+    def write(self, slot: int, epoch: int, seq: int, kind: int,
+              parts: Tuple[bytes, ...]) -> int:
+        """Write one payload (concatenated ``parts``) + header into
+        ``slot``.  Returns the payload length; raises ValueError when
+        the window exceeds the slot (the caller splits or falls
+        back)."""
+        total = sum(len(p) for p in parts)
+        if total > self.payload_capacity:
+            raise ValueError(
+                f"window of {total}B exceeds ring slot "
+                f"({self.payload_capacity}B payload)"
+            )
+        off = self._off(slot)
+        buf = self._shm.buf
+        pos = off + SLOT_HDR_BYTES
+        for p in parts:
+            n = len(p)
+            buf[pos:pos + n] = bytes(p) if not isinstance(p, bytes) else p
+            pos += n
+        # header LAST: a reader that raced the doorbell still sees a
+        # consistent (epoch, seq) only once the payload is in place
+        _SLOT_HDR.pack_into(buf, off, epoch, seq, kind, total)
+        return total
+
+    def read(self, slot: int, epoch: int, seq: int
+             ) -> Optional[Tuple[int, bytes]]:
+        """Copy one slot's payload out (``(kind, payload)``), verifying
+        the header matches the doorbelled ``(epoch, seq)`` — a stale
+        write from a previous service incarnation returns None.  The
+        transient view is released before returning (slot-lifetime
+        rule)."""
+        off = self._off(slot)
+        s_epoch, s_seq, kind, ln = _SLOT_HDR.unpack_from(
+            self._shm.buf, off
+        )
+        if s_epoch != epoch or s_seq != seq:
+            return None
+        start = off + SLOT_HDR_BYTES
+        payload = bytes(self._shm.buf[start:start + ln])
+        return kind, payload
+
+
+__all__ = [
+    "KIND_DECIDE_REQ", "KIND_DECIDE_RESP", "KIND_ERROR",
+    "KIND_MATCH_REQ", "KIND_MATCH_RESP", "RingFull", "SLOT_HDR_BYTES",
+    "WindowRing",
+]

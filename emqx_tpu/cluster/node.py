@@ -322,6 +322,7 @@ class ClusterNode:
         self.transport.on("forward_sync", self._handle_forward_sync,
                           concurrent=True)
         self.transport.on("heartbeat", self._handle_heartbeat)
+        self.transport.on("node_info", self._handle_node_info)
         self.transport.on("conn_count", self._handle_conn_count)
         self.transport.on("rebalance_shed", self._handle_rebalance_shed)
         self.transport.on("session_purge", self._handle_session_purge)
@@ -1337,6 +1338,33 @@ class ClusterNode:
     async def _handle_takeover(self, peer: str, obj: Dict) -> Dict:
         state = self.broker.export_session(obj.get("clientid", ""))
         return {"state": state}
+
+    # --------------------------------------------------- node inventory
+
+    async def _handle_node_info(self, peer: str, obj: Dict) -> Dict:
+        return {"info": self.broker.node_info()}
+
+    async def fetch_node_infos(self, timeout: float = 2.0) -> List[Dict]:
+        """Every alive peer's `Broker.node_info` row, gathered
+        concurrently — the merged ``GET /api/v5/nodes`` view a
+        multicore pool serves from ANY worker's api port (each row
+        carries that worker's own olp level, durability surface, and
+        match-service attachment)."""
+        peers = sorted(self.peers_alive())
+        if not peers:
+            return []
+
+        async def one(p: str) -> Optional[Dict]:
+            try:
+                reply = await self.transport.call(
+                    p, {"type": "node_info"}, timeout=timeout
+                )
+            except Exception:
+                return None
+            return (reply or {}).get("info")
+
+        rows = await asyncio.gather(*(one(p) for p in peers))
+        return [r for r in rows if r]
 
     # ----------------------------------------------------- forwarding
 

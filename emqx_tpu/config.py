@@ -310,6 +310,13 @@ class ResumeConfig:
     # encode-once + native splice).  False pins the scalar per-session
     # mqueue path — the property-tested referee.
     windowed: bool = True
+    # multicore resume sharding: this worker admits resume for client
+    # ids with ``crc32(client_id) % shard_count == shard_index`` and
+    # parks/redirects the rest, so a mass reconnect spreads its replay
+    # floor over the pool instead of stampeding one worker.
+    # (1, 0) = shard-all (the single-process default).
+    shard_index: int = 0
+    shard_count: int = 1
 
 
 @dataclass
@@ -375,6 +382,34 @@ class LogConfig:
 
 
 @dataclass
+class MulticoreConfig:
+    """Multicore topology (the layer-1/layer-2 split): this worker's
+    half of the N-workers x one-match-service arrangement.  Populated
+    by `broker.multicore.worker_configs`; all-defaults means a
+    single-process broker (no service, engine owns its own device
+    policy)."""
+
+    # pool size as the SUPERVISOR sees it (workers carry it for
+    # introspection; 0 = not part of a pool)
+    n_workers: int = 0
+    # unix control socket of the shared match service; "" disables the
+    # service client entirely (workers match in-process)
+    service_socket: str = ""
+    # this worker's index in the pool (= resume shard index)
+    worker_id: int = 0
+    # shared-memory window ring geometry (per worker): slots bound the
+    # in-flight windows, slot_bytes bound one window's payload
+    ring_slots: int = 8
+    ring_slot_bytes: int = 1 << 18
+    # ship decide windows to the service only at/above this fanout and
+    # only when the service owns a device (small windows aren't worth
+    # the round-trip; the local numpy twin is bit-identical)
+    decide_min: int = 64
+    # per-window service RPC deadline before the in-process fallback
+    rpc_timeout: float = 2.0
+
+
+@dataclass
 class BrokerConfig:
     mqtt: MqttConfig = field(default_factory=MqttConfig)
     listeners: List[ListenerConfig] = field(
@@ -409,6 +444,7 @@ class BrokerConfig:
     telemetry_url: str = ""
     telemetry_interval: float = 7 * 24 * 3600.0
     durable: DurableConfig = field(default_factory=DurableConfig)
+    multicore: MulticoreConfig = field(default_factory=MulticoreConfig)
     node_name: str = "emqx_tpu@127.0.0.1"
     # cluster linking (emqx_cluster_link): this cluster's name plus
     # links [{"name", "host", "port", "topics": [...]}, ...]

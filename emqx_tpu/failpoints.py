@@ -79,6 +79,9 @@ SEAMS = (
     "olp.shed",
     "ds.journal.append",
     "ds.gc.reclaim",
+    "multicore.ring.submit",
+    "multicore.ring.complete",
+    "multicore.service.restart",
 )
 
 enabled = False  # fast-path gate: disabled brokers pay one bool check

@@ -91,6 +91,7 @@ METRICS = (
     "session.resumed",
     "session.resume.parked",
     "session.resume.busy",
+    "session.resume.foreign_shard",
     "session.replay.windows",
     "session.replay.messages",
     "ds.sync.count",

@@ -493,25 +493,18 @@ class MgmtApi:
         return _json(self.broker.metrics.all())
 
     async def get_nodes(self, request: web.Request) -> web.Response:
-        node = {
-            "node": self.broker.config.node_name,
-            "uptime": int(time.time() - self.broker.metrics.start_time),
-            "connections": len(self.broker.cm),
-            "node_status": "running",
-        }
-        if self.broker.resume is not None:
-            # resume-queue depth (mass-reconnect admission control):
-            # active replay slots, parked FIFO, paused mid-replay jobs
-            node["resume"] = self.broker.resume.info()
-        if self.broker.olp.enabled:
-            node["olp_level"] = self.broker.olp.level
-        if self.broker.durable is not None:
-            # durability contract surface: fsync mode, group-commit
-            # flush counters, unsynced/parked backlog, corruption
-            node["durability"] = self.broker.durable.sync_stats()
+        # this node's row (resume depth, olp level, durability surface,
+        # multicore attachment) + every alive peer's row over the
+        # cluster node_info RPC: ANY worker's api port serves the whole
+        # pool's merged view
+        data = [self.broker.node_info()]
         ext = self.broker.external
         cluster = ext.info() if ext is not None else {}
-        return _json({"data": [node], "cluster": cluster})
+        if ext is not None:
+            fetch = getattr(ext, "fetch_node_infos", None)
+            if fetch is not None:
+                data += await fetch()
+        return _json({"data": data, "cluster": cluster})
 
     # ----------------------------------------------------------- rules
 

@@ -1,0 +1,455 @@
+"""Match service: the layer-2 half of the multicore split.
+
+One process owns the trie-automaton (the ONLY device-enabled
+`MatchEngine` in a worker pool), the interned (worker, fid) route
+registry — rule fids included — and the session-agnostic decide
+kernel.  N broker workers (layer 1: SO_REUSEPORT listeners, sessions,
+channels, inflight) submit dispatch windows over per-worker
+shared-memory rings (`broker.shmring.WindowRing`) and receive matched
+fid CSR columns (or packed decide bytes) back in the same slot; a unix
+control socket carries only hellos, route deltas, and 40-byte
+doorbells.  This is the EMQX layer split (one ``emqx_broker`` per
+scheduler over one shared ``emqx_router``) with the router table as a
+process instead of an ETS table.
+
+Route state is per-worker and rebuilt from the workers: a ``hello``
+from worker *i* drops worker *i*'s previous routes (fresh worker, or a
+re-attach after a service restart — either way the worker re-sends its
+full live set), and a disconnect drops them too.  The service
+therefore needs NO persistence: its entire state is a fold of its
+workers' current subscriptions, exactly like `emqx_router`'s ETS
+table.
+
+Run it standalone (``python -m emqx_tpu.ops.matchsvc --socket P``) or
+let `broker.multicore.WorkerPool` spawn and supervise it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import struct
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+log = logging.getLogger("emqx_tpu.matchsvc")
+
+_U32 = struct.Struct("<I")
+_DEC_HDR = struct.Struct("<IQIII")  # has_cols, rev, S, n, b
+
+# ------------------------------------------------------ payload codec
+#
+# The slot payload formats both sides agree on.  Kept here (the
+# service facade) so the worker-side client imports ONE source of
+# truth; all numpy columns cross as raw little-endian bytes.
+
+
+def pack_match_req(topics: List[str], congested: bool) -> Tuple[bytes, ...]:
+    parts: List[bytes] = [
+        struct.pack("<BI", 1 if congested else 0, len(topics))
+    ]
+    for t in topics:
+        tb = t.encode("utf-8")
+        parts.append(struct.pack("<H", len(tb)))
+        parts.append(tb)
+    return tuple(parts)
+
+
+def unpack_match_req(payload: bytes) -> Tuple[List[str], bool]:
+    congested, n = struct.unpack_from("<BI", payload, 0)
+    pos = 5
+    topics: List[str] = []
+    for _ in range(n):
+        (ln,) = struct.unpack_from("<H", payload, pos)
+        pos += 2
+        topics.append(payload[pos:pos + ln].decode("utf-8"))
+        pos += ln
+    return topics, bool(congested)
+
+
+def pack_match_resp(id_sets: List[List[int]]) -> Tuple[bytes, ...]:
+    n = len(id_sets)
+    lens = np.fromiter((len(s) for s in id_sets), np.uint32, n)
+    offsets = np.zeros(n + 1, dtype=np.uint32)
+    np.cumsum(lens, out=offsets[1:])
+    total = int(offsets[-1])
+    fids = np.empty(total, dtype=np.uint32)
+    pos = 0
+    for s in id_sets:
+        fids[pos:pos + len(s)] = s
+        pos += len(s)
+    return (
+        struct.pack("<II", n, total),
+        offsets.tobytes(),
+        fids.tobytes(),
+    )
+
+
+def unpack_match_resp(payload: bytes) -> List[np.ndarray]:
+    n, total = struct.unpack_from("<II", payload, 0)
+    pos = 8
+    offsets = np.frombuffer(payload, np.uint32, n + 1, pos)
+    pos += (n + 1) * 4
+    fids = np.frombuffer(payload, np.uint32, total, pos)
+    return [
+        fids[offsets[i]:offsets[i + 1]] for i in range(n)
+    ]
+
+
+def pack_decide_req(
+    cols: Optional[Tuple[np.ndarray, ...]], rev: int,
+    opts_rows: np.ndarray, client_rows: np.ndarray,
+    msg_idx: np.ndarray, m_qos: np.ndarray, m_retain: np.ndarray,
+    m_from_row: np.ndarray,
+) -> Tuple[bytes, ...]:
+    n = len(opts_rows)
+    b = len(m_qos)
+    s = len(cols[0]) if cols is not None else 0
+    parts: List[bytes] = [
+        _DEC_HDR.pack(1 if cols is not None else 0, rev, s, n, b)
+    ]
+    if cols is not None:
+        oa_qos, oa_nl, oa_rap, oa_subid = cols
+        parts += [
+            np.ascontiguousarray(oa_qos, dtype=np.int8).tobytes(),
+            np.ascontiguousarray(oa_nl, dtype=np.uint8).tobytes(),
+            np.ascontiguousarray(oa_rap, dtype=np.uint8).tobytes(),
+            np.ascontiguousarray(oa_subid, dtype=np.uint8).tobytes(),
+        ]
+    parts += [
+        np.ascontiguousarray(opts_rows, dtype=np.int64).tobytes(),
+        np.ascontiguousarray(client_rows, dtype=np.int64).tobytes(),
+        np.ascontiguousarray(msg_idx, dtype=np.int64).tobytes(),
+        np.ascontiguousarray(m_qos, dtype=np.int8).tobytes(),
+        np.ascontiguousarray(m_retain, dtype=np.uint8).tobytes(),
+        np.ascontiguousarray(m_from_row, dtype=np.int32).tobytes(),
+    ]
+    return tuple(parts)
+
+
+def unpack_decide_req(payload: bytes):
+    has_cols, rev, s, n, b = _DEC_HDR.unpack_from(payload, 0)
+    pos = _DEC_HDR.size
+    cols = None
+    if has_cols:
+        oa_qos = np.frombuffer(payload, np.int8, s, pos)
+        pos += s
+        oa_nl = np.frombuffer(payload, np.uint8, s, pos).view(bool)
+        pos += s
+        oa_rap = np.frombuffer(payload, np.uint8, s, pos).view(bool)
+        pos += s
+        oa_subid = np.frombuffer(payload, np.uint8, s, pos).view(bool)
+        pos += s
+        cols = (oa_qos, oa_nl, oa_rap, oa_subid)
+    opts_rows = np.frombuffer(payload, np.int64, n, pos)
+    pos += n * 8
+    client_rows = np.frombuffer(payload, np.int64, n, pos)
+    pos += n * 8
+    msg_idx = np.frombuffer(payload, np.int64, n, pos)
+    pos += n * 8
+    m_qos = np.frombuffer(payload, np.int8, b, pos)
+    pos += b
+    m_retain = np.frombuffer(payload, np.uint8, b, pos).view(bool)
+    pos += b
+    m_from_row = np.frombuffer(payload, np.int32, b, pos)
+    return (cols, rev, opts_rows, client_rows, msg_idx, m_qos,
+            m_retain, m_from_row)
+
+
+def pack_decide_resp(packed: np.ndarray, path: str) -> Tuple[bytes, ...]:
+    return (
+        struct.pack("<B", 1 if path == "dev" else 0),
+        np.ascontiguousarray(packed, dtype=np.uint8).tobytes(),
+    )
+
+
+def unpack_decide_resp(payload: bytes) -> Tuple[np.ndarray, str]:
+    # COPY out of the message buffer: the decision column outlives
+    # this frame
+    packed = np.frombuffer(payload, np.uint8, len(payload) - 1, 1).copy()
+    return packed, ("dev" if payload[0] else "host")
+
+
+# ----------------------------------------------------------- service
+
+
+class _Worker:
+    """One attached worker's connection state."""
+
+    __slots__ = ("wid", "epoch", "ring", "writer", "cols_rev", "cols",
+                 "fids")
+
+    def __init__(self, wid: int, epoch: int, ring, writer) -> None:
+        self.wid = wid
+        self.epoch = epoch
+        self.ring = ring
+        self.writer = writer
+        self.cols_rev: Optional[int] = None
+        self.cols: Optional[Tuple[np.ndarray, ...]] = None
+        self.fids: Set[int] = set()
+
+
+class MatchService:
+    """The shared match/decide process.  Single event loop, no worker
+    threads: every route mutation and window runs loop-serialized, the
+    same single-writer discipline `emqx_router`'s gen_server gives the
+    reference (and the reason this class carries no locks)."""
+
+    def __init__(self, socket_path: str,
+                 use_device: Optional[bool] = None,
+                 engine_kw: Optional[Dict] = None) -> None:
+        from ..engine import MatchEngine
+
+        self.socket_path = socket_path
+        kw = dict(engine_kw or {})
+        kw.setdefault("use_device", use_device)
+        self.engine = MatchEngine(**kw)
+        self._workers: Dict[int, _Worker] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._stats = {
+            "windows": 0, "topics": 0, "decides": 0, "route_ops": 0,
+            "errors": 0,
+        }
+
+    # ------------------------------------------------------ lifecycle
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_unix_server(
+            self._serve, path=self.socket_path
+        )
+        log.info("match service on %s (device=%s)",
+                 self.socket_path, self._device_on())
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        for w in list(self._workers.values()):
+            self._drop_worker(w)
+
+    def _device_on(self) -> bool:
+        eng = self.engine
+        if eng.use_device is False:
+            return False
+        try:
+            import jax
+
+            return jax.devices()[0].platform != "cpu"
+        except Exception:
+            return False
+
+    # ------------------------------------------------------- routes
+
+    def _drop_worker(self, w: _Worker) -> None:
+        self._workers.pop(w.wid, None)
+        for fid_id in list(w.fids):
+            self.engine.delete((w.wid, fid_id))
+        w.fids.clear()
+        if w.ring is not None:
+            w.ring.close()
+            w.ring = None
+        try:
+            w.writer.close()
+        except Exception:
+            pass
+
+    def _apply_routes(self, w: _Worker, add, delete) -> None:
+        for fid_id, flt in add:
+            fid_id = int(fid_id)
+            self.engine.insert(flt, (w.wid, fid_id))
+            w.fids.add(fid_id)
+            self._stats["route_ops"] += 1
+        for fid_id in delete:
+            fid_id = int(fid_id)
+            self.engine.delete((w.wid, fid_id))
+            w.fids.discard(fid_id)
+            self._stats["route_ops"] += 1
+
+    # ------------------------------------------------------- windows
+
+    def _serve_window(self, w: _Worker, slot: int, seq: int) -> Dict:
+        """One doorbelled slot: read request, compute, write response
+        into the same slot.  Returns the completion doorbell dict."""
+        if w.ring is None or self._workers.get(w.wid) is not w:
+            # superseded/dropped incarnation: its ring is closed — a
+            # late doorbell from the old connection must not touch it
+            self._stats["errors"] += 1
+            return {"t": "e", "slot": slot, "seq": seq,
+                    "err": "worker detached"}
+        got = w.ring.read(slot, w.epoch, seq)
+        if got is None:
+            self._stats["errors"] += 1
+            return {"t": "e", "slot": slot, "seq": seq,
+                    "err": "stale slot header"}
+        kind, payload = got
+        try:
+            from ..broker import shmring
+
+            if kind == shmring.KIND_MATCH_REQ:
+                topics, congested = unpack_match_req(payload)
+                matched = self.engine.match_batch(
+                    topics, congested=congested
+                )
+                wid = w.wid
+                ids = [
+                    [f[1] for f in s if type(f) is tuple and f[0] == wid]
+                    for s in matched
+                ]
+                parts = pack_match_resp(ids)
+                w.ring.write(slot, w.epoch, seq,
+                             shmring.KIND_MATCH_RESP, parts)
+                self._stats["windows"] += 1
+                self._stats["topics"] += len(topics)
+            elif kind == shmring.KIND_DECIDE_REQ:
+                (cols, rev, opts_rows, client_rows, msg_idx, m_qos,
+                 m_retain, m_from_row) = unpack_decide_req(payload)
+                if cols is not None:
+                    # own the columns beyond this slot's lifetime
+                    w.cols = tuple(np.array(c) for c in cols)
+                    w.cols_rev = rev
+                elif w.cols_rev != rev or w.cols is None:
+                    self._stats["errors"] += 1
+                    return {"t": "e", "slot": slot, "seq": seq,
+                            "err": "cols cache miss"}
+                packed, path = self.engine.decide_window(
+                    w.cols, (w.wid << 32) | (rev & 0xFFFFFFFF),
+                    np.array(opts_rows), np.array(client_rows),
+                    np.array(msg_idx), np.array(m_qos),
+                    np.array(m_retain), np.array(m_from_row),
+                )
+                w.ring.write(slot, w.epoch, seq,
+                             shmring.KIND_DECIDE_RESP,
+                             pack_decide_resp(packed, path))
+                self._stats["decides"] += 1
+            else:
+                self._stats["errors"] += 1
+                return {"t": "e", "slot": slot, "seq": seq,
+                        "err": f"unknown kind {kind}"}
+        except Exception as exc:  # degrade THIS window, not the worker
+            log.exception("window slot=%d seq=%d failed", slot, seq)
+            self._stats["errors"] += 1
+            return {"t": "e", "slot": slot, "seq": seq, "err": str(exc)}
+        return {"t": "c", "slot": slot, "seq": seq}
+
+    # ---------------------------------------------------- connection
+
+    async def _serve(self, reader: asyncio.StreamReader,
+                     writer: asyncio.StreamWriter) -> None:
+        w: Optional[_Worker] = None
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    break
+                try:
+                    obj = json.loads(line)
+                except json.JSONDecodeError:
+                    log.warning("bad control line: %r", line[:80])
+                    continue
+                t = obj.get("t")
+                if t == "hello":
+                    w = await self._handle_hello(obj, writer)
+                elif w is None:
+                    self._send(writer, {"t": "e", "err": "hello first"})
+                elif t == "routes":
+                    self._apply_routes(
+                        w, obj.get("add") or (), obj.get("del") or ()
+                    )
+                    self._send(writer, {"t": "routes_ok",
+                                        "seq": obj.get("seq", 0)})
+                elif t == "w":
+                    out = self._serve_window(
+                        w, int(obj["slot"]), int(obj["seq"])
+                    )
+                    self._send(writer, out)
+                elif t == "ping":
+                    self._send(writer, {"t": "pong",
+                                        "stats": dict(self._stats),
+                                        "routes": len(self.engine)})
+                await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            if w is not None and self._workers.get(w.wid) is w:
+                log.info("worker %d detached; dropping %d routes",
+                         w.wid, len(w.fids))
+                self._drop_worker(w)
+            else:
+                writer.close()
+
+    async def _handle_hello(self, obj: Dict,
+                            writer: asyncio.StreamWriter
+                            ) -> Optional[_Worker]:
+        from ..broker import shmring
+
+        wid = int(obj["worker"])
+        epoch = int(obj.get("epoch", 0))
+        old = self._workers.get(wid)
+        if old is not None:
+            # a newer incarnation of this worker supersedes the old
+            # connection (and its route set) atomically
+            self._drop_worker(old)
+        try:
+            ring = shmring.WindowRing.attach(obj["ring"])
+        except Exception as exc:
+            log.warning("worker %d ring attach failed: %s", wid, exc)
+            self._send(writer, {"t": "e", "err": f"ring: {exc}"})
+            return None
+        w = _Worker(wid, epoch, ring, writer)
+        self._workers[wid] = w
+        self._send(writer, {"t": "hello_ok",
+                            "device": self._device_on()})
+        log.info("worker %d attached (epoch %d, ring %s)",
+                 wid, epoch, obj["ring"])
+        return w
+
+    @staticmethod
+    def _send(writer: asyncio.StreamWriter, obj: Dict) -> None:
+        writer.write(json.dumps(obj).encode() + b"\n")
+
+
+# --------------------------------------------------------------- cli
+
+
+def main(argv=None) -> None:
+    import argparse
+    import os
+    import signal
+
+    ap = argparse.ArgumentParser(
+        description="emqx_tpu multicore match service"
+    )
+    ap.add_argument("--socket", required=True,
+                    help="unix control socket path")
+    ap.add_argument("--engine-json", default=None,
+                    help="MatchEngine kwargs as JSON")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    engine_kw = json.loads(args.engine_json) if args.engine_json else None
+    if os.path.exists(args.socket):
+        os.unlink(args.socket)
+
+    async def run() -> None:
+        svc = MatchService(args.socket, engine_kw=engine_kw)
+        await svc.start()
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        try:
+            await stop.wait()
+        finally:
+            await svc.stop()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

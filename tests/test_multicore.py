@@ -4,6 +4,10 @@ subscribers owned by any other (the esockd-acceptor-pool +
 broker-pool role, emqx_broker.erl:539-540, as processes)."""
 
 import asyncio
+import json
+import os
+import subprocess
+import sys
 
 from emqx_tpu.broker.multicore import (free_ports, spawn_workers,
                                        worker_configs)
@@ -63,3 +67,49 @@ def test_cross_worker_pubsub():
         assert pool.alive() == 3
     finally:
         pool.stop()
+
+
+def test_worker_configs_shard_durable_homes(tmp_path):
+    """Durable multicore pools shard their session homes: per-worker
+    data dirs + the crc32 shard rule in every worker's resume config
+    (no two workers may hold rival checkpoints for one client)."""
+    base = {"durable": {"enable": True, "data_dir": str(tmp_path)}}
+    cfgs = worker_configs(2, 1883, base_config=base,
+                          service_socket="/tmp/svc.sock")
+    for i, cfg in enumerate(cfgs):
+        assert cfg["durable"]["data_dir"] == str(tmp_path / f"worker{i}")
+        assert cfg["durable"]["resume"]["shard_index"] == i
+        assert cfg["durable"]["resume"]["shard_count"] == 2
+        assert cfg["multicore"] == {
+            "n_workers": 2, "worker_id": i,
+            "service_socket": "/tmp/svc.sock",
+        }
+
+
+def test_worker_configs_merge_olp(tmp_path):
+    cfgs = worker_configs(
+        2, 1883, base_config={"olp": {"hwm_backlog": 9}},
+        olp={"enable": True},
+    )
+    for cfg in cfgs:
+        assert cfg["olp"] == {"hwm_backlog": 9, "enable": True}
+
+
+def test_bench_smoke_mode():
+    """The tier-1 liveness gate: `bench_multicore --smoke` boots the
+    full 2-worker + match-service topology, pushes one pubsub round,
+    shuts down cleanly, and lints the multicore modules clean."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(repo, "tools", "bench_multicore.py"), "--smoke"],
+        capture_output=True, text=True, timeout=240, cwd=repo,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    res = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert res["mc_smoke"] == "ok"
+    assert res["mc_alive"] == 2
+    assert res["mc_service_alive"] is True
+    assert res["mc_stopped_clean"] is True
+    assert res["lint_findings"] == 0

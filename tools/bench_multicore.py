@@ -1,11 +1,17 @@
 """Multi-core broker benchmark: N worker processes (SO_REUSEPORT +
-loopback clustering) driven by K load-generator processes, so neither
-side is single-core-bound.  Prints ONE JSON line.
+loopback clustering + the shared match service) driven by K
+load-generator processes, so neither side is single-core-bound.
+Prints ONE JSON line.
 
 Workload = the emqtt_bench shape run_broker_bench uses: S wildcard
 subscribers (bench/{i}/#), P QoS1 publishers round-robining over
 them; with workers sharing the accept socket, most deliveries cross
-worker processes over the binary cluster wire."""
+worker processes over the binary cluster wire.
+
+``--smoke`` is the tier-1 fast path: 2 workers + the match service,
+one tiny cross-worker pubsub round, liveness + clean-shutdown checks,
+and a zero-findings brokerlint pass over the multicore modules —
+small enough to run un-``slow``-marked in CI."""
 
 import asyncio
 import json
@@ -125,12 +131,65 @@ async def _loadgen(port, gen_id, n_pubs, n_subs, sub_base, n_msgs,
     import numpy as np
 
     lat_ms = np.array(lat) * 1e3
-    print(json.dumps({
+    return {
         "msgs": total,
         "elapsed": elapsed,
         "p50_ms": float(np.percentile(lat_ms, 50)),
         "p99_ms": float(np.percentile(lat_ms, 99)),
-    }))
+    }
+
+
+def smoke():
+    """Tier-1 liveness smoke: boot the REAL multicore topology (2
+    workers sharing the port + the match service over shm rings), push
+    one small cross-worker pubsub round, then prove clean shutdown and
+    a clean brokerlint over the multicore modules.  Prints ONE JSON
+    line; exits non-zero on any failed check."""
+    from emqx_tpu.broker.multicore import free_ports, spawn_workers
+    from tools.brokerlint.engine import run_lint
+
+    ncpu = os.cpu_count() or 1
+    port = free_ports(1)[0]
+    pool = spawn_workers(2, port, bind="127.0.0.1")
+    try:
+        pool.wait_ready(port, timeout=120)
+        time.sleep(1.5)  # cluster mesh + service attach settle
+        res = asyncio.run(_loadgen(
+            port, 0, n_pubs=2, n_subs=4, sub_base=0, n_msgs=5,
+            inflight=16,
+        ))
+        alive = pool.alive()
+        service_alive = pool.service_alive()
+    finally:
+        pool.stop()
+    # clean shutdown: SIGINT drains the workers, SIGTERM the service
+    stopped_clean = (pool.procs == [] and pool.service_proc is None
+                     and not os.path.exists(pool.service_socket))
+    findings = run_lint([
+        "emqx_tpu/broker/shmring.py",
+        "emqx_tpu/broker/matchclient.py",
+        "emqx_tpu/broker/multicore.py",
+        "emqx_tpu/ops/matchsvc.py",
+    ])
+    out = {
+        "mc_smoke": "ok",
+        "mc_host_cpus": ncpu,
+        "mc_workers": 2,
+        "mc_alive": alive,
+        "mc_service_alive": service_alive,
+        "mc_stopped_clean": stopped_clean,
+        "mc_msgs": res["msgs"],
+        "mc_delivery_p50_ms": round(res["p50_ms"], 2),
+        "lint_findings": len(findings),
+    }
+    failed = (alive != 2 or not service_alive or not stopped_clean
+              or res["msgs"] != 2 * 5 or findings)
+    if failed:
+        out["mc_smoke"] = "FAILED"
+        if findings:
+            out["lint"] = [f.render() for f in findings]
+    print(json.dumps(out))
+    sys.exit(1 if failed else 0)
 
 
 def main():
@@ -182,6 +241,7 @@ def main():
             "mc_host_cpus": ncpu,
             "mc_workers": n_workers,
             "mc_alive": pool.alive(),
+            "mc_service_alive": pool.service_alive(),
             "mc_loadgens": n_gens,
             "mc_msgs": total,
             "mc_msgs_per_s": round(total / elapsed, 1),
@@ -200,9 +260,12 @@ if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--loadgen":
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         _, _, port, gid, pubs, subs, base, msgs = sys.argv
-        asyncio.run(_loadgen(
+        print(json.dumps(asyncio.run(_loadgen(
             int(port), int(gid), int(pubs), int(subs), int(base),
             int(msgs), inflight=256,
-        ))
+        ))))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--smoke":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        smoke()
     else:
         main()

@@ -84,6 +84,16 @@ SEAM_FUNCS: Tuple[Seam, ...] = (
     Seam("emqx_tpu/ds/journal.py", "MetaJournal.append",
          "ds.journal.append"),
     Seam("emqx_tpu/ds/native.py", "DsLog.gc", "ds.gc.reclaim"),
+    Seam("emqx_tpu/broker/matchclient.py",
+         "ServiceMatchEngine._ring_submit", "multicore.ring.submit"),
+    Seam("emqx_tpu/broker/matchclient.py",
+         "ServiceMatchEngine._ring_decide", "multicore.ring.submit"),
+    Seam("emqx_tpu/broker/matchclient.py",
+         "ServiceMatchEngine._ring_complete",
+         "multicore.ring.complete"),
+    Seam("emqx_tpu/broker/matchclient.py",
+         "ServiceMatchEngine._reconnect_once",
+         "multicore.service.restart"),
 )
 
 

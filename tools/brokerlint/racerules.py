@@ -97,6 +97,12 @@ SHARED_CLASSES: Tuple[SharedClass, ...] = (
     SharedClass("emqx_tpu/ds/durability.py", "SyncGate"),
     SharedClass("emqx_tpu/ds/durability.py", "GateGroup"),
     SharedClass("emqx_tpu/olp.py", "LoadMonitor"),
+    # multicore worker<->service handoff state: the shm ring's free
+    # list (submits from executor threads, releases from the reader
+    # thread) and the service client's attach/seq/completion state
+    SharedClass("emqx_tpu/broker/shmring.py", "WindowRing"),
+    SharedClass("emqx_tpu/broker/matchclient.py", "ServiceMatchEngine"),
+    SharedClass("emqx_tpu/ops/matchsvc.py", "MatchService"),
 )
 
 _METRIC_CALL_TAILS = {"inc", "observe", "inc_bulk"}
