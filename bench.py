@@ -1803,6 +1803,110 @@ def run_overload_bench(log, iters=None, write_json=True):
     return out
 
 
+def run_flightrec_bench(log, iters=None, write_json=True):
+    """Flight-recorder overhead A/B (BENCH_r15, the flight-recorder
+    tentpole's acceptance criterion): fanout-256 QoS1 windows with the
+    always-on recorder ARMED (one ring append per committed window via
+    Profiler.commit, plus a tick — SLO delta check, samplers — inside
+    the timed region) vs disabled (``flight.enable=false``: the
+    recorder object exists but ``armed`` is False and the profiler
+    hook is None — the pre-PR dispatch byte-for-byte, which the
+    property suite pins bit-identical).  Paired interleaved on one
+    box; medians.  The criterion: armed-vs-off median throughput
+    within 2%."""
+    import statistics
+
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.broker.channel import Channel
+    from emqx_tpu.broker.session import SubOpts
+    from emqx_tpu.codec import mqtt as C
+    from emqx_tpu.config import BrokerConfig
+    from emqx_tpu.message import Message
+
+    iters = int(os.environ.get("BENCH_FLIGHT_ITERS", iters or 5))
+
+    def fanout_once(armed):
+        cfg = BrokerConfig()
+        cfg.engine.use_device = False
+        cfg.flight.enable = armed
+        b = Broker(config=cfg)
+        sink = [0]
+
+        def send(pkts):
+            sink[0] += sum(
+                len(C.serialize(p, C.MQTT_V5)) for p in pkts
+            )
+
+        flt = "fan/flight"
+        for i in range(256):
+            ch = Channel(b, send=send, close=lambda r: None)
+            cid = f"f{i}"
+            session, _ = b.cm.open_session(
+                True, cid, ch, max_inflight=0
+            )
+            session.subscribe(flt, SubOpts(qos=1))
+            b.subscribe(cid, flt, SubOpts(qos=1))
+        n = 500
+        msgs = [Message(topic=flt, payload=b"x" * 64, qos=1)
+                for _ in range(n)]
+        b.publish_many(msgs[:64])  # warm
+        t0 = time.perf_counter()
+        for w0 in range(64, n, 64):
+            w = msgs[w0:w0 + 64]
+            now = time.time()
+            for m in w:
+                m.timestamp = now
+            b.publish_many(w)
+        # the recorder's 1 Hz housekeeping, charged to the armed side
+        # (production runs it from the broker tick)
+        b.flight.tick(profiler=b.profiler)
+        dt = time.perf_counter() - t0
+        b.flight.stop()
+        return (n - 64) / dt
+
+    on_rates, off_rates = [], []
+    for _ in range(iters):  # paired interleaved
+        off_rates.append(fanout_once(False))
+        on_rates.append(fanout_once(True))
+    off_med = statistics.median(off_rates)
+    on_med = statistics.median(on_rates)
+    ratio = on_med / off_med
+    results = {
+        "fanout256_qos1_flight_off_msgs_per_s": off_med,
+        "fanout256_qos1_flight_on_msgs_per_s": on_med,
+        "armed_over_off_ratio": ratio,
+        "within_2pct": bool(ratio >= 0.98),
+        "iters": iters,
+    }
+    log(
+        f"flightrec fanout-256 qos1: recorder-off {off_med:,.0f} "
+        f"msg/s vs armed {on_med:,.0f} ({ratio:.3f}x — criterion "
+        f">= 0.98)"
+    )
+    if write_json:
+        out = {
+            "schema": "flight-recorder overhead A/B",
+            "note": (
+                "Interleaved A/B, {it} iteration pairs, same box "
+                "(bench.py run_flightrec_bench): fanout-256 QoS1, "
+                "500 msgs in 64-msg windows per iteration, fresh "
+                "broker per run.  'armed' = always-on flight "
+                "recorder (ring append per committed window + one "
+                "tick with SLO delta check inside the timed "
+                "region); 'off' = flight.enable=false (the pre-PR "
+                "dispatch — the property suite pins the armed wire "
+                "bit-identical to it).  Medians; acceptance is "
+                "armed/off >= 0.98."
+            ).format(it=iters),
+            **results,
+        }
+        with open(os.path.join(
+            os.path.dirname(__file__) or ".", "BENCH_r15.json"
+        ), "w") as f:
+            json.dump(out, f, indent=2)
+    return results
+
+
 def run_broker_bench(log, mode="auto"):
     """End-to-end socket benchmark (BASELINE config 1 shape, the
     emqtt_bench workload): N publishers / M wildcard subscribers over
@@ -2527,6 +2631,12 @@ def main():
         # (BENCH_r11 tracks the PR 13 tentpole)
         overload_stats = run_overload_bench(log)
 
+    flight_stats = {}
+    if os.environ.get("BENCH_FLIGHT", "1") != "0":
+        # always-on flight recorder armed vs off (BENCH_r15 tracks
+        # the flight-recorder tentpole's <=2% overhead criterion)
+        flight_stats = run_flightrec_bench(log)
+
     rules_stats = {}
     if os.environ.get("BENCH_RULES", "1") != "0":
         # rule-engine WHERE matrix vs the scalar interpreter referee
@@ -2591,6 +2701,7 @@ def main():
         "cluster_forward": cluster_fwd_stats,
         "rules": rules_stats,
         "overload": overload_stats,
+        "flightrec": flight_stats,
         **sharded_stats,
         **broker_stats,
     }

@@ -77,6 +77,21 @@ class Broker:
             enabled=prof_cfg.enable,
             process_label=self.config.node_name,
         )
+        # always-on flight recorder (flightrec.py): the per-process
+        # black box.  Committed windows mirror into its numeric ring
+        # via the profiler hook; olp transitions, breaker/alarm edges,
+        # ring occupancy, failpoint fires and watchdog stalls join
+        # them, and anomaly triggers freeze + dump the lot.
+        from ..flightrec import FlightRecorder
+
+        self.flight = FlightRecorder.from_config(
+            self.config.flight,
+            process_label=self.config.node_name,
+            role="broker",
+            metrics=self.metrics,
+        )
+        self.flight.profiler = self.profiler
+        self.profiler.flight = self.flight if self.flight.armed else None
         # per-message lifecycle tracer (tracecontext.py): head-sampled
         # trace contexts through the batched path, spans cut from the
         # profiler's WindowRecords, propagated across cluster/worker
@@ -131,6 +146,22 @@ class Broker:
         # L1 ladder: background rebuilds defer while the broker is
         # overloaded (the delta tiers keep serving correctness)
         self.router.engine.defer_rebuild = self.olp.defer_rebuild
+        if hasattr(engine, "flight_broadcast"):
+            # multicore worker: the engine's control stream carries the
+            # "dump now, correlated by id" broadcast, detects service
+            # restarts, and samples its shm ring's occupancy at 1 Hz
+            engine.flight = self.flight
+            engine.metrics = self.metrics
+            self.flight.on_trigger = engine.flight_broadcast
+            from ..flightrec import EV_RING
+
+            def _ring_sampler(fl, _ring=engine._ring) -> None:
+                st = _ring.stats()
+                fl.record(EV_RING, float(st["in_flight"]),
+                          float(st["high_watermark"]),
+                          float(st["full"]), float(st["free"]))
+
+            self.flight.add_sampler(_ring_sampler)
         ret_cfg = self.config.retainer
         self.retainer = Retainer(
             max_retained_messages=ret_cfg.max_retained_messages,
@@ -1120,12 +1151,15 @@ class Broker:
                 counts = self._dispatch_window(
                     live, matched, rule_sink=rule_sink, rec=rec
                 )
-            except Exception:
+            except Exception as exc:
                 log.exception(
                     "window dispatch failed for %d messages", len(live)
                 )
                 self.metrics.inc("messages.publish.error", len(live))
                 counts = [0] * len(live)
+                # unhandled dispatch fault: exactly the black-box case —
+                # freeze the ring while the evidence is still in it
+                self.flight.dispatch_fault("publish_dispatch", exc)
         j = 0
         for i, r in enumerate(results):
             if r is None:
@@ -2414,6 +2448,14 @@ class Broker:
         self.delayed.tick(now)
         self.topic_metrics.tick(now)
         self.olp.tick(now)
+        # flight housekeeping: watchdog heartbeat, occupancy samplers,
+        # failpoint drain, per-stage p99 SLO checks; also poll the
+        # match service for its counters/histograms (fire-and-forget —
+        # the pong lands on the client's reader thread)
+        self.flight.tick(now, self.profiler)
+        poll = getattr(self.router.engine, "poll_service", None)
+        if poll is not None:
+            poll()
         self.alarms.tick(now)
         self.slow_subs.tick(now)
         self.ft.tick(now)
@@ -2503,12 +2545,14 @@ class Broker:
     def _ds_synced(self, dur_s: float) -> None:
         self.metrics.inc("ds.sync.count")
         self.profiler.stage("ds_sync", dur_s)
+        self.flight.fsync(dur_s)
 
     def _ds_sync_error(self, exc: BaseException) -> None:
         self.metrics.inc("ds.sync.errors")
 
     def _engine_breaker_trip(self, info: Dict) -> None:
         self.metrics.inc("engine.breaker.trip")
+        self.flight.breaker_edge(True, info)
         self._on_loop(lambda: self.alarms.activate(
             "engine_device_path",
             details=info,
@@ -2517,12 +2561,14 @@ class Broker:
 
     def _engine_breaker_clear(self, info: Dict) -> None:
         self.metrics.inc("engine.breaker.clear")
+        self.flight.breaker_edge(False, info)
         self._on_loop(
             lambda: self.alarms.deactivate("engine_device_path")
         )
 
     def shutdown(self) -> None:
         """Flush and close durable state (called by BrokerServer.stop)."""
+        self.flight.stop()
         self.trace.stop_all()
         if self.durable is not None:
             self.durable.close()
@@ -2566,6 +2612,8 @@ class Broker:
             # durability contract surface: fsync mode, group-commit
             # flush counters, unsynced/parked backlog, corruption
             node["durability"] = self.durable.sync_stats()
+        if self.flight.armed:
+            node["flight"] = self.flight.status()
         mc = self.config.multicore
         if mc.service_socket or mc.n_workers:
             node["multicore"] = {

@@ -438,6 +438,10 @@ class BrokerServer:
                 interval=cfg.telemetry_interval,
             )
             await self.telemetry.start()
+        # serving process: arm the event-loop-lag watchdog + GC-pause
+        # observer (short-lived test brokers never reach here, so they
+        # never spawn the thread)
+        self.broker.flight.arm_watchdog()
         self._housekeeper = asyncio.get_running_loop().create_task(
             self._housekeeping()
         )

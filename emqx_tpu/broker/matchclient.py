@@ -43,7 +43,7 @@ from typing import Dict, Hashable, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
-from .. import failpoints
+from .. import failpoints, flightrec
 from ..engine import MatchEngine
 from ..ops import matchsvc as wire
 from . import shmring
@@ -100,8 +100,18 @@ class ServiceMatchEngine(MatchEngine):
         self._cols_sent_rev: Optional[int] = None
         self.svc_stats = {
             "windows": 0, "decides": 0, "fallbacks": 0, "ring_full": 0,
-            "reconnects": 0, "route_lines": 0,
+            "reconnects": 0, "route_lines": 0, "quarantined": 0,
+            "oversize": 0,
         }
+        # observability wiring (set by the owning Broker): the flight
+        # recorder sees ring-full edges / detaches and carries the
+        # cross-process dump broadcast; the metrics registry gets the
+        # multicore.ring.* counters
+        self.flight = None
+        self.metrics = None
+        self._flight_pending: Optional[Tuple[str, str]] = None
+        self._svc_remote: Dict = {}   # last pong payload from service
+        self._ring_full_log_ts = 0.0  # rate-limits the degrade warning
         self._reader = threading.Thread(
             target=self._reader_main,
             name=f"matchsvc-client-w{worker_id}", daemon=True,
@@ -120,15 +130,40 @@ class ServiceMatchEngine(MatchEngine):
             return self._attached
 
     def service_info(self) -> Dict:
-        """Attachment + fallback counters for /api/v5/nodes."""
+        """Attachment + fallback counters for /api/v5/nodes, plus the
+        ring occupancy snapshot and the service's last pong payload
+        (service-side counters + stage histograms)."""
         with self._lk:
             return {
                 "attached": self._attached,
                 "service_device": self._svc_device,
                 "epoch": self._epoch,
                 "ring_free": self._ring.free_slots(),
+                "ring": self._ring.stats(),
+                "service": dict(self._svc_remote),
                 **dict(self.svc_stats),
             }
+
+    def poll_service(self) -> bool:
+        """Fire-and-forget service stats poll (1 Hz from the broker
+        tick): the pong lands on the reader thread and is cached in
+        ``_svc_remote`` for service_info / /metrics."""
+        return self._send({"t": "ping"})
+
+    def flight_broadcast(self, trig_id: str, reason: str) -> None:
+        """Carry a flight-dump trigger to the service (which dumps its
+        own ring under the same id and relays to the other workers).
+        When the anomaly IS the lost service connection, the line is
+        queued and sent right after the next successful re-attach —
+        the service's post-restart incarnation still holds its
+        (fresh) ring, and every sibling worker still holds the window
+        of history that matters."""
+        msg = {"t": "flight", "id": trig_id, "reason": reason,
+               "worker": self.worker_id}
+        if not self._send(msg):
+            with self._lk:
+                if not self._closed:
+                    self._flight_pending = (trig_id, reason)
 
     def close(self) -> None:
         with self._cond:
@@ -298,6 +333,17 @@ class ServiceMatchEngine(MatchEngine):
                     })
                     with self._lk:
                         self.svc_stats["route_lines"] += 1
+                # a dump broadcast that raced the outage goes out the
+                # moment the control stream exists again, so the
+                # restarted service still joins the correlated capture
+                with self._lk:
+                    pending = self._flight_pending
+                    self._flight_pending = None
+                if pending is not None:
+                    self._send_locked(sock, {
+                        "t": "flight", "id": pending[0],
+                        "reason": pending[1], "worker": self.worker_id,
+                    })
             sock.settimeout(None)
             log.info("attached to match service %s (epoch %d, "
                      "device=%s, %d routes)", self.socket_path, epoch,
@@ -335,10 +381,32 @@ class ServiceMatchEngine(MatchEngine):
                     elif seq in self._waiting:
                         self._done[seq] = obj
                         self._cond.notify_all()
-            # routes_ok / pong / unknown lines are informational
+            elif t == "flight":
+                # correlated dump request initiated elsewhere in the
+                # pool: freeze + persist THIS worker's ring under the
+                # initiator's id (idempotent per id)
+                fl = self.flight
+                if fl is not None:
+                    fl.dump_remote(
+                        str(obj.get("id") or ""),
+                        str(obj.get("reason") or ""),
+                    )
+            elif t == "pong":
+                with self._lk:
+                    self._svc_remote = {
+                        "stats": obj.get("stats") or {},
+                        "hist": obj.get("hist") or {},
+                        "routes": obj.get("routes"),
+                        "flight": obj.get("flight") or {},
+                        "at": time.time(),
+                    }
+            # routes_ok / unknown lines are informational
 
     def _detach(self, sock: socket.socket) -> None:
         with self._cond:
+            was_attached = self._attached
+            closed = self._closed
+            dead_epoch = self._epoch
             self._attached = False
             self._svc_device = False
             if self._sock is sock:
@@ -351,8 +419,53 @@ class ServiceMatchEngine(MatchEngine):
             self._done.clear()
             self._cond.notify_all()
         sock.close()
+        # outside the locks: the trigger dumps and then broadcasts via
+        # flight_broadcast, which re-enters _slk/_lk
+        if was_attached and not closed:
+            fl = self.flight
+            if fl is not None:
+                # epoch-keyed deterministic id: every worker watching
+                # incarnation N die mints the SAME id, so one service
+                # death yields one correlated capture even though the
+                # relay hub is down at detection time
+                fl.service_restart({
+                    "socket": self.socket_path,
+                    "worker": self.worker_id,
+                }, key=f"e{dead_epoch}")
 
     # ------------------------------------------------------- windows
+
+    def _note_ring_full(self) -> None:
+        """Ring-full degrade bookkeeping: counters, a flight event,
+        and a rate-limited warning that names WHICH ring saturated and
+        at what depth (the window itself degrades to the in-process
+        path — correct, just slower)."""
+        with self._lk:
+            self.svc_stats["ring_full"] += 1
+        m = self.metrics
+        if m is not None:
+            m.inc("multicore.ring.full")
+        st = self._ring.stats()
+        fl = self.flight
+        if fl is not None:
+            fl.record(flightrec.EV_RING_FULL, float(st["slots"]),
+                      float(st["full"]))
+        now = time.monotonic()
+        if now - self._ring_full_log_ts >= 1.0:
+            self._ring_full_log_ts = now
+            log.warning(
+                "worker %d ring %s full at depth %d/%d (hwm %d, "
+                "%d refusals total); window degrades to in-process "
+                "match", self.worker_id, st["name"], st["in_flight"],
+                st["slots"], st["high_watermark"], st["full"],
+            )
+
+    def _note_oversize(self) -> None:
+        with self._lk:
+            self.svc_stats["oversize"] += 1
+        m = self.metrics
+        if m is not None:
+            m.inc("multicore.ring.oversize")
 
     def _ring_submit(self, topics: Sequence[str], congested: bool):
         """Submit one match window over the ring.  Returns a pending
@@ -369,8 +482,7 @@ class ServiceMatchEngine(MatchEngine):
         try:
             slot = self._ring.acquire()
         except shmring.RingFull:
-            with self._lk:
-                self.svc_stats["ring_full"] += 1
+            self._note_ring_full()
             return None
         with self._lk:
             self._seq += 1
@@ -382,6 +494,7 @@ class ServiceMatchEngine(MatchEngine):
             )
         except ValueError:  # window exceeds slot payload
             self._ring.release(slot)
+            self._note_oversize()
             return None
         with self._lk:
             self._waiting.add(seq)
@@ -421,6 +534,10 @@ class ServiceMatchEngine(MatchEngine):
                     if left <= 0:
                         self._waiting.discard(seq)
                         self._abandoned[seq] = slot
+                        self.svc_stats["quarantined"] += 1
+                        m = self.metrics
+                        if m is not None:
+                            m.inc("multicore.ring.quarantined")
                         return None
                     self._cond.wait(left)
             if obj.get("t") != "c":
@@ -437,6 +554,10 @@ class ServiceMatchEngine(MatchEngine):
             with self._cond:
                 self._waiting.discard(seq)
                 self._abandoned[seq] = slot
+                self.svc_stats["quarantined"] += 1
+            m = self.metrics
+            if m is not None:
+                m.inc("multicore.ring.quarantined")
             return None
 
     # --------------------------------------------- MatchEngine facade
@@ -541,8 +662,7 @@ class ServiceMatchEngine(MatchEngine):
         try:
             slot = self._ring.acquire()
         except shmring.RingFull:
-            with self._lk:
-                self.svc_stats["ring_full"] += 1
+            self._note_ring_full()
             return None
         with self._lk:
             self._seq += 1
@@ -557,6 +677,7 @@ class ServiceMatchEngine(MatchEngine):
             )
         except ValueError:
             self._ring.release(slot)
+            self._note_oversize()
             return None
         with self._lk:
             self._waiting.add(seq)

@@ -237,6 +237,15 @@ class WorkerPool:
         self.procs: List[subprocess.Popen] = []
         self.service_proc: Optional[subprocess.Popen] = None
         self._conf_paths: List[str] = []
+        # one shared flight-dump directory for the whole pool: a
+        # correlated trigger makes every process persist its ring HERE,
+        # so one `GET /api/v5/flight/{id}` (any worker) merges them all
+        for cfg in self.configs:
+            fl = dict(cfg.get("flight") or {})
+            fl.setdefault(
+                "dump_dir", os.path.join(self.log_dir, "flight")
+            )
+            cfg["flight"] = fl
 
     # ------------------------------------------------------- workers
 
@@ -274,6 +283,27 @@ class WorkerPool:
 
     # ------------------------------------------------- match service
 
+    # FlightRecorder constructor keys a FlightConfig-shaped dict may
+    # carry across the --flight-json boundary
+    _FLIGHT_KEYS = (
+        "enable", "ring_size", "notes_cap", "dump_dir", "max_dumps",
+        "min_dump_interval", "watchdog_stall_ms", "slo_p99_ms",
+        "fsync_stall_ms", "gc_stall_ms", "trigger_olp_level",
+        "trigger_on_breaker", "trigger_on_restart", "trigger_on_fault",
+    )
+
+    def _service_flight_kw(self) -> Optional[Dict]:
+        """The service's flight recorder settings: the pool's shared
+        dump_dir + whatever the worker configs carry (minus the
+        profiler-stage SLOs, which are worker-side sensors)."""
+        if not self.configs:
+            return None
+        fl = dict(self.configs[0].get("flight") or {})
+        if not fl.get("enable", True):
+            return None
+        fl.pop("slo_p99_ms", None)
+        return {k: v for k, v in fl.items() if k in self._FLIGHT_KEYS}
+
     def _spawn_service(self, mode: str = "w") -> subprocess.Popen:
         assert self.service_socket is not None
         # a stale socket file from a previous incarnation would make
@@ -286,6 +316,9 @@ class WorkerPool:
                 "--socket", self.service_socket]
         if self.service_engine:
             argv += ["--engine-json", json.dumps(self.service_engine)]
+        fl = self._service_flight_kw()
+        if fl is not None:
+            argv += ["--flight-json", json.dumps(fl)]
         log_f = open(
             os.path.join(self.log_dir, "matchsvc.log"), mode
         )

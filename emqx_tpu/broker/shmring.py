@@ -75,6 +75,13 @@ class WindowRing:
         self._lk = threading.Lock()
         self._free: List[int] = list(range(slots)) if owner else []
         self._closed = False
+        # occupancy instrumentation (owner side, guarded by _lk):
+        # scalar bumps inside sections that already hold the lock, so
+        # the counters are free at the acquire/release call sites
+        self._acquires = 0       # slots handed out, total
+        self._hwm = 0            # max slots simultaneously in flight
+        self._full = 0           # acquire() refusals (RingFull)
+        self._oversize = 0       # write() payloads over slot capacity
 
     # ------------------------------------------------------ lifecycle
 
@@ -136,12 +143,22 @@ class WindowRing:
         this window in-process rather than queue behind the service."""
         with self._lk:
             if self._closed:
-                raise RingFull("ring closed")
+                raise RingFull(f"ring {self._shm.name} closed")
             if not self._free:
+                self._full += 1
+                # name the ring and the depth: the degrade path's log
+                # line must say WHICH worker's ring saturated and how
+                # deep it was, not just "ring full"
                 raise RingFull(
-                    f"all {self.slots} ring slots in flight"
+                    f"ring {self._shm.name}: all {self.slots} slots "
+                    "in flight"
                 )
-            return self._free.pop()
+            slot = self._free.pop()
+            self._acquires += 1
+            in_flight = self.slots - len(self._free)
+            if in_flight > self._hwm:
+                self._hwm = in_flight
+            return slot
 
     def release(self, slot: int) -> None:
         """Return a consumed slot to the free list (owner side)."""
@@ -153,6 +170,23 @@ class WindowRing:
     def free_slots(self) -> int:
         with self._lk:
             return len(self._free)
+
+    def stats(self) -> dict:
+        """Occupancy snapshot (owner side): gauges for /metrics and
+        the flight recorder's 1 Hz ring sampler."""
+        with self._lk:
+            free = len(self._free)
+            return {
+                "name": self._shm.name,
+                "slots": self.slots,
+                "slot_bytes": self.slot_bytes,
+                "free": free,
+                "in_flight": self.slots - free,
+                "high_watermark": self._hwm,
+                "acquires": self._acquires,
+                "full": self._full,
+                "oversize": self._oversize,
+            }
 
     # ----------------------------------------------------- slot io
 
@@ -173,9 +207,11 @@ class WindowRing:
         back)."""
         total = sum(len(p) for p in parts)
         if total > self.payload_capacity:
+            with self._lk:
+                self._oversize += 1
             raise ValueError(
-                f"window of {total}B exceeds ring slot "
-                f"({self.payload_capacity}B payload)"
+                f"ring {self._shm.name}: window of {total}B exceeds "
+                f"ring slot ({self.payload_capacity}B payload)"
             )
         off = self._off(slot)
         buf = self._shm.buf

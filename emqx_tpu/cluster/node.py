@@ -29,6 +29,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from .. import failpoints
 from ..aio import cancel_and_wait
+from ..flightrec import EV_FWD as _EV_FWD
 from ..ds.replication import ReplicaStore, rendezvous_pick
 from ..message import Message
 from .routes import ClusterRouteTable
@@ -1450,11 +1451,14 @@ class ClusterNode:
 
         pending, self._pending_fwd = self._pending_fwd, {}
         loop = asyncio.get_running_loop()
+        fl = getattr(self.broker, "flight", None)
         for node, msgs in pending.items():
             st = self._fwd_state(node)
             self._fwd_make_room(node, st)
             st.seq += 1
             seq = st.seq
+            if fl is not None:
+                fl.record(_EV_FWD, float(len(msgs)), float(seq))
             max_qos = max((m.qos for m in msgs), default=0)
             base = next(iter(st.inflight), seq)
             blob = encode_window(self._epoch, seq, base, msgs)

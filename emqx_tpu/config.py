@@ -164,6 +164,45 @@ class ProfilerConfig:
 
 
 @dataclass
+class FlightConfig:
+    """Flight recorder (flightrec.py): always-on black-box event ring
+    in every process, frozen + dumped atomically on anomaly triggers
+    and correlated across workers / match service by one trigger id.
+    Recording is O(1) and allocation-free (brokerlint OBS602), so the
+    default is armed."""
+
+    enable: bool = True
+    # bounded preallocated event ring (numeric events)
+    ring_size: int = 4096
+    # bounded annotation ring (cold-path notes with payloads)
+    notes_cap: int = 512
+    # shared directory dumps are persisted into ("" = in-memory only;
+    # the multicore launcher points every worker + the service at one
+    # directory so correlated dumps land together)
+    dump_dir: str = ""
+    # in-memory dumps kept per process
+    max_dumps: int = 16
+    # trigger debounce: a second trigger inside this window is counted
+    # and suppressed (a p99 breach storm yields ONE dump, not N)
+    min_dump_interval: float = 30.0
+    # event-loop-lag watchdog threshold (0 disables the thread)
+    watchdog_stall_ms: float = 5000.0
+    # per-profiler-stage p99 SLO triggers, e.g. {"dispatch": 50.0}
+    # (ms, checked over 1 Hz delta windows); empty = no SLO triggers
+    slo_p99_ms: Dict[str, float] = field(default_factory=dict)
+    # note fsync calls slower than this (ms; 0 disables)
+    fsync_stall_ms: float = 500.0
+    # record GC pauses longer than this (ms; 0 disables the observer)
+    gc_stall_ms: float = 100.0
+    # olp level that triggers a dump when entered from below (and
+    # 0 disables the olp trigger entirely)
+    trigger_olp_level: int = 2
+    trigger_on_breaker: bool = True
+    trigger_on_restart: bool = True
+    trigger_on_fault: bool = True
+
+
+@dataclass
 class TracingConfig:
     """Message-lifecycle tracing (tracecontext.py): head-sampled trace
     contexts carried through the batched hot path and across cluster /
@@ -424,6 +463,7 @@ class BrokerConfig:
     slow_subs: SlowSubsConfig = field(default_factory=SlowSubsConfig)
     olp: OlpConfig = field(default_factory=OlpConfig)
     profiler: ProfilerConfig = field(default_factory=ProfilerConfig)
+    flight: FlightConfig = field(default_factory=FlightConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
     # server-side auto-subscribe on connect (emqx_auto_subscribe):
     # entries {"topic": ..., "qos": 0}; %c/%u placeholders supported
@@ -740,4 +780,23 @@ def check_config(cfg: BrokerConfig) -> List[str]:
         bad("olp alarm damping intervals must be >= 0")
     if int(cfg.mqtt.outbound_high_watermark) < 0:
         bad("mqtt.outbound_high_watermark must be >= 0")
+    fl = cfg.flight
+    if int(fl.ring_size) < 64:
+        bad("flight.ring_size must be >= 64")
+    if int(fl.notes_cap) < 16:
+        bad("flight.notes_cap must be >= 16")
+    if int(fl.max_dumps) < 1:
+        bad("flight.max_dumps must be >= 1")
+    if float(fl.min_dump_interval) < 0:
+        bad("flight.min_dump_interval must be >= 0")
+    if float(fl.watchdog_stall_ms) < 0:
+        bad("flight.watchdog_stall_ms must be >= 0 (0 disables)")
+    if not 0 <= int(fl.trigger_olp_level) <= 3:
+        bad("flight.trigger_olp_level must be in [0, 3]")
+    from .observability import Profiler as _prof
+    for stage, limit in dict(fl.slo_p99_ms or {}).items():
+        if stage not in _prof.STAGES:
+            bad(f"flight.slo_p99_ms: unknown profiler stage {stage!r}")
+        elif float(limit) <= 0:
+            bad(f"flight.slo_p99_ms[{stage!r}] must be > 0")
     return problems

@@ -139,6 +139,34 @@ class Ctl:
                         f"{row.get('quarantined_segments', 0)} "
                         "quarantined segs"
                     )
+            mc = n.get("multicore")
+            if mc:
+                svc = mc.get("service") or {}
+                ring = svc.get("ring") or {}
+                state = "attached" if svc.get("attached") else "detached"
+                print(
+                    f"  multicore: worker {mc.get('worker_id')}"
+                    f"/{mc.get('n_workers')} {state}"
+                    + (f"; ring {ring.get('in_flight')}/"
+                       f"{ring.get('slots')} in flight "
+                       f"(hwm={ring.get('high_watermark')}, "
+                       f"full={ring.get('full')})" if ring else "")
+                )
+                rstats = (svc.get("service") or {}).get("stats") or {}
+                if rstats:
+                    print(
+                        "    matchsvc: "
+                        + " ".join(f"{k}={rstats[k]}"
+                                   for k in sorted(rstats))
+                    )
+            fl = n.get("flight")
+            if fl:
+                print(
+                    f"  flight: armed; {fl.get('events_recorded')} "
+                    f"events in ring; {fl.get('triggers')} triggers "
+                    f"({fl.get('triggers_suppressed')} suppressed); "
+                    f"last dump {fl.get('last_id') or '-'}"
+                )
         cluster = nodes.get("cluster") or {}
         if cluster:
             print(
@@ -530,10 +558,87 @@ class Ctl:
                 f"parent={(s.get('parent_id') or '-')[:8]} {extra}"
             )
 
-    def olp(self) -> None:
+    def flight(self, action: str = "status", *args: str) -> None:
+        """Always-on flight recorder: status, manual dump, merged
+        cross-process Perfetto export.
+
+            flight status
+            flight dump
+            flight show <id> [out.json]
+        """
+        if action == "status":
+            info = self._req("/api/v5/flight")
+            st = info["status"]
+            state = "armed" if st["armed"] else "DISARMED"
+            print(
+                f"flight recorder {state} [{st['role']} {st['node']} "
+                f"pid={st['pid']}]; ring {st['events_recorded']}"
+                f"/{st['ring_size']} events; "
+                f"{st['triggers']} triggers "
+                f"({st['triggers_suppressed']} suppressed, "
+                f"debounce {st['min_dump_interval']}s)"
+            )
+            if st.get("slo_p99_ms"):
+                print("  slo p99 (ms): " + " ".join(
+                    f"{k}={v}" for k, v in
+                    sorted(st["slo_p99_ms"].items())))
+            dumps = info.get("dumps") or []
+            if not dumps:
+                print("  no dumps captured")
+            for row in dumps:
+                print(f"  dump {row['id']}: "
+                      f"{len(row['files'])} process file(s)")
+        elif action == "dump":
+            out = self._req("/api/v5/flight/dump", method="POST",
+                            body={})
+            print(f"dump triggered: id {out['id']}")
+        elif action == "show":
+            if not args:
+                raise SystemExit("usage: flight show <id> [out.json]")
+            trig_id = args[0]
+            out_path = args[1] if len(args) > 1 else (
+                f"flight_{trig_id}.json")
+            info = self._req(f"/api/v5/flight/{trig_id}")
+            for p in info["processes"]:
+                print(f"  {p['role']} {p['node']} pid={p['pid']} "
+                      f"({p['reason']})")
+            if info.get("torn"):
+                print(f"  {info['torn']} torn dump file(s) skipped")
+            trace = info["trace"]
+            with open(out_path, "w") as f:
+                json.dump(trace, f)
+            print(
+                f"wrote {len(trace['traceEvents'])} merged trace "
+                f"events from {len(info['processes'])} process(es) to "
+                f"{out_path}; open it at https://ui.perfetto.dev or "
+                "chrome://tracing"
+            )
+        else:
+            raise SystemExit(f"unknown flight action {action!r}")
+
+    def olp(self, action: str = "status") -> None:
         """Overload-protection ladder: level, signals vs thresholds,
-        shed/deferred/refused accounting, recent transitions."""
+        shed/deferred/refused accounting, recent transitions.
+
+            olp [status]
+            olp history
+        """
         info = self._req("/api/v5/olp")
+        if action == "history":
+            trans = info["transitions"]
+            if not trans:
+                print("no olp transitions recorded")
+                return
+            for t in trans:
+                sig = " ".join(
+                    f"{k}={v}" for k, v in sorted(
+                        (t.get("signals") or {}).items())
+                )
+                print(f"L{t['from']} -> L{t['to']} at {t['at']:.3f}"
+                      + (f"  [{sig}]" if sig else ""))
+            return
+        if action != "status":
+            raise SystemExit(f"unknown olp action {action!r}")
         state = "enabled" if info["enable"] else "disabled"
         print(
             f"olp {state}; level {info['level']}"
@@ -603,7 +708,7 @@ def main(argv=None) -> None:
     )
     ap.add_argument("command", help="status|clients|subscriptions|topics|"
                     "rules|metrics|stats|publish|trace|banned|data|"
-                    "rebalance|failpoints|profiler|tracing|olp")
+                    "rebalance|failpoints|profiler|tracing|olp|flight")
     ap.add_argument("args", nargs="*")
     ap.add_argument("--qos", type=int, default=0)
     ns = ap.parse_args(argv)
@@ -643,7 +748,9 @@ def main(argv=None) -> None:
         ctl.rebalance(ns.args[0] if ns.args else "status",
                       *ns.args[1:])
     elif cmd == "olp":
-        ctl.olp()
+        ctl.olp(ns.args[0] if ns.args else "status")
+    elif cmd == "flight":
+        ctl.flight(ns.args[0] if ns.args else "status", *ns.args[1:])
     else:
         raise SystemExit(f"unknown command {cmd!r}")
 

@@ -92,6 +92,13 @@ enabled = False  # fast-path gate: disabled brokers pay one bool check
 RECENT_FIRES: "deque" = deque(maxlen=256)
 
 
+def fires_since(ts: float):
+    """Fires strictly newer than ``ts``, oldest first — the flight
+    recorder drains these at its 1 Hz tick so injected faults land in
+    the black-box timeline next to their consequences."""
+    return [f for f in list(RECENT_FIRES) if f[0] > ts]
+
+
 class FailpointError(ConnectionError):
     """Injected failure.  Subclasses ConnectionError so transport-layer
     seams recover through their real ``except (ConnectionError, ...)``

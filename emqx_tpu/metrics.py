@@ -132,6 +132,23 @@ METRICS = (
     "cluster_link.egress",
     "bridge.ingress",
     "bridge.egress",
+    # flight recorder (flightrec.py)
+    "flight.triggers",
+    "flight.triggers.suppressed",
+    "flight.dumps",
+    "flight.dump.errors",
+    "flight.remote_requests",
+    # shared match service, service-side registry (ops/matchsvc.py)
+    "matchsvc.windows",
+    "matchsvc.topics",
+    "matchsvc.decides",
+    "matchsvc.route_ops",
+    "matchsvc.errors",
+    "matchsvc.flight_relayed",
+    # per-worker shm window ring (broker/shmring.py via matchclient)
+    "multicore.ring.full",
+    "multicore.ring.oversize",
+    "multicore.ring.quarantined",
 )
 
 # open-ended per-feature counter families (the reference's

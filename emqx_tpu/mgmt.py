@@ -14,7 +14,7 @@ from __future__ import annotations
 import json
 import os
 import time
-from typing import Optional
+from typing import Dict, Optional
 
 from aiohttp import web
 
@@ -201,6 +201,9 @@ class MgmtApi:
         r.add_delete("/api/v5/banned/{kind}/{who}", self.delete_banned)
         r.add_get("/api/v5/slow_subscriptions", self.get_slow_subs)
         r.add_get("/api/v5/olp", self.get_olp)
+        r.add_get("/api/v5/flight", self.get_flight)
+        r.add_post("/api/v5/flight/dump", self.post_flight_dump)
+        r.add_get("/api/v5/flight/{id}", self.get_flight_dump)
         r.add_get("/api/v5/profiler", self.get_profiler)
         r.add_get("/api/v5/profiler/trace", self.get_profiler_trace)
         r.add_delete("/api/v5/profiler", self.reset_profiler)
@@ -714,6 +717,59 @@ class MgmtApi:
         self.broker.profiler.reset()
         return web.Response(status=204)
 
+    # -------------------------------------------------- flight recorder
+
+    async def get_flight(self, request: web.Request) -> web.Response:
+        """Flight-recorder status for this process plus every dump id
+        retrievable from the shared dump directory — a multicore
+        pool's workers and match service persist into ONE directory,
+        so any worker's API port lists the whole pool's captures."""
+        from . import flightrec
+        fl = self.broker.flight
+        return _json({
+            "status": fl.status(),
+            "dumps": flightrec.list_dump_ids(fl.dump_dir),
+        })
+
+    async def get_flight_dump(self, request: web.Request) -> web.Response:
+        """One correlated capture: every process's dump for the
+        trigger id merged into a single Perfetto-loadable Chrome trace
+        with per-process tracks.  ``?raw=1`` returns the raw dump
+        documents instead of the merged timeline."""
+        from . import flightrec
+        fl = self.broker.flight
+        trig_id = request.match_info["id"]
+        docs, torn = flightrec.collect_dumps(fl, trig_id)
+        if not docs:
+            return _json({"code": "NOT_FOUND",
+                          "message": f"no flight dump {trig_id!r}"}, 404)
+        out: Dict = {
+            "id": trig_id,
+            "torn": torn,
+            "processes": [
+                {"node": d.get("node"), "role": d.get("role"),
+                 "pid": d.get("pid"), "reason": d.get("reason"),
+                 "at": d.get("at")}
+                for d in docs
+            ],
+        }
+        if request.query.get("raw"):
+            out["dumps"] = docs
+        else:
+            out["trace"] = flightrec.merge_dumps(docs)
+        return _json(out)
+
+    async def post_flight_dump(self, request: web.Request) -> web.Response:
+        """Operator-initiated capture ("dump now"): triggers a dump in
+        this process and — over the worker↔service control stream —
+        every attached peer process, correlated under one id."""
+        fl = self.broker.flight
+        if not fl.armed:
+            return _json({"code": "NOT_FOUND",
+                          "message": "flight recorder disabled"}, 404)
+        trig_id = fl.trigger("manual", force=True)
+        return _json({"id": trig_id, "status": fl.status()})
+
     # ------------------------------------------- lifecycle tracing
 
     async def get_tracing(self, request: web.Request) -> web.Response:
@@ -1226,6 +1282,39 @@ class MgmtApi:
             lines.extend(prom_histogram_lines(
                 family, snap,
                 help_text=f"window pipeline stage '{name}' latency "
+                          "in microseconds",
+            ))
+        # multicore surface: this worker's shm window ring (occupancy,
+        # high-watermark, refusal counters) and the shared match
+        # service's counters + per-stage histograms, as cached from
+        # the control stream's last pong — any worker's scrape carries
+        # the service's view
+        svc_info = getattr(self.broker.router.engine, "service_info",
+                           None)
+        info = svc_info() if svc_info is not None else {}
+        for name, value in sorted((info.get("ring") or {}).items()):
+            if not isinstance(value, (int, float)) or isinstance(
+                value, bool
+            ):
+                continue
+            emit("multicore_ring_" + name, "gauge", value,
+                 help_text=f"shm window ring {name}")
+        remote = info.get("service") or {}
+        for name, value in sorted((remote.get("stats") or {}).items()):
+            emit("matchsvc_" + name, "counter", value,
+                 help_text=f"match service {name}")
+        if remote.get("routes") is not None:
+            emit("matchsvc_routes", "gauge", remote["routes"],
+                 help_text="match service route count")
+        from .observability import HistogramSnapshot
+        for name, raw in sorted((remote.get("hist") or {}).items()):
+            family = prom_name(f"emqx_matchsvc_{name}_us")
+            if family in seen or not isinstance(raw, dict):
+                continue
+            seen.add(family)
+            lines.extend(prom_histogram_lines(
+                family, HistogramSnapshot.from_dict(raw),
+                help_text=f"match service stage '{name}' latency "
                           "in microseconds",
             ))
         return web.Response(

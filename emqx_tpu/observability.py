@@ -92,6 +92,24 @@ class HistogramSnapshot:
             cum += c
         return float(BOUNDS[-1])
 
+    def raw_dict(self) -> Dict[str, object]:
+        """Lossless wire form (counts included) — the match service
+        ships these over the control socket so the broker side can
+        re-expose REAL histograms (prometheus buckets, mergeable
+        snapshots), not just point percentiles."""
+        return {
+            "counts": list(self.counts),
+            "sum": self.sum,
+            "count": self.count,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "HistogramSnapshot":
+        counts = list(d.get("counts") or [])
+        counts = (counts + [0] * N_BUCKETS)[:N_BUCKETS]
+        return cls(counts, float(d.get("sum", 0.0)),
+                   int(d.get("count", 0)))
+
     def to_dict(self) -> Dict[str, object]:
         return {
             "count": self.count,
@@ -268,6 +286,11 @@ class Profiler:
         self._seq = 0
         # engine lifecycle events: (kind, wall_ts, dur_s, meta)
         self._events: deque = deque(maxlen=max(events_cap, 1))
+        # optional flightrec.FlightRecorder: every committed window is
+        # mirrored into its numeric ring (one attribute load + one O(1)
+        # append — the black box sees dispatch cadence without a
+        # second instrumentation point in the dispatch loops)
+        self.flight = None
 
     # ------------------------------------------------------- windows
 
@@ -301,6 +324,9 @@ class Profiler:
                     e2e._record_locked(v * 1e3)  # ms -> µs
         with self._ring_lock:
             self._ring[rec.seq % len(self._ring)] = rec
+        fl = self.flight
+        if fl is not None:
+            fl.on_window(rec)
 
     # -------------------------------------------------- stages/events
 

@@ -322,6 +322,15 @@ class LoadMonitor:
             "at": now, "from": old, "to": new,
             "signals": {k: round(v, 3) for k, v in self._signals.items()},
         })
+        fl = getattr(b, "flight", None)
+        if fl is not None:
+            # the transition (with its sensor snapshot) joins the black
+            # box; a jump INTO L2+ is itself a dump trigger — the ring
+            # holds the minute of windows that pushed the ladder up
+            fl.olp_transition(
+                old, new, self._lag_ms,
+                {k: round(v, 3) for k, v in self._signals.items()},
+            )
         self.shed_qos0_mask = new >= 2
         self.shed_ingress_qos0 = new >= 3
         self.defer_admissions = new >= 1

@@ -30,11 +30,18 @@ import asyncio
 import json
 import logging
 import struct
+import time
 from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from .. import flightrec as _flight
+
 log = logging.getLogger("emqx_tpu.matchsvc")
+
+# service-side per-stage histograms (µs): one window's wall time split
+# the same way the broker profiler splits its dispatch stages
+SVC_STAGES = ("unpack", "match", "decide", "pack")
 
 _U32 = struct.Struct("<I")
 _DEC_HDR = struct.Struct("<IQIII")  # has_cols, rev, S, n, b
@@ -197,10 +204,18 @@ class MatchService:
     same single-writer discipline `emqx_router`'s gen_server gives the
     reference (and the reason this class carries no locks)."""
 
+    # the pong payload's stats keys (wire compat with the worker-side
+    # cache): registry counter matchsvc.<key>
+    STAT_KEYS = ("windows", "topics", "decides", "route_ops", "errors",
+                 "flight_relayed")
+
     def __init__(self, socket_path: str,
                  use_device: Optional[bool] = None,
-                 engine_kw: Optional[Dict] = None) -> None:
+                 engine_kw: Optional[Dict] = None,
+                 flight=None) -> None:
         from ..engine import MatchEngine
+        from ..metrics import Metrics
+        from ..observability import Histogram
 
         self.socket_path = socket_path
         kw = dict(engine_kw or {})
@@ -208,9 +223,28 @@ class MatchService:
         self.engine = MatchEngine(**kw)
         self._workers: Dict[int, _Worker] = {}
         self._server: Optional[asyncio.AbstractServer] = None
-        self._stats = {
-            "windows": 0, "topics": 0, "decides": 0, "route_ops": 0,
-            "errors": 0,
+        # real metrics registry (the reference's emqx_metrics slots),
+        # not an ad-hoc dict: the broker re-exposes these through
+        # /metrics as emqx_matchsvc_* via the pong payload
+        self.metrics = Metrics()
+        self._hist: Dict[str, Histogram] = {
+            name: Histogram() for name in SVC_STAGES
+        }
+        # flight recorder for THIS process (flightrec.FlightRecorder);
+        # None = not armed (in-process test services usually pass one)
+        self.flight = flight
+        if flight is not None:
+            flight.on_trigger = self._broadcast_flight
+        self._inc = self.metrics.inc
+
+    def stats_dict(self) -> Dict[str, int]:
+        val = self.metrics.val
+        return {k: val(f"matchsvc.{k}") for k in self.STAT_KEYS}
+
+    def hist_dict(self) -> Dict[str, Dict]:
+        return {
+            name: h.snapshot().raw_dict()
+            for name, h in self._hist.items()
         }
 
     # ------------------------------------------------------ lifecycle
@@ -261,12 +295,11 @@ class MatchService:
             fid_id = int(fid_id)
             self.engine.insert(flt, (w.wid, fid_id))
             w.fids.add(fid_id)
-            self._stats["route_ops"] += 1
         for fid_id in delete:
             fid_id = int(fid_id)
             self.engine.delete((w.wid, fid_id))
             w.fids.discard(fid_id)
-            self._stats["route_ops"] += 1
+        self._inc("matchsvc.route_ops", len(add) + len(delete))
 
     # ------------------------------------------------------- windows
 
@@ -276,23 +309,27 @@ class MatchService:
         if w.ring is None or self._workers.get(w.wid) is not w:
             # superseded/dropped incarnation: its ring is closed — a
             # late doorbell from the old connection must not touch it
-            self._stats["errors"] += 1
+            self._inc("matchsvc.errors")
             return {"t": "e", "slot": slot, "seq": seq,
                     "err": "worker detached"}
         got = w.ring.read(slot, w.epoch, seq)
         if got is None:
-            self._stats["errors"] += 1
+            self._inc("matchsvc.errors")
             return {"t": "e", "slot": slot, "seq": seq,
                     "err": "stale slot header"}
         kind, payload = got
+        hist = self._hist
+        t0 = time.perf_counter()
         try:
             from ..broker import shmring
 
             if kind == shmring.KIND_MATCH_REQ:
                 topics, congested = unpack_match_req(payload)
+                t1 = time.perf_counter()
                 matched = self.engine.match_batch(
                     topics, congested=congested
                 )
+                t2 = time.perf_counter()
                 wid = w.wid
                 ids = [
                     [f[1] for f in s if type(f) is tuple and f[0] == wid]
@@ -301,8 +338,16 @@ class MatchService:
                 parts = pack_match_resp(ids)
                 w.ring.write(slot, w.epoch, seq,
                              shmring.KIND_MATCH_RESP, parts)
-                self._stats["windows"] += 1
-                self._stats["topics"] += len(topics)
+                t3 = time.perf_counter()
+                hist["unpack"].record((t1 - t0) * 1e6)
+                hist["match"].record((t2 - t1) * 1e6)
+                hist["pack"].record((t3 - t2) * 1e6)
+                self._inc("matchsvc.windows")
+                self._inc("matchsvc.topics", len(topics))
+                fl = self.flight
+                if fl is not None:
+                    fl.record(_flight.EV_SVC_WINDOW, float(len(topics)),
+                              (t3 - t0) * 1e6, float(seq), float(wid))
             elif kind == shmring.KIND_DECIDE_REQ:
                 (cols, rev, opts_rows, client_rows, msg_idx, m_qos,
                  m_retain, m_from_row) = unpack_decide_req(payload)
@@ -311,26 +356,41 @@ class MatchService:
                     w.cols = tuple(np.array(c) for c in cols)
                     w.cols_rev = rev
                 elif w.cols_rev != rev or w.cols is None:
-                    self._stats["errors"] += 1
+                    self._inc("matchsvc.errors")
                     return {"t": "e", "slot": slot, "seq": seq,
                             "err": "cols cache miss"}
+                t1 = time.perf_counter()
                 packed, path = self.engine.decide_window(
                     w.cols, (w.wid << 32) | (rev & 0xFFFFFFFF),
                     np.array(opts_rows), np.array(client_rows),
                     np.array(msg_idx), np.array(m_qos),
                     np.array(m_retain), np.array(m_from_row),
                 )
+                t2 = time.perf_counter()
                 w.ring.write(slot, w.epoch, seq,
                              shmring.KIND_DECIDE_RESP,
                              pack_decide_resp(packed, path))
-                self._stats["decides"] += 1
+                t3 = time.perf_counter()
+                hist["unpack"].record((t1 - t0) * 1e6)
+                hist["decide"].record((t2 - t1) * 1e6)
+                hist["pack"].record((t3 - t2) * 1e6)
+                self._inc("matchsvc.decides")
+                fl = self.flight
+                if fl is not None:
+                    fl.record(_flight.EV_SVC_WINDOW,
+                              float(len(opts_rows)), (t3 - t0) * 1e6,
+                              float(seq), float(w.wid))
             else:
-                self._stats["errors"] += 1
+                self._inc("matchsvc.errors")
                 return {"t": "e", "slot": slot, "seq": seq,
                         "err": f"unknown kind {kind}"}
         except Exception as exc:  # degrade THIS window, not the worker
             log.exception("window slot=%d seq=%d failed", slot, seq)
-            self._stats["errors"] += 1
+            self._inc("matchsvc.errors")
+            fl = self.flight
+            if fl is not None:
+                fl.note("svc_window_error", slot=slot, seq=seq,
+                        error=repr(exc))
             return {"t": "e", "slot": slot, "seq": seq, "err": str(exc)}
         return {"t": "c", "slot": slot, "seq": seq}
 
@@ -366,9 +426,16 @@ class MatchService:
                     )
                     self._send(writer, out)
                 elif t == "ping":
-                    self._send(writer, {"t": "pong",
-                                        "stats": dict(self._stats),
-                                        "routes": len(self.engine)})
+                    fl = self.flight
+                    self._send(writer, {
+                        "t": "pong",
+                        "stats": self.stats_dict(),
+                        "hist": self.hist_dict(),
+                        "routes": len(self.engine),
+                        "flight": fl.status() if fl is not None else {},
+                    })
+                elif t == "flight":
+                    self._handle_flight(obj, w)
                 await writer.drain()
         except (ConnectionError, asyncio.IncompleteReadError):
             pass
@@ -406,6 +473,48 @@ class MatchService:
                  wid, epoch, obj["ring"])
         return w
 
+    # ----------------------------------------------- flight recorder
+
+    def _handle_flight(self, obj: Dict, sender: Optional[_Worker]
+                       ) -> None:
+        """A worker tripped an anomaly: dump THIS process's ring under
+        the initiator's id and relay the request to every OTHER
+        attached worker — the service is the natural hub, so one
+        trigger anywhere becomes one pool-wide correlated capture."""
+        trig_id = str(obj.get("id") or "")
+        reason = str(obj.get("reason") or "")
+        if not trig_id:
+            return
+        fl = self.flight
+        if fl is not None:
+            fl.dump_remote(trig_id, reason)
+        self._relay_flight(trig_id, reason,
+                           skip_wid=sender.wid if sender else None)
+
+    def _broadcast_flight(self, trig_id: str, reason: str) -> None:
+        """on_trigger hook for SERVICE-side anomalies (watchdog stall,
+        unhandled fault): push the dump request to every worker."""
+        self._relay_flight(trig_id, reason, skip_wid=None)
+
+    def _relay_flight(self, trig_id: str, reason: str,
+                      skip_wid: Optional[int]) -> None:
+        msg = {"t": "flight", "id": trig_id, "reason": reason}
+        for ow in list(self._workers.values()):
+            if skip_wid is not None and ow.wid == skip_wid:
+                continue
+            try:
+                self._send(ow.writer, msg)
+                self._inc("matchsvc.flight_relayed")
+            except Exception:
+                log.debug("flight relay to worker %d failed", ow.wid)
+
+    def tick(self) -> None:
+        """1 Hz housekeeping from the CLI runner: flight heartbeat +
+        sensor drain for the service process."""
+        fl = self.flight
+        if fl is not None:
+            fl.tick()
+
     @staticmethod
     def _send(writer: asyncio.StreamWriter, obj: Dict) -> None:
         writer.write(json.dumps(obj).encode() + b"\n")
@@ -426,6 +535,9 @@ def main(argv=None) -> None:
                     help="unix control socket path")
     ap.add_argument("--engine-json", default=None,
                     help="MatchEngine kwargs as JSON")
+    ap.add_argument("--flight-json", default=None,
+                    help="flight recorder kwargs as JSON "
+                         "(FlightConfig fields incl. dump_dir)")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
     logging.basicConfig(
@@ -433,19 +545,39 @@ def main(argv=None) -> None:
         format="%(asctime)s %(levelname)s %(name)s %(message)s",
     )
     engine_kw = json.loads(args.engine_json) if args.engine_json else None
+    flight = None
+    if args.flight_json:
+        fl_kw = json.loads(args.flight_json)
+        flight = _flight.FlightRecorder(
+            role="matchsvc", process_label="matchsvc", **fl_kw
+        )
     if os.path.exists(args.socket):
         os.unlink(args.socket)
 
     async def run() -> None:
-        svc = MatchService(args.socket, engine_kw=engine_kw)
+        svc = MatchService(args.socket, engine_kw=engine_kw,
+                           flight=flight)
+        if flight is not None:
+            flight.metrics = svc.metrics
+            flight.arm_watchdog()
         await svc.start()
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()
         for sig in (signal.SIGINT, signal.SIGTERM):
             loop.add_signal_handler(sig, stop.set)
+
+        async def ticker() -> None:
+            while not stop.is_set():
+                svc.tick()
+                await asyncio.sleep(1.0)
+
+        tick_task = asyncio.ensure_future(ticker())
         try:
             await stop.wait()
         finally:
+            tick_task.cancel()
+            if flight is not None:
+                flight.stop()
             await svc.stop()
 
     asyncio.run(run())

@@ -81,6 +81,9 @@ class AlarmRegistry:
             expires_at=None if ttl is None else now + ttl,
         )
         self._active[name] = alarm
+        fl = getattr(self.broker, "flight", None)
+        if fl is not None:
+            fl.alarm_edge(name, True)
         if min_reraise > 0.0:
             # an inactive->active transition ALWAYS publishes (any
             # prior published deactivate cleared the throttle); the
@@ -155,6 +158,9 @@ class AlarmRegistry:
         # the rest of the episode.  (Also keeps `_last_raise` from
         # outliving its alarm.)
         self._last_raise.pop(name, None)
+        fl = getattr(self.broker, "flight", None)
+        if fl is not None:
+            fl.alarm_edge(name, False)
         self._publish("alarms/deactivate", alarm)
         return True
 

@@ -384,6 +384,24 @@ class OtelExporter:
                 "name": "emqx_engine_" + name.replace(".", "_"),
                 "gauge": {"dataPoints": [dp]},
             })
+        # multicore shm window-ring occupancy (the same surface the
+        # flight recorder samples as EV_RING events), as live gauges
+        svc_info = getattr(self.broker.router.engine, "service_info",
+                           None)
+        if svc_info is not None:
+            ring = (svc_info() or {}).get("ring") or {}
+            for name, val in sorted(ring.items()):
+                if not isinstance(val, (int, float)) or isinstance(
+                    val, bool
+                ):
+                    continue
+                metrics.append({
+                    "name": "emqx_multicore_ring_"
+                            + str(name).replace(".", "_"),
+                    "gauge": {"dataPoints": [{
+                        "timeUnixNano": t_ns, "asInt": str(int(val)),
+                    }]},
+                })
         # window profiler stage histograms as OTLP histogram
         # datapoints (per-bucket counts + explicit log2 bounds)
         prof = getattr(self.broker, "profiler", None)

@@ -1,0 +1,484 @@
+"""Flight recorder (flightrec.py): the always-on black-box.
+
+Covers the four ISSUE acceptance behaviors:
+
+* the bounded ring + trigger debounce (a p99 storm mints ONE dump, not
+  N — the storm rule) and the SLO sensor's delta-window semantics;
+* torn-dump recovery: a dump file caught mid-replace by a crash
+  (crashsim's meta materializer) self-identifies via the atomicio CRC
+  wrapper and is SKIPPED-and-counted, never merged, never fatal;
+* the recorder-armed dispatch path is bit-identical per connection to
+  recorder-off (observability must not change behavior);
+* the chaos scenario: an injected service loss while a worker is
+  attached yields EXACTLY ONE correlated capture — the worker's ring
+  and the (restarted) service's ring under the SAME trigger id, merged
+  into one Perfetto timeline with distinct per-process tracks.
+"""
+
+import asyncio
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from emqx_tpu import failpoints as fp
+from emqx_tpu import flightrec
+from emqx_tpu.broker import shmring
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.channel import Channel
+from emqx_tpu.broker.matchclient import ServiceMatchEngine
+from emqx_tpu.broker.session import SubOpts
+from emqx_tpu.codec import mqtt as C
+from emqx_tpu.config import BrokerConfig, ListenerConfig, check_config
+from emqx_tpu.message import Message
+from emqx_tpu.metrics import Metrics
+from emqx_tpu.observability import Histogram
+from emqx_tpu.ops.matchsvc import MatchService
+from tools.crashsim import CrashRecorder, materialize
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    fp.clear()
+    yield
+    fp.clear()
+
+
+def wait_until(cond, timeout=10.0, what="condition"):
+    t0 = time.monotonic()
+    while not cond():
+        assert time.monotonic() - t0 < timeout, f"timeout: {what}"
+        time.sleep(0.01)
+
+
+# --------------------------------------------------- ring + debounce
+
+def test_ring_bounded_and_ordered():
+    r = flightrec.FlightRecorder(process_label="t", ring_size=64)
+    for i in range(1000):
+        r.record(flightrec.EV_RING, float(i))
+    tid = r.trigger("manual", force=True)
+    (doc,) = r.local_dumps(tid)
+    events = [e for e in doc["events"] if e[1] == flightrec.EV_RING]
+    # bounded: only the NEWEST ring_size survive, oldest -> newest
+    assert len(events) <= 64
+    vals = [e[2] for e in events]
+    assert vals == sorted(vals) and vals[-1] == 999.0
+    assert r.status()["events_recorded"] == 1001  # total, not resident
+    r.stop()
+
+
+def test_trigger_debounce_storm_mints_one_dump():
+    m = Metrics()
+    r = flightrec.FlightRecorder(
+        process_label="t", ring_size=64, min_dump_interval=60.0,
+        metrics=m,
+    )
+    ids = [r.trigger("slo_breach") for _ in range(10)]
+    minted = [i for i in ids if i]
+    assert len(minted) == 1
+    st = r.status()
+    assert st["triggers"] == 1
+    assert st["triggers_suppressed"] == 9
+    assert len(r.local_dumps()) == 1
+    assert m.val("flight.triggers") == 1
+    assert m.val("flight.triggers.suppressed") == 9
+    # manual force bypasses the debounce (ctl flight dump)
+    assert r.trigger("manual", force=True)
+    assert len(r.local_dumps()) == 2
+    r.stop()
+
+
+class _FakeProf:
+    """snapshots()-shaped stand-in: one e2e histogram."""
+
+    def __init__(self):
+        self.h = Histogram()
+
+    def snapshots(self):
+        return {"e2e": self.h.snapshot()}
+
+
+def test_slo_breach_delta_window_one_dump_per_storm():
+    prof = _FakeProf()
+    r = flightrec.FlightRecorder(
+        process_label="t", slo_p99_ms={"e2e": 1.0},
+        min_dump_interval=60.0,
+    )
+    r.tick(profiler=prof)          # baseline snapshot: no prev delta
+    assert not r.local_dumps()
+    for _ in range(100):
+        prof.h.record(50_000.0)    # 50 ms >> the 1 ms SLO
+    r.tick(profiler=prof)          # breach over THIS interval
+    assert r.status()["triggers"] == 1
+    (doc,) = r.local_dumps()
+    assert doc["reason"] == "slo_breach"
+    assert any(n["kind"] == "slo_breach" and n["stage"] == "e2e"
+               for n in doc["notes"])
+    # the storm keeps breaching every tick; the debounce holds at one
+    for _ in range(5):
+        for _ in range(50):
+            prof.h.record(50_000.0)
+        r.tick(profiler=prof)
+    st = r.status()
+    assert st["triggers"] == 1 and st["triggers_suppressed"] >= 1
+    # quiet interval (delta count == 0): no new breach recorded
+    r.tick(profiler=prof)
+    r.stop()
+
+
+def test_config_validation():
+    cfg = BrokerConfig()
+    cfg.flight.slo_p99_ms = {"e2e": 5.0}
+    assert not check_config(cfg)
+    cfg.flight.slo_p99_ms = {"nope": 5.0}
+    assert any("unknown profiler stage" in p for p in check_config(cfg))
+    cfg.flight.slo_p99_ms = {"e2e": -1}
+    assert any("must be > 0" in p for p in check_config(cfg))
+    cfg.flight.slo_p99_ms = {}
+    cfg.flight.ring_size = 8
+    assert any("ring_size" in p for p in check_config(cfg))
+
+
+# ------------------------------------------------- dump files + merge
+
+def test_dump_files_collect_and_perfetto_merge(tmp_path):
+    dump_dir = str(tmp_path / "flight")
+    w = flightrec.FlightRecorder(
+        process_label="w0", role="broker", dump_dir=dump_dir, pid=111)
+    s = flightrec.FlightRecorder(
+        process_label="matchsvc", role="matchsvc", dump_dir=dump_dir,
+        pid=222)
+    w.record(flightrec.EV_RING, 3.0, 4.0)
+    s.record(flightrec.EV_SVC_WINDOW, 7.0)
+    tid = w.trigger("manual", force=True)
+    assert s.dump_remote(tid, "manual")
+    assert s.dump_remote(tid, "manual") is False  # idempotent per id
+    names = sorted(os.listdir(dump_dir))
+    assert names == [
+        flightrec.dump_filename(tid, "matchsvc", 222),
+        flightrec.dump_filename(tid, "w0", 111),
+    ]
+    rows = flightrec.list_dump_ids(dump_dir)
+    assert len(rows) == 1 and rows[0]["id"] == tid
+    assert len(rows[0]["files"]) == 2
+    docs, torn = flightrec.collect_dumps(w, tid)
+    assert torn == 0
+    assert {(d["role"], d["pid"]) for d in docs} == {
+        ("broker", 111), ("matchsvc", 222)}
+    trace = flightrec.merge_dumps(docs)
+    evs = trace["traceEvents"]
+    tracks = {e["args"]["name"]: e["pid"] for e in evs
+              if e.get("name") == "process_name"}
+    assert tracks == {"w0 [broker pid=111]": 111,
+                      "matchsvc [matchsvc pid=222]": 222}
+    by_pid = {e["pid"] for e in evs if e.get("ph") == "i"}
+    assert by_pid == {111, 222}
+    w.stop()
+    s.stop()
+
+
+def test_torn_dump_recovery_via_crashsim(tmp_path):
+    """A crash mid-replace of the SECOND process's dump file: the
+    surviving prefix is a torn document; collect_dumps counts it and
+    merges from the intact process only — alarmed conservative
+    recovery, never a parse crash, never a silent half-merge."""
+    src = tmp_path / "live"
+    src.mkdir()
+    dump_dir = str(src / "flight")
+    w = flightrec.FlightRecorder(
+        process_label="w0", role="broker", dump_dir=dump_dir, pid=11)
+    s = flightrec.FlightRecorder(
+        process_label="matchsvc", role="matchsvc", dump_dir=dump_dir,
+        pid=22)
+    w.record(flightrec.EV_RING, 1.0)
+    cr = CrashRecorder()
+    with cr:
+        tid = w.trigger("manual", force=True)
+        assert s.dump_remote(tid, "manual")
+    meta_idx = [i for i, op in enumerate(cr.ops) if op.kind == "meta"]
+    assert len(meta_idx) == 2
+    out = tmp_path / "crashed"
+    # crash AT the service's dump write: rename persisted, data pages
+    # torn at byte 40 — the atomicio CRC wrapper's detection case
+    materialize(cr.ops, meta_idx[1], str(src), str(out),
+                torn_bytes=40, meta_variant="replaced-torn")
+    crashed = str(out / "flight")
+    docs, torn = flightrec.collect_dumps(None, tid, dump_dir=crashed)
+    assert torn == 1
+    assert len(docs) == 1 and docs[0]["pid"] == 11
+    trace = flightrec.merge_dumps(docs)
+    assert any(e.get("name") == "process_name"
+               for e in trace["traceEvents"])
+    w.stop()
+    s.stop()
+
+
+# ------------------------------------- armed dispatch is bit-identical
+
+def _fanout_wire(flight_on):
+    """256-subscriber QoS1 fanout; returns {clientid: wire bytes}."""
+    cfg = BrokerConfig()
+    cfg.engine.use_device = False
+    cfg.flight.enable = flight_on
+    cfg.flight.slo_p99_ms = {"e2e": 0.0001}  # hair trigger
+    cfg.flight.min_dump_interval = 0.0
+    b = Broker(config=cfg)
+    wires = {}
+    for i in range(256):
+        cid = f"c{i}"
+        wires[cid] = bytearray()
+
+        def send(pkts, _w=wires[cid]):
+            for p in pkts:
+                _w += C.serialize(p, C.MQTT_V5)
+
+        ch = Channel(b, send=send, close=lambda r: None)
+        session, _ = b.cm.open_session(True, cid, ch, max_inflight=0)
+        session.subscribe("fan/fl", SubOpts(qos=1))
+        b.subscribe(cid, "fan/fl", SubOpts(qos=1))
+    for w0 in range(0, 192, 64):
+        msgs = [Message(topic="fan/fl", payload=b"x" * 64, qos=1,
+                        timestamp=1000.0 + w0 + k)
+                for k in range(64)]
+        b.publish_many(msgs)
+        # the 1 Hz tick path (SLO checks, samplers) between windows
+        b.flight.tick(profiler=b.profiler)
+    if flight_on:
+        # a mid-run capture must not perturb the wire either
+        assert b.flight.status()["triggers"] >= 1 or \
+            b.flight.trigger("manual", force=True)
+    b.flight.stop()
+    return {k: bytes(v) for k, v in wires.items()}
+
+
+def test_recorder_armed_dispatch_bit_identical():
+    on = _fanout_wire(True)
+    off = _fanout_wire(False)
+    assert on.keys() == off.keys()
+    for cid in on:
+        assert on[cid] == off[cid], f"wire divergence for {cid}"
+    # and the armed run actually recorded window events
+    cfg = BrokerConfig()
+    cfg.engine.use_device = False
+    b = Broker(config=cfg)
+    assert b.profiler.flight is b.flight
+
+
+# ------------------------------------------- cross-process chaos
+
+class _SvcThread:
+    """Real MatchService on a real unix socket in a daemon thread,
+    with its own flight recorder (the service process's black box)."""
+
+    def __init__(self, socket_path, flight=None):
+        self.socket_path = socket_path
+        self.flight = flight
+        self.svc = None
+        self._loop = None
+        self._stop_ev = None
+        self._started = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        asyncio.run(self._main())
+
+    async def _main(self):
+        self._loop = asyncio.get_running_loop()
+        self._stop_ev = asyncio.Event()
+        self.svc = MatchService(
+            self.socket_path, use_device=False, flight=self.flight)
+        await self.svc.start()
+        self._started.set()
+        await self._stop_ev.wait()
+        await self.svc.stop()
+
+    def start(self):
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        self._thread.start()
+        assert self._started.wait(10), "service failed to start"
+        return self
+
+    def stop(self):
+        loop = self._loop
+        if loop is not None and loop.is_running():
+            loop.call_soon_threadsafe(self._stop_ev.set)
+        self._thread.join(10)
+        assert not self._thread.is_alive(), "service thread hung"
+
+
+def _attach_engine(sock, **kw):
+    kw.setdefault("reconnect_backoff", 0.05)
+    eng = ServiceMatchEngine(sock, worker_id=0, **kw)
+    wait_until(lambda: eng.attached, what="client attach")
+    return eng
+
+
+def test_chaos_service_restart_exactly_one_correlated_dump(tmp_path):
+    sock = str(tmp_path / "svc.sock")
+    dump_dir = str(tmp_path / "flight")
+    svc1 = _SvcThread(sock, flight=flightrec.FlightRecorder(
+        process_label="matchsvc", role="matchsvc", dump_dir=dump_dir,
+        pid=501)).start()
+    eng = _attach_engine(sock)
+    wfl = flightrec.FlightRecorder(
+        process_label="w0", role="broker", dump_dir=dump_dir, pid=401)
+    eng.flight = wfl
+    eng.metrics = Metrics()
+    wfl.on_trigger = eng.flight_broadcast
+    svc2 = None
+    try:
+        wfl.record(flightrec.EV_RING, 1.0, 2.0)
+        # the injected anomaly: the service dies under an attached
+        # worker (multicore.service.restart in production terms)
+        svc1.stop()
+        # wait for the dump FILE, not just the trigger counter: the
+        # counter bumps before the reader thread finishes the write
+        wait_until(
+            lambda: sum(
+                len(r["files"]) for r in flightrec.list_dump_ids(dump_dir)
+            ) == 1,
+            what="worker-side service_restart trigger + dump")
+        assert wfl.status()["triggers"] == 1
+        tid = wfl.status()["last_id"]
+        assert "service-restart" in tid or "service_restart" in tid
+        # worker's own dump is the only file; the broadcast is QUEUED
+        # (the anomaly IS the lost connection)
+        assert len(flightrec.list_dump_ids(dump_dir)) == 1
+        # the restarted service re-attaches the worker, which flushes
+        # the queued "dump now" line -> the service dumps THE SAME id
+        svc2 = _SvcThread(sock, flight=flightrec.FlightRecorder(
+            process_label="matchsvc", role="matchsvc",
+            dump_dir=dump_dir, pid=502)).start()
+        wait_until(lambda: eng.attached, what="re-attach")
+        # wait on list_dump_ids, not os.listdir: the latter counts
+        # atomicio's transient .tmp file before the rename lands
+        wait_until(
+            lambda: sum(
+                len(r["files"]) for r in flightrec.list_dump_ids(dump_dir)
+            ) == 2,
+            what="service-side correlated dump")
+        rows = flightrec.list_dump_ids(dump_dir)
+        assert len(rows) == 1 and rows[0]["id"] == tid, rows
+        assert len(rows[0]["files"]) == 2
+        # exactly one: no second id minted anywhere, ever
+        assert wfl.status()["triggers"] == 1
+        docs, torn = flightrec.collect_dumps(wfl, tid)
+        assert torn == 0
+        assert {(d["role"], d["pid"]) for d in docs} == {
+            ("broker", 401), ("matchsvc", 502)}
+        trace = flightrec.merge_dumps(docs)
+        names = {e["args"]["name"] for e in trace["traceEvents"]
+                 if e.get("name") == "process_name"}
+        assert names == {"w0 [broker pid=401]",
+                         "matchsvc [matchsvc pid=502]"}
+    finally:
+        eng.close()
+        wfl.stop()
+        if svc2 is not None:
+            svc2.stop()
+
+
+def test_matchsvc_counters_histograms_and_pong(tmp_path):
+    sock = str(tmp_path / "svc.sock")
+    svc = _SvcThread(sock).start()
+    eng = _attach_engine(sock)
+    try:
+        info = {}
+        pending = eng.match_batch_submit(["a/b", "c/d"])
+        eng.match_batch_finish(pending, info=info)
+        assert info.get("path", "svc") == "svc"
+        eng.poll_service()
+        wait_until(
+            lambda: eng.poll_service() and (
+                (eng.service_info()["service"].get("stats") or {})
+                .get("windows", 0) >= 1),
+            what="pong carries service counters")
+        remote = eng.service_info()["service"]
+        assert remote["stats"]["topics"] >= 2
+        assert remote["stats"]["errors"] == 0
+        assert set(remote["hist"]) == {"unpack", "match", "decide",
+                                       "pack"}
+        assert remote["hist"]["match"]["count"] >= 1
+        assert remote["flight"] == {}  # service ran without a recorder
+        # worker-side ring occupancy surface rides the same info dict
+        ring = eng.service_info()["ring"]
+        assert ring["slots"] >= 1 and ring["free"] == ring["slots"]
+        assert ring["high_watermark"] >= 1
+    finally:
+        eng.close()
+        svc.stop()
+
+
+def test_shmring_stats_name_full_and_oversize():
+    ring = shmring.WindowRing.create(slots=2, slot_bytes=4096)
+    try:
+        a = ring.acquire()
+        ring.acquire()
+        st = ring.stats()
+        assert st["in_flight"] == 2 and st["high_watermark"] == 2
+        with pytest.raises(shmring.RingFull) as ei:
+            ring.acquire()
+        # the degrade path names WHICH ring and at what depth
+        assert ring.stats()["name"] in str(ei.value)
+        assert "all 2 slots" in str(ei.value)
+        assert ring.stats()["full"] == 1
+        with pytest.raises(ValueError) as ei:
+            ring.write(a, epoch=1, seq=1,
+                       kind=shmring.KIND_MATCH_REQ,
+                       parts=(b"x" * 8192,))
+        assert ring.stats()["name"] in str(ei.value)
+        assert ring.stats()["oversize"] == 1
+    finally:
+        ring.close()
+
+
+# ---------------------------------------------------------- REST
+
+def test_rest_flight_surface(tmp_path):
+    async def t():
+        from api_helper import auth_session
+
+        from emqx_tpu.broker.listener import BrokerServer
+
+        cfg = BrokerConfig()
+        cfg.listeners = [ListenerConfig(port=0)]
+        cfg.engine.use_device = False
+        cfg.api.enable = True
+        cfg.api.port = 0
+        cfg.api.data_dir = str(tmp_path / "api")
+        cfg.flight.dump_dir = str(tmp_path / "flight")
+        srv = BrokerServer(cfg)
+        await srv.start()
+        try:
+            http, api = await auth_session(srv)
+            async with http:
+                async with http.get(api + "/api/v5/flight") as r:
+                    assert r.status == 200
+                    info = await r.json()
+                    assert info["status"]["armed"]
+                    assert info["dumps"] == []
+                async with http.post(api + "/api/v5/flight/dump") as r:
+                    assert r.status == 200
+                    tid = (await r.json())["id"]
+                async with http.get(api + f"/api/v5/flight/{tid}") as r:
+                    assert r.status == 200
+                    doc = await r.json()
+                    assert doc["id"] == tid and doc["torn"] == 0
+                    assert doc["processes"][0]["role"] == "broker"
+                    assert doc["trace"]["traceEvents"]
+                async with http.get(api + "/api/v5/flight/nope") as r:
+                    assert r.status == 404
+                # the olp satellite: transitions ride /api/v5/olp
+                async with http.get(api + "/api/v5/olp") as r:
+                    assert "transitions" in await r.json()
+                async with http.get(api + "/metrics") as r:
+                    text = await r.text()
+                    assert "emqx_flight_triggers" in text
+        finally:
+            await srv.stop()
+
+    asyncio.run(t())

@@ -777,6 +777,115 @@ def test_obs601_instrumented_dispatch_path_clean():
     assert not findings, "\n".join(f.render() for f in findings)
 
 
+# ------------------------------------------------------------- OBS602
+
+def test_obs602_cold_path_flight_call_in_dispatch_loop():
+    # `note`/`trigger`/`status` are cold-path API: a finding in a loop
+    bad = (
+        "class B:\n"
+        "    def fan_out(self, deliveries):\n"
+        "        fl = self.flight\n"
+        "        for m, opts in deliveries:\n"
+        "            fl.note('deliver', topic=m.topic)\n"
+    )
+    assert "OBS602" in rules_of(bad, path="pkg/disp.py",
+                                dispatch=_DISPATCH)
+    # the un-hoisted receiver spelling fires too
+    attr = bad.replace("fl.note('deliver', topic=m.topic)",
+                       "self.flight.trigger('storm')")
+    assert "OBS602" in rules_of(attr, path="pkg/disp.py",
+                                dispatch=_DISPATCH)
+    # an unrelated module is not checked
+    assert "OBS602" not in rules_of(bad, path="pkg/other.py",
+                                    dispatch=_DISPATCH)
+    # UNLIKE OBS601 there is no sampled-guard exemption: the recorder
+    # is always on, so an enclosing if cannot make the work free
+    guarded = (
+        "class B:\n"
+        "    def fan_out(self, deliveries):\n"
+        "        fl = self.flight\n"
+        "        for m, opts in deliveries:\n"
+        "            ctx = getattr(m, '_trace_ctx', None)\n"
+        "            if ctx is not None:\n"
+        "                fl.note('deliver', topic=m.topic)\n"
+    )
+    assert "OBS602" in rules_of(guarded, path="pkg/disp.py",
+                                dispatch=_DISPATCH)
+
+
+def test_obs602_record_scalar_args_pass():
+    # the approved shape: the preallocated O(1) ring append with
+    # scalar-coercion args only
+    ok = (
+        "class B:\n"
+        "    def fan_out(self, deliveries):\n"
+        "        fl = self.flight\n"
+        "        for m, opts in deliveries:\n"
+        "            fl.record(13, float(len(opts)), float(m.seq))\n"
+    )
+    assert "OBS602" not in rules_of(ok, path="pkg/disp.py",
+                                    dispatch=_DISPATCH)
+    # arithmetic on names/attributes is scalar too
+    arith = ok.replace("fl.record(13, float(len(opts)), float(m.seq))",
+                       "fl.record(13, (m.t1 - m.t0) * 1e6, m.seq + 1)")
+    assert "OBS602" not in rules_of(arith, path="pkg/disp.py",
+                                    dispatch=_DISPATCH)
+    # cold-path emission OUTSIDE the loop: fine
+    hoisted = (
+        "class B:\n"
+        "    def fan_out(self, deliveries):\n"
+        "        for m, opts in deliveries:\n"
+        "            m.deliver()\n"
+        "        self.flight.note('window', n=len(deliveries))\n"
+    )
+    assert "OBS602" not in rules_of(hoisted, path="pkg/disp.py",
+                                    dispatch=_DISPATCH)
+
+
+def test_obs602_allocating_record_args():
+    base = (
+        "class B:\n"
+        "    def fan_out(self, deliveries):\n"
+        "        fl = self.flight\n"
+        "        for m, opts in deliveries:\n"
+        "            CALL\n"
+    )
+    for call in (
+        "fl.record(13, len([m.topic]))",          # list display
+        "fl.record(13, d={'topic': m.topic})",    # dict display (kwarg)
+        "fl.record(13, float(str(m.seq)))",       # str() allocates
+        "fl.record(13, sum(x.n for x in opts))",  # genexp + non-scalar
+    ):
+        src = base.replace("CALL", call)
+        assert "OBS602" in rules_of(src, path="pkg/disp.py",
+                                    dispatch=_DISPATCH), call
+
+
+def test_obs602_suppression_comment():
+    sup = (
+        "class B:\n"
+        "    def fan_out(self, deliveries):\n"
+        "        fl = self.flight\n"
+        "        for m, opts in deliveries:\n"
+        "            fl.note('deliver')"
+        "  # brokerlint: ignore[OBS602]\n"
+    )
+    assert "OBS602" not in rules_of(sup, path="pkg/disp.py",
+                                    dispatch=_DISPATCH)
+
+
+def test_obs602_instrumented_dispatch_path_clean():
+    """The acceptance gate: the flight recorder's own dispatch-path
+    instrumentation (the per-peer EV_FWD append in _flush_forwards,
+    ring samples, window hooks) satisfies the O(1) no-allocation
+    contract it imposes."""
+    findings = [
+        f for f in run_lint(["emqx_tpu"])
+        if f.rule == "OBS602"
+    ]
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
 # ------------------------------------------------------------ the gate
 
 def test_repo_has_no_findings_beyond_baseline():

@@ -1,4 +1,4 @@
-"""Observability-cost rule (OBS601).
+"""Observability-cost rules (OBS601, OBS602).
 
 PR 8 threads a per-message lifecycle tracer through the dispatch path
 under one invariant: tracing work happens OUTSIDE the dispatch hot
@@ -16,6 +16,15 @@ receiver chain names the tracer (``tracer``/``lifecycle``/
 enclosing ``if``'s test mentions the sampling decision (``sampled``,
 ``trace_ctx``/``tctx``/``ctx``, or ``_trace_fwd``).  Intentional
 exceptions take a justified inline ``# brokerlint: ignore[OBS601]``.
+
+OBS602 holds the flight recorder (flightrec.py) to its own stricter
+contract: the recorder is ALWAYS ON, so there is no sampled-guard to
+hide behind — any flight-recorder call inside a dispatch hot loop must
+be the preallocated O(1) ring append (``.record(...)``), and its
+argument tree must not allocate (no dict/list/set/tuple/f-string
+displays, no comprehensions, no calls beyond scalar coercions like
+``float``/``int``/``len``).  ``fl.note(...)``, ``fl.trigger(...)`` and
+friends are cold-path API and a finding when they appear in a loop.
 """
 
 from __future__ import annotations
@@ -104,6 +113,111 @@ def _walk(fn: ast.AST) -> List[Tuple[ast.Call, bool]]:
     return hits
 
 
+# ------------------------------------------------------------- OBS602
+
+# attribute-chain segments that mean "this receiver is the flight
+# recorder" — `self.flight.record(...)`, the hoisted-local idiom
+# `fl.record(...)`, and module-level `flightrec.X(...)`
+_FLIGHT_SEGMENTS = {"flight", "flightrec", "fl"}
+
+# the ONLY flight-recorder method allowed inside a dispatch loop: the
+# preallocated O(1) ring append
+_FLIGHT_HOT_OK = {"record"}
+
+# scalar coercions that do not allocate per-call — everything else in
+# a record() argument tree is a finding
+_SCALAR_CALLS = {"float", "int", "len", "bool", "abs", "min", "max"}
+
+# AST displays/comprehensions that allocate a fresh container (or
+# string) per evaluation
+_ALLOC_NODES = (
+    ast.Dict, ast.List, ast.Set, ast.Tuple, ast.ListComp, ast.SetComp,
+    ast.DictComp, ast.GeneratorExp, ast.JoinedStr, ast.Starred,
+    ast.Await,
+)
+
+
+def _is_flight_call(call: ast.Call) -> bool:
+    name = dotted_name(call.func)
+    if not name:
+        return False
+    segments = name.split(".")
+    # receiver segments only: a local variable named `record` or a
+    # plain function `flight()` is not a flight-recorder method call
+    return len(segments) > 1 and any(
+        seg in _FLIGHT_SEGMENTS for seg in segments[:-1]
+    )
+
+
+def _alloc_in_args(call: ast.Call) -> str:
+    """First allocating construct in the call's argument tree, or ""
+    when every argument is scalar-shaped (names, attributes,
+    constants, arithmetic, and _SCALAR_CALLS coercions)."""
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        for node in ast.walk(arg):
+            if isinstance(node, _ALLOC_NODES):
+                return type(node).__name__
+            if isinstance(node, ast.Call):
+                inner = dotted_name(node.func) or "<call>"
+                if inner.split(".")[-1] not in _SCALAR_CALLS:
+                    return f"{inner}()"
+    return ""
+
+
+def _walk_flight(fn: ast.AST) -> List[ast.Call]:
+    """Flight-recorder calls lexically inside a loop of `fn`; nested
+    def/lambda subtrees are pruned, and — unlike OBS601 — there is NO
+    guard exemption: the recorder is always on, so an enclosing if
+    cannot make the work free."""
+    hits: List[ast.Call] = []
+
+    def walk(node: ast.AST, in_loop: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)) and child is not fn:
+                continue
+            child_in_loop = in_loop or isinstance(
+                child, (ast.For, ast.AsyncFor, ast.While)
+            )
+            if (
+                in_loop
+                and isinstance(child, ast.Call)
+                and _is_flight_call(child)
+            ):
+                hits.append(child)
+            walk(child, child_in_loop)
+
+    walk(fn, False)
+    return hits
+
+
+def _check_obs602(ctx: ModuleContext, d: DispatchFn,
+                  fn: ast.AST) -> None:
+    for call in _walk_flight(fn):
+        name = dotted_name(call.func) or "<flight>"
+        tail = name.split(".")[-1]
+        if tail not in _FLIGHT_HOT_OK:
+            ctx.report(
+                call, "OBS602", d.qualname,
+                f"flight-recorder call `{name}(` inside the dispatch "
+                f"hot loop `{d.qualname}` is not the O(1) ring append "
+                f"— only `.record(...)` may run per-iteration; "
+                f"`note`/`trigger`/`status` are cold-path API",
+                detail=name,
+            )
+            continue
+        alloc = _alloc_in_args(call)
+        if alloc:
+            ctx.report(
+                call, "OBS602", d.qualname,
+                f"`{name}(` in the dispatch hot loop `{d.qualname}` "
+                f"allocates in its argument tree ({alloc}) — the "
+                f"always-on recorder's loop contract is scalar args "
+                f"only (names, constants, arithmetic, float/int/len)",
+                detail=f"{name}+{alloc}",
+            )
+
+
 def check(ctx: ModuleContext,
           dispatch: Sequence[DispatchFn] = DISPATCH_FUNCS) -> None:
     relevant = [d for d in dispatch if ctx.path.endswith(d.path_suffix)]
@@ -126,6 +240,7 @@ def check(ctx: ModuleContext,
                 f"it to the once-per-window emission",
                 detail=name,
             )
+        _check_obs602(ctx, d, fn)
 
 
 __all__ = ["check"]
