@@ -19,6 +19,7 @@ Prints ONE JSON line:
 Extra detail goes to BENCH_DETAILS.json, never stdout.
 """
 
+import asyncio
 import json
 import os
 import sys
@@ -1447,6 +1448,254 @@ def run_rules_bench(log, iters=None, write_json=True):
     return results
 
 
+def run_rule_egress_bench(log, iters=None, write_json=True):
+    """Rule-engine OUTPUT half A/B (BENCH_r16, the PR 20 tentpole):
+    1k registered rules x 64-msg publish windows through the REAL
+    end-to-end action pipeline — SELECT materialization, payload
+    templating, buffered sink worker, and an actual TCP round-trip to
+    an in-process loopback sink server per delivery:
+
+      * ``scalar``  — select_force="scalar" (the per-row interpreter
+        referee) + a max_batch=1 sink worker: one eval_select + one
+        template render + ONE sink round-trip per action row (the
+        pre-PR shape);
+      * ``batched`` — select_force="batched" + micro-batching worker
+        + ``on_query_batch``: one `materialize_rows` pass per (rule,
+        window), one `render_rows` per action, ONE sink round-trip
+        per flushed micro-batch.
+
+    Both sides run the SAME WHERE matrix (the PR 12 half stays on) so
+    the ratio isolates the output half.  An iteration clocks publish
+    -> last action ACKED by the sink server.  Interleaved iterations,
+    medians."""
+    import struct as _struct
+
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.config import BrokerConfig
+    from emqx_tpu.message import Message
+    from emqx_tpu.resources import Resource
+
+    iters = iters or int(os.environ.get("BENCH_EGRESS_ITERS", 5))
+    window = 64
+    n_groups = 16
+    n_rules = 1000
+    n_windows = int(os.environ.get("BENCH_EGRESS_WINDOWS", 6))
+
+    class TcpSink(Resource):
+        """Length-framed loopback sink: each frame carries N
+        newline-joined records, the server acks with the count — so
+        every ``on_query`` is one real RTT and every
+        ``on_query_batch`` amortizes the window into one."""
+
+        max_batch = 1
+
+        def __init__(self, port: int) -> None:
+            self.port = port
+            self._r = self._w = None
+
+        async def on_start(self) -> None:
+            self._r, self._w = await asyncio.open_connection(
+                "127.0.0.1", self.port
+            )
+
+        async def on_stop(self) -> None:
+            if self._w is not None:
+                self._w.close()
+
+        async def _send(self, records) -> int:
+            body = b"\n".join(
+                r.encode() if isinstance(r, str) else r
+                for r in records
+            )
+            self._w.write(_struct.pack(">I", len(body)) + body)
+            await self._w.drain()
+            hdr = await self._r.readexactly(4)
+            return _struct.unpack(">I", hdr)[0]
+
+        async def on_query(self, query) -> None:
+            await self._send([query])
+
+        async def on_query_batch(self, queries) -> int:
+            return await self._send(queries)
+
+    _TMPL = (
+        '{"t":"${topic}","v":${v},"v2":${v2},"s":"${s}"}'
+    )
+
+    async def build(mode, port):
+        cfg = BrokerConfig()
+        cfg.engine.use_device = False  # match half: host trie
+        b = Broker(config=cfg)
+        from emqx_tpu.rules.engine import SinkAction
+
+        sink = TcpSink(port)
+        if mode == "scalar":
+            b.rules.select_force = "scalar"
+            sink.max_batch = 1
+            worker = await b.resources.create(
+                "bench_sink", sink, max_buffer=1_000_000
+            )
+        else:
+            b.rules.select_force = "batched"
+            sink.max_batch = 4096
+            worker = await b.resources.create(
+                "bench_sink", sink, max_buffer=1_000_000,
+                batch_records=512, batch_age=0.002,
+            )
+        for i in range(n_rules):
+            b.rules.add_rule(
+                f"r{i}",
+                f"SELECT payload.v AS v, topic, "
+                f"payload.v * 2 + {i % 8} AS v2, payload.s AS s "
+                f'FROM "bench/{i % n_groups}/#" '
+                f"WHERE payload.v >= 16",
+                actions=[SinkAction("bench_sink", payload=_TMPL)],
+            )
+        return b, worker
+
+    def make_msgs(n_msgs):
+        return [
+            Message(
+                topic=f"bench/{j % n_groups}/x",
+                payload=(
+                    '{"v": %d, "s": "%s"}' % (j % 32, "xyq"[j % 3])
+                ).encode(),
+                qos=0,
+            )
+            for j in range(n_msgs)
+        ]
+
+    async def pump(b, worker, received):
+        """One timed iteration: publish every window, then wait for
+        the LAST enqueued action's sink ack."""
+        msgs = make_msgs(window * n_windows)
+        base_matched = worker.stats["matched"]
+        base_dropped = worker.stats["dropped"]
+        base_rcvd = received["n"]
+        t0 = time.perf_counter()
+        for w0 in range(0, len(msgs), window):
+            w = msgs[w0:w0 + window]
+            now = time.time()
+            for m in w:
+                m.timestamp = now
+            b.publish_many(w)
+            # yield so the drain loop overlaps with publish (the
+            # broker's event loop does this for free)
+            await asyncio.sleep(0)
+        expect = (
+            worker.stats["matched"] - base_matched
+            - (worker.stats["dropped"] - base_dropped)
+        )
+        while received["n"] - base_rcvd < expect:
+            await asyncio.sleep(0.0005)
+        dt = time.perf_counter() - t0
+        return expect / dt
+
+    async def main():
+        received = {"n": 0}
+
+        async def handle(reader, writer):
+            try:
+                while True:
+                    hdr = await reader.readexactly(4)
+                    (ln,) = _struct.unpack(">I", hdr)
+                    body = await reader.readexactly(ln)
+                    cnt = body.count(b"\n") + 1 if body else 0
+                    received["n"] += cnt
+                    writer.write(_struct.pack(">I", cnt))
+                    await writer.drain()
+            except (
+                asyncio.IncompleteReadError, ConnectionResetError
+            ):
+                pass
+
+        server = await asyncio.start_server(
+            handle, "127.0.0.1", 0
+        )
+        port = server.sockets[0].getsockname()[1]
+        sides = {}
+        for mode in ("scalar", "batched"):
+            sides[mode] = await build(mode, port)
+        runs = {m: [] for m in sides}
+        # warm both sides off-clock (imports, JIT, template cache)
+        for mode, (b, worker) in sides.items():
+            await pump(b, worker, received)
+        for _ in range(iters):
+            for mode, (b, worker) in sides.items():
+                runs[mode].append(await pump(b, worker, received))
+        stats = {}
+        for mode, (b, worker) in sides.items():
+            snap = worker.batch_hist.snapshot()
+            stats[mode] = {
+                "engine": {
+                    k: v for k, v in b.rules.stats().items()
+                    if isinstance(v, (int, float)) and v is not None
+                },
+                "sink": {
+                    **{
+                        k: v for k, v in worker.stats.items()
+                        if isinstance(v, (int, float))
+                    },
+                    "batch_p50": round(snap.percentile(50), 1),
+                    "batch_p99": round(snap.percentile(99), 1),
+                },
+            }
+            await b.resources.stop_all()
+        server.close()
+        await server.wait_closed()
+        return runs, stats
+
+    runs, stats = asyncio.run(main())
+
+    def med(xs):
+        return sorted(xs)[len(xs) // 2]
+
+    medians = {m: round(med(rs), 1) for m, rs in runs.items()}
+    speedup = round(medians["batched"] / medians["scalar"], 2)
+    results = {
+        "runs": {m: [round(r, 1) for r in rs]
+                 for m, rs in runs.items()},
+        "medians_actions_per_s": medians,
+        "speedup_batched_vs_scalar": speedup,
+        "stages": stats,
+    }
+    log(
+        f"rule egress bench {n_rules} rules: "
+        f"scalar {medians['scalar']:,.0f} "
+        f"batched {medians['batched']:,.0f} actions/s "
+        f"({speedup}x)"
+    )
+    if write_json:
+        out = {
+            "pr": 20,
+            "metric": "rule_action_throughput_actions_per_s",
+            "methodology": (
+                "Interleaved A/B, {it} iterations each, same box "
+                "(bench.py run_rule_egress_bench): 1k lowerable "
+                "SELECT rules over 16 topic groups (each 64-msg "
+                "window matches ~62 rules, WHERE pass rate 1/2), "
+                "every action a templated-payload sink delivery to "
+                "an in-process loopback TCP server that acks each "
+                "frame (a REAL per-delivery round-trip).  'scalar' "
+                "= per-row eval_select + per-record sink RTT "
+                "(max_batch=1, the pre-PR shape); 'batched' = "
+                "windowed SELECT lowering (materialize_rows + "
+                "render_rows) + micro-batched worker flushes "
+                "(batch_records=512, batch_age=2ms) + one RTT per "
+                "flushed batch.  Both sides run the same WHERE "
+                "matrix; an iteration clocks publish -> last action "
+                "ACK.  Medians reported."
+            ).format(it=iters),
+            "rules_1000": results,
+        }
+        path = os.path.join(
+            os.path.dirname(__file__) or ".", "BENCH_r16.json"
+        )
+        with open(path, "w") as f:
+            json.dump(out, f, indent=2)
+    return results
+
+
 def run_overload_bench(log, iters=None, write_json=True):
     """Overload-protection A/B (BENCH_r11): the PR 13 acceptance
     counterfactual.  Two halves:
@@ -2644,6 +2893,13 @@ def main():
         # tentpole)
         rules_stats = run_rules_bench(log)
 
+    rule_egress_stats = {}
+    if os.environ.get("BENCH_RULE_EGRESS", "1") != "0":
+        # rule OUTPUT half: batched SELECT + micro-batched sink
+        # egress vs the per-row scalar referee with per-record sink
+        # round-trips (BENCH_r16 tracks the PR 20 tentpole)
+        rule_egress_stats = run_rule_egress_bench(log)
+
     broker_stats = {}
     if os.environ.get("BENCH_BROKER", "1") != "0":
         # three rows at >=1M background subs: host-pinned (the
@@ -2700,6 +2956,7 @@ def main():
         "ds_shard": ds_shard_stats,
         "cluster_forward": cluster_fwd_stats,
         "rules": rules_stats,
+        "rule_egress": rule_egress_stats,
         "overload": overload_stats,
         "flightrec": flight_stats,
         **sharded_stats,

@@ -53,7 +53,12 @@ class Aggregator:
         self._approx_bytes = 0
         self._bucket_start = time.time()
         self._seq = 0
-        self.stats = {"pushed": 0, "flushed_objects": 0, "errors": 0}
+        self.stats = {
+            "pushed": 0,
+            "flushed_objects": 0,
+            "errors": 0,
+            "deferred_ticks": 0,
+        }
 
     # ----------------------------------------------------------- push
 
@@ -70,13 +75,25 @@ class Aggregator:
         ):
             self.flush()
 
-    def tick(self, now: Optional[float] = None) -> bool:
-        """1 Hz housekeeping: flush when the time bucket lapses."""
+    def tick(
+        self, now: Optional[float] = None, defer: bool = False
+    ) -> bool:
+        """1 Hz housekeeping: flush when the time bucket lapses.
+        ``defer`` (the olp ladder's L1+ egress deferral) holds a due
+        flush back — but only up to ``interval_s * 4``; the record and
+        byte caps in `push` are never deferred, so the buffer stays
+        bounded through a long overload episode."""
         now = now if now is not None else time.time()
-        if self._records and now - self._bucket_start >= self.interval_s:
-            self.flush(now)
-            return True
-        return False
+        if not self._records:
+            return False
+        age = now - self._bucket_start
+        if age < self.interval_s:
+            return False
+        if defer and age < self.interval_s * 4:
+            self.stats["deferred_ticks"] += 1
+            return False
+        self.flush(now)
+        return True
 
     # ---------------------------------------------------------- flush
 

@@ -10,9 +10,11 @@ optional topic prefix.  Both ride `MqttClient` with auto-reconnect.
 
 from __future__ import annotations
 
+import asyncio
 import logging
 from typing import List, Optional, Tuple
 
+from . import failpoints
 from .client import MqttClient
 from .hooks import STOP_WITH
 from .message import Message
@@ -22,7 +24,17 @@ log = logging.getLogger("emqx_tpu.bridge")
 
 
 class MqttEgressResource(Resource):
-    """Resource wrapper: queries are (topic, payload, qos, retain)."""
+    """Resource wrapper: queries are (topic, payload, qos, retain).
+
+    ``on_query_batch`` ships a whole action window at-least-once:
+    `MqttClient.publish` writes one atomic frame per message, so the
+    window pipelines as concurrent publishes (QoS1 acks resolve via
+    per-pid futures) instead of ack-serialized round-trips.  The
+    consumed count is the longest delivered PREFIX — the buffer worker
+    keeps the tail queued and replays it, so a mid-window failure
+    duplicates at most, never loses (MQTT QoS1 semantics)."""
+
+    max_batch = 64
 
     def __init__(
         self,
@@ -45,6 +57,42 @@ class MqttEgressResource(Resource):
     async def on_query(self, query: Tuple[str, bytes, int, bool]) -> None:
         topic, payload, qos, retain = query
         await self.client.publish(topic, payload, qos=qos, retain=retain)
+
+    async def _send_window(
+        self, queries: List[Tuple[str, bytes, int, bool]]
+    ) -> int:
+        results = await asyncio.gather(
+            *(
+                self.client.publish(t, p, qos=q, retain=r)
+                for t, p, q, r in queries
+            ),
+            return_exceptions=True,
+        )
+        done = 0
+        for res in results:
+            if isinstance(res, BaseException):
+                if done == 0:
+                    raise res
+                break
+            done += 1
+        return done
+
+    async def on_query_batch(
+        self, queries: List[Tuple[str, bytes, int, bool]]
+    ) -> int:
+        if failpoints.enabled:
+            # chaos seam for the window send: ``drop`` claims nothing
+            # was consumed (the worker raises and replays the whole
+            # window — at-least-once, no loss), ``duplicate`` sends
+            # the window twice before the accounted send
+            act = await failpoints.evaluate_async(
+                "bridge.mqtt.send", key=self.client.client_id
+            )
+            if act == "drop":
+                return 0
+            if act == "duplicate":
+                await self._send_window(queries)
+        return await self._send_window(queries)
 
     async def health_check(self) -> bool:
         return self.client.connected.is_set()

@@ -231,6 +231,11 @@ class Broker:
         self.tracer = None
         self.alarms = AlarmRegistry(self)
         self.resources.alarms = self.alarms
+        # sink egress observability: breaker edges -> flight recorder,
+        # flush deferrals -> olp counter, defer signal -> linger
+        self.resources.metrics = self.metrics
+        self.resources.flight = self.flight
+        self.resources.olp = self.olp
         # failure-driven device→host degradation: the match engine's
         # circuit breaker reports trip/clear here, raising/clearing a
         # $SYS alarm and bumping counters.  The callbacks fire on
@@ -2614,6 +2619,11 @@ class Broker:
             node["durability"] = self.durable.sync_stats()
         if self.flight.armed:
             node["flight"] = self.flight.status()
+        egress = self.resources.summary()
+        if egress["sinks"]:
+            # sink-egress roll-up (PR 20 windowed pipeline): buffered
+            # depth, batch count, deferral + breaker state at a glance
+            node["egress"] = egress
         mc = self.config.multicore
         if mc.service_socket or mc.n_workers:
             node["multicore"] = {

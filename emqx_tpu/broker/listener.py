@@ -568,9 +568,10 @@ class BrokerServer:
                 self.telemetry.tick()
             if self.otel is not None:
                 self.otel.tick()
+            defer_flush = self.broker.olp.defer_sink_flush
             for agg in self.broker.aggregators:
                 try:
-                    agg.tick()
+                    agg.tick(defer=defer_flush)
                 except Exception:
                     log.exception("aggregator tick failed")
             for client in self.exhook_clients:

@@ -139,6 +139,15 @@ class Ctl:
                         f"{row.get('quarantined_segments', 0)} "
                         "quarantined segs"
                     )
+            eg = n.get("egress")
+            if eg:
+                print(
+                    f"  egress: {eg['sinks']} sinks, "
+                    f"{eg['buffered']} buffered, "
+                    f"{eg['batches']} batches flushed "
+                    f"({eg['flush_deferred']} deferred); "
+                    f"{eg['breakers_open']} breakers open"
+                )
             mc = n.get("multicore")
             if mc:
                 svc = mc.get("service") or {}

@@ -1811,7 +1811,10 @@ class MatchEngine:
         # cannot produce float64-correct results for these windows
         if stack.has_arith or not stack.f32_lits_safe:
             return False
-        return cols.f32_safe()
+        # only the WHERE planes reach the device kernel (they are a
+        # prefix of the combined WHERE+SELECT path union); SELECT-only
+        # columns stay on the float64 numpy materialization
+        return cols.f32_safe(len(stack.paths))
 
     def _rules_device(self, stack, rev: int, cols) -> np.ndarray:
         """One device rules step: upload the stacked program (cached

@@ -82,6 +82,8 @@ SEAMS = (
     "multicore.ring.submit",
     "multicore.ring.complete",
     "multicore.service.restart",
+    "resource.batch.flush",
+    "bridge.mqtt.send",
 )
 
 enabled = False  # fast-path gate: disabled brokers pay one bool check

@@ -193,6 +193,69 @@ def decode_batch_record_count(batch: bytes) -> int:
                               + 8 + 8 + 8 + 2 + 4)[0]
 
 
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    """Signed (zigzag) varint at ``pos`` -> (value, next_pos)."""
+    shift = 0
+    z = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        z |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    return (z >> 1) ^ -(z & 1), pos
+
+
+def decode_record_batch(
+    batch: bytes,
+) -> List[Tuple[Optional[bytes], bytes]]:
+    """Full magic-2 RecordBatch decode -> ``[(key, value), ...]`` —
+    the inverse of `encode_record_batch`, crc-verified.  Tests
+    round-trip multi-record batches through it; the fake broker uses
+    `decode_batch_record_count` on the hot path instead."""
+    base = 8 + 4 + 4  # baseOffset, batchLength, partitionLeaderEpoch
+    if batch[base:base + 1] != b"\x02":
+        raise ValueError(f"not a magic-2 batch: {batch[base:base+1]!r}")
+    (crc,) = struct.unpack_from(">I", batch, base + 1)
+    tail = batch[base + 1 + 4:]
+    actual = crc32c(tail)
+    if actual != crc:
+        raise ValueError(f"batch crc mismatch: {actual:#x} != {crc:#x}")
+    (n_records,) = struct.unpack_from(
+        ">i", tail, 2 + 4 + 8 + 8 + 8 + 2 + 4
+    )
+    pos = 2 + 4 + 8 + 8 + 8 + 2 + 4 + 4
+    out: List[Tuple[Optional[bytes], bytes]] = []
+    for _ in range(n_records):
+        length, pos = _read_varint(tail, pos)
+        end = pos + length
+        pos += 1  # record attributes
+        _, pos = _read_varint(tail, pos)  # timestamp delta
+        _, pos = _read_varint(tail, pos)  # offset delta
+        klen, pos = _read_varint(tail, pos)
+        if klen < 0:
+            key = None
+        else:
+            key = tail[pos:pos + klen]
+            pos += klen
+        vlen, pos = _read_varint(tail, pos)
+        value = tail[pos:pos + vlen]
+        pos += vlen
+        n_headers, pos = _read_varint(tail, pos)
+        for _h in range(n_headers):
+            hklen, pos = _read_varint(tail, pos)
+            pos += max(hklen, 0)
+            hvlen, pos = _read_varint(tail, pos)
+            pos += max(hvlen, 0)
+        if pos != end:
+            raise ValueError(
+                f"record length mismatch: ended {pos}, expected {end}"
+            )
+        out.append((key, value))
+    return out
+
+
 # -------------------------------------------------------------- client
 
 class KafkaClient:

@@ -83,6 +83,7 @@ METRICS = (
     "olp.deferred.resume",
     "olp.deferred.retained",
     "olp.deferred.rebuild",
+    "olp.deferred.sink_flush",
     "olp.dropped.retained",
     "olp.refused.connect",
     "olp.shed.publish_qos0",

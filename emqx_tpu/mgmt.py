@@ -514,10 +514,12 @@ class MgmtApi:
     async def get_rules(self, request: web.Request) -> web.Response:
         # "stats" carries the columnar-eval surface: lowered-vs-
         # fallback registry split, matrix/scalar window counts, the
-        # engine's per-cell cost EWMAs and breaker state
+        # engine's per-cell cost EWMAs and breaker state; "egress" the
+        # per-sink queue depth / batch-size percentiles / breaker view
         return _json({
             "data": self.broker.rules.info(),
             "stats": self.broker.rules.stats(),
+            "egress": self.broker.resources.info(),
         })
 
     async def post_rule(self, request: web.Request) -> web.Response:
@@ -1273,6 +1275,36 @@ class MgmtApi:
                 continue
             emit("rules_" + name, "gauge", value,
                  help_text=f"rule engine {name}")
+        # sink-egress surface (PR 20 windowed pipeline): per-sink
+        # labeled gauges plus ONE merged batch-size histogram family
+        # (prom_histogram_lines has no label support; snapshots merge
+        # losslessly per bucket)
+        batch_snap = None
+        for rid, row in sorted(self.broker.resources.info().items()):
+            for name, value in sorted(row.items()):
+                if isinstance(value, bool):
+                    value = int(value)
+                if not isinstance(value, (int, float)):
+                    continue
+                emit("sink_" + name, "gauge", value,
+                     labels={"sink": rid},
+                     help_text=f"sink egress {name}")
+            w = self.broker.resources.get(rid)
+            if w is not None:
+                snap = w.batch_hist.snapshot()
+                batch_snap = (
+                    snap if batch_snap is None
+                    else batch_snap.merge(snap)
+                )
+        if batch_snap is not None and batch_snap.count:
+            family = prom_name("emqx_sink_batch_size")
+            if family not in seen:
+                seen.add(family)
+                lines.extend(prom_histogram_lines(
+                    family, batch_snap,
+                    help_text="records per flushed sink batch "
+                              "(all sinks merged)",
+                ))
         prof = self.broker.profiler
         for name, snap in sorted(prof.snapshots().items()):
             family = prom_name(f"emqx_profiler_{name}_us")

@@ -92,6 +92,7 @@ COUNTERS = (
     "olp.deferred.rebuild",
     "olp.dropped.retained",
     "olp.refused.connect",
+    "olp.deferred.sink_flush",
     "olp.shed.publish_qos0",
     "olp.killed.slow_subs",
     "delivery.dropped.olp_shed",
@@ -117,7 +118,8 @@ class LoadMonitor:
 
     Hot paths read the precomputed flag attributes only (one attribute
     load per window/run): ``shed_qos0_mask`` (L2), ``shed_ingress_qos0``
-    (L3), ``defer_admissions`` (L1), ``window_cap_now`` (L1, 0 = off).
+    (L3), ``defer_admissions`` (L1), ``defer_sink_flush`` (L1),
+    ``window_cap_now`` (L1, 0 = off).
     """
 
     def __init__(self, broker, cfg) -> None:
@@ -132,6 +134,7 @@ class LoadMonitor:
         self.shed_qos0_mask = False
         self.shed_ingress_qos0 = False
         self.defer_admissions = False
+        self.defer_sink_flush = False
         self.window_cap_now = 0
         self._thresholds: Dict[str, Tuple[float, float, float]] = {
             name: tuple(float(v) for v in getattr(cfg, name))
@@ -334,6 +337,9 @@ class LoadMonitor:
         self.shed_qos0_mask = new >= 2
         self.shed_ingress_qos0 = new >= 3
         self.defer_admissions = new >= 1
+        # sink micro-batch flushes stretch their linger at L1+ —
+        # egress deferral buys headroom BEFORE any QoS0 shedding
+        self.defer_sink_flush = new >= 1
         self.window_cap_now = int(self.cfg.window_cap) if new >= 1 else 0
         want_clamp = new >= 2
         if want_clamp != self._clamped:

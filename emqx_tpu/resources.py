@@ -19,16 +19,35 @@ import asyncio
 import logging
 import time
 from collections import deque
-from typing import Any, Dict, Optional
+from typing import Any, Callable, Dict, Optional
 
 from . import failpoints
 from .aio import cancel_and_wait
+from .observability import Histogram
 
 log = logging.getLogger("emqx_tpu.resources")
 
 CONNECTING = "connecting"
 CONNECTED = "connected"
 DISCONNECTED = "disconnected"
+
+# an olp-deferred flush still has a hard age ceiling: the linger cap
+# stretches by at most this factor while the ladder is at L1+
+DEFER_AGE_FACTOR = 4.0
+
+
+def _qsize(q: Any) -> int:
+    """Approximate in-buffer byte cost of one query (drives the
+    ``batch_bytes`` flush threshold; exactness doesn't matter, only
+    monotonic accounting that returns the same figure on enqueue and
+    dequeue)."""
+    if isinstance(q, (bytes, str)):
+        return len(q)
+    if isinstance(q, tuple):
+        return 16 + sum(
+            len(x) for x in q if isinstance(x, (bytes, str))
+        )
+    return 64
 
 
 class Resource:
@@ -100,7 +119,24 @@ class HttpSink(Resource):
 class BufferWorker:
     """Bounded replay buffer + retrying drain loop per resource
     (emqx_resource_buffer_worker.erl): queries survive sink outages up
-    to ``max_buffer``; beyond it the OLDEST drops (counted)."""
+    to ``max_buffer``; beyond it the OLDEST drops (counted).
+
+    Micro-batching (PR 20, the window-shaped egress): with
+    ``batch_age > 0`` the drain loop lingers until a count
+    (``batch_records``), byte (``batch_bytes``) or age threshold is
+    crossed before flushing — so a window of rule actions leaves as
+    ONE ``on_query_batch`` call instead of per-record round-trips.
+    All three default OFF (immediate drain, the pre-PR behavior).
+    An olp L1+ episode stretches the age linger (``defer_flush``
+    callable, capped at ``DEFER_AGE_FACTOR``x) — flushes defer before
+    any QoS0 shed, and nothing is lost: queries stay buffered.
+
+    Circuit breaker (``breaker_threshold`` consecutive failures):
+    while open, the drain loop parks — buffered batches are retained
+    for replay, intake keeps absorbing up to the bound — and the
+    periodic health probe re-closes it.  Edges fire
+    ``on_breaker_edge`` (ResourceManager wires the $SYS alarm +
+    flight-recorder event)."""
 
     def __init__(
         self,
@@ -110,6 +146,11 @@ class BufferWorker:
         retry_base: float = 0.05,
         retry_cap: float = 5.0,
         health_interval: float = 1.0,
+        batch_records: int = 0,
+        batch_bytes: int = 0,
+        batch_age: float = 0.0,
+        breaker_threshold: int = 0,
+        defer_flush: Optional[Callable[[], bool]] = None,
     ) -> None:
         self.resource = resource
         self.name = ""  # resource_id when owned by a ResourceManager
@@ -118,15 +159,30 @@ class BufferWorker:
         self.retry_base = retry_base
         self.retry_cap = retry_cap
         self.health_interval = health_interval
+        self.batch_records = batch_records
+        self.batch_bytes = batch_bytes
+        self.batch_age = batch_age
+        self.breaker_threshold = breaker_threshold
+        self.defer_flush = defer_flush
         self.status = CONNECTING
+        self.breaker_open = False
         self.stats = {
             "matched": 0,
             "success": 0,
             "failed": 0,
             "dropped": 0,
             "retried": 0,
+            "batches": 0,
+            "flush_deferred": 0,
+            "breaker_opens": 0,
         }
+        self.batch_hist = Histogram()  # flushed batch sizes
         self._buf: deque = deque()
+        self._buf_bytes = 0
+        self._oldest_ts = 0.0
+        self._defer_noted = False
+        self._fail_streak = 0
+        self._q_full_edge = False
         self._wake = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
 
@@ -151,9 +207,14 @@ class BufferWorker:
         except Exception:
             return False
 
-    # alarm hook, wired by the ResourceManager when a broker owns this
-    # worker (the reference raises resource_down alarms the same way)
+    # hooks, wired by the ResourceManager when a broker owns this
+    # worker (the reference raises resource_down alarms the same way):
+    # status alarm, breaker open/close edge, olp flush-deferral count,
+    # queue-full edge — all optional, all exception-isolated
     on_status_alarm = None
+    on_breaker_edge: Optional[Callable[[bool], None]] = None
+    on_flush_deferred: Optional[Callable[[], None]] = None
+    on_queue_full: Optional[Callable[[int], None]] = None
 
     def _alarm(self, down: bool) -> None:
         if self.on_status_alarm is not None:
@@ -180,19 +241,184 @@ class BufferWorker:
         """Queue one query (non-blocking; called from rule actions).
         Returns False when the buffer had to drop its oldest entry."""
         self.stats["matched"] += 1
+        if not self._buf:
+            self._oldest_ts = time.monotonic()
         ok = True
         if len(self._buf) >= self.max_buffer:
-            self._buf.popleft()
+            old = self._buf.popleft()
+            self._buf_bytes -= _qsize(old)
             self.stats["dropped"] += 1
+            self._note_queue_full(1)
             ok = False
+        elif self._q_full_edge and (
+            len(self._buf) < self.max_buffer // 2
+        ):
+            self._q_full_edge = False  # re-arm the edge event
         self._buf.append(query)
+        self._buf_bytes += _qsize(query)
         self._wake.set()
         return ok
+
+    def enqueue_batch(self, queries: list) -> int:
+        """Queue a whole action window in one call (the batched rule
+        egress).  Returns how many OLDEST entries dropped to hold the
+        ``max_buffer`` bound (0 = nothing lost)."""
+        n = len(queries)
+        if not n:
+            return 0
+        self.stats["matched"] += n
+        if not self._buf:
+            self._oldest_ts = time.monotonic()
+        buf = self._buf
+        buf.extend(queries)
+        self._buf_bytes += sum(map(_qsize, queries))
+        dropped = len(buf) - self.max_buffer
+        if dropped > 0:
+            for _ in range(dropped):
+                old = buf.popleft()
+                self._buf_bytes -= _qsize(old)
+            self.stats["dropped"] += dropped
+            self._note_queue_full(dropped)
+        else:
+            dropped = 0
+            if self._q_full_edge and len(buf) < self.max_buffer // 2:
+                self._q_full_edge = False
+        self._wake.set()
+        return dropped
+
+    def _note_queue_full(self, dropped: int) -> None:
+        """Edge-triggered queue-full event (flight recorder feed): one
+        event per excursion to the bound, re-armed once the buffer
+        drains below half."""
+        if not self._q_full_edge:
+            self._q_full_edge = True
+            if self.on_queue_full is not None:
+                try:
+                    self.on_queue_full(dropped)
+                except Exception:
+                    pass
 
     def __len__(self) -> int:
         return len(self._buf)
 
     # ---------------------------------------------------------- drain
+
+    def _linger_remaining(self) -> float:
+        """Seconds the drain loop should still linger before flushing
+        the pending micro-batch (0.0 = flush now).  Count and byte
+        thresholds release immediately; otherwise the batch rides
+        until ``batch_age`` — stretched (capped) while the olp ladder
+        asks sink flushes to defer."""
+        if self.batch_age <= 0.0:
+            return 0.0
+        if self.batch_records and len(self._buf) >= self.batch_records:
+            return 0.0
+        if self.batch_bytes and self._buf_bytes >= self.batch_bytes:
+            return 0.0
+        limit = self.batch_age
+        if self.defer_flush is not None:
+            try:
+                if self.defer_flush():
+                    limit = self.batch_age * DEFER_AGE_FACTOR
+                    if not self._defer_noted:
+                        # one deferral event per pending batch
+                        self._defer_noted = True
+                        self.stats["flush_deferred"] += 1
+                        if self.on_flush_deferred is not None:
+                            self.on_flush_deferred()
+            except Exception:
+                pass
+        age = time.monotonic() - self._oldest_ts
+        return max(0.0, limit - age)
+
+    def _trip_breaker(self, exc: Exception) -> None:
+        self.breaker_open = True
+        self.stats["breaker_opens"] += 1
+        log.warning(
+            "sink %s breaker OPEN after %d consecutive failures "
+            "(%d queries parked): %s",
+            self.name or type(self.resource).__name__,
+            self._fail_streak, len(self._buf), exc,
+        )
+        if self.on_breaker_edge is not None:
+            try:
+                self.on_breaker_edge(True)
+            except Exception:
+                pass
+
+    async def _breaker_probe(self) -> None:
+        """While the breaker is open the drain loop parks here:
+        buffered batches are retained for replay, and a successful
+        health probe re-closes the breaker."""
+        await asyncio.sleep(self.health_interval)
+        if await self._health():
+            self.breaker_open = False
+            self._fail_streak = 0
+            self._set_status(CONNECTED)
+            if self.on_breaker_edge is not None:
+                try:
+                    self.on_breaker_edge(False)
+                except Exception:
+                    pass
+        else:
+            self._set_status(DISCONNECTED)
+
+    async def _flush_once(self) -> None:
+        """Deliver the buffer head: one query, or — for batching
+        sinks — up to ``resource.max_batch`` queries as ONE
+        ``on_query_batch`` call, which returns how many it consumed;
+        a partial consume leaves the tail at the head for the retry
+        path (the reference's buffer workers batch the same way).
+
+        Chaos seams (both INSIDE the caller's try, so injected faults
+        ride the real retry/backoff/replay path with every query
+        still buffered): ``resource.buffer.query`` per delivery
+        attempt, ``resource.batch.flush`` per multi-record flush —
+        there, ``drop`` simulates a flush lost in flight (records
+        stay at the head and replay; no loss) and ``duplicate``
+        delivers the batch twice (at-least-once duplication)."""
+        buf = self._buf
+        n_batch = getattr(self.resource, "max_batch", 1)
+        if failpoints.enabled:
+            await failpoints.evaluate_async(
+                "resource.buffer.query",
+                key=self.name or type(self.resource).__name__,
+            )
+        if n_batch > 1 and hasattr(self.resource, "on_query_batch"):
+            batch = [
+                buf[i] for i in range(min(n_batch, len(buf)))
+            ]
+            if failpoints.enabled:
+                act = await failpoints.evaluate_async(
+                    "resource.batch.flush",
+                    key=self.name or type(self.resource).__name__,
+                )
+                if act == "drop":
+                    raise RuntimeError(
+                        "batch flush dropped in flight (failpoint)"
+                    )
+                if act == "duplicate":
+                    await self.resource.on_query_batch(list(batch))
+            done = await self.resource.on_query_batch(batch)
+            done = len(batch) if done is None else int(done)
+            for _ in range(done):
+                self._buf_bytes -= _qsize(buf.popleft())
+            self.stats["success"] += done
+            self.stats["batches"] += 1
+            self.batch_hist.record(len(batch))
+            if done < len(batch):
+                raise RuntimeError(
+                    f"sink consumed {done}/{len(batch)}"
+                )
+        else:
+            query = buf[0]  # keep at head until delivered
+            await self.resource.on_query(query)
+            self._buf_bytes -= _qsize(buf.popleft())
+            self.stats["success"] += 1
+        # the flushed batch's linger window is spent; the tail (if
+        # any) starts a fresh age/deferral budget
+        self._oldest_ts = time.monotonic()
+        self._defer_noted = False
 
     async def _run(self) -> None:
         backoff = self.retry_base
@@ -215,56 +441,48 @@ class BufferWorker:
                         CONNECTED if healthy else DISCONNECTED
                     )
                     continue
-            # batching sinks (Kafka): drain up to resource.max_batch
-            # queries into one on_query_batch call, which returns how
-            # many it consumed — a partial consume leaves the tail at
-            # the head for the retry path (the reference's buffer
-            # workers batch the same way)
-            n_batch = getattr(self.resource, "max_batch", 1)
-            query = self._buf[0]  # keep at head until delivered
+            if self.breaker_open:
+                await self._breaker_probe()
+                continue
+            rem = self._linger_remaining()
+            if rem > 0.0:
+                # micro-batch linger: wake early if intake crosses a
+                # count/byte threshold, else sleep out the age budget
+                self._wake.clear()
+                try:
+                    await asyncio.wait_for(self._wake.wait(), rem)
+                except asyncio.TimeoutError:
+                    pass
+                continue
             try:
-                if failpoints.enabled:
-                    # chaos seam INSIDE the try: an injected error
-                    # rides the worker's real retry/backoff path with
-                    # the query still at the buffer head (no loss)
-                    await failpoints.evaluate_async(
-                        "resource.buffer.query",
-                        key=self.name or type(self.resource).__name__,
-                    )
-                if n_batch > 1 and hasattr(
-                    self.resource, "on_query_batch"
-                ):
-                    batch = [
-                        self._buf[i]
-                        for i in range(min(n_batch, len(self._buf)))
-                    ]
-                    done = await self.resource.on_query_batch(batch)
-                    done = len(batch) if done is None else int(done)
-                    for _ in range(done):
-                        self._buf.popleft()
-                    self.stats["success"] += done
-                    if done < len(batch):
-                        raise RuntimeError(
-                            f"sink consumed {done}/{len(batch)}"
-                        )
-                else:
-                    await self.resource.on_query(query)
-                    self._buf.popleft()
-                    self.stats["success"] += 1
+                await self._flush_once()
                 self._set_status(CONNECTED)
                 backoff = self.retry_base
                 retries = 0
+                self._fail_streak = 0
             except asyncio.CancelledError:
                 raise
             except Exception as exc:
                 self._set_status(DISCONNECTED)
                 self.stats["retried"] += 1
                 retries += 1
+                self._fail_streak += 1
+                if (
+                    self.breaker_threshold
+                    and self._fail_streak >= self.breaker_threshold
+                    and not self.breaker_open
+                ):
+                    # park instead of hot-retrying a dead sink; the
+                    # buffered queries replay after the probe re-close
+                    self._trip_breaker(exc)
+                    retries = 0
+                    backoff = self.retry_base
+                    continue
                 if (
                     self.max_retries is not None
                     and retries > self.max_retries
                 ):
-                    self._buf.popleft()
+                    self._buf_bytes -= _qsize(self._buf.popleft())
                     self.stats["failed"] += 1
                     retries = 0
                     backoff = self.retry_base  # next query starts fresh
@@ -280,11 +498,18 @@ class BufferWorker:
 
 class ResourceManager:
     """Registry of named resources and their buffer workers
-    (emqx_resource_manager's lifecycle role)."""
+    (emqx_resource_manager's lifecycle role).  When a broker owns the
+    manager it wires ``alarms``/``metrics``/``flight``/``olp`` so
+    every worker's breaker edges raise $SYS alarms + flight events,
+    flush deferrals count under the olp ladder, and queue-full
+    excursions land in the black box."""
 
     def __init__(self, alarms=None) -> None:
         self._workers: Dict[str, BufferWorker] = {}
         self.alarms = alarms  # broker AlarmRegistry (optional)
+        self.metrics = None  # broker MetricsRegistry (optional)
+        self.flight = None  # broker FlightRecorder (optional)
+        self.olp = None  # broker OverloadProtection (optional)
 
     async def create(
         self, resource_id: str, resource: Resource, **worker_kw
@@ -303,6 +528,40 @@ class ResourceManager:
                 else:
                     self.alarms.deactivate(f"resource_down:{rid}")
             worker.on_status_alarm = status_alarm
+
+        def breaker_edge(opened: bool, rid=resource_id):
+            if self.alarms is not None:
+                if opened:
+                    self.alarms.activate(
+                        f"sink_breaker:{rid}",
+                        details={"resource": rid},
+                        message=(
+                            f"sink {rid} circuit breaker open "
+                            "(batches parked for replay)"
+                        ),
+                    )
+                else:
+                    self.alarms.deactivate(f"sink_breaker:{rid}")
+            if self.flight is not None:
+                self.flight.breaker_edge(opened, {"sink": rid})
+        worker.on_breaker_edge = breaker_edge
+
+        def flush_deferred():
+            if self.metrics is not None:
+                self.metrics.inc("olp.deferred.sink_flush")
+        worker.on_flush_deferred = flush_deferred
+
+        def queue_full(dropped: int, rid=resource_id):
+            if self.flight is not None:
+                self.flight.note(
+                    "sink_queue_full", sink=rid, dropped=dropped
+                )
+        worker.on_queue_full = queue_full
+
+        if worker.defer_flush is None and self.olp is not None:
+            worker.defer_flush = (
+                lambda: self.olp.defer_sink_flush
+            )
         await worker.start()
         self._workers[resource_id] = worker
         return worker
@@ -314,8 +573,13 @@ class ResourceManager:
         worker = self._workers.pop(resource_id, None)
         if worker is None:
             return False
-        # a deleted resource must not leave its down-alarm behind
+        # a deleted resource must not leave its alarms behind
         worker._alarm(False)
+        if worker.breaker_open and worker.on_breaker_edge is not None:
+            try:
+                worker.on_breaker_edge(False)
+            except Exception:
+                pass
         await worker.stop()
         return True
 
@@ -324,11 +588,32 @@ class ResourceManager:
             await self.remove(rid)
 
     def info(self) -> Dict[str, Dict]:
-        return {
-            rid: {
+        out: Dict[str, Dict] = {}
+        for rid, w in self._workers.items():
+            snap = w.batch_hist.snapshot()
+            out[rid] = {
                 "status": w.status,
                 "buffered": len(w),
+                "breaker_open": w.breaker_open,
+                "batch_size": {
+                    "count": snap.count,
+                    "p50": snap.percentile(50),
+                    "p95": snap.percentile(95),
+                    "p99": snap.percentile(99),
+                },
                 **w.stats,
             }
-            for rid, w in self._workers.items()
+        return out
+
+    def summary(self) -> Dict[str, int]:
+        """Node-info roll-up across every sink worker."""
+        ws = self._workers.values()
+        return {
+            "sinks": len(self._workers),
+            "buffered": sum(len(w) for w in ws),
+            "batches": sum(w.stats["batches"] for w in ws),
+            "flush_deferred": sum(
+                w.stats["flush_deferred"] for w in ws
+            ),
+            "breakers_open": sum(1 for w in ws if w.breaker_open),
         }

@@ -38,7 +38,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..message import Message
-from .runtime import LazyEnv, lookup_var
+from .runtime import LazyEnv, _PayloadStr, lookup_var
 
 # reserved string-lane ids: bools are equality-comparable but must
 # never participate in rank (string) ordering
@@ -79,7 +79,7 @@ class WindowColumns:
 
     __slots__ = (
         "n", "paths", "num", "sid", "err", "prs", "lit_ranks",
-        "envs", "n_strings", "has_nan_value",
+        "envs", "n_strings", "has_nan_value", "vals",
     )
 
     def __init__(
@@ -88,6 +88,7 @@ class WindowColumns:
         paths: Sequence[Tuple[str, ...]],
         lit_strings: Sequence[str],
         envs: Optional[List[Optional[LazyEnv]]] = None,
+        keep_values: bool = False,
     ) -> None:
         n = len(msgs)
         n_paths = len(paths)
@@ -97,11 +98,21 @@ class WindowColumns:
         self.sid = np.full((n_paths, n), SID_NONE, np.int32)
         self.err = np.zeros((n_paths, n), bool)
         self.prs = np.zeros((n_paths, n), bool)
+        # ``keep_values``: also keep each cell's RAW extracted value
+        # (the batched SELECT transform's input — int-ness and nested
+        # objects survive, which the f64/rank planes erase).  None
+        # covers both "missing" and "error" cells; the err lane
+        # disambiguates where it matters (expression operands).
+        self.vals: Optional[List[List[Any]]] = (
+            [[None] * n for _ in range(n_paths)] if keep_values
+            else None
+        )
         if envs is None:
             envs = [None] * n
         self.envs = envs
         self.has_nan_value = False
         num, sid, err, prs = self.num, self.sid, self.err, self.prs
+        vals = self.vals
         # (plane, msg, string, is_term) cells holding a string-interned
         # value, resolved after the scan once the window's full
         # dictionary is known
@@ -120,6 +131,10 @@ class WindowColumns:
         _ERR = object()
 
         def classify(p: int, i: int, v: Any) -> None:
+            if vals is not None and v is not None:
+                # raw-value plane: _PayloadStr flattens to plain str
+                # here, exactly eval_select's output conversion
+                vals[p][i] = str(v) if type(v) is _PayloadStr else v
             if isinstance(v, bool):
                 sid[p, i] = SID_TRUE if v else SID_FALSE
                 prs[p, i] = True
@@ -190,13 +205,20 @@ class WindowColumns:
         and SELECT evaluation ride the same decode cache)."""
         return self.envs[i]
 
-    def f32_safe(self) -> bool:
+    def f32_safe(self, n_paths: Optional[int] = None) -> bool:
         """True when every numeric cell round-trips float32 — the
         device kernel computes in f32 (TPU-native), so a window
         carrying f32-unsafe values (millisecond timestamps are the
         canonical offender) stays on the float64 host twin, exactly
-        the `PredicateProgram._f32_safe` rule."""
-        a = self.num
+        the `PredicateProgram._f32_safe` rule.
+
+        ``n_paths`` limits the scan to the first N path planes: the
+        WHERE stack's planes are a PREFIX of the combined WHERE+SELECT
+        path union, and SELECT-only planes (consumed by the float64
+        numpy materialization, never by the device kernel) must not
+        veto the device path — `SELECT timestamp` would otherwise pin
+        every window to host."""
+        a = self.num if n_paths is None else self.num[:n_paths]
         finite = a[np.isfinite(a)]
         if finite.size == 0:
             return True

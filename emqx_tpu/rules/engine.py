@@ -26,9 +26,9 @@ materialized envs, never pushing the window off the matrix path.
 
 from __future__ import annotations
 
+import json as _json
 import logging
 import os
-import re as _re
 import time
 from collections import Counter
 from dataclasses import dataclass, field
@@ -42,6 +42,10 @@ from .predicate import (
     PredicateProgram, StackedRules, build_stack, compile_where,
 )
 from .runtime import LazyEnv, build_env, eval_select, eval_where
+from .select import (
+    SelectStack, build_select_stack, compile_template,
+    materialize_rows,
+)
 from .sql import ParsedSql, parse_sql
 
 log = logging.getLogger("emqx_tpu.rules")
@@ -52,32 +56,13 @@ RULE_FID = "rule"  # fid class tag
 # on operator care; we hard-cap recursion)
 MAX_REPUBLISH_DEPTH = 8
 
-_PLACEHOLDER = _re.compile(r"\$\{([^}]+)\}")
-
 
 def render_template(template: str, data: Dict[str, Any]) -> str:
-    """${a.b} placeholder substitution (emqx_placeholder parity)."""
-
-    def sub(m):
-        cur: Any = data
-        for part in m.group(1).split("."):
-            if isinstance(cur, dict) and part in cur:
-                cur = cur[part]
-            else:
-                return "undefined"
-        if isinstance(cur, bool):
-            return "true" if cur else "false"
-        if isinstance(cur, bytes):
-            return cur.decode("utf-8", "replace")
-        if isinstance(cur, float) and cur.is_integer():
-            return str(int(cur))
-        if isinstance(cur, (dict, list)):
-            import json
-
-            return json.dumps(cur)
-        return str(cur)
-
-    return _PLACEHOLDER.sub(sub, template)
+    """${a.b} placeholder substitution (emqx_placeholder parity),
+    through the compiled segment-program cache (`select.py`) — action
+    templates attached to registered rules are compiled once at
+    rule-add and skip even the cache probe."""
+    return compile_template(template).render(data)
 
 
 @dataclass
@@ -167,9 +152,24 @@ class RuleEngine:
         # property suites' oracle); None takes the matrix path with
         # host-vs-device resolved by the match engine's cost EWMAs
         self.eval_force: Optional[str] = None
+        # SELECT lane pin: "scalar" keeps the interpreter referee for
+        # every rule's SELECT+actions, "batched" pins the column
+        # transform past the cost gate, None auto (EWMA-gated)
+        self.select_force: Optional[str] = None
+        self._sel_cache: Optional[Tuple[int, SelectStack]] = None
+        # cost-EWMA gate state (the WHERE matrix idiom): per-row us
+        # for each lane, sampled on single-lane windows only; tripping
+        # the breaker pins scalar until registry churn
+        self._sel_batch_off = False
+        self._sel_us_b: Optional[float] = None
+        self._sel_us_s: Optional[float] = None
+        self._sel_n_b = 0
+        self._sel_n_s = 0
         self._stats = {
             "matrix_windows": 0, "scalar_windows": 0,
             "fallback_rule_evals": 0,
+            "select_batched_rows": 0, "select_scalar_rows": 0,
+            "select_ewma_off": 0,
         }
         cfg_on = True
         if broker is not None:
@@ -193,6 +193,11 @@ class RuleEngine:
         self._pos_row = np.zeros(0, np.int64)
         self._pos_of: Dict[str, int] = {}
         self._ids_cache: Dict[Tuple[str, ...], np.ndarray] = {}
+        # per-position batched-egress plan: (SelectProgram, planes)
+        # when the rule's SELECT lowered AND every action is window-
+        # shaped (Sink/Aggregate); None degrades the rule to the
+        # scalar referee loop
+        self._pos_selp: List[Optional[tuple]] = []
 
     # ------------------------------------------------------ registry
 
@@ -209,6 +214,24 @@ class RuleEngine:
         ])
         self._stack_cache = (self.rules_rev, stack)
         return stack
+
+    def _select_stack(self, stack: StackedRules) -> SelectStack:
+        """The enabled registry's lowered SELECT programs, sharing the
+        WHERE stack's path union (SELECT-only paths are APPENDED, so
+        the WHERE rows' plane indices survive)."""
+        cached = self._sel_cache
+        if cached is not None and cached[0] == self.rules_rev:
+            return cached[1]
+        sel = build_select_stack(
+            [
+                (rid, r.parsed)
+                for rid, r in self.rules.items()
+                if r.enabled
+            ],
+            stack.paths,
+        )
+        self._sel_cache = (self.rules_rev, sel)
+        return sel
 
     def add_rule(
         self,
@@ -236,6 +259,16 @@ class RuleEngine:
             description=description,
             program=compile_where(parsed.where),
         )
+        # precompile every action template ONCE at rule-add (the old
+        # render_template re-walked the regex per message); both the
+        # batched transform and the scalar referee render through the
+        # attached programs
+        for a in rule.actions:
+            if isinstance(a, RepublishAction):
+                a._topic_prog = compile_template(a.topic)
+                a._payload_prog = compile_template(a.payload)
+            elif isinstance(a, SinkAction) and a.payload is not None:
+                a._payload_prog = compile_template(a.payload)
         self.rules[rule_id] = rule
         self.rules_rev += 1
         if self.broker is not None:
@@ -322,8 +355,10 @@ class RuleEngine:
             self._matrix_enabled and self.eval_force != "scalar"
         )
         stack: Optional[StackedRules] = None
+        selstack: Optional[SelectStack] = None
         if use_matrix:
             stack = self._stacked()
+            selstack = self._select_stack(stack)
         key = (self.rules_rev, use_matrix)
         if self._flat_key != key:
             self._flat_key = key
@@ -345,6 +380,23 @@ class RuleEngine:
                 np.int64, n_all,
             )
             self._ids_cache = {}
+            sel_progs = selstack.progs if selstack is not None else {}
+            self._pos_selp = [
+                (
+                    (sel_progs[r.rule_id],
+                     selstack.planes[r.rule_id])
+                    if r.enabled and r.rule_id in sel_progs
+                    and r.actions
+                    and all(
+                        isinstance(a, (SinkAction, AggregateAction))
+                        for a in r.actions
+                    )
+                    else None
+                )
+                for r in objs
+            ]
+            # registry churn re-arms the SELECT cost gate
+            self._sel_batch_off = False
         objs = self._pos_objs
         n_pos = len(objs)
         pos_of = self._pos_of
@@ -373,13 +425,36 @@ class RuleEngine:
         plive = self._pos_live[ppos]
         prow = self._pos_row[ppos]
         matrix = None
+        cols: Optional[WindowColumns] = None
         if use_matrix:
             known = prow >= 0
             active = np.unique(prow[known])
-            if active.size:
+            # SELECT lane decision: extract the combined WHERE+SELECT
+            # path union (WHERE rows' plane indices are a prefix, so
+            # the matrix kernels are untouched) and keep raw values
+            # whenever some live matched rule has a batched plan
+            use_all = (
+                selstack.n_lowered > 0
+                and self.select_force != "scalar"
+                and (
+                    self.select_force == "batched"
+                    or not self._sel_batch_off
+                )
+            )
+            batch_sel = False
+            if use_all and ppos.size:
+                selp = self._pos_selp
+                batch_sel = any(
+                    selp[p] is not None
+                    for p in np.unique(ppos[plive]).tolist()
+                )
+            if active.size or batch_sel:
                 t0 = time.perf_counter()
                 cols = WindowColumns(
-                    msgs, stack.paths, stack.lit_strings, envs
+                    msgs,
+                    selstack.all_paths if use_all else stack.paths,
+                    stack.lit_strings, envs,
+                    keep_values=batch_sel,
                 )
                 t1 = time.perf_counter()
                 if cols.has_nan_value:
@@ -388,13 +463,13 @@ class RuleEngine:
                     # rules take the interpreter (bit-exactness over
                     # speed for a pathological payload)
                     pass
-                elif self.broker is not None:
+                elif active.size and self.broker is not None:
                     matrix, _path = (
                         self.broker.router.engine.rules_eval_window(
                             stack, self.rules_rev, cols, rows=active
                         )
                     )
-                else:  # standalone engines: the host twin directly
+                elif active.size:  # standalone: the host twin directly
                     from ..ops.match_kernel import rules_eval_host
 
                     sub = rules_eval_host(
@@ -458,20 +533,151 @@ class RuleEngine:
             sel_l = sel[order].tolist()
             ppos_l = ppos.tolist()
             pmsg_l = pmsg.tolist()
-            for j in sel_l:
-                rule = objs[ppos_l[j]]
+            selp = self._pos_selp
+            use_batched = cols is not None and cols.vals is not None
+            t_act0 = time.perf_counter()  # hoisted (no clocks in loop)
+            rows_b = 0
+            rows_s = 0
+            k = 0
+            n_sel = len(sel_l)
+            while k < n_sel:
+                # consecutive run of pairs for ONE rule (sel_l is
+                # rule-major after the lexsort)
+                pos = ppos_l[sel_l[k]]
+                k2 = k + 1
+                while k2 < n_sel and ppos_l[sel_l[k2]] == pos:
+                    k2 += 1
+                rule = objs[pos]
                 if not rule.actions:
                     # nothing consumes the SELECT columns: skip the
                     # per-hit projection entirely (counter-only rules)
+                    k = k2
                     continue
-                i = pmsg_l[j]
-                selected = eval_select(rule.parsed, env(i))
-                self._run_actions(rule, selected, msgs[i], mloc)
+                plan = selp[pos] if use_batched else None
+                if plan is not None:
+                    rows = [pmsg_l[sel_l[t]] for t in range(k, k2)]
+                    self._run_rule_batched(rule, plan, cols, rows, mloc)
+                    rows_b += k2 - k
+                else:
+                    for t in range(k, k2):
+                        i = pmsg_l[sel_l[t]]
+                        selected = eval_select(rule.parsed, env(i))
+                        self._run_actions(rule, selected, msgs[i], mloc)
+                    rows_s += k2 - k
+                k = k2
+            t_act1 = time.perf_counter()
+            self._sel_lane_account(rows_b, rows_s, t_act1 - t_act0)
         if hits:
             mloc["rules.matched"] += hits
         if self.broker is not None and mloc:
             self.broker.metrics.inc_bulk(mloc)
         return hits
+
+    def _sel_lane_account(
+        self, rows_b: int, rows_s: int, dt: float
+    ) -> None:
+        """Fold one window's SELECT+action lap into the per-lane cost
+        EWMAs (sampled on single-lane windows only, so the figures
+        aren't cross-contaminated) and trip the batched lane's cost
+        breaker when it measures materially slower than the scalar
+        referee — re-armed by registry churn, overridden by
+        ``select_force``."""
+        if rows_b and not rows_s:
+            us = dt * 1e6 / rows_b
+            self._sel_us_b = (
+                us if self._sel_us_b is None
+                else 0.2 * us + 0.8 * self._sel_us_b
+            )
+            self._sel_n_b += 1
+        elif rows_s and not rows_b:
+            us = dt * 1e6 / rows_s
+            self._sel_us_s = (
+                us if self._sel_us_s is None
+                else 0.2 * us + 0.8 * self._sel_us_s
+            )
+            self._sel_n_s += 1
+        if rows_b:
+            self._stats["select_batched_rows"] += rows_b
+        if rows_s:
+            self._stats["select_scalar_rows"] += rows_s
+        if (
+            self.select_force is None
+            and not self._sel_batch_off
+            and self._sel_n_b >= 16
+            and self._sel_n_s >= 16
+            and self._sel_us_b is not None
+            and self._sel_us_s is not None
+            and self._sel_us_b > self._sel_us_s * 1.5
+        ):
+            self._sel_batch_off = True
+            self._stats["select_ewma_off"] += 1
+
+    def _run_rule_batched(
+        self,
+        rule: Rule,
+        plan: tuple,
+        cols: WindowColumns,
+        rows: List[int],
+        mloc: Counter,
+    ) -> None:
+        """One rule's whole matched-row set through its lowered
+        SELECT and window-shaped actions: one `materialize_rows` pass
+        over the shared column planes, then ONE bulk handoff per
+        (action, window) — `BufferWorker.enqueue_batch` for sinks,
+        one `Aggregator.push` for aggregate actions.  Counter totals
+        and per-sink query streams match the scalar referee exactly
+        (same values, same order); only the cross-ACTION interleave
+        differs (batched emits action-major within a rule)."""
+        prog, planes = plan
+        names, colvals = materialize_rows(prog, planes, cols, rows)
+        n = len(rows)
+        resources = (
+            self.broker.resources if self.broker is not None else None
+        )
+        for action in rule.actions:
+            try:
+                if isinstance(action, AggregateAction):
+                    action.aggregator.push([
+                        dict(zip(names, row)) for row in zip(*colvals)
+                    ])
+                else:  # SinkAction (plan eligibility guarantees it)
+                    if resources is None:
+                        raise RuntimeError(
+                            "sink action without a broker"
+                        )
+                    worker = resources.get(action.resource_id)
+                    if worker is None:
+                        raise RuntimeError(
+                            f"unknown resource {action.resource_id!r}"
+                        )
+                    if action.payload is not None:
+                        prog_t = getattr(action, "_payload_prog", None)
+                        if prog_t is None:
+                            prog_t = compile_template(action.payload)
+                        colmap: Dict[str, Any] = {}
+                        for nm, col in zip(names, colvals):
+                            colmap[nm] = col
+                        queries = prog_t.render_rows(colmap, n)
+                    else:
+                        queries = [
+                            _json.dumps(
+                                dict(zip(names, row)), default=str
+                            )
+                            for row in zip(*colvals)
+                        ]
+                    worker.enqueue_batch(queries)
+                rule.actions_success += n
+                mloc["actions.success"] += n
+                mloc["actions.batched"] += n
+            except Exception as exc:
+                rule.actions_failed += n
+                mloc["actions.failed"] += n
+                log.warning(
+                    "rule %s batched action %s failed: %s",
+                    rule.rule_id,
+                    getattr(action, "kind", action),
+                    exc,
+                )
 
     def _run_actions(
         self,
@@ -508,9 +714,15 @@ class RuleEngine:
             depth = int(msg.headers.get("republish_depth", 0))
             if depth >= MAX_REPUBLISH_DEPTH:
                 raise RuntimeError("republish depth cap hit (rule loop?)")
+            tprog = getattr(action, "_topic_prog", None)
+            if tprog is None:
+                tprog = compile_template(action.topic)
+            pprog = getattr(action, "_payload_prog", None)
+            if pprog is None:
+                pprog = compile_template(action.payload)
             out = Message(
-                topic=render_template(action.topic, selected),
-                payload=render_template(action.payload, selected).encode(),
+                topic=tprog.render(selected),
+                payload=pprog.render(selected).encode(),
                 qos=action.qos,
                 retain=action.retain,
                 from_client=msg.from_client,
@@ -535,10 +747,11 @@ class RuleEngine:
                     f"unknown resource {action.resource_id!r}"
                 )
             if action.payload is not None:
-                query: Any = render_template(action.payload, selected)
+                pprog = getattr(action, "_payload_prog", None)
+                if pprog is None:
+                    pprog = compile_template(action.payload)
+                query: Any = pprog.render(selected)
             else:
-                import json as _json
-
                 query = _json.dumps(selected, default=str)
             worker.enqueue(query)
         else:
@@ -562,6 +775,7 @@ class RuleEngine:
         engine's per-cell cost EWMAs and breaker state — exposed
         through ``/metrics``, ``GET /api/v5/rules`` and $SYS."""
         stack = self._stacked()
+        selstack = self._select_stack(stack)
         out: Dict[str, Any] = {
             "rules": len(self.rules),
             "lowered": stack.n_lowered,
@@ -571,6 +785,15 @@ class RuleEngine:
             "matrix_windows": self._stats["matrix_windows"],
             "scalar_windows": self._stats["scalar_windows"],
             "fallback_rule_evals": self._stats["fallback_rule_evals"],
+            # output half (PR 20): lowered SELECT registry split, the
+            # per-lane row counts and cost EWMAs, breaker state
+            "select_lowered": selstack.n_lowered,
+            "select_batched_rows": self._stats["select_batched_rows"],
+            "select_scalar_rows": self._stats["select_scalar_rows"],
+            "select_ewma_off": self._stats["select_ewma_off"],
+            "select_batched_us_ewma": self._sel_us_b,
+            "select_scalar_us_ewma": self._sel_us_s,
+            "select_batch_disabled": self._sel_batch_off,
         }
         if self.broker is not None:
             eng = self.broker.router.engine

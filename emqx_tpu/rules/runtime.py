@@ -213,7 +213,15 @@ def _eval_op(sym: str, le: tuple, re_: tuple, env: Dict[str, Any]) -> Any:
         return {
             ">": a > b, "<": a < b, ">=": a >= b, "<=": a <= b
         }[sym]
-    # arithmetic
+    return arith_op(sym, a, b)
+
+
+def arith_op(sym: str, a: Any, b: Any) -> Any:
+    """One arithmetic step over already-evaluated operands — shared by
+    the interpreter (`_eval_op`) and the batched SELECT transform's
+    compiled expression closures (`select.py`), so the two lanes are
+    bit-identical by construction (int-ness preservation, string
+    concat '+', truncating div/mod, div-by-zero -> EvalError)."""
     if sym == "+" and isinstance(a, str) and isinstance(b, str):
         return a + b  # string concat like the reference's '+'
     if not (

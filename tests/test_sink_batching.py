@@ -1,0 +1,632 @@
+"""Window-shaped sink egress (PR 20): micro-batch thresholds, circuit
+breaker park/replay, olp flush deferral, manager alarm wiring, and the
+chaos seams `resource.batch.flush` / `bridge.mqtt.send`.
+
+The delivery contract under every injected fault is AT-LEAST-ONCE:
+error/drop replays the parked window (nothing lost), duplicate
+double-delivers (never consumes twice from the buffer)."""
+
+import asyncio
+import time
+
+import pytest
+
+from emqx_tpu import failpoints as fp
+from emqx_tpu.bridge_mqtt import MqttEgressResource
+from emqx_tpu.resources import (
+    BufferWorker, Resource, ResourceManager,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture(autouse=True)
+def _clear_failpoints():
+    fp.clear()
+    yield
+    fp.clear()
+
+
+async def wait_until(cond, timeout=5.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        if time.monotonic() > deadline:
+            raise AssertionError(f"timed out waiting for {what}")
+        await asyncio.sleep(0.005)
+
+
+class BatchSink(Resource):
+    """Records every on_query/on_query_batch call; scriptable
+    failures and partial consumes."""
+
+    max_batch = 64
+
+    def __init__(self):
+        self.batches = []  # list of lists, one per batch call
+        self.singles = []
+        self.fail_next = 0  # raise on the next N delivery attempts
+        self.healthy = True
+        self.consume_limit = None  # partial-consume ceiling
+
+    @property
+    def received(self):
+        out = list(self.singles)
+        for b in self.batches:
+            out.extend(b)
+        return out
+
+    async def on_query(self, query):
+        if self.fail_next > 0:
+            self.fail_next -= 1
+            raise ConnectionError("sink down (scripted)")
+        self.singles.append(query)
+
+    async def on_query_batch(self, queries):
+        if self.fail_next > 0:
+            self.fail_next -= 1
+            raise ConnectionError("sink down (scripted)")
+        if self.consume_limit is not None:
+            queries = queries[: self.consume_limit]
+        self.batches.append(list(queries))
+        return len(queries)
+
+    async def health_check(self):
+        return self.healthy
+
+
+# ------------------------------------------------------- thresholds
+
+
+def test_count_threshold_releases_before_age():
+    async def t():
+        sink = BatchSink()
+        w = BufferWorker(
+            sink, batch_records=4, batch_age=30.0, retry_base=0.01
+        )
+        await w.start()
+        try:
+            for i in range(4):
+                w.enqueue(("q", i))
+            await wait_until(
+                lambda: len(sink.received) == 4, what="flush"
+            )
+            # one window, not four round-trips, and far before the
+            # 30 s age budget
+            assert sink.batches == [[("q", 0), ("q", 1),
+                                     ("q", 2), ("q", 3)]]
+            assert w.stats["batches"] == 1
+            assert w.batch_hist.snapshot().count == 1
+        finally:
+            await w.stop()
+
+    run(t())
+
+
+def test_byte_threshold_releases_before_age():
+    async def t():
+        sink = BatchSink()
+        w = BufferWorker(
+            sink, batch_records=10_000, batch_bytes=64,
+            batch_age=30.0, retry_base=0.01,
+        )
+        await w.start()
+        try:
+            w.enqueue(b"x" * 100)  # alone crosses 64 bytes
+            await wait_until(
+                lambda: len(sink.received) == 1, what="flush"
+            )
+            assert w.stats["batches"] == 1
+        finally:
+            await w.stop()
+
+    run(t())
+
+
+def test_age_threshold_flushes_partial_batch():
+    async def t():
+        sink = BatchSink()
+        w = BufferWorker(
+            sink, batch_records=1000, batch_age=0.03,
+            retry_base=0.01,
+        )
+        await w.start()
+        try:
+            w.enqueue("a")
+            w.enqueue("b")
+            await asyncio.sleep(0.01)
+            assert sink.received == []  # still lingering
+            await wait_until(
+                lambda: len(sink.received) == 2, what="age flush"
+            )
+            assert sink.batches == [["a", "b"]]
+        finally:
+            await w.stop()
+
+    run(t())
+
+
+def test_enqueue_batch_drop_oldest_and_edge_event():
+    async def t():
+        sink = BatchSink()
+        sink.healthy = False
+        w = BufferWorker(
+            sink, max_buffer=5, batch_age=30.0, batch_records=1000
+        )
+        edges = []
+        w.on_queue_full = edges.append
+        dropped = w.enqueue_batch([f"q{i}" for i in range(8)])
+        assert dropped == 3
+        assert list(w._buf) == ["q3", "q4", "q5", "q6", "q7"]
+        assert w.stats["dropped"] == 3
+        assert w.stats["matched"] == 8
+        # edge-triggered: ONE event per excursion, not per drop
+        assert edges == [3]
+        w.enqueue_batch(["q8"])
+        assert edges == [3]
+        assert w.enqueue_batch([]) == 0
+
+    run(t())
+
+
+# -------------------------------------------- breaker park + replay
+
+
+def test_breaker_opens_parks_and_replays_on_probe():
+    async def t():
+        sink = BatchSink()
+        w = BufferWorker(
+            sink, batch_records=2, batch_age=0.005,
+            breaker_threshold=3, retry_base=0.001,
+            health_interval=0.02,
+        )
+        edges = []
+        w.on_breaker_edge = edges.append
+        await w.start()
+        try:
+            sink.fail_next = 10**9
+            sink.healthy = False
+            for i in range(6):
+                w.enqueue(i)
+            await wait_until(
+                lambda: w.breaker_open, what="breaker open"
+            )
+            assert edges == [True]
+            assert w.stats["breaker_opens"] == 1
+            assert len(w) == 6  # everything parked, nothing dropped
+            attempts_when_open = sink.fail_next
+            await asyncio.sleep(0.05)
+            # parked: the drain loop probes health, it does NOT keep
+            # hammering the sink with deliveries
+            assert sink.fail_next == attempts_when_open
+            assert w.breaker_open
+            # heal: the probe re-closes and the whole backlog replays
+            sink.fail_next = 0
+            sink.healthy = True
+            await wait_until(
+                lambda: len(sink.received) == 6, what="replay"
+            )
+            assert not w.breaker_open
+            assert edges == [True, False]
+            assert sorted(sink.received) == list(range(6))
+            assert w.stats["dropped"] == 0 and w.stats["failed"] == 0
+        finally:
+            await w.stop()
+
+    run(t())
+
+
+def test_max_retries_drop_path_still_works_without_breaker():
+    async def t():
+        sink = BatchSink()
+        sink.max_batch = 1  # scalar path
+        w = BufferWorker(
+            sink, max_retries=2, retry_base=0.001, retry_cap=0.002
+        )
+        await w.start()
+        try:
+            sink.fail_next = 10**9
+            w.enqueue("doomed")
+            await wait_until(
+                lambda: w.stats["failed"] == 1, what="retry drop"
+            )
+            assert len(w) == 0
+        finally:
+            await w.stop()
+
+    run(t())
+
+
+# ------------------------------------------------ olp flush deferral
+
+
+def test_defer_flush_stretches_age_linger():
+    async def t():
+        sink = BatchSink()
+        defer = {"on": True}
+        w = BufferWorker(
+            sink, batch_records=1000, batch_age=0.04,
+            defer_flush=lambda: defer["on"], retry_base=0.01,
+        )
+        noted = []
+        w.on_flush_deferred = lambda: noted.append(1)
+        await w.start()
+        try:
+            w.enqueue("held")
+            await asyncio.sleep(0.08)  # past batch_age, inside 4x
+            assert sink.received == []
+            assert w.stats["flush_deferred"] == 1
+            assert noted == [1]  # one event per pending batch
+            defer["on"] = False  # ladder cleared -> flush promptly
+            await wait_until(
+                lambda: sink.received == ["held"], what="flush"
+            )
+            # stretched age is CAPPED: even a stuck ladder flushes
+            w.enqueue("capped")
+            defer["on"] = True
+            await wait_until(
+                lambda: "capped" in sink.received, timeout=1.0,
+                what="capped flush",
+            )
+            assert w.stats["flush_deferred"] == 2
+        finally:
+            await w.stop()
+
+    run(t())
+
+
+# --------------------------------------------- manager hook wiring
+
+
+class FakeAlarms:
+    def __init__(self):
+        self.active = {}
+        self.log = []
+
+    def activate(self, name, details=None, message=""):
+        self.active[name] = message
+        self.log.append(("activate", name))
+
+    def deactivate(self, name):
+        self.active.pop(name, None)
+        self.log.append(("deactivate", name))
+
+
+class FakeFlight:
+    def __init__(self):
+        self.edges = []
+        self.notes = []
+
+    def breaker_edge(self, opened, info):
+        self.edges.append((opened, dict(info)))
+
+    def note(self, kind, **fields):
+        self.notes.append((kind, fields))
+
+
+class FakeMetrics:
+    def __init__(self):
+        self.counts = {}
+
+    def inc(self, name, by=1):
+        self.counts[name] = self.counts.get(name, 0) + by
+
+
+def test_manager_wires_breaker_alarm_flight_and_olp_counter():
+    async def t():
+        mgr = ResourceManager(alarms=FakeAlarms())
+        mgr.flight = FakeFlight()
+        mgr.metrics = FakeMetrics()
+        sink = BatchSink()
+        w = await mgr.create(
+            "k1", sink, batch_records=2, batch_age=0.005,
+            breaker_threshold=2, retry_base=0.001,
+            health_interval=0.02, max_buffer=4,
+        )
+        try:
+            sink.fail_next = 10**9
+            sink.healthy = False
+            w.enqueue("a")
+            await wait_until(
+                lambda: w.breaker_open, what="breaker open"
+            )
+            assert "sink_breaker:k1" in mgr.alarms.active
+            assert mgr.flight.edges == [(True, {"sink": "k1"})]
+            # queue-full excursion lands in the black box
+            for i in range(9):
+                w.enqueue(i)
+            assert mgr.flight.notes[0][0] == "sink_queue_full"
+            assert mgr.flight.notes[0][1]["sink"] == "k1"
+            sink.fail_next = 0
+            sink.healthy = True
+            await wait_until(
+                lambda: not w.breaker_open, what="breaker close"
+            )
+            assert "sink_breaker:k1" not in mgr.alarms.active
+            assert mgr.flight.edges[-1] == (False, {"sink": "k1"})
+            # info()/summary() expose the batch shape, JSON-safe
+            import json as _j
+            info = mgr.info()["k1"]
+            _j.dumps(info)
+            assert set(info["batch_size"]) == {
+                "count", "p50", "p95", "p99"
+            }
+            assert mgr.summary()["sinks"] == 1
+        finally:
+            await mgr.stop_all()
+        # removal cleared the down-alarm too
+        assert "resource_down:k1" not in mgr.alarms.active
+
+    run(t())
+
+
+def test_manager_flush_deferred_counts_olp_metric():
+    async def t():
+        mgr = ResourceManager()
+        mgr.metrics = FakeMetrics()
+
+        class Olp:
+            defer_sink_flush = True
+
+        mgr.olp = Olp()
+        sink = BatchSink()
+        w = await mgr.create(
+            "k2", sink, batch_records=1000, batch_age=0.02,
+        )
+        try:
+            w.enqueue("x")
+            await wait_until(
+                lambda: sink.received == ["x"], what="capped flush"
+            )
+            assert (
+                mgr.metrics.counts["olp.deferred.sink_flush"] == 1
+            )
+            assert w.stats["flush_deferred"] == 1
+        finally:
+            await mgr.stop_all()
+
+    run(t())
+
+
+# --------------------------------- chaos: resource.batch.flush seam
+
+
+def test_chaos_batch_flush_error_retries_without_loss():
+    async def t():
+        sink = BatchSink()
+        w = BufferWorker(
+            sink, batch_records=4, batch_age=0.005,
+            retry_base=0.001, retry_cap=0.002,
+        )
+        await w.start()
+        try:
+            fp.configure(
+                "resource.batch.flush", "error", times=3
+            )
+            for i in range(4):
+                w.enqueue(i)
+            await wait_until(
+                lambda: len(sink.received) == 4, what="delivery"
+            )
+            assert sink.received == [0, 1, 2, 3]
+            assert w.stats["retried"] == 3
+            assert w.stats["dropped"] == 0
+            assert w.stats["failed"] == 0
+        finally:
+            await w.stop()
+
+    run(t())
+
+
+def test_chaos_batch_flush_drop_replays_whole_window():
+    async def t():
+        sink = BatchSink()
+        w = BufferWorker(
+            sink, batch_records=3, batch_age=0.005,
+            retry_base=0.001,
+        )
+        await w.start()
+        try:
+            fp.configure("resource.batch.flush", "drop", times=1)
+            for i in range(3):
+                w.enqueue(i)
+            await wait_until(
+                lambda: len(sink.received) == 3, what="replay"
+            )
+            # the dropped flush never reached the sink; the replay
+            # delivered the SAME window once — no loss, no dup
+            assert sink.batches == [[0, 1, 2]]
+            assert w.stats["retried"] == 1
+        finally:
+            await w.stop()
+
+    run(t())
+
+
+def test_chaos_batch_flush_duplicate_is_at_least_once():
+    async def t():
+        sink = BatchSink()
+        w = BufferWorker(
+            sink, batch_records=3, batch_age=0.005,
+            retry_base=0.001,
+        )
+        await w.start()
+        try:
+            fp.configure(
+                "resource.batch.flush", "duplicate", times=1
+            )
+            for i in range(3):
+                w.enqueue(i)
+            await wait_until(
+                lambda: len(sink.batches) >= 2, what="dup delivery"
+            )
+            await asyncio.sleep(0.02)
+            # delivered twice, but consumed from the buffer ONCE
+            assert sink.batches == [[0, 1, 2], [0, 1, 2]]
+            assert len(w) == 0
+            assert w.stats["success"] == 3
+            assert w.stats["dropped"] == 0
+        finally:
+            await w.stop()
+
+    run(t())
+
+
+def test_chaos_partial_consume_replays_tail():
+    async def t():
+        sink = BatchSink()
+        w = BufferWorker(
+            sink, batch_records=4, batch_age=0.005,
+            retry_base=0.001,
+        )
+        await w.start()
+        try:
+            sink.consume_limit = 3  # sink takes 3 of the 4
+            for i in range(4):
+                w.enqueue(i)
+            await wait_until(
+                lambda: len(sink.batches) >= 1, what="first flush"
+            )
+            sink.consume_limit = None
+            await wait_until(
+                lambda: len(w) == 0, what="tail replay"
+            )
+            assert sink.batches[0] == [0, 1, 2]
+            assert sink.batches[1] == [3]  # tail replayed, no loss
+        finally:
+            await w.stop()
+
+    run(t())
+
+
+# ------------------------------------ chaos: bridge.mqtt.send seam
+
+
+class StubMqttClient:
+    """Duck-typed MqttClient: records publishes, scriptable per-call
+    failures, so the egress window semantics are tested without a
+    socket."""
+
+    def __init__(self, client_id="eg1"):
+        self.client_id = client_id
+        self.connected = asyncio.Event()
+        self.connected.set()
+        self.published = []
+        self.fail_topics = set()
+
+    async def publish(self, topic, payload, qos=0, retain=False):
+        await asyncio.sleep(0)
+        if topic in self.fail_topics:
+            raise ConnectionError(f"publish {topic} failed")
+        self.published.append((topic, payload, qos, retain))
+
+    async def start(self):
+        pass
+
+    async def stop(self):
+        pass
+
+
+def _egress(client):
+    res = MqttEgressResource.__new__(MqttEgressResource)
+    res.client = client
+    return res
+
+
+def test_bridge_window_prefix_consume_and_replay():
+    async def t():
+        client = StubMqttClient()
+        res = _egress(client)
+        w = BufferWorker(
+            res, batch_records=3, batch_age=0.005,
+            retry_base=0.001,
+        )
+        await w.start()
+        try:
+            client.fail_topics.add("t/1")
+            w.enqueue(("t/0", b"a", 1, False))
+            w.enqueue(("t/1", b"b", 1, False))
+            w.enqueue(("t/2", b"c", 1, False))
+            await wait_until(
+                lambda: len(client.published) >= 2,
+                what="first window",
+            )
+            client.fail_topics.clear()
+            await wait_until(lambda: len(w) == 0, what="replay")
+            topics = [t for t, _, _, _ in client.published]
+            # prefix consumed; the failed message and its tail
+            # replayed — at-least-once, nothing lost
+            assert topics.count("t/0") >= 1
+            assert topics.count("t/1") == 1
+            assert topics.count("t/2") >= 1
+            assert w.stats["dropped"] == 0
+        finally:
+            await w.stop()
+
+    run(t())
+
+
+def test_bridge_send_chaos_drop_and_duplicate():
+    async def t():
+        client = StubMqttClient()
+        res = _egress(client)
+        w = BufferWorker(
+            res, batch_records=2, batch_age=0.005,
+            retry_base=0.001,
+        )
+        await w.start()
+        try:
+            fp.configure(
+                "bridge.mqtt.send", "drop", times=1,
+                match="eg1",
+            )
+            w.enqueue(("t/a", b"1", 0, False))
+            w.enqueue(("t/b", b"2", 0, False))
+            await wait_until(lambda: len(w) == 0, what="replay")
+            topics = [t for t, _, _, _ in client.published]
+            # drop claims 0 consumed -> worker replays; exactly one
+            # real delivery
+            assert topics == ["t/a", "t/b"]
+            assert w.stats["retried"] == 1
+
+            fp.clear()
+            fp.configure(
+                "bridge.mqtt.send", "duplicate", times=1,
+                match="eg1",
+            )
+            client.published.clear()
+            w.enqueue(("t/c", b"3", 0, False))
+            w.enqueue(("t/d", b"4", 0, False))
+            await wait_until(
+                lambda: len(client.published) >= 4, what="dup"
+            )
+            topics = [t for t, _, _, _ in client.published]
+            assert topics == ["t/c", "t/d", "t/c", "t/d"]
+            assert len(w) == 0  # consumed once despite double send
+        finally:
+            await w.stop()
+
+    run(t())
+
+
+def test_bridge_send_chaos_keyed_to_other_client_is_inert():
+    async def t():
+        client = StubMqttClient(client_id="eg1")
+        res = _egress(client)
+        w = BufferWorker(
+            res, batch_records=1, batch_age=0.005, retry_base=0.001
+        )
+        await w.start()
+        try:
+            fp.configure(
+                "bridge.mqtt.send", "drop", match="other-bridge"
+            )
+            w.enqueue(("t/x", b"p", 0, False))
+            await wait_until(lambda: len(w) == 0, what="send")
+            assert [t for t, _, _, _ in client.published] == ["t/x"]
+            assert w.stats["retried"] == 0
+        finally:
+            await w.stop()
+
+    run(t())

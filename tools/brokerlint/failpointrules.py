@@ -54,6 +54,10 @@ SEAM_FUNCS: Tuple[Seam, ...] = (
     Seam("emqx_tpu/kafka.py", "KafkaClient.produce", "kafka.produce"),
     Seam("emqx_tpu/resources.py", "BufferWorker._run",
          "resource.buffer.query"),
+    Seam("emqx_tpu/resources.py", "BufferWorker._flush_once",
+         "resource.batch.flush"),
+    Seam("emqx_tpu/bridge_mqtt.py", "MqttEgressResource.on_query_batch",
+         "bridge.mqtt.send"),
     Seam("emqx_tpu/exhook/client.py", "ExhookClient._call",
          "exhook.call"),
     Seam("emqx_tpu/ds/beamformer.py", "Beamformer.poll",

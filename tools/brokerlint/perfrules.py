@@ -65,6 +65,12 @@ DISPATCH_FUNCS = (
     # work may creep back in
     DispatchFn("emqx_tpu/rules/engine.py", "RuleEngine.apply_batch"),
     DispatchFn("emqx_tpu/rules/columns.py", "WindowColumns.__init__"),
+    # windowed egress (PR 20): batched SELECT materialization and the
+    # sink flush loop move per-ROW work to per-WINDOW — keep it there
+    DispatchFn("emqx_tpu/rules/select.py", "materialize_rows"),
+    DispatchFn("emqx_tpu/rules/engine.py",
+               "RuleEngine._run_rule_batched"),
+    DispatchFn("emqx_tpu/resources.py", "BufferWorker._flush_once"),
     DispatchFn("emqx_tpu/engine.py", "MatchEngine.rules_eval_window"),
     DispatchFn("emqx_tpu/broker/broker.py", "Broker._resume_enqueue"),
     DispatchFn("emqx_tpu/broker/session.py", "Session.deliver"),
